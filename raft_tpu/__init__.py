@@ -1,0 +1,73 @@
+"""raft_tpu: a TPU-native library of reusable ML / data-analytics primitives.
+
+A ground-up JAX/XLA/Pallas re-design of the capability surface of RAPIDS RAFT
+(reference: jinsolp/raft 26.06.00 — see /root/reference, SURVEY.md): dense and
+sparse linear algebra, matrix primitives (select_k, argmin, gather), statistics
+and metrics, random generation, iterative and combinatorial solvers (Lanczos,
+MST, LAP), spectral analysis, and multi-chip communicator infrastructure.
+
+Layering mirrors the reference's shape (handle + resources, primitive free
+functions, comms-in-handle, thin Python parity layer) but every implementation
+is TPU-first: XLA ops and Pallas kernels instead of CUDA, jit-traced functions
+instead of streams, named-axis `jax.sharding.Mesh` collectives instead of NCCL.
+
+Subpackages
+-----------
+core     : resources handle, array model, operators, serialization, logging
+comms    : communicator over mesh collectives (ref: cpp/include/raft/comms/)
+linalg   : dense linear algebra           (ref: cpp/include/raft/linalg/)
+matrix   : dense matrix ops incl select_k (ref: cpp/include/raft/matrix/)
+sparse   : sparse formats, ops, solvers   (ref: cpp/include/raft/sparse/)
+spectral : spectral analyzers             (ref: cpp/include/raft/spectral/)
+stats    : statistics and metrics         (ref: cpp/include/raft/stats/)
+random   : RNG and dataset generators     (ref: cpp/include/raft/random/)
+solver   : linear assignment problem      (ref: cpp/include/raft/solver/)
+label    : label utilities                (ref: cpp/include/raft/label/)
+distance : pairwise distances (rebuilt from the contractions primitive layer)
+cluster  : k-means (rebuilt from primitives, incl. multi-chip SPMD)
+util     : host/device helper utilities   (ref: cpp/include/raft/util/)
+"""
+
+__version__ = "0.1.0"
+
+from raft_tpu.core.resources import (  # noqa: F401
+    Resources,
+    device_resources,
+    DeviceResources,
+)
+
+# Subpackages are imported lazily by attribute access to keep `import raft_tpu`
+# cheap (jax itself is imported eagerly by core).
+import importlib as _importlib
+
+_SUBPACKAGES = (
+    "core",
+    "comms",
+    "linalg",
+    "matrix",
+    "sparse",
+    "spectral",
+    "stats",
+    "random",
+    "solver",
+    "label",
+    "cluster",
+    "distance",
+    "util",
+)
+
+
+def __getattr__(name):
+    if name in _SUBPACKAGES:
+        try:
+            module = _importlib.import_module(f"raft_tpu.{name}")
+        except ImportError as e:
+            raise AttributeError(
+                f"subpackage raft_tpu.{name} failed to import: {e}") from e
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module 'raft_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_SUBPACKAGES))

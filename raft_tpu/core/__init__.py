@@ -1,0 +1,60 @@
+"""Core runtime: handle/resources, array model, operators, serialization.
+
+TPU-native re-design of the reference's cpp/include/raft/core/ layer.
+"""
+
+from raft_tpu.core.resources import (  # noqa: F401
+    Resources,
+    ResourceType,
+    ResourceFactory,
+    DeviceResources,
+    Handle,
+    device_resources,
+    get_device_resources,
+    default_resources,
+    get_device,
+    set_device,
+    get_mesh,
+    set_mesh,
+    get_rng_state,
+    set_rng_state,
+    get_comms,
+    set_comms,
+    comms_initialized,
+    get_subcomm,
+    set_subcomm,
+    get_workspace_limit,
+    set_workspace_limit,
+    sync,
+)
+from raft_tpu.core.memory_type import MemoryType, HOST, DEVICE, PINNED, MANAGED  # noqa: F401
+from raft_tpu.core.mdarray import (  # noqa: F401
+    MdArray,
+    MdBuffer,
+    ROW_MAJOR,
+    COL_MAJOR,
+    copy,
+    make_device_matrix,
+    make_device_vector,
+    make_device_scalar,
+    make_device_mdarray,
+    make_host_matrix,
+    make_host_vector,
+    make_host_scalar,
+    make_pinned_matrix,
+    make_managed_matrix,
+    temporary_device_buffer,
+)
+from raft_tpu.core.sparse_types import CSRMatrix, COOMatrix  # noqa: F401
+from raft_tpu.core.bitset import Bitset, Bitmap, popc  # noqa: F401
+from raft_tpu.core.kvp import KeyValuePair, make_kvp  # noqa: F401
+from raft_tpu.core.interruptible import (  # noqa: F401
+    InterruptedException,
+    CancelToken,
+    synchronize,
+)
+from raft_tpu.core import operators  # noqa: F401
+from raft_tpu.core import serialize  # noqa: F401
+from raft_tpu.core import trace  # noqa: F401
+from raft_tpu.core import logger  # noqa: F401
+from raft_tpu.core import memory  # noqa: F401

@@ -1,0 +1,148 @@
+"""Device bitset / bitmap (ref: core/bitset.hpp:90-134,378-425, core/bitmap.hpp).
+
+A bitset is a packed uint32 word array on device with test/set/flip/count
+operations, used for masking and sample filtering.  All operations are
+functional (return a new Bitset) and jit-friendly; ``count`` is the popc
+primitive (ref: util/popc.cuh:23).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+WORD_BITS = 32
+_WORD_DTYPE = jnp.uint32
+
+
+def _n_words(n_bits: int) -> int:
+    return (n_bits + WORD_BITS - 1) // WORD_BITS
+
+
+def popc(words: jnp.ndarray) -> jnp.ndarray:
+    """Population count over a packed word array (ref: util/popc.cuh:23)."""
+    return jnp.sum(jax.lax.population_count(words.astype(_WORD_DTYPE))
+                   .astype(jnp.int32))
+
+
+class Bitset:
+    """Packed bit array of logical length ``n_bits`` over uint32 words."""
+
+    def __init__(self, n_bits: int, words: Optional[jnp.ndarray] = None,
+                 default_value: bool = True):
+        self.n_bits = int(n_bits)
+        if words is None:
+            fill = jnp.uint32(0xFFFFFFFF) if default_value else jnp.uint32(0)
+            words = jnp.full((_n_words(self.n_bits),), fill, dtype=_WORD_DTYPE)
+            words = _mask_tail(words, self.n_bits)
+        self.words = words
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_bools(bools: jnp.ndarray) -> "Bitset":
+        bools = jnp.asarray(bools, dtype=jnp.bool_).ravel()
+        n = bools.shape[0]
+        pad = _n_words(n) * WORD_BITS - n
+        b = jnp.pad(bools, (0, pad)).reshape(-1, WORD_BITS)
+        weights = (jnp.uint32(1) << jnp.arange(WORD_BITS, dtype=_WORD_DTYPE))
+        words = jnp.sum(b.astype(_WORD_DTYPE) * weights, axis=1,
+                        dtype=_WORD_DTYPE)
+        return Bitset(n, words)
+
+    def to_bools(self) -> jnp.ndarray:
+        bits = ((self.words[:, None] >>
+                 jnp.arange(WORD_BITS, dtype=_WORD_DTYPE)[None, :]) & 1)
+        return bits.ravel()[: self.n_bits].astype(jnp.bool_)
+
+    # -- element ops (ref: bitset.hpp test/set/flip) -------------------------
+    def test(self, indices) -> jnp.ndarray:
+        indices = jnp.asarray(indices)
+        word = self.words[indices // WORD_BITS]
+        return ((word >> (indices % WORD_BITS).astype(_WORD_DTYPE)) & 1
+                ).astype(jnp.bool_)
+
+    def set(self, indices, value: bool = True) -> "Bitset":
+        indices = jnp.asarray(indices).ravel()
+        word_idx = indices // WORD_BITS
+        bit = (jnp.uint32(1) <<
+               (indices % WORD_BITS).astype(_WORD_DTYPE))
+        if value:
+            # Multiple indices may share a word: build via bitwise-or scatter.
+            acc = _scatter_or(jnp.zeros_like(self.words), word_idx, bit)
+            return Bitset(self.n_bits, self.words | acc)
+        acc = _scatter_or(jnp.zeros_like(self.words), word_idx, bit)
+        return Bitset(self.n_bits, self.words & ~acc)
+
+    def flip(self) -> "Bitset":
+        return Bitset(self.n_bits,
+                      _mask_tail(~self.words, self.n_bits))
+
+    def reset(self, default_value: bool = True) -> "Bitset":
+        return Bitset(self.n_bits, default_value=default_value)
+
+    # -- reductions (ref: bitset.hpp count/any/all/none) ---------------------
+    def count(self) -> jnp.ndarray:
+        return popc(self.words)
+
+    def any(self) -> jnp.ndarray:
+        return self.count() > 0
+
+    def all(self) -> jnp.ndarray:
+        return self.count() == self.n_bits
+
+    def none(self) -> jnp.ndarray:
+        return self.count() == 0
+
+    @property
+    def size(self) -> int:
+        return self.n_bits
+
+
+def _mask_tail(words: jnp.ndarray, n_bits: int) -> jnp.ndarray:
+    """Zero bits beyond n_bits in the last word."""
+    rem = n_bits % WORD_BITS
+    if rem == 0:
+        return words
+    tail_mask = jnp.uint32((1 << rem) - 1)
+    return words.at[-1].set(words[-1] & tail_mask)
+
+
+def _scatter_or(acc: jnp.ndarray, idx: jnp.ndarray,
+                bits: jnp.ndarray) -> jnp.ndarray:
+    """Bitwise-OR scatter: acc[idx] |= bits, duplicates combined.
+
+    XLA has no `or` scatter mode; decompose per bit-plane with `max` scatter
+    (bits are single-bit values so max == or within a plane).
+    """
+    out = acc
+    for plane in range(WORD_BITS):
+        plane_bit = jnp.uint32(1) << plane
+        has = (bits & plane_bit) > 0
+        contrib = jnp.where(has, plane_bit, jnp.uint32(0))
+        out = out | jnp.zeros_like(acc).at[idx].max(contrib)
+    return out
+
+
+class Bitmap(Bitset):
+    """2-D bitset addressed by (row, col) (ref: core/bitmap.hpp)."""
+
+    def __init__(self, n_rows: int, n_cols: int,
+                 words: Optional[jnp.ndarray] = None,
+                 default_value: bool = False):
+        super().__init__(n_rows * n_cols, words, default_value)
+        self.n_rows = int(n_rows)
+        self.n_cols = int(n_cols)
+
+    @staticmethod
+    def from_bool_matrix(mat: jnp.ndarray) -> "Bitmap":
+        mat = jnp.asarray(mat, dtype=jnp.bool_)
+        bs = Bitset.from_bools(mat.ravel())
+        return Bitmap(mat.shape[0], mat.shape[1], bs.words)
+
+    def test_rc(self, rows, cols) -> jnp.ndarray:
+        return self.test(jnp.asarray(rows) * self.n_cols + jnp.asarray(cols))
+
+    def to_bool_matrix(self) -> jnp.ndarray:
+        return self.to_bools().reshape(self.n_rows, self.n_cols)

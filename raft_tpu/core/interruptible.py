@@ -1,0 +1,89 @@
+"""Cooperative cross-thread cancellation (ref: core/interruptible.hpp:63-110).
+
+The reference interposes on stream synchronization: each thread owns a token;
+``synchronize`` spins on ``cudaStreamQuery`` yielding at each poll, and a
+concurrent ``cancel()`` flips the token making the waiter throw
+``interrupted_exception``.
+
+XLA execution can't be interrupted mid-kernel, so the TPU contract is the
+honest subset: cancellation is observed *between* dispatched steps.  Host
+driver loops (Lanczos, k-means, MST, LAP) call ``check()`` or ``synchronize``
+each iteration; ``cancel()`` from any thread makes the next check raise.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+
+
+class InterruptedException(RuntimeError):
+    """Raised at a cancellation point (ref: raft::interrupted_exception)."""
+
+
+class CancelToken:
+    """Per-thread cancellation flag (ref: interruptible token store)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+
+    def cancel(self) -> None:
+        self._event.set()
+
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def check(self) -> None:
+        """Cancellation point: raise and clear if cancelled
+        (matches the reference's flag-consuming yield)."""
+        if self._event.is_set():
+            self._event.clear()
+            raise InterruptedException("raft_tpu: operation cancelled")
+
+
+_registry_lock = threading.Lock()
+_registry: Dict[int, CancelToken] = {}
+
+
+def get_token(thread_id: Optional[int] = None) -> CancelToken:
+    """Token for a thread (default: calling thread), creating on demand.
+
+    Mirrors ``interruptible::get_token()`` /
+    ``get_token(std::thread::id)`` (interruptible.hpp:97-110).
+    """
+    tid = thread_id if thread_id is not None else threading.get_ident()
+    with _registry_lock:
+        if tid not in _registry:
+            _registry[tid] = CancelToken()
+        return _registry[tid]
+
+
+def cancel(thread_id: Optional[int] = None) -> None:
+    get_token(thread_id).cancel()
+
+
+def yield_now() -> None:
+    """Cancellation point (ref: interruptible::yield)."""
+    get_token().check()
+
+
+def yield_no_throw() -> bool:
+    token = get_token()
+    if token.cancelled():
+        token._event.clear()
+        return False
+    return True
+
+
+def synchronize(*arrays) -> None:
+    """Interruptible sync: block on arrays, observing cancellation before
+    and after (ref: interruptible::synchronize, :75-92)."""
+    yield_now()
+    for a in arrays:
+        if hasattr(a, "block_until_ready"):
+            a.block_until_ready()
+    if not arrays:
+        jax.effects_barrier()
+    yield_now()

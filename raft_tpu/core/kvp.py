@@ -1,0 +1,21 @@
+"""Key-value pair used by arg-reductions (ref: core/kvp.hpp).
+
+On TPU a KVP is simply a pair of arrays (keys, values); helpers here build
+and reduce them with the tie-breaking rules the reference's device atomics
+implement (smallest key wins on equal value).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class KeyValuePair(NamedTuple):
+    key: jnp.ndarray
+    value: jnp.ndarray
+
+
+def make_kvp(keys, values) -> KeyValuePair:
+    return KeyValuePair(jnp.asarray(keys), jnp.asarray(values))

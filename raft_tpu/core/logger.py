@@ -1,0 +1,64 @@
+"""Logging (ref: core/logger.hpp:20,58-67).
+
+The reference uses rapids-logger macros with a compile-time level and an
+env-var file sink (``RAFT_DEBUG_LOG_FILE``).  Here: a stdlib logger named
+``raft_tpu``, level from ``RAFT_TPU_LOG_LEVEL``, optional file sink from
+``RAFT_TPU_DEBUG_LOG_FILE``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+LEVELS = {
+    "trace": 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+    "off": logging.CRITICAL + 10,
+}
+
+logging.addLevelName(5, "TRACE")
+
+logger = logging.getLogger("raft_tpu")
+
+if not logger.handlers:
+    _handler: logging.Handler
+    _file = os.environ.get("RAFT_TPU_DEBUG_LOG_FILE")
+    _handler = logging.FileHandler(_file) if _file else logging.StreamHandler()
+    _handler.setFormatter(
+        logging.Formatter("[%(levelname)s] [%(asctime)s] %(message)s"))
+    logger.addHandler(_handler)
+    logger.setLevel(
+        LEVELS.get(os.environ.get("RAFT_TPU_LOG_LEVEL", "warn"), logging.WARNING))
+
+
+def set_level(level: str) -> None:
+    logger.setLevel(LEVELS[level])
+
+
+def trace(msg, *args):
+    logger.log(5, msg, *args)
+
+
+def debug(msg, *args):
+    logger.debug(msg, *args)
+
+
+def info(msg, *args):
+    logger.info(msg, *args)
+
+
+def warn(msg, *args):
+    logger.warning(msg, *args)
+
+
+def error(msg, *args):
+    logger.error(msg, *args)
+
+
+def critical(msg, *args):
+    logger.critical(msg, *args)

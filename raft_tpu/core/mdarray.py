@@ -1,0 +1,216 @@
+"""Owning multi-dimensional arrays + non-owning views over host/device memory.
+
+TPU-native re-design of the reference's mdspan/mdarray stack
+(core/mdarray.hpp:93-118, core/device_mdarray.hpp:127-183,
+core/device_mdspan.hpp and the host_/managed_/pinned_ variants).
+
+Under JAX there is no user-managed device pointer: a device array *is*
+``jax.Array`` (HBM, XLA-managed) and a host array is ``numpy.ndarray``.  An
+``MdArray`` is a small mutable holder pairing one of those with its
+:class:`MemoryType`; the "view" (`.view()`) is the underlying array itself,
+which every raft_tpu primitive accepts directly.  Factory helpers mirror the
+reference's ``make_device_matrix/vector/scalar`` family.
+
+Layouts: JAX arrays are logically row-major (layout_c / layout_right); a
+column-major view is represented by a transposed row-major array plus the
+``layout`` tag, mirroring the reference's layout template parameter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.memory_type import MemoryType
+from raft_tpu.core.resources import Resources, default_resources, get_device
+
+ROW_MAJOR = "row_major"     # ref: layout_c_contiguous / layout_right
+COL_MAJOR = "col_major"     # ref: layout_f_contiguous / layout_left
+
+
+class MdArray:
+    """Owning n-d array tagged with memory type and layout.
+
+    ``data`` may be replaced (functional updates write a new jax.Array back),
+    which stands in for the reference's mutable device buffers.
+    """
+
+    def __init__(self, data: Any, memory_type: MemoryType,
+                 layout: str = ROW_MAJOR):
+        self.data = data
+        self.memory_type = memory_type
+        self.layout = layout
+
+    # -- mdspan protocol ----------------------------------------------------
+    def view(self):
+        """The non-owning view: the underlying array itself."""
+        return self.data
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.data.shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.data.shape)) if self.data.shape else 1
+
+    def extent(self, axis: int) -> int:
+        return int(self.data.shape[axis])
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(jax.device_get(self.data))
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __repr__(self):
+        return (f"MdArray(shape={self.shape}, dtype={self.dtype}, "
+                f"memory_type={self.memory_type.value}, layout={self.layout})")
+
+
+# -- factories (ref: core/device_mdarray.hpp:127-183; host_mdarray.hpp) ------
+
+def _zeros(res: Optional[Resources], shape, dtype, memory_type: MemoryType,
+           layout: str) -> MdArray:
+    if memory_type.is_device_accessible:
+        res = default_resources(res)
+        dev = get_device(res)
+        data = jax.device_put(jnp.zeros(shape, dtype=dtype), dev)
+    else:
+        data = np.zeros(shape, dtype=dtype)
+    return MdArray(data, memory_type, layout)
+
+
+def make_device_matrix(res, n_rows: int, n_cols: int, dtype=jnp.float32,
+                       layout: str = ROW_MAJOR) -> MdArray:
+    return _zeros(res, (n_rows, n_cols), dtype, MemoryType.DEVICE, layout)
+
+
+def make_device_vector(res, n: int, dtype=jnp.float32) -> MdArray:
+    return _zeros(res, (n,), dtype, MemoryType.DEVICE, ROW_MAJOR)
+
+
+def make_device_scalar(res, value=0, dtype=jnp.float32) -> MdArray:
+    out = _zeros(res, (), dtype, MemoryType.DEVICE, ROW_MAJOR)
+    out.data = jnp.asarray(value, dtype=dtype)
+    return out
+
+
+def make_device_mdarray(res, shape, dtype=jnp.float32,
+                        layout: str = ROW_MAJOR) -> MdArray:
+    return _zeros(res, tuple(shape), dtype, MemoryType.DEVICE, layout)
+
+
+def make_host_matrix(n_rows: int, n_cols: int, dtype=np.float32,
+                     layout: str = ROW_MAJOR) -> MdArray:
+    return _zeros(None, (n_rows, n_cols), dtype, MemoryType.HOST, layout)
+
+
+def make_host_vector(n: int, dtype=np.float32) -> MdArray:
+    return _zeros(None, (n,), dtype, MemoryType.HOST, ROW_MAJOR)
+
+
+def make_host_scalar(value=0, dtype=np.float32) -> MdArray:
+    out = _zeros(None, (), dtype, MemoryType.HOST, ROW_MAJOR)
+    out.data = np.asarray(value, dtype=dtype)
+    return out
+
+
+def make_pinned_matrix(n_rows: int, n_cols: int, dtype=np.float32) -> MdArray:
+    return _zeros(None, (n_rows, n_cols), dtype, MemoryType.PINNED, ROW_MAJOR)
+
+
+def make_managed_matrix(res, n_rows: int, n_cols: int,
+                        dtype=jnp.float32) -> MdArray:
+    return _zeros(res, (n_rows, n_cols), dtype, MemoryType.MANAGED, ROW_MAJOR)
+
+
+# -- layout/type-converting copy (ref: core/detail/copy.hpp:39,178-193) ------
+
+def copy(res: Optional[Resources], dst: MdArray, src: MdArray) -> None:
+    """Copy ``src`` into ``dst``, converting memory type / dtype / layout.
+
+    The reference picks between raft-copy, cuBLAS geam and a custom kernel at
+    compile time; XLA's transpose+convert+transfer covers all those cases, so
+    the dispatch collapses to "move to the right memory space, transpose if
+    layouts differ, cast if dtypes differ".
+    """
+    if dst.shape != src.shape:
+        raise ValueError(f"shape mismatch: dst {dst.shape} vs src {src.shape}")
+    data = src.data
+    if src.layout != dst.layout and len(src.shape) == 2:
+        # The backing buffer of a COL_MAJOR MdArray physically stores the
+        # transposed row-major matrix; flipping layout means re-materializing
+        # the buffer in the other physical order while the logical values
+        # stay identical.
+        data = (jnp.asarray(data) if dst.memory_type.is_device_accessible
+                else np.asarray(data))
+        if dst.layout == COL_MAJOR:
+            # row-major buffer -> col-major buffer: store A^T contiguously.
+            data = data.T.reshape(src.shape)
+        else:
+            # col-major buffer (holding A^T contiguously) -> row-major A.
+            rows, cols = src.shape
+            data = data.reshape(cols, rows).T.reshape(src.shape)
+    if dst.memory_type.is_device_accessible:
+        res = default_resources(res)
+        out = jax.device_put(jnp.asarray(data, dtype=dst.dtype),
+                             get_device(res))
+    else:
+        out = np.asarray(jax.device_get(data)).astype(dst.dtype)
+    dst.data = out
+
+
+# -- mdbuffer (ref: core/mdbuffer.hpp): lazy memory-type/dtype conversion ----
+
+class MdBuffer:
+    """Variant buffer that lazily materializes views in other memory types.
+
+    ``view(memory_type, dtype)`` returns (and caches) a copy in the requested
+    space, copying only when needed — the reference's ``mdbuffer`` contract.
+    """
+
+    def __init__(self, source: Any,
+                 memory_type: Optional[MemoryType] = None):
+        if isinstance(source, MdArray):
+            self._mt = source.memory_type
+            self._data = source.data
+        else:
+            self._mt = memory_type or (
+                MemoryType.DEVICE if isinstance(source, jax.Array)
+                else MemoryType.HOST)
+            self._data = source
+        self._cache = {(self._mt, np.dtype(self._data.dtype)): self._data}
+
+    @property
+    def memory_type(self) -> MemoryType:
+        return self._mt
+
+    def view(self, memory_type: Optional[MemoryType] = None, dtype=None):
+        memory_type = memory_type or self._mt
+        dtype = np.dtype(dtype) if dtype is not None else np.dtype(
+            self._data.dtype)
+        key = (memory_type, dtype)
+        if key not in self._cache:
+            if memory_type.is_device_accessible:
+                self._cache[key] = jnp.asarray(self._data, dtype=dtype)
+            else:
+                self._cache[key] = np.asarray(
+                    jax.device_get(self._data)).astype(dtype)
+        return self._cache[key]
+
+    def is_copy_required(self, memory_type: MemoryType) -> bool:
+        return memory_type.is_device_accessible != self._mt.is_device_accessible
+
+
+def temporary_device_buffer(res, array) -> Any:
+    """Device-accessible temporary view of possibly-host data
+    (ref: core/temporary_device_buffer.hpp)."""
+    if isinstance(array, jax.Array):
+        return array
+    return jnp.asarray(array)

@@ -1,0 +1,181 @@
+"""Host-side memory observability (ref: raft/mr/).
+
+XLA owns device allocation, so RAFT's pluggable device memory resources
+collapse to observability + policy here:
+
+- :class:`StatisticsTracker` — byte/alloc counters
+  (ref: mr/statistics_adaptor.hpp:25,66)
+- :class:`NotifyingTracker` — alloc/dealloc event hooks
+  (ref: mr/notifying_adaptor.hpp:25,77)
+- :class:`ResourceMonitor` — background sampler writing CSV rows tagged with
+  the current trace range (ref: mr/resource_monitor.hpp:29-66)
+- :func:`mmap_buffer` — tmpfile-backed mmap host allocation for out-of-core
+  staging (ref: mr/mmap_memory_resource.hpp:31,86)
+- :func:`device_memory_stats` — live/peak HBM from the JAX runtime.
+"""
+
+from __future__ import annotations
+
+import csv
+import mmap
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from raft_tpu.core import trace
+
+
+class StatisticsTracker:
+    """Counts allocations/bytes reported through it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.bytes_allocated = 0
+        self.peak_bytes = 0
+        self.allocation_count = 0
+        self.deallocation_count = 0
+
+    def on_alloc(self, nbytes: int) -> None:
+        with self._lock:
+            self.bytes_allocated += nbytes
+            self.peak_bytes = max(self.peak_bytes, self.bytes_allocated)
+            self.allocation_count += 1
+
+    def on_dealloc(self, nbytes: int) -> None:
+        with self._lock:
+            self.bytes_allocated -= nbytes
+            self.deallocation_count += 1
+
+    def snapshot(self) -> Tuple[int, int, int, int]:
+        with self._lock:
+            return (self.bytes_allocated, self.peak_bytes,
+                    self.allocation_count, self.deallocation_count)
+
+
+class NotifyingTracker(StatisticsTracker):
+    """Statistics tracker that additionally wakes observers on events."""
+
+    def __init__(self):
+        super().__init__()
+        self._observers: List[Callable[[str, int], None]] = []
+
+    def subscribe(self, fn: Callable[[str, int], None]) -> None:
+        self._observers.append(fn)
+
+    def on_alloc(self, nbytes: int) -> None:
+        super().on_alloc(nbytes)
+        for fn in self._observers:
+            fn("alloc", nbytes)
+
+    def on_dealloc(self, nbytes: int) -> None:
+        super().on_dealloc(nbytes)
+        for fn in self._observers:
+            fn("dealloc", nbytes)
+
+
+def device_memory_stats(device: Optional[jax.Device] = None) -> dict:
+    """Live/peak HBM usage from the runtime (bytes), when supported."""
+    device = device or jax.devices()[0]
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        stats = {}
+    return {
+        "bytes_in_use": stats.get("bytes_in_use", 0),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+        "bytes_limit": stats.get("bytes_limit", 0),
+    }
+
+
+class ResourceMonitor:
+    """Background thread sampling memory stats to CSV, tagged with the
+    active trace range (ref: mr/resource_monitor.hpp:29-66)."""
+
+    def __init__(self, path: str, tracker: Optional[StatisticsTracker] = None,
+                 interval_s: float = 0.1,
+                 device: Optional[jax.Device] = None):
+        self.path = path
+        self.tracker = tracker
+        self.interval_s = interval_s
+        self.device = device
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # The sampler tags rows with the *starting* thread's range stack.
+        self._range_fn = trace.current_range
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def start(self) -> None:
+        f = open(self.path, "w", newline="")
+        writer = csv.writer(f)
+        writer.writerow(["time_s", "range", "host_bytes", "host_peak",
+                         "device_bytes", "device_peak"])
+        t0 = time.monotonic()
+
+        def run():
+            while not self._stop.is_set():
+                host_bytes = host_peak = 0
+                if self.tracker is not None:
+                    host_bytes, host_peak, _, _ = self.tracker.snapshot()
+                dstats = device_memory_stats(self.device)
+                writer.writerow([
+                    f"{time.monotonic() - t0:.4f}",
+                    self._range_fn() or "",
+                    host_bytes, host_peak,
+                    dstats["bytes_in_use"], dstats["peak_bytes_in_use"],
+                ])
+                self._stop.wait(self.interval_s)
+            f.flush()
+            f.close()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+
+
+class MmapBuffer:
+    """tmpfile-backed mmap host buffer for out-of-core staging
+    (ref: mr/mmap_memory_resource.hpp:31,86)."""
+
+    def __init__(self, nbytes: int, dir: Optional[str] = None):
+        self._file = tempfile.TemporaryFile(dir=dir)
+        self._file.truncate(nbytes)
+        self.nbytes = nbytes
+        self._mmap = mmap.mmap(self._file.fileno(), nbytes)
+
+    def as_array(self, dtype=np.uint8, shape=None) -> np.ndarray:
+        arr = np.frombuffer(self._mmap, dtype=dtype)
+        return arr.reshape(shape) if shape is not None else arr
+
+    def close(self) -> None:
+        try:
+            self._mmap.close()
+        except BufferError:
+            # Arrays still view the mapping; the OS reclaims it when they
+            # are garbage collected (the tmpfile is already unlinked).
+            pass
+        self._file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def mmap_buffer(nbytes: int, dir: Optional[str] = None) -> MmapBuffer:
+    return MmapBuffer(nbytes, dir=dir)

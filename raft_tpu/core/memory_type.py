@@ -1,0 +1,33 @@
+"""Memory-type vocabulary (ref: core/memory_type.hpp:21-29).
+
+On TPU the meaningful distinction is host (numpy, CPU RAM) vs device
+(jax.Array in HBM).  ``pinned`` maps to host (XLA stages transfers through
+pinned buffers internally) and ``managed`` has no analogue — it behaves as
+device with transparent host access via jax.device_get.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class MemoryType(enum.Enum):
+    HOST = "host"
+    PINNED = "pinned"
+    DEVICE = "device"
+    MANAGED = "managed"
+
+    @property
+    def is_device_accessible(self) -> bool:
+        # ref: core/memory_type.hpp is_device_accessible trait
+        return self in (MemoryType.DEVICE, MemoryType.MANAGED)
+
+    @property
+    def is_host_accessible(self) -> bool:
+        return self in (MemoryType.HOST, MemoryType.PINNED, MemoryType.MANAGED)
+
+
+HOST = MemoryType.HOST
+PINNED = MemoryType.PINNED
+DEVICE = MemoryType.DEVICE
+MANAGED = MemoryType.MANAGED

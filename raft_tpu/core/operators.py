@@ -1,0 +1,165 @@
+"""Composable operator vocabulary used by map/reduce primitives.
+
+Re-design of the reference's host/device functor vocabulary
+(core/operators.hpp:27-391).  In JAX these are ordinary callables traceable
+under jit; composition helpers mirror ``compose_op`` / ``plug_const_op`` /
+``map_args_op``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+# -- unary ------------------------------------------------------------------
+
+def identity_op(x, *_):
+    return x
+
+
+def void_op(*_):
+    return None
+
+
+def abs_op(x, *_):
+    return jnp.abs(x)
+
+
+def sq_op(x, *_):
+    return x * x
+
+
+def sqrt_op(x, *_):
+    return jnp.sqrt(x)
+
+
+def nz_op(x, *_):
+    return jnp.where(x != 0, jnp.ones_like(x), jnp.zeros_like(x))
+
+
+def key_op(kvp, *_):
+    return kvp[0]
+
+
+def value_op(kvp, *_):
+    return kvp[1]
+
+
+# -- binary -----------------------------------------------------------------
+
+def add_op(a, b):
+    return a + b
+
+
+def sub_op(a, b):
+    return a - b
+
+
+def mul_op(a, b):
+    return a * b
+
+
+def div_op(a, b):
+    return a / b
+
+
+def div_checkzero_op(a, b):
+    zero = jnp.zeros_like(a * b)
+    return jnp.where(b == 0, zero, a / jnp.where(b == 0, jnp.ones_like(b), b))
+
+
+def pow_op(a, b):
+    return jnp.power(a, b)
+
+
+def mod_op(a, b):
+    return jnp.mod(a, b)
+
+
+def min_op(a, b):
+    return jnp.minimum(a, b)
+
+
+def max_op(a, b):
+    return jnp.maximum(a, b)
+
+
+def argmin_op(kvp_a, kvp_b):
+    """KeyValuePair argmin reduction (ref: core/kvp.hpp + operators.hpp)."""
+    ka, va = kvp_a
+    kb, vb = kvp_b
+    take_b = (vb < va) | ((vb == va) & (kb < ka))
+    return (jnp.where(take_b, kb, ka), jnp.where(take_b, vb, va))
+
+
+def argmax_op(kvp_a, kvp_b):
+    ka, va = kvp_a
+    kb, vb = kvp_b
+    take_b = (vb > va) | ((vb == va) & (kb < ka))
+    return (jnp.where(take_b, kb, ka), jnp.where(take_b, vb, va))
+
+
+def sqdiff_op(a, b):
+    d = a - b
+    return d * d
+
+
+def absdiff_op(a, b):
+    return jnp.abs(a - b)
+
+
+# -- combinators (ref: operators.hpp compose_op/plug_const/map_args) ---------
+
+def compose_op(*fns):
+    """compose_op(f, g, h)(x) == f(g(h(x)))."""
+
+    def composed(*args):
+        out = fns[-1](*args)
+        for fn in reversed(fns[:-1]):
+            out = fn(out)
+        return out
+
+    return composed
+
+
+def const_op(value):
+    def op(*_):
+        return value
+
+    return op
+
+
+def plug_const_op(fn, const, side="right"):
+    """Bind a constant to one side of a binary op."""
+    if side == "right":
+        return lambda x, *_: fn(x, const)
+    return lambda x, *_: fn(const, x)
+
+
+def add_const_op(c):
+    return plug_const_op(add_op, c)
+
+
+def sub_const_op(c):
+    return plug_const_op(sub_op, c)
+
+
+def mul_const_op(c):
+    return plug_const_op(mul_op, c)
+
+
+def div_const_op(c):
+    return plug_const_op(div_op, c)
+
+
+def pow_const_op(c):
+    return plug_const_op(pow_op, c)
+
+
+def map_args_op(fn, *maps):
+    """map_args_op(f, g1, g2)(x1, x2) == f(g1(x1), g2(x2))."""
+
+    def op(*args):
+        return fn(*(g(a) for g, a in zip(maps, args)))
+
+    return op

@@ -1,0 +1,370 @@
+"""Resources handle: a type-indexed, lazily-constructed resource registry.
+
+TPU-native re-design of the reference's ``raft::resources`` container
+(reference cpp/include/raft/core/resources.hpp:39,47-56,103-123) and
+``raft::device_resources`` handle (core/device_resources.hpp:53,78-92).
+
+Where the reference's handle holds CUDA streams and cuBLAS/cuSOLVER/cuSPARSE
+handles, the TPU handle holds the things an XLA program needs threaded through
+it: the target :class:`jax.Device`, a `jax.sharding.Mesh` for multi-chip work,
+a counter-based PRNG state, a communicator (``raft_tpu.comms``), sub-comms,
+and host-side services (logger, allocation trackers, workspace limits).
+
+Resources are registered as *factories* and constructed lazily, under a lock,
+on first access — exactly the reference's scheme (resources.hpp:103-123).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+class ResourceType(enum.Enum):
+    """Vocabulary of resource slots.
+
+    Mirrors the reference's ``resource_type`` enum
+    (core/resource/resource_types.hpp:20-46) with CUDA-specific slots
+    (CUBLAS_HANDLE, CUDA_STREAM_VIEW, ...) replaced by their TPU-native
+    equivalents (DEVICE, MESH, ...).  Slots that have no TPU analogue
+    (e.g. per-vendor library handles) are intentionally absent: XLA owns
+    the compiled-kernel plumbing.
+    """
+
+    DEVICE = "device"                       # jax.Device           (ref: DEVICE_ID)
+    MESH = "mesh"                           # jax.sharding.Mesh    (ref: stream pool / SNMG clique)
+    PRNG = "prng"                           # RngState             (ref: none; curand was per-call)
+    COMMS = "comms"                         # comms_t              (ref: COMMUNICATOR)
+    SUB_COMMS = "sub_comms"                 # dict key->comms_t    (ref: SUB_COMMUNICATOR)
+    WORKSPACE = "workspace"                 # workspace byte limit (ref: WORKSPACE_RESOURCE)
+    LARGE_WORKSPACE = "large_workspace"     # (ref: LARGE_WORKSPACE_RESOURCE)
+    MEMORY_STATS = "memory_stats"           # allocation statistics adaptor
+    LOGGER = "logger"                       # per-handle logger
+    CANCEL_TOKEN = "cancel_token"           # interruptible token  (ref: core/interruptible.hpp)
+    MULTI_DEVICE = "multi_device"           # list[Resources], one per local device (ref: multi_gpu.hpp)
+    DONATION = "donation"                   # buffer-donation policy knobs
+
+
+class ResourceFactory:
+    """Factory that constructs a resource on first access.
+
+    Reference: ``resource_factory`` virtual pair
+    (core/resource/resource_types.hpp:54-88).
+    """
+
+    def __init__(self, key: ResourceType, fn: Callable[[], Any]):
+        self.key = key
+        self.fn = fn
+
+    def make_resource(self) -> Any:
+        return self.fn()
+
+
+class Resources:
+    """Lazily-constructed, thread-safe resource registry.
+
+    Shallow-copyable: copies share the registered factories and already
+    constructed resources, like the reference's copy semantics
+    (core/resources.hpp:47-56).
+    """
+
+    def __init__(self, other: Optional["Resources"] = None):
+        if other is not None:
+            # Shallow copy: share factory and resource tables (+lock).
+            self._lock = other._lock
+            self._factories = other._factories
+            self._resources = other._resources
+        else:
+            self._lock = threading.RLock()
+            self._factories: Dict[ResourceType, ResourceFactory] = {}
+            self._resources: Dict[ResourceType, Any] = {}
+
+    # -- registry protocol (ref: resources.hpp:75-123) ------------------------
+
+    def add_resource_factory(self, factory: ResourceFactory) -> None:
+        with self._lock:
+            self._factories[factory.key] = factory
+            # A new factory invalidates a previously-constructed resource.
+            self._resources.pop(factory.key, None)
+
+    def has_resource_factory(self, key: ResourceType) -> bool:
+        with self._lock:
+            return key in self._factories
+
+    def get_resource(self, key: ResourceType) -> Any:
+        with self._lock:
+            if key not in self._resources:
+                if key not in self._factories:
+                    raise KeyError(
+                        f"no resource factory registered for {key!r}; "
+                        f"register one with add_resource_factory()"
+                    )
+                self._resources[key] = self._factories[key].make_resource()
+            return self._resources[key]
+
+    def set_resource(self, key: ResourceType, value: Any) -> None:
+        """Directly install a constructed resource (factory-less)."""
+        with self._lock:
+            self._factories[key] = ResourceFactory(key, lambda: value)
+            self._resources[key] = value
+
+
+# ---------------------------------------------------------------------------
+# Accessors — one per resource, registering a default factory on demand,
+# mirroring the reference's per-resource headers (core/resource/*.hpp).
+# ---------------------------------------------------------------------------
+
+
+def get_device(res: Resources) -> jax.Device:
+    """Target device (ref: core/resource/device_id.hpp)."""
+    if not res.has_resource_factory(ResourceType.DEVICE):
+        res.set_resource(ResourceType.DEVICE, jax.devices()[0])
+    return res.get_resource(ResourceType.DEVICE)
+
+
+def set_device(res: Resources, device: jax.Device) -> None:
+    res.set_resource(ResourceType.DEVICE, device)
+
+
+def get_mesh(res: Resources):
+    """Device mesh for multi-chip execution.
+
+    The TPU analogue of both the stream pool and the SNMG clique: a named-axis
+    `jax.sharding.Mesh`.  Defaults to a 1-axis mesh over all local devices.
+    """
+    if not res.has_resource_factory(ResourceType.MESH):
+        def _make():
+            import numpy as np
+            from jax.sharding import Mesh
+
+            devs = np.asarray(jax.devices())
+            return Mesh(devs, axis_names=("data",))
+
+        res.add_resource_factory(ResourceFactory(ResourceType.MESH, _make))
+    return res.get_resource(ResourceType.MESH)
+
+
+def set_mesh(res: Resources, mesh) -> None:
+    res.set_resource(ResourceType.MESH, mesh)
+
+
+def get_rng_state(res: Resources):
+    """Per-handle PRNG state (lazily seeded to 0)."""
+    if not res.has_resource_factory(ResourceType.PRNG):
+        def _make():
+            from raft_tpu.random.rng_state import RngState
+
+            return RngState(seed=0)
+
+        res.add_resource_factory(ResourceFactory(ResourceType.PRNG, _make))
+    return res.get_resource(ResourceType.PRNG)
+
+
+def set_rng_state(res: Resources, state) -> None:
+    res.set_resource(ResourceType.PRNG, state)
+
+
+def get_comms(res: Resources):
+    """Communicator injected into the handle (ref: core/resource/comms.hpp).
+
+    Raises if none was set — same contract as the reference, where algorithms
+    require ``build_comms_*`` / ``initialize_mpi_comms`` to have run first.
+    """
+    if not res.has_resource_factory(ResourceType.COMMS):
+        raise RuntimeError(
+            "no communicator set on this handle; call "
+            "raft_tpu.comms.build_mesh_comms(res, mesh) first"
+        )
+    return res.get_resource(ResourceType.COMMS)
+
+
+def set_comms(res: Resources, comms) -> None:
+    res.set_resource(ResourceType.COMMS, comms)
+
+
+def comms_initialized(res: Resources) -> bool:
+    return res.has_resource_factory(ResourceType.COMMS)
+
+
+def get_subcomm(res: Resources, key: str):
+    """Keyed sub-communicator (ref: core/resource/sub_comms.hpp)."""
+    if not res.has_resource_factory(ResourceType.SUB_COMMS):
+        res.set_resource(ResourceType.SUB_COMMS, {})
+    table = res.get_resource(ResourceType.SUB_COMMS)
+    if key not in table:
+        raise KeyError(f"no sub-communicator registered under key {key!r}")
+    return table[key]
+
+
+def set_subcomm(res: Resources, key: str, comms) -> None:
+    if not res.has_resource_factory(ResourceType.SUB_COMMS):
+        res.set_resource(ResourceType.SUB_COMMS, {})
+    res.get_resource(ResourceType.SUB_COMMS)[key] = comms
+
+
+def get_workspace_limit(res: Resources) -> int:
+    """Soft byte cap primitives use when sizing scratch buffers.
+
+    The reference bounds a dedicated workspace memory resource
+    (core/resource/device_memory_resource.hpp); under XLA the compiler owns
+    allocation, so this is a *policy* value primitives consult when choosing
+    tile/batch sizes for memory-hungry paths.
+    """
+    if not res.has_resource_factory(ResourceType.WORKSPACE):
+        res.set_resource(ResourceType.WORKSPACE, 1 << 30)  # 1 GiB default
+    return res.get_resource(ResourceType.WORKSPACE)
+
+
+def set_workspace_limit(res: Resources, nbytes: int) -> None:
+    res.set_resource(ResourceType.WORKSPACE, int(nbytes))
+
+
+def get_memory_stats(res: Resources):
+    """Allocation statistics tracker (ref: mr/statistics_adaptor.hpp:25,66)."""
+    if not res.has_resource_factory(ResourceType.MEMORY_STATS):
+        from raft_tpu.core.memory import StatisticsTracker
+
+        res.set_resource(ResourceType.MEMORY_STATS, StatisticsTracker())
+    return res.get_resource(ResourceType.MEMORY_STATS)
+
+
+def get_cancel_token(res: Resources):
+    """Cooperative-cancellation token (ref: core/interruptible.hpp:63)."""
+    if not res.has_resource_factory(ResourceType.CANCEL_TOKEN):
+        from raft_tpu.core.interruptible import CancelToken
+
+        res.set_resource(ResourceType.CANCEL_TOKEN, CancelToken())
+    return res.get_resource(ResourceType.CANCEL_TOKEN)
+
+
+def sync(res: Resources, *arrays) -> None:
+    """Block until enqueued device work completes.
+
+    The analogue of ``resource::sync_stream`` → ``interruptible::synchronize``
+    (core/interruptible.hpp:75-92): JAX dispatch is async; this blocks on the
+    given arrays (or does a global barrier if none given), polling the
+    handle's cancel token.
+    """
+    token = get_cancel_token(res)
+    token.check()
+    if arrays:
+        for a in arrays:
+            if hasattr(a, "block_until_ready"):
+                a.block_until_ready()
+    else:
+        jax.effects_barrier()
+    token.check()
+
+
+# ---------------------------------------------------------------------------
+# device_resources — the user-facing handle (ref: core/device_resources.hpp:53)
+# ---------------------------------------------------------------------------
+
+
+class DeviceResources(Resources):
+    """The "handle": Resources pre-loaded with device / mesh / PRNG factories.
+
+    Reference: ``raft::device_resources`` registers device_id, stream and
+    stream-pool factories in its constructor (device_resources.hpp:78-92);
+    here we pre-register the device, the default mesh and the PRNG seed.
+    """
+
+    def __init__(self, device: Optional[jax.Device] = None, mesh=None,
+                 seed: int = 0, other: Optional[Resources] = None):
+        super().__init__(other)
+        if other is None:
+            if device is not None:
+                set_device(self, device)
+            if mesh is not None:
+                set_mesh(self, mesh)
+            from raft_tpu.random.rng_state import RngState
+
+            set_rng_state(self, RngState(seed=seed))
+
+    # Convenience getters, mirroring device_resources.hpp:97-110.
+    @property
+    def device(self) -> jax.Device:
+        return get_device(self)
+
+    @property
+    def mesh(self):
+        return get_mesh(self)
+
+    def get_comms(self):
+        return get_comms(self)
+
+    def sync_stream(self, *arrays) -> None:
+        sync(self, *arrays)
+
+
+def device_resources(device: Optional[jax.Device] = None, mesh=None,
+                     seed: int = 0) -> DeviceResources:
+    """Create a handle. ``raft::device_resources handle;`` equivalent."""
+    return DeviceResources(device=device, mesh=mesh, seed=seed)
+
+
+# Deprecated alias kept for API parity with the reference's handle_t
+# (core/handle.hpp:23).
+Handle = DeviceResources
+
+
+class DeviceResourcesManager:
+    """Process-global pool of handles, one per (device, thread) pair.
+
+    Reference: ``device_resources_manager``
+    (core/device_resources_manager.hpp:73,99,125-183): lazily builds and
+    caches a handle per device so repeated calls are cheap, with settable
+    defaults applied to newly built handles.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles: Dict[Any, DeviceResources] = {}
+        self._default_seed = 0
+        self._default_workspace = 1 << 30
+        self._default_mesh = None
+
+    def set_seed(self, seed: int) -> None:
+        with self._lock:
+            self._default_seed = seed
+
+    def set_workspace_limit(self, nbytes: int) -> None:
+        with self._lock:
+            self._default_workspace = int(nbytes)
+
+    def set_mesh(self, mesh) -> None:
+        with self._lock:
+            self._default_mesh = mesh
+
+    def get_device_resources(self, device: Optional[jax.Device] = None
+                             ) -> DeviceResources:
+        device = device if device is not None else jax.devices()[0]
+        key = (device, threading.get_ident())
+        with self._lock:
+            if key not in self._handles:
+                h = DeviceResources(device=device, mesh=self._default_mesh,
+                                    seed=self._default_seed)
+                set_workspace_limit(h, self._default_workspace)
+                self._handles[key] = h
+            return self._handles[key]
+
+
+_manager = DeviceResourcesManager()
+
+
+def get_device_resources(device: Optional[jax.Device] = None) -> DeviceResources:
+    """Process-global cached handle (device_resources_manager.hpp:99)."""
+    return _manager.get_device_resources(device)
+
+
+def default_resources(res: Optional[Resources] = None) -> Resources:
+    """Return ``res`` or the process-global default handle.
+
+    Primitives take an optional handle first argument; ``None`` means "use
+    the global default" (the reference forces explicit handles, but JAX's
+    functional style makes the implicit default the common case).
+    """
+    return res if res is not None else get_device_resources()

@@ -1,0 +1,60 @@
+"""Array ⇄ NumPy `.npy` stream serialization.
+
+Re-design of the reference's mdspan serializer (core/serialize.hpp:26-112,
+core/detail/mdspan_numpy_serializer.hpp): host and device arrays are written
+to / read from the NumPy binary format so checkpoints interoperate with
+NumPy and with the reference's own serialized artifacts.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, BinaryIO, Union
+
+import jax
+import numpy as np
+
+from raft_tpu.core.mdarray import MdArray
+
+
+def _to_numpy(array: Any) -> np.ndarray:
+    if isinstance(array, MdArray):
+        array = array.data
+    if isinstance(array, jax.Array):
+        return np.asarray(jax.device_get(array))
+    return np.asarray(array)
+
+
+def serialize_mdspan(res, stream: BinaryIO, array: Any) -> None:
+    """Write an array (host or device) in .npy format
+    (ref: serialize_mdspan, core/serialize.hpp:26-68)."""
+    np.save(stream, _to_numpy(array), allow_pickle=False)
+
+
+def deserialize_mdspan(res, stream: BinaryIO, to_device: bool = True):
+    """Read a .npy stream back (ref: deserialize_mdspan,
+    core/serialize.hpp:70-112)."""
+    arr = np.load(stream, allow_pickle=False)
+    if to_device:
+        import jax.numpy as jnp
+
+        return jnp.asarray(arr)
+    return arr
+
+
+def serialize_scalar(res, stream: BinaryIO, value) -> None:
+    np.save(stream, np.asarray(value), allow_pickle=False)
+
+
+def deserialize_scalar(res, stream: BinaryIO):
+    return np.load(stream, allow_pickle=False)[()]
+
+
+def dumps(array: Any) -> bytes:
+    buf = io.BytesIO()
+    serialize_mdspan(None, buf, array)
+    return buf.getvalue()
+
+
+def loads(data: Union[bytes, bytearray], to_device: bool = True):
+    return deserialize_mdspan(None, io.BytesIO(bytes(data)), to_device)

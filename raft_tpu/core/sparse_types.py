@@ -1,0 +1,160 @@
+"""Owning sparse structure/matrix types (ref: core/csr_matrix.hpp:21-235,
+core/coo_matrix.hpp, core/sparse_types.hpp).
+
+The reference separates *structure* (indices) from *elements* (values) with
+owning/preserving sparsity semantics and host/device variants.  Here both
+host (numpy) and device (jax.numpy) arrays are accepted; static shapes are
+required under jit, so ``nnz`` is a static Python int and growth re-allocates
+(mirroring the reference's ``initialize_sparsity`` re-allocation contract).
+
+These classes are registered as JAX pytrees so they can flow through jitted
+functions with indices/values as leaves.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CSRMatrix:
+    """Compressed sparse row matrix: indptr[n_rows+1], indices[nnz], data[nnz].
+
+    ref: csr_matrix / compressed_structure_t (core/csr_matrix.hpp:21,55,106).
+    """
+
+    def __init__(self, indptr, indices, data, shape: Tuple[int, int]):
+        self.indptr = indptr
+        self.indices = indices
+        self.data = data
+        self.shape = (int(shape[0]), int(shape[1]))
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    @property
+    def n_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.shape[1]
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def structure_view(self) -> Tuple[Any, Any]:
+        return self.indptr, self.indices
+
+    def to_device(self) -> "CSRMatrix":
+        return CSRMatrix(jnp.asarray(self.indptr), jnp.asarray(self.indices),
+                         jnp.asarray(self.data), self.shape)
+
+    def to_host(self) -> "CSRMatrix":
+        g = jax.device_get
+        return CSRMatrix(np.asarray(g(self.indptr)),
+                         np.asarray(g(self.indices)),
+                         np.asarray(g(self.data)), self.shape)
+
+    def to_scipy(self):
+        import scipy.sparse as sp
+
+        h = self.to_host()
+        return sp.csr_matrix((h.data, h.indices, h.indptr), shape=self.shape)
+
+    @staticmethod
+    def from_scipy(mat) -> "CSRMatrix":
+        mat = mat.tocsr()
+        return CSRMatrix(jnp.asarray(mat.indptr), jnp.asarray(mat.indices),
+                         jnp.asarray(mat.data), mat.shape)
+
+    def row_lengths(self):
+        return self.indptr[1:] - self.indptr[:-1]
+
+    def row_ids(self):
+        """Expand indptr to a per-nnz row-id vector (the reference's
+        csr_to_coo conversion kernel, sparse/convert/coo.cuh)."""
+        lengths = self.indptr[1:] - self.indptr[:-1]
+        row_range = jnp.arange(self.n_rows, dtype=self.indices.dtype)
+        if isinstance(self.indptr, jax.Array):
+            return jnp.repeat(row_range, lengths,
+                              total_repeat_length=self.nnz)
+        return np.repeat(np.asarray(row_range), np.asarray(lengths))
+
+
+class COOMatrix:
+    """Coordinate-format matrix: rows[nnz], cols[nnz], data[nnz].
+
+    ref: coo_matrix (core/coo_matrix.hpp); the legacy `COO` container
+    (sparse/detail/coo.cuh:38) is the same triple with a setSize contract.
+    """
+
+    def __init__(self, rows, cols, data, shape: Tuple[int, int]):
+        self.rows = rows
+        self.cols = cols
+        self.data = data
+        self.shape = (int(shape[0]), int(shape[1]))
+
+    @property
+    def nnz(self) -> int:
+        return int(self.rows.shape[0])
+
+    @property
+    def n_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.shape[1]
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def to_device(self) -> "COOMatrix":
+        return COOMatrix(jnp.asarray(self.rows), jnp.asarray(self.cols),
+                         jnp.asarray(self.data), self.shape)
+
+    def to_host(self) -> "COOMatrix":
+        g = jax.device_get
+        return COOMatrix(np.asarray(g(self.rows)), np.asarray(g(self.cols)),
+                         np.asarray(g(self.data)), self.shape)
+
+    def to_scipy(self):
+        import scipy.sparse as sp
+
+        h = self.to_host()
+        return sp.coo_matrix((h.data, (h.rows, h.cols)), shape=self.shape)
+
+    @staticmethod
+    def from_scipy(mat) -> "COOMatrix":
+        mat = mat.tocoo()
+        return COOMatrix(jnp.asarray(mat.row), jnp.asarray(mat.col),
+                         jnp.asarray(mat.data), mat.shape)
+
+
+# -- pytree registration so sparse matrices flow through jit ----------------
+
+def _csr_flatten(m: CSRMatrix):
+    return (m.indptr, m.indices, m.data), m.shape
+
+
+def _csr_unflatten(shape, children):
+    return CSRMatrix(*children, shape=shape)
+
+
+def _coo_flatten(m: COOMatrix):
+    return (m.rows, m.cols, m.data), m.shape
+
+
+def _coo_unflatten(shape, children):
+    return COOMatrix(*children, shape=shape)
+
+
+jax.tree_util.register_pytree_node(CSRMatrix, _csr_flatten, _csr_unflatten)
+jax.tree_util.register_pytree_node(COOMatrix, _coo_flatten, _coo_unflatten)

@@ -1,0 +1,68 @@
+"""Tracing/profiling ranges (ref: core/nvtx.hpp:88-136).
+
+The reference emits NVTX domain-tagged push/pop ranges (compiled out unless
+``RAFT_NVTX``).  The TPU analogue is `jax.named_scope` (visible in XLA HLO
+and Xprof traces) plus `jax.profiler.TraceAnnotation` for host-side spans.
+A thread-local range stack mirrors core/detail/nvtx_range_stack.hpp so
+observers (e.g. the memory resource_monitor) can ask "what range am I in?".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional
+
+import jax
+
+_tls = threading.local()
+
+
+def _stack() -> List[str]:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def current_range() -> Optional[str]:
+    """Innermost active range name (None outside any range)."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+def range_stack() -> List[str]:
+    return list(_stack())
+
+
+@contextlib.contextmanager
+def push_range(name: str):
+    """RAII-style range (ref: nvtx.hpp `class range`); usable as decorator
+    via `annotate`."""
+    _stack().append(name)
+    try:
+        with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        _stack().pop()
+
+
+# Alias matching the reference's free functions.
+range = push_range
+
+
+def annotate(name: Optional[str] = None):
+    """Decorator form: wraps fn body in a named range."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        def wrapped(*args, **kwargs):
+            with push_range(label):
+                return fn(*args, **kwargs)
+
+        wrapped.__name__ = fn.__name__
+        wrapped.__qualname__ = fn.__qualname__
+        wrapped.__doc__ = fn.__doc__
+        return wrapped
+
+    return deco

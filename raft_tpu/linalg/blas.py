@@ -1,0 +1,86 @@
+"""BLAS-level ops: gemm/gemv/axpy/dot/transpose
+(ref: linalg/gemm.cuh, gemv.cuh, axpy.cuh, dot.cuh, transpose.cuh and the
+cuBLAS(Lt) wrapper layer linalg/detail/cublas_wrappers.hpp,
+cublaslt_wrappers.hpp:28-62).
+
+The reference routes gemm through cublasLt with a compute-type table
+(fp32/fp16/int8).  On TPU the MXU is driven through `lax.dot_general` with
+``preferred_element_type`` as the compute-type knob; bf16 inputs with f32
+accumulation is the fast path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def gemm(res, A, B, alpha: float = 1.0, beta: float = 0.0, C=None,
+         trans_a: bool = False, trans_b: bool = False,
+         compute_type=None):
+    """C = alpha·op(A)·op(B) + beta·C (ref: linalg/gemm.cuh).
+
+    ``compute_type`` maps the reference's cublasLt compute-type selection
+    (detail/cublaslt_wrappers.hpp get_matmul_type): None → accumulate in
+    f32 (or f64 for f64 inputs); pass jnp.float32 explicitly to force MXU
+    bf16×bf16→f32 style accumulation for low-precision inputs.
+    """
+    A = jnp.asarray(A)
+    B = jnp.asarray(B)
+    if trans_a:
+        A = A.T
+    if trans_b:
+        B = B.T
+    if compute_type is None:
+        compute_type = jnp.float64 if A.dtype == jnp.float64 else jnp.float32
+    out = lax.dot_general(A, B, (((1,), (0,)), ((), ())),
+                          preferred_element_type=compute_type)
+    out = (alpha * out).astype(A.dtype) if alpha != 1.0 else out.astype(A.dtype)
+    if C is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(C)
+    return out
+
+
+def gemv(res, A, x, alpha: float = 1.0, beta: float = 0.0, y=None,
+         trans: bool = False):
+    """y = alpha·op(A)·x + beta·y (ref: linalg/gemv.cuh)."""
+    A = jnp.asarray(A)
+    x = jnp.asarray(x)
+    if trans:
+        A = A.T
+    out = alpha * (A @ x)
+    if y is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(y)
+    return out.astype(A.dtype)
+
+
+def axpy(res, alpha: float, x, y):
+    """y = alpha·x + y (ref: linalg/axpy.cuh)."""
+    return alpha * jnp.asarray(x) + jnp.asarray(y)
+
+
+def dot(res, x, y):
+    """Inner product (ref: linalg/dot.cuh)."""
+    x = jnp.asarray(x)
+    return jnp.dot(x.ravel(), jnp.asarray(y).ravel(),
+                   preferred_element_type=jnp.float32 if
+                   x.dtype != jnp.float64 else jnp.float64).astype(x.dtype)
+
+
+def transpose(res, A):
+    """Out-of-place transpose (ref: linalg/transpose.cuh — cublas geam)."""
+    return jnp.asarray(A).T
+
+
+def scal(res, alpha: float, x):
+    return alpha * jnp.asarray(x)
+
+
+def mean_squared_error(res, a, b, weight: float = 1.0):
+    """weight · mean((a-b)^2) (ref: linalg/mean_squared_error.cuh)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    d = a - b
+    return weight * jnp.mean(d * d)

@@ -1,0 +1,43 @@
+"""Rank-1 Cholesky update (ref: linalg/cholesky_r1_update.cuh).
+
+The reference grows an L factor of A by one row/column incrementally:
+given L of A[:n-1,:n-1] and the new column A[:,n-1], compute the new row
+of L.  Same math here; the triangular solve is `solve_triangular`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+
+def cholesky_r1_update(res, L, A_new_col, n: int, lower: bool = True,
+                       eps: float = 0.0):
+    """Extend Cholesky factor by one rank.
+
+    Args:
+      L: [n, n] buffer whose leading (n-1)×(n-1) block is the factor of the
+         previous matrix (lower) — only that block is read.
+      A_new_col: the new column A[:n, n-1] (length n).
+      n: new size.
+    Returns the updated [n, n] factor (lower/upper per ``lower``).
+    """
+    L = jnp.asarray(L)
+    a = jnp.asarray(A_new_col).ravel()
+    if not lower:
+        L = L.T
+    if n == 1:
+        val = jnp.sqrt(jnp.maximum(a[0], eps if eps > 0 else a[0]))
+        out = L.at[0, 0].set(val)
+        return out if lower else out.T
+    Lsub = L[: n - 1, : n - 1]
+    # Solve L[:n-1,:n-1] · x = a[:n-1]
+    x = solve_triangular(Lsub, a[: n - 1], lower=True)
+    d_sq = a[n - 1] - jnp.dot(x, x)
+    if eps > 0:
+        d_sq = jnp.maximum(d_sq, eps)
+    d = jnp.sqrt(d_sq)
+    out = L.at[n - 1, : n - 1].set(x)
+    out = out.at[n - 1, n - 1].set(d)
+    out = out.at[: n - 1, n - 1].set(jnp.zeros((n - 1,), dtype=L.dtype))
+    return out if lower else out.T

@@ -1,0 +1,47 @@
+"""Symmetric eigensolvers (ref: linalg/eig.cuh — cuSOLVER syevd/syevj/syevdx).
+
+XLA's `eigh` (QDWH-eig on TPU) replaces cuSOLVER's divide-&-conquer and
+Jacobi paths; both reference spellings are kept and dispatch to the same
+compiled routine.  ``eig_sel`` (syevdx subset selection) computes the full
+decomposition and slices — on TPU the full eigh is MXU-bound and subset
+tricks don't pay until n is very large, where Lanczos
+(raft_tpu.sparse.solver) is the right tool anyway.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+EigVecUsage = ("OVERWRITE_INPUT", "COPY_INPUT")
+
+
+def eig_dc(res, matrix):
+    """Divide-and-conquer eigendecomposition of a symmetric matrix.
+
+    Returns (eigenvalues ascending, eigenvectors as columns)
+    (ref: eig.cuh eig_dc → cusolverDnsyevd).
+    """
+    m = jnp.asarray(matrix)
+    w, v = jnp.linalg.eigh(m)
+    return w, v
+
+
+def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15):
+    """Jacobi eigensolver spelling (ref: eig.cuh eig_jacobi → syevj).
+
+    tol/sweeps are accepted for parity; XLA's eigh is already
+    iteration-free from the caller's perspective.
+    """
+    return eig_dc(res, matrix)
+
+
+def eig_sel(res, matrix, n_eig_vals: int, largest: bool = True):
+    """Subset eigendecomposition (ref: eig.cuh eig_sel → syevdx).
+
+    Returns the ``n_eig_vals`` largest (or smallest) eigenpairs, eigenvalues
+    ascending within the selection, vectors as columns.
+    """
+    w, v = eig_dc(res, matrix)
+    if largest:
+        return w[-n_eig_vals:], v[:, -n_eig_vals:]
+    return w[:n_eig_vals], v[:, :n_eig_vals]

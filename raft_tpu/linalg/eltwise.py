@@ -1,0 +1,76 @@
+"""Elementwise operations (ref: linalg/add.cuh, subtract.cuh, divide.cuh,
+multiply.cuh, power.cuh, sqrt.cuh, unary_op.cuh, binary_op.cuh,
+ternary_op.cuh, eltwise.cuh).
+
+XLA fuses these into surrounding computations; the wrappers exist for API
+parity and for the scalar variants' broadcasting rules.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def add(res, a, b):
+    return jnp.asarray(a) + jnp.asarray(b)
+
+
+def add_scalar(res, a, scalar):
+    return jnp.asarray(a) + scalar
+
+
+def subtract(res, a, b):
+    return jnp.asarray(a) - jnp.asarray(b)
+
+
+def subtract_scalar(res, a, scalar):
+    return jnp.asarray(a) - scalar
+
+
+def multiply(res, a, b):
+    return jnp.asarray(a) * jnp.asarray(b)
+
+
+def multiply_scalar(res, a, scalar):
+    return jnp.asarray(a) * scalar
+
+
+def divide(res, a, b):
+    return jnp.asarray(a) / jnp.asarray(b)
+
+
+def divide_scalar(res, a, scalar):
+    return jnp.asarray(a) / scalar
+
+
+def power(res, a, b):
+    return jnp.power(jnp.asarray(a), jnp.asarray(b))
+
+
+def power_scalar(res, a, scalar):
+    return jnp.power(jnp.asarray(a), scalar)
+
+
+def sqrt(res, a):
+    return jnp.sqrt(jnp.asarray(a))
+
+
+def unary_op(res, a, op):
+    """out[i] = op(a[i]) (ref: unary_op.cuh)."""
+    return op(jnp.asarray(a))
+
+
+def write_only_unary_op(res, shape, op, dtype=jnp.float32):
+    """out[i] = op(i) over a fresh array (ref: write_only_unary_op)."""
+    n = 1
+    for s in shape:
+        n *= s
+    return op(jnp.arange(n).reshape(shape)).astype(dtype)
+
+
+def binary_op(res, a, b, op):
+    return op(jnp.asarray(a), jnp.asarray(b))
+
+
+def ternary_op(res, a, b, c, op):
+    return op(jnp.asarray(a), jnp.asarray(b), jnp.asarray(c))

@@ -1,0 +1,42 @@
+"""Least squares solvers (ref: linalg/lstsq.cuh — SVD/eig/QR variants)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def lstsq_svd_qr(res, A, b):
+    """Minimum-norm solution via SVD (ref: lstsq.cuh lstsqSvdQR)."""
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    u, s, vt = jnp.linalg.svd(A, full_matrices=False)
+    cutoff = jnp.finfo(A.dtype).eps * max(A.shape) * s[0]
+    s_inv = jnp.where(s > cutoff, 1.0 / s, 0.0)
+    return vt.T @ (s_inv * (u.T @ b))
+
+
+def lstsq_svd_jacobi(res, A, b):
+    """ref: lstsq.cuh lstsqSvdJacobi (gesvdj path)."""
+    return lstsq_svd_qr(res, A, b)
+
+
+def lstsq_eig(res, A, b):
+    """Normal-equations path via eigendecomposition of AᵀA
+    (ref: lstsq.cuh lstsqEig)."""
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    g = A.T @ A
+    w, v = jnp.linalg.eigh(g)
+    cutoff = jnp.finfo(A.dtype).eps * max(A.shape) * jnp.max(jnp.abs(w))
+    w_inv = jnp.where(jnp.abs(w) > cutoff, 1.0 / w, 0.0)
+    return v @ (w_inv * (v.T @ (A.T @ b)))
+
+
+def lstsq_qr(res, A, b):
+    """QR path (ref: lstsq.cuh lstsqQR — geqrf/ormqr + triangular solve)."""
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    q, r = jnp.linalg.qr(A, mode="reduced")
+    from jax.scipy.linalg import solve_triangular
+
+    return solve_triangular(r, q.T @ b, lower=False)

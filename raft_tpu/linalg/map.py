@@ -1,0 +1,55 @@
+"""n-ary elementwise map primitives (ref: linalg/map.cuh:95-241,
+linalg/map_reduce.cuh).
+
+Under XLA, a map is just a traced elementwise expression — the fusion the
+reference implements with vectorized-IO kernels falls out of the compiler.
+These wrappers keep RAFT's calling shapes (op first-class, n-ary inputs,
+offset variants) so algorithm code reads the same.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def map(res, op, *ins):
+    """out[i] = op(ins0[i], ins1[i], ...) (ref: map.cuh map)."""
+    return op(*[jnp.asarray(x) for x in ins])
+
+
+def map_offset(res, op, shape_or_ref, *ins):
+    """out[i] = op(i, ins0[i], ...) (ref: map.cuh map_offset).
+
+    ``shape_or_ref`` gives the output shape (an int, tuple, or array whose
+    shape is used); the flat offset is passed as the first op argument.
+    """
+    if isinstance(shape_or_ref, int):
+        shape = (shape_or_ref,)
+    elif isinstance(shape_or_ref, tuple):
+        shape = shape_or_ref
+    else:
+        shape = tuple(shape_or_ref.shape)
+    n = 1
+    for s in shape:
+        n *= s
+    idx = jnp.arange(n).reshape(shape)
+    return op(idx, *[jnp.asarray(x) for x in ins])
+
+
+def map_reduce(res, op, reduce_op, init, *ins):
+    """reduce(op(ins...)) to scalar (ref: map_reduce.cuh map_reduce)."""
+    mapped = op(*[jnp.asarray(x) for x in ins])
+    flat = mapped.ravel()
+    out = init
+    # Use lax.reduce for general monoids; jnp covers the common ones fast.
+    if reduce_op in (jnp.add, None):
+        return jnp.sum(flat) + init
+    return lax.reduce(flat, jnp.asarray(init, dtype=flat.dtype),
+                      lambda a, b: reduce_op(a, b), (0,))
+
+
+def map_then_reduce(res, op, *ins):
+    """Sum-reduction of a mapped expression
+    (ref: map_then_reduce / map_then_sum_reduce)."""
+    return jnp.sum(op(*[jnp.asarray(x) for x in ins]))

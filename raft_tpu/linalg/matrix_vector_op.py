@@ -1,0 +1,35 @@
+"""Broadcast a vector (or two) along rows/columns of a matrix
+(ref: linalg/matrix_vector_op.cuh, detail/matrix_vector_op.cuh:23-82 —
+delegates to matrix::linewise_op in the reference).
+
+``apply`` names the broadcast direction with RAFT's vocabulary:
+ALONG_ROWS broadcasts a length-n_cols vector across every row;
+ALONG_COLUMNS broadcasts a length-n_rows vector down every column.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from raft_tpu.linalg.reduce import ALONG_COLUMNS, ALONG_ROWS
+
+
+def matrix_vector_op(res, matrix, vec, op: Callable,
+                     apply: str = ALONG_ROWS, vec2=None):
+    """out[i,j] = op(m[i,j], v[j] (, v2[j])) for ALONG_ROWS
+    (ref: matrix_vector_op.cuh)."""
+    m = jnp.asarray(matrix)
+    v = jnp.asarray(vec)
+    if apply == ALONG_ROWS:
+        bv = v[None, :]
+        bv2 = None if vec2 is None else jnp.asarray(vec2)[None, :]
+    elif apply == ALONG_COLUMNS:
+        bv = v[:, None]
+        bv2 = None if vec2 is None else jnp.asarray(vec2)[:, None]
+    else:
+        raise ValueError(f"bad apply {apply}")
+    if vec2 is None:
+        return op(m, bv)
+    return op(m, bv, bv2)

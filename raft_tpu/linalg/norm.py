@@ -1,0 +1,57 @@
+"""Row/column norms and normalization (ref: linalg/norm.cuh,
+normalize.cuh, norm_types.hpp)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.linalg.reduce import ALONG_COLUMNS, ALONG_ROWS, _axis
+
+L1Norm = "l1"
+L2Norm = "l2"
+LinfNorm = "linf"
+
+
+def norm(res, data, norm_type: str = L2Norm, apply: str = ALONG_ROWS,
+         sqrt: bool = False):
+    """Per-row/column norm (ref: norm.cuh rowNorm/colNorm).
+
+    Matches the reference's convention: L2 returns the *squared* norm unless
+    ``sqrt=True`` (rowNorm's fin_op).
+    """
+    data = jnp.asarray(data)
+    axis = _axis(apply)
+    if norm_type == L1Norm:
+        out = jnp.sum(jnp.abs(data), axis=axis)
+    elif norm_type == L2Norm:
+        out = jnp.sum(data * data, axis=axis)
+        if sqrt:
+            out = jnp.sqrt(out)
+    elif norm_type == LinfNorm:
+        out = jnp.max(jnp.abs(data), axis=axis)
+    else:
+        raise ValueError(f"unknown norm {norm_type}")
+    return out
+
+
+def row_norm(res, data, norm_type: str = L2Norm, sqrt: bool = False):
+    return norm(res, data, norm_type, ALONG_ROWS, sqrt)
+
+
+def col_norm(res, data, norm_type: str = L2Norm, sqrt: bool = False):
+    return norm(res, data, norm_type, ALONG_COLUMNS, sqrt)
+
+
+def normalize(res, data, norm_type: str = L2Norm, eps: float = 1e-8):
+    """Row-normalize (ref: normalize.cuh row_normalize)."""
+    data = jnp.asarray(data)
+    if norm_type == L2Norm:
+        n = jnp.sqrt(jnp.sum(data * data, axis=1, keepdims=True))
+    elif norm_type == L1Norm:
+        n = jnp.sum(jnp.abs(data), axis=1, keepdims=True)
+    elif norm_type == LinfNorm:
+        n = jnp.max(jnp.abs(data), axis=1, keepdims=True)
+    else:
+        raise ValueError(f"unknown norm {norm_type}")
+    return jnp.where(n > eps, data / jnp.maximum(n, eps),
+                     jnp.zeros_like(data))

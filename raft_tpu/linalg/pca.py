@@ -1,0 +1,165 @@
+"""PCA and truncated SVD (ref: linalg/pca.cuh:41-178, linalg/tsvd.cuh:34-160,
+detail/tsvd.cuh; moved into RAFT from cuML — CHANGELOG.md:21).
+
+Solvers mirror the reference's ``enum class solver`` (pca_types.hpp:21):
+COV_EIG_DQ (covariance + divide-&-conquer eig), COV_EIG_JACOBI, and the
+randomized path.  All heavy steps are MXU matmuls + XLA eigh/svd.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import RngState
+
+
+class Solver(enum.Enum):
+    COV_EIG_DQ = "cov_eig_dq"
+    COV_EIG_JACOBI = "cov_eig_jacobi"
+    RANDOMIZED = "randomized"
+
+
+class PCAResult(NamedTuple):
+    components: jnp.ndarray          # [n_components, n_cols]
+    explained_variance: jnp.ndarray  # [n_components]
+    explained_variance_ratio: jnp.ndarray
+    singular_values: jnp.ndarray
+    mean: jnp.ndarray                # [n_cols]
+    noise_variance: jnp.ndarray      # scalar
+
+
+def sign_flip_components(components, U=None):
+    """Deterministic sign convention: the max-|value| entry of each
+    component is made positive (ref: tsvd.cuh sign_flip / signFlip)."""
+    comps = jnp.asarray(components)
+    idx = jnp.argmax(jnp.abs(comps), axis=1)
+    signs = jnp.sign(comps[jnp.arange(comps.shape[0]), idx])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    comps = comps * signs[:, None]
+    if U is not None:
+        return comps, jnp.asarray(U) * signs[None, :]
+    return comps
+
+
+def cal_eig(res, cov, n_components: int, solver: Solver = Solver.COV_EIG_DQ):
+    """Top-k eigenpairs of a covariance matrix, descending
+    (ref: pca.cuh calEig)."""
+    w, v = jnp.linalg.eigh(jnp.asarray(cov))
+    w = w[::-1]
+    v = v[:, ::-1]
+    return w[:n_components], v[:, :n_components]
+
+
+def pca_fit(res, X, n_components: int,
+            solver: Solver = Solver.COV_EIG_DQ,
+            state: Optional[RngState] = None) -> PCAResult:
+    """Fit PCA (ref: pca.cuh pca_fit).
+
+    Returns components as rows, explained variance (unbiased, n-1 divisor),
+    singular values and the column mean — matching the reference's outputs.
+    """
+    X = jnp.asarray(X)
+    n_rows, n_cols = X.shape
+    mu = jnp.mean(X, axis=0)
+    Xc = X - mu[None, :]
+
+    if solver == Solver.RANDOMIZED:
+        from raft_tpu.linalg.svd import rsvd_fixed_rank
+
+        u, s, v = rsvd_fixed_rank(res, Xc, n_components, state=state)
+        explained = (s * s) / (n_rows - 1)
+        comps = v.T
+    else:
+        cov = (Xc.T @ Xc) / (n_rows - 1)
+        w, v = cal_eig(res, cov, n_components, solver)
+        explained = w
+        s = jnp.sqrt(jnp.maximum(w * (n_rows - 1), 0.0))
+        comps = v.T
+
+    comps = sign_flip_components(comps)
+    total_var = jnp.sum(jnp.var(X, axis=0, ddof=1))
+    ratio = explained / total_var
+    if n_components < min(n_rows, n_cols):
+        noise = (total_var - jnp.sum(explained)) / (
+            min(n_rows, n_cols) - n_components)
+    else:
+        noise = jnp.asarray(0.0, dtype=X.dtype)
+    return PCAResult(comps.astype(X.dtype), explained.astype(X.dtype),
+                     ratio.astype(X.dtype), s.astype(X.dtype), mu,
+                     noise.astype(X.dtype))
+
+
+def pca_transform(res, X, result: PCAResult, whiten: bool = False):
+    """Project into component space (ref: pca.cuh pca_transform)."""
+    X = jnp.asarray(X)
+    t = (X - result.mean[None, :]) @ result.components.T
+    if whiten:
+        t = t / jnp.sqrt(jnp.maximum(result.explained_variance,
+                                     1e-30))[None, :]
+    return t
+
+
+def pca_inverse_transform(res, T, result: PCAResult, whiten: bool = False):
+    """ref: pca.cuh pca_inverse_transform."""
+    T = jnp.asarray(T)
+    if whiten:
+        T = T * jnp.sqrt(jnp.maximum(result.explained_variance,
+                                     1e-30))[None, :]
+    return T @ result.components + result.mean[None, :]
+
+
+def pca_fit_transform(res, X, n_components: int, **kw):
+    result = pca_fit(res, X, n_components, **kw)
+    return pca_transform(res, X, result), result
+
+
+# -- truncated SVD (no centering) -------------------------------------------
+
+
+class TSVDResult(NamedTuple):
+    components: jnp.ndarray
+    singular_values: jnp.ndarray
+    explained_variance: jnp.ndarray
+    explained_variance_ratio: jnp.ndarray
+
+
+def tsvd_fit(res, X, n_components: int,
+             solver: Solver = Solver.COV_EIG_DQ,
+             state: Optional[RngState] = None) -> TSVDResult:
+    """Truncated SVD on the *uncentered* data (ref: tsvd.cuh tsvd_fit —
+    eig of XᵀX)."""
+    X = jnp.asarray(X)
+    n_rows = X.shape[0]
+    if solver == Solver.RANDOMIZED:
+        from raft_tpu.linalg.svd import rsvd_fixed_rank
+
+        u, s, v = rsvd_fixed_rank(res, X, n_components, state=state)
+        comps = v.T
+    else:
+        g = X.T @ X
+        w, v = cal_eig(res, g, n_components, solver)
+        s = jnp.sqrt(jnp.maximum(w, 0.0))
+        comps = v.T
+    comps = sign_flip_components(comps)
+    T = X @ comps.T
+    explained = jnp.var(T, axis=0, ddof=1)
+    total_var = jnp.sum(jnp.var(X, axis=0, ddof=1))
+    return TSVDResult(comps.astype(X.dtype), s.astype(X.dtype),
+                      explained.astype(X.dtype),
+                      (explained / total_var).astype(X.dtype))
+
+
+def tsvd_transform(res, X, result: TSVDResult):
+    return jnp.asarray(X) @ result.components.T
+
+
+def tsvd_inverse_transform(res, T, result: TSVDResult):
+    return jnp.asarray(T) @ result.components
+
+
+def tsvd_fit_transform(res, X, n_components: int, **kw):
+    result = tsvd_fit(res, X, n_components, **kw)
+    return tsvd_transform(res, X, result), result

@@ -1,0 +1,16 @@
+"""QR factorization (ref: linalg/qr.cuh — cuSOLVER geqrf/orgqr)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def qr_get_q(res, matrix):
+    """Q factor only (ref: qr.cuh qrGetQ)."""
+    q, _ = jnp.linalg.qr(jnp.asarray(matrix), mode="reduced")
+    return q
+
+
+def qr_get_qr(res, matrix):
+    """(Q, R) (ref: qr.cuh qrGetQR)."""
+    return jnp.linalg.qr(jnp.asarray(matrix), mode="reduced")

@@ -1,0 +1,90 @@
+"""Row/column reductions (ref: linalg/reduce.cuh, coalesced_reduction-inl.cuh,
+strided_reduction.cuh, reduce_rows_by_key.cuh, reduce_cols_by_key.cuh).
+
+The reference dispatches coalesced vs strided kernel families by layout
+(reduce.cuh:63,148) and picks thin/medium/thick block policies by shape.  On
+TPU a reduction is a single XLA `reduce` the compiler tiles onto the VPU; the
+layout dispatch collapses to an ``axis`` argument.  ``apply`` selects whether
+the reduction runs along rows or columns, matching the reference's
+``Apply::ALONG_ROWS/ALONG_COLUMNS`` vocabulary (linalg_types.hpp).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import operators as ops
+
+ALONG_ROWS = "along_rows"        # reduce each row → one value per row
+ALONG_COLUMNS = "along_columns"  # reduce each column → one value per column
+
+
+def _axis(apply: str) -> int:
+    if apply == ALONG_ROWS:
+        return 1
+    if apply == ALONG_COLUMNS:
+        return 0
+    raise ValueError(f"apply must be ALONG_ROWS or ALONG_COLUMNS, got {apply}")
+
+
+def reduce(res, data, apply: str = ALONG_ROWS, init: float = 0.0,
+           main_op: Callable = ops.identity_op,
+           reduce_op: Callable = ops.add_op,
+           final_op: Callable = ops.identity_op,
+           inplace: bool = False, out=None):
+    """Generalized reduction: final_op(reduce(main_op(x), init))
+    (ref: reduce.cuh raft::linalg::reduce)."""
+    data = jnp.asarray(data)
+    axis = _axis(apply)
+    mapped = main_op(data)
+    init_val = jnp.asarray(init, dtype=mapped.dtype)
+    if reduce_op is ops.add_op:
+        red = jnp.sum(mapped, axis=axis) + init_val
+    elif reduce_op is ops.min_op:
+        red = jnp.minimum(jnp.min(mapped, axis=axis), init_val)
+    elif reduce_op is ops.max_op:
+        red = jnp.maximum(jnp.max(mapped, axis=axis), init_val)
+    else:
+        red = jax.lax.reduce(mapped, init_val,
+                             lambda a, b: reduce_op(a, b), (axis,))
+    out_val = final_op(red)
+    if inplace and out is not None:
+        return reduce_op(out, out_val)
+    return out_val
+
+
+def coalesced_reduction(res, data, init: float = 0.0, **kw):
+    """Reduce along the contiguous (last) dimension
+    (ref: coalesced_reduction.cuh)."""
+    return reduce(res, data, apply=ALONG_ROWS, init=init, **kw)
+
+
+def strided_reduction(res, data, init: float = 0.0, **kw):
+    """Reduce along the strided (first) dimension
+    (ref: strided_reduction.cuh)."""
+    return reduce(res, data, apply=ALONG_COLUMNS, init=init, **kw)
+
+
+def reduce_rows_by_key(res, data, keys, n_unique_keys: int, weights=None):
+    """Sum rows that share a key: out[k, :] = Σ_{i: keys[i]==k} w[i]·data[i, :]
+    (ref: reduce_rows_by_key.cuh).
+
+    TPU formulation: segment-sum — a scatter-add XLA lowers to an efficient
+    sorted-segment reduction; no atomics needed.
+    """
+    data = jnp.asarray(data)
+    keys = jnp.asarray(keys)
+    if weights is not None:
+        data = data * jnp.asarray(weights)[:, None].astype(data.dtype)
+    return jax.ops.segment_sum(data, keys, num_segments=n_unique_keys)
+
+
+def reduce_cols_by_key(res, data, keys, n_unique_keys: int):
+    """Sum columns that share a key: out[:, k] = Σ_{j: keys[j]==k} data[:, j]
+    (ref: reduce_cols_by_key.cuh)."""
+    data = jnp.asarray(data)
+    keys = jnp.asarray(keys)
+    return jax.ops.segment_sum(data.T, keys, num_segments=n_unique_keys).T

@@ -1,0 +1,93 @@
+"""SVD and randomized SVD (ref: linalg/svd.cuh, rsvd.cuh).
+
+Full SVD maps to XLA's `jnp.linalg.svd`; the reference's QR- and
+Jacobi-flavoured spellings dispatch to the same routine.  Randomized SVD
+keeps the reference's structure (row/column-sampled range finder + small
+exact SVD) built from MXU matmuls and QR — the algorithm of Halko et al.
+that rsvd.cuh implements.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import RngState
+
+
+def svd_qr(res, matrix, full_matrices: bool = False):
+    """SVD returning (U, S, V) with V as columns of right singular vectors
+    (ref: svd.cuh svdQR).  Note: returns V, not V^T."""
+    u, s, vt = jnp.linalg.svd(jnp.asarray(matrix),
+                              full_matrices=full_matrices)
+    return u, s, vt.T
+
+
+def svd_eig(res, matrix):
+    """SVD via eigendecomposition of the Gram matrix
+    (ref: svd.cuh svdEig — the path used when n_rows >> n_cols)."""
+    a = jnp.asarray(matrix)
+    w, v = jnp.linalg.eigh(a.T @ a)          # ascending
+    w = w[::-1]
+    v = v[:, ::-1]
+    s = jnp.sqrt(jnp.maximum(w, 0.0))
+    u = (a @ v) / jnp.maximum(s[None, :], jnp.finfo(a.dtype).tiny)
+    return u, s, v
+
+
+def svd_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15):
+    """Jacobi SVD spelling (ref: svd.cuh svdJacobi → gesvdj)."""
+    return svd_qr(res, matrix)
+
+
+def svd_reconstruction(res, u, s, v):
+    """A ≈ U·diag(S)·V^T (ref: svd.cuh svdReconstruction)."""
+    return (jnp.asarray(u) * jnp.asarray(s)[None, :]) @ jnp.asarray(v).T
+
+
+def evaluate_svd_by_reconstruction(res, matrix, u, s, v,
+                                   tol: float = 1e-3) -> bool:
+    """ref: svd.cuh evaluateSVDByL2Norm."""
+    a = jnp.asarray(matrix)
+    recon = svd_reconstruction(res, u, s, v)
+    err = jnp.linalg.norm(a - recon) / jnp.maximum(jnp.linalg.norm(a), 1e-30)
+    return bool(err < tol)
+
+
+def rsvd_fixed_rank(res, matrix, k: int, p: int = 10, n_iter: int = 2,
+                    state: Optional[RngState] = None,
+                    use_bbt: Optional[bool] = None):
+    """Randomized SVD, fixed rank k with oversampling p
+    (ref: rsvd.cuh rsvd_fixed_rank / randomized_svd).
+
+    Structure follows the reference's range-finder: Gaussian sketch →
+    power iterations with QR re-orthonormalization → small SVD in the
+    subspace.  All heavy ops are MXU matmuls.
+    """
+    a = jnp.asarray(matrix)
+    m, n = a.shape
+    state = state or RngState(seed=0)
+    ell = min(k + p, min(m, n))
+    omega = jax.random.normal(state.next_key(), (n, ell), dtype=a.dtype)
+    y = a @ omega
+    q, _ = jnp.linalg.qr(y)
+    for _ in range(n_iter):
+        z, _ = jnp.linalg.qr(a.T @ q)
+        q, _ = jnp.linalg.qr(a @ z)
+    b = q.T @ a                                   # ell × n
+    ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = q @ ub
+    return u[:, :k], s[:k], vt[:k].T
+
+
+def rsvd_perc(res, matrix, perc: float, p: int = 10, n_iter: int = 2,
+              state: Optional[RngState] = None):
+    """Rank chosen as a fraction of min(m,n) (ref: rsvd.cuh rsvdPerc)."""
+    m, n = jnp.asarray(matrix).shape
+    k = max(1, int(perc * min(m, n)))
+    return rsvd_fixed_rank(res, matrix, k, p=p, n_iter=n_iter, state=state)
+
+
+randomized_svd = rsvd_fixed_rank

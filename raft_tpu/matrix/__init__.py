@@ -1,0 +1,34 @@
+"""Dense matrix primitives (ref: cpp/include/raft/matrix/)."""
+
+from raft_tpu.matrix.select_k import SelectAlgo, select_k  # noqa: F401
+from raft_tpu.matrix.argminmax import argmin, argmax  # noqa: F401
+from raft_tpu.matrix.gather import gather, gather_if, scatter  # noqa: F401
+from raft_tpu.matrix.linewise_op import linewise_op  # noqa: F401
+from raft_tpu.matrix.ops import (  # noqa: F401
+    copy,
+    get_diagonal,
+    set_diagonal,
+    invert_diagonal,
+    eye,
+    fill,
+    linspace,
+    l2_norm,
+    weighted_power,
+    power,
+    ratio,
+    reciprocal,
+    col_reverse,
+    row_reverse,
+    sign_flip,
+    slice,
+    sqrt,
+    zero_small_values,
+    upper_triangular,
+    lower_triangular,
+    SHIFT_TOWARDS_END,
+    SHIFT_TOWARDS_BEGINNING,
+    col_shift,
+    row_shift,
+    sort_cols_per_row,
+    sample_rows,
+)

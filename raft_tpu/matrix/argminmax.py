@@ -1,0 +1,18 @@
+"""Per-row argmin/argmax (ref: matrix/argmax.cuh, matrix/argmin.cuh).
+
+Tie-breaking: smallest index wins, matching the reference's KVP atomics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def argmin(res, matrix):
+    """Index of the minimum of each row (ref: argmin.cuh)."""
+    return jnp.argmin(jnp.asarray(matrix), axis=1).astype(jnp.int32)
+
+
+def argmax(res, matrix):
+    """Index of the maximum of each row (ref: argmax.cuh)."""
+    return jnp.argmax(jnp.asarray(matrix), axis=1).astype(jnp.int32)

@@ -1,0 +1,45 @@
+"""Row gather/scatter (ref: matrix/gather.cuh, matrix/scatter.cuh,
+detail/gather.cuh, gather_inplace.cuh, scatter_inplace.cuh).
+
+XLA's gather is a first-class op on TPU; the reference's kernel zoo
+(gather, gather_if, gatherv, transformed maps) collapses to indexed reads
+with optional transforms and masks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+
+def gather(res, matrix, indices, transform: Optional[Callable] = None):
+    """out[i, :] = matrix[indices[i], :] (ref: gather.cuh gather)."""
+    m = jnp.asarray(matrix)
+    idx = jnp.asarray(indices)
+    out = m[idx]
+    return transform(out) if transform is not None else out
+
+
+def gather_if(res, matrix, indices, stencil, pred: Callable,
+              transform: Optional[Callable] = None, fill_value=0):
+    """Gather rows whose stencil passes pred; failing rows filled
+    (ref: gather.cuh gather_if)."""
+    m = jnp.asarray(matrix)
+    idx = jnp.asarray(indices)
+    keep = pred(jnp.asarray(stencil))
+    out = m[idx]
+    if transform is not None:
+        out = transform(out)
+    return jnp.where(keep[:, None], out, jnp.asarray(fill_value,
+                                                     dtype=out.dtype))
+
+
+def scatter(res, matrix, indices, updates=None):
+    """out[indices[i], :] = updates[i, :] — or a permutation-scatter of
+    matrix itself when updates is None (ref: scatter.cuh in-place kernel)."""
+    m = jnp.asarray(matrix)
+    idx = jnp.asarray(indices)
+    if updates is None:
+        return jnp.zeros_like(m).at[idx].set(m)
+    return m.at[idx].set(jnp.asarray(updates))

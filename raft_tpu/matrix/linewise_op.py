@@ -1,0 +1,26 @@
+"""Row/column broadcast op over a matrix with one or more vectors
+(ref: matrix/linewise_op.cuh, detail/linewise_op.cuh:40,246-296).
+
+The reference's `struct Linewise` hand-vectorizes the broadcast; XLA emits
+the same fused loads from a broadcasted expression, so this is a thin,
+layout-aware wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+
+def linewise_op(res, matrix, op: Callable, along_lines: bool, *vecs):
+    """Apply op(m_ij, v1_?, v2_?, ...) broadcasting each vec along matrix
+    lines.  along_lines=True: vectors have length n_cols and broadcast
+    across rows (vec indexed by column); False: length n_rows, indexed by
+    row (ref: linewise_op.cuh matrixLinewiseOp)."""
+    m = jnp.asarray(matrix)
+    if along_lines:
+        bvecs = [jnp.asarray(v)[None, :] for v in vecs]
+    else:
+        bvecs = [jnp.asarray(v)[:, None] for v in vecs]
+    return op(m, *bvecs)

@@ -1,0 +1,194 @@
+"""Misc dense matrix ops (ref: matrix/{copy,diagonal,init,norm,power,print,
+ratio,reciprocal,reverse,sign_flip,slice,sqrt,threshold,triangular,shift,
+col_wise_sort,sample_rows}.cuh)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import RngState
+
+
+def copy(res, matrix):
+    """Fresh copy (ref: matrix/copy.cuh)."""
+    return jnp.array(jnp.asarray(matrix))
+
+
+def get_diagonal(res, matrix):
+    """Extract diagonal (ref: matrix/diagonal.cuh get_diagonal)."""
+    return jnp.diagonal(jnp.asarray(matrix))
+
+
+def set_diagonal(res, matrix, vec):
+    """Set diagonal (ref: matrix/diagonal.cuh set_diagonal)."""
+    m = jnp.asarray(matrix)
+    n = min(m.shape)
+    idx = jnp.arange(n)
+    return m.at[idx, idx].set(jnp.asarray(vec, dtype=m.dtype)[:n])
+
+
+def invert_diagonal(res, matrix):
+    """ref: matrix/diagonal.cuh invert_diagonal."""
+    m = jnp.asarray(matrix)
+    n = min(m.shape)
+    idx = jnp.arange(n)
+    return m.at[idx, idx].set(1.0 / m[idx, idx])
+
+
+def eye(res, n_rows: int, n_cols: Optional[int] = None, dtype=jnp.float32):
+    """Identity fill (ref: matrix/init.cuh / eye)."""
+    return jnp.eye(n_rows, n_cols if n_cols is not None else n_rows,
+                   dtype=dtype)
+
+
+def fill(res, shape, value, dtype=jnp.float32):
+    """Constant fill (ref: matrix/init.cuh fill)."""
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def linspace(res, start, stop, n: int, dtype=jnp.float32):
+    return jnp.linspace(start, stop, n, dtype=dtype)
+
+
+def l2_norm(res, matrix):
+    """Frobenius norm (ref: matrix/norm.cuh l2_norm)."""
+    m = jnp.asarray(matrix)
+    return jnp.sqrt(jnp.sum(m * m))
+
+
+def weighted_power(res, matrix, weight: float = 1.0, exponent: float = 2.0):
+    """weight · m^exponent elementwise (ref: matrix/power.cuh)."""
+    return weight * jnp.power(jnp.asarray(matrix), exponent)
+
+
+def power(res, matrix, exponent: float = 2.0):
+    return jnp.power(jnp.asarray(matrix), exponent)
+
+
+def ratio(res, matrix):
+    """m / sum(m) (ref: matrix/ratio.cuh)."""
+    m = jnp.asarray(matrix)
+    return m / jnp.sum(m)
+
+
+def reciprocal(res, matrix, scalar: float = 1.0, setzero: bool = False,
+               thres: float = 1e-15):
+    """scalar / m with optional zero-guard (ref: matrix/reciprocal.cuh)."""
+    m = jnp.asarray(matrix)
+    if setzero:
+        return jnp.where(jnp.abs(m) <= thres, jnp.zeros_like(m), scalar / m)
+    return scalar / m
+
+
+def col_reverse(res, matrix):
+    """Reverse column order (ref: matrix/reverse.cuh col_reverse)."""
+    return jnp.asarray(matrix)[:, ::-1]
+
+
+def row_reverse(res, matrix):
+    """Reverse row order (ref: matrix/reverse.cuh row_reverse)."""
+    return jnp.asarray(matrix)[::-1, :]
+
+
+def sign_flip(res, matrix):
+    """Flip column signs so each column's max-|v| entry is positive
+    (ref: matrix/math.cuh signFlip — column-major convention)."""
+    m = jnp.asarray(matrix)
+    idx = jnp.argmax(jnp.abs(m), axis=0)
+    signs = jnp.sign(m[idx, jnp.arange(m.shape[1])])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return m * signs[None, :]
+
+
+def slice(res, matrix, row_range: Tuple[int, int],
+          col_range: Tuple[int, int]):
+    """Submatrix copy (ref: matrix/slice.cuh)."""
+    return jnp.asarray(matrix)[row_range[0]:row_range[1],
+                               col_range[0]:col_range[1]]
+
+
+def sqrt(res, matrix):
+    return jnp.sqrt(jnp.asarray(matrix))
+
+
+def zero_small_values(res, matrix, thres: float = 1e-15):
+    """ref: matrix/threshold.cuh zero_small_values."""
+    m = jnp.asarray(matrix)
+    return jnp.where(jnp.abs(m) < thres, jnp.zeros_like(m), m)
+
+
+def upper_triangular(res, matrix):
+    """Extract upper triangle (ref: matrix/triangular.cuh)."""
+    return jnp.triu(jnp.asarray(matrix))
+
+
+def lower_triangular(res, matrix):
+    return jnp.tril(jnp.asarray(matrix))
+
+
+# -- shift (ref: matrix/shift.cuh, shift_types.hpp) --------------------------
+
+SHIFT_TOWARDS_END = "towards_end"
+SHIFT_TOWARDS_BEGINNING = "towards_beginning"
+
+
+def col_shift(res, matrix, k: int = 1,
+              direction: str = SHIFT_TOWARDS_END, fill_value=0.0,
+              values=None):
+    """Shift columns by k, filling vacated columns with a constant or given
+    values (ref: shift.cuh col shift)."""
+    m = jnp.asarray(matrix)
+    n_rows, n_cols = m.shape
+    if values is not None:
+        fill_block = jnp.broadcast_to(jnp.asarray(values, dtype=m.dtype),
+                                      (n_rows, k))
+    else:
+        fill_block = jnp.full((n_rows, k), fill_value, dtype=m.dtype)
+    if direction == SHIFT_TOWARDS_END:
+        return jnp.concatenate([fill_block, m[:, : n_cols - k]], axis=1)
+    return jnp.concatenate([m[:, k:], fill_block], axis=1)
+
+
+def row_shift(res, matrix, k: int = 1,
+              direction: str = SHIFT_TOWARDS_END, fill_value=0.0,
+              values=None):
+    m = jnp.asarray(matrix)
+    n_rows, n_cols = m.shape
+    if values is not None:
+        fill_block = jnp.broadcast_to(jnp.asarray(values, dtype=m.dtype),
+                                      (k, n_cols))
+    else:
+        fill_block = jnp.full((k, n_cols), fill_value, dtype=m.dtype)
+    if direction == SHIFT_TOWARDS_END:
+        return jnp.concatenate([fill_block, m[: n_rows - k, :]], axis=0)
+    return jnp.concatenate([m[k:, :], fill_block], axis=0)
+
+
+# -- col_wise_sort (ref: matrix/col_wise_sort.cuh) ---------------------------
+
+def sort_cols_per_row(res, matrix, ascending: bool = True,
+                      return_indices: bool = False):
+    """Sort each row's values (the reference's "column-wise sort per row",
+    cub segmented sort).  Optionally return source indices."""
+    m = jnp.asarray(matrix)
+    order = m if ascending else -m
+    if return_indices:
+        idx = jnp.argsort(order, axis=1, stable=True).astype(jnp.int32)
+        return jnp.take_along_axis(m, idx, axis=1), idx
+    srt = jnp.sort(order, axis=1)
+    return srt if ascending else -srt
+
+
+# -- sample_rows (ref: matrix/sample_rows.cuh:30) ----------------------------
+
+def sample_rows(res, state: RngState, matrix, n_samples: int):
+    """Uniform random row subsample without replacement
+    (gather + excess_subsample, ref: detail/sample_rows.cuh)."""
+    from raft_tpu.random.rng import excess_subsample
+
+    m = jnp.asarray(matrix)
+    idx = excess_subsample(res, state, n_samples, m.shape[0])
+    return m[idx]

@@ -1,0 +1,30 @@
+"""Random generation (ref: cpp/include/raft/random/)."""
+
+from raft_tpu.random.rng_state import RngState, GeneratorType  # noqa: F401
+from raft_tpu.random.rng import (  # noqa: F401
+    uniform,
+    uniform_int,
+    normal,
+    normal_int,
+    normal_table,
+    fill,
+    bernoulli,
+    scaled_bernoulli,
+    gumbel,
+    laplace,
+    logistic,
+    lognormal,
+    rayleigh,
+    exponential,
+    sample,
+    sample_without_replacement,
+    excess_subsample,
+)
+from raft_tpu.random.make_blobs import make_blobs  # noqa: F401
+from raft_tpu.random.make_regression import make_regression  # noqa: F401
+from raft_tpu.random.permute import permute, permute_rows  # noqa: F401
+from raft_tpu.random.multi_variable_gaussian import (  # noqa: F401
+    multi_variable_gaussian,
+    Decomposer,
+)
+from raft_tpu.random.rmat import rmat_rectangular_gen  # noqa: F401

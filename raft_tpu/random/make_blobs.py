@@ -1,0 +1,39 @@
+"""Isotropic Gaussian cluster generator (ref: random/make_blobs.cuh,
+kernel detail/make_blobs.cuh:88-160)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import RngState
+
+
+def make_blobs(res, state: RngState, n_rows: int, n_cols: int,
+               n_clusters: int = 5, cluster_std: float = 1.0,
+               center_box: Tuple[float, float] = (-10.0, 10.0),
+               centers: Optional[jnp.ndarray] = None,
+               shuffle: bool = True, dtype=jnp.float32):
+    """Generate (X[n_rows, n_cols], labels[n_rows], centers).
+
+    Matches the reference's semantics: centers drawn uniformly in
+    ``center_box`` unless provided; points = center[label] + N(0, std);
+    labels assigned in round-robin then shuffled.
+    """
+    kc, kl, kn, ks = jax.random.split(state.next_key(), 4)
+    if centers is None:
+        centers = jax.random.uniform(
+            kc, (n_clusters, n_cols), dtype=dtype,
+            minval=center_box[0], maxval=center_box[1])
+    else:
+        centers = jnp.asarray(centers, dtype=dtype)
+        n_clusters = centers.shape[0]
+
+    labels = jnp.arange(n_rows, dtype=jnp.int32) % n_clusters
+    if shuffle:
+        labels = jax.random.permutation(kl, labels)
+    noise = jax.random.normal(kn, (n_rows, n_cols), dtype=dtype) * cluster_std
+    X = centers[labels] + noise
+    return X, labels, centers

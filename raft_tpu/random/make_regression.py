@@ -1,0 +1,58 @@
+"""Linear-model dataset generator (ref: random/make_regression.cuh).
+
+X is Gaussian (optionally with low effective rank), y = X·w + bias + noise,
+with ``n_informative`` nonzero weight rows — the reference's gemm(+optional
+qr) pipeline expressed as XLA matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import RngState
+
+
+def make_regression(res, state: RngState, n_rows: int, n_cols: int,
+                    n_informative: Optional[int] = None, n_targets: int = 1,
+                    bias: float = 0.0, effective_rank: Optional[int] = None,
+                    tail_strength: float = 0.5, noise: float = 0.0,
+                    shuffle: bool = True, dtype=jnp.float32):
+    """Returns (X[n_rows,n_cols], y[n_rows,n_targets], w[n_cols,n_targets])."""
+    n_informative = n_informative if n_informative is not None else n_cols
+    kx, kw, kn, kp, kr = jax.random.split(state.next_key(), 5)
+
+    if effective_rank is None:
+        X = jax.random.normal(kx, (n_rows, n_cols), dtype=dtype)
+    else:
+        # Low-rank X with bell-shaped singular profile, as in the reference's
+        # make_low_rank_matrix path.
+        k1, k2 = jax.random.split(kx)
+        u, _ = jnp.linalg.qr(jax.random.normal(k1, (n_rows, n_cols),
+                                               dtype=jnp.float32))
+        v, _ = jnp.linalg.qr(jax.random.normal(k2, (n_cols, n_cols),
+                                               dtype=jnp.float32))
+        sing_idx = jnp.arange(n_cols, dtype=jnp.float32) / effective_rank
+        low_rank = (1 - tail_strength) * jnp.exp(-(sing_idx ** 2))
+        tail = tail_strength * jnp.exp(-0.1 * sing_idx)
+        s = low_rank + tail
+        X = ((u * s[None, :]) @ v.T).astype(dtype)
+
+    w = jnp.zeros((n_cols, n_targets), dtype=dtype)
+    w_inf = 100.0 * jax.random.uniform(kw, (n_informative, n_targets),
+                                       dtype=dtype)
+    w = w.at[:n_informative].set(w_inf)
+
+    y = X @ w + jnp.asarray(bias, dtype=dtype)
+    if noise > 0.0:
+        y = y + noise * jax.random.normal(kn, y.shape, dtype=dtype)
+
+    if shuffle:
+        row_perm = jax.random.permutation(kp, n_rows)
+        col_perm = jax.random.permutation(kr, n_cols)
+        X = X[row_perm][:, col_perm]
+        w = w[col_perm]
+        y = y[row_perm]
+    return X, y, w

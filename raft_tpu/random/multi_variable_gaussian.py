@@ -1,0 +1,51 @@
+"""Multi-variable Gaussian sampling (ref: random/multi_variable_gaussian.cuh).
+
+The reference decomposes the covariance with a selectable method
+(``enum Decomposer { chol_decomp, jacobi, qr }``,
+detail/multi_variable_gaussian.cuh:121) via cuSOLVER; here the same three
+spellings map to `jnp.linalg` Cholesky / eigendecomposition / QR-of-sqrt.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import RngState
+
+
+class Decomposer(enum.Enum):
+    CHOLESKY = "chol_decomp"
+    JACOBI = "jacobi"      # symmetric eigendecomposition
+    QR = "qr"
+
+
+def multi_variable_gaussian(res, state: RngState, mean, cov, n_samples: int,
+                            method: Decomposer = Decomposer.CHOLESKY,
+                            dtype=jnp.float32):
+    """Draw ``n_samples`` from N(mean, cov); returns [n_samples, dim]."""
+    mean = jnp.asarray(mean, dtype=jnp.float32)
+    cov = jnp.asarray(cov, dtype=jnp.float32)
+    dim = mean.shape[0]
+
+    if method == Decomposer.CHOLESKY:
+        factor = jnp.linalg.cholesky(cov)
+    elif method == Decomposer.JACOBI:
+        w, v = jnp.linalg.eigh(cov)
+        factor = v * jnp.sqrt(jnp.maximum(w, 0.0))[None, :]
+    elif method == Decomposer.QR:
+        # cov = (v sqrt(w))(v sqrt(w))^T; QR of the square root gives an
+        # equivalent factor with orthogonal mixing, matching the reference's
+        # qr decomposer semantics (any F with F F^T = cov works).
+        w, v = jnp.linalg.eigh(cov)
+        root = v * jnp.sqrt(jnp.maximum(w, 0.0))[None, :]
+        q, r = jnp.linalg.qr(root.T)
+        factor = r.T
+    else:
+        raise ValueError(f"unknown decomposer {method}")
+
+    z = jax.random.normal(state.next_key(), (n_samples, dim),
+                          dtype=jnp.float32)
+    return (mean[None, :] + z @ factor.T).astype(dtype)

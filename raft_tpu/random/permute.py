@@ -1,0 +1,20 @@
+"""Random permutations (ref: random/permute.cuh)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import RngState
+
+
+def permute(res, state: RngState, n: int, dtype=jnp.int32):
+    """Random permutation of [0, n) (ref: raft::random::permute perms out)."""
+    return jax.random.permutation(state.next_key(), n).astype(dtype)
+
+
+def permute_rows(res, state: RngState, X):
+    """Row-permuted copy of X plus the permutation used."""
+    X = jnp.asarray(X)
+    perm = jax.random.permutation(state.next_key(), X.shape[0])
+    return X[perm], perm.astype(jnp.int32)

@@ -1,0 +1,152 @@
+"""Random distribution generators (ref: random/rng.cuh:43-794).
+
+Every function takes ``(res, state, shape, ...)`` and returns a fresh array;
+``state`` is an :class:`RngState` whose subsequence advances per call, so
+repeated calls produce independent streams (the reference's contract where
+each kernel launch consumes a subsequence).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import RngState
+
+Shape = Union[int, Sequence[int]]
+
+
+def _shape(shape: Shape):
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(res, state: RngState, shape: Shape, low=0.0, high=1.0,
+            dtype=jnp.float32):
+    """U[low, high) (ref: rng.cuh uniform)."""
+    return jax.random.uniform(state.next_key(), _shape(shape), dtype=dtype,
+                              minval=low, maxval=high)
+
+
+def uniform_int(res, state: RngState, shape: Shape, low: int, high: int,
+                dtype=jnp.int32):
+    """Integers in [low, high) (ref: rng.cuh uniformInt)."""
+    return jax.random.randint(state.next_key(), _shape(shape), low, high,
+                              dtype=dtype)
+
+
+def normal(res, state: RngState, shape: Shape, mu=0.0, sigma=1.0,
+           dtype=jnp.float32):
+    return jax.random.normal(state.next_key(), _shape(shape),
+                             dtype=dtype) * sigma + mu
+
+
+def normal_int(res, state: RngState, shape: Shape, mu: int, sigma: int,
+               dtype=jnp.int32):
+    """Rounded normal (ref: rng.cuh normalInt)."""
+    vals = jax.random.normal(state.next_key(), _shape(shape),
+                             dtype=jnp.float32) * sigma + mu
+    return jnp.round(vals).astype(dtype)
+
+
+def normal_table(res, state: RngState, n_rows: int, mu_vec, sigma_vec,
+                 dtype=jnp.float32):
+    """Per-column mean/sigma normal table (ref: rng.cuh normalTable)."""
+    mu_vec = jnp.asarray(mu_vec, dtype=dtype)
+    sigma_vec = jnp.asarray(sigma_vec, dtype=dtype)
+    n_cols = mu_vec.shape[0]
+    z = jax.random.normal(state.next_key(), (n_rows, n_cols), dtype=dtype)
+    return z * sigma_vec[None, :] + mu_vec[None, :]
+
+
+def fill(res, state: RngState, shape: Shape, value, dtype=jnp.float32):
+    return jnp.full(_shape(shape), value, dtype=dtype)
+
+
+def bernoulli(res, state: RngState, shape: Shape, prob: float):
+    return jax.random.bernoulli(state.next_key(), prob, _shape(shape))
+
+
+def scaled_bernoulli(res, state: RngState, shape: Shape, prob: float,
+                     scale: float, dtype=jnp.float32):
+    """±scale with P(positive)=1-prob (ref: rng.cuh scaled_bernoulli)."""
+    b = jax.random.bernoulli(state.next_key(), prob, _shape(shape))
+    return jnp.where(b, -scale, scale).astype(dtype)
+
+
+def gumbel(res, state: RngState, shape: Shape, mu=0.0, beta=1.0,
+           dtype=jnp.float32):
+    return (jax.random.gumbel(state.next_key(), _shape(shape), dtype=dtype)
+            * beta + mu)
+
+
+def laplace(res, state: RngState, shape: Shape, mu=0.0, scale=1.0,
+            dtype=jnp.float32):
+    return (jax.random.laplace(state.next_key(), _shape(shape), dtype=dtype)
+            * scale + mu)
+
+
+def logistic(res, state: RngState, shape: Shape, mu=0.0, scale=1.0,
+             dtype=jnp.float32):
+    return (jax.random.logistic(state.next_key(), _shape(shape), dtype=dtype)
+            * scale + mu)
+
+
+def lognormal(res, state: RngState, shape: Shape, mu=0.0, sigma=1.0,
+              dtype=jnp.float32):
+    z = jax.random.normal(state.next_key(), _shape(shape), dtype=dtype)
+    return jnp.exp(z * sigma + mu)
+
+
+def rayleigh(res, state: RngState, shape: Shape, sigma=1.0,
+             dtype=jnp.float32):
+    u = jax.random.uniform(state.next_key(), _shape(shape), dtype=dtype,
+                           minval=jnp.finfo(dtype).tiny, maxval=1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def exponential(res, state: RngState, shape: Shape, lam=1.0,
+                dtype=jnp.float32):
+    return jax.random.exponential(state.next_key(), _shape(shape),
+                                  dtype=dtype) / lam
+
+
+def sample(res, state: RngState, n_samples: int, weights,
+           replace: bool = True, dtype=jnp.int32):
+    """Weighted discrete sampling (ref: rng.cuh discrete / sample)."""
+    weights = jnp.asarray(weights)
+    idx = jax.random.choice(state.next_key(), weights.shape[0],
+                            shape=(n_samples,), replace=replace, p=weights /
+                            jnp.sum(weights))
+    return idx.astype(dtype)
+
+
+def sample_without_replacement(res, state: RngState, n_samples: int,
+                               weights=None, pool_size: Optional[int] = None,
+                               dtype=jnp.int32):
+    """Weighted sampling without replacement via the Gumbel-top-k trick —
+    the one-pass equivalent of the reference's Fisher-Yates-free kernel
+    (ref: rng.cuh sampleWithoutReplacement,
+    random/sample_without_replacement.cuh:90)."""
+    if weights is None:
+        if pool_size is None:
+            raise ValueError("need weights or pool_size")
+        logits = jnp.zeros((pool_size,), dtype=jnp.float32)
+    else:
+        weights = jnp.asarray(weights, dtype=jnp.float32)
+        logits = jnp.log(jnp.maximum(weights, jnp.finfo(jnp.float32).tiny))
+        pool_size = weights.shape[0]
+    if n_samples > pool_size:
+        raise ValueError("n_samples exceeds pool size")
+    g = jax.random.gumbel(state.next_key(), (pool_size,), dtype=jnp.float32)
+    _, idx = jax.lax.top_k(logits + g, n_samples)
+    return idx.astype(dtype)
+
+
+def excess_subsample(res, state: RngState, n_samples: int, pool_size: int,
+                     dtype=jnp.int32):
+    """Uniform subsample of [0, pool_size) without replacement
+    (ref: random/excess_sampling / matrix::sample_rows backend)."""
+    return sample_without_replacement(res, state, n_samples,
+                                      pool_size=pool_size, dtype=dtype)

@@ -1,0 +1,59 @@
+"""PRNG state (ref: random/rng_state.hpp:19-45).
+
+The reference's ``RngState`` carries {seed, base_subsequence, generator_type}
+for counter-based Philox/PCG device generators.  JAX's PRNG is already
+counter-based (threefry2x32 default; rbg available), so the TPU rebuild keeps
+the same shape: a seed plus an advancing subsequence counter, deterministic
+and order-independent across calls — each kernel launch folds
+(seed, subsequence) into a fresh key.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+
+class GeneratorType(enum.Enum):
+    """ref: GeneratorType enum (GenPhilox/GenPC).  JAX exposes threefry and
+    rbg; both are counter-based like the originals."""
+
+    THREEFRY = "threefry"
+    RBG = "rbg"
+
+
+class RngState:
+    def __init__(self, seed: int = 0, base_subsequence: int = 0,
+                 type: GeneratorType = GeneratorType.THREEFRY):
+        self.seed = int(seed)
+        self.base_subsequence = int(base_subsequence)
+        self.type = type
+
+    def advance(self, max_streams_used: int = 1,
+                max_calls_per_subsequence: int = 1) -> None:
+        """Advance the subsequence so the next call sees fresh streams
+        (ref: rng_state.hpp `advance`)."""
+        self.base_subsequence += int(max_streams_used) * int(
+            max_calls_per_subsequence)
+
+    def key(self) -> jax.Array:
+        """The jax PRNG key for the *current* subsequence."""
+        base = jax.random.key(self.seed)
+        return jax.random.fold_in(base, self.base_subsequence)
+
+    def next_key(self) -> jax.Array:
+        """Key for this call, then advance — one key per kernel launch."""
+        k = self.key()
+        self.advance()
+        return k
+
+    def split(self, n: int):
+        """n independent keys for intra-call parallel streams."""
+        return jax.random.split(self.next_key(), n)
+
+    def __repr__(self):
+        return (f"RngState(seed={self.seed}, "
+                f"base_subsequence={self.base_subsequence}, "
+                f"type={self.type.value})")

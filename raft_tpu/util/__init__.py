@@ -1,0 +1,31 @@
+"""Utility layer (ref: cpp/include/raft/util/).
+
+The reference's util/ is intra-kernel CUDA machinery (warp shuffles, bitonic
+sort, vectorized IO).  On TPU those jobs belong to the Mosaic compiler, so
+the utilities that survive are the host-side ones: integer/layout math,
+alignment helpers, and Pallas launch plumbing.
+"""
+
+from raft_tpu.util.math import (  # noqa: F401
+    cdiv,
+    round_up_to_multiple,
+    round_down_to_multiple,
+    is_pow2,
+    next_pow2,
+    prev_pow2,
+    Pow2,
+    FastIntDiv,
+    bound_by_power_of_two_and_ratio,
+)
+from raft_tpu.util.pallas_utils import (  # noqa: F401
+    use_interpret,
+    pallas_call,
+    MIN_BLOCK,
+)
+from raft_tpu.util.input_validation import (  # noqa: F401
+    expect,
+    expect_shape,
+    expect_2d,
+    expect_same_shape,
+)
+from raft_tpu.util.itertools import product_of_lists  # noqa: F401

@@ -1,0 +1,29 @@
+"""Argument validation (ref: util/input_validation.hpp, RAFT_EXPECTS)."""
+
+from __future__ import annotations
+
+
+def expect(cond: bool, msg: str) -> None:
+    """RAFT_EXPECTS equivalent (ref: core/error.hpp)."""
+    if not cond:
+        raise ValueError(msg)
+
+
+def expect_shape(arr, shape, name: str = "array") -> None:
+    actual = tuple(arr.shape)
+    expected = tuple(shape)
+    if len(actual) != len(expected) or any(
+            e is not None and a != e for a, e in zip(actual, expected)):
+        raise ValueError(f"{name}: expected shape {expected}, got {actual}")
+
+
+def expect_2d(arr, name: str = "array") -> None:
+    if arr.ndim != 2:
+        raise ValueError(f"{name}: expected 2-D array, got ndim={arr.ndim}")
+
+
+def expect_same_shape(a, b, names=("a", "b")) -> None:
+    if tuple(a.shape) != tuple(b.shape):
+        raise ValueError(
+            f"{names[0]} shape {tuple(a.shape)} != {names[1]} shape "
+            f"{tuple(b.shape)}")

@@ -1,0 +1,12 @@
+"""Host-side test-case generation helper (ref: util/itertools.hpp)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterable, List
+
+
+def product_of_lists(*lists: Iterable[Any]) -> List[tuple]:
+    """Cartesian product used to build parameterized test inputs
+    (ref: raft::util::itertools::product)."""
+    return list(itertools.product(*lists))

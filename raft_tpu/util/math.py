@@ -1,0 +1,91 @@
+"""Integer / layout math helpers (ref: util/pow2_utils.cuh,
+util/fast_int_div.cuh, util/integer_utils.hpp)."""
+
+from __future__ import annotations
+
+
+def cdiv(a: int, b: int) -> int:
+    """Ceiling division (ref: raft::ceildiv, util/cuda_utils.cuh)."""
+    return -(-a // b)
+
+
+def round_up_to_multiple(x: int, m: int) -> int:
+    return cdiv(x, m) * m
+
+
+def round_down_to_multiple(x: int, m: int) -> int:
+    return (x // m) * m
+
+
+def is_pow2(x: int) -> bool:
+    return x > 0 and (x & (x - 1)) == 0
+
+
+def next_pow2(x: int) -> int:
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def prev_pow2(x: int) -> int:
+    if x < 1:
+        raise ValueError("prev_pow2 requires x >= 1")
+    return 1 << (x.bit_length() - 1)
+
+
+class Pow2:
+    """Power-of-two layout math (ref: util/pow2_utils.cuh `Pow2<Value>`)."""
+
+    def __init__(self, value: int):
+        if not is_pow2(value):
+            raise ValueError(f"{value} is not a power of two")
+        self.value = value
+        self.mask = value - 1
+        self.log2 = value.bit_length() - 1
+
+    def round_down(self, x: int) -> int:
+        return x & ~self.mask
+
+    def round_up(self, x: int) -> int:
+        return (x + self.mask) & ~self.mask
+
+    def div(self, x: int) -> int:
+        return x >> self.log2
+
+    def mod(self, x: int) -> int:
+        return x & self.mask
+
+    def is_aligned(self, x: int) -> bool:
+        return (x & self.mask) == 0
+
+
+class FastIntDiv:
+    """Strength-reduced division by a runtime constant
+    (ref: util/fast_int_div.cuh).
+
+    On TPU the XLA compiler already strength-reduces division by traced
+    constants; this host-side version exists for API parity and host loops.
+    """
+
+    def __init__(self, divisor: int):
+        if divisor <= 0:
+            raise ValueError("divisor must be positive")
+        self.divisor = divisor
+
+    def div(self, x: int) -> int:
+        return x // self.divisor
+
+    def mod(self, x: int) -> int:
+        return x % self.divisor
+
+    def __call__(self, x: int) -> int:
+        return self.div(x)
+
+
+def bound_by_power_of_two_and_ratio(total: int, cap_pow2: int,
+                                    ratio: int) -> int:
+    """Pick the largest power-of-two tile ≤ cap that divides work into at
+    least `ratio` pieces — the tile-size heuristic shape used throughout the
+    reference's kernel policies (e.g. linalg/contractions.cuh:52-80)."""
+    tile = min(cap_pow2, next_pow2(max(1, total // ratio)))
+    return max(1, prev_pow2(tile))

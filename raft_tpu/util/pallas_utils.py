@@ -1,0 +1,32 @@
+"""Pallas launch plumbing shared by raft_tpu kernels.
+
+Kernels compile via Mosaic on TPU and fall back to the Pallas interpreter on
+CPU (so the test suite runs on a virtual CPU mesh, mirroring the reference's
+strategy of validating kernels against host references).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+from jax.experimental import pallas as pl
+
+# Minimum lane-aligned block edge for f32 (sublane 8 × lane 128).
+MIN_BLOCK = (8, 128)
+
+
+@functools.lru_cache(maxsize=None)
+def use_interpret() -> bool:
+    """True when Pallas must run interpreted (no TPU backend present)."""
+    forced = os.environ.get("RAFT_TPU_PALLAS_INTERPRET")
+    if forced is not None:
+        return forced not in ("0", "false", "")
+    return jax.default_backend() != "tpu"
+
+
+def pallas_call(kernel, **kwargs):
+    """`pl.pallas_call` with backend-appropriate interpret default."""
+    kwargs.setdefault("interpret", use_interpret())
+    return pl.pallas_call(kernel, **kwargs)

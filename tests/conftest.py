@@ -1,0 +1,50 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy of exercising multi-GPU paths on a
+single host (LocalCUDACluster, SURVEY.md §4): we run the whole suite on CPU
+with 8 virtual devices so comms/mesh code paths execute for real, and Pallas
+kernels run in interpreter mode (see raft_tpu.util.pallas_utils).
+"""
+
+import os
+
+# Force CPU (the ambient environment may point JAX_PLATFORMS at real TPU
+# hardware, but the unit suite runs on an 8-device virtual CPU mesh).  Set
+# both the env var and — because pytest plugins (jaxtyping) import jax
+# before this file runs, baking the env-derived default in — the live jax
+# config, which is honored as long as no backend has initialized yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture(scope="session")
+def res():
+    import raft_tpu
+
+    return raft_tpu.device_resources(seed=42)
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices())
+    assert len(devs) >= 8, "conftest expects 8 virtual devices"
+    return Mesh(devs[:8], axis_names=("data",))
+
+
+@pytest.fixture
+def rng_state():
+    from raft_tpu.random import RngState
+
+    return RngState(seed=1234)

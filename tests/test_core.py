@@ -1,0 +1,310 @@
+"""Core runtime tests (ref test models: cpp/tests/core/*)."""
+
+import io
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import raft_tpu
+from raft_tpu.core import (
+    Bitmap,
+    Bitset,
+    CSRMatrix,
+    COOMatrix,
+    InterruptedException,
+    MdBuffer,
+    MemoryType,
+    ResourceType,
+    copy,
+    make_device_matrix,
+    make_device_vector,
+    make_host_matrix,
+    serialize,
+)
+from raft_tpu.core import interruptible, memory, trace
+from raft_tpu.core.resources import (
+    ResourceFactory,
+    Resources,
+    get_device_resources,
+    get_mesh,
+    get_rng_state,
+    get_workspace_limit,
+    set_workspace_limit,
+)
+
+
+class TestResources:
+    def test_lazy_construction(self):
+        res = Resources()
+        calls = []
+
+        def make():
+            calls.append(1)
+            return "the-resource"
+
+        res.add_resource_factory(ResourceFactory(ResourceType.LOGGER, make))
+        assert calls == []
+        assert res.get_resource(ResourceType.LOGGER) == "the-resource"
+        assert res.get_resource(ResourceType.LOGGER) == "the-resource"
+        assert calls == [1]  # constructed exactly once
+
+    def test_missing_factory_raises(self):
+        res = Resources()
+        with pytest.raises(KeyError):
+            res.get_resource(ResourceType.COMMS)
+
+    def test_shallow_copy_shares_state(self):
+        res = Resources()
+        res.set_resource(ResourceType.WORKSPACE, 123)
+        clone = Resources(res)
+        assert clone.get_resource(ResourceType.WORKSPACE) == 123
+        clone.set_resource(ResourceType.WORKSPACE, 456)
+        assert res.get_resource(ResourceType.WORKSPACE) == 456
+
+    def test_device_resources_defaults(self, res):
+        assert res.device in jax.devices()
+        assert get_mesh(res) is not None
+        assert get_rng_state(res).seed == 42
+
+    def test_workspace_limit(self, res):
+        set_workspace_limit(res, 1 << 20)
+        assert get_workspace_limit(res) == 1 << 20
+
+    def test_manager_caches_per_thread(self):
+        h1 = get_device_resources()
+        h2 = get_device_resources()
+        assert h1 is h2
+        results = []
+
+        def worker():
+            results.append(get_device_resources())
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert results[0] is not h1
+
+    def test_sync(self, res):
+        x = jnp.ones((8, 8)) * 2
+        res.sync_stream(x)
+
+
+class TestMdArray:
+    def test_factories(self, res):
+        m = make_device_matrix(res, 4, 5)
+        assert m.shape == (4, 5)
+        assert m.memory_type == MemoryType.DEVICE
+        v = make_device_vector(res, 7, dtype=jnp.int32)
+        assert v.dtype == jnp.int32
+        h = make_host_matrix(3, 3)
+        assert isinstance(h.view(), np.ndarray)
+
+    def test_copy_host_device_roundtrip(self, res):
+        h = make_host_matrix(4, 4, dtype=np.float64)
+        h.data[:] = np.arange(16, dtype=np.float64).reshape(4, 4)
+        d = make_device_matrix(res, 4, 4, dtype=jnp.float32)
+        copy(res, d, h)
+        back = make_host_matrix(4, 4, dtype=np.float64)
+        copy(res, back, d)
+        np.testing.assert_allclose(np.asarray(back.view()),
+                                   np.asarray(h.view()))
+
+    def test_mdbuffer_lazy_copy(self):
+        src = np.arange(12, dtype=np.float32).reshape(3, 4)
+        buf = MdBuffer(src)
+        dview = buf.view(MemoryType.DEVICE)
+        assert isinstance(dview, jax.Array)
+        # cached: same object on second call
+        assert buf.view(MemoryType.DEVICE) is dview
+        np.testing.assert_array_equal(np.asarray(dview), src)
+
+
+class TestBitset:
+    def test_roundtrip(self):
+        bools = np.array([True, False, True, True] * 17 + [False])
+        bs = Bitset.from_bools(bools)
+        np.testing.assert_array_equal(np.asarray(bs.to_bools()), bools)
+        assert int(bs.count()) == int(bools.sum())
+
+    def test_set_and_test(self):
+        bs = Bitset(70, default_value=False)
+        bs = bs.set(jnp.array([0, 33, 69]))
+        assert bool(bs.test(33))
+        assert not bool(bs.test(34))
+        assert int(bs.count()) == 3
+        bs = bs.set(jnp.array([33]), value=False)
+        assert int(bs.count()) == 2
+
+    def test_flip_all_none(self):
+        bs = Bitset(10, default_value=False)
+        assert bool(bs.none())
+        flipped = bs.flip()
+        assert bool(flipped.all())
+        assert int(flipped.count()) == 10
+
+    def test_bitmap(self):
+        mat = np.zeros((5, 9), dtype=bool)
+        mat[2, 3] = True
+        mat[4, 8] = True
+        bm = Bitmap.from_bool_matrix(mat)
+        assert bool(bm.test_rc(2, 3))
+        assert not bool(bm.test_rc(2, 4))
+        np.testing.assert_array_equal(np.asarray(bm.to_bool_matrix()), mat)
+
+
+class TestSparseTypes:
+    def test_csr_scipy_roundtrip(self):
+        import scipy.sparse as sp
+
+        rng = np.random.default_rng(0)
+        m = sp.random(20, 30, density=0.2, random_state=rng, format="csr")
+        ours = CSRMatrix.from_scipy(m)
+        assert ours.nnz == m.nnz
+        back = ours.to_scipy()
+        assert (abs(back - m)).max() < 1e-12
+
+    def test_coo_roundtrip_and_pytree(self):
+        coo = COOMatrix(jnp.array([0, 1]), jnp.array([2, 0]),
+                        jnp.array([1.0, 2.0]), (3, 4))
+        leaves = jax.tree_util.tree_leaves(coo)
+        assert len(leaves) == 3
+
+        @jax.jit
+        def scale(c):
+            return COOMatrix(c.rows, c.cols, c.data * 2, c.shape)
+
+        out = scale(coo)
+        np.testing.assert_allclose(np.asarray(out.data), [2.0, 4.0])
+
+    def test_csr_row_ids(self):
+        indptr = jnp.array([0, 2, 2, 5])
+        csr = CSRMatrix(indptr, jnp.array([0, 1, 0, 1, 2]),
+                        jnp.ones(5), (3, 3))
+        np.testing.assert_array_equal(np.asarray(csr.row_ids()),
+                                      [0, 0, 2, 2, 2])
+
+
+class TestSerialize:
+    def test_npy_roundtrip_device(self, res):
+        x = jnp.arange(24, dtype=jnp.float32).reshape(4, 6)
+        buf = io.BytesIO()
+        serialize.serialize_mdspan(res, buf, x)
+        buf.seek(0)
+        y = serialize.deserialize_mdspan(res, buf)
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+        # also numpy-compatible
+        buf.seek(0)
+        z = np.load(buf)
+        np.testing.assert_array_equal(z, np.asarray(x))
+
+    def test_dumps_loads(self):
+        x = np.random.default_rng(0).normal(size=(5, 5))
+        data = serialize.dumps(x)
+        y = serialize.loads(data, to_device=False)
+        np.testing.assert_array_equal(x, y)
+
+
+class TestInterruptible:
+    def test_cancel_raises_on_next_check(self):
+        token = interruptible.get_token()
+        token.cancel()
+        with pytest.raises(InterruptedException):
+            interruptible.yield_now()
+        # flag consumed: next check passes
+        interruptible.yield_now()
+
+    def test_cross_thread_cancel(self):
+        errors = []
+        started = threading.Event()
+        tid_holder = []
+
+        def worker():
+            tid_holder.append(threading.get_ident())
+            started.set()
+            try:
+                for _ in range(2000):
+                    interruptible.synchronize(jnp.ones(4))
+            except InterruptedException:
+                errors.append("interrupted")
+
+        t = threading.Thread(target=worker)
+        t.start()
+        started.wait()
+        interruptible.cancel(tid_holder[0])
+        t.join(timeout=30)
+        assert errors == ["interrupted"]
+
+
+class TestTrace:
+    def test_range_stack(self):
+        assert trace.current_range() is None
+        with trace.push_range("outer"):
+            with trace.push_range("inner"):
+                assert trace.current_range() == "inner"
+                assert trace.range_stack() == ["outer", "inner"]
+            assert trace.current_range() == "outer"
+        assert trace.current_range() is None
+
+    def test_annotate_decorator(self):
+        @trace.annotate("my_op")
+        def fn(x):
+            assert trace.current_range() == "my_op"
+            return x + 1
+
+        assert fn(1) == 2
+
+
+class TestMemory:
+    def test_statistics_tracker(self):
+        tr = memory.StatisticsTracker()
+        tr.on_alloc(100)
+        tr.on_alloc(50)
+        tr.on_dealloc(100)
+        b, peak, na, nd = tr.snapshot()
+        assert (b, peak, na, nd) == (50, 150, 2, 1)
+
+    def test_notifying_tracker(self):
+        tr = memory.NotifyingTracker()
+        events = []
+        tr.subscribe(lambda kind, n: events.append((kind, n)))
+        tr.on_alloc(10)
+        tr.on_dealloc(10)
+        assert events == [("alloc", 10), ("dealloc", 10)]
+
+    def test_resource_monitor_writes_csv(self, tmp_path):
+        path = tmp_path / "monitor.csv"
+        tr = memory.StatisticsTracker()
+        with memory.ResourceMonitor(str(path), tracker=tr, interval_s=0.01):
+            tr.on_alloc(1000)
+            import time
+
+            time.sleep(0.05)
+        lines = path.read_text().strip().splitlines()
+        assert lines[0].startswith("time_s,range")
+        assert len(lines) >= 2
+
+    def test_mmap_buffer(self):
+        with memory.mmap_buffer(4096) as buf:
+            arr = buf.as_array(np.float32, (32, 32))
+            arr[:] = 7.0
+            assert arr.sum() == 7.0 * 1024
+
+
+class TestOperators:
+    def test_compose_and_plug(self):
+        from raft_tpu.core import operators as ops
+
+        f = ops.compose_op(ops.sqrt_op, ops.abs_op)
+        assert float(f(jnp.asarray(-4.0))) == 2.0
+        add3 = ops.plug_const_op(ops.add_op, 3.0)
+        assert float(add3(jnp.asarray(1.0))) == 4.0
+
+    def test_argmin_op(self):
+        from raft_tpu.core import operators as ops
+
+        k, v = ops.argmin_op((jnp.asarray(5), jnp.asarray(2.0)),
+                             (jnp.asarray(3), jnp.asarray(2.0)))
+        assert int(k) == 3  # tie → smaller key

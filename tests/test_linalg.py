@@ -1,0 +1,293 @@
+"""Dense linalg tests vs NumPy/SciPy references
+(ref test models: cpp/tests/linalg/*)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+from raft_tpu.core import operators as ops
+from raft_tpu.random import RngState
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+class TestBlas:
+    def test_gemm(self, rng):
+        A = rng.normal(size=(32, 16)).astype(np.float32)
+        B = rng.normal(size=(16, 24)).astype(np.float32)
+        out = np.asarray(linalg.gemm(None, A, B))
+        np.testing.assert_allclose(out, A @ B, rtol=1e-4)
+
+    def test_gemm_trans_alpha_beta(self, rng):
+        A = rng.normal(size=(16, 32)).astype(np.float32)
+        B = rng.normal(size=(24, 16)).astype(np.float32)
+        C = rng.normal(size=(32, 24)).astype(np.float32)
+        out = np.asarray(linalg.gemm(None, A, B, alpha=2.0, beta=0.5, C=C,
+                                     trans_a=True, trans_b=True))
+        np.testing.assert_allclose(out, 2.0 * (A.T @ B.T) + 0.5 * C,
+                                   rtol=1e-4)
+
+    def test_gemv_axpy_dot(self, rng):
+        A = rng.normal(size=(10, 5)).astype(np.float32)
+        x = rng.normal(size=5).astype(np.float32)
+        y = rng.normal(size=10).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.gemv(None, A, x)),
+                                   A @ x, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(linalg.axpy(None, 2.0, y, y)), 3.0 * y, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(linalg.dot(None, x, x)),
+                                   x @ x, rtol=1e-4)
+
+    def test_transpose_mse(self, rng):
+        A = rng.normal(size=(4, 7)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(linalg.transpose(None, A)),
+                                      A.T)
+        B = A + 1.0
+        np.testing.assert_allclose(
+            np.asarray(linalg.mean_squared_error(None, A, B)), 1.0,
+            rtol=1e-5)
+
+
+class TestReductions:
+    def test_reduce_rows_and_cols(self, rng):
+        X = rng.normal(size=(8, 6)).astype(np.float32)
+        r = np.asarray(linalg.reduce(None, X, apply=linalg.ALONG_ROWS))
+        np.testing.assert_allclose(r, X.sum(axis=1), rtol=1e-4)
+        c = np.asarray(linalg.reduce(None, X, apply=linalg.ALONG_COLUMNS))
+        np.testing.assert_allclose(c, X.sum(axis=0), rtol=1e-4)
+
+    def test_reduce_with_ops(self, rng):
+        X = rng.normal(size=(8, 6)).astype(np.float32)
+        # sum of squares with final sqrt = L2 norms
+        r = np.asarray(linalg.reduce(None, X, main_op=ops.sq_op,
+                                     final_op=ops.sqrt_op))
+        np.testing.assert_allclose(r, np.linalg.norm(X, axis=1), rtol=1e-4)
+        m = np.asarray(linalg.reduce(None, X, reduce_op=ops.max_op,
+                                     init=-np.inf))
+        np.testing.assert_allclose(m, X.max(axis=1))
+
+    def test_reduce_rows_by_key(self, rng):
+        X = rng.normal(size=(10, 4)).astype(np.float32)
+        keys = np.array([0, 1, 0, 2, 1, 0, 2, 2, 1, 0], dtype=np.int32)
+        out = np.asarray(linalg.reduce_rows_by_key(None, X, keys, 3))
+        for k in range(3):
+            np.testing.assert_allclose(out[k], X[keys == k].sum(axis=0),
+                                       rtol=1e-4)
+
+    def test_reduce_rows_by_key_weighted(self, rng):
+        X = rng.normal(size=(6, 3)).astype(np.float32)
+        keys = np.array([0, 0, 1, 1, 1, 0], dtype=np.int32)
+        w = rng.uniform(size=6).astype(np.float32)
+        out = np.asarray(linalg.reduce_rows_by_key(None, X, keys, 2,
+                                                   weights=w))
+        for k in range(2):
+            np.testing.assert_allclose(
+                out[k], (X[keys == k] * w[keys == k, None]).sum(axis=0),
+                rtol=1e-4)
+
+    def test_reduce_cols_by_key(self, rng):
+        X = rng.normal(size=(5, 8)).astype(np.float32)
+        keys = np.array([0, 1, 2, 0, 1, 2, 0, 1], dtype=np.int32)
+        out = np.asarray(linalg.reduce_cols_by_key(None, X, keys, 3))
+        for k in range(3):
+            np.testing.assert_allclose(out[:, k], X[:, keys == k].sum(axis=1),
+                                       rtol=1e-4)
+
+
+class TestMapNormMvo:
+    def test_map_and_map_offset(self, rng):
+        x = rng.normal(size=10).astype(np.float32)
+        y = rng.normal(size=10).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.map(None, ops.add_op, x, y)), x + y, rtol=1e-5)
+        out = np.asarray(linalg.map_offset(None, lambda i, v: i + v,
+                                           10, jnp.zeros(10)))
+        np.testing.assert_allclose(out, np.arange(10))
+
+    def test_map_then_reduce(self, rng):
+        x = rng.normal(size=100).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.map_then_reduce(None, ops.sq_op, x)),
+            (x * x).sum(), rtol=1e-3)
+
+    def test_matrix_vector_op(self, rng):
+        X = rng.normal(size=(6, 4)).astype(np.float32)
+        v = rng.normal(size=4).astype(np.float32)
+        out = np.asarray(linalg.matrix_vector_op(None, X, v, ops.add_op,
+                                                 apply=linalg.ALONG_ROWS))
+        np.testing.assert_allclose(out, X + v[None, :], rtol=1e-5)
+        w = rng.normal(size=6).astype(np.float32)
+        out = np.asarray(linalg.matrix_vector_op(None, X, w, ops.mul_op,
+                                                 apply=linalg.ALONG_COLUMNS))
+        np.testing.assert_allclose(out, X * w[:, None], rtol=1e-5)
+
+    def test_norms(self, rng):
+        X = rng.normal(size=(6, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.row_norm(None, X, linalg.L2Norm, sqrt=True)),
+            np.linalg.norm(X, axis=1), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(linalg.col_norm(None, X, linalg.L1Norm)),
+            np.abs(X).sum(axis=0), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(None, X, linalg.LinfNorm)),
+            np.abs(X).max(axis=1), rtol=1e-5)
+
+    def test_normalize(self, rng):
+        X = rng.normal(size=(6, 4)).astype(np.float32)
+        out = np.asarray(linalg.normalize(None, X))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=1),
+                                   np.ones(6), rtol=1e-4)
+
+
+class TestDecompositions:
+    def test_eig_dc(self, rng):
+        A = rng.normal(size=(12, 12))
+        S = (A + A.T).astype(np.float64)
+        w, v = linalg.eig_dc(None, S)
+        w, v = np.asarray(w), np.asarray(v)
+        wref = np.linalg.eigvalsh(S)
+        np.testing.assert_allclose(w, wref, rtol=1e-8)
+        np.testing.assert_allclose(S @ v, v * w[None, :], atol=1e-8)
+
+    def test_eig_sel(self, rng):
+        A = rng.normal(size=(10, 10))
+        S = (A + A.T).astype(np.float64)
+        w, v = linalg.eig_sel(None, S, 3, largest=True)
+        wref = np.linalg.eigvalsh(S)
+        np.testing.assert_allclose(np.asarray(w), wref[-3:], rtol=1e-8)
+
+    def test_qr(self, rng):
+        A = rng.normal(size=(10, 4)).astype(np.float64)
+        q, r = linalg.qr_get_qr(None, A)
+        q, r = np.asarray(q), np.asarray(r)
+        np.testing.assert_allclose(q @ r, A, atol=1e-10)
+        np.testing.assert_allclose(q.T @ q, np.eye(4), atol=1e-10)
+
+    def test_svd_qr_and_eig(self, rng):
+        A = rng.normal(size=(20, 6)).astype(np.float64)
+        for fn in (linalg.svd_qr, linalg.svd_eig):
+            u, s, v = fn(None, A)
+            u, s, v = np.asarray(u), np.asarray(s), np.asarray(v)
+            np.testing.assert_allclose((u * s[None, :]) @ v.T, A, atol=1e-6)
+            np.testing.assert_allclose(
+                s, np.linalg.svd(A, compute_uv=False), rtol=1e-6)
+        assert linalg.evaluate_svd_by_reconstruction(
+            None, A, *linalg.svd_qr(None, A))
+
+    def test_rsvd(self, rng):
+        # Low-rank matrix: rsvd should recover the spectrum.
+        U = rng.normal(size=(60, 5))
+        V = rng.normal(size=(5, 40))
+        A = (U @ V).astype(np.float64)
+        u, s, v = linalg.rsvd_fixed_rank(None, A, 5, state=RngState(0))
+        sref = np.linalg.svd(A, compute_uv=False)[:5]
+        np.testing.assert_allclose(np.asarray(s), sref, rtol=1e-6)
+        np.testing.assert_allclose(
+            (np.asarray(u) * np.asarray(s)) @ np.asarray(v).T, A, atol=1e-6)
+
+    def test_lstsq_all_variants(self, rng):
+        A = rng.normal(size=(30, 5)).astype(np.float64)
+        x_true = rng.normal(size=5)
+        b = A @ x_true
+        for fn in (linalg.lstsq_svd_qr, linalg.lstsq_eig, linalg.lstsq_qr):
+            x = np.asarray(fn(None, A, b))
+            np.testing.assert_allclose(x, x_true, rtol=1e-6,
+                                       err_msg=str(fn))
+
+    def test_cholesky_r1_update(self, rng):
+        # Grow a Cholesky factor one rank at a time; compare to direct chol.
+        n = 6
+        B = rng.normal(size=(n, n))
+        A = B @ B.T + n * np.eye(n)
+        L = jnp.zeros((n, n), dtype=jnp.float64)
+        for k in range(1, n + 1):
+            L = linalg.cholesky_r1_update(None, L, A[:k, k - 1], k)
+        np.testing.assert_allclose(np.asarray(L), np.linalg.cholesky(A),
+                                   atol=1e-8)
+
+
+class TestPCA:
+    def test_pca_matches_svd(self, rng):
+        X = rng.normal(size=(200, 10)).astype(np.float64)
+        result = linalg.pca_fit(None, X, 4)
+        Xc = X - X.mean(axis=0)
+        _, sref, vt = np.linalg.svd(Xc, full_matrices=False)
+        var_ref = (sref ** 2) / (X.shape[0] - 1)
+        np.testing.assert_allclose(np.asarray(result.explained_variance),
+                                   var_ref[:4], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(result.singular_values),
+                                   sref[:4], rtol=1e-6)
+        # components span the same subspace (rows, up to sign)
+        for i in range(4):
+            c = np.asarray(result.components)[i]
+            r = vt[i]
+            assert min(np.linalg.norm(c - r), np.linalg.norm(c + r)) < 1e-6
+
+    def test_pca_transform_roundtrip(self, rng):
+        X = rng.normal(size=(100, 8)).astype(np.float64)
+        T, result = linalg.pca_fit_transform(None, X, 8)
+        Xr = np.asarray(linalg.pca_inverse_transform(None, T, result))
+        np.testing.assert_allclose(Xr, X, atol=1e-8)
+
+    def test_pca_whiten_roundtrip(self, rng):
+        X = rng.normal(size=(100, 6)).astype(np.float64)
+        result = linalg.pca_fit(None, X, 6)
+        T = linalg.pca_transform(None, X, result, whiten=True)
+        np.testing.assert_allclose(np.asarray(T).std(axis=0, ddof=1),
+                                   np.ones(6), rtol=1e-6)
+        Xr = np.asarray(linalg.pca_inverse_transform(None, T, result,
+                                                     whiten=True))
+        np.testing.assert_allclose(Xr, X, atol=1e-8)
+
+    def test_pca_randomized_solver(self, rng):
+        X = rng.normal(size=(300, 12)).astype(np.float64)
+        exact = linalg.pca_fit(None, X, 3)
+        rnd = linalg.pca_fit(None, X, 3, solver=linalg.Solver.RANDOMIZED,
+                             state=RngState(1))
+        np.testing.assert_allclose(np.asarray(rnd.explained_variance),
+                                   np.asarray(exact.explained_variance),
+                                   rtol=1e-2)
+
+    def test_tsvd(self, rng):
+        X = rng.normal(size=(150, 10)).astype(np.float64)
+        result = linalg.tsvd_fit(None, X, 4)
+        sref = np.linalg.svd(X, compute_uv=False)
+        np.testing.assert_allclose(np.asarray(result.singular_values),
+                                   sref[:4], rtol=1e-6)
+        T, _ = linalg.tsvd_fit_transform(None, X, 10)
+        Xr = np.asarray(linalg.tsvd_inverse_transform(None, T,
+                        linalg.tsvd_fit(None, X, 10)))
+        np.testing.assert_allclose(Xr, X, atol=1e-6)
+
+
+class TestContractions:
+    def test_pairwise_l2_vs_numpy(self, rng):
+        x = rng.normal(size=(100, 37)).astype(np.float32)
+        y = rng.normal(size=(53, 37)).astype(np.float32)
+        ref = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        d = np.asarray(linalg.pairwise_l2_pallas(x, y))
+        np.testing.assert_allclose(d, ref, atol=1e-3)
+        d2 = np.asarray(linalg.pairwise_l2_pallas(x, y, sqrt=True))
+        np.testing.assert_allclose(d2, np.sqrt(ref), atol=1e-3)
+
+    def test_fused_l2_argmin(self, rng):
+        x = rng.normal(size=(129, 17)).astype(np.float32)
+        y = rng.normal(size=(77, 17)).astype(np.float32)
+        ref = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        val, idx = linalg.fused_l2_argmin_pallas(x, y)
+        np.testing.assert_array_equal(np.asarray(idx), ref.argmin(axis=1))
+        np.testing.assert_allclose(np.asarray(val), ref.min(axis=1),
+                                   atol=1e-3)
+
+    def test_fused_l2_argmin_multi_tile(self, rng):
+        # More centroids than one tile → exercises the running-min loop.
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = rng.normal(size=(300, 8)).astype(np.float32)
+        ref = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        val, idx = linalg.fused_l2_argmin_pallas(x, y, tm=64, tn=128)
+        np.testing.assert_array_equal(np.asarray(idx), ref.argmin(axis=1))

@@ -1,0 +1,153 @@
+"""Matrix primitive tests (ref test models: cpp/tests/matrix/*)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import matrix
+from raft_tpu.matrix import SelectAlgo
+from raft_tpu.random import RngState
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+class TestSelectK:
+    @pytest.mark.parametrize("n_rows,n_cols,k", [
+        (1, 100, 5), (8, 1000, 32), (3, 257, 257), (4, 64, 1),
+    ])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_against_numpy(self, rng, n_rows, n_cols, k, select_min):
+        v = rng.normal(size=(n_rows, n_cols)).astype(np.float32)
+        out_val, out_idx = matrix.select_k(None, v, k, select_min=select_min)
+        out_val, out_idx = np.asarray(out_val), np.asarray(out_idx)
+        order = np.sort(v, axis=1)
+        expect = order[:, :k] if select_min else order[:, ::-1][:, :k]
+        np.testing.assert_allclose(out_val, expect, rtol=1e-6)
+        # indices recover the values
+        np.testing.assert_allclose(
+            np.take_along_axis(v, out_idx, axis=1), out_val, rtol=1e-6)
+
+    def test_tiled_path_matches_direct(self, rng):
+        v = rng.normal(size=(2, 70000)).astype(np.float32)
+        direct_v, direct_i = matrix.select_k(
+            None, v, 50, algo=SelectAlgo.WARPSORT_IMMEDIATE)
+        tiled_v, tiled_i = matrix.select_k(
+            None, v, 50, algo=SelectAlgo.RADIX_11BITS)
+        np.testing.assert_allclose(np.asarray(tiled_v), np.asarray(direct_v),
+                                   rtol=1e-6)
+
+    def test_in_idx_passthrough(self, rng):
+        v = rng.normal(size=(2, 100)).astype(np.float32)
+        payload = rng.integers(0, 10**6, size=(2, 100)).astype(np.int32)
+        out_val, out_idx = matrix.select_k(None, v, 5, in_idx=payload)
+        pos = np.argsort(np.asarray(v), axis=1)[:, :5]
+        np.testing.assert_array_equal(np.asarray(out_idx),
+                                      np.take_along_axis(payload, pos, 1))
+
+    def test_int_dtype_preserved(self):
+        v = jnp.asarray([[16777216, 16777217, 3]], dtype=jnp.int32)
+        out_val, out_idx = matrix.select_k(None, v, 1, select_min=False)
+        assert out_val.dtype == jnp.int32
+        assert int(out_val[0, 0]) == 16777217
+        assert int(out_idx[0, 0]) == 1
+
+    def test_k_too_large_raises(self, rng):
+        with pytest.raises(ValueError):
+            matrix.select_k(None, jnp.ones((2, 10)), 11)
+
+
+class TestArgMinMax:
+    def test_argmin_argmax(self, rng):
+        m = rng.normal(size=(20, 30)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.argmin(None, m)),
+                                      m.argmin(axis=1))
+        np.testing.assert_array_equal(np.asarray(matrix.argmax(None, m)),
+                                      m.argmax(axis=1))
+
+
+class TestGatherScatter:
+    def test_gather(self, rng):
+        m = rng.normal(size=(10, 4)).astype(np.float32)
+        idx = np.array([3, 1, 7], dtype=np.int32)
+        np.testing.assert_array_equal(np.asarray(matrix.gather(None, m, idx)),
+                                      m[idx])
+
+    def test_gather_if(self, rng):
+        m = rng.normal(size=(10, 4)).astype(np.float32)
+        idx = np.array([0, 1, 2, 3], dtype=np.int32)
+        stencil = np.array([1.0, -1.0, 1.0, -1.0], dtype=np.float32)
+        out = np.asarray(matrix.gather_if(None, m, idx, stencil,
+                                          lambda s: s > 0))
+        np.testing.assert_array_equal(out[0], m[0])
+        np.testing.assert_array_equal(out[1], np.zeros(4))
+
+    def test_scatter_permutation(self, rng):
+        m = rng.normal(size=(5, 3)).astype(np.float32)
+        perm = np.array([4, 2, 0, 1, 3], dtype=np.int32)
+        out = np.asarray(matrix.scatter(None, m, perm))
+        np.testing.assert_array_equal(out[perm], m)
+
+
+class TestMiscOps:
+    def test_diagonal(self, rng):
+        m = rng.normal(size=(5, 5)).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(matrix.get_diagonal(None, m)), np.diag(m))
+        out = np.asarray(matrix.set_diagonal(None, m, jnp.zeros(5)))
+        assert np.abs(np.diag(out)).max() == 0
+
+    def test_linewise_and_reverse(self, rng):
+        m = rng.normal(size=(4, 6)).astype(np.float32)
+        v = rng.normal(size=6).astype(np.float32)
+        out = np.asarray(matrix.linewise_op(None, m, lambda a, b: a * b,
+                                            True, v))
+        np.testing.assert_allclose(out, m * v[None, :], rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(matrix.col_reverse(None, m)),
+                                      m[:, ::-1])
+        np.testing.assert_array_equal(np.asarray(matrix.row_reverse(None, m)),
+                                      m[::-1])
+
+    def test_sign_flip(self, rng):
+        m = rng.normal(size=(6, 3)).astype(np.float32)
+        out = np.asarray(matrix.sign_flip(None, m))
+        for j in range(3):
+            assert out[np.abs(out[:, j]).argmax(), j] > 0
+
+    def test_shift(self):
+        m = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+        out = np.asarray(matrix.col_shift(None, m, k=1, fill_value=-1))
+        np.testing.assert_array_equal(out[:, 0], [-1, -1, -1])
+        np.testing.assert_array_equal(out[:, 1:], np.asarray(m)[:, :3])
+        out = np.asarray(matrix.row_shift(
+            None, m, k=1, direction=matrix.SHIFT_TOWARDS_BEGINNING,
+            fill_value=0))
+        np.testing.assert_array_equal(out[:2], np.asarray(m)[1:])
+        np.testing.assert_array_equal(out[2], np.zeros(4))
+
+    def test_sort_cols_per_row(self, rng):
+        m = rng.normal(size=(5, 9)).astype(np.float32)
+        out, idx = matrix.sort_cols_per_row(None, m, return_indices=True)
+        np.testing.assert_allclose(np.asarray(out), np.sort(m, axis=1),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(
+            np.take_along_axis(m, np.asarray(idx), axis=1), np.asarray(out))
+
+    def test_sample_rows(self, rng):
+        m = rng.normal(size=(100, 3)).astype(np.float32)
+        out = np.asarray(matrix.sample_rows(None, RngState(3), m, 10))
+        assert out.shape == (10, 3)
+        # every sampled row exists in the source
+        for row in out:
+            assert (np.abs(m - row).sum(axis=1) < 1e-6).any()
+
+    def test_triangular_threshold_reciprocal(self, rng):
+        m = rng.normal(size=(4, 4)).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(matrix.upper_triangular(None, m)), np.triu(m))
+        z = np.asarray(matrix.zero_small_values(None, m, thres=10.0))
+        assert np.abs(z).max() == 0
+        r = np.asarray(matrix.reciprocal(None, m + 10.0))
+        np.testing.assert_allclose(r, 1.0 / (m + 10.0), rtol=1e-5)

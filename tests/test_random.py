@@ -1,0 +1,160 @@
+"""Random generation tests (ref: cpp/tests/random/, pylibraft test_random.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import random as rrandom
+from raft_tpu.random import Decomposer, RngState
+
+
+class TestRngState:
+    def test_determinism_and_advance(self):
+        s1 = RngState(seed=7)
+        s2 = RngState(seed=7)
+        a = rrandom.uniform(None, s1, 100)
+        b = rrandom.uniform(None, s2, 100)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # advanced state → different stream
+        c = rrandom.uniform(None, s1, 100)
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    def test_explicit_advance_matches(self):
+        s1 = RngState(seed=7)
+        rrandom.uniform(None, s1, 10)
+        s2 = RngState(seed=7)
+        s2.advance()
+        a = rrandom.uniform(None, s1, 10)
+        b = rrandom.uniform(None, s2, 10)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestDistributions:
+    def test_uniform_range(self, rng_state):
+        x = np.asarray(rrandom.uniform(None, rng_state, 10000, -2.0, 3.0))
+        assert x.min() >= -2.0 and x.max() < 3.0
+        assert abs(x.mean() - 0.5) < 0.1
+
+    def test_uniform_int(self, rng_state):
+        x = np.asarray(rrandom.uniform_int(None, rng_state, 10000, 0, 10))
+        assert x.min() == 0 and x.max() == 9
+
+    def test_normal_moments(self, rng_state):
+        x = np.asarray(rrandom.normal(None, rng_state, 50000, 3.0, 2.0))
+        assert abs(x.mean() - 3.0) < 0.1
+        assert abs(x.std() - 2.0) < 0.1
+
+    def test_normal_table(self, rng_state):
+        mu = jnp.asarray([0.0, 10.0, -5.0])
+        sigma = jnp.asarray([1.0, 0.5, 2.0])
+        x = np.asarray(rrandom.normal_table(None, rng_state, 20000, mu, sigma))
+        np.testing.assert_allclose(x.mean(axis=0), [0.0, 10.0, -5.0],
+                                   atol=0.15)
+        np.testing.assert_allclose(x.std(axis=0), [1.0, 0.5, 2.0], atol=0.15)
+
+    def test_bernoulli(self, rng_state):
+        x = np.asarray(rrandom.bernoulli(None, rng_state, 20000, 0.3))
+        assert abs(x.mean() - 0.3) < 0.02
+
+    @pytest.mark.parametrize("dist,params,mean_fn", [
+        ("exponential", {"lam": 2.0}, lambda: 0.5),
+        ("rayleigh", {"sigma": 1.0}, lambda: np.sqrt(np.pi / 2)),
+        ("lognormal", {"mu": 0.0, "sigma": 0.25},
+         lambda: float(np.exp(0.25 ** 2 / 2))),
+        ("laplace", {"mu": 1.0, "scale": 1.0}, lambda: 1.0),
+        ("logistic", {"mu": -1.0, "scale": 0.5}, lambda: -1.0),
+        ("gumbel", {"mu": 0.0, "beta": 1.0}, lambda: float(np.euler_gamma)),
+    ])
+    def test_distribution_means(self, rng_state, dist, params, mean_fn):
+        fn = getattr(rrandom, dist)
+        x = np.asarray(fn(None, rng_state, 100000, **params))
+        assert abs(x.mean() - mean_fn()) < 0.05
+
+    def test_scaled_bernoulli(self, rng_state):
+        x = np.asarray(rrandom.scaled_bernoulli(None, rng_state, 10000,
+                                                0.25, 2.0))
+        assert set(np.unique(x)) == {-2.0, 2.0}
+        assert abs((x == -2.0).mean() - 0.25) < 0.02
+
+
+class TestSampling:
+    def test_weighted_sample(self, rng_state):
+        w = jnp.asarray([0.0, 1.0, 3.0, 0.0])
+        idx = np.asarray(rrandom.sample(None, rng_state, 20000, w))
+        assert set(np.unique(idx)) <= {1, 2}
+        assert abs((idx == 2).mean() - 0.75) < 0.02
+
+    def test_sample_without_replacement_unique(self, rng_state):
+        idx = np.asarray(rrandom.sample_without_replacement(
+            None, rng_state, 50, pool_size=64))
+        assert len(np.unique(idx)) == 50
+
+    def test_weighted_without_replacement_respects_zero(self, rng_state):
+        w = np.ones(100)
+        w[10] = 0.0
+        idx = np.asarray(rrandom.sample_without_replacement(
+            None, rng_state, 99, weights=jnp.asarray(w)))
+        assert 10 not in idx
+        assert len(np.unique(idx)) == 99
+
+    def test_excess_subsample(self, rng_state):
+        idx = np.asarray(rrandom.excess_subsample(None, rng_state, 10, 1000))
+        assert len(np.unique(idx)) == 10
+        assert idx.max() < 1000
+
+    def test_permute(self, rng_state):
+        p = np.asarray(rrandom.permute(None, rng_state, 100))
+        np.testing.assert_array_equal(np.sort(p), np.arange(100))
+
+
+class TestGenerators:
+    def test_make_blobs_labels_and_spread(self, rng_state):
+        X, labels, centers = rrandom.make_blobs(
+            None, rng_state, 1000, 8, n_clusters=4, cluster_std=0.1)
+        assert X.shape == (1000, 8)
+        assert centers.shape == (4, 8)
+        labels = np.asarray(labels)
+        assert set(np.unique(labels)) == {0, 1, 2, 3}
+        # points cluster tightly around their centers
+        d = np.linalg.norm(np.asarray(X) - np.asarray(centers)[labels],
+                           axis=1)
+        assert d.max() < 1.5
+
+    def test_make_blobs_given_centers(self, rng_state):
+        centers = jnp.asarray([[0.0, 0.0], [100.0, 100.0]])
+        X, labels, _ = rrandom.make_blobs(None, rng_state, 200, 2,
+                                          centers=centers, cluster_std=0.5)
+        X, labels = np.asarray(X), np.asarray(labels)
+        assert np.all(X[labels == 1].mean(axis=0) > 90)
+
+    def test_make_regression_recoverable(self, rng_state):
+        X, y, w = rrandom.make_regression(None, rng_state, 500, 10,
+                                          n_informative=5, noise=0.0,
+                                          shuffle=False)
+        X, y, w = np.asarray(X), np.asarray(y), np.asarray(w)
+        np.testing.assert_allclose(X @ w, y, rtol=1e-3, atol=1e-2)
+        assert np.abs(w[5:]).max() == 0.0
+
+    def test_mvg_cholesky_vs_eig(self, rng_state):
+        cov = np.asarray([[2.0, 0.8], [0.8, 1.0]])
+        mean = np.asarray([1.0, -1.0])
+        for method in (Decomposer.CHOLESKY, Decomposer.JACOBI, Decomposer.QR):
+            x = np.asarray(rrandom.multi_variable_gaussian(
+                None, rng_state, mean, cov, 50000, method=method))
+            np.testing.assert_allclose(x.mean(axis=0), mean, atol=0.05)
+            np.testing.assert_allclose(np.cov(x.T), cov, atol=0.1)
+
+    def test_rmat_shapes_and_bounds(self, rng_state):
+        src, dst = rrandom.rmat_rectangular_gen(None, rng_state, 10, 8,
+                                                5000)
+        src, dst = np.asarray(src), np.asarray(dst)
+        assert src.shape == dst.shape == (5000,)
+        assert src.min() >= 0 and src.max() < 2 ** 10
+        assert dst.min() >= 0 and dst.max() < 2 ** 8
+
+    def test_rmat_skew(self, rng_state):
+        # a=0.9 concentrates edges near vertex 0
+        src, dst = rrandom.rmat_rectangular_gen(None, rng_state, 12, 12,
+                                                20000, a=0.9, b=0.04, c=0.04)
+        src = np.asarray(src)
+        assert (src < 2 ** 11).mean() > 0.8  # heavy top-half skew
