@@ -1,0 +1,51 @@
+"""Multi-chip communicator infrastructure over JAX mesh collectives.
+
+TPU-native re-design of the reference comms stack
+(cpp/include/raft/core/comms.hpp:115-234 ``comms_iface``/``comms_t``;
+cpp/include/raft/comms/std_comms.hpp — NCCL/UCX implementation).
+
+Where the reference layers a virtual interface over NCCL collectives and
+UCX tag-matched p2p, the TPU design has *two* surfaces:
+
+1. **Device-side functional collectives** (:mod:`raft_tpu.comms.device`) —
+   free functions (`allreduce`, `bcast`, `allgather`, ...) legal *inside*
+   `shard_map`-traced code, compiled by XLA into ICI/DCN collectives.
+   These replace the NCCL enqueue calls that appear inside reference
+   MNMG algorithms.
+2. **`MeshComms`** (:mod:`raft_tpu.comms.comms`) — the host-side
+   ``comms_t`` analogue injected into the handle via
+   `raft_tpu.core.resources.set_comms`.  It owns a `jax.sharding.Mesh`
+   axis, answers `get_size`/`get_rank`, performs *eager* collectives on
+   mesh-sharded arrays (each call jit-compiles a shard_map — the analogue
+   of enqueueing an NCCL kernel on a stream), splits into
+   sub-communicators (`comm_split` → sub-mesh), and hosts a tag-matched
+   host mailbox standing in for UCX isend/irecv.
+
+The self-test suite mirroring comms/detail/test.hpp:31-513 lives in
+:mod:`raft_tpu.comms.test_suite` and is runnable on any mesh (including the
+8-virtual-CPU-device test mesh) — the analogue of ``perform_test_comms_*``.
+"""
+
+from raft_tpu.comms.comms import (  # noqa: F401
+    Op,
+    Datatype,
+    Status,
+    MeshComms,
+    build_mesh_comms,
+)
+from raft_tpu.comms import device  # noqa: F401
+from raft_tpu.comms.test_suite import (  # noqa: F401
+    perform_test_comms_allreduce,
+    perform_test_comms_bcast,
+    perform_test_comms_reduce,
+    perform_test_comms_allgather,
+    perform_test_comms_allgatherv,
+    perform_test_comms_gather,
+    perform_test_comms_gatherv,
+    perform_test_comms_reducescatter,
+    perform_test_comms_send_recv,
+    perform_test_comms_device_send_recv,
+    perform_test_comms_device_sendrecv,
+    perform_test_comms_device_multicast_sendrecv,
+    perform_test_comm_split,
+)

@@ -1,0 +1,251 @@
+"""Device-side collectives: legal inside `shard_map`-traced code.
+
+These are the TPU-native analogue of the NCCL calls the reference makes from
+inside MNMG algorithms (comms/detail/std_comms.hpp:366-571).  Each maps to an
+XLA collective that rides ICI within a slice (DCN across slices), chosen by
+the compiler from the mesh axis:
+
+    reference (NCCL)                 raft_tpu (XLA, inside shard_map)
+    ----------------                 --------------------------------
+    ncclAllReduce                    lax.psum / pmin / pmax / psum(log-mul)
+    ncclBroadcast                    select root shard + psum  (bcast)
+    ncclReduce                       psum + keep-on-root
+    ncclAllGather                    lax.all_gather
+    grouped bcast loop (allgatherv)  lax.all_gather + per-rank slicing
+    ncclSend/Recv loops (gatherv)    lax.all_gather + host-side slicing
+    ncclReduceScatter                lax.psum_scatter
+    ncclSend + ncclRecv (p2p)        lax.ppermute
+    grouped multicast loops          lax.ppermute per (src,dst) pair
+
+`op_t` (core/comms.hpp:26) maps to the reductions below; PROD is implemented
+with psum of logs only where XLA lacks a pprod — we instead use
+``lax.all_gather`` + product for exactness on small ranks, since XLA exposes
+no native product collective.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Op(enum.Enum):
+    """Reduction vocabulary (ref: core/comms.hpp:26 ``op_t``)."""
+
+    SUM = "sum"
+    PROD = "prod"
+    MIN = "min"
+    MAX = "max"
+
+
+def _axis_size(axis_name) -> int:
+    return lax.axis_size(axis_name)
+
+
+def rank(axis_name="data"):
+    """This shard's rank along ``axis_name`` (ref: get_rank())."""
+    return lax.axis_index(axis_name)
+
+
+def size(axis_name="data") -> int:
+    """Number of shards along ``axis_name`` (ref: get_size())."""
+    return _axis_size(axis_name)
+
+
+def _grouped_reduce(x, op: Op, axis_name, groups):
+    """Grouped reduction emulated with all_gather + static membership mask
+    (shard_map collectives don't take axis_index_groups; the data movement
+    is one all_gather on ICI, the masked reduce fuses into it)."""
+    import numpy as np
+
+    n = _axis_size(axis_name)
+    member = np.zeros((n, n), bool)
+    for grp in groups:
+        for i in grp:
+            member[i, list(grp)] = True
+    g = lax.all_gather(x, axis_name=axis_name)  # [n, ...]
+    idx = lax.axis_index(axis_name)
+    mask = jnp.asarray(member)[idx]  # [n]
+    mask = mask.reshape((n,) + (1,) * (g.ndim - 1))
+    if op == Op.SUM:
+        return jnp.sum(jnp.where(mask, g, jnp.zeros_like(g)), axis=0)
+    if op == Op.MIN:
+        big = jnp.full_like(g, jnp.inf if jnp.issubdtype(g.dtype, jnp.floating)
+                            else jnp.iinfo(g.dtype).max)
+        return jnp.min(jnp.where(mask, g, big), axis=0)
+    if op == Op.MAX:
+        small = jnp.full_like(g, -jnp.inf if jnp.issubdtype(g.dtype, jnp.floating)
+                              else jnp.iinfo(g.dtype).min)
+        return jnp.max(jnp.where(mask, g, small), axis=0)
+    if op == Op.PROD:
+        return jnp.prod(jnp.where(mask, g, jnp.ones_like(g)), axis=0)
+    raise ValueError(f"unsupported op {op}")
+
+
+def allreduce(x, op: Op = Op.SUM, axis_name="data",
+              axis_index_groups: Optional[Sequence[Sequence[int]]] = None):
+    """All-reduce across the named axis (ref: std_comms.hpp:366-374).
+
+    ``axis_index_groups`` implements grouped reductions — the in-jit analogue
+    of operating in a split communicator.
+    """
+    if axis_index_groups is not None:
+        return _grouped_reduce(x, op, axis_name, axis_index_groups)
+    if op == Op.SUM:
+        return lax.psum(x, axis_name=axis_name)
+    if op == Op.MIN:
+        return lax.pmin(x, axis_name=axis_name)
+    if op == Op.MAX:
+        return lax.pmax(x, axis_name=axis_name)
+    if op == Op.PROD:
+        # XLA has no product collective; gather along the axis and reduce.
+        g = lax.all_gather(x, axis_name=axis_name)
+        return jnp.prod(g, axis=0)
+    raise ValueError(f"unsupported op {op}")
+
+
+def bcast(x, root: int = 0, axis_name="data"):
+    """Broadcast the root shard's value to all shards
+    (ref: std_comms.hpp:377-395 ncclBroadcast).
+
+    Implemented as mask + psum: zero all non-root contributions, sum.
+    XLA lowers this to a broadcast-shaped collective on ICI.
+    """
+    idx = lax.axis_index(axis_name)
+    contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis_name=axis_name)
+
+
+def reduce(x, op: Op = Op.SUM, root: int = 0, axis_name="data"):
+    """Reduce to root; non-root shards receive their input unchanged
+    (ref: std_comms.hpp:398-422 ncclReduce semantics: recvbuff valid on root).
+    """
+    red = allreduce(x, op=op, axis_name=axis_name)
+    idx = lax.axis_index(axis_name)
+    return jnp.where(idx == root, red, x)
+
+
+def allgather(x, axis_name="data", tiled: bool = False):
+    """All-gather shards along a new (or tiled) leading dimension
+    (ref: std_comms.hpp:425-433 ncclAllGather).
+    """
+    return lax.all_gather(x, axis_name=axis_name, tiled=tiled)
+
+
+def allgatherv(x, recvcounts: Sequence[int], axis_name="data"):
+    """Variable-count all-gather (ref: std_comms.hpp:436-468, implemented
+    there as a loop of per-root grouped broadcasts).
+
+    Each shard contributes its first ``recvcounts[rank]`` rows of ``x``
+    (shards pad to a common static shape — the TPU-native stand-in for
+    variable buffer sizes, which XLA's static shapes cannot express
+    directly).  Returns the concatenation, padded to ``sum(max_count)``
+    with validity handled by the caller via ``recvcounts``.
+    """
+    counts = [int(c) for c in recvcounts]  # static: buffer sizes are
+    g = lax.all_gather(x, axis_name=axis_name)  # [size, pad, ...]
+    nranks = g.shape[0]
+    # Compact via static cumulative displacements (counts are host values,
+    # exactly as the reference's size_t* recvcounts/displs are host memory).
+    total = sum(counts)
+    out_shape = (total,) + g.shape[2:]
+    out = jnp.zeros(out_shape, g.dtype)
+    displ = 0
+    for r in range(nranks):  # static unroll: nranks is a mesh constant
+        out = lax.dynamic_update_slice(
+            out, g[r, : counts[r]],
+            (displ,) + (0,) * (len(out_shape) - 1))
+        displ += counts[r]
+    return out
+
+
+def gather(x, root: int = 0, axis_name="data"):
+    """Gather shards to root (ref: std_comms.hpp:471-495).
+
+    All shards receive the gathered array (XLA collectives are SPMD);
+    parity with "recvbuff only valid on root" is natural — non-roots may
+    ignore the result and XLA DCEs unused outputs.
+    """
+    return lax.all_gather(x, axis_name=axis_name)
+
+
+def gatherv(x, recvcounts: Sequence[int], root: int = 0, axis_name="data"):
+    """Variable-count gather to root (ref: std_comms.hpp:498-528)."""
+    return allgatherv(x, recvcounts, axis_name=axis_name)
+
+
+def reducescatter(x, op: Op = Op.SUM, axis_name="data"):
+    """Reduce-scatter: each shard gets one reduced block
+    (ref: std_comms.hpp:531-541 ncclReduceScatter).  ``x`` is the full-size
+    per-shard contribution; shard i receives block i of the sum.
+    """
+    if op == Op.SUM:
+        return lax.psum_scatter(x, axis_name=axis_name, tiled=True)
+    # MIN/MAX/PROD: gather-reduce-slice (no fused XLA op exists).
+    g = lax.all_gather(x, axis_name=axis_name)
+    if op == Op.MIN:
+        red = jnp.min(g, axis=0)
+    elif op == Op.MAX:
+        red = jnp.max(g, axis=0)
+    else:
+        red = jnp.prod(g, axis=0)
+    n = _axis_size(axis_name)
+    block = red.shape[0] // n
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(red, idx * block, block, axis=0)
+
+
+def device_send(x, dest: int, source: int, axis_name="data"):
+    """Point-to-point send as its SPMD equivalent: a single-pair permute
+    (ref: std_comms.hpp:544-548 ncclSend).
+
+    NCCL p2p is two-sided; XLA's model is one-sided SPMD, so send and recv
+    collapse into one ppermute issued by *all* shards.  Shards outside the
+    pair receive zeros.
+    """
+    return lax.ppermute(x, axis_name, perm=[(source, dest)])
+
+
+def device_recv(x, source: int, dest: int, axis_name="data"):
+    """See :func:`device_send` — the same single-pair permute
+    (ref: std_comms.hpp:551-555 ncclRecv)."""
+    return lax.ppermute(x, axis_name, perm=[(source, dest)])
+
+
+def device_sendrecv(x, perm: Sequence[tuple], axis_name="data"):
+    """Simultaneous send+recv without deadlock
+    (ref: std_comms.hpp:558-571 grouped ncclSend+ncclRecv).
+
+    The reference's host loop calls this per-rank with that rank's
+    (dest, source); under SPMD those per-rank pairs collapse into one static
+    ``perm`` list of (source, dest) pairs executed as a single ppermute.
+    For the common ring pattern use :func:`ring_shift`.
+    """
+    return lax.ppermute(x, axis_name, perm=list(perm))
+
+
+def ring_shift(x, shift: int = 1, axis_name="data"):
+    """Rotate shards around the ring (the idiomatic TPU p2p pattern:
+    neighbor exchange over ICI; used by ring reductions / halo exchange)."""
+    n = _axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def device_multicast_sendrecv(x, pairs: Sequence[tuple], axis_name="data"):
+    """Multiple simultaneous p2p transfers
+    (ref: std_comms.hpp:574-601 device_multicast_sendrecv): ``pairs`` is a
+    static list of (source, dest) rank pairs, executed as one ppermute.
+    Shards not receiving from anyone get zeros.
+    """
+    return lax.ppermute(x, axis_name, perm=list(pairs))
+
+
+def barrier(axis_name="data"):
+    """In-jit barrier: psum of 1 (exactly the reference's implementation,
+    std_comms.hpp:133-147 barrier = allreduce of an int)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis_name=axis_name)

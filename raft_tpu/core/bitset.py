@@ -65,14 +65,9 @@ class Bitset:
 
     def set(self, indices, value: bool = True) -> "Bitset":
         indices = jnp.asarray(indices).ravel()
-        word_idx = indices // WORD_BITS
-        bit = (jnp.uint32(1) <<
-               (indices % WORD_BITS).astype(_WORD_DTYPE))
+        acc = _scatter_word_mask(self.words.shape[0], indices)
         if value:
-            # Multiple indices may share a word: build via bitwise-or scatter.
-            acc = _scatter_or(jnp.zeros_like(self.words), word_idx, bit)
             return Bitset(self.n_bits, self.words | acc)
-        acc = _scatter_or(jnp.zeros_like(self.words), word_idx, bit)
         return Bitset(self.n_bits, self.words & ~acc)
 
     def flip(self) -> "Bitset":
@@ -109,20 +104,20 @@ def _mask_tail(words: jnp.ndarray, n_bits: int) -> jnp.ndarray:
     return words.at[-1].set(words[-1] & tail_mask)
 
 
-def _scatter_or(acc: jnp.ndarray, idx: jnp.ndarray,
-                bits: jnp.ndarray) -> jnp.ndarray:
-    """Bitwise-OR scatter: acc[idx] |= bits, duplicates combined.
+def _scatter_word_mask(n_words: int, indices: jnp.ndarray) -> jnp.ndarray:
+    """Packed word mask with bit ``indices[i]`` set, duplicates combined.
 
-    XLA has no `or` scatter mode; decompose per bit-plane with `max` scatter
-    (bits are single-bit values so max == or within a plane).
+    XLA has no `or` scatter mode; one max-scatter into an (n_words, 32)
+    bit plane followed by a weighted sum along the bit axis packs the words
+    (same trick as :meth:`Bitset.from_bools`).
     """
-    out = acc
-    for plane in range(WORD_BITS):
-        plane_bit = jnp.uint32(1) << plane
-        has = (bits & plane_bit) > 0
-        contrib = jnp.where(has, plane_bit, jnp.uint32(0))
-        out = out | jnp.zeros_like(acc).at[idx].max(contrib)
-    return out
+    word_idx = indices // WORD_BITS
+    bit_pos = indices % WORD_BITS
+    plane = jnp.zeros((n_words, WORD_BITS), _WORD_DTYPE)
+    plane = plane.at[word_idx, bit_pos].max(jnp.uint32(1),
+                                            mode="drop")
+    weights = (jnp.uint32(1) << jnp.arange(WORD_BITS, dtype=_WORD_DTYPE))
+    return jnp.sum(plane * weights, axis=1, dtype=_WORD_DTYPE)
 
 
 class Bitmap(Bitset):

@@ -5,6 +5,11 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def _scale_rows(x, s):
+    """Row-scale that is correct for both 1-D (single-RHS) and 2-D b."""
+    return x * s if x.ndim == 1 else x * s[:, None]
+
+
 def lstsq_svd_qr(res, A, b):
     """Minimum-norm solution via SVD (ref: lstsq.cuh lstsqSvdQR)."""
     A = jnp.asarray(A)
@@ -12,7 +17,7 @@ def lstsq_svd_qr(res, A, b):
     u, s, vt = jnp.linalg.svd(A, full_matrices=False)
     cutoff = jnp.finfo(A.dtype).eps * max(A.shape) * s[0]
     s_inv = jnp.where(s > cutoff, 1.0 / s, 0.0)
-    return vt.T @ (s_inv * (u.T @ b))
+    return vt.T @ _scale_rows(u.T @ b, s_inv)
 
 
 def lstsq_svd_jacobi(res, A, b):
@@ -29,7 +34,7 @@ def lstsq_eig(res, A, b):
     w, v = jnp.linalg.eigh(g)
     cutoff = jnp.finfo(A.dtype).eps * max(A.shape) * jnp.max(jnp.abs(w))
     w_inv = jnp.where(jnp.abs(w) > cutoff, 1.0 / w, 0.0)
-    return v @ (w_inv * (v.T @ (A.T @ b)))
+    return v @ _scale_rows(v.T @ (A.T @ b), w_inv)
 
 
 def lstsq_qr(res, A, b):

@@ -30,24 +30,36 @@ def _axis(apply: str) -> int:
     raise ValueError(f"apply must be ALONG_ROWS or ALONG_COLUMNS, got {apply}")
 
 
-def reduce(res, data, apply: str = ALONG_ROWS, init: float = 0.0,
+def reduce(res, data, apply: str = ALONG_ROWS,
+           init: Optional[float] = None,
            main_op: Callable = ops.identity_op,
            reduce_op: Callable = ops.add_op,
            final_op: Callable = ops.identity_op,
            inplace: bool = False, out=None):
     """Generalized reduction: final_op(reduce(main_op(x), init))
-    (ref: reduce.cuh raft::linalg::reduce)."""
+    (ref: reduce.cuh raft::linalg::reduce).
+
+    ``init`` defaults to the reduction's identity (the reference makes the
+    caller supply it; a defaulted 0 must not clamp min/max results).
+    """
     data = jnp.asarray(data)
     axis = _axis(apply)
     mapped = main_op(data)
-    init_val = jnp.asarray(init, dtype=mapped.dtype)
     if reduce_op is ops.add_op:
-        red = jnp.sum(mapped, axis=axis) + init_val
+        red = jnp.sum(mapped, axis=axis)
+        if init is not None:
+            red = red + jnp.asarray(init, dtype=mapped.dtype)
     elif reduce_op is ops.min_op:
-        red = jnp.minimum(jnp.min(mapped, axis=axis), init_val)
+        red = jnp.min(mapped, axis=axis)
+        if init is not None:
+            red = jnp.minimum(red, jnp.asarray(init, dtype=mapped.dtype))
     elif reduce_op is ops.max_op:
-        red = jnp.maximum(jnp.max(mapped, axis=axis), init_val)
+        red = jnp.max(mapped, axis=axis)
+        if init is not None:
+            red = jnp.maximum(red, jnp.asarray(init, dtype=mapped.dtype))
     else:
+        init_val = jnp.asarray(0.0 if init is None else init,
+                               dtype=mapped.dtype)
         red = jax.lax.reduce(mapped, init_val,
                              lambda a, b: reduce_op(a, b), (axis,))
     out_val = final_op(red)
@@ -56,13 +68,13 @@ def reduce(res, data, apply: str = ALONG_ROWS, init: float = 0.0,
     return out_val
 
 
-def coalesced_reduction(res, data, init: float = 0.0, **kw):
+def coalesced_reduction(res, data, init: Optional[float] = None, **kw):
     """Reduce along the contiguous (last) dimension
     (ref: coalesced_reduction.cuh)."""
     return reduce(res, data, apply=ALONG_ROWS, init=init, **kw)
 
 
-def strided_reduction(res, data, init: float = 0.0, **kw):
+def strided_reduction(res, data, init: Optional[float] = None, **kw):
     """Reduce along the strided (first) dimension
     (ref: strided_reduction.cuh)."""
     return reduce(res, data, apply=ALONG_COLUMNS, init=init, **kw)

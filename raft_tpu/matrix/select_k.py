@@ -53,12 +53,22 @@ def _choose_tiled(n_rows: int, n_cols: int, k: int) -> bool:
     return n_cols >= 64 * 1024 and k <= 512
 
 
+def _order_flip(values: jnp.ndarray) -> jnp.ndarray:
+    """Strictly order-reversing, self-inverse transform.
+
+    Floats negate; integers use bitwise NOT (~x = -x-1 in two's complement),
+    which reverses order without the overflow of -INT_MIN and is also correct
+    for unsigned dtypes (~x = MAX - x).
+    """
+    if jnp.issubdtype(values.dtype, jnp.integer):
+        return ~values
+    return -values
+
+
 def _direct_select(values: jnp.ndarray, k: int, select_min: bool):
-    # Negate (dtype-preserving) rather than multiply by a float sign, so
-    # integer inputs keep their dtype and precision.
     if select_min:
-        vals, idx = jax.lax.top_k(-values, k)
-        return -vals, idx
+        vals, idx = jax.lax.top_k(_order_flip(values), k)
+        return _order_flip(vals), idx
     return jax.lax.top_k(values, k)
 
 
@@ -71,7 +81,7 @@ def _pad_lowest(dtype):
 def _tiled_select(values: jnp.ndarray, k: int, select_min: bool,
                   tile: int = 8192):
     n_rows, n_cols = values.shape
-    v = -values if select_min else values
+    v = _order_flip(values) if select_min else values
     n_tiles = cdiv(n_cols, tile)
     padded = n_tiles * tile
     if padded != n_cols:
@@ -87,7 +97,7 @@ def _tiled_select(values: jnp.ndarray, k: int, select_min: bool,
     pool_i = gidx.reshape(n_rows, -1)
     fvals, fpos = jax.lax.top_k(pool_v, k)
     fidx = jnp.take_along_axis(pool_i, fpos, axis=1)
-    return (-fvals if select_min else fvals), fidx
+    return (_order_flip(fvals) if select_min else fvals), fidx
 
 
 def select_k(res, values, k: int, select_min: bool = True,

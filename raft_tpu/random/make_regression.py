@@ -30,11 +30,12 @@ def make_regression(res, state: RngState, n_rows: int, n_cols: int,
         # Low-rank X with bell-shaped singular profile, as in the reference's
         # make_low_rank_matrix path.
         k1, k2 = jax.random.split(kx)
-        u, _ = jnp.linalg.qr(jax.random.normal(k1, (n_rows, n_cols),
+        rank = min(n_rows, n_cols)
+        u, _ = jnp.linalg.qr(jax.random.normal(k1, (n_rows, rank),
                                                dtype=jnp.float32))
-        v, _ = jnp.linalg.qr(jax.random.normal(k2, (n_cols, n_cols),
+        v, _ = jnp.linalg.qr(jax.random.normal(k2, (n_cols, rank),
                                                dtype=jnp.float32))
-        sing_idx = jnp.arange(n_cols, dtype=jnp.float32) / effective_rank
+        sing_idx = jnp.arange(rank, dtype=jnp.float32) / effective_rank
         low_rank = (1 - tail_strength) * jnp.exp(-(sing_idx ** 2))
         tail = tail_strength * jnp.exp(-0.1 * sing_idx)
         s = low_rank + tail

@@ -50,18 +50,14 @@ def rmat_rectangular_gen(res, state: RngState, r_scale: int, c_scale: int,
         src, dst = carry
         lvl, u_lvl = inputs
         t = theta[lvl]
-        a_, b_, c_ = t[0], t[1], t[2]
-        # Rectangular handling (ref: gen_and_update_bits): once a dimension's
-        # scale is exhausted, collapse probabilities onto the other dimension.
+        pa, pb, pc = t[0], t[1], t[2]
+        # Rectangular handling (ref: gen_and_update_bits,
+        # detail/rmat_rectangular_generator.cuh:23): the draw always uses the
+        # full (a, a+b, a+b+c) CDF; when a dimension's scale is exhausted its
+        # bit is simply dropped — no renormalization, preserving the marginal
+        # distribution of the remaining dimension.
         r_active = lvl < r_scale
         c_active = lvl < c_scale
-        # Quadrant probabilities, renormalized for inactive axes.
-        pa = a_
-        pb = jnp.where(c_active, b_, 0.0)
-        pc = jnp.where(r_active, c_, 0.0)
-        pd = jnp.where(r_active & c_active, 1.0 - (a_ + b_ + c_), 0.0)
-        total = pa + pb + pc + pd
-        pa, pb, pc = pa / total, pb / total, pc / total
         # Draw quadrant: 0=a(0,0) 1=b(0,1) 2=c(1,0) 3=d(1,1)
         q = (jnp.where(u_lvl < pa, 0,
              jnp.where(u_lvl < pa + pb, 1,

@@ -291,3 +291,32 @@ class TestContractions:
         ref = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
         val, idx = linalg.fused_l2_argmin_pallas(x, y, tm=64, tn=128)
         np.testing.assert_array_equal(np.asarray(idx), ref.argmin(axis=1))
+
+
+def test_lstsq_multi_rhs(res):
+    """Regression: 2-D (multi-RHS) b must row-scale by 1/s, not broadcast
+    along the RHS axis."""
+    import numpy as np
+    from raft_tpu.linalg import lstsq_svd_qr, lstsq_eig, lstsq_qr
+
+    rng = np.random.default_rng(3)
+    A = rng.normal(size=(100, 4)).astype(np.float64)
+    b = rng.normal(size=(100, 3)).astype(np.float64)
+    want = np.linalg.lstsq(A, b, rcond=None)[0]
+    for fn in (lstsq_svd_qr, lstsq_eig, lstsq_qr):
+        got = np.asarray(fn(res, A, b))
+        assert got.shape == (4, 3)
+        assert np.allclose(got, want, atol=1e-8), fn.__name__
+
+
+def test_reduce_minmax_default_init(res):
+    """Regression: defaulted init must not clamp min/max reductions at 0."""
+    import numpy as np
+    from raft_tpu.linalg import coalesced_reduction
+    from raft_tpu.core import operators as ops
+
+    x = -1.0 - np.arange(6, dtype=np.float32).reshape(2, 3)
+    got = np.asarray(coalesced_reduction(res, x, reduce_op=ops.max_op))
+    assert np.allclose(got, x.max(axis=1))
+    got = np.asarray(coalesced_reduction(res, -x, reduce_op=ops.min_op))
+    assert np.allclose(got, (-x).min(axis=1))

@@ -151,3 +151,18 @@ class TestMiscOps:
         assert np.abs(z).max() == 0
         r = np.asarray(matrix.reciprocal(None, m + 10.0))
         np.testing.assert_allclose(r, 1.0 / (m + 10.0), rtol=1e-5)
+
+
+def test_select_k_int_min_extremes(res):
+    """Regression: integer select_min must not wrap at INT32_MIN
+    (order-flip uses bitwise NOT, not negation)."""
+    import numpy as np
+    from raft_tpu.matrix import select_k
+
+    lo = np.iinfo(np.int32).min
+    vals = np.array([[lo, 5, 7]], np.int32)
+    v, i = select_k(res, vals, k=1, select_min=True)
+    assert int(v[0, 0]) == lo and int(i[0, 0]) == 0
+    u = np.array([[0, 3, 2**32 - 1]], np.uint32)
+    v, i = select_k(res, u, k=2, select_min=True)
+    assert list(np.asarray(v[0])) == [0, 3]

@@ -158,3 +158,14 @@ class TestGenerators:
                                                 20000, a=0.9, b=0.04, c=0.04)
         src = np.asarray(src)
         assert (src < 2 ** 11).mean() > 0.8  # heavy top-half skew
+
+
+def test_make_regression_wide_low_rank(res, rng_state):
+    """Regression: effective_rank path with n_rows < n_cols."""
+    import numpy as np
+    from raft_tpu.random import make_regression
+
+    X, y, w = make_regression(res, rng_state, n_rows=10, n_cols=20,
+                              effective_rank=5)
+    assert X.shape == (10, 20) and y.shape == (10, 1) and w.shape == (20, 1)
+    assert np.isfinite(np.asarray(X)).all()
