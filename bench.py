@@ -1,0 +1,80 @@
+"""North-star benchmark: fused-kernel k-means Lloyd iterations (BASELINE
+config 3: 1M×128 f32, k=1024, single chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
+roofline sanity metric BASELINE.md prescribes: achieved FLOP throughput as a
+fraction of the chip's peak (>1.0 would beat the roofline estimate; the
+recorded TPU numbers otherwise stand alone). Peak is taken from the device
+kind; unknown devices (CPU runs) use a nominal 1 TFLOP/s.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+# Dense f32-on-MXU peak estimates per chip kind (TFLOP/s). bf16 peaks are
+# ~2× these; the bench runs f32 for numeric parity with the reference path.
+_PEAK_TFLOPS = {
+    "TPU v4": 137.5,      # bf16 275 / 2
+    "TPU v5e": 98.5,      # bf16 197 / 2
+    "TPU v5p": 229.5,
+    "TPU v6e": 459.0,     # bf16 918 / 2
+}
+
+
+def _device_peak_tflops(dev) -> float:
+    kind = getattr(dev, "device_kind", "")
+    for name, peak in _PEAK_TFLOPS.items():
+        if name.lower().replace(" ", "") in kind.lower().replace(" ", ""):
+            return peak
+    return 1.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.cluster.kmeans import lloyd_step
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        m, k, n_clusters, iters = 1_000_000, 128, 1024, 5
+    else:  # CPU smoke configuration: same code path, tractable shapes
+        m, k, n_clusters, iters = 20_000, 64, 256, 3
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(m, k)).astype(np.float32))
+    c = jnp.asarray(rng.normal(size=(n_clusters, k)).astype(np.float32))
+
+    # Warmup / compile.
+    c1, inertia, _ = lloyd_step(x, c, n_clusters)
+    jax.block_until_ready((c1, inertia))
+
+    t0 = time.perf_counter()
+    cc = c
+    for _ in range(iters):
+        cc, inertia, labels = lloyd_step(x, cc, n_clusters)
+    jax.block_until_ready((cc, inertia))
+    dt = time.perf_counter() - t0
+
+    iters_per_sec = iters / dt
+    # FLOPs per iteration: distance expansion 2mnk (GEMM) + m n (epilogue)
+    # + update ~2mk; GEMM dominates.
+    flops = 2.0 * m * n_clusters * k * iters
+    gflops = flops / dt / 1e9
+    peak = _device_peak_tflops(jax.devices()[0]) * 1e3  # GFLOP/s
+    print(json.dumps({
+        "metric": f"kmeans_lloyd_{m}x{k}_k{n_clusters}",
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/sec",
+        "vs_baseline": round(gflops / peak, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Clustering rebuilt from primitives (the BASELINE north-star workload).
+
+The reference's k-means moved to cuVS (SURVEY.md preamble); per the north
+star it is rebuilt here from the primitive layers exactly as cuVS builds it:
+fused L2+argmin contraction kernel (assignment), segment-sum (update),
+comms allreduce (MNMG).
+"""
+
+from raft_tpu.cluster.kmeans import (  # noqa: F401
+    KMeansParams,
+    KMeansInit,
+    kmeans_fit,
+    kmeans_predict,
+    kmeans_transform,
+    kmeans_fit_predict,
+    lloyd_step,
+    mnmg_lloyd_step,
+    kmeans_fit_mnmg,
+)

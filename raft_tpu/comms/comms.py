@@ -115,13 +115,19 @@ class MeshComms:
     """
 
     def __init__(self, mesh: Mesh, axis_name: str = "data", rank: int = 0,
-                 _mailbox: Optional[_Mailbox] = None):
+                 _mailbox: Optional[_Mailbox] = None,
+                 _shared: Optional[dict] = None):
         if axis_name not in mesh.axis_names:
             raise ValueError(f"axis {axis_name!r} not in mesh {mesh.axis_names}")
         self.mesh = mesh
         self.axis_name = axis_name
         self._rank = int(rank)
         self._mailbox = _mailbox if _mailbox is not None else _Mailbox()
+        # Clique-wide state shared by all rank views: compiled-collective
+        # cache and per-split child mailboxes (so sub-communicators built
+        # from different rank views can exchange host messages).
+        self._shared = _shared if _shared is not None else {
+            "jit": {}, "split": {}, "lock": threading.Lock()}
 
     # -- identity (ref: core/comms.hpp:244-258) -----------------------------
 
@@ -134,7 +140,7 @@ class MeshComms:
     def rank_view(self, rank: int) -> "MeshComms":
         """A view of the same clique addressing a different rank."""
         return MeshComms(self.mesh, self.axis_name, rank,
-                         _mailbox=self._mailbox)
+                         _mailbox=self._mailbox, _shared=self._shared)
 
     # -- split (ref: core/comms.hpp:267 comm_split; ncclCommSplit) ----------
 
@@ -160,7 +166,13 @@ class MeshComms:
         sub_devices = np.asarray([axis_devs[r] for r in members])
         sub_mesh = Mesh(sub_devices, axis_names=(self.axis_name,))
         new_rank = members.index(self._rank)
-        return MeshComms(sub_mesh, self.axis_name, new_rank)
+        # Sub-communicators from different rank views of the same split must
+        # share one mailbox per color group, or their host p2p can't match.
+        split_key = (tuple(color), tuple(key), my_color)
+        with self._shared["lock"]:
+            sub_mail = self._shared["split"].setdefault(split_key, _Mailbox())
+        return MeshComms(sub_mesh, self.axis_name, new_rank,
+                         _mailbox=sub_mail)
 
     def axis_index_groups(self, color: Sequence[int]) -> List[List[int]]:
         """Same split expressed for in-jit grouped collectives
@@ -194,7 +206,7 @@ class MeshComms:
 
     def barrier(self) -> None:
         """allreduce of one int + sync (exactly std_comms.hpp:133-147)."""
-        out = self._run(lambda x: dev.barrier(self.axis_name),
+        out = self._run(("barrier",), lambda x: dev.barrier(self.axis_name),
                         jnp.ones((self.get_size(), 1), jnp.int32))
         self.sync_stream(out)
 
@@ -218,29 +230,47 @@ class MeshComms:
     # sendbuff) and returns the stacked recvbuffs. Compiled via shard_map so
     # the actual data movement is the real XLA collective.
 
-    def _run(self, shard_fn, x, out_drop_leading=False):
+    def _run(self, cache_key, shard_fn, x):
+        """Compile-once-per-(op, shape, dtype) eager collective dispatch.
+
+        ``cache_key`` identifies the collective + its static params; the
+        compiled shard_map is cached in clique-shared state so repeated
+        calls cost one dispatch, not one compile (the analogue of NCCL
+        kernels being enqueued, not rebuilt).
+        """
         x = jnp.asarray(x)
         n = self.get_size()
         if x.shape[0] != n:
             raise ValueError(
                 f"leading dim {x.shape[0]} != clique size {n}; eager "
                 "collectives take stacked per-rank buffers")
-        return _eager_collective(
-            self.mesh, self.axis_name, shard_fn, x, out_drop_leading)
+        full_key = (self.mesh, self.axis_name, cache_key, x.shape,
+                    str(x.dtype))
+        cache = self._shared["jit"]
+        with self._shared["lock"]:
+            f = cache.get(full_key)
+        if f is None:
+            f = _build_eager_collective(self.mesh, self.axis_name, shard_fn)
+            with self._shared["lock"]:
+                cache[full_key] = f
+        return f(x)
 
     def allreduce(self, x, op: Op = Op.SUM):
         """ref: comms_t::allreduce → ncclAllReduce (std_comms.hpp:366-374)."""
         return self._run(
+            ("allreduce", op),
             lambda s: dev.allreduce(s, op=op, axis_name=self.axis_name), x)
 
     def bcast(self, x, root: int = 0):
         """ref: comms_t::bcast → ncclBroadcast (std_comms.hpp:377-395)."""
         return self._run(
+            ("bcast", root),
             lambda s: dev.bcast(s, root=root, axis_name=self.axis_name), x)
 
     def reduce(self, x, op: Op = Op.SUM, root: int = 0):
         """ref: comms_t::reduce → ncclReduce (std_comms.hpp:398-422)."""
         return self._run(
+            ("reduce", op, root),
             lambda s: dev.reduce(s, op=op, root=root,
                                  axis_name=self.axis_name), x)
 
@@ -251,6 +281,7 @@ class MeshComms:
         [n, n*m, ...]: every rank's recvbuff holds all ranks' rows.
         """
         return self._run(
+            ("allgather",),
             lambda s: dev.allgather(s, axis_name=self.axis_name, tiled=True),
             x)
 
@@ -258,12 +289,14 @@ class MeshComms:
         """ref: comms_t::allgatherv (std_comms.hpp:436-468). ``x`` is padded
         per-rank [n, maxcount, ...]; returns [n, sum(recvcounts), ...]."""
         return self._run(
+            ("allgatherv", tuple(int(c) for c in recvcounts)),
             lambda s: dev.allgatherv(s, recvcounts,
                                      axis_name=self.axis_name), x)
 
     def gather(self, x, root: int = 0):
         """ref: comms_t::gather (std_comms.hpp:471-495)."""
         return self._run(
+            ("gather", root),
             lambda s: dev.gather(s, root=root, axis_name=self.axis_name)
             .reshape((-1,) + s.shape[1:]),
             x)
@@ -276,6 +309,7 @@ class MeshComms:
         """ref: comms_t::reducescatter → ncclReduceScatter
         (std_comms.hpp:531-541). Input [n, n*m, ...] → output [n, m, ...]."""
         return self._run(
+            ("reducescatter", op),
             lambda s: dev.reducescatter(s, op=op, axis_name=self.axis_name),
             x)
 
@@ -284,12 +318,14 @@ class MeshComms:
         the per-rank (dest, source) host loop collapses to one static
         ``perm`` of (source, dest) pairs."""
         return self._run(
+            ("sendrecv", tuple(map(tuple, perm))),
             lambda s: dev.device_sendrecv(s, perm,
                                           axis_name=self.axis_name), x)
 
     def device_multicast_sendrecv(self, x, pairs: Sequence[Tuple[int, int]]):
         """ref: comms_t::device_multicast_sendrecv (std_comms.hpp:574-601)."""
         return self._run(
+            ("multicast", tuple(map(tuple, pairs))),
             lambda s: dev.device_multicast_sendrecv(
                 s, pairs, axis_name=self.axis_name), x)
 
@@ -302,23 +338,21 @@ class MeshComms:
         pass
 
 
-def _eager_collective(mesh, axis_name, shard_fn, x, out_drop_leading):
+def _build_eager_collective(mesh, axis_name, shard_fn):
     """shard x's leading dim over the axis, apply shard_fn per shard, restack.
 
     Inside the shard the leading dim is 1 (one rank's buffer); shard_fn sees
-    the squeezed buffer. jit caches compilation per (fn identity, shapes).
+    the squeezed buffer.
     """
     spec = P(axis_name)
-    out_spec = P(axis_name)
 
     def wrapped(block):
         s = block[0]  # squeeze the per-rank slot
         r = shard_fn(s)
         return r[None]
 
-    f = jax.jit(jax.shard_map(wrapped, mesh=mesh, in_specs=spec,
-                              out_specs=out_spec))
-    return f(x)
+    return jax.jit(jax.shard_map(wrapped, mesh=mesh, in_specs=spec,
+                                 out_specs=spec))
 
 
 def build_mesh_comms(res=None, mesh: Optional[Mesh] = None,

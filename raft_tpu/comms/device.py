@@ -194,6 +194,10 @@ def reducescatter(x, op: Op = Op.SUM, axis_name="data"):
     else:
         red = jnp.prod(g, axis=0)
     n = _axis_size(axis_name)
+    if red.shape[0] % n != 0:
+        raise ValueError(
+            f"reducescatter length {red.shape[0]} not divisible by "
+            f"axis size {n}")
     block = red.shape[0] // n
     idx = lax.axis_index(axis_name)
     return lax.dynamic_slice_in_dim(red, idx * block, block, axis=0)

@@ -1,0 +1,16 @@
+"""Pairwise distances, rebuilt from the contraction primitive layer.
+
+The reference migrated its distance algorithms to cuVS (README.md:99-135)
+but retained the `contractions` tiling engine they were built on
+(linalg/detail/contractions.cuh:16); the BASELINE north star requires
+pairwise distance rebuilt from those primitives, exactly as cuVS builds
+them.  The TPU contraction engine is `raft_tpu.linalg.contractions`
+(Pallas MXU tiles); expanded-form metrics ride it, the rest are XLA
+formulations the compiler fuses.
+"""
+
+from raft_tpu.distance.pairwise import (  # noqa: F401
+    DistanceType,
+    pairwise_distance,
+    fused_l2_nn_argmin,
+)

@@ -57,9 +57,16 @@ def reduce(res, data, apply: str = ALONG_ROWS,
         red = jnp.max(mapped, axis=axis)
         if init is not None:
             red = jnp.maximum(red, jnp.asarray(init, dtype=mapped.dtype))
+    elif reduce_op is ops.mul_op:
+        red = jnp.prod(mapped, axis=axis)
+        if init is not None:
+            red = red * jnp.asarray(init, dtype=mapped.dtype)
     else:
-        init_val = jnp.asarray(0.0 if init is None else init,
-                               dtype=mapped.dtype)
+        if init is None:
+            raise ValueError(
+                "a custom reduce_op needs an explicit init (its identity); "
+                "there is no way to infer it")
+        init_val = jnp.asarray(init, dtype=mapped.dtype)
         red = jax.lax.reduce(mapped, init_val,
                              lambda a, b: reduce_op(a, b), (axis,))
     out_val = final_op(red)

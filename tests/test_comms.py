@@ -154,3 +154,25 @@ def test_get_type():
 
     assert get_type(np.zeros(3, np.float64)) == Datatype.FLOAT64
     assert get_type(np.zeros(3, np.int32)) == Datatype.INT32
+
+
+def test_split_mailbox_shared(comm):
+    """Regression: sub-communicators built from different rank views must
+    share a mailbox per color group for host p2p to match."""
+    color = [r % 2 for r in range(8)]
+    key = list(range(8))
+    sub0 = comm.rank_view(0).comm_split(color, key)  # color 0, sub-rank 0
+    sub2 = comm.rank_view(2).comm_split(color, key)  # color 0, sub-rank 1
+    sub0.isend(np.int32(99), dest=1, tag=0)
+    got = sub2.irecv(source=0, tag=0).wait()
+    assert int(got) == 99
+
+
+def test_eager_collective_cached(comm):
+    """Regression: repeated eager collectives reuse the compiled shard_map."""
+    x = np.ones((8, 4), np.float32)
+    comm.allreduce(x)
+    n_entries = len(comm._shared["jit"])
+    for _ in range(5):
+        comm.allreduce(x)
+    assert len(comm._shared["jit"]) == n_entries

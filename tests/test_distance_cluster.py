@@ -1,0 +1,221 @@
+"""Distance + k-means tests vs scipy/numpy references (the reference's
+devArrMatch-vs-host pattern, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as sdist
+
+import raft_tpu
+from raft_tpu.distance import DistanceType, pairwise_distance, \
+    fused_l2_nn_argmin
+from raft_tpu.cluster import (KMeansParams, KMeansInit, kmeans_fit,
+                              kmeans_predict, kmeans_transform,
+                              kmeans_fit_mnmg, lloyd_step)
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(83, 17)).astype(np.float32)
+    y = rng.normal(size=(41, 17)).astype(np.float32)
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def xy_pos(xy):
+    x, y = xy
+    xp = np.abs(x) + 0.01
+    yp = np.abs(y) + 0.01
+    xp /= xp.sum(1, keepdims=True)
+    yp /= yp.sum(1, keepdims=True)
+    return xp.astype(np.float32), yp.astype(np.float32)
+
+
+CDIST_CASES = [
+    (DistanceType.L2SqrtExpanded, "euclidean", 2e-3),
+    (DistanceType.L2SqrtUnexpanded, "euclidean", 1e-4),
+    (DistanceType.L2Expanded, "sqeuclidean", 2e-3),
+    (DistanceType.L2Unexpanded, "sqeuclidean", 1e-4),
+    (DistanceType.L1, "cityblock", 1e-4),
+    (DistanceType.Linf, "chebyshev", 1e-5),
+    (DistanceType.Canberra, "canberra", 1e-4),
+    (DistanceType.CosineExpanded, "cosine", 1e-5),
+    (DistanceType.CorrelationExpanded, "correlation", 1e-5),
+]
+
+
+class TestPairwiseDistance:
+    @pytest.mark.parametrize("metric,scipy_name,tol", CDIST_CASES,
+                             ids=lambda c: str(c))
+    def test_vs_scipy(self, res, xy, metric, scipy_name, tol):
+        x, y = xy
+        got = np.asarray(pairwise_distance(res, x, y, metric=metric))
+        want = sdist.cdist(x.astype(np.float64), y.astype(np.float64),
+                           scipy_name)
+        np.testing.assert_allclose(got, want, atol=tol, rtol=tol)
+
+    def test_minkowski(self, res, xy):
+        x, y = xy
+        got = np.asarray(pairwise_distance(
+            res, x, y, metric=DistanceType.LpUnexpanded, p=3.0))
+        want = sdist.cdist(x.astype(np.float64), y.astype(np.float64),
+                           "minkowski", p=3.0)
+        np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+    def test_inner_product(self, res, xy):
+        x, y = xy
+        got = np.asarray(pairwise_distance(res, x, y,
+                                           metric=DistanceType.InnerProduct))
+        np.testing.assert_allclose(got, x @ y.T, atol=1e-4)
+
+    def test_hellinger(self, res, xy_pos):
+        x, y = xy_pos
+        got = np.asarray(pairwise_distance(
+            res, x, y, metric=DistanceType.HellingerExpanded))
+        bc = np.sqrt(x)[:, None, :] * np.sqrt(y)[None, :, :]
+        want = np.sqrt(np.maximum(1.0 - bc.sum(-1), 0.0))
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_jensen_shannon(self, res, xy_pos):
+        x, y = xy_pos
+        got = np.asarray(pairwise_distance(
+            res, x, y, metric=DistanceType.JensenShannon))
+        want = sdist.cdist(x.astype(np.float64), y.astype(np.float64),
+                           "jensenshannon")
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_kl(self, res, xy_pos):
+        x, y = xy_pos
+        got = np.asarray(pairwise_distance(
+            res, x, y, metric=DistanceType.KLDivergence))
+        xd, yd = x.astype(np.float64), y.astype(np.float64)
+        want = (xd[:, None, :] * np.log(xd[:, None, :] / yd[None, :, :])
+                ).sum(-1)
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_boolean_metrics(self, res):
+        rng = np.random.default_rng(5)
+        x = (rng.random((30, 24)) > 0.5)
+        y = (rng.random((20, 24)) > 0.5)
+        for metric, name in [(DistanceType.JaccardExpanded, "jaccard"),
+                             (DistanceType.HammingUnexpanded, "hamming"),
+                             (DistanceType.RusselRaoExpanded, "russellrao"),
+                             (DistanceType.DiceExpanded, "dice")]:
+            got = np.asarray(pairwise_distance(
+                res, x.astype(np.float32), y.astype(np.float32),
+                metric=metric))
+            want = sdist.cdist(x, y, name)
+            np.testing.assert_allclose(got, want, atol=1e-5, err_msg=name)
+
+    def test_self_distance(self, res, xy):
+        x, _ = xy
+        d = np.asarray(pairwise_distance(res, x,
+                                         metric=DistanceType.L2SqrtExpanded))
+        assert d.shape == (83, 83)
+        assert np.allclose(np.diag(d), 0.0, atol=1e-2)
+
+    def test_fused_l2_nn(self, res, xy):
+        x, y = xy
+        val, idx = fused_l2_nn_argmin(res, x, y)
+        d = sdist.cdist(x, y, "sqeuclidean")
+        np.testing.assert_array_equal(np.asarray(idx), d.argmin(1))
+        np.testing.assert_allclose(np.asarray(val), d.min(1), rtol=1e-3,
+                                   atol=1e-3)
+
+
+class TestKMeans:
+    @pytest.fixture(scope="class")
+    def blobs(self, res):
+        from raft_tpu.random import make_blobs, RngState
+
+        X, labels, centers = make_blobs(res, RngState(3), 3000, 8,
+                                        n_clusters=5, cluster_std=0.3)
+        return np.asarray(X), np.asarray(labels), np.asarray(centers)
+
+    def test_lloyd_converges(self, res, blobs):
+        X, true_labels, centers = blobs
+        params = KMeansParams(n_clusters=5, max_iter=50, seed=1)
+        c, inertia, labels, n_iter = kmeans_fit(res, params, X)
+        assert n_iter < 50
+        # every true cluster is recovered: centroid within 3·std of a center
+        d = sdist.cdist(np.asarray(c), centers)
+        assert d.min(axis=0).max() < 1.0
+        # labels consistent with true clustering (perfect up to permutation)
+        from scipy.stats import mode
+        for t in range(5):
+            assert mode(np.asarray(labels)[true_labels == t]).count > \
+                0.95 * (true_labels == t).sum()
+
+    def test_random_init(self, res, blobs):
+        X, _, centers = blobs
+        params = KMeansParams(n_clusters=5, init=KMeansInit.RANDOM,
+                              max_iter=100, seed=4)
+        c, inertia, _, _ = kmeans_fit(res, params, X)
+        assert float(inertia) < X.shape[0] * 0.3 ** 2 * 8 * 3
+
+    def test_predict_transform(self, res, blobs):
+        X, _, _ = blobs
+        params = KMeansParams(n_clusters=5, seed=1, max_iter=20)
+        c, _, labels, _ = kmeans_fit(res, params, X)
+        pred, _ = kmeans_predict(res, X, c)
+        np.testing.assert_array_equal(np.asarray(pred), np.asarray(labels))
+        t = np.asarray(kmeans_transform(res, X[:10], c))
+        want = sdist.cdist(X[:10], np.asarray(c))
+        np.testing.assert_allclose(t, want, atol=1e-2)
+
+    def test_lloyd_step_jit(self, blobs):
+        X, _, _ = blobs
+        c0 = X[:5]
+        c1, inertia, labels = lloyd_step(X, c0, 5)
+        assert c1.shape == c0.shape and labels.shape == (X.shape[0],)
+
+    def test_mnmg_matches_single(self, res, blobs, mesh8):
+        """MNMG result == single-chip result for identical init (the
+        allreduce makes the math bitwise-equivalent up to reduction order)."""
+        X, _, _ = blobs
+        X = X[:2048]  # divisible by 8
+        init = X[7 * np.arange(5)]
+        params = KMeansParams(n_clusters=5, init=KMeansInit.ARRAY,
+                              max_iter=10, tol=0.0, seed=1)
+        c_single, in_single, _, _ = kmeans_fit(res, params, X,
+                                               centroids=init)
+        c_mnmg, in_mnmg, labels, _ = kmeans_fit_mnmg(
+            res, params, X, centroids=init, mesh=mesh8)
+        np.testing.assert_allclose(np.asarray(c_single), np.asarray(c_mnmg),
+                                   rtol=1e-4, atol=1e-4)
+        assert abs(float(in_single) - float(in_mnmg)) < 1e-1
+        assert labels.shape == (2048,)
+
+    def test_mnmg_model_axis(self, mesh8):
+        """2-D mesh: rows over 'data', centroids over 'model'."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from raft_tpu.cluster.kmeans import mnmg_lloyd_step
+
+        devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+        mesh = Mesh(devs, axis_names=("data", "model"))
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(256, 16)).astype(np.float32)
+        C = rng.normal(size=(8, 16)).astype(np.float32)
+
+        def step(x, cblk):
+            return mnmg_lloyd_step(x, cblk, n_clusters=8, data_axis="data",
+                                   model_axis="model")
+
+        f = jax.jit(jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P("data"), P("model")),
+            out_specs=(P("model"), P(), P("data")),
+            check_vma=False))
+        new_c, inertia, labels = f(X, C)
+        # reference single-device Lloyd step
+        d = sdist.cdist(X, C, "sqeuclidean")
+        want_labels = d.argmin(1)
+        np.testing.assert_array_equal(np.asarray(labels), want_labels)
+        want_c = np.stack([
+            X[want_labels == i].mean(0) if (want_labels == i).any() else C[i]
+            for i in range(8)])
+        np.testing.assert_allclose(np.asarray(new_c), want_c, atol=1e-4)
+        assert abs(float(inertia) - d.min(1).sum()) < 1.0
