@@ -69,12 +69,18 @@ def _assign(x, centroids):
 
 
 def _update(x, labels, n_clusters, old_centroids):
-    """Centroid update: segment mean with empty-cluster carry-over."""
-    sums = jax.ops.segment_sum(x, labels, num_segments=n_clusters)
-    counts = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), labels,
-                                 num_segments=n_clusters)
+    """Centroid update: segment mean with empty-cluster carry-over.
+
+    Sums/counts accumulate in float32 regardless of input dtype — bf16
+    accumulation saturates (256 + 1 == 256 in bf16), which would silently
+    mis-scale centroids for clusters with >256 members."""
+    sums = jax.ops.segment_sum(x.astype(jnp.float32), labels,
+                               num_segments=n_clusters)
+    counts = jax.ops.segment_sum(
+        jnp.ones((x.shape[0],), jnp.float32), labels,
+        num_segments=n_clusters)
     safe = jnp.maximum(counts, 1.0)[:, None]
-    new = sums / safe
+    new = (sums / safe).astype(x.dtype)
     return jnp.where(counts[:, None] > 0, new, old_centroids), counts
 
 
@@ -90,24 +96,83 @@ def lloyd_step(x, centroids, n_clusters: int):
     return new_centroids, jnp.sum(dist), labels
 
 
-def _kmeans_plus_plus(state: RngState, x, n_clusters: int):
-    """k-means++ seeding (scalable variant of Arthur & Vassilvitskii):
-    greedy D² sampling with one fused-argmin pass per chosen center."""
+def _weighted_plus_plus(rng, cand, w, n_clusters: int):
+    """Classic weighted k-means++ on the (small) candidate set — host-side
+    numpy; candidate count is O(rounds · oversampling · k)."""
+    import numpy as np
+
+    ncand = cand.shape[0]
+    centers = np.empty((n_clusters, cand.shape[1]), cand.dtype)
+    first = rng.choice(ncand, p=w / w.sum())
+    centers[0] = cand[first]
+    d2 = np.sum((cand - centers[0][None, :]) ** 2, axis=1)
+    for i in range(1, n_clusters):
+        probs = w * d2
+        total = probs.sum()
+        if total <= 0:
+            nxt = rng.choice(ncand)
+        else:
+            nxt = rng.choice(ncand, p=probs / total)
+        centers[i] = cand[nxt]
+        d2 = np.minimum(d2, np.sum((cand - cand[nxt][None, :]) ** 2, axis=1))
+    return centers
+
+
+@jax.jit
+def _min_d2_update(x, new_pts, d2):
+    d = (jnp.sum(x * x, 1, keepdims=True)
+         - 2.0 * (x @ new_pts.T)
+         + jnp.sum(new_pts * new_pts, 1)[None, :])
+    return jnp.minimum(d2, jnp.min(d, axis=1))
+
+
+def _kmeans_plus_plus(state: RngState, x, n_clusters: int,
+                      oversampling_factor: float = 2.0):
+    """k-means|| seeding (Bahmani et al., the scalable k-means++): a few
+    oversampled D²-Bernoulli rounds over the full data (each one fused
+    device pass), then weighted k-means++ on the small candidate set.
+
+    Replaces the naive k sequential D² draws — k full-dataset passes — with
+    ~5 passes regardless of k."""
+    import numpy as np
+
     m = x.shape[0]
     key = state.next_key()
     k0, key = jax.random.split(key)
-    first = jax.random.randint(k0, (), 0, m)
-    centroids = jnp.zeros((n_clusters, x.shape[1]), x.dtype)
-    centroids = centroids.at[0].set(x[first])
+    first = int(jax.random.randint(k0, (), 0, m))
+    cand = [np.asarray(x[first])[None, :]]
+    d2 = jnp.sum((x - x[first][None, :]) ** 2, axis=1).astype(jnp.float32)
+    ell = max(1.0, oversampling_factor * n_clusters)
 
-    d2 = jnp.sum((x - centroids[0][None, :]) ** 2, axis=1)
-    for i in range(1, n_clusters):
+    for _ in range(5):
         ki, key = jax.random.split(key)
-        probs = d2 / jnp.maximum(jnp.sum(d2), 1e-12)
-        nxt = jax.random.choice(ki, m, p=probs)
-        centroids = centroids.at[i].set(x[nxt])
-        d2 = jnp.minimum(d2, jnp.sum((x - x[nxt][None, :]) ** 2, axis=1))
-    return centroids
+        total = float(jnp.sum(d2))
+        if total <= 0:
+            break
+        probs = jnp.minimum(1.0, ell * d2 / total)
+        picked = np.nonzero(
+            np.asarray(jax.random.uniform(ki, (m,)) < probs))[0]
+        if picked.size == 0:
+            continue
+        new_pts = x[jnp.asarray(picked)]
+        cand.append(np.asarray(new_pts))
+        d2 = _min_d2_update(x, new_pts, d2)
+
+    cand_np = np.concatenate(cand, axis=0)
+    rng = np.random.default_rng(int(jax.random.randint(
+        key, (), 0, np.iinfo(np.int32).max)))
+    if cand_np.shape[0] <= n_clusters:
+        # degenerate: too few candidates — top up with random rows
+        extra = rng.choice(m, n_clusters - cand_np.shape[0] + 1,
+                           replace=False)
+        cand_np = np.concatenate([cand_np, np.asarray(x[jnp.asarray(extra)])])
+    # weight candidates by how many points they serve
+    _, labels = _assign(x, jnp.asarray(cand_np, x.dtype))
+    w = np.bincount(np.asarray(labels), minlength=cand_np.shape[0]) \
+        .astype(np.float64) + 1e-3
+    centers = _weighted_plus_plus(rng, cand_np.astype(np.float64), w,
+                                  n_clusters)
+    return jnp.asarray(centers, x.dtype)
 
 
 def _init_centroids(params: KMeansParams, state: RngState, x,
@@ -120,7 +185,8 @@ def _init_centroids(params: KMeansParams, state: RngState, x,
         idx = jax.random.choice(state.next_key(), x.shape[0],
                                 (params.n_clusters,), replace=False)
         return x[idx]
-    return _kmeans_plus_plus(state, x, params.n_clusters)
+    return _kmeans_plus_plus(state, x, params.n_clusters,
+                             params.oversampling_factor)
 
 
 def kmeans_fit(res, params: KMeansParams, x,
@@ -145,6 +211,9 @@ def kmeans_fit(res, params: KMeansParams, x,
                 params.tol * max(prev_inertia, 1e-30):
             break
         prev_inertia = float(inertia)
+    # lloyd_step's labels/inertia are measured against its *input* centroids;
+    # re-assign once so the returned triple is self-consistent.
+    labels, inertia = kmeans_predict(res, x, c)
     return c, inertia, labels, n_iter
 
 
@@ -198,26 +267,30 @@ def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
         # Each model shard accumulates rows assigned to ITS block.
         in_block = (labels >= mi * kb) & (labels < (mi + 1) * kb)
         local_labels = jnp.where(in_block, labels - mi * kb, 0)
-        w = in_block.astype(x_shard.dtype)
-        sums = jax.ops.segment_sum(x_shard * w[:, None], local_labels,
-                                   num_segments=kb)
+        w = in_block.astype(jnp.float32)   # f32 accumulation (bf16 saturates)
+        sums = jax.ops.segment_sum(
+            x_shard.astype(jnp.float32) * w[:, None], local_labels,
+            num_segments=kb)
         counts = jax.ops.segment_sum(w, local_labels, num_segments=kb)
         sums = lax.psum(sums, data_axis)
         counts = lax.psum(counts, data_axis)
         safe = jnp.maximum(counts, 1.0)[:, None]
-        new_c = jnp.where(counts[:, None] > 0, sums / safe, centroids)
+        new_c = jnp.where(counts[:, None] > 0,
+                          (sums / safe).astype(centroids.dtype), centroids)
         inertia = lax.psum(jnp.sum(dist), data_axis)
         return new_c, inertia, labels
 
     dist, labels = _assign(x_shard, centroids)
-    sums = jax.ops.segment_sum(x_shard, labels, num_segments=n_clusters)
+    sums = jax.ops.segment_sum(x_shard.astype(jnp.float32), labels,
+                               num_segments=n_clusters)
     counts = jax.ops.segment_sum(
-        jnp.ones((x_shard.shape[0],), x_shard.dtype), labels,
+        jnp.ones((x_shard.shape[0],), jnp.float32), labels,
         num_segments=n_clusters)
     sums = lax.psum(sums, data_axis)            # ← the per-iter allreduce
     counts = lax.psum(counts, data_axis)
     safe = jnp.maximum(counts, 1.0)[:, None]
-    new_c = jnp.where(counts[:, None] > 0, sums / safe, centroids)
+    new_c = jnp.where(counts[:, None] > 0,
+                      (sums / safe).astype(centroids.dtype), centroids)
     inertia = lax.psum(jnp.sum(dist), data_axis)
     return new_c, inertia, labels
 
@@ -239,12 +312,7 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
     if mesh is None:
         mesh = core_res.get_mesh(core_res.default_resources(res))
     state = RngState(seed=params.seed)
-    if centroids is None:
-        idx = jax.random.choice(state.next_key(), x.shape[0],
-                                (params.n_clusters,), replace=False)
-        c = x[idx]
-    else:
-        c = jnp.asarray(centroids, x.dtype)
+    c = _init_centroids(params, state, x, centroids)
 
     x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
     c = jax.device_put(c, NamedSharding(mesh, P()))
@@ -260,14 +328,23 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
             check_vma=False,
         ))
 
+    assign_only = jax.jit(
+        jax.shard_map(
+            lambda xs, cs: _assign(xs, cs),
+            mesh=mesh,
+            in_specs=(P(data_axis), P()),
+            out_specs=(P(data_axis), P(data_axis)),
+            check_vma=False,
+        ))
+
     prev = None
     n_iter = 0
-    labels = None
-    inertia = jnp.asarray(jnp.inf, x.dtype)
     for n_iter in range(1, params.max_iter + 1):
         c, inertia, labels = step(x, c)
         if prev is not None and abs(prev - float(inertia)) <= \
                 params.tol * max(prev, 1e-30):
             break
         prev = float(inertia)
-    return c, inertia, labels, n_iter
+    # re-assign against the final centroids for a self-consistent return
+    dist, labels = assign_only(x, c)
+    return c, jnp.sum(dist), labels, n_iter

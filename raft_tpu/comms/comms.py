@@ -168,11 +168,21 @@ class MeshComms:
         new_rank = members.index(self._rank)
         # Sub-communicators from different rank views of the same split must
         # share one mailbox per color group, or their host p2p can't match.
+        # They must also share one clique-state dict, so second-level splits
+        # (sub.comm_split from different rank views) coordinate too.
         split_key = (tuple(color), tuple(key), my_color)
         with self._shared["lock"]:
-            sub_mail = self._shared["split"].setdefault(split_key, _Mailbox())
+            entry = self._shared["split"].get(split_key)
+            if entry is None:
+                entry = {
+                    "mailbox": _Mailbox(),
+                    "shared": {"jit": {}, "split": {},
+                               "lock": threading.Lock()},
+                }
+                self._shared["split"][split_key] = entry
         return MeshComms(sub_mesh, self.axis_name, new_rank,
-                         _mailbox=sub_mail)
+                         _mailbox=entry["mailbox"],
+                         _shared=entry["shared"])
 
     def axis_index_groups(self, color: Sequence[int]) -> List[List[int]]:
         """Same split expressed for in-jit grouped collectives

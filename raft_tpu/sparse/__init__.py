@@ -1,0 +1,20 @@
+"""Sparse primitives (ref: cpp/include/raft/sparse/ — formats, conversions,
+linalg, ops, matrix helpers, solvers).
+
+TPU design notes
+----------------
+Sparse irregularity is handled the XLA way, not the CUDA way:
+
+* compute kernels (``spmv``/``spmm``/``sddmm``/``masked_matmul``) are
+  formulated as gathers + ``segment_sum`` over a static-``nnz`` buffer, so a
+  single trace serves every matrix with the same nnz/shape — no atomics, no
+  dynamic shapes inside jit;
+* structure-producing ops (sort, dedup, conversions, filtering) run on host
+  (numpy) exactly where the reference runs thrust/cub on a stream, because
+  their output nnz is data-dependent and would break jit shapes.
+"""
+
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix  # noqa: F401
+
+from . import convert, linalg, matrix, op  # noqa: F401
+from . import solver  # noqa: F401

@@ -1,0 +1,98 @@
+"""Format conversions (ref: raft/sparse/convert/{coo,csr,dense}.cuh,
+detail/adj_to_csr.cuh, detail/bitmap_to_csr.cuh, detail/bitset_to_csr.cuh).
+
+Output nnz is data-dependent for most conversions, so these run host-side
+(the reference likewise drives them from host code with device scans); the
+results are returned as device arrays ready for the jitted compute ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.bitset import Bitmap, Bitset
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+
+
+def _host(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def sorted_coo_to_csr(coo: COOMatrix) -> CSRMatrix:
+    """Row-sorted COO → CSR (ref: sparse/convert/csr.cuh `sorted_coo_to_csr`).
+
+    The rows array must already be sorted (use op.coo_sort first)."""
+    rows = _host(coo.rows)
+    counts = np.bincount(rows, minlength=coo.n_rows)
+    indptr = np.zeros(coo.n_rows + 1, dtype=rows.dtype)
+    np.cumsum(counts, out=indptr[1:])
+    return CSRMatrix(jnp.asarray(indptr), jnp.asarray(coo.cols),
+                     jnp.asarray(coo.data), coo.shape)
+
+
+def csr_to_coo(csr: CSRMatrix) -> COOMatrix:
+    """CSR → COO by expanding indptr into per-nnz row ids
+    (ref: sparse/convert/coo.cuh `csr_to_coo`)."""
+    indptr = _host(csr.indptr)
+    rows = np.repeat(np.arange(csr.n_rows, dtype=_host(csr.indices).dtype),
+                     np.diff(indptr))
+    return COOMatrix(jnp.asarray(rows), jnp.asarray(csr.indices),
+                     jnp.asarray(csr.data), csr.shape)
+
+
+def dense_to_csr(dense, tol: float = 0.0) -> CSRMatrix:
+    """Dense → CSR keeping entries with |x| > tol
+    (reverse of csr_to_dense; used by tests and masked_matmul setup)."""
+    d = _host(dense)
+    mask = np.abs(d) > tol
+    rows, cols = np.nonzero(mask)
+    counts = np.bincount(rows, minlength=d.shape[0])
+    indptr = np.zeros(d.shape[0] + 1, dtype=np.int32)
+    np.cumsum(counts, out=indptr[1:])
+    return CSRMatrix(jnp.asarray(indptr), jnp.asarray(cols.astype(np.int32)),
+                     jnp.asarray(d[rows, cols]), d.shape)
+
+
+def csr_to_dense(csr: CSRMatrix) -> jnp.ndarray:
+    """CSR → dense (ref: sparse/convert/dense.cuh `csr_to_dense`).
+
+    jit-compatible: scatter-add into a zero matrix with static shapes."""
+    row_ids = csr.row_ids()
+    out = jnp.zeros(csr.shape, dtype=csr.data.dtype)
+    return out.at[row_ids, csr.indices].add(csr.data)
+
+
+def adj_to_csr(adj, row_ind: Optional[np.ndarray] = None) -> CSRMatrix:
+    """Boolean adjacency matrix → CSR with unit values
+    (ref: sparse/convert/csr.cuh `adj_to_csr`, detail/adj_to_csr.cuh)."""
+    a = _host(adj).astype(bool)
+    rows, cols = np.nonzero(a)
+    counts = np.bincount(rows, minlength=a.shape[0])
+    indptr = np.zeros(a.shape[0] + 1, dtype=np.int32)
+    np.cumsum(counts, out=indptr[1:])
+    data = np.ones(rows.shape[0], dtype=np.float32)
+    return CSRMatrix(jnp.asarray(indptr), jnp.asarray(cols.astype(np.int32)),
+                     jnp.asarray(data), a.shape)
+
+
+def bitmap_to_csr(bitmap: Bitmap) -> CSRMatrix:
+    """Bitmap mask (n_rows × n_cols bits) → CSR structure with unit values
+    (ref: sparse/convert/csr.cuh `bitmap_to_csr`, detail/bitmap_to_csr.cuh)."""
+    return adj_to_csr(bitmap.to_bool_matrix())
+
+
+def bitset_to_csr(bitset: Bitset, n_rows: int) -> CSRMatrix:
+    """Single-row bitset repeated over n_rows → CSR
+    (ref: sparse/convert/csr.cuh `bitset_to_csr`, detail/bitset_to_csr.cuh:
+    every row of the output has the same sparsity pattern)."""
+    bools = _host(bitset.to_bools())
+    cols = np.nonzero(bools)[0].astype(np.int32)
+    nnz_row = cols.shape[0]
+    indptr = (np.arange(n_rows + 1, dtype=np.int32) * nnz_row).astype(np.int32)
+    cols_all = np.tile(cols, n_rows)
+    data = np.ones(cols_all.shape[0], dtype=np.float32)
+    return CSRMatrix(jnp.asarray(indptr), jnp.asarray(cols_all),
+                     jnp.asarray(data), (n_rows, bitset.size))

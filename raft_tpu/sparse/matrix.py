@@ -1,0 +1,135 @@
+"""Sparse matrix helpers: CSR select_k, diagonal ops, TF-IDF / BM25 encoders
+(ref: raft/sparse/matrix/{select_k,diagonal,preprocessing}.cuh).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+from raft_tpu.matrix import select_k as dense_select_k
+from raft_tpu.sparse import convert
+
+
+def select_k(res, csr: CSRMatrix, k: int, select_min: bool = True,
+             in_idx=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row top-k over a CSR matrix with logical shape [batch, len]
+    (ref: sparse/matrix/select_k.cuh:64).
+
+    Returns (values [batch,k], indices [batch,k]); rows with fewer than k
+    entries are padded with the dummy bound value and index -1.  TPU
+    formulation: scatter the ragged rows into a padded [batch, max_row_len]
+    tile (static shape), then run the dense select_k path — the irregular
+    part is a single scatter, the selection rides the tuned dense kernel."""
+    indptr = np.asarray(csr.indptr)
+    row_len = np.diff(indptr)
+    max_len = max(int(row_len.max()) if row_len.size else 0, k)
+    n_rows = csr.n_rows
+
+    dtype = np.asarray(csr.data).dtype
+    pad_val = np.inf if select_min else -np.inf
+    if not np.issubdtype(dtype, np.floating):
+        info = np.iinfo(dtype)
+        pad_val = info.max if select_min else info.min
+
+    # position of each nnz inside its row
+    row_ids = csr.row_ids()
+    offsets = jnp.arange(csr.nnz) - jnp.asarray(indptr[:-1])[row_ids]
+    padded_val = jnp.full((n_rows, max_len), pad_val, dtype=csr.data.dtype)
+    padded_val = padded_val.at[row_ids, offsets].set(csr.data)
+    col_src = jnp.asarray(in_idx)[csr.indices] if in_idx is not None \
+        else csr.indices
+    padded_idx = jnp.full((n_rows, max_len), -1, dtype=csr.indices.dtype)
+    padded_idx = padded_idx.at[row_ids, offsets].set(col_src)
+
+    vals, pos = dense_select_k(res, padded_val, k, select_min=select_min)
+    idx = jnp.take_along_axis(padded_idx, pos, axis=1)
+    # positions selected from padding keep index -1
+    valid = pos < jnp.asarray(row_len)[:, None]
+    idx = jnp.where(valid, idx, -1)
+    return vals, idx
+
+
+def diagonal(mat) -> jnp.ndarray:
+    """Extract the diagonal of a CSR/COO matrix as a dense vector
+    (ref: sparse/matrix/diagonal.cuh:21,92)."""
+    if isinstance(mat, CSRMatrix):
+        coo = convert.csr_to_coo(mat)
+    else:
+        coo = mat
+    on_diag = coo.rows == coo.cols
+    n = min(coo.shape)
+    contrib = jnp.where(on_diag, coo.data, 0)
+    return jax.ops.segment_sum(contrib, jnp.minimum(coo.rows, n - 1),
+                               num_segments=n)
+
+
+def set_diagonal(csr: CSRMatrix, scalar) -> CSRMatrix:
+    """Set existing diagonal entries to a scalar value
+    (ref: sparse/matrix/diagonal.cuh:69 `set_diagonal`)."""
+    row_ids = csr.row_ids()
+    on_diag = row_ids == csr.indices
+    return CSRMatrix(csr.indptr, csr.indices,
+                     jnp.where(on_diag, scalar, csr.data), csr.shape)
+
+
+def scale_by_diagonal_symmetric(csr: CSRMatrix) -> CSRMatrix:
+    """A[i,j] /= sqrt(d[i])·sqrt(d[j]) (ref: sparse/matrix/diagonal.cuh:44
+    `scale_by_diagonal_symmetric`)."""
+    d = diagonal(csr)
+    inv = jnp.where(d != 0, 1.0 / jnp.sqrt(jnp.abs(d)), 1.0)
+    row_ids = csr.row_ids()
+    return CSRMatrix(csr.indptr, csr.indices,
+                     csr.data * inv[row_ids] * inv[csr.indices], csr.shape)
+
+
+# ---------------------------------------------------------------------------
+# Text preprocessing (ref: sparse/matrix/preprocessing.cuh:28-101,
+# detail/preprocessing.cuh — fit_tfidf/fit_bm25 + transform kernels)
+# ---------------------------------------------------------------------------
+
+def _fit_counts(coo: COOMatrix):
+    """featIdCount[c] = nnz entries in column c (documents containing the
+    feature); fullIdLen = sum of all values (total token count)
+    (ref: detail/preprocessing.cuh fit_tfidf:61-89)."""
+    n_cols = coo.n_cols
+    feat_count = jax.ops.segment_sum(jnp.ones_like(coo.cols), coo.cols,
+                                     num_segments=n_cols)
+    full_len = jnp.sum(coo.data)
+    return feat_count, full_len
+
+
+def encode_tfidf(coo_or_csr) -> jnp.ndarray:
+    """TF-IDF value per nnz entry (ref: sparse/matrix/preprocessing.cuh:28
+    `encode_tfidf`; transform kernel detail/preprocessing.cuh:199-213:
+    tf = log(v), idf = log(num_rows / featIdCount[col] + 1), out = tf·idf)."""
+    coo = convert.csr_to_coo(coo_or_csr) \
+        if isinstance(coo_or_csr, CSRMatrix) else coo_or_csr
+    feat_count, _ = _fit_counts(coo)
+    tf = jnp.log(coo.data.astype(jnp.float32))
+    idf = jnp.log(coo.n_rows / feat_count[coo.cols].astype(jnp.float32) + 1.0)
+    return tf * idf
+
+
+def encode_bm25(coo_or_csr, k_param: float = 1.6,
+                b_param: float = 0.75) -> jnp.ndarray:
+    """Okapi BM25 value per nnz entry (ref: sparse/matrix/preprocessing.cuh
+    `encode_bm25`; transform kernel detail/preprocessing.cuh:162-184:
+    bm = ((k1+1)·tf) / (k1·((1−b) + b·rowLen/avgLen) + tf), out = idf·bm)."""
+    coo = convert.csr_to_coo(coo_or_csr) \
+        if isinstance(coo_or_csr, CSRMatrix) else coo_or_csr
+    feat_count, full_len = _fit_counts(coo)
+    row_len = jax.ops.segment_sum(coo.data, coo.rows,
+                                  num_segments=coo.n_rows)
+    avg_len = full_len.astype(jnp.float32) / coo.n_rows
+    tf = jnp.log(coo.data.astype(jnp.float32))
+    idf = jnp.log(coo.n_rows / feat_count[coo.cols].astype(jnp.float32) + 1.0)
+    bm = ((k_param + 1.0) * tf) / (
+        k_param * ((1.0 - b_param)
+                   + b_param * (row_len[coo.rows].astype(jnp.float32)
+                                / avg_len)) + tf)
+    return idf * bm

@@ -1,0 +1,96 @@
+"""Structural COO/CSR operations (ref: raft/sparse/op/{sort,filter,reduce,
+row_op,slice}.cuh).
+
+These change nnz or ordering, so they run host-side (numpy) — the same role
+the reference's thrust sorts/scans play — and hand static-shape device
+buffers to the jitted compute layer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+
+
+def _host(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def coo_sort(coo: COOMatrix) -> COOMatrix:
+    """Sort COO entries by (row, col) (ref: sparse/op/sort.cuh `coo_sort`)."""
+    rows, cols, data = _host(coo.rows), _host(coo.cols), _host(coo.data)
+    order = np.lexsort((cols, rows))
+    return COOMatrix(jnp.asarray(rows[order]), jnp.asarray(cols[order]),
+                     jnp.asarray(data[order]), coo.shape)
+
+
+def coo_remove_scalar(coo: COOMatrix, scalar) -> COOMatrix:
+    """Drop entries equal to `scalar` (ref: sparse/op/filter.cuh
+    `coo_remove_scalar`)."""
+    rows, cols, data = _host(coo.rows), _host(coo.cols), _host(coo.data)
+    keep = data != scalar
+    return COOMatrix(jnp.asarray(rows[keep]), jnp.asarray(cols[keep]),
+                     jnp.asarray(data[keep]), coo.shape)
+
+
+def coo_remove_zeros(coo: COOMatrix) -> COOMatrix:
+    """ref: sparse/op/filter.cuh `coo_remove_zeros`."""
+    return coo_remove_scalar(coo, 0)
+
+
+def max_duplicates(coo: COOMatrix) -> COOMatrix:
+    """Merge duplicate (row, col) entries keeping the max value
+    (ref: sparse/op/reduce.cuh `max_duplicates`)."""
+    return reduce_duplicates(coo, np.maximum.reduceat)
+
+
+def sum_duplicates(coo: COOMatrix) -> COOMatrix:
+    """Merge duplicate (row, col) entries by summing (scipy-compatible
+    canonicalization; the reference exposes max via op/reduce.cuh and sums
+    inside convert/symmetrize kernels)."""
+    return reduce_duplicates(coo, np.add.reduceat)
+
+
+def reduce_duplicates(coo: COOMatrix,
+                      reduceat: Callable[[np.ndarray, np.ndarray], np.ndarray]
+                      ) -> COOMatrix:
+    """Shared dedup: sort by (row, col), segment-reduce runs of equal keys
+    (ref: sparse/op/reduce.cuh `compute_duplicates_mask` + scatter)."""
+    rows, cols, data = _host(coo.rows), _host(coo.cols), _host(coo.data)
+    if rows.shape[0] == 0:
+        return coo
+    order = np.lexsort((cols, rows))
+    rows, cols, data = rows[order], cols[order], data[order]
+    new_run = np.empty(rows.shape[0], dtype=bool)
+    new_run[0] = True
+    np.logical_or(rows[1:] != rows[:-1], cols[1:] != cols[:-1],
+                  out=new_run[1:])
+    starts = np.nonzero(new_run)[0]
+    merged = reduceat(data, starts)
+    return COOMatrix(jnp.asarray(rows[starts]), jnp.asarray(cols[starts]),
+                     jnp.asarray(merged), coo.shape)
+
+
+def csr_row_op(csr: CSRMatrix, fn) -> jnp.ndarray:
+    """Apply `fn(row_id, values_segment)` conceptually per row; here realized
+    as a vectorized map over (row_ids, data) (ref: sparse/op/row_op.cuh
+    `csr_row_op` hands each row's [start, stop) to a device lambda)."""
+    row_ids = csr.row_ids()
+    return fn(row_ids, csr.data)
+
+
+def csr_row_slice(csr: CSRMatrix, start: int, stop: int) -> CSRMatrix:
+    """Extract rows [start, stop) as a new CSR matrix
+    (ref: sparse/op/slice.cuh `csr_row_slice_indptr` /
+    `csr_row_slice_populate`)."""
+    indptr = _host(csr.indptr)
+    lo, hi = int(indptr[start]), int(indptr[stop])
+    new_indptr = (indptr[start:stop + 1] - lo).astype(indptr.dtype)
+    return CSRMatrix(jnp.asarray(new_indptr),
+                     jnp.asarray(_host(csr.indices)[lo:hi]),
+                     jnp.asarray(_host(csr.data)[lo:hi]),
+                     (stop - start, csr.n_cols))

@@ -1,0 +1,6 @@
+"""Sparse solvers: thick-restart Lanczos eigsh and Borůvka MST
+(ref: raft/sparse/solver/{lanczos,mst}.cuh).
+"""
+
+from .lanczos import LanczosConfig, eigsh, lanczos_compute_eigenpairs  # noqa: F401
+from .mst import GraphCOO, mst  # noqa: F401

@@ -1,0 +1,124 @@
+"""Borůvka minimum spanning tree / forest (ref: raft/sparse/solver/mst.cuh,
+mst_solver.cuh:32 `MST_solver`, detail/mst_solver_inl.cuh:127-131 iteration
+loop, detail/mst_kernels.cuh kernels).
+
+TPU formulation: the per-iteration hot work — "cheapest outgoing edge per
+supervertex" over all E edges — is a pair of jitted ``segment_min`` passes
+(value pass then tie-break-by-edge-id pass, replacing the reference's
+atomicMin on an alteration-uniquified weight, detail/mst_solver_inl.cuh:235).
+Supervertex merging (`merge_labels`) runs on host union-find between device
+steps; the loop count is ≤ log2(V) as in Borůvka.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.sparse_types import CSRMatrix
+
+
+@dataclasses.dataclass
+class GraphCOO:
+    """ref: mst_solver.cuh:19 `Graph_COO` {src, dst, weights, n_edges}."""
+    src: jnp.ndarray
+    dst: jnp.ndarray
+    weights: jnp.ndarray
+    n_edges: int
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _min_edge_per_color(colors, src, dst, weights, n: int):
+    """For every color c: the (weight, edge-id) minimal cross edge leaving c.
+    Two segment_min passes give a deterministic unique choice."""
+    cu = colors[src]
+    cv = colors[dst]
+    cross = cu != cv
+    big = jnp.asarray(jnp.inf, weights.dtype)
+    w = jnp.where(cross, weights, big)
+    seg_min = jax.ops.segment_min(w, cu, num_segments=n)
+    e_ids = jnp.arange(src.shape[0], dtype=jnp.int32)
+    is_min = cross & (w == seg_min[cu])
+    e_masked = jnp.where(is_min, e_ids, jnp.iinfo(jnp.int32).max)
+    seg_edge = jax.ops.segment_min(e_masked, cu, num_segments=n)
+    has_edge = seg_min < big
+    return seg_edge, has_edge
+
+
+def mst(res, csr: CSRMatrix, color: Optional[np.ndarray] = None,
+        symmetrize_output: bool = True) -> GraphCOO:
+    """MST/MSF of an undirected graph in CSR form
+    (ref: sparse/solver/mst.cuh `mst`; the input is expected symmetric, as
+    in the reference's tests).
+
+    Returns the forest as GraphCOO; `color` (if given, len V) is updated
+    in place with final supervertex labels."""
+    indptr = np.asarray(csr.indptr)
+    n = csr.n_rows
+    src_h = np.repeat(np.arange(n, dtype=np.int32), np.diff(indptr))
+    dst_h = np.asarray(csr.indices, dtype=np.int32)
+    w_h = np.asarray(csr.data)
+
+    src = jnp.asarray(src_h)
+    dst = jnp.asarray(dst_h)
+    weights = jnp.asarray(w_h)
+
+    colors = np.arange(n, dtype=np.int32) if color is None \
+        else np.asarray(color, dtype=np.int32).copy()
+
+    out_src, out_dst, out_w = [], [], []
+    max_iters = max(1, int(np.ceil(np.log2(max(n, 2)))) + 1)
+
+    for _ in range(max_iters):
+        seg_edge, has_edge = _min_edge_per_color(
+            jnp.asarray(colors), src, dst, weights, n)
+        seg_edge_h = np.asarray(seg_edge)
+        has_h = np.asarray(has_edge)
+        chosen = np.unique(seg_edge_h[has_h])
+        if chosen.size == 0:
+            break
+        eu, ev, ew = src_h[chosen], dst_h[chosen], w_h[chosen]
+
+        # union-find merge of supervertices (ref: label/merge_labels.cuh:47
+        # pointer-jumping flatten; host union-find is exact and ≤V work)
+        parent = np.arange(n, dtype=np.int32)
+
+        def find(x):
+            root = x
+            while parent[root] != root:
+                root = parent[root]
+            while parent[x] != root:
+                parent[x], x = root, parent[x]
+            return root
+
+        added_any = False
+        for u, v_, wv in zip(colors[eu], colors[ev],
+                             zip(eu, ev, ew)):
+            ru, rv = find(u), find(v_)
+            if ru != rv:
+                parent[max(ru, rv)] = min(ru, rv)
+                out_src.append(wv[0])
+                out_dst.append(wv[1])
+                out_w.append(wv[2])
+                added_any = True
+        if not added_any:
+            break
+        roots = np.array([find(c) for c in range(n)], dtype=np.int32)
+        colors = roots[colors]
+
+    if color is not None:
+        color[:] = colors
+
+    s = np.asarray(out_src, dtype=np.int32)
+    d = np.asarray(out_dst, dtype=np.int32)
+    w = np.asarray(out_w, dtype=w_h.dtype)
+    if symmetrize_output:
+        s, d, w = (np.concatenate([s, d]), np.concatenate([d, s]),
+                   np.concatenate([w, w]))
+    return GraphCOO(jnp.asarray(s), jnp.asarray(d), jnp.asarray(w),
+                    int(s.shape[0]))

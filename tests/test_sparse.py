@@ -1,0 +1,314 @@
+"""Sparse layer tests vs scipy references (mirrors the reference's SPARSE_TEST
+suite, cpp/tests/CMakeLists.txt:249-286 — convert, linalg, ops, matrix,
+solvers)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.csgraph as csgraph
+import scipy.sparse.linalg as spla
+
+from raft_tpu.core.bitset import Bitmap, Bitset
+from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
+from raft_tpu.sparse import convert, linalg, matrix, op
+from raft_tpu.sparse.solver import GraphCOO, eigsh, mst
+
+
+def _rand_csr(rng, m, n, density=0.2, dtype=np.float32):
+    mat = sp.random(m, n, density=density, random_state=rng,
+                    dtype=np.float64).astype(dtype)
+    mat.sum_duplicates()
+    return mat.tocsr()
+
+
+class TestConvert:
+    def test_csr_coo_roundtrip(self):
+        rng = np.random.RandomState(0)
+        ref = _rand_csr(rng, 23, 17)
+        csr = CSRMatrix.from_scipy(ref)
+        coo = convert.csr_to_coo(csr)
+        back = convert.sorted_coo_to_csr(coo)
+        assert (back.to_scipy() != ref).nnz == 0
+
+    def test_csr_to_dense(self):
+        rng = np.random.RandomState(1)
+        ref = _rand_csr(rng, 9, 13)
+        dense = convert.csr_to_dense(CSRMatrix.from_scipy(ref))
+        np.testing.assert_allclose(np.asarray(dense), ref.toarray(),
+                                   rtol=1e-6)
+
+    def test_dense_to_csr(self):
+        rng = np.random.RandomState(2)
+        d = rng.randn(8, 11) * (rng.rand(8, 11) > 0.6)
+        csr = convert.dense_to_csr(d.astype(np.float32))
+        np.testing.assert_allclose(csr.to_scipy().toarray(),
+                                   d.astype(np.float32), rtol=1e-6)
+
+    def test_adj_to_csr(self):
+        rng = np.random.RandomState(3)
+        adj = rng.rand(7, 7) > 0.5
+        csr = convert.adj_to_csr(adj)
+        np.testing.assert_array_equal(
+            csr.to_scipy().toarray() != 0, adj)
+
+    def test_bitmap_bitset_to_csr(self):
+        rng = np.random.RandomState(4)
+        m = rng.rand(5, 40) > 0.5
+        csr = convert.bitmap_to_csr(Bitmap.from_bool_matrix(m))
+        np.testing.assert_array_equal(csr.to_scipy().toarray() != 0, m)
+
+        row = rng.rand(40) > 0.5
+        csr2 = convert.bitset_to_csr(Bitset.from_bools(row), n_rows=3)
+        np.testing.assert_array_equal(
+            csr2.to_scipy().toarray() != 0, np.tile(row, (3, 1)))
+
+
+class TestOps:
+    def test_coo_sort_and_dedup(self):
+        rows = np.array([2, 0, 1, 0, 2], dtype=np.int32)
+        cols = np.array([1, 3, 0, 3, 1], dtype=np.int32)
+        data = np.array([5., 1., 2., 4., 7.], dtype=np.float32)
+        coo = COOMatrix(rows, cols, data, (3, 4))
+        merged = op.sum_duplicates(coo)
+        ref = sp.coo_matrix((data, (rows, cols)), shape=(3, 4)).tocsr()
+        got = convert.sorted_coo_to_csr(merged).to_scipy()
+        assert (got != ref).nnz == 0
+        maxed = op.max_duplicates(coo)
+        got_max = convert.sorted_coo_to_csr(maxed).to_scipy().toarray()
+        assert got_max[0, 3] == 4.0 and got_max[2, 1] == 7.0
+
+    def test_remove_scalar(self):
+        coo = COOMatrix(np.array([0, 1]), np.array([1, 0]),
+                        np.array([0.0, 3.0], dtype=np.float32), (2, 2))
+        out = op.coo_remove_zeros(coo)
+        assert out.nnz == 1 and float(out.data[0]) == 3.0
+
+    def test_row_slice(self):
+        rng = np.random.RandomState(5)
+        ref = _rand_csr(rng, 12, 9)
+        sliced = op.csr_row_slice(CSRMatrix.from_scipy(ref), 3, 8)
+        assert (sliced.to_scipy() != ref[3:8]).nnz == 0
+
+
+class TestLinalg:
+    def test_spmv(self):
+        rng = np.random.RandomState(6)
+        ref = _rand_csr(rng, 33, 21)
+        x = rng.randn(21).astype(np.float32)
+        y = linalg.spmv(CSRMatrix.from_scipy(ref), x)
+        np.testing.assert_allclose(np.asarray(y), ref @ x, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_spmm(self):
+        rng = np.random.RandomState(7)
+        ref = _rand_csr(rng, 19, 15)
+        b = rng.randn(15, 6).astype(np.float32)
+        c = linalg.spmm(CSRMatrix.from_scipy(ref), b)
+        np.testing.assert_allclose(np.asarray(c), ref @ b, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_sddmm(self):
+        rng = np.random.RandomState(8)
+        a = rng.randn(10, 5).astype(np.float32)
+        b = rng.randn(5, 12).astype(np.float32)
+        pat = _rand_csr(rng, 10, 12, density=0.3)
+        out = linalg.sddmm(a, b, CSRMatrix.from_scipy(pat),
+                           alpha=2.0, beta=0.5)
+        dense = 2.0 * (a @ b) * (pat.toarray() != 0) \
+            + 0.5 * pat.toarray()
+        got = out.to_scipy().toarray()
+        mask = pat.toarray() != 0
+        np.testing.assert_allclose(got[mask], dense[mask], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_masked_matmul_bitmap(self):
+        rng = np.random.RandomState(9)
+        a = rng.randn(6, 4).astype(np.float32)
+        b = rng.randn(8, 4).astype(np.float32)
+        mask = rng.rand(6, 8) > 0.4
+        out = linalg.masked_matmul(a, b, Bitmap.from_bool_matrix(mask))
+        ref = (a @ b.T) * mask
+        np.testing.assert_allclose(out.to_scipy().toarray(), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_masked_matmul_bitset(self):
+        rng = np.random.RandomState(10)
+        a = rng.randn(5, 3).astype(np.float32)
+        b = rng.randn(7, 3).astype(np.float32)
+        row = rng.rand(7) > 0.3
+        out = linalg.masked_matmul(a, b, Bitset.from_bools(row))
+        ref = (a @ b.T) * np.tile(row, (5, 1))
+        np.testing.assert_allclose(out.to_scipy().toarray(), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_csr_add(self):
+        rng = np.random.RandomState(11)
+        a = _rand_csr(rng, 9, 9)
+        b = _rand_csr(rng, 9, 9)
+        out = linalg.csr_add(CSRMatrix.from_scipy(a),
+                             CSRMatrix.from_scipy(b))
+        np.testing.assert_allclose(out.to_scipy().toarray(),
+                                   (a + b).toarray(), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_transpose(self):
+        rng = np.random.RandomState(12)
+        a = _rand_csr(rng, 7, 13)
+        out = linalg.transpose(CSRMatrix.from_scipy(a))
+        assert (out.to_scipy() != a.T.tocsr()).nnz == 0
+
+    def test_row_normalize(self):
+        rng = np.random.RandomState(13)
+        a = _rand_csr(rng, 11, 8, density=0.5)
+        a.data = np.abs(a.data)
+        out = linalg.csr_row_normalize_l1(CSRMatrix.from_scipy(a))
+        sums = np.asarray(out.to_scipy().sum(axis=1)).ravel()
+        nz = np.diff(a.indptr) > 0
+        np.testing.assert_allclose(sums[nz], 1.0, rtol=1e-5)
+
+    def test_laplacian(self):
+        rng = np.random.RandomState(14)
+        adj = _rand_csr(rng, 16, 16, density=0.2)
+        adj = adj + adj.T   # symmetric, no self loops guaranteed removed
+        adj.setdiag(0)
+        adj.eliminate_zeros()
+        lap = linalg.laplacian(CSRMatrix.from_scipy(adj))
+        ref = csgraph.laplacian(adj.astype(np.float64))
+        np.testing.assert_allclose(lap.to_scipy().toarray(),
+                                   ref.toarray(), rtol=1e-4, atol=1e-5)
+
+    def test_laplacian_normalized(self):
+        rng = np.random.RandomState(15)
+        adj = _rand_csr(rng, 12, 12, density=0.3)
+        adj = adj + adj.T
+        adj.setdiag(0)
+        adj.eliminate_zeros()
+        adj.data = np.abs(adj.data)
+        lap = linalg.laplacian_normalized(CSRMatrix.from_scipy(adj))
+        ref = csgraph.laplacian(adj.astype(np.float64), normed=True)
+        np.testing.assert_allclose(lap.to_scipy().toarray(),
+                                   ref.toarray(), rtol=1e-4, atol=1e-4)
+
+    def test_symmetrize(self):
+        rng = np.random.RandomState(16)
+        a = _rand_csr(rng, 10, 10)
+        coo = convert.csr_to_coo(CSRMatrix.from_scipy(a))
+        out = linalg.coo_symmetrize(coo)
+        ref = (a + a.T).toarray()
+        got = convert.sorted_coo_to_csr(op.coo_sort(out)) \
+            .to_scipy().toarray()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_degree(self):
+        rng = np.random.RandomState(17)
+        a = _rand_csr(rng, 9, 9)
+        coo = convert.csr_to_coo(CSRMatrix.from_scipy(a))
+        deg = linalg.coo_degree(coo)
+        np.testing.assert_array_equal(np.asarray(deg),
+                                      np.diff(a.indptr))
+
+
+class TestMatrix:
+    def test_select_k_csr(self, res):
+        rng = np.random.RandomState(18)
+        ref = _rand_csr(rng, 14, 30, density=0.4)
+        vals, idx = matrix.select_k(res, CSRMatrix.from_scipy(ref), k=3,
+                                    select_min=True)
+        dense = ref.toarray()
+        dense[dense == 0] = np.inf
+        order = np.argsort(dense, axis=1)[:, :3]
+        expect = np.take_along_axis(dense, order, axis=1)
+        got = np.asarray(vals)
+        finite = np.isfinite(expect)
+        np.testing.assert_allclose(got[finite], expect[finite],
+                                   rtol=1e-5)
+        gi = np.asarray(idx)
+        np.testing.assert_array_equal(gi[finite], order[finite])
+
+    def test_diagonal(self):
+        rng = np.random.RandomState(19)
+        a = _rand_csr(rng, 8, 8, density=0.5)
+        d = matrix.diagonal(CSRMatrix.from_scipy(a))
+        np.testing.assert_allclose(np.asarray(d), a.diagonal(),
+                                   rtol=1e-6)
+
+    def test_set_diagonal(self):
+        rng = np.random.RandomState(20)
+        a = _rand_csr(rng, 8, 8, density=0.6)
+        out = matrix.set_diagonal(CSRMatrix.from_scipy(a), 9.0)
+        got = out.to_scipy().toarray()
+        refd = a.toarray()
+        mask = np.eye(8, dtype=bool) & (refd != 0)
+        assert np.all(got[mask] == 9.0)
+
+    def test_tfidf(self):
+        # ref formula: tf = log(v), idf = log(n_rows/featCount + 1)
+        rows = np.array([0, 0, 1, 2], dtype=np.int32)
+        cols = np.array([0, 1, 0, 2], dtype=np.int32)
+        vals = np.array([2., 3., 1., 5.], dtype=np.float32)
+        coo = COOMatrix(rows, cols, vals, (3, 3))
+        out = np.asarray(matrix.encode_tfidf(coo))
+        feat = np.array([2, 1, 1])
+        expect = np.log(vals) * np.log(3 / feat[cols] + 1)
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_bm25(self):
+        rows = np.array([0, 0, 1, 2], dtype=np.int32)
+        cols = np.array([0, 1, 0, 2], dtype=np.int32)
+        vals = np.array([2., 3., 1., 5.], dtype=np.float32)
+        coo = COOMatrix(rows, cols, vals, (3, 3))
+        k1, b = 1.6, 0.75
+        out = np.asarray(matrix.encode_bm25(coo, k1, b))
+        feat = np.array([2, 1, 1])
+        row_len = np.array([5., 1., 5.])
+        avg = 11.0 / 3
+        tf = np.log(vals)
+        idf = np.log(3 / feat[cols] + 1)
+        bm = ((k1 + 1) * tf) / (
+            k1 * ((1 - b) + b * row_len[rows] / avg) + tf)
+        np.testing.assert_allclose(out, idf * bm, rtol=1e-5)
+
+
+class TestSolvers:
+    def _sym_psd(self, rng, n, density=0.15):
+        a = sp.random(n, n, density=density, random_state=rng,
+                      dtype=np.float64)
+        a = a + a.T + sp.eye(n) * 5.0
+        return a.tocsr().astype(np.float32)
+
+    @pytest.mark.parametrize("which", ["SA", "LA", "LM", "SM"])
+    def test_eigsh_vs_scipy(self, which):
+        rng = np.random.RandomState(21)
+        a = self._sym_psd(rng, 120)
+        k = 4
+        vals, vecs = eigsh(CSRMatrix.from_scipy(a), k=k, which=which,
+                           tol=1e-6, seed=7)
+        ref_vals = spla.eigsh(a.astype(np.float64), k=k, which=which,
+                              return_eigenvectors=False)
+        np.testing.assert_allclose(np.sort(np.asarray(vals)),
+                                   np.sort(ref_vals), rtol=2e-3,
+                                   atol=2e-3)
+        # residual check ‖Av − λv‖
+        av = a @ np.asarray(vecs)
+        lv = np.asarray(vecs) * np.asarray(vals)[None, :]
+        assert np.linalg.norm(av - lv) < 5e-2
+
+    def test_mst_total_weight(self, res):
+        rng = np.random.RandomState(22)
+        n = 40
+        dense = rng.rand(n, n)
+        dense = np.triu(dense, 1)
+        dense = dense + dense.T
+        adj = sp.csr_matrix(dense * (dense < 0.3))
+        # ensure connectivity via a ring
+        ring = sp.coo_matrix(
+            (np.full(n, 0.5), (np.arange(n), (np.arange(n) + 1) % n)),
+            shape=(n, n))
+        adj = (adj + ring + ring.T).tocsr().astype(np.float32)
+        colors = np.zeros(n, dtype=np.int32)
+        out = mst(res, CSRMatrix.from_scipy(adj), color=np.arange(n, dtype=np.int32))
+        assert isinstance(out, GraphCOO)
+        got_w = float(np.sum(np.asarray(out.weights))) / 2.0
+        ref = csgraph.minimum_spanning_tree(adj.astype(np.float64))
+        np.testing.assert_allclose(got_w, ref.sum(), rtol=1e-5)
+        assert out.n_edges == 2 * (n - 1)
