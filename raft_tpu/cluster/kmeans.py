@@ -156,6 +156,14 @@ def _kmeans_plus_plus(state: RngState, x, n_clusters: int,
             continue
         new_pts = x[jnp.asarray(picked)]
         cand.append(np.asarray(new_pts))
+        # Pad the candidate batch to a power-of-two bucket (rows duplicated;
+        # duplicates don't change the min) so _min_d2_update sees O(log)
+        # distinct shapes across rounds instead of recompiling every round.
+        bucket = 1 << (int(picked.size) - 1).bit_length()
+        if bucket != picked.size:
+            pad = jnp.broadcast_to(new_pts[:1],
+                                   (bucket - picked.size, x.shape[1]))
+            new_pts = jnp.concatenate([new_pts, pad], axis=0)
         d2 = _min_d2_update(x, new_pts, d2)
 
     cand_np = np.concatenate(cand, axis=0)
@@ -177,10 +185,14 @@ def _kmeans_plus_plus(state: RngState, x, n_clusters: int,
 
 def _init_centroids(params: KMeansParams, state: RngState, x,
                     centroids: Optional[jnp.ndarray]):
-    if params.init == KMeansInit.ARRAY:
-        if centroids is None:
-            raise ValueError("init=ARRAY requires centroids")
+    # An explicitly supplied centroid array always wins (warm start),
+    # regardless of params.init — matching the reference's behavior where a
+    # caller-provided centroids buffer with init=Array is the only way to
+    # pass one and passing one implies using it.
+    if centroids is not None:
         return jnp.asarray(centroids, x.dtype)
+    if params.init == KMeansInit.ARRAY:
+        raise ValueError("init=ARRAY requires centroids")
     if params.init == KMeansInit.RANDOM:
         idx = jax.random.choice(state.next_key(), x.shape[0],
                                 (params.n_clusters,), replace=False)

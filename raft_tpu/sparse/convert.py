@@ -21,14 +21,21 @@ def _host(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def _counts_to_indptr(rows: np.ndarray, n_rows: int,
+                      dtype=np.int32) -> np.ndarray:
+    """Row-occurrence counts → CSR indptr (shared by every *_to_csr)."""
+    counts = np.bincount(rows, minlength=n_rows)
+    indptr = np.zeros(n_rows + 1, dtype=dtype)
+    np.cumsum(counts, out=indptr[1:])
+    return indptr
+
+
 def sorted_coo_to_csr(coo: COOMatrix) -> CSRMatrix:
     """Row-sorted COO → CSR (ref: sparse/convert/csr.cuh `sorted_coo_to_csr`).
 
     The rows array must already be sorted (use op.coo_sort first)."""
     rows = _host(coo.rows)
-    counts = np.bincount(rows, minlength=coo.n_rows)
-    indptr = np.zeros(coo.n_rows + 1, dtype=rows.dtype)
-    np.cumsum(counts, out=indptr[1:])
+    indptr = _counts_to_indptr(rows, coo.n_rows, dtype=rows.dtype)
     return CSRMatrix(jnp.asarray(indptr), jnp.asarray(coo.cols),
                      jnp.asarray(coo.data), coo.shape)
 
@@ -49,9 +56,7 @@ def dense_to_csr(dense, tol: float = 0.0) -> CSRMatrix:
     d = _host(dense)
     mask = np.abs(d) > tol
     rows, cols = np.nonzero(mask)
-    counts = np.bincount(rows, minlength=d.shape[0])
-    indptr = np.zeros(d.shape[0] + 1, dtype=np.int32)
-    np.cumsum(counts, out=indptr[1:])
+    indptr = _counts_to_indptr(rows, d.shape[0])
     return CSRMatrix(jnp.asarray(indptr), jnp.asarray(cols.astype(np.int32)),
                      jnp.asarray(d[rows, cols]), d.shape)
 
@@ -70,9 +75,7 @@ def adj_to_csr(adj, row_ind: Optional[np.ndarray] = None) -> CSRMatrix:
     (ref: sparse/convert/csr.cuh `adj_to_csr`, detail/adj_to_csr.cuh)."""
     a = _host(adj).astype(bool)
     rows, cols = np.nonzero(a)
-    counts = np.bincount(rows, minlength=a.shape[0])
-    indptr = np.zeros(a.shape[0] + 1, dtype=np.int32)
-    np.cumsum(counts, out=indptr[1:])
+    indptr = _counts_to_indptr(rows, a.shape[0])
     data = np.ones(rows.shape[0], dtype=np.float32)
     return CSRMatrix(jnp.asarray(indptr), jnp.asarray(cols.astype(np.int32)),
                      jnp.asarray(data), a.shape)

@@ -30,7 +30,7 @@ def select_k(res, csr: CSRMatrix, k: int, select_min: bool = True,
     max_len = max(int(row_len.max()) if row_len.size else 0, k)
     n_rows = csr.n_rows
 
-    dtype = np.asarray(csr.data).dtype
+    dtype = np.dtype(csr.data.dtype)
     pad_val = np.inf if select_min else -np.inf
     if not np.issubdtype(dtype, np.floating):
         info = np.iinfo(dtype)

@@ -14,10 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
-
-
-def _host(x) -> np.ndarray:
-    return np.asarray(x)
+from raft_tpu.sparse.convert import _host
 
 
 def coo_sort(coo: COOMatrix) -> COOMatrix:

@@ -58,9 +58,8 @@ def mst(res, csr: CSRMatrix, color: Optional[np.ndarray] = None,
 
     Returns the forest as GraphCOO; `color` (if given, len V) is updated
     in place with final supervertex labels."""
-    indptr = np.asarray(csr.indptr)
     n = csr.n_rows
-    src_h = np.repeat(np.arange(n, dtype=np.int32), np.diff(indptr))
+    src_h = np.asarray(csr.row_ids(), dtype=np.int32)
     dst_h = np.asarray(csr.indices, dtype=np.int32)
     w_h = np.asarray(csr.data)
 

@@ -1,0 +1,57 @@
+"""Statistics and metrics layer (ref: cpp/include/raft/stats/ — SURVEY.md §2.8).
+
+Every primitive is a pure jnp function, jit-composable and shardable. Where
+the reference uses bespoke CUDA kernels (histogram smem strategies, O(n^2)
+rand-index pair counting), the TPU design reformulates the computation as
+matmul / segment-sum / sort primitives that XLA tiles onto the MXU:
+
+- histogram          -> clipped-bin scatter-add (one-hot matmul for small bins)
+- contingency matrix -> 2-D scatter-add; rand/ARI/MI/V-measure derive from it
+  in closed form instead of pair-counting kernels
+- silhouette/trustworthiness -> tiled pairwise-distance reductions on the
+  fused contraction kernel layer (rebuilt here since the reference moved its
+  copies to cuVS; stats/silhouette_score.cuh, stats/trustworthiness_score.cuh
+  are vestigial upstream)
+"""
+
+from raft_tpu.stats.moments import (  # noqa: F401
+    mean,
+    stddev,
+    vars_,
+    sum_,
+    meanvar,
+    mean_center,
+    mean_add,
+    minmax,
+    cov,
+    weighted_mean,
+    row_weighted_mean,
+    col_weighted_mean,
+)
+from raft_tpu.stats.histogram import HistType, histogram  # noqa: F401
+from raft_tpu.stats.information import (  # noqa: F401
+    entropy,
+    kl_divergence,
+    IC_Type,
+    information_criterion_batched,
+    cluster_dispersion,
+)
+from raft_tpu.stats.clustering_metrics import (  # noqa: F401
+    contingency_matrix,
+    rand_index,
+    adjusted_rand_index,
+    mutual_info_score,
+    homogeneity_score,
+    completeness_score,
+    v_measure,
+    silhouette_score,
+)
+from raft_tpu.stats.regression_metrics import (  # noqa: F401
+    accuracy,
+    r2_score,
+    regression_metrics,
+)
+from raft_tpu.stats.neighborhood import (  # noqa: F401
+    neighborhood_recall,
+    trustworthiness_score,
+)

@@ -1,0 +1,167 @@
+"""External clustering metrics (ref: raft/stats/{contingency_matrix,
+rand_index,adjusted_rand_index,mutual_info_score,homogeneity_score,
+completeness_score,v_measure,silhouette_score}.cuh).
+
+TPU-first design note: the reference's ``rand_index`` launches an
+O(n^2/2) pair-counting kernel (stats/detail/rand_index.cuh) and the
+entropy-family metrics each walk a contingency matrix with bespoke kernels.
+Here *one* scatter-add contingency matrix feeds every metric in closed form
+— the pair counts a/b/c/d are algebraic functions of the contingency table,
+so no quadratic work is needed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _num_classes(arr, n=None):
+    if n is not None:
+        return int(n)
+    return int(jnp.max(arr)) + 1
+
+
+def contingency_matrix(y_true, y_pred, n_classes_true: int = None,
+                       n_classes_pred: int = None):
+    """(n_true, n_pred) label co-occurrence counts via one scatter-add.
+    Labels are assumed monotonic from 0 (use raft_tpu.label.make_monotonic
+    first, exactly like the reference's workflow).
+    Ref: stats/contingency_matrix.cuh."""
+    y_true = jnp.asarray(y_true)
+    y_pred = jnp.asarray(y_pred)
+    nt = _num_classes(y_true, n_classes_true)
+    np_ = _num_classes(y_pred, n_classes_pred)
+    flat = y_true.astype(jnp.int32) * np_ + y_pred.astype(jnp.int32)
+    out = jnp.zeros((nt * np_,), jnp.result_type(int))
+    out = out.at[flat].add(1)
+    return out.reshape(nt, np_)
+
+
+def _comb2(x):
+    x = x.astype(jnp.result_type(float))
+    return x * (x - 1.0) / 2.0
+
+
+def rand_index(y_a, y_b):
+    """Rand index. Closed form over the contingency table (equivalent to the
+    reference's O(n^2) pair kernel, stats/detail/rand_index.cuh which the
+    header itself flags for this optimisation)."""
+    c = contingency_matrix(y_a, y_b)
+    n = jnp.asarray(y_a).shape[0]
+    sum_ij = jnp.sum(_comb2(c))
+    sum_a = jnp.sum(_comb2(jnp.sum(c, axis=1)))
+    sum_b = jnp.sum(_comb2(jnp.sum(c, axis=0)))
+    total = _comb2(jnp.asarray(n))
+    agreements = total + 2.0 * sum_ij - sum_a - sum_b
+    return agreements / total
+
+
+def adjusted_rand_index(y_a, y_b):
+    """Corrected-for-chance Rand index. Ref: stats/adjusted_rand_index.cuh."""
+    c = contingency_matrix(y_a, y_b)
+    n = jnp.asarray(y_a).shape[0]
+    sum_ij = jnp.sum(_comb2(c))
+    sum_a = jnp.sum(_comb2(jnp.sum(c, axis=1)))
+    sum_b = jnp.sum(_comb2(jnp.sum(c, axis=0)))
+    total = _comb2(jnp.asarray(n))
+    expected = sum_a * sum_b / total
+    max_index = 0.5 * (sum_a + sum_b)
+    denom = max_index - expected
+    # All-singleton / single-cluster degenerate cases: perfect agreement.
+    return jnp.where(denom == 0, 1.0, (sum_ij - expected) / denom)
+
+
+def mutual_info_score(y_a, y_b, n_classes: int = None):
+    """Mutual information (natural log) between two labelings.
+    Ref: stats/mutual_info_score.cuh."""
+    c = contingency_matrix(y_a, y_b, n_classes, n_classes).astype(jnp.result_type(float))
+    n = jnp.sum(c)
+    pij = c / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    logterm = jnp.where(pij > 0, jnp.log(pij / (pi * pj)), 0.0)
+    return jnp.sum(pij * logterm)
+
+
+def _conditional_entropy(c):
+    """H(rows | cols) from a contingency matrix."""
+    n = jnp.sum(c)
+    pj = jnp.sum(c, axis=0)  # marginal of the conditioning labels
+    pij = c / n
+    ratio = jnp.where(c > 0, c / pj[None, :], 1.0)
+    return -jnp.sum(jnp.where(c > 0, pij * jnp.log(ratio), 0.0))
+
+
+def _label_entropy(counts, n):
+    p = counts / n
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+
+
+def homogeneity_score(y_true, y_pred, n_classes: int = None):
+    """1 - H(C|K)/H(C): each predicted cluster contains members of a single
+    class. Ref: stats/homogeneity_score.cuh."""
+    c = contingency_matrix(y_true, y_pred, n_classes, n_classes).astype(
+        jnp.result_type(float))
+    n = jnp.sum(c)
+    h_c = _label_entropy(jnp.sum(c, axis=1), n)
+    h_ck = _conditional_entropy(c)
+    return jnp.where(h_c == 0, 1.0, 1.0 - h_ck / h_c)
+
+
+def completeness_score(y_true, y_pred, n_classes: int = None):
+    """Homogeneity with roles swapped. Ref: stats/completeness_score.cuh."""
+    return homogeneity_score(y_pred, y_true, n_classes)
+
+
+def v_measure(y_true, y_pred, n_classes: int = None, beta: float = 1.0):
+    """Weighted harmonic mean of homogeneity and completeness.
+    Ref: stats/v_measure.cuh (beta default 1.0)."""
+    h = homogeneity_score(y_true, y_pred, n_classes)
+    c = completeness_score(y_true, y_pred, n_classes)
+    denom = beta * h + c
+    return jnp.where(denom == 0, 0.0, (1.0 + beta) * h * c / denom)
+
+
+def silhouette_score(res, x, labels, n_clusters: int, metric=None,
+                     chunk: int = 4096):
+    """Mean silhouette coefficient s(i) = (b-a)/max(a,b).
+
+    Rebuilt from the distance layer (the reference's silhouette_score.cuh is
+    vestigial after the cuVS migration — SURVEY.md §2.8). Per-point mean
+    distance to every cluster comes from one (chunked) pairwise-distance
+    matrix times a cluster one-hot — a single MXU contraction per chunk —
+    rather than a per-pair atomic kernel.
+    """
+    from raft_tpu.distance.pairwise import pairwise_distance, DistanceType
+
+    if metric is None:
+        metric = DistanceType.L2Unexpanded
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    n = x.shape[0]
+    onehot = (labels[:, None] == jnp.arange(n_clusters)[None, :]).astype(
+        x.dtype)                                   # (n, k)
+    counts = jnp.sum(onehot, axis=0)               # (k,)
+
+    sil_sum = jnp.zeros((), x.dtype)
+    for start in range(0, n, chunk):
+        xb = x[start:start + chunk]
+        lb = labels[start:start + chunk]
+        d = pairwise_distance(res, xb, x, metric=metric)   # (b, n)
+        cluster_sums = d @ onehot                          # (b, k)
+        own = counts[lb]
+        # a: mean distance to own cluster, excluding self (distance 0).
+        a = jnp.where(own > 1,
+                      cluster_sums[jnp.arange(xb.shape[0]), lb]
+                      / jnp.maximum(own - 1, 1),
+                      0.0)
+        mean_other = cluster_sums / jnp.maximum(counts[None, :], 1)
+        mean_other = jnp.where(
+            (jnp.arange(n_clusters)[None, :] == lb[:, None])
+            | (counts[None, :] == 0),
+            jnp.inf, mean_other)
+        b = jnp.min(mean_other, axis=1)
+        s = jnp.where(own > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30),
+                      0.0)
+        sil_sum = sil_sum + jnp.sum(s)
+    return sil_sum / n

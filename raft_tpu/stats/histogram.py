@@ -1,0 +1,78 @@
+"""Per-column histogram (ref: raft/stats/histogram.cuh, detail/histogram.cuh).
+
+The reference ships nine CUDA strategies (smem bit-packed atomics, gmem
+atomics, match_any, smem hash — stats/stats_types.hpp:22-52) chosen by
+``HistType``. On TPU there are no atomics to tune: a histogram is a
+scatter-add (XLA lowers jnp.add.at-style segment sums efficiently) or, for
+small bin counts, a one-hot matmul that rides the MXU. We keep the
+``HistType`` vocabulary for API parity; every member maps onto the same two
+TPU formulations with ``HistTypeAuto`` picking by n_bins.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+
+class HistType(enum.Enum):
+    """API-parity enum (ref: stats_types.hpp:22-52). On TPU all smem/gmem
+    atomic strategies collapse to scatter-add; small-bin cases use the
+    one-hot matmul path."""
+
+    SmemBits1 = 1
+    SmemBits2 = 2
+    SmemBits4 = 4
+    SmemBits8 = 8
+    SmemBits16 = 16
+    Gmem = "gmem"
+    Smem = "smem"
+    SmemMatchAny = "smem_match_any"
+    SmemHash = "smem_hash"
+    Auto = "auto"
+
+
+# Below this many bins a one-hot (n, bins) matmul against ones is cheaper
+# than scatter: it is a single MXU-friendly contraction with no serialization.
+_ONEHOT_BIN_LIMIT = 512
+
+
+def histogram(data, n_bins: int, binner=None,
+              hist_type: HistType = HistType.Auto):
+    """Per-column histogram of ``data`` (n_rows, n_cols) -> (n_bins, n_cols).
+
+    ``binner(value, row, col)`` maps a value to its bin (default: identity
+    cast to int, the reference's default IdentityBinner). Out-of-range bins
+    are dropped, matching the reference's bounds check.
+    """
+    if data.ndim == 1:
+        data = data[:, None]
+    n_rows, n_cols = data.shape
+
+    if binner is None:
+        bins = data.astype(jnp.int32)
+    else:
+        rows = jnp.arange(n_rows)[:, None]
+        cols = jnp.arange(n_cols)[None, :]
+        bins = binner(data, rows, cols).astype(jnp.int32)
+
+    valid = (bins >= 0) & (bins < n_bins)
+
+    use_onehot = hist_type is not HistType.Gmem and (
+        hist_type is not HistType.Auto or n_bins <= _ONEHOT_BIN_LIMIT
+    )
+    if use_onehot and n_bins <= _ONEHOT_BIN_LIMIT:
+        # (n_bins, n_rows) x (n_rows, n_cols) contraction per column via
+        # broadcasting: one_hot is (n_rows, n_cols, n_bins).
+        onehot = (bins[..., None] == jnp.arange(n_bins)[None, None, :])
+        onehot = jnp.where(valid[..., None], onehot, False)
+        return jnp.sum(onehot, axis=0, dtype=jnp.int32).T
+
+    # Scatter-add path: flatten (bin, col) into a single segment id.
+    clipped = jnp.clip(bins, 0, n_bins - 1)
+    flat_ids = clipped * n_cols + jnp.arange(n_cols)[None, :]
+    weights = valid.astype(jnp.int32)
+    out = jnp.zeros((n_bins * n_cols,), jnp.int32)
+    out = out.at[flat_ids.reshape(-1)].add(weights.reshape(-1))
+    return out.reshape(n_bins, n_cols)

@@ -1,0 +1,77 @@
+"""Information-theoretic stats and model-selection criteria
+(ref: raft/stats/{entropy,kl_divergence,information_criterion,dispersion}.cuh).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+from raft_tpu.stats.histogram import histogram
+
+
+def entropy(labels, lower: int = None, upper: int = None):
+    """Shannon entropy (natural log) of an integer label array whose values
+    lie in [lower, upper). Ref: stats/entropy.cuh (detail builds a histogram
+    then reduces -p log p)."""
+    labels = jnp.asarray(labels)
+    if lower is None:
+        lower = 0
+    n_classes = int(upper - lower) if upper is not None else int(
+        jnp.max(labels)) + 1 - lower
+    counts = histogram(labels - lower, n_classes)[:, 0]
+    n = labels.shape[0]
+    p = counts.astype(jnp.result_type(float)) / n
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+
+
+def kl_divergence(p, q):
+    """KL(P || Q) = sum p log(p/q), skipping p==0 terms (ref:
+    stats/kl_divergence.cuh detail op)."""
+    p = jnp.asarray(p)
+    q = jnp.asarray(q)
+    return jnp.sum(jnp.where(p > 0, p * jnp.log(p / q), 0.0))
+
+
+class IC_Type(enum.Enum):
+    """Ref: stats_types.hpp IC_Type {AIC, AICc, BIC}."""
+
+    AIC = "aic"
+    AICc = "aicc"
+    BIC = "bic"
+
+
+def information_criterion_batched(loglikelihood, ic_type: IC_Type,
+                                  n_params: int, n_samples: int):
+    """Penalised log-likelihood per batch member (ref:
+    stats/information_criterion.cuh, detail/batched/information_criterion.cuh:
+    IC = -2 ll + penalty; AICc adds the small-sample correction)."""
+    ll = jnp.asarray(loglikelihood)
+    k = n_params
+    n = n_samples
+    if ic_type is IC_Type.AIC:
+        penalty = 2.0 * k
+    elif ic_type is IC_Type.AICc:
+        penalty = 2.0 * k + (2.0 * k * (k + 1)) / (n - k - 1)
+    elif ic_type is IC_Type.BIC:
+        penalty = jnp.log(jnp.asarray(float(n))) * k
+    else:  # pragma: no cover - exhaustive enum
+        raise ValueError(f"unknown IC type {ic_type}")
+    return -2.0 * ll + penalty
+
+
+def cluster_dispersion(centroids, cluster_sizes, n_points: int = None):
+    """Weighted RMS spread of cluster centroids about the size-weighted
+    global centroid: sqrt(sum_i n_i ||c_i - mu||^2), mu = sum_i n_i c_i / N.
+    Useful for choosing k. Ref: stats/dispersion.cuh,
+    detail/dispersion.cuh:47-131 (weightedMeanKernel + dispersionKernel,
+    final sqrt on host)."""
+    centroids = jnp.asarray(centroids)
+    sizes = jnp.asarray(cluster_sizes)
+    if n_points is None:
+        n_points = jnp.sum(sizes)
+    mu = jnp.sum(centroids * sizes[:, None].astype(centroids.dtype),
+                 axis=0) / n_points
+    d2 = jnp.sum((centroids - mu[None, :]) ** 2, axis=1)
+    return jnp.sqrt(jnp.sum(d2 * sizes.astype(centroids.dtype)))

@@ -1,0 +1,102 @@
+"""First/second-moment statistics (ref: raft/stats/{mean,stddev,sum,meanvar,
+mean_center,minmax,cov,weighted_mean}.cuh).
+
+The reference reduces along rows or columns with bespoke coalesced/strided
+kernels; here every reduction is a jnp reduction XLA maps onto the VPU/MXU.
+All functions take ``axis`` (0 = per-column stats over rows, the reference's
+default layout) and are jit-safe.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def mean(x, axis: int = 0):
+    """Per-column (axis=0) or per-row (axis=1) mean. Ref: stats/mean.cuh."""
+    return jnp.mean(x, axis=axis)
+
+
+def sum_(x, axis: int = 0):
+    """Column/row sums. Ref: stats/sum.cuh."""
+    return jnp.sum(x, axis=axis)
+
+
+def vars_(x, mu=None, axis: int = 0, sample: bool = True):
+    """Variance about ``mu`` (computed if None). ``sample`` divides by n-1.
+    Ref: stats/stddev.cuh (vars overloads)."""
+    n = x.shape[axis]
+    if mu is None:
+        mu = jnp.mean(x, axis=axis)
+    centered = x - jnp.expand_dims(mu, axis)
+    denom = (n - 1) if sample else n
+    return jnp.sum(centered * centered, axis=axis) / denom
+
+
+def stddev(x, mu=None, axis: int = 0, sample: bool = True):
+    """Standard deviation. Ref: stats/stddev.cuh."""
+    return jnp.sqrt(vars_(x, mu=mu, axis=axis, sample=sample))
+
+
+def meanvar(x, axis: int = 0, sample: bool = True):
+    """Single-pass mean+variance pair. Ref: stats/meanvar.cuh."""
+    mu = jnp.mean(x, axis=axis)
+    return mu, vars_(x, mu=mu, axis=axis, sample=sample)
+
+
+def mean_center(x, mu=None, axis: int = 0):
+    """Subtract the mean along ``axis``. Ref: stats/mean_center.cuh."""
+    if mu is None:
+        mu = jnp.mean(x, axis=axis)
+    return x - jnp.expand_dims(mu, axis)
+
+
+def mean_add(x, mu, axis: int = 0):
+    """Add a mean vector back (inverse of mean_center). Ref: mean_center.cuh."""
+    return x + jnp.expand_dims(mu, axis)
+
+
+def minmax(x, axis: int = 0, rows=None, row_ids=None):
+    """Per-column (min, max). Optional ``row_ids`` restricts to a sampled row
+    subset, mirroring the reference's sampledRows path. Ref: stats/minmax.cuh."""
+    if row_ids is not None:
+        x = jnp.take(x, row_ids, axis=0)
+    elif rows is not None:
+        x = x[:rows]
+    return jnp.min(x, axis=axis), jnp.max(x, axis=axis)
+
+
+def cov(x, mu=None, sample: bool = True, center: bool = True):
+    """Covariance matrix of row-sample data ``x`` (n, d) -> (d, d).
+
+    One dot_general on the MXU instead of the reference's gemm-over-centered
+    buffer (stats/cov.cuh; it optionally destroys the input by centering
+    in place — we stay functional).
+    """
+    n = x.shape[0]
+    if center:
+        if mu is None:
+            mu = jnp.mean(x, axis=0)
+        x = x - mu[None, :]
+    denom = (n - 1) if sample else n
+    return (x.T @ x) / denom
+
+
+def weighted_mean(x, weights, axis: int = 0):
+    """Weighted mean along ``axis``; ``weights`` has length x.shape[axis].
+    Ref: stats/weighted_mean.cuh (weighted_mean)."""
+    w = jnp.asarray(weights)
+    wsum = jnp.sum(w)
+    return jnp.tensordot(w, x, axes=([0], [axis])) / wsum
+
+
+def row_weighted_mean(x, weights):
+    """Per-row weighted mean over columns (weights: ncols).
+    Ref: stats/weighted_mean.cuh (row_weighted_mean)."""
+    return weighted_mean(x, weights, axis=1)
+
+
+def col_weighted_mean(x, weights):
+    """Per-column weighted mean over rows (weights: nrows).
+    Ref: stats/weighted_mean.cuh (col_weighted_mean)."""
+    return weighted_mean(x, weights, axis=0)

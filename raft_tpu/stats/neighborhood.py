@@ -1,0 +1,74 @@
+"""Neighborhood-preservation metrics (ref: raft/stats/neighborhood_recall.cuh
+and the vestigial stats/trustworthiness_score.cuh, rebuilt from this repo's
+distance + select_k layers per SURVEY.md §2.8)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def neighborhood_recall(indices, ref_indices, distances=None,
+                        ref_distances=None, eps: float = 1e-4):
+    """Fraction of k-NN indices matching a reference k-NN result.
+
+    When distances are supplied, an index mismatch still counts if the
+    distances coincide within ``eps`` (tie handling, mirroring
+    stats/neighborhood_recall.cuh:77-162's distance-equality fallback).
+    """
+    idx = jnp.asarray(indices)
+    ref = jnp.asarray(ref_indices)
+    n, k = idx.shape
+    # (n, k, k) membership test: is idx[i, j] anywhere in ref[i, :]?
+    match = idx[:, :, None] == ref[:, None, :]
+    if distances is not None and ref_distances is not None:
+        d = jnp.asarray(distances)
+        rd = jnp.asarray(ref_distances)
+        tie = jnp.abs(d[:, :, None] - rd[:, None, :]) <= eps
+        match = match | tie
+    hits = jnp.sum(jnp.any(match, axis=2).astype(jnp.result_type(float)))
+    return hits / (n * k)
+
+
+def trustworthiness_score(res, x, x_embedded, n_neighbors: int,
+                          metric=None, batch_size: int = 512):
+    """Trustworthiness of a low-dimensional embedding:
+
+        T = 1 - 2/(n k (2n - 3k - 1)) * sum_i sum_{j in kNN_emb(i)}
+                max(0, rank_orig(i, j) - k)
+
+    where rank_orig is 1-based among non-self points. Ranks come from
+    comparison counting (#points strictly closer) on chunked
+    pairwise-distance rows — no (n, n) argsort materialised, one broadcast
+    reduction per chunk. Ref: stats/trustworthiness_score.cuh (vestigial
+    upstream; formula per its cuML lineage).
+    """
+    from raft_tpu.distance.pairwise import pairwise_distance, DistanceType
+
+    if metric is None:
+        metric = DistanceType.L2SqrtUnexpanded
+    x = jnp.asarray(x)
+    emb = jnp.asarray(x_embedded)
+    n = x.shape[0]
+    k = n_neighbors
+
+    penalty = jnp.zeros((), jnp.result_type(float))
+    for start in range(0, n, batch_size):
+        xb = x[start:start + batch_size]
+        eb = emb[start:start + batch_size]
+        b = xb.shape[0]
+        rows = jnp.arange(b)
+
+        d_emb = pairwise_distance(res, eb, emb, metric=metric)   # (b, n)
+        d_emb = d_emb.at[rows, start + rows].set(jnp.inf)        # drop self
+        _, nn_emb = jax.lax.top_k(-d_emb, k)                     # (b, k)
+
+        d_orig = pairwise_distance(res, xb, x, metric=metric)    # (b, n)
+        self_d = d_orig[rows, start + rows]
+        d_nn = jnp.take_along_axis(d_orig, nn_emb, axis=1)       # (b, k)
+        closer = d_orig[:, None, :] < d_nn[:, :, None]           # (b, k, n)
+        rank0 = jnp.sum(closer, axis=2)                          # 0-based,
+        rank0 = rank0 - (self_d[:, None] < d_nn)                 # self out
+        rank1 = rank0.astype(jnp.result_type(float)) + 1.0                  # 1-based
+        penalty = penalty + jnp.sum(jnp.maximum(rank1 - k, 0.0))
+    return 1.0 - penalty * (2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0)))

@@ -1,0 +1,43 @@
+"""Classification/regression scoring (ref: raft/stats/{accuracy,r2_score,
+regression_metrics}.cuh)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def accuracy(predictions, ref_predictions):
+    """Fraction of exact matches. Ref: stats/accuracy.cuh."""
+    p = jnp.asarray(predictions)
+    r = jnp.asarray(ref_predictions)
+    return jnp.mean((p == r).astype(jnp.result_type(float)))
+
+
+def r2_score(y, y_hat):
+    """Coefficient of determination 1 - SS_res/SS_tot.
+    Ref: stats/r2_score.cuh."""
+    y = jnp.asarray(y)
+    y_hat = jnp.asarray(y_hat)
+    mu = jnp.mean(y)
+    ss_tot = jnp.sum((y - mu) ** 2)
+    ss_res = jnp.sum((y - y_hat) ** 2)
+    return 1.0 - ss_res / ss_tot
+
+
+def regression_metrics(predictions, ref_predictions):
+    """(mean_abs_error, mean_squared_error, median_abs_error).
+
+    Median via sort (TPU-friendly; the reference uses a cub device sort +
+    midpoint pick, stats/detail/scores.cuh). Ref: stats/regression_metrics.cuh.
+    """
+    p = jnp.asarray(predictions, dtype=jnp.result_type(float))
+    r = jnp.asarray(ref_predictions, dtype=jnp.result_type(float))
+    err = p - r
+    abs_err = jnp.abs(err)
+    mae = jnp.mean(abs_err)
+    mse = jnp.mean(err * err)
+    s = jnp.sort(abs_err)
+    n = s.shape[0]
+    medae = jnp.where(n % 2 == 1, s[n // 2],
+                      0.5 * (s[n // 2 - 1] + s[n // 2]))
+    return mae, mse, medae

@@ -1,0 +1,260 @@
+"""Stats layer tests vs NumPy/SciPy-style references
+(ref test models: cpp/tests/stats/*)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import stats
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+class TestMoments:
+    def test_mean_sum_stddev(self, rng):
+        x = rng.normal(size=(200, 8)).astype(np.float64)
+        np.testing.assert_allclose(np.asarray(stats.mean(x)), x.mean(0),
+                                   rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(stats.sum_(x, axis=1)),
+                                   x.sum(1), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(stats.stddev(x)),
+                                   x.std(0, ddof=1), rtol=1e-10)
+        np.testing.assert_allclose(
+            np.asarray(stats.stddev(x, sample=False)), x.std(0), rtol=1e-10)
+
+    def test_meanvar_center_add(self, rng):
+        x = rng.normal(size=(64, 5))
+        mu, var = stats.meanvar(x)
+        np.testing.assert_allclose(np.asarray(mu), x.mean(0), rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(var), x.var(0, ddof=1),
+                                   rtol=1e-10)
+        c = stats.mean_center(x)
+        np.testing.assert_allclose(np.asarray(c), x - x.mean(0), rtol=1e-12)
+        back = stats.mean_add(c, mu)
+        np.testing.assert_allclose(np.asarray(back), x, rtol=1e-12)
+
+    def test_minmax(self, rng):
+        x = rng.normal(size=(100, 4))
+        lo, hi = stats.minmax(x)
+        np.testing.assert_allclose(np.asarray(lo), x.min(0))
+        np.testing.assert_allclose(np.asarray(hi), x.max(0))
+        ids = np.array([0, 5, 9])
+        lo, hi = stats.minmax(x, row_ids=ids)
+        np.testing.assert_allclose(np.asarray(lo), x[ids].min(0))
+
+    def test_cov(self, rng):
+        x = rng.normal(size=(300, 6))
+        np.testing.assert_allclose(np.asarray(stats.cov(x)),
+                                   np.cov(x, rowvar=False), rtol=1e-10)
+
+    def test_weighted_mean(self, rng):
+        x = rng.normal(size=(50, 7))
+        w_rows = rng.uniform(0.1, 1.0, size=50)
+        w_cols = rng.uniform(0.1, 1.0, size=7)
+        np.testing.assert_allclose(
+            np.asarray(stats.col_weighted_mean(x, w_rows)),
+            (x * w_rows[:, None]).sum(0) / w_rows.sum(), rtol=1e-12)
+        np.testing.assert_allclose(
+            np.asarray(stats.row_weighted_mean(x, w_cols)),
+            (x * w_cols[None, :]).sum(1) / w_cols.sum(), rtol=1e-12)
+
+
+class TestHistogram:
+    @pytest.mark.parametrize("hist_type", [stats.HistType.Auto,
+                                           stats.HistType.Gmem])
+    def test_identity_binner(self, rng, hist_type):
+        data = rng.integers(0, 16, size=(500, 3))
+        h = np.asarray(stats.histogram(data, 16, hist_type=hist_type))
+        expect = np.stack([np.bincount(data[:, c], minlength=16)
+                           for c in range(3)], axis=1)
+        np.testing.assert_array_equal(h, expect)
+
+    def test_out_of_range_dropped(self):
+        data = np.array([[-1], [0], [1], [99]])
+        h = np.asarray(stats.histogram(data, 2))
+        np.testing.assert_array_equal(h[:, 0], [1, 1])
+
+    def test_custom_binner(self, rng):
+        data = rng.uniform(0.0, 1.0, size=(400, 2))
+        h = np.asarray(stats.histogram(
+            data, 10, binner=lambda v, r, c: (v * 10).astype(np.int32)))
+        expect = np.stack([np.histogram(data[:, c], bins=10,
+                                        range=(0, 1))[0]
+                           for c in range(2)], axis=1)
+        np.testing.assert_array_equal(h, expect)
+
+
+class TestInformation:
+    def test_entropy(self, rng):
+        labels = rng.integers(0, 5, size=1000)
+        p = np.bincount(labels, minlength=5) / 1000
+        expect = -np.sum(p[p > 0] * np.log(p[p > 0]))
+        got = float(stats.entropy(labels, lower=0, upper=5))
+        np.testing.assert_allclose(got, expect, rtol=1e-10)
+
+    def test_kl_divergence(self, rng):
+        p = rng.uniform(0.1, 1.0, size=50)
+        p /= p.sum()
+        q = rng.uniform(0.1, 1.0, size=50)
+        q /= q.sum()
+        got = float(stats.kl_divergence(p, q))
+        np.testing.assert_allclose(got, np.sum(p * np.log(p / q)),
+                                   rtol=1e-10)
+
+    @pytest.mark.parametrize("ic,expect_penalty", [
+        (stats.IC_Type.AIC, 2.0 * 3),
+        (stats.IC_Type.BIC, np.log(100) * 3),
+        (stats.IC_Type.AICc, 2.0 * 3 + (2.0 * 3 * 4) / (100 - 3 - 1)),
+    ])
+    def test_information_criterion(self, ic, expect_penalty):
+        ll = np.array([-50.0, -42.0])
+        got = np.asarray(stats.information_criterion_batched(ll, ic, 3, 100))
+        np.testing.assert_allclose(got, -2 * ll + expect_penalty, rtol=1e-12)
+
+    def test_cluster_dispersion(self, rng):
+        k, d = 8, 4
+        centroids = rng.normal(size=(k, d))
+        sizes = rng.integers(10, 100, size=k)
+        n = sizes.sum()
+        mu = (centroids * sizes[:, None]).sum(0) / n
+        expect = np.sqrt(np.sum(sizes * ((centroids - mu) ** 2).sum(1)))
+        got = float(stats.cluster_dispersion(centroids, sizes))
+        np.testing.assert_allclose(got, expect, rtol=1e-10)
+
+
+class TestClusteringMetrics:
+    def test_contingency(self):
+        a = np.array([0, 0, 1, 1, 2])
+        b = np.array([1, 1, 0, 0, 0])
+        c = np.asarray(stats.contingency_matrix(a, b))
+        np.testing.assert_array_equal(c, [[0, 2], [2, 0], [1, 0]])
+
+    def test_rand_index_perfect_and_known(self):
+        a = np.array([0, 0, 1, 1])
+        assert float(stats.rand_index(a, a)) == pytest.approx(1.0)
+        b = np.array([0, 1, 0, 1])
+        # pairs: C(4,2)=6; agreements = both-diff pairs = 4 -> RI = 1/3...
+        # compute directly: disagree pairs are (0,1),(2,3) same-in-a diff-in-b
+        # and (0,2),(1,3) diff-in-a same-in-b -> 4 disagreements, RI = 2/6.
+        assert float(stats.rand_index(a, b)) == pytest.approx(2.0 / 6.0)
+
+    def test_ari_matches_sklearn_formula(self, rng):
+        a = rng.integers(0, 4, size=500)
+        b = rng.integers(0, 3, size=500)
+        got = float(stats.adjusted_rand_index(a, b))
+        # independent labelings -> ARI near 0
+        assert abs(got) < 0.05
+        assert float(stats.adjusted_rand_index(a, a)) == pytest.approx(1.0)
+        # label-permutation invariance
+        perm = np.array([2, 0, 3, 1])
+        assert float(stats.adjusted_rand_index(a, perm[a])) == pytest.approx(
+            1.0)
+
+    def test_mutual_info_and_vmeasure(self, rng):
+        a = rng.integers(0, 4, size=400)
+        # identical labelings: MI == H, h = c = v = 1
+        mi = float(stats.mutual_info_score(a, a))
+        h_a = float(stats.entropy(a, lower=0, upper=4))
+        np.testing.assert_allclose(mi, h_a, rtol=1e-8)
+        assert float(stats.homogeneity_score(a, a)) == pytest.approx(1.0)
+        assert float(stats.completeness_score(a, a)) == pytest.approx(1.0)
+        assert float(stats.v_measure(a, a)) == pytest.approx(1.0)
+        # singleton clusters: perfectly homogeneous, poorly complete
+        singletons = np.arange(400)
+        assert float(stats.homogeneity_score(
+            a, singletons, n_classes=400)) == pytest.approx(1.0)
+        assert float(stats.completeness_score(
+            a, singletons, n_classes=400)) < 0.6
+
+    def test_silhouette(self, res):
+        # two well-separated blobs -> silhouette near 1
+        rng = np.random.default_rng(0)
+        x0 = rng.normal(size=(50, 2)) * 0.1
+        x1 = rng.normal(size=(50, 2)) * 0.1 + 10.0
+        x = np.vstack([x0, x1]).astype(np.float32)
+        labels = np.repeat([0, 1], 50)
+        s = float(stats.silhouette_score(res, x, labels, n_clusters=2))
+        assert s > 0.95
+        # random labels -> near 0
+        s_bad = float(stats.silhouette_score(
+            res, x, rng.integers(0, 2, size=100), n_clusters=2))
+        assert s_bad < 0.2
+
+
+class TestRegressionMetrics:
+    def test_accuracy(self):
+        p = np.array([1, 2, 3, 4])
+        r = np.array([1, 2, 0, 4])
+        assert float(stats.accuracy(p, r)) == pytest.approx(0.75)
+
+    def test_r2(self, rng):
+        y = rng.normal(size=100)
+        noise = rng.normal(size=100) * 0.1
+        yh = y + noise
+        expect = 1 - np.sum((y - yh) ** 2) / np.sum((y - y.mean()) ** 2)
+        np.testing.assert_allclose(float(stats.r2_score(y, yh)), expect,
+                                   rtol=1e-10)
+
+    @pytest.mark.parametrize("n", [99, 100])
+    def test_regression_metrics(self, rng, n):
+        p = rng.normal(size=n)
+        r = rng.normal(size=n)
+        mae, mse, medae = stats.regression_metrics(p, r)
+        np.testing.assert_allclose(float(mae), np.abs(p - r).mean(),
+                                   rtol=1e-10)
+        np.testing.assert_allclose(float(mse), ((p - r) ** 2).mean(),
+                                   rtol=1e-10)
+        np.testing.assert_allclose(float(medae), np.median(np.abs(p - r)),
+                                   rtol=1e-10)
+
+
+class TestNeighborhood:
+    def test_recall_perfect_and_partial(self):
+        idx = np.array([[0, 1, 2], [3, 4, 5]])
+        assert float(stats.neighborhood_recall(idx, idx)) == pytest.approx(
+            1.0)
+        ref = np.array([[0, 1, 9], [9, 9, 9]])
+        assert float(stats.neighborhood_recall(idx, ref)) == pytest.approx(
+            2.0 / 6.0)
+
+    def test_recall_distance_ties(self):
+        idx = np.array([[0, 1]])
+        ref = np.array([[0, 7]])  # index mismatch at slot 1
+        d = np.array([[0.0, 1.0]])
+        rd = np.array([[0.0, 1.0]])  # but identical distance -> tie counts
+        assert float(stats.neighborhood_recall(
+            idx, ref, distances=d, ref_distances=rd)) == pytest.approx(1.0)
+
+    def test_trustworthiness_identity_embedding(self, res, rng):
+        x = rng.normal(size=(120, 5)).astype(np.float32)
+        t = float(stats.trustworthiness_score(res, x, x, n_neighbors=7))
+        assert t == pytest.approx(1.0, abs=1e-6)
+
+    def test_trustworthiness_vs_sklearn_formula(self, res, rng):
+        # reference implementation in numpy
+        x = rng.normal(size=(80, 6))
+        emb = rng.normal(size=(80, 2))
+        k = 5
+        n = 80
+
+        def knn_ranks(data):
+            d = np.sqrt(((data[:, None, :] - data[None, :, :]) ** 2).sum(-1))
+            np.fill_diagonal(d, np.inf)
+            order = np.argsort(d, axis=1)
+            ranks = np.empty_like(order)
+            rows = np.arange(n)[:, None]
+            ranks[rows, order] = np.arange(n - 1 + 1)[None, :]
+            return d, order, ranks
+
+        _, _, ranks_orig = knn_ranks(x)
+        d_emb, order_emb, _ = knn_ranks(emb)
+        nn_emb = order_emb[:, :k]
+        rank1 = ranks_orig[np.arange(n)[:, None], nn_emb] + 1
+        penalty = np.maximum(rank1 - k, 0).sum()
+        expect = 1 - penalty * 2.0 / (n * k * (2 * n - 3 * k - 1))
+
+        got = float(stats.trustworthiness_score(res, x, emb, n_neighbors=k,
+                                                batch_size=32))
+        np.testing.assert_allclose(got, expect, rtol=1e-6)
