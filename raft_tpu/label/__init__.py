@@ -1,0 +1,8 @@
+"""Label utilities (ref: cpp/include/raft/label/ — SURVEY.md §2.10)."""
+
+from raft_tpu.label.classlabels import (  # noqa: F401
+    get_unique_labels,
+    get_ovr_labels,
+    make_monotonic,
+)
+from raft_tpu.label.merge_labels import MAX_LABEL, merge_labels  # noqa: F401

@@ -1,0 +1,51 @@
+"""Class-label utilities (ref: raft/label/classlabels.cuh,
+detail/classlabels.cuh).
+
+The reference sorts + uniques on device (thrust) and maps via a linear-scan
+kernel; here unique extraction is a host-side sort (label cardinality is
+tiny) and the mapping is a device ``searchsorted`` — one vectorized binary
+search instead of an O(n_classes) scan per element.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_unique_labels(labels):
+    """Sorted unique labels (ref: classlabels.cuh `getUniquelabels`)."""
+    return jnp.asarray(np.unique(np.asarray(labels)))
+
+
+def get_ovr_labels(labels, unique_labels, idx: int):
+    """One-vs-rest relabeling: +1 where label == unique_labels[idx], else -1
+    (ref: classlabels.cuh:55 `getOvrlabels`,
+    detail/classlabels.cuh:96-106)."""
+    n_classes = unique_labels.shape[0]
+    if idx >= n_classes:
+        raise ValueError(
+            f"idx ({idx}) must be < number of classes ({n_classes})")
+    labels = jnp.asarray(labels)
+    return jnp.where(labels == unique_labels[idx], 1, -1).astype(labels.dtype)
+
+
+def make_monotonic(labels, filter_op: Optional[Callable] = None,
+                   zero_based: bool = False):
+    """Map labels onto a monotonically increasing set (ref:
+    classlabels.cuh:81 `make_monotonic`, detail/classlabels.cuh:114-168).
+
+    Values for which ``filter_op`` returns True are passed through unchanged
+    (the reference kernel leaves them untouched). Labels start at 1 unless
+    ``zero_based``.
+    """
+    labels = jnp.asarray(labels)
+    uniq = get_unique_labels(labels)
+    ranks = jnp.searchsorted(uniq, labels) + (0 if zero_based else 1)
+    ranks = ranks.astype(labels.dtype)
+    if filter_op is not None:
+        keep = filter_op(labels)
+        return jnp.where(keep, labels, ranks)
+    return ranks

@@ -1,0 +1,70 @@
+"""Union-find label merging (ref: raft/label/merge_labels.cuh:47,
+detail/merge_labels.cuh — the kernel used by MST and connected components).
+
+Two labelings A and B over points 0..N-1 are merged: where ``mask`` is true,
+label a_i and b_i are equivalent and both groups get the smaller label.
+
+The reference flattens a union-find forest with three kernels iterated until
+a device flag settles. The TPU design expresses one flattening round as pure
+scatter-min + gather (jit-able, fixed shapes) and iterates on the host until
+the fixed point — the iteration count is O(log N) because path-halving
+doubles the flattened depth each round.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Sentinel for unlabelled points (ref: MAX_LABEL in detail/merge_labels.cuh).
+MAX_LABEL = np.iinfo(np.int32).max
+
+
+@jax.jit
+def _merge_round(r, labels_a, labels_b, mask):
+    """One equivalence-propagation round over the label map ``r``
+    (size N+1: label value -> representative; labels are 1-based)."""
+    a = labels_a
+    b = labels_b
+    ra = r[a]
+    rb = r[b]
+    lo = jnp.minimum(ra, rb)
+    # where mask: representative of both a- and b-labels becomes min
+    safe_a = jnp.where(mask, a, 0)
+    safe_b = jnp.where(mask, b, 0)
+    upd = jnp.where(mask, lo, MAX_LABEL)
+    r = r.at[safe_a].min(upd)
+    r = r.at[safe_b].min(upd)
+    # path halving: r = r[r]
+    r = r.at[1:].set(jnp.minimum(r[1:], r[r[1:]]))
+    return r
+
+
+def merge_labels(labels_a, labels_b, mask):
+    """Merged labels (new array; the reference updates labels_a in place).
+
+    Labels take values 1..N; MAX_LABEL marks unlabelled points, which must
+    have mask False (ref contract, merge_labels.cuh:17-45).
+    """
+    a = jnp.asarray(labels_a).astype(jnp.int32)
+    b = jnp.asarray(labels_b).astype(jnp.int32)
+    mask = jnp.asarray(mask)
+    n = a.shape[0]
+
+    # r[v] = current representative of label value v (identity to start).
+    # Index 0 is a scratch slot for masked-off scatter targets.
+    r = jnp.arange(n + 1, dtype=jnp.int32)
+
+    prev = None
+    # O(log N) rounds suffice (path halving); cap defensively.
+    for _ in range(max(2, int(np.ceil(np.log2(n + 1))) + 2)):
+        r = _merge_round(r, a, b, mask)
+        cur = np.asarray(r)
+        if prev is not None and np.array_equal(cur, prev):
+            break
+        prev = cur
+
+    out = jnp.where(a == MAX_LABEL, MAX_LABEL, r[jnp.where(
+        a == MAX_LABEL, 0, a)])
+    return out.astype(jnp.asarray(labels_a).dtype)

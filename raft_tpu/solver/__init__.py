@@ -1,0 +1,6 @@
+"""Combinatorial solvers (ref: cpp/include/raft/solver/ — SURVEY.md §2.10)."""
+
+from raft_tpu.solver.linear_assignment import (  # noqa: F401
+    LinearAssignmentProblem,
+    solve_linear_assignment,
+)

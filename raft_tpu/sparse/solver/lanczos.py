@@ -78,6 +78,9 @@ def _eigsh_csr(csr: CSRMatrix, cfg: LanczosConfig, v0) -> Tuple:
     k = cfg.n_components
     if k <= 0 or k >= n:
         raise ValueError(f"need 0 < n_components < n, got {k} vs {n}")
+    if cfg.max_iterations < 1:
+        raise ValueError(
+            f"max_iterations must be >= 1, got {cfg.max_iterations}")
     ncv = cfg.ncv if cfg.ncv else min(n, max(2 * k + 1, 20))
     ncv = min(max(ncv, k + 2), n)
     which = cfg.which

@@ -1,0 +1,12 @@
+"""Spectral graph analysis (ref: cpp/include/raft/spectral/ — SURVEY.md §2.7).
+
+The reference retains the partition/modularity *analyzers* (the spectral
+clustering driver moved to cuVS); both are provided here, plus the matrix
+wrappers' semantics (Laplacian / modularity operators) expressed as pure
+functions over the sparse layer.
+"""
+
+from raft_tpu.spectral.analyzers import (  # noqa: F401
+    analyze_partition,
+    analyze_modularity,
+)

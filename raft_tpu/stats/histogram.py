@@ -59,10 +59,7 @@ def histogram(data, n_bins: int, binner=None,
 
     valid = (bins >= 0) & (bins < n_bins)
 
-    use_onehot = hist_type is not HistType.Gmem and (
-        hist_type is not HistType.Auto or n_bins <= _ONEHOT_BIN_LIMIT
-    )
-    if use_onehot and n_bins <= _ONEHOT_BIN_LIMIT:
+    if hist_type is not HistType.Gmem and n_bins <= _ONEHOT_BIN_LIMIT:
         # (n_bins, n_rows) x (n_rows, n_cols) contraction per column via
         # broadcasting: one_hot is (n_rows, n_cols, n_bins).
         onehot = (bins[..., None] == jnp.arange(n_bins)[None, None, :])

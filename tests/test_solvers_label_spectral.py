@@ -1,0 +1,205 @@
+"""Tests for label utilities, LAP solver, and spectral analyzers
+(ref test models: cpp/tests/label/*, cpp/tests/lap/lap.cu,
+cpp/tests/linalg/eigen_solvers.cu karate-club fixture)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from raft_tpu import label as rlabel
+from raft_tpu import spectral
+from raft_tpu.solver import LinearAssignmentProblem, solve_linear_assignment
+from raft_tpu.sparse import convert
+
+
+# Zachary karate club edges (public domain fixture; the reference embeds the
+# same graph in tests/linalg/eigen_solvers.cu:50-67).
+_KARATE_EDGES = [
+    (0, 1), (0, 2), (0, 3), (0, 4), (0, 5), (0, 6), (0, 7), (0, 8), (0, 10),
+    (0, 11), (0, 12), (0, 13), (0, 17), (0, 19), (0, 21), (0, 31), (1, 2),
+    (1, 3), (1, 7), (1, 13), (1, 17), (1, 19), (1, 21), (1, 30), (2, 3),
+    (2, 7), (2, 8), (2, 9), (2, 13), (2, 27), (2, 28), (2, 32), (3, 7),
+    (3, 12), (3, 13), (4, 6), (4, 10), (5, 6), (5, 10), (5, 16), (6, 16),
+    (8, 30), (8, 32), (8, 33), (9, 33), (13, 33), (14, 32), (14, 33),
+    (15, 32), (15, 33), (18, 32), (18, 33), (19, 33), (20, 32), (20, 33),
+    (22, 32), (22, 33), (23, 25), (23, 27), (23, 29), (23, 32), (23, 33),
+    (24, 25), (24, 27), (24, 31), (25, 31), (26, 29), (26, 33), (27, 33),
+    (28, 31), (28, 33), (29, 32), (29, 33), (30, 32), (30, 33), (31, 32),
+    (31, 33), (32, 33),
+]
+
+
+def karate_csr():
+    n = 34
+    a = np.zeros((n, n), np.float32)
+    for i, j in _KARATE_EDGES:
+        a[i, j] = a[j, i] = 1.0
+    return convert.dense_to_csr(a), a
+
+
+class TestLabel:
+    def test_get_unique(self):
+        y = np.array([5, 1, 5, 3, 1, 9])
+        u = np.asarray(rlabel.get_unique_labels(y))
+        np.testing.assert_array_equal(u, [1, 3, 5, 9])
+
+    def test_ovr(self):
+        y = np.array([1, 3, 5, 3, 1])
+        u = rlabel.get_unique_labels(y)
+        out = np.asarray(rlabel.get_ovr_labels(y, u, 1))   # class 3
+        np.testing.assert_array_equal(out, [-1, 1, -1, 1, -1])
+        with pytest.raises(ValueError):
+            rlabel.get_ovr_labels(y, u, 5)
+
+    @pytest.mark.parametrize("zero_based,base", [(False, 1), (True, 0)])
+    def test_make_monotonic(self, zero_based, base):
+        y = np.array([10, 30, 10, 50, 30])
+        out = np.asarray(rlabel.make_monotonic(y, zero_based=zero_based))
+        np.testing.assert_array_equal(out, np.array([0, 1, 0, 2, 1]) + base)
+
+    def test_make_monotonic_filtered(self):
+        y = np.array([-1, 10, 30, -1, 10])
+        out = np.asarray(rlabel.make_monotonic(
+            y, filter_op=lambda v: v < 0, zero_based=True))
+        # -1 passes through; unique set is {-1,10,30} so 10->1, 30->2
+        np.testing.assert_array_equal(out, [-1, 1, 2, -1, 1])
+
+    def test_merge_labels_connected_components(self):
+        # two labelings of 6 points; groups (by label value, 1-based):
+        # A: {0,1}=1, {2,3}=3, {4,5}=5 ; B: {1,2}=2, {3}=4, {0}=1,{4}=5,{5}=6
+        a = np.array([1, 1, 3, 3, 5, 5], np.int32)
+        b = np.array([1, 2, 2, 4, 5, 6], np.int32)
+        mask = np.ones(6, bool)
+        out = np.asarray(rlabel.merge_labels(a, b, mask))
+        # point1/point2 bridge groups 1 and 3 -> all of {0,1,2,3} get label 1
+        assert out[0] == out[1] == out[2] == out[3] == 1
+        assert out[4] == out[5] == 5
+
+    def test_merge_labels_masked(self):
+        a = np.array([1, 1, 3, 3], np.int32)
+        b = np.array([1, 2, 2, 4], np.int32)
+        mask = np.array([True, False, False, True])  # no bridge via point 1/2
+        out = np.asarray(rlabel.merge_labels(a, b, mask))
+        assert out[0] == out[1] == 1
+        assert out[2] == out[3] == 3
+
+
+def _brute_force_lap(cost):
+    n = cost.shape[0]
+    best, best_perm = np.inf, None
+    for perm in itertools.permutations(range(n)):
+        v = cost[np.arange(n), perm].sum()
+        if v < best:
+            best, best_perm = v, perm
+    return best, np.asarray(best_perm)
+
+
+class TestLAP:
+    def test_small_exact(self, res):
+        rng = np.random.default_rng(3)
+        for _ in range(5):
+            cost = rng.integers(0, 20, size=(6, 6)).astype(np.float32)
+            row, total = solve_linear_assignment(res, cost, epsilon=0.01)
+            expect, _ = _brute_force_lap(cost)
+            assert float(total) == pytest.approx(expect)
+            # assignment is a permutation
+            assert sorted(np.asarray(row).tolist()) == list(range(6))
+
+    def test_batched_class_api(self, res):
+        rng = np.random.default_rng(11)
+        batch, n = 4, 8
+        costs = rng.integers(0, 50, size=(batch, n, n)).astype(np.float32)
+        lap = LinearAssignmentProblem(res, n, batch, epsilon=0.01)
+        rows, cols = lap.solve(costs)
+        for b in range(batch):
+            expect, _ = _brute_force_lap(costs[b])
+            got = float(lap.get_primal_objective_value(b))
+            assert got == pytest.approx(expect)
+            # row/col assignments are inverse permutations
+            r = np.asarray(rows[b])
+            c = np.asarray(cols[b])
+            np.testing.assert_array_equal(c[r], np.arange(n))
+            # duality gap within n*eps
+            dual = float(lap.get_dual_objective_value(b))
+            assert abs(dual - got) <= n * 0.01 + 1e-3
+
+    def test_large_magnitude_f32_costs(self, res):
+        # regression: costs at 1e5 magnitude with epsilon below f32 ulp
+        # used to stall the bidding and return -1 assignments
+        rng = np.random.default_rng(0)
+        cost = rng.integers(0, 10, (16, 16)).astype(np.float32) * 1e5
+        row, total = solve_linear_assignment(res, cost, epsilon=1e-6)
+        assert sorted(np.asarray(row).tolist()) == list(range(16))
+        scipy_opt = pytest.importorskip("scipy.optimize")
+        ri, ci = scipy_opt.linear_sum_assignment(cost)
+        assert float(total) == pytest.approx(cost[ri, ci].sum())
+
+    def test_size_one(self, res):
+        row, total = solve_linear_assignment(res, np.array([[3.0]]))
+        assert int(row[0]) == 0 and float(total) == 3.0
+
+    def test_identity_like(self, res):
+        # strongly diagonal-dominant cost -> identity assignment
+        n = 10
+        cost = np.full((n, n), 100.0, np.float32)
+        np.fill_diagonal(cost, 1.0)
+        row, total = solve_linear_assignment(res, cost)
+        np.testing.assert_array_equal(np.asarray(row), np.arange(n))
+        assert float(total) == pytest.approx(n * 1.0)
+
+
+class TestSpectral:
+    def test_partition_two_cliques(self, res):
+        # two 4-cliques joined by one edge; the natural partition cuts 1 edge
+        n = 8
+        a = np.zeros((n, n), np.float32)
+        for grp in (range(0, 4), range(4, 8)):
+            for i in grp:
+                for j in grp:
+                    if i != j:
+                        a[i, j] = 1.0
+        a[3, 4] = a[4, 3] = 1.0
+        csr = convert.dense_to_csr(a)
+        clusters = np.repeat([0, 1], 4)
+        edge_cut, cost = spectral.analyze_partition(res, csr, 2, clusters)
+        assert float(edge_cut) == pytest.approx(1.0)
+        # ratio cut: each side has cut weight 1, size 4 -> 1/4 + 1/4
+        assert float(cost) == pytest.approx(0.5)
+
+    def test_modularity_karate(self, res):
+        csr, a = karate_csr()
+        # ground-truth two-faction split of the karate club
+        faction2 = {8, 9, 14, 15, 18, 20, 22, 23, 24, 25, 26, 27, 28, 29,
+                    30, 31, 32, 33}
+        clusters = np.array([1 if i in faction2 else 0 for i in range(34)])
+        q = float(spectral.analyze_modularity(res, csr, 2, clusters))
+        # the true faction split has strong positive modularity
+        assert 0.3 < q < 0.45
+        # reference numpy computation
+        deg = a.sum(1)
+        two_m = deg.sum()
+        b = a - np.outer(deg, deg) / two_m
+        h = np.eye(2)[clusters]
+        expect = np.trace(h.T @ b @ h) / two_m
+        assert q == pytest.approx(expect, rel=1e-5)
+
+    def test_modularity_single_cluster_zero(self, res):
+        csr, _ = karate_csr()
+        q = float(spectral.analyze_modularity(res, csr, 1,
+                                              np.zeros(34, np.int32)))
+        assert q == pytest.approx(0.0, abs=1e-6)
+
+    def test_partition_matches_numpy_laplacian(self, res):
+        csr, a = karate_csr()
+        rng = np.random.default_rng(0)
+        clusters = rng.integers(0, 3, size=34)
+        edge_cut, cost = spectral.analyze_partition(res, csr, 3, clusters)
+        lap = np.diag(a.sum(1)) - a
+        h = np.eye(3)[clusters]
+        quad = np.diag(h.T @ lap @ h)
+        sizes = h.sum(0)
+        np.testing.assert_allclose(float(edge_cut), quad.sum() / 2,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(cost), (quad / sizes).sum(),
+                                   rtol=1e-5)
