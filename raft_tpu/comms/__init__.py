@@ -49,3 +49,10 @@ from raft_tpu.comms.test_suite import (  # noqa: F401
     perform_test_comms_device_multicast_sendrecv,
     perform_test_comm_split,
 )
+from raft_tpu.comms.bootstrap import (  # noqa: F401
+    Comms,
+    initialize_distributed,
+    inject_comms_on_handle,
+    local_handle,
+    get_raft_comm_state,
+)

@@ -1,0 +1,146 @@
+"""Cluster bootstrap in the shape of raft-dask's ``Comms`` (ref:
+python/raft-dask/raft_dask/common/comms.py:28-233 `Comms`,
+:236 `local_handle`, :257 `get_raft_comm_state`,
+comms_utils.pyx:248-317 `inject_comms_on_handle*`).
+
+TPU-native translation of the bootstrap dance (SURVEY.md §3.3): where the
+reference places an NCCL uniqueId, rendezvouses every Dask worker on it and
+injects a `std_comms` into each worker's handle, here the "cluster" is the
+device mesh XLA already knows about — `jax.distributed.initialize` (on
+multi-host) or the local device set (single-host) — so ``init()`` builds a
+Mesh, creates one handle per participating rank and injects a `MeshComms`
+rank view into each. Session registry semantics (sessionId keys, per-rank
+state dicts, idempotent destroy) mirror the reference so downstream
+"rank loop" algorithms port directly.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from raft_tpu.core import logger
+from raft_tpu.core import resources as core_res
+from raft_tpu.comms.comms import MeshComms
+
+# sessionId -> {"comms": Comms, "handles": {rank: Resources}, ...}
+# (ref: comms.py:257 get_raft_comm_state's per-worker state dict)
+_session_state: dict = {}
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host process-group init — the analogue of the NCCL-uniqueId
+    broadcast (comms.py:126-142): on TPU pods, `jax.distributed.initialize`
+    wires every host into one XLA runtime; afterwards `jax.devices()`
+    spans the whole slice. No-op if already initialized."""
+    try:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    except RuntimeError as e:
+        # Only the benign re-init case may be swallowed; a coordinator
+        # timeout (XlaRuntimeError is a RuntimeError subclass) must
+        # propagate or the job would silently run single-host.
+        if "already" in str(e).lower():
+            logger.debug("jax.distributed already initialized: %s", e)
+        else:
+            raise
+
+
+def inject_comms_on_handle(handle, mesh: Mesh, axis_name: str, rank: int,
+                           _shared: Optional[dict] = None,
+                           _mailbox=None) -> MeshComms:
+    """Create a rank view of the clique communicator and set it on the
+    handle (ref: comms_utils.pyx:278-317 → build_comms_nccl_ucx →
+    resource::set_comms)."""
+    comms = MeshComms(mesh, axis_name=axis_name, rank=rank,
+                      _mailbox=_mailbox, _shared=_shared)
+    core_res.set_mesh(handle, mesh)
+    core_res.set_comms(handle, comms)
+    return comms
+
+
+class Comms:
+    """Initializes and manages an SPMD communicator clique over the mesh
+    (ref: raft_dask comms.py:28 `Comms`; comms_p2p there toggles UCX —
+    here host p2p always works through the MeshComms mailbox).
+    """
+
+    def __init__(self, devices=None, axis_name: str = "world",
+                 verbose: bool = False, nccl_root_location: str = "n/a"):
+        self.sessionId = uuid.uuid4().bytes
+        self._axis_name = axis_name
+        self._devices = devices
+        self._verbose = verbose
+        self.nccl_root_location = nccl_root_location  # accepted for parity
+        self._initialized = False
+
+    # -- lifecycle (ref: comms.py:161 init, :210 destroy) -------------------
+
+    def init(self, devices=None):
+        """Build the mesh and one injected handle per rank.
+
+        ``devices``: explicit device list (defaults to all of
+        ``jax.devices()``), standing in for the reference's dask worker
+        list (comms.py:161's `workers`).
+        """
+        if self._initialized:
+            logger.warn("Comms have already been initialized.")
+            return
+        devs = list(devices if devices is not None
+                    else (self._devices or jax.devices()))
+        mesh = Mesh(np.asarray(devs), axis_names=(self._axis_name,))
+        nranks = len(devs)
+
+        shared = None
+        mailbox = None
+        handles = {}
+        comms_views = {}
+        for rank in range(nranks):
+            handle = core_res.Resources()
+            view = inject_comms_on_handle(
+                handle, mesh, self._axis_name, rank,
+                _shared=shared, _mailbox=mailbox)
+            # all rank views share one mailbox + compiled-collective cache
+            shared = view._shared
+            mailbox = view._mailbox
+            handles[rank] = handle
+            comms_views[rank] = view
+
+        _session_state[self.sessionId] = {
+            "comms": self,
+            "mesh": mesh,
+            "nranks": nranks,
+            "handles": handles,
+            "comms_views": comms_views,
+        }
+        self._initialized = True
+        if self._verbose:
+            logger.info("Initialized comms session over %d devices", nranks)
+
+    def destroy(self):
+        """Tear the session down (ref: comms.py:210-233)."""
+        if not self._initialized:
+            return
+        _session_state.pop(self.sessionId, None)
+        self._initialized = False
+
+    def __del__(self):
+        self.destroy()
+
+
+def local_handle(sessionId, rank: int = 0):
+    """Simple helper to retrieve the rank's handle for a comms session
+    (ref: comms.py:236 `local_handle`)."""
+    state = _session_state.get(sessionId)
+    return None if state is None else state["handles"].get(rank)
+
+
+def get_raft_comm_state(sessionId):
+    """Per-session state dict (ref: comms.py:257)."""
+    return _session_state.get(sessionId, {})

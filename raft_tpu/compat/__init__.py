@@ -1,0 +1,21 @@
+"""pylibraft-shaped Python parity layer (ref: python/pylibraft/ —
+SURVEY.md §2.12).
+
+Gives a pylibraft user the same vocabulary on TPU: ``Handle`` /
+``DeviceResources``, ``device_ndarray``, ``eigsh``, ``rmat``, output
+auto-conversion (``set_output_as``) and the interruptible bridge — all
+backed by jax.Array instead of CUDA device memory.
+"""
+
+from raft_tpu.compat.common import (  # noqa: F401
+    DeviceResources,
+    Handle,
+    ai_wrapper,
+    auto_sync_handle,
+    device_ndarray,
+)
+from raft_tpu.compat.config import set_output_as  # noqa: F401
+from raft_tpu.compat.outputs import auto_convert_output  # noqa: F401
+from raft_tpu.compat.interruptible import interruptible  # noqa: F401
+from raft_tpu.compat.random_api import rmat  # noqa: F401
+from raft_tpu.compat.sparse_api import eigsh  # noqa: F401

@@ -1,0 +1,143 @@
+"""pylibraft.common parity (ref: python/pylibraft/pylibraft/common/:
+handle.pyx:21-120, device_ndarray.py:10-157, ai_wrapper.py/cai_wrapper.py,
+auto_sync_handle decorator).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import DeviceResources, Resources
+
+# pylibraft exposes Handle as the deprecated alias of DeviceResources
+# (ref: common/handle.pyx, core/handle.hpp:23).
+Handle = DeviceResources
+
+
+class device_ndarray:
+    """Lightweight device-array wrapper (ref: common/device_ndarray.py:10).
+
+    Where pylibraft wraps an ``__cuda_array_interface__`` over RMM memory,
+    the TPU analog wraps a ``jax.Array`` and interoperates through
+    ``__array__`` (NumPy), ``__dlpack__`` (torch & friends) and the
+    ``.values`` attribute (raw jax.Array).
+    """
+
+    def __init__(self, array_like):
+        if isinstance(array_like, device_ndarray):
+            self._arr = array_like._arr
+        else:
+            self._arr = jnp.asarray(array_like)
+
+    @classmethod
+    def empty(cls, shape, dtype=np.float32, order="C"):
+        """Device allocation without meaningful contents
+        (ref: device_ndarray.empty)."""
+        if order not in ("C", None):
+            raise ValueError("TPU arrays are row-major; order must be 'C'")
+        return cls(jnp.zeros(shape, dtype))
+
+    @property
+    def values(self) -> jax.Array:
+        return self._arr
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def dtype(self):
+        return np.dtype(self._arr.dtype)
+
+    @property
+    def c_contiguous(self) -> bool:
+        return True
+
+    @property
+    def f_contiguous(self) -> bool:
+        return self._arr.ndim <= 1
+
+    def copy_to_host(self) -> np.ndarray:
+        """Device -> host copy (ref: device_ndarray.copy_to_host)."""
+        return np.asarray(self._arr)
+
+    def __array__(self, dtype=None, copy=None):
+        host = np.asarray(self._arr)
+        return host.astype(dtype) if dtype is not None else host
+
+    def __dlpack__(self, **kwargs):
+        return self._arr.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._arr.__dlpack_device__()
+
+    def __len__(self):
+        return len(self._arr)
+
+    def __getitem__(self, item):
+        return device_ndarray(self._arr[item])
+
+    def __repr__(self):
+        return f"device_ndarray({self._arr!r})"
+
+
+class ai_wrapper:
+    """Duck-typed adapter for anything array-interface-ish (ref:
+    common/ai_wrapper.py:10-32, cai_wrapper.py — the CUDA-array-interface
+    duck type collapses to 'convertible to jax.Array' here)."""
+
+    def __init__(self, ai_arr):
+        if isinstance(ai_arr, device_ndarray):
+            self._arr = ai_arr.values
+        elif hasattr(ai_arr, "__dlpack__") or hasattr(ai_arr, "__array__") \
+                or isinstance(ai_arr, (np.ndarray, jax.Array)):
+            self._arr = jnp.asarray(np.asarray(ai_arr)) \
+                if not isinstance(ai_arr, jax.Array) else ai_arr
+        else:
+            raise TypeError(
+                f"cannot wrap {type(ai_arr)} as a device array")
+
+    @property
+    def dtype(self):
+        return np.dtype(self._arr.dtype)
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def c_contiguous(self) -> bool:
+        return True
+
+    @property
+    def values(self) -> jax.Array:
+        return self._arr
+
+
+def auto_sync_handle(f):
+    """Decorator injecting a default handle and syncing it on return
+    (ref: common/__init__.py `auto_sync_handle`, which creates a Handle if
+    the kwarg is absent and calls handle.sync() after).
+
+    The wrapped function must accept a ``handle=`` keyword argument.
+    """
+    sig = inspect.signature(f)
+    if "handle" not in sig.parameters:
+        raise TypeError(f"{f.__name__} has no 'handle' parameter")
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        bound = sig.bind_partial(*args, **kwargs)
+        handle = bound.arguments.get("handle")
+        if handle is None:
+            kwargs["handle"] = handle = DeviceResources()
+        ret = f(*args, **kwargs)
+        handle.sync_stream()   # block until dispatched work completes
+        return ret
+
+    return wrapper

@@ -1,0 +1,24 @@
+"""Output-format configuration (ref: python/pylibraft/pylibraft/config.py:9
+`set_output_as`)."""
+
+from __future__ import annotations
+
+SUPPORTED_OUTPUT_TYPES = ("raft", "jax", "numpy", "torch")
+
+output_as_ = "raft"
+
+
+def set_output_as(output):
+    """Set the global output format for auto-converted results.
+
+    ``output`` is one of "raft" (device_ndarray, the default), "jax",
+    "numpy", "torch", or a callable taking a device_ndarray (ref:
+    config.py:9-30; "cupy" there maps to "jax" here — the native device
+    array type).
+    """
+    global output_as_
+    if output not in SUPPORTED_OUTPUT_TYPES and not callable(output):
+        raise ValueError(
+            f"Unsupported output option {output!r}; expected one of "
+            f"{SUPPORTED_OUTPUT_TYPES} or a callable")
+    output_as_ = output

@@ -1,0 +1,52 @@
+"""SIGINT -> cooperative-cancel bridge (ref:
+python/pylibraft/pylibraft/common/interruptible.pyx:21-76
+`cuda_interruptible` and the SIGINT handler installation).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+import threading
+
+from raft_tpu.core import interruptible as core_interruptible
+
+
+@contextlib.contextmanager
+def interruptible():
+    """Within the context, SIGINT cancels the current thread's token
+    (checked by long-running host-driven loops via
+    `core.interruptible.yield_now`) and then re-raises KeyboardInterrupt.
+    Mirrors `cuda_interruptible`'s promise: Ctrl+C aborts the computation
+    promptly without corrupting state."""
+    if threading.current_thread() is not threading.main_thread():
+        # Signal handlers are main-thread only; degrade to plain execution
+        # exactly like the reference does outside the main thread.
+        yield
+        return
+
+    token = core_interruptible.get_token()
+    prev = signal.getsignal(signal.SIGINT)
+
+    def handler(signum, frame):
+        token.cancel()
+        if callable(prev):
+            prev(signum, frame)
+
+    signal.signal(signal.SIGINT, handler)
+    try:
+        yield
+    finally:
+        signal.signal(signal.SIGINT, prev)
+        # If the SIGINT arrived while no cancellation checkpoint was
+        # reached, the token would stay set and poison the thread's next
+        # long-running call — consume any leftover flag on exit.
+        try:
+            token.check()
+        except core_interruptible.InterruptedException:
+            pass
+
+
+# pylibraft exposes the name cuda_interruptible; keep an alias with the
+# platform-neutral spelling primary.
+cuda_interruptible = interruptible

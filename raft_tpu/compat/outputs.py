@@ -1,0 +1,66 @@
+"""Automatic output conversion (ref:
+python/pylibraft/pylibraft/common/outputs.py:18-79)."""
+
+from __future__ import annotations
+
+import functools
+import warnings
+
+from raft_tpu.compat import config
+from raft_tpu.compat.common import device_ndarray
+
+
+def _import_warn(lib):
+    warnings.warn(
+        f"Attempted to convert output to {lib}, but {lib} could not be "
+        f"imported. Returning original output instead.")
+
+
+def convert_to_torch(arr: device_ndarray):
+    try:
+        import torch
+        return torch.from_dlpack(arr.values)
+    except ImportError:
+        _import_warn("torch")
+        return arr
+
+
+def convert_to_numpy(arr: device_ndarray):
+    return arr.copy_to_host()
+
+
+def convert_to_jax(arr: device_ndarray):
+    return arr.values
+
+
+def no_conversion(arr):
+    return arr
+
+
+def _conv(ret):
+    if not isinstance(ret, device_ndarray):
+        return ret
+    output_as = config.output_as_
+    if callable(output_as):
+        return output_as(ret)
+    return {
+        "raft": no_conversion,
+        "jax": convert_to_jax,
+        "numpy": convert_to_numpy,
+        "torch": convert_to_torch,
+    }[output_as](ret)
+
+
+def auto_convert_output(f):
+    """Convert device_ndarray returns per `set_output_as`
+    (ref: outputs.py:64-79; handles scalars, tuples and lists)."""
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        ret = f(*args, **kwargs)
+        if isinstance(ret, (tuple, list)):
+            converted = [_conv(r) for r in ret]
+            return type(ret)(converted)
+        return _conv(ret)
+
+    return wrapper

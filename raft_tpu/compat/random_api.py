@@ -1,0 +1,65 @@
+"""pylibraft.random parity (ref:
+python/pylibraft/pylibraft/random/rmat_rectangular_generator.pyx:69 `rmat`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.compat.common import auto_sync_handle, device_ndarray
+from raft_tpu.compat.outputs import auto_convert_output
+from raft_tpu.random import RngState, rmat_rectangular_gen
+
+
+@auto_sync_handle
+@auto_convert_output
+def rmat(out=None, theta=None, r_scale: int = 0, c_scale: int = 0,
+         n_edges: int = 0, seed: int = 12345, handle=None):
+    """Generate R-MAT edges (ref: rmat_rectangular_generator.pyx:69).
+
+    pylibraft signature: ``rmat(out, theta, r_scale, c_scale, seed,
+    handle)`` where ``out`` is a preallocated [n_edges, 2] int array and
+    ``theta`` a [max(r_scale, c_scale) * 4] probability table. ``out`` may
+    be None (pass n_edges instead) — the edge list is always returned.
+    """
+    if out is not None:
+        n_edges = ai_shape(out)[0]
+        dtype = ai_dtype(out)
+    else:
+        if n_edges <= 0:
+            raise ValueError("pass a preallocated `out` or n_edges > 0")
+        dtype = jnp.int32
+    if theta is None:
+        raise ValueError("theta is required")
+    theta = np.asarray(theta, np.float32).reshape(-1, 4)
+    max_scale = max(r_scale, c_scale)
+    if theta.shape[0] < max_scale:
+        raise ValueError(
+            f"theta must supply {max_scale} levels, got {theta.shape[0]}")
+    src, dst = rmat_rectangular_gen(
+        None, RngState(seed), r_scale, c_scale, n_edges,
+        theta=theta[:max_scale], dtype=dtype)
+    edges = jnp.stack([src, dst], axis=1)
+    result = device_ndarray(edges)
+    if out is not None:
+        # pylibraft's contract is an in-place fill of `out`
+        # (rmat_rectangular_generator.pyx:69); honor it for every out type
+        # we can write to, and refuse loudly otherwise.
+        if isinstance(out, device_ndarray):
+            out._arr = edges
+        elif isinstance(out, np.ndarray) and out.flags.writeable:
+            out[...] = np.asarray(edges, dtype=out.dtype)
+        else:
+            raise TypeError(
+                f"cannot fill `out` of type {type(out)} in place; pass a "
+                "device_ndarray or a writable numpy array")
+    return result
+
+
+def ai_shape(arr):
+    return arr.shape
+
+
+def ai_dtype(arr):
+    return arr.dtype

@@ -1,0 +1,49 @@
+"""pylibraft.sparse.linalg parity (ref:
+python/pylibraft/pylibraft/sparse/linalg/lanczos.pyx:85-200 `eigsh`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.compat.common import auto_sync_handle, device_ndarray
+from raft_tpu.compat.outputs import auto_convert_output
+from raft_tpu.core.sparse_types import CSRMatrix
+from raft_tpu.sparse.solver import lanczos as _lanczos
+
+
+def _as_csr(a) -> CSRMatrix:
+    """Accept our CSRMatrix or any scipy-like duck object with
+    indptr/indices/data (+ shape), matching the pyx's duck-typed CAI
+    unwrapping (lanczos.pyx:147-153)."""
+    if isinstance(a, CSRMatrix):
+        return a
+    if all(hasattr(a, attr) for attr in ("indptr", "indices", "data")):
+        shape = getattr(a, "shape", None)
+        if shape is None:
+            n = len(np.asarray(a.indptr)) - 1
+            shape = (n, n)
+        return CSRMatrix(jnp.asarray(np.asarray(a.indptr)),
+                         jnp.asarray(np.asarray(a.indices)),
+                         jnp.asarray(np.asarray(a.data)), tuple(shape))
+    raise TypeError(
+        f"expected CSRMatrix or an object with indptr/indices/data, "
+        f"got {type(a)}")
+
+
+@auto_sync_handle
+@auto_convert_output
+def eigsh(a, k: int = 6, v0=None, ncv: int = 0, maxiter: int = 4000,
+          tol: float = 0.0, which: str = "LM", seed: int = 42,
+          handle=None):
+    """Find k eigenvalues/eigenvectors of the sparse symmetric matrix A
+    (ref: lanczos.pyx:85 — scipy.sparse.linalg.eigsh-compatible surface).
+
+    Returns (eigenvalues, eigenvectors) as device arrays.
+    """
+    csr = _as_csr(a)
+    w, v = _lanczos.eigsh(
+        csr, k=k, which=which, v0=v0, ncv=ncv, maxiter=maxiter,
+        tol=tol if tol > 0 else 1e-7, seed=seed, res=handle)
+    return device_ndarray(w), device_ndarray(v)

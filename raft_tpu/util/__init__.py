@@ -29,3 +29,4 @@ from raft_tpu.util.input_validation import (  # noqa: F401
     expect_same_shape,
 )
 from raft_tpu.util.itertools import product_of_lists  # noqa: F401
+from raft_tpu.util.cache import VectorCache  # noqa: F401

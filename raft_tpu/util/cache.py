@@ -1,0 +1,139 @@
+"""Set-associative device vector cache (ref: raft/util/cache.cuh:102
+`Cache`, util/cache_util.cuh; used upstream to cache kernel-matrix columns
+in SVM-style workloads).
+
+TPU design: the reference's GPU hash-cache uses per-set atomic clocks for
+pseudo-LRU victim selection inside a kernel. Here the cache state lives in
+device arrays (keys, timestamps, payload matrix) updated with pure
+scatter/gather ops; the host drives eviction decisions (lookup/assign are
+one jitted gather/scatter each — no atomics needed because assignment
+batches are deduplicated up front).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class VectorCache:
+    """Cache for n-dimensional vectors addressed by integer keys.
+
+    Equivalent surface to `Cache<math_t>` (util/cache.cuh:102):
+    get_vecs / store_vecs / get_cache_idx / assign_cache_idx.
+    """
+
+    def __init__(self, n_vec: int, capacity: int, associativity: int = 32,
+                 dtype=jnp.float32):
+        if capacity <= 0:
+            raise ValueError("cache capacity must be positive")
+        self.n_vec = n_vec
+        self.associativity = min(associativity, capacity)
+        self.n_sets = max(1, capacity // self.associativity)
+        self.capacity = self.n_sets * self.associativity
+        self.keys = jnp.full((self.capacity,), -1, jnp.int32)
+        self.time = jnp.zeros((self.capacity,), jnp.int32)
+        self.store = jnp.zeros((self.capacity, n_vec), dtype)
+        self._clock = 0
+
+    def _set_of(self, keys):
+        return keys % self.n_sets
+
+    def get_cache_idx(self, keys):
+        """For each key: its cache slot, or -1 on miss. Hits refresh the
+        entry's timestamp so eviction is true LRU, like the reference
+        (ref: GetCacheIdx kernel updates cache_time on hit)."""
+        keys = jnp.asarray(keys, jnp.int32)
+        sets = self._set_of(keys)                         # [q]
+        lanes = jnp.arange(self.associativity)
+        slot_ids = sets[:, None] * self.associativity + lanes[None, :]
+        slot_keys = self.keys[slot_ids]                   # [q, assoc]
+        hit = slot_keys == keys[:, None]
+        lane = jnp.argmax(hit, axis=1)
+        idx = jnp.where(jnp.any(hit, axis=1),
+                        sets * self.associativity + lane, -1)
+        hits = np.asarray(idx)
+        hits = hits[hits >= 0]
+        if hits.size:
+            self._clock += 1
+            self.time = self.time.at[jnp.asarray(hits)].set(self._clock)
+        return idx
+
+    def assign_cache_idx(self, keys):
+        """Assign slots for (missing) keys, evicting the least-recently-used
+        slot in each set (ref: AssignCacheIdx kernel). Duplicate keys and
+        same-set collisions beyond the associativity get -1, like the
+        reference (callers retry next round)."""
+        keys_h = np.asarray(keys, np.int32)
+        out = np.full(keys_h.shape, -1, np.int32)
+        taken: dict[int, set] = {}
+        keys_dev = np.array(self.keys)   # mutable host copies
+        time_dev = np.array(self.time)
+        seen = set(keys_dev[keys_dev >= 0].tolist())
+        for i, k in enumerate(keys_h):
+            k = int(k)
+            if k in seen:
+                continue
+            s = k % self.n_sets
+            base = s * self.associativity
+            lanes = range(base, base + self.associativity)
+            used = taken.setdefault(s, set())
+            # pick LRU lane not already taken this round
+            cand = [j for j in lanes if j not in used]
+            if not cand:
+                continue
+            j = min(cand, key=lambda j: (keys_dev[j] >= 0, time_dev[j]))
+            used.add(j)
+            out[i] = j
+            keys_dev[j] = k
+            seen.add(k)
+        self._clock += 1
+        self.keys = jnp.asarray(keys_dev)
+        self.time = self.time.at[jnp.asarray(
+            out[out >= 0])].set(self._clock)
+        return jnp.asarray(out)
+
+    def store_vecs(self, vecs, cache_idx):
+        """Write vectors into assigned slots (ref: StoreVecs).
+
+        Only rows with a valid slot are scattered — masking invalid rows
+        through a dummy index would create duplicate-index writes whose
+        winner is unspecified in JAX."""
+        vecs = jnp.asarray(vecs)
+        idx_h = np.asarray(cache_idx, np.int32)
+        valid = np.nonzero(idx_h >= 0)[0]
+        if valid.size == 0:
+            return
+        slots = jnp.asarray(idx_h[valid])
+        self.store = self.store.at[slots].set(vecs[jnp.asarray(valid)])
+        self._clock += 1
+        self.time = self.time.at[slots].set(self._clock)
+
+    def get_vecs(self, cache_idx):
+        """Gather cached vectors for slot indices (ref: GetVecs)."""
+        idx = jnp.asarray(cache_idx, jnp.int32)
+        return self.store[jnp.where(idx >= 0, idx, 0)]
+
+    def get_or_compute(self, keys, compute_fn):
+        """Convenience wrapper: return vectors for keys, computing and
+        caching misses via ``compute_fn(missing_keys) -> [m, n_vec]``."""
+        keys = jnp.asarray(keys, jnp.int32)
+        idx = self.get_cache_idx(keys)
+        miss_rows = np.nonzero(np.asarray(idx < 0))[0]
+        fresh = None
+        if miss_rows.size:
+            missing = keys[jnp.asarray(miss_rows)]
+            fresh = compute_fn(missing)
+            slots = self.assign_cache_idx(missing)
+            self.store_vecs(fresh, slots)
+            idx = self.get_cache_idx(keys)
+        out = self.get_vecs(idx)
+        still = np.nonzero(np.asarray(idx < 0))[0]
+        if still.size:
+            # associativity conflicts within one batch: those keys' rows
+            # were already computed in `fresh` — reuse, don't recompute
+            pos_in_miss = {int(k): i for i, k in enumerate(miss_rows)}
+            rows = jnp.asarray([pos_in_miss[int(r)] for r in still])
+            out = out.at[jnp.asarray(still)].set(fresh[rows])
+        return out

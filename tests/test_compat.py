@@ -1,0 +1,136 @@
+"""Python parity layer tests (ref test models:
+python/pylibraft/pylibraft/tests/, python/raft-dask/raft_dask/tests/)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import compat
+from raft_tpu.comms import (
+    Comms,
+    get_raft_comm_state,
+    local_handle,
+    perform_test_comms_allreduce,
+)
+
+
+class TestDeviceNdarray:
+    def test_roundtrip(self):
+        host = np.arange(12, dtype=np.float32).reshape(3, 4)
+        arr = compat.device_ndarray(host)
+        assert arr.shape == (3, 4)
+        assert arr.dtype == np.float32
+        np.testing.assert_array_equal(arr.copy_to_host(), host)
+        np.testing.assert_array_equal(np.asarray(arr), host)
+
+    def test_empty_and_getitem(self):
+        arr = compat.device_ndarray.empty((5, 2))
+        assert arr.shape == (5, 2)
+        row = arr[0]
+        assert isinstance(row, compat.device_ndarray)
+        assert row.shape == (2,)
+
+    def test_dlpack_to_torch(self):
+        torch = pytest.importorskip("torch")
+        arr = compat.device_ndarray(np.ones((4,), np.float32))
+        t = torch.from_dlpack(arr)
+        assert t.shape == (4,)
+        assert float(t.sum()) == 4.0
+
+    def test_ai_wrapper(self):
+        w = compat.ai_wrapper(np.zeros((2, 3), np.float64))
+        assert w.shape == (2, 3)
+        assert w.c_contiguous
+        with pytest.raises(TypeError):
+            compat.ai_wrapper(object())
+
+
+class TestOutputConversion:
+    def teardown_method(self):
+        compat.set_output_as("raft")
+
+    def test_set_output_as(self):
+        from raft_tpu.compat.outputs import _conv
+
+        arr = compat.device_ndarray(np.ones(3, np.float32))
+        compat.set_output_as("numpy")
+        assert isinstance(_conv(arr), np.ndarray)
+        compat.set_output_as("jax")
+        import jax
+        assert isinstance(_conv(arr), jax.Array)
+        compat.set_output_as(lambda a: "custom")
+        assert _conv(arr) == "custom"
+        with pytest.raises(ValueError):
+            compat.set_output_as("cudf")
+
+    def test_auto_convert_decorator(self):
+        compat.set_output_as("numpy")
+
+        @compat.auto_convert_output
+        def f():
+            return (compat.device_ndarray(np.ones(2)), 5)
+
+        out, five = f()
+        assert isinstance(out, np.ndarray)
+        assert five == 5
+
+
+class TestCompatAPIs:
+    def test_rmat(self):
+        theta = np.tile(np.array([0.55, 0.2, 0.2, 0.05], np.float32), (8, 1))
+        edges = compat.rmat(theta=theta, r_scale=8, c_scale=8,
+                            n_edges=1000, seed=7)
+        e = np.asarray(edges)
+        assert e.shape == (1000, 2)
+        assert e.min() >= 0 and e.max() < 256
+
+    def test_rmat_out_param(self):
+        out = compat.device_ndarray.empty((500, 2), np.int32)
+        theta = np.tile(np.array([0.6, 0.15, 0.15, 0.1], np.float32),
+                        (6, 1))
+        compat.rmat(out=out, theta=theta, r_scale=6, c_scale=6)
+        e = np.asarray(out)
+        assert e.shape == (500, 2) and e.max() < 64
+
+    def test_eigsh_scipy_duck(self):
+        scipy_sparse = pytest.importorskip("scipy.sparse")
+        rng = np.random.default_rng(0)
+        n = 60
+        dense = rng.normal(size=(n, n)).astype(np.float32)
+        dense = (dense + dense.T) / 2
+        dense[np.abs(dense) < 0.8] = 0.0
+        np.fill_diagonal(dense, np.arange(1.0, n + 1.0))
+        a = scipy_sparse.csr_matrix(dense)
+        w, v = compat.eigsh(a, k=4, which="SA", tol=1e-6)
+        w = np.asarray(w)
+        expect = np.linalg.eigvalsh(dense)[:4]
+        np.testing.assert_allclose(w, expect, rtol=1e-3, atol=1e-3)
+
+    def test_interruptible_context(self):
+        with compat.interruptible():
+            x = 1 + 1
+        assert x == 2
+
+
+class TestCommsBootstrap:
+    def test_init_and_collective(self, mesh8):
+        comms = Comms(devices=list(mesh8.devices.ravel()))
+        comms.init()
+        state = get_raft_comm_state(comms.sessionId)
+        assert state["nranks"] == 8
+        handle = local_handle(comms.sessionId, rank=0)
+        assert handle is not None
+        from raft_tpu.core.resources import get_comms
+
+        view = get_comms(handle)
+        assert view.get_size() == 8
+        assert view.get_rank() == 0
+        # the reference's perform_test_comms_* self-test path
+        assert perform_test_comms_allreduce(view)
+        comms.destroy()
+        assert get_raft_comm_state(comms.sessionId) == {}
+
+    def test_double_init_warns_not_raises(self, mesh8):
+        comms = Comms(devices=list(mesh8.devices.ravel()))
+        comms.init()
+        comms.init()   # idempotent
+        comms.destroy()

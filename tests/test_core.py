@@ -308,3 +308,48 @@ class TestOperators:
         k, v = ops.argmin_op((jnp.asarray(5), jnp.asarray(2.0)),
                              (jnp.asarray(3), jnp.asarray(2.0)))
         assert int(k) == 3  # tie → smaller key
+
+
+class TestVectorCache:
+    """ref test model: cpp/tests/util (cache tests)."""
+
+    def test_miss_then_hit(self):
+        import numpy as np
+        from raft_tpu.util import VectorCache
+
+        cache = VectorCache(n_vec=4, capacity=8, associativity=4)
+        keys = np.array([3, 9, 3])
+        idx = np.asarray(cache.get_cache_idx(keys))
+        assert (idx == -1).all()
+        slots = np.asarray(cache.assign_cache_idx(np.array([3, 9])))
+        assert (slots >= 0).all() and slots[0] != slots[1]
+        vecs = np.arange(8, dtype=np.float32).reshape(2, 4)
+        cache.store_vecs(vecs, slots)
+        idx = np.asarray(cache.get_cache_idx(keys))
+        assert (idx >= 0).all()
+        got = np.asarray(cache.get_vecs(idx))
+        np.testing.assert_array_equal(got[0], vecs[0])
+        np.testing.assert_array_equal(got[1], vecs[1])
+        np.testing.assert_array_equal(got[2], vecs[0])
+
+    def test_lru_eviction_and_get_or_compute(self):
+        import numpy as np
+        from raft_tpu.util import VectorCache
+
+        cache = VectorCache(n_vec=2, capacity=4, associativity=4)
+        calls = []
+
+        def compute(keys):
+            k = np.asarray(keys)
+            calls.append(k.tolist())
+            return np.stack([k.astype(np.float32)] * 2, axis=1)
+
+        out = np.asarray(cache.get_or_compute(np.array([0, 1, 2, 3]),
+                                              compute))
+        np.testing.assert_array_equal(out[:, 0], [0, 1, 2, 3])
+        # all cached now: no new compute
+        cache.get_or_compute(np.array([1, 2]), compute)
+        assert len(calls) == 1
+        # 5th key evicts the LRU slot; cache stays consistent
+        out = np.asarray(cache.get_or_compute(np.array([4, 1]), compute))
+        np.testing.assert_array_equal(out[:, 0], [4, 1])
