@@ -1,0 +1,112 @@
+"""Native host runtime: build-on-demand C++ core bound via ctypes.
+
+The reference ships its host runtime as compiled C++ (`libraft.so`,
+cpp/CMakeLists.txt:274-341) loaded by the `libraft` Python package's
+`load_library()` (python/libraft/libraft/load.py:8-35). The analogue here
+compiles `raft_tpu_native.cpp` with the ambient g++ on first use (cached
+next to the source keyed by content hash) and binds the flat C ABI with
+ctypes — no pybind11 dependency by design.
+
+If no toolchain is available the import still succeeds with
+``native_available() == False`` and pure-Python fallbacks take over
+(mirroring the header-only vs compiled split of the reference).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "raft_tpu_native.cpp")
+
+_lib = None        # None = not tried, False = build failed, else CDLL
+_lib_err: str = ""
+_lock = threading.Lock()
+
+
+def _build_and_load():
+    global _lib, _lib_err
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_HERE, f"libraft_tpu_native_{digest}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               "-fvisibility=hidden", "-pthread", _SRC, "-o",
+               so_path + ".tmp"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True,
+                           timeout=300)
+            os.replace(so_path + ".tmp", so_path)
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                FileNotFoundError) as e:
+            _lib_err = getattr(e, "stderr", str(e)) or str(e)
+            return None
+    lib = ctypes.CDLL(so_path)
+    _bind(lib)
+    return lib
+
+
+def _bind(lib):
+    c = ctypes
+    lib.rt_pool_create.restype = c.c_void_p
+    lib.rt_pool_create.argtypes = [c.c_int]
+    lib.rt_pool_destroy.argtypes = [c.c_void_p]
+    lib.rt_pool_alloc.restype = c.c_void_p
+    lib.rt_pool_alloc.argtypes = [c.c_void_p, c.c_int64]
+    lib.rt_pool_dealloc.restype = c.c_int
+    lib.rt_pool_dealloc.argtypes = [c.c_void_p, c.c_void_p]
+    lib.rt_pool_stats.argtypes = [c.c_void_p, c.POINTER(c.c_int64)]
+    lib.rt_pool_set_notify.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+    lib.rt_monitor_start.restype = c.c_void_p
+    lib.rt_monitor_start.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.rt_monitor_set_tag.argtypes = [c.c_void_p, c.c_char_p]
+    lib.rt_monitor_stop.argtypes = [c.c_void_p]
+    lib.rt_interruptible_cancel.argtypes = [c.c_int64]
+    lib.rt_interruptible_check.restype = c.c_int
+    lib.rt_interruptible_check.argtypes = [c.c_int64]
+    lib.rt_interruptible_cancelled.restype = c.c_int
+    lib.rt_interruptible_cancelled.argtypes = [c.c_int64]
+    lib.rt_npy_write.restype = c.c_int
+    lib.rt_npy_write.argtypes = [c.c_char_p, c.c_char_p,
+                                 c.POINTER(c.c_int64), c.c_int, c.c_void_p,
+                                 c.c_int64]
+    lib.rt_npy_read_header.restype = c.c_int64
+    lib.rt_npy_read_header.argtypes = [c.c_char_p, c.c_char_p,
+                                       c.POINTER(c.c_int64),
+                                       c.POINTER(c.c_int)]
+    lib.rt_npy_read_data.restype = c.c_int
+    lib.rt_npy_read_data.argtypes = [c.c_char_p, c.c_int64, c.c_void_p,
+                                     c.c_int64]
+    lib.rt_threadpool_create.restype = c.c_void_p
+    lib.rt_threadpool_create.argtypes = [c.c_int]
+    lib.rt_threadpool_destroy.argtypes = [c.c_void_p]
+    lib.rt_threadpool_memcpy.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p,
+                                         c.c_int64, c.c_int64]
+    lib.rt_threadpool_submit.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+    lib.rt_threadpool_wait.argtypes = [c.c_void_p]
+    lib.rt_version.restype = c.c_int
+
+
+def get_lib():
+    """The loaded native library, building it if necessary; None if no
+    toolchain is available (callers fall back to Python)."""
+    global _lib
+    if _lib is None:
+        with _lock:
+            if _lib is None:
+                # cache failure as False so a broken toolchain is probed
+                # once, not on every call
+                _lib = _build_and_load() or False
+    return _lib or None
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def build_error() -> str:
+    return _lib_err

@@ -17,6 +17,7 @@ state dicts, idempotent destroy) mirror the reference so downstream
 from __future__ import annotations
 
 import uuid
+import weakref
 from typing import Optional
 
 import jax
@@ -112,8 +113,11 @@ class Comms:
             handles[rank] = handle
             comms_views[rank] = view
 
+        # weakref: the registry must not keep the Comms object alive, or
+        # __del__-driven cleanup could never run and un-destroyed sessions
+        # would accumulate for the process lifetime
         _session_state[self.sessionId] = {
-            "comms": self,
+            "comms": weakref.ref(self),
             "mesh": mesh,
             "nranks": nranks,
             "handles": handles,

@@ -137,7 +137,10 @@ def auto_sync_handle(f):
         if handle is None:
             kwargs["handle"] = handle = DeviceResources()
         ret = f(*args, **kwargs)
-        handle.sync_stream()   # block until dispatched work completes
+        # module-level sync works for any Resources, including the plain
+        # per-rank handles built by the comms bootstrap
+        from raft_tpu.core import resources as core_res
+        core_res.sync(handle)
         return ret
 
     return wrapper

@@ -1,0 +1,232 @@
+"""High-level wrappers over the native host runtime
+(raft_tpu/_native/raft_tpu_native.cpp).
+
+Each class mirrors a native component of the reference runtime:
+
+- TrackedHostPool      <- mr/statistics_adaptor.hpp + mmap_memory_resource
+- NativeResourceMonitor<- mr/resource_monitor.hpp:29-66
+- native npy save/load <- core/serialize.hpp + detail/mdspan_numpy_serializer
+- NativeThreadPool     <- host-job analogue of the handle's stream pool
+- native interruptible <- core/interruptible.hpp token registry
+
+All are optional accelerations: when `_native.native_available()` is False
+(no g++), the pure-Python equivalents in core.memory / core.serialize /
+core.interruptible remain the implementation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional
+
+import numpy as np
+
+from raft_tpu import _native
+
+_DESCR = {
+    np.dtype("float32"): "<f4", np.dtype("float64"): "<f8",
+    np.dtype("int32"): "<i4", np.dtype("int64"): "<i8",
+    np.dtype("int16"): "<i2", np.dtype("int8"): "|i1",
+    np.dtype("uint8"): "|u1", np.dtype("uint32"): "<u4",
+    np.dtype("uint64"): "<u8", np.dtype("bool"): "|b1",
+}
+_DESCR_INV = {v: k for k, v in _DESCR.items()}
+
+
+def native_available() -> bool:
+    return _native.native_available()
+
+
+class TrackedHostPool:
+    """Statistics-tracking host allocator (optionally mmap-backed).
+
+    Hands out numpy arrays backed by native allocations; frees on
+    release() or pool destruction. ref: mr/statistics_adaptor.hpp:25,66,
+    mr/mmap_memory_resource.hpp:31,86."""
+
+    def __init__(self, use_mmap: bool = False):
+        self._lib = _native.get_lib()
+        if self._lib is None:
+            raise RuntimeError(
+                f"native runtime unavailable: {_native.build_error()}")
+        self._pool = self._lib.rt_pool_create(1 if use_mmap else 0)
+        self._ptrs: dict[int, int] = {}
+        self._cb = None  # keep ctypes callback alive
+        self._lock = threading.Lock()
+
+    def allocate(self, shape, dtype=np.float32) -> np.ndarray:
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        ptr = self._lib.rt_pool_alloc(self._pool, max(nbytes, 1))
+        if not ptr:
+            raise MemoryError(f"native pool allocation of {nbytes}B failed")
+        buf = (ctypes.c_char * max(nbytes, 1)).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+        with self._lock:
+            self._ptrs[id(arr)] = ptr
+        return arr
+
+    def release(self, arr: np.ndarray) -> None:
+        with self._lock:
+            ptr = self._ptrs.pop(id(arr), None)
+        if ptr is not None:
+            self._lib.rt_pool_dealloc(self._pool, ptr)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_int64 * 4)()
+        self._lib.rt_pool_stats(self._pool, out)
+        return {"bytes_allocated": out[0], "peak_bytes": out[1],
+                "n_allocations": out[2], "n_deallocations": out[3]}
+
+    def set_notify(self, fn) -> None:
+        """Observer hook: fn(is_alloc: bool, nbytes: int)
+        (ref: mr/notifying_adaptor.hpp)."""
+        if fn is None:
+            self._cb = None
+            self._lib.rt_pool_set_notify(self._pool, None, None)
+            return
+        cb_t = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_int64,
+                                ctypes.c_void_p)
+        self._cb = cb_t(lambda is_alloc, nbytes, _:
+                        fn(bool(is_alloc), int(nbytes)))
+        self._lib.rt_pool_set_notify(
+            self._pool, ctypes.cast(self._cb, ctypes.c_void_p), None)
+
+    def close(self) -> None:
+        if getattr(self, "_pool", None):
+            self._lib.rt_pool_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeResourceMonitor:
+    """Background sampler writing pool stats to CSV, rows tagged with the
+    active range (ref: mr/resource_monitor.hpp:29-66)."""
+
+    def __init__(self, pool: TrackedHostPool, csv_path: str,
+                 interval_ms: int = 50):
+        self._lib = _native.get_lib()
+        self._mon = self._lib.rt_monitor_start(
+            pool._pool, csv_path.encode(), interval_ms)
+        if not self._mon:
+            raise RuntimeError(f"cannot open {csv_path}")
+
+    def set_tag(self, tag: str) -> None:
+        self._lib.rt_monitor_set_tag(self._mon, tag.encode())
+
+    def stop(self) -> None:
+        if self._mon:
+            self._lib.rt_monitor_stop(self._mon)
+            self._mon = None
+
+
+def npy_save(path: str, arr: np.ndarray) -> None:
+    """Native .npy writer (ref: serialize_mdspan, core/serialize.hpp:26)."""
+    lib = _native.get_lib()
+    arr = np.ascontiguousarray(arr)
+    descr = _DESCR.get(arr.dtype)
+    if lib is None or descr is None:
+        # fallback via a file object so np.save cannot append ".npy" and
+        # diverge from the native writer's exact-path behavior
+        with open(path, "wb") as f:
+            np.save(f, arr, allow_pickle=False)
+        return
+    shape = (ctypes.c_int64 * max(arr.ndim, 1))(*arr.shape)
+    rc = lib.rt_npy_write(path.encode(), descr.encode(), shape, arr.ndim,
+                          arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes)
+    if rc != 0:
+        raise IOError(f"native npy write failed with code {rc}")
+
+
+def npy_load(path: str) -> np.ndarray:
+    """Native .npy reader (ref: deserialize_mdspan)."""
+    lib = _native.get_lib()
+    if lib is None:
+        return np.load(path, allow_pickle=False)
+    descr = ctypes.create_string_buffer(16)
+    shape = (ctypes.c_int64 * 32)()
+    ndim = ctypes.c_int(0)
+    off = lib.rt_npy_read_header(path.encode(), descr, shape,
+                                 ctypes.byref(ndim))
+    if off < 0:
+        raise IOError(f"native npy header parse failed with code {off}")
+    dtype = _DESCR_INV.get(descr.value.decode())
+    if dtype is None:   # exotic dtype: punt to numpy
+        return np.load(path, allow_pickle=False)
+    shp = tuple(shape[i] for i in range(ndim.value))
+    out = np.empty(shp, dtype)
+    rc = lib.rt_npy_read_data(path.encode(), off,
+                              out.ctypes.data_as(ctypes.c_void_p),
+                              out.nbytes)
+    if rc != 0:
+        raise IOError(f"native npy read failed with code {rc}")
+    return out
+
+
+class NativeThreadPool:
+    """Host worker pool for IO/copy jobs — the host-side analogue of the
+    handle's stream pool (core/resource/cuda_stream_pool.hpp)."""
+
+    def __init__(self, n_threads: int = 0):
+        self._lib = _native.get_lib()
+        if self._lib is None:
+            raise RuntimeError(
+                f"native runtime unavailable: {_native.build_error()}")
+        self._tp = self._lib.rt_threadpool_create(n_threads)
+
+    def parallel_copy(self, dst: np.ndarray, src: np.ndarray,
+                      chunk_bytes: int = 8 << 20) -> None:
+        if dst.nbytes != src.nbytes:
+            raise ValueError("size mismatch")
+        self._lib.rt_threadpool_memcpy(
+            self._tp, dst.ctypes.data_as(ctypes.c_void_p),
+            np.ascontiguousarray(src).ctypes.data_as(ctypes.c_void_p),
+            dst.nbytes, chunk_bytes)
+
+    def close(self) -> None:
+        if getattr(self, "_tp", None):
+            self._lib.rt_threadpool_destroy(self._tp)
+            self._tp = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_cancel(thread_id: Optional[int] = None) -> None:
+    """Native token registry mirror of core.interruptible
+    (ref: core/interruptible.hpp:97 `cancel`). Falls back to the Python
+    token registry without a toolchain."""
+    lib = _native.get_lib()
+    tid = thread_id if thread_id is not None else threading.get_ident()
+    if lib is None:
+        from raft_tpu.core import interruptible
+        interruptible.cancel(tid)
+        return
+    lib.rt_interruptible_cancel(tid)
+
+
+def native_check_cancelled(thread_id: Optional[int] = None) -> bool:
+    """Flag-consuming check (ref: interruptible `yield_no_throw`). Falls
+    back to the Python token registry without a toolchain."""
+    lib = _native.get_lib()
+    tid = thread_id if thread_id is not None else threading.get_ident()
+    if lib is None:
+        from raft_tpu.core import interruptible
+        token = interruptible.get_token(tid)
+        cancelled = token.cancelled()
+        if cancelled:
+            try:
+                token.check()
+            except interruptible.InterruptedException:
+                pass
+        return cancelled
+    return bool(lib.rt_interruptible_check(tid))

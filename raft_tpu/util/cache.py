@@ -131,9 +131,18 @@ class VectorCache:
         out = self.get_vecs(idx)
         still = np.nonzero(np.asarray(idx < 0))[0]
         if still.size:
-            # associativity conflicts within one batch: those keys' rows
-            # were already computed in `fresh` — reuse, don't recompute
+            # Rows can be missing on re-query for two reasons: (a) an
+            # associativity conflict in this batch (the row originally
+            # missed, its vector is in `fresh` — reuse it) or (b) the row
+            # hit at first but its slot was evicted by this very batch's
+            # assignments (recompute just those).
             pos_in_miss = {int(k): i for i, k in enumerate(miss_rows)}
-            rows = jnp.asarray([pos_in_miss[int(r)] for r in still])
-            out = out.at[jnp.asarray(still)].set(fresh[rows])
+            reuse = [r for r in still if int(r) in pos_in_miss]
+            evicted = [r for r in still if int(r) not in pos_in_miss]
+            if reuse:
+                rows = jnp.asarray([pos_in_miss[int(r)] for r in reuse])
+                out = out.at[jnp.asarray(np.asarray(reuse))].set(fresh[rows])
+            if evicted:
+                ev = jnp.asarray(np.asarray(evicted))
+                out = out.at[ev].set(compute_fn(keys[ev]))
         return out

@@ -1,0 +1,121 @@
+"""Native C++ host runtime tests (ref models: cpp/tests/core/
+allocation_tracking.cpp, monitor_resources.cu, numpy_serializer.cu,
+interruptible.cu)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import native_runtime as nr
+
+pytestmark = pytest.mark.skipif(
+    not nr.native_available(),
+    reason="no C++ toolchain for the native runtime")
+
+
+class TestTrackedHostPool:
+    def test_alloc_stats_release(self):
+        pool = nr.TrackedHostPool()
+        try:
+            a = pool.allocate((100, 10), np.float32)
+            a[:] = 2.0
+            s = pool.stats()
+            assert s["bytes_allocated"] == 4000
+            assert s["n_allocations"] == 1
+            b = pool.allocate((50,), np.float64)
+            assert pool.stats()["bytes_allocated"] == 4400
+            assert pool.stats()["peak_bytes"] == 4400
+            pool.release(a)
+            pool.release(b)
+            s = pool.stats()
+            assert s["bytes_allocated"] == 0
+            assert s["n_deallocations"] == 2
+            assert s["peak_bytes"] == 4400
+        finally:
+            pool.close()
+
+    def test_mmap_pool(self):
+        pool = nr.TrackedHostPool(use_mmap=True)
+        try:
+            a = pool.allocate((1 << 16,), np.uint8)
+            a[:] = 7
+            assert int(a.sum()) == 7 * (1 << 16)
+            pool.release(a)
+        finally:
+            pool.close()
+
+    def test_notify_hook(self):
+        pool = nr.TrackedHostPool()
+        try:
+            events = []
+            pool.set_notify(lambda is_alloc, n: events.append((is_alloc, n)))
+            a = pool.allocate((10,), np.int32)
+            pool.release(a)
+            assert events == [(True, 40), (False, 40)]
+        finally:
+            pool.close()
+
+
+class TestResourceMonitor:
+    def test_csv_sampling_with_tags(self, tmp_path):
+        pool = nr.TrackedHostPool()
+        csv = str(tmp_path / "mon.csv")
+        try:
+            mon = nr.NativeResourceMonitor(pool, csv, interval_ms=5)
+            mon.set_tag("warmup")
+            a = pool.allocate((1024,), np.float32)
+            time.sleep(0.03)
+            mon.set_tag("steady")
+            time.sleep(0.03)
+            mon.stop()
+            lines = open(csv).read().strip().split("\n")
+            assert lines[0].startswith("timestamp_us,tag")
+            assert any(",warmup," in ln for ln in lines[1:])
+            assert any(",steady," in ln for ln in lines[1:])
+            pool.release(a)
+        finally:
+            pool.close()
+
+
+class TestNpySerializer:
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64, np.int32,
+                                       np.int64, np.uint8, np.bool_])
+    def test_roundtrip_vs_numpy(self, tmp_path, dtype):
+        rng = np.random.default_rng(0)
+        x = (rng.normal(size=(5, 3, 2)) * 10).astype(dtype)
+        p = str(tmp_path / "x.npy")
+        nr.npy_save(p, x)
+        np.testing.assert_array_equal(np.load(p), x)       # numpy reads ours
+        np.testing.assert_array_equal(nr.npy_load(p), x)   # we read ours
+        p2 = str(tmp_path / "y.npy")
+        np.save(p2, x)
+        np.testing.assert_array_equal(nr.npy_load(p2), x)  # we read numpy's
+
+    def test_scalar_and_1d(self, tmp_path):
+        p = str(tmp_path / "v.npy")
+        v = np.arange(7, dtype=np.int32)
+        nr.npy_save(p, v)
+        np.testing.assert_array_equal(np.load(p), v)
+
+
+class TestThreadPool:
+    def test_parallel_copy(self):
+        tp = nr.NativeThreadPool(4)
+        try:
+            src = np.random.default_rng(1).normal(
+                size=(1 << 18,)).astype(np.float32)
+            dst = np.empty_like(src)
+            tp.parallel_copy(dst, src, chunk_bytes=1 << 15)
+            np.testing.assert_array_equal(dst, src)
+        finally:
+            tp.close()
+
+
+class TestNativeInterruptible:
+    def test_cancel_check_consumes(self):
+        assert not nr.native_check_cancelled()
+        nr.native_cancel()
+        assert nr.native_check_cancelled()
+        assert not nr.native_check_cancelled()
