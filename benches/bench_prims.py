@@ -1,0 +1,350 @@
+"""Primitive microbenches (ref: cpp/bench/prims/ — one case family per
+reference bench TU; SURVEY.md §2.13 lists the matrix).
+
+Run: python benches/run_benches.py [--filter substr] [--size small|full]
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benches.harness import bench, run_case
+
+_SMALL = {"rows": 1 << 14, "cols": 256, "k": 64}
+_FULL = {"rows": 1 << 20, "cols": 256, "k": 256}
+SIZES = _SMALL
+
+
+def _data(rows, cols, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(rows, cols)).astype(dtype))
+
+
+# -- core (ref: bench/prims/core/bitset.cu, copy.cu, memory_tracking.cu) ----
+
+@bench("core/bitset")
+def bench_bitset():
+    from raft_tpu.core.bitset import Bitset
+
+    n = SIZES["rows"] * 8
+    bs = Bitset(n)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, n, size=n // 16).astype(np.int32))
+
+    def roundtrip(bs, ids):
+        bs2 = bs.set(ids, True)
+        return bs2.count()
+
+    return [run_case("core/bitset_set_count", roundtrip, bs, ids,
+                     items=int(ids.shape[0]), n=n)]
+
+
+@bench("core/copy")
+def bench_copy():
+    x = _data(SIZES["rows"], SIZES["cols"])
+    f = jax.jit(lambda a: a.T.copy())
+    nbytes = x.size * 4 * 2
+    return [run_case("core/copy_transpose", f, x, bytes_moved=nbytes,
+                     shape=list(x.shape))]
+
+
+@bench("core/memory_tracking")
+def bench_memory_tracking():
+    from raft_tpu.core.native_runtime import (TrackedHostPool,
+                                              native_available)
+    if not native_available():
+        return []
+    pool = TrackedHostPool()
+
+    def cycle():
+        arrs = [pool.allocate((4096,), np.float32) for _ in range(64)]
+        for a in arrs:
+            pool.release(a)
+        return jnp.zeros(())
+
+    out = [run_case("core/native_pool_alloc_free", cycle, items=128)]
+    pool.close()
+    return out
+
+
+# -- linalg (ref: bench/prims/linalg/*.cu) ----------------------------------
+
+@bench("linalg/add")
+def bench_add():
+    from raft_tpu.linalg import add
+
+    x = _data(SIZES["rows"], SIZES["cols"])
+    y = _data(SIZES["rows"], SIZES["cols"], seed=1)
+    f = jax.jit(lambda a, b: add(None, a, b))
+    return [run_case("linalg/add", f, x, y, bytes_moved=x.size * 4 * 3)]
+
+
+@bench("linalg/reduce")
+def bench_reduce():
+    from raft_tpu.linalg import reduce as reduce_fn
+
+    x = _data(SIZES["rows"], SIZES["cols"])
+    out = []
+    for apply, nm in (("along_columns", "strided"),
+                      ("along_rows", "coalesced")):
+        f = jax.jit(functools.partial(reduce_fn, None, apply=apply))
+        out.append(run_case(f"linalg/reduce_{nm}", f, x,
+                            bytes_moved=x.size * 4))
+    return out
+
+
+@bench("linalg/norm")
+def bench_norm():
+    from raft_tpu.linalg import row_norm
+
+    x = _data(SIZES["rows"], SIZES["cols"])
+    f = jax.jit(functools.partial(row_norm, None, norm_type="l2"))
+    return [run_case("linalg/row_norm_l2", f, x, bytes_moved=x.size * 4)]
+
+
+@bench("linalg/matrix_vector_op")
+def bench_mvo():
+    from raft_tpu.linalg import matrix_vector_op
+
+    x = _data(SIZES["rows"], SIZES["cols"])
+    v = _data(1, SIZES["cols"], seed=2)[0]
+    f = jax.jit(lambda m, vec: matrix_vector_op(None, m, vec,
+                                                op=lambda a, b: a + b))
+    return [run_case("linalg/matrix_vector_op", f, x, v,
+                     bytes_moved=x.size * 4 * 2)]
+
+
+@bench("linalg/map_then_reduce")
+def bench_map_then_reduce():
+    from raft_tpu.linalg import map_then_reduce
+
+    x = _data(SIZES["rows"], SIZES["cols"])
+    f = jax.jit(functools.partial(map_then_reduce, None, jnp.abs))
+    return [run_case("linalg/map_then_reduce", f, x,
+                     bytes_moved=x.size * 4)]
+
+
+@bench("linalg/reduce_rows_by_key")
+def bench_rrbk():
+    from raft_tpu.linalg import reduce_rows_by_key
+
+    x = _data(SIZES["rows"], SIZES["cols"])
+    keys = jnp.asarray(
+        np.random.default_rng(3).integers(0, 32, SIZES["rows"])
+        .astype(np.int32))
+    f = jax.jit(lambda d, k: reduce_rows_by_key(None, d, k, 32))
+    return [run_case("linalg/reduce_rows_by_key", f, x, keys,
+                     bytes_moved=x.size * 4)]
+
+
+@bench("linalg/transpose")
+def bench_transpose():
+    from raft_tpu.linalg import transpose
+
+    x = _data(SIZES["rows"], SIZES["cols"])
+    f = jax.jit(functools.partial(transpose, None))
+    return [run_case("linalg/transpose", f, x,
+                     bytes_moved=x.size * 4 * 2)]
+
+
+@bench("linalg/gemm")
+def bench_gemm():
+    from raft_tpu.linalg import gemm
+
+    n = 2048
+    a = _data(n, n)
+    b = _data(n, n, seed=4)
+    f = jax.jit(functools.partial(gemm, None))
+    return [run_case("linalg/gemm_2048", f, a, b, flops=2 * n ** 3)]
+
+
+# -- matrix (ref: bench/prims/matrix/*.cu) ----------------------------------
+
+@bench("matrix/select_k")
+def bench_select_k():
+    from raft_tpu.matrix import select_k
+
+    x = _data(64, SIZES["rows"])
+    out = []
+    for k in (16, SIZES["k"]):
+        f = jax.jit(functools.partial(select_k, None, k=k,
+                                      select_min=True))
+        out.append(run_case(f"matrix/select_k_k{k}", f, x,
+                            items=x.shape[0] * x.shape[1], k=k,
+                            batch=x.shape[0], length=x.shape[1]))
+    return out
+
+
+@bench("matrix/argmin")
+def bench_argmin():
+    from raft_tpu.matrix import argmin
+
+    x = _data(SIZES["rows"], SIZES["cols"])
+    f = jax.jit(functools.partial(argmin, None))
+    return [run_case("matrix/argmin", f, x, items=x.shape[0],
+                     bytes_moved=x.size * 4)]
+
+
+@bench("matrix/gather")
+def bench_gather():
+    from raft_tpu.matrix import gather
+
+    x = _data(SIZES["rows"], SIZES["cols"])
+    idx = jnp.asarray(np.random.default_rng(5).integers(
+        0, SIZES["rows"], SIZES["rows"] // 2).astype(np.int32))
+    f = jax.jit(functools.partial(gather, None))
+    return [run_case("matrix/gather", f, x, idx,
+                     bytes_moved=idx.shape[0] * SIZES["cols"] * 4 * 2)]
+
+
+# -- random (ref: bench/prims/random/*.cu) ----------------------------------
+
+@bench("random/rng")
+def bench_rng():
+    from raft_tpu.random import RngState, uniform
+
+    n = SIZES["rows"] * SIZES["cols"]
+
+    def gen():
+        return uniform(None, RngState(0), (n,))
+
+    return [run_case("random/uniform", gen, items=n,
+                     bytes_moved=n * 4)]
+
+
+@bench("random/make_blobs")
+def bench_make_blobs():
+    from raft_tpu.random import RngState, make_blobs
+
+    def gen():
+        return make_blobs(None, RngState(1), SIZES["rows"], 64,
+                          n_clusters=16)
+
+    return [run_case("random/make_blobs", gen,
+                     items=SIZES["rows"] * 64)]
+
+
+@bench("random/permute")
+def bench_permute():
+    from raft_tpu.random import RngState, permute_rows
+
+    x = _data(SIZES["rows"], SIZES["cols"])
+
+    def gen(x):
+        return permute_rows(None, RngState(2), x)
+
+    return [run_case("random/permute_rows", gen, x,
+                     bytes_moved=x.size * 4 * 2)]
+
+
+@bench("random/subsample")
+def bench_subsample():
+    from raft_tpu.random import RngState, excess_subsample
+
+    n = SIZES["rows"] * 4
+
+    def gen():
+        return excess_subsample(None, RngState(3), n // 8, n)
+
+    return [run_case("random/excess_subsample", gen, items=n // 8)]
+
+
+# -- sparse (ref: bench/prims/sparse/*.cu) ----------------------------------
+
+@bench("sparse/bitmap_to_csr")
+def bench_bitmap_to_csr():
+    from raft_tpu.core.bitset import Bitmap
+    from raft_tpu.sparse.convert import bitmap_to_csr
+
+    rows, cols = 2048, 2048
+    rng = np.random.default_rng(6)
+    dense = rng.uniform(size=(rows, cols)) < 0.05
+    bm = Bitmap.from_bool_matrix(jnp.asarray(dense))
+
+    def conv(bm):
+        return bitmap_to_csr(bm).indptr
+
+    return [run_case("sparse/bitmap_to_csr", conv, bm,
+                     items=int(dense.sum()), density=0.05)]
+
+
+@bench("sparse/spmv")
+def bench_spmv():
+    from raft_tpu.sparse.convert import dense_to_csr
+    from raft_tpu.sparse.linalg import spmv
+
+    rng = np.random.default_rng(7)
+    n = 4096
+    dense = rng.normal(size=(n, n)).astype(np.float32)
+    dense[rng.uniform(size=(n, n)) > 0.02] = 0.0
+    csr = dense_to_csr(jnp.asarray(dense))
+    x = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    nnz = int(csr.data.shape[0])
+
+    def f(x):
+        return spmv(csr, x)
+
+    return [run_case("sparse/spmv_4096_d02", f, x, flops=2 * nnz,
+                     nnz=nnz)]
+
+
+@bench("sparse/select_k_csr")
+def bench_select_k_csr():
+    from raft_tpu.sparse.convert import dense_to_csr
+    from raft_tpu.sparse.matrix import select_k
+
+    rng = np.random.default_rng(8)
+    rows, cols = 1024, 4096
+    dense = rng.normal(size=(rows, cols)).astype(np.float32)
+    dense[rng.uniform(size=(rows, cols)) > 0.1] = 0.0
+    csr = dense_to_csr(jnp.asarray(dense))
+
+    def f():
+        v, i = select_k(None, csr, k=32, select_min=False)
+        return v
+
+    return [run_case("sparse/select_k_csr", f, items=rows, k=32)]
+
+
+# -- distance / cluster (BASELINE north-star rebuild layer) -----------------
+
+@bench("distance/pairwise_l2")
+def bench_pairwise():
+    from raft_tpu.distance.pairwise import pairwise_distance, DistanceType
+
+    x = _data(4096, 256)
+    y = _data(1024, 256, seed=9)
+    f = jax.jit(functools.partial(pairwise_distance, None,
+                                  metric=DistanceType.L2Expanded))
+    flops = 2 * x.shape[0] * y.shape[0] * x.shape[1]
+    return [run_case("distance/pairwise_l2_4096x1024x256", f, x, y,
+                     flops=flops)]
+
+
+@bench("cluster/kmeans_iter")
+def bench_kmeans():
+    from raft_tpu.cluster.kmeans import lloyd_step
+
+    x = _data(SIZES["rows"], 64)
+    c = _data(256, 64, seed=10)
+    f = jax.jit(functools.partial(lloyd_step, n_clusters=256))
+    flops = 2 * x.shape[0] * 256 * 64
+    return [run_case("cluster/lloyd_iter", f, x, c, flops=flops,
+                     rows=x.shape[0], k=256)]
+
+
+# -- util (ref: bench/prims/util/popc.cu) -----------------------------------
+
+@bench("util/popc")
+def bench_popc():
+    from raft_tpu.core.bitset import popc
+
+    n = SIZES["rows"] * 32
+    words = jnp.asarray(np.random.default_rng(11).integers(
+        0, 2 ** 31, n // 32, dtype=np.int64).astype(np.int32))
+    f = jax.jit(popc)
+    return [run_case("util/popc", f, words, bytes_moved=n // 8)]

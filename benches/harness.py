@@ -1,0 +1,80 @@
+"""Microbenchmark harness (ref: cpp/bench/prims/common/benchmark.hpp:34-60
+— google-benchmark fixture with CUDA event timing + RMM pool setup).
+
+TPU translation: wall-clock around `block_until_ready` after an untimed
+warmup that triggers jit compilation (the analogue of the reference's
+warmup kernel launch), median-of-repeats reporting, one JSON line per
+case so the driver and CI can diff runs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+
+
+@dataclass
+class BenchResult:
+    name: str
+    median_ms: float
+    best_ms: float
+    repeats: int
+    items_per_s: Optional[float] = None
+    gbytes_per_s: Optional[float] = None
+    gflops: Optional[float] = None
+    params: dict = field(default_factory=dict)
+
+    def json_line(self) -> str:
+        out = {"bench": self.name, "median_ms": round(self.median_ms, 4),
+               "best_ms": round(self.best_ms, 4), "repeats": self.repeats}
+        if self.items_per_s is not None:
+            out["items_per_s"] = f"{self.items_per_s:.3e}"
+        if self.gbytes_per_s is not None:
+            out["GB_per_s"] = round(self.gbytes_per_s, 2)
+        if self.gflops is not None:
+            out["GFLOP_per_s"] = round(self.gflops, 2)
+        out.update(self.params)
+        return json.dumps(out)
+
+
+def run_case(name: str, fn: Callable, *args, repeats: int = 5,
+             warmup: int = 2, items: Optional[int] = None,
+             bytes_moved: Optional[int] = None,
+             flops: Optional[int] = None, **params) -> BenchResult:
+    """Time fn(*args) with warmup + median-of-repeats."""
+    for _ in range(warmup):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    med = times[len(times) // 2]
+    res = BenchResult(
+        name=name, median_ms=med * 1e3, best_ms=times[0] * 1e3,
+        repeats=repeats, params=params)
+    if items is not None:
+        res.items_per_s = items / med
+    if bytes_moved is not None:
+        res.gbytes_per_s = bytes_moved / med / 1e9
+    if flops is not None:
+        res.gflops = flops / med / 1e9
+    return res
+
+
+# global registry: name -> zero-arg callable returning list[BenchResult]
+REGISTRY: dict = {}
+
+
+def bench(name: str):
+    def deco(f):
+        REGISTRY[name] = f
+        return f
+    return deco

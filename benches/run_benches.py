@@ -1,0 +1,52 @@
+"""Bench runner (ref: cpp/bench/prims/ executables via `./build.sh
+bench-prims`; docs/source/build.md:171-183).
+
+Usage:
+    python benches/run_benches.py                 # all, small sizes
+    python benches/run_benches.py --filter linalg # substring filter
+    python benches/run_benches.py --size full     # production sizes
+Prints one JSON line per case.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--filter", default="", help="substring filter")
+    ap.add_argument("--size", choices=("small", "full"), default="small")
+    ap.add_argument("--list", action="store_true")
+    args = ap.parse_args()
+
+    from benches import bench_prims
+    from benches.harness import REGISTRY
+
+    if args.size == "full":
+        bench_prims.SIZES = bench_prims._FULL
+
+    names = sorted(n for n in REGISTRY if args.filter in n)
+    if args.list:
+        print("\n".join(names))
+        return
+
+    import jax
+    print(f"# devices: {[d.device_kind for d in jax.devices()]}",
+          file=sys.stderr)
+    for name in names:
+        try:
+            for result in REGISTRY[name]():
+                print(result.json_line(), flush=True)
+        except Exception as e:   # keep the sweep going, report the failure
+            print(f"# {name} FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,19 +34,32 @@ def _build_and_load():
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
     so_path = os.path.join(_HERE, f"libraft_tpu_native_{digest}.so")
     if not os.path.exists(so_path):
+        # pid-suffixed temp + atomic rename: concurrent builders (multi-rank
+        # hosts, pytest-xdist) each write their own file and whoever renames
+        # last wins with an identical artifact
+        tmp = f"{so_path}.tmp{os.getpid()}"
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-               "-fvisibility=hidden", "-pthread", _SRC, "-o",
-               so_path + ".tmp"]
+               "-fvisibility=hidden", "-pthread", _SRC, "-o", tmp]
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True,
                            timeout=300)
-            os.replace(so_path + ".tmp", so_path)
+            os.replace(tmp, so_path)
         except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
                 FileNotFoundError) as e:
             _lib_err = getattr(e, "stderr", str(e)) or str(e)
             return None
-    lib = ctypes.CDLL(so_path)
-    _bind(lib)
+    try:
+        lib = ctypes.CDLL(so_path)
+        _bind(lib)
+    except OSError as e:
+        # corrupt cached artifact: drop it so the next import rebuilds,
+        # and report unavailable instead of raising out of get_lib()
+        _lib_err = str(e)
+        try:
+            os.remove(so_path)
+        except OSError:
+            pass
+        return None
     return lib
 
 
@@ -77,6 +90,7 @@ def _bind(lib):
     lib.rt_npy_read_header.restype = c.c_int64
     lib.rt_npy_read_header.argtypes = [c.c_char_p, c.c_char_p,
                                        c.POINTER(c.c_int64),
+                                       c.POINTER(c.c_int),
                                        c.POINTER(c.c_int)]
     lib.rt_npy_read_data.restype = c.c_int
     lib.rt_npy_read_data.argtypes = [c.c_char_p, c.c_int64, c.c_void_p,

@@ -308,9 +308,10 @@ RT_EXPORT int rt_npy_write(const char* path, const char* descr,
 }
 
 // Parses the header; returns data offset, fills descr (caller buffer of 16),
-// shape (caller buffer of 32) and ndim. Returns <0 on error.
+// shape (caller buffer of 32), ndim and fortran_order. Returns <0 on error.
 RT_EXPORT int64_t rt_npy_read_header(const char* path, char* descr,
-                                     int64_t* shape, int* ndim) {
+                                     int64_t* shape, int* ndim,
+                                     int* fortran_order) {
   std::FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
   unsigned char magic[8];
@@ -330,6 +331,7 @@ RT_EXPORT int64_t rt_npy_read_header(const char* path, char* descr,
     return -4;
   }
   std::fclose(f);
+  *fortran_order = dict.find("'fortran_order': True") != std::string::npos;
   auto dpos = dict.find("'descr':");
   auto q1 = dict.find('\'', dpos + 8);
   auto q2 = dict.find('\'', q1 + 1);
@@ -394,7 +396,12 @@ struct ThreadPool {
             jobs.pop_front();
           }
           job();
-          completed += 1;
+          {
+            // increment under the mutex or a waiter that just evaluated
+            // the predicate could miss this notify (lost wakeup)
+            std::lock_guard<std::mutex> g(lock);
+            completed += 1;
+          }
           done_cv.notify_all();
         }
       });

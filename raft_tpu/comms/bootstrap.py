@@ -28,7 +28,8 @@ from raft_tpu.core import logger
 from raft_tpu.core import resources as core_res
 from raft_tpu.comms.comms import MeshComms
 
-# sessionId -> {"comms": Comms, "handles": {rank: Resources}, ...}
+# sessionId -> {"comms": weakref.ref(Comms), "handles": {rank: Resources},
+# ...}; get_raft_comm_state dereferences the weakref before returning
 # (ref: comms.py:257 get_raft_comm_state's per-worker state dict)
 _session_state: dict = {}
 
@@ -146,5 +147,12 @@ def local_handle(sessionId, rank: int = 0):
 
 
 def get_raft_comm_state(sessionId):
-    """Per-session state dict (ref: comms.py:257)."""
-    return _session_state.get(sessionId, {})
+    """Per-session state dict (ref: comms.py:257). The "comms" entry is
+    returned as the live Comms object (or None if it has been collected),
+    matching the reference contract."""
+    state = _session_state.get(sessionId)
+    if state is None:
+        return {}
+    out = dict(state)
+    out["comms"] = state["comms"]()
+    return out

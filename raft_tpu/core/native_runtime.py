@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -51,27 +52,58 @@ class TrackedHostPool:
             raise RuntimeError(
                 f"native runtime unavailable: {_native.build_error()}")
         self._pool = self._lib.rt_pool_create(1 if use_mmap else 0)
-        self._ptrs: dict[int, int] = {}
+        # base address -> (ptr, weakref.finalize); keyed by the allocation's
+        # data address (stable), not id() (recyclable)
+        self._ptrs: dict[int, tuple] = {}
         self._cb = None  # keep ctypes callback alive
         self._lock = threading.Lock()
+        # finalizers consult this shared cell so an array collected after
+        # close() doesn't touch the destroyed native pool
+        self._alive = {"pool": self._pool, "lib": self._lib}
 
     def allocate(self, shape, dtype=np.float32) -> np.ndarray:
         dtype = np.dtype(dtype)
-        nbytes = int(np.prod(shape)) * dtype.itemsize
-        ptr = self._lib.rt_pool_alloc(self._pool, max(nbytes, 1))
+        count = int(np.prod(shape))
+        if count == 0:
+            return np.empty(shape, dtype)   # no native backing needed
+        nbytes = count * dtype.itemsize
+        ptr = self._lib.rt_pool_alloc(self._pool, nbytes)
         if not ptr:
             raise MemoryError(f"native pool allocation of {nbytes}B failed")
-        buf = (ctypes.c_char * max(nbytes, 1)).from_address(ptr)
+        buf = (ctypes.c_char * nbytes).from_address(ptr)
         arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+        alive = self._alive
+        lock = self._lock
+        ptrs = self._ptrs
+
+        def _finalize(addr=ptr):
+            # auto-free when the array is GC'd without release()
+            with lock:
+                entry = ptrs.pop(addr, None)
+            if entry is not None and alive["pool"]:
+                alive["lib"].rt_pool_dealloc(alive["pool"], addr)
+
+        fin = weakref.finalize(arr, _finalize)
+        fin.atexit = False
         with self._lock:
-            self._ptrs[id(arr)] = ptr
+            self._ptrs[ptr] = (ptr, fin)
         return arr
 
     def release(self, arr: np.ndarray) -> None:
+        """Free an array returned by allocate(). Views/copies are rejected
+        loudly rather than silently leaking."""
+        if arr.size == 0:
+            return
+        addr = arr.__array_interface__["data"][0]
         with self._lock:
-            ptr = self._ptrs.pop(id(arr), None)
-        if ptr is not None:
-            self._lib.rt_pool_dealloc(self._pool, ptr)
+            entry = self._ptrs.pop(addr, None)
+        if entry is None:
+            raise ValueError(
+                "release() got an array this pool did not allocate (or a "
+                "view offset from the allocation base)")
+        ptr, fin = entry
+        fin.detach()
+        self._lib.rt_pool_dealloc(self._pool, ptr)
 
     def stats(self) -> dict:
         out = (ctypes.c_int64 * 4)()
@@ -95,6 +127,11 @@ class TrackedHostPool:
 
     def close(self) -> None:
         if getattr(self, "_pool", None):
+            with self._lock:
+                for _, fin in self._ptrs.values():
+                    fin.detach()   # pool destroy frees everything at once
+                self._ptrs.clear()
+            self._alive["pool"] = None
             self._lib.rt_pool_destroy(self._pool)
             self._pool = None
 
@@ -112,6 +149,9 @@ class NativeResourceMonitor:
     def __init__(self, pool: TrackedHostPool, csv_path: str,
                  interval_ms: int = 50):
         self._lib = _native.get_lib()
+        # hold the pool: the sampler thread reads its native state, so the
+        # pool must outlive the monitor even if the caller drops it
+        self._pool_ref = pool
         self._mon = self._lib.rt_monitor_start(
             pool._pool, csv_path.encode(), interval_ms)
         if not self._mon:
@@ -124,6 +164,7 @@ class NativeResourceMonitor:
         if self._mon:
             self._lib.rt_monitor_stop(self._mon)
             self._mon = None
+            self._pool_ref = None
 
 
 def npy_save(path: str, arr: np.ndarray) -> None:
@@ -152,8 +193,9 @@ def npy_load(path: str) -> np.ndarray:
     descr = ctypes.create_string_buffer(16)
     shape = (ctypes.c_int64 * 32)()
     ndim = ctypes.c_int(0)
+    fortran = ctypes.c_int(0)
     off = lib.rt_npy_read_header(path.encode(), descr, shape,
-                                 ctypes.byref(ndim))
+                                 ctypes.byref(ndim), ctypes.byref(fortran))
     if off < 0:
         raise IOError(f"native npy header parse failed with code {off}")
     dtype = _DESCR_INV.get(descr.value.decode())
@@ -166,6 +208,9 @@ def npy_load(path: str) -> np.ndarray:
                               out.nbytes)
     if rc != 0:
         raise IOError(f"native npy read failed with code {rc}")
+    if fortran.value:
+        # bytes on disk are column-major: reinterpret, preserving shape
+        out = out.reshape(shp[::-1]).T
     return out
 
 
@@ -184,6 +229,10 @@ class NativeThreadPool:
                       chunk_bytes: int = 8 << 20) -> None:
         if dst.nbytes != src.nbytes:
             raise ValueError("size mismatch")
+        if not dst.flags["C_CONTIGUOUS"]:
+            raise ValueError(
+                "dst must be C-contiguous: the native memcpy writes a flat "
+                "byte range and would corrupt a strided view's base buffer")
         self._lib.rt_threadpool_memcpy(
             self._tp, dst.ctypes.data_as(ctypes.c_void_p),
             np.ascontiguousarray(src).ctypes.data_as(ctypes.c_void_p),
