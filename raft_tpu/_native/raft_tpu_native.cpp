@@ -421,8 +421,10 @@ struct ThreadPool {
     {
       std::lock_guard<std::mutex> g(lock);
       jobs.push_back(std::move(job));
+      // under the mutex, like `completed` — otherwise a waiter can observe
+      // completed == submitted+1, re-sleep, and miss the final notify
+      submitted += 1;
     }
-    submitted += 1;
     cv.notify_one();
   }
 

@@ -114,16 +114,16 @@ class Comms:
             handles[rank] = handle
             comms_views[rank] = view
 
-        # weakref: the registry must not keep the Comms object alive, or
-        # __del__-driven cleanup could never run and un-destroyed sessions
-        # would accumulate for the process lifetime
-        _session_state[self.sessionId] = {
-            "comms": weakref.ref(self),
-            "mesh": mesh,
-            "nranks": nranks,
-            "handles": handles,
-            "comms_views": comms_views,
-        }
+        # weakref inside _SessionState: the registry must not keep the Comms
+        # object alive, or __del__-driven cleanup could never run and
+        # un-destroyed sessions would accumulate for the process lifetime
+        _session_state[self.sessionId] = _SessionState(
+            comms=weakref.ref(self),
+            mesh=mesh,
+            nranks=nranks,
+            handles=handles,
+            comms_views=comms_views,
+        )
         self._initialized = True
         if self._verbose:
             logger.info("Initialized comms session over %d devices", nranks)
@@ -146,13 +146,26 @@ def local_handle(sessionId, rank: int = 0):
     return None if state is None else state["handles"].get(rank)
 
 
+class _SessionState(dict):
+    """Live, mutable per-session state (the reference contract: rank-loop
+    code stashes values in this dict between calls, comms.py:257). The
+    "comms" slot is stored as a weakref (so the registry can't pin the
+    Comms object) but reads back as the live object or None."""
+
+    def __getitem__(self, key):
+        val = super().__getitem__(key)
+        if key == "comms" and isinstance(val, weakref.ref):
+            return val()
+        return val
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+
 def get_raft_comm_state(sessionId):
-    """Per-session state dict (ref: comms.py:257). The "comms" entry is
-    returned as the live Comms object (or None if it has been collected),
-    matching the reference contract."""
-    state = _session_state.get(sessionId)
-    if state is None:
-        return {}
-    out = dict(state)
-    out["comms"] = state["comms"]()
-    return out
+    """Per-session LIVE state dict (ref: comms.py:257) — mutations persist
+    across calls. Empty dict for unknown/destroyed sessions."""
+    return _session_state.get(sessionId, {})

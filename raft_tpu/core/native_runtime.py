@@ -71,19 +71,23 @@ class TrackedHostPool:
         if not ptr:
             raise MemoryError(f"native pool allocation of {nbytes}B failed")
         buf = (ctypes.c_char * nbytes).from_address(ptr)
-        arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+        flat = np.frombuffer(buf, dtype=dtype)
+        arr = flat.reshape(shape)
         alive = self._alive
         lock = self._lock
         ptrs = self._ptrs
 
         def _finalize(addr=ptr):
-            # auto-free when the array is GC'd without release()
+            # auto-free when the last view of the allocation is GC'd
             with lock:
                 entry = ptrs.pop(addr, None)
             if entry is not None and alive["pool"]:
                 alive["lib"].rt_pool_dealloc(alive["pool"], addr)
 
-        fin = weakref.finalize(arr, _finalize)
+        # The finalizer must hang off the frombuffer base: every view of
+        # `arr` keeps `flat` alive through .base, whereas `arr` itself
+        # (a reshape view) can be collected while views of the memory live.
+        fin = weakref.finalize(flat, _finalize)
         fin.atexit = False
         with self._lock:
             self._ptrs[ptr] = (ptr, fin)

@@ -368,3 +368,58 @@ def default_resources(res: Optional[Resources] = None) -> Resources:
     functional style makes the implicit default the common case).
     """
     return res if res is not None else get_device_resources()
+
+
+class DeviceResourcesSNMG(DeviceResources):
+    """Single-process multi-device handle: a root rank plus one child
+    handle per device, with rank-loop helpers.
+
+    Reference: ``device_resources_snmg`` (core/device_resources_snmg.hpp:36,
+    44,91-144) keeps a `raft::resources` per GPU and switches the current
+    device while looping ranks; the TPU analogue keeps one child handle per
+    mesh device — device switching is replaced by the mesh axis, and
+    ``set_memory_pool`` (per-device RMM pools) by XLA's own allocator, so
+    it is accepted and ignored.
+    """
+
+    def __init__(self, devices=None, seed: int = 0,
+                 axis_name: str = "data"):
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if not devs:
+            raise ValueError("no devices for SNMG handle")
+        import numpy as _np
+        from jax.sharding import Mesh as _Mesh
+
+        mesh = _Mesh(_np.asarray(devs), axis_names=(axis_name,))
+        super().__init__(device=devs[0], mesh=mesh, seed=seed)
+        self._axis_name = axis_name
+        self._children = [
+            DeviceResources(device=d, mesh=mesh, seed=seed + i)
+            for i, d in enumerate(devs)
+        ]
+        from raft_tpu.comms.bootstrap import inject_comms_on_handle
+
+        shared = None
+        mailbox = None
+        for rank, child in enumerate(self._children):
+            view = inject_comms_on_handle(child, mesh, axis_name, rank,
+                                          _shared=shared, _mailbox=mailbox)
+            shared = view._shared
+            mailbox = view._mailbox
+        set_comms(self, get_comms(self._children[0]))
+
+    @property
+    def n_ranks(self) -> int:
+        return len(self._children)
+
+    def rank_resources(self, rank: int) -> DeviceResources:
+        """Child handle for one rank (ref: the per-GPU resources vector,
+        device_resources_snmg.hpp:44 + multi_gpu.hpp:66-112)."""
+        return self._children[rank]
+
+    def __iter__(self):
+        return iter(self._children)
+
+    def set_memory_pool(self, percent_of_free: int) -> None:
+        """Accepted for parity (ref: device_resources_snmg.hpp:127-144);
+        XLA owns device memory on TPU, so this is a no-op."""

@@ -55,6 +55,15 @@ def _l2_tile_kernel(x_ref, y_ref, out_ref):
     out_ref[:] = xn - 2.0 * cross + yn.T
 
 
+def _inside_shard_map(*arrays) -> bool:
+    """True when tracing inside shard_map (operands carry varying mesh
+    axes). The Pallas kernels fall back to the jnp formulation there: the
+    per-shard problem is tile-sized already and pallas_call's vma plumbing
+    under the interpreter rejects replicated×varying mixes; XLA fuses the
+    jnp path onto the MXU just as well at shard granularity."""
+    return any(bool(getattr(jax.typeof(a), "vma", None)) for a in arrays)
+
+
 @functools.partial(jax.jit, static_argnames=("tm", "tn"))
 def _pairwise_l2_padded(x, y, tm: int, tn: int):
     m, k = x.shape
@@ -87,13 +96,19 @@ def pairwise_l2_pallas(x, y, sqrt: bool = False,
     y = jnp.asarray(y)
     m, k = x.shape
     n = y.shape[0]
-    tm = min(tm, round_up_to_multiple(m, 8))
-    tn = min(tn, round_up_to_multiple(n, 128))
-    mp = round_up_to_multiple(m, tm)
-    np_ = round_up_to_multiple(n, tn)
-    kp = round_up_to_multiple(k, 128)
-    out = _pairwise_l2_padded(_pad2(x, mp, kp), _pad2(y, np_, kp), tm, tn)
-    out = out[:m, :n]
+    if _inside_shard_map(x, y):
+        out = (jnp.sum(x * x, 1, keepdims=True)
+               - 2.0 * jnp.dot(x, y.T, preferred_element_type=jnp.float32)
+               + jnp.sum(y * y, 1)[None, :])
+    else:
+        tm = min(tm, round_up_to_multiple(m, 8))
+        tn = min(tn, round_up_to_multiple(n, 128))
+        mp = round_up_to_multiple(m, tm)
+        np_ = round_up_to_multiple(n, tn)
+        kp = round_up_to_multiple(k, 128)
+        out = _pairwise_l2_padded(_pad2(x, mp, kp), _pad2(y, np_, kp),
+                                  tm, tn)
+        out = out[:m, :n]
     out = jnp.maximum(out, 0.0)
     return jnp.sqrt(out) if sqrt else out
 
@@ -169,23 +184,45 @@ def _fused_l2_argmin_padded(x, y, tm: int, tn: int, n_valid: int):
     )(x, y)
 
 
-def fused_l2_argmin_pallas(x, y, tm: int = 512, tn: int = 256
+def fused_l2_argmin_pallas(x, y, tm: int = 1024, tn: int = 256
                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(min_dist², argmin) of each row of x against rows of y, fused.
 
     Never materializes the m×n distance matrix: HBM traffic is O(mk + nk + m)
     instead of O(mn) — the property that makes Lloyd iterations bandwidth-
     friendly at k=4096.
+
+    ``tm`` is a hint: honored in interpreter mode, but rounded up to a
+    1024-multiple on hardware (XLA's 1-D layout constraint — see inline
+    comment). Workloads whose forced tiles exceed the VMEM budget fall
+    back to the jnp formulation, as do shard_map-traced calls.
     """
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     m, k = x.shape
     n = y.shape[0]
-    tm = min(tm, round_up_to_multiple(m, 8))
     tn = min(tn, round_up_to_multiple(n, 128))
+    kp = round_up_to_multiple(k, 128)
+    if use_interpret():
+        tm = min(tm, round_up_to_multiple(m, 8))   # honor the caller's tile
+    else:
+        # Compiled path: the 1-D val/idx outputs are blocked (tm,) and XLA
+        # lays large 1-D f32/i32 arrays out with tile T(1024), so tm must
+        # be a 1024-multiple (verified on v5e: T(512) block fails Mosaic
+        # layout checks). Callers tune VMEM via tn/k, not tm.
+        tm = max(1024, round_up_to_multiple(tm, 1024))
+    # Fall back to the jnp formulation when inside shard_map (see
+    # _inside_shard_map) or when the forced row tile would blow VMEM
+    # (x tile + y tile at ~16 MB/core budget; large-k workloads).
+    vmem_bytes = (tm * kp + tn * kp) * 4
+    if _inside_shard_map(x, y) or vmem_bytes > 12 * 1024 * 1024:
+        d = (jnp.sum(x * x, 1, keepdims=True)
+             - 2.0 * jnp.dot(x, y.T, preferred_element_type=jnp.float32)
+             + jnp.sum(y * y, 1)[None, :])
+        return (jnp.maximum(jnp.min(d, axis=1), 0.0),
+                jnp.argmin(d, axis=1).astype(jnp.int32))
     mp = round_up_to_multiple(m, tm)
     np_ = round_up_to_multiple(n, tn)
-    kp = round_up_to_multiple(k, 128)
     val, idx = _fused_l2_argmin_padded(_pad2(x, mp, kp), _pad2(y, np_, kp),
                                        tm, tn, n)
     return jnp.maximum(val[:m], 0.0), idx[:m]

@@ -31,6 +31,16 @@ def contingency_matrix(y_true, y_pred, n_classes_true: int = None,
     y_pred = jnp.asarray(y_pred)
     nt = _num_classes(y_true, n_classes_true)
     np_ = _num_classes(y_pred, n_classes_pred)
+    # With an explicit (too-small) class count, out-of-range labels would be
+    # silently DROPPED by the scatter-add under jit; validate eagerly when
+    # the labels are concrete so the error is loud where it can be.
+    import jax as _jax
+    if not isinstance(y_true, _jax.core.Tracer):
+        mt, mp = int(jnp.max(y_true)), int(jnp.max(y_pred))
+        if mt >= nt or mp >= np_:
+            raise ValueError(
+                f"labels exceed the class count: max labels ({mt}, {mp}) "
+                f"vs n_classes ({nt}, {np_})")
     flat = y_true.astype(jnp.int32) * np_ + y_pred.astype(jnp.int32)
     out = jnp.zeros((nt * np_,), jnp.result_type(int))
     out = out.at[flat].add(1)
@@ -42,11 +52,14 @@ def _comb2(x):
     return x * (x - 1.0) / 2.0
 
 
-def rand_index(y_a, y_b):
+def rand_index(y_a, y_b, n_classes: int = None):
     """Rand index. Closed form over the contingency table (equivalent to the
     reference's O(n^2) pair kernel, stats/detail/rand_index.cuh which the
-    header itself flags for this optimisation)."""
-    c = contingency_matrix(y_a, y_b)
+    header itself flags for this optimisation).
+
+    Pass ``n_classes`` to make the function jit-traceable (class counts
+    are shape-determining)."""
+    c = contingency_matrix(y_a, y_b, n_classes, n_classes)
     n = jnp.asarray(y_a).shape[0]
     sum_ij = jnp.sum(_comb2(c))
     sum_a = jnp.sum(_comb2(jnp.sum(c, axis=1)))
@@ -56,9 +69,11 @@ def rand_index(y_a, y_b):
     return agreements / total
 
 
-def adjusted_rand_index(y_a, y_b):
-    """Corrected-for-chance Rand index. Ref: stats/adjusted_rand_index.cuh."""
-    c = contingency_matrix(y_a, y_b)
+def adjusted_rand_index(y_a, y_b, n_classes: int = None):
+    """Corrected-for-chance Rand index. Ref: stats/adjusted_rand_index.cuh.
+
+    Pass ``n_classes`` to make the function jit-traceable."""
+    c = contingency_matrix(y_a, y_b, n_classes, n_classes)
     n = jnp.asarray(y_a).shape[0]
     sum_ij = jnp.sum(_comb2(c))
     sum_a = jnp.sum(_comb2(jnp.sum(c, axis=1)))

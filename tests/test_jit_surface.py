@@ -1,0 +1,167 @@
+"""jit-surface tier: every major primitive must trace and compile under
+jax.jit with no concrete-value leaks (ref test model: the EXT_HEADERS
+compile-surface tests, cpp/tests/CMakeLists.txt:128-138 — 'does every
+public entry compile in isolation' — translated to XLA tracing).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def x64():
+    return np.random.default_rng(0).normal(size=(64, 16)).astype(np.float32)
+
+
+def _compiles(fn, *args):
+    """Assert fn jits end-to-end: trace, lower, compile, run."""
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    return out
+
+
+class TestLinalgJit:
+    def test_elementwise_and_reduce(self, x64):
+        from raft_tpu import linalg
+
+        _compiles(lambda a: linalg.add(None, a, a), x64)
+        _compiles(lambda a: linalg.reduce(None, a), x64)
+        _compiles(lambda a: linalg.row_norm(None, a, norm_type="l2"), x64)
+        _compiles(lambda a: linalg.normalize(None, a), x64)
+        _compiles(lambda a: linalg.map_then_reduce(None, jnp.abs, a), x64)
+
+    def test_decompositions(self, x64):
+        from raft_tpu import linalg
+
+        _compiles(lambda a: linalg.qr_get_qr(None, a), x64)
+        _compiles(lambda a: linalg.svd_qr(None, a), x64)
+        cov = x64.T @ x64
+        _compiles(lambda a: linalg.eig_dc(None, a), cov)
+
+    def test_gemm_dtypes(self, x64):
+        from raft_tpu.linalg import gemm
+
+        for dt in (jnp.float32, jnp.bfloat16):
+            a = x64.astype(dt)
+            _compiles(lambda p, q: gemm(None, p, q), a, a.T)
+
+
+class TestMatrixJit:
+    def test_select_k_static_k(self, x64):
+        from raft_tpu.matrix import select_k
+
+        f = functools.partial(select_k, None, k=4, select_min=True)
+        _compiles(f, x64)
+
+    def test_argminmax_gather(self, x64):
+        from raft_tpu.matrix import argmax, argmin, gather
+
+        _compiles(functools.partial(argmin, None), x64)
+        _compiles(functools.partial(argmax, None), x64)
+        idx = jnp.asarray([0, 5, 9], jnp.int32)
+        _compiles(functools.partial(gather, None), x64, idx)
+
+
+class TestStatsJit:
+    def test_moments_and_metrics(self, x64):
+        from raft_tpu import stats
+
+        _compiles(lambda a: stats.meanvar(a), x64)
+        _compiles(lambda a: stats.cov(a), x64)
+        _compiles(lambda a: stats.minmax(a), x64)
+        labels = jnp.asarray(np.random.default_rng(1).integers(
+            0, 4, 64).astype(np.int32))
+        _compiles(lambda p, q: stats.adjusted_rand_index(p, q, n_classes=4),
+                  labels, labels)
+        _compiles(lambda p, q: stats.v_measure(p, q, n_classes=4),
+                  labels, labels)
+
+    def test_histogram_static_bins(self, x64):
+        from raft_tpu.stats import histogram
+
+        data = jnp.asarray((np.abs(x64) * 3).astype(np.int32))
+        _compiles(functools.partial(histogram, n_bins=8), data)
+
+
+class TestClusterDistanceJit:
+    def test_lloyd_step(self, x64):
+        from raft_tpu.cluster.kmeans import lloyd_step
+
+        c = x64[:8]
+        _compiles(functools.partial(lloyd_step, n_clusters=8), x64, c)
+
+    def test_pairwise_metrics(self, x64):
+        from raft_tpu.distance.pairwise import (DistanceType,
+                                                pairwise_distance)
+
+        for metric in (DistanceType.L2Expanded, DistanceType.L1,
+                       DistanceType.CosineExpanded):
+            _compiles(functools.partial(pairwise_distance, None,
+                                        metric=metric), x64, x64[:16])
+
+
+class TestSparseJit:
+    def test_spmv_spmm(self, x64):
+        from raft_tpu.sparse.convert import dense_to_csr
+        from raft_tpu.sparse.linalg import spmm, spmv
+
+        d = np.array(x64)
+        d[np.abs(d) < 0.5] = 0.0
+        csr = dense_to_csr(d)
+        v = jnp.asarray(np.ones(16, np.float32))
+        _compiles(lambda vv: spmv(csr, vv), v)
+        b = jnp.asarray(np.ones((16, 4), np.float32))
+        _compiles(lambda bb: spmm(csr, bb), b)
+
+
+class TestRandomJit:
+    def test_distributions(self):
+        from raft_tpu.random import RngState, normal, uniform
+
+        # RngState is host state; the jit boundary takes the raw key
+        key = RngState(0).next_key()
+
+        def gen(k):
+            import jax.random as jr
+            k1, k2 = jr.split(k)
+            return jr.uniform(k1, (32,)), jr.normal(k2, (32,))
+
+        _compiles(gen, key)
+        # and the wrapper API executes eagerly without tracer leaks
+        uniform(None, RngState(1), (8,))
+        normal(None, RngState(2), (8,))
+
+
+class TestMultichipJit:
+    def test_sharded_lloyd_compiles(self, mesh8):
+        """The full MNMG step lowers under shard_map on the 8-device mesh
+        (same path as __graft_entry__.dryrun_multichip)."""
+        import functools as ft
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from raft_tpu.cluster.kmeans import mnmg_lloyd_step
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(128, 16)).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+        step = jax.jit(jax.shard_map(
+            ft.partial(mnmg_lloyd_step, n_clusters=8, data_axis="data"),
+            mesh=mesh8,
+            in_specs=(P("data", None), P(None, None)),
+            out_specs=(P(None, None), P(), P("data")),
+        ))
+        with jax.sharding.use_mesh(mesh8) if hasattr(
+                jax.sharding, "use_mesh") else _nullcontext():
+            out = step(x, c)
+            jax.block_until_ready(out)
+
+
+def _nullcontext():
+    import contextlib
+
+    return contextlib.nullcontext()

@@ -1,0 +1,33 @@
+"""SNMG handle tests (ref test model: the reference exercises
+device_resources_snmg via its SNMG clique init,
+core/device_resources_snmg.hpp:102-126)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.resources import DeviceResourcesSNMG, get_comms
+
+
+class TestSNMG:
+    def test_rank_loop(self, mesh8):
+        snmg = DeviceResourcesSNMG(devices=list(mesh8.devices.ravel()))
+        assert snmg.n_ranks == 8
+        for rank, child in enumerate(snmg):
+            view = get_comms(child)
+            assert view.get_rank() == rank
+            assert view.get_size() == 8
+
+    def test_root_comms_and_noop_pool(self, mesh8):
+        snmg = DeviceResourcesSNMG(devices=list(mesh8.devices.ravel()))
+        assert get_comms(snmg).get_rank() == 0
+        snmg.set_memory_pool(80)   # parity no-op
+
+    def test_collective_through_rank_views(self, mesh8):
+        from raft_tpu.comms import perform_test_comms_allreduce
+
+        snmg = DeviceResourcesSNMG(devices=list(mesh8.devices.ravel()))
+        assert perform_test_comms_allreduce(get_comms(snmg.rank_resources(3)))
+
+    def test_empty_devices_rejected(self):
+        with pytest.raises(ValueError):
+            DeviceResourcesSNMG(devices=[])
