@@ -208,6 +208,17 @@ def kmeans_fit(res, params: KMeansParams, x,
 
     Host-driven convergence loop around the jitted `lloyd_step` — the same
     structure as the reference lineage's host loop enqueueing fused kernels.
+
+    >>> import numpy as np
+    >>> from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+    >>> x = np.concatenate([np.zeros((10, 2)), np.ones((10, 2)) * 9])
+    >>> x = (x + np.linspace(0, .1, 20)[:, None]).astype(np.float32)
+    >>> c, inertia, labels, n_iter = kmeans_fit(
+    ...     None, KMeansParams(n_clusters=2, seed=0), x)
+    >>> sorted(np.asarray(labels)[[0, 19]].tolist())   # two blobs split
+    [0, 1]
+    >>> bool(np.asarray(labels)[:10].std() == 0)
+    True
     """
     x = jnp.asarray(x)
     state = RngState(seed=params.seed)

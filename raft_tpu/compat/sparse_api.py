@@ -41,6 +41,14 @@ def eigsh(a, k: int = 6, v0=None, ncv: int = 0, maxiter: int = 4000,
     (ref: lanczos.pyx:85 — scipy.sparse.linalg.eigsh-compatible surface).
 
     Returns (eigenvalues, eigenvectors) as device arrays.
+
+    >>> import numpy as np
+    >>> from raft_tpu.compat import eigsh
+    >>> from raft_tpu.sparse.convert import dense_to_csr
+    >>> a = dense_to_csr(np.diag([1., 2., 3., 4., 10.]).astype(np.float32))
+    >>> w, v = eigsh(a, k=2, which="SA")
+    >>> np.asarray(w).round(4).tolist()
+    [1.0, 2.0]
     """
     csr = _as_csr(a)
     w, v = _lanczos.eigsh(

@@ -45,6 +45,8 @@ class DistanceType(enum.Enum):
     KLDivergence = "kl_divergence"
     RusselRaoExpanded = "russelrao"
     DiceExpanded = "dice"
+    Haversine = "haversine"                 # [lat, lon] in radians, k==2
+    BrayCurtis = "braycurtis"
 
 
 _EPS = 1e-8
@@ -148,6 +150,13 @@ def pairwise_distance(res, x, y=None,
 
     API parity with the reference lineage's
     ``pairwise_distance(handle, x, y, out, metric, p)``; y=None means y=x.
+
+    >>> import numpy as np
+    >>> from raft_tpu.distance import pairwise_distance, DistanceType
+    >>> x = np.array([[0., 0.], [3., 4.]], np.float32)
+    >>> d = pairwise_distance(None, x, metric=DistanceType.L2SqrtExpanded)
+    >>> np.asarray(d).round(1).tolist()
+    [[0.0, 5.0], [5.0, 0.0]]
     """
     x = _as2d(x)
     y = x if y is None else _as2d(y)
@@ -213,6 +222,21 @@ def pairwise_distance(res, x, y=None,
         denom = 2 * both + x_only + y_only
         return 1.0 - jnp.where(denom > 0,
                                2 * both / jnp.maximum(denom, _EPS), 1.0)
+    if m == DistanceType.Haversine:
+        if x.shape[1] != 2:
+            raise ValueError("haversine needs [lat, lon] pairs (k == 2)")
+        lat1, lon1 = x[:, None, 0], x[:, None, 1]
+        lat2, lon2 = y[None, :, 0], y[None, :, 1]
+        a = (jnp.sin((lat2 - lat1) / 2) ** 2
+             + jnp.cos(lat1) * jnp.cos(lat2)
+             * jnp.sin((lon2 - lon1) / 2) ** 2)
+        return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+    if m == DistanceType.BrayCurtis:
+        def braycurtis(xb, yy):
+            num = jnp.sum(jnp.abs(xb[:, None, :] - yy[None, :, :]), axis=-1)
+            den = jnp.sum(jnp.abs(xb[:, None, :] + yy[None, :, :]), axis=-1)
+            return jnp.where(den > 0, num / jnp.maximum(den, _EPS), 0.0)
+        return _blocked_rowwise(x, y, braycurtis, block=1024)
     raise ValueError(f"unsupported metric {metric}")
 
 

@@ -40,6 +40,12 @@ def make_monotonic(labels, filter_op: Optional[Callable] = None,
     Values for which ``filter_op`` returns True are passed through unchanged
     (the reference kernel leaves them untouched). Labels start at 1 unless
     ``zero_based``.
+
+    >>> import numpy as np
+    >>> from raft_tpu.label import make_monotonic
+    >>> np.asarray(make_monotonic(np.array([10, 30, 10, 50]),
+    ...                           zero_based=True)).tolist()
+    [0, 1, 0, 2]
     """
     labels = jnp.asarray(labels)
     uniq = get_unique_labels(labels)

@@ -109,6 +109,12 @@ def select_k(res, values, k: int, select_min: bool = True,
     values: [batch, len]; optional in_idx [batch, len] gives payload indices
     to return instead of positions (ref: select_k.cuh in_idx passthrough).
     Returns (out_val [batch, k], out_idx [batch, k]), sorted best-first.
+
+    >>> import numpy as np
+    >>> from raft_tpu.matrix import select_k
+    >>> vals, idx = select_k(None, np.array([[9., 1., 5., 3.]]), k=2)
+    >>> np.asarray(vals).tolist(), np.asarray(idx).tolist()
+    ([[1.0, 3.0]], [[1, 3]])
     """
     values = jnp.asarray(values)
     squeeze = values.ndim == 1

@@ -194,7 +194,15 @@ class LinearAssignmentProblem:
 
 
 def solve_linear_assignment(res, cost_matrix, epsilon: float = 1e-6):
-    """Functional one-shot front-end: returns (row_assignment, total_cost)."""
+    """Functional one-shot front-end: returns (row_assignment, total_cost).
+
+    >>> import numpy as np
+    >>> from raft_tpu.solver import solve_linear_assignment
+    >>> cost = np.array([[4., 1., 3.], [2., 0., 5.], [3., 2., 2.]])
+    >>> rows, total = solve_linear_assignment(None, cost)
+    >>> np.asarray(rows).tolist(), float(total)
+    ([1, 0, 2], 5.0)
+    """
     cost = jnp.asarray(cost_matrix)
     squeeze = cost.ndim == 2
     if squeeze:

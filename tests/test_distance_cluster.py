@@ -219,3 +219,36 @@ class TestKMeans:
             for i in range(8)])
         np.testing.assert_allclose(np.asarray(new_c), want_c, atol=1e-4)
         assert abs(float(inertia) - d.min(1).sum()) < 1.0
+
+
+class TestExtraMetrics:
+    def test_haversine(self, res):
+        import numpy as np
+        from raft_tpu.distance import DistanceType, pairwise_distance
+
+        rng = np.random.default_rng(0)
+        pts = np.stack([rng.uniform(-np.pi / 2, np.pi / 2, 20),
+                        rng.uniform(-np.pi, np.pi, 20)], axis=1)
+        d = np.asarray(pairwise_distance(res, pts.astype(np.float32),
+                                         metric=DistanceType.Haversine))
+        lat1, lon1 = pts[:, None, 0], pts[:, None, 1]
+        lat2, lon2 = pts[None, :, 0], pts[None, :, 1]
+        a = (np.sin((lat2 - lat1) / 2) ** 2
+             + np.cos(lat1) * np.cos(lat2) * np.sin((lon2 - lon1) / 2) ** 2)
+        expect = 2 * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+        np.testing.assert_allclose(d, expect, atol=1e-5)
+        with __import__("pytest").raises(ValueError):
+            pairwise_distance(res, np.zeros((3, 4), np.float32),
+                              metric=DistanceType.Haversine)
+
+    def test_braycurtis(self, res):
+        import numpy as np
+        from raft_tpu.distance import DistanceType, pairwise_distance
+
+        rng = np.random.default_rng(1)
+        x = rng.uniform(0, 1, (15, 6)).astype(np.float32)
+        d = np.asarray(pairwise_distance(res, x,
+                                         metric=DistanceType.BrayCurtis))
+        num = np.abs(x[:, None, :] - x[None, :, :]).sum(-1)
+        den = np.abs(x[:, None, :] + x[None, :, :]).sum(-1)
+        np.testing.assert_allclose(d, num / den, rtol=1e-5)

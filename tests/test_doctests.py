@@ -1,0 +1,50 @@
+"""Doctest harvest (ref test model:
+python/pylibraft/pylibraft/tests/test_doctests.py — walks the package,
+collects docstring examples and runs each as a test case)."""
+
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import raft_tpu
+
+# Modules whose import or examples need hardware/toolchain are skipped the
+# same way the reference skips GPU-less doctests.
+_SKIP_PREFIXES = ("raft_tpu._native",)
+
+
+def _iter_modules():
+    for info in pkgutil.walk_packages(raft_tpu.__path__,
+                                      prefix="raft_tpu."):
+        if info.name.startswith(_SKIP_PREFIXES):
+            continue
+        yield info.name
+
+
+def _collect():
+    finder = doctest.DocTestFinder(recurse=True)
+    cases = []
+    for name in _iter_modules():
+        mod = importlib.import_module(name)
+        for test in finder.find(mod, module=mod):
+            if test.examples:
+                cases.append(pytest.param(test, id=test.name))
+    return cases
+
+
+_CASES = _collect()
+
+
+def test_doctests_found():
+    # guards against the harvest silently collecting nothing
+    assert len(_CASES) >= 6
+
+
+@pytest.mark.parametrize("dt", _CASES)
+def test_docstring_example(dt):
+    runner = doctest.DocTestRunner(
+        optionflags=doctest.NORMALIZE_WHITESPACE | doctest.ELLIPSIS)
+    result = runner.run(dt)
+    assert result.failed == 0, f"{dt.name}: {result.failed} failed examples"

@@ -166,3 +166,32 @@ def test_select_k_int_min_extremes(res):
     u = np.array([[0, 3, 2**32 - 1]], np.uint32)
     v, i = select_k(res, u, k=2, select_min=True)
     assert list(np.asarray(v[0])) == [0, 3]
+
+
+class TestSelectKLarge:
+    """MATRIX_SELECT_LARGE_TEST analogue (cpp/tests/CMakeLists.txt:216-219):
+    randomized wide rows across algos vs a numpy partition oracle."""
+
+    def test_wide_rows_all_algos(self):
+        import numpy as np
+        from raft_tpu.matrix import SelectAlgo, select_k
+
+        rng = np.random.default_rng(7)
+        vals = rng.normal(size=(4, 70_000)).astype(np.float32)
+        expect = np.sort(vals, axis=1)[:, :37]
+        for algo in (SelectAlgo.AUTO, SelectAlgo.RADIX_11BITS,
+                     SelectAlgo.WARPSORT_IMMEDIATE):
+            v, i = select_k(None, vals, k=37, select_min=True, algo=algo)
+            np.testing.assert_allclose(np.asarray(v), expect, rtol=1e-6)
+            np.testing.assert_allclose(
+                np.take_along_axis(vals, np.asarray(i), axis=1), expect,
+                rtol=1e-6)
+
+    def test_k_equals_len_and_duplicates(self):
+        import numpy as np
+        from raft_tpu.matrix import select_k
+
+        vals = np.array([[2., 2., 1., 1.]], np.float32)
+        v, i = select_k(None, vals, k=4, select_min=True)
+        np.testing.assert_array_equal(np.asarray(v), [[1, 1, 2, 2]])
+        assert sorted(np.asarray(i)[0].tolist()) == [0, 1, 2, 3]
