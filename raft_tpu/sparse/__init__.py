@@ -15,6 +15,7 @@ Sparse irregularity is handled the XLA way, not the CUDA way:
 """
 
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix  # noqa: F401
+from raft_tpu.sparse.ell import ELLMatrix  # noqa: F401
 
-from . import convert, linalg, matrix, op  # noqa: F401
+from . import convert, ell, linalg, matrix, op  # noqa: F401
 from . import solver  # noqa: F401

@@ -1,0 +1,125 @@
+"""ELL (padded-row) sparse format and kernels — the TPU-preferred layout
+for SpMV/SpMM on moderately regular sparsity.
+
+Rationale (SURVEY.md §7 "hard parts"): TPU has no gather/scatter atomics,
+and a ``segment_sum`` over the nnz axis serializes through a scatter-add.
+Packing each row's nonzeros into a fixed-width [n_rows, width] slab turns
+SpMV into a *dense* gather + row reduction — fixed shapes, VPU-vectorized,
+no scatter at all — at the cost of padding (stored zeros). The classic
+GPU ELL trade-off applies: it wins when max_row_nnz is within a small
+factor of mean_row_nnz; `from_csr` reports the padding ratio so callers
+(or the auto dispatch in sparse.linalg.spmv) can decide.
+
+The reference keeps CSR/COO only and leans on cuSPARSE's internal formats;
+this module is the equivalent of that hidden format choice made explicit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.sparse_types import CSRMatrix
+from raft_tpu.util.math import round_up_to_multiple
+
+
+@dataclasses.dataclass(frozen=True)
+class ELLMatrix:
+    """Row-padded sparse matrix: cols/data are [n_rows, width]; padding
+    entries have col == 0 and data == 0 (zero data makes padded lanes
+    contribute nothing, so no masking is needed in the kernels)."""
+
+    cols: jnp.ndarray     # int32 [n_rows, width]
+    data: jnp.ndarray     # [n_rows, width]
+    shape: Tuple[int, int]
+    nnz: int
+
+    @property
+    def n_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.shape[1]
+
+    @property
+    def width(self) -> int:
+        return self.cols.shape[1]
+
+    @property
+    def padding_ratio(self) -> float:
+        """stored / actual nonzeros (1.0 = no waste)."""
+        total = self.n_rows * self.width
+        return total / max(self.nnz, 1)
+
+
+def from_csr(csr: CSRMatrix, lane_multiple: int = 8) -> ELLMatrix:
+    """Pack CSR into ELL; width = max row nnz rounded up to a lane multiple
+    (8 sublanes keeps the slab layout friendly)."""
+    indptr = np.asarray(csr.indptr)
+    row_len = np.diff(indptr)
+    width = int(row_len.max()) if row_len.size else 0
+    width = max(round_up_to_multiple(max(width, 1), lane_multiple),
+                lane_multiple)
+    n_rows = csr.n_rows
+    nnz = int(indptr[-1])
+
+    cols_h = np.zeros((n_rows, width), np.int32)
+    data_h = np.zeros((n_rows, width), np.asarray(csr.data).dtype)
+    src_cols = np.asarray(csr.indices)
+    src_data = np.asarray(csr.data)
+    rows = np.repeat(np.arange(n_rows), row_len)
+    lanes = np.arange(nnz) - np.repeat(indptr[:-1], row_len)
+    cols_h[rows, lanes] = src_cols
+    data_h[rows, lanes] = src_data
+    return ELLMatrix(jnp.asarray(cols_h), jnp.asarray(data_h),
+                     csr.shape, nnz)
+
+
+@jax.jit
+def _ell_spmv(cols, data, x):
+    # dense gather [n_rows, width] then a fixed-shape row reduction —
+    # no segment ids, no scatter
+    return jnp.sum(data * x[cols], axis=1)
+
+
+def spmv(ell: ELLMatrix, x) -> jnp.ndarray:
+    """y = A·x on the ELL slab."""
+    return _ell_spmv(ell.cols, ell.data, jnp.asarray(x))
+
+
+@jax.jit
+def _ell_spmm(cols, data, b):
+    # [n_rows, width, k] gather; contraction over width
+    return jnp.einsum("rw,rwk->rk", data, b[cols, :])
+
+
+def spmm(ell: ELLMatrix, b) -> jnp.ndarray:
+    """C = A·B for dense B [n_cols, k]."""
+    return _ell_spmm(ell.cols, ell.data, jnp.asarray(b))
+
+
+# Auto-dispatch threshold: beyond this stored/actual ratio the padding
+# costs more bandwidth than the segment-sum path's scatter.
+MAX_AUTO_PADDING = 4.0
+
+
+def maybe_ell(csr: CSRMatrix):
+    """ELL view of ``csr`` when the padding trade-off is favorable, else
+    None."""
+    indptr = np.asarray(csr.indptr)
+    row_len = np.diff(indptr)
+    if row_len.size == 0:
+        return None
+    # judge on the unrounded width (max vs mean row nnz); the lane
+    # rounding in from_csr is a constant additive cost, not a skew signal
+    stored = csr.n_rows * max(int(row_len.max()), 1)
+    nnz = max(int(indptr[-1]), 1)
+    if stored / nnz > MAX_AUTO_PADDING:
+        return None
+    return from_csr(csr)

@@ -26,10 +26,18 @@ def _segment_spmv(row_ids, cols, data, x, n_rows: int):
     return jax.ops.segment_sum(data * x[cols], row_ids, num_segments=n_rows)
 
 
-def spmv(csr: CSRMatrix, x) -> jnp.ndarray:
-    """y = A·x for CSR A (ref: sparse/linalg/spmv — cusparseSpMV wrapper in
-    detail/cusparse_wrappers.h; here one gather+segment_sum)."""
-    return _segment_spmv(csr.row_ids(), csr.indices, csr.data, x, csr.n_rows)
+def spmv(a, x) -> jnp.ndarray:
+    """y = A·x for sparse A (ref: sparse/linalg/spmv — cusparseSpMV wrapper
+    in detail/cusparse_wrappers.h).
+
+    Accepts CSRMatrix (gather + segment_sum) or ELLMatrix (dense row-slab
+    reduction, the TPU-preferred path for regular sparsity — see
+    raft_tpu.sparse.ell)."""
+    from raft_tpu.sparse.ell import ELLMatrix, spmv as ell_spmv
+
+    if isinstance(a, ELLMatrix):
+        return ell_spmv(a, x)
+    return _segment_spmv(a.row_ids(), a.indices, a.data, x, a.n_rows)
 
 
 @functools.partial(jax.jit, static_argnames=("n_rows",))
@@ -38,11 +46,16 @@ def _segment_spmm(row_ids, cols, data, b, n_rows: int):
     return jax.ops.segment_sum(prods, row_ids, num_segments=n_rows)
 
 
-def spmm(csr: CSRMatrix, b, alpha=1.0, beta=0.0, c=None) -> jnp.ndarray:
-    """C = alpha·A·B + beta·C for CSR A [m,n], dense B [n,k]
-    (ref: sparse/linalg/spmm.hpp:42)."""
-    out = _segment_spmm(csr.row_ids(), csr.indices, csr.data,
-                        jnp.asarray(b), csr.n_rows)
+def spmm(a, b, alpha=1.0, beta=0.0, c=None) -> jnp.ndarray:
+    """C = alpha·A·B + beta·C for sparse A [m,n], dense B [n,k]
+    (ref: sparse/linalg/spmm.hpp:42). Accepts CSRMatrix or ELLMatrix."""
+    from raft_tpu.sparse.ell import ELLMatrix, spmm as ell_spmm
+
+    if isinstance(a, ELLMatrix):
+        out = ell_spmm(a, jnp.asarray(b))
+    else:
+        out = _segment_spmm(a.row_ids(), a.indices, a.data,
+                            jnp.asarray(b), a.n_rows)
     out = alpha * out
     if c is not None and beta != 0.0:
         out = out + beta * jnp.asarray(c)

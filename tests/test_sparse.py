@@ -312,3 +312,68 @@ class TestSolvers:
         ref = csgraph.minimum_spanning_tree(adj.astype(np.float64))
         np.testing.assert_allclose(got_w, ref.sum(), rtol=1e-5)
         assert out.n_edges == 2 * (n - 1)
+
+
+class TestELL:
+    """ELL slab format (raft_tpu.sparse.ell — the TPU-preferred layout)."""
+
+    def _random_csr(self, rng, rows=60, cols=40, density=0.1):
+        import numpy as np
+        from raft_tpu.sparse import convert
+
+        d = rng.normal(size=(rows, cols)).astype(np.float32)
+        d[rng.uniform(size=(rows, cols)) > density] = 0.0
+        return convert.dense_to_csr(d), d
+
+    def test_from_csr_roundtrip_spmv(self):
+        import numpy as np
+        from raft_tpu.sparse import ell
+        from raft_tpu.sparse.linalg import spmv
+
+        rng = np.random.default_rng(0)
+        csr, dense = self._random_csr(rng)
+        e = ell.from_csr(csr)
+        assert e.nnz == int(np.asarray(csr.indptr)[-1])
+        assert e.width % 8 == 0
+        x = rng.normal(size=dense.shape[1]).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(spmv(e, x)), dense @ x,
+                                   rtol=1e-4, atol=1e-4)
+        # dispatch equivalence with the CSR path
+        np.testing.assert_allclose(np.asarray(spmv(e, x)),
+                                   np.asarray(spmv(csr, x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_spmm(self):
+        import numpy as np
+        from raft_tpu.sparse import ell
+        from raft_tpu.sparse.linalg import spmm
+
+        rng = np.random.default_rng(1)
+        csr, dense = self._random_csr(rng)
+        e = ell.from_csr(csr)
+        b = rng.normal(size=(dense.shape[1], 7)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(spmm(e, b)), dense @ b,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_maybe_ell_padding_policy(self):
+        import numpy as np
+        from raft_tpu.sparse import convert, ell
+
+        # uniform rows: favorable
+        d = np.eye(32, dtype=np.float32)
+        assert ell.maybe_ell(convert.dense_to_csr(d)) is not None
+        # one huge row among empty ones: unfavorable
+        d = np.zeros((64, 64), np.float32)
+        d[0, :] = 1.0
+        assert ell.maybe_ell(convert.dense_to_csr(d)) is None
+
+    def test_empty_and_zero_rows(self):
+        import numpy as np
+        from raft_tpu.sparse import convert, ell
+        from raft_tpu.sparse.linalg import spmv
+
+        d = np.zeros((8, 8), np.float32)
+        d[3, 2] = 5.0
+        e = ell.from_csr(convert.dense_to_csr(d))
+        y = np.asarray(spmv(e, np.ones(8, np.float32)))
+        np.testing.assert_array_equal(y, d.sum(1))
