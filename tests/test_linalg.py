@@ -292,6 +292,51 @@ class TestContractions:
         val, idx = linalg.fused_l2_argmin_pallas(x, y, tm=64, tn=128)
         np.testing.assert_array_equal(np.asarray(idx), ref.argmin(axis=1))
 
+    def test_fused_l2_argmin_tiled_path(self, rng):
+        # Y too large for VMEM residency → the 2-axis running-min kernel.
+        from raft_tpu.linalg.contractions import _pick_tm
+        x = rng.normal(size=(40, 9)).astype(np.float32)
+        y = rng.normal(size=(20000, 9)).astype(np.float32)
+        assert _pick_tm(128, 20096, mn_bufs=2,
+                        const_bytes=20096 * 128 * 4) is None
+        ref = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        val, idx = linalg.fused_l2_argmin_pallas(x, y)
+        np.testing.assert_array_equal(np.asarray(idx), ref.argmin(axis=1))
+        np.testing.assert_allclose(np.asarray(val), ref.min(axis=1),
+                                   atol=1e-3)
+
+    def _lloyd_oracle(self, x, y):
+        ref = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        lab = ref.argmin(axis=1)
+        sums = np.zeros_like(y)
+        np.add.at(sums, lab, x)
+        counts = np.bincount(lab, minlength=y.shape[0]).astype(np.float32)
+        return ref, lab, sums, counts
+
+    def test_fused_lloyd(self, rng):
+        from raft_tpu.linalg.contractions import fused_lloyd_pallas
+        x = rng.normal(size=(257, 19)).astype(np.float32)
+        y = rng.normal(size=(31, 19)).astype(np.float32)
+        ref, lab, sums_ref, counts_ref = self._lloyd_oracle(x, y)
+        sums, counts, val, idx = fused_lloyd_pallas(x, y)
+        np.testing.assert_array_equal(np.asarray(idx), lab)
+        np.testing.assert_allclose(np.asarray(val), ref.min(1), atol=1e-3)
+        np.testing.assert_allclose(np.asarray(sums), sums_ref,
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(counts), counts_ref)
+
+    def test_fused_lloyd_large_n_fallback(self, rng):
+        # n too large for VMEM residency → argmin kernel + chunked one-hot.
+        from raft_tpu.linalg.contractions import fused_lloyd_pallas
+        x = rng.normal(size=(37, 5)).astype(np.float32)
+        y = rng.normal(size=(20000, 5)).astype(np.float32)
+        ref, lab, sums_ref, counts_ref = self._lloyd_oracle(x, y)
+        sums, counts, val, idx = fused_lloyd_pallas(x, y)
+        np.testing.assert_array_equal(np.asarray(idx), lab)
+        np.testing.assert_allclose(np.asarray(sums), sums_ref,
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(np.asarray(counts), counts_ref)
+
 
 def test_lstsq_multi_rhs(res):
     """Regression: 2-D (multi-RHS) b must row-scale by 1/s, not broadcast
