@@ -4,10 +4,9 @@ Rebuilt from primitives per the BASELINE north star (the algorithm layer
 moved from the reference to cuVS; its building blocks — the contractions
 engine, segment reductions, comms allreduce — are the layers below):
 
-- assignment: `fused_l2_argmin_pallas` (raft_tpu.linalg.contractions) — one
-  MXU contraction per (row-tile × centroid-tile), no m×n matrix in HBM.
-- update: `segment_sum` over assignments (raft_tpu.linalg.reduce analogue
-  of reduce_rows_by_key).
+- assignment + update: `fused_lloyd_pallas` (raft_tpu.linalg.contractions)
+  — one X pass computing distances, argmin, AND one-hot centroid
+  sums/counts, both contractions on the MXU; no m×n matrix and no scatter.
 - MNMG: rows partitioned across the mesh's data axis (the reference's
   row-partitioned convention, docs/source/using_raft_comms.rst); per-shard
   partial sums/counts combined with `psum` — the NCCL allreduce of the
@@ -61,12 +60,14 @@ class KMeansParams:
 
 
 def _assign(x, centroids):
-    """Nearest-centroid assignment via the fused Pallas kernel."""
+    """Nearest-centroid assignment via the fused Pallas kernel (jnp
+    reference formulation for dtypes the kernel doesn't take)."""
     if x.dtype in (jnp.float32, jnp.bfloat16):
         return fused_l2_argmin_pallas(x, centroids)
-    d = (jnp.sum(x * x, 1, keepdims=True) - 2.0 * (x @ centroids.T)
-         + jnp.sum(centroids * centroids, 1)[None, :])
-    return jnp.min(d, 1), jnp.argmin(d, 1).astype(jnp.int32)
+    from raft_tpu.linalg.contractions import _argmin_jnp
+
+    val, idx = _argmin_jnp(x, centroids)
+    return val, idx.astype(jnp.int32)
 
 
 def _finish_update(sums, counts, old_centroids):
@@ -80,22 +81,17 @@ def _finish_update(sums, counts, old_centroids):
     return jnp.where(counts[:, None] > 0, new, old_centroids)
 
 
-def _lloyd_sums(x, centroids, n_clusters: int):
+def _lloyd_sums(x, centroids):
     """(sums, counts, dist², labels) for one Lloyd pass — the fused kernel
-    when the dtype allows, a one-hot matmul formulation otherwise (never a
+    when the dtype allows, the kernels' jnp reference otherwise (never a
     scatter: one-hot update runs at MXU rate, segment_sum's scatter does
     not — 9.6 ms vs 22.4 ms measured at 1M×128, k=1024 on v5e)."""
     if x.dtype in (jnp.float32, jnp.bfloat16):
         return fused_lloyd_pallas(x, centroids)
-    d = (jnp.sum(x * x, 1, keepdims=True) - 2.0 * (x @ centroids.T)
-         + jnp.sum(centroids * centroids, 1)[None, :])
-    dist = jnp.min(d, 1)
-    labels = jnp.argmin(d, 1).astype(jnp.int32)
-    oh = (jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
-          == labels[:, None]).astype(jnp.float32)
-    sums = jnp.dot(oh.T, x.astype(jnp.float32))
-    counts = jnp.sum(oh, axis=0)
-    return sums, counts, dist, labels
+    from raft_tpu.linalg.contractions import _lloyd_jnp
+
+    sums, counts, dist, labels = _lloyd_jnp(x, centroids)
+    return sums, counts, dist, labels.astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("n_clusters",))
@@ -106,7 +102,7 @@ def lloyd_step(x, centroids, n_clusters: int):
     driver's compile check). One fused kernel pass over X computes the
     assignment AND the centroid sums/counts.
     """
-    sums, counts, dist, labels = _lloyd_sums(x, centroids, n_clusters)
+    sums, counts, dist, labels = _lloyd_sums(x, centroids)
     new_centroids = _finish_update(sums, counts, centroids)
     return new_centroids, jnp.sum(dist), labels
 
@@ -317,7 +313,7 @@ def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
         inertia = lax.psum(jnp.sum(dist), data_axis)
         return new_c, inertia, labels
 
-    sums, counts, dist, labels = _lloyd_sums(x_shard, centroids, n_clusters)
+    sums, counts, dist, labels = _lloyd_sums(x_shard, centroids)
     sums = lax.psum(sums, data_axis)            # ← the per-iter allreduce
     counts = lax.psum(counts, data_axis)
     new_c = _finish_update(sums, counts, centroids)

@@ -81,6 +81,12 @@ def _pad_lowest(dtype):
 def _tiled_select(values: jnp.ndarray, k: int, select_min: bool,
                   tile: int = 8192):
     n_rows, n_cols = values.shape
+    # Correctness requires the full top-k OF EACH TILE in the candidate
+    # pool (a tile may hold up to k of the global winners), so the tile
+    # can never be smaller than k. One tile covering the row = direct.
+    tile = max(tile, k)
+    if n_cols <= tile:
+        return _direct_select(values, k, select_min)
     v = _order_flip(values) if select_min else values
     n_tiles = cdiv(n_cols, tile)
     padded = n_tiles * tile
@@ -89,7 +95,7 @@ def _tiled_select(values: jnp.ndarray, k: int, select_min: bool,
                     constant_values=_pad_lowest(v.dtype))
     vt = v.reshape(n_rows, n_tiles, tile)
     # Stage 1: per-tile top-k (batched over rows × tiles).
-    tvals, tidx = jax.lax.top_k(vt, min(k, tile))
+    tvals, tidx = jax.lax.top_k(vt, k)
     base = (jnp.arange(n_tiles, dtype=jnp.int32) * tile)[None, :, None]
     gidx = tidx.astype(jnp.int32) + base
     # Stage 2: top-k of the candidate pool.

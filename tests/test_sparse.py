@@ -313,6 +313,51 @@ class TestSolvers:
         np.testing.assert_allclose(got_w, ref.sum(), rtol=1e-5)
         assert out.n_edges == 2 * (n - 1)
 
+    def test_eigsh_ell_auto_selection(self, res):
+        """Regular sparsity → maybe_ell picks the slab SpMV inside the
+        Lanczos device loop; results must match scipy either way."""
+        from raft_tpu.sparse.ell import maybe_ell
+        from raft_tpu.sparse.solver.lanczos import eigsh
+
+        n = 300
+        diags = [np.full(n, 4.0), np.full(n - 1, -1.0), np.full(n - 3, -.5)]
+        A = sp.diags(diags, [0, 1, 3])
+        A = (A + A.T).tocsr().astype(np.float32)
+        csr = CSRMatrix.from_scipy(A)
+        assert maybe_ell(csr) is not None           # the regular case
+        vals, vecs = eigsh(csr, k=4, which="SA", seed=0)
+        ref = spla.eigsh(A.astype(np.float64), k=4, which="SA")[0]
+        np.testing.assert_allclose(np.sort(np.asarray(vals)),
+                                   np.sort(ref), rtol=1e-3, atol=1e-4)
+
+        # skewed rows (one dense row) → ELL declined, segment path used
+        B = A.tolil()
+        B[0, :] = 1.0
+        B[:, 0] = 1.0
+        csr_skew = CSRMatrix.from_scipy(B.tocsr().astype(np.float32))
+        assert maybe_ell(csr_skew) is None
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_mst_random_vs_scipy(self, res, seed):
+        """Randomized forests (possibly disconnected) against scipy,
+        including duplicate weights (exercises the canonical-undirected-key
+        tie-break and mutual-pair dedup of the device Borůvka rounds)."""
+        rng = np.random.RandomState(seed)
+        n = 120
+        dense = np.round(rng.rand(n, n), 2)      # many exact weight ties
+        dense = np.triu(dense, 1)
+        dense = dense * (dense < 0.08)           # sparse → likely a forest
+        adj = sp.csr_matrix(dense + dense.T).astype(np.float32)
+        colors = np.arange(n, dtype=np.int32)
+        out = mst(res, CSRMatrix.from_scipy(adj), color=colors)
+        got_w = float(np.sum(np.asarray(out.weights))) / 2.0
+        ref = csgraph.minimum_spanning_tree(adj.astype(np.float64))
+        np.testing.assert_allclose(got_w, ref.sum(), rtol=1e-5)
+        # component count from MSF size and from colors must agree
+        n_comp = csgraph.connected_components(adj, directed=False)[0]
+        assert out.n_edges // 2 == n - n_comp
+        assert len(np.unique(colors)) == n_comp
+
 
 class TestELL:
     """ELL slab format (raft_tpu.sparse.ell — the TPU-preferred layout)."""
