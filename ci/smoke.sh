@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Install-and-test smoke (the analogue of the reference's ci/ scripts:
+# run_pylibraft_pytests.sh etc.). Creates a fresh venv, installs the wheel
+# path end-to-end, and runs the CPU test suite.
+#
+# Offline-friendly: --no-build-isolation --no-deps reuse the ambient
+# jax/numpy/pytest (this environment has no network egress; a networked CI
+# would drop those flags).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUTER_SITE=$(python -c 'import site; print(site.getsitepackages()[0])')
+VENV=$(mktemp -d)/venv
+python -m venv --system-site-packages "$VENV"
+# The ambient interpreter may itself be a venv (as on this machine, where
+# python lives in /opt/venv): --system-site-packages then links the BASE
+# interpreter's site-packages, not the ambient one holding jax/setuptools.
+# A .pth file bridges the ambient site-packages into the fresh venv.
+VENV_SITE=$("$VENV/bin/python" -c 'import site; print(site.getsitepackages()[0])')
+echo "$OUTER_SITE" > "$VENV_SITE/_ambient.pth"
+. "$VENV/bin/activate"
+
+pip install --no-build-isolation --no-deps -e . 2>&1 | tail -2
+python -c "
+import raft_tpu
+from raft_tpu.core.native_runtime import native_available
+print('import OK; native runtime available:', native_available())
+import raft_tpu.cluster.kmeans, raft_tpu.sparse.solver, raft_tpu.comms
+print('subsystem imports OK')
+"
+python -m pytest tests/ -x -q
+echo "smoke: PASS"

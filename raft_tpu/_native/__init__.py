@@ -22,24 +22,55 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "raft_tpu_native.cpp")
+# Prebuilt artifact written by setup.py's build hook + its source digest
+# sidecar (stale-detection: an edited .cpp must beat a cached binary).
+_PREBUILT = os.path.join(_HERE, "libraft_tpu_native.so")
+_PREBUILT_DIGEST = _PREBUILT + ".sha"
 
 _lib = None        # None = not tried, False = build failed, else CDLL
 _lib_err: str = ""
 _lock = threading.Lock()
 
 
+def source_digest() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def build_command(src: str, out: str) -> list:
+    """The one true g++ invocation — shared with setup.py so the packaged
+    and the on-demand artifacts can never be compiled differently."""
+    return ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+            "-fvisibility=hidden", "-pthread", src, "-o", out]
+
+
 def _build_and_load():
     global _lib, _lib_err
-    with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    digest = source_digest()
+    # Prefer the prebuilt artifact shipped by the package build (setup.py's
+    # build_py hook — the analogue of loading the packaged libraft.so,
+    # ref python/libraft/libraft/load.py:8-35) — but only when its digest
+    # sidecar matches the current source, so an edited .cpp falls through
+    # to the on-demand content-hash dev build below.
+    try:
+        with open(_PREBUILT_DIGEST) as f:
+            prebuilt_fresh = f.read().strip() == digest
+    except OSError:
+        prebuilt_fresh = False
+    if prebuilt_fresh and os.path.exists(_PREBUILT):
+        try:
+            lib = ctypes.CDLL(_PREBUILT)
+            _bind(lib)
+            return lib
+        except (OSError, AttributeError) as e:
+            _lib_err = str(e)   # foreign-arch artifact → on-demand build
     so_path = os.path.join(_HERE, f"libraft_tpu_native_{digest}.so")
     if not os.path.exists(so_path):
         # pid-suffixed temp + atomic rename: concurrent builders (multi-rank
         # hosts, pytest-xdist) each write their own file and whoever renames
         # last wins with an identical artifact
         tmp = f"{so_path}.tmp{os.getpid()}"
-        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-               "-fvisibility=hidden", "-pthread", _SRC, "-o", tmp]
+        cmd = build_command(_SRC, tmp)
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True,
                            timeout=300)
@@ -51,6 +82,7 @@ def _build_and_load():
     try:
         lib = ctypes.CDLL(so_path)
         _bind(lib)
+        _lib_err = ""    # a stale prebuilt error must not outlive success
     except OSError as e:
         # corrupt cached artifact: drop it so the next import rebuilds,
         # and report unavailable instead of raising out of get_lib()

@@ -12,6 +12,14 @@ Mesh, creates one handle per participating rank and injects a `MeshComms`
 rank view into each. Session registry semantics (sessionId keys, per-rank
 state dicts, idempotent destroy) mirror the reference so downstream
 "rank loop" algorithms port directly.
+
+Multi-process design note (the committed story; exercised by
+tests/test_multiprocess.py over real processes): device-side collectives
+in a multi-process job are XLA's own — jit over the global mesh moves
+data over ICI/DCN, so no NCCL-style wire protocol is re-implemented.
+Host tag-matched p2p (the reference's UCX role) crosses processes via
+`raft_tpu.comms.tcp_mailbox.TcpMailbox`, a drop-in for the in-process
+mailbox: ``MeshComms(mesh, rank=process_index, _mailbox=TcpMailbox(...))``.
 """
 
 from __future__ import annotations

@@ -1,0 +1,176 @@
+"""Cross-process tag-matched host p2p — the UCX analogue
+(ref: comms/detail/std_comms.hpp:163-223 ucp tag send/recv;
+ucp_helper.hpp; raft_dask common/ucx.py listener/endpoint manager).
+
+Single-controller cliques use the in-process `_Mailbox` (comms.comms); a
+multi-process SPMD job (one controller per host, wired together with
+`jax.distributed` — see comms.bootstrap.initialize_distributed) uses this
+`TcpMailbox` instead: same (source, dest, tag) FIFO semantics, but
+messages to remote ranks travel over TCP. Payloads are numpy arrays in
+``.npy`` wire format (no pickle: nothing executable crosses the wire).
+
+Design note (the committed multi-process story, VERDICT #7): device-side
+collectives in a multi-process job are XLA's own — a jitted computation
+over the global mesh moves data over ICI/DCN, so MeshComms never needs a
+device-side wire protocol of its own. What the reference's UCX layer adds
+beyond NCCL is *host* tag-matched p2p for control/bootstrap traffic; this
+module is that layer's TPU-stack equivalent.
+"""
+
+from __future__ import annotations
+
+import io
+import queue
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_HDR = struct.Struct("<iiiq")  # source, dest, tag, nbytes
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class TcpMailbox:
+    """Tag-matched mailbox whose remote legs ride TCP.
+
+    Parameters
+    ----------
+    rank : this process's rank.
+    addrs : per-rank "host:port" listen addresses (every rank gets the
+        same list — the analogue of the worker address exchange in
+        raft_dask comms.py:144's worker_info).
+    """
+
+    def __init__(self, rank: int, addrs: List[str]):
+        self.rank = int(rank)
+        self.addrs = list(addrs)
+        self._queues: Dict[Tuple[int, int, int], "queue.Queue"] = {}
+        self._lock = threading.Lock()
+        # One persistent connection per destination, guarded by a per-dest
+        # lock: all messages to a peer travel one ordered byte stream, and
+        # the peer's single per-connection serve thread enqueues them in
+        # arrival order — preserving the _Mailbox per-(source,dest,tag)
+        # FIFO contract across processes.
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_locks: Dict[int, threading.Lock] = {}
+        host, port = self.addrs[self.rank].rsplit(":", 1)
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, int(port)))
+        self._srv.listen(64)
+        self._closed = False
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # -- the _Mailbox interface (comms.comms) ------------------------------
+
+    def _connect(self, dest: int) -> socket.socket:
+        host, port = self.addrs[dest].rsplit(":", 1)
+        # Peers come up at different speeds during bootstrap; retry any
+        # transient connect failure (refused before the listener binds,
+        # SYN drops past the backlog → timeout, peer resets) — the
+        # reference's UCX endpoint creation likewise blocks in a
+        # rendezvous (ucx.py:47).
+        last: Optional[OSError] = None
+        for _ in range(40):
+            try:
+                return socket.create_connection((host, int(port)),
+                                                timeout=30)
+            except OSError as e:
+                last = e
+                import time
+                time.sleep(0.25)
+        raise last
+
+    def put(self, source: int, dest: int, tag: int, payload) -> None:
+        arr = np.asarray(payload)
+        if dest == self.rank:
+            self._q((source, dest, tag)).put(arr)
+            return
+        bio = io.BytesIO()
+        np.save(bio, arr, allow_pickle=False)
+        raw = bio.getvalue()
+        with self._lock:
+            lock = self._conn_locks.setdefault(dest, threading.Lock())
+        with lock:
+            s = self._conns.get(dest)
+            if s is None:
+                s = self._connect(dest)
+                self._conns[dest] = s
+            try:
+                s.sendall(_HDR.pack(source, dest, tag, len(raw)))
+                s.sendall(raw)
+            except OSError:
+                # peer restarted: reconnect once and resend
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                s = self._connect(dest)
+                self._conns[dest] = s
+                s.sendall(_HDR.pack(source, dest, tag, len(raw)))
+                s.sendall(raw)
+
+    def get(self, source: int, dest: int, tag: int, timeout: float = 30.0):
+        assert dest == self.rank, \
+            f"rank {self.rank} cannot receive for rank {dest}"
+        return self._q((source, dest, tag)).get(timeout=timeout)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _q(self, key):
+        with self._lock:
+            if key not in self._queues:
+                self._queues[key] = queue.Queue()
+            return self._queues[key]
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return                      # listener closed
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            with conn:
+                while True:                 # messages stream until close
+                    hdr = _recv_exact(conn, _HDR.size)
+                    source, dest, tag, nbytes = _HDR.unpack(hdr)
+                    raw = _recv_exact(conn, nbytes)
+                    arr = np.load(io.BytesIO(raw), allow_pickle=False)
+                    self._q((source, dest, tag)).put(arr)
+        except (ConnectionError, OSError, ValueError):
+            pass                            # peer closed / torn connection
+
+    def close(self):
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def __del__(self):
+        self.close()

@@ -1,0 +1,49 @@
+"""Build hook: compile the C++ host runtime into a prebuilt shared library.
+
+The reference's analogous artifact is libraft.so built by CMake
+(/root/reference/cpp/CMakeLists.txt:274-341) and shipped inside the
+`libraft` wheel. Here the native layer is one translation unit with a flat
+C ABI (raft_tpu/_native/raft_tpu_native.cpp) bound via ctypes, so the
+"build system" is a single g++ invocation; a missing toolchain degrades to
+the pure-Python fallbacks (raft_tpu/_native/__init__.py), never a failed
+install — the same graceful split as the reference's header-only vs
+compiled modes.
+"""
+
+import os
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+# single source of truth for the compile flags + stale-detection digest
+from raft_tpu._native import build_command, source_digest  # noqa: E402
+
+_NATIVE_DIR = os.path.join("raft_tpu", "_native")
+_SRC = os.path.join(_NATIVE_DIR, "raft_tpu_native.cpp")
+_OUT = os.path.join(_NATIVE_DIR, "libraft_tpu_native.so")
+
+
+def _build_native() -> None:
+    try:
+        subprocess.run(build_command(_SRC, _OUT), check=True,
+                       capture_output=True, text=True, timeout=600)
+        with open(_OUT + ".sha", "w") as f:
+            f.write(source_digest())
+        print(f"built {_OUT}")
+    except Exception as e:  # noqa: BLE001 — degrade, don't fail the install
+        err = getattr(e, "stderr", "") or str(e)
+        print(f"warning: native runtime build failed; pure-Python "
+              f"fallbacks will be used at runtime:\n{err}",
+              file=sys.stderr)
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        _build_native()
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

@@ -1,0 +1,61 @@
+"""Worker process for the multi-process comms test tier (run by
+test_multiprocess.py; the analogue of the code raft-dask ships to each dask
+worker in _func_init_all, ref comms.py:414-505).
+
+Usage: python _mp_worker.py <pid> <nproc> <coord_port> <p2p_port0> <p2p_port1>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, coord_port = (int(a) for a in sys.argv[1:4])
+    p2p_ports = [int(a) for a in sys.argv[4:4 + nproc]]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from raft_tpu.comms.bootstrap import initialize_distributed
+
+    initialize_distributed(f"localhost:{coord_port}", nproc, pid)
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    # --- device-side collective across processes (XLA/Gloo path) ---------
+    devs = jax.devices()
+    assert len(devs) == 2 * nproc, f"global devices {len(devs)}"
+    mesh = Mesh(np.asarray(devs), ("data",))
+    local = np.full((2, 4), float(pid + 1), np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local)
+    total = jax.jit(lambda a: jnp.sum(a),
+                    out_shardings=NamedSharding(mesh, P()))(arr)
+    expect = sum(2 * 4 * (r + 1) for r in range(nproc))
+    assert float(total) == expect, (float(total), expect)
+
+    # --- host p2p across processes (TcpMailbox through MeshComms) --------
+    from raft_tpu.comms.comms import MeshComms
+    from raft_tpu.comms.tcp_mailbox import TcpMailbox
+
+    addrs = [f"127.0.0.1:{p}" for p in p2p_ports]
+    box = TcpMailbox(pid, addrs)
+    comms = MeshComms(mesh, axis_name="data", rank=pid, _mailbox=box)
+    payload = np.arange(8, dtype=np.float32) + 100 * pid
+    comms.isend(payload, dest=(pid + 1) % nproc, tag=7)
+    req = comms.irecv(source=(pid - 1) % nproc, tag=7)
+    (got,) = comms.waitall([req])
+    src = (pid - 1) % nproc
+    np.testing.assert_array_equal(got, np.arange(8, dtype=np.float32)
+                                  + 100 * src)
+    box.close()
+    print(f"MP_WORKER_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

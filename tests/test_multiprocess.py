@@ -1,0 +1,58 @@
+"""Multi-process comms tier (VERDICT #7): real separate processes wired by
+`jax.distributed`, exercising (a) a device-side collective through the
+global mesh and (b) cross-process host p2p through TcpMailbox — the
+analogue of raft-dask's LocalCUDACluster-based test_comms.py:254-293,
+where each dask worker process NCCL-rendezvouses and runs device-verified
+collective self-tests.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.mark.parametrize("nproc", [2])
+def test_multiprocess_comms(nproc):
+    coord, *p2p = _free_ports(1 + nproc)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no TPU plugin in the workers
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(_REPO, "tests", "_mp_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), str(nproc), str(coord)]
+            + [str(p) for p in p2p],
+            cwd=_REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for pid in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"MP_WORKER_OK {pid}" in out, out
