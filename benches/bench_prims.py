@@ -20,8 +20,11 @@ SIZES = _SMALL
 
 
 def _data(rows, cols, seed=0, dtype=np.float32):
-    rng = np.random.default_rng(seed)
-    return jnp.asarray(rng.normal(size=(rows, cols)).astype(dtype))
+    # Generate ON device: at full sizes, pushing ~1 GB of host data through
+    # the remote TPU tunnel dominates the whole bench family's wall-clock;
+    # jax.random costs nothing to ship.
+    x = jax.random.normal(jax.random.key(seed), (rows, cols), jnp.float32)
+    return x.astype(dtype)
 
 
 # -- core (ref: bench/prims/core/bitset.cu, copy.cu, memory_tracking.cu) ----
@@ -290,6 +293,70 @@ def bench_spmv():
 
     return [run_case("sparse/spmv_4096_d02", f, x, flops=2 * nnz,
                      nnz=nnz)]
+
+
+@bench("sparse/spmv_large")
+def bench_spmv_large():
+    """CSR segment-sum vs ELL slab SpMV at scale (VERDICT #9: 10M nnz on
+    chip; ref: cusparseSpMV, sparse/detail/cusparse_wrappers.h)."""
+    import scipy.sparse as sp
+
+    from raft_tpu.core.sparse_types import CSRMatrix
+    from raft_tpu.sparse.ell import from_csr
+    from raft_tpu.sparse.ell import spmv as ell_spmv
+    from raft_tpu.sparse.linalg import spmv
+
+    full = SIZES["rows"] >= (1 << 20)
+    n, nnz_target = (1 << 20, 10_000_000) if full else (1 << 14, 200_000)
+    rng = np.random.default_rng(13)
+    # uniform-degree graph → ELL-friendly; the skewed case is covered by
+    # maybe_ell declining (tests); here we measure both formats' ceilings
+    deg = nnz_target // n
+    cols_h = rng.integers(0, n, size=(n, deg)).astype(np.int32)
+    data_h = rng.random((n, deg)).astype(np.float32)
+    indptr = np.arange(n + 1, dtype=np.int64) * deg
+    a = sp.csr_matrix((data_h.ravel(), cols_h.ravel(), indptr),
+                      shape=(n, n))
+    csr = CSRMatrix.from_scipy(a)
+    ell = from_csr(csr)
+    x = jnp.asarray(rng.random(n).astype(np.float32))
+    nnz = int(a.nnz)
+
+    f_csr = jax.jit(lambda v: spmv(csr, v))
+    f_ell = jax.jit(lambda v: ell_spmv(ell, v))
+    return [
+        run_case("sparse/spmv_csr_segment", f_csr, x, flops=2 * nnz,
+                 nnz=nnz, fmt="csr"),
+        run_case("sparse/spmv_ell_slab", f_ell, x, flops=2 * nnz,
+                 nnz=nnz, fmt="ell", width=int(ell.width)),
+    ]
+
+
+@bench("comms/collectives")
+def bench_collectives():
+    """Eager MeshComms collective throughput over the local device set
+    (VERDICT weak #8: no bench showed collective throughput; ref: NCCL
+    perf tests' role for std_comms)."""
+    from raft_tpu.comms.comms import MeshComms
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    comms = MeshComms(mesh, axis_name="data", rank=0)
+    n = len(devs)
+    rows = 1 << (20 if SIZES["rows"] >= (1 << 20) else 14)
+    x = jnp.reshape(
+        jax.random.normal(jax.random.key(0), (n * rows,), jnp.float32),
+        (n, rows))
+    nbytes = int(x.size * 4)
+
+    out = []
+    for name, fn in (("allreduce", lambda v: comms.allreduce(v)),
+                     ("allgather", lambda v: comms.allgather(v)),
+                     ("reducescatter", lambda v: comms.reducescatter(v))):
+        out.append(run_case(f"comms/{name}", fn, x, bytes_moved=nbytes,
+                            nranks=n, rows=rows))
+    return out
 
 
 @bench("sparse/select_k_csr")

@@ -28,7 +28,7 @@ cluster  : k-means (rebuilt from primitives, incl. multi-chip SPMD)
 util     : host/device helper utilities   (ref: cpp/include/raft/util/)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from raft_tpu.core.resources import (  # noqa: F401
     Resources,

@@ -312,7 +312,14 @@ class MeshComms:
             x)
 
     def gatherv(self, x, recvcounts: Sequence[int], root: int = 0):
-        """ref: comms_t::gatherv (std_comms.hpp:498-528)."""
+        """ref: comms_t::gatherv (std_comms.hpp:498-528).
+
+        Root contract (same as :meth:`gather`): XLA collectives are SPMD,
+        so every rank receives the gathered buffer; ``root`` names the
+        rank whose view is contractually valid — non-roots may ignore
+        theirs and XLA DCEs unused outputs. There is no cheaper root-only
+        collective on ICI (NCCL's gatherv is likewise grouped sends)."""
+        del root   # all ranks compute; root names the valid view
         return self.allgatherv(x, recvcounts)
 
     def reducescatter(self, x, op: Op = Op.SUM):

@@ -1,0 +1,29 @@
+"""Runtime instantiation layer (ref: cpp/include/raft_runtime/ + cpp/src/
+— the pre-instantiated, host-callable API surface compiled into
+`libraft.so`, usable without a CUDA compiler; SURVEY.md §2.11).
+
+The TPU translation has two halves:
+
+- **AOT export** (:mod:`raft_tpu.runtime.aot`): where the reference
+  pre-instantiates templates into `.cu` TUs (explicit-instantiation
+  discipline, util/raft_explicit.hpp), the XLA equivalent is
+  ahead-of-time serialization: `jax.export` lowers a jitted function to
+  versioned StableHLO that loads and runs WITHOUT retracing Python — the
+  artifact a deployment ships instead of source + trace time.
+- **Instantiated entry points** (:mod:`solver`, :mod:`random_gen`): the
+  concrete functions the reference exposes from libraft.so —
+  `raft::runtime::solver::lanczos_solver` (raft_runtime/solver/lanczos.hpp:23)
+  and `raft::runtime::random::rmat_rectangular_gen`
+  (raft_runtime/random/rmat_rectangular_generator.hpp:22) — with the same
+  {float}×{index-type} instantiation matrix made explicit.
+"""
+
+from raft_tpu.runtime.aot import (aot_export, deserialize_computation,
+                                  load_computation, save_computation,
+                                  serialize_computation)
+from raft_tpu.runtime import random_gen, solver
+
+__all__ = [
+    "aot_export", "serialize_computation", "deserialize_computation",
+    "save_computation", "load_computation", "solver", "random_gen",
+]

@@ -1,0 +1,64 @@
+"""AOT export/import of compiled computations (ref: the explicit template
+instantiation machinery — util/raft_explicit.hpp, cpp/src/*.cu TUs,
+developer_guide.md:301-323 — whose purpose is "pay compilation once,
+ship a callable artifact").
+
+`jax.export` serializes a jitted function as versioned StableHLO with
+embedded calling conventions; `deserialize(...).call` runs it with no
+Python retracing. Artifacts are portable across processes and across
+compatible jax versions, and may target multiple platforms at once
+(`platforms=("tpu", "cpu")`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Sequence
+
+import jax
+from jax import export as _jexport
+
+
+def aot_export(fn: Callable, *example_args,
+               platforms: Optional[Sequence[str]] = None,
+               **jit_kwargs):
+    """Trace + lower ``fn`` at the example arguments' shapes/dtypes.
+
+    Returns a `jax.export.Exported`; use :func:`serialize_computation` /
+    :func:`save_computation` to persist it. ``platforms`` defaults to the
+    current backend (pass ``("tpu", "cpu")`` for a dual-target artifact).
+    """
+    jfn = fn if isinstance(fn, jax.stages.Wrapped) \
+        else jax.jit(fn, **jit_kwargs)
+    if platforms is not None:
+        return _jexport.export(jfn, platforms=tuple(platforms))(
+            *example_args)
+    return _jexport.export(jfn)(*example_args)
+
+
+def serialize_computation(exported) -> bytes:
+    """Exported → portable bytes (versioned StableHLO artifact)."""
+    return bytes(exported.serialize())
+
+
+def deserialize_computation(blob: bytes) -> Callable:
+    """Bytes → callable running the compiled computation (no retracing).
+
+    The callable validates shapes/dtypes against the export-time
+    signature, exactly as the reference's instantiated symbols fix their
+    template parameters.
+    """
+    exp = _jexport.deserialize(bytearray(blob))
+    return exp.call
+
+
+def save_computation(exported, path: str) -> None:
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(serialize_computation(exported))
+    os.replace(tmp, path)
+
+
+def load_computation(path: str) -> Callable:
+    with open(path, "rb") as f:
+        return deserialize_computation(f.read())

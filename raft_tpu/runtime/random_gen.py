@@ -1,0 +1,33 @@
+"""raft::runtime::random parity (ref:
+raft_runtime/random/rmat_rectangular_generator.hpp:22
+`rmat_rectangular_gen`, instantiated for {int, int64_t} × {float, double}
+theta by cpp/CMakeLists.txt:277-280).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.random.rmat import rmat_rectangular_gen as _rmat
+from raft_tpu.random.rng_state import RngState
+
+_INDEX_TYPES = (np.int32, np.int64)
+
+
+def rmat_rectangular_gen(handle, state: RngState, theta, r_scale: int,
+                         c_scale: int, n_edges: int,
+                         out_dtype=np.int32
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Host-callable R-MAT edge generator over a per-level theta table
+    (ref call shape: rmat_rectangular_gen(handle, rng, theta, out,
+    r_scale, c_scale) — the out buffer becomes a returned (src, dst))."""
+    if np.dtype(out_dtype).type not in _INDEX_TYPES:
+        raise TypeError(
+            f"index dtype must be one of {_INDEX_TYPES}, got {out_dtype} "
+            f"(the reference instantiates exactly these)")
+    theta = None if theta is None else np.asarray(theta, np.float32)
+    return _rmat(handle, state, r_scale=r_scale, c_scale=c_scale,
+                 n_edges=n_edges, theta=theta, dtype=jnp.dtype(out_dtype))

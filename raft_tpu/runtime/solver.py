@@ -1,0 +1,53 @@
+"""raft::runtime::solver parity (ref: raft_runtime/solver/lanczos.hpp:23
+`lanczos_solver`, instantiated for {int, int64_t} × {float, double} by
+cpp/src/raft_runtime/solver/lanczos_solver.cuh:10-24 macro FUNC_DEF into
+four .cu TUs, cpp/CMakeLists.txt:281-284).
+
+The instantiation matrix is explicit here too: index dtype ∈ {int32,
+int64}, value dtype ∈ {float32, float64} — anything else is rejected at
+the boundary, mirroring the reference's fixed symbol set rather than
+silently tracing a new variant.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.sparse_types import CSRMatrix
+from raft_tpu.sparse.solver.lanczos import (LanczosConfig,
+                                            lanczos_compute_eigenpairs)
+
+_INDEX_TYPES = (np.int32, np.int64)
+_VALUE_TYPES = (np.float32, np.float64)
+
+
+def lanczos_solver(handle, config: LanczosConfig, rows, cols, vals,
+                   v0: Optional[np.ndarray] = None,
+                   n: Optional[int] = None
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Host-callable thick-restart Lanczos over raw CSR buffers
+    (ref signature: lanczos_solver(res, config, rows, cols, vals, v0,
+    eigenvalues, eigenvectors) — outputs returned rather than written).
+
+    ``rows`` is the CSR indptr (len n+1), ``cols``/``vals`` the column
+    indices and values.
+    """
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    vals = np.asarray(vals)
+    if rows.dtype.type not in _INDEX_TYPES or \
+            cols.dtype.type not in _INDEX_TYPES:
+        raise TypeError(
+            f"index dtype must be one of {_INDEX_TYPES}, got "
+            f"{rows.dtype}/{cols.dtype} (the reference instantiates "
+            f"exactly these, lanczos_solver.cuh:10-24)")
+    if vals.dtype.type not in _VALUE_TYPES:
+        raise TypeError(
+            f"value dtype must be one of {_VALUE_TYPES}, got {vals.dtype}")
+    nn = int(n if n is not None else rows.shape[0] - 1)
+    csr = CSRMatrix(jnp.asarray(rows), jnp.asarray(cols),
+                    jnp.asarray(vals), (nn, nn))
+    return lanczos_compute_eigenpairs(handle, csr, config, v0)

@@ -17,9 +17,20 @@ import sys
 from setuptools import setup
 from setuptools.command.build_py import build_py
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-# single source of truth for the compile flags + stale-detection digest
-from raft_tpu._native import build_command, source_digest  # noqa: E402
+# Single source of truth for the compile flags + stale-detection digest.
+# Loaded from the file directly — importing the raft_tpu package would
+# pull in jax, which isolated build environments (pip default: only
+# setuptools) don't have.
+import importlib.util as _ilu
+
+_spec = _ilu.spec_from_file_location(
+    "_raft_tpu_native_build",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "raft_tpu", "_native", "__init__.py"))
+_native_mod = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_native_mod)
+build_command = _native_mod.build_command
+source_digest = _native_mod.source_digest
 
 _NATIVE_DIR = os.path.join("raft_tpu", "_native")
 _SRC = os.path.join(_NATIVE_DIR, "raft_tpu_native.cpp")

@@ -1,0 +1,101 @@
+"""Runtime instantiation layer tests (ref model: the runtime APIs are what
+pylibraft links against — cpp/include/raft_runtime/, SURVEY.md §2.11; the
+AOT tier is the explicit-instantiation discipline's analogue)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.linalg as spla
+
+from raft_tpu.runtime import (aot_export, deserialize_computation,
+                              load_computation, save_computation,
+                              serialize_computation)
+from raft_tpu.runtime.random_gen import rmat_rectangular_gen
+from raft_tpu.runtime.solver import lanczos_solver
+from raft_tpu.sparse.solver.lanczos import LanczosConfig
+
+
+class TestAotExport:
+    def test_roundtrip_bytes(self):
+        import jax.numpy as jnp
+
+        def f(x, y):
+            return (x @ y).sum(axis=1)
+
+        a = np.arange(32, dtype=np.float32).reshape(8, 4)
+        b = np.ones((4, 8), np.float32)
+        exp = aot_export(f, a, b)
+        blob = serialize_computation(exp)
+        assert isinstance(blob, bytes) and len(blob) > 0
+        call = deserialize_computation(blob)
+        np.testing.assert_allclose(np.asarray(call(a, b)), (a @ b).sum(1))
+
+    def test_roundtrip_file(self, tmp_path):
+        def f(x):
+            return x * 2.0 + 1.0
+
+        x = np.linspace(0, 1, 7, dtype=np.float32)
+        p = str(tmp_path / "double_plus_one.stablehlo")
+        save_computation(aot_export(f, x), p)
+        call = load_computation(p)
+        np.testing.assert_allclose(np.asarray(call(x)), x * 2 + 1)
+
+    def test_shape_signature_enforced(self):
+        def f(x):
+            return x + 1
+
+        call = deserialize_computation(serialize_computation(
+            aot_export(f, np.zeros((4,), np.float32))))
+        with pytest.raises(Exception):
+            call(np.zeros((5,), np.float32))    # wrong shape must reject
+
+    def test_flagship_lloyd_step_exports(self):
+        """The driver's flagship step survives AOT roundtrip."""
+        import functools
+
+        from raft_tpu.cluster.kmeans import lloyd_step
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(256, 16)).astype(np.float32)
+        c = rng.normal(size=(8, 16)).astype(np.float32)
+        fn = functools.partial(lloyd_step, n_clusters=8)
+        ref = [np.asarray(o) for o in fn(x, c)]
+        call = deserialize_computation(serialize_computation(
+            aot_export(fn, x, c)))
+        out = [np.asarray(o) for o in call(x, c)]
+        for got, want in zip(out, ref):
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestRuntimeEntryPoints:
+    def test_lanczos_solver_raw_buffers(self, res):
+        n = 200
+        A = sp.diags([np.full(n, 3.0), np.full(n - 1, -1.0)], [0, 1])
+        A = (A + A.T).tocsr().astype(np.float32)
+        cfg = LanczosConfig(n_components=3, which="SA", seed=0)
+        vals, vecs = lanczos_solver(res, cfg, A.indptr.astype(np.int32),
+                                    A.indices.astype(np.int32), A.data)
+        ref = spla.eigsh(A.astype(np.float64), k=3, which="SA")[0]
+        np.testing.assert_allclose(np.sort(np.asarray(vals)),
+                                   np.sort(ref), rtol=1e-3, atol=1e-4)
+
+    def test_lanczos_solver_rejects_foreign_dtypes(self, res):
+        cfg = LanczosConfig(n_components=2)
+        with pytest.raises(TypeError):
+            lanczos_solver(res, cfg, np.zeros(5, np.int16),
+                           np.zeros(4, np.int32), np.zeros(4, np.float32))
+        with pytest.raises(TypeError):
+            lanczos_solver(res, cfg, np.zeros(5, np.int32),
+                           np.zeros(4, np.int32), np.zeros(4, np.float16))
+
+    def test_rmat_entry(self, res):
+        from raft_tpu.random.rng_state import RngState
+
+        src, dst = rmat_rectangular_gen(res, RngState(5), None, 8, 8,
+                                        1000)
+        src, dst = np.asarray(src), np.asarray(dst)
+        assert src.shape == dst.shape == (1000,)
+        assert src.max() < 256 and dst.max() < 256
+        with pytest.raises(TypeError):
+            rmat_rectangular_gen(res, RngState(5), None, 8, 8, 10,
+                                 out_dtype=np.int8)

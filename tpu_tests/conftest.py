@@ -1,0 +1,24 @@
+"""TPU smoke tier configuration (VERDICT #10).
+
+Unlike tests/ (which pins an 8-virtual-device CPU mesh and runs Pallas in
+interpreter mode), this tier runs COMPILED Mosaic kernels on the real chip:
+no platform pinning here. The whole tier skips when no TPU is reachable,
+so `pytest tpu_tests -q` is safe to run anywhere.
+
+Run: python -m pytest tpu_tests -q        (~2-4 min incl. tunnel warmup)
+"""
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    import jax
+
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    if not on_tpu:
+        marker = pytest.mark.skip(reason="no TPU backend reachable")
+        for item in items:
+            item.add_marker(marker)

@@ -1,0 +1,159 @@
+"""Compiled-Pallas smoke tier on real TPU hardware (VERDICT #10): the CPU
+suite exercises kernels through the interpreter only, so Mosaic layout
+regressions (like the v5e (1, m) stats-layout constraints found manually in
+round 1) could hide. This tier compiles every raft_tpu Pallas kernel on the
+chip and checks numerics against oracles — including inside shard_map,
+where it asserts the REAL kernel lowered (no fallback; VERDICT #3's
+"fails if the fallback triggers" test).
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(11)
+
+
+def _l2_oracle(x, y):
+    return ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+
+
+class TestCompiledKernels:
+    def test_pairwise_l2(self, rng):
+        from raft_tpu.linalg.contractions import pairwise_l2_pallas
+
+        x = rng.normal(size=(300, 70)).astype(np.float32)
+        y = rng.normal(size=(150, 70)).astype(np.float32)
+        d = np.asarray(pairwise_l2_pallas(x, y))
+        np.testing.assert_allclose(d, _l2_oracle(x, y), rtol=1e-3,
+                                   atol=1e-3)
+
+    @pytest.mark.parametrize("m,n,k", [(257, 31, 19), (2000, 700, 40)])
+    def test_fused_argmin(self, rng, m, n, k):
+        from raft_tpu.linalg.contractions import fused_l2_argmin_pallas
+
+        x = rng.normal(size=(m, k)).astype(np.float32)
+        y = rng.normal(size=(n, k)).astype(np.float32)
+        ref = _l2_oracle(x, y)
+        val, idx = fused_l2_argmin_pallas(x, y)
+        # expansion-formula f32 noise flips near-ties: compare by achieved
+        # distance, and demand near-total index agreement
+        assert (np.asarray(idx) == ref.argmin(1)).mean() > 0.99
+        np.testing.assert_allclose(np.asarray(val), ref.min(1), rtol=1e-2,
+                                   atol=1e-2)
+
+    def test_fused_argmin_tiled_path(self, rng):
+        """Y past VMEM residency → the 2-axis running-min kernel compiles
+        and agrees with the resident path's tie rule."""
+        from raft_tpu.linalg.contractions import _pick_tm, \
+            fused_l2_argmin_pallas
+
+        x = rng.normal(size=(64, 24)).astype(np.float32)
+        y = rng.normal(size=(20000, 24)).astype(np.float32)
+        assert _pick_tm(128, 20096, mn_bufs=2,
+                        const_bytes=20096 * 128 * 4) is None
+        ref = _l2_oracle(x, y)
+        val, idx = fused_l2_argmin_pallas(x, y)
+        assert (np.asarray(idx) == ref.argmin(1)).mean() > 0.99
+
+    def test_fused_lloyd(self, rng):
+        from raft_tpu.linalg.contractions import fused_lloyd_pallas
+
+        x = rng.normal(size=(1000, 33)).astype(np.float32)
+        y = rng.normal(size=(37, 33)).astype(np.float32)
+        sums, counts, val, idx = fused_lloyd_pallas(x, y)
+        lab = np.asarray(idx)
+        sums_ref = np.zeros_like(y)
+        np.add.at(sums_ref, lab, x)
+        np.testing.assert_allclose(np.asarray(sums), sums_ref, rtol=1e-3,
+                                   atol=1e-3)
+        np.testing.assert_array_equal(
+            np.asarray(counts), np.bincount(lab, minlength=37))
+        assert int(counts.sum()) == 1000
+
+    def test_select_k(self, rng):
+        from raft_tpu.matrix import SelectAlgo, select_k
+
+        v = rng.normal(size=(8, 40000)).astype(np.float32)
+        for k, algo in ((50, SelectAlgo.AUTO), (50, SelectAlgo.RADIX_11BITS),
+                        (9000, SelectAlgo.RADIX_11BITS)):
+            ov, oi = select_k(None, v, k, algo=algo)
+            np.testing.assert_allclose(np.asarray(ov),
+                                       np.sort(v, 1)[:, :k], rtol=1e-6)
+
+    def test_spmv_csr_and_ell(self, rng):
+        import scipy.sparse as sp
+
+        from raft_tpu.core.sparse_types import CSRMatrix
+        from raft_tpu.sparse.ell import from_csr, spmv as ell_spmv
+        from raft_tpu.sparse.linalg import spmv
+
+        a = sp.random(500, 400, density=0.05, random_state=7,
+                      dtype=np.float64).astype(np.float32).tocsr()
+        x = rng.normal(size=400).astype(np.float32)
+        csr = CSRMatrix.from_scipy(a)
+        y1 = np.asarray(spmv(csr, x))
+        y2 = np.asarray(ell_spmv(from_csr(csr), x))
+        ref = a @ x
+        np.testing.assert_allclose(y1, ref, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(y2, ref, rtol=1e-3, atol=1e-4)
+
+
+class TestShardMapCompiled:
+    """The kernels must lower to Mosaic INSIDE shard_map with
+    check_vma=True — bit-identical to the out-of-shard_map kernel, with a
+    tpu_custom_call visibly present in the compiled HLO."""
+
+    def test_lloyd_in_shard_map_is_real_kernel(self, rng):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from raft_tpu.linalg.contractions import fused_lloyd_pallas
+
+        x = rng.normal(size=(512, 40)).astype(np.float32)
+        c = rng.normal(size=(24, 40)).astype(np.float32)
+        s0, cnt0, v0, i0 = [np.asarray(a)
+                            for a in fused_lloyd_pallas(x, c)]
+
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+
+        def f(xs, cs):
+            s, cnt, v, i = fused_lloyd_pallas(xs, cs)
+            return (jax.lax.psum(s, "data"), jax.lax.psum(cnt, "data"),
+                    v, i)
+
+        g = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P("data"), P()),
+            out_specs=(P(), P(), P("data"), P("data"))))
+        hlo = g.lower(x, c).compile().as_text()
+        assert "tpu_custom_call" in hlo, \
+            "fused kernel fell back to jnp inside shard_map"
+        s, cnt, v, i = [np.asarray(a) for a in g(x, c)]
+        np.testing.assert_array_equal(i, i0)
+        np.testing.assert_array_equal(v, v0)
+        np.testing.assert_array_equal(s, s0)
+        np.testing.assert_array_equal(cnt, cnt0)
+
+    def test_full_mnmg_step_hlo_contains_kernel(self, rng):
+        import functools
+
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from raft_tpu.cluster.kmeans import mnmg_lloyd_step
+
+        x = rng.normal(size=(256, 32)).astype(np.float32)
+        c = rng.normal(size=(16, 32)).astype(np.float32)
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        step = jax.jit(jax.shard_map(
+            functools.partial(mnmg_lloyd_step, n_clusters=16,
+                              data_axis="data"),
+            mesh=mesh, in_specs=(P("data"), P()),
+            out_specs=(P(), P(), P("data"))))
+        hlo = step.lower(x, c).compile().as_text()
+        assert "tpu_custom_call" in hlo
+        new_c, inertia, labels = step(x, c)
+        assert np.isfinite(float(inertia))
