@@ -91,6 +91,10 @@ def _l2_unexpanded(x, y, sqrt: bool):
 
 
 def _cosine(x, y):
+    if x.dtype in (jnp.float32, jnp.bfloat16) and y.dtype == x.dtype:
+        from raft_tpu.linalg.contractions import pairwise_pallas
+
+        return pairwise_pallas(x, y, metric="cosine")
     xn = jnp.linalg.norm(x, axis=1, keepdims=True)
     yn = jnp.linalg.norm(y, axis=1, keepdims=True)
     sim = (x @ y.T) / jnp.maximum(xn * yn.T, _EPS)
@@ -197,6 +201,8 @@ def pairwise_distance(res, x, y=None,
     if m == DistanceType.CorrelationExpanded:
         return _correlation(x, y)
     if m == DistanceType.InnerProduct:
+        # a bare GEMM: XLA's dot IS the kernel; the 'inner' epilogue only
+        # pays off fused with argmin (fused_argmin_pallas)
         return x @ y.T
     if m == DistanceType.HammingUnexpanded:
         return _blocked_rowwise(

@@ -58,21 +58,18 @@ def _pad2(x: jnp.ndarray, rows: int, cols: int) -> jnp.ndarray:
     return x
 
 
-def _l2_expanded_jnp(x, y):
-    """The kernels' exact math as plain jnp — the interpreter-under-
-    shard_map reference (see pallas_utils.interpret_needs_ref) and the
-    building block of each kernel's fallback."""
-    return (jnp.sum(x * x, 1, keepdims=True)
-            - 2.0 * jnp.dot(x, y.T, preferred_element_type=jnp.float32)
-            + jnp.sum(y * y, 1)[None, :])
-
-
-def _argmin_jnp(x, y):
-    d = _l2_expanded_jnp(x, y)
+def _argmin_jnp(x, y, metric: str = "l2"):
+    # _metric_tile is plain jnp on whole arrays — the SAME function the
+    # kernel body runs on its VMEM blocks, so the interpreter-under-
+    # shard_map reference (pallas_utils.interpret_needs_ref) can never
+    # diverge from the compiled epilogue.
+    d = _metric_tile(x, y, metric)
     col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
     minval = jnp.min(d, axis=1)
     arg = jnp.min(jnp.where(d == minval[:, None], col, _I32_MAX), axis=1)
-    return jnp.maximum(minval, 0.0), arg
+    if metric == "l2":
+        minval = jnp.maximum(minval, 0.0)
+    return minval, arg
 
 
 def _lloyd_jnp(x, y):
@@ -107,23 +104,40 @@ def _pick_tm(kp: int, np_: int, mn_bufs: int, const_bytes: int,
 # ---------------------------------------------------------------------------
 
 
-def _l2_tile_kernel(x_ref, y_ref, out_ref):
-    x = x_ref[:]
-    y = y_ref[:]
-    xn = jnp.sum(x * x, axis=1, keepdims=True)
-    yn = jnp.sum(y * y, axis=1, keepdims=True)
+def _metric_tile(x, y, metric: str):
+    """Distance tile for one (x-tile, y-tile) pair — the fused epilogue
+    menu of the contraction engine (ref lineage: the pairwise-distance
+    kernels cuVS builds on Contractions_NT; L2 = fusedL2NN, cosine =
+    fusedCosineNN). ``metric``: 'l2' (squared), 'cosine' (1 - cos), or
+    'inner' (negative inner product — a similarity turned distance so the
+    same argmin machinery applies)."""
     cross = jnp.dot(x, y.T, preferred_element_type=jnp.float32)
-    out_ref[:] = xn - 2.0 * cross + yn.T
+    if metric == "l2":
+        xn = jnp.sum(x * x, axis=1, keepdims=True)
+        yn = jnp.sum(y * y, axis=1, keepdims=True)
+        return xn - 2.0 * cross + yn.T
+    if metric == "cosine":
+        eps = jnp.asarray(1e-30, jnp.float32)
+        xn = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True) + eps)
+        yn = jnp.sqrt(jnp.sum(y * y, axis=1, keepdims=True) + eps)
+        return 1.0 - cross / (xn * yn.T)
+    if metric == "inner":
+        return -cross
+    raise ValueError(f"unknown metric {metric!r}")
 
 
-@functools.partial(jax.jit, static_argnames=("tm", "tn"))
-def _pairwise_l2_padded(x, y, tm: int, tn: int):
+def _pairwise_tile_kernel(x_ref, y_ref, out_ref, *, metric: str):
+    out_ref[:] = _metric_tile(x_ref[:], y_ref[:], metric)
+
+
+@functools.partial(jax.jit, static_argnames=("tm", "tn", "metric"))
+def _pairwise_padded(x, y, tm: int, tn: int, metric: str = "l2"):
     m, k = x.shape
     n = y.shape[0]
     grid = (m // tm, n // tn)
     vma, (x, y) = join_vma(x, y)
     return pallas_call(
-        _l2_tile_kernel,
+        functools.partial(_pairwise_tile_kernel, metric=metric),
         grid=grid,
         in_specs=[
             pl.BlockSpec((tm, k), lambda i, j: (i, 0),
@@ -137,6 +151,31 @@ def _pairwise_l2_padded(x, y, tm: int, tn: int):
     )(x, y)
 
 
+def pairwise_pallas(x, y, metric: str = "l2",
+                    tm: int = 256, tn: int = 256) -> jnp.ndarray:
+    """Distance matrix between rows of x and y under a fused epilogue
+    metric ('l2' squared, 'cosine', 'inner' = negative inner product).
+
+    x: [m, k] f32/bf16, y: [n, k].  Inputs are zero-padded to tile
+    multiples (zero rows/features are exact no-ops for every epilogue:
+    they contribute nothing to cross terms or norms).
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    m, k = x.shape
+    n = y.shape[0]
+    if interpret_needs_ref(x, y):
+        return _metric_tile(x, y, metric)
+    tm = min(tm, round_up_to_multiple(m, 8))
+    tn = min(tn, round_up_to_multiple(n, 128))
+    mp = round_up_to_multiple(m, tm)
+    np_ = round_up_to_multiple(n, tn)
+    kp = round_up_to_multiple(k, 128)
+    out = _pairwise_padded(_pad2(x, mp, kp), _pad2(y, np_, kp), tm, tn,
+                           metric)
+    return out[:m, :n]
+
+
 def pairwise_l2_pallas(x, y, sqrt: bool = False,
                        tm: int = 256, tn: int = 256) -> jnp.ndarray:
     """Squared (or rooted) L2 distance matrix between rows of x and y.
@@ -144,22 +183,7 @@ def pairwise_l2_pallas(x, y, sqrt: bool = False,
     x: [m, k] f32/bf16, y: [n, k].  Inputs are zero-padded to tile multiples
     (zero feature padding does not change distances).
     """
-    x = jnp.asarray(x)
-    y = jnp.asarray(y)
-    m, k = x.shape
-    n = y.shape[0]
-    if interpret_needs_ref(x, y):
-        out = _l2_expanded_jnp(x, y)
-    else:
-        tm = min(tm, round_up_to_multiple(m, 8))
-        tn = min(tn, round_up_to_multiple(n, 128))
-        mp = round_up_to_multiple(m, tm)
-        np_ = round_up_to_multiple(n, tn)
-        kp = round_up_to_multiple(k, 128)
-        out = _pairwise_l2_padded(_pad2(x, mp, kp), _pad2(y, np_, kp),
-                                  tm, tn)
-        out = out[:m, :n]
-    out = jnp.maximum(out, 0.0)
+    out = jnp.maximum(pairwise_pallas(x, y, "l2", tm, tn), 0.0)
     return jnp.sqrt(out) if sqrt else out
 
 
@@ -169,13 +193,10 @@ def pairwise_l2_pallas(x, y, sqrt: bool = False,
 # ---------------------------------------------------------------------------
 
 
-def _distance_tile(x, y, n_valid: int):
-    """Masked squared-L2 tile + its per-row (min, argmin). Shapes:
+def _distance_tile(x, y, n_valid: int, metric: str = "l2"):
+    """Masked metric tile + its per-row (min, argmin). Shapes:
     x (tm, kp), y (np_, kp) → d (tm, np_), minval (tm, 1), arg (tm, 1)."""
-    xn = jnp.sum(x * x, axis=1, keepdims=True)
-    yn = jnp.sum(y * y, axis=1, keepdims=True)
-    d = (xn - 2.0 * jnp.dot(x, y.T, preferred_element_type=jnp.float32)
-         + yn.T)
+    d = _metric_tile(x, y, metric)
     col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
     d = jnp.where(col < n_valid, d, jnp.inf)
     minval = jnp.min(d, axis=1, keepdims=True)
@@ -186,14 +207,14 @@ def _distance_tile(x, y, n_valid: int):
 
 
 def _argmin_resident_kernel(x_ref, y_ref, val_ref, idx_ref, *,
-                            n_valid: int):
-    _, _, minval, arg = _distance_tile(x_ref[:], y_ref[:], n_valid)
-    val_ref[:] = jnp.maximum(minval, 0.0).T          # (1, tm)
+                            n_valid: int, metric: str):
+    _, _, minval, arg = _distance_tile(x_ref[:], y_ref[:], n_valid, metric)
+    val_ref[:] = minval.T                            # (1, tm)
     idx_ref[:] = arg.T
 
 
 def _argmin_tiled_kernel(x_ref, y_ref, val_ref, idx_ref, *,
-                         tn: int, n_valid: int):
+                         tn: int, n_valid: int, metric: str):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -201,7 +222,8 @@ def _argmin_tiled_kernel(x_ref, y_ref, val_ref, idx_ref, *,
         val_ref[:] = jnp.full_like(val_ref, jnp.inf)
         idx_ref[:] = jnp.zeros_like(idx_ref)
 
-    _, _, minval, arg = _distance_tile(x_ref[:], y_ref[:], n_valid - j * tn)
+    _, _, minval, arg = _distance_tile(x_ref[:], y_ref[:],
+                                       n_valid - j * tn, metric)
     garg = (arg + j * tn).T                           # (1, tm)
     minval = minval.T
     prev_val = val_ref[:]
@@ -210,12 +232,13 @@ def _argmin_tiled_kernel(x_ref, y_ref, val_ref, idx_ref, *,
     idx_ref[:] = jnp.where(better, garg, idx_ref[:])
 
 
-@functools.partial(jax.jit, static_argnames=("tm", "n_valid"))
-def _fused_argmin_resident(x, y, tm: int, n_valid: int):
+@functools.partial(jax.jit, static_argnames=("tm", "n_valid", "metric"))
+def _fused_argmin_resident(x, y, tm: int, n_valid: int, metric: str):
     m, kp = x.shape
     np_ = y.shape[0]
     vma, (x, y) = join_vma(x, y)
-    kernel = functools.partial(_argmin_resident_kernel, n_valid=n_valid)
+    kernel = functools.partial(_argmin_resident_kernel, n_valid=n_valid,
+                               metric=metric)
     return pallas_call(
         kernel,
         grid=(m // tm,),
@@ -240,12 +263,14 @@ def _fused_argmin_resident(x, y, tm: int, n_valid: int):
     )(x, y)
 
 
-@functools.partial(jax.jit, static_argnames=("tm", "tn", "n_valid"))
-def _fused_argmin_tiled(x, y, tm: int, tn: int, n_valid: int):
+@functools.partial(jax.jit,
+                   static_argnames=("tm", "tn", "n_valid", "metric"))
+def _fused_argmin_tiled(x, y, tm: int, tn: int, n_valid: int, metric: str):
     m, kp = x.shape
     n = y.shape[0]
     vma, (x, y) = join_vma(x, y)
-    kernel = functools.partial(_argmin_tiled_kernel, tn=tn, n_valid=n_valid)
+    kernel = functools.partial(_argmin_tiled_kernel, tn=tn, n_valid=n_valid,
+                               metric=metric)
     return pallas_call(
         kernel,
         grid=(m // tm, n // tn),
@@ -271,13 +296,14 @@ def _fused_argmin_tiled(x, y, tm: int, tn: int, n_valid: int):
     )(x, y)
 
 
-def fused_l2_argmin_pallas(x, y, tm: Optional[int] = None,
-                           tn: int = 512) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(min_dist², argmin) of each row of x against rows of y, fused.
-
-    Never materializes the m×n distance matrix: HBM traffic is O(mk + nk + m)
-    instead of O(mn) — the property that makes Lloyd iterations bandwidth-
-    friendly at k=4096.
+def fused_argmin_pallas(x, y, metric: str = "l2",
+                        tm: Optional[int] = None, tn: int = 512
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(min_dist, argmin) of each row of x against rows of y under a fused
+    metric epilogue ('l2' squared, 'cosine', 'inner'), never materializing
+    the m×n distance matrix: HBM traffic is O(mk + nk + m) instead of
+    O(mn) — the property that makes Lloyd iterations bandwidth-friendly at
+    k=4096 (ref lineage: fusedL2NN / fusedCosineNN on Contractions_NT).
 
     Y stays resident in VMEM when it fits (one X pass, no revisits); larger
     Y falls back to a 2-axis grid with a running (min, argmin) in the
@@ -288,7 +314,7 @@ def fused_l2_argmin_pallas(x, y, tm: Optional[int] = None,
     m, k = x.shape
     n = y.shape[0]
     if interpret_needs_ref(x, y):
-        val, idx = _argmin_jnp(x, y)
+        val, idx = _argmin_jnp(x, y, metric)
         return val, idx.astype(jnp.int32)
     kp = round_up_to_multiple(k, 128)
     np_ = round_up_to_multiple(n, 128)
@@ -300,7 +326,7 @@ def fused_l2_argmin_pallas(x, y, tm: Optional[int] = None,
         tm_ = max(8, round_up_to_multiple(min(tm_, m), 8))
         mp = round_up_to_multiple(m, tm_)
         val, idx = _fused_argmin_resident(
-            _pad2(x, mp, kp), _pad2(y, np_, kp), tm_, n)
+            _pad2(x, mp, kp), _pad2(y, np_, kp), tm_, n, metric)
     else:
         tn_ = min(tn, np_)
         tm_ = _pick_tm(kp, tn_, mn_bufs=2, const_bytes=tn_ * kp * isz,
@@ -311,8 +337,15 @@ def fused_l2_argmin_pallas(x, y, tm: Optional[int] = None,
         mp = round_up_to_multiple(m, tm_)
         npp = round_up_to_multiple(n, tn_)
         val, idx = _fused_argmin_tiled(
-            _pad2(x, mp, kp), _pad2(y, npp, kp), tm_, tn_, n)
-    return jnp.maximum(val[0, :m], 0.0), idx[0, :m]
+            _pad2(x, mp, kp), _pad2(y, npp, kp), tm_, tn_, n, metric)
+    return val[0, :m], idx[0, :m]
+
+
+def fused_l2_argmin_pallas(x, y, tm: Optional[int] = None,
+                           tn: int = 512) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(min_dist², argmin) under squared L2 — see :func:`fused_argmin_pallas`."""
+    val, idx = fused_argmin_pallas(x, y, "l2", tm, tn)
+    return jnp.maximum(val, 0.0), idx
 
 
 # ---------------------------------------------------------------------------

@@ -1,16 +1,26 @@
 """Symmetric eigensolvers (ref: linalg/eig.cuh — cuSOLVER syevd/syevj/syevdx).
 
-XLA's `eigh` (QDWH-eig on TPU) replaces cuSOLVER's divide-&-conquer and
-Jacobi paths; both reference spellings are kept and dispatch to the same
-compiled routine.  ``eig_sel`` (syevdx subset selection) computes the full
-decomposition and slices — on TPU the full eigh is MXU-bound and subset
-tricks don't pay until n is very large, where Lanczos
-(raft_tpu.sparse.solver) is the right tool anyway.
+XLA's `eigh` (QDWH-eig on TPU) replaces cuSOLVER's divide-&-conquer path
+(`eig_dc`). `eig_jacobi` is a REAL one-sided-free cyclic Jacobi solver —
+the syevj analogue — honoring the reference's tol/sweeps semantics
+(cusolverDnsyevj's residual tolerance and max_sweeps knobs): rotation sets
+use the round-robin parallel ordering, so each set is n/2 disjoint
+rotations applied as ONE dense orthogonal factor on the MXU (two matmuls),
+the TPU-idiomatic form of the reference's batched element rotations.
+``eig_sel`` (syevdx subset selection) computes the full decomposition and
+slices — on TPU the full eigh is MXU-bound and subset tricks don't pay
+until n is very large, where Lanczos (raft_tpu.sparse.solver) is the
+right tool anyway.
 """
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
 EigVecUsage = ("OVERWRITE_INPUT", "COPY_INPUT")
 
@@ -26,13 +36,100 @@ def eig_dc(res, matrix):
     return w, v
 
 
-def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15):
-    """Jacobi eigensolver spelling (ref: eig.cuh eig_jacobi → syevj).
+@functools.lru_cache(maxsize=64)
+def _round_robin_pairs(n: int) -> np.ndarray:
+    """Circle-method tournament schedule: n-1 rounds of n/2 disjoint
+    pairs covering every (p, q) once. n must be even. [n-1, n/2, 2]."""
+    assert n % 2 == 0
+    players = list(range(n))
+    rounds = []
+    for _ in range(n - 1):
+        pairs = [(players[i], players[n - 1 - i]) for i in range(n // 2)]
+        rounds.append([(min(p, q), max(p, q)) for p, q in pairs])
+        players = [players[0]] + [players[-1]] + players[1:-1]
+    return np.asarray(rounds, dtype=np.int32)
 
-    tol/sweeps are accepted for parity; XLA's eigh is already
-    iteration-free from the caller's perspective.
+
+@functools.partial(jax.jit, static_argnames=("max_sweeps",))
+def _jacobi_sweeps(a, pairs, tol, max_sweeps: int):
+    """Cyclic Jacobi with parallel orderings until off(A) ≤ tol·||A||_F
+    or ``max_sweeps`` sweeps (ref: syevj semantics)."""
+    n = a.shape[0]
+    eye = jnp.eye(n, dtype=a.dtype)
+    norm = jnp.linalg.norm(a)
+
+    def rotation_set(carry, pq):
+        a, v = carry
+        p, q = pq[:, 0], pq[:, 1]
+        app = a[p, p]
+        aqq = a[q, q]
+        apq = a[p, q]
+        # rotation angle per pair (Golub & Van Loan 8.4): skip tiny apq
+        safe = jnp.abs(apq) > jnp.finfo(a.dtype).tiny * 16
+        tau = (aqq - app) / jnp.where(safe, 2.0 * apq, 1.0)
+        # Golub & Van Loan convention sign(0) = +1: equal diagonal entries
+        # (tau == 0) still need a 45° rotation — jnp.sign(0) = 0 would make
+        # the rotation the identity and never annihilate apq.
+        sgn = jnp.where(tau >= 0, 1.0, -1.0).astype(a.dtype)
+        t = sgn / (jnp.abs(tau) + jnp.sqrt(1.0 + tau * tau))
+        c = 1.0 / jnp.sqrt(1.0 + t * t)
+        s = t * c
+        c = jnp.where(safe, c, 1.0)
+        s = jnp.where(safe, s, 0.0)
+        # one dense orthogonal factor applying all n/2 disjoint rotations
+        g = eye.at[p, p].set(c).at[q, q].set(c) \
+               .at[p, q].set(s).at[q, p].set(-s)
+        a = g.T @ a @ g
+        v = v @ g
+        return (a, v), None
+
+    def sweep_body(state):
+        i, a, v, _ = state
+        (a, v), _ = lax.scan(rotation_set, (a, v), pairs)
+        off = jnp.sqrt(jnp.maximum(
+            jnp.sum(a * a) - jnp.sum(jnp.diagonal(a) ** 2), 0.0))
+        return i + 1, a, v, off
+
+    def sweep_cond(state):
+        i, _, _, off = state
+        return (off > tol * norm) & (i < max_sweeps)
+
+    _, a, v, _ = lax.while_loop(
+        sweep_cond, sweep_body,
+        (jnp.int32(0), a, eye, jnp.asarray(jnp.inf, a.dtype)))
+    return jnp.diagonal(a), v
+
+
+def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15):
+    """Jacobi eigensolver (ref: eig.cuh eig_jacobi → cusolverDnsyevj).
+
+    Returns (eigenvalues ascending, eigenvectors as columns). ``tol`` is
+    the off-diagonal Frobenius residual relative to ||A||_F; ``sweeps``
+    caps the cyclic sweeps — both the reference's syevj knobs, actually
+    honored (round 1 aliased this to eig_dc).
     """
-    return eig_dc(res, matrix)
+    a = jnp.asarray(matrix)
+    if jnp.issubdtype(a.dtype, jnp.complexfloating):
+        # the real-rotation sweeps below would silently drop the imaginary
+        # part; Hermitian input goes to the QDWH path (syevj handles
+        # complex in the reference too, just by a different rotation form)
+        return eig_dc(res, a)
+    n = a.shape[0]
+    if n <= 1:
+        return jnp.diagonal(a), jnp.eye(n, dtype=a.dtype)
+    dtype = a.dtype if a.dtype in (jnp.float32, jnp.float64) \
+        else jnp.float32
+    a = a.astype(dtype)
+    np_ = n + (n % 2)
+    if np_ != n:                       # pad with a decoupled diagonal slot
+        a = jnp.pad(a, ((0, 1), (0, 1)))
+    pairs = jnp.asarray(_round_robin_pairs(np_))
+    w, v = _jacobi_sweeps(a, pairs, jnp.asarray(tol, dtype), sweeps)
+    # the padded slot stays exactly decoupled (every rotation touching it
+    # sees a zero off-diagonal → identity), so dropping row/col n is exact
+    w, v = w[:n], v[:n, :n]
+    order = jnp.argsort(w)
+    return w[order], v[:, order]
 
 
 def eig_sel(res, matrix, n_eig_vals: int, largest: bool = True):

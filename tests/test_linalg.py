@@ -161,6 +161,47 @@ class TestDecompositions:
         wref = np.linalg.eigvalsh(S)
         np.testing.assert_allclose(np.asarray(w), wref[-3:], rtol=1e-8)
 
+    @pytest.mark.parametrize("n", [2, 5, 16, 33])
+    def test_eig_jacobi(self, rng, n):
+        """Real cyclic Jacobi (syevj analogue): eigenpairs, orthogonality,
+        and both odd/even n (odd exercises the decoupled padding slot)."""
+        A = rng.normal(size=(n, n))
+        S = ((A + A.T) / 2).astype(np.float32)
+        w, v = linalg.eig_jacobi(None, S, tol=1e-7, sweeps=20)
+        w, v = np.asarray(w), np.asarray(v)
+        wref = np.linalg.eigvalsh(S.astype(np.float64))
+        np.testing.assert_allclose(w, wref, atol=5e-4)
+        np.testing.assert_allclose(S @ v, v * w[None, :], atol=5e-3)
+        np.testing.assert_allclose(v.T @ v, np.eye(n), atol=1e-4)
+
+    def test_eig_jacobi_equal_diagonal(self):
+        """tau == 0 (equal diagonal entries) needs the sign(0)=+1
+        convention — a 45° rotation, not the identity."""
+        S = np.array([[1.0, 0.5], [0.5, 1.0]], np.float32)
+        w, v = linalg.eig_jacobi(None, S, tol=1e-7, sweeps=10)
+        np.testing.assert_allclose(np.asarray(w), [0.5, 1.5], atol=1e-5)
+        np.testing.assert_allclose(
+            S @ np.asarray(v), np.asarray(v) * np.asarray(w)[None, :],
+            atol=1e-5)
+
+    def test_eig_jacobi_complex_routes_to_dc(self):
+        A = np.array([[2.0, 1j], [-1j, 2.0]], np.complex64)
+        w, v = linalg.eig_jacobi(None, A)
+        np.testing.assert_allclose(np.sort(np.asarray(w)), [1.0, 3.0],
+                                   atol=1e-5)
+
+    def test_eig_jacobi_sweeps_knob(self, rng):
+        """The sweeps cap must actually bound work (round 1 aliased
+        eig_jacobi to eig_dc and ignored it)."""
+        A = rng.normal(size=(48, 48))
+        S = ((A + A.T) / 2).astype(np.float32)
+        wref = np.linalg.eigvalsh(S.astype(np.float64))
+        e1 = np.abs(np.sort(np.asarray(
+            linalg.eig_jacobi(None, S, tol=1e-12, sweeps=1)[0])) - wref).max()
+        e12 = np.abs(np.sort(np.asarray(
+            linalg.eig_jacobi(None, S, tol=1e-12, sweeps=12)[0])) - wref).max()
+        assert e12 < e1 * 1e-2
+
     def test_qr(self, rng):
         A = rng.normal(size=(10, 4)).astype(np.float64)
         q, r = linalg.qr_get_qr(None, A)
@@ -304,6 +345,38 @@ class TestContractions:
         np.testing.assert_array_equal(np.asarray(idx), ref.argmin(axis=1))
         np.testing.assert_allclose(np.asarray(val), ref.min(axis=1),
                                    atol=1e-3)
+
+    @pytest.mark.parametrize("metric", ["cosine", "inner"])
+    def test_pairwise_metric_epilogues(self, rng, metric):
+        from raft_tpu.linalg.contractions import pairwise_pallas
+
+        x = rng.normal(size=(90, 23)).astype(np.float32)
+        y = rng.normal(size=(41, 23)).astype(np.float32)
+        d = np.asarray(pairwise_pallas(x, y, metric=metric))
+        if metric == "cosine":
+            xn = np.linalg.norm(x, axis=1, keepdims=True)
+            yn = np.linalg.norm(y, axis=1, keepdims=True)
+            ref = 1.0 - (x @ y.T) / (xn * yn.T)
+        else:
+            ref = -(x @ y.T)
+        np.testing.assert_allclose(d, ref, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("metric", ["cosine", "inner"])
+    def test_fused_argmin_metric(self, rng, metric):
+        from raft_tpu.linalg.contractions import fused_argmin_pallas
+
+        x = rng.normal(size=(129, 17)).astype(np.float32)
+        y = rng.normal(size=(300, 17)).astype(np.float32)
+        if metric == "cosine":
+            xn = np.linalg.norm(x, axis=1, keepdims=True)
+            yn = np.linalg.norm(y, axis=1, keepdims=True)
+            ref = 1.0 - (x @ y.T) / (xn * yn.T)
+        else:
+            ref = -(x @ y.T)
+        val, idx = fused_argmin_pallas(x, y, metric=metric)
+        np.testing.assert_array_equal(np.asarray(idx), ref.argmin(1))
+        np.testing.assert_allclose(np.asarray(val), ref.min(1),
+                                   rtol=1e-4, atol=1e-4)
 
     def _lloyd_oracle(self, x, y):
         ref = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
