@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Wait for the TPU tunnel, then run the full hardware battery:
+# smoke tier -> full bench sweep -> north-star bench. Results land in
+# tpu_battery_out/.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p tpu_battery_out
+
+probe() {
+    timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'" \
+        >/dev/null 2>&1
+}
+
+echo "[battery] waiting for TPU tunnel..."
+for i in $(seq 1 100); do
+    if probe; then
+        echo "[battery] TPU reachable (attempt $i)"
+        break
+    fi
+    if [ "$i" = 100 ]; then
+        echo "[battery] TPU never came back; giving up"
+        exit 1
+    fi
+    sleep 120
+done
+
+echo "[battery] running tpu_tests smoke tier"
+timeout 1800 python -m pytest tpu_tests -q \
+    > tpu_battery_out/tpu_smoke.txt 2>&1
+echo "[battery] smoke rc=$? (tail below)"
+tail -3 tpu_battery_out/tpu_smoke.txt
+
+echo "[battery] running full bench sweep"
+timeout 5400 python benches/run_benches.py --size full \
+    > tpu_battery_out/bench_full.jsonl 2> tpu_battery_out/bench_full.err
+echo "[battery] sweep rc=$?"
+
+echo "[battery] running north-star bench"
+timeout 900 python bench.py > tpu_battery_out/bench_northstar.json 2>&1
+echo "[battery] bench rc=$?"
+cat tpu_battery_out/bench_northstar.json
+echo "[battery] DONE"
