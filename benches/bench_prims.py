@@ -169,16 +169,24 @@ def bench_gemm():
 
 @bench("matrix/select_k")
 def bench_select_k():
-    from raft_tpu.matrix import select_k
+    """k sweep incl. the large-k wide-row regime, direct vs tiled
+    tournament (VERDICT #4 asks for tiled-vs-lax.top_k evidence on
+    [64, 1M] rows)."""
+    from raft_tpu.matrix import SelectAlgo, select_k
 
     x = _data(64, SIZES["rows"])
     out = []
-    for k in (16, SIZES["k"]):
-        f = jax.jit(functools.partial(select_k, None, k=k,
-                                      select_min=True))
-        out.append(run_case(f"matrix/select_k_k{k}", f, x,
-                            items=x.shape[0] * x.shape[1], k=k,
-                            batch=x.shape[0], length=x.shape[1]))
+    for k in (16, SIZES["k"], 10_000):
+        if k > x.shape[1]:
+            continue
+        for algo, tag in ((SelectAlgo.WARPSORT_IMMEDIATE, "direct"),
+                          (SelectAlgo.RADIX_11BITS, "tiled")):
+            f = jax.jit(functools.partial(select_k, None, k=k,
+                                          select_min=True, algo=algo))
+            out.append(run_case(f"matrix/select_k_k{k}_{tag}", f, x,
+                                items=x.shape[0] * x.shape[1], k=k,
+                                batch=x.shape[0], length=x.shape[1],
+                                algo=tag))
     return out
 
 

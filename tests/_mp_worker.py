@@ -39,6 +39,37 @@ def main():
     expect = sum(2 * 4 * (r + 1) for r in range(nproc))
     assert float(total) == expect, (float(total), expect)
 
+    # --- device collective self-tests over the global mesh ---------------
+    # (the reference's perform_test_comms_* battery, comms/detail/test.hpp,
+    # run multi-process: each collective is verified numerically on every
+    # rank's shard)
+    from raft_tpu.comms import device as cdev
+
+    world = 2 * nproc                     # 2 local devices per process
+
+    def selftests(xs):
+        r = cdev.rank("data").astype(jnp.float32)
+        ok = jnp.bool_(True)
+        ok &= cdev.allreduce((r + 1.0)[None])[0] == world * (world + 1) / 2
+        ok &= cdev.bcast((r * 3.0)[None], root=1)[0] == 3.0
+        g = cdev.allgather(r[None])                      # [world, 1]
+        ok &= jnp.all(g[:, 0] == jnp.arange(world, dtype=jnp.float32))
+        rs = cdev.reducescatter(jnp.arange(world, dtype=jnp.float32)
+                                + 0.0 * r)               # shard gets [1]
+        ok &= rs[0] == world * r
+        ring = cdev.ring_shift(r[None], 1)[0]            # from rank r-1
+        ok &= ring == (r - 1) % world
+        return ok[None]
+
+    xs = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), np.zeros((2, 1), np.float32))
+    oks = jax.jit(jax.shard_map(
+        selftests, mesh=mesh, in_specs=(P("data"),),
+        out_specs=P("data")))(xs)
+    for shard in oks.addressable_shards:
+        assert bool(np.asarray(shard.data)[0]), \
+            f"collective self-test failed on shard {shard.index}"
+
     # --- host p2p across processes (TcpMailbox through MeshComms) --------
     from raft_tpu.comms.comms import MeshComms
     from raft_tpu.comms.tcp_mailbox import TcpMailbox

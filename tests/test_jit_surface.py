@@ -42,6 +42,23 @@ class TestLinalgJit:
         cov = x64.T @ x64
         _compiles(lambda a: linalg.eig_dc(None, a), cov)
 
+    def test_eig_jacobi(self, x64):
+        from raft_tpu import linalg
+
+        cov = (x64.T @ x64).astype(np.float32)
+        _compiles(lambda a: linalg.eig_jacobi(None, a, sweeps=4), cov)
+
+    def test_contraction_metric_epilogues(self, x64):
+        from raft_tpu.linalg.contractions import (fused_argmin_pallas,
+                                                  pairwise_pallas)
+
+        y = x64[:16]
+        for metric in ("l2", "cosine", "inner"):
+            _compiles(functools.partial(pairwise_pallas, metric=metric),
+                      x64, y)
+            _compiles(functools.partial(fused_argmin_pallas, metric=metric),
+                      x64, y)
+
     def test_gemm_dtypes(self, x64):
         from raft_tpu.linalg import gemm
 
