@@ -32,3 +32,17 @@ from raft_tpu.matrix.ops import (  # noqa: F401
     sort_cols_per_row,
     sample_rows,
 )
+
+# Reference-spelling aliases (one name per public header of raft/matrix/ —
+# migration-doc parity; the canonical raft_tpu names above are preferred):
+# col_wise_sort.cuh, diagonal.cuh, norm.cuh, reverse.cuh, shift.cuh,
+# threshold.cuh, triangular.cuh, print.cuh.
+from raft_tpu.matrix.ops import print_matrix  # noqa: F401
+
+col_wise_sort = sort_cols_per_row
+diagonal = get_diagonal
+norm = l2_norm
+reverse = row_reverse
+shift = row_shift
+threshold = zero_small_values
+triangular = upper_triangular

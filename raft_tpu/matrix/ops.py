@@ -192,3 +192,24 @@ def sample_rows(res, state: RngState, matrix, n_samples: int):
     m = jnp.asarray(matrix)
     idx = excess_subsample(res, state, n_samples, m.shape[0])
     return m[idx]
+
+
+def print_matrix(res, matrix, name: str = "", h_separator: str = " ",
+                 v_separator: str = "\n") -> str:
+    """Render (and print) a matrix (ref: matrix/print.cuh `print` —
+    host-side debug formatting; separators match the reference's args).
+
+    >>> import numpy as np
+    >>> from raft_tpu.matrix import print_matrix
+    >>> s = print_matrix(None, np.array([[1., 2.], [3., 4.]]))
+    1 2
+    3 4
+    """
+    import numpy as np
+
+    m = np.asarray(matrix)
+    body = v_separator.join(
+        h_separator.join(f"{v:g}" for v in row) for row in np.atleast_2d(m))
+    out = (name + v_separator if name else "") + body
+    print(out)
+    return out
