@@ -28,3 +28,6 @@ from raft_tpu.random.multi_variable_gaussian import (  # noqa: F401
     Decomposer,
 )
 from raft_tpu.random.rmat import rmat_rectangular_gen  # noqa: F401
+
+# Reference-spelling alias (rng.cuh `discrete` — weighted discrete draw).
+from raft_tpu.random.rng import sample as discrete  # noqa: F401,E402

@@ -99,3 +99,8 @@ def bitset_to_csr(bitset: Bitset, n_rows: int) -> CSRMatrix:
     data = np.ones(cols_all.shape[0], dtype=np.float32)
     return CSRMatrix(jnp.asarray(indptr), jnp.asarray(cols_all),
                      jnp.asarray(data), (n_rows, bitset.size))
+
+
+# Reference-spelling alias (sparse/convert/csr.cuh: the sorted-COO→CSR
+# path is the conversion the reference exposes as coo_to_csr).
+coo_to_csr = sorted_coo_to_csr

@@ -133,3 +133,7 @@ def encode_bm25(coo_or_csr, k_param: float = 1.6,
                    + b_param * (row_len[coo.rows].astype(jnp.float32)
                                 / avg_len)) + tf)
     return idf * bm
+
+
+# Reference-spelling alias (sparse/matrix/diagonal.cuh get_diagonal).
+get_diagonal = diagonal
