@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Render a bench sweep (JSON lines from benches/run_benches.py) as a
+markdown table for BASELINE.md / round notes.
+
+Usage: python ci/render_bench.py tpu_battery_out/bench_full.jsonl
+"""
+
+import json
+import sys
+
+
+def main(path: str) -> None:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    if not rows:
+        print("(no results)")
+        return
+    print("| bench | median ms | throughput | params |")
+    print("|---|---|---|---|")
+    skip = {"bench", "median_ms", "best_ms", "repeats"}
+    for r in sorted(rows, key=lambda r: r["bench"]):
+        thr = ""
+        for k, unit in (("GFLOP_per_s", "GFLOP/s"), ("GB_per_s", "GB/s"),
+                        ("items_per_s", "items/s")):
+            if r.get(k) is not None:
+                thr = f"{r[k]} {unit}"
+                break
+        params = ", ".join(f"{k}={v}" for k, v in r.items()
+                           if k not in skip and f"{k} {v}" not in thr
+                           and k not in ("GFLOP_per_s", "GB_per_s",
+                                         "items_per_s"))
+        print(f"| {r['bench']} | {r['median_ms']} | {thr} | {params} |")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else
+         "tpu_battery_out/bench_full.jsonl")

@@ -7,7 +7,7 @@ cd "$(dirname "$0")/.."
 mkdir -p tpu_battery_out
 
 probe() {
-    timeout 90 python -c "import jax; assert jax.default_backend()=='tpu'" \
+    timeout 240 python -c "import jax; assert jax.default_backend()=='tpu'" \
         >/dev/null 2>&1
 }
 
