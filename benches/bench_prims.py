@@ -498,6 +498,23 @@ def bench_kmeans():
                      rows=x.shape[0], k=256)]
 
 
+@bench("neighbors/brute_force")
+def bench_knn():
+    """Brute-force k-NN (the cuVS consumer workload rebuilt from the
+    primitives; tiled fused-metric distances + running top-k)."""
+    from raft_tpu.neighbors import knn
+
+    full = SIZES["rows"] >= (1 << 20)
+    n, q, d, k = ((1 << 20, 4096, 128, 64) if full
+                  else (1 << 14, 512, 64, 32))
+    db = _data(n, d, seed=21)
+    queries = _data(q, d, seed=22)
+    f = jax.jit(functools.partial(knn, None, k=k))
+    flops = 2 * q * n * d
+    return [run_case("neighbors/knn_l2", f, db, queries, flops=flops,
+                     n=n, q=q, d=d, k=k)]
+
+
 # -- util (ref: bench/prims/util/popc.cu) -----------------------------------
 
 @bench("util/popc")

@@ -1,0 +1,13 @@
+"""Brute-force k-nearest neighbors rebuilt from the primitives layer.
+
+The reference migrated its k-NN/ANN algorithm tier to cuVS
+(README.md:99-135) but kept the layers they are built FROM — the
+contraction engine and select_k. This module is the canonical consumer
+composition (cuvs::neighbors::brute_force lineage): tiled fused-metric
+distances + running top-k merges, the same way the kmeans flagship
+composes fused L2-argmin + one-hot updates.
+"""
+
+from raft_tpu.neighbors.brute_force import knn, knn_mnmg  # noqa: F401
+
+__all__ = ["knn", "knn_mnmg"]

@@ -1,0 +1,184 @@
+"""Brute-force k-NN (lineage: cuvs::neighbors::brute_force, built on this
+repo's analogues of the layers it consumes — the contraction engine's
+pairwise tiles, matrix/select_k's tournament).
+
+TPU formulation: the database streams through in column tiles under
+`lax.scan`; each step computes a queries×tile distance block with the
+fused metric epilogue (MXU) and folds it into the running per-query
+top-k via one select over the [k | tile-top-k] candidate pool — HBM
+traffic O(q·n_tiles·k) beyond the required reads, never the full q×n
+matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.linalg.contractions import pairwise_pallas
+from raft_tpu.util.math import cdiv, round_up_to_multiple
+
+
+
+_METRIC_ALIASES = {"l2": "l2", "sqeuclidean": "l2", "euclidean": "l2",
+                   "cosine": "cosine", "inner": "inner"}
+
+
+def _resolve_metric(metric: str) -> str:
+    kernel_metric = _METRIC_ALIASES.get(metric)
+    if kernel_metric is None:
+        raise ValueError(f"unknown metric {metric!r}")
+    return kernel_metric
+
+
+def _validate(db, queries, k: int) -> None:
+    if db.ndim != 2 or queries.ndim != 2 or db.shape[1] != queries.shape[1]:
+        raise ValueError(
+            f"shape mismatch: db {db.shape} vs queries {queries.shape}")
+    if not 0 < k <= db.shape[0]:
+        raise ValueError(f"need 0 < k <= n_db, got k={k}, n={db.shape[0]}")
+
+
+def _finalize(vals, metric: str):
+    if metric == "euclidean":
+        return jnp.sqrt(jnp.maximum(vals, 0.0))
+    if metric in ("l2", "sqeuclidean"):
+        return jnp.maximum(vals, 0.0)
+    if metric == "inner":
+        return -vals                   # back to similarity, desc order
+    return vals
+
+
+def _clamp_tile(tile: int, k: int, n: int) -> int:
+    """Tile width: lane-aligned, no wider than the (padded) database, and
+    never below k — the per-tile lax.top_k needs k ≤ tile."""
+    t = min(round_up_to_multiple(tile, 128), round_up_to_multiple(n, 128))
+    return max(t, round_up_to_multiple(k, 128))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "tile", "metric"))
+def _knn_scan(queries, db, k: int, tile: int, metric: str, n_valid=None):
+    """Running top-k over database column tiles. ``n_valid`` (traced
+    scalar, default = db rows) masks trailing padded rows — the MNMG path
+    passes each shard's true row count."""
+    q, d = queries.shape
+    n = db.shape[0]
+    if n_valid is None:
+        n_valid = jnp.int32(n)
+    n_tiles = cdiv(n, tile)
+    npad = n_tiles * tile
+    dbp = jnp.pad(db, ((0, npad - n), (0, 0)))
+    tiles = dbp.reshape(n_tiles, tile, d)
+    offsets = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+
+    from raft_tpu.util.pallas_utils import join_vma, pcast_to
+
+    vma, _ = join_vma(queries, db)
+    init = pcast_to(vma, jnp.full((q, k), jnp.inf, jnp.float32),
+                    jnp.zeros((q, k), jnp.int32))
+
+    def step(carry, inp):
+        best_v, best_i = carry
+        tile_db, off = inp
+        dist = pairwise_pallas(queries, tile_db, metric=metric)
+        col = lax.broadcasted_iota(jnp.int32, dist.shape, 1) + off
+        # mask padded db rows out of the tournament
+        dist = jnp.where(col < n_valid, dist, jnp.inf)
+        tv, tp = lax.top_k(-dist, k)                  # tile top-k (min)
+        ti = jnp.take_along_axis(col, tp, axis=1)
+        pool_v = jnp.concatenate([best_v, -tv], axis=1)
+        pool_i = jnp.concatenate([best_i, ti], axis=1)
+        mv, mp = lax.top_k(-pool_v, k)
+        return (-mv, jnp.take_along_axis(pool_i, mp, axis=1)), None
+
+    (vals, idx), _ = lax.scan(step, init, (tiles, offsets))
+    return vals, idx
+
+
+def knn(res, db, queries, k: int, metric: str = "l2",
+        tile: int = 8192) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k nearest database rows per query. Returns (distances [q, k],
+    indices [q, k]), nearest first.
+
+    ``metric``: 'l2' (squared L2), 'sqeuclidean' (alias), 'euclidean'
+    (rooted), 'cosine', or 'inner' (largest inner product first).
+
+    >>> import numpy as np
+    >>> from raft_tpu.neighbors import knn
+    >>> db = np.array([[0., 0.], [1., 0.], [5., 5.]], np.float32)
+    >>> d, i = knn(None, db, np.array([[0.9, 0.]], np.float32), k=2)
+    >>> np.asarray(i).tolist()
+    [[1, 0]]
+    """
+    db = jnp.asarray(db)
+    queries = jnp.asarray(queries)
+    _validate(db, queries, k)
+    kernel_metric = _resolve_metric(metric)
+    tile = _clamp_tile(tile, k, db.shape[0])
+    vals, idx = _knn_scan(queries.astype(jnp.float32),
+                          db.astype(jnp.float32), k, tile, kernel_metric)
+    return _finalize(vals, metric), idx
+
+
+def knn_mnmg(res, db, queries, k: int, metric: str = "l2",
+             tile: int = 8192, mesh=None, data_axis: str = "data"
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """MNMG brute-force k-NN: database rows sharded over ``data_axis``,
+    queries replicated; per-shard running top-k, then one all-gather of
+    the n_dev·k candidate pool and a final merge — the row-partitioned
+    convention of the reference's MNMG algorithms
+    (docs/source/using_raft_comms.rst) with the k-merge riding ICI.
+
+    Returns replicated (distances [q, k], indices [q, k]) in GLOBAL
+    database row numbering.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raft_tpu.core import resources as core_res
+
+    db = jnp.asarray(db, jnp.float32)
+    queries = jnp.asarray(queries, jnp.float32)
+    _validate(db, queries, k)
+    kernel_metric = _resolve_metric(metric)
+    if mesh is None:
+        mesh = core_res.get_mesh(core_res.default_resources(res))
+    ndev = mesh.shape[data_axis]
+    n = db.shape[0]
+    per = cdiv(n, ndev)
+    if k > per:
+        # a single shard cannot hold k candidates; degenerate scale —
+        # run single-device (the reference's MNMG paths assume k ≪ n/dev)
+        return knn(res, db, queries, k, metric=metric, tile=tile)
+    dbp = jnp.pad(db, ((0, per * ndev - n), (0, 0)))
+    tile_ = _clamp_tile(tile, k, per)
+
+    def shard_fn(db_shard, q):
+        me = lax.axis_index(data_axis)
+        start = me * per
+        # this shard's real row count (last shard may be short)
+        n_local = jnp.clip(jnp.int32(n) - start, 0, per)
+        v, i = _knn_scan(q, db_shard, k, tile_, kernel_metric,
+                         n_valid=n_local)
+        return v[None], (i + start)[None]            # [1, q, k] per shard
+
+    @jax.jit
+    def step(dbs, qs):
+        # per-shard candidates out of shard_map, global k-merge outside
+        # (XLA inserts the ICI gather for the replicated merge)
+        av, ai = jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(data_axis), P()),
+            out_specs=(P(data_axis), P(data_axis)))(dbs, qs)
+        pool_v = jnp.moveaxis(av, 0, 1).reshape(qs.shape[0], ndev * k)
+        pool_i = jnp.moveaxis(ai, 0, 1).reshape(qs.shape[0], ndev * k)
+        mv, mp = lax.top_k(-pool_v, k)
+        return -mv, jnp.take_along_axis(pool_i, mp, axis=1)
+
+    dbs = jax.device_put(dbp, NamedSharding(mesh, P(data_axis)))
+    qs = jax.device_put(queries, NamedSharding(mesh, P()))
+    vals, idx = step(dbs, qs)
+    return _finalize(vals, metric), idx

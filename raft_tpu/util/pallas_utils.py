@@ -53,11 +53,21 @@ def join_vma(*arrays):
         vma |= _vma(a)
     if not vma:
         return vma, arrays
+    out = pcast_to(vma, *arrays)
+    return vma, out if isinstance(out, tuple) else (out,)
+
+
+def pcast_to(vma, *arrays):
+    """pcast each array UP to ``vma`` (no-op outside shard_map). Use for
+    loop-carry inits that must match varying body outputs (lax.scan /
+    while_loop require carry types, incl. vma, to be invariant)."""
+    if not vma:
+        return arrays if len(arrays) != 1 else arrays[0]
     out = []
     for a in arrays:
-        missing = tuple(sorted(vma - _vma(a)))
+        missing = tuple(sorted(frozenset(vma) - _vma(a)))
         out.append(jax.lax.pcast(a, missing, to="varying") if missing else a)
-    return vma, tuple(out)
+    return tuple(out) if len(out) != 1 else out[0]
 
 
 def out_struct(shape, dtype, vma=frozenset()):

@@ -42,6 +42,11 @@ class TestLinalgJit:
         cov = x64.T @ x64
         _compiles(lambda a: linalg.eig_dc(None, a), cov)
 
+    def test_knn(self, x64):
+        from raft_tpu.neighbors import knn
+
+        _compiles(functools.partial(knn, None, k=5), x64, x64[:8])
+
     def test_eig_jacobi(self, x64):
         from raft_tpu import linalg
 
