@@ -10,3 +10,7 @@ from raft_tpu.spectral.analyzers import (  # noqa: F401
     analyze_partition,
     analyze_modularity,
 )
+from raft_tpu.spectral.partition import (  # noqa: F401
+    modularity_maximization,
+    partition,
+)

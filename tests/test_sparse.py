@@ -313,6 +313,45 @@ class TestSolvers:
         np.testing.assert_allclose(got_w, ref.sum(), rtol=1e-5)
         assert out.n_edges == 2 * (n - 1)
 
+    def test_eigsh_invariant_subspace_stability(self, res):
+        """Highly symmetric graph (few distinct eigenvalues) with ncv near
+        n: betas decay to ~1e-5 mid-extension; the RELATIVE breakdown
+        threshold must catch it or noise amplification corrupts the basis
+        (regression: Ritz values exploded to ±435 on a matrix with
+        ||A|| <= 2)."""
+        blocks = [np.ones((8, 8)) - np.eye(8)] * 4
+        a = sp.block_diag(blocks).tolil()
+        for i in range(4):
+            u, v = i * 8, ((i + 1) % 4) * 8 + 1
+            a[u, v] = a[v, u] = 1.0
+        L = csgraph.laplacian(sp.csr_matrix(a).astype(np.float64),
+                              normed=True)
+        Lc = CSRMatrix.from_scipy(sp.csr_matrix(L.astype(np.float32)))
+        for ncv in (12, 20, 31):
+            vals, vecs = eigsh(Lc, k=4, which="SA", ncv=ncv, seed=1)
+            ref = spla.eigsh(L, k=4, which="SA")[0]
+            np.testing.assert_allclose(np.sort(np.asarray(vals)),
+                                       np.sort(ref), atol=1e-3,
+                                       err_msg=f"ncv={ncv}")
+
+    def test_eigsh_scale_invariance(self, res):
+        """A 1e-4-scaled matrix must solve exactly like its unit-scale
+        version (regression: a constant floor in the breakdown threshold
+        made every step on a tiny-norm operator look like breakdown)."""
+        rng = np.random.RandomState(3)
+        d = rng.rand(40, 40)
+        d = np.triu(d, 1) * (np.triu(d, 1) < 0.2)
+        A = sp.csr_matrix(d + d.T).astype(np.float32)
+        L = csgraph.laplacian(A.astype(np.float64))
+        for scale in (1.0, 1e-4):
+            Ls = sp.csr_matrix(L * scale).astype(np.float32)
+            vals, _ = eigsh(CSRMatrix.from_scipy(Ls), k=3, which="SA",
+                            seed=0)
+            ref = np.sort(np.linalg.eigvalsh((L * scale).toarray()))[:3]
+            np.testing.assert_allclose(np.sort(np.asarray(vals)), ref,
+                                       atol=1e-3 * scale + 1e-7,
+                                       err_msg=f"scale={scale}")
+
     def test_eigsh_ell_auto_selection(self, res):
         """Regular sparsity → maybe_ell picks the slab SpMV inside the
         Lanczos device loop; results must match scipy either way."""
