@@ -47,7 +47,7 @@ def _merge_fixpoint(labels_a, labels_b, mask, max_rounds: int):
 
     def cond(state):
         i, r, changed = state
-        return changed & (i < max_rounds)
+        return changed & (i < jnp.int32(max_rounds))
 
     def body(state):
         i, r, _ = state
@@ -70,8 +70,10 @@ def merge_labels(labels_a, labels_b, mask):
     mask = jnp.asarray(mask)
     n = a.shape[0]
 
-    # O(log N) rounds suffice (path halving); cap defensively.
-    max_rounds = max(4, 2 * int(np.ceil(np.log2(n + 1))) + 4)
+    # The `changed` flag exits in O(log N) rounds on ordinary inputs;
+    # the cap must be DIAMETER-safe (n+2), not logarithmic — adversarial
+    # equivalence chains propagate the min one hop per round.
+    max_rounds = n + 2
     r = _merge_fixpoint(a, b, mask, max_rounds)
 
     out = jnp.where(a == MAX_LABEL, MAX_LABEL,

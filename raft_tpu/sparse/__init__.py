@@ -19,3 +19,4 @@ from raft_tpu.sparse.ell import ELLMatrix  # noqa: F401
 
 from . import convert, ell, linalg, matrix, op  # noqa: F401
 from . import solver  # noqa: F401
+from raft_tpu.sparse.csr import weak_cc, weak_cc_batched  # noqa: F401

@@ -99,7 +99,9 @@ def _boruvka_round(colors, src, dst, weights, n: int):
 
     def cond(state):
         i, r, changed = state
-        return changed & (i < jnp.int32(2 * max(1, n.bit_length()) + 4))
+        # diameter-safe cap (see sparse/csr.py weak_cc): chosen-edge
+        # chains with adversarial color ids propagate one hop per round
+        return changed & (i < jnp.int32(n + 2))
 
     def body(state):
         i, r, _ = state

@@ -48,3 +48,20 @@ def rng_state():
     from raft_tpu.random import RngState
 
     return RngState(seed=1234)
+
+
+def ring_of_cliques(n_cliques=4, size=8):
+    """Shared graph fixture: n cliques joined in a ring by single bridge
+    edges — highly symmetric (few distinct eigenvalues), the Lanczos
+    invariant-subspace stress case and the spectral-partition oracle."""
+    import scipy.sparse as sp
+
+    from raft_tpu.core.sparse_types import CSRMatrix
+
+    blocks = [np.ones((size, size)) - np.eye(size)] * n_cliques
+    a = sp.block_diag(blocks).tolil()
+    for i in range(n_cliques):
+        u = i * size
+        v = ((i + 1) % n_cliques) * size + 1
+        a[u, v] = a[v, u] = 1.0
+    return CSRMatrix.from_scipy(sp.csr_matrix(a).astype(np.float32))

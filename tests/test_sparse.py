@@ -319,13 +319,9 @@ class TestSolvers:
         threshold must catch it or noise amplification corrupts the basis
         (regression: Ritz values exploded to ±435 on a matrix with
         ||A|| <= 2)."""
-        blocks = [np.ones((8, 8)) - np.eye(8)] * 4
-        a = sp.block_diag(blocks).tolil()
-        for i in range(4):
-            u, v = i * 8, ((i + 1) % 4) * 8 + 1
-            a[u, v] = a[v, u] = 1.0
-        L = csgraph.laplacian(sp.csr_matrix(a).astype(np.float64),
-                              normed=True)
+        from tests.conftest import ring_of_cliques
+        L = csgraph.laplacian(
+            ring_of_cliques().to_scipy().astype(np.float64), normed=True)
         Lc = CSRMatrix.from_scipy(sp.csr_matrix(L.astype(np.float32)))
         for ncv in (12, 20, 31):
             vals, vecs = eigsh(Lc, k=4, which="SA", ncv=ncv, seed=1)
@@ -461,3 +457,75 @@ class TestELL:
         e = ell.from_csr(convert.dense_to_csr(d))
         y = np.asarray(spmv(e, np.ones(8, np.float32)))
         np.testing.assert_array_equal(y, d.sum(1))
+
+
+class TestWeakCC:
+    """Weakly-connected components (ref: sparse/csr.hpp weak_cc)."""
+
+    def test_vs_scipy(self):
+        from raft_tpu.sparse.csr import weak_cc
+
+        rng = np.random.RandomState(5)
+        for trial in range(4):
+            d = rng.rand(60, 60)
+            d = np.triu(d, 1) * (np.triu(d, 1) < 0.03)
+            a = sp.csr_matrix(d).astype(np.float32)   # directed edges
+            labels = np.asarray(weak_cc(None, CSRMatrix.from_scipy(a)))
+            ncomp, ref = csgraph.connected_components(a, directed=True,
+                                                      connection="weak")
+            assert len(np.unique(labels)) == ncomp
+            # same partition: our label == 1 + min vertex per component
+            for c in range(ncomp):
+                ours = labels[ref == c]
+                assert len(set(ours.tolist())) == 1
+                assert ours[0] == np.nonzero(ref == c)[0].min() + 1
+
+    def test_mask_barriers(self):
+        from raft_tpu.label.merge_labels import MAX_LABEL
+        from raft_tpu.sparse.csr import weak_cc, weak_cc_batched
+
+        # path 0-1-2-3; masking vertex 1 splits {0} | {2,3}
+        rows = np.array([0, 1, 2], np.int64)
+        cols = np.array([1, 2, 3], np.int64)
+        a = sp.csr_matrix((np.ones(3, np.float32), (rows, cols)),
+                          shape=(4, 4))
+        mask = np.array([True, False, True, True])
+        labels = np.asarray(weak_cc(None, CSRMatrix.from_scipy(a),
+                                    mask=mask))
+        assert labels[1] == MAX_LABEL
+        assert labels[0] == 1 and labels[2] == labels[3] == 3
+        # batched spelling agrees
+        lb = np.asarray(weak_cc_batched(None, CSRMatrix.from_scipy(a),
+                                        0, 2, mask=mask))
+        np.testing.assert_array_equal(lb, labels)
+
+    def test_adversarial_path_diameter(self):
+        """The reviewer's counterexample: path 0-(n-1)-(n-2)-...-1, a
+        single weak component whose min label spreads only one hop per
+        round — the iteration cap must be diameter-safe, not log-bounded
+        (regression: log cap silently returned 2 components)."""
+        from raft_tpu.sparse.csr import weak_cc
+
+        for n in (64, 256, 1024):
+            src = np.array([0] + list(range(n - 1, 1, -1)), np.int64)
+            dst = np.array([n - 1] + list(range(n - 2, 0, -1)), np.int64)
+            a = sp.csr_matrix((np.ones(len(src), np.float32), (src, dst)),
+                              shape=(n, n))
+            labels = np.asarray(weak_cc(None, CSRMatrix.from_scipy(a)))
+            assert len(np.unique(labels)) == 1, \
+                f"n={n}: {len(np.unique(labels))} labels"
+            assert labels[0] == 1
+
+    def test_mst_adversarial_path(self, res):
+        """Path graph with reversed vertex numbering: color-merge chains
+        propagate one hop per round; forest must still be exact."""
+        n = 512
+        src = np.array(list(range(n - 1, 0, -1)), np.int64)
+        dst = src - 1
+        w = np.linspace(1, 2, n - 1).astype(np.float32)
+        adj = sp.coo_matrix((w, (src, dst)), shape=(n, n))
+        adj = (adj + adj.T).tocsr()
+        out = mst(res, CSRMatrix.from_scipy(adj))
+        assert out.n_edges // 2 == n - 1           # spanning tree
+        got = float(np.sum(np.asarray(out.weights))) / 2
+        np.testing.assert_allclose(got, w.sum(), rtol=1e-5)

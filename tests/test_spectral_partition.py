@@ -35,14 +35,7 @@ def _karate_csr():
     return CSRMatrix.from_scipy((a + a.T).tocsr())
 
 
-def _ring_of_cliques(n_cliques=4, size=8, seed=0):
-    blocks = [np.ones((size, size)) - np.eye(size)] * n_cliques
-    a = sp.block_diag(blocks).tolil()
-    for i in range(n_cliques):  # one bridge edge between adjacent cliques
-        u = i * size
-        v = ((i + 1) % n_cliques) * size + 1
-        a[u, v] = a[v, u] = 1.0
-    return CSRMatrix.from_scipy(sp.csr_matrix(a).astype(np.float32))
+from tests.conftest import ring_of_cliques as _ring_of_cliques  # shared fixture
 
 
 class TestSpectralDrivers:
