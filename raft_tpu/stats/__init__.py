@@ -33,6 +33,7 @@ from raft_tpu.stats.information import (  # noqa: F401
     entropy,
     kl_divergence,
     IC_Type,
+    information_criterion,
     information_criterion_batched,
     cluster_dispersion,
 )

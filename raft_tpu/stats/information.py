@@ -75,3 +75,11 @@ def cluster_dispersion(centroids, cluster_sizes, n_points: int = None):
                  axis=0) / n_points
     d2 = jnp.sum((centroids - mu[None, :]) ** 2, axis=1)
     return jnp.sqrt(jnp.sum(d2 * sizes.astype(centroids.dtype)))
+
+
+def information_criterion(loglikelihood, ic_type: IC_Type, n_params: int,
+                          n_samples: int):
+    """Scalar spelling (ref: stats/information_criterion.cuh — the
+    non-batched overload; identical math on a scalar log-likelihood)."""
+    return information_criterion_batched(loglikelihood, ic_type, n_params,
+                                         n_samples)
