@@ -252,3 +252,21 @@ class TestExtraMetrics:
         num = np.abs(x[:, None, :] - x[None, :, :]).sum(-1)
         den = np.abs(x[:, None, :] + x[None, :, :]).sum(-1)
         np.testing.assert_allclose(d, num / den, rtol=1e-5)
+
+
+def test_kmeans_check_every_same_result(res):
+    """Batched convergence polling must land on the same clustering as
+    per-iteration polling (at most check_every-1 extra iterations)."""
+    import numpy as np
+
+    from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+    from raft_tpu.random import RngState, make_blobs
+
+    x, labels, _ = make_blobs(res, RngState(3), 3000, 12, n_clusters=6)
+    x = np.asarray(x)
+    c1, i1, l1, n1 = kmeans_fit(res, KMeansParams(n_clusters=6, seed=1), x)
+    c2, i2, l2, n2 = kmeans_fit(
+        res, KMeansParams(n_clusters=6, seed=1, check_every=5), x)
+    np.testing.assert_allclose(float(i1), float(i2), rtol=1e-4)
+    assert (np.asarray(l1) == np.asarray(l2)).mean() > 0.999
+    assert n2 <= n1 + 5
