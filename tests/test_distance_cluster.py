@@ -269,4 +269,5 @@ def test_kmeans_check_every_same_result(res):
         res, KMeansParams(n_clusters=6, seed=1, check_every=5), x)
     np.testing.assert_allclose(float(i1), float(i2), rtol=1e-4)
     assert (np.asarray(l1) == np.asarray(l2)).mean() > 0.999
-    assert n2 <= n1 + 5
+    # convergence needs two poll values: bound is next-multiple + one window
+    assert n2 <= -(-n1 // 5) * 5 + 5
