@@ -102,11 +102,78 @@ def bench_reduce():
 
 @bench("linalg/norm")
 def bench_norm():
-    from raft_tpu.linalg import row_norm
+    from raft_tpu.linalg import normalize, row_norm
 
     x = _data(SIZES["rows"], SIZES["cols"])
     f = jax.jit(functools.partial(row_norm, None, norm_type="l2"))
-    return [run_case("linalg/row_norm_l2", f, x, bytes_moved=x.size * 4)]
+    g = jax.jit(functools.partial(normalize, None))
+    return [
+        run_case("linalg/row_norm_l2", f, x, bytes_moved=x.size * 4),
+        run_case("linalg/normalize", g, x, bytes_moved=x.size * 8),
+    ]
+
+
+@bench("linalg/reduce_cols_by_key")
+def bench_rcbk():
+    from raft_tpu.linalg import reduce_cols_by_key
+
+    rng = np.random.default_rng(19)
+    x = _data(1024, SIZES["cols"])
+    keys = jnp.asarray(rng.integers(0, 32,
+                                    size=SIZES["cols"]).astype(np.int32))
+    f = jax.jit(lambda d, k: reduce_cols_by_key(None, d, k,
+                                                n_unique_keys=32))
+    return [run_case("linalg/reduce_cols_by_key", f, x, keys,
+                     bytes_moved=x.size * 4, n_keys=32)]
+
+
+@bench("sparse/sddmm_masked")
+def bench_sddmm_masked():
+    """sddmm + masked_matmul (ref: bench/prims/linalg/sddmm.cu,
+    masked_matmul.cu)."""
+    from raft_tpu.core.bitset import Bitmap
+    from raft_tpu.sparse.convert import dense_to_csr
+    from raft_tpu.sparse.linalg import masked_matmul, sddmm
+
+    rng = np.random.default_rng(23)
+    m, n, k = 2048, 2048, SIZES["cols"]
+    a = _data(m, k, seed=24)
+    b = _data(k, n, seed=25)
+    pat = rng.uniform(size=(m, n)) < 0.01
+    csr = dense_to_csr(jnp.asarray(pat.astype(np.float32)))
+    nnz = int(csr.data.shape[0])
+    f = jax.jit(lambda aa, bb: sddmm(aa, bb, csr).data)
+    out = [run_case("sparse/sddmm", f, a, b, flops=2 * nnz * k, nnz=nnz)]
+    # convert the bitmap pattern once outside the hot loop (the reference
+    # bench also pre-builds its mask CSR)
+    from raft_tpu.sparse.convert import bitmap_to_csr
+
+    pattern = bitmap_to_csr(Bitmap.from_bool_matrix(pat))
+    g = jax.jit(lambda aa, bb: masked_matmul(aa, bb.T, pattern).data)
+    out.append(run_case("sparse/masked_matmul", g, a, b,
+                        flops=2 * nnz * k, nnz=nnz))
+    return out
+
+
+@bench("sparse/convert_csr")
+def bench_convert_csr():
+    """adj→CSR + bitset→CSR conversions (ref: bench/prims/sparse/
+    convert_csr.cu, bitset_to_csr.cu)."""
+    from raft_tpu.core.bitset import Bitset
+    from raft_tpu.sparse.convert import adj_to_csr, bitset_to_csr
+
+    rng = np.random.default_rng(29)
+    rows, cols = 4096, 4096
+    adj = rng.uniform(size=(rows, cols)) < 0.05
+    # host-side conversions (dynamic nnz → not jittable by design);
+    # timed eagerly, matching what the reference bench measures
+    out = [run_case("sparse/adj_to_csr", lambda: adj_to_csr(adj).indices,
+                    items=rows * cols)]
+    bs = Bitset.from_bools(adj[0])
+    out.append(run_case("sparse/bitset_to_csr",
+                        lambda: bitset_to_csr(bs, n_rows=rows).indices,
+                        items=rows * cols))
+    return out
 
 
 @bench("linalg/matrix_vector_op")
