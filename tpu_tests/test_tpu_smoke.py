@@ -83,6 +83,27 @@ class TestCompiledKernels:
             np.testing.assert_allclose(np.asarray(ov),
                                        np.sort(v, 1)[:, :k], rtol=1e-6)
 
+    def test_pairwise_cosine_compiled(self, rng):
+        from raft_tpu.linalg.contractions import pairwise_pallas
+
+        x = rng.normal(size=(200, 40)).astype(np.float32)
+        y = rng.normal(size=(90, 40)).astype(np.float32)
+        d = np.asarray(pairwise_pallas(x, y, metric="cosine"))
+        xn = np.linalg.norm(x, axis=1, keepdims=True)
+        yn = np.linalg.norm(y, axis=1, keepdims=True)
+        np.testing.assert_allclose(d, 1 - (x @ y.T) / (xn * yn.T),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_knn_compiled(self, rng):
+        from raft_tpu.neighbors import knn
+
+        db = rng.normal(size=(3000, 32)).astype(np.float32)
+        q = rng.normal(size=(64, 32)).astype(np.float32)
+        d, i = knn(None, db, q, k=10, metric="euclidean", tile=1024)
+        ref = np.sqrt(((q[:, None, :] - db[None, :, :]) ** 2).sum(-1))
+        order = np.argsort(ref, axis=1)[:, :10]
+        assert (np.asarray(i) == order).mean() > 0.99
+
     def test_spmv_csr_and_ell(self, rng):
         import scipy.sparse as sp
 
