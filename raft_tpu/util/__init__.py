@@ -15,6 +15,7 @@ from raft_tpu.util.math import (  # noqa: F401
     prev_pow2,
     Pow2,
     FastIntDiv,
+    Seive,
     bound_by_power_of_two_and_ratio,
 )
 from raft_tpu.util.pallas_utils import (  # noqa: F401

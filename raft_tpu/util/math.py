@@ -89,3 +89,40 @@ def bound_by_power_of_two_and_ratio(total: int, cap_pow2: int,
     reference's kernel policies (e.g. linalg/contractions.cuh:52-80)."""
     tile = min(cap_pow2, next_pow2(max(1, total // ratio)))
     return max(1, prev_pow2(tile))
+
+
+class Seive:
+    """Prime sieve (ref: util/seive.hpp — the reference uses it to pick
+    hash strides for its GPU cache; kept name-compatible, misspelling and
+    all).
+
+    >>> from raft_tpu.util.math import Seive
+    >>> s = Seive(30)
+    >>> s.is_prime(29), s.is_prime(28)
+    (True, False)
+    >>> s.get_num_primes()
+    10
+    """
+
+    def __init__(self, n: int):
+        import numpy as np
+
+        self._n = int(n)
+        sieve = np.ones(max(self._n + 1, 2), dtype=bool)
+        sieve[:2] = False
+        for p in range(2, int(self._n ** 0.5) + 1):
+            if sieve[p]:
+                sieve[p * p::p] = False
+        self._sieve = sieve
+        self._primes = np.nonzero(sieve)[0]
+
+    def is_prime(self, num: int) -> bool:
+        if not 0 <= num <= self._n:
+            raise ValueError(f"{num} outside sieve range [0, {self._n}]")
+        return bool(self._sieve[num])
+
+    def get_num_primes(self) -> int:
+        return int(self._primes.shape[0])
+
+    def get_primes(self):
+        return self._primes.copy()
