@@ -20,6 +20,17 @@ T tiles, top-k each tile on the VPU (cheap local sort), then top-k the
 T·k-wide candidate pool — a 2-level tournament with identical results for
 any distribution, because a global top-k element is necessarily a top-k
 element of its tile.
+
+Design note — why no Pallas radix-select kernel (the reference's 1.3k-LoC
+select_radix.cuh): Mosaic has no in-kernel sort primitive, and the radix
+approach's final step (compacting the ≤k candidates below the histogram
+threshold) is itself a variable-length selection that XLA can only express
+as another top_k — so a hand-written kernel would re-pay exactly the cost
+it tries to avoid. The tournament keeps every pass bandwidth-shaped
+(tiles stream once; the pool is T·k ≪ len); the select_k bench family
+(direct vs tiled, k up to 10⁴) records where each wins on hardware, and a
+Pallas path remains future work ONLY if those numbers show XLA's top_k
+below the bandwidth roofline at a shape that matters.
 """
 
 from __future__ import annotations
