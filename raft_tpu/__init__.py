@@ -35,6 +35,10 @@ from raft_tpu.core.resources import (  # noqa: F401
     device_resources,
     DeviceResources,
 )
+from raft_tpu.util.precision import (  # noqa: F401
+    set_matmul_precision,
+    get_matmul_precision,
+)
 
 # Subpackages are imported lazily by attribute access to keep `import raft_tpu`
 # cheap (jax itself is imported eagerly by core).

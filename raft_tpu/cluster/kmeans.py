@@ -32,6 +32,7 @@ from jax import lax
 from raft_tpu.linalg.contractions import (fused_l2_argmin_pallas,
                                           fused_lloyd_pallas)
 from raft_tpu.random.rng_state import RngState
+from raft_tpu.util.precision import with_matmul_precision
 
 
 class KMeansInit(enum.Enum):
@@ -103,6 +104,7 @@ def _lloyd_sums(x, centroids):
     return sums, counts, dist, labels.astype(jnp.int32)
 
 
+@with_matmul_precision
 @functools.partial(jax.jit, static_argnames=("n_clusters",))
 def lloyd_step(x, centroids, n_clusters: int):
     """One Lloyd iteration: returns (new_centroids, inertia, labels).
@@ -221,6 +223,7 @@ def _init_centroids(params: KMeansParams, state: RngState, x,
                              params.oversampling_factor)
 
 
+@with_matmul_precision
 def kmeans_fit(res, params: KMeansParams, x,
                centroids: Optional[jnp.ndarray] = None
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
@@ -263,12 +266,14 @@ def kmeans_fit(res, params: KMeansParams, x,
     return c, inertia, labels, n_iter
 
 
+@with_matmul_precision
 def kmeans_predict(res, x, centroids):
     """Assignment only. Returns (labels, inertia)."""
     dist, labels = _assign(jnp.asarray(x), jnp.asarray(centroids))
     return labels, jnp.sum(dist)
 
 
+@with_matmul_precision
 def kmeans_transform(res, x, centroids):
     """Distance-to-centroid embedding [m, k]."""
     from raft_tpu.distance import pairwise_distance, DistanceType
@@ -277,6 +282,7 @@ def kmeans_transform(res, x, centroids):
                              metric=DistanceType.L2SqrtExpanded)
 
 
+@with_matmul_precision
 def kmeans_fit_predict(res, params: KMeansParams, x,
                        centroids: Optional[jnp.ndarray] = None):
     c, inertia, labels, n_iter = kmeans_fit(res, params, x, centroids)
@@ -288,6 +294,7 @@ def kmeans_fit_predict(res, params: KMeansParams, x,
 # ---------------------------------------------------------------------------
 
 
+@with_matmul_precision
 def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
                     data_axis: str = "data",
                     model_axis: Optional[str] = None):
@@ -333,6 +340,7 @@ def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
     return new_c, inertia, labels
 
 
+@with_matmul_precision
 def kmeans_fit_mnmg(res, params: KMeansParams, x,
                     centroids: Optional[jnp.ndarray] = None,
                     mesh=None, data_axis: str = "data"):

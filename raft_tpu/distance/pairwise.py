@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from raft_tpu.linalg.contractions import pairwise_l2_pallas, \
     fused_l2_argmin_pallas
+from raft_tpu.util.precision import with_matmul_precision
 
 
 class DistanceType(enum.Enum):
@@ -146,6 +147,7 @@ def _bool_stats(x, y):
     return both, x_only, y_only, xf.shape[1]
 
 
+@with_matmul_precision
 def pairwise_distance(res, x, y=None,
                       metric: DistanceType = DistanceType.L2Expanded,
                       p: float = 2.0, sqrt: Optional[bool] = None
@@ -246,6 +248,7 @@ def pairwise_distance(res, x, y=None,
     raise ValueError(f"unsupported metric {metric}")
 
 
+@with_matmul_precision
 def fused_l2_nn_argmin(res, x, y, sqrt: bool = False):
     """Nearest-neighbor (1-NN) under L2 without materializing distances —
     the fusedL2NN of the reference lineage, on the Pallas contraction

@@ -16,16 +16,22 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.util.precision import resolve, with_matmul_precision
 
+
+@with_matmul_precision
 def gemm(res, A, B, alpha: float = 1.0, beta: float = 0.0, C=None,
          trans_a: bool = False, trans_b: bool = False,
-         compute_type=None):
+         compute_type=None, precision=None):
     """C = alpha·op(A)·op(B) + beta·C (ref: linalg/gemm.cuh).
 
     ``compute_type`` maps the reference's cublasLt compute-type selection
     (detail/cublaslt_wrappers.hpp get_matmul_type): None → accumulate in
     f32 (or f64 for f64 inputs); pass jnp.float32 explicitly to force MXU
     bf16×bf16→f32 style accumulation for low-precision inputs.
+    ``precision`` ('default' | 'high' | 'highest' | lax.Precision) is the
+    MXU pass-count knob — the other half of the compute-type table; None
+    defers to the framework policy (util.precision, default 'highest').
     """
     A = jnp.asarray(A)
     B = jnp.asarray(B)
@@ -36,13 +42,15 @@ def gemm(res, A, B, alpha: float = 1.0, beta: float = 0.0, C=None,
     if compute_type is None:
         compute_type = jnp.float64 if A.dtype == jnp.float64 else jnp.float32
     out = lax.dot_general(A, B, (((1,), (0,)), ((), ())),
-                          preferred_element_type=compute_type)
+                          preferred_element_type=compute_type,
+                          precision=resolve(precision))
     out = (alpha * out).astype(A.dtype) if alpha != 1.0 else out.astype(A.dtype)
     if C is not None and beta != 0.0:
         out = out + beta * jnp.asarray(C)
     return out
 
 
+@with_matmul_precision
 def gemv(res, A, x, alpha: float = 1.0, beta: float = 0.0, y=None,
          trans: bool = False):
     """y = alpha·op(A)·x + beta·y (ref: linalg/gemv.cuh)."""
@@ -61,6 +69,7 @@ def axpy(res, alpha: float, x, y):
     return alpha * jnp.asarray(x) + jnp.asarray(y)
 
 
+@with_matmul_precision
 def dot(res, x, y):
     """Inner product (ref: linalg/dot.cuh)."""
     x = jnp.asarray(x)

@@ -43,6 +43,7 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.util.math import round_up_to_multiple
 from raft_tpu.util.pallas_utils import (interpret_needs_ref, join_vma,
                                         out_struct, pallas_call)
+from raft_tpu.util.precision import with_matmul_precision
 
 # Per-kernel VMEM working-set budget (v5e has ~16 MB/core; leave headroom
 # for Mosaic's own buffers and double-buffered pipelining).
@@ -151,6 +152,7 @@ def _pairwise_padded(x, y, tm: int, tn: int, metric: str = "l2"):
     )(x, y)
 
 
+@with_matmul_precision
 def pairwise_pallas(x, y, metric: str = "l2",
                     tm: int = 256, tn: int = 256) -> jnp.ndarray:
     """Distance matrix between rows of x and y under a fused epilogue
@@ -296,6 +298,7 @@ def _fused_argmin_tiled(x, y, tm: int, tn: int, n_valid: int, metric: str):
     )(x, y)
 
 
+@with_matmul_precision
 def fused_argmin_pallas(x, y, metric: str = "l2",
                         tm: Optional[int] = None, tn: int = 512
                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -414,6 +417,7 @@ def _fused_lloyd_padded(x, y, tm: int, n_valid: int, m_valid: int):
     )(x, y)
 
 
+@with_matmul_precision
 def fused_lloyd_pallas(x, y) -> Tuple[jnp.ndarray, jnp.ndarray,
                                       jnp.ndarray, jnp.ndarray]:
     """One full Lloyd iteration's data pass, fused into a single kernel.

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from raft_tpu.util.precision import with_matmul_precision
 
 EigVecUsage = ("OVERWRITE_INPUT", "COPY_INPUT")
 
@@ -100,6 +101,7 @@ def _jacobi_sweeps(a, pairs, tol, max_sweeps: int):
     return jnp.diagonal(a), v
 
 
+@with_matmul_precision
 def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15):
     """Jacobi eigensolver (ref: eig.cuh eig_jacobi → cusolverDnsyevj).
 

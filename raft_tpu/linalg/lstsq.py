@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import jax.numpy as jnp
+from raft_tpu.util.precision import with_matmul_precision
 
 
 def _scale_rows(x, s):
@@ -10,6 +11,7 @@ def _scale_rows(x, s):
     return x * s if x.ndim == 1 else x * s[:, None]
 
 
+@with_matmul_precision
 def lstsq_svd_qr(res, A, b):
     """Minimum-norm solution via SVD (ref: lstsq.cuh lstsqSvdQR)."""
     A = jnp.asarray(A)
@@ -20,11 +22,13 @@ def lstsq_svd_qr(res, A, b):
     return vt.T @ _scale_rows(u.T @ b, s_inv)
 
 
+@with_matmul_precision
 def lstsq_svd_jacobi(res, A, b):
     """ref: lstsq.cuh lstsqSvdJacobi (gesvdj path)."""
     return lstsq_svd_qr(res, A, b)
 
 
+@with_matmul_precision
 def lstsq_eig(res, A, b):
     """Normal-equations path via eigendecomposition of AᵀA
     (ref: lstsq.cuh lstsqEig)."""
@@ -37,6 +41,7 @@ def lstsq_eig(res, A, b):
     return v @ _scale_rows(v.T @ (A.T @ b), w_inv)
 
 
+@with_matmul_precision
 def lstsq_qr(res, A, b):
     """QR path (ref: lstsq.cuh lstsqQR — geqrf/ormqr + triangular solve)."""
     A = jnp.asarray(A)

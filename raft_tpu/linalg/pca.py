@@ -14,6 +14,7 @@ from typing import NamedTuple, Optional
 import jax.numpy as jnp
 
 from raft_tpu.random.rng_state import RngState
+from raft_tpu.util.precision import with_matmul_precision
 
 
 class Solver(enum.Enum):
@@ -53,6 +54,7 @@ def cal_eig(res, cov, n_components: int, solver: Solver = Solver.COV_EIG_DQ):
     return w[:n_components], v[:, :n_components]
 
 
+@with_matmul_precision
 def pca_fit(res, X, n_components: int,
             solver: Solver = Solver.COV_EIG_DQ,
             state: Optional[RngState] = None) -> PCAResult:
@@ -92,6 +94,7 @@ def pca_fit(res, X, n_components: int,
                      noise.astype(X.dtype))
 
 
+@with_matmul_precision
 def pca_transform(res, X, result: PCAResult, whiten: bool = False):
     """Project into component space (ref: pca.cuh pca_transform)."""
     X = jnp.asarray(X)
@@ -102,6 +105,7 @@ def pca_transform(res, X, result: PCAResult, whiten: bool = False):
     return t
 
 
+@with_matmul_precision
 def pca_inverse_transform(res, T, result: PCAResult, whiten: bool = False):
     """ref: pca.cuh pca_inverse_transform."""
     T = jnp.asarray(T)
@@ -111,6 +115,7 @@ def pca_inverse_transform(res, T, result: PCAResult, whiten: bool = False):
     return T @ result.components + result.mean[None, :]
 
 
+@with_matmul_precision
 def pca_fit_transform(res, X, n_components: int, **kw):
     result = pca_fit(res, X, n_components, **kw)
     return pca_transform(res, X, result), result
@@ -126,6 +131,7 @@ class TSVDResult(NamedTuple):
     explained_variance_ratio: jnp.ndarray
 
 
+@with_matmul_precision
 def tsvd_fit(res, X, n_components: int,
              solver: Solver = Solver.COV_EIG_DQ,
              state: Optional[RngState] = None) -> TSVDResult:
@@ -152,14 +158,17 @@ def tsvd_fit(res, X, n_components: int,
                       (explained / total_var).astype(X.dtype))
 
 
+@with_matmul_precision
 def tsvd_transform(res, X, result: TSVDResult):
     return jnp.asarray(X) @ result.components.T
 
 
+@with_matmul_precision
 def tsvd_inverse_transform(res, T, result: TSVDResult):
     return jnp.asarray(T) @ result.components
 
 
+@with_matmul_precision
 def tsvd_fit_transform(res, X, n_components: int, **kw):
     result = tsvd_fit(res, X, n_components, **kw)
     return tsvd_transform(res, X, result), result

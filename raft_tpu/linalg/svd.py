@@ -15,8 +15,10 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.random.rng_state import RngState
+from raft_tpu.util.precision import with_matmul_precision
 
 
+@with_matmul_precision
 def svd_qr(res, matrix, full_matrices: bool = False):
     """SVD returning (U, S, V) with V as columns of right singular vectors
     (ref: svd.cuh svdQR).  Note: returns V, not V^T."""
@@ -25,6 +27,7 @@ def svd_qr(res, matrix, full_matrices: bool = False):
     return u, s, vt.T
 
 
+@with_matmul_precision
 def svd_eig(res, matrix):
     """SVD via eigendecomposition of the Gram matrix
     (ref: svd.cuh svdEig — the path used when n_rows >> n_cols)."""
@@ -37,16 +40,19 @@ def svd_eig(res, matrix):
     return u, s, v
 
 
+@with_matmul_precision
 def svd_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15):
     """Jacobi SVD spelling (ref: svd.cuh svdJacobi → gesvdj)."""
     return svd_qr(res, matrix)
 
 
+@with_matmul_precision
 def svd_reconstruction(res, u, s, v):
     """A ≈ U·diag(S)·V^T (ref: svd.cuh svdReconstruction)."""
     return (jnp.asarray(u) * jnp.asarray(s)[None, :]) @ jnp.asarray(v).T
 
 
+@with_matmul_precision
 def evaluate_svd_by_reconstruction(res, matrix, u, s, v,
                                    tol: float = 1e-3) -> bool:
     """ref: svd.cuh evaluateSVDByL2Norm."""
@@ -56,6 +62,7 @@ def evaluate_svd_by_reconstruction(res, matrix, u, s, v,
     return bool(err < tol)
 
 
+@with_matmul_precision
 def rsvd_fixed_rank(res, matrix, k: int, p: int = 10, n_iter: int = 2,
                     state: Optional[RngState] = None,
                     use_bbt: Optional[bool] = None):
@@ -82,6 +89,7 @@ def rsvd_fixed_rank(res, matrix, k: int, p: int = 10, n_iter: int = 2,
     return u[:, :k], s[:k], vt[:k].T
 
 
+@with_matmul_precision
 def rsvd_perc(res, matrix, perc: float, p: int = 10, n_iter: int = 2,
               state: Optional[RngState] = None):
     """Rank chosen as a fraction of min(m,n) (ref: rsvd.cuh rsvdPerc)."""

@@ -21,6 +21,7 @@ from jax import lax
 
 from raft_tpu.linalg.contractions import pairwise_pallas
 from raft_tpu.util.math import cdiv, round_up_to_multiple
+from raft_tpu.util.precision import with_matmul_precision
 
 
 
@@ -99,6 +100,7 @@ def _knn_scan(queries, db, k: int, tile: int, metric: str, n_valid=None):
     return vals, idx
 
 
+@with_matmul_precision
 def knn(res, db, queries, k: int, metric: str = "l2",
         tile: int = 8192) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """k nearest database rows per query. Returns (distances [q, k],
@@ -124,6 +126,7 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     return _finalize(vals, metric), idx
 
 
+@with_matmul_precision
 def knn_mnmg(res, db, queries, k: int, metric: str = "l2",
              tile: int = 8192, mesh=None, data_axis: str = "data"
              ) -> Tuple[jnp.ndarray, jnp.ndarray]:

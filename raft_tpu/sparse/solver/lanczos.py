@@ -30,6 +30,7 @@ from raft_tpu.core import logger
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.sparse import convert
 from raft_tpu.sparse.linalg import _segment_spmv as _spmv_kernel
+from raft_tpu.util.precision import with_matmul_precision
 
 
 @dataclasses.dataclass
@@ -134,6 +135,7 @@ def _extend_device(m1, m2, m3, basis, v, key,
     return basis, jnp.stack([alphas, betas]), brk, v
 
 
+@with_matmul_precision
 def lanczos_compute_eigenpairs(res, a, config: LanczosConfig,
                                v0: Optional[jnp.ndarray] = None,
                                rank1=None) -> Tuple[jnp.ndarray,
@@ -151,6 +153,7 @@ def lanczos_compute_eigenpairs(res, a, config: LanczosConfig,
     return _eigsh_csr(a, config, v0, rank1=rank1)
 
 
+@with_matmul_precision
 def eigsh(a, k: int = 6, which: str = "SA", v0=None, ncv: int = 0,
           maxiter: int = 1000, tol: float = 1e-7, seed: int = 42,
           res=None) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.sparse import convert
+from raft_tpu.util.precision import with_matmul_precision
 
 
 def _csr(a):
@@ -40,6 +41,7 @@ def _spmm(csr: CSRMatrix, h):
     return out.at[row_ids].add(gathered)
 
 
+@with_matmul_precision
 def analyze_partition(res, csr, n_clusters: int, clusters):
     """Returns (edge_cut, cost) for a clustering of a weighted undirected
     graph (ref: partition.cuh:38; cost is the ratio-cut sum of
@@ -59,6 +61,7 @@ def analyze_partition(res, csr, n_clusters: int, clusters):
     return edge_cut, cost
 
 
+@with_matmul_precision
 def analyze_modularity(res, csr, n_clusters: int, clusters):
     """Returns the modularity of a clustering (ref:
     modularity_maximization.cuh:31; detail computes

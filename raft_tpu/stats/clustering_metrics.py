@@ -13,6 +13,7 @@ so no quadratic work is needed.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from raft_tpu.util.precision import with_matmul_precision
 
 
 def _num_classes(arr, n=None):
@@ -137,6 +138,7 @@ def v_measure(y_true, y_pred, n_classes: int = None, beta: float = 1.0):
     return jnp.where(denom == 0, 0.0, (1.0 + beta) * h * c / denom)
 
 
+@with_matmul_precision
 def silhouette_score(res, x, labels, n_clusters: int, metric=None,
                      chunk: int = 4096):
     """Mean silhouette coefficient s(i) = (b-a)/max(a,b).

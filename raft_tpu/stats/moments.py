@@ -10,6 +10,7 @@ default layout) and are jit-safe.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from raft_tpu.util.precision import with_matmul_precision
 
 
 def mean(x, axis: int = 0):
@@ -66,6 +67,7 @@ def minmax(x, axis: int = 0, rows=None, row_ids=None):
     return jnp.min(x, axis=axis), jnp.max(x, axis=axis)
 
 
+@with_matmul_precision
 def cov(x, mu=None, sample: bool = True, center: bool = True):
     """Covariance matrix of row-sample data ``x`` (n, d) -> (d, d).
 

@@ -31,3 +31,8 @@ from raft_tpu.util.input_validation import (  # noqa: F401
 )
 from raft_tpu.util.itertools import product_of_lists  # noqa: F401
 from raft_tpu.util.cache import VectorCache  # noqa: F401
+from raft_tpu.util.precision import (  # noqa: F401
+    set_matmul_precision,
+    get_matmul_precision,
+    with_matmul_precision,
+)
