@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from raft_tpu.linalg.contractions import (fused_l2_argmin_pallas,
+from raft_tpu.linalg.contractions import (_kernel_dot_exact_lhs,
+                                          fused_l2_argmin_pallas,
                                           fused_lloyd_pallas)
 from raft_tpu.random.rng_state import RngState
 from raft_tpu.util.precision import with_matmul_precision
@@ -324,7 +325,7 @@ def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
         oh = ((jax.lax.broadcasted_iota(jnp.int32, (x_shard.shape[0], kb), 1)
                == local_labels[:, None])
               & in_block[:, None]).astype(jnp.float32)
-        sums = jnp.dot(oh.T, x_shard.astype(jnp.float32))
+        sums = _kernel_dot_exact_lhs(oh.T, x_shard.astype(jnp.float32))
         counts = jnp.sum(oh, axis=0)
         sums = lax.psum(sums, data_axis)
         counts = lax.psum(counts, data_axis)

@@ -163,11 +163,28 @@ def pairwise_distance(res, x, y=None,
     >>> d = pairwise_distance(None, x, metric=DistanceType.L2SqrtExpanded)
     >>> np.asarray(d).round(1).tolist()
     [[0.0, 5.0], [5.0, 0.0]]
+
+    With ``y=None`` (self-distance) the diagonal is set to exactly zero
+    for every true metric: the expanded forms compute ||x||²-2x·y+||y||²,
+    whose cancellation noise on the diagonal scales with the matmul tier
+    (~1e-7 rel at f32, ~1e-5 at the default bf16x3 tier) — the same
+    conditioning the reference's L2Expanded kernels have in f32. Off-
+    diagonal near-zero distances at exact-parity accuracy need the
+    Unexpanded metrics, as in the reference.
     """
     x = _as2d(x)
-    y = x if y is None else _as2d(y)
+    self_dist = y is None
+    y = x if self_dist else _as2d(y)
     if x.shape[1] != y.shape[1]:
         raise ValueError(f"feature dims differ: {x.shape[1]} vs {y.shape[1]}")
+    # InnerProduct is a similarity and RusselRao's self-"distance" is
+    # legitimately nonzero ((k - #ones)/k) — only true metrics get the
+    # exact-zero diagonal.
+    if self_dist and metric not in (DistanceType.InnerProduct,
+                                    DistanceType.RusselRaoExpanded):
+        d = pairwise_distance(res, x, x, metric=metric, p=p, sqrt=sqrt)
+        eye = jnp.eye(d.shape[0], dtype=bool)
+        return jnp.where(eye, jnp.zeros((), d.dtype), d)
 
     m = metric
     if m == DistanceType.L2Expanded:

@@ -31,7 +31,8 @@ def gemm(res, A, B, alpha: float = 1.0, beta: float = 0.0, C=None,
     bf16×bf16→f32 style accumulation for low-precision inputs.
     ``precision`` ('default' | 'high' | 'highest' | lax.Precision) is the
     MXU pass-count knob — the other half of the compute-type table; None
-    defers to the framework policy (util.precision, default 'highest').
+    defers to the framework policy (util.precision, default 'high' =
+    bf16x3, measured ~1e-6 rel-err; 'highest' for strict f32 parity).
     """
     A = jnp.asarray(A)
     B = jnp.asarray(B)

@@ -43,13 +43,58 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.util.math import round_up_to_multiple
 from raft_tpu.util.pallas_utils import (interpret_needs_ref, join_vma,
                                         out_struct, pallas_call)
-from raft_tpu.util.precision import with_matmul_precision
+from raft_tpu.util.precision import current_mode, with_matmul_precision
 
 # Per-kernel VMEM working-set budget (v5e has ~16 MB/core; leave headroom
 # for Mosaic's own buffers and double-buffered pipelining).
 _VMEM_BUDGET = 10 * 1024 * 1024
 
 _I32_MAX = 2147483647
+
+
+def _kernel_dot(a, b, exact_lhs: bool = False):
+    """``a @ b`` with f32 accumulation at the policy's accuracy tier,
+    spelled so it lowers under Mosaic (which rejects Precision.HIGH):
+
+    - 'default': one bf16 MXU pass (~8 mantissa bits) — the fast path.
+    - 'high': manual bf16 hi/lo split — a = hi + lo with both halves bf16,
+      a·b ≈ hi·hi + hi·lo + lo·hi (3 MXU passes, ~2^-17 relative; the
+      dropped lo·lo term is below that). This is the same bf16x3
+      decomposition XLA uses for Precision.HIGH outside kernels.
+    - 'highest': full f32 (Mosaic lowers HIGHEST natively) — the
+      accuracy contract of the reference's CUBLAS_COMPUTE_32F / f32-FMA
+      kernels (ref: linalg/detail/cublaslt_wrappers.hpp:28-62).
+
+    ``exact_lhs=True`` declares that ``a``'s values are exactly
+    bf16-representable (a one-hot 0/1 matrix): its lo half is identically
+    zero, so the 'high' tier drops that pass (2 passes instead of 3).
+    Non-f32 inputs (bf16) take a single exact-multiply pass regardless.
+    """
+    mode = current_mode()
+    f32 = jnp.float32
+    one_pass = jax.lax.Precision.DEFAULT         # bf16 multiply is exact
+    if a.dtype != f32 or b.dtype != f32 or mode == "default":
+        return jnp.dot(a, b, preferred_element_type=f32,
+                       precision=one_pass)
+    if mode == "high":
+        a_hi = a.astype(jnp.bfloat16)
+        b_hi = b.astype(jnp.bfloat16)
+        b_lo = (b - b_hi.astype(f32)).astype(jnp.bfloat16)
+        out = (jnp.dot(a_hi, b_hi, preferred_element_type=f32,
+                       precision=one_pass)
+               + jnp.dot(a_hi, b_lo, preferred_element_type=f32,
+                         precision=one_pass))
+        if exact_lhs:
+            return out
+        a_lo = (a - a_hi.astype(f32)).astype(jnp.bfloat16)
+        return out + jnp.dot(a_lo, b_hi, preferred_element_type=f32,
+                             precision=one_pass)
+    return jnp.dot(a, b, preferred_element_type=f32,
+                   precision=jax.lax.Precision.HIGHEST)
+
+
+def _kernel_dot_exact_lhs(a, b):
+    return _kernel_dot(a, b, exact_lhs=True)
 
 
 def _pad2(x: jnp.ndarray, rows: int, cols: int) -> jnp.ndarray:
@@ -77,8 +122,7 @@ def _lloyd_jnp(x, y):
     val, idx = _argmin_jnp(x, y)
     oh = (jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], y.shape[0]), 1)
           == idx[:, None]).astype(jnp.float32)
-    sums = jnp.dot(oh.T, x.astype(jnp.float32),
-                   preferred_element_type=jnp.float32)
+    sums = _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32))
     counts = jnp.sum(oh, axis=0)
     return sums, counts, val, idx
 
@@ -112,7 +156,7 @@ def _metric_tile(x, y, metric: str):
     fusedCosineNN). ``metric``: 'l2' (squared), 'cosine' (1 - cos), or
     'inner' (negative inner product — a similarity turned distance so the
     same argmin machinery applies)."""
-    cross = jnp.dot(x, y.T, preferred_element_type=jnp.float32)
+    cross = _kernel_dot(x, y.T)
     if metric == "l2":
         xn = jnp.sum(x * x, axis=1, keepdims=True)
         yn = jnp.sum(y * y, axis=1, keepdims=True)
@@ -374,8 +418,7 @@ def _lloyd_kernel(x_ref, y_ref, sums_ref, counts_ref, val_ref, idx_ref, *,
     # sums) but must not inflate counts — mask them out of the one-hot.
     row = jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0) + i * tm
     oh = ((col == arg) & (row < m_valid)).astype(jnp.float32)
-    sums_ref[:] += jnp.dot(oh.T, x.astype(jnp.float32),
-                           preferred_element_type=jnp.float32)
+    sums_ref[:] += _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32))
     counts_ref[:] += jnp.sum(oh, axis=0, keepdims=True)
 
 
@@ -459,8 +502,7 @@ def fused_lloyd_pallas(x, y) -> Tuple[jnp.ndarray, jnp.ndarray,
             sums, counts = carry
             xc, ic = inp
             oh = jax.nn.one_hot(ic, n, dtype=jnp.float32)
-            sums = sums + jnp.dot(oh.T, xc.astype(jnp.float32),
-                                  preferred_element_type=jnp.float32)
+            sums = sums + _kernel_dot_exact_lhs(oh.T, xc.astype(jnp.float32))
             return (sums, counts + jnp.sum(oh, axis=0)), None
 
         (sums, counts), _ = jax.lax.scan(

@@ -10,9 +10,15 @@ knn index agreement 95% vs the 99%+ the reference achieves).  raft_tpu
 therefore computes matmuls at f32-equivalent precision by default and makes
 the speed/accuracy trade explicit:
 
-- ``'highest'`` (default) — full f32 (multi-pass bf16 decomposition).
-- ``'high'``   — bf16x3 (~21 mantissa bits; f32-like for well-scaled data).
-- ``'default'`` — one bf16 pass; the fast path, opt-in.
+- ``'high'`` (default) — bf16x3 (~21 mantissa bits). Measured on v5e at
+  the north-star shape: max rel-err 2.7e-6 on pairwise L2 (500× tighter
+  than one bf16 pass, and 100-1000× inside the tolerances the reference's
+  own tests assert), at 1.46× the speed of full f32.
+- ``'highest'`` — full f32 (multi-pass decomposition); the accuracy
+  contract of the reference's CUBLAS_COMPUTE_32F (f32-grade error bounds;
+  not bit-identical across architectures — accumulation order differs).
+- ``'default'`` — one bf16 pass (~8 mantissa bits); the fast path, opt-in
+  only: measured 3.1% wrong top-10 neighbor sets.
 
 Mechanics: JAX's ``jax_default_matmul_precision`` config is the source of
 truth — it participates in jit trace-cache keys, so switching the policy
@@ -49,16 +55,16 @@ _AS_LAX = {
     "highest": lax.Precision.HIGHEST,
 }
 
-_env = os.environ.get("RAFT_TPU_MATMUL_PRECISION", "highest").lower()
+_env = os.environ.get("RAFT_TPU_MATMUL_PRECISION", "high").lower()
 _policy = _CANON.get(_env)
 if _policy is None:
     import warnings
 
     warnings.warn(
         f"RAFT_TPU_MATMUL_PRECISION={_env!r} is not one of "
-        f"{sorted(_AS_LAX)} (or an alias); using 'highest'",
+        f"{sorted(_AS_LAX)} (or an alias); using 'high'",
         stacklevel=2)
-    _policy = "highest"
+    _policy = "high"
 
 
 def set_matmul_precision(name: str) -> None:
@@ -73,8 +79,16 @@ def set_matmul_precision(name: str) -> None:
     global _policy
     canon = _CANON.get(str(name).lower())
     if canon is None:
-        raise ValueError(
-            f"unknown precision {name!r}; want one of {sorted(_AS_LAX)}")
+        # Pass JAX-only spellings (dot-algorithm presets) straight through
+        # so set(get()) round-trips even when the user configured one.
+        try:
+            jax.config.update("jax_default_matmul_precision", str(name))
+        except Exception as e:
+            raise ValueError(
+                f"unknown precision {name!r}; want one of "
+                f"{sorted(_AS_LAX)} or a value accepted by "
+                f"jax_default_matmul_precision") from e
+        return
     _policy = canon
     jax.config.update("jax_default_matmul_precision", canon)
 
@@ -88,6 +102,21 @@ def get_matmul_precision() -> str:
     if cfg is None:
         return _policy
     return _CANON.get(str(cfg).lower(), str(cfg))
+
+
+def current_mode() -> str:
+    """Trace-time accuracy tier for hand-written kernels:
+    'default' | 'high' | 'highest'.
+
+    Pallas/Mosaic rejects ``lax.Precision.HIGH`` on dots, so kernels cannot
+    simply inherit the config — they read this mode and pick an
+    implementation (single bf16 pass, manual bf16 hi/lo split, or full-f32
+    HIGHEST). JAX-only config spellings (dot-algorithm presets) map to
+    'highest' — never silently downgrade accuracy."""
+    cfg = jax.config.jax_default_matmul_precision
+    if cfg is None:
+        return _policy
+    return _CANON.get(str(cfg).lower(), "highest")
 
 
 def resolve(precision=None):

@@ -44,14 +44,15 @@ def restore_policy():
     jax.config.update("jax_default_matmul_precision", None)
 
 
-def test_default_policy_is_highest():
-    assert prec.get_matmul_precision() == "highest"
+def test_default_policy_is_high():
+    assert prec.get_matmul_precision() == "high"
 
 
 def test_scope_pins_dots_in_pairwise(restore_policy):
     from raft_tpu.distance import DistanceType, pairwise_distance
 
     x = jnp.ones((8, 4), jnp.float32)
+    prec.set_matmul_precision("highest")
     ps = _dot_precisions(
         lambda a: pairwise_distance(None, a, a, DistanceType.L2Expanded), x)
     assert ps, "expected at least one dot_general in the L2Expanded path"
@@ -92,11 +93,28 @@ def test_gemm_precision_arg(restore_policy):
     assert ps == [(jax.lax.Precision.HIGH,) * 2]
 
 
-def test_knn_traced_at_highest(restore_policy):
+def test_knn_traced_at_policy(restore_policy):
     from raft_tpu.neighbors import knn
 
     db = jnp.asarray(np.random.default_rng(0).normal(size=(64, 8)),
                      jnp.float32)
     q = db[:4]
+    prec.set_matmul_precision("highest")
     ps = _dot_precisions(lambda d, qq: knn(None, d, qq, k=3)[0], db, q)
     assert ps and all(p == (jax.lax.Precision.HIGHEST,) * 2 for p in ps), ps
+
+
+def test_high_tier_split_accuracy(restore_policy):
+    """The manual bf16 hi/lo split ('high' inside kernels) must land within
+    ~2^-17 of the f64 oracle — far tighter than one bf16 pass."""
+    from raft_tpu.linalg.contractions import pairwise_l2_pallas
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(96, 40)).astype(np.float32)
+    y = rng.normal(size=(48, 40)).astype(np.float32)
+    ref = ((x[:, None, :].astype(np.float64)
+            - y[None, :, :].astype(np.float64)) ** 2).sum(-1)
+    prec.set_matmul_precision("high")
+    d = np.asarray(pairwise_l2_pallas(x, y)).astype(np.float64)
+    rel = np.abs(d - ref) / np.maximum(np.abs(ref), 1e-9)
+    assert rel.max() < 1e-4, rel.max()
