@@ -232,6 +232,31 @@ def bench_gemm():
     return [run_case("linalg/gemm_2048", f, a, b, flops=2 * n ** 3)]
 
 
+@bench("linalg/svd")
+def bench_svd():
+    """BASELINE config 2's dense path: GEMM + row-norm + SVD on a tall
+    16384×1024 f32 matrix (small sizes shrink to 2048×256)."""
+    from raft_tpu.linalg import gemm, svd_eig, rsvd_fixed_rank
+    from raft_tpu.linalg.norm import row_norm
+
+    m, n = ((16384, 1024) if SIZES["rows"] >= (1 << 20) else (2048, 256))
+    a = _data(m, n)
+
+    def dense_path(a):
+        g = gemm(None, a, a, trans_a=True)           # n×n gram GEMM
+        norms = row_norm(None, a)
+        u, s, v = svd_eig(None, a)
+        return g[0, 0] + norms[0] + s[0] + u[0, 0] + v[0, 0]
+
+    f = jax.jit(dense_path)
+    r = jax.jit(functools.partial(rsvd_fixed_rank, None, k=64))
+    return [
+        run_case(f"linalg/svd_dense_path_{m}x{n}", f, a,
+                 flops=2 * m * n * n),
+        run_case(f"linalg/rsvd_k64_{m}x{n}", r, a),
+    ]
+
+
 # -- matrix (ref: bench/prims/matrix/*.cu) ----------------------------------
 
 @bench("matrix/select_k")

@@ -1,0 +1,64 @@
+"""Decompose the fused-Lloyd kernel's time at the north-star shape.
+
+Times, at each precision tier: the bare distance matmul, the pairwise
+kernel, the fused argmin kernel, and the full Lloyd kernel — the
+increments localize where the milliseconds go (MXU passes vs VPU epilogue
+vs one-hot update), which is what decides the next tuning step.
+
+Run on the real chip: python ci/lloyd_decomp.py [m] [k] [K]
+"""
+
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import raft_tpu
+from raft_tpu.linalg.contractions import (fused_l2_argmin_pallas,
+                                          fused_lloyd_pallas,
+                                          pairwise_l2_pallas)
+from raft_tpu.cluster.kmeans import lloyd_step
+
+
+def timeit(fn, *args, reps=10):
+    out = fn(*args)
+    jax.tree_util.tree_map(
+        lambda a: float(jnp.ravel(a)[0]), out)          # sync via fetch
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.tree_util.tree_map(lambda a: float(jnp.ravel(a)[0]), out)
+    return (time.perf_counter() - t0) / reps * 1e3
+
+
+def main():
+    m = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    K = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+    c = jnp.asarray(rng.normal(size=(K, k)), jnp.float32)
+
+    mm = jax.jit(lambda a, b: a @ b.T)
+    cases = [
+        ("matmul x@cT", lambda: mm(x, c)),
+        ("pairwise_l2", lambda: pairwise_l2_pallas(x, c)),
+        ("fused_argmin", lambda: fused_l2_argmin_pallas(x, c)),
+        ("fused_lloyd", lambda: fused_lloyd_pallas(x, c)),
+        ("lloyd_step", lambda: lloyd_step(x, c, K)),
+    ]
+    for tier in ("default", "high", "highest"):
+        raft_tpu.set_matmul_precision(tier)
+        for name, fn in cases:
+            try:
+                ms = timeit(fn)
+                print(f"{tier:8s} {name:14s} {ms:8.2f} ms")
+            except Exception as e:  # noqa: BLE001 — report and continue
+                print(f"{tier:8s} {name:14s} FAILED {type(e).__name__}: "
+                      f"{str(e)[:120]}")
+
+
+if __name__ == "__main__":
+    main()

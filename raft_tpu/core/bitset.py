@@ -64,8 +64,15 @@ class Bitset:
                 ).astype(jnp.bool_)
 
     def set(self, indices, value: bool = True) -> "Bitset":
-        indices = jnp.asarray(indices).ravel()
-        acc = _scatter_word_mask(self.words.shape[0], indices)
+        """Set (or clear) the given bit indices; anything outside
+        [0, n_bits) — including negatives and the packed tail of the last
+        word — is dropped, identically on both scatter paths."""
+        indices = jnp.asarray(indices).ravel().astype(jnp.int32)
+        n_words = self.words.shape[0]
+        oob = n_words * WORD_BITS                  # beyond the last word
+        indices = jnp.where((indices >= 0) & (indices < self.n_bits),
+                            indices, oob)
+        acc = _scatter_word_mask(n_words, indices)
         if value:
             return Bitset(self.n_bits, self.words | acc)
         return Bitset(self.n_bits, self.words & ~acc)
@@ -104,20 +111,54 @@ def _mask_tail(words: jnp.ndarray, n_bits: int) -> jnp.ndarray:
     return words.at[-1].set(words[-1] & tail_mask)
 
 
+# Below this many indices the plane scatter wins (sort overhead dominates);
+# above it, the sort+cumsum path avoids TPU's serialized scatter entirely.
+_SORT_THRESHOLD = 4096
+
+
 def _scatter_word_mask(n_words: int, indices: jnp.ndarray) -> jnp.ndarray:
     """Packed word mask with bit ``indices[i]`` set, duplicates combined.
 
-    XLA has no `or` scatter mode; one max-scatter into an (n_words, 32)
-    bit plane followed by a weighted sum along the bit axis packs the words
-    (same trick as :meth:`Bitset.from_bools`).
+    Two formulations, both scatter-light because TPU serializes scatters
+    (the reference leans on global-memory atomics here, bitset.hpp:378):
+
+    - small index sets: one max-scatter into an (n_words, 32) bit plane
+      followed by a weighted sum along the bit axis (same packing trick as
+      :meth:`Bitset.from_bools`).
+    - large index sets: NO scatter — sort the indices, build a (32, n_idx)
+      per-bit occurrence plane, 2-D cumsum along the sorted axis, and read
+      per-word occurrence counts as cumsum differences at word boundaries
+      (boundaries via searchsorted = vectorized binary-search gathers).
+      count > 0 → bit set, which also absorbs duplicates for free.
+      Everything is dense VPU work + gathers, the ops TPU is fast at.
     """
-    word_idx = indices // WORD_BITS
-    bit_pos = indices % WORD_BITS
-    plane = jnp.zeros((n_words, WORD_BITS), _WORD_DTYPE)
-    plane = plane.at[word_idx, bit_pos].max(jnp.uint32(1),
-                                            mode="drop")
-    weights = (jnp.uint32(1) << jnp.arange(WORD_BITS, dtype=_WORD_DTYPE))
-    return jnp.sum(plane * weights, axis=1, dtype=_WORD_DTYPE)
+    indices = indices.astype(jnp.int32)
+    if indices.shape[0] <= _SORT_THRESHOLD:
+        word_idx = indices // WORD_BITS
+        bit_pos = indices % WORD_BITS
+        plane = jnp.zeros((n_words, WORD_BITS), _WORD_DTYPE)
+        plane = plane.at[word_idx, bit_pos].max(jnp.uint32(1),
+                                                mode="drop")
+        weights = (jnp.uint32(1) << jnp.arange(WORD_BITS,
+                                               dtype=_WORD_DTYPE))
+        return jnp.sum(plane * weights, axis=1, dtype=_WORD_DTYPE)
+
+    srt = jnp.sort(indices)
+    word_idx = srt // WORD_BITS
+    bit_pos = srt % WORD_BITS
+    # occurrence counts of bit b among the first i sorted indices
+    occ = (bit_pos[None, :] == jnp.arange(WORD_BITS,
+                                          dtype=jnp.int32)[:, None])
+    cum = jnp.cumsum(occ.astype(jnp.int32), axis=1)
+    cum = jnp.pad(cum, ((0, 0), (1, 0)))            # cum[:, 0] = 0
+    # first sorted position belonging to each word (and the end sentinel)
+    bounds = jnp.searchsorted(word_idx,
+                              jnp.arange(n_words + 1, dtype=jnp.int32))
+    per_word = cum[:, bounds[1:]] - cum[:, bounds[:-1]]   # (32, n_words)
+    weights = (jnp.uint32(1) << jnp.arange(WORD_BITS,
+                                           dtype=_WORD_DTYPE))
+    return jnp.sum((per_word > 0).astype(_WORD_DTYPE) * weights[:, None],
+                   axis=0, dtype=_WORD_DTYPE)
 
 
 class Bitmap(Bitset):

@@ -138,6 +138,32 @@ class TestBitset:
         bs = bs.set(jnp.array([33]), value=False)
         assert int(bs.count()) == 2
 
+    @pytest.mark.parametrize("extra", [0, 2000])
+    def test_set_paths_agree(self, extra):
+        # extra=0 stays under _SORT_THRESHOLD (plane scatter); extra=2000
+        # crosses it (sort+cumsum). Same semantics on both: duplicates
+        # combine; negatives, >= n_bits, and the packed tail of the last
+        # word all drop; clears (value=False) mirror sets.
+        from raft_tpu.core.bitset import _SORT_THRESHOLD
+
+        rng = np.random.default_rng(3)
+        n = 40_007                                  # n % 32 != 0: tail bits
+        count = _SORT_THRESHOLD - 1000 + extra
+        ids = rng.integers(0, n, size=count)
+        ids = np.concatenate([ids, ids[:500],       # duplicates
+                              [-3, -1, n, n + 17,   # out of range
+                               n + (32 - n % 32) - 1]])   # tail of last word
+        bs = Bitset(n, default_value=False).set(jnp.asarray(ids))
+        want = np.zeros(n, dtype=bool)
+        valid = ids[(ids >= 0) & (ids < n)]
+        want[valid] = True
+        np.testing.assert_array_equal(np.asarray(bs.to_bools()), want)
+        assert int(bs.count()) == int(want.sum())
+        clear = np.concatenate([valid[:1000], [-3, n]])
+        bs2 = bs.set(jnp.asarray(clear), value=False)
+        want[valid[:1000]] = False
+        np.testing.assert_array_equal(np.asarray(bs2.to_bools()), want)
+
     def test_flip_all_none(self):
         bs = Bitset(10, default_value=False)
         assert bool(bs.none())
