@@ -267,19 +267,19 @@ def bench_select_k():
     from raft_tpu.matrix import SelectAlgo, select_k
 
     x = _data(64, SIZES["rows"])
-    out = []
+    # a generator so each case streams out as soon as it completes — a
+    # slow/hung case can't hold the whole family's results hostage
     for k in (16, SIZES["k"], 10_000):
         if k > x.shape[1]:
             continue
-        for algo, tag in ((SelectAlgo.WARPSORT_IMMEDIATE, "direct"),
-                          (SelectAlgo.RADIX_11BITS, "tiled")):
+        for algo, tag in ((SelectAlgo.RADIX_11BITS, "tiled"),
+                          (SelectAlgo.WARPSORT_IMMEDIATE, "direct")):
             f = jax.jit(functools.partial(select_k, None, k=k,
                                           select_min=True, algo=algo))
-            out.append(run_case(f"matrix/select_k_k{k}_{tag}", f, x,
-                                items=x.shape[0] * x.shape[1], k=k,
-                                batch=x.shape[0], length=x.shape[1],
-                                algo=tag))
-    return out
+            yield run_case(f"matrix/select_k_k{k}_{tag}", f, x,
+                           items=x.shape[0] * x.shape[1], k=k,
+                           batch=x.shape[0], length=x.shape[1],
+                           algo=tag)
 
 
 @bench("matrix/argmin")

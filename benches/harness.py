@@ -28,15 +28,29 @@ class BenchResult:
     gflops: Optional[float] = None
     params: dict = field(default_factory=dict)
 
+    # v5e single-chip ceilings for roofline context: ~819 GB/s HBM,
+    # 197 TFLOP/s bf16 MXU (logical f32 FLOPs run 2-6 hardware passes
+    # depending on the precision tier — fractions use the bf16 ceiling,
+    # so a tier-'high' matmul tops out near 1/3). Emitted only on the
+    # tpu backend; other backends have different ceilings.
+    HBM_GB_S = 819.0
+    MXU_GFLOPS = 197_000.0
+
     def json_line(self) -> str:
         out = {"bench": self.name, "median_ms": round(self.median_ms, 4),
                "best_ms": round(self.best_ms, 4), "repeats": self.repeats}
+        on_tpu = jax.default_backend() == "tpu"
         if self.items_per_s is not None:
             out["items_per_s"] = f"{self.items_per_s:.3e}"
         if self.gbytes_per_s is not None:
             out["GB_per_s"] = round(self.gbytes_per_s, 2)
+            if on_tpu:
+                out["hbm_frac"] = round(self.gbytes_per_s / self.HBM_GB_S,
+                                        3)
         if self.gflops is not None:
             out["GFLOP_per_s"] = round(self.gflops, 2)
+            if on_tpu:
+                out["mxu_frac"] = round(self.gflops / self.MXU_GFLOPS, 3)
         out.update(self.params)
         return json.dumps(out)
 

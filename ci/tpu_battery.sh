@@ -2,27 +2,38 @@
 # Wait for the TPU tunnel, then run the full hardware battery:
 # smoke tier -> full bench sweep -> north-star bench. Results land in
 # tpu_battery_out/.
+#
+# The sweep runs ONE PYTHON PROCESS PER FAMILY with an individual timeout:
+# the axon tunnel can wedge a long-lived client process indefinitely (seen
+# twice in round 2 — a wedged process goes ~idle while fresh processes
+# talk to the chip fine), so isolation + per-family budgets turn a wedge
+# into one rc=124 line instead of a lost sweep. Families already recorded
+# in bench_full.jsonl are skipped, so the script is resumable.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p tpu_battery_out
+OUT=tpu_battery_out/bench_full.jsonl
+ERR=tpu_battery_out/bench_full.err
+touch "$OUT"
 
 probe() {
     timeout 240 python -c "import jax; assert jax.default_backend()=='tpu'" \
         >/dev/null 2>&1
 }
 
-echo "[battery] waiting for TPU tunnel..."
-for i in $(seq 1 100); do
-    if probe; then
-        echo "[battery] TPU reachable (attempt $i)"
-        break
-    fi
-    if [ "$i" = 100 ]; then
-        echo "[battery] TPU never came back; giving up"
-        exit 1
-    fi
-    sleep 120
-done
+wait_for_tpu() {
+    for i in $(seq 1 100); do
+        if probe; then
+            echo "[battery] TPU reachable (attempt $i)"
+            return 0
+        fi
+        sleep 120
+    done
+    echo "[battery] TPU never came back; giving up"
+    return 1
+}
+
+wait_for_tpu || exit 1
 
 echo "[battery] running tpu_tests smoke tier"
 timeout 1800 python -m pytest tpu_tests -q \
@@ -30,10 +41,28 @@ timeout 1800 python -m pytest tpu_tests -q \
 echo "[battery] smoke rc=$? (tail below)"
 tail -3 tpu_battery_out/tpu_smoke.txt
 
-echo "[battery] running full bench sweep"
-timeout 5400 python benches/run_benches.py --size full \
-    > tpu_battery_out/bench_full.jsonl 2> tpu_battery_out/bench_full.err
-echo "[battery] sweep rc=$?"
+echo "[battery] running full bench sweep (per-family processes)"
+for fam in $(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+             python benches/run_benches.py --list); do
+    # family-done markers handle families whose case names differ from
+    # the family name (e.g. cluster/kmeans_iter -> cluster/lloyd_iter)
+    if grep -q "\"family_done\": \"$fam\"" "$OUT" \
+            || grep -q "\"bench\": \"$fam" "$OUT"; then
+        echo "[battery] skip $fam (already recorded)"
+        continue
+    fi
+    # re-probe between families: don't burn every budget on a dead tunnel
+    if ! probe; then
+        echo "[battery] tunnel gone before $fam; waiting"
+        wait_for_tpu || break
+    fi
+    echo "[battery] run $fam $(date +%H:%M:%S)"
+    timeout 420 python benches/run_benches.py --size full --filter "$fam" \
+        2>>"$ERR" | grep -v '^#' >> "$OUT"
+    rc=$?
+    echo "[battery] rc=$rc $fam"
+    [ "$rc" = 0 ] && echo "{\"family_done\": \"$fam\"}" >> "$OUT"
+done
 
 echo "[battery] running north-star bench"
 timeout 900 python bench.py > tpu_battery_out/bench_northstar.json 2>&1
