@@ -49,8 +49,6 @@ from raft_tpu.util.precision import current_mode, with_matmul_precision
 # for Mosaic's own buffers and double-buffered pipelining).
 _VMEM_BUDGET = 10 * 1024 * 1024
 
-_I32_MAX = 2147483647
-
 
 def _kernel_dot(a, b, exact_lhs: bool = False):
     """``a @ b`` with f32 accumulation at the policy's accuracy tier,
@@ -110,9 +108,8 @@ def _argmin_jnp(x, y, metric: str = "l2"):
     # shard_map reference (pallas_utils.interpret_needs_ref) can never
     # diverge from the compiled epilogue.
     d = _metric_tile(x, y, metric)
-    col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    arg = jax.lax.argmin(d, 1, jnp.int32)
     minval = jnp.min(d, axis=1)
-    arg = jnp.min(jnp.where(d == minval[:, None], col, _I32_MAX), axis=1)
     if metric == "l2":
         minval = jnp.maximum(minval, 0.0)
     return minval, arg
@@ -241,20 +238,27 @@ def pairwise_l2_pallas(x, y, sqrt: bool = False,
 
 def _distance_tile(x, y, n_valid: int, metric: str = "l2"):
     """Masked metric tile + its per-row (min, argmin). Shapes:
-    x (tm, kp), y (np_, kp) → d (tm, np_), minval (tm, 1), arg (tm, 1)."""
+    x (tm, kp), y (np_, kp) → col (tm, np_) column iota,
+    minval (tm, 1), arg (tm, 1).
+
+    A fused argmin reduction replaces the old masked-min spelling
+    (compare + select + second reduce) — one full-tile elementwise pass
+    fewer on the VPU, which bounds this kernel. The index dtype is pinned
+    to int32: Mosaic's reduce-index helper rejects int64, which
+    jnp.argmin would bind under jax_enable_x64. lax.argmin's
+    first-minimum tie rule IS the reference's KVP argmin rule
+    (kvp.hpp operator< on value-then-key)."""
     d = _metric_tile(x, y, metric)
     col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
     d = jnp.where(col < n_valid, d, jnp.inf)
+    arg = jax.lax.argmin(d, 1, jnp.int32)[:, None]
     minval = jnp.min(d, axis=1, keepdims=True)
-    # Smallest index among ties — the reference's KVP argmin tie rule.
-    arg = jnp.min(jnp.where(d == minval, col, _I32_MAX), axis=1,
-                  keepdims=True)
-    return d, col, minval, arg
+    return col, minval, arg
 
 
 def _argmin_resident_kernel(x_ref, y_ref, val_ref, idx_ref, *,
                             n_valid: int, metric: str):
-    _, _, minval, arg = _distance_tile(x_ref[:], y_ref[:], n_valid, metric)
+    _, minval, arg = _distance_tile(x_ref[:], y_ref[:], n_valid, metric)
     val_ref[:] = minval.T                            # (1, tm)
     idx_ref[:] = arg.T
 
@@ -268,8 +272,8 @@ def _argmin_tiled_kernel(x_ref, y_ref, val_ref, idx_ref, *,
         val_ref[:] = jnp.full_like(val_ref, jnp.inf)
         idx_ref[:] = jnp.zeros_like(idx_ref)
 
-    _, _, minval, arg = _distance_tile(x_ref[:], y_ref[:],
-                                       n_valid - j * tn, metric)
+    _, minval, arg = _distance_tile(x_ref[:], y_ref[:],
+                                    n_valid - j * tn, metric)
     garg = (arg + j * tn).T                           # (1, tm)
     minval = minval.T
     prev_val = val_ref[:]
@@ -410,12 +414,12 @@ def _lloyd_kernel(x_ref, y_ref, sums_ref, counts_ref, val_ref, idx_ref, *,
         counts_ref[:] = jnp.zeros_like(counts_ref)
 
     x = x_ref[:]
-    _, col, minval, arg = _distance_tile(x, y_ref[:], n_valid)
+    col, minval, arg = _distance_tile(x, y_ref[:], n_valid)
     val_ref[:] = jnp.maximum(minval, 0.0).T
     idx_ref[:] = arg.T
 
-    # One-hot accumulation on the MXU: padded X rows are zero (no effect on
-    # sums) but must not inflate counts — mask them out of the one-hot.
+    # One-hot accumulation on the MXU: padded X rows are zero (no effect
+    # on sums) but must not inflate counts — mask them out.
     row = jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0) + i * tm
     oh = ((col == arg) & (row < m_valid)).astype(jnp.float32)
     sums_ref[:] += _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32))
