@@ -57,7 +57,10 @@ _SUBPACKAGES = (
     "label",
     "cluster",
     "distance",
+    "neighbors",
     "util",
+    "compat",
+    "runtime",
 )
 
 
