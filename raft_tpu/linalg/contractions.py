@@ -70,23 +70,21 @@ def _kernel_dot(a, b, exact_lhs: bool = False):
     """
     mode = current_mode()
     f32 = jnp.float32
-    one_pass = jax.lax.Precision.DEFAULT         # bf16 multiply is exact
     if a.dtype != f32 or b.dtype != f32 or mode == "default":
         return jnp.dot(a, b, preferred_element_type=f32,
-                       precision=one_pass)
+                       precision=_ONE_PASS)
     if mode == "high":
         a_hi = a.astype(jnp.bfloat16)
-        b_hi = b.astype(jnp.bfloat16)
-        b_lo = (b - b_hi.astype(f32)).astype(jnp.bfloat16)
+        b_hi, b_lo = _split_hi_lo(b)
         out = (jnp.dot(a_hi, b_hi, preferred_element_type=f32,
-                       precision=one_pass)
+                       precision=_ONE_PASS)
                + jnp.dot(a_hi, b_lo, preferred_element_type=f32,
-                         precision=one_pass))
+                         precision=_ONE_PASS))
         if exact_lhs:
             return out
         a_lo = (a - a_hi.astype(f32)).astype(jnp.bfloat16)
         return out + jnp.dot(a_lo, b_hi, preferred_element_type=f32,
-                             precision=one_pass)
+                             precision=_ONE_PASS)
     return jnp.dot(a, b, preferred_element_type=f32,
                    precision=jax.lax.Precision.HIGHEST)
 
@@ -102,11 +100,84 @@ def _pad2(x: jnp.ndarray, rows: int, cols: int) -> jnp.ndarray:
     return x
 
 
+def _split_hi_lo(a):
+    """f32 → (hi, lo) bf16 halves with a ≈ hi + lo (~2^-17 residual).
+
+    Done ONCE in HBM before the kernel launch: the hi/lo pair is the
+    tier-'high' operand format, so kernels never re-split per grid step
+    (the resident-Y kernels used to pay the split np_×kp cast every one
+    of their m/tm steps), and the pair costs exactly the same bytes as
+    the f32 original (2+2 vs 4)."""
+    hi = a.astype(jnp.bfloat16)
+    lo = (a - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _use_split(*arrays) -> bool:
+    """Tier-'high' f32 operands take the pre-split bf16-pair kernels."""
+    return current_mode() == "high" and all(
+        a.dtype == jnp.float32 for a in arrays)
+
+
+_ONE_PASS = jax.lax.Precision.DEFAULT            # bf16 multiply is exact
+
+
+def _cross_split(xh, xl, yh_t, yl_t):
+    """x·yᵀ from pre-split bf16 halves: hi·hi + hi·lo + lo·hi (the bf16x3
+    decomposition; the dropped lo·lo term is ~2^-34 relative)."""
+    f32 = jnp.float32
+    return (jnp.dot(xh, yh_t, preferred_element_type=f32,
+                    precision=_ONE_PASS)
+            + jnp.dot(xh, yl_t, preferred_element_type=f32,
+                      precision=_ONE_PASS)
+            + jnp.dot(xl, yh_t, preferred_element_type=f32,
+                      precision=_ONE_PASS))
+
+
+def _metric_tile_split(xh, xl, xn, yh, yl, yn, metric: str):
+    """Split-operand twin of :func:`_metric_tile`. ``xn`` (tm, 1) and
+    ``yn`` (1, np_) are squared norms precomputed OUTSIDE in full f32 —
+    more accurate than the in-kernel recompute they replace."""
+    cross = _cross_split(xh, xl, yh.T, yl.T)
+    if metric == "l2":
+        return xn - 2.0 * cross + yn
+    if metric == "cosine":
+        eps = jnp.asarray(1e-30, jnp.float32)
+        return 1.0 - cross / (jnp.sqrt(xn + eps) * jnp.sqrt(yn + eps))
+    if metric == "inner":
+        return -cross
+    raise ValueError(f"unknown metric {metric!r}")
+
+
+def _mask_argmin(d, n_valid: int):
+    """Shared masking + fused argmin over a distance tile (see
+    :func:`_distance_tile` for the tie rule and index-dtype rationale)."""
+    col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    d = jnp.where(col < n_valid, d, jnp.inf)
+    arg = jax.lax.argmin(d, 1, jnp.int32)[:, None]
+    minval = jnp.min(d, axis=1, keepdims=True)
+    return col, minval, arg
+
+
+def _distance_tile_split(xh, xl, xn, yh, yl, yn, n_valid: int,
+                         metric: str = "l2"):
+    return _mask_argmin(
+        _metric_tile_split(xh, xl, xn, yh, yl, yn, metric), n_valid)
+
+
+def _sq_norms(a):
+    """Row squared norms in full f32 (elementwise — no MXU tier concerns)."""
+    a = a.astype(jnp.float32)
+    return jnp.sum(a * a, axis=1)
+
+
 def _argmin_jnp(x, y, metric: str = "l2"):
-    # _metric_tile is plain jnp on whole arrays — the SAME function the
-    # kernel body runs on its VMEM blocks, so the interpreter-under-
-    # shard_map reference (pallas_utils.interpret_needs_ref) can never
-    # diverge from the compiled epilogue.
+    # Plain-jnp reference for the interpreter-under-shard_map path
+    # (pallas_utils.interpret_needs_ref). Same epilogue (argmin tie rule)
+    # as the kernels; numerics match the 'default'/'highest' kernels
+    # exactly and the 'high' split kernels to ~2^-17 (the split
+    # decomposition and precomputed norms round differently at the last
+    # bit — ties between float-identical distances can differ there).
     d = _metric_tile(x, y, metric)
     arg = jax.lax.argmin(d, 1, jnp.int32)
     minval = jnp.min(d, axis=1)
@@ -172,6 +243,57 @@ def _pairwise_tile_kernel(x_ref, y_ref, out_ref, *, metric: str):
     out_ref[:] = _metric_tile(x_ref[:], y_ref[:], metric)
 
 
+def _pairwise_tile_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref,
+                                yn_ref, out_ref, *, metric: str):
+    out_ref[:] = _metric_tile_split(xh_ref[:], xl_ref[:], xn_ref[:].T,
+                                    yh_ref[:], yl_ref[:], yn_ref[:],
+                                    metric)
+
+
+@functools.partial(jax.jit, static_argnames=("tm", "tn", "metric"))
+def _pairwise_padded_split(xh, xl, xn, yh, yl, yn, tm: int, tn: int,
+                           metric: str):
+    m, k = xh.shape
+    n = yh.shape[0]
+    vma, (xh, xl, xn, yh, yl, yn) = join_vma(xh, xl, xn, yh, yl, yn)
+    return pallas_call(
+        functools.partial(_pairwise_tile_kernel_split, metric=metric),
+        grid=(m // tm, n // tn),
+        in_specs=[
+            pl.BlockSpec((tm, k), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, k), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tm), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, k), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, k), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tn), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=out_struct((m, n), jnp.float32, vma),
+    )(xh, xl, xn, yh, yl, yn)
+
+
+@functools.partial(jax.jit, static_argnames=("mp", "np_", "kp"))
+def _split_operands(x, y, mp: int, np_: int, kp: int):
+    """Pad to tile multiples, split to bf16 pairs, precompute f32 squared
+    norms laid out as (1, m) blocks for the kernels. Jitted so the ~10
+    pad/cast/subtract/norm steps fuse into one dispatch instead of eager
+    HBM round-trips (callers already inside jit inline it for free)."""
+    xp = _pad2(x, mp, kp)
+    yp = _pad2(y, np_, kp)
+    xh, xl = _split_hi_lo(xp)
+    yh, yl = _split_hi_lo(yp)
+    xn = _sq_norms(xp)[None, :]
+    yn = _sq_norms(yp)[None, :]
+    return xh, xl, xn, yh, yl, yn
+
+
 @functools.partial(jax.jit, static_argnames=("tm", "tn", "metric"))
 def _pairwise_padded(x, y, tm: int, tn: int, metric: str = "l2"):
     m, k = x.shape
@@ -214,8 +336,12 @@ def pairwise_pallas(x, y, metric: str = "l2",
     mp = round_up_to_multiple(m, tm)
     np_ = round_up_to_multiple(n, tn)
     kp = round_up_to_multiple(k, 128)
-    out = _pairwise_padded(_pad2(x, mp, kp), _pad2(y, np_, kp), tm, tn,
-                           metric)
+    if _use_split(x, y):
+        out = _pairwise_padded_split(
+            *_split_operands(x, y, mp, np_, kp), tm, tn, metric)
+    else:
+        out = _pairwise_padded(_pad2(x, mp, kp), _pad2(y, np_, kp), tm, tn,
+                               metric)
     return out[:m, :n]
 
 
@@ -248,12 +374,27 @@ def _distance_tile(x, y, n_valid: int, metric: str = "l2"):
     jnp.argmin would bind under jax_enable_x64. lax.argmin's
     first-minimum tie rule IS the reference's KVP argmin rule
     (kvp.hpp operator< on value-then-key)."""
-    d = _metric_tile(x, y, metric)
-    col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
-    d = jnp.where(col < n_valid, d, jnp.inf)
-    arg = jax.lax.argmin(d, 1, jnp.int32)[:, None]
-    minval = jnp.min(d, axis=1, keepdims=True)
-    return col, minval, arg
+    return _mask_argmin(_metric_tile(x, y, metric), n_valid)
+
+
+def _fold_running_min(val_ref, idx_ref, minval, arg, offset):
+    """Tiled-kernel epilogue shared by the split and non-split variants:
+    initialize the revisited (val, idx) block on the first y-tile, then
+    fold this tile's (min, argmin) in (ties keep the earlier tile — the
+    global first-minimum rule)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        val_ref[:] = jnp.full_like(val_ref, jnp.inf)
+        idx_ref[:] = jnp.zeros_like(idx_ref)
+
+    garg = (arg + offset).T                           # (1, tm)
+    minval = minval.T
+    prev_val = val_ref[:]
+    better = minval < prev_val
+    val_ref[:] = jnp.where(better, minval, prev_val)
+    idx_ref[:] = jnp.where(better, garg, idx_ref[:])
 
 
 def _argmin_resident_kernel(x_ref, y_ref, val_ref, idx_ref, *,
@@ -263,23 +404,32 @@ def _argmin_resident_kernel(x_ref, y_ref, val_ref, idx_ref, *,
     idx_ref[:] = arg.T
 
 
+def _argmin_resident_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref,
+                                  yn_ref, val_ref, idx_ref, *,
+                                  n_valid: int, metric: str):
+    _, minval, arg = _distance_tile_split(
+        xh_ref[:], xl_ref[:], xn_ref[:].T, yh_ref[:], yl_ref[:],
+        yn_ref[:], n_valid, metric)
+    val_ref[:] = minval.T
+    idx_ref[:] = arg.T
+
+
 def _argmin_tiled_kernel(x_ref, y_ref, val_ref, idx_ref, *,
                          tn: int, n_valid: int, metric: str):
     j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _init():
-        val_ref[:] = jnp.full_like(val_ref, jnp.inf)
-        idx_ref[:] = jnp.zeros_like(idx_ref)
-
     _, minval, arg = _distance_tile(x_ref[:], y_ref[:],
                                     n_valid - j * tn, metric)
-    garg = (arg + j * tn).T                           # (1, tm)
-    minval = minval.T
-    prev_val = val_ref[:]
-    better = minval < prev_val
-    val_ref[:] = jnp.where(better, minval, prev_val)
-    idx_ref[:] = jnp.where(better, garg, idx_ref[:])
+    _fold_running_min(val_ref, idx_ref, minval, arg, j * tn)
+
+
+def _argmin_tiled_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref,
+                               yn_ref, val_ref, idx_ref, *,
+                               tn: int, n_valid: int, metric: str):
+    j = pl.program_id(1)
+    _, minval, arg = _distance_tile_split(
+        xh_ref[:], xl_ref[:], xn_ref[:].T, yh_ref[:], yl_ref[:],
+        yn_ref[:], n_valid - j * tn, metric)
+    _fold_running_min(val_ref, idx_ref, minval, arg, j * tn)
 
 
 @functools.partial(jax.jit, static_argnames=("tm", "n_valid", "metric"))
@@ -311,6 +461,46 @@ def _fused_argmin_resident(x, y, tm: int, n_valid: int, metric: str):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)),
     )(x, y)
+
+
+@functools.partial(jax.jit, static_argnames=("tm", "n_valid", "metric"))
+def _fused_argmin_resident_split(xh, xl, xn, yh, yl, yn, tm: int,
+                                 n_valid: int, metric: str):
+    m, kp = xh.shape
+    np_ = yh.shape[0]
+    vma, (xh, xl, xn, yh, yl, yn) = join_vma(xh, xl, xn, yh, yl, yn)
+    kernel = functools.partial(_argmin_resident_kernel_split,
+                               n_valid=n_valid, metric=metric)
+    return pallas_call(
+        kernel,
+        grid=(m // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, kp), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, kp), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tm), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((np_, kp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((np_, kp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, np_), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tm), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tm), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            out_struct((1, m), jnp.float32, vma),
+            out_struct((1, m), jnp.int32, vma),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+    )(xh, xl, xn, yh, yl, yn)
 
 
 @functools.partial(jax.jit,
@@ -346,6 +536,47 @@ def _fused_argmin_tiled(x, y, tm: int, tn: int, n_valid: int, metric: str):
     )(x, y)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("tm", "tn", "n_valid", "metric"))
+def _fused_argmin_tiled_split(xh, xl, xn, yh, yl, yn, tm: int, tn: int,
+                              n_valid: int, metric: str):
+    m, kp = xh.shape
+    n = yh.shape[0]
+    vma, (xh, xl, xn, yh, yl, yn) = join_vma(xh, xl, xn, yh, yl, yn)
+    kernel = functools.partial(_argmin_tiled_kernel_split, tn=tn,
+                               n_valid=n_valid, metric=metric)
+    return pallas_call(
+        kernel,
+        grid=(m // tm, n // tn),
+        in_specs=[
+            pl.BlockSpec((tm, kp), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, kp), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tm), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, kp), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, kp), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tn), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tm), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tm), lambda i, j: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            out_struct((1, m), jnp.float32, vma),
+            out_struct((1, m), jnp.int32, vma),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(xh, xl, xn, yh, yl, yn)
+
+
 @with_matmul_precision
 def fused_argmin_pallas(x, y, metric: str = "l2",
                         tm: Optional[int] = None, tn: int = 512
@@ -372,12 +603,17 @@ def fused_argmin_pallas(x, y, metric: str = "l2",
     isz = jnp.dtype(x.dtype).itemsize
     auto_tm = _pick_tm(kp, np_, mn_bufs=2, const_bytes=np_ * kp * isz,
                        itemsize=isz)
+    split = _use_split(x, y)
     if auto_tm is not None:
         tm_ = min(tm or auto_tm, auto_tm)
         tm_ = max(8, round_up_to_multiple(min(tm_, m), 8))
         mp = round_up_to_multiple(m, tm_)
-        val, idx = _fused_argmin_resident(
-            _pad2(x, mp, kp), _pad2(y, np_, kp), tm_, n, metric)
+        if split:
+            val, idx = _fused_argmin_resident_split(
+                *_split_operands(x, y, mp, np_, kp), tm_, n, metric)
+        else:
+            val, idx = _fused_argmin_resident(
+                _pad2(x, mp, kp), _pad2(y, np_, kp), tm_, n, metric)
     else:
         tn_ = min(tn, np_)
         tm_ = _pick_tm(kp, tn_, mn_bufs=2, const_bytes=tn_ * kp * isz,
@@ -387,8 +623,12 @@ def fused_argmin_pallas(x, y, metric: str = "l2",
         tm_ = max(8, round_up_to_multiple(min(tm_, m), 8))
         mp = round_up_to_multiple(m, tm_)
         npp = round_up_to_multiple(n, tn_)
-        val, idx = _fused_argmin_tiled(
-            _pad2(x, mp, kp), _pad2(y, npp, kp), tm_, tn_, n, metric)
+        if split:
+            val, idx = _fused_argmin_tiled_split(
+                *_split_operands(x, y, mp, npp, kp), tm_, tn_, n, metric)
+        else:
+            val, idx = _fused_argmin_tiled(
+                _pad2(x, mp, kp), _pad2(y, npp, kp), tm_, tn_, n, metric)
     return val[0, :m], idx[0, :m]
 
 
@@ -424,6 +664,82 @@ def _lloyd_kernel(x_ref, y_ref, sums_ref, counts_ref, val_ref, idx_ref, *,
     oh = ((col == arg) & (row < m_valid)).astype(jnp.float32)
     sums_ref[:] += _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32))
     counts_ref[:] += jnp.sum(oh, axis=0, keepdims=True)
+
+
+def _lloyd_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
+                        sums_ref, counts_ref, val_ref, idx_ref, *,
+                        tm: int, n_valid: int, m_valid: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    col, minval, arg = _distance_tile_split(
+        xh_ref[:], xl_ref[:], xn_ref[:].T, yh_ref[:], yl_ref[:],
+        yn_ref[:], n_valid)
+    val_ref[:] = jnp.maximum(minval, 0.0).T
+    idx_ref[:] = arg.T
+
+    row = jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0) + i * tm
+    # one-hot is exact in bf16; X arrives pre-split, so the 'high'-tier
+    # update is two one-pass MXU dots against the hi/lo halves
+    ohb = ((col == arg) & (row < m_valid)).astype(jnp.bfloat16)
+    f32 = jnp.float32
+    sums_ref[:] += (jnp.dot(ohb.T, xh_ref[:], preferred_element_type=f32,
+                            precision=_ONE_PASS)
+                    + jnp.dot(ohb.T, xl_ref[:],
+                              preferred_element_type=f32,
+                              precision=_ONE_PASS))
+    counts_ref[:] += jnp.sum(ohb.astype(f32), axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tm", "n_valid", "m_valid"))
+def _fused_lloyd_padded_split(xh, xl, xn, yh, yl, yn, tm: int,
+                              n_valid: int, m_valid: int):
+    m, kp = xh.shape
+    np_ = yh.shape[0]
+    vma, (xh, xl, xn, yh, yl, yn) = join_vma(xh, xl, xn, yh, yl, yn)
+    kernel = functools.partial(_lloyd_kernel_split, tm=tm, n_valid=n_valid,
+                               m_valid=m_valid)
+    return pallas_call(
+        kernel,
+        grid=(m // tm,),
+        in_specs=[
+            pl.BlockSpec((tm, kp), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, kp), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tm), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((np_, kp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((np_, kp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, np_), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((np_, kp), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, np_), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tm), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tm), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            out_struct((np_, kp), jnp.float32, vma),
+            out_struct((1, np_), jnp.float32, vma),
+            out_struct((1, m), jnp.float32, vma),
+            out_struct((1, m), jnp.int32, vma),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(xh, xl, xn, yh, yl, yn)
 
 
 @functools.partial(jax.jit,
@@ -515,7 +831,11 @@ def fused_lloyd_pallas(x, y) -> Tuple[jnp.ndarray, jnp.ndarray,
         return sums, counts, val, idx
     tm = max(8, round_up_to_multiple(min(tm, m), 8))
     mp = round_up_to_multiple(m, tm)
-    sums, counts, val, idx = _fused_lloyd_padded(
-        _pad2(x, mp, kp), _pad2(y, np_, kp), tm, n, m)
+    if _use_split(x, y):
+        sums, counts, val, idx = _fused_lloyd_padded_split(
+            *_split_operands(x, y, mp, np_, kp), tm, n, m)
+    else:
+        sums, counts, val, idx = _fused_lloyd_padded(
+            _pad2(x, mp, kp), _pad2(y, np_, kp), tm, n, m)
     return (sums[:n, :k], counts[0, :n],
             jnp.maximum(val[0, :m], 0.0), idx[0, :m])
