@@ -23,7 +23,8 @@ from raft_tpu.sparse import convert, op
 
 @functools.partial(jax.jit, static_argnames=("n_rows",))
 def _segment_spmv(row_ids, cols, data, x, n_rows: int):
-    return jax.ops.segment_sum(data * x[cols], row_ids, num_segments=n_rows)
+    return jax.ops.segment_sum(data * x[cols], row_ids, num_segments=n_rows,
+                               indices_are_sorted=True)
 
 
 def spmv(a, x) -> jnp.ndarray:
@@ -43,7 +44,8 @@ def spmv(a, x) -> jnp.ndarray:
 @functools.partial(jax.jit, static_argnames=("n_rows",))
 def _segment_spmm(row_ids, cols, data, b, n_rows: int):
     prods = data[:, None] * b[cols, :]
-    return jax.ops.segment_sum(prods, row_ids, num_segments=n_rows)
+    return jax.ops.segment_sum(prods, row_ids, num_segments=n_rows,
+                               indices_are_sorted=True)
 
 
 def spmm(a, b, alpha=1.0, beta=0.0, c=None) -> jnp.ndarray:
@@ -127,7 +129,7 @@ def coo_degree(coo: COOMatrix) -> jnp.ndarray:
 
 def rows_sum(csr: CSRMatrix) -> jnp.ndarray:
     """Per-row value sum — the degree matrix diagonal for an adjacency."""
-    return jax.ops.segment_sum(csr.data, csr.row_ids(),
+    return jax.ops.segment_sum(csr.data, csr.row_ids(), indices_are_sorted=True,
                                num_segments=csr.n_rows)
 
 
@@ -135,7 +137,7 @@ def csr_row_normalize_l1(csr: CSRMatrix) -> CSRMatrix:
     """Scale each row to unit L1 norm (ref: sparse/linalg/norm.cuh
     `csr_row_normalize_l1`)."""
     row_ids = csr.row_ids()
-    norms = jax.ops.segment_sum(jnp.abs(csr.data), row_ids,
+    norms = jax.ops.segment_sum(jnp.abs(csr.data), row_ids, indices_are_sorted=True,
                                 num_segments=csr.n_rows)
     norms = jnp.where(norms == 0, 1, norms)
     return CSRMatrix(csr.indptr, csr.indices, csr.data / norms[row_ids],
@@ -146,7 +148,8 @@ def csr_row_normalize_max(csr: CSRMatrix) -> CSRMatrix:
     """Scale each row by its max value (ref: sparse/linalg/norm.cuh
     `csr_row_normalize_max`)."""
     row_ids = csr.row_ids()
-    maxs = jax.ops.segment_max(csr.data, row_ids, num_segments=csr.n_rows)
+    maxs = jax.ops.segment_max(csr.data, row_ids, num_segments=csr.n_rows,
+                                indices_are_sorted=True)
     maxs = jnp.where(maxs <= 0, 1, maxs)
     return CSRMatrix(csr.indptr, csr.indices, csr.data / maxs[row_ids],
                      csr.shape)
@@ -240,16 +243,19 @@ def csr_row_norm(csr: CSRMatrix, norm_type: str = "l2") -> jnp.ndarray:
     rows = csr.row_ids()
     if norm_type == "l1":
         return jax.ops.segment_sum(jnp.abs(csr.data), rows,
-                                   num_segments=csr.n_rows)
+                                   num_segments=csr.n_rows,
+                                   indices_are_sorted=True)
     if norm_type == "l2":
         return jnp.sqrt(jax.ops.segment_sum(csr.data * csr.data, rows,
-                                            num_segments=csr.n_rows))
+                                            num_segments=csr.n_rows,
+                                            indices_are_sorted=True))
     if norm_type == "linf":
         # clamp: empty rows see segment_max's -inf identity; |x| ≥ 0 makes
         # the clamp a no-op for any non-empty row
         return jnp.maximum(
             jax.ops.segment_max(jnp.abs(csr.data), rows,
-                                num_segments=csr.n_rows), 0.0)
+                                num_segments=csr.n_rows,
+                                indices_are_sorted=True), 0.0)
     raise ValueError(f"norm_type must be l1|l2|linf, got {norm_type}")
 
 
