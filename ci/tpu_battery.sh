@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Wait for the TPU tunnel, then run the full hardware battery:
-# smoke tier -> full bench sweep -> north-star bench. Results land in
+# smoke tier -> north-star bench -> full bench sweep. Results land in
 # tpu_battery_out/.
 #
 # The sweep runs ONE PYTHON PROCESS PER FAMILY with an individual timeout:
@@ -41,6 +41,11 @@ timeout 1800 python -m pytest tpu_tests -q \
 echo "[battery] smoke rc=$? (tail below)"
 tail -3 tpu_battery_out/tpu_smoke.txt
 
+echo "[battery] running north-star bench"
+timeout 900 python bench.py > tpu_battery_out/bench_northstar.json 2>&1
+echo "[battery] bench rc=$?"
+cat tpu_battery_out/bench_northstar.json
+
 echo "[battery] running full bench sweep (per-family processes)"
 for fam in $(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
              python benches/run_benches.py --list); do
@@ -64,8 +69,4 @@ for fam in $(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     [ "$rc" = 0 ] && echo "{\"family_done\": \"$fam\"}" >> "$OUT"
 done
 
-echo "[battery] running north-star bench"
-timeout 900 python bench.py > tpu_battery_out/bench_northstar.json 2>&1
-echo "[battery] bench rc=$?"
-cat tpu_battery_out/bench_northstar.json
 echo "[battery] DONE"
