@@ -43,8 +43,16 @@ def contingency_matrix(y_true, y_pred, n_classes_true: int = None,
                 f"labels exceed the class count: max labels ({mt}, {mp}) "
                 f"vs n_classes ({nt}, {np_})")
     flat = y_true.astype(jnp.int32) * np_ + y_pred.astype(jnp.int32)
-    out = jnp.zeros((nt * np_,), jnp.result_type(int))
-    out = out.at[flat].add(1)
+    if nt * np_ <= 4096:
+        # Small contingency tables (the common clustering-metric case):
+        # a one-hot bincount sums on the VPU instead of serializing
+        # through TPU's scatter-add — the same dispatch rule as
+        # stats.histogram's one-hot-vs-Gmem strategies.
+        onehot = flat[:, None] == jnp.arange(nt * np_, dtype=jnp.int32)
+        out = jnp.sum(onehot, axis=0, dtype=jnp.result_type(int))
+    else:
+        out = jnp.zeros((nt * np_,), jnp.result_type(int))
+        out = out.at[flat].add(1)
     return out.reshape(nt, np_)
 
 
