@@ -17,9 +17,11 @@ def main(path: str) -> None:
             if not line or line.startswith("#"):
                 continue
             try:
-                rows.append(json.loads(line))
+                row = json.loads(line)
             except json.JSONDecodeError:
                 continue
+            if "bench" in row:          # skip family_done marker lines
+                rows.append(row)
     if not rows:
         print("(no results)")
         return
