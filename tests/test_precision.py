@@ -104,6 +104,23 @@ def test_knn_traced_at_policy(restore_policy):
     assert ps and all(p == (jax.lax.Precision.HIGHEST,) * 2 for p in ps), ps
 
 
+def test_bf16_inputs_skip_split(restore_policy):
+    """bf16 operands take the single-pass non-split kernels at every tier
+    (splitting a bf16 value is meaningless) and still produce bf16-grade
+    results."""
+    from raft_tpu.linalg.contractions import pairwise_l2_pallas
+
+    rng = np.random.default_rng(5)
+    x16 = rng.normal(size=(64, 32)).astype(np.float32)
+    y16 = rng.normal(size=(48, 32)).astype(np.float32)
+    ref = ((x16[:, None, :] - y16[None, :, :]) ** 2).sum(-1)
+    for tier in ("default", "high", "highest"):
+        prec.set_matmul_precision(tier)
+        d = np.asarray(pairwise_l2_pallas(jnp.asarray(x16, jnp.bfloat16),
+                                          jnp.asarray(y16, jnp.bfloat16)))
+        np.testing.assert_allclose(d, ref, rtol=0.1, atol=0.3)
+
+
 def test_high_tier_split_accuracy(restore_policy):
     """The manual bf16 hi/lo split ('high' inside kernels) must land within
     ~2^-17 of the f64 oracle — far tighter than one bf16 pass."""
