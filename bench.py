@@ -147,8 +147,9 @@ def run():
         # tunnel can wedge for hours — see BASELINE.md); the last real-TPU
         # measurement of the full-size config is recorded there.
         line["note"] = ("cpu fallback (TPU unreachable); last real-TPU "
-                        "measurement this round: 82.8 iters/s at "
-                        "1000000x128 k=1024 (BASELINE.md)")
+                        "measurement this round: 75.4 iters/s at "
+                        "1000000x128 k=1024, default 'high' accuracy "
+                        "tier (BASELINE.md)")
     return line
 
 
