@@ -22,7 +22,7 @@ probe() {
 }
 
 wait_for_tpu() {
-    for i in $(seq 1 100); do
+    for i in $(seq 1 2000); do
         if probe; then
             echo "[battery] TPU reachable (attempt $i)"
             return 0
