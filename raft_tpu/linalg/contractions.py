@@ -153,7 +153,10 @@ def _mask_argmin(d, n_valid: int):
     """Shared masking + fused argmin over a distance tile (see
     :func:`_distance_tile` for the tie rule and index-dtype rationale)."""
     col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
-    d = jnp.where(col < n_valid, d, jnp.inf)
+    # dtype-matched inf: a bare jnp.inf is a weak-f64 constant under
+    # jax_enable_x64, and the resulting f64→f32 convert has no Mosaic
+    # lowering (caught by tests/test_mosaic_lowering.py)
+    d = jnp.where(col < n_valid, d, jnp.asarray(jnp.inf, d.dtype))
     arg = jax.lax.argmin(d, 1, jnp.int32)[:, None]
     minval = jnp.min(d, axis=1, keepdims=True)
     return col, minval, arg

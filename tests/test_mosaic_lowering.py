@@ -1,0 +1,75 @@
+"""Cross-platform Mosaic lowering tier: every Pallas kernel, every
+precision tier, lowered FOR TPU on a machine with no TPU.
+
+`jax.export(platforms=("tpu",))` runs the full Pallas→Mosaic module
+generation at lowering time — the phase that rejects unsupported kernel
+constructs (e.g. Precision.HIGH on dots, int64 reduce indices). The
+hardware smoke tier (tpu_tests/) still owns Mosaic-compile and numerics
+on a real chip; this tier catches the lowering class of regression in
+every CPU test run, which matters because the chip tunnel can be
+unreachable for hours at a time.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import raft_tpu
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+@pytest.fixture(autouse=True)
+def _compiled_pallas(monkeypatch):
+    # force the compiled (non-interpret) kernel path during lowering
+    monkeypatch.setenv("RAFT_TPU_PALLAS_INTERPRET", "0")
+    from raft_tpu.util import pallas_utils
+
+    pallas_utils.use_interpret.cache_clear()
+    yield
+    pallas_utils.use_interpret.cache_clear()
+
+
+@pytest.fixture
+def xy():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(512, 64)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(96, 64)), jnp.float32)
+    return x, y
+
+
+def _lowers_with_mosaic(fn):
+    exp = jax.export.export(jax.jit(fn), platforms=("tpu",))()
+    assert "tpu_custom_call" in exp.mlir_module(), \
+        "kernel fell back to plain XLA during TPU lowering"
+
+
+@pytest.mark.parametrize("tier", ["default", "high", "highest"])
+@pytest.mark.parametrize("kernel", ["pairwise", "argmin", "lloyd",
+                                    "argmin_tiled"])
+def test_kernels_lower_for_tpu(tier, kernel, xy, restore=None):
+    from raft_tpu.linalg.contractions import (fused_l2_argmin_pallas,
+                                              fused_lloyd_pallas,
+                                              pairwise_l2_pallas)
+
+    x, y = xy
+    old = raft_tpu.get_matmul_precision()
+    try:
+        raft_tpu.set_matmul_precision(tier)
+        if kernel == "pairwise":
+            _lowers_with_mosaic(lambda: pairwise_l2_pallas(x, y))
+        elif kernel == "argmin":
+            _lowers_with_mosaic(lambda: fused_l2_argmin_pallas(x, y))
+        elif kernel == "lloyd":
+            _lowers_with_mosaic(lambda: fused_lloyd_pallas(x, y))
+        else:
+            # wide Y forces the 2-axis running-min kernel
+            rng = np.random.default_rng(2)
+            ywide = jnp.asarray(rng.normal(size=(20000, 24)), jnp.float32)
+            xs = jnp.asarray(rng.normal(size=(64, 24)), jnp.float32)
+            _lowers_with_mosaic(lambda: fused_l2_argmin_pallas(xs, ywide))
+    finally:
+        raft_tpu.set_matmul_precision(old)
+        jax.config.update("jax_default_matmul_precision", None)
