@@ -47,6 +47,21 @@ def _lowers_with_mosaic(fn):
 
 
 @pytest.mark.parametrize("tier", ["default", "high", "highest"])
+def test_knn_scan_lowers_for_tpu(tier, xy):
+    """Pallas kernel inside lax.scan (the knn database streaming loop)."""
+    from raft_tpu.neighbors import knn
+
+    x, y = xy
+    old = raft_tpu.get_matmul_precision()
+    try:
+        raft_tpu.set_matmul_precision(tier)
+        _lowers_with_mosaic(lambda: knn(None, x, y, k=5, tile=256)[0])
+    finally:
+        raft_tpu.set_matmul_precision(old)
+        jax.config.update("jax_default_matmul_precision", None)
+
+
+@pytest.mark.parametrize("tier", ["default", "high", "highest"])
 @pytest.mark.parametrize("kernel", ["pairwise", "argmin", "lloyd",
                                     "argmin_tiled"])
 def test_kernels_lower_for_tpu(tier, kernel, xy, restore=None):
