@@ -152,6 +152,15 @@ def run():
     return line
 
 
+def is_valid_northstar_line(d: dict) -> bool:
+    """Single source of truth for what counts as a machine-captured
+    on-TPU north-star measurement — shared by the battery's artifact
+    validator (ci/tpu_battery.sh) and the relay below, so the two can't
+    drift: backend really tpu, not an error line, not itself a relay."""
+    return (d.get("backend") == "tpu" and "error" not in d
+            and "relay" not in d)
+
+
 def _relay_battery_artifact():
     """When the tunnel is wedged at driver time, relay the battery's last
     machine-captured on-TPU north-star line instead of a CPU number.
@@ -170,7 +179,7 @@ def _relay_battery_artifact():
                 raw = raw.strip()
                 if raw.startswith("{"):
                     cand = json.loads(raw)
-                    if cand.get("backend") == "tpu" and "error" not in cand:
+                    if is_valid_northstar_line(cand):
                         cand["relay"] = "tpu_battery_out/bench_northstar.json"
                         cand["captured_unix"] = int(os.path.getmtime(path))
                         return cand

@@ -21,6 +21,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--filter", default="", help="substring filter")
+    ap.add_argument("--family", default=None,
+                    help="exact family name (the battery's per-family "
+                         "isolation needs exact match: a substring filter "
+                         "would drag matrix/select_k_large into "
+                         "matrix/select_k's time budget)")
     ap.add_argument("--size", choices=("small", "full"), default="small")
     ap.add_argument("--list", action="store_true")
     args = ap.parse_args()
@@ -31,7 +36,12 @@ def main():
     if args.size == "full":
         bench_prims.SIZES = bench_prims._FULL
 
-    names = sorted(n for n in REGISTRY if args.filter in n)
+    if args.family is not None:
+        if args.family not in REGISTRY:
+            sys.exit(f"unknown family {args.family!r}; see --list")
+        names = [args.family]
+    else:
+        names = sorted(n for n in REGISTRY if args.filter in n)
     if args.list:
         print("\n".join(names))
         return

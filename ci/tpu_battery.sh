@@ -47,14 +47,16 @@ refresh_northstar() {
     rc=$?
     if [ "$rc" = 0 ] && python - <<'EOF'
 import json, sys
+from bench import is_valid_northstar_line   # shared predicate
 ok = False
 with open("tpu_battery_out/bench_northstar.tmp") as f:
     for raw in f:
         raw = raw.strip()
         if raw.startswith("{"):
-            d = json.loads(raw)
-            ok = d.get("backend") == "tpu" and "error" not in d \
-                and "relay" not in d
+            try:
+                ok = is_valid_northstar_line(json.loads(raw))
+            except ValueError:
+                ok = False
 sys.exit(0 if ok else 1)
 EOF
     then
@@ -72,16 +74,22 @@ EOF
 wait_for_tpu || exit 1
 refresh_northstar
 
-if [ ! -f tpu_battery_out/smoke_green ]; then
-    echo "[battery] running tpu_tests smoke tier"
+# smoke-green marker is keyed on HEAD + a working-tree diff hash: a pass
+# only counts for the exact code state it ran against — committed OR
+# uncommitted kernel changes invalidate it
+HEAD_SHA="$(git rev-parse HEAD 2>/dev/null || echo unknown)-$(
+    git diff HEAD -- . ':!tpu_battery_out' 2>/dev/null \
+    | sha1sum | cut -c1-12)"   # battery's own output mutations excluded
+if [ "$(cat tpu_battery_out/smoke_green 2>/dev/null)" != "$HEAD_SHA" ]; then
+    echo "[battery] running tpu_tests smoke tier (HEAD $HEAD_SHA)"
     timeout 1800 python -m pytest tpu_tests -q \
         > tpu_battery_out/tpu_smoke.txt 2>&1
     rc=$?
     echo "[battery] smoke rc=$rc (tail below)"
     tail -3 tpu_battery_out/tpu_smoke.txt
-    if [ "$rc" = 0 ]; then touch tpu_battery_out/smoke_green; fi
+    if [ "$rc" = 0 ]; then echo "$HEAD_SHA" > tpu_battery_out/smoke_green; fi
 else
-    echo "[battery] smoke already green; skipping"
+    echo "[battery] smoke already green at $HEAD_SHA; skipping"
 fi
 
 echo "[battery] running full bench sweep (per-family processes)"
@@ -92,6 +100,7 @@ PRIORITY="cluster/kmeans_iter matrix/select_k matrix/select_k_large
 sparse/spmv_large sparse/lanczos sparse/mst neighbors/brute_force
 stats/moments stats/metrics random/rng random/make_blobs random/permute
 random/subsample"
+PRIORITY=$(echo $PRIORITY)   # flatten newlines -> single spaces
 ALL=$(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
       python benches/run_benches.py --list)
 REST=$(for f in $ALL; do
@@ -112,11 +121,34 @@ for fam in $PRIORITY $REST; do
         refresh_northstar
     fi
     echo "[battery] run $fam $(date +%H:%M:%S)"
-    timeout 420 python benches/run_benches.py --size full --filter "$fam" \
-        2>>"$ERR" | grep -v '^#' >> "$OUT"
-    rc=$?
+    # per-family tmp file: completed families append clean; a timed-out
+    # family's completed cases still land, annotated "partial": true, so
+    # a later rerun's full rows are distinguishable from the stale window
+    FTMP="tpu_battery_out/.fam.$(echo "$fam" | tr / _).tmp"
+    timeout 420 python benches/run_benches.py --size full --family "$fam" \
+        2>>"$ERR" | grep -v '^#' > "$FTMP"
+    rc=${PIPESTATUS[0]}   # the runner's status, not grep's (a family that
+                          # legitimately emits zero rows must still get
+                          # its family_done marker under pipefail)
     echo "[battery] rc=$rc $fam"
-    [ "$rc" = 0 ] && echo "{\"family_done\": \"$fam\"}" >> "$OUT"
+    if [ "$rc" = 0 ]; then
+        cat "$FTMP" >> "$OUT"
+        echo "{\"family_done\": \"$fam\"}" >> "$OUT"
+    else
+        python - "$FTMP" <<'EOF' >> "$OUT"
+import json, sys
+for raw in open(sys.argv[1]):
+    raw = raw.strip()
+    if raw.startswith("{"):
+        try:
+            d = json.loads(raw)
+        except ValueError:      # stray non-JSON line: keep the rest
+            continue
+        d["partial"] = True
+        print(json.dumps(d))
+EOF
+    fi
+    rm -f "$FTMP"
 done
 
 echo "[battery] DONE $(date +%H:%M:%S)"

@@ -240,6 +240,18 @@ class MeshComms:
     # sendbuff) and returns the stacked recvbuffs. Compiled via shard_map so
     # the actual data movement is the real XLA collective.
 
+    def _is_multiprocess(self) -> bool:
+        """True when the clique's mesh spans more than this process (the
+        `jax.distributed` multi-controller regime — each process only
+        addresses its local devices)."""
+        flag = self._shared.get("multiprocess")
+        if flag is None:
+            me = jax.process_index()
+            flag = any(d.process_index != me
+                       for d in np.asarray(self.mesh.devices).flat)
+            self._shared["multiprocess"] = flag
+        return flag
+
     def _run(self, cache_key, shard_fn, x):
         """Compile-once-per-(op, shape, dtype) eager collective dispatch.
 
@@ -247,8 +259,28 @@ class MeshComms:
         compiled shard_map is cached in clique-shared state so repeated
         calls cost one dispatch, not one compile (the analogue of NCCL
         kernels being enqueued, not rebuilt).
+
+        Multi-controller (mesh spans processes): the stacked buffer —
+        identical on every process, as each comms-battery caller builds
+        the same one — is turned into a global sharded array by slicing
+        each process's addressable shards out of it, and the output is
+        replicated so every process can read the full stacked result.
+        All processes must call eager collectives in the same order (the
+        usual SPMD contract; ref: every NCCL rank enqueues symmetric
+        calls or deadlocks — std_comms.hpp inherits the same rule).
         """
-        x = jnp.asarray(x)
+        multi = self._is_multiprocess()
+        # validate on the host view; only materialize on device once, on
+        # the path that will actually consume it (the multi path slices
+        # process-local shards straight from host memory)
+        if multi:
+            host = np.asarray(x)
+            # same dtype rules as jnp.asarray (e.g. f64→f32 when x64 is
+            # off) so the single- and multi-controller paths agree
+            host = host.astype(jax.dtypes.canonicalize_dtype(host.dtype))
+            x = host
+        else:
+            x = jnp.asarray(x)
         n = self.get_size()
         if x.shape[0] != n:
             raise ValueError(
@@ -260,9 +292,17 @@ class MeshComms:
         with self._shared["lock"]:
             f = cache.get(full_key)
         if f is None:
-            f = _build_eager_collective(self.mesh, self.axis_name, shard_fn)
+            f = _build_eager_collective(self.mesh, self.axis_name, shard_fn,
+                                        replicate_out=multi)
             with self._shared["lock"]:
                 cache[full_key] = f
+        if multi:
+            from jax.sharding import NamedSharding
+
+            sharding = NamedSharding(self.mesh, P(self.axis_name))
+            ga = jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
+            return f(ga)
         return f(x)
 
     def allreduce(self, x, op: Op = Op.SUM):
@@ -355,11 +395,13 @@ class MeshComms:
         pass
 
 
-def _build_eager_collective(mesh, axis_name, shard_fn):
+def _build_eager_collective(mesh, axis_name, shard_fn, replicate_out=False):
     """shard x's leading dim over the axis, apply shard_fn per shard, restack.
 
     Inside the shard the leading dim is 1 (one rank's buffer); shard_fn sees
-    the squeezed buffer.
+    the squeezed buffer. ``replicate_out`` adds a final all-gather so every
+    process of a multi-controller clique holds the full stacked result
+    (single-controller callers skip it — they already address every shard).
     """
     spec = P(axis_name)
 
@@ -368,8 +410,12 @@ def _build_eager_collective(mesh, axis_name, shard_fn):
         r = shard_fn(s)
         return r[None]
 
-    return jax.jit(jax.shard_map(wrapped, mesh=mesh, in_specs=spec,
-                                 out_specs=spec))
+    sm = jax.shard_map(wrapped, mesh=mesh, in_specs=spec, out_specs=spec)
+    if replicate_out:
+        from jax.sharding import NamedSharding
+
+        return jax.jit(sm, out_shardings=NamedSharding(mesh, P()))
+    return jax.jit(sm)
 
 
 def build_mesh_comms(res=None, mesh: Optional[Mesh] = None,

@@ -70,21 +70,41 @@ def _kernel_dot(a, b, exact_lhs: bool = False):
     """
     mode = current_mode()
     f32 = jnp.float32
-    if a.dtype != f32 or b.dtype != f32 or mode == "default":
+    bf16 = jnp.bfloat16
+    if a.dtype == bf16 and b.dtype == bf16:
+        # both already bf16: one MXU pass multiplies them exactly
+        # (bf16×bf16 with f32 accumulation loses nothing)
+        return jnp.dot(a, b, preferred_element_type=f32,
+                       precision=_ONE_PASS)
+    # ONLY bf16 is exactly representable in a split's hi half (its lo is
+    # identically zero, so that pass can be skipped — the same economy
+    # exact_lhs declares for one-hot matrices). f16/f64 are NOT: f16
+    # carries 10 mantissa bits vs bf16's 7; f64 carries 52.
+    a_exact = exact_lhs or a.dtype == bf16
+    b_exact = b.dtype == bf16
+    if a.dtype != f32 or b.dtype != f32:
+        # mixed or non-f32 dtypes: promote to a common f32 pair so every
+        # operand still gets the tier's mantissa handling — the old
+        # early-return silently truncated non-f32 cases to one bf16 pass
+        # even at tier 'highest' (round-2 advisor finding)
+        a, b = a.astype(f32), b.astype(f32)
+    if mode == "default":
         return jnp.dot(a, b, preferred_element_type=f32,
                        precision=_ONE_PASS)
     if mode == "high":
         a_hi = a.astype(jnp.bfloat16)
-        b_hi, b_lo = _split_hi_lo(b)
-        out = (jnp.dot(a_hi, b_hi, preferred_element_type=f32,
-                       precision=_ONE_PASS)
-               + jnp.dot(a_hi, b_lo, preferred_element_type=f32,
-                         precision=_ONE_PASS))
-        if exact_lhs:
-            return out
-        a_lo = (a - a_hi.astype(f32)).astype(jnp.bfloat16)
-        return out + jnp.dot(a_lo, b_hi, preferred_element_type=f32,
-                             precision=_ONE_PASS)
+        b_hi = b.astype(jnp.bfloat16)
+        out = jnp.dot(a_hi, b_hi, preferred_element_type=f32,
+                      precision=_ONE_PASS)
+        if not b_exact:
+            b_lo = (b - b_hi.astype(f32)).astype(jnp.bfloat16)
+            out = out + jnp.dot(a_hi, b_lo, preferred_element_type=f32,
+                                precision=_ONE_PASS)
+        if not a_exact:
+            a_lo = (a - a_hi.astype(f32)).astype(jnp.bfloat16)
+            out = out + jnp.dot(a_lo, b_hi, preferred_element_type=f32,
+                                precision=_ONE_PASS)
+        return out
     return jnp.dot(a, b, preferred_element_type=f32,
                    precision=jax.lax.Precision.HIGHEST)
 
@@ -375,8 +395,10 @@ def _distance_tile(x, y, n_valid: int, metric: str = "l2"):
     fewer on the VPU, which bounds this kernel. The index dtype is pinned
     to int32: Mosaic's reduce-index helper rejects int64, which
     jnp.argmin would bind under jax_enable_x64. lax.argmin's
-    first-minimum tie rule IS the reference's KVP argmin rule
-    (kvp.hpp operator< on value-then-key)."""
+    first-minimum tie rule matches the fused-NN KVP min-reduce (the
+    value-then-key reduce op of the cuVS fused-distance lineage; note
+    kvp.hpp's operator< itself orders key-then-value — it is the reduce
+    op, not operator<, that defines the tie rule)."""
     return _mask_argmin(_metric_tile(x, y, metric), n_valid)
 
 

@@ -43,11 +43,13 @@ def contingency_matrix(y_true, y_pred, n_classes_true: int = None,
                 f"labels exceed the class count: max labels ({mt}, {mp}) "
                 f"vs n_classes ({nt}, {np_})")
     flat = y_true.astype(jnp.int32) * np_ + y_pred.astype(jnp.int32)
-    if nt * np_ <= 4096:
-        # Small contingency tables (the common clustering-metric case):
-        # a one-hot bincount sums on the VPU instead of serializing
-        # through TPU's scatter-add — the same dispatch rule as
-        # stats.histogram's one-hot-vs-Gmem strategies.
+    # One-hot bincount sums on the VPU instead of serializing through
+    # TPU's scatter-add — but its (n_samples, table) intermediate can
+    # materialize under eager execution, so the dispatch is bounded on
+    # BOTH the table size and the intermediate's element count (~128 MB
+    # bool cap); beyond that the scatter path's O(n) memory wins
+    # (round-2 advisor finding: 1M samples × 4096 table ≈ 4 GB eager).
+    if nt * np_ <= 4096 and flat.shape[0] * (nt * np_) <= (1 << 27):
         onehot = flat[:, None] == jnp.arange(nt * np_, dtype=jnp.int32)
         out = jnp.sum(onehot, axis=0, dtype=jnp.result_type(int))
     else:

@@ -64,7 +64,7 @@ def test_knn_scan_lowers_for_tpu(tier, xy):
 @pytest.mark.parametrize("tier", ["default", "high", "highest"])
 @pytest.mark.parametrize("kernel", ["pairwise", "argmin", "lloyd",
                                     "argmin_tiled"])
-def test_kernels_lower_for_tpu(tier, kernel, xy, restore=None):
+def test_kernels_lower_for_tpu(tier, kernel, xy):
     from raft_tpu.linalg.contractions import (fused_l2_argmin_pallas,
                                               fused_lloyd_pallas,
                                               pairwise_l2_pallas)

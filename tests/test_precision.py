@@ -135,3 +135,35 @@ def test_high_tier_split_accuracy(restore_policy):
     d = np.asarray(pairwise_l2_pallas(x, y)).astype(np.float64)
     rel = np.abs(d - ref) / np.maximum(np.abs(ref), 1e-9)
     assert rel.max() < 1e-4, rel.max()
+
+
+def test_mixed_dtype_keeps_f32_operand_precision(restore_policy):
+    """A mixed f32/bf16 dot must not silently truncate the f32 operand to
+    one bf16 pass at tiers 'high'/'highest' (round-2 advisor finding):
+    both are promoted to f32 and run through the tier's decomposition.
+    The f32 operand carries sub-bf16 mantissa structure that one bf16
+    pass destroys; the tiered result must preserve it."""
+    from raft_tpu.linalg.contractions import _kernel_dot
+
+    rng = np.random.default_rng(9)
+    # values needing >8 mantissa bits: 1 + tiny perturbations
+    a = (1.0 + rng.normal(size=(32, 64)) * 1e-4).astype(np.float32)
+    b16 = jnp.asarray(rng.normal(size=(64, 16)).astype(np.float32),
+                      jnp.bfloat16)
+    ref = np.asarray(a, np.float64) @ np.asarray(
+        b16.astype(jnp.float32), np.float64)
+    for tier in ("high", "highest"):
+        prec.set_matmul_precision(tier)
+        out = np.asarray(_kernel_dot(jnp.asarray(a), b16), np.float64)
+        rel = np.abs(out - ref) / np.maximum(np.abs(ref), 1e-9)
+        assert rel.max() < 1e-4, (tier, rel.max())
+    # the numeric check alone can't fail on CPU (XLA:CPU computes DEFAULT
+    # dots in f32), so also pin the LOWERING: the old bug emitted ONE
+    # DEFAULT-precision dot for the mixed case at every tier
+    prec.set_matmul_precision("high")
+    ps = _dot_precisions(_kernel_dot, jnp.asarray(a), b16)
+    # a is f32 (needs its lo pass), b is bf16-exact (lo pass skipped) -> 2
+    assert len(ps) == 2, ps
+    prec.set_matmul_precision("highest")
+    ps = _dot_precisions(_kernel_dot, jnp.asarray(a), b16)
+    assert ps == [(jax.lax.Precision.HIGHEST,) * 2], ps
