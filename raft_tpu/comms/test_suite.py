@@ -84,13 +84,23 @@ def perform_test_comms_allgatherv(handle, root: int = 0) -> bool:
 
 
 def perform_test_comms_gather(handle, root: int = 0) -> bool:
-    """ref: test_collective_gather (detail/test.hpp:226-263)."""
+    """ref: test_collective_gather (detail/test.hpp:226-263).
+
+    Also pins the DOCUMENTED divergence from the reference (gatherv
+    docstring, comms.py): XLA collectives are SPMD, so every rank — not
+    just root — receives the gathered buffer. Reference-ported code that
+    relied on non-root recv buffers staying untouched must not assume
+    that here; this test makes the behavioral contract explicit."""
     comm = _comms(handle)
     n = comm.get_size()
     send = np.arange(n, dtype=np.int32).reshape(n, 1)
     out = np.asarray(comm.gather(send, root=root))
     comm.barrier()
-    return bool(np.array_equal(out[root], np.arange(n, dtype=np.int32)))
+    want = np.arange(n, dtype=np.int32)
+    if not np.array_equal(out[root], want):
+        return False
+    # the divergence: non-root ranks hold the same full buffer
+    return bool(all(np.array_equal(out[r], want) for r in range(n)))
 
 
 def perform_test_comms_gatherv(handle, root: int = 0) -> bool:
@@ -106,7 +116,11 @@ def perform_test_comms_gatherv(handle, root: int = 0) -> bool:
     comm.barrier()
     want = np.concatenate(
         [np.full(counts[r], r, np.int32) for r in range(n)])
-    return bool(np.array_equal(out[root], want))
+    if not np.array_equal(out[root], want):
+        return False
+    # assert the SPMD divergence (see perform_test_comms_gather): every
+    # rank receives the full gathered buffer, not only root
+    return bool(all(np.array_equal(out[r], want) for r in range(n)))
 
 
 def perform_test_comms_reducescatter(handle, root: int = 0) -> bool:

@@ -13,11 +13,46 @@ functions with indices/values as leaves.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+import os
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# -- dynamic-nnz bucketing (SURVEY §7 hard part: every distinct nnz is a
+#    distinct static shape, so a stream of graphs with varying nnz would
+#    retrace every sparse jit; ref contrast: sparse/detail/coo.cuh:38
+#    setSize just realloc's). Policy: pad indices/data up to a size class;
+#    ``indptr`` is NOT touched, so ``indptr[-1]`` remains the LOGICAL nnz
+#    as device data. Pad entries carry data == 0 and column == 0:
+#    - linear ops (spmv/spmm/norms/degree) are unaffected — zero
+#      contributions land in the last row;
+#    - per-nnz-output and selection ops mask on position < indptr[-1];
+#    - eager conversions (csr_to_coo and everything built on it) slice
+#      back to the logical nnz.
+#    Quarter-octave classes (2^k × {1, 1.25, 1.5, 1.75}) bound the wasted
+#    bandwidth at ≤25% while keeping the class count logarithmic.
+
+PAD_MIN_NNZ = 256
+
+
+def nnz_bucket(n: int, min_size: int = PAD_MIN_NNZ) -> int:
+    """Smallest quarter-octave size class ≥ n."""
+    if n <= min_size:
+        return min_size
+    b = min_size
+    while b * 2 <= n:
+        b *= 2
+    for frac in (4, 5, 6, 7):
+        cand = b * frac // 4
+        if cand >= n:
+            return cand
+    return b * 2
+
+
+def _default_pad() -> bool:
+    return os.environ.get("RAFT_TPU_SPARSE_PAD", "1") not in ("0", "false")
 
 
 class CSRMatrix:
@@ -34,7 +69,61 @@ class CSRMatrix:
 
     @property
     def nnz(self) -> int:
+        """PHYSICAL nnz (the static jit shape). With padding this can
+        exceed :meth:`logical_nnz` = ``indptr[-1]``."""
         return int(self.indices.shape[0])
+
+    def logical_nnz(self) -> int:
+        """Actual stored-entry count, ``indptr[-1]``, as a host int.
+
+        EAGER-ONLY (raises on tracers): jit-compatible consumers build
+        positional masks from the device scalar ``indptr[-1]`` instead
+        (see e.g. sparse.linalg._segment_spmv). The value is cached at
+        construction where known (pad_nnz/from_scipy), so the common
+        eager paths don't device-sync; the cache is deliberately NOT
+        pytree aux data — a per-graph static would retrace every jit,
+        defeating the bucketing."""
+        hint = getattr(self, "_logical_nnz_hint", None)
+        if hint is not None:
+            return hint
+        n = int(np.asarray(self.indptr[-1]))
+        self._logical_nnz_hint = n
+        return n
+
+    def pad_nnz(self, target: Optional[int] = None,
+                min_size: int = PAD_MIN_NNZ) -> "CSRMatrix":
+        """Pad indices/data to ``target`` (default: the nnz size class) so
+        matrices with nearby nnz share one jit executable. Pad entries:
+        data 0, column 0; ``indptr`` is unchanged — ``indptr[-1]`` stays
+        the logical nnz."""
+        phys = self.nnz
+        logical = self.logical_nnz()
+        if target is None:
+            target = nnz_bucket(max(logical, phys), min_size)
+        pad = target - phys
+        if pad <= 0:
+            return self
+        if isinstance(self.indices, jax.Array):
+            indices = jnp.concatenate(
+                [self.indices, jnp.zeros(pad, self.indices.dtype)])
+            data = jnp.concatenate(
+                [self.data, jnp.zeros(pad, self.data.dtype)])
+        else:
+            indices = np.concatenate(
+                [self.indices, np.zeros(pad, self.indices.dtype)])
+            data = np.concatenate(
+                [self.data, np.zeros(pad, self.data.dtype)])
+        out = CSRMatrix(self.indptr, indices, data, self.shape)
+        out._logical_nnz_hint = logical
+        return out
+
+    def depad(self) -> "CSRMatrix":
+        """Slice back to the logical nnz (eager; host syncs indptr[-1])."""
+        n = self.logical_nnz()
+        if n == self.nnz:
+            return self
+        return CSRMatrix(self.indptr, self.indices[:n], self.data[:n],
+                         self.shape)
 
     @property
     def n_rows(self) -> int:
@@ -64,27 +153,41 @@ class CSRMatrix:
     def to_scipy(self):
         import scipy.sparse as sp
 
-        h = self.to_host()
+        h = self.to_host().depad()   # drop bucketing pad entries
         return sp.csr_matrix((h.data, h.indices, h.indptr), shape=self.shape)
 
     @staticmethod
-    def from_scipy(mat) -> "CSRMatrix":
+    def from_scipy(mat, pad: Optional[bool] = None) -> "CSRMatrix":
+        """scipy → device CSR. ``pad`` controls nnz bucketing (default: on;
+        opt out per-call with ``pad=False`` or globally with
+        ``RAFT_TPU_SPARSE_PAD=0``)."""
         mat = mat.tocsr()
-        return CSRMatrix(jnp.asarray(mat.indptr), jnp.asarray(mat.indices),
-                         jnp.asarray(mat.data), mat.shape)
+        out = CSRMatrix(jnp.asarray(mat.indptr), jnp.asarray(mat.indices),
+                        jnp.asarray(mat.data), mat.shape)
+        out._logical_nnz_hint = int(mat.nnz)
+        if pad if pad is not None else _default_pad():
+            out = out.pad_nnz()
+        return out
 
     def row_lengths(self):
         return self.indptr[1:] - self.indptr[:-1]
 
     def row_ids(self):
         """Expand indptr to a per-nnz row-id vector (the reference's
-        csr_to_coo conversion kernel, sparse/convert/coo.cuh)."""
+        csr_to_coo conversion kernel, sparse/convert/coo.cuh). Always
+        PHYSICAL length: bucketing pad slots get the last row's id (the
+        same fill jnp.repeat's total_repeat_length uses)."""
         lengths = self.indptr[1:] - self.indptr[:-1]
         row_range = jnp.arange(self.n_rows, dtype=self.indices.dtype)
         if isinstance(self.indptr, jax.Array):
             return jnp.repeat(row_range, lengths,
                               total_repeat_length=self.nnz)
-        return np.repeat(np.asarray(row_range), np.asarray(lengths))
+        out = np.repeat(np.asarray(row_range), np.asarray(lengths))
+        if out.shape[0] < self.nnz:
+            fill = self.n_rows - 1 if self.n_rows else 0
+            out = np.concatenate(
+                [out, np.full(self.nnz - out.shape[0], fill, out.dtype)])
+        return out
 
 
 class COOMatrix:

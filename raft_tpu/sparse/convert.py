@@ -46,8 +46,11 @@ def csr_to_coo(csr: CSRMatrix) -> COOMatrix:
     indptr = _host(csr.indptr)
     rows = np.repeat(np.arange(csr.n_rows, dtype=_host(csr.indices).dtype),
                      np.diff(indptr))
-    return COOMatrix(jnp.asarray(rows), jnp.asarray(csr.indices),
-                     jnp.asarray(csr.data), csr.shape)
+    # logical nnz: drops bucketing pad entries, so every conversion-based
+    # consumer (transpose, laplacian, csr_add, ...) sees the true structure
+    n = int(indptr[-1])
+    return COOMatrix(jnp.asarray(rows), jnp.asarray(csr.indices[:n]),
+                     jnp.asarray(csr.data[:n]), csr.shape)
 
 
 def dense_to_csr(dense, tol: float = 0.0) -> CSRMatrix:

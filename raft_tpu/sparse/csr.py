@@ -93,8 +93,13 @@ def weak_cc(res, csr: CSRMatrix,
     n = csr.n_rows
     vmask = jnp.ones((n,), jnp.bool_) if mask is None \
         else jnp.asarray(mask).astype(jnp.bool_)
-    return _weak_cc_device(csr.row_ids().astype(jnp.int32),
-                           csr.indices.astype(jnp.int32), vmask, n)
+    src = csr.row_ids().astype(jnp.int32)
+    dst = jnp.asarray(csr.indices).astype(jnp.int32)
+    # bucketing pad entries must not connect the last row to vertex 0:
+    # rewrite them as self-loops, which never merge components. The mask
+    # bound is the device scalar indptr[-1], so this stays jit-traceable.
+    dst = jnp.where(jnp.arange(dst.shape[0]) < csr.indptr[-1], dst, src)
+    return _weak_cc_device(src, dst, vmask, n)
 
 
 def weak_cc_batched(res, csr: CSRMatrix, start_vertex_id: int = 0,

@@ -31,13 +31,16 @@ from raft_tpu.util.math import round_up_to_multiple
 @dataclasses.dataclass(frozen=True)
 class ELLMatrix:
     """Row-padded sparse matrix: cols/data are [n_rows, width]; padding
-    entries have col == 0 and data == 0 (zero data makes padded lanes
-    contribute nothing, so no masking is needed in the kernels)."""
+    lanes have col == 0 and data == 0. Kernels mask on ``row_len`` (lanes
+    beyond a row's nnz) rather than trusting the zero data: a padded lane
+    gathers x[0], and 0 * inf = nan would otherwise leak into the row sum
+    while a stored-zero entry must still propagate inf/nan per IEEE."""
 
     cols: jnp.ndarray     # int32 [n_rows, width]
     data: jnp.ndarray     # [n_rows, width]
     shape: Tuple[int, int]
     nnz: int
+    row_len: jnp.ndarray = None  # int32 [n_rows] — valid lanes per row
 
     @property
     def n_rows(self) -> int:
@@ -71,37 +74,54 @@ def from_csr(csr: CSRMatrix, lane_multiple: int = 8) -> ELLMatrix:
 
     cols_h = np.zeros((n_rows, width), np.int32)
     data_h = np.zeros((n_rows, width), np.asarray(csr.data).dtype)
-    src_cols = np.asarray(csr.indices)
-    src_data = np.asarray(csr.data)
+    src_cols = np.asarray(csr.indices)[:nnz]   # logical slice: bucketing
+    src_data = np.asarray(csr.data)[:nnz]      # pads aren't row members
     rows = np.repeat(np.arange(n_rows), row_len)
     lanes = np.arange(nnz) - np.repeat(indptr[:-1], row_len)
     cols_h[rows, lanes] = src_cols
     data_h[rows, lanes] = src_data
     return ELLMatrix(jnp.asarray(cols_h), jnp.asarray(data_h),
-                     csr.shape, nnz)
+                     csr.shape, nnz,
+                     row_len=jnp.asarray(row_len.astype(np.int32)))
+
+
+def _lane_mask(data, row_len):
+    if row_len is None:           # legacy slab with no lane bookkeeping
+        return None
+    return jnp.arange(data.shape[1], dtype=jnp.int32)[None, :] \
+        < row_len[:, None]
 
 
 @jax.jit
-def _ell_spmv(cols, data, x):
+def _ell_spmv(cols, data, x, mask):
     # dense gather [n_rows, width] then a fixed-shape row reduction —
-    # no segment ids, no scatter
-    return jnp.sum(data * x[cols], axis=1)
+    # no segment ids, no scatter; padded lanes masked (0 * inf = nan)
+    prod = data * x[cols]
+    if mask is not None:
+        prod = jnp.where(mask, prod, 0)
+    return jnp.sum(prod, axis=1)
 
 
 def spmv(ell: ELLMatrix, x) -> jnp.ndarray:
     """y = A·x on the ELL slab."""
-    return _ell_spmv(ell.cols, ell.data, jnp.asarray(x))
+    return _ell_spmv(ell.cols, ell.data, jnp.asarray(x),
+                     _lane_mask(ell.data, ell.row_len))
 
 
 @jax.jit
-def _ell_spmm(cols, data, b):
-    # [n_rows, width, k] gather; contraction over width
-    return jnp.einsum("rw,rwk->rk", data, b[cols, :])
+def _ell_spmm(cols, data, b, mask):
+    # [n_rows, width, k] gather; contraction over width. Padded lanes are
+    # masked on the GATHERED operand (so 0-data × b[0]=inf can't make nan)
+    bg = b[cols, :]
+    if mask is not None:
+        bg = jnp.where(mask[:, :, None], bg, 0)
+    return jnp.einsum("rw,rwk->rk", data, bg)
 
 
 def spmm(ell: ELLMatrix, b) -> jnp.ndarray:
     """C = A·B for dense B [n_cols, k]."""
-    return _ell_spmm(ell.cols, ell.data, jnp.asarray(b))
+    return _ell_spmm(ell.cols, ell.data, jnp.asarray(b),
+                     _lane_mask(ell.data, ell.row_len))
 
 
 # Auto-dispatch threshold: beyond this stored/actual ratio the padding

@@ -21,9 +21,25 @@ from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.sparse import convert, op
 
 
+def _zero_pad_entries(vals, pattern: CSRMatrix):
+    """Re-establish the bucketing invariant (pad entries carry data == 0)
+    for ops that compute fresh per-nnz values over a padded pattern —
+    a pattern pad slot (row n-1, col 0) would otherwise receive a real
+    dot product that downstream linear ops would sum in. The mask is the
+    DEVICE scalar indptr[-1] (the logical nnz), so this traces under jit;
+    for unpadded matrices it is a no-op elementwise select."""
+    return jnp.where(jnp.arange(vals.shape[0]) < pattern.indptr[-1],
+                     vals, 0)
+
+
 @functools.partial(jax.jit, static_argnames=("n_rows",))
-def _segment_spmv(row_ids, cols, data, x, n_rows: int):
-    return jax.ops.segment_sum(data * x[cols], row_ids, num_segments=n_rows,
+def _segment_spmv(row_ids, cols, data, x, n_rows: int, limit=None):
+    prod = data * x[cols]
+    if limit is not None:
+        # bucketing pad slots gather x[0]; data there is 0, but 0 * inf
+        # (or 0 * nan) is nan — mask the PRODUCT, not just the data
+        prod = jnp.where(jnp.arange(prod.shape[0]) < limit, prod, 0)
+    return jax.ops.segment_sum(prod, row_ids, num_segments=n_rows,
                                indices_are_sorted=True)
 
 
@@ -38,12 +54,16 @@ def spmv(a, x) -> jnp.ndarray:
 
     if isinstance(a, ELLMatrix):
         return ell_spmv(a, x)
-    return _segment_spmv(a.row_ids(), a.indices, a.data, x, a.n_rows)
+    return _segment_spmv(a.row_ids(), a.indices, a.data, x, a.n_rows,
+                         limit=a.indptr[-1])
 
 
 @functools.partial(jax.jit, static_argnames=("n_rows",))
-def _segment_spmm(row_ids, cols, data, b, n_rows: int):
+def _segment_spmm(row_ids, cols, data, b, n_rows: int, limit=None):
     prods = data[:, None] * b[cols, :]
+    if limit is not None:
+        prods = jnp.where((jnp.arange(prods.shape[0]) < limit)[:, None],
+                          prods, 0)
     return jax.ops.segment_sum(prods, row_ids, num_segments=n_rows,
                                indices_are_sorted=True)
 
@@ -57,7 +77,7 @@ def spmm(a, b, alpha=1.0, beta=0.0, c=None) -> jnp.ndarray:
         out = ell_spmm(a, jnp.asarray(b))
     else:
         out = _segment_spmm(a.row_ids(), a.indices, a.data,
-                            jnp.asarray(b), a.n_rows)
+                            jnp.asarray(b), a.n_rows, limit=a.indptr[-1])
     out = alpha * out
     if c is not None and beta != 0.0:
         out = out + beta * jnp.asarray(c)
@@ -82,6 +102,7 @@ def sddmm(a, b, pattern: CSRMatrix, alpha=1.0, beta=0.0) -> CSRMatrix:
     new = alpha * vals.astype(pattern.data.dtype)
     if beta != 0.0:
         new = new + beta * pattern.data
+    new = _zero_pad_entries(new, pattern)
     return CSRMatrix(pattern.indptr, pattern.indices, new, pattern.shape)
 
 
@@ -106,6 +127,7 @@ def masked_matmul(a, b, mask, alpha=1.0, beta=0.0,
     new = alpha * vals.astype(a.dtype)
     if c is not None and beta != 0.0:
         new = new + beta * c.data
+    new = _zero_pad_entries(new, pattern)
     return CSRMatrix(pattern.indptr, pattern.indices, new,
                      (m, pattern.n_cols))
 

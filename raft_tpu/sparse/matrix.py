@@ -36,15 +36,21 @@ def select_k(res, csr: CSRMatrix, k: int, select_min: bool = True,
         info = np.iinfo(dtype)
         pad_val = info.max if select_min else info.min
 
-    # position of each nnz inside its row
+    # position of each nnz inside its row; bucketing pad entries (beyond
+    # indptr[-1]) are pushed out of bounds so the scatter drops them —
+    # otherwise their zero values would land in the last row's padding
+    # slots and win the selection over real negative entries
     row_ids = csr.row_ids()
     offsets = jnp.arange(csr.nnz) - jnp.asarray(indptr[:-1])[row_ids]
+    offsets = jnp.where(jnp.arange(csr.nnz) < int(indptr[-1]),
+                        offsets, max_len)
     padded_val = jnp.full((n_rows, max_len), pad_val, dtype=csr.data.dtype)
-    padded_val = padded_val.at[row_ids, offsets].set(csr.data)
+    padded_val = padded_val.at[row_ids, offsets].set(csr.data,
+                                                     mode="drop")
     col_src = jnp.asarray(in_idx)[csr.indices] if in_idx is not None \
         else csr.indices
     padded_idx = jnp.full((n_rows, max_len), -1, dtype=csr.indices.dtype)
-    padded_idx = padded_idx.at[row_ids, offsets].set(col_src)
+    padded_idx = padded_idx.at[row_ids, offsets].set(col_src, mode="drop")
 
     vals, pos = dense_select_k(res, padded_val, k, select_min=select_min)
     idx = jnp.take_along_axis(padded_idx, pos, axis=1)
@@ -72,7 +78,8 @@ def set_diagonal(csr: CSRMatrix, scalar) -> CSRMatrix:
     """Set existing diagonal entries to a scalar value
     (ref: sparse/matrix/diagonal.cuh:69 `set_diagonal`)."""
     row_ids = csr.row_ids()
-    on_diag = row_ids == csr.indices
+    on_diag = (row_ids == csr.indices) \
+        & (jnp.arange(csr.nnz) < csr.indptr[-1])   # jit-safe pad mask
     return CSRMatrix(csr.indptr, csr.indices,
                      jnp.where(on_diag, scalar, csr.data), csr.shape)
 

@@ -137,6 +137,16 @@ def mst(res, csr: CSRMatrix, color: Optional[np.ndarray] = None,
     src = jnp.asarray(csr.row_ids(), dtype=jnp.int32)
     dst = jnp.asarray(csr.indices, dtype=jnp.int32)
     weights = jnp.asarray(csr.data)
+    # bucketing pad entries would be phantom zero-weight edges (last row →
+    # vertex 0) and zero-weight MINIMA — rewrite them as infinite-weight
+    # SELF-loops (src==dst is never a cross edge, so they can't bridge
+    # genuinely disconnected components either)
+    logical = csr.logical_nnz()
+    if logical != csr.nnz:
+        valid = jnp.arange(weights.shape[0]) < logical
+        weights = jnp.where(valid, weights,
+                            jnp.asarray(np.inf, weights.dtype))
+        dst = jnp.where(valid, dst, src)
 
     colors = jnp.arange(n, dtype=jnp.int32) if color is None \
         else jnp.asarray(np.asarray(color, dtype=np.int32))

@@ -1,0 +1,176 @@
+"""Adversarial / stress tier (round-2 verdict item 7).
+
+NaN/inf and duplicate-value semantics through select_k and argmin, k≈m and
+empty-cluster k-means, MATRIX_SELECT_LARGE-style shapes, and low-precision
+select_k dtypes (ref: cpp/tests/matrix/select_large_k.cu and the NaN/tie
+handling contracts of detail/select_radix.cuh + test_utils.cuh:45-141).
+
+Documented contracts pinned here:
+- NaN ordering is the IEEE total order the reference's radix bit-twiddle
+  also induces (select_radix.cuh maps float→sortable uint): +NaN sorts
+  above +inf, -NaN below -inf. So +NaN is selected LAST by select_min and
+  FIRST by select_max; non-NaN winners are never perturbed.
+- Duplicate values break ties toward ascending input position — the KVP
+  first-minimum rule (smallest index among equal values wins).
+- argmin treats NaN as minimal (numpy semantics: the NaN position is
+  returned) — distances produced by the fused kernels are clamped ≥ 0 and
+  cannot be NaN, so this only concerns direct primitive use.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.matrix import SelectAlgo, argmin, select_k
+
+
+def _np_select_min(x, k):
+    part = np.argsort(x, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(x, part, axis=1), part
+
+
+class TestSelectKAdversarial:
+    def test_inf_values_selected_correctly(self):
+        x = np.array([[3., -np.inf, 1., np.inf, 2.]], np.float32)
+        v, i = select_k(None, x, k=2, select_min=True)
+        assert np.asarray(v).tolist() == [[-np.inf, 1.0]]
+        assert np.asarray(i).tolist() == [[1, 2]]
+        v, i = select_k(None, x, k=2, select_min=False)
+        assert np.asarray(v).tolist() == [[np.inf, 3.0]]
+        assert np.asarray(i).tolist() == [[3, 0]]
+
+    def test_nan_total_order_and_non_nan_winners_stable(self):
+        x = np.array([[4., np.nan, 1., 2., np.inf]], np.float32)
+        # select_min: +NaN sorts above +inf -> last; first 3 unperturbed
+        v, i = select_k(None, x, k=3, select_min=True)
+        assert np.asarray(v).tolist() == [[1.0, 2.0, 4.0]]
+        assert np.asarray(i).tolist() == [[2, 3, 0]]
+        # select_max: +NaN above +inf -> selected first
+        v, i = select_k(None, x, k=2, select_min=False)
+        out = np.asarray(v)[0]
+        assert np.isnan(out[0]) and out[1] == np.inf
+        assert np.asarray(i).tolist()[0] == [1, 4]
+
+    def test_duplicate_ties_ascending_position(self):
+        """KVP first-minimum rule: equal values -> ascending indices."""
+        x = np.array([[5., 1., 1., 1., 7., 1.]], np.float32)
+        v, i = select_k(None, x, k=4, select_min=True)
+        assert np.asarray(v).tolist() == [[1.0, 1.0, 1.0, 1.0]]
+        assert np.asarray(i).tolist() == [[1, 2, 3, 5]]
+        # the tiled path must agree on ties within a tile
+        wide = np.full((1, 20_000), 3.0, np.float32)
+        wide[0, 777] = 1.0
+        wide[0, 778] = 1.0
+        v, i = select_k(None, wide, k=3, select_min=True,
+                        algo=SelectAlgo.RADIX_11BITS)
+        assert np.asarray(i).tolist() == [[777, 778, 0]]
+
+    @pytest.mark.parametrize("k_rel", ["k_eq_len", "k_eq_len_minus_1"])
+    def test_k_equals_len(self, k_rel):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(4, 33)).astype(np.float32)
+        k = x.shape[1] - (0 if k_rel == "k_eq_len" else 1)
+        v, i = select_k(None, x, k=k, select_min=True)
+        ref_v, ref_i = _np_select_min(x, k)
+        np.testing.assert_array_equal(np.asarray(v), ref_v)
+        np.testing.assert_array_equal(np.asarray(i), ref_i)
+
+    def test_single_element_rows(self):
+        v, i = select_k(None, np.array([[7.]], np.float32), k=1)
+        assert np.asarray(v).tolist() == [[7.0]]
+        assert np.asarray(i).tolist() == [[0]]
+
+    def test_select_large_shapes_tiled_vs_direct(self):
+        """MATRIX_SELECT_LARGE analogue (select_large_k.cu): 1M+odd-length
+        rows, k=2048, both algorithms, against the numpy oracle."""
+        rng = np.random.default_rng(11)
+        n_cols = (1 << 20) + 17            # non-multiple of every tile
+        x = rng.normal(size=(2, n_cols)).astype(np.float32)
+        k = 2048
+        ref_v, _ = _np_select_min(x, k)
+        for algo in (SelectAlgo.RADIX_11BITS,
+                     SelectAlgo.WARPSORT_IMMEDIATE):
+            v, i = select_k(None, x, k=k, select_min=True, algo=algo)
+            np.testing.assert_array_equal(np.asarray(v), ref_v)
+            # indices must address the claimed values
+            np.testing.assert_array_equal(
+                np.take_along_axis(x, np.asarray(i), axis=1), ref_v)
+
+    @pytest.mark.parametrize("dtype", [np.float16, np.int8, np.uint8,
+                                       np.int32])
+    def test_low_precision_dtypes(self, dtype):
+        rng = np.random.default_rng(5)
+        if np.issubdtype(dtype, np.floating):
+            x = rng.normal(size=(3, 50)).astype(dtype)
+        else:
+            info = np.iinfo(dtype)
+            x = rng.integers(info.min, info.max + 1, size=(3, 50),
+                             endpoint=False).astype(dtype)
+        for select_min in (True, False):
+            v, i = select_k(None, x, k=5, select_min=select_min)
+            assert np.asarray(v).dtype == dtype
+            xs = np.sort(x, axis=1)
+            ref = xs[:, :5] if select_min else xs[:, ::-1][:, :5]
+            np.testing.assert_array_equal(np.asarray(v), ref)
+
+    def test_int_extremes_no_negation_overflow(self):
+        """-INT_MIN overflows; the bitwise-NOT order flip must not."""
+        x = np.array([[np.iinfo(np.int32).min, 0,
+                       np.iinfo(np.int32).max]], np.int32)
+        v, _ = select_k(None, x, k=3, select_min=True)
+        assert np.asarray(v).tolist() == [
+            [np.iinfo(np.int32).min, 0, np.iinfo(np.int32).max]]
+
+
+class TestArgminAdversarial:
+    def test_nan_is_minimal(self):
+        a = np.array([[3., np.nan, 1.], [2., 5., 2.]], np.float32)
+        out = np.asarray(argmin(None, a))
+        assert out.tolist() == [1, 0]      # NaN position; tie -> first
+
+    def test_all_equal_rows_first_index(self):
+        a = np.zeros((5, 7), np.float32)
+        assert np.asarray(argmin(None, a)).tolist() == [0] * 5
+
+
+class TestKMeansAdversarial:
+    def _fit(self, x, k, **kw):
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        params = KMeansParams(n_clusters=k, max_iter=20, seed=0, **kw)
+        return kmeans_fit(None, params, jnp.asarray(x))
+
+    def test_k_equals_n_samples(self):
+        """Every point becomes its own centroid. The expanded-L2 form
+        gives d(x, x) a cancellation error ~|x|^2 * tier_eps rather than
+        exact 0, so the inertia bound scales with the squared norms."""
+        rng = np.random.default_rng(21)
+        x = rng.normal(size=(16, 8)).astype(np.float32) * 10
+        c, inertia, labels, _ = self._fit(x, k=16)
+        scale = float((x.astype(np.float64) ** 2).sum())
+        assert float(inertia) < scale * 1e-5
+        assert len(set(np.asarray(labels).tolist())) == 16
+
+    def test_empty_clusters_keep_centroid_finite(self):
+        """k far above the number of distinct points: empty clusters must
+        not produce NaN/inf centroids (the 0/0 update), and occupied
+        clusters must sit on the duplicated points."""
+        x = np.repeat(np.array([[0., 0.], [10., 10.]], np.float32),
+                      8, axis=0)
+        c, inertia, labels, _ = self._fit(x, k=6)
+        c = np.asarray(c)
+        assert np.all(np.isfinite(c))
+        assert float(inertia) < 1e-6
+        # both distinct locations are represented
+        d0 = np.abs(c - np.array([0., 0.])).sum(1).min()
+        d1 = np.abs(c - np.array([10., 10.])).sum(1).min()
+        assert d0 < 1e-4 and d1 < 1e-4
+
+    def test_single_cluster(self):
+        rng = np.random.default_rng(23)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        c, inertia, labels, _ = self._fit(x, k=1)
+        np.testing.assert_allclose(np.asarray(c)[0], x.mean(0), rtol=1e-4,
+                                   atol=1e-4)
+        assert set(np.asarray(labels).tolist()) == {0}

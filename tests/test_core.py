@@ -188,9 +188,16 @@ class TestSparseTypes:
         rng = np.random.default_rng(0)
         m = sp.random(20, 30, density=0.2, random_state=rng, format="csr")
         ours = CSRMatrix.from_scipy(m)
-        assert ours.nnz == m.nnz
+        # nnz bucketing (default on): physical nnz is the size class,
+        # indptr[-1] keeps the logical count; scipy roundtrip is exact
+        assert ours.logical_nnz() == m.nnz
+        from raft_tpu.core.sparse_types import nnz_bucket
+        assert ours.nnz == nnz_bucket(m.nnz)
         back = ours.to_scipy()
+        assert back.nnz == m.nnz
         assert (abs(back - m)).max() < 1e-12
+        unpadded = CSRMatrix.from_scipy(m, pad=False)
+        assert unpadded.nnz == m.nnz
 
     def test_coo_roundtrip_and_pytree(self):
         coo = COOMatrix(jnp.array([0, 1]), jnp.array([2, 0]),

@@ -215,3 +215,42 @@ class TestShardMapCompiled:
         assert "tpu_custom_call" in hlo
         new_c, inertia, labels = step(x, c)
         assert np.isfinite(float(inertia))
+
+
+class TestAdversarialOnChip:
+    """Promoted adversarial cases (round-3; full tier in
+    tests/test_adversarial.py): NaN/inf total-order and duplicate ties
+    must hold through the REAL XLA:TPU sort, not just the CPU emulator,
+    and low-precision select_k must survive TPU layouts."""
+
+    def test_select_k_nan_inf_total_order(self):
+        from raft_tpu.matrix import select_k
+
+        x = np.array([[4., np.nan, 1., 2., np.inf, -np.inf]], np.float32)
+        v, i = select_k(None, x, k=3, select_min=True)
+        assert np.asarray(v).tolist() == [[-np.inf, 1.0, 2.0]]
+        v, i = select_k(None, x, k=2, select_min=False)
+        out = np.asarray(v)[0]
+        assert np.isnan(out[0]) and out[1] == np.inf
+
+    def test_select_k_duplicate_ties_tiled(self):
+        from raft_tpu.matrix import SelectAlgo, select_k
+
+        wide = np.full((2, 20_000), 3.0, np.float32)
+        wide[:, 777] = 1.0
+        wide[:, 778] = 1.0
+        v, i = select_k(None, wide, k=3, select_min=True,
+                        algo=SelectAlgo.RADIX_11BITS)
+        assert np.asarray(i).tolist() == [[777, 778, 0]] * 2
+
+    def test_select_k_low_precision_dtypes(self, rng):
+        from raft_tpu.matrix import select_k
+
+        xh = rng.normal(size=(4, 600)).astype(np.float16)
+        v, _ = select_k(None, xh, k=7, select_min=True)
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.sort(xh, 1)[:, :7])
+        xi = rng.integers(-120, 120, size=(4, 600)).astype(np.int8)
+        v, _ = select_k(None, xi, k=7, select_min=False)
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.sort(xi, 1)[:, ::-1][:, :7])
