@@ -1,0 +1,131 @@
+"""North-star tuning sweep (round-3 verdict item 3): run the fused Lloyd
+kernel at its measured frontier and record WHERE the time goes.
+
+Sweeps, at the BASELINE config-3 shape (1M×128 f32, k=1024, one chip):
+- tm ∈ {128, 256, 512, 1024} at the default tier (round-2 sweep measured
+  tm=256 fastest at the single-pass tier; this pins it at tier 'high');
+- precision tiers at the chosen tm (MXU-pass scaling: 2/5/2+ passes per
+  iteration — if 'default'≈'high' the kernel is epilogue/VPU-bound, not
+  MXU-bound);
+- host-loop vs lax.scan iteration (the round-2 3× scan regression), and a
+  single-step sync time so tunnel dispatch overhead is separable.
+
+One JSON line per case → ci/tpu_battery.sh redirects to
+tpu_battery_out/northstar_tune.jsonl. Ref anchor for the exercise:
+linalg/detail/contractions.cuh:16-309 (the reference tunes its
+Policy<> tile templates per arch the same way, offline).
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.cluster.kmeans import lloyd_step
+    from raft_tpu.linalg.contractions import fused_lloyd_pallas
+    from raft_tpu.util import precision as prec
+
+    on_tpu = jax.default_backend() == "tpu"
+    m, k, n_clusters = (1_000_000, 128, 1024) if on_tpu else (20_000, 64,
+                                                              256)
+    iters = 30 if on_tpu else 3
+    kx, kc = jax.random.split(jax.random.key(0))
+    x = jax.random.normal(kx, (m, k), jnp.float32)
+    c = jax.random.normal(kc, (n_clusters, k), jnp.float32)
+    jax.block_until_ready((x, c))
+
+    def sync(v):
+        jax.device_get(jnp.ravel(v)[0])
+
+    def emit(**kw):
+        print(json.dumps({"bench": "cluster/northstar_tune", **kw}),
+              flush=True)
+
+    def time_loop(fn, n_iter):
+        out = fn()
+        sync(out[0])                       # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(n_iter):
+            out = fn()
+        sync(out[0])
+        return (time.perf_counter() - t0) / n_iter * 1e3
+
+    # -- tm sweep at the default tier ------------------------------------
+    for tm in (128, 256, 512, 1024):
+        f = jax.jit(functools.partial(fused_lloyd_pallas, tm=tm))
+        try:
+            ms = time_loop(lambda: f(x, c), iters)
+            emit(case="tm_sweep", tm=tm, tier=prec.get_matmul_precision(),
+                 ms_per_iter=round(ms, 3))
+        except Exception as e:   # noqa: BLE001 — record, keep sweeping
+            emit(case="tm_sweep", tm=tm, error=f"{type(e).__name__}: {e}"[:200])
+
+    # -- tier sweep at auto tm -------------------------------------------
+    old = prec.get_matmul_precision()
+    step = functools.partial(lloyd_step, n_clusters=n_clusters)
+    try:
+        for tier in ("default", "high", "highest"):
+            try:
+                prec.set_matmul_precision(tier)
+                g = jax.jit(step)
+                ms = time_loop(lambda: g(x, c), iters)
+                emit(case="tier_sweep", tier=tier,
+                     ms_per_iter=round(ms, 3),
+                     iters_per_s=round(1e3 / ms, 2))
+            except Exception as e:   # noqa: BLE001 — keep sweeping
+                emit(case="tier_sweep", tier=tier,
+                     error=f"{type(e).__name__}: {e}"[:200])
+    finally:
+        prec.set_matmul_precision(old)
+
+    # -- dispatch overhead: 1-step sync vs amortized loop ----------------
+    g = jax.jit(step)
+    try:
+        out = g(x, c)
+        sync(out[0])
+        t0 = time.perf_counter()
+        out = g(x, c)
+        sync(out[0])
+        single = (time.perf_counter() - t0) * 1e3
+        amort = time_loop(lambda: g(x, c), iters)
+        emit(case="dispatch_overhead", single_step_ms=round(single, 3),
+             amortized_ms=round(amort, 3),
+             overhead_ms=round(max(single - amort, 0.0), 3))
+    except Exception as e:   # noqa: BLE001
+        amort = float("nan")
+        emit(case="dispatch_overhead",
+             error=f"{type(e).__name__}: {e}"[:200])
+
+    # -- host loop vs lax.scan (the 3x restaging regression) -------------
+    def scan_iters(x, c, n_iter):
+        def body(cc, _):
+            nc, inertia, _ = step(x, cc)
+            return nc, inertia
+        cc, inertias = jax.lax.scan(body, c, None, length=n_iter)
+        return cc, inertias
+
+    s = jax.jit(functools.partial(scan_iters, n_iter=iters))
+    try:
+        cc, _ = s(x, c)
+        sync(cc)
+        t0 = time.perf_counter()
+        cc, _ = s(x, c)
+        sync(cc)
+        scan_ms = (time.perf_counter() - t0) / iters * 1e3
+        emit(case="scan_vs_loop", scan_ms_per_iter=round(scan_ms, 3),
+             loop_ms_per_iter=round(amort, 3))
+    except Exception as e:   # noqa: BLE001
+        emit(case="scan_vs_loop", error=f"{type(e).__name__}: {e}"[:200])
+
+
+if __name__ == "__main__":
+    main()

@@ -92,6 +92,21 @@ else
     echo "[battery] smoke already green at $HEAD_SHA; skipping"
 fi
 
+# north-star tuning sweep (tm × tier × scan-vs-loop × dispatch overhead):
+# the decision data for contraction defaults — once per code state
+if [ "$(cat tpu_battery_out/tune_done 2>/dev/null)" != "$HEAD_SHA" ]; then
+    echo "[battery] running north-star tuning sweep"
+    timeout 1500 python benches/tune_northstar.py \
+        > tpu_battery_out/northstar_tune.jsonl \
+        2>> tpu_battery_out/northstar_tune.err
+    rc=$?
+    echo "[battery] tune rc=$rc"
+    tail -9 tpu_battery_out/northstar_tune.jsonl
+    [ "$rc" = 0 ] && echo "$HEAD_SHA" > tpu_battery_out/tune_done
+else
+    echo "[battery] tune already recorded at $HEAD_SHA; skipping"
+fi
+
 echo "[battery] running full bench sweep (per-family processes)"
 # decision-bearing families first (they gate standing design choices:
 # select_k thresholds, ELL auto-select, segment-spmv, north-star shape),

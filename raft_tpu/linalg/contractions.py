@@ -218,6 +218,16 @@ def _lloyd_jnp(x, y):
     return sums, counts, val, idx
 
 
+def _tm_fits(tm: int, kp: int, np_: int, mn_bufs: int, const_bytes: int,
+             itemsize: int = 4) -> bool:
+    """Whether an EXPLICIT row-tile request fits the VMEM budget (the
+    companion to _pick_tm for caller-supplied tm: clamping a request with
+    min() against _pick_tm's PREFERENCE would silently cap every request
+    at 256 and mislabel tuning-sweep rows)."""
+    need = const_bytes + 2 * tm * kp * itemsize + mn_bufs * tm * np_ * 4
+    return need <= _VMEM_BUDGET
+
+
 def _pick_tm(kp: int, np_: int, mn_bufs: int, const_bytes: int,
              itemsize: int = 4) -> Optional[int]:
     """Largest row-tile that keeps the kernel working set under budget.
@@ -630,7 +640,13 @@ def fused_argmin_pallas(x, y, metric: str = "l2",
                        itemsize=isz)
     split = _use_split(x, y)
     if auto_tm is not None:
-        tm_ = min(tm or auto_tm, auto_tm)
+        # same explicit-tm contract as fused_lloyd_pallas: honor a
+        # request that fits VMEM, fall back to auto otherwise
+        if tm is not None and _tm_fits(tm, kp, np_, 2, np_ * kp * isz,
+                                       isz):
+            tm_ = tm
+        else:
+            tm_ = auto_tm
         tm_ = max(8, round_up_to_multiple(min(tm_, m), 8))
         mp = round_up_to_multiple(m, tm_)
         if split:
@@ -806,8 +822,9 @@ def _fused_lloyd_padded(x, y, tm: int, n_valid: int, m_valid: int):
 
 
 @with_matmul_precision
-def fused_lloyd_pallas(x, y) -> Tuple[jnp.ndarray, jnp.ndarray,
-                                      jnp.ndarray, jnp.ndarray]:
+def fused_lloyd_pallas(x, y, tm: Optional[int] = None
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                  jnp.ndarray, jnp.ndarray]:
     """One full Lloyd iteration's data pass, fused into a single kernel.
 
     Returns ``(sums [n, k] f32, counts [n] f32, min_dist² [m] f32,
@@ -831,7 +848,14 @@ def fused_lloyd_pallas(x, y) -> Tuple[jnp.ndarray, jnp.ndarray,
     np_ = round_up_to_multiple(n, 128)
     isz = jnp.dtype(x.dtype).itemsize
     const = np_ * kp * (isz + 4) + 4 * np_          # y + sums + counts
-    tm = _pick_tm(kp, np_, mn_bufs=2, const_bytes=const, itemsize=isz)
+    auto_tm = _pick_tm(kp, np_, mn_bufs=2, const_bytes=const, itemsize=isz)
+    # explicit tm (the tuning sweep's knob) is honored whenever it fits
+    # VMEM — NOT min()'d against the preference order, which would cap
+    # every request at the preferred 256; unsafe requests fall back to auto
+    if tm is None:
+        tm = auto_tm
+    elif auto_tm is None or not _tm_fits(tm, kp, np_, 2, const, isz):
+        tm = auto_tm
     if tm is None:
         # Y (+ sums) exceed VMEM: fused argmin kernel, then a CHUNKED
         # one-hot update so the m×n one-hot never materializes in HBM.

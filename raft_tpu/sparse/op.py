@@ -75,7 +75,17 @@ def reduce_duplicates(coo: COOMatrix,
 def csr_row_op(csr: CSRMatrix, fn) -> jnp.ndarray:
     """Apply `fn(row_id, values_segment)` conceptually per row; here realized
     as a vectorized map over (row_ids, data) (ref: sparse/op/row_op.cuh
-    `csr_row_op` hands each row's [start, stop) to a device lambda)."""
+    `csr_row_op` hands each row's [start, stop) to a device lambda).
+
+    ``fn`` receives ONLY logical entries: nnz-bucketing pad slots are
+    sliced off eagerly (an arbitrary user fn — counts, min-reductions,
+    means — can't be pad-masked generically). Under jit tracing the slice
+    is impossible; there the caller must pass an unpadded matrix
+    (``csr.depad()`` before the jit boundary)."""
+    import jax as _jax
+
+    if not isinstance(csr.indptr, _jax.core.Tracer):
+        csr = csr.depad()
     row_ids = csr.row_ids()
     return fn(row_ids, csr.data)
 
