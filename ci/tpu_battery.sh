@@ -135,13 +135,21 @@ for fam in $PRIORITY $REST; do
         # new tunnel window: the driver artifact is the priority measurement
         refresh_northstar
     fi
-    echo "[battery] run $fam $(date +%H:%M:%S)"
+    # heavy families (graph generation + many compiles) get a bigger
+    # budget — in round 2 these were exactly the ones rc=124'd
+    case "$fam" in
+        sparse/lanczos|sparse/mst|sparse/spmv_large|\
+        matrix/select_k_large|matrix/select_k|neighbors/brute_force)
+            BUDGET=900 ;;
+        *)  BUDGET=420 ;;
+    esac
+    echo "[battery] run $fam (budget ${BUDGET}s) $(date +%H:%M:%S)"
     # per-family tmp file: completed families append clean; a timed-out
     # family's completed cases still land, annotated "partial": true, so
     # a later rerun's full rows are distinguishable from the stale window
     FTMP="tpu_battery_out/.fam.$(echo "$fam" | tr / _).tmp"
-    timeout 420 python benches/run_benches.py --size full --family "$fam" \
-        2>>"$ERR" | grep -v '^#' > "$FTMP"
+    timeout "$BUDGET" python benches/run_benches.py --size full \
+        --family "$fam" 2>>"$ERR" | grep -v '^#' > "$FTMP"
     rc=${PIPESTATUS[0]}   # the runner's status, not grep's (a family that
                           # legitimately emits zero rows must still get
                           # its family_done marker under pipefail)
