@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Derive select_k dispatch thresholds from the hardware tournament.
+
+Reads matrix/select_k* rows from a bench JSONL (direct vs tiled per
+(len, k) cell), prints the winner map + a recommended `_choose_tiled`
+predicate, and flags cells where `lax.top_k` (direct) falls below the
+bandwidth roofline — the explicit evidence gate the design note in
+raft_tpu/matrix/select_k.py names for ever writing a Pallas radix
+kernel (ref heuristic being replaced: detail/select_k-inl.cuh:38-63).
+
+Usage: python ci/derive_select_k.py tpu_battery_out/bench_full.jsonl
+"""
+
+import json
+import sys
+from collections import defaultdict
+
+HBM_GB_S = 819.0     # v5e
+
+
+def main(path):
+    cells = defaultdict(dict)    # (length, k) -> {algo: row}
+    for line in open(path):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue
+        name = r.get("bench", "")
+        if not name.startswith("matrix/select_k_len"):
+            continue
+        if r.get("partial"):
+            continue
+        cells[(r["length"], r["k"])][r["algo"]] = r
+
+    if not cells:
+        print("(no select_k tournament rows found)")
+        return
+
+    print(f"{'len':>9} {'k':>6} {'direct ms':>10} {'tiled ms':>9} "
+          f"{'winner':>7} {'direct GB/s':>12} {'hbm frac':>9}")
+    tiled_wins = []
+    for (length, k), algos in sorted(cells.items()):
+        d = algos.get("direct")
+        t = algos.get("tiled")
+        if not d or not t:
+            continue
+        dm, tm = d["median_ms"], t["median_ms"]
+        win = "tiled" if tm < dm else "direct"
+        if win == "tiled":
+            tiled_wins.append((length, k, dm / tm))
+        # the selection streams batch*len f32 once: the bandwidth floor
+        gbs = d["batch"] * length * 4 / (dm / 1e3) / 1e9
+        print(f"{length:>9} {k:>6} {dm:>10.2f} {tm:>9.2f} {win:>7} "
+              f"{gbs:>12.1f} {gbs / HBM_GB_S:>9.2f}")
+
+    print()
+    if tiled_wins:
+        min_len = min(w[0] for w in tiled_wins)
+        max_k = max(w[1] for w in tiled_wins)
+        print(f"tiled wins at: {tiled_wins}")
+        print(f"recommended _choose_tiled: n_cols >= {min_len} and "
+              f"k <= {max_k}")
+    else:
+        print("direct (lax.top_k) wins every cell: "
+              "_choose_tiled should return False everywhere measured")
+    print("\nPallas-radix gate: any cell with winner-side hbm frac well "
+          "below ~0.5 at len >= 64k is evidence lax.top_k leaves "
+          "bandwidth on the table (see select_k.py design note).")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else
+         "tpu_battery_out/bench_full.jsonl")
