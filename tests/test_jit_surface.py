@@ -187,3 +187,37 @@ def _nullcontext():
     import contextlib
 
     return contextlib.nullcontext()
+
+
+class TestSparsePaddingJit:
+    """Round-3 API additions must hold the jit surface: padded CSR
+    matrices as pytree args, the tm override on the fused kernels."""
+
+    def test_padded_csr_ops_compile(self):
+        import scipy.sparse as sp
+
+        from raft_tpu.core.sparse_types import CSRMatrix
+        from raft_tpu.sparse.linalg import csr_row_norm, spmm, spmv
+
+        a = sp.random(64, 64, density=0.1, random_state=3,
+                      format="csr").astype(np.float32)
+        csr = CSRMatrix.from_scipy(a)          # padded by default
+        x = jnp.asarray(np.random.default_rng(4).normal(size=64)
+                        .astype(np.float32))
+        b = jnp.asarray(np.random.default_rng(5).normal(size=(64, 4))
+                        .astype(np.float32))
+        _compiles(spmv, csr, x)
+        _compiles(spmm, csr, b)
+        _compiles(csr_row_norm, csr)
+
+    def test_fused_kernels_tm_override_compile(self, x64):
+        from raft_tpu.linalg.contractions import (fused_l2_argmin_pallas,
+                                                  fused_lloyd_pallas)
+
+        y = jnp.asarray(np.random.default_rng(6).normal(size=(8, 16))
+                        .astype(np.float32))
+        for tm in (None, 16, 1 << 20):      # oversized falls back to auto
+            _compiles(functools.partial(fused_lloyd_pallas, tm=tm),
+                      jnp.asarray(x64), y)
+            _compiles(functools.partial(fused_l2_argmin_pallas, tm=tm),
+                      jnp.asarray(x64), y)
