@@ -69,6 +69,25 @@ def main():
         except Exception as e:   # noqa: BLE001 — record, keep sweeping
             emit(case="tm_sweep", tm=tm, error=f"{type(e).__name__}: {e}"[:200])
 
+    # -- packed vs 3-dot bf16x3 spelling, PINNED to tier 'high' ----------
+    # (the packed knob only exists on the split kernels — at any other
+    # tier fused_lloyd_pallas ignores it and this would be an A/A run)
+    old = prec.get_matmul_precision()
+    try:
+        prec.set_matmul_precision("high")
+        for packed in (False, True):
+            f = jax.jit(functools.partial(fused_lloyd_pallas,
+                                          packed=packed))
+            try:
+                ms = time_loop(lambda: f(x, c), iters)
+                emit(case="packed_split", packed=packed, tier="high",
+                     ms_per_iter=round(ms, 3))
+            except Exception as e:   # noqa: BLE001
+                emit(case="packed_split", packed=packed,
+                     error=f"{type(e).__name__}: {e}"[:200])
+    finally:
+        prec.set_matmul_precision(old)
+
     # -- tier sweep at auto tm -------------------------------------------
     old = prec.get_matmul_precision()
     step = functools.partial(lloyd_step, n_clusters=n_clusters)

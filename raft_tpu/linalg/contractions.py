@@ -142,10 +142,38 @@ def _use_split(*arrays) -> bool:
 _ONE_PASS = jax.lax.Precision.DEFAULT            # bf16 multiply is exact
 
 
-def _cross_split(xh, xl, yh_t, yl_t):
+def _packed_split_default() -> bool:
+    """Opt-in default for the depth-packed bf16x3 spelling
+    (``RAFT_TPU_SPLIT_PACKED=1``), threaded into the kernels as a STATIC
+    jit argument. CAVEAT: the env is read when fused_lloyd_pallas runs —
+    if a caller wraps it in its own jax.jit (lloyd_step does), the read
+    happens at that trace and is NOT in the outer cache key, so flipping
+    the env mid-process reuses the stale executable. Callers that need
+    to vary the spelling at runtime must pass ``packed=`` explicitly
+    (what benches/tune_northstar.py does); the env var is a process-level
+    default, set before first use."""
+    import os
+
+    return os.environ.get("RAFT_TPU_SPLIT_PACKED", "0") not in ("0", "")
+
+
+def _cross_split(xh, xl, yh_t, yl_t, packed: bool = False):
     """x·yᵀ from pre-split bf16 halves: hi·hi + hi·lo + lo·hi (the bf16x3
-    decomposition; the dropped lo·lo term is ~2^-34 relative)."""
+    decomposition; the dropped lo·lo term is ~2^-34 relative).
+
+    ``packed``: concatenate the three dots along the CONTRACTION dim into
+    one 3k-deep dot — the same three product sets and FLOPs, but one dot
+    dispatch instead of three plus two (tm × np_) f32 VPU adds, which may
+    pipeline better at small k. The f32 accumulation ORDER differs (one
+    running sum across 3k vs per-dot totals then adds), so results agree
+    to ~1 ulp, not bitwise. Benched by benches/tune_northstar.py; becomes
+    the default only if hardware data says so."""
     f32 = jnp.float32
+    if packed:
+        xcat = jnp.concatenate([xh, xh, xl], axis=1)        # (tm, 3k)
+        ycat = jnp.concatenate([yh_t, yl_t, yh_t], axis=0)  # (3k, np_)
+        return jnp.dot(xcat, ycat, preferred_element_type=f32,
+                       precision=_ONE_PASS)
     return (jnp.dot(xh, yh_t, preferred_element_type=f32,
                     precision=_ONE_PASS)
             + jnp.dot(xh, yl_t, preferred_element_type=f32,
@@ -154,11 +182,12 @@ def _cross_split(xh, xl, yh_t, yl_t):
                       precision=_ONE_PASS))
 
 
-def _metric_tile_split(xh, xl, xn, yh, yl, yn, metric: str):
+def _metric_tile_split(xh, xl, xn, yh, yl, yn, metric: str,
+                       packed: bool = False):
     """Split-operand twin of :func:`_metric_tile`. ``xn`` (tm, 1) and
     ``yn`` (1, np_) are squared norms precomputed OUTSIDE in full f32 —
     more accurate than the in-kernel recompute they replace."""
-    cross = _cross_split(xh, xl, yh.T, yl.T)
+    cross = _cross_split(xh, xl, yh.T, yl.T, packed=packed)
     if metric == "l2":
         return xn - 2.0 * cross + yn
     if metric == "cosine":
@@ -183,9 +212,10 @@ def _mask_argmin(d, n_valid: int):
 
 
 def _distance_tile_split(xh, xl, xn, yh, yl, yn, n_valid: int,
-                         metric: str = "l2"):
+                         metric: str = "l2", packed: bool = False):
     return _mask_argmin(
-        _metric_tile_split(xh, xl, xn, yh, yl, yn, metric), n_valid)
+        _metric_tile_split(xh, xl, xn, yh, yl, yn, metric, packed=packed),
+        n_valid)
 
 
 def _sq_norms(a):
@@ -709,7 +739,8 @@ def _lloyd_kernel(x_ref, y_ref, sums_ref, counts_ref, val_ref, idx_ref, *,
 
 def _lloyd_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
                         sums_ref, counts_ref, val_ref, idx_ref, *,
-                        tm: int, n_valid: int, m_valid: int):
+                        tm: int, n_valid: int, m_valid: int,
+                        packed: bool = False):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -719,32 +750,41 @@ def _lloyd_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
 
     col, minval, arg = _distance_tile_split(
         xh_ref[:], xl_ref[:], xn_ref[:].T, yh_ref[:], yl_ref[:],
-        yn_ref[:], n_valid)
+        yn_ref[:], n_valid, packed=packed)
     val_ref[:] = jnp.maximum(minval, 0.0).T
     idx_ref[:] = arg.T
 
     row = jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0) + i * tm
     # one-hot is exact in bf16; X arrives pre-split, so the 'high'-tier
-    # update is two one-pass MXU dots against the hi/lo halves
+    # update is two one-pass MXU dots against the hi/lo halves — or one
+    # depth-packed 2tm-deep dot when ``packed`` (see _cross_split)
     ohb = ((col == arg) & (row < m_valid)).astype(jnp.bfloat16)
     f32 = jnp.float32
-    sums_ref[:] += (jnp.dot(ohb.T, xh_ref[:], preferred_element_type=f32,
-                            precision=_ONE_PASS)
-                    + jnp.dot(ohb.T, xl_ref[:],
-                              preferred_element_type=f32,
-                              precision=_ONE_PASS))
+    if packed:
+        ohcat = jnp.concatenate([ohb.T, ohb.T], axis=1)     # (np_, 2tm)
+        xcat = jnp.concatenate([xh_ref[:], xl_ref[:]], axis=0)
+        sums_ref[:] += jnp.dot(ohcat, xcat, preferred_element_type=f32,
+                               precision=_ONE_PASS)
+    else:
+        sums_ref[:] += (jnp.dot(ohb.T, xh_ref[:],
+                                preferred_element_type=f32,
+                                precision=_ONE_PASS)
+                        + jnp.dot(ohb.T, xl_ref[:],
+                                  preferred_element_type=f32,
+                                  precision=_ONE_PASS))
     counts_ref[:] += jnp.sum(ohb.astype(f32), axis=0, keepdims=True)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("tm", "n_valid", "m_valid"))
+                   static_argnames=("tm", "n_valid", "m_valid", "packed"))
 def _fused_lloyd_padded_split(xh, xl, xn, yh, yl, yn, tm: int,
-                              n_valid: int, m_valid: int):
+                              n_valid: int, m_valid: int,
+                              packed: bool = False):
     m, kp = xh.shape
     np_ = yh.shape[0]
     vma, (xh, xl, xn, yh, yl, yn) = join_vma(xh, xl, xn, yh, yl, yn)
     kernel = functools.partial(_lloyd_kernel_split, tm=tm, n_valid=n_valid,
-                               m_valid=m_valid)
+                               m_valid=m_valid, packed=packed)
     return pallas_call(
         kernel,
         grid=(m // tm,),
@@ -822,7 +862,8 @@ def _fused_lloyd_padded(x, y, tm: int, n_valid: int, m_valid: int):
 
 
 @with_matmul_precision
-def fused_lloyd_pallas(x, y, tm: Optional[int] = None
+def fused_lloyd_pallas(x, y, tm: Optional[int] = None,
+                       packed: Optional[bool] = None
                        ) -> Tuple[jnp.ndarray, jnp.ndarray,
                                   jnp.ndarray, jnp.ndarray]:
     """One full Lloyd iteration's data pass, fused into a single kernel.
@@ -836,6 +877,11 @@ def fused_lloyd_pallas(x, y, tm: Optional[int] = None
     Requires Y (+ the [n, k] sums accumulator) to fit in VMEM; larger
     problems fall back to :func:`fused_l2_argmin_pallas` + an XLA one-hot
     matmul (still scatter-free).
+
+    ``packed`` selects the depth-packed bf16x3 spelling and applies ONLY
+    on the tier-'high' split resident path — it is (deliberately, without
+    warning) a no-op at other tiers, for bf16 inputs, and on the VMEM
+    fallback, all of which have no split dots to pack.
     """
     x = jnp.asarray(x)
     y = jnp.asarray(y)
@@ -882,7 +928,9 @@ def fused_lloyd_pallas(x, y, tm: Optional[int] = None
     mp = round_up_to_multiple(m, tm)
     if _use_split(x, y):
         sums, counts, val, idx = _fused_lloyd_padded_split(
-            *_split_operands(x, y, mp, np_, kp), tm, n, m)
+            *_split_operands(x, y, mp, np_, kp), tm, n, m,
+            packed=(_packed_split_default() if packed is None
+                    else bool(packed)))
     else:
         sums, counts, val, idx = _fused_lloyd_padded(
             _pad2(x, mp, kp), _pad2(y, np_, kp), tm, n, m)

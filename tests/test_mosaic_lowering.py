@@ -88,3 +88,23 @@ def test_kernels_lower_for_tpu(tier, kernel, xy):
     finally:
         raft_tpu.set_matmul_precision(old)
         jax.config.update("jax_default_matmul_precision", None)
+
+
+def test_packed_split_lowers_for_tpu(xy):
+    """The depth-packed bf16x3 Lloyd variant concatenates operands along
+    the contraction dim INSIDE the kernel — that concat must have a
+    Mosaic lowering (the whole point of this tier: no chip needed to
+    catch it)."""
+    import functools
+
+    from raft_tpu.linalg.contractions import fused_lloyd_pallas
+
+    x, y = xy
+    old = raft_tpu.get_matmul_precision()
+    try:
+        raft_tpu.set_matmul_precision("high")
+        _lowers_with_mosaic(functools.partial(fused_lloyd_pallas, x, y,
+                                              packed=True))
+    finally:
+        raft_tpu.set_matmul_precision(old)
+        jax.config.update("jax_default_matmul_precision", None)

@@ -167,3 +167,20 @@ def test_mixed_dtype_keeps_f32_operand_precision(restore_policy):
     prec.set_matmul_precision("highest")
     ps = _dot_precisions(_kernel_dot, jnp.asarray(a), b16)
     assert ps == [(jax.lax.Precision.HIGHEST,) * 2], ps
+
+
+def test_packed_split_exact_equivalence(restore_policy):
+    """The depth-packed bf16x3 spelling must be numerically IDENTICAL to
+    the 3-dot spelling (same products, same f32 accumulation targets) —
+    it is a scheduling variant, not an accuracy tier."""
+    from raft_tpu.linalg.contractions import fused_lloyd_pallas
+
+    rng = np.random.default_rng(31)
+    x = jnp.asarray(rng.normal(size=(96, 40)).astype(np.float32))
+    c = jnp.asarray(rng.normal(size=(16, 40)).astype(np.float32))
+    prec.set_matmul_precision("high")
+    ref = fused_lloyd_pallas(x, c, packed=False)
+    got = fused_lloyd_pallas(x, c, packed=True)
+    for a, b, name in zip(ref, got, ("sums", "counts", "dist", "labels")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6, err_msg=name)
