@@ -1,6 +1,7 @@
 """Brute-force k-NN tests vs sklearn/numpy oracles (ref lineage:
 cuvs::neighbors::brute_force built from this primitives layer)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -114,3 +115,63 @@ class TestBruteForceKnn:
         _, idx = knn(None, db, queries.astype(np.float32), k=10, tile=128)
         blob_of = np.asarray(idx) // 50
         assert (blob_of == np.arange(5)[:, None]).all()
+
+
+class TestKnnAdversarial:
+    """Edge cases for the streaming brute-force path (round-3 depth:
+    k == n_db, single query/row, duplicate points — tie rule, bf16
+    inputs, non-tile-multiple database sizes). Order comparisons follow
+    the file convention: compare achieved DISTANCES, not exact index
+    order (f32 near-ties swap across precision tiers/backends)."""
+
+    def test_k_equals_db_size(self, rng):
+        db = rng.normal(size=(37, 8)).astype(np.float32)
+        q = rng.normal(size=(3, 8)).astype(np.float32)
+        d, i = knn(None, db, q, k=37)
+        ref = np.sort(((q[:, None].astype(np.float64)
+                        - db[None].astype(np.float64)) ** 2).sum(-1), 1)
+        # every db row present exactly once, distances sorted + correct
+        assert all(sorted(r) == list(range(37))
+                   for r in np.asarray(i).tolist())
+        np.testing.assert_allclose(np.asarray(d), ref, rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_single_query_single_db_row(self, rng):
+        db = np.array([[1., 2., 3.]], np.float32)
+        q = np.array([[1., 2., 3.]], np.float32)
+        d, i = knn(None, db, q, k=1)
+        assert np.asarray(i).tolist() == [[0]]
+        assert float(np.asarray(d)[0, 0]) < 1e-5
+
+    def test_duplicate_points_tie_to_lower_index(self, rng):
+        row = rng.normal(size=(1, 16)).astype(np.float32)
+        db = np.concatenate([row] * 5 + [row + 10.0], axis=0)
+        d, i = knn(None, db, row, k=5)
+        # five BIT-IDENTICAL distances -> ascending db indices (KVP rule)
+        assert np.asarray(i).tolist() == [[0, 1, 2, 3, 4]]
+
+    def test_bf16_database(self, rng):
+        db = rng.normal(size=(256, 32)).astype(np.float32)
+        q = db[:8] + 1e-3
+        d32, i32 = knn(None, db, q, k=5)
+        d16, i16 = knn(None, jnp.asarray(db, jnp.bfloat16), q, k=5)
+        # bf16 storage: nearest-neighbor agreement stays high (the true
+        # NN is ~4 orders of magnitude closer than the runner-up)
+        agree = (np.asarray(i16)[:, 0] == np.asarray(i32)[:, 0]).mean()
+        assert agree == 1.0
+
+    def test_odd_db_size_vs_tile(self, rng):
+        db = rng.normal(size=(1003, 8)).astype(np.float32)
+        q = rng.normal(size=(9, 8)).astype(np.float32)
+        d, i = knn(None, db, q, k=7, tile=256)    # 1003 = 3*256 + 235
+        ref = np.sort(((q[:, None].astype(np.float64)
+                        - db[None].astype(np.float64)) ** 2).sum(-1),
+                      1)[:, :7]
+        np.testing.assert_allclose(np.asarray(d), ref, rtol=1e-3,
+                                   atol=1e-3)
+        # indices address rows whose true distance matches the claimed one
+        true_d = np.take_along_axis(
+            ((q[:, None].astype(np.float64)
+              - db[None].astype(np.float64)) ** 2).sum(-1),
+            np.asarray(i), axis=1)
+        np.testing.assert_allclose(true_d, ref, rtol=1e-3, atol=1e-3)
