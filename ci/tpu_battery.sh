@@ -19,7 +19,7 @@ ERR=tpu_battery_out/bench_full.err
 touch "$OUT"
 
 probe() {
-    timeout 240 python -c "import jax; assert jax.default_backend()=='tpu'" \
+    timeout -k 15 240 python -c "import jax; assert jax.default_backend()=='tpu'" \
         >/dev/null 2>&1
 }
 
@@ -41,7 +41,7 @@ wait_for_tpu() {
 # goes to its own log — round 2 mixed it into the artifact.
 refresh_northstar() {
     echo "[battery] refreshing north-star artifact $(date +%H:%M:%S)"
-    timeout 900 python bench.py \
+    timeout -k 30 900 python bench.py \
         > tpu_battery_out/bench_northstar.tmp \
         2>> tpu_battery_out/bench_northstar.err
     rc=$?
@@ -82,7 +82,7 @@ HEAD_SHA="$(git rev-parse HEAD 2>/dev/null || echo unknown)-$(
     | sha1sum | cut -c1-12)"   # battery's own output mutations excluded
 if [ "$(cat tpu_battery_out/smoke_green 2>/dev/null)" != "$HEAD_SHA" ]; then
     echo "[battery] running tpu_tests smoke tier (HEAD $HEAD_SHA)"
-    timeout 1800 python -m pytest tpu_tests -q \
+    timeout -k 30 1800 python -m pytest tpu_tests -q \
         > tpu_battery_out/tpu_smoke.txt 2>&1
     rc=$?
     echo "[battery] smoke rc=$rc (tail below)"
@@ -96,7 +96,7 @@ fi
 # the decision data for contraction defaults — once per code state
 if [ "$(cat tpu_battery_out/tune_done 2>/dev/null)" != "$HEAD_SHA" ]; then
     echo "[battery] running north-star tuning sweep"
-    timeout 1500 python benches/tune_northstar.py \
+    timeout -k 30 1500 python benches/tune_northstar.py \
         > tpu_battery_out/northstar_tune.jsonl \
         2>> tpu_battery_out/northstar_tune.err
     rc=$?
@@ -148,7 +148,7 @@ for fam in $PRIORITY $REST; do
     # family's completed cases still land, annotated "partial": true, so
     # a later rerun's full rows are distinguishable from the stale window
     FTMP="tpu_battery_out/.fam.$(echo "$fam" | tr / _).tmp"
-    timeout "$BUDGET" python benches/run_benches.py --size full \
+    timeout -k 30 "$BUDGET" python benches/run_benches.py --size full \
         --family "$fam" 2>>"$ERR" | grep -v '^#' > "$FTMP"
     rc=${PIPESTATUS[0]}   # the runner's status, not grep's (a family that
                           # legitimately emits zero rows must still get
