@@ -145,8 +145,9 @@ _ONE_PASS = jax.lax.Precision.DEFAULT            # bf16 multiply is exact
 def _packed_split_default() -> bool:
     """Opt-in default for the depth-packed bf16x3 spelling
     (``RAFT_TPU_SPLIT_PACKED=1``), threaded into the kernels as a STATIC
-    jit argument. CAVEAT: the env is read when fused_lloyd_pallas runs —
-    if a caller wraps it in its own jax.jit (lloyd_step does), the read
+    jit argument. CAVEAT: the env is read when the kernel entries
+    (fused_lloyd_pallas / fused_argmin_pallas) run — if a caller wraps
+    them in its own jax.jit (lloyd_step does), the read
     happens at that trace and is NOT in the outer cache key, so flipping
     the env mid-process reuses the stale executable. Callers that need
     to vary the spelling at runtime must pass ``packed=`` explicitly
@@ -471,10 +472,11 @@ def _argmin_resident_kernel(x_ref, y_ref, val_ref, idx_ref, *,
 
 def _argmin_resident_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref,
                                   yn_ref, val_ref, idx_ref, *,
-                                  n_valid: int, metric: str):
+                                  n_valid: int, metric: str,
+                                  packed: bool = False):
     _, minval, arg = _distance_tile_split(
         xh_ref[:], xl_ref[:], xn_ref[:].T, yh_ref[:], yl_ref[:],
-        yn_ref[:], n_valid, metric)
+        yn_ref[:], n_valid, metric, packed=packed)
     val_ref[:] = minval.T
     idx_ref[:] = arg.T
 
@@ -489,11 +491,12 @@ def _argmin_tiled_kernel(x_ref, y_ref, val_ref, idx_ref, *,
 
 def _argmin_tiled_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref,
                                yn_ref, val_ref, idx_ref, *,
-                               tn: int, n_valid: int, metric: str):
+                               tn: int, n_valid: int, metric: str,
+                               packed: bool = False):
     j = pl.program_id(1)
     _, minval, arg = _distance_tile_split(
         xh_ref[:], xl_ref[:], xn_ref[:].T, yh_ref[:], yl_ref[:],
-        yn_ref[:], n_valid - j * tn, metric)
+        yn_ref[:], n_valid - j * tn, metric, packed=packed)
     _fold_running_min(val_ref, idx_ref, minval, arg, j * tn)
 
 
@@ -528,14 +531,17 @@ def _fused_argmin_resident(x, y, tm: int, n_valid: int, metric: str):
     )(x, y)
 
 
-@functools.partial(jax.jit, static_argnames=("tm", "n_valid", "metric"))
+@functools.partial(jax.jit,
+                   static_argnames=("tm", "n_valid", "metric", "packed"))
 def _fused_argmin_resident_split(xh, xl, xn, yh, yl, yn, tm: int,
-                                 n_valid: int, metric: str):
+                                 n_valid: int, metric: str,
+                                 packed: bool = False):
     m, kp = xh.shape
     np_ = yh.shape[0]
     vma, (xh, xl, xn, yh, yl, yn) = join_vma(xh, xl, xn, yh, yl, yn)
     kernel = functools.partial(_argmin_resident_kernel_split,
-                               n_valid=n_valid, metric=metric)
+                               n_valid=n_valid, metric=metric,
+                               packed=packed)
     return pallas_call(
         kernel,
         grid=(m // tm,),
@@ -602,14 +608,17 @@ def _fused_argmin_tiled(x, y, tm: int, tn: int, n_valid: int, metric: str):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("tm", "tn", "n_valid", "metric"))
+                   static_argnames=("tm", "tn", "n_valid", "metric",
+                                    "packed"))
 def _fused_argmin_tiled_split(xh, xl, xn, yh, yl, yn, tm: int, tn: int,
-                              n_valid: int, metric: str):
+                              n_valid: int, metric: str,
+                              packed: bool = False):
     m, kp = xh.shape
     n = yh.shape[0]
     vma, (xh, xl, xn, yh, yl, yn) = join_vma(xh, xl, xn, yh, yl, yn)
     kernel = functools.partial(_argmin_tiled_kernel_split, tn=tn,
-                               n_valid=n_valid, metric=metric)
+                               n_valid=n_valid, metric=metric,
+                               packed=packed)
     return pallas_call(
         kernel,
         grid=(m // tm, n // tn),
@@ -644,7 +653,8 @@ def _fused_argmin_tiled_split(xh, xl, xn, yh, yl, yn, tm: int, tn: int,
 
 @with_matmul_precision
 def fused_argmin_pallas(x, y, metric: str = "l2",
-                        tm: Optional[int] = None, tn: int = 512
+                        tm: Optional[int] = None, tn: int = 512,
+                        packed: Optional[bool] = None
                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(min_dist, argmin) of each row of x against rows of y under a fused
     metric epilogue ('l2' squared, 'cosine', 'inner'), never materializing
@@ -660,6 +670,7 @@ def fused_argmin_pallas(x, y, metric: str = "l2",
     y = jnp.asarray(y)
     m, k = x.shape
     n = y.shape[0]
+    packed = _packed_split_default() if packed is None else bool(packed)
     if interpret_needs_ref(x, y):
         val, idx = _argmin_jnp(x, y, metric)
         return val, idx.astype(jnp.int32)
@@ -681,7 +692,8 @@ def fused_argmin_pallas(x, y, metric: str = "l2",
         mp = round_up_to_multiple(m, tm_)
         if split:
             val, idx = _fused_argmin_resident_split(
-                *_split_operands(x, y, mp, np_, kp), tm_, n, metric)
+                *_split_operands(x, y, mp, np_, kp), tm_, n, metric,
+                packed=packed)
         else:
             val, idx = _fused_argmin_resident(
                 _pad2(x, mp, kp), _pad2(y, np_, kp), tm_, n, metric)
@@ -696,7 +708,8 @@ def fused_argmin_pallas(x, y, metric: str = "l2",
         npp = round_up_to_multiple(n, tn_)
         if split:
             val, idx = _fused_argmin_tiled_split(
-                *_split_operands(x, y, mp, npp, kp), tm_, tn_, n, metric)
+                *_split_operands(x, y, mp, npp, kp), tm_, tn_, n, metric,
+                packed=packed)
         else:
             val, idx = _fused_argmin_tiled(
                 _pad2(x, mp, kp), _pad2(y, npp, kp), tm_, tn_, n, metric)
@@ -704,9 +717,10 @@ def fused_argmin_pallas(x, y, metric: str = "l2",
 
 
 def fused_l2_argmin_pallas(x, y, tm: Optional[int] = None,
-                           tn: int = 512) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                           tn: int = 512, packed: Optional[bool] = None
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(min_dist², argmin) under squared L2 — see :func:`fused_argmin_pallas`."""
-    val, idx = fused_argmin_pallas(x, y, "l2", tm, tn)
+    val, idx = fused_argmin_pallas(x, y, "l2", tm, tn, packed=packed)
     return jnp.maximum(val, 0.0), idx
 
 
@@ -878,10 +892,10 @@ def fused_lloyd_pallas(x, y, tm: Optional[int] = None,
     problems fall back to :func:`fused_l2_argmin_pallas` + an XLA one-hot
     matmul (still scatter-free).
 
-    ``packed`` selects the depth-packed bf16x3 spelling and applies ONLY
-    on the tier-'high' split resident path — it is (deliberately, without
-    warning) a no-op at other tiers, for bf16 inputs, and on the VMEM
-    fallback, all of which have no split dots to pack.
+    ``packed`` selects the depth-packed bf16x3 spelling wherever split
+    dots exist: the tier-'high' resident path AND (via the argmin kernel)
+    the VMEM fallback. It is (deliberately, without warning) a no-op at
+    other tiers and for bf16 inputs, which have no split dots to pack.
     """
     x = jnp.asarray(x)
     y = jnp.asarray(y)
@@ -905,7 +919,7 @@ def fused_lloyd_pallas(x, y, tm: Optional[int] = None,
     if tm is None:
         # Y (+ sums) exceed VMEM: fused argmin kernel, then a CHUNKED
         # one-hot update so the m×n one-hot never materializes in HBM.
-        val, idx = fused_l2_argmin_pallas(x, y)
+        val, idx = fused_l2_argmin_pallas(x, y, packed=packed)
         chunk = max(1, min(m, (1 << 25) // max(n, 1)))   # ≈128 MB of one-hot
         mp = round_up_to_multiple(m, chunk)
         xp = _pad2(x, mp, k).reshape(mp // chunk, chunk, k)
