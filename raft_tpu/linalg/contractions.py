@@ -901,6 +901,7 @@ def fused_lloyd_pallas(x, y, tm: Optional[int] = None,
     y = jnp.asarray(y)
     m, k = x.shape
     n = y.shape[0]
+    packed = _packed_split_default() if packed is None else bool(packed)
     if interpret_needs_ref(x, y):
         sums, counts, val, idx = _lloyd_jnp(x, y)
         return sums, counts, val, idx.astype(jnp.int32)
@@ -942,9 +943,7 @@ def fused_lloyd_pallas(x, y, tm: Optional[int] = None,
     mp = round_up_to_multiple(m, tm)
     if _use_split(x, y):
         sums, counts, val, idx = _fused_lloyd_padded_split(
-            *_split_operands(x, y, mp, np_, kp), tm, n, m,
-            packed=(_packed_split_default() if packed is None
-                    else bool(packed)))
+            *_split_operands(x, y, mp, np_, kp), tm, n, m, packed=packed)
     else:
         sums, counts, val, idx = _fused_lloyd_padded(
             _pad2(x, mp, kp), _pad2(y, np_, kp), tm, n, m)

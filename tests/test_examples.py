@@ -1,0 +1,24 @@
+"""The examples/ scripts must actually run (docs that execute are the
+only docs that stay true; ref model: pylibraft's doctested quick starts)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("script", ["kmeans_quickstart.py",
+                                    "knn_quickstart.py",
+                                    "spectral_eigsh.py"])
+def test_example_runs(script):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", script)],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"{script}:\n{r.stdout}\n{r.stderr}"
