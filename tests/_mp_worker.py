@@ -134,6 +134,58 @@ def main():
         for s, rq in recs:
             assert int(rq.wait()) == s
 
+    # --- 2-D (data × model) mesh k-means step ACROSS processes ----------
+    # (round-3: the model-axis sharding — cluster blocks over 'model',
+    # paired-pmin global argmin, per-block one-hot update psum'd over
+    # 'data' — with a device layout TRANSPOSED so the model axis itself
+    # spans process boundaries: model partner of devs[i] is devs[dp+i],
+    # owned by a different process. jax.devices() orders by process.)
+    if (2 * nproc) % 4 == 0:
+        import functools
+
+        from raft_tpu.cluster.kmeans import mnmg_lloyd_step
+
+        mp_ = 2
+        dp = (2 * nproc) // mp_
+        mesh2 = Mesh(np.asarray(devs).reshape(mp_, dp).T,
+                     axis_names=("data", "model"))
+        n_clusters, dim = 8, 16
+        rows = 4 * dp
+        rng = np.random.default_rng(41)     # same data on every process
+        x_host = rng.normal(size=(rows, dim)).astype(np.float32)
+        c_host = rng.normal(size=(n_clusters, dim)).astype(np.float32)
+        step2 = jax.jit(jax.shard_map(
+            functools.partial(mnmg_lloyd_step,
+                              n_clusters=n_clusters // mp_,
+                              data_axis="data", model_axis="model"),
+            mesh=mesh2,
+            in_specs=(P("data"), P("model")),
+            out_specs=(P("model"), P(), P("data"))),
+            out_shardings=(NamedSharding(mesh2, P()),
+                           NamedSharding(mesh2, P()),
+                           NamedSharding(mesh2, P())))
+        x2 = jax.make_array_from_callback(
+            x_host.shape, NamedSharding(mesh2, P("data")),
+            lambda idx: x_host[idx])
+        c2 = jax.make_array_from_callback(
+            c_host.shape, NamedSharding(mesh2, P("model")),
+            lambda idx: c_host[idx])
+        new_c, inertia, labels = step2(x2, c2)
+        new_c_h = np.asarray(new_c)
+        labels_h = np.asarray(labels)
+        # full numpy oracle for one Lloyd step on the replicated data
+        d = ((x_host[:, None] - c_host[None]) ** 2).sum(-1)
+        want_labels = d.argmin(1)
+        np.testing.assert_array_equal(labels_h, want_labels)
+        np.testing.assert_allclose(float(inertia), d.min(1).sum(),
+                                   rtol=1e-4)
+        want_c = c_host.copy()              # empty clusters keep old rows
+        for cl in range(n_clusters):
+            members = x_host[want_labels == cl]
+            if members.shape[0]:
+                want_c[cl] = members.mean(0)
+        np.testing.assert_allclose(new_c_h, want_c, rtol=1e-3, atol=1e-3)
+
     box.close()
     print(f"MP_WORKER_OK {pid}", flush=True)
 
