@@ -254,3 +254,26 @@ class TestAdversarialOnChip:
         v, _ = select_k(None, xi, k=7, select_min=False)
         np.testing.assert_array_equal(np.asarray(v),
                                       np.sort(xi, 1)[:, ::-1][:, :7])
+
+    def test_packed_split_equivalence_on_chip(self, rng):
+        """The depth-packed bf16x3 spelling must Mosaic-COMPILE and agree
+        with the 3-dot spelling on real hardware (CPU interpret already
+        pins this; chip layouts are the remaining risk). Gate for ever
+        flipping RAFT_TPU_SPLIT_PACKED on by default."""
+        import raft_tpu
+        from raft_tpu.linalg.contractions import fused_lloyd_pallas
+
+        old = raft_tpu.get_matmul_precision()
+        try:
+            raft_tpu.set_matmul_precision("high")
+            x = rng.normal(size=(512, 64)).astype(np.float32)
+            c = rng.normal(size=(96, 64)).astype(np.float32)
+            ref = fused_lloyd_pallas(x, c, packed=False)
+            got = fused_lloyd_pallas(x, c, packed=True)
+            for a, b, name in zip(ref, got,
+                                  ("sums", "counts", "dist", "labels")):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-5,
+                                           err_msg=name)
+        finally:
+            raft_tpu.set_matmul_precision(old)
