@@ -344,12 +344,20 @@ def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
 @with_matmul_precision
 def kmeans_fit_mnmg(res, params: KMeansParams, x,
                     centroids: Optional[jnp.ndarray] = None,
-                    mesh=None, data_axis: str = "data"):
+                    mesh=None, data_axis: str = "data",
+                    model_axis: Optional[str] = None):
     """MNMG Lloyd over a row-partitioned dataset (ref workload: raft-dask
     MNMG k-means; BASELINE config 5).
 
     x: global [m, k] array (sharded or to-be-sharded along rows over
     ``data_axis``). Returns (centroids, inertia, labels, n_iter).
+
+    ``model_axis`` (2-D mesh): centroid BLOCKS are sharded over it —
+    each model shard scans only its n_clusters/s block, the global
+    argmin combines via paired pmins, and the per-block one-hot update
+    psums over ``data_axis`` only (see :func:`mnmg_lloyd_step`). This is
+    the k≫VMEM regime the reference reaches with multi-GPU cluster
+    splits; requires n_clusters divisible by the model-axis size.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -358,27 +366,35 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
     x = jnp.asarray(x)
     if mesh is None:
         mesh = core_res.get_mesh(core_res.default_resources(res))
+    # validate the sharding config BEFORE the (expensive) k-means|| seeding
+    if model_axis is not None:
+        ms = mesh.shape[model_axis]
+        if params.n_clusters % ms:
+            raise ValueError(
+                f"n_clusters={params.n_clusters} not divisible by "
+                f"model axis {model_axis!r} size {ms}")
+        c_spec = P(model_axis)
+    else:
+        c_spec = P()
     state = RngState(seed=params.seed)
     c = _init_centroids(params, state, x, centroids)
 
     x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
-    c = jax.device_put(c, NamedSharding(mesh, P()))
+    c = jax.device_put(c, NamedSharding(mesh, c_spec))
 
+    # n_clusters is vestigial in mnmg_lloyd_step (the shard derives its
+    # block size from the sharded centroids' shape); pass the per-shard
+    # truth anyway so a future reader of the step sees consistent values
     step = jax.jit(
         jax.shard_map(
-            functools.partial(mnmg_lloyd_step, n_clusters=params.n_clusters,
-                              data_axis=data_axis),
+            functools.partial(
+                mnmg_lloyd_step,
+                n_clusters=params.n_clusters // mesh.shape[model_axis]
+                if model_axis is not None else params.n_clusters,
+                data_axis=data_axis, model_axis=model_axis),
             mesh=mesh,
-            in_specs=(P(data_axis), P()),
-            out_specs=(P(), P(), P(data_axis)),
-        ))
-
-    assign_only = jax.jit(
-        jax.shard_map(
-            lambda xs, cs: _assign(xs, cs),
-            mesh=mesh,
-            in_specs=(P(data_axis), P()),
-            out_specs=(P(data_axis), P(data_axis)),
+            in_specs=(P(data_axis), c_spec),
+            out_specs=(c_spec, P(), P(data_axis)),
         ))
 
     prev = None
@@ -392,6 +408,8 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
                 params.tol * max(prev, 1e-30):
             break
         prev = float(inertia)
-    # re-assign against the final centroids for a self-consistent return
-    dist, labels = assign_only(x, c)
-    return c, jnp.sum(dist), labels, n_iter
+    # re-assign against the FINAL centroids for a self-consistent return:
+    # one more step gives labels + inertia vs c (its centroid update is
+    # discarded) — works identically on 1-D and 2-D meshes
+    _, inertia, labels = step(x, c)
+    return c, inertia, labels, n_iter

@@ -271,3 +271,45 @@ def test_kmeans_check_every_same_result(res):
     assert (np.asarray(l1) == np.asarray(l2)).mean() > 0.999
     # convergence needs two poll values: bound is next-multiple + one window
     assert n2 <= -(-n1 // 5) * 5 + 5
+
+
+class TestKmeansFit2D:
+    def test_fit_mnmg_model_axis_matches_1d(self, mesh8):
+        """The PUBLIC 2-D fit path (round-3: kmeans_fit_mnmg grew
+        model_axis) must match the 1-D fit exactly — same init seed, same
+        math, only the sharding differs."""
+        import jax
+        from jax.sharding import Mesh
+
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit_mnmg
+
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(512, 16)).astype(np.float32)
+        params = KMeansParams(n_clusters=8, max_iter=8, tol=0.0, seed=3)
+
+        c1, in1, l1, n1 = kmeans_fit_mnmg(None, params, x, mesh=mesh8,
+                                          data_axis="data")
+        devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+        mesh2 = Mesh(devs, axis_names=("data", "model"))
+        c2, in2, l2, n2 = kmeans_fit_mnmg(None, params, x, mesh=mesh2,
+                                          data_axis="data",
+                                          model_axis="model")
+        assert n1 == n2
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+        np.testing.assert_allclose(float(in1), float(in2), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fit_mnmg_model_axis_divisibility_error(self, mesh8):
+        import jax
+        from jax.sharding import Mesh
+
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit_mnmg
+
+        devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+        mesh2 = Mesh(devs, axis_names=("data", "model"))
+        params = KMeansParams(n_clusters=7, max_iter=2, seed=0)
+        x = np.zeros((64, 4), np.float32)
+        with pytest.raises(ValueError, match="divisible"):
+            kmeans_fit_mnmg(None, params, x, mesh=mesh2,
+                            data_axis="data", model_axis="model")
