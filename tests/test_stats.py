@@ -258,3 +258,78 @@ class TestNeighborhood:
         got = float(stats.trustworthiness_score(res, x, emb, n_neighbors=k,
                                                 batch_size=32))
         np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+class TestSklearnCrossValidation:
+    """Direct numeric cross-checks against scikit-learn (available in this
+    image) — stronger than formula-identity tests: two independent
+    implementations agreeing on random inputs (ref model: pylibraft test
+    suites compare against sklearn/scipy the same way)."""
+
+    @pytest.fixture
+    def labels_pair(self):
+        rng = np.random.default_rng(77)
+        a = rng.integers(0, 6, size=2000).astype(np.int32)
+        # correlated second labeling: 70% copied, 30% random
+        b = np.where(rng.uniform(size=2000) < 0.7, a,
+                     rng.integers(0, 5, size=2000)).astype(np.int32)
+        return a, b
+
+    def test_pair_metrics_vs_sklearn(self, labels_pair):
+        import sklearn.metrics as skm
+
+        a, b = labels_pair
+        checks = [
+            (stats.adjusted_rand_index, skm.adjusted_rand_score, {}),
+            (stats.rand_index, skm.rand_score, {}),
+            (stats.mutual_info_score, skm.mutual_info_score, {}),
+            (stats.homogeneity_score, skm.homogeneity_score, {}),
+            (stats.completeness_score, skm.completeness_score, {}),
+            (stats.v_measure, skm.v_measure_score, {}),
+        ]
+        for ours, theirs, kw in checks:
+            got = float(ours(a, b, **kw))
+            want = float(theirs(a, b))
+            assert got == pytest.approx(want, rel=1e-5), \
+                (ours.__name__, got, want)
+
+    def test_silhouette_vs_sklearn(self):
+        import sklearn.metrics as skm
+
+        from raft_tpu.distance.pairwise import DistanceType
+
+        rng = np.random.default_rng(78)
+        x = np.concatenate([rng.normal(size=(60, 8)) + off
+                            for off in (0.0, 4.0, -4.0)]).astype(np.float32)
+        labels = np.repeat(np.arange(3), 60).astype(np.int32)
+        # sklearn roots its euclidean distances; our DEFAULT is squared L2
+        # (the reference's DistanceType default) — pass the rooted metric
+        # for an apples-to-apples check
+        got = float(stats.silhouette_score(
+            None, x, labels, n_clusters=3,
+            metric=DistanceType.L2SqrtUnexpanded))
+        want = float(skm.silhouette_score(x.astype(np.float64), labels))
+        assert got == pytest.approx(want, rel=1e-4, abs=1e-4)
+
+    def test_entropy_vs_scipy(self):
+        from scipy.stats import entropy as scipy_entropy
+
+        rng = np.random.default_rng(79)
+        labels = rng.integers(0, 10, size=3000).astype(np.int32)
+        got = float(stats.entropy(labels, lower=0, upper=10))
+        counts = np.bincount(labels, minlength=10)
+        want = float(scipy_entropy(counts / counts.sum()))
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_trustworthiness_vs_sklearn(self):
+        import sklearn.manifold as skman
+
+        rng = np.random.default_rng(80)
+        x = rng.normal(size=(120, 16)).astype(np.float32)
+        emb = x[:, :2] + 0.05 * rng.normal(size=(120, 2)).astype(np.float32)
+        got = float(stats.trustworthiness_score(None, x, emb,
+                                                n_neighbors=7))
+        want = float(skman.trustworthiness(x.astype(np.float64),
+                                           emb.astype(np.float64),
+                                           n_neighbors=7))
+        assert got == pytest.approx(want, rel=1e-3, abs=1e-3)
