@@ -221,3 +221,48 @@ class TestSparsePaddingJit:
                       jnp.asarray(x64), y)
             _compiles(functools.partial(fused_l2_argmin_pallas, tm=tm),
                       jnp.asarray(x64), y)
+
+
+class TestSolverLabelSpectralJit:
+    """jit-surface for the solver/label/spectral layer (absent from this
+    tier until round 3): LAP, weak_cc, label relabeling — each must trace
+    with no concrete-value leaks."""
+
+    def test_linear_assignment_compiles(self):
+        from raft_tpu.solver.linear_assignment import (
+            LinearAssignmentProblem)
+
+        rng = np.random.default_rng(2)
+        costs = jnp.asarray(rng.uniform(1, 9, (8, 8)).astype(np.float32))
+        lap = LinearAssignmentProblem(None, 8, epsilon=1e-3)
+        # solve dispatches jitted auction rounds internally
+        rows = np.asarray(lap.solve(costs)[0]).reshape(-1)
+        assert sorted(rows.tolist()) == list(range(8))
+
+    def test_weak_cc_compiles_with_padded_csr(self):
+        import scipy.sparse as sp
+
+        from raft_tpu.core.sparse_types import CSRMatrix
+        from raft_tpu.sparse.csr import weak_cc
+
+        a = sp.random(32, 32, density=0.08, random_state=5,
+                      format="csr").astype(np.float32)
+        a = a + a.T
+        csr = CSRMatrix.from_scipy(sp.csr_matrix(a))
+        _compiles(lambda c: weak_cc(None, c), csr)
+
+    def test_label_relabel_eager_contract(self):
+        """make_monotonic is EAGER-ONLY by design — its output values
+        depend on np.unique of the data (dynamic), exactly like the
+        reference's getUniquelabels+host path. The jit-surface fact to
+        pin: it works on device arrays eagerly and refuses tracers with
+        jax's standard error (not a hang or silent wrong result)."""
+        import jax.errors
+
+        from raft_tpu.label import make_monotonic
+
+        labels = jnp.asarray(np.array([7, 7, 3, 9, 3], np.int32))
+        got = np.asarray(make_monotonic(labels))
+        assert got.tolist() == [2, 2, 1, 3, 1]
+        with pytest.raises(jax.errors.TracerArrayConversionError):
+            jax.jit(make_monotonic)(labels)
