@@ -260,7 +260,7 @@ def bench_svd():
 # -- matrix (ref: bench/prims/matrix/*.cu) ----------------------------------
 
 def _select_k_grid(lens_ks):
-    """Direct-vs-tiled tournament over a (len, k) grid. This is the
+    """Three-way direct/tiled/stream tournament over a (len, k) grid. This is the
     evidence base for `_choose_tiled`'s thresholds (ref heuristic:
     matrix/detail/select_k-inl.cuh:38-63 picks radix vs warpsort from
     (len, k); our analogue picks lax.top_k direct vs the two-stage
@@ -274,8 +274,13 @@ def _select_k_grid(lens_ks):
             continue
         batch = max(4, min(8192, target_elems // length))
         x = _data(batch, length)
-        for algo, tag in ((SelectAlgo.RADIX_11BITS, "tiled"),
-                          (SelectAlgo.WARPSORT_IMMEDIATE, "direct")):
+        algos = [(SelectAlgo.RADIX_11BITS, "tiled"),
+                 (SelectAlgo.WARPSORT_IMMEDIATE, "direct")]
+        if length > 8192:
+            # below this the stream path dispatches to direct anyway —
+            # benching it would record mislabeled duplicate rows
+            algos.append((SelectAlgo.WARPSORT_FILTERED, "stream"))
+        for algo, tag in algos:
             f = jax.jit(functools.partial(select_k, None, k=k,
                                           select_min=True, algo=algo))
             yield run_case(f"matrix/select_k_len{length}_k{k}_{tag}", f, x,

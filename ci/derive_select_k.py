@@ -40,30 +40,36 @@ def main(path):
         return
 
     print(f"{'len':>9} {'k':>6} {'direct ms':>10} {'tiled ms':>9} "
-          f"{'winner':>7} {'direct GB/s':>12} {'hbm frac':>9}")
-    tiled_wins = []
+          f"{'stream ms':>10} {'winner':>7} {'win GB/s':>9} "
+          f"{'hbm frac':>9}")
+    wins = {}
     for (length, k), algos in sorted(cells.items()):
         d = algos.get("direct")
-        t = algos.get("tiled")
-        if not d or not t:
+        if not d:
             continue
-        dm, tm = d["median_ms"], t["median_ms"]
-        win = "tiled" if tm < dm else "direct"
-        if win == "tiled":
-            tiled_wins.append((length, k, dm / tm))
+        times = {a: algos[a]["median_ms"] for a in ("direct", "tiled",
+                                                    "stream") if a in algos}
+        win = min(times, key=times.get)
+        wins.setdefault(win, []).append((length, k, times))
         # the selection streams batch*len f32 once: the bandwidth floor
-        gbs = d["batch"] * length * 4 / (dm / 1e3) / 1e9
-        print(f"{length:>9} {k:>6} {dm:>10.2f} {tm:>9.2f} {win:>7} "
-              f"{gbs:>12.1f} {gbs / HBM_GB_S:>9.2f}")
+        # quoted for the WINNER (is the best algo leaving bandwidth idle?)
+        gbs = d["batch"] * length * 4 / (times[win] / 1e3) / 1e9
+
+        def fmt(a):
+            return f"{times[a]:.2f}" if a in times else "-"
+        print(f"{length:>9} {k:>6} {fmt('direct'):>10} {fmt('tiled'):>9} "
+              f"{fmt('stream'):>10} {win:>7} {gbs:>9.1f} "
+              f"{gbs / HBM_GB_S:>9.2f}")
 
     print()
-    if tiled_wins:
-        min_len = min(w[0] for w in tiled_wins)
-        max_k = max(w[1] for w in tiled_wins)
-        print(f"tiled wins at: {tiled_wins}")
-        print(f"recommended _choose_tiled: n_cols >= {min_len} and "
-              f"k <= {max_k}")
-    else:
+    for algo in ("tiled", "stream"):
+        if wins.get(algo):
+            cells_won = [(w[0], w[1]) for w in wins[algo]]
+            print(f"{algo} wins at: {cells_won}")
+            print(f"  -> dispatch should pick {algo} for n_cols >= "
+                  f"{min(c[0] for c in cells_won)} and k <= "
+                  f"{max(c[1] for c in cells_won)}")
+    if set(wins) == {"direct"}:
         print("direct (lax.top_k) wins every cell: "
               "_choose_tiled should return False everywhere measured")
     print("\nPallas-radix gate: any cell with winner-side hbm frac well "

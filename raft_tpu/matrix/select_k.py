@@ -12,8 +12,12 @@ dispatch is:
 - ``kAuto``: `lax.top_k` for k ≤ 1024 or small rows; two-stage tiled
   selection for very wide rows (len ≫ k) where sorting the whole row wastes
   bandwidth — the same motivation as the reference's radix path.
-- explicit algos kept for parity: kRadix* / kWarp* map onto the tiled or
-  direct paths.
+- explicit algos kept for parity: kRadix* maps onto the 2-stage tiled
+  tournament, kWarpsortImmediate onto the direct path, and
+  kWarpsortFiltered/Distributed onto a third contender — a streaming
+  running-top-k (single row pass, scan-merged k-buffer; the reference's
+  filtered/distributed warpsort variants are likewise the
+  stream-and-merge family).
 
 The two-stage path mirrors the radix idea in TPU form: split each row into
 T tiles, top-k each tile on the VPU (cheap local sort), then top-k the
@@ -89,21 +93,34 @@ def _pad_lowest(dtype):
     return jnp.iinfo(dtype).min
 
 
-def _tiled_select(values: jnp.ndarray, k: int, select_min: bool,
-                  tile: int = 8192):
+def _flip_pad_rows(values: jnp.ndarray, k: int, select_min: bool,
+                   tile: int):
+    """Shared selection prologue: clamp tile to k (correctness — a tile
+    may hold up to k global winners, so it can never be smaller than k),
+    fall back to direct when one tile covers the row, order-flip for
+    select_min, pad the row length to a tile multiple with the
+    lowest-sorting sentinel. Returns (v, n_tiles, tile) or None when the
+    direct path should be taken."""
     n_rows, n_cols = values.shape
-    # Correctness requires the full top-k OF EACH TILE in the candidate
-    # pool (a tile may hold up to k of the global winners), so the tile
-    # can never be smaller than k. One tile covering the row = direct.
     tile = max(tile, k)
     if n_cols <= tile:
-        return _direct_select(values, k, select_min)
+        return None
     v = _order_flip(values) if select_min else values
     n_tiles = cdiv(n_cols, tile)
     padded = n_tiles * tile
     if padded != n_cols:
         v = jnp.pad(v, ((0, 0), (0, padded - n_cols)),
                     constant_values=_pad_lowest(v.dtype))
+    return v, n_tiles, tile
+
+
+def _tiled_select(values: jnp.ndarray, k: int, select_min: bool,
+                  tile: int = 8192):
+    n_rows, n_cols = values.shape
+    pre = _flip_pad_rows(values, k, select_min, tile)
+    if pre is None:
+        return _direct_select(values, k, select_min)
+    v, n_tiles, tile = pre
     vt = v.reshape(n_rows, n_tiles, tile)
     # Stage 1: per-tile top-k (batched over rows × tiles).
     tvals, tidx = jax.lax.top_k(vt, k)
@@ -115,6 +132,47 @@ def _tiled_select(values: jnp.ndarray, k: int, select_min: bool,
     fvals, fpos = jax.lax.top_k(pool_v, k)
     fidx = jnp.take_along_axis(pool_i, fpos, axis=1)
     return (_order_flip(fvals) if select_min else fvals), fidx
+
+
+def _stream_select(values: jnp.ndarray, k: int, select_min: bool,
+                   tile: int = 8192):
+    """Single-pass streaming selection: scan the row in tiles, folding
+    each tile into a running k-buffer via one top_k over the
+    [buffer | tile] pool (the knn running-top-k pattern,
+    neighbors/brute_force._knn_scan). One read of the data + O(n_tiles·k)
+    merge work — the bandwidth-shaped contender for len ≫ k where the
+    direct path sorts the whole row and the 2-stage tournament buffers
+    every tile's candidates. The third algo of the hardware tournament
+    (ci/derive_select_k.py decides the dispatch)."""
+    n_rows, n_cols = values.shape
+    pre = _flip_pad_rows(values, k, select_min, tile)
+    if pre is None:
+        return _direct_select(values, k, select_min)
+    v, n_tiles, tile = pre
+    # scan over tile OFFSETS with dynamic_slice — no [n_tiles, rows,
+    # tile] transpose copy of the (potentially huge) input; the scan
+    # body reads each tile straight out of the row-major buffer
+    offsets = jnp.arange(1, n_tiles, dtype=jnp.int32) * tile
+
+    def tile_at(off):
+        return jax.lax.dynamic_slice(v, (jnp.int32(0), off),
+                                     (n_rows, tile))
+
+    def step(carry, off):
+        bv, bi = carry                       # [n_rows, k] running best
+        cv, ci = jax.lax.top_k(tile_at(off), k)   # tile-local top-k
+        pool_v = jnp.concatenate([bv, cv], axis=1)
+        pool_i = jnp.concatenate([bi, ci.astype(jnp.int32) + off], axis=1)
+        nv, pos = jax.lax.top_k(pool_v, k)
+        return (nv, jnp.take_along_axis(pool_i, pos, axis=1)), None
+
+    # seed the buffer from tile 0 (a pad-filled seed would tie-win
+    # against real extreme values — e.g. rows containing -inf — and
+    # surface its bogus indices); scan folds the remaining tiles
+    iv, ii = jax.lax.top_k(tile_at(jnp.int32(0)), k)
+    init = (iv, ii.astype(jnp.int32))
+    (fv, fi), _ = jax.lax.scan(step, init, offsets)
+    return (_order_flip(fv) if select_min else fv), fi
 
 
 def select_k(res, values, k: int, select_min: bool = True,
@@ -142,15 +200,24 @@ def select_k(res, values, k: int, select_min: bool = True,
         raise ValueError(f"k={k} > len={n_cols}")
 
     if algo == SelectAlgo.AUTO:
-        tiled = _choose_tiled(n_rows, n_cols, k)
+        mode = "tiled" if _choose_tiled(n_rows, n_cols, k) else "direct"
     elif algo in (SelectAlgo.RADIX_8BITS, SelectAlgo.RADIX_11BITS,
                   SelectAlgo.RADIX_11BITS_EXTRA_PASS):
-        tiled = n_cols > 8192
+        mode = "tiled" if n_cols > 8192 else "direct"
+    elif algo in (SelectAlgo.WARPSORT_FILTERED,
+                  SelectAlgo.WARPSORT_DISTRIBUTED,
+                  SelectAlgo.WARPSORT_DISTRIBUTED_EXT):
+        # the streaming running-top-k contender (the reference's filtered/
+        # distributed warpsort variants are likewise the stream-and-merge
+        # family, select_warpsort.cuh:129)
+        mode = "stream" if n_cols > 8192 else "direct"
     else:
-        tiled = False
+        mode = "direct"
 
-    if tiled:
+    if mode == "tiled":
         out_val, out_idx = _tiled_select(values, k, select_min)
+    elif mode == "stream":
+        out_val, out_idx = _stream_select(values, k, select_min)
     else:
         out_val, out_idx = _direct_select(values, k, select_min)
 
