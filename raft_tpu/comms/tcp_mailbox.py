@@ -123,7 +123,14 @@ class TcpMailbox:
                 s.sendall(_HDR.pack(source, dest, tag, len(raw)))
                 s.sendall(raw)
 
-    def get(self, source: int, dest: int, tag: int, timeout: float = 30.0):
+    def get(self, source: int, dest: int, tag: int,
+            timeout: float = 120.0):
+        """Blocking tag-matched receive. The default deadline is sized
+        for a LOADED host: the peer may be stuck behind multi-second XLA
+        compiles or a saturated CPU before it sends (observed: the
+        30 s default flaked the multiprocess tier when the full test
+        suite and bench battery shared the machine). It is a
+        deadlock-detection bound, not a latency promise."""
         assert dest == self.rank, \
             f"rank {self.rank} cannot receive for rank {dest}"
         return self._q((source, dest, tag)).get(timeout=timeout)
