@@ -78,7 +78,8 @@ class TestCompiledKernels:
 
         v = rng.normal(size=(8, 40000)).astype(np.float32)
         for k, algo in ((50, SelectAlgo.AUTO), (50, SelectAlgo.RADIX_11BITS),
-                        (9000, SelectAlgo.RADIX_11BITS)):
+                        (9000, SelectAlgo.RADIX_11BITS),
+                        (50, SelectAlgo.WARPSORT_FILTERED)):  # stream path
             ov, oi = select_k(None, v, k, algo=algo)
             np.testing.assert_allclose(np.asarray(ov),
                                        np.sort(v, 1)[:, :k], rtol=1e-6)
