@@ -13,6 +13,7 @@ from raft_tpu.cluster.kmeans import (  # noqa: F401
     kmeans_predict,
     kmeans_transform,
     kmeans_fit_predict,
+    cluster_cost,
     lloyd_step,
     mnmg_lloyd_step,
     kmeans_fit_mnmg,

@@ -290,6 +290,16 @@ def kmeans_fit_predict(res, params: KMeansParams, x,
     return c, inertia, labels, n_iter
 
 
+@with_matmul_precision
+def cluster_cost(res, x, centroids):
+    """Sum of squared distances of every point to its nearest centroid
+    (cuVS/raft API parity: cluster::kmeans::cluster_cost). Same quantity
+    kmeans_predict returns as its second value; exposed standalone for
+    the reference's call shape."""
+    dist, _ = _assign(jnp.asarray(x), jnp.asarray(centroids))
+    return jnp.sum(dist)
+
+
 # ---------------------------------------------------------------------------
 # MNMG (multi-chip SPMD)
 # ---------------------------------------------------------------------------

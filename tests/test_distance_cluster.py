@@ -313,3 +313,16 @@ class TestKmeansFit2D:
         with pytest.raises(ValueError, match="divisible"):
             kmeans_fit_mnmg(None, params, x, mesh=mesh2,
                             data_axis="data", model_axis="model")
+
+
+def test_cluster_cost_matches_predict_inertia():
+    from raft_tpu.cluster.kmeans import cluster_cost, kmeans_predict
+
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(300, 8)).astype(np.float32)
+    c = rng.normal(size=(5, 8)).astype(np.float32)
+    _, inertia = kmeans_predict(None, x, c)
+    cost = cluster_cost(None, x, c)
+    np.testing.assert_allclose(float(cost), float(inertia), rtol=1e-6)
+    ref = ((x[:, None] - c[None]) ** 2).sum(-1).min(1).sum()
+    np.testing.assert_allclose(float(cost), ref, rtol=1e-3)
