@@ -8,6 +8,9 @@ backed by jax.Array instead of CUDA device memory.
 """
 
 from raft_tpu.compat.common import (  # noqa: F401
+    DeviceResourcesSNMG,
+    Stream,
+    cai_wrapper,
     DeviceResources,
     Handle,
     ai_wrapper,

@@ -12,7 +12,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.core.resources import DeviceResources, Resources
+from raft_tpu.core.resources import (DeviceResources,
+                                     DeviceResourcesSNMG, Resources)
 
 # pylibraft exposes Handle as the deprecated alias of DeviceResources
 # (ref: common/handle.pyx, core/handle.hpp:23).
@@ -144,3 +145,29 @@ def auto_sync_handle(f):
         return ret
 
     return wrapper
+
+
+# pylibraft.common exposes cai_wrapper alongside ai_wrapper; on TPU there
+# is no CUDA array interface to view zero-copy, so both duck types
+# collapse to the same "convertible to jax.Array" adapter (a CAI-bearing
+# object without __array__/__dlpack__ raises the same TypeError the
+# reference raises for non-CAI inputs).
+cai_wrapper = ai_wrapper
+
+
+class Stream:
+    """API-parity stand-in for pylibraft.common.Stream (cuda.pyx).
+
+    XLA owns ordering/streams on TPU; constructing one is free and
+    ``sync()`` drains dispatched work (the analogue of
+    cudaStreamSynchronize for code ported from the handle+stream idiom).
+    """
+
+    def __init__(self, handle=None):
+        del handle
+
+    def sync(self) -> None:
+        jax.effects_barrier()
+
+    def __repr__(self):
+        return "Stream(<xla-managed>)"

@@ -134,3 +134,17 @@ class TestCommsBootstrap:
         comms.init()
         comms.init()   # idempotent
         comms.destroy()
+
+
+def test_common_symbol_parity():
+    """Every name pylibraft.common exports must exist here (ref:
+    python/pylibraft/pylibraft/common/__init__.py:5-10)."""
+    from raft_tpu import compat
+
+    for name in ("ai_wrapper", "cai_wrapper", "Stream", "device_ndarray",
+                 "DeviceResources", "DeviceResourcesSNMG", "Handle",
+                 "auto_convert_output", "auto_sync_handle"):
+        assert hasattr(compat, name), name
+    compat.Stream().sync()            # no-op barrier must not raise
+    w = compat.cai_wrapper(np.arange(4, dtype=np.float32))
+    assert w.shape == (4,) and w.dtype == np.float32
