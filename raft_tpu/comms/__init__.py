@@ -45,6 +45,7 @@ from raft_tpu.comms.test_suite import (  # noqa: F401
     perform_test_comms_reducescatter,
     perform_test_comms_send_recv,
     perform_test_comms_device_send_recv,
+    perform_test_comms_device_send_or_recv,
     perform_test_comms_device_sendrecv,
     perform_test_comms_device_multicast_sendrecv,
     perform_test_comm_split,
@@ -53,6 +54,7 @@ from raft_tpu.comms.bootstrap import (  # noqa: F401
     Comms,
     initialize_distributed,
     inject_comms_on_handle,
+    inject_comms_on_handle_coll_only,
     local_handle,
     get_raft_comm_state,
 )

@@ -75,6 +75,18 @@ def inject_comms_on_handle(handle, mesh: Mesh, axis_name: str, rank: int,
     return comms
 
 
+
+def inject_comms_on_handle_coll_only(handle, mesh: Mesh, axis_name: str,
+                                     rank: int, verbose: bool = False):
+    """API parity with raft-dask's collectives-only injection
+    (comms_utils.pyx `inject_comms_on_handle_coll_only` — NCCL without
+    UCX). On TPU both variants wire the same MeshComms: device
+    collectives always ride XLA; the host mailbox is in-process state
+    with no setup cost, so there is nothing to omit. ``verbose`` is
+    accepted for call compatibility and ignored."""
+    del verbose
+    return inject_comms_on_handle(handle, mesh, axis_name, rank)
+
 class Comms:
     """Initializes and manages an SPMD communicator clique over the mesh
     (ref: raft_dask comms.py:28 `Comms`; comms_p2p there toggles UCX —

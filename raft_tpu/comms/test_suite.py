@@ -232,3 +232,8 @@ def perform_test_comm_split(handle, n_colors: int = 2) -> bool:
         if sub.get_rank() != expect_rank:
             return False
     return True
+
+
+# Reference-exact alias (raft-dask exports the device p2p self-test as
+# perform_test_comms_device_send_or_recv, comms_utils.pyx / common/__init__).
+perform_test_comms_device_send_or_recv = perform_test_comms_device_send_recv

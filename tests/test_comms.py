@@ -176,3 +176,26 @@ def test_eager_collective_cached(comm):
     for _ in range(5):
         comm.allreduce(x)
     assert len(comm._shared["jit"]) == n_entries
+
+
+def test_raft_dask_symbol_parity():
+    """Every comms name raft_dask.common exports must exist here (ref:
+    python/raft-dask/raft_dask/common/__init__.py:5-21; UCX's role is
+    TcpMailbox, comms/tcp_mailbox.py)."""
+    import raft_tpu.comms as c
+
+    for name in ("Comms", "local_handle", "inject_comms_on_handle",
+                 "inject_comms_on_handle_coll_only",
+                 "perform_test_comm_split",
+                 "perform_test_comms_allgather",
+                 "perform_test_comms_allreduce",
+                 "perform_test_comms_bcast",
+                 "perform_test_comms_device_multicast_sendrecv",
+                 "perform_test_comms_device_send_or_recv",
+                 "perform_test_comms_device_sendrecv",
+                 "perform_test_comms_gather",
+                 "perform_test_comms_gatherv",
+                 "perform_test_comms_reduce",
+                 "perform_test_comms_reducescatter",
+                 "perform_test_comms_send_recv"):
+        assert hasattr(c, name), name
