@@ -34,11 +34,12 @@ def _as_csr(a) -> CSRMatrix:
 
 @auto_sync_handle
 @auto_convert_output
-def eigsh(a, k: int = 6, v0=None, ncv: int = 0, maxiter: int = 4000,
-          tol: float = 0.0, which: str = "LM", seed: int = 42,
-          handle=None):
+def eigsh(a, k: int = 6, which: str = "LM", v0=None, ncv=None,
+          maxiter=None, tol: float = 0.0, seed=None, handle=None):
     """Find k eigenvalues/eigenvectors of the sparse symmetric matrix A
-    (ref: lanczos.pyx:85 — scipy.sparse.linalg.eigsh-compatible surface).
+    (ref: lanczos.pyx:100 — scipy.sparse.linalg.eigsh-compatible surface;
+    the POSITIONAL parameter order matches the reference exactly, so
+    ported positional call sites — eigsh(A, 6, "SA") — keep working).
 
     Returns (eigenvalues, eigenvectors) as device arrays.
 
@@ -52,6 +53,9 @@ def eigsh(a, k: int = 6, v0=None, ncv: int = 0, maxiter: int = 4000,
     """
     csr = _as_csr(a)
     w, v = _lanczos.eigsh(
-        csr, k=k, which=which, v0=v0, ncv=ncv, maxiter=maxiter,
-        tol=tol if tol > 0 else 1e-7, seed=seed, res=handle)
+        csr, k=k, which=which, v0=v0,
+        ncv=0 if ncv is None else int(ncv),          # 0 = solver default
+        maxiter=4000 if maxiter is None else int(maxiter),
+        tol=tol if tol > 0 else 1e-7,
+        seed=42 if seed is None else int(seed), res=handle)
     return device_ndarray(w), device_ndarray(v)

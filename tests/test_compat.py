@@ -148,3 +148,21 @@ def test_common_symbol_parity():
     compat.Stream().sync()            # no-op barrier must not raise
     w = compat.cai_wrapper(np.arange(4, dtype=np.float32))
     assert w.shape == (4,) and w.dtype == np.float32
+
+
+def test_eigsh_positional_order_matches_reference():
+    """pylibraft calls eigsh positionally as (A, k, which, ...) —
+    lanczos.pyx:100. A ported eigsh(A, 2, "SA") must keep working."""
+    import scipy.sparse as sp
+
+    from raft_tpu.compat import eigsh
+    from raft_tpu.core.sparse_types import CSRMatrix
+
+    a = CSRMatrix.from_scipy(
+        sp.diags([1., 2., 3., 4., 10.]).tocsr().astype(np.float32))
+    w, _ = eigsh(a, 2, "SA")            # positional which
+    np.testing.assert_allclose(np.asarray(w.values), [1.0, 2.0],
+                               atol=1e-3)
+    w, _ = eigsh(a, 2, "LM", None, None, None, 0.0, None)  # full ref order
+    np.testing.assert_allclose(sorted(np.asarray(w.values)), [4.0, 10.0],
+                               atol=1e-3)
