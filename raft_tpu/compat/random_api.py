@@ -15,13 +15,16 @@ from raft_tpu.random import RngState, rmat_rectangular_gen
 @auto_sync_handle
 @auto_convert_output
 def rmat(out=None, theta=None, r_scale: int = 0, c_scale: int = 0,
-         n_edges: int = 0, seed: int = 12345, handle=None):
+         seed: int = 12345, handle=None, *, n_edges: int = 0):
     """Generate R-MAT edges (ref: rmat_rectangular_generator.pyx:69).
 
     pylibraft signature: ``rmat(out, theta, r_scale, c_scale, seed,
-    handle)`` where ``out`` is a preallocated [n_edges, 2] int array and
-    ``theta`` a [max(r_scale, c_scale) * 4] probability table. ``out`` may
-    be None (pass n_edges instead) — the edge list is always returned.
+    handle)`` — the positional order matches EXACTLY so ported positional
+    call sites keep working; our extension ``n_edges`` (allocate instead
+    of passing a preallocated ``out``) is keyword-only for that reason.
+    ``out`` is a preallocated [n_edges, 2] int array and ``theta`` a
+    [max(r_scale, c_scale) * 4] probability table. The edge list is
+    always returned.
     """
     if out is not None:
         n_edges = ai_shape(out)[0]

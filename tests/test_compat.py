@@ -166,3 +166,17 @@ def test_eigsh_positional_order_matches_reference():
     w, _ = eigsh(a, 2, "LM", None, None, None, 0.0, None)  # full ref order
     np.testing.assert_allclose(sorted(np.asarray(w.values)), [4.0, 10.0],
                                atol=1e-3)
+
+
+def test_rmat_positional_order_matches_reference():
+    """pylibraft calls rmat positionally as (out, theta, r_scale, c_scale,
+    seed, handle) — rmat_rectangular_generator.pyx:69. seed must land in
+    the seed slot (our n_edges extension is keyword-only)."""
+    theta = [0.55, 0.25, 0.15, 0.05] * 8
+    out = np.zeros((64, 2), np.int32)
+    compat.rmat(out, theta, 8, 8, 999, None)
+    assert out.max() < (1 << 8) and out.min() >= 0
+    # different seeds -> different edges (seed really is the 5th arg)
+    out2 = np.zeros((64, 2), np.int32)
+    compat.rmat(out2, theta, 8, 8, 1000, None)
+    assert not np.array_equal(out, out2)
