@@ -136,7 +136,13 @@ def auto_sync_handle(f):
         bound = sig.bind_partial(*args, **kwargs)
         handle = bound.arguments.get("handle")
         if handle is None:
-            kwargs["handle"] = handle = DeviceResources()
+            # inject through the BOUND arguments: ``handle`` may have
+            # been passed positionally as None (pylibraft's positional
+            # call shape, e.g. rmat(out, theta, rs, cs, seed, None)) —
+            # adding a handle kwarg on top would collide with it
+            bound.arguments["handle"] = handle = DeviceResources()
+            args = bound.args
+            kwargs = bound.kwargs
         ret = f(*args, **kwargs)
         # module-level sync works for any Resources, including the plain
         # per-rank handles built by the comms bootstrap
