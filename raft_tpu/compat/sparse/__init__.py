@@ -1,0 +1,7 @@
+"""Import-path parity with ``pylibraft.sparse`` (ref:
+python/pylibraft/pylibraft/sparse/__init__.py): migrators who only
+rewrite the top-level package name keep their import lines working —
+``from raft_tpu.compat.sparse.linalg import eigsh``.
+"""
+
+from raft_tpu.compat.sparse import linalg  # noqa: F401

@@ -1,0 +1,3 @@
+"""ref: pylibraft/sparse/linalg/__init__.py — re-exports eigsh."""
+
+from raft_tpu.compat.sparse_api import eigsh  # noqa: F401

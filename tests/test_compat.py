@@ -180,3 +180,11 @@ def test_rmat_positional_order_matches_reference():
     out2 = np.zeros((64, 2), np.int32)
     compat.rmat(out2, theta, 8, 8, 1000, None)
     assert not np.array_equal(out, out2)
+
+
+def test_sparse_linalg_import_path_parity():
+    """pylibraft.sparse.linalg.eigsh import shape (sparse/__init__.py:5)."""
+    from raft_tpu.compat.sparse.linalg import eigsh as e2
+    from raft_tpu.compat import eigsh as e1
+
+    assert e1 is e2
