@@ -483,9 +483,9 @@ def bench_collectives():
     for name, fn in (("allreduce", lambda v: comms.allreduce(v)),
                      ("allgather", lambda v: comms.allgather(v)),
                      ("reducescatter", lambda v: comms.reducescatter(v))):
-        case = run_case(f"comms/{name}{suffix}", fn, x, nranks=n, rows=rows,
-                        **({"bytes_moved": nbytes} if n > 1 else {}))
-        out.append(case)
+        out.append(run_case(
+            f"comms/{name}{suffix}", fn, x, nranks=n, rows=rows,
+            **({"bytes_moved": nbytes} if n > 1 else {})))
     return out
 
 

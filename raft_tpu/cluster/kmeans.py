@@ -395,12 +395,12 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
     # n_clusters is vestigial in mnmg_lloyd_step (the shard derives its
     # block size from the sharded centroids' shape); pass the per-shard
     # truth anyway so a future reader of the step sees consistent values
+    per_shard_k = (params.n_clusters if model_axis is None
+                   else params.n_clusters // mesh.shape[model_axis])
     step = jax.jit(
         jax.shard_map(
             functools.partial(
-                mnmg_lloyd_step,
-                n_clusters=params.n_clusters // mesh.shape[model_axis]
-                if model_axis is not None else params.n_clusters,
+                mnmg_lloyd_step, n_clusters=per_shard_k,
                 data_axis=data_axis, model_axis=model_axis),
             mesh=mesh,
             in_specs=(P(data_axis), c_spec),
