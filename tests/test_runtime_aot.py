@@ -99,3 +99,32 @@ class TestRuntimeEntryPoints:
         with pytest.raises(TypeError):
             rmat_rectangular_gen(res, RngState(5), None, 8, 8, 10,
                                  out_dtype=np.int8)
+
+
+def test_lloyd_packed_spelling_exports(tmp_path):
+    """The depth-packed kernel spelling must survive the AOT path too:
+    export → serialize → reload → run gives the 3-dot spelling's numbers
+    (the artifact story must not constrain kernel-variant choices)."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from raft_tpu import set_matmul_precision, get_matmul_precision
+    from raft_tpu.linalg.contractions import fused_lloyd_pallas
+
+    old = get_matmul_precision()
+    try:
+        set_matmul_precision("high")
+        rng = np.random.default_rng(13)
+        x = jnp.asarray(rng.normal(size=(128, 32)).astype(np.float32))
+        c = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+        exp = aot_export(functools.partial(fused_lloyd_pallas, packed=True),
+                         x, c)
+        fn = deserialize_computation(serialize_computation(exp))
+        got = fn(x, c)
+        want = fused_lloyd_pallas(x, c, packed=False)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-5)
+    finally:
+        set_matmul_precision(old)
