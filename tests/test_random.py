@@ -169,3 +169,43 @@ def test_make_regression_wide_low_rank(res, rng_state):
                               effective_rank=5)
     assert X.shape == (10, 20) and y.shape == (10, 1) and w.shape == (20, 1)
     assert np.isfinite(np.asarray(X)).all()
+
+
+class TestDistributionKS:
+    """Kolmogorov–Smirnov goodness-of-fit against scipy's reference CDFs —
+    distribution SHAPE validation beyond the existing moment checks (the
+    reference's rng tests use mean/std tolerance matchers; KS is strictly
+    stronger and free here)."""
+
+    N = 20_000
+
+    def _ks(self, samples, cdf):
+        from scipy.stats import kstest
+
+        return kstest(np.asarray(samples, np.float64), cdf).pvalue
+
+    def test_ks_uniform_normal_exponential(self):
+        from scipy import stats as ss
+
+        from raft_tpu.random import RngState, exponential, normal, uniform
+
+        s = RngState(1234)
+        assert self._ks(uniform(None, s, (self.N,), 2.0, 5.0),
+                        ss.uniform(loc=2.0, scale=3.0).cdf) > 1e-3
+        assert self._ks(normal(None, s, (self.N,), 1.0, 2.0),
+                        ss.norm(loc=1.0, scale=2.0).cdf) > 1e-3
+        assert self._ks(exponential(None, s, (self.N,), lam=0.5),
+                        ss.expon(scale=2.0).cdf) > 1e-3
+
+    def test_ks_gumbel_laplace_lognormal(self):
+        from scipy import stats as ss
+
+        from raft_tpu.random import RngState, gumbel, laplace, lognormal
+
+        s = RngState(77)
+        assert self._ks(gumbel(None, s, (self.N,), 0.5, 1.5),
+                        ss.gumbel_r(loc=0.5, scale=1.5).cdf) > 1e-3
+        assert self._ks(laplace(None, s, (self.N,), -1.0, 0.7),
+                        ss.laplace(loc=-1.0, scale=0.7).cdf) > 1e-3
+        assert self._ks(lognormal(None, s, (self.N,), 0.2, 0.6),
+                        ss.lognorm(s=0.6, scale=np.exp(0.2)).cdf) > 1e-3
