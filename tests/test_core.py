@@ -386,3 +386,51 @@ class TestVectorCache:
         # 5th key evicts the LRU slot; cache stays consistent
         out = np.asarray(cache.get_or_compute(np.array([4, 1]), compute))
         np.testing.assert_array_equal(out[:, 0], [4, 1])
+
+
+class TestBitsetProperty:
+    """Property sweep: Bitset ops vs a numpy bool-array oracle across
+    random index streams, duplicate-heavy sets, word-boundary sizes, and
+    full clear/set cycles (ref model: cpp/tests/core/bitset.cu's
+    parameterized grids)."""
+
+    @pytest.mark.parametrize("n_bits", [1, 31, 32, 33, 64, 1000, 4097])
+    def test_random_op_stream_matches_oracle(self, n_bits):
+        from raft_tpu.core.bitset import Bitset
+
+        rng = np.random.default_rng(n_bits)
+        oracle = np.zeros(n_bits, bool)
+        bs = Bitset(n_bits, default_value=False)
+        for _ in range(4):
+            ids = rng.integers(0, n_bits, size=max(1, n_bits // 3))
+            val = bool(rng.integers(0, 2))
+            bs = bs.set(jnp.asarray(ids.astype(np.int32)), val)
+            oracle[ids] = val
+            np.testing.assert_array_equal(np.asarray(bs.to_bools()),
+                                          oracle)
+            assert int(bs.count()) == int(oracle.sum())
+        flipped = bs.flip()
+        np.testing.assert_array_equal(np.asarray(flipped.to_bools()),
+                                      ~oracle)
+        # tail bits beyond n_bits must not leak into count after flip
+        assert int(flipped.count()) == int((~oracle).sum())
+
+    def test_duplicate_indices_last_write_semantics(self):
+        from raft_tpu.core.bitset import Bitset
+
+        bs = Bitset(64, default_value=False)
+        ids = jnp.asarray(np.array([5, 5, 5, 9], np.int32))
+        bs = bs.set(ids, True)
+        assert int(bs.count()) == 2
+        assert bool(bs.test(jnp.asarray([5]))[0])
+
+    def test_popc_matches_bit_count(self):
+        """popc totals the set bits of the whole word span (the
+        reference's detail::popc reduction, not a per-word map)."""
+        from raft_tpu.core.bitset import popc
+
+        rng = np.random.default_rng(3)
+        words = rng.integers(0, 2 ** 31, size=257, dtype=np.int64)
+        got = int(popc(jnp.asarray(words.astype(np.int32))))
+        want = sum(bin(int(w)).count("1") for w in words)
+        assert got == want
