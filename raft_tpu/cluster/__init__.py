@@ -15,6 +15,7 @@ from raft_tpu.cluster.kmeans import (  # noqa: F401
     kmeans_fit_predict,
     cluster_cost,
     lloyd_step,
+    weighted_lloyd_step,
     mnmg_lloyd_step,
     kmeans_fit_mnmg,
 )

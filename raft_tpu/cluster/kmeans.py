@@ -119,6 +119,25 @@ def lloyd_step(x, centroids, n_clusters: int):
     return new_centroids, jnp.sum(dist), labels
 
 
+@with_matmul_precision
+@functools.partial(jax.jit, static_argnames=("n_clusters",))
+def weighted_lloyd_step(x, w, centroids, n_clusters: int):
+    """Sample-weighted Lloyd iteration (ref/cuVS parity: kmeans fit takes
+    ``sample_weight``; detail applies it to both the update sums and the
+    inertia). Assignment rides the fused argmin kernel; the weighted
+    update is the scatter-free one-hot contraction with w-scaled rows —
+    XLA-side rather than the fused kernel (the unweighted fused path
+    stays the hot default; w == ones reproduces lloyd_step exactly)."""
+    dist, labels = _assign(x, centroids)
+    w = w.astype(jnp.float32)
+    oh = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)
+    sums = _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32)
+                                 * w[:, None])
+    counts = oh.T @ w
+    new_centroids = _finish_update(sums, counts, centroids)
+    return new_centroids, jnp.sum(dist * w), labels
+
+
 def _weighted_plus_plus(rng, cand, w, n_clusters: int):
     """Classic weighted k-means++ on the (small) candidate set — host-side
     numpy; candidate count is O(rounds · oversampling · k)."""
@@ -150,7 +169,8 @@ def _min_d2_update(x, new_pts, d2):
 
 
 def _kmeans_plus_plus(state: RngState, x, n_clusters: int,
-                      oversampling_factor: float = 2.0):
+                      oversampling_factor: float = 2.0,
+                      sample_weights=None):
     """k-means|| seeding (Bahmani et al., the scalable k-means++): a few
     oversampled D²-Bernoulli rounds over the full data (each one fused
     device pass), then weighted k-means++ on the small candidate set.
@@ -162,17 +182,29 @@ def _kmeans_plus_plus(state: RngState, x, n_clusters: int,
     m = x.shape[0]
     key = state.next_key()
     k0, key = jax.random.split(key)
-    first = int(jax.random.randint(k0, (), 0, m))
+    if sample_weights is None:
+        first = int(jax.random.randint(k0, (), 0, m))
+        wts = None
+    else:
+        # weighted first draw (ref/cuVS: sample_weight reaches the
+        # init's D^2 sampling — zero-weight points are never seeds)
+        wts = jnp.asarray(sample_weights, jnp.float32)
+        first = int(jax.random.categorical(k0, jnp.log(
+            jnp.maximum(wts, 1e-30))))
     cand = [np.asarray(x[first])[None, :]]
     d2 = jnp.sum((x - x[first][None, :]) ** 2, axis=1).astype(jnp.float32)
     ell = max(1.0, oversampling_factor * n_clusters)
 
     for _ in range(5):
         ki, key = jax.random.split(key)
-        total = float(jnp.sum(d2))
+        # d2 stays the PURE min-squared-distance; weights enter only the
+        # sampling mass (probability ∝ w·D² — the reference's weighted
+        # D² sampling), never the distance recurrence itself
+        mass = d2 if wts is None else d2 * wts
+        total = float(jnp.sum(mass))
         if total <= 0:
             break
-        probs = jnp.minimum(1.0, ell * d2 / total)
+        probs = jnp.minimum(1.0, ell * mass / total)
         picked = np.nonzero(
             np.asarray(jax.random.uniform(ki, (m,)) < probs))[0]
         if picked.size == 0:
@@ -193,13 +225,20 @@ def _kmeans_plus_plus(state: RngState, x, n_clusters: int,
     rng = np.random.default_rng(int(jax.random.randint(
         key, (), 0, np.iinfo(np.int32).max)))
     if cand_np.shape[0] <= n_clusters:
-        # degenerate: too few candidates — top up with random rows
+        # degenerate: too few candidates — top up with random rows,
+        # weighted so zero-weight points can never become seeds
+        p = None
+        if wts is not None:
+            p = np.asarray(wts, np.float64)
+            p = p / p.sum()
         extra = rng.choice(m, n_clusters - cand_np.shape[0] + 1,
-                           replace=False)
+                           replace=False, p=p)
         cand_np = np.concatenate([cand_np, np.asarray(x[jnp.asarray(extra)])])
-    # weight candidates by how many points they serve
+    # weight candidates by how much (weighted) mass they serve
     _, labels = _assign(x, jnp.asarray(cand_np, x.dtype))
-    w = np.bincount(np.asarray(labels), minlength=cand_np.shape[0]) \
+    w = np.bincount(
+        np.asarray(labels), minlength=cand_np.shape[0],
+        weights=None if wts is None else np.asarray(wts, np.float64)) \
         .astype(np.float64) + 1e-3
     centers = _weighted_plus_plus(rng, cand_np.astype(np.float64), w,
                                   n_clusters)
@@ -207,7 +246,8 @@ def _kmeans_plus_plus(state: RngState, x, n_clusters: int,
 
 
 def _init_centroids(params: KMeansParams, state: RngState, x,
-                    centroids: Optional[jnp.ndarray]):
+                    centroids: Optional[jnp.ndarray],
+                    sample_weights=None):
     # An explicitly supplied centroid array always wins (warm start),
     # regardless of params.init — matching the reference's behavior where a
     # caller-provided centroids buffer with init=Array is the only way to
@@ -221,17 +261,23 @@ def _init_centroids(params: KMeansParams, state: RngState, x,
                                 (params.n_clusters,), replace=False)
         return x[idx]
     return _kmeans_plus_plus(state, x, params.n_clusters,
-                             params.oversampling_factor)
+                             params.oversampling_factor,
+                             sample_weights=sample_weights)
 
 
 @with_matmul_precision
 def kmeans_fit(res, params: KMeansParams, x,
-               centroids: Optional[jnp.ndarray] = None
+               centroids: Optional[jnp.ndarray] = None,
+               sample_weights=None
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
     """Lloyd's algorithm. Returns (centroids, inertia, labels, n_iter).
 
     Host-driven convergence loop around the jitted `lloyd_step` — the same
     structure as the reference lineage's host loop enqueueing fused kernels.
+
+    ``sample_weights`` [m] (ref/cuVS parity: fit's ``sample_weight``):
+    points contribute proportionally to the centroid update and the
+    inertia; None (the default) is the unweighted fused-kernel hot path.
 
     >>> import numpy as np
     >>> from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
@@ -244,16 +290,33 @@ def kmeans_fit(res, params: KMeansParams, x,
     >>> bool(np.asarray(labels)[:10].std() == 0)
     True
     """
+    import numpy as np
+
     x = jnp.asarray(x)
+    w = None if sample_weights is None else jnp.asarray(sample_weights)
+    if w is not None:
+        if w.shape != (x.shape[0],):
+            raise ValueError(
+                f"sample_weights shape {w.shape} != ({x.shape[0]},)")
+        w_host = np.asarray(w)
+        if not np.all(np.isfinite(w_host)) or np.any(w_host < 0):
+            raise ValueError(
+                "sample_weights must be finite and non-negative")
+        if w_host.sum() <= 0:
+            raise ValueError("sample_weights must have positive total")
     state = RngState(seed=params.seed)
-    c = _init_centroids(params, state, x, centroids)
+    c = _init_centroids(params, state, x, centroids, sample_weights=w)
     prev_inertia = None
     n_iter = 0
     labels = None
     check = max(1, int(params.check_every))
     inertia = jnp.asarray(jnp.inf, x.dtype)
     for n_iter in range(1, params.max_iter + 1):
-        c, inertia, labels = lloyd_step(x, c, params.n_clusters)
+        if w is None:
+            c, inertia, labels = lloyd_step(x, c, params.n_clusters)
+        else:
+            c, inertia, labels = weighted_lloyd_step(
+                x, w, c, params.n_clusters)
         if n_iter % check and n_iter != params.max_iter:
             continue                     # no host sync between polls
         if prev_inertia is not None and \
@@ -262,8 +325,11 @@ def kmeans_fit(res, params: KMeansParams, x,
             break
         prev_inertia = float(inertia)
     # lloyd_step's labels/inertia are measured against its *input* centroids;
-    # re-assign once so the returned triple is self-consistent.
-    labels, inertia = kmeans_predict(res, x, c)
+    # re-assign ONCE so the returned triple is self-consistent (one pass
+    # serves both labels and the [weighted] inertia).
+    dist, labels = _assign(x, c)
+    inertia = jnp.sum(dist) if w is None \
+        else jnp.sum(dist * w.astype(dist.dtype))
     return c, inertia, labels, n_iter
 
 
@@ -285,8 +351,10 @@ def kmeans_transform(res, x, centroids):
 
 @with_matmul_precision
 def kmeans_fit_predict(res, params: KMeansParams, x,
-                       centroids: Optional[jnp.ndarray] = None):
-    c, inertia, labels, n_iter = kmeans_fit(res, params, x, centroids)
+                       centroids: Optional[jnp.ndarray] = None,
+                       sample_weights=None):
+    c, inertia, labels, n_iter = kmeans_fit(
+        res, params, x, centroids, sample_weights=sample_weights)
     return c, inertia, labels, n_iter
 
 

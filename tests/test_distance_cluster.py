@@ -326,3 +326,76 @@ def test_cluster_cost_matches_predict_inertia():
     np.testing.assert_allclose(float(cost), float(inertia), rtol=1e-6)
     ref = ((x[:, None] - c[None]) ** 2).sum(-1).min(1).sum()
     np.testing.assert_allclose(float(cost), ref, rtol=1e-3)
+
+
+class TestWeightedKMeans:
+    def test_uniform_weights_match_unweighted(self):
+        """With the SAME init (pinned centroids — the weighted init uses
+        a different RNG draw, so seeding-level equality is not the
+        contract), w == ones must reproduce the unweighted iteration
+        math exactly."""
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        rng = np.random.default_rng(15)
+        x = rng.normal(size=(400, 8)).astype(np.float32)
+        init_c = x[:6].copy()
+        params = KMeansParams(n_clusters=6, max_iter=12, tol=0.0, seed=1)
+        c1, in1, l1, n1 = kmeans_fit(None, params, x, centroids=init_c)
+        w = np.ones(400, np.float32)
+        c2, in2, l2, n2 = kmeans_fit(None, params, x, centroids=init_c,
+                                     sample_weights=w)
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(float(in1), float(in2), rtol=1e-4)
+
+    def test_weights_equal_duplication(self):
+        """Weighting a point by k must equal duplicating it k times (the
+        defining property of sample weights; sklearn pins the same)."""
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        rng = np.random.default_rng(16)
+        base = rng.normal(size=(60, 4)).astype(np.float32)
+        reps = rng.integers(1, 4, size=60)
+        dup = np.repeat(base, reps, axis=0)
+        params = KMeansParams(n_clusters=4, max_iter=15, tol=0.0, seed=2,
+                              init=KMeansInit.ARRAY)
+        init_c = base[:4].copy()
+        cw, iw, _, _ = kmeans_fit(None, params, base, centroids=init_c,
+                                  sample_weights=reps.astype(np.float32))
+        cd, idp, _, _ = kmeans_fit(None, params, dup, centroids=init_c)
+        np.testing.assert_allclose(np.asarray(cw), np.asarray(cd),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(float(iw), float(idp), rtol=1e-3)
+
+    def test_zero_weight_points_ignored(self):
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        rng = np.random.default_rng(17)
+        x = np.concatenate([rng.normal(size=(100, 2)).astype(np.float32),
+                            np.full((5, 2), 100.0, np.float32)])
+        w = np.concatenate([np.ones(100), np.zeros(5)]).astype(np.float32)
+        params = KMeansParams(n_clusters=3, max_iter=20, seed=3)
+        c, inertia, labels, _ = kmeans_fit(None, params, x,
+                                           sample_weights=w)
+        # no centroid gets dragged to the zero-weight outliers
+        assert np.abs(np.asarray(c)).max() < 50.0
+
+    def test_bad_weight_shape_raises(self):
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        with pytest.raises(ValueError, match="sample_weights"):
+            kmeans_fit(None, KMeansParams(n_clusters=2, seed=0),
+                       np.zeros((10, 2), np.float32),
+                       sample_weights=np.ones(9, np.float32))
+
+    def test_invalid_weights_raise(self):
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        x = np.zeros((10, 2), np.float32)
+        p = KMeansParams(n_clusters=2, seed=0)
+        for bad in (np.full(10, -1.0, np.float32),
+                    np.zeros(10, np.float32),
+                    np.full(10, np.nan, np.float32)):
+            with pytest.raises(ValueError):
+                kmeans_fit(None, p, x, sample_weights=bad)
