@@ -87,7 +87,10 @@ def _finish_update(sums, counts, old_centroids):
     Sums/counts accumulate in float32 regardless of input dtype — bf16
     accumulation saturates (256 + 1 == 256 in bf16), which would silently
     mis-scale centroids for clusters with >256 members."""
-    safe = jnp.maximum(counts, 1.0)[:, None]
+    # counts can be FRACTIONAL under sample weights: dividing by
+    # max(counts, 1) would scale a cluster with total mass 0.3 down to
+    # 0.3x its true mean — divide by the actual positive mass instead
+    safe = jnp.where(counts > 0, counts, 1.0)[:, None]
     new = (sums / safe).astype(old_centroids.dtype)
     return jnp.where(counts[:, None] > 0, new, old_centroids)
 
@@ -119,6 +122,32 @@ def lloyd_step(x, centroids, n_clusters: int):
     return new_centroids, jnp.sum(dist), labels
 
 
+def _weighted_sums(x, w, labels, dist, n_clusters: int):
+    """Weighted (sums, counts, inertia_term) from an assignment — the
+    scatter-free one-hot contraction with w-scaled rows, shared by the
+    single-chip and both MNMG weighted update paths."""
+    wf = w.astype(jnp.float32)
+    oh = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)
+    sums = _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32)
+                                 * wf[:, None])
+    counts = oh.T @ wf
+    return sums, counts, jnp.sum(dist * wf)
+
+
+def _validate_sample_weights(w, n_rows: int):
+    """Shared fit-entry validation (both kmeans_fit and the MNMG fit)."""
+    import numpy as np
+
+    if w.shape != (n_rows,):
+        raise ValueError(
+            f"sample_weights shape {w.shape} != ({n_rows},)")
+    w_host = np.asarray(w)
+    if not np.all(np.isfinite(w_host)) or np.any(w_host < 0) \
+            or w_host.sum() <= 0:
+        raise ValueError("sample_weights must be finite, non-negative, "
+                         "with positive total")
+
+
 @with_matmul_precision
 @functools.partial(jax.jit, static_argnames=("n_clusters",))
 def weighted_lloyd_step(x, w, centroids, n_clusters: int):
@@ -129,13 +158,9 @@ def weighted_lloyd_step(x, w, centroids, n_clusters: int):
     XLA-side rather than the fused kernel (the unweighted fused path
     stays the hot default; w == ones reproduces lloyd_step exactly)."""
     dist, labels = _assign(x, centroids)
-    w = w.astype(jnp.float32)
-    oh = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)
-    sums = _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32)
-                                 * w[:, None])
-    counts = oh.T @ w
+    sums, counts, winertia = _weighted_sums(x, w, labels, dist, n_clusters)
     new_centroids = _finish_update(sums, counts, centroids)
-    return new_centroids, jnp.sum(dist * w), labels
+    return new_centroids, winertia, labels
 
 
 def _weighted_plus_plus(rng, cand, w, n_clusters: int):
@@ -295,15 +320,7 @@ def kmeans_fit(res, params: KMeansParams, x,
     x = jnp.asarray(x)
     w = None if sample_weights is None else jnp.asarray(sample_weights)
     if w is not None:
-        if w.shape != (x.shape[0],):
-            raise ValueError(
-                f"sample_weights shape {w.shape} != ({x.shape[0]},)")
-        w_host = np.asarray(w)
-        if not np.all(np.isfinite(w_host)) or np.any(w_host < 0):
-            raise ValueError(
-                "sample_weights must be finite and non-negative")
-        if w_host.sum() <= 0:
-            raise ValueError("sample_weights must have positive total")
+        _validate_sample_weights(w, x.shape[0])
     state = RngState(seed=params.seed)
     c = _init_centroids(params, state, x, centroids, sample_weights=w)
     prev_inertia = None
@@ -376,13 +393,16 @@ def cluster_cost(res, x, centroids):
 @with_matmul_precision
 def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
                     data_axis: str = "data",
-                    model_axis: Optional[str] = None):
+                    model_axis: Optional[str] = None,
+                    w_shard=None):
     """One Lloyd iteration *inside* shard_map.
 
     x_shard: this shard's rows [m_local, k]. centroids: replicated [K, k]
     (or the local block [K/s, k] when ``model_axis`` shards the cluster
     dimension). Partial sums/counts ride a psum over the data axis — the
-    reference's ncclAllReduce per iteration.
+    reference's ncclAllReduce per iteration. ``w_shard`` [m_local]
+    applies the reference's ``sample_weight`` semantics (weights shard
+    with the rows; the psums aggregate weighted mass identically).
     """
     if model_axis is not None:
         # Local argmin over this model shard's centroid block, then combine
@@ -403,19 +423,34 @@ def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
         oh = ((jax.lax.broadcasted_iota(jnp.int32, (x_shard.shape[0], kb), 1)
                == local_labels[:, None])
               & in_block[:, None]).astype(jnp.float32)
-        sums = _kernel_dot_exact_lhs(oh.T, x_shard.astype(jnp.float32))
-        counts = jnp.sum(oh, axis=0)
+        if w_shard is not None:
+            wf = w_shard.astype(jnp.float32)
+            sums = _kernel_dot_exact_lhs(
+                oh.T, x_shard.astype(jnp.float32) * wf[:, None])
+            counts = oh.T @ wf
+            inertia_local = jnp.sum(dist * wf)
+        else:
+            sums = _kernel_dot_exact_lhs(oh.T,
+                                         x_shard.astype(jnp.float32))
+            counts = jnp.sum(oh, axis=0)
+            inertia_local = jnp.sum(dist)
         sums = lax.psum(sums, data_axis)
         counts = lax.psum(counts, data_axis)
         new_c = _finish_update(sums, counts, centroids)
-        inertia = lax.psum(jnp.sum(dist), data_axis)
+        inertia = lax.psum(inertia_local, data_axis)
         return new_c, inertia, labels
 
-    sums, counts, dist, labels = _lloyd_sums(x_shard, centroids)
+    if w_shard is not None:
+        dist, labels = _assign(x_shard, centroids)
+        sums, counts, inertia_local = _weighted_sums(
+            x_shard, w_shard, labels, dist, n_clusters)
+    else:
+        sums, counts, dist, labels = _lloyd_sums(x_shard, centroids)
+        inertia_local = jnp.sum(dist)
     sums = lax.psum(sums, data_axis)            # ← the per-iter allreduce
     counts = lax.psum(counts, data_axis)
     new_c = _finish_update(sums, counts, centroids)
-    inertia = lax.psum(jnp.sum(dist), data_axis)
+    inertia = lax.psum(inertia_local, data_axis)
     return new_c, inertia, labels
 
 
@@ -423,7 +458,8 @@ def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
 def kmeans_fit_mnmg(res, params: KMeansParams, x,
                     centroids: Optional[jnp.ndarray] = None,
                     mesh=None, data_axis: str = "data",
-                    model_axis: Optional[str] = None):
+                    model_axis: Optional[str] = None,
+                    sample_weights=None):
     """MNMG Lloyd over a row-partitioned dataset (ref workload: raft-dask
     MNMG k-means; BASELINE config 5).
 
@@ -441,7 +477,12 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
 
     from raft_tpu.core import resources as core_res
 
+    import numpy as np
+
     x = jnp.asarray(x)
+    w = None if sample_weights is None else jnp.asarray(sample_weights)
+    if w is not None:
+        _validate_sample_weights(w, x.shape[0])
     if mesh is None:
         mesh = core_res.get_mesh(core_res.default_resources(res))
     # validate the sharding config BEFORE the (expensive) k-means|| seeding
@@ -455,31 +496,41 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
     else:
         c_spec = P()
     state = RngState(seed=params.seed)
-    c = _init_centroids(params, state, x, centroids)
+    c = _init_centroids(params, state, x, centroids, sample_weights=w)
 
     x = jax.device_put(x, NamedSharding(mesh, P(data_axis)))
     c = jax.device_put(c, NamedSharding(mesh, c_spec))
+    if w is not None:
+        w = jax.device_put(w, NamedSharding(mesh, P(data_axis)))
 
-    # n_clusters is vestigial in mnmg_lloyd_step (the shard derives its
-    # block size from the sharded centroids' shape); pass the per-shard
-    # truth anyway so a future reader of the step sees consistent values
+    # per-shard cluster count: the model-axis branch derives its block
+    # from the sharded centroids' shape, but the WEIGHTED data-parallel
+    # branch uses n_clusters as the one-hot width — it must be the
+    # per-shard truth
     per_shard_k = (params.n_clusters if model_axis is None
                    else params.n_clusters // mesh.shape[model_axis])
-    step = jax.jit(
-        jax.shard_map(
-            functools.partial(
-                mnmg_lloyd_step, n_clusters=per_shard_k,
-                data_axis=data_axis, model_axis=model_axis),
-            mesh=mesh,
-            in_specs=(P(data_axis), c_spec),
-            out_specs=(c_spec, P(), P(data_axis)),
-        ))
+    step_fn = functools.partial(
+        mnmg_lloyd_step, n_clusters=per_shard_k,
+        data_axis=data_axis, model_axis=model_axis)
+    if w is None:
+        in_specs = (P(data_axis), c_spec)
+        body = step_fn
+    else:
+        in_specs = (P(data_axis), c_spec, P(data_axis))
+        body = lambda xs, cs, ws: step_fn(xs, cs, w_shard=ws)  # noqa: E731
+    step = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs,
+        out_specs=(c_spec, P(), P(data_axis))))
+
+    def run(cur_c):
+        args = (x, cur_c) if w is None else (x, cur_c, w)
+        return step(*args)
 
     prev = None
     n_iter = 0
     check = max(1, int(params.check_every))
     for n_iter in range(1, params.max_iter + 1):
-        c, inertia, labels = step(x, c)
+        c, inertia, labels = run(c)
         if n_iter % check and n_iter != params.max_iter:
             continue                     # no host sync between polls
         if prev is not None and abs(prev - float(inertia)) <= \
@@ -489,5 +540,5 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
     # re-assign against the FINAL centroids for a self-consistent return:
     # one more step gives labels + inertia vs c (its centroid update is
     # discarded) — works identically on 1-D and 2-D meshes
-    _, inertia, labels = step(x, c)
+    _, inertia, labels = run(c)
     return c, inertia, labels, n_iter

@@ -399,3 +399,63 @@ class TestWeightedKMeans:
                     np.full(10, np.nan, np.float32)):
             with pytest.raises(ValueError):
                 kmeans_fit(None, p, x, sample_weights=bad)
+
+    def test_mnmg_weighted_matches_single(self, mesh8):
+        """Weighted MNMG fit (1-D and 2-D mesh) == weighted single-device
+        fit for identical init — weights shard with the rows and the
+        psums aggregate the same weighted mass."""
+        import jax
+        from jax.sharding import Mesh
+
+        from raft_tpu.cluster.kmeans import (KMeansParams, kmeans_fit,
+                                             kmeans_fit_mnmg)
+
+        rng = np.random.default_rng(19)
+        x = rng.normal(size=(512, 8)).astype(np.float32)
+        w = rng.uniform(0.1, 3.0, size=512).astype(np.float32)
+        init_c = x[11 * np.arange(4)].copy()
+        params = KMeansParams(n_clusters=4, init=KMeansInit.ARRAY,
+                              max_iter=8, tol=0.0, seed=5)
+        c0, in0, l0, _ = kmeans_fit(None, params, x, centroids=init_c,
+                                    sample_weights=w)
+        c1, in1, l1, _ = kmeans_fit_mnmg(None, params, x,
+                                         centroids=init_c, mesh=mesh8,
+                                         data_axis="data",
+                                         sample_weights=w)
+        np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+        np.testing.assert_allclose(float(in0), float(in1), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(c0), np.asarray(c1),
+                                   rtol=1e-3, atol=1e-3)
+        devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+        mesh2 = Mesh(devs, axis_names=("data", "model"))
+        c2, in2, l2, _ = kmeans_fit_mnmg(None, params, x,
+                                         centroids=init_c, mesh=mesh2,
+                                         data_axis="data",
+                                         model_axis="model",
+                                         sample_weights=w)
+        np.testing.assert_array_equal(np.asarray(l0), np.asarray(l2))
+        np.testing.assert_allclose(float(in0), float(in2), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(c0), np.asarray(c2),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_small_scale_weights_are_scale_invariant(self):
+        """Weights are a relative measure: scaling all weights by 0.01
+        must not change the fit (regression: max(counts, 1) in the update
+        collapsed clusters whose total weighted mass fell below 1)."""
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        rng = np.random.default_rng(23)
+        x = rng.normal(size=(200, 4)).astype(np.float32)
+        init_c = x[:5].copy()
+        params = KMeansParams(n_clusters=5, init=KMeansInit.ARRAY,
+                              max_iter=10, tol=0.0, seed=6)
+        c1, in1, l1, _ = kmeans_fit(None, params, x, centroids=init_c,
+                                    sample_weights=np.ones(200, np.float32))
+        c2, in2, l2, _ = kmeans_fit(
+            None, params, x, centroids=init_c,
+            sample_weights=np.full(200, 0.01, np.float32))
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(float(in2), 0.01 * float(in1),
+                                   rtol=1e-4)
