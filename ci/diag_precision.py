@@ -1,0 +1,139 @@
+"""On-chip precision diagnosis for the smoke-tier accuracy failures.
+
+Round-3 smoke run (01:06 window) failed pairwise_l2 / fused_argmin-small /
+fused_lloyd / knn / precision_tiers / lloyd_in_shard_map at the default
+'high' tier while cosine / tiled-argmin / select_k passed — consistent with
+the bf16x3 split NOT delivering its ~2^-17 contract on the real chip. This
+script isolates where: plain XLA dots at each lax.Precision, the in-kernel
+_kernel_dot tiers, the pre-split kernel path, and the fused epilogue —
+one JSON line per probe, flushed immediately (a wedged tunnel loses the
+tail, not the run).
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def rel_err(got, ref):
+    got = np.asarray(got, np.float64)
+    return float((np.abs(got - ref) / np.maximum(np.abs(ref), 1e-9)).max())
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    emit(probe="backend", backend=jax.default_backend(),
+         device=str(jax.devices()[0]))
+
+    rng = np.random.default_rng(11)
+    a = rng.normal(size=(512, 96)).astype(np.float32)
+    b = rng.normal(size=(96, 256)).astype(np.float32)
+    ref = a.astype(np.float64) @ b.astype(np.float64)
+
+    # 1. plain XLA dot at each lax.Precision — does the chip honor the
+    # precision attribute at all outside Pallas?
+    for prec in ("default", "high", "highest"):
+        try:
+            d = jax.jit(lambda x, y: jnp.dot(
+                x, y, precision=prec))(a, b)
+            emit(probe="xla_dot", precision=prec, rel_err=rel_err(d, ref))
+        except Exception as e:   # noqa: BLE001
+            emit(probe="xla_dot", precision=prec,
+                 error=f"{type(e).__name__}: {e}"[:200])
+
+    # 2. manual bf16x3 split OUTSIDE Pallas (plain XLA) — is the split
+    # algebra sound on this chip?
+    try:
+        def split3(x, y):
+            xh = x.astype(jnp.bfloat16)
+            xl = (x - xh.astype(jnp.float32)).astype(jnp.bfloat16)
+            yh = y.astype(jnp.bfloat16)
+            yl = (y - yh.astype(jnp.float32)).astype(jnp.bfloat16)
+            f32 = jnp.float32
+            kw = dict(preferred_element_type=f32,
+                      precision=jax.lax.Precision.DEFAULT)
+            return (jnp.dot(xh, yh, **kw) + jnp.dot(xh, yl, **kw)
+                    + jnp.dot(xl, yh, **kw))
+        d = jax.jit(split3)(a, b)
+        emit(probe="xla_manual_split3", rel_err=rel_err(d, ref))
+    except Exception as e:   # noqa: BLE001
+        emit(probe="xla_manual_split3", error=f"{type(e).__name__}: {e}"[:200])
+
+    # 3. _kernel_dot inside a minimal pallas_call at each tier
+    import raft_tpu
+    from jax.experimental import pallas as pl
+    from raft_tpu.linalg import contractions as C
+
+    def dot_kernel(x_ref, y_ref, o_ref):
+        o_ref[:] = C._kernel_dot(x_ref[:], y_ref[:])
+
+    for tier in ("default", "high", "highest"):
+        try:
+            raft_tpu.set_matmul_precision(tier)
+            d = pl.pallas_call(
+                dot_kernel,
+                out_shape=jax.ShapeDtypeStruct((512, 256), jnp.float32),
+            )(a, b)
+            emit(probe="pallas_kernel_dot", tier=tier,
+                 rel_err=rel_err(d, ref))
+        except Exception as e:   # noqa: BLE001
+            emit(probe="pallas_kernel_dot", tier=tier,
+                 error=f"{type(e).__name__}: {e}"[:250])
+
+    # 4. the actual failing entry points at each tier
+    x = rng.normal(size=(300, 70)).astype(np.float32)
+    y = rng.normal(size=(150, 70)).astype(np.float32)
+    l2_ref = ((x[:, None, :].astype(np.float64)
+               - y[None, :, :].astype(np.float64)) ** 2).sum(-1)
+    for tier in ("default", "high", "highest"):
+        try:
+            raft_tpu.set_matmul_precision(tier)
+            d = C.pairwise_l2_pallas(x, y)
+            emit(probe="pairwise_l2", tier=tier, rel_err=rel_err(d, l2_ref))
+        except Exception as e:   # noqa: BLE001
+            emit(probe="pairwise_l2", tier=tier,
+                 error=f"{type(e).__name__}: {e}"[:250])
+
+    # 5. fused_lloyd sums vs oracle built from ITS OWN labels (r2 failure
+    # showed 27% rel on sums — label-independent check of the one-hot
+    # accumulation path)
+    try:
+        raft_tpu.set_matmul_precision("high")
+        xs = rng.normal(size=(1000, 33)).astype(np.float32)
+        ys = rng.normal(size=(37, 33)).astype(np.float32)
+        sums, counts, val, idx = C.fused_lloyd_pallas(xs, ys)
+        lab = np.asarray(idx)
+        sums_ref = np.zeros((37, 33), np.float64)
+        np.add.at(sums_ref, lab, xs.astype(np.float64))
+        bad = np.abs(np.asarray(sums, np.float64) - sums_ref)
+        emit(probe="fused_lloyd_sums", tier="high",
+             max_abs_err=float(bad.max()),
+             count_ok=bool((np.asarray(counts)
+                            == np.bincount(lab, minlength=37)).all()))
+    except Exception as e:   # noqa: BLE001
+        emit(probe="fused_lloyd_sums", error=f"{type(e).__name__}: {e}"[:250])
+
+    # 6. argmin agreement at 'high' on the small failing shape
+    try:
+        raft_tpu.set_matmul_precision("high")
+        xa = rng.normal(size=(257, 19)).astype(np.float32)
+        ya = rng.normal(size=(31, 19)).astype(np.float32)
+        dref = ((xa[:, None, :].astype(np.float64)
+                 - ya[None, :, :].astype(np.float64)) ** 2).sum(-1)
+        val, idx = C.fused_l2_argmin_pallas(xa, ya)
+        agree = float((np.asarray(idx) == dref.argmin(1)).mean())
+        emit(probe="fused_argmin_small", tier="high", agreement=agree)
+    except Exception as e:   # noqa: BLE001
+        emit(probe="fused_argmin_small",
+             error=f"{type(e).__name__}: {e}"[:250])
+
+
+if __name__ == "__main__":
+    main()

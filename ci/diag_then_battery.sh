@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Wait for the TPU tunnel, capture the precision diagnosis FIRST (short,
+# bounded — the decision data for the smoke-tier accuracy failures), then
+# hand off to the full battery.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p tpu_battery_out
+
+probe() {
+    timeout -k 15 240 python -c "import jax; assert jax.default_backend()=='tpu'" \
+        >/dev/null 2>&1
+}
+
+reached=""
+for i in $(seq 1 2000); do
+    if probe; then
+        echo "[diag] TPU reachable (attempt $i) $(date +%H:%M:%S)"
+        reached=1
+        break
+    fi
+    sleep 120
+done
+
+if [ -n "$reached" ]; then
+    echo "[diag] running precision diagnosis $(date +%H:%M:%S)"
+    timeout -k 30 900 python ci/diag_precision.py \
+        > tpu_battery_out/diag_precision.jsonl \
+        2> tpu_battery_out/diag_precision.err
+    echo "[diag] rc=$? — results:"
+    cat tpu_battery_out/diag_precision.jsonl
+else
+    echo "[diag] TPU never came back; skipping diagnosis"
+fi
+
+# hand off either way — the battery has its own wait/give-up logic
+exec bash ci/tpu_battery.sh

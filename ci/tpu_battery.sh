@@ -82,12 +82,39 @@ HEAD_SHA="$(git rev-parse HEAD 2>/dev/null || echo unknown)-$(
     | sha1sum | cut -c1-12)"   # battery's own output mutations excluded
 if [ "$(cat tpu_battery_out/smoke_green 2>/dev/null)" != "$HEAD_SHA" ]; then
     echo "[battery] running tpu_tests smoke tier (HEAD $HEAD_SHA)"
-    timeout -k 30 1800 python -m pytest tpu_tests -q \
-        > tpu_battery_out/tpu_smoke.txt 2>&1
-    rc=$?
-    echo "[battery] smoke rc=$rc (tail below)"
-    tail -3 tpu_battery_out/tpu_smoke.txt
-    if [ "$rc" = 0 ]; then echo "$HEAD_SHA" > tpu_battery_out/smoke_green; fi
+    # ONE PROCESS PER TEST, output appended incrementally: pytest only
+    # prints its FAILURES section at session end, so the 01:06 wedge mid-
+    # session lost every traceback — per-test isolation turns a wedge
+    # into one truncated case instead of a lost tier (same lesson as the
+    # per-family sweep below)
+    : > tpu_battery_out/tpu_smoke.txt
+    SMOKE_RC=0
+    SMOKE_IDS=$(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+                python -m pytest tpu_tests -q --collect-only -p no:cacheprovider 2>/dev/null \
+                | grep '::')
+    if [ -z "$SMOKE_IDS" ]; then
+        # collection failed (import error etc.) — that is a red tier, not
+        # a vacuous green one
+        echo "[battery] smoke COLLECTION FAILED" \
+            | tee -a tpu_battery_out/tpu_smoke.txt
+        SMOKE_RC=1
+    fi
+    while IFS= read -r t; do
+        [ -n "$t" ] || continue
+        if ! probe; then
+            echo "[battery] tunnel gone mid-smoke; waiting" \
+                | tee -a tpu_battery_out/tpu_smoke.txt
+            wait_for_tpu || { SMOKE_RC=1; break; }
+        fi
+        echo "=== $t ===" >> tpu_battery_out/tpu_smoke.txt
+        timeout -k 30 420 python -m pytest "$t" -q --tb=short \
+            -p no:cacheprovider >> tpu_battery_out/tpu_smoke.txt 2>&1
+        rc=$?
+        [ "$rc" = 0 ] || SMOKE_RC=1
+        echo "[battery] smoke rc=$rc $t"
+    done <<< "$SMOKE_IDS"
+    echo "[battery] smoke tier overall rc=$SMOKE_RC"
+    if [ "$SMOKE_RC" = 0 ]; then echo "$HEAD_SHA" > tpu_battery_out/smoke_green; fi
 else
     echo "[battery] smoke already green at $HEAD_SHA; skipping"
 fi
