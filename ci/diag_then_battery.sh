@@ -6,22 +6,9 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p tpu_battery_out
 
-probe() {
-    timeout -k 15 240 python -c "import jax; assert jax.default_backend()=='tpu'" \
-        >/dev/null 2>&1
-}
+. ci/tpu_common.sh   # probe / wait_for_tpu (we cd'd to repo root above)
 
-reached=""
-for i in $(seq 1 2000); do
-    if probe; then
-        echo "[diag] TPU reachable (attempt $i) $(date +%H:%M:%S)"
-        reached=1
-        break
-    fi
-    sleep 120
-done
-
-if [ -n "$reached" ]; then
+if wait_for_tpu; then
     echo "[diag] running precision diagnosis $(date +%H:%M:%S)"
     timeout -k 30 900 python ci/diag_precision.py \
         > tpu_battery_out/diag_precision.jsonl \

@@ -18,22 +18,7 @@ OUT=tpu_battery_out/bench_full.jsonl
 ERR=tpu_battery_out/bench_full.err
 touch "$OUT"
 
-probe() {
-    timeout -k 15 240 python -c "import jax; assert jax.default_backend()=='tpu'" \
-        >/dev/null 2>&1
-}
-
-wait_for_tpu() {
-    for i in $(seq 1 2000); do
-        if probe; then
-            echo "[battery] TPU reachable (attempt $i) $(date +%H:%M:%S)"
-            return 0
-        fi
-        sleep 120
-    done
-    echo "[battery] TPU never came back; giving up"
-    return 1
-}
+. ci/tpu_common.sh   # probe / wait_for_tpu (we cd'd to repo root above)
 
 # Refresh the driver-readable north-star artifact. Atomic: write to a temp
 # file, accept only if the output parses as a backend=tpu JSON line with no
@@ -107,10 +92,20 @@ if [ "$(cat tpu_battery_out/smoke_green 2>/dev/null)" != "$HEAD_SHA" ]; then
             wait_for_tpu || { SMOKE_RC=1; break; }
         fi
         echo "=== $t ===" >> tpu_battery_out/tpu_smoke.txt
+        TLOG=tpu_battery_out/.smoke_one.tmp
         timeout -k 30 420 python -m pytest "$t" -q --tb=short \
-            -p no:cacheprovider >> tpu_battery_out/tpu_smoke.txt 2>&1
+            -p no:cacheprovider > "$TLOG" 2>&1
         rc=$?
-        [ "$rc" = 0 ] || SMOKE_RC=1
+        cat "$TLOG" >> tpu_battery_out/tpu_smoke.txt
+        # tpu_tests/conftest.py SKIPS (exit 0) when the backend isn't tpu
+        # — e.g. the tunnel dropped between probe and jax init. A skip is
+        # NOT a pass for the hardware tier: without this check the loop
+        # could write smoke_green for a tier that never touched the chip.
+        if [ "$rc" != 0 ] || ! grep -q "1 passed" "$TLOG" \
+           || grep -q "skipped" "$TLOG"; then
+            SMOKE_RC=1
+        fi
+        rm -f "$TLOG"
         echo "[battery] smoke rc=$rc $t"
     done <<< "$SMOKE_IDS"
     echo "[battery] smoke tier overall rc=$SMOKE_RC"

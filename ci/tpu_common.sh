@@ -1,0 +1,20 @@
+# Shared TPU-tunnel helpers, sourced by ci/tpu_battery.sh and
+# ci/diag_then_battery.sh — ONE definition of "TPU reachable" so the
+# gate and the battery can't drift apart.
+
+probe() {
+    timeout -k 15 240 python -c "import jax; assert jax.default_backend()=='tpu'" \
+        >/dev/null 2>&1
+}
+
+wait_for_tpu() {
+    for i in $(seq 1 2000); do
+        if probe; then
+            echo "[tpu] reachable (attempt $i) $(date +%H:%M:%S)"
+            return 0
+        fi
+        sleep 120
+    done
+    echo "[tpu] never came back; giving up"
+    return 1
+}

@@ -92,16 +92,18 @@ def _kernel_dot(a, b, exact_lhs: bool = False):
         return jnp.dot(a, b, preferred_element_type=f32,
                        precision=_ONE_PASS)
     if mode == "high":
-        a_hi = a.astype(jnp.bfloat16)
-        b_hi = b.astype(jnp.bfloat16)
+        a_hi_f = _round_to_bf16_f32(a)
+        b_hi_f = _round_to_bf16_f32(b)
+        a_hi = a_hi_f.astype(jnp.bfloat16)
+        b_hi = b_hi_f.astype(jnp.bfloat16)
         out = jnp.dot(a_hi, b_hi, preferred_element_type=f32,
                       precision=_ONE_PASS)
         if not b_exact:
-            b_lo = (b - b_hi.astype(f32)).astype(jnp.bfloat16)
+            b_lo = (b - b_hi_f).astype(jnp.bfloat16)
             out = out + jnp.dot(a_hi, b_lo, preferred_element_type=f32,
                                 precision=_ONE_PASS)
         if not a_exact:
-            a_lo = (a - a_hi.astype(f32)).astype(jnp.bfloat16)
+            a_lo = (a - a_hi_f).astype(jnp.bfloat16)
             out = out + jnp.dot(a_lo, b_hi, preferred_element_type=f32,
                                 precision=_ONE_PASS)
         return out
@@ -120,6 +122,36 @@ def _pad2(x: jnp.ndarray, rows: int, cols: int) -> jnp.ndarray:
     return x
 
 
+def _round_to_bf16_f32(a):
+    """Round f32 to its nearest-bf16 value (round-half-to-even), KEPT in
+    f32 — via integer bit arithmetic.
+
+    The natural spelling ``a_hi.astype(f32)`` (a bf16→f32 convert right
+    after an f32→bf16 one) is a convert PAIR that XLA's algebraic
+    simplifier deletes under ``--xla_allow_excess_precision`` (on by
+    default on TPU): the residual ``a - a_hi`` then folds to ZERO and the
+    bf16x3 'high' tier silently becomes ONE bf16 pass. That is invisible
+    on CPU (every CPU path is f32-exact) and was caught only by the
+    on-chip smoke tier (pairwise rel-err ~1.5e-3 ≈ single-pass, knn
+    agreement 0.95). ``lax.reduce_precision`` is the canonical guard but
+    has no Mosaic lowering, so kernels and HBM pre-split share this
+    bitcast spelling instead — it rounds identically to ``astype``
+    (pinned by tests/test_precision.py) and is opaque to the simplifier.
+
+    NaN inputs produce a GARBAGE hi half (the +0x7FFF carry can walk the
+    payload through the exponent into the sign bit: quiet-NaN 0x7FC00000
+    → inf, full-payload 0x7FFFFFFF → -0.0) — but the lo half
+    ``a - hi`` is NaN for every NaN input, so NaN still propagates
+    through any split dot that includes the lo pass. Callers that skip
+    the lo pass (``exact_lhs``/bf16-exact operands) never see NaN there:
+    bf16 inputs take the single-pass branch before any split.
+    """
+    u = jax.lax.bitcast_convert_type(a, jnp.uint32)
+    u = u + jnp.uint32(0x7FFF) + ((u >> 16) & jnp.uint32(1))
+    return jax.lax.bitcast_convert_type(u & jnp.uint32(0xFFFF0000),
+                                        jnp.float32)
+
+
 def _split_hi_lo(a):
     """f32 → (hi, lo) bf16 halves with a ≈ hi + lo (~2^-17 residual).
 
@@ -127,9 +159,12 @@ def _split_hi_lo(a):
     tier-'high' operand format, so kernels never re-split per grid step
     (the resident-Y kernels used to pay the split np_×kp cast every one
     of their m/tm steps), and the pair costs exactly the same bytes as
-    the f32 original (2+2 vs 4)."""
-    hi = a.astype(jnp.bfloat16)
-    lo = (a - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    the f32 original (2+2 vs 4). The hi rounding goes through
+    :func:`_round_to_bf16_f32` so the residual survives XLA's
+    excess-precision convert-pair elision."""
+    hi_f = _round_to_bf16_f32(a)
+    hi = hi_f.astype(jnp.bfloat16)
+    lo = (a - hi_f).astype(jnp.bfloat16)
     return hi, lo
 
 
