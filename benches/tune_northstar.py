@@ -88,6 +88,22 @@ def main():
     finally:
         prec.set_matmul_precision(old)
 
+    # -- bf16 END-TO-END inputs (VERDICT #3's "bf16-input end-to-end"
+    # lever): when the caller's data is ALREADY bf16, every dot is one
+    # exact MXU pass (bf16×bf16 accumulates in f32 — no split needed, no
+    # accuracy tier in play) and X tiles move half the HBM bytes. This is
+    # the honest fast path: full accuracy RELATIVE TO THE DATA's own
+    # precision, unlike tier 'default' which silently rounds f32 data.
+    try:
+        xb, cb = x.astype(jnp.bfloat16), c.astype(jnp.bfloat16)
+        jax.block_until_ready((xb, cb))
+        fb = jax.jit(functools.partial(lloyd_step, n_clusters=n_clusters))
+        ms = time_loop(lambda: fb(xb, cb), iters)
+        emit(case="bf16_inputs", ms_per_iter=round(ms, 3),
+             iters_per_s=round(1e3 / ms, 2))
+    except Exception as e:   # noqa: BLE001
+        emit(case="bf16_inputs", error=f"{type(e).__name__}: {e}"[:200])
+
     # -- tier sweep at auto tm -------------------------------------------
     old = prec.get_matmul_precision()
     step = functools.partial(lloyd_step, n_clusters=n_clusters)
