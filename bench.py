@@ -152,10 +152,28 @@ def run():
         except Exception as e:   # noqa: BLE001 — provenance only
             probe_rel_err = f"error: {type(e).__name__}: {e}"[:160]
 
+    # Prepared path when it applies (tier 'high', f32, resident): the
+    # loop-invariant X split+norms are hoisted exactly as kmeans_fit's
+    # own loop does — bit-identical steps, ~1.3 GB/iter less HBM traffic.
+    from raft_tpu.cluster.kmeans import lloyd_step_prepared
+    from raft_tpu.linalg.contractions import lloyd_prepare
+
+    ops, meta = lloyd_prepare(x, n_clusters)
+    if ops is not None:
+        jax.block_until_ready(ops)
+        cc, inertia, _ = lloyd_step_prepared(ops, c, **meta)
+        float(inertia)                       # warm the prepared executable
+
+        def step(cc):
+            return lloyd_step_prepared(ops, cc, **meta)
+    else:
+        def step(cc):
+            return lloyd_step(x, cc, n_clusters)
+
     t0 = time.perf_counter()
     cc = c
     for _ in range(iters):
-        cc, inertia, labels = lloyd_step(x, cc, n_clusters)
+        cc, inertia, labels = step(cc)
     float(inertia)  # true synchronization point
     dt = time.perf_counter() - t0
 
@@ -175,6 +193,7 @@ def run():
         "vs_baseline": round(gflops / peak, 4),
         "backend": backend,
         "tier": current_mode(),
+        "prepared": ops is not None,
     }
     if probe_rel_err is not None:
         line["probe_rel_err"] = probe_rel_err

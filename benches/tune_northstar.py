@@ -88,6 +88,30 @@ def main():
     finally:
         prec.set_matmul_precision(old)
 
+    # -- prepared loop: X split+norms hoisted out of the iteration
+    # (lloyd_prepare) vs recomputed every step — the measured value of
+    # ~1.3 GB/iter of avoided HBM traffic at tier 'high'
+    old = prec.get_matmul_precision()
+    try:
+        prec.set_matmul_precision("high")    # prepare only applies at 'high'
+        from raft_tpu.cluster.kmeans import lloyd_step_prepared
+        from raft_tpu.linalg.contractions import lloyd_prepare
+
+        ops_prep, meta = lloyd_prepare(x, n_clusters)
+        if ops_prep is None:
+            emit(case="prepared_loop", error="prepare declined")
+        else:
+            jax.block_until_ready(ops_prep)
+            ms = time_loop(lambda: lloyd_step_prepared(ops_prep, c, **meta),
+                           iters)
+            emit(case="prepared_loop", tier="high",
+                 ms_per_iter=round(ms, 3),
+                 iters_per_s=round(1e3 / ms, 2))
+    except Exception as e:   # noqa: BLE001
+        emit(case="prepared_loop", error=f"{type(e).__name__}: {e}"[:200])
+    finally:
+        prec.set_matmul_precision(old)
+
     # -- bf16 END-TO-END inputs (VERDICT #3's "bf16-input end-to-end"
     # lever): when the caller's data is ALREADY bf16, every dot is one
     # exact MXU pass (bf16×bf16 accumulates in f32 — no split needed, no

@@ -122,6 +122,23 @@ def lloyd_step(x, centroids, n_clusters: int):
     return new_centroids, jnp.sum(dist), labels
 
 
+@with_matmul_precision
+@functools.partial(jax.jit, static_argnames=("tm", "m"))
+def lloyd_step_prepared(ops, centroids, *, tm: int, m: int):
+    """:func:`lloyd_step` against hoisted X operands (see
+    `raft_tpu.linalg.contractions.lloyd_prepare`): at tier 'high' the
+    invariant bf16 hi/lo split + row norms of X are produced once per
+    fit instead of once per iteration (~1.3 GB/iter of HBM traffic at
+    1M×128). Bit-identical to :func:`lloyd_step` — same kernel, same
+    operand bytes."""
+    from raft_tpu.linalg.contractions import fused_lloyd_prepared
+
+    sums, counts, dist, labels = fused_lloyd_prepared(
+        ops, centroids, tm=tm, m=m)
+    new_centroids = _finish_update(sums, counts, centroids)
+    return new_centroids, jnp.sum(dist), labels
+
+
 def _weighted_sums(x, w, labels, dist, n_clusters: int):
     """Weighted (sums, counts, inertia_term) from an assignment — the
     scatter-free one-hot contraction with w-scaled rows, shared by the
@@ -328,8 +345,17 @@ def kmeans_fit(res, params: KMeansParams, x,
     labels = None
     check = max(1, int(params.check_every))
     inertia = jnp.asarray(jnp.inf, x.dtype)
+    # Hoist the loop-invariant X operand work (tier-'high' split + norms)
+    # out of the Lloyd loop; (None, None) when the prepared path doesn't
+    # apply and the plain step is used unchanged.
+    from raft_tpu.linalg.contractions import lloyd_prepare
+
+    ops, meta = (None, None) if w is not None \
+        else lloyd_prepare(x, params.n_clusters)
     for n_iter in range(1, params.max_iter + 1):
-        if w is None:
+        if ops is not None:
+            c, inertia, labels = lloyd_step_prepared(ops, c, **meta)
+        elif w is None:
             c, inertia, labels = lloyd_step(x, c, params.n_clusters)
         else:
             c, inertia, labels = weighted_lloyd_step(

@@ -378,18 +378,24 @@ def _pairwise_padded_split(xh, xl, xn, yh, yl, yn, tm: int, tn: int,
     )(xh, xl, xn, yh, yl, yn)
 
 
-@functools.partial(jax.jit, static_argnames=("mp", "np_", "kp"))
+@functools.partial(jax.jit, static_argnames=("rows", "kp"))
+def _split_side(a, rows: int, kp: int):
+    """Pad one operand to its tile multiple, split to the bf16 pair,
+    precompute f32 squared norms laid out as a (1, rows) block. Jitted so
+    the pad/cast/subtract/norm steps fuse into one dispatch instead of
+    eager HBM round-trips (callers already inside jit inline it free).
+    Shared by :func:`_split_operands` (both sides, per call) and
+    :func:`lloyd_prepare` (X side, hoisted out of the Lloyd loop) — ONE
+    production path so the prepared-loop bit-identical contract can't
+    drift."""
+    ap = _pad2(a, rows, kp)
+    h, lo = _split_hi_lo(ap)
+    return h, lo, _sq_norms(ap)[None, :]
+
+
 def _split_operands(x, y, mp: int, np_: int, kp: int):
-    """Pad to tile multiples, split to bf16 pairs, precompute f32 squared
-    norms laid out as (1, m) blocks for the kernels. Jitted so the ~10
-    pad/cast/subtract/norm steps fuse into one dispatch instead of eager
-    HBM round-trips (callers already inside jit inline it for free)."""
-    xp = _pad2(x, mp, kp)
-    yp = _pad2(y, np_, kp)
-    xh, xl = _split_hi_lo(xp)
-    yh, yl = _split_hi_lo(yp)
-    xn = _sq_norms(xp)[None, :]
-    yn = _sq_norms(yp)[None, :]
+    xh, xl, xn = _split_side(x, mp, kp)
+    yh, yl, yn = _split_side(y, np_, kp)
     return xh, xl, xn, yh, yl, yn
 
 
@@ -910,6 +916,94 @@ def _fused_lloyd_padded(x, y, tm: int, n_valid: int, m_valid: int):
     )(x, y)
 
 
+def _lloyd_tile_plan(m: int, k: int, n: int, itemsize: int,
+                     tm: Optional[int]):
+    """The fused-Lloyd tile selection — ONE copy shared by
+    :func:`fused_lloyd_pallas` and :func:`lloyd_prepare`, because the
+    prepared path's bit-identical contract requires both to pick the
+    same tiles. Returns ``(tm, mp, kp, np_)``; ``tm is None`` means the
+    Y+sums working set exceeds VMEM residency (callers take the chunked
+    fallback)."""
+    kp = round_up_to_multiple(k, 128)
+    np_ = round_up_to_multiple(n, 128)
+    const = np_ * kp * (itemsize + 4) + 4 * np_   # y + sums + counts
+    auto_tm = _pick_tm(kp, np_, mn_bufs=2, const_bytes=const,
+                       itemsize=itemsize)
+    # explicit tm (the tuning sweep's knob) is honored whenever it fits
+    # VMEM — NOT min()'d against the preference order, which would cap
+    # every request at the preferred 256; unsafe requests fall back to
+    # auto
+    if tm is None:
+        tm = auto_tm
+    elif auto_tm is None or not _tm_fits(tm, kp, np_, 2, const, itemsize):
+        tm = auto_tm
+    if tm is None:
+        return None, None, kp, np_
+    tm = max(8, round_up_to_multiple(min(tm, m), 8))
+    return tm, round_up_to_multiple(m, tm), kp, np_
+
+
+@with_matmul_precision
+def lloyd_prepare(x, n_clusters: int, tm: Optional[int] = None):
+    """Hoist the LOOP-INVARIANT operand work of the tier-'high' fused
+    Lloyd kernel out of the iteration loop.
+
+    At tier 'high' every :func:`fused_lloyd_pallas` call re-derives X's
+    bf16 hi/lo halves and squared norms — ~1.3 GB of HBM traffic per
+    iteration at the north-star shape (1M×128: read 512 MB f32, write
+    2×256 MB bf16 + 4 MB norms) that is identical across Lloyd
+    iterations because X never changes. The reference hoists the same
+    way: cuVS k-means precomputes row norms once per fit, outside the
+    minimum-distance loop. Returns ``(ops, meta)``:
+
+    - ``ops``: tuple of device arrays (xh, xl, xn) padded to the chosen
+      tile grid — pass to :func:`fused_lloyd_prepared` every iteration.
+    - ``meta``: dict of STATIC kwargs for :func:`fused_lloyd_prepared`
+      (tile size, true row count).
+
+    Returns ``(None, None)`` when the prepared path does not apply —
+    any of: tier ≠ 'high', non-f32 dtype, interpreter mode, or Y+sums
+    exceeding VMEM residency (the chunked fallback path) — callers then
+    use :func:`fused_lloyd_pallas` unchanged. Outputs of the prepared
+    step are BIT-IDENTICAL to the unprepared call: same kernel, same
+    operand bytes, only their production is hoisted.
+    """
+    x = jnp.asarray(x)
+    m, k = x.shape
+    if (current_mode() != "high" or x.dtype != jnp.float32
+            or interpret_needs_ref(x)):
+        return None, None
+    tm, mp, kp, np_ = _lloyd_tile_plan(m, k, n_clusters, 4, tm)
+    if tm is None:                            # VMEM-fallback path
+        return None, None
+    return _split_side(x, mp, kp), {"tm": tm, "m": m}
+
+
+@with_matmul_precision
+def fused_lloyd_prepared(ops, y, *, tm: int, m: int,
+                         packed: Optional[bool] = None
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                    jnp.ndarray, jnp.ndarray]:
+    """Per-iteration half of the prepared Lloyd pass: split/norm Y (the
+    centroids — tiny, they change every iteration) and run the resident
+    split kernel against the hoisted X operands from
+    :func:`lloyd_prepare`. Same return contract as
+    :func:`fused_lloyd_pallas`, bit-identical results."""
+    xh, xl, xn = ops
+    y = jnp.asarray(y)
+    n, k = y.shape
+    kp = xh.shape[1]
+    np_ = round_up_to_multiple(n, 128)
+    packed = _packed_split_default() if packed is None else bool(packed)
+    yp = _pad2(y.astype(jnp.float32), np_, kp)
+    yh, yl = _split_hi_lo(yp)
+    yn = _sq_norms(yp)[None, :]
+    sums, counts, val, idx = _fused_lloyd_padded_split(
+        xh, xl, xn, yh, yl, yn, tm, n, m, packed=packed)
+    return (sums[:n, :k], counts[0, :n],
+            jnp.maximum(val[0, :m], 0.0), idx[0, :m])
+
+
 @with_matmul_precision
 def fused_lloyd_pallas(x, y, tm: Optional[int] = None,
                        packed: Optional[bool] = None
@@ -940,18 +1034,8 @@ def fused_lloyd_pallas(x, y, tm: Optional[int] = None,
     if interpret_needs_ref(x, y):
         sums, counts, val, idx = _lloyd_jnp(x, y)
         return sums, counts, val, idx.astype(jnp.int32)
-    kp = round_up_to_multiple(k, 128)
-    np_ = round_up_to_multiple(n, 128)
     isz = jnp.dtype(x.dtype).itemsize
-    const = np_ * kp * (isz + 4) + 4 * np_          # y + sums + counts
-    auto_tm = _pick_tm(kp, np_, mn_bufs=2, const_bytes=const, itemsize=isz)
-    # explicit tm (the tuning sweep's knob) is honored whenever it fits
-    # VMEM — NOT min()'d against the preference order, which would cap
-    # every request at the preferred 256; unsafe requests fall back to auto
-    if tm is None:
-        tm = auto_tm
-    elif auto_tm is None or not _tm_fits(tm, kp, np_, 2, const, isz):
-        tm = auto_tm
+    tm, mp, kp, np_ = _lloyd_tile_plan(m, k, n, isz, tm)
     if tm is None:
         # Y (+ sums) exceed VMEM: fused argmin kernel, then a CHUNKED
         # one-hot update so the m×n one-hot never materializes in HBM.
@@ -974,8 +1058,6 @@ def fused_lloyd_pallas(x, y, tm: Optional[int] = None,
             body, (jnp.zeros((n, k), jnp.float32),
                    jnp.zeros((n,), jnp.float32)), (xp, idxp))
         return sums, counts, val, idx
-    tm = max(8, round_up_to_multiple(min(tm, m), 8))
-    mp = round_up_to_multiple(m, tm)
     if _use_split(x, y):
         sums, counts, val, idx = _fused_lloyd_padded_split(
             *_split_operands(x, y, mp, np_, kp), tm, n, m, packed=packed)

@@ -459,3 +459,45 @@ class TestWeightedKMeans:
                                    rtol=1e-4, atol=1e-4)
         np.testing.assert_allclose(float(in2), 0.01 * float(in1),
                                    rtol=1e-4)
+
+
+def test_lloyd_prepared_bit_identical():
+    """The hoisted-operand Lloyd path (lloyd_prepare +
+    lloyd_step_prepared) must be BIT-identical to lloyd_step at tier
+    'high' — same kernel, same operand bytes, only their production is
+    hoisted out of the loop — and must decline (None) when the prepared
+    path doesn't apply (non-'high' tier, non-f32 dtype)."""
+    import jax.numpy as jnp
+    import raft_tpu
+    from raft_tpu.cluster.kmeans import lloyd_step, lloyd_step_prepared
+    from raft_tpu.linalg.contractions import lloyd_prepare
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(700, 33)).astype(np.float32))
+    c = jnp.asarray(rng.normal(size=(37, 33)).astype(np.float32))
+    old = raft_tpu.get_matmul_precision()
+    try:
+        raft_tpu.set_matmul_precision("high")
+        ops, meta = lloyd_prepare(x, 37)
+        assert ops is not None
+        ref = lloyd_step(x, c, 37)
+        got = lloyd_step_prepared(ops, c, **meta)
+        for a, b, name in zip(ref, got, ("centroids", "inertia", "labels")):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+        # two chained iterations stay identical (the prepared ops are
+        # reused across steps; centroids evolve)
+        ref2 = lloyd_step(x, ref[0], 37)
+        got2 = lloyd_step_prepared(ops, got[0], **meta)
+        np.testing.assert_array_equal(np.asarray(ref2[0]),
+                                      np.asarray(got2[0]))
+
+        raft_tpu.set_matmul_precision("highest")
+        assert lloyd_prepare(x, 37) == (None, None)
+        raft_tpu.set_matmul_precision("high")
+        assert lloyd_prepare(x.astype(jnp.bfloat16), 37) == (None, None)
+        # VMEM-fallback shapes decline too (Y + sums beyond residency)
+        big = jnp.zeros((64, 40000), jnp.float32)
+        assert lloyd_prepare(big, 20000) == (None, None)
+    finally:
+        raft_tpu.set_matmul_precision(old)
