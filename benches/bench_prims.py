@@ -629,6 +629,18 @@ def bench_kmeans():
         yield run_case("cluster/lloyd_iter_northstar_1Mx128_k1024", g,
                        xn, cn, flops=2 * (1 << 20) * 1024 * 128,
                        rows=1 << 20, k=1024, tier=tier)
+        # prepared-loop variant (what kmeans_fit/bench.py actually run
+        # at tier 'high': X split+norms hoisted out of the iteration)
+        from raft_tpu.cluster.kmeans import lloyd_step_prepared
+        from raft_tpu.linalg.contractions import lloyd_prepare
+
+        ops, meta = lloyd_prepare(xn, 1024)
+        if ops is not None:
+            jax.block_until_ready(ops)
+            h = functools.partial(lloyd_step_prepared, **meta)
+            yield run_case("cluster/lloyd_iter_northstar_prepared", h,
+                           ops, cn, flops=2 * (1 << 20) * 1024 * 128,
+                           rows=1 << 20, k=1024, tier=tier)
 
 
 @bench("neighbors/brute_force")
