@@ -256,6 +256,32 @@ class TestAdversarialOnChip:
         np.testing.assert_array_equal(np.asarray(v),
                                       np.sort(xi, 1)[:, ::-1][:, :7])
 
+    def test_lloyd_prepared_bit_identical_on_chip(self, rng):
+        """The hoisted-operand Lloyd path (what bench.py times at tier
+        'high') must be bit-identical to the plain fused call ON THE
+        CHIP — the shared tile plan guarantees it structurally; this
+        gates it against Mosaic layout/lowering drift."""
+        import raft_tpu
+        from raft_tpu.linalg.contractions import (fused_lloyd_pallas,
+                                                  fused_lloyd_prepared,
+                                                  lloyd_prepare)
+
+        old = raft_tpu.get_matmul_precision()
+        try:
+            raft_tpu.set_matmul_precision("high")
+            x = rng.normal(size=(1500, 48)).astype(np.float32)
+            c = rng.normal(size=(64, 48)).astype(np.float32)
+            ops, meta = lloyd_prepare(x, 64)
+            assert ops is not None
+            ref = fused_lloyd_pallas(x, c)
+            got = fused_lloyd_prepared(ops, c, **meta)
+            for a, b, name in zip(ref, got,
+                                  ("sums", "counts", "dist", "labels")):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                              err_msg=name)
+        finally:
+            raft_tpu.set_matmul_precision(old)
+
     def test_packed_split_equivalence_on_chip(self, rng):
         """The depth-packed bf16x3 spelling must Mosaic-COMPILE and agree
         with the 3-dot spelling on real hardware (CPU interpret already
