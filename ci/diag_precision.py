@@ -11,9 +11,15 @@ tail, not the run).
 """
 
 import json
+import os
 import sys
 
 import numpy as np
+
+# Runnable as `python ci/diag_precision.py` from the repo root: sys.path[0]
+# is ci/, which hides the raft_tpu package (the 03:18 window lost the
+# pallas/tier probes to exactly this).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def emit(**kw):

@@ -242,8 +242,18 @@ def _mask_argmin(d, n_valid: int):
     # jax_enable_x64, and the resulting f64→f32 convert has no Mosaic
     # lowering (caught by tests/test_mosaic_lowering.py)
     d = jnp.where(col < n_valid, d, jnp.asarray(jnp.inf, d.dtype))
-    arg = jax.lax.argmin(d, 1, jnp.int32)[:, None]
     minval = jnp.min(d, axis=1, keepdims=True)
+    # Manual first-minimum argmin: lax.argmin's variadic-reduce lowering
+    # fails Mosaic legalization at narrow tiles (unresolved f32->i32
+    # materialization, observed on-chip at a (257, 19) tile); min +
+    # masked-iota uses only plain reduce-min/where ops (no variadic
+    # reduce) and keeps the KVP first-minimum tie rule. On-chip evidence
+    # gate: the smoke tier's test_fused_argmin[257-31-19] at this sha. NaN positions count as minimal (lax.argmin/numpy parity —
+    # XLA reduce-min propagates NaN, so minval is NaN and only the NaN
+    # columns survive the candidate mask).
+    cand = (d == minval) | (d != d)
+    sentinel = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
+    arg = jnp.min(jnp.where(cand, col, sentinel), axis=1, keepdims=True)
     return col, minval, arg
 
 
@@ -301,10 +311,15 @@ def _pick_tm(kp: int, np_: int, mn_bufs: int, const_bytes: int,
     Working set ≈ const (resident Y/accumulators) + double-buffered X tile
     + ``mn_bufs`` (tm × np_) f32 intermediates (distance tile, one-hot).
 
-    256 leads the preference order: measured fastest on v5e at the BASELINE
-    shape (10.7 ms vs 11.9 at tm=1024, 14.8 at tm=512 for 1M×128 k=1024) —
-    more grid steps pipeline X loads better than bigger tiles do."""
-    for tm in (256, 512, 1024, 128, 64, 32, 16, 8):
+    512 leads the preference order: measured fastest on v5e at the BASELINE
+    shape at the FIXED bf16x3 kernel (r3 tune artifact
+    `tpu_battery_out/northstar_tune.jsonl` tm_sweep @ tier 'high':
+    12.29 ms at tm=512 vs 13.84 at 256, 13.9 at 1024, 15.5 at 128 for
+    1M×128 k=1024). The r2 sweep that put 256 first (10.7 ms) was
+    measured while XLA's excess-precision pass had folded the split to a
+    single bf16 pass — a different (lighter) kernel; at the real 5-pass
+    working set the larger tile amortizes Y-resident reloads better."""
+    for tm in (512, 256, 1024, 128, 64, 32, 16, 8):
         need = const_bytes + 2 * tm * kp * itemsize + mn_bufs * tm * np_ * 4
         if need <= _VMEM_BUDGET:
             return tm
@@ -472,15 +487,16 @@ def _distance_tile(x, y, n_valid: int, metric: str = "l2"):
     x (tm, kp), y (np_, kp) → col (tm, np_) column iota,
     minval (tm, 1), arg (tm, 1).
 
-    A fused argmin reduction replaces the old masked-min spelling
-    (compare + select + second reduce) — one full-tile elementwise pass
-    fewer on the VPU, which bounds this kernel. The index dtype is pinned
-    to int32: Mosaic's reduce-index helper rejects int64, which
-    jnp.argmin would bind under jax_enable_x64. lax.argmin's
-    first-minimum tie rule matches the fused-NN KVP min-reduce (the
-    value-then-key reduce op of the cuVS fused-distance lineage; note
-    kvp.hpp's operator< itself orders key-then-value — it is the reduce
-    op, not operator<, that defines the tie rule)."""
+    The argmin is spelled manually in :func:`_mask_argmin` (reduce-min +
+    masked column iota) because lax.argmin's variadic-reduce lowering
+    fails Mosaic legalization at narrow tiles. The index dtype is pinned
+    to int32 via the iota/sentinel dtype (jnp.argmin would bind int64
+    under jax_enable_x64, which Mosaic rejects). The first-minimum tie
+    rule — smallest column index among equal minima, enforced by the
+    reduce-min over masked indices — matches the fused-NN KVP min-reduce
+    (the value-then-key reduce op of the cuVS fused-distance lineage;
+    note kvp.hpp's operator< itself orders key-then-value — it is the
+    reduce op, not operator<, that defines the tie rule)."""
     return _mask_argmin(_metric_tile(x, y, metric), n_valid)
 
 
