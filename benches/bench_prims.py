@@ -260,13 +260,16 @@ def bench_svd():
 # -- matrix (ref: bench/prims/matrix/*.cu) ----------------------------------
 
 def _select_k_grid(lens_ks):
-    """Three-way direct/tiled/stream tournament over a (len, k) grid. This is the
-    evidence base for `_choose_tiled`'s thresholds (ref heuristic:
+    """Four-way direct/tiled/stream/radix tournament over a (len, k)
+    grid — the evidence base for select_k's dispatch (ref heuristic:
     matrix/detail/select_k-inl.cuh:38-63 picks radix vs warpsort from
-    (len, k); our analogue picks lax.top_k direct vs the two-stage
-    tournament). Batch is scaled so every case streams ~the same element
-    count — throughput comparisons are then apples-to-apples."""
-    from raft_tpu.matrix import SelectAlgo, select_k
+    (len, k)). Implementations are invoked DIRECTLY (not through the
+    algo enums) so a dispatch change can never silently relabel a row.
+    Batch is scaled so every case streams ~the same element count —
+    throughput comparisons are then apples-to-apples."""
+    from raft_tpu.matrix import radix_select
+    from raft_tpu.matrix.select_k import (_direct_select, _stream_select,
+                                          _tiled_select)
 
     target_elems = (64 << 20) if SIZES["rows"] >= (1 << 20) else (1 << 22)
     for length, k in lens_ks:
@@ -274,15 +277,15 @@ def _select_k_grid(lens_ks):
             continue
         batch = max(4, min(8192, target_elems // length))
         x = _data(batch, length)
-        algos = [(SelectAlgo.RADIX_11BITS, "tiled"),
-                 (SelectAlgo.WARPSORT_IMMEDIATE, "direct")]
+        algos = [("tiled", _tiled_select), ("direct", _direct_select)]
         if length > 8192:
             # below this the stream path dispatches to direct anyway —
             # benching it would record mislabeled duplicate rows
-            algos.append((SelectAlgo.WARPSORT_FILTERED, "stream"))
-        for algo, tag in algos:
-            f = jax.jit(functools.partial(select_k, None, k=k,
-                                          select_min=True, algo=algo))
+            algos.append(("stream", _stream_select))
+        if radix_select.supports(x.dtype, length, k):
+            algos.append(("radix", radix_select.radix_select_k))
+        for tag, impl in algos:
+            f = jax.jit(functools.partial(impl, k=k, select_min=True))
             yield run_case(f"matrix/select_k_len{length}_k{k}_{tag}", f, x,
                            items=batch * length, k=k, batch=batch,
                            length=length, algo=tag)

@@ -1,12 +1,12 @@
 #!/usr/bin/env python
 """Derive select_k dispatch thresholds from the hardware tournament.
 
-Reads matrix/select_k* rows from a bench JSONL (direct vs tiled per
-(len, k) cell), prints the winner map + a recommended `_choose_tiled`
-predicate, and flags cells where `lax.top_k` (direct) falls below the
-bandwidth roofline — the explicit evidence gate the design note in
-raft_tpu/matrix/select_k.py names for ever writing a Pallas radix
-kernel (ref heuristic being replaced: detail/select_k-inl.cuh:38-63).
+Reads matrix/select_k* rows from a bench JSONL (the four-way
+direct/tiled/stream/radix tournament per (len, k) cell), prints the
+winner map + a recommended dispatch predicate, and quotes the winner's
+HBM fraction — the roofline evidence that originally triggered building
+the Pallas radix-rank kernel (raft_tpu/matrix/radix_select.py; ref
+heuristic being replaced: detail/select_k-inl.cuh:38-63).
 
 Usage: python ci/derive_select_k.py tpu_battery_out/bench_full.jsonl
 """
@@ -40,15 +40,16 @@ def main(path):
         return
 
     print(f"{'len':>9} {'k':>6} {'direct ms':>10} {'tiled ms':>9} "
-          f"{'stream ms':>10} {'winner':>7} {'win GB/s':>9} "
-          f"{'hbm frac':>9}")
+          f"{'stream ms':>10} {'radix ms':>9} {'winner':>7} "
+          f"{'win GB/s':>9} {'hbm frac':>9}")
     wins = {}
     for (length, k), algos in sorted(cells.items()):
         d = algos.get("direct")
         if not d:
             continue
-        times = {a: algos[a]["median_ms"] for a in ("direct", "tiled",
-                                                    "stream") if a in algos}
+        times = {a: algos[a]["median_ms"]
+                 for a in ("direct", "tiled", "stream", "radix")
+                 if a in algos}
         win = min(times, key=times.get)
         wins.setdefault(win, []).append((length, k, times))
         # the selection streams batch*len f32 once: the bandwidth floor
@@ -58,11 +59,11 @@ def main(path):
         def fmt(a):
             return f"{times[a]:.2f}" if a in times else "-"
         print(f"{length:>9} {k:>6} {fmt('direct'):>10} {fmt('tiled'):>9} "
-              f"{fmt('stream'):>10} {win:>7} {gbs:>9.1f} "
-              f"{gbs / HBM_GB_S:>9.2f}")
+              f"{fmt('stream'):>10} {fmt('radix'):>9} {win:>7} "
+              f"{gbs:>9.1f} {gbs / HBM_GB_S:>9.2f}")
 
     print()
-    for algo in ("tiled", "stream"):
+    for algo in ("tiled", "stream", "radix"):
         if wins.get(algo):
             cells_won = [(w[0], w[1]) for w in wins[algo]]
             print(f"{algo} wins at: {cells_won}")

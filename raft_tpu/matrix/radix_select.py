@@ -1,0 +1,304 @@
+"""Pallas radix-rank select: exact batched top-k below the sort roofline.
+
+The round-3 hardware grid (``tpu_battery_out/bench_full.jsonl``,
+matrix/select_k*) showed every `lax.top_k`-based winner at k >= 256
+running at ~1% of HBM bandwidth (8192x8192 f32 = 256 MB selected in
+46 ms = 5.8 GB/s) — a ~50x roofline gap.  This module is the TPU
+re-design of the reference's radix selection (ref:
+matrix/detail/select_radix.cuh:639 — the "Air Top-k" multi-pass
+histogram filter): same exact-threshold idea, but shaped for the MXU/VPU
+instead of warp atomics, and with the candidate COMPACTION step — the
+part CUDA does with global-atomic buffers, previously believed
+inexpressible on TPU — done as a one-hot rank CONTRACTION on the MXU.
+
+Two Pallas kernels over a precomputed sortable-key array:
+
+1. `_threshold_kernel` — rows resident in VMEM, a 32-step bitwise binary
+   search finds the EXACT k-th smallest key per row (the reference's
+   per-digit histogram walk collapses to count(key <= probe) reductions:
+   one VPU compare+reduce per bit, zero extra HBM traffic). Also emits
+   `n_tie` = how many threshold-equal elements belong in the output.
+2. `_emit_kernel` — streams the rows once more; per chunk it computes
+   each candidate's output slot (a running rank carried across grid
+   steps; the in-chunk exclusive cumsum is a triangular matmul, NOT a
+   lane-shift scan), factorizes the slot one-hot as rank = 128*hi + lo,
+   and contracts (one-hot_hi * column-index-part) against one-hot_lo on
+   the MXU — emitting winner indices without a sort, scatter, or
+   variable-length compaction.  Column indices (< 2^24) ride exactly in
+   three bf16 parts (split via the bitcast rounding helper — the
+   astype spelling would be folded by XLA's excess-precision pass, see
+   linalg/contractions._round_to_bf16_f32).
+
+Values are then a k-wide `take_along_axis` gather, and the final
+best-first ordering a stable (R, k) sort by sortable key — ties keep
+emission order, which IS ascending column order, reproducing the
+reference's first-come tie rule (select_radix.cuh's
+last-filter-pass in-order candidate writes).
+
+Key domain: floats map through the sign-magnitude fold
+``b ^ ((b >> 31) & 0x7fffffff)`` (IEEE total order: -NaN < -inf,
++NaN > +inf — the same order the reference's radix bit-twiddle
+induces); ints widen; uint32 re-biases; select_max is ``~key``.
+NaN payloads and every value bit survive (values are gathered, never
+arithmetically transformed).
+
+Supported: f32/bf16/f16 + (u)int8/16/32 values, n_cols <= 2^24 (index
+exactness in three bf16 parts), k <= 16384.  Callers (select_k) fall
+back to the tournament paths outside that envelope.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.linalg.contractions import _round_to_bf16_f32
+from raft_tpu.util.math import cdiv, round_up_to_multiple
+from raft_tpu.util.pallas_utils import out_struct, pallas_call
+
+_I32_MAX = 0x7FFFFFFF
+_I32_MIN = -0x80000000
+
+# Emission chunk width (lanes) and row block (sublanes).
+_EMIT_TL = 512
+_EMIT_TM = 8
+
+# One row lives VMEM-resident in the threshold kernel: 1M * 4 B = 4 MB,
+# ~8 MB with Pallas double-buffering — inside the same ~10 MB working-set
+# budget every other kernel sizes to (contractions._VMEM_BUDGET). Index
+# exactness would allow 2^24; the VMEM residency bound binds first.
+# Longer rows fall back to the tournament paths.
+MAX_LEN = 1 << 20
+MAX_K = 16384
+
+
+def supports(dtype, n_cols: int, k: int) -> bool:
+    """Whether the radix path handles this problem (callers fall back)."""
+    dt = jnp.dtype(dtype)
+    ok = dt in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+                jnp.dtype(jnp.float16), jnp.dtype(jnp.int8),
+                jnp.dtype(jnp.int16), jnp.dtype(jnp.int32),
+                jnp.dtype(jnp.uint8), jnp.dtype(jnp.uint16),
+                jnp.dtype(jnp.uint32))
+    return ok and k <= n_cols and n_cols <= MAX_LEN and k <= MAX_K
+
+
+def _to_key(values: jnp.ndarray, select_min: bool) -> jnp.ndarray:
+    """Order-preserving map into int32 ("sortable key") — ascending key
+    == ascending IEEE-total-order value. One fused XLA elementwise pass;
+    the kernels then work dtype-free."""
+    v = values
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        f = v.astype(jnp.float32)  # exact + monotone for f16/bf16
+        b = jax.lax.bitcast_convert_type(f, jnp.int32)
+        key = b ^ ((b >> 31) & jnp.int32(_I32_MAX))
+    elif v.dtype == jnp.uint32:
+        # unsigned order -> signed order: flip the top bit
+        key = jax.lax.bitcast_convert_type(v, jnp.int32) ^ jnp.int32(
+            _I32_MIN)
+    else:
+        key = v.astype(jnp.int32)
+    return key if select_min else ~key
+
+
+def _threshold_kernel(key_ref, t_ref, ntie_ref, *, k: int):
+    """Exact k-th smallest key for ONE row (grid step = row) via a
+    bitwise binary search. The row arrives reshaped (1, Lp/128, 128) so
+    both Mosaic-tiled dims are aligned regardless of row length.
+
+    Invariant entering the step for bit b: T in
+    [prefix, prefix + 2^(b+1) - 1]. probe = prefix + 2^b - 1 tests
+    whether T fits with bit b clear: count(key <= probe) >= k keeps the
+    bit 0, else the bit is set. The sign bit is the seed step (negatives
+    sort below in the signed key domain). Padded tail columns hold
+    INT32_MAX; probes only reach INT32_MAX where the answer is forced
+    (count >= k trivially), so the padding never biases a decision."""
+    kk = jnp.float32(k)
+
+    def count_le(t):
+        # re-read per call: keeps the row vector's live range inside one
+        # loop iteration instead of spanning the whole fori_loop
+        return jnp.sum((key_ref[:] <= t).astype(jnp.float32))
+
+    neg = count_le(jnp.int32(-1))
+    prefix = jnp.where(neg >= kk, jnp.int32(_I32_MIN), jnp.int32(0))
+
+    # The probed bit rides in the CARRY (2^30 halving each step) instead
+    # of being derived from the fori index: referencing the loop index in
+    # the body trips a RecursionError in jax.export's lowering under
+    # jax_enable_x64 (jax 0.9.0; reproduced minimally — any use of `i`
+    # inside a pallas_call fori body recurses; ignoring it is fine).
+    def body(_, carry):
+        prefix, bit = carry
+        probe = prefix + bit - jnp.int32(1)
+        cnt = count_le(probe)
+        return (jnp.where(cnt < kk, probe + jnp.int32(1), prefix),
+                bit >> jnp.int32(1))
+
+    t, _ = jax.lax.fori_loop(0, 31, body,
+                             (prefix, jnp.int32(1 << 30)))
+    # count(key < T) — at T = INT32_MIN nothing is below
+    c_less = jnp.where(t == jnp.int32(_I32_MIN), jnp.float32(0),
+                       count_le(t - 1))
+    t_ref[0, 0, 0] = t
+    ntie_ref[0, 0, 0] = jnp.int32(k) - c_less.astype(jnp.int32)
+
+
+def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
+                 k: int, kh: int, tl: int, tm: int):
+    """Emit each candidate's global column index into its output slot.
+
+    rank(candidate) = #earlier-candidates; strict-below-threshold
+    elements first (in column order), then the first `n_tie`
+    threshold-equal elements. Slot one-hot factorizes as
+    rank = 128*hi + lo; the index value rides the hi side in three exact
+    bf16 parts and one (3*kh, tl) @ (tl, 128) MXU contraction per row
+    accumulates all three parts' slabs, summed into the (kh*128,) output
+    block f32-exactly (each slot receives exactly one candidate)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+        less_run[:] = jnp.zeros_like(less_run)
+        tie_run[:] = jnp.zeros_like(tie_run)
+
+    key = key_ref[:]                                   # (tm, tl) i32
+    t = t_ref[:]                                       # (tm, 1)
+    ntie = ntie_ref[:]                                 # (tm, 1)
+    strict = key < t
+    tie = key == t
+
+    # In-chunk EXCLUSIVE cumsums via one triangular matmul (lane-shift
+    # scans need relayouts Mosaic handles poorly; the MXU does not).
+    ci = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
+    cj = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
+    tri = (ci < cj).astype(jnp.bfloat16)               # tri[c', c] = c' < c
+    masks = jnp.concatenate(
+        [strict.astype(jnp.bfloat16), tie.astype(jnp.bfloat16)], axis=0)
+    excl = jnp.dot(masks, tri, preferred_element_type=jnp.float32)
+    excl_strict = excl[:tm].astype(jnp.int32)          # (tm, tl)
+    excl_tie = excl[tm:].astype(jnp.int32)
+
+    run_less = less_run[:]                             # (tm, 1) i32
+    run_tie = tie_run[:]
+    member_tie = tie & ((run_tie + excl_tie) < ntie)
+    c_less_total = jnp.int32(k) - ntie
+    rank = jnp.where(strict, run_less + excl_strict,
+                     c_less_total + run_tie + excl_tie)
+    member = strict | member_tie
+    hi = jnp.where(member, rank >> 7, jnp.int32(-1))   # -1: no slot
+    lo = rank & jnp.int32(127)
+
+    # Global column index of each chunk element, in three exact bf16
+    # parts (col < 2^24 = 8+8+8 mantissa bits).
+    col = (jnp.float32(j * tl)
+           + jax.lax.broadcasted_iota(jnp.int32, (1, tl), 1)
+           .astype(jnp.float32))
+    p0 = _round_to_bf16_f32(col)
+    r1 = col - p0
+    p1 = _round_to_bf16_f32(r1)
+    p2 = r1 - p1
+
+    lo_t = lo.T                                        # (tl, tm)
+    iota_h = jax.lax.broadcasted_iota(jnp.int32, (kh, 1), 0)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+    for r in range(tm):
+        ohhi = (iota_h == hi[r:r + 1, :]).astype(jnp.bfloat16)  # (kh, tl)
+        a = jnp.concatenate([ohhi * p0.astype(jnp.bfloat16),
+                             ohhi * p1.astype(jnp.bfloat16),
+                             ohhi * p2.astype(jnp.bfloat16)], axis=0)
+        ohlo = (lo_t[:, r:r + 1] == iota_l).astype(jnp.bfloat16)
+        slabs = jnp.dot(a, ohlo, preferred_element_type=jnp.float32)
+        slab = (slabs[:kh] + slabs[kh:2 * kh] + slabs[2 * kh:]
+                ).reshape(1, kh * 128)
+        out_ref[r:r + 1, :] += slab
+
+    less_run[:] = run_less + jnp.sum(
+        strict.astype(jnp.float32), axis=1, keepdims=True).astype(jnp.int32)
+    tie_run[:] = run_tie + jnp.sum(
+        tie.astype(jnp.float32), axis=1, keepdims=True).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
+    """keys (R, L) i32 -> winner column indices (R, k) i32, in ascending
+    column order (strict-below first, then in-order threshold ties)."""
+    n_rows, n_cols = keys.shape
+    # lp multiple of 1024 so the (lp/128, 128) row view is sublane-aligned
+    lp = round_up_to_multiple(n_cols, 1024)
+    rp = round_up_to_multiple(n_rows, _EMIT_TM)
+    kpad = jnp.pad(keys, ((0, rp - n_rows), (0, lp - n_cols)),
+                   constant_values=_I32_MAX)
+    ls = lp // 128
+
+    t3, ntie3 = pallas_call(
+        functools.partial(_threshold_kernel, k=k),
+        grid=(rp,),
+        in_specs=[pl.BlockSpec((1, ls, 128), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0),
+                                memory_space=pltpu.SMEM),
+                   pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0),
+                                memory_space=pltpu.SMEM)],
+        out_shape=[out_struct((rp, 1, 1), jnp.int32),
+                   out_struct((rp, 1, 1), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(kpad.reshape(rp, ls, 128))
+    t = t3.reshape(rp, 1)
+    ntie = ntie3.reshape(rp, 1)
+
+    kh = cdiv(k, 128)
+    tm, tl = _EMIT_TM, _EMIT_TL
+    idx_f = pallas_call(
+        functools.partial(_emit_kernel, k=k, kh=kh, tl=tl, tm=tm),
+        grid=(rp // tm, lp // tl),
+        in_specs=[
+            pl.BlockSpec((tm, tl), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tm, kh * 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=out_struct((rp, kh * 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tm, 1), jnp.int32),
+                        pltpu.VMEM((tm, 1), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+    )(kpad, t, ntie)
+
+    return idx_f[:n_rows, :k].astype(jnp.int32)
+
+
+def radix_select_k(values: jnp.ndarray, k: int,
+                   select_min: bool = True
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact batched top-k (smallest if select_min) of values (R, L).
+
+    Returns (vals (R, k), idx (R, k)) sorted best-first; threshold ties
+    resolve to the lowest column indices (reference tie rule). Callers
+    must check :func:`supports` first.
+    """
+    values = jnp.asarray(values)
+    if not supports(values.dtype, values.shape[1], k):
+        raise ValueError(
+            f"radix_select_k: unsupported problem (dtype={values.dtype}, "
+            f"n_cols={values.shape[1]}, k={k}); check supports()")
+    keys = _to_key(values, select_min)
+    idx = _radix_ranks(keys, k)
+    out_v = jnp.take_along_axis(values, idx, axis=1)
+    out_k = jnp.take_along_axis(keys, idx, axis=1)
+    # Best-first ordering: stable sort by sortable key keeps the
+    # emission's ascending-column order among equal values.
+    out_k, out_v, idx = jax.lax.sort((out_k, out_v, idx), dimension=1,
+                                     is_stable=True, num_keys=1)
+    return out_v, idx

@@ -25,16 +25,32 @@ T·k-wide candidate pool — a 2-level tournament with identical results for
 any distribution, because a global top-k element is necessarily a top-k
 element of its tile.
 
-Design note — why no Pallas radix-select kernel (the reference's 1.3k-LoC
-select_radix.cuh): Mosaic has no in-kernel sort primitive, and the radix
-approach's final step (compacting the ≤k candidates below the histogram
-threshold) is itself a variable-length selection that XLA can only express
-as another top_k — so a hand-written kernel would re-pay exactly the cost
-it tries to avoid. The tournament keeps every pass bandwidth-shaped
-(tiles stream once; the pool is T·k ≪ len); the select_k bench family
-(direct vs tiled, k up to 10⁴) records where each wins on hardware, and a
-Pallas path remains future work ONLY if those numbers show XLA's top_k
-below the bandwidth roofline at a shape that matters.
+Hardware verdict (round-3 v5e grid, `tpu_battery_out/bench_full.jsonl`
+matrix/select_k + select_k_large, adjudicated by ci/derive_select_k.py):
+
+- direct `lax.top_k` wins every k ≤ 16 cell (3.8-5.0 ms; its best cell
+  runs at 71 GB/s ≈ 9% of HBM) and the (1M, k ≥ 2048) cells;
+- the 2-stage tournament wins the mid-k long-row band — (65k, 256)
+  1.43×, (65k, 2048) 1.16×, (1M, 256) 1.09× over direct — which sets
+  `_choose_tiled`'s measured rule (wide row, k > 16, candidate pool
+  bounded);
+- the streaming contender NEVER wins a cell (its scan-merge re-pays a
+  top_k per tile; 1.4× to 7.5× behind the winner as k grows) — kept
+  only as the explicit kWarpsortFiltered/Distributed parity mapping.
+
+The round-2 design note here bet that a Pallas radix kernel could not
+beat `lax.top_k`. The grid REFUTES the premise that top_k is
+bandwidth-shaped: every k ≥ 256 winner sits at ~1% of HBM bandwidth
+(e.g. 8192×8192 f32 = 256 MB selected in 46 ms ≈ 5.8 GB/s, a ~50×
+roofline gap). That triggered the gate the note named, and the Pallas
+two-pass radix-rank kernel now exists: :mod:`raft_tpu.matrix.radix_select`
+(histogram passes find the exact k-th key; a factorized one-hot rank
+contraction emits winners through the MXU — compaction WITHOUT a sort,
+the step the old note thought inexpressible). kAuto dispatches to it in
+the roofline-indicted band (16 < k <= 2048, long rows) PENDING its own
+four-way grid rows — its cells re-derive from ci/derive_select_k.py
+when the next battery window records them; the radix algo enums map to
+it directly.
 """
 
 from __future__ import annotations
@@ -46,6 +62,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.util.math import cdiv, round_up_to_multiple
+from raft_tpu.util.pallas_utils import interpret_needs_ref
 
 
 class SelectAlgo(enum.Enum):
@@ -61,11 +78,16 @@ class SelectAlgo(enum.Enum):
     WARPSORT_DISTRIBUTED_EXT = "warpsort_distributed_ext"
 
 
-def _choose_tiled(n_rows: int, n_cols: int, k: int) -> bool:
+def _choose_tiled(n_rows: int, n_cols: int, k: int,
+                  tile: int = 8192) -> bool:
     """Heuristic analogue of choose_select_k_algorithm
-    (detail/select_k-inl.cuh:38-63): tile when rows are very wide relative
-    to k so we avoid sorting/scanning full rows in one shot."""
-    return n_cols >= 64 * 1024 and k <= 512
+    (detail/select_k-inl.cuh:38-63), re-derived from the round-3 v5e grid
+    (module docstring): tiled wins wide rows at k > 16 as long as the
+    stage-2 candidate pool (n_tiles · k) stays bounded — at (1M, 2048)
+    the 262k-wide pool hands the win back to direct (59.9 vs 66.4 ms),
+    while (65k, 2048)'s 16k pool and (1M, 256)'s 32k pool keep it."""
+    pool = cdiv(n_cols, tile) * k
+    return n_cols >= 64 * 1024 and k > 16 and pool <= 64 * 1024
 
 
 def _order_flip(values: jnp.ndarray) -> jnp.ndarray:
@@ -199,11 +221,32 @@ def select_k(res, values, k: int, select_min: bool = True,
     if k > n_cols:
         raise ValueError(f"k={k} > len={n_cols}")
 
+    from raft_tpu.matrix import radix_select
+
+    def _radix_ok():
+        return (radix_select.supports(values.dtype, n_cols, k)
+                and not interpret_needs_ref(values))
+
     if algo == SelectAlgo.AUTO:
-        mode = "tiled" if _choose_tiled(n_rows, n_cols, k) else "direct"
+        # Roofline-motivated dispatch, pending the four-way hardware
+        # grid: radix takes the band where the measured grid showed
+        # lax.top_k ~50x under the bandwidth roofline (16 < k <= 2048 on
+        # long rows). k > 2048 stays on the grid's measured winner
+        # (direct at (1M, 10^4)) until radix rows land; thresholds get
+        # re-derived from ci/derive_select_k.py when they do.
+        if n_cols >= 8192 and 16 < k <= 2048 and _radix_ok():
+            mode = "radix"
+        elif _choose_tiled(n_rows, n_cols, k):
+            mode = "tiled"
+        else:
+            mode = "direct"
     elif algo in (SelectAlgo.RADIX_8BITS, SelectAlgo.RADIX_11BITS,
                   SelectAlgo.RADIX_11BITS_EXTRA_PASS):
-        mode = "tiled" if n_cols > 8192 else "direct"
+        # the reference's radix slots map to the Pallas radix-rank kernel
+        if _radix_ok():
+            mode = "radix"
+        else:
+            mode = "tiled" if n_cols > 8192 else "direct"
     elif algo in (SelectAlgo.WARPSORT_FILTERED,
                   SelectAlgo.WARPSORT_DISTRIBUTED,
                   SelectAlgo.WARPSORT_DISTRIBUTED_EXT):
@@ -214,7 +257,10 @@ def select_k(res, values, k: int, select_min: bool = True,
     else:
         mode = "direct"
 
-    if mode == "tiled":
+    if mode == "radix":
+        out_val, out_idx = radix_select.radix_select_k(values, k,
+                                                       select_min)
+    elif mode == "tiled":
         out_val, out_idx = _tiled_select(values, k, select_min)
     elif mode == "stream":
         out_val, out_idx = _stream_select(values, k, select_min)

@@ -108,3 +108,21 @@ def test_packed_split_lowers_for_tpu(xy):
     finally:
         raft_tpu.set_matmul_precision(old)
         jax.config.update("jax_default_matmul_precision", None)
+
+
+@pytest.mark.parametrize("kcase", [(9000, 64), (1000, 7), (600, 5)])
+def test_radix_select_lowers_for_tpu(kcase):
+    """Both radix-select kernels: the fori_loop bit walk with in-loop
+    VMEM re-reads (threshold) and the triangular-matmul cumsum +
+    factorized one-hot contraction with scratch carry (emission).
+
+    This tier runs under jax_enable_x64 (conftest), which is exactly the
+    configuration where referencing the fori index inside a pallas_call
+    body recurses in jax.export lowering — the kernel's carry-the-bit
+    workaround (radix_select.py:_threshold_kernel) is pinned here."""
+    from raft_tpu.matrix.radix_select import radix_select_k
+
+    n_cols, k = kcase
+    rng = np.random.default_rng(n_cols)
+    v = jnp.asarray(rng.normal(size=(16, n_cols)), jnp.float32)
+    _lowers_with_mosaic(lambda: radix_select_k(v, k))

@@ -1,0 +1,177 @@
+"""Radix-rank select kernel vs a numpy total-order oracle.
+
+The oracle sorts by the same sortable-key map the kernel uses (IEEE
+total order for floats), stably — so expected indices pin BOTH the
+selected set and the reference tie rule (lowest column index wins among
+equal values; ref: select_radix.cuh's in-order last-pass writes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.matrix import SelectAlgo, select_k
+from raft_tpu.matrix.radix_select import radix_select_k, supports
+
+
+def _oracle(v, k, select_min=True):
+    v = np.asarray(v)
+    if v.dtype.kind == "f":
+        b = v.astype(np.float32).view(np.int32)
+        key = (b ^ ((b >> 31) & 0x7FFFFFFF)).astype(np.int64)
+    else:
+        key = v.astype(np.int64)
+    if not select_min:
+        key = -key - 1
+    order = np.argsort(key, axis=1, kind="stable")
+    idx = order[:, :k]
+    return np.take_along_axis(v, idx, 1), idx
+
+
+def _check(v, k, select_min=True):
+    ov, oi = _oracle(v, k, select_min)
+    gv, gi = radix_select_k(jnp.asarray(v), k, select_min)
+    np.testing.assert_array_equal(np.asarray(gi), oi)
+    np.testing.assert_array_equal(
+        np.asarray(gv).astype(np.float64),
+        ov.astype(np.float64))
+
+
+class TestRadixSelect:
+    def test_random_f32(self):
+        rng = np.random.default_rng(0)
+        _check(rng.normal(size=(13, 1000)).astype(np.float32), 7)
+
+    @pytest.mark.parametrize("k", [1, 2, 127, 128, 129, 255])
+    def test_k_boundaries(self, k):
+        rng = np.random.default_rng(k)
+        _check(rng.normal(size=(5, 777)).astype(np.float32), k)
+
+    @pytest.mark.parametrize("n_cols", [511, 512, 513, 1000, 4096])
+    def test_len_boundaries(self, n_cols):
+        rng = np.random.default_rng(n_cols)
+        _check(rng.normal(size=(4, n_cols)).astype(np.float32),
+               min(31, n_cols))
+
+    def test_k_equals_len(self):
+        rng = np.random.default_rng(3)
+        _check(rng.normal(size=(2, 256)).astype(np.float32), 256)
+
+    def test_select_max(self):
+        rng = np.random.default_rng(4)
+        _check(rng.normal(size=(6, 900)).astype(np.float32), 33,
+               select_min=False)
+
+    def test_all_equal_rows_tie_to_first_indices(self):
+        v = np.zeros((3, 600), np.float32)
+        _, gi = radix_select_k(v, 5)
+        np.testing.assert_array_equal(np.asarray(gi),
+                                      np.tile(np.arange(5), (3, 1)))
+
+    def test_duplicate_blocks_first_come(self):
+        v = np.array([[5., 7., 5., 7., 5.]], np.float32)
+        _, gi = radix_select_k(v, 3, select_min=False)
+        assert np.asarray(gi).tolist() == [[1, 3, 0]]
+        _, gi = radix_select_k(v, 3)
+        assert np.asarray(gi).tolist() == [[0, 2, 4]]
+
+    def test_nan_inf_total_order(self):
+        v = np.array([[4., np.nan, 1., 2., np.inf, -np.inf, -np.nan]],
+                     np.float32)
+        gv, gi = radix_select_k(v, 3)
+        assert np.isnan(np.asarray(gv)[0, 0]) and np.asarray(gi)[0, 0] == 6
+        assert np.asarray(gv)[0, 1] == -np.inf
+        assert np.asarray(gv)[0, 2] == 1.0
+        gv, gi = radix_select_k(v, 3, select_min=False)
+        assert np.isnan(np.asarray(gv)[0, 0]) and np.asarray(gi)[0, 0] == 1
+        assert np.asarray(gv)[0, 1] == np.inf
+        assert np.asarray(gv)[0, 2] == 4.0
+
+    def test_threshold_straddles_tie_run(self):
+        # exactly the radix hard case: the k-th value sits inside a run
+        # of equal values; only the earliest columns of the run belong
+        v = np.full((1, 300), 2.0, np.float32)
+        v[0, 250:] = 1.0                      # 50 strictly-smaller at the end
+        gv, gi = radix_select_k(v, 60)
+        # 50 ones (cols 250..299) then the first 10 twos (cols 0..9)
+        assert np.asarray(gv)[0].tolist() == [1.0] * 50 + [2.0] * 10
+        assert np.asarray(gi)[0, :50].tolist() == list(range(250, 300))
+        assert np.asarray(gi)[0, 50:].tolist() == list(range(10))
+
+    @pytest.mark.parametrize("dt", [np.int8, np.int16, np.int32,
+                                    np.uint8, np.uint16, np.uint32])
+    def test_int_dtypes(self, dt):
+        rng = np.random.default_rng(11)
+        info = np.iinfo(dt)
+        v = rng.integers(info.min, int(info.max) + 1,
+                         size=(5, 700)).astype(dt)
+        _check(v, 9)
+        _check(v, 9, select_min=False)
+
+    @pytest.mark.parametrize("dt", [np.float16, jnp.bfloat16])
+    def test_small_floats(self, dt):
+        rng = np.random.default_rng(12)
+        v = jnp.asarray(rng.normal(size=(4, 500)).astype(np.float32), dt)
+        gv, gi = radix_select_k(v, 11)
+        ov, oi = _oracle(np.asarray(v, np.float32), 11)
+        np.testing.assert_array_equal(np.asarray(gi), oi)
+        assert gv.dtype == jnp.asarray(v).dtype
+
+    def test_int_extremes(self):
+        v = np.array([[np.iinfo(np.int32).min, np.iinfo(np.int32).max,
+                       0, -1, 1]], np.int32)
+        _check(v, 3)
+        _check(v, 3, select_min=False)
+
+    def test_supports_envelope(self):
+        assert supports(np.float32, 1 << 20, 16384)
+        assert not supports(np.float32, (1 << 20) + 1, 16)
+        assert not supports(np.float32, 32768, 16385)
+        assert not supports(np.float32, 1024, 2048)   # k > n_cols
+        assert not supports(np.float64, 1024, 16)
+        assert not supports(np.int64, 1024, 16)
+        with pytest.raises(ValueError):
+            radix_select_k(np.zeros((2, 100), np.float32), 200)
+
+    def test_jit_surface(self):
+        rng = np.random.default_rng(13)
+        v = rng.normal(size=(4, 600)).astype(np.float32)
+        f = jax.jit(lambda a: radix_select_k(a, 9))
+        gv, gi = f(v)
+        ov, oi = _oracle(v, 9)
+        np.testing.assert_array_equal(np.asarray(gi), oi)
+
+
+class TestSelectKDispatch:
+    def test_radix_enum_routes_to_radix_kernel(self):
+        rng = np.random.default_rng(14)
+        v = rng.normal(size=(3, 9000)).astype(np.float32)
+        for algo in (SelectAlgo.RADIX_8BITS, SelectAlgo.RADIX_11BITS,
+                     SelectAlgo.RADIX_11BITS_EXTRA_PASS):
+            gv, gi = select_k(None, v, 20, algo=algo)
+            ov, oi = _oracle(v, 20)
+            np.testing.assert_array_equal(np.asarray(gi), oi)
+            np.testing.assert_allclose(np.asarray(gv), ov)
+
+    def test_auto_agrees_with_direct_everywhere(self):
+        rng = np.random.default_rng(15)
+        for n_cols, k in [(8192, 17), (9000, 64), (4096, 32), (700, 8)]:
+            v = rng.normal(size=(2, n_cols)).astype(np.float32)
+            av, ai = select_k(None, v, k)
+            dv, di = select_k(None, v, k,
+                              algo=SelectAlgo.WARPSORT_IMMEDIATE)
+            np.testing.assert_array_equal(np.asarray(ai), np.asarray(di))
+
+    def test_in_idx_passthrough_on_radix(self):
+        rng = np.random.default_rng(16)
+        v = rng.normal(size=(2, 8500)).astype(np.float32)
+        payload = jnp.asarray(
+            rng.integers(0, 1 << 30, size=(2, 8500)), jnp.int32)
+        _, gi = select_k(None, v, 20, algo=SelectAlgo.RADIX_11BITS)
+        _, pi = select_k(None, v, 20, in_idx=payload,
+                         algo=SelectAlgo.RADIX_11BITS)
+        np.testing.assert_array_equal(
+            np.asarray(pi),
+            np.take_along_axis(np.asarray(payload), np.asarray(gi), 1))
