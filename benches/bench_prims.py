@@ -460,6 +460,44 @@ def bench_spmv_large():
     ]
 
 
+@bench("sparse/prim_probe")
+def bench_sparse_prim_probe():
+    """On-chip throughput of the primitives a TPU SpMV redesign could be
+    built from. Mosaic's vector gather requires SAME-SHAPE source/index
+    operands (probed in round 3), so a Pallas x-resident ELL gather is
+    inexpressible — the SpMV design space is therefore spanned by XLA's
+    gather / segment-sum / sort / scan / repeat rates measured here; the
+    redesign verdict gets written into sparse/ell.py from these rows."""
+    full = SIZES["rows"] >= (1 << 20)
+    n = (1 << 20) if full else (1 << 14)
+    e = 16 * n
+    rng = np.random.default_rng(17)
+    x = jnp.asarray(rng.random(n).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, n, size=e).astype(np.int32))
+    seg = jnp.asarray(np.sort(rng.integers(0, n, size=e)).astype(np.int32))
+    vals = jnp.asarray(rng.random(e).astype(np.float32))
+
+    f_gather = jax.jit(lambda v, i: v[i])
+    f_take = jax.jit(lambda v, i: jnp.take(v, i, indices_are_sorted=False))
+    f_gather_sorted = jax.jit(
+        lambda v, i: jnp.take(v, i, indices_are_sorted=True))
+    f_seg = jax.jit(functools.partial(
+        jax.ops.segment_sum, num_segments=n, indices_are_sorted=True))
+    f_sort = jax.jit(jnp.sort)
+    f_cumsum = jax.jit(jnp.cumsum)
+
+    return [
+        run_case("sparse/probe_gather", f_gather, x, idx, items=e),
+        run_case("sparse/probe_take", f_take, x, idx, items=e),
+        run_case("sparse/probe_take_sorted", f_gather_sorted, x, seg,
+                 items=e),
+        run_case("sparse/probe_segment_sum_sorted", f_seg, vals, seg,
+                 items=e),
+        run_case("sparse/probe_sort", f_sort, vals, items=e),
+        run_case("sparse/probe_cumsum", f_cumsum, vals, items=e),
+    ]
+
+
 @bench("comms/collectives")
 def bench_collectives():
     """Eager MeshComms collective throughput over the local device set

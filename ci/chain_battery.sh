@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Chain a fresh battery pass at the current HEAD after the running one
+# exits: wait for the old watcher pid to disappear, then run the full
+# battery (north-star refresh + smoke at the new sha + the re-opened
+# select_k four-way grid incl. the radix kernel).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OLD_PID="${1:?usage: chain_battery.sh <old-watcher-pid>}"
+while kill -0 "$OLD_PID" 2>/dev/null; do sleep 60; done
+echo "[chain] previous battery (pid $OLD_PID) exited; starting fresh pass"
+exec bash ci/tpu_battery.sh

@@ -88,6 +88,15 @@ def supports(dtype, n_cols: int, k: int) -> bool:
     return ok and k <= n_cols and n_cols <= MAX_LEN and k <= MAX_K
 
 
+def preferred(n_cols: int, k: int) -> bool:
+    """The single source of truth for the dispatch band where radix is
+    expected to win (select_k AUTO and the chunked kNN path both gate on
+    this): the round-3 grid showed lax.top_k ~50x under the bandwidth
+    roofline exactly at 16 < k <= 2048 on long rows. Re-derive from
+    ci/derive_select_k.py when the four-way grid rows land."""
+    return n_cols >= 8192 and 16 < k <= 2048
+
+
 def _to_key(values: jnp.ndarray, select_min: bool) -> jnp.ndarray:
     """Order-preserving map into int32 ("sortable key") — ascending key
     == ascending IEEE-total-order value. One fused XLA elementwise pass;

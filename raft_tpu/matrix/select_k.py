@@ -62,7 +62,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.util.math import cdiv, round_up_to_multiple
-from raft_tpu.util.pallas_utils import interpret_needs_ref
+from raft_tpu.util.pallas_utils import has_vma
 
 
 class SelectAlgo(enum.Enum):
@@ -224,17 +224,19 @@ def select_k(res, values, k: int, select_min: bool = True,
     from raft_tpu.matrix import radix_select
 
     def _radix_ok():
+        # vma guard: the radix kernels carry no shard_map vma plumbing
+        # yet — under shard_map the tournament paths keep the call
         return (radix_select.supports(values.dtype, n_cols, k)
-                and not interpret_needs_ref(values))
+                and not has_vma(values))
 
     if algo == SelectAlgo.AUTO:
         # Roofline-motivated dispatch, pending the four-way hardware
         # grid: radix takes the band where the measured grid showed
-        # lax.top_k ~50x under the bandwidth roofline (16 < k <= 2048 on
-        # long rows). k > 2048 stays on the grid's measured winner
-        # (direct at (1M, 10^4)) until radix rows land; thresholds get
-        # re-derived from ci/derive_select_k.py when they do.
-        if n_cols >= 8192 and 16 < k <= 2048 and _radix_ok():
+        # lax.top_k ~50x under the bandwidth roofline
+        # (radix_select.preferred — shared with the chunked kNN gate).
+        # Outside the band the grid's measured winners stand (direct at
+        # (1M, 10^4)); thresholds re-derive from ci/derive_select_k.py.
+        if radix_select.preferred(n_cols, k) and _radix_ok():
             mode = "radix"
         elif _choose_tiled(n_rows, n_cols, k):
             mode = "tiled"

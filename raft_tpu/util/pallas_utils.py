@@ -78,6 +78,14 @@ def out_struct(shape, dtype, vma=frozenset()):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def has_vma(*arrays) -> bool:
+    """True when any operand carries varying-mesh-axes (i.e. we are under
+    shard_map). Kernels without vma plumbing (join_vma + out_struct vma)
+    must not be dispatched to in that case — their vma-free out_shapes
+    fail check_vma on the compiled path, not just in the interpreter."""
+    return any(_vma(a) for a in arrays)
+
+
 def interpret_needs_ref(*arrays) -> bool:
     """True when this call would hit the interpreter's vma replay limitation
     (see module doc): interpret mode AND some operand varies over mesh axes.

@@ -126,3 +126,15 @@ def test_radix_select_lowers_for_tpu(kcase):
     rng = np.random.default_rng(n_cols)
     v = jnp.asarray(rng.normal(size=(16, n_cols)), jnp.float32)
     _lowers_with_mosaic(lambda: radix_select_k(v, k))
+
+
+def test_knn_chunked_radix_lowers_for_tpu():
+    """The chunked-radix kNN path: radix-select kernels inside lax.scan
+    behind the distance kernel (the dispatch regime the CPU suite's
+    small shapes never reach)."""
+    from raft_tpu.neighbors.brute_force import _knn_chunked
+
+    rng = np.random.default_rng(5)
+    db = jnp.asarray(rng.normal(size=(20000, 16)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
+    _lowers_with_mosaic(lambda: _knn_chunked(q, db, 20, 8192, "l2")[0])
