@@ -6,6 +6,10 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 OLD_PID="${1:?usage: chain_battery.sh <old-watcher-pid>}"
-while kill -0 "$OLD_PID" 2>/dev/null; do sleep 60; done
+# PID liveness alone misreads reuse (waits forever) and EPERM (double
+# battery on one chip) — require the cmdline to still be the battery.
+while grep -qa "tpu_battery" "/proc/$OLD_PID/cmdline" 2>/dev/null; do
+    sleep 60
+done
 echo "[chain] previous battery (pid $OLD_PID) exited; starting fresh pass"
 exec bash ci/tpu_battery.sh

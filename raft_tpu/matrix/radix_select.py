@@ -64,8 +64,11 @@ from raft_tpu.util.pallas_utils import out_struct, pallas_call
 _I32_MAX = 0x7FFFFFFF
 _I32_MIN = -0x80000000
 
-# Emission chunk width (lanes) and row block (sublanes).
-_EMIT_TL = 512
+# Emission chunk width (lanes) and row block (sublanes). The chunk is
+# deliberately wide: each grid step pays fixed overhead, and the
+# in-chunk cumsum rides a (tl, tl) triangular matmul whose MXU cost
+# (tl MACs/element) stays cheap next to the 128-wide one-hot VPU work.
+_EMIT_TL = 1024
 _EMIT_TM = 8
 
 # One row lives VMEM-resident in the threshold kernel: 1M * 4 B = 4 MB,
@@ -88,13 +91,18 @@ def supports(dtype, n_cols: int, k: int) -> bool:
     return ok and k <= n_cols and n_cols <= MAX_LEN and k <= MAX_K
 
 
+# Minimum row length of the preferred band (exported so callers sizing
+# their own tiles — the chunked kNN gate — stay in lockstep).
+MIN_COLS = 8192
+
+
 def preferred(n_cols: int, k: int) -> bool:
     """The single source of truth for the dispatch band where radix is
     expected to win (select_k AUTO and the chunked kNN path both gate on
     this): the round-3 grid showed lax.top_k ~50x under the bandwidth
     roofline exactly at 16 < k <= 2048 on long rows. Re-derive from
     ci/derive_select_k.py when the four-way grid rows land."""
-    return n_cols >= 8192 and 16 < k <= 2048
+    return n_cols >= MIN_COLS and 16 < k <= 2048
 
 
 def _to_key(values: jnp.ndarray, select_min: bool) -> jnp.ndarray:
@@ -116,9 +124,11 @@ def _to_key(values: jnp.ndarray, select_min: bool) -> jnp.ndarray:
 
 
 def _threshold_kernel(key_ref, t_ref, ntie_ref, *, k: int):
-    """Exact k-th smallest key for ONE row (grid step = row) via a
-    bitwise binary search. The row arrives reshaped (1, Lp/128, 128) so
-    both Mosaic-tiled dims are aligned regardless of row length.
+    """Exact k-th smallest key per row for a BLOCK of rows (grid step =
+    tm rows) via a per-row bitwise binary search. Rows arrive reshaped
+    (tm, Lp/128, 128) so both Mosaic-tiled dims are aligned regardless
+    of row length; tm scales with VMEM budget so short-row/many-row
+    problems (the chunked kNN shape) don't pay one grid step per row.
 
     Invariant entering the step for bit b: T in
     [prefix, prefix + 2^(b+1) - 1]. probe = prefix + 2^b - 1 tests
@@ -130,11 +140,12 @@ def _threshold_kernel(key_ref, t_ref, ntie_ref, *, k: int):
     kk = jnp.float32(k)
 
     def count_le(t):
-        # re-read per call: keeps the row vector's live range inside one
-        # loop iteration instead of spanning the whole fori_loop
-        return jnp.sum((key_ref[:] <= t).astype(jnp.float32))
+        # t (tm, 1, 1); re-read the block per call: keeps its live range
+        # inside one loop iteration instead of spanning the fori_loop
+        return jnp.sum((key_ref[:] <= t).astype(jnp.float32),
+                       axis=(1, 2), keepdims=True)
 
-    neg = count_le(jnp.int32(-1))
+    neg = count_le(jnp.full(t_ref.shape, -1, jnp.int32))
     prefix = jnp.where(neg >= kk, jnp.int32(_I32_MIN), jnp.int32(0))
 
     # The probed bit rides in the CARRY (2^30 halving each step) instead
@@ -152,10 +163,10 @@ def _threshold_kernel(key_ref, t_ref, ntie_ref, *, k: int):
     t, _ = jax.lax.fori_loop(0, 31, body,
                              (prefix, jnp.int32(1 << 30)))
     # count(key < T) — at T = INT32_MIN nothing is below
-    c_less = jnp.where(t == jnp.int32(_I32_MIN), jnp.float32(0),
-                       count_le(t - 1))
-    t_ref[0, 0, 0] = t
-    ntie_ref[0, 0, 0] = jnp.int32(k) - c_less.astype(jnp.int32)
+    c_less = jnp.where(t == jnp.int32(_I32_MIN), jnp.float32(0.0),
+                       count_le(t - jnp.int32(1)))
+    t_ref[:] = t
+    ntie_ref[:] = jnp.int32(k) - c_less.astype(jnp.int32)
 
 
 def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
@@ -241,20 +252,29 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
     n_rows, n_cols = keys.shape
     # lp multiple of 1024 so the (lp/128, 128) row view is sublane-aligned
     lp = round_up_to_multiple(n_cols, 1024)
-    rp = round_up_to_multiple(n_rows, _EMIT_TM)
+    # rows per threshold grid step: fill the VMEM budget (the whole point
+    # — many-row/short-row problems like the chunked kNN shape must not
+    # pay one grid step per row); power of two so rp stays a common
+    # multiple with the emission row block
+    tm_a = 1
+    row_cap = round_up_to_multiple(n_rows, _EMIT_TM)
+    while (tm_a * 2 * lp * 4 <= MAX_LEN * 4 and tm_a < 128
+           and tm_a * 2 <= row_cap):
+        tm_a *= 2                     # never pad a small batch up to tm_a
+    rp = round_up_to_multiple(n_rows, max(tm_a, _EMIT_TM))
     kpad = jnp.pad(keys, ((0, rp - n_rows), (0, lp - n_cols)),
                    constant_values=_I32_MAX)
     ls = lp // 128
 
     t3, ntie3 = pallas_call(
         functools.partial(_threshold_kernel, k=k),
-        grid=(rp,),
-        in_specs=[pl.BlockSpec((1, ls, 128), lambda i: (i, 0, 0),
+        grid=(rp // tm_a,),
+        in_specs=[pl.BlockSpec((tm_a, ls, 128), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM)],
-        out_specs=[pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0),
-                                memory_space=pltpu.SMEM),
-                   pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0),
-                                memory_space=pltpu.SMEM)],
+        out_specs=[pl.BlockSpec((tm_a, 1, 1), lambda i: (i, 0, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((tm_a, 1, 1), lambda i: (i, 0, 0),
+                                memory_space=pltpu.VMEM)],
         out_shape=[out_struct((rp, 1, 1), jnp.int32),
                    out_struct((rp, 1, 1), jnp.int32)],
         compiler_params=pltpu.CompilerParams(

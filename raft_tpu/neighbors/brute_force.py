@@ -13,7 +13,7 @@ matrix.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -100,22 +100,28 @@ def _knn_scan(queries, db, k: int, tile: int, metric: str, n_valid=None):
     return vals, idx
 
 
-def _chunk_for(q: int, n: int, k: int) -> int:
+def _chunk_for(q: int, n: int, k: int, tile_cap: int = 0) -> int:
     """Database chunk width for the radix path: large enough that the
     per-chunk radix select amortizes (the whole point — fewer, bigger
     selects), small enough that the materialized (q, chunk) f32 distance
-    block stays ~512 MB. Returns 0 when the radix path should not run:
-    short databases, k outside the preferred band
-    (radix_select.preferred — shared with select_k AUTO), or a query
-    count so large the 512 MB block cap cannot be met."""
+    block stays under ~512 MB (cap rounded DOWN to lane alignment — the
+    bound is a promise, not a hint). ``tile_cap``: a caller-supplied
+    explicit tile is ALSO a memory bound — the chunk never exceeds it.
+    Returns 0 when the radix path should not run: short databases,
+    k outside the preferred band (radix_select.preferred — shared with
+    select_k AUTO, incl. its MIN_COLS floor), or a cap below that
+    floor."""
     from raft_tpu.matrix import radix_select
 
+    floor = radix_select.MIN_COLS
     cap = (512 << 20) // max(q * 4, 1)
-    if cap < 8192:
+    cap -= cap % 128                  # round DOWN: honor the bound
+    if tile_cap:
+        cap = min(cap, tile_cap)
+    if cap < floor:
         return 0                      # block cap unmeetable at this q
-    chunk = min(round_up_to_multiple(n, 128), 1 << 20,
-                round_up_to_multiple(cap, 128))
-    if n < 2 * 8192 or not radix_select.preferred(chunk, k):
+    chunk = min(round_up_to_multiple(n, 128), 1 << 20, cap)
+    if n < 2 * floor or not radix_select.preferred(chunk, k):
         return 0
     if not radix_select.supports(jnp.float32, chunk, k):
         return 0
@@ -163,12 +169,16 @@ def _knn_chunked(queries, db, k: int, chunk: int, metric: str,
 
 @with_matmul_precision
 def knn(res, db, queries, k: int, metric: str = "l2",
-        tile: int = 8192) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        tile: Optional[int] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """k nearest database rows per query. Returns (distances [q, k],
     indices [q, k]), nearest first.
 
     ``metric``: 'l2' (squared L2), 'sqeuclidean' (alias), 'euclidean'
     (rooted), 'cosine', or 'inner' (largest inner product first).
+
+    ``tile``: explicit working-block width; also acts as a memory bound
+    on the chunked path's distance block (an explicit small tile forces
+    the scan path rather than being silently ignored). Default: auto.
 
     Dispatch: long databases at 16 < k <= 2048 run the chunked-radix
     path (:func:`_knn_chunked`); otherwise the streaming scan with
@@ -188,15 +198,16 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     queries = jnp.asarray(queries)
     _validate(db, queries, k)
     kernel_metric = _resolve_metric(metric)
-    chunk = _chunk_for(queries.shape[0], db.shape[0], k)
+    chunk = _chunk_for(queries.shape[0], db.shape[0], k,
+                       tile_cap=tile or 0)
     if chunk and not has_vma(db, queries):  # radix kernels: no vma yet
         vals, idx = _knn_chunked(queries.astype(jnp.float32),
                                  db.astype(jnp.float32), k, chunk,
                                  kernel_metric)
     else:
-        tile = _clamp_tile(tile, k, db.shape[0])
+        tile_w = _clamp_tile(tile or 8192, k, db.shape[0])
         vals, idx = _knn_scan(queries.astype(jnp.float32),
-                              db.astype(jnp.float32), k, tile,
+                              db.astype(jnp.float32), k, tile_w,
                               kernel_metric)
     return _finalize(vals, metric), idx
 
