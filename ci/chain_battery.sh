@@ -7,8 +7,10 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 OLD_PID="${1:?usage: chain_battery.sh <old-watcher-pid>}"
 # PID liveness alone misreads reuse (waits forever) and EPERM (double
-# battery on one chip) — require the cmdline to still be the battery.
-while grep -qa "tpu_battery" "/proc/$OLD_PID/cmdline" 2>/dev/null; do
+# battery on one chip) — require the cmdline to still be one of the
+# battery-family scripts (tpu_battery / diag_then_battery /
+# chain_battery all match "battery").
+while grep -qa "battery" "/proc/$OLD_PID/cmdline" 2>/dev/null; do
     sleep 60
 done
 echo "[chain] previous battery (pid $OLD_PID) exited; starting fresh pass"

@@ -59,7 +59,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.linalg.contractions import _round_to_bf16_f32
 from raft_tpu.util.math import cdiv, round_up_to_multiple
-from raft_tpu.util.pallas_utils import out_struct, pallas_call
+from raft_tpu.util.pallas_utils import join_vma, out_struct, pallas_call
 
 _I32_MAX = 0x7FFFFFFF
 _I32_MIN = -0x80000000
@@ -258,13 +258,20 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
     # multiple with the emission row block
     tm_a = 1
     row_cap = round_up_to_multiple(n_rows, _EMIT_TM)
+    # grow only while the resulting row padding stays at the emission
+    # minimum — a bigger threshold block must never force extra pad rows
+    # (they would ride through BOTH kernels)
     while (tm_a * 2 * lp * 4 <= MAX_LEN * 4 and tm_a < 128
-           and tm_a * 2 <= row_cap):
-        tm_a *= 2                     # never pad a small batch up to tm_a
+           and round_up_to_multiple(n_rows, max(tm_a * 2, _EMIT_TM))
+           == row_cap):
+        tm_a *= 2
     rp = round_up_to_multiple(n_rows, max(tm_a, _EMIT_TM))
     kpad = jnp.pad(keys, ((0, rp - n_rows), (0, lp - n_cols)),
                    constant_values=_I32_MAX)
     ls = lp // 128
+    # shard_map plumbing (contractions.py pattern): operands pcast to
+    # the joint varying-mesh-axes, out_shapes declare the same vma
+    vma, (kpad,) = join_vma(kpad)
 
     t3, ntie3 = pallas_call(
         functools.partial(_threshold_kernel, k=k),
@@ -275,8 +282,8 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
                                 memory_space=pltpu.VMEM),
                    pl.BlockSpec((tm_a, 1, 1), lambda i: (i, 0, 0),
                                 memory_space=pltpu.VMEM)],
-        out_shape=[out_struct((rp, 1, 1), jnp.int32),
-                   out_struct((rp, 1, 1), jnp.int32)],
+        out_shape=[out_struct((rp, 1, 1), jnp.int32, vma),
+                   out_struct((rp, 1, 1), jnp.int32, vma)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )(kpad.reshape(rp, ls, 128))
@@ -298,7 +305,7 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
         ],
         out_specs=pl.BlockSpec((tm, kh * 128), lambda i, j: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=out_struct((rp, kh * 128), jnp.float32),
+        out_shape=out_struct((rp, kh * 128), jnp.float32, vma),
         scratch_shapes=[pltpu.VMEM((tm, 1), jnp.int32),
                         pltpu.VMEM((tm, 1), jnp.int32)],
         compiler_params=pltpu.CompilerParams(

@@ -62,7 +62,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.util.math import cdiv, round_up_to_multiple
-from raft_tpu.util.pallas_utils import has_vma
+from raft_tpu.util.pallas_utils import interpret_needs_ref
 
 
 class SelectAlgo(enum.Enum):
@@ -224,10 +224,12 @@ def select_k(res, values, k: int, select_min: bool = True,
     from raft_tpu.matrix import radix_select
 
     def _radix_ok():
-        # vma guard: the radix kernels carry no shard_map vma plumbing
-        # yet — under shard_map the tournament paths keep the call
+        # The radix kernels carry shard_map vma (join_vma + vma
+        # out_shapes); only the INTERPRETER cannot replay vma-carrying
+        # kernels (pallas_utils.interpret_needs_ref) — the CPU test tier
+        # falls back to the tournament paths under shard_map there.
         return (radix_select.supports(values.dtype, n_cols, k)
-                and not has_vma(values))
+                and not interpret_needs_ref(values))
 
     if algo == SelectAlgo.AUTO:
         # Roofline-motivated dispatch, pending the four-way hardware

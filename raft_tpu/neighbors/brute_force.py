@@ -148,8 +148,11 @@ def _knn_chunked(queries, db, k: int, chunk: int, metric: str,
     tiles = dbp.reshape(n_chunks, chunk, d)
     offsets = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
 
-    init = (jnp.full((q, k), jnp.inf, jnp.float32),
-            jnp.zeros((q, k), jnp.int32))
+    from raft_tpu.util.pallas_utils import join_vma, pcast_to
+
+    vma, _ = join_vma(queries, db)
+    init = pcast_to(vma, jnp.full((q, k), jnp.inf, jnp.float32),
+                    jnp.zeros((q, k), jnp.int32))
 
     def step(carry, inp):
         best_v, best_i = carry
@@ -182,8 +185,9 @@ def knn(res, db, queries, k: int, metric: str = "l2",
 
     Dispatch: long databases at 16 < k <= 2048 run the chunked-radix
     path (:func:`_knn_chunked`); otherwise the streaming scan with
-    per-tile top_k (:func:`_knn_scan` — still the shard_map/MNMG path,
-    whose per-shard vma the radix kernels do not carry yet).
+    per-tile top_k (:func:`_knn_scan`). knn_mnmg's per-shard body stays
+    on the scan path until the radix-specific shard_map smoke case
+    (tpu_tests TestShardMapRadixSelect) is green on hardware.
 
     >>> import numpy as np
     >>> from raft_tpu.neighbors import knn
@@ -192,7 +196,7 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     >>> np.asarray(i).tolist()
     [[1, 0]]
     """
-    from raft_tpu.util.pallas_utils import has_vma
+    from raft_tpu.util.pallas_utils import interpret_needs_ref
 
     db = jnp.asarray(db)
     queries = jnp.asarray(queries)
@@ -200,7 +204,9 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     kernel_metric = _resolve_metric(metric)
     chunk = _chunk_for(queries.shape[0], db.shape[0], k,
                        tile_cap=tile or 0)
-    if chunk and not has_vma(db, queries):  # radix kernels: no vma yet
+    # interpret+vma cannot replay vma-carrying kernels — only there does
+    # the dispatch fall back (compiled shard_map uses the radix path)
+    if chunk and not interpret_needs_ref(db, queries):
         vals, idx = _knn_chunked(queries.astype(jnp.float32),
                                  db.astype(jnp.float32), k, chunk,
                                  kernel_metric)
@@ -214,7 +220,8 @@ def knn(res, db, queries, k: int, metric: str = "l2",
 
 @with_matmul_precision
 def knn_mnmg(res, db, queries, k: int, metric: str = "l2",
-             tile: int = 8192, mesh=None, data_axis: str = "data"
+             tile: Optional[int] = None, mesh=None,
+             data_axis: str = "data"
              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """MNMG brute-force k-NN: database rows sharded over ``data_axis``,
     queries replicated; per-shard running top-k, then one all-gather of
@@ -243,7 +250,7 @@ def knn_mnmg(res, db, queries, k: int, metric: str = "l2",
         # run single-device (the reference's MNMG paths assume k ≪ n/dev)
         return knn(res, db, queries, k, metric=metric, tile=tile)
     dbp = jnp.pad(db, ((0, per * ndev - n), (0, 0)))
-    tile_ = _clamp_tile(tile, k, per)
+    tile_ = _clamp_tile(tile or 8192, k, per)
 
     def shard_fn(db_shard, q):
         me = lax.axis_index(data_axis)

@@ -326,3 +326,32 @@ class TestChunkedRadixKnnOnChip:
         order = np.argsort(d2, axis=1, kind="stable")[:, :32]
         agree = (np.asarray(i) == order).mean()
         assert agree > 0.999, agree
+
+
+class TestShardMapRadixSelect:
+    """Radix-select kernels inside shard_map with check_vma=True on the
+    chip: the vma plumbing (join_vma + vma out_shapes) must produce the
+    same result as the out-of-shard_map kernel, with the tpu_custom_call
+    present in the compiled HLO. Green here gates flipping knn_mnmg's
+    shard body to the chunked-radix path."""
+
+    def test_select_k_radix_in_shard_map(self, rng):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from raft_tpu.matrix.radix_select import radix_select_k
+
+        v = rng.normal(size=(16, 9000)).astype(np.float32)
+        v0, i0 = [np.asarray(a) for a in radix_select_k(v, 64)]
+
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        g = jax.jit(jax.shard_map(
+            lambda x: radix_select_k(x, 64), mesh=mesh,
+            in_specs=P("data"), out_specs=(P("data"), P("data"))))
+        hlo = g.lower(v).compile().as_text()
+        assert "tpu_custom_call" in hlo, \
+            "radix kernels fell back inside shard_map"
+        vv, ii = [np.asarray(a) for a in g(v)]
+        np.testing.assert_array_equal(ii, i0)
+        np.testing.assert_array_equal(vv, v0)
