@@ -738,12 +738,16 @@ def bench_stats_metrics():
     h_scatter = jax.jit(functools.partial(
         histogram, n_bins=2048, binner=lambda v, r, c: v * 2048,
         hist_type=HistType.Gmem))
+    h_factored = jax.jit(functools.partial(
+        histogram, n_bins=2048, binner=lambda v, r, c: v * 2048))
     ari = jax.jit(functools.partial(adjusted_rand_index, n_classes=32))
     ent = jax.jit(functools.partial(entropy, lower=0, upper=32))
     return [
         run_case("stats/histogram_64bins_onehot", h_onehot, data,
                  items=data.size),
         run_case("stats/histogram_2048bins_scatter", h_scatter, data,
+                 items=data.size),
+        run_case("stats/histogram_2048bins_factored", h_factored, data,
                  items=data.size),
         run_case("stats/adjusted_rand_index", ari, ya, yb, items=n),
         run_case("stats/entropy", ent, ya, items=n),

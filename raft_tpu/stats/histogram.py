@@ -37,6 +37,56 @@ class HistType(enum.Enum):
 # than scatter: it is a single MXU-friendly contraction with no serialization.
 _ONEHOT_BIN_LIMIT = 512
 
+# Between the one-hot limit and this, the factored path applies (below);
+# beyond it, scatter-add (a 2^14-bin one-hot pair still fits comfortably,
+# and real bin counts beyond that are rare).
+_FACTORED_BIN_LIMIT = 1 << 14
+
+
+def _histogram_factored(bins, valid, n_bins: int):
+    """Mid/large-bin histogram as a FACTORED one-hot contraction: write
+    bin = 128*hi + lo, then H[c, hi, lo] = sum_r OHhi[r,c,hi]*OHlo[r,c,lo]
+    — a batched (n_hi, chunk) @ (chunk, 128) MXU matmul per column
+    instead of a scatter-add (the on-chip sweep measured the scatter at
+    1.4e8 items/s vs 5e9+ for contraction-shaped stats — TPU has no
+    atomics, so scatter serializes; the MXU does not). Exact: one-hot
+    products are 0/1, per-chunk partial counts are integers < 2^24 in
+    f32, accumulated into int32 across row chunks."""
+    import jax
+
+    n_rows, n_cols = bins.shape
+    n_hi = (n_bins + 127) // 128
+    if n_rows == 0:
+        return jnp.zeros((n_bins, n_cols), jnp.int32)
+    # out-of-range rows get hi = n_hi (matches no one-hot column)
+    hi = jnp.where(valid, bins >> 7, n_hi)
+    lo = bins & 127
+    # chunk rows so the transient bf16 one-hots stay ~<=64 MB
+    chunk = max(8, (32 << 20) // max(n_cols * (128 + n_hi), 1))
+    chunk = min(chunk, n_rows)
+    n_chunks = -(-n_rows // chunk)
+    pad = n_chunks * chunk - n_rows
+    if pad:
+        hi = jnp.pad(hi, ((0, pad), (0, 0)), constant_values=n_hi)
+        lo = jnp.pad(lo, ((0, pad), (0, 0)))
+    hi = hi.reshape(n_chunks, chunk, n_cols)
+    lo = lo.reshape(n_chunks, chunk, n_cols)
+    iota_hi = jnp.arange(n_hi, dtype=jnp.int32)
+    iota_lo = jnp.arange(128, dtype=jnp.int32)
+
+    def body(acc, sl):
+        h, l = sl
+        ohhi = (h[..., None] == iota_hi).astype(jnp.bfloat16)
+        ohlo = (l[..., None] == iota_lo).astype(jnp.bfloat16)
+        part = jax.lax.dot_general(
+            ohhi, ohlo, (((0,), (0,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32)      # (n_cols, n_hi, 128)
+        return acc + part.astype(jnp.int32), None
+
+    acc0 = jnp.zeros((n_cols, n_hi, 128), jnp.int32)
+    h, _ = jax.lax.scan(body, acc0, (hi, lo))
+    return h.reshape(n_cols, n_hi * 128)[:, :n_bins].T
+
 
 def histogram(data, n_bins: int, binner=None,
               hist_type: HistType = HistType.Auto):
@@ -65,6 +115,9 @@ def histogram(data, n_bins: int, binner=None,
         onehot = (bins[..., None] == jnp.arange(n_bins)[None, None, :])
         onehot = jnp.where(valid[..., None], onehot, False)
         return jnp.sum(onehot, axis=0, dtype=jnp.int32).T
+
+    if hist_type is not HistType.Gmem and n_bins <= _FACTORED_BIN_LIMIT:
+        return _histogram_factored(bins, valid, n_bins)
 
     # Scatter-add path: flatten (bin, col) into a single segment id.
     clipped = jnp.clip(bins, 0, n_bins - 1)

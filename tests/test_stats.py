@@ -85,6 +85,38 @@ class TestHistogram:
                            for c in range(2)], axis=1)
         np.testing.assert_array_equal(h, expect)
 
+    @pytest.mark.parametrize("n_bins", [513, 777, 2048, 4096])
+    def test_factored_path_matches_scatter(self, rng, n_bins):
+        """Mid/large bin counts ride the factored hi/lo one-hot matmul
+        (the scatter measured 1.4e8 items/s on chip); results must be
+        bit-identical to the Gmem scatter path, incl. out-of-range
+        drops."""
+        data = rng.integers(-10, n_bins + 10, size=(3000, 3)).astype(
+            np.float32)
+        h_fac = np.asarray(stats.histogram(data, n_bins))
+        h_sct = np.asarray(stats.histogram(data, n_bins,
+                                           hist_type=stats.HistType.Gmem))
+        np.testing.assert_array_equal(h_fac, h_sct)
+        assert h_fac.shape == (n_bins, 3)
+
+    def test_factored_multi_chunk_and_padding(self, rng):
+        """The scan accumulation across row chunks INCLUDING a padded
+        tail — the branch a one-chunk test never reaches. The chunk
+        budget is (32<<20) // (n_cols * (128 + n_hi)): n_cols=16384 with
+        n_bins=4096 (n_hi=32) gives chunk=12, so 257 rows span 22
+        chunks with a 7-row pad."""
+        data = rng.integers(-5, 4101, size=(257, 16384)).astype(
+            np.float32)
+        h_fac = np.asarray(stats.histogram(data, 4096))
+        h_sct = np.asarray(stats.histogram(data, 4096,
+                                           hist_type=stats.HistType.Gmem))
+        np.testing.assert_array_equal(h_fac, h_sct)
+
+    def test_factored_empty_input(self):
+        h = np.asarray(stats.histogram(np.zeros((0, 3), np.float32),
+                                       1000))
+        assert h.shape == (1000, 3) and h.sum() == 0
+
 
 class TestInformation:
     def test_entropy(self, rng):
