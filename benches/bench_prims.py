@@ -462,12 +462,15 @@ def bench_spmv_large():
 
 @bench("sparse/prim_probe")
 def bench_sparse_prim_probe():
-    """On-chip throughput of the primitives a TPU SpMV redesign could be
-    built from. Mosaic's vector gather requires SAME-SHAPE source/index
-    operands (probed in round 3), so a Pallas x-resident ELL gather is
-    inexpressible — the SpMV design space is therefore spanned by XLA's
-    gather / segment-sum / sort / scan / repeat rates measured here; the
-    redesign verdict gets written into sparse/ell.py from these rows."""
+    """On-chip throughput of the primitives a TPU SpMV redesign could
+    be built from. Mosaic's vector gather requires SAME-SHAPE
+    source/index operands (probed in round 3), which rules out a
+    narrow-index gather from a wide resident x — but NOT a same-shape
+    formulation: probe_pallas_rowwise_gather measures a (rows, W)-from-
+    (rows, W) in-kernel gather, the primitive an nnz-blocked SpMV would
+    be built on. The XLA gather / segment-sum / sort / scan rates bound
+    the non-Pallas alternatives; the redesign verdict gets written into
+    sparse/ell.py from these rows."""
     full = SIZES["rows"] >= (1 << 20)
     n = (1 << 20) if full else (1 << 14)
     e = 16 * n
@@ -476,6 +479,36 @@ def bench_sparse_prim_probe():
     idx = jnp.asarray(rng.integers(0, n, size=e).astype(np.int32))
     seg = jnp.asarray(np.sort(rng.integers(0, n, size=e)).astype(np.int32))
     vals = jnp.asarray(rng.random(e).astype(np.float32))
+
+    def _pallas_same_shape_gather():
+        # Mosaic's vector gather REQUIRES same-shape source/index. A
+        # (1, n)-from-(1, n) gather is therefore expressible — if its
+        # on-chip rate is good, SpMV can gather x for nnz in n-sized
+        # blocks (src = x itself). This kernel measures that rate.
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        from raft_tpu.util.pallas_utils import pallas_call
+
+        rows = max(n // (128 * 128), 8)
+
+        def kern(x_ref, i_ref, o_ref):
+            o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=1)
+
+        def run(xv, iv):
+            x2 = xv.reshape(rows, -1)
+            i2 = (iv % x2.shape[1]).reshape(rows, -1)
+            return pallas_call(
+                kern,
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                          pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct(x2.shape, jnp.float32),
+            )(x2, i2)
+
+        return jax.jit(run)
+
+    f_pallas_gather = _pallas_same_shape_gather()
 
     f_gather = jax.jit(lambda v, i: v[i])
     f_take = jax.jit(lambda v, i: jnp.take(v, i, indices_are_sorted=False))
@@ -487,6 +520,8 @@ def bench_sparse_prim_probe():
     f_cumsum = jax.jit(jnp.cumsum)
 
     return [
+        run_case("sparse/probe_pallas_rowwise_gather", f_pallas_gather,
+                 x, idx[:n], items=n),
         run_case("sparse/probe_gather", f_gather, x, idx, items=e),
         run_case("sparse/probe_take", f_take, x, idx, items=e),
         run_case("sparse/probe_take_sorted", f_gather_sorted, x, seg,

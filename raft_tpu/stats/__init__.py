@@ -5,7 +5,7 @@ the reference uses bespoke CUDA kernels (histogram smem strategies, O(n^2)
 rand-index pair counting), the TPU design reformulates the computation as
 matmul / segment-sum / sort primitives that XLA tiles onto the MXU:
 
-- histogram          -> clipped-bin scatter-add (one-hot matmul for small bins)
+- histogram          -> one-hot matmul (small bins) / factored hi-lo contraction (mid) / scatter-add (huge)
 - contingency matrix -> 2-D scatter-add; rand/ARI/MI/V-measure derive from it
   in closed form instead of pair-counting kernels
 - silhouette/trustworthiness -> tiled pairwise-distance reductions on the

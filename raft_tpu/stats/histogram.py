@@ -3,10 +3,13 @@
 The reference ships nine CUDA strategies (smem bit-packed atomics, gmem
 atomics, match_any, smem hash — stats/stats_types.hpp:22-52) chosen by
 ``HistType``. On TPU there are no atomics to tune: a histogram is a
-scatter-add (XLA lowers jnp.add.at-style segment sums efficiently) or, for
-small bin counts, a one-hot matmul that rides the MXU. We keep the
-``HistType`` vocabulary for API parity; every member maps onto the same two
-TPU formulations with ``HistTypeAuto`` picking by n_bins.
+scatter-add (XLA lowers jnp.add.at-style segment sums efficiently), a
+one-hot matmul that rides the MXU (small bin counts), or a FACTORED
+hi/lo one-hot contraction (mid/large bin counts — bin = 128*hi + lo,
+batched MXU matmul per column; the on-chip sweep measured the scatter
+~35x slower there). We keep the ``HistType`` vocabulary for API parity;
+every member maps onto these three TPU formulations with
+``HistTypeAuto`` picking by n_bins.
 """
 
 from __future__ import annotations
