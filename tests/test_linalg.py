@@ -514,3 +514,79 @@ class TestShapeDtypeGrid:
         ref = a.astype(np.float64) @ b.astype(np.float64)
         np.testing.assert_allclose(out.astype(np.float64), ref,
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestKeyedRowsumMatmul:
+    """The one-hot contraction path of reduce_rows_by_key (small key
+    counts) vs the segment-sum oracle, incl. chunk-boundary row counts,
+    out-of-range key drops, and the int-dtype carve-out."""
+
+    def test_matches_segment_sum_multi_chunk(self):
+        import jax
+
+        from raft_tpu import linalg
+
+        rng = np.random.default_rng(7)
+        # chunk = (32<<20)//(2*512) = 32768 -> 70000 rows span 3 chunks
+        X = rng.normal(size=(70000, 8)).astype(np.float32)
+        keys = rng.integers(-2, 514, size=70000).astype(np.int32)
+        got = np.asarray(linalg.reduce_rows_by_key(None, X, keys, 512))
+        ref = np.asarray(jax.ops.segment_sum(
+            jnp.asarray(X), jnp.asarray(keys), num_segments=512))
+        np.testing.assert_allclose(got, ref, rtol=3e-5, atol=3e-4)
+
+    def test_int_data_stays_exact_segment_path(self):
+        from raft_tpu import linalg
+
+        X = np.arange(40, dtype=np.int32).reshape(10, 4)
+        keys = np.array([0, 1] * 5, np.int32)
+        got = np.asarray(linalg.reduce_rows_by_key(None, X, keys, 2))
+        assert got.dtype == np.int32
+        np.testing.assert_array_equal(got[0], X[::2].sum(0))
+
+    def test_large_key_count_uses_segment_path(self, monkeypatch):
+        import importlib
+
+        from raft_tpu import linalg
+        red = importlib.import_module("raft_tpu.linalg.reduce")
+
+        def boom(*a, **k):
+            raise AssertionError("matmul path must not run at 5000 keys")
+
+        monkeypatch.setattr(red, "_keyed_rowsum_matmul", boom)
+        rng = np.random.default_rng(8)
+        X = rng.normal(size=(100, 4)).astype(np.float32)
+        keys = rng.integers(0, 5000, size=100).astype(np.int32)
+        got = np.asarray(linalg.reduce_rows_by_key(None, X, keys, 5000))
+        assert got.shape == (5000, 4)
+        np.testing.assert_allclose(got.sum(0), X.sum(0), rtol=1e-5)
+
+    def test_narrow_key_dtype(self):
+        from raft_tpu import linalg
+
+        rng = np.random.default_rng(9)
+        X = rng.normal(size=(1000, 4)).astype(np.float32)
+        keys = rng.integers(0, 250, size=1000).astype(np.uint8)
+        got = np.asarray(linalg.reduce_rows_by_key(None, X, keys, 300))
+        ref = np.zeros((300, 4), np.float64)
+        np.add.at(ref, keys, X.astype(np.float64))
+        np.testing.assert_allclose(got, ref, rtol=3e-5, atol=3e-4)
+
+    def test_f64_keeps_exact_segment_path(self, monkeypatch):
+        import jax
+
+        if not jax.config.jax_enable_x64:
+            return
+        import importlib
+
+        from raft_tpu import linalg
+        red = importlib.import_module("raft_tpu.linalg.reduce")
+
+        def boom(*a, **k):
+            raise AssertionError("f64 must stay on segment_sum")
+
+        monkeypatch.setattr(red, "_keyed_rowsum_matmul", boom)
+        X = np.random.default_rng(10).normal(size=(50, 3))
+        keys = np.zeros(50, np.int32)
+        got = np.asarray(linalg.reduce_rows_by_key(None, X, keys, 4))
+        np.testing.assert_allclose(got[0], X.sum(0), rtol=1e-12)
