@@ -51,5 +51,14 @@ def map_reduce(res, op, reduce_op, init, *ins):
 
 def map_then_reduce(res, op, *ins):
     """Sum-reduction of a mapped expression
-    (ref: map_then_reduce / map_then_sum_reduce)."""
-    return jnp.sum(op(*[jnp.asarray(x) for x in ins]))
+    (ref: map_then_reduce / map_then_sum_reduce).
+
+    Staged reduction (minor axis first, then the rest): the r2 sweep
+    measured the single `jnp.sum(x)` all-axes spelling at 127 GB/s on
+    v5e while the row-reduce spelling ran at 753 — XLA's direct
+    to-scalar reduce emitter does not tile the minor dim as well as the
+    staged pair, which fuses into the same one pass over the data."""
+    mapped = op(*[jnp.asarray(x) for x in ins])
+    if mapped.ndim <= 1:
+        return jnp.sum(mapped)
+    return jnp.sum(jnp.sum(mapped, axis=-1))

@@ -96,9 +96,20 @@ _MATMUL_KEY_LIMIT = 1024
 def _keyed_rowsum_matmul(data, keys, n_keys: int):
     """out[k, :] = sum_{i: keys[i]==k} data[i, :] as a one-hot MXU
     contraction, row-chunked so the transient bf16 one-hot stays small.
-    The one-hot side is exactly bf16-representable, so the precision
-    tier's exact_lhs economy applies (contractions._kernel_dot)."""
-    from raft_tpu.linalg.contractions import _kernel_dot_exact_lhs
+
+    Precision floor: this op replaces an EXACT segment sum, so it never
+    follows the tier below 'high' — the one-hot side is exactly bf16
+    (one pass economy) and the data side always gets its bf16 hi/lo
+    split (2 MXU passes, ~2^-17), even when the session opted into the
+    single-pass 'default' tier (which would round data to ~8 mantissa
+    bits — a silent downgrade of a formerly exact op). 'highest' is
+    honored. Same chunked one-hot shape as the Lloyd interpreter
+    fallback (contractions._lloyd_jnp_chunked lineage) — kept separate
+    because that site also carries counts and runs inside the
+    kernel-reference path."""
+    from raft_tpu.linalg.contractions import (_kernel_dot_exact_lhs,
+                                              _round_to_bf16_f32)
+    from raft_tpu.util.precision import current_mode
 
     n_rows = data.shape[0]
     # int32 key domain: narrow key dtypes (uint8 etc.) would overflow on
@@ -115,10 +126,22 @@ def _keyed_rowsum_matmul(data, keys, n_keys: int):
     kc = keys.reshape(n_chunks, chunk)
     iota = jnp.arange(n_keys, dtype=jnp.int32)
 
+    exact_tier = current_mode() == "highest"
+
     def body(acc, sl):
         d, k = sl
         oh = (iota[:, None] == k[None, :]).astype(jnp.bfloat16)
-        return acc + _kernel_dot_exact_lhs(oh, d.astype(jnp.float32)), None
+        d = d.astype(jnp.float32)
+        if exact_tier:
+            return acc + _kernel_dot_exact_lhs(oh, d), None
+        # tier-independent 'high' floor: bf16 hi/lo split of the data
+        # side, one-hot side exact (see docstring)
+        d_hi_f = _round_to_bf16_f32(d)
+        part = jnp.dot(oh, d_hi_f.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+        part = part + jnp.dot(oh, (d - d_hi_f).astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32)
+        return acc + part, None
 
     acc0 = jnp.zeros((n_keys, data.shape[1]), jnp.float32)
     out, _ = jax.lax.scan(body, acc0, (dc, kc))

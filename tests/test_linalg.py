@@ -561,6 +561,26 @@ class TestKeyedRowsumMatmul:
         assert got.shape == (5000, 4)
         np.testing.assert_allclose(got.sum(0), X.sum(0), rtol=1e-5)
 
+    def test_default_tier_keeps_high_floor(self):
+        """The keyed rowsum replaces an exact segment sum, so it must
+        NOT follow the session tier down to one bf16 pass (~1e-3 rel) —
+        the data side keeps its hi/lo split even at 'default'."""
+        import raft_tpu
+        from raft_tpu import linalg
+
+        rng = np.random.default_rng(12)
+        X = rng.normal(size=(40000, 6)).astype(np.float32)
+        keys = rng.integers(0, 32, size=40000).astype(np.int32)
+        ref = np.zeros((32, 6), np.float64)
+        np.add.at(ref, keys, X.astype(np.float64))
+        old = raft_tpu.get_matmul_precision()
+        try:
+            raft_tpu.set_matmul_precision("default")
+            got = np.asarray(linalg.reduce_rows_by_key(None, X, keys, 32))
+        finally:
+            raft_tpu.set_matmul_precision(old)
+        np.testing.assert_allclose(got, ref, rtol=3e-5, atol=3e-3)
+
     def test_narrow_key_dtype(self):
         from raft_tpu import linalg
 
@@ -576,7 +596,7 @@ class TestKeyedRowsumMatmul:
         import jax
 
         if not jax.config.jax_enable_x64:
-            return
+            pytest.skip("requires jax_enable_x64")
         import importlib
 
         from raft_tpu import linalg
