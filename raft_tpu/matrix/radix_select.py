@@ -177,9 +177,12 @@ def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
     elements first (in column order), then the first `n_tie`
     threshold-equal elements. Slot one-hot factorizes as
     rank = 128*hi + lo; the index value rides the hi side in three exact
-    bf16 parts and one (3*kh, tl) @ (tl, 128) MXU contraction per row
-    accumulates all three parts' slabs, summed into the (kh*128,) output
-    block f32-exactly (each slot receives exactly one candidate)."""
+    bf16 parts and one ROW-BATCHED (tm, 3*kh, tl) @ (tm, tl, 128)
+    dot_general accumulates all three parts' slabs, summed into the
+    (kh*128,) output block f32-exactly (each slot receives exactly one
+    candidate). Batching the rows through one dot keeps the kernel body
+    compact (the earlier per-row unrolled loop grew the module with tm
+    and serialized tm small matmuls per grid step)."""
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -225,19 +228,21 @@ def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
     p1 = _round_to_bf16_f32(r1)
     p2 = r1 - p1
 
-    lo_t = lo.T                                        # (tl, tm)
-    iota_h = jax.lax.broadcasted_iota(jnp.int32, (kh, 1), 0)
-    iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
-    for r in range(tm):
-        ohhi = (iota_h == hi[r:r + 1, :]).astype(jnp.bfloat16)  # (kh, tl)
-        a = jnp.concatenate([ohhi * p0.astype(jnp.bfloat16),
-                             ohhi * p1.astype(jnp.bfloat16),
-                             ohhi * p2.astype(jnp.bfloat16)], axis=0)
-        ohlo = (lo_t[:, r:r + 1] == iota_l).astype(jnp.bfloat16)
-        slabs = jnp.dot(a, ohlo, preferred_element_type=jnp.float32)
-        slab = (slabs[:kh] + slabs[kh:2 * kh] + slabs[2 * kh:]
-                ).reshape(1, kh * 128)
-        out_ref[r:r + 1, :] += slab
+    iota_h = jax.lax.broadcasted_iota(jnp.int32, (1, kh, 1), 1)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 128), 2)
+    ohhi = (iota_h == hi[:, None, :]).astype(jnp.bfloat16)  # (tm, kh, tl)
+    pb0 = p0.astype(jnp.bfloat16)[None, :, :]          # (1, 1, tl)
+    pb1 = p1.astype(jnp.bfloat16)[None, :, :]
+    pb2 = p2.astype(jnp.bfloat16)[None, :, :]
+    a = jnp.concatenate([ohhi * pb0, ohhi * pb1, ohhi * pb2],
+                        axis=1)                        # (tm, 3kh, tl)
+    ohlo = (lo[:, :, None] == iota_l).astype(jnp.bfloat16)  # (tm, tl, 128)
+    slabs = jax.lax.dot_general(
+        a, ohlo, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)            # (tm, 3kh, 128)
+    slab = (slabs[:, :kh] + slabs[:, kh:2 * kh] + slabs[:, 2 * kh:]
+            ).reshape(tm, kh * 128)
+    out_ref[:] += slab
 
     less_run[:] = run_less + jnp.sum(
         strict.astype(jnp.float32), axis=1, keepdims=True).astype(jnp.int32)
@@ -256,16 +261,25 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
     # — many-row/short-row problems like the chunked kNN shape must not
     # pay one grid step per row); power of two so rp stays a common
     # multiple with the emission row block
+    # emission row block: wider halves the grid-step count (per-step
+    # overhead is the emission's fixed cost at many-row shapes); at
+    # large k the (tm, 3*kh, tl) operand would blow VMEM, so fall back
+    kh = cdiv(k, 128)
+    # gate on the FULL emission live set (a + ohlo + tri + ohhi + slabs
+    # ≈ 8.6 MB at kh=16/tm=16 vs ~11 MB at kh=32 — over the ~10 MB
+    # working-set budget); kh <= 16 covers the whole preferred dispatch
+    # band (k <= 2048)
+    tm_e = 16 if kh <= 16 else _EMIT_TM
     tm_a = 1
-    row_cap = round_up_to_multiple(n_rows, _EMIT_TM)
+    row_cap = round_up_to_multiple(n_rows, tm_e)
     # grow only while the resulting row padding stays at the emission
     # minimum — a bigger threshold block must never force extra pad rows
     # (they would ride through BOTH kernels)
     while (tm_a * 2 * lp * 4 <= MAX_LEN * 4 and tm_a < 128
-           and round_up_to_multiple(n_rows, max(tm_a * 2, _EMIT_TM))
+           and round_up_to_multiple(n_rows, max(tm_a * 2, tm_e))
            == row_cap):
         tm_a *= 2
-    rp = round_up_to_multiple(n_rows, max(tm_a, _EMIT_TM))
+    rp = round_up_to_multiple(n_rows, max(tm_a, tm_e))
     kpad = jnp.pad(keys, ((0, rp - n_rows), (0, lp - n_cols)),
                    constant_values=_I32_MAX)
     ls = lp // 128
@@ -290,8 +304,7 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
     t = t3.reshape(rp, 1)
     ntie = ntie3.reshape(rp, 1)
 
-    kh = cdiv(k, 128)
-    tm, tl = _EMIT_TM, _EMIT_TL
+    tm, tl = tm_e, _EMIT_TL
     idx_f = pallas_call(
         functools.partial(_emit_kernel, k=k, kh=kh, tl=tl, tm=tm),
         grid=(rp // tm, lp // tl),
