@@ -336,14 +336,19 @@ def bench_gather():
 
 @bench("random/rng")
 def bench_rng():
-    from raft_tpu.random import RngState, uniform
+    from raft_tpu.random import GeneratorType, RngState, uniform
 
     n = SIZES["rows"] * SIZES["cols"]
 
     def gen():
         return uniform(None, RngState(0), (n,))
 
+    def gen_rbg():
+        return uniform(None, RngState(0, type=GeneratorType.RBG), (n,))
+
     return [run_case("random/uniform", gen, items=n,
+                     bytes_moved=n * 4),
+            run_case("random/uniform_rbg", gen_rbg, items=n,
                      bytes_moved=n * 4)]
 
 

@@ -39,8 +39,22 @@ class RngState:
             max_calls_per_subsequence)
 
     def key(self) -> jax.Array:
-        """The jax PRNG key for the *current* subsequence."""
-        base = jax.random.key(self.seed)
+        """The jax PRNG key for the *current* subsequence.
+
+        GeneratorType.RBG selects jax's 'rbg' implementation — on TPU it
+        drives the hardware RNG instructions instead of computing
+        threefry rounds on the VPU (the r2 sweep measured threefry
+        uniform generation at 18% of HBM rate, compute-bound). Same
+        counter-based key semantics (fold_in/split supported); streams
+        are NOT cross-implementation reproducible, matching the
+        reference's contract that GenPhilox/GenPC draw different
+        sequences (rng_state.hpp:19-45)."""
+        # explicit impl for BOTH types: impl=None would follow the
+        # global jax_default_prng_impl, so the enum wouldn't pin the
+        # generator (an embedding app flipping the global default must
+        # not silently change RngState streams)
+        impl = "rbg" if self.type == GeneratorType.RBG else "threefry2x32"
+        base = jax.random.key(self.seed, impl=impl)
         return jax.random.fold_in(base, self.base_subsequence)
 
     def next_key(self) -> jax.Array:
