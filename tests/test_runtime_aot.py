@@ -128,3 +128,23 @@ def test_lloyd_packed_spelling_exports(tmp_path):
                                        rtol=1e-5, atol=1e-5)
     finally:
         set_matmul_precision(old)
+
+
+def test_radix_select_exports(tmp_path):
+    """The radix-select kernels (fori bit walk + batched-dot emission +
+    scratch carry) survive the AOT serialize/reload boundary with
+    identical results — the runtime layer's contract for every shipped
+    kernel family."""
+    import numpy as np
+
+    from raft_tpu.matrix.radix_select import radix_select_k
+
+    rng = np.random.default_rng(0)
+    v = rng.normal(size=(12, 2000)).astype(np.float32)
+    ref_v, ref_i = radix_select_k(v, 25)
+    exp = aot_export(lambda a: radix_select_k(a, 25), v)
+    p = str(tmp_path / "radix_select.stablehlo")
+    save_computation(exp, p)
+    got_v, got_i = load_computation(p)(v)
+    np.testing.assert_array_equal(np.asarray(got_i), np.asarray(ref_i))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))
