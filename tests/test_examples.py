@@ -12,6 +12,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.mark.parametrize("script", ["kmeans_quickstart.py",
                                     "knn_quickstart.py",
+                                    "select_k_quickstart.py",
                                     "spectral_eigsh.py"])
 def test_example_runs(script):
     env = dict(os.environ)
