@@ -355,3 +355,37 @@ class TestShardMapRadixSelect:
         vv, ii = [np.asarray(a) for a in g(v)]
         np.testing.assert_array_equal(ii, i0)
         np.testing.assert_array_equal(vv, v0)
+
+
+class TestScatterToContractionOnChip:
+    """The round-3 scatter->contraction formulations carry exactness
+    claims (one-hot products, integer partials, f32 accumulation) that
+    CPU cannot falsify for MXU execution — pin them on hardware."""
+
+    def test_factored_histogram_bit_identical_to_scatter(self):
+        import jax.numpy as jnp
+
+        from raft_tpu.stats import histogram
+        from raft_tpu.stats.histogram import HistType
+
+        rng = np.random.default_rng(41)
+        data = rng.integers(-9, 2060, size=(60000, 4)).astype(np.float32)
+        h_fac = np.asarray(histogram(jnp.asarray(data), 2048))
+        h_sct = np.asarray(histogram(jnp.asarray(data), 2048,
+                                     hist_type=HistType.Gmem))
+        np.testing.assert_array_equal(h_fac, h_sct)
+
+    def test_keyed_rowsum_matches_segment_sum(self):
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu import linalg
+
+        rng = np.random.default_rng(42)
+        X = rng.normal(size=(60000, 8)).astype(np.float32)
+        keys = rng.integers(0, 64, size=60000).astype(np.int32)
+        got = np.asarray(linalg.reduce_rows_by_key(None, X, keys, 64))
+        ref = np.asarray(jax.ops.segment_sum(
+            jnp.asarray(X), jnp.asarray(keys), num_segments=64))
+        # 'high'-floor contraction vs exact segment: 2^-17 data rounding
+        np.testing.assert_allclose(got, ref, rtol=3e-5, atol=3e-3)
