@@ -57,19 +57,49 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu.linalg.contractions import _round_to_bf16_f32
+from raft_tpu.linalg.contractions import _VMEM_BUDGET, _round_to_bf16_f32
 from raft_tpu.util.math import cdiv, round_up_to_multiple
 from raft_tpu.util.pallas_utils import join_vma, out_struct, pallas_call
 
 _I32_MAX = 0x7FFFFFFF
 _I32_MIN = -0x80000000
 
-# Emission chunk width (lanes) and row block (sublanes). The chunk is
-# deliberately wide: each grid step pays fixed overhead, and the
-# in-chunk cumsum rides a (tl, tl) triangular matmul whose MXU cost
-# (tl MACs/element) stays cheap next to the 128-wide one-hot VPU work.
-_EMIT_TL = 1024
-_EMIT_TM = 8
+# The emission chunk is deliberately wide (tl = 1024 where it fits):
+# each grid step pays fixed overhead, and the in-chunk cumsum rides a
+# (tl, tl) triangular matmul whose MXU cost (tl MACs/element) stays
+# cheap next to the 128-wide one-hot VPU work.
+
+
+def _emit_live_set_bytes(tm: int, tl: int, kh: int) -> int:
+    """Simultaneously-live VMEM of one _emit_kernel grid step: the
+    one-hot/index operand `a` (tm, 3kh, tl) bf16 + ohhi (tm, kh, tl)
+    bf16 ride the kh axis; ohlo (tm, tl, 128) bf16, the triangular
+    cumsum mask (tl, tl) bf16, masks/excl (~12 B/elem over (tm, tl)),
+    slabs (tm, 3kh, 128) f32 and the (tm, kh*128) f32 output block."""
+    return (8 * tm * kh * tl          # a + ohhi
+            + 256 * tm * tl           # ohlo
+            + 2 * tl * tl             # tri
+            + 16 * tm * tl            # key/masks/excl/rank temporaries
+            + 1536 * tm * kh          # slabs
+            + 512 * tm * kh)          # out block
+
+
+def _emit_tiles(kh: int) -> Tuple[int, int]:
+    """(tm, tl) for the emission kernel: the largest tile whose live set
+    fits the ~10 MB working-set budget (contractions._VMEM_BUDGET).
+    kh <= 16 (the whole preferred dispatch band, k <= 2048) keeps the
+    round-3 (16, 1024) tile — the hardware-validated band, so tm = 16
+    is not offered above it even where the estimate would fit; larger
+    k — reachable via the explicit RADIX_* enums up to MAX_K — shrinks
+    tl before tm so the (tm, 3kh, tl) operand cannot blow VMEM (advisor
+    finding, round 3: at kh=128/tm=8/tl=1024 the live set is
+    ~14-15 MB)."""
+    candidates = ((16, 1024),) if kh <= 16 else ()
+    candidates += ((8, 1024), (8, 512), (8, 256), (8, 128))
+    for tm, tl in candidates:
+        if _emit_live_set_bytes(tm, tl, kh) <= _VMEM_BUDGET:
+            return tm, tl
+    return 8, 128
 
 # One row lives VMEM-resident in the threshold kernel: 1M * 4 B = 4 MB,
 # ~8 MB with Pallas double-buffering — inside the same ~10 MB working-set
@@ -265,11 +295,10 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
     # overhead is the emission's fixed cost at many-row shapes); at
     # large k the (tm, 3*kh, tl) operand would blow VMEM, so fall back
     kh = cdiv(k, 128)
-    # gate on the FULL emission live set (a + ohlo + tri + ohhi + slabs
-    # ≈ 8.6 MB at kh=16/tm=16 vs ~11 MB at kh=32 — over the ~10 MB
-    # working-set budget); kh <= 16 covers the whole preferred dispatch
-    # band (k <= 2048)
-    tm_e = 16 if kh <= 16 else _EMIT_TM
+    # tile sized from the FULL emission live set (≈ 8.6 MB at
+    # kh=16/tm=16/tl=1024; tl shrinks as kh grows past the preferred
+    # band so the explicit-enum k <= MAX_K route stays inside budget)
+    tm_e, tl_e = _emit_tiles(kh)
     tm_a = 1
     row_cap = round_up_to_multiple(n_rows, tm_e)
     # grow only while the resulting row padding stays at the emission
@@ -304,7 +333,7 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
     t = t3.reshape(rp, 1)
     ntie = ntie3.reshape(rp, 1)
 
-    tm, tl = tm_e, _EMIT_TL
+    tm, tl = tm_e, tl_e
     idx_f = pallas_call(
         functools.partial(_emit_kernel, k=k, kh=kh, tl=tl, tm=tm),
         grid=(rp // tm, lp // tl),

@@ -110,7 +110,8 @@ def test_packed_split_lowers_for_tpu(xy):
         jax.config.update("jax_default_matmul_precision", None)
 
 
-@pytest.mark.parametrize("kcase", [(9000, 64), (1000, 7), (600, 5)])
+@pytest.mark.parametrize("kcase", [(9000, 64), (1000, 7), (600, 5),
+                                   (32768, 16384)])
 def test_radix_select_lowers_for_tpu(kcase):
     """Both radix-select kernels: the fori_loop bit walk with in-loop
     VMEM re-reads (threshold) and the triangular-matmul cumsum +

@@ -64,6 +64,26 @@ class TestRadixSelect:
         _check(rng.normal(size=(6, 900)).astype(np.float32), 33,
                select_min=False)
 
+    def test_large_k_above_preferred_band(self):
+        # kh = 32 leaves the (16, 1024) emission tile for (8, 1024)
+        # (advisor finding, round 3: large-k live-set gating)
+        rng = np.random.default_rng(41)
+        _check(rng.normal(size=(2, 8192)).astype(np.float32), 4096)
+
+    def test_emit_tiles_fit_budget_up_to_max_k(self):
+        from raft_tpu.linalg.contractions import _VMEM_BUDGET
+        from raft_tpu.matrix.radix_select import (MAX_K,
+                                                  _emit_live_set_bytes,
+                                                  _emit_tiles)
+
+        assert MAX_K == 128 * 128   # kh sample below covers the envelope
+        for kh in (1, 4, 16, 17, 32, 64, 128):
+            tm, tl = _emit_tiles(kh)
+            assert _emit_live_set_bytes(tm, tl, kh) <= _VMEM_BUDGET
+            # tm = 16 is the hardware-validated band only
+            assert kh <= 16 or tm == 8
+        assert _emit_tiles(16) == (16, 1024)   # preferred band unchanged
+
     def test_all_equal_rows_tie_to_first_indices(self):
         v = np.zeros((3, 600), np.float32)
         _, gi = radix_select_k(v, 5)

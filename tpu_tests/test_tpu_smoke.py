@@ -389,3 +389,22 @@ class TestScatterToContractionOnChip:
             jnp.asarray(X), jnp.asarray(keys), num_segments=64))
         # 'high'-floor contraction vs exact segment: 2^-17 data rounding
         np.testing.assert_allclose(got, ref, rtol=3e-5, atol=3e-3)
+
+
+class TestRadixSelectMaxKOnChip:
+    def test_radix_select_at_max_k(self):
+        """kh = 128 drives the emission tile to (8, 512) — the live-set
+        gating added for the round-3 advisor finding; before it, this
+        shape sized a ~14-15 MB working set and was never compiled on
+        hardware."""
+        import jax.numpy as jnp
+
+        from raft_tpu.matrix.radix_select import MAX_K, radix_select_k
+
+        rng = np.random.default_rng(43)
+        v = rng.normal(size=(3, 2 * MAX_K)).astype(np.float32)
+        gv, gi = radix_select_k(jnp.asarray(v), MAX_K)
+        order = np.argsort(v, axis=1, kind="stable")[:, :MAX_K]
+        np.testing.assert_array_equal(np.asarray(gi), order)
+        np.testing.assert_array_equal(
+            np.asarray(gv), np.take_along_axis(v, order, 1))
