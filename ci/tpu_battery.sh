@@ -133,8 +133,9 @@ echo "[battery] running full bench sweep (per-family processes)"
 # decision-bearing families first (they gate standing design choices:
 # select_k thresholds, ELL auto-select, segment-spmv, north-star shape),
 # then everything else in registry order
-PRIORITY="cluster/kmeans_iter matrix/select_k matrix/select_k_large
-sparse/spmv_large sparse/lanczos sparse/mst neighbors/brute_force
+PRIORITY="cluster/kmeans_iter sparse/prim_probe sparse/spmv_large
+sparse/lanczos matrix/select_k matrix/select_k_large
+neighbors/brute_force sparse/mst
 stats/moments stats/metrics random/rng random/make_blobs random/permute
 random/subsample"
 PRIORITY=$(echo $PRIORITY)   # flatten newlines -> single spaces
@@ -195,5 +196,17 @@ EOF
     fi
     rm -f "$FTMP"
 done
+
+# Adjudications from the fresh rows (decision data for dispatch defaults;
+# consumed by the next code change, never auto-applied): the four-way
+# select_k tournament and the SpMV formulation comparison.
+if grep -q '"bench": "matrix/select_k' "$OUT"; then
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python ci/derive_select_k.py "$OUT" \
+        > tpu_battery_out/select_k_derive.txt 2>&1 \
+        && echo "[battery] select_k adjudication written"
+fi
+grep -E '"bench": "sparse/(spmv|probe)' "$OUT" \
+    > tpu_battery_out/spmv_verdict_rows.txt 2>/dev/null
 
 echo "[battery] DONE $(date +%H:%M:%S)"

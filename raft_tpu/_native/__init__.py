@@ -134,6 +134,10 @@ def _bind(lib):
                                          c.c_int64, c.c_int64]
     lib.rt_threadpool_submit.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
     lib.rt_threadpool_wait.argtypes = [c.c_void_p]
+    lib.rt_spmv_pack.restype = c.c_int64
+    lib.rt_spmv_pack.argtypes = [c.POINTER(c.c_int32), c.c_int64, c.c_int32,
+                                 c.POINTER(c.c_int32), c.c_int64,
+                                 c.POINTER(c.c_int32), c.c_int64]
     lib.rt_version.restype = c.c_int
 
 

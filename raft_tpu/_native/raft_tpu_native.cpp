@@ -471,4 +471,75 @@ RT_EXPORT void rt_threadpool_wait(void* tp) {
   static_cast<ThreadPool*>(tp)->wait_all();
 }
 
+// ---------------------------------------------------------------------------
+// Sparse slot-grid packer — the sequential hot loop of the grid-SpMV format
+// builder (raft_tpu/sparse/grid_spmv.py; role of the cuSPARSE analysis/
+// preprocessing step, ref sparse/detail/cusparse_wrappers.h SpMV_preprocess).
+//
+// Packs a row-sorted entry stream into (tile, sub-row, lane) slots under the
+// kernel's structural rules:
+//   - a tile is 8 sub-rows x 128 lanes;
+//   - a row's entries within a sub-row are contiguous (one run piece);
+//   - a run piece crosses into the next sub-row only when it fills the
+//     current one to lane 127 (the kernel's cross-sub-row carry contract);
+//   - all rows in a tile lie within `span_windows` 128-row windows of the
+//     tile's base window (the emission target range);
+//   - otherwise the sub-row (or tile) is padded out and a new one begins.
+//
+// Writes slot_src[pos] = source entry index (or -1 for padding) and
+// tile_base[t] = base row-window per tile. Returns the slot count (a
+// multiple of 1024), or -1 if `cap` would be exceeded (caller re-sizes).
+RT_EXPORT int64_t rt_spmv_pack(const int32_t* row, int64_t nnz,
+                               int32_t span_windows, int32_t* slot_src,
+                               int64_t cap, int32_t* tile_base,
+                               int64_t tile_cap) {
+  const int64_t kTile = 1024, kLane = 128;
+  int64_t pos = 0;
+  int32_t base = -1;
+  int64_t i = 0;
+  auto ensure = [&](int64_t need) { return pos + need <= cap; };
+  while (i < nnz) {
+    int32_t r = row[i];
+    int64_t j = i;
+    while (j < nnz && row[j] == r) ++j;
+    int64_t run = j - i;
+    while (run > 0) {
+      if (pos % kTile == 0) base = -1;
+      if (base < 0) {
+        if (pos / kTile >= tile_cap) return -1;
+        base = r >> 7;
+        tile_base[pos / kTile] = base;
+      }
+      if ((r >> 7) - base >= span_windows) {
+        // row outside the tile's emission range: pad to the tile edge
+        int64_t pad = kTile - (pos % kTile);
+        if (!ensure(pad)) return -1;
+        for (int64_t p = 0; p < pad; ++p) slot_src[pos++] = -1;
+        continue;
+      }
+      int64_t lane = pos % kLane;
+      int64_t rem = kLane - lane;
+      if (run <= rem) {
+        if (!ensure(run)) return -1;
+        for (int64_t p = 0; p < run; ++p) slot_src[pos++] = (int32_t)(i++);
+        run = 0;
+      } else if (lane == 0) {
+        // fill the whole sub-row; the piece chains into the next one
+        if (!ensure(kLane)) return -1;
+        for (int64_t p = 0; p < kLane; ++p) slot_src[pos++] = (int32_t)(i++);
+        run -= kLane;
+      } else {
+        // piece would straddle mid-sub-row: pad to the sub-row edge
+        if (!ensure(rem)) return -1;
+        for (int64_t p = 0; p < rem; ++p) slot_src[pos++] = -1;
+      }
+    }
+  }
+  // pad the final partial tile
+  int64_t tail = (kTile - pos % kTile) % kTile;
+  if (!ensure(tail)) return -1;
+  for (int64_t p = 0; p < tail; ++p) slot_src[pos++] = -1;
+  return pos;
+}
+
 RT_EXPORT int rt_version() { return 1; }

@@ -17,6 +17,7 @@ Sparse irregularity is handled the XLA way, not the CUDA way:
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix  # noqa: F401
 from raft_tpu.sparse.ell import ELLMatrix  # noqa: F401
 
-from . import convert, ell, linalg, matrix, op  # noqa: F401
+from . import convert, ell, grid_spmv, linalg, matrix, op  # noqa: F401
+from raft_tpu.sparse.grid_spmv import GridSpMV  # noqa: F401
 from . import solver  # noqa: F401
 from raft_tpu.sparse.csr import weak_cc, weak_cc_batched  # noqa: F401

@@ -1,0 +1,501 @@
+"""Slot-grid SpMV/SpMM — the TPU rebuild of the cuSPARSE SpMV kernel layer.
+
+The reference's sparse stack bottoms out in cusparseSpMV/SpMM
+(ref: sparse/detail/cusparse_wrappers.h:86-200), the hot kernel of the
+Lanczos loop (ref: sparse/solver/detail/lanczos.cuh:303-319).  The round-3
+hardware sweep measured the XLA gather+segment_sum formulation at 0.07
+GFLOP/s on 9.4M nnz (tpu_battery_out/bench_full.jsonl) — the gather and the
+scatter both serialize through XLA's generic element-at-a-time paths.
+
+This module replaces both sides with Mosaic-expressible structure:
+
+* **Gather** — Mosaic's vector gather (``tpu.dynamic_gather``) requires
+  same-shape source/index operands, so x is tiled into column shards of
+  65536, replicated across the 8 sublanes, and each kernel-1 grid step
+  gathers 8x65536 slots from its shard in ONE ``take_along_axis``: no
+  per-element address generation, no XLA gather.
+* **Scatter** — there is no scatter on TPU.  Entries are packed (host-side,
+  once per sparsity pattern — the analogue of cusparseSpMV_preprocess) into
+  a (tile, sub-row, lane) grid in CSR row order, so each row's products are
+  contiguous runs.  Kernel 2 reduces runs with an EXACT segmented scan
+  (7 lane steps + a 3-step cross-sub-row carry; f32 tree sums confined to
+  each row — no cross-row cancellation), then emits one partial per row per
+  tile through a flat one-gather relocation to its (window, row%128) slot.
+* **Accumulation** — kernel 3 walks tiles in base-window order (a host-
+  sorted permutation riding scalar prefetch) and accumulates each tile's
+  (8, 128) window contributions into 8 window-aligned output planes;
+  revisits are consecutive by construction, which is exactly the Pallas
+  output-accumulation contract.
+
+The packing rules live in ``_native/raft_tpu_native.cpp:rt_spmv_pack`` (with
+a pure-Python fallback): runs split into <=128-slot pieces, pieces cross
+sub-rows only when filling to lane 127 (the carry contract), and every row
+in a tile stays within 8 row-windows of the tile base (the emission range).
+
+Numerical contract: products and sums are f32; each row's sum is a tree
+reduction over its own entries only (padding slots are masked before the
+multiply, so stored zeros still propagate inf/nan per IEEE while pad slots
+never can).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.util.math import cdiv, round_up_to_multiple
+from raft_tpu.util.pallas_utils import pallas_call
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+SUBROWS = 8
+TILE_SLOTS = LANES * SUBROWS          # 1024
+SPAN_WINDOWS = 8                      # emission range: 8 x 128 rows per tile
+SHARD_W = 65536                       # columns per x shard (VMEM-sized)
+
+_F_CONT = 1                           # slot continues the run from lane-1
+_F_REAL = 2                           # slot holds a real entry
+_F_CROSS = 4                          # lane belongs to the sub-row's leading
+                                      # run chained from the previous sub-row
+
+
+def _pack_python(row: np.ndarray, span_windows: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pure-Python mirror of rt_spmv_pack (toolchain-free fallback)."""
+    slots: list = []
+    bases: list = []
+    base = -1
+    i, nnz = 0, len(row)
+    while i < nnz:
+        r = int(row[i])
+        j = i
+        while j < nnz and row[j] == r:
+            j += 1
+        run = j - i
+        while run > 0:
+            if len(slots) % TILE_SLOTS == 0:
+                base = -1
+            if base < 0:
+                base = r >> 7
+                bases.append(base)
+            if (r >> 7) - base >= span_windows:
+                pad = TILE_SLOTS - len(slots) % TILE_SLOTS
+                slots.extend([-1] * pad)
+                continue
+            lane = len(slots) % LANES
+            rem = LANES - lane
+            if run <= rem:
+                slots.extend(range(i, i + run))
+                i += run
+                run = 0
+            elif lane == 0:
+                slots.extend(range(i, i + LANES))
+                i += LANES
+                run -= LANES
+            else:
+                slots.extend([-1] * rem)
+    tail = (-len(slots)) % TILE_SLOTS
+    slots.extend([-1] * tail)
+    return (np.asarray(slots, np.int32),
+            np.asarray(bases, np.int32))
+
+
+def _pack(row: np.ndarray, span_windows: int
+          ) -> Tuple[np.ndarray, np.ndarray]:
+    from raft_tpu import _native
+
+    lib = _native.get_lib()
+    if lib is None:
+        return _pack_python(row, span_windows)
+    import ctypes
+
+    row = np.ascontiguousarray(row, np.int32)
+    nnz = len(row)
+    # worst case ~2x slots (alternating pad), tiles bounded by slots/1024
+    cap = int(round_up_to_multiple(max(4 * nnz, TILE_SLOTS), TILE_SLOTS))
+    while True:
+        slot_src = np.empty(cap, np.int32)
+        tile_base = np.zeros(cap // TILE_SLOTS, np.int32)
+        n = lib.rt_spmv_pack(
+            row.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), nnz,
+            span_windows,
+            slot_src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap,
+            tile_base.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cap // TILE_SLOTS)
+        if n >= 0:
+            return slot_src[:n], tile_base[:n // TILE_SLOTS]
+        cap *= 2
+
+
+class GridSpMV:
+    """Prepared SpMV plan for one sparsity pattern (role of the cuSPARSE
+    preprocessed SpMV descriptor).  Build once per matrix with
+    :func:`prepare`; apply with :func:`spmv` / :func:`spmm`.
+
+    Registered as a pytree so it can close over or flow through jit; all
+    metadata except the device arrays is static.
+    """
+
+    def __init__(self, *, cols_grid, data_grid, flags_grid, emit_grid,
+                 chunk_shard, tile_base, perm_sorted, base_sorted,
+                 visited, shape, nnz, n_shards, pad_ratio):
+        self.cols_grid = cols_grid        # (nchunk, SUBROWS, SHARD_W) i32
+        self.data_grid = data_grid        # (ntile, 8, 128) f32
+        self.flags_grid = flags_grid      # (ntile, 8, 128) i32
+        self.emit_grid = emit_grid        # (ntile, 8, 128) i32, -1 = none
+        self.chunk_shard = chunk_shard    # (nchunk,) i32
+        self.tile_base = tile_base        # (ntile,) i32 (build order)
+        self.perm_sorted = perm_sorted    # (ntile,) i32: tiles by base
+        self.base_sorted = base_sorted    # (ntile,) i32
+        self.visited = visited            # (8, NWP) bool (host constant)
+        self.shape = shape
+        self.nnz = nnz                    # logical nnz packed
+        self.n_shards = n_shards
+        self.pad_ratio = pad_ratio        # slots / nnz (build diagnostic)
+
+    @property
+    def n_rows(self):
+        return self.shape[0]
+
+    @property
+    def n_cols(self):
+        return self.shape[1]
+
+    def matvec(self, x):
+        return spmv(self, x)
+
+
+def _grid_flatten(g: GridSpMV):
+    leaves = (g.cols_grid, g.data_grid, g.flags_grid, g.emit_grid,
+              g.chunk_shard, g.tile_base, g.perm_sorted, g.base_sorted)
+    aux = (g.visited.tobytes(), g.visited.shape, g.shape, g.nnz,
+           g.n_shards, g.pad_ratio)
+    return leaves, aux
+
+
+def _grid_unflatten(aux, leaves):
+    vis_bytes, vis_shape, shape, nnz, n_shards, pad_ratio = aux
+    g = GridSpMV.__new__(GridSpMV)
+    (g.cols_grid, g.data_grid, g.flags_grid, g.emit_grid,
+     g.chunk_shard, g.tile_base, g.perm_sorted, g.base_sorted) = leaves
+    g.visited = np.frombuffer(vis_bytes, np.bool_).reshape(vis_shape)
+    g.shape, g.nnz, g.n_shards, g.pad_ratio = shape, nnz, n_shards, pad_ratio
+    return g
+
+
+jax.tree_util.register_pytree_node(GridSpMV, _grid_flatten, _grid_unflatten)
+
+
+def prepare(csr, span_windows: int = SPAN_WINDOWS,
+            shard_w: int = SHARD_W) -> GridSpMV:
+    """Build the slot-grid plan from a CSRMatrix (host-side, once per
+    pattern — the cusparseSpMV_preprocess analogue)."""
+    indptr = np.asarray(csr.indptr)
+    nnz_log = int(indptr[-1])
+    cols = np.asarray(csr.indices)[:nnz_log].astype(np.int32)
+    data = np.asarray(csr.data)[:nnz_log].astype(np.float32)
+    n_rows, n_cols = csr.shape
+    row_len = np.diff(indptr)
+    rows = np.repeat(np.arange(n_rows, dtype=np.int32),
+                     row_len).astype(np.int32)
+
+    n_shards = max(1, cdiv(n_cols, shard_w))
+    chunk_slots = SUBROWS * shard_w
+
+    all_src_col: list = []        # per-slot column (shard-local), 0 pad
+    all_src_data: list = []
+    all_src_row: list = []        # per-slot row, -1 pad
+    all_bases: list = []
+    chunk_shard: list = []
+
+    for s in range(n_shards):
+        m = (cols >= s * shard_w) & (cols < (s + 1) * shard_w)
+        srow, scol, sdat = rows[m], cols[m] - s * shard_w, data[m]
+        if len(srow) == 0:
+            continue
+        slot_src, bases = _pack(srow, span_windows)
+        # pad the shard's slot stream to a kernel-1 chunk multiple; pad
+        # tiles carry base 0 and no real slots
+        n = len(slot_src)
+        npad = round_up_to_multiple(n, chunk_slots)
+        slot_src = np.pad(slot_src, (0, npad - n), constant_values=-1)
+        bases = np.pad(bases, (0, npad // TILE_SLOTS - len(bases)))
+        real = slot_src >= 0
+        idx = np.where(real, slot_src, 0)
+        all_src_col.append(np.where(real, scol[idx], 0).astype(np.int32))
+        all_src_data.append(
+            np.where(real, sdat[idx], 0).astype(np.float32))
+        all_src_row.append(np.where(real, srow[idx], -1).astype(np.int32))
+        all_bases.append(bases)
+        chunk_shard.extend([s] * (npad // chunk_slots))
+
+    if not all_src_col:   # empty matrix: a single all-pad chunk
+        all_src_col = [np.zeros(chunk_slots, np.int32)]
+        all_src_data = [np.zeros(chunk_slots, np.float32)]
+        all_src_row = [np.full(chunk_slots, -1, np.int32)]
+        all_bases = [np.zeros(chunk_slots // TILE_SLOTS, np.int32)]
+        chunk_shard = [0]
+
+    scol = np.concatenate(all_src_col)
+    sdat = np.concatenate(all_src_data)
+    srow = np.concatenate(all_src_row)
+    tile_base = np.concatenate(all_bases)
+    n_slots = len(scol)
+    n_tiles = n_slots // TILE_SLOTS
+
+    # --- flags (vectorized over the whole grid) ---
+    rg = srow.reshape(n_tiles, SUBROWS, LANES)
+    real = rg >= 0
+    cont = np.zeros_like(real)
+    cont[:, :, 1:] = real[:, :, 1:] & (rg[:, :, 1:] == rg[:, :, :-1])
+    chain = np.zeros((n_tiles, SUBROWS), np.bool_)   # sub-row continues prev
+    chain[:, 1:] = (real[:, 1:, 0] & real[:, :-1, 127]
+                    & (rg[:, 1:, 0] == rg[:, :-1, 127]))
+    # leading-run mask: lanes up to the first run break of the sub-row
+    brk = ~cont & (np.arange(LANES) > 0)             # run break at lane l
+    lead = np.cumsum(brk, axis=2) == 0               # lane 0 always leads
+    cross = lead & chain[:, :, None]
+    flags = (cont * _F_CONT + real * _F_REAL + cross * _F_CROSS
+             ).astype(np.int32)
+
+    # --- emissions: one per (row, tile) at the end of its last piece ---
+    is_end = real.copy()
+    is_end[:, :, :-1] &= (rg[:, :, :-1] != rg[:, :, 1:])
+    # lane 127 is an end unless the run chains into the next sub-row
+    is_end[:, :-1, 127] &= ~chain[:, 1:]
+    t_i, s_i, l_i = np.nonzero(is_end)
+    q = rg[t_i, s_i, l_i] - tile_base[t_i] * LANES
+    if q.size and (q.min() < 0 or q.max() >= TILE_SLOTS):
+        raise AssertionError("packer emitted a row outside its tile span")
+    emit = np.full((n_tiles, TILE_SLOTS), -1, np.int32)
+    emit[t_i, q] = (s_i * LANES + l_i).astype(np.int32)
+    emit = emit.reshape(n_tiles, SUBROWS, LANES)
+
+    # --- tile ordering + visited masks for the window planes ---
+    perm = np.argsort(tile_base, kind="stable").astype(np.int32)
+    base_sorted = tile_base[perm]
+    nwp = cdiv(max(n_rows, 1), LANES) + SPAN_WINDOWS
+    visited = np.zeros((SPAN_WINDOWS, nwp), np.bool_)
+    for d in range(SPAN_WINDOWS):
+        visited[d, np.minimum(tile_base + d, nwp - 1)] = True
+
+    return GridSpMV(
+        cols_grid=jnp.asarray(
+            scol.reshape(-1, SUBROWS, shard_w)),
+        data_grid=jnp.asarray(sdat.reshape(n_tiles, SUBROWS, LANES)),
+        flags_grid=jnp.asarray(flags),
+        emit_grid=jnp.asarray(emit),
+        chunk_shard=jnp.asarray(np.asarray(chunk_shard, np.int32)),
+        tile_base=jnp.asarray(tile_base),
+        perm_sorted=jnp.asarray(perm),
+        base_sorted=jnp.asarray(base_sorted),
+        visited=visited,
+        shape=(n_rows, n_cols), nnz=nnz_log, n_shards=n_shards,
+        pad_ratio=float(n_slots) / max(nnz_log, 1))
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def _lane_gather(src, idx):
+    """Same-shape gather along lanes (take_along_axis axis=1) spelled as
+    the exact lax.gather form Mosaic lowers to tpu.dynamic_gather —
+    jnp.take_along_axis canonicalizes indices to int64 under x64, which
+    Mosaic rejects; idx stays int32 here."""
+    dnums = jax.lax.GatherDimensionNumbers(
+        offset_dims=(), collapsed_slice_dims=(1,), start_index_map=(1,),
+        operand_batching_dims=(0,), start_indices_batching_dims=(0,))
+    return jax.lax.gather(
+        src, idx[..., None], dnums, (1, 1),
+        mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS)
+
+
+def _gather_kernel(shard_ref, x_ref, i_ref, o_ref):
+    del shard_ref
+    o_ref[0] = _lane_gather(x_ref[0], i_ref[0])
+
+
+def _f0():
+    """A strongly-typed f32 zero: weak python floats lower as f64 casts
+    inside Mosaic kernels under jax_enable_x64."""
+    return jnp.float32(0.0)
+
+
+def _shift_lanes(x, d):
+    """Shift right along lanes by d, zero/False fill."""
+    pad = jnp.zeros_like(x[:, :d])
+    return jnp.concatenate([pad, x[:, :-d]], axis=1)
+
+
+def _shift_subs(x, d):
+    """Shift down along sub-rows by d, zero/False fill."""
+    pad = jnp.zeros_like(x[:d, :])
+    return jnp.concatenate([pad, x[:-d, :]], axis=0)
+
+
+def _segsum_kernel(g_ref, d_ref, f_ref, e_ref, o_ref):
+    g = g_ref[0]
+    dat = d_ref[0]
+    f = f_ref[0]
+    real = (f & _F_REAL) != 0
+    cont = (f & _F_CONT) != 0
+    crossm = (f & _F_CROSS) != 0
+
+    p = jnp.where(real, g * dat, _f0())
+
+    # segmented inclusive scan along lanes: runs are row pieces
+    c, fl = p, cont
+    for d in (1, 2, 4, 8, 16, 32, 64):
+        c = c + jnp.where(fl, _shift_lanes(c, d), _f0())
+        fl = fl & _shift_lanes(fl, d)
+
+    # cross-sub-row carry: a piece chained from the previous sub-row adds
+    # the chain sum of the predecessors' tails (each tail is its sub-row's
+    # final segment value — exactly the chained piece's partial)
+    tails = c[:, 127:128]
+    crossf = crossm[:, 0:1]
+    ts, fs = tails, crossf
+    for d in (1, 2, 4):
+        ts = ts + jnp.where(fs, _shift_subs(ts, d), _f0())
+        fs = fs & _shift_subs(fs, d)
+    car = jnp.where(crossf, _shift_subs(ts, 1), _f0())
+    c = c + jnp.where(crossm, car, _f0())
+
+    # emission: relocate each row's final partial to its (window, row%128)
+    # slot via one flat same-shape gather
+    flat = c.reshape(1, TILE_SLOTS)
+    e = e_ref[0].reshape(1, TILE_SLOTS)
+    gath = _lane_gather(flat, jnp.maximum(e, 0))
+    contrib = jnp.where(e >= 0, gath, _f0())
+    o_ref[0] = contrib.reshape(SUBROWS, LANES)
+
+
+def _reduce_kernel(perm_ref, base_ref, c_ref, *o_refs):
+    del perm_ref
+    t = pl.program_id(0)
+    prev = base_ref[jnp.maximum(t - 1, 0)]
+    first = (t == 0) | (base_ref[t] != prev)
+    contrib = c_ref[0]
+
+    @pl.when(first)
+    def _init():
+        for d in range(SPAN_WINDOWS):
+            o_refs[d][0] = contrib[d:d + 1]
+
+    @pl.when(jnp.logical_not(first))
+    def _acc():
+        for d in range(SPAN_WINDOWS):
+            o_refs[d][0] += contrib[d:d + 1]
+
+
+@jax.jit
+def _spmv_impl(fmt: GridSpMV, x):
+    n_rows, n_cols = fmt.shape
+    shard_w = fmt.cols_grid.shape[2]
+    n_shards = fmt.n_shards
+    nchunk = fmt.cols_grid.shape[0]
+    ntile = fmt.data_grid.shape[0]
+    nwp = fmt.visited.shape[1]
+
+    xpad = jnp.zeros(n_shards * shard_w, jnp.float32
+                     ).at[:n_cols].set(x.astype(jnp.float32))
+    # replicate each shard across the 8 sublanes (same-shape gather source)
+    x_rep = jnp.broadcast_to(xpad.reshape(n_shards, 1, shard_w),
+                             (n_shards, SUBROWS, shard_w))
+
+    grid1 = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nchunk,),
+        in_specs=[
+            pl.BlockSpec((1, SUBROWS, shard_w),
+                         lambda c, sh: (sh[c], 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, SUBROWS, shard_w), lambda c, sh: (c, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, SUBROWS, shard_w),
+                               lambda c, sh: (c, 0, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    gathered = pallas_call(
+        _gather_kernel, grid_spec=grid1,
+        out_shape=jax.ShapeDtypeStruct((nchunk, SUBROWS, shard_w),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(fmt.chunk_shard, x_rep, fmt.cols_grid)
+
+    prod_tiles = gathered.reshape(ntile, SUBROWS, LANES)
+
+    contrib = pallas_call(
+        _segsum_kernel,
+        grid=(ntile,),
+        in_specs=[
+            pl.BlockSpec((1, SUBROWS, LANES), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, SUBROWS, LANES), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, SUBROWS, LANES), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, SUBROWS, LANES), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, SUBROWS, LANES), lambda t: (t, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((ntile, SUBROWS, LANES),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(prod_tiles, fmt.data_grid, fmt.flags_grid, fmt.emit_grid)
+
+    grid3 = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(ntile,),
+        in_specs=[pl.BlockSpec((1, SUBROWS, LANES),
+                               lambda t, pm, bs: (pm[t], 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[
+            pl.BlockSpec((1, 1, LANES),
+                         (lambda t, pm, bs, _d=d: (bs[t] + _d, 0, 0)),
+                         memory_space=pltpu.VMEM)
+            for d in range(SPAN_WINDOWS)
+        ],
+    )
+    planes = pallas_call(
+        _reduce_kernel, grid_spec=grid3,
+        out_shape=[jax.ShapeDtypeStruct((nwp, 1, LANES), jnp.float32)
+                   for _ in range(SPAN_WINDOWS)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(fmt.perm_sorted, fmt.base_sorted, contrib)
+
+    y = jnp.zeros((nwp, LANES), jnp.float32)
+    for d in range(SPAN_WINDOWS):
+        y = y + jnp.where(jnp.asarray(fmt.visited[d])[:, None],
+                          planes[d][:, 0, :], 0.0)
+    return y.reshape(-1)[:n_rows]
+
+
+def spmv(fmt: GridSpMV, x) -> jnp.ndarray:
+    """y = A @ x on the prepared plan (f32)."""
+    x = jnp.asarray(x)
+    if x.shape != (fmt.n_cols,):
+        raise ValueError(f"x must be ({fmt.n_cols},), got {x.shape}")
+    return _spmv_impl(fmt, x)
+
+
+def spmm(fmt: GridSpMV, b) -> jnp.ndarray:
+    """C = A @ B for dense B (n_cols, k): k column passes over the shared
+    plan (each pass reuses the packed pattern; the gather indices and the
+    reduction structure are identical)."""
+    b = jnp.asarray(b)
+    if b.ndim != 2 or b.shape[0] != fmt.n_cols:
+        raise ValueError(f"b must be ({fmt.n_cols}, k), got {b.shape}")
+    cols = jax.lax.map(lambda col: _spmv_impl(fmt, col), b.T)
+    return cols.T

@@ -43,19 +43,88 @@ def _segment_spmv(row_ids, cols, data, x, n_rows: int, limit=None):
                                indices_are_sorted=True)
 
 
+# auto-dispatch threshold for the slot-grid plan: below this the per-call
+# plan build (host packing) costs more than the gather it saves
+_GRID_MIN_NNZ = 1 << 18
+
+
+def spmv_method(a=None) -> str:
+    """Resolve the SpMV formulation. ``RAFT_TPU_SPMV`` ∈ {auto, grid, ell,
+    segment} forces a path; ``auto`` picks the slot-grid Pallas plan
+    (grid_spmv.py) for large-nnz matrices on the compiled backend and the
+    ell/segment pair elsewhere. Returns the forced name, or "grid"/"auto"
+    for the auto decision."""
+    import os
+
+    m = os.environ.get("RAFT_TPU_SPMV", "auto").lower()
+    if m not in ("auto", "grid", "ell", "segment"):
+        raise ValueError(f"RAFT_TPU_SPMV must be auto|grid|ell|segment, "
+                         f"got {m}")
+    if m != "auto" or a is None:
+        return m
+    from raft_tpu.util.pallas_utils import use_interpret
+
+    if isinstance(a.indptr, jax.core.Tracer) or isinstance(
+            a.data, jax.core.Tracer):
+        return "auto"   # plans are host-built; never auto-build under jit
+    if jnp.dtype(a.data.dtype) != jnp.dtype(jnp.float32):
+        return "auto"   # the grid plan computes in f32; keep f64 exact
+    cached = getattr(a, "_spmv_auto_method", None)
+    if cached is not None:
+        return cached   # one device fetch per MATRIX, not per call
+    nnz = int(np.asarray(a.indptr)[-1])
+    method = ("grid" if nnz >= _GRID_MIN_NNZ and not use_interpret()
+              else "auto")
+    try:
+        a._spmv_auto_method = method
+    except AttributeError:
+        pass
+    return method
+
+
 def spmv(a, x) -> jnp.ndarray:
     """y = A·x for sparse A (ref: sparse/linalg/spmv — cusparseSpMV wrapper
     in detail/cusparse_wrappers.h).
 
-    Accepts CSRMatrix (gather + segment_sum) or ELLMatrix (dense row-slab
-    reduction, the TPU-preferred path for regular sparsity — see
-    raft_tpu.sparse.ell)."""
+    Accepts a prepared GridSpMV plan (the Pallas slot-grid kernels — see
+    raft_tpu.sparse.grid_spmv; build with ``grid_spmv.prepare``), a
+    CSRMatrix (gather + segment_sum; auto-upgraded to a fresh grid plan
+    for large nnz on the compiled backend — prefer preparing once for
+    repeated products), or an ELLMatrix (dense row-slab reduction)."""
     from raft_tpu.sparse.ell import ELLMatrix, spmv as ell_spmv
+    from raft_tpu.sparse.grid_spmv import GridSpMV
+    from raft_tpu.sparse.grid_spmv import spmv as grid_apply
 
+    if isinstance(a, GridSpMV):
+        return grid_apply(a, x)
     if isinstance(a, ELLMatrix):
         return ell_spmv(a, x)
+    method = spmv_method(a)
+    if method == "grid":
+        return grid_apply(_cached_plan(a), x)
+    if method == "ell":
+        from raft_tpu.sparse.ell import from_csr
+
+        return ell_spmv(from_csr(a), x)
     return _segment_spmv(a.row_ids(), a.indices, a.data, x, a.n_rows,
                          limit=a.indptr[-1])
+
+
+def _cached_plan(a):
+    """The matrix's GridSpMV plan, built once and memoized on the object
+    (an eager caller's matvec loop must not re-run the host pack per
+    call — the plan is the cusparse preprocessed-descriptor analogue
+    and has the same once-per-pattern lifetime)."""
+    plan = getattr(a, "_grid_plan", None)
+    if plan is None:
+        from raft_tpu.sparse.grid_spmv import prepare
+
+        plan = prepare(a)
+        try:
+            a._grid_plan = plan
+        except AttributeError:
+            pass
+    return plan
 
 
 @functools.partial(jax.jit, static_argnames=("n_rows",))
@@ -70,10 +139,15 @@ def _segment_spmm(row_ids, cols, data, b, n_rows: int, limit=None):
 
 def spmm(a, b, alpha=1.0, beta=0.0, c=None) -> jnp.ndarray:
     """C = alpha·A·B + beta·C for sparse A [m,n], dense B [n,k]
-    (ref: sparse/linalg/spmm.hpp:42). Accepts CSRMatrix or ELLMatrix."""
+    (ref: sparse/linalg/spmm.hpp:42). Accepts a GridSpMV plan, CSRMatrix
+    or ELLMatrix."""
     from raft_tpu.sparse.ell import ELLMatrix, spmm as ell_spmm
+    from raft_tpu.sparse.grid_spmv import GridSpMV
+    from raft_tpu.sparse.grid_spmv import spmm as grid_spmm
 
-    if isinstance(a, ELLMatrix):
+    if isinstance(a, GridSpMV):
+        out = grid_spmm(a, jnp.asarray(b))
+    elif isinstance(a, ELLMatrix):
         out = ell_spmm(a, jnp.asarray(b))
     else:
         out = _segment_spmm(a.row_ids(), a.indices, a.data,

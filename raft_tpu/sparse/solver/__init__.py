@@ -2,5 +2,6 @@
 (ref: raft/sparse/solver/{lanczos,mst}.cuh).
 """
 
-from .lanczos import LanczosConfig, eigsh, lanczos_compute_eigenpairs  # noqa: F401
+from .lanczos import (LanczosConfig, eigsh,  # noqa: F401
+                      eigsh_mnmg, lanczos_compute_eigenpairs)
 from .mst import GraphCOO, mst  # noqa: F401

@@ -1,0 +1,300 @@
+"""Slot-grid SpMV/SpMM vs scipy oracles.
+
+The grid formulation (sparse/grid_spmv.py) re-packs the pattern host-side
+and reduces with a segmented scan, so beyond value agreement these tests
+pin the STRUCTURAL contracts: packer rules (run contiguity, cross-sub-row
+chaining, tile span), C++/Python packer equivalence, pad-slot isolation
+(inf/nan x never contaminates other rows), and the jit/pytree surface.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.sparse_types import CSRMatrix
+from raft_tpu.sparse import grid_spmv
+from raft_tpu.sparse.grid_spmv import (GridSpMV, _pack, _pack_python,
+                                       prepare, spmm, spmv)
+
+
+def _random_csr(rng, n_rows, n_cols, density):
+    dense = rng.normal(size=(n_rows, n_cols)).astype(np.float32)
+    dense[rng.uniform(size=(n_rows, n_cols)) > density] = 0.0
+    return sp.csr_matrix(dense)
+
+
+def _check(A, x=None, shard_w=None, rtol=2e-5, atol=2e-5):
+    rng = np.random.default_rng(99)
+    if x is None:
+        x = rng.normal(size=A.shape[1]).astype(np.float32)
+    kw = {} if shard_w is None else {"shard_w": shard_w}
+    fmt = prepare(CSRMatrix.from_scipy(A), **kw)
+    y = np.asarray(spmv(fmt, jnp.asarray(x)))
+    ref = A @ x
+    np.testing.assert_allclose(y, ref, rtol=rtol, atol=atol)
+    return fmt
+
+
+class TestGridSpMV:
+    def test_random(self):
+        rng = np.random.default_rng(0)
+        _check(_random_csr(rng, 500, 700, 0.05))
+
+    def test_multi_shard(self):
+        rng = np.random.default_rng(1)
+        fmt = _check(_random_csr(rng, 300, 900, 0.04), shard_w=256)
+        assert fmt.n_shards == 4
+
+    def test_skewed_hub_rows_and_cols(self):
+        # power-law-ish: a hub row (long runs chaining across sub-rows
+        # and tiles) and a hub column (gather index repetition)
+        rng = np.random.default_rng(2)
+        n = 600
+        r = np.concatenate([np.full(400, 37), rng.integers(0, n, 2000),
+                            np.full(300, 599)])
+        c = np.concatenate([rng.integers(0, n, 400), np.full(2000, 11),
+                            rng.integers(0, n, 300)])
+        d = rng.normal(size=r.size).astype(np.float32)
+        A = sp.csr_matrix((d, (r, c)), shape=(n, n))
+        A.sum_duplicates()
+        _check(A, shard_w=256)
+
+    def test_sparse_tail_rows(self):
+        # mostly-empty matrix: tiles close on the 8-window span rule
+        rng = np.random.default_rng(3)
+        n = 4000
+        r = np.sort(rng.choice(n, 60, replace=False)).astype(np.int32)
+        c = rng.integers(0, n, 60).astype(np.int32)
+        d = rng.normal(size=60).astype(np.float32)
+        _check(sp.csr_matrix((d, (r, c)), shape=(n, n)), shard_w=512)
+
+    def test_empty_rows_and_empty_matrix(self):
+        rng = np.random.default_rng(4)
+        A = _random_csr(rng, 200, 200, 0.02)
+        A[50:150] = 0
+        A.eliminate_zeros()
+        _check(A)
+        Z = sp.csr_matrix((64, 64), dtype=np.float32)
+        fmt = prepare(CSRMatrix.from_scipy(Z))
+        y = np.asarray(spmv(fmt, jnp.ones(64, jnp.float32)))
+        np.testing.assert_array_equal(y, np.zeros(64))
+
+    def test_single_dense_row(self):
+        # one row owning every column: maximal cross-sub-row chaining
+        n = 700
+        rng = np.random.default_rng(5)
+        d = rng.normal(size=n).astype(np.float32)
+        A = sp.csr_matrix((d, (np.zeros(n, np.int64), np.arange(n))),
+                          shape=(4, n))
+        _check(A, shard_w=256)
+
+    def test_stored_zero_propagates_inf_pad_does_not(self):
+        # A stored zero at (0, 1) must see x[1] = inf (0 * inf = nan per
+        # IEEE, matching cuSPARSE); pad slots gather arbitrary x but are
+        # masked BEFORE the multiply, so row 1 stays finite.
+        A = sp.csr_matrix(np.array([[2.0, 0.0], [3.0, 0.0]], np.float32))
+        A[0, 1] = 0.0   # explicit stored zero
+        x = np.array([1.0, np.inf], np.float32)
+        fmt = prepare(CSRMatrix.from_scipy(sp.csr_matrix(A)))
+        y = np.asarray(spmv(fmt, jnp.asarray(x)))
+        assert np.isnan(y[0])
+        assert y[1] == 3.0
+
+    def test_inf_x_with_padding_isolated(self):
+        rng = np.random.default_rng(6)
+        A = _random_csr(rng, 100, 300, 0.05)
+        x = rng.normal(size=300).astype(np.float32)
+        x[7] = np.inf
+        fmt = prepare(CSRMatrix.from_scipy(A), shard_w=256)
+        y = np.asarray(spmv(fmt, jnp.asarray(x)))
+        ref = A @ x
+        finite = np.isfinite(ref)
+        np.testing.assert_allclose(y[finite], ref[finite], rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_array_equal(np.isfinite(y), finite)
+
+    def test_wide_matrix_shard_boundary_columns(self):
+        # entries sitting exactly at shard edges
+        n_cols = 1024
+        r = np.arange(8, dtype=np.int64) % 4
+        c = np.array([0, 255, 256, 511, 512, 767, 768, 1023])
+        order = np.argsort(r, kind="stable")
+        A = sp.csr_matrix((np.ones(8, np.float32), (r[order], c[order])),
+                          shape=(4, n_cols))
+        x = np.arange(n_cols, dtype=np.float32)
+        _check(A, x=x, shard_w=256)
+
+    def test_spmm(self):
+        rng = np.random.default_rng(7)
+        A = _random_csr(rng, 300, 400, 0.05)
+        B = rng.normal(size=(400, 5)).astype(np.float32)
+        fmt = prepare(CSRMatrix.from_scipy(A))
+        C = np.asarray(spmm(fmt, jnp.asarray(B)))
+        np.testing.assert_allclose(C, A @ B, rtol=2e-5, atol=2e-5)
+
+    def test_jit_and_pytree_surface(self):
+        rng = np.random.default_rng(8)
+        A = _random_csr(rng, 200, 200, 0.05)
+        fmt = prepare(CSRMatrix.from_scipy(A))
+
+        @jax.jit
+        def f(fmt, x):
+            return spmv(fmt, x)
+
+        x = rng.normal(size=200).astype(np.float32)
+        y = np.asarray(f(fmt, jnp.asarray(x)))
+        np.testing.assert_allclose(y, A @ x, rtol=2e-5, atol=2e-5)
+        leaves, treedef = jax.tree_util.tree_flatten(fmt)
+        fmt2 = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert isinstance(fmt2, GridSpMV)
+        y2 = np.asarray(spmv(fmt2, jnp.asarray(x)))
+        np.testing.assert_array_equal(y, y2)
+
+    def test_padded_bucketed_csr_input(self):
+        # CSRMatrix nnz-bucket padding: pad entries (data 0, col 0) must
+        # be excluded by the logical-nnz slice in prepare()
+        rng = np.random.default_rng(9)
+        A = _random_csr(rng, 100, 100, 0.05)
+        csr = CSRMatrix.from_scipy(A, pad=True)
+        assert csr.nnz > int(np.asarray(csr.indptr)[-1])
+        fmt = prepare(csr)
+        assert fmt.nnz == int(np.asarray(csr.indptr)[-1])
+        x = rng.normal(size=100).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(spmv(fmt, jnp.asarray(x))),
+                                   A @ x, rtol=2e-5, atol=2e-5)
+
+
+class TestIntegration:
+    def test_linalg_spmv_dispatch(self, monkeypatch):
+        rng = np.random.default_rng(20)
+        A = _random_csr(rng, 150, 150, 0.05)
+        csr = CSRMatrix.from_scipy(A)
+        fmt = prepare(csr)
+        x = rng.normal(size=150).astype(np.float32)
+        from raft_tpu.sparse import linalg as slinalg
+
+        y_grid = np.asarray(slinalg.spmv(fmt, jnp.asarray(x)))
+        y_seg = np.asarray(slinalg.spmv(csr, jnp.asarray(x)))
+        np.testing.assert_allclose(y_grid, y_seg, rtol=2e-5, atol=2e-5)
+        B = rng.normal(size=(150, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(slinalg.spmm(fmt, jnp.asarray(B))),
+            np.asarray(slinalg.spmm(csr, jnp.asarray(B))),
+            rtol=2e-5, atol=2e-5)
+        # env force knob validation
+        monkeypatch.setenv("RAFT_TPU_SPMV", "bogus")
+        with pytest.raises(ValueError):
+            slinalg.spmv_method(csr)
+
+    def test_eigsh_on_grid_matches_scipy(self, monkeypatch):
+        import scipy.sparse.linalg as spla
+
+        from raft_tpu.sparse.solver.lanczos import eigsh
+
+        monkeypatch.setenv("RAFT_TPU_SPMV", "grid")
+        rng = np.random.default_rng(21)
+        n = 150   # small: every restart re-runs 3 interpreted kernels
+        dense = rng.normal(size=(n, n)).astype(np.float32)
+        dense[rng.uniform(size=(n, n)) > 0.06] = 0.0
+        A = sp.csr_matrix(dense + dense.T)
+        ref = np.sort(spla.eigsh(A.astype(np.float64), k=2, which="SA",
+                                 return_eigenvectors=False))
+        vals, _ = eigsh(CSRMatrix.from_scipy(A), k=2, which="SA",
+                        maxiter=60)
+        np.testing.assert_allclose(np.sort(np.asarray(vals)), ref,
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestMNMGLanczos:
+    def test_eigsh_mnmg_matches_scipy(self, mesh8):
+        import scipy.sparse.linalg as spla
+
+        from raft_tpu.sparse.solver import eigsh_mnmg
+
+        rng = np.random.default_rng(30)
+        n = 500   # NOT a multiple of 8: exercises the row-band padding
+        dense = rng.normal(size=(n, n)).astype(np.float32)
+        dense[rng.uniform(size=(n, n)) > 0.04] = 0.0
+        A = sp.csr_matrix(dense + dense.T)
+        vals, vecs = eigsh_mnmg(CSRMatrix.from_scipy(A), k=4, mesh=mesh8,
+                                which="SA")
+        ref = np.sort(spla.eigsh(A.astype(np.float64), k=4, which="SA",
+                                 return_eigenvectors=False))
+        np.testing.assert_allclose(np.sort(np.asarray(vals)), ref,
+                                   rtol=3e-4, atol=3e-4)
+        res = np.abs(A @ np.asarray(vecs)
+                     - np.asarray(vecs) * np.asarray(vals)).max()
+        assert res < 1e-2
+
+    def test_eigsh_mnmg_agrees_with_single_device(self, mesh8):
+        from raft_tpu.sparse.solver import eigsh, eigsh_mnmg
+
+        rng = np.random.default_rng(31)
+        n = 256
+        dense = rng.normal(size=(n, n)).astype(np.float32)
+        dense[rng.uniform(size=(n, n)) > 0.05] = 0.0
+        A = sp.csr_matrix(dense + dense.T)
+        csr = CSRMatrix.from_scipy(A)
+        v1, _ = eigsh(csr, k=3, which="LA")
+        v2, _ = eigsh_mnmg(csr, k=3, mesh=mesh8, which="LA")
+        np.testing.assert_allclose(np.sort(np.asarray(v1)),
+                                   np.sort(np.asarray(v2)),
+                                   rtol=5e-4, atol=5e-4)
+
+    def test_eigsh_mnmg_requires_mesh(self):
+        from raft_tpu.sparse.solver import eigsh_mnmg
+
+        with pytest.raises(ValueError):
+            eigsh_mnmg(CSRMatrix.from_scipy(
+                sp.eye(32, format="csr", dtype=np.float32)), k=2)
+
+
+class TestPacker:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_native_matches_python(self, seed):
+        rng = np.random.default_rng(seed)
+        parts = [rng.integers(0, 2000, rng.integers(1, 4000))]
+        if seed % 2:
+            parts.append(np.full(rng.integers(1, 900), 777))
+        rows = np.sort(np.concatenate(parts)).astype(np.int32)
+        s_n, b_n = _pack(rows, 8)
+        s_p, b_p = _pack_python(rows, 8)
+        from raft_tpu import _native
+        if _native.get_lib() is None:
+            pytest.skip("no native toolchain")
+        np.testing.assert_array_equal(s_n, s_p)
+        np.testing.assert_array_equal(b_n, b_p)
+
+    def test_packing_invariants(self):
+        rng = np.random.default_rng(42)
+        rows = np.sort(rng.integers(0, 3000, 5000)).astype(np.int32)
+        slots, bases = _pack_python(rows, 8)
+        assert len(slots) % grid_spmv.TILE_SLOTS == 0
+        grid = slots.reshape(-1, grid_spmv.SUBROWS, grid_spmv.LANES)
+        rgrid = np.where(grid >= 0, rows[np.maximum(grid, 0)], -1)
+        for t in range(grid.shape[0]):
+            tile_rows = rgrid[t][rgrid[t] >= 0]
+            if tile_rows.size == 0:
+                continue
+            # span rule: all rows within 8 windows of the base
+            assert (tile_rows >> 7).min() == bases[t]
+            assert (tile_rows >> 7).max() - bases[t] < 8
+            for s in range(grid_spmv.SUBROWS):
+                r = rgrid[t, s]
+                real = r >= 0
+                # runs contiguous within a sub-row: each row id appears
+                # in one consecutive stretch
+                vals = r[real]
+                changes = np.count_nonzero(np.diff(vals) != 0)
+                assert changes == len(np.unique(vals)) - 1
+                # crossing rule: a run continues to the next sub-row only
+                # if it fills to lane 127
+                if s + 1 < grid_spmv.SUBROWS and rgrid[t, s + 1, 0] >= 0:
+                    if rgrid[t, s + 1, 0] in vals:
+                        assert r[127] == rgrid[t, s + 1, 0]
+        # every entry placed exactly once
+        placed = np.sort(slots[slots >= 0])
+        np.testing.assert_array_equal(placed, np.arange(len(rows)))

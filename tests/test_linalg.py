@@ -161,6 +161,25 @@ class TestDecompositions:
         wref = np.linalg.eigvalsh(S)
         np.testing.assert_allclose(np.asarray(w), wref[-3:], rtol=1e-8)
 
+    @pytest.mark.parametrize("largest", [True, False])
+    def test_eig_sel_iterative_subset_path(self, rng, largest):
+        # above _EIG_SEL_ITERATIVE_MIN_N the subset solver must run the
+        # dense-operator Lanczos (never the full spectrum) and still match
+        # the scipy subset to f32 accuracy
+        from raft_tpu.linalg.eig import _EIG_SEL_ITERATIVE_MIN_N as n
+
+        k = 4
+        q, _ = np.linalg.qr(rng.normal(size=(n, n)))
+        lam = np.sort(rng.normal(size=n) * 3.0)
+        S = ((q * lam) @ q.T).astype(np.float32)
+        w, v = linalg.eig_sel(None, jnp.asarray(S), k, largest=largest)
+        w, v = np.asarray(w), np.asarray(v)
+        ref = lam[-k:] if largest else lam[:k]
+        np.testing.assert_allclose(w, ref, rtol=5e-4, atol=5e-4)
+        assert np.all(np.diff(w) >= 0)          # ascending within selection
+        res = np.abs(S.astype(np.float64) @ v - v * w).max()
+        assert res < 5e-3 * np.abs(lam).max()
+
     @pytest.mark.parametrize("n", [2, 5, 16, 33])
     def test_eig_jacobi(self, rng, n):
         """Real cyclic Jacobi (syevj analogue): eigenpairs, orthogonality,
