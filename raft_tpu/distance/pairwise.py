@@ -83,11 +83,27 @@ def _l2_expanded(x, y, sqrt: bool):
     return jnp.sqrt(d) if sqrt else d
 
 
+def _use_unexpanded_pallas(x, y) -> bool:
+    return x.dtype in (jnp.float32, jnp.bfloat16) and y.dtype == x.dtype
+
+
+def _unexpanded(x, y, metric: str, p: float = 2.0):
+    """Unexpanded metric core: the Pallas VPU reduction tile when dtypes
+    allow (contractions.pairwise_unexpanded_pallas — the k axis rides the
+    grid, no [m,n,k] HBM intermediate), else the blocked jnp broadcast."""
+    if _use_unexpanded_pallas(x, y):
+        from raft_tpu.linalg.contractions import pairwise_unexpanded_pallas
+
+        return pairwise_unexpanded_pallas(x, y, metric, p)
+    from raft_tpu.linalg.contractions import unexpanded_ref
+
+    return _blocked_rowwise(
+        x, y, lambda xb, yy: unexpanded_ref(xb, yy, metric, p),
+        block=1024)
+
+
 def _l2_unexpanded(x, y, sqrt: bool):
-    def f(xb, yy):
-        diff = xb[:, None, :] - yy[None, :, :]
-        return jnp.sum(diff * diff, axis=-1)
-    d = _blocked_rowwise(x, y, f)
+    d = _unexpanded(x, y, "l2un")
     return jnp.sqrt(d) if sqrt else d
 
 
@@ -196,25 +212,13 @@ def pairwise_distance(res, x, y=None,
     if m == DistanceType.L2SqrtUnexpanded:
         return _l2_unexpanded(x, y, sqrt=True)
     if m == DistanceType.L1:
-        return _blocked_rowwise(
-            x, y, lambda xb, yy: jnp.sum(
-                jnp.abs(xb[:, None, :] - yy[None, :, :]), axis=-1))
+        return _unexpanded(x, y, "l1")
     if m == DistanceType.Linf:
-        return _blocked_rowwise(
-            x, y, lambda xb, yy: jnp.max(
-                jnp.abs(xb[:, None, :] - yy[None, :, :]), axis=-1))
+        return _unexpanded(x, y, "linf")
     if m == DistanceType.Canberra:
-        def canberra(xb, yy):
-            num = jnp.abs(xb[:, None, :] - yy[None, :, :])
-            den = jnp.abs(xb[:, None, :]) + jnp.abs(yy[None, :, :])
-            return jnp.sum(jnp.where(den > 0, num / jnp.maximum(den, _EPS),
-                                     0.0), axis=-1)
-        return _blocked_rowwise(x, y, canberra, block=1024)
+        return _unexpanded(x, y, "canberra")
     if m == DistanceType.LpUnexpanded:
-        def minkowski(xb, yy):
-            d = jnp.abs(xb[:, None, :] - yy[None, :, :]) ** p
-            return jnp.sum(d, axis=-1) ** (1.0 / p)
-        return _blocked_rowwise(x, y, minkowski, block=1024)
+        return _unexpanded(x, y, "lp", p) ** (1.0 / p)
     if m == DistanceType.CosineExpanded:
         return _cosine(x, y)
     if m == DistanceType.CorrelationExpanded:
@@ -224,6 +228,8 @@ def pairwise_distance(res, x, y=None,
         # pays off fused with argmin (fused_argmin_pallas)
         return x @ y.T
     if m == DistanceType.HammingUnexpanded:
+        if _use_unexpanded_pallas(x, y):
+            return _unexpanded(x, y, "hamming") / x.shape[1]
         return _blocked_rowwise(
             x, y, lambda xb, yy: jnp.mean(
                 (xb[:, None, :] != yy[None, :, :]).astype(jnp.float32),

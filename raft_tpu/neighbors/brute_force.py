@@ -26,7 +26,23 @@ from raft_tpu.util.precision import with_matmul_precision
 
 
 _METRIC_ALIASES = {"l2": "l2", "sqeuclidean": "l2", "euclidean": "l2",
-                   "cosine": "cosine", "inner": "inner"}
+                   "cosine": "cosine", "inner": "inner",
+                   # unexpanded metrics (ref: brute-force kNN accepts the
+                   # full pairwise vocabulary): VPU reduction tile
+                   # (contractions.pairwise_unexpanded_pallas)
+                   "l1": "l1", "manhattan": "l1", "cityblock": "l1",
+                   "linf": "linf", "chebyshev": "linf",
+                   "canberra": "canberra"}
+
+_UNEXPANDED = ("l1", "linf", "canberra")
+
+
+def _tile_distances(queries, tile_db, metric: str):
+    if metric in _UNEXPANDED:
+        from raft_tpu.linalg.contractions import pairwise_unexpanded_pallas
+
+        return pairwise_unexpanded_pallas(queries, tile_db, metric)
+    return pairwise_pallas(queries, tile_db, metric=metric)
 
 
 def _resolve_metric(metric: str) -> str:
@@ -85,7 +101,7 @@ def _knn_scan(queries, db, k: int, tile: int, metric: str, n_valid=None):
     def step(carry, inp):
         best_v, best_i = carry
         tile_db, off = inp
-        dist = pairwise_pallas(queries, tile_db, metric=metric)
+        dist = _tile_distances(queries, tile_db, metric)
         col = lax.broadcasted_iota(jnp.int32, dist.shape, 1) + off
         # mask padded db rows out of the tournament
         dist = jnp.where(col < n_valid, dist, jnp.inf)
@@ -157,7 +173,7 @@ def _knn_chunked(queries, db, k: int, chunk: int, metric: str,
     def step(carry, inp):
         best_v, best_i = carry
         tile_db, off = inp
-        dist = pairwise_pallas(queries, tile_db, metric=metric)
+        dist = _tile_distances(queries, tile_db, metric)
         col = lax.broadcasted_iota(jnp.int32, dist.shape, 1) + off
         dist = jnp.where(col < n_valid, dist, jnp.inf)
         tv, tp = radix_select_k(dist, k)

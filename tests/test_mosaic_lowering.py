@@ -139,3 +139,40 @@ def test_knn_chunked_radix_lowers_for_tpu():
     db = jnp.asarray(rng.normal(size=(20000, 16)), jnp.float32)
     q = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
     _lowers_with_mosaic(lambda: _knn_chunked(q, db, 20, 8192, "l2")[0])
+
+
+@pytest.mark.parametrize("metric", ["l1", "linf", "canberra", "lp",
+                                    "hamming", "l2un"])
+def test_unexpanded_pairwise_lowers_for_tpu(metric, xy):
+    """The VPU reduction tile for unexpanded metrics: 3-D broadcast +
+    axis-1 reduction per k-chunk with output accumulation over the k grid
+    dimension (max-accumulate for linf)."""
+    from raft_tpu.linalg.contractions import pairwise_unexpanded_pallas
+
+    x, y = xy
+    _lowers_with_mosaic(
+        lambda: pairwise_unexpanded_pallas(x, y, metric, p=3.0))
+
+
+def test_grid_spmv_lowers_for_tpu():
+    """All three slot-grid SpMV kernels: the same-shape dynamic gather
+    (tpu.dynamic_gather via take_along_axis), the segmented-scan tile
+    reduction with its (8,128)<->(1,1024) relayouts and flat emission
+    gather, and the scalar-prefetch window reduction with 8 accumulating
+    output planes."""
+    import scipy.sparse as sp
+
+    from raft_tpu.core.sparse_types import CSRMatrix
+    from raft_tpu.sparse.grid_spmv import prepare, spmv
+
+    rng = np.random.default_rng(6)
+    dense = rng.normal(size=(512, 700)).astype(np.float32)
+    dense[rng.uniform(size=dense.shape) > 0.03] = 0.0
+    fmt = prepare(CSRMatrix.from_scipy(sp.csr_matrix(dense)), shard_w=256)
+    assert fmt.n_shards == 3
+    x = jnp.asarray(rng.normal(size=700), jnp.float32)
+    exp = jax.export.export(jax.jit(lambda: spmv(fmt, x)),
+                            platforms=("tpu",))()
+    mod = exp.mlir_module()
+    assert mod.count("tpu_custom_call") >= 3, \
+        "expected all three grid-SpMV kernels to lower via Mosaic"

@@ -69,7 +69,10 @@ class TestBruteForceKnn:
         with pytest.raises(ValueError):
             knn(None, db, db, k=11)
         with pytest.raises(ValueError):
-            knn(None, db, db, k=2, metric="manhattan")
+            knn(None, db, db, k=2, metric="mahalanobis")
+        # round 4: manhattan IS now in the vocabulary (unexpanded tile)
+        d, _ = knn(None, db, db, k=1, metric="manhattan")
+        np.testing.assert_allclose(np.asarray(d), 0.0, atol=1e-5)
 
     def test_mnmg_matches_single(self, rng, mesh8):
         """Row-sharded MNMG k-NN (uneven last shard) must reproduce the
@@ -230,3 +233,22 @@ class TestChunkedRadixPath:
         v, i = _knn_chunked(jnp.asarray(q), jnp.asarray(db), 20, 4096,
                             "l2")
         assert np.asarray(i)[0].tolist() == list(range(20))
+
+
+class TestUnexpandedMetricsKnn:
+    @pytest.mark.parametrize("metric,sname", [
+        ("l1", "cityblock"), ("chebyshev", "chebyshev"),
+        ("canberra", "canberra")])
+    def test_vs_scipy(self, metric, sname):
+        from scipy.spatial.distance import cdist
+
+        rng = np.random.default_rng(40)
+        db = rng.normal(size=(400, 24)).astype(np.float32)
+        q = rng.normal(size=(29, 24)).astype(np.float32)
+        d, i = knn(None, db, q, 5, metric=metric)
+        ref = cdist(q, db, sname)
+        ri = np.argsort(ref, axis=1, kind="stable")[:, :5]
+        np.testing.assert_allclose(
+            np.asarray(d), np.take_along_axis(ref, ri, 1),
+            rtol=2e-4, atol=2e-4)
+        np.testing.assert_array_equal(np.asarray(i), ri)
