@@ -178,9 +178,13 @@ def run():
     dt = time.perf_counter() - t0
 
     iters_per_sec = iters / dt
-    # FLOPs per iteration: distance expansion 2mnk (GEMM) + m n (epilogue)
-    # + update ~2mk; GEMM dominates.
-    flops = 2.0 * m * n_clusters * k * iters
+    # FLOP convention (single source: BASELINE.md "FLOP accounting"):
+    # one Lloyd iteration performs TWO m×n×k MXU contractions — the
+    # distance expansion AND the one-hot centroid update (real algorithmic
+    # work replacing a scatter) — so logical FLOP/iter = 4mnk. Artifacts
+    # from rounds <= 3 carried 2mnk in vs_baseline; the flop_convention
+    # field disambiguates.
+    flops = 4.0 * m * n_clusters * k * iters
     gflops = flops / dt / 1e9
     peak = _device_peak_tflops(jax.devices()[0]) * 1e3  # GFLOP/s
 
@@ -194,6 +198,7 @@ def run():
         "backend": backend,
         "tier": current_mode(),
         "prepared": ops is not None,
+        "flop_convention": "4mnk-logical",
     }
     if probe_rel_err is not None:
         line["probe_rel_err"] = probe_rel_err
