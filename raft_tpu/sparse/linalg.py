@@ -149,6 +149,8 @@ def spmm(a, b, alpha=1.0, beta=0.0, c=None) -> jnp.ndarray:
         out = grid_spmm(a, jnp.asarray(b))
     elif isinstance(a, ELLMatrix):
         out = ell_spmm(a, jnp.asarray(b))
+    elif spmv_method(a) == "grid":   # same plan cache as spmv
+        out = grid_spmm(_cached_plan(a), jnp.asarray(b))
     else:
         out = _segment_spmm(a.row_ids(), a.indices, a.data,
                             jnp.asarray(b), a.n_rows, limit=a.indptr[-1])
