@@ -204,6 +204,9 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
     rows = np.repeat(np.arange(n_rows, dtype=np.int32),
                      row_len).astype(np.int32)
 
+    # a chunk is SUBROWS * shard_w slots — shrink the shard to the matrix
+    # so small patterns don't pad up to the 64K-column chunk minimum
+    shard_w = min(shard_w, round_up_to_multiple(max(n_cols, 1), 128))
     n_shards = max(1, cdiv(n_cols, shard_w))
     chunk_slots = SUBROWS * shard_w
 
