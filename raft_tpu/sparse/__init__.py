@@ -20,4 +20,5 @@ from raft_tpu.sparse.ell import ELLMatrix  # noqa: F401
 from . import convert, ell, grid_spmv, linalg, matrix, op  # noqa: F401
 from raft_tpu.sparse.grid_spmv import GridSpMV  # noqa: F401
 from . import solver  # noqa: F401
-from raft_tpu.sparse.csr import weak_cc, weak_cc_batched  # noqa: F401
+from raft_tpu.sparse.csr import (weak_cc, weak_cc_batched,  # noqa: F401
+                                 weak_cc_mnmg)

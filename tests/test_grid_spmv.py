@@ -252,6 +252,30 @@ class TestMNMGLanczos:
                 sp.eye(32, format="csr", dtype=np.float32)), k=2)
 
 
+class TestMNMGWeakCC:
+    def test_matches_single_device_and_scipy(self, mesh8):
+        from scipy.sparse.csgraph import connected_components
+
+        from raft_tpu.sparse import weak_cc, weak_cc_mnmg
+
+        rng = np.random.default_rng(33)
+        n = 700   # not a multiple of 8: exercises edge-band padding
+        A = sp.csr_matrix(
+            (rng.uniform(size=(n, n)) < 0.002).astype(np.float32))
+        csr = CSRMatrix.from_scipy(A)
+        l1 = np.asarray(weak_cc(None, csr))
+        l2 = np.asarray(weak_cc_mnmg(None, csr, mesh8))
+        np.testing.assert_array_equal(l1, l2)
+        _, ref = connected_components(A, directed=False)
+        seen = {}
+        assert all(seen.setdefault(a, b) == b for a, b in zip(l2, ref))
+        # mask barriers agree too
+        mask = rng.uniform(size=n) > 0.15
+        np.testing.assert_array_equal(
+            np.asarray(weak_cc(None, csr, mask=mask)),
+            np.asarray(weak_cc_mnmg(None, csr, mesh8, mask=mask)))
+
+
 class TestPacker:
     @pytest.mark.parametrize("seed", range(4))
     def test_native_matches_python(self, seed):
