@@ -169,6 +169,19 @@ class CSRMatrix:
             out = out.pad_nnz()
         return out
 
+    def host_edges(self):
+        """Host numpy (rows, cols, data) of the LOGICAL entries (pad
+        tail stripped) — the COO expansion every host-side driver
+        (MNMG banding, packers) starts from; one definition so the
+        padding convention has a single consumer-side reading."""
+        indptr = np.asarray(self.indptr)
+        nnz = int(indptr[-1])
+        rows = np.repeat(np.arange(self.n_rows, dtype=np.int32),
+                         np.diff(indptr)).astype(np.int32)[:nnz]
+        cols = np.asarray(self.indices)[:nnz].astype(np.int32)
+        data = np.asarray(self.data)[:nnz]
+        return rows, cols, data
+
     def row_lengths(self):
         return self.indptr[1:] - self.indptr[:-1]
 

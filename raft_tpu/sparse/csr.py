@@ -29,11 +29,17 @@ from raft_tpu.core.sparse_types import CSRMatrix
 from raft_tpu.label.merge_labels import MAX_LABEL
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _weak_cc_device(src, dst, vmask, n: int):
+@functools.partial(jax.jit, static_argnames=("n", "axis"))
+def _weak_cc_device(src, dst, vmask, n: int, active=None,
+                    axis: Optional[str] = None):
+    """Label-propagation fixpoint. With ``axis`` (the MNMG path, under
+    shard_map) each device scatter-mins its own edge band and a
+    ``lax.pmin`` after every round restores the global minimum — the
+    same rounds, so the fixpoint and the diameter cap are shared."""
     cid = jnp.arange(n, dtype=jnp.int32)
-    # filtered vertices are barriers: they take no label and pass none
-    active = vmask[src] & vmask[dst]
+    if active is None:
+        # filtered vertices are barriers: they take no label, pass none
+        active = vmask[src] & vmask[dst]
     safe_src = jnp.where(active, src, 0)
     safe_dst = jnp.where(active, dst, 0)
     r0 = jnp.where(vmask, cid, _i32(MAX_LABEL))
@@ -50,6 +56,8 @@ def _weak_cc_device(src, dst, vmask, n: int):
         upd = jnp.where(active, lo, _i32(MAX_LABEL))
         r = r.at[safe_dst].min(upd)
         r = r.at[safe_src].min(upd)
+        if axis is not None:
+            r = lax.pmin(r, axis)
         return halve(r)
 
     def cond(state):
@@ -120,44 +128,6 @@ def weak_cc_batched(res, csr: CSRMatrix, start_vertex_id: int = 0,
 # item: MNMG beyond k-means/kNN)
 # ---------------------------------------------------------------------------
 
-def _weak_cc_mnmg_body(src_l, dst_l, active_l, vmask, n: int, axis: str):
-    """Per-shard label propagation: each device scatter-mins ITS edge
-    band into a replicated (n,) label vector; a lax.pmin after every
-    round restores the global minimum so the fixpoint is mesh-wide."""
-    cid = jnp.arange(n, dtype=jnp.int32)
-    safe_src = jnp.where(active_l, src_l, 0)
-    safe_dst = jnp.where(active_l, dst_l, 0)
-    r0 = jnp.where(vmask, cid, _i32(MAX_LABEL))
-
-    def halve(r):
-        tgt = jnp.clip(r, 0, n - 1)
-        return jnp.where(r < n, jnp.minimum(r, r[tgt]), r)
-
-    def propagate(r):
-        ls = r[safe_src]
-        ld = r[safe_dst]
-        lo = jnp.minimum(ls, ld)
-        upd = jnp.where(active_l, lo, _i32(MAX_LABEL))
-        r = r.at[safe_dst].min(upd)
-        r = r.at[safe_src].min(upd)
-        # per-shard partial labels -> global elementwise min, then the
-        # (now replicated) pointer jump
-        return halve(lax.pmin(r, axis))
-
-    def cond(state):
-        i, r, changed = state
-        return changed & (i < jnp.int32(n + 2))
-
-    def body(state):
-        i, r, _ = state
-        nr = propagate(r)
-        return i + 1, nr, jnp.any(nr != r)
-
-    _, r, _ = lax.while_loop(cond, body,
-                             (jnp.int32(0), propagate(r0), jnp.bool_(True)))
-    return jnp.where(r < n, r + 1, _i32(MAX_LABEL))
-
-
 def weak_cc_mnmg(res, csr: CSRMatrix, mesh, axis: str = "data",
                  mask: Optional[np.ndarray] = None) -> jnp.ndarray:
     """Multi-device weak_cc: the edge list is split into equal bands over
@@ -175,11 +145,8 @@ def weak_cc_mnmg(res, csr: CSRMatrix, mesh, axis: str = "data",
     vmask = np.ones((n,), np.bool_) if mask is None \
         else np.asarray(mask).astype(np.bool_)
 
-    indptr = np.asarray(csr.indptr)
-    nnz = int(indptr[-1])
-    src = np.repeat(np.arange(n, dtype=np.int32),
-                    np.diff(indptr)).astype(np.int32)[:nnz]
-    dst = np.asarray(csr.indices)[:nnz].astype(np.int32)
+    src, dst, _ = csr.host_edges()
+    nnz = len(src)
     active = vmask[src] & vmask[dst]
 
     per = -(-max(nnz, 1) // n_dev)
@@ -189,9 +156,9 @@ def weak_cc_mnmg(res, csr: CSRMatrix, mesh, axis: str = "data",
     act_b = np.pad(active, (0, pad))          # pad edges inactive
 
     shard = NamedSharding(mesh, P(axis))
-    body = functools.partial(_weak_cc_mnmg_body, n=n, axis=axis)
+    body = functools.partial(_weak_cc_device, n=n, axis=axis)
     fn = jax.jit(jax.shard_map(
-        body, mesh=mesh,
+        lambda s_, d_, a_, v_: body(s_, d_, v_, active=a_), mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P()),
         out_specs=P()))
     return fn(jax.device_put(jnp.asarray(src_b), shard),

@@ -195,14 +195,10 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
             shard_w: int = SHARD_W) -> GridSpMV:
     """Build the slot-grid plan from a CSRMatrix (host-side, once per
     pattern — the cusparseSpMV_preprocess analogue)."""
-    indptr = np.asarray(csr.indptr)
-    nnz_log = int(indptr[-1])
-    cols = np.asarray(csr.indices)[:nnz_log].astype(np.int32)
-    data = np.asarray(csr.data)[:nnz_log].astype(np.float32)
+    rows, cols, data = csr.host_edges()
+    data = data.astype(np.float32)
+    nnz_log = len(rows)
     n_rows, n_cols = csr.shape
-    row_len = np.diff(indptr)
-    rows = np.repeat(np.arange(n_rows, dtype=np.int32),
-                     row_len).astype(np.int32)
 
     # a chunk is SUBROWS * shard_w slots — shrink the shard to the matrix
     # so small patterns don't pad up to the 64K-column chunk minimum

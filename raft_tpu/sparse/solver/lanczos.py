@@ -446,11 +446,8 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
 
     n_local = cdiv(n, n_dev)
     n_pad = n_local * n_dev
-    indptr = np.asarray(csr.indptr)
-    nnz_log = int(indptr[-1])
-    cols_h = np.asarray(csr.indices)[:nnz_log]
-    data_h = np.asarray(csr.data)[:nnz_log].astype(np.float32)
-    rows_h = np.repeat(np.arange(n, dtype=np.int32), np.diff(indptr))
+    rows_h, cols_h, data_h = csr.host_edges()
+    data_h = data_h.astype(np.float32)
     band = rows_h // n_local
     counts = np.bincount(band, minlength=n_dev)
     nnz_max = max(int(counts.max()), 1)

@@ -50,7 +50,7 @@ def _embed(res, csr: CSRMatrix, n_components: int, which: str,
 def partition(res, graph, n_clusters: int, n_eig_vects: int = 0,
               normalized: bool = True, ncv: int = 0,
               max_iterations: int = 200, tolerance: float = 1e-4,
-              seed: int = 0
+              seed: int = 0, mesh=None, data_axis: str = "data"
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Spectral partition of an undirected graph (CSR/COO adjacency).
 
@@ -59,9 +59,30 @@ def partition(res, graph, n_clusters: int, n_eig_vects: int = 0,
     eigenvectors of the (normalized) Laplacian; rows are L2-normalized
     before k-means (the Ng–Jordan–Weiss step), matching the reference
     lineage's transform_eigen_matrix (detail/spectral_util.cuh:33).
+
+    With ``mesh``, the whole pipeline is multi-device on the row-band
+    convention: the Laplacian eigensolve runs `eigsh_mnmg` (operator
+    row-partitioned over ``mesh[data_axis]``) and the embedding k-means
+    runs `kmeans_fit_mnmg` over the same axis — BASELINE config 4
+    composed with config 5's mesh.
     """
     csr = _as_csr(graph)
     k = n_eig_vects or n_clusters
+    if mesh is not None:
+        from raft_tpu.cluster.kmeans import kmeans_fit_mnmg
+        from raft_tpu.sparse.solver.lanczos import eigsh_mnmg
+
+        lap = laplacian_normalized(csr) if normalized else laplacian(csr)
+        vals, vecs = eigsh_mnmg(lap, k=k, mesh=mesh, axis=data_axis,
+                                which="SA", ncv=ncv,
+                                maxiter=max_iterations,
+                                tol=tolerance, seed=seed)
+        norms = jnp.linalg.norm(vecs, axis=1, keepdims=True)
+        emb = (vecs / jnp.maximum(norms, 1e-12)).astype(jnp.float32)
+        c, inertia, labels, _ = kmeans_fit_mnmg(
+            res, KMeansParams(n_clusters=n_clusters, seed=seed), emb,
+            mesh=mesh, data_axis=data_axis)
+        return labels, vals, vecs
     vals, vecs = _embed(res, csr, k, "SA", normalized, ncv,
                         max_iterations, tolerance, seed)
     norms = jnp.linalg.norm(vecs, axis=1, keepdims=True)

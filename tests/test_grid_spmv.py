@@ -267,8 +267,10 @@ class TestMNMGWeakCC:
         l2 = np.asarray(weak_cc_mnmg(None, csr, mesh8))
         np.testing.assert_array_equal(l1, l2)
         _, ref = connected_components(A, directed=False)
-        seen = {}
-        assert all(seen.setdefault(a, b) == b for a, b in zip(l2, ref))
+        fwd, bwd = {}, {}
+        for a, b in zip(l2, ref):     # bijection = identical partitions
+            assert fwd.setdefault(a, b) == b
+            assert bwd.setdefault(b, a) == a
         # mask barriers agree too
         mask = rng.uniform(size=n) > 0.15
         np.testing.assert_array_equal(

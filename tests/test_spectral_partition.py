@@ -69,3 +69,26 @@ class TestSpectralDrivers:
         assert q > 0.3, q                   # known 2-way modularity ≈ 0.37
         agree = (labels == _FACTION).mean()
         assert max(agree, 1 - agree) >= 0.8
+
+
+class TestMNMGPartition:
+    def test_mesh_pipeline_finds_planted_cut(self, mesh8):
+        from raft_tpu.spectral import analyze_partition, partition
+
+        rng = np.random.default_rng(3)
+        n, half = 400, 200
+        dense = np.zeros((n, n), np.float32)
+        for blk in (slice(0, half), slice(half, n)):
+            w = (rng.uniform(size=(half, half)) < 0.08).astype(np.float32)
+            dense[blk, blk] = np.triu(w, 1)
+        for _ in range(6):
+            dense[rng.integers(0, half), rng.integers(half, n)] = 1.0
+        dense = dense + dense.T
+        csr = CSRMatrix.from_scipy(sp.csr_matrix(dense))
+        labels, vals, vecs = partition(None, csr, n_clusters=2,
+                                       n_eig_vects=2, mesh=mesh8)
+        edge_cut, _ = analyze_partition(None, csr, 2, labels)
+        assert int(edge_cut) <= 24
+        l1, _, _ = partition(None, csr, n_clusters=2, n_eig_vects=2)
+        a = (np.asarray(l1) == np.asarray(labels)).mean()
+        assert max(a, 1 - a) > 0.97    # identical up to label swap
