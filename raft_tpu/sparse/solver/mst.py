@@ -35,6 +35,13 @@ from raft_tpu.core.sparse_types import CSRMatrix
 
 _I32_MAX = np.iinfo(np.int32).max
 
+# Edge-compaction schedule bounds (see mst()): each _compact CALL lands
+# the rounds on one new _boruvka_round shape, so at most
+# _COMPACT_STEPS + 1 distinct sizes (all of the form ceil-halvings of
+# this input's nnz, floored at _COMPACT_MIN) are ever compiled.
+_COMPACT_STEPS = 3
+_COMPACT_MIN = 4096
+
 
 @dataclasses.dataclass
 class GraphCOO:
@@ -116,13 +123,35 @@ def _boruvka_round(colors, src, dst, weights, n: int):
 
     _, r, _ = lax.while_loop(cond, body, (jnp.int32(0), r0, jnp.bool_(True)))
     new_colors = r[colors]
-    return new_colors, seg_e, include, jnp.any(has_edge)
+    # surviving cross-edge count under the NEW coloring: the driver's
+    # compaction schedule (and its termination poll) read this scalar
+    n_cross = jnp.sum(new_colors[src] != new_colors[dst])
+    return new_colors, seg_e, include, n_cross
 
 
 @jax.jit
-def _accumulate(edge_mask, seg_e, include):
+def _accumulate(edge_mask, eids, seg_e, include):
+    # seg_e indexes the CURRENT (possibly compacted) edge arrays; eids
+    # maps back to original edge ids, where the output mask lives
     safe = jnp.where(include, seg_e, 0)
-    return edge_mask.at[safe].max(include)
+    return edge_mask.at[eids[safe]].max(include)
+
+
+@functools.partial(jax.jit, static_argnames=("out_size",))
+def _compact(colors, src, dst, weights, eids, out_size: int):
+    """Keep only cross edges (intra-component edges never matter again —
+    the standard Borůvka filter, here at a STATIC out size chosen by the
+    driver's bounded schedule so the jit cache stays bounded). Pad slots
+    become infinite-weight self-loops (never cross, never minimal)."""
+    cross = colors[src] != colors[dst]
+    idx = jnp.nonzero(cross, size=out_size, fill_value=0)[0]
+    valid = jnp.arange(out_size) < jnp.sum(cross)
+    s2 = jnp.where(valid, src[idx], 0)
+    d2 = jnp.where(valid, dst[idx], 0)
+    w2 = jnp.where(valid, weights[idx],
+                   jnp.asarray(jnp.inf, weights.dtype))
+    e2 = jnp.where(valid, eids[idx], 0)
+    return s2, d2, w2, e2
 
 
 def mst(res, csr: CSRMatrix, color: Optional[np.ndarray] = None,
@@ -154,20 +183,42 @@ def mst(res, csr: CSRMatrix, color: Optional[np.ndarray] = None,
     edge_mask = jnp.zeros((src.shape[0],), jnp.bool_)
     max_iters = max(1, int(np.ceil(np.log2(max(n, 2)))) + 1)
 
+    # Edge filtering (the standard Borůvka compaction, shaped for jit):
+    # intra-component edges can never be chosen again, so once the
+    # surviving cross count fits half the current buffer the arrays
+    # shrink to the next power-of-two size. At most _COMPACT_STEPS
+    # halvings (each size is one extra _boruvka_round compile); typical
+    # graphs drop most edges in the first rounds, so later rounds scan a
+    # fraction of E instead of all of it every time.
+    eids = jnp.arange(src.shape[0], dtype=jnp.int32)
+    src0, dst0, weights0 = src, dst, weights   # originals: output ids
+    steps_left = _COMPACT_STEPS
     for _ in range(max_iters):
-        colors, seg_e, include, any_cross = _boruvka_round(
+        colors, seg_e, include, n_cross = _boruvka_round(
             colors, src, dst, weights, n)
-        if not bool(any_cross):          # the round's single host poll
+        count = int(n_cross)             # the round's single host poll
+        edge_mask = _accumulate(edge_mask, eids, seg_e, include)
+        if count == 0:
             break
-        edge_mask = _accumulate(edge_mask, seg_e, include)
+        cur = int(src.shape[0])
+        if steps_left > 0 and cur > _COMPACT_MIN and count <= cur // 2:
+            # one _compact call = one new round shape = one budget step,
+            # however many halvings the target size jumps
+            new_size = max(_COMPACT_MIN, cur // 2)
+            while new_size // 2 >= max(count, 1) \
+                    and new_size // 2 >= _COMPACT_MIN:
+                new_size //= 2
+            steps_left -= 1
+            src, dst, weights, eids = _compact(
+                colors, src, dst, weights, eids, new_size)
 
     if color is not None:
         color[:] = np.asarray(colors)
 
     ids = np.nonzero(np.asarray(edge_mask))[0]
-    s = np.asarray(src)[ids]
-    d = np.asarray(dst)[ids]
-    w = np.asarray(weights)[ids]
+    s = np.asarray(src0)[ids]          # edge_mask lives in ORIGINAL ids
+    d = np.asarray(dst0)[ids]
+    w = np.asarray(weights0)[ids]
     if symmetrize_output:
         s, d, w = (np.concatenate([s, d]), np.concatenate([d, s]),
                    np.concatenate([w, w]))

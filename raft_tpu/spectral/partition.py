@@ -77,18 +77,24 @@ def partition(res, graph, n_clusters: int, n_eig_vects: int = 0,
                                 which="SA", ncv=ncv,
                                 maxiter=max_iterations,
                                 tol=tolerance, seed=seed)
-        norms = jnp.linalg.norm(vecs, axis=1, keepdims=True)
-        emb = (vecs / jnp.maximum(norms, 1e-12)).astype(jnp.float32)
-        c, inertia, labels, _ = kmeans_fit_mnmg(
-            res, KMeansParams(n_clusters=n_clusters, seed=seed), emb,
-            mesh=mesh, data_axis=data_axis)
-        return labels, vals, vecs
-    vals, vecs = _embed(res, csr, k, "SA", normalized, ncv,
-                        max_iterations, tolerance, seed)
+
+        def fit(params, emb):
+            return kmeans_fit_mnmg(res, params, emb, mesh=mesh,
+                                   data_axis=data_axis)
+    else:
+        vals, vecs = _embed(res, csr, k, "SA", normalized, ncv,
+                            max_iterations, tolerance, seed)
+
+        def fit(params, emb):
+            return kmeans_fit(res, params, emb)
+
+    # Ng–Jordan–Weiss row normalization + embedding k-means: ONE tail
+    # for both pipelines (only the eigensolve and the k-means driver
+    # differ between single-device and mesh)
     norms = jnp.linalg.norm(vecs, axis=1, keepdims=True)
     emb = (vecs / jnp.maximum(norms, 1e-12)).astype(jnp.float32)
-    c, inertia, labels, _ = kmeans_fit(
-        res, KMeansParams(n_clusters=n_clusters, seed=seed), emb)
+    c, inertia, labels, _ = fit(
+        KMeansParams(n_clusters=n_clusters, seed=seed), emb)
     return labels, vals, vecs
 
 

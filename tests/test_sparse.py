@@ -393,6 +393,36 @@ class TestSolvers:
         assert out.n_edges // 2 == n - n_comp
         assert len(np.unique(colors)) == n_comp
 
+    def test_mst_with_edge_compaction(self, res, monkeypatch):
+        # a weighted path needs ~log2(n) Boruvka rounds, so with a tiny
+        # size floor the driver MUST run the round-4 edge compaction
+        # (asserted via a spy — this test caught the original-id output
+        # extraction bug) and still match scipy exactly
+        import importlib
+
+        mst_mod = importlib.import_module("raft_tpu.sparse.solver.mst")
+        monkeypatch.setattr(mst_mod, "_COMPACT_MIN", 8)
+        calls = []
+        orig = mst_mod._compact
+
+        def spy(colors, src, dst, w, eids, out_size):
+            calls.append(out_size)
+            return orig(colors, src, dst, w, eids, out_size)
+
+        monkeypatch.setattr(mst_mod, "_compact", spy)
+        rng = np.random.RandomState(7)
+        n = 3000
+        i = np.arange(n - 1)
+        w = rng.rand(n - 1).astype(np.float32) + 0.1
+        adj = sp.coo_matrix((w, (i, i + 1)), shape=(n, n))
+        adj = (adj + adj.T).tocsr()
+        out = mst_mod.mst(res, CSRMatrix.from_scipy(adj))
+        assert calls and calls == sorted(calls, reverse=True)
+        got_w = float(np.sum(np.asarray(out.weights))) / 2.0
+        ref = csgraph.minimum_spanning_tree(adj.astype(np.float64))
+        np.testing.assert_allclose(got_w, ref.sum(), rtol=1e-5)
+        assert out.n_edges // 2 == n - 1
+
 
 class TestELL:
     """ELL slab format (raft_tpu.sparse.ell — the TPU-preferred layout)."""
