@@ -324,3 +324,28 @@ class TestPacker:
         # every entry placed exactly once
         placed = np.sort(slots[slots >= 0])
         np.testing.assert_array_equal(placed, np.arange(len(rows)))
+
+
+class TestGridSpMVFuzz:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_random_patterns_vs_scipy(self, seed):
+        """Seeded fuzz over pattern shapes the packer must survive:
+        skewed degrees, empty rows/cols bands, duplicate-free random,
+        tiny shards, non-square."""
+        rng = np.random.default_rng(100 + seed)
+        n_rows = int(rng.integers(1, 900))
+        n_cols = int(rng.integers(1, 900))
+        nnz = int(rng.integers(0, max(1, n_rows * n_cols // 20)))
+        r = rng.integers(0, n_rows, nnz)
+        c = rng.integers(0, n_cols, nnz)
+        if seed % 3 == 0 and nnz > 10:     # hub row + hub col
+            r[: nnz // 3] = int(rng.integers(0, n_rows))
+            c[nnz // 3: 2 * nnz // 3] = int(rng.integers(0, n_cols))
+        d = rng.normal(size=nnz).astype(np.float32)
+        A = sp.csr_matrix((d, (r, c)), shape=(n_rows, n_cols))
+        A.sum_duplicates()
+        shard_w = int(rng.choice([128, 256, 65536]))
+        x = rng.normal(size=n_cols).astype(np.float32)
+        fmt = prepare(CSRMatrix.from_scipy(A), shard_w=shard_w)
+        y = np.asarray(spmv(fmt, jnp.asarray(x)))
+        np.testing.assert_allclose(y, A @ x, rtol=5e-5, atol=5e-5)

@@ -559,3 +559,40 @@ class TestWeakCC:
         assert out.n_edges // 2 == n - 1           # spanning tree
         got = float(np.sum(np.asarray(out.weights))) / 2
         np.testing.assert_allclose(got, w.sum(), rtol=1e-5)
+
+
+class TestMSTFuzz:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_compaction_schedule_vs_scipy(self, res, seed, monkeypatch):
+        """Seeded fuzz over graph shapes with the compaction floor forced
+        low: paths (log rounds), random forests, cliques with ties —
+        total MSF weight must match scipy exactly through every
+        compaction step."""
+        import importlib
+
+        mst_mod = importlib.import_module("raft_tpu.sparse.solver.mst")
+        monkeypatch.setattr(mst_mod, "_COMPACT_MIN", 8)
+        rng = np.random.RandomState(200 + seed)
+        n = int(rng.randint(20, 800))
+        kind = seed % 3
+        if kind == 0:        # path + chords
+            i = np.arange(n - 1)
+            w = rng.rand(n - 1).astype(np.float32) + 0.1
+            A = sp.coo_matrix((w, (i, i + 1)), shape=(n, n))
+        elif kind == 1:      # sparse random (often a forest)
+            dense = np.triu(np.round(rng.rand(n, n), 2), 1)
+            dense = dense * (dense < 0.04)
+            A = sp.coo_matrix(dense)
+        else:                # denser with many exact ties
+            dense = np.triu(np.round(rng.rand(n, n), 1), 1)
+            dense = dense * (dense < 0.3)
+            A = sp.coo_matrix(dense)
+        A = (A + A.T).tocsr().astype(np.float32)
+        if A.nnz == 0:
+            return
+        out = mst_mod.mst(res, CSRMatrix.from_scipy(A))
+        got = float(np.asarray(out.weights).sum()) / 2.0
+        ref = csgraph.minimum_spanning_tree(A.astype(np.float64)).sum()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        n_comp = csgraph.connected_components(A, directed=False)[0]
+        assert out.n_edges // 2 == n - n_comp
