@@ -332,13 +332,18 @@ def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype):
 
 def _extend_mnmg_body(rows_l, cols_g, data_l, basis_l, v_l, key,
                       j_start: int, ncv: int, n_local: int, n_true: int,
-                      axis: str):
+                      axis: str, use_ell: bool = False):
     """Per-shard Lanczos extension under shard_map: each device owns a row
     band of A (local row ids, GLOBAL col ids, nnz padded per band with
     rows_l == -1) and the matching slice of every basis vector. The SpMV
     all-gathers v (the row-partitioned MNMG convention,
     ref docs/source/using_raft_comms.rst:1-40 — replicate the vector,
-    partition the operator); every dot/norm is a lax.psum over the axis."""
+    partition the operator); every dot/norm is a lax.psum over the axis.
+
+    ``use_ell``: the band arrives as row-slab arrays (cols/data
+    (n_local, w), rows_l = per-row lane counts) — the scatter-free
+    gather+reduce formulation maybe_ell prefers on one device, applied
+    per band."""
     dtype = basis_l.dtype
 
     def psum(x):
@@ -346,6 +351,13 @@ def _extend_mnmg_body(rows_l, cols_g, data_l, basis_l, v_l, key,
 
     def do_spmv(v_l):
         v_full = lax.all_gather(v_l, axis, tiled=True)
+        if use_ell:
+            # rows_l: (n_local,) valid-lane counts; pad lanes masked on
+            # the product (they gather v[0]; 0 * inf = nan otherwise)
+            lane_ok = (jnp.arange(cols_g.shape[1], dtype=jnp.int32)[None]
+                       < rows_l[:, None])
+            prod = jnp.where(lane_ok, data_l * v_full[cols_g], 0.0)
+            return jnp.sum(prod, axis=1)
         prod = data_l * v_full[cols_g]
         # band pads carry rows_l == -1: mask the PRODUCT (pad slots gather
         # v[0]; 0 * inf would poison row 0 of the band otherwise)
@@ -449,22 +461,46 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
     rows_h, cols_h, data_h = csr.host_edges()
     data_h = data_h.astype(np.float32)
     band = rows_h // n_local
-    counts = np.bincount(band, minlength=n_dev)
-    nnz_max = max(int(counts.max()), 1)
-    rows_b = np.full((n_dev, nnz_max), -1, np.int32)
-    cols_b = np.zeros((n_dev, nnz_max), np.int32)
-    data_b = np.zeros((n_dev, nnz_max), np.float32)
-    for d in range(n_dev):
-        m = band == d
-        c = int(counts[d])
-        rows_b[d, :c] = rows_h[m] - d * n_local
-        cols_b[d, :c] = cols_h[m]
-        data_b[d, :c] = data_h[m]
 
     shard = NamedSharding(mesh, P(axis))
-    rows_g = jax.device_put(rows_b.reshape(-1), shard)
-    cols_g = jax.device_put(cols_b.reshape(-1), shard)
-    data_g = jax.device_put(data_b.reshape(-1), shard)
+    # Per-band ELL slab when the padding trade is favorable (the same
+    # <= 4x stored/actual gate as maybe_ell): gather + dense row reduce,
+    # no scatter — otherwise the segment-sum band formulation.
+    from raft_tpu.sparse.ell import MAX_AUTO_PADDING
+
+    row_len_h = np.zeros(n_pad, np.int64)
+    np.add.at(row_len_h, rows_h, 1)
+    width = int(row_len_h.max()) if len(rows_h) else 0
+    width = max(8 * -(-max(width, 1) // 8), 8)
+    use_ell = (len(rows_h) > 0
+               and n_pad * width <= MAX_AUTO_PADDING * len(rows_h))
+    if use_ell:
+        cols_e = np.zeros((n_pad, width), np.int32)
+        data_e = np.zeros((n_pad, width), np.float32)
+        lanes = (np.arange(len(rows_h))
+                 - np.concatenate([[0], np.cumsum(row_len_h)[:-1]]
+                                  )[rows_h])
+        cols_e[rows_h, lanes] = cols_h
+        data_e[rows_h, lanes] = data_h
+        rows_g = jax.device_put(
+            jnp.asarray(row_len_h.astype(np.int32)), shard)
+        cols_g = jax.device_put(jnp.asarray(cols_e), shard)
+        data_g = jax.device_put(jnp.asarray(data_e), shard)
+    else:
+        counts = np.bincount(band, minlength=n_dev)
+        nnz_max = max(int(counts.max()), 1)
+        rows_b = np.full((n_dev, nnz_max), -1, np.int32)
+        cols_b = np.zeros((n_dev, nnz_max), np.int32)
+        data_b = np.zeros((n_dev, nnz_max), np.float32)
+        for d in range(n_dev):
+            m = band == d
+            c = int(counts[d])
+            rows_b[d, :c] = rows_h[m] - d * n_local
+            cols_b[d, :c] = cols_h[m]
+            data_b[d, :c] = data_h[m]
+        rows_g = jax.device_put(rows_b.reshape(-1), shard)
+        cols_g = jax.device_put(cols_b.reshape(-1), shard)
+        data_g = jax.device_put(data_b.reshape(-1), shard)
 
     rng = np.random.default_rng(cfg.seed)
     v_h = (np.asarray(v0, np.float32) if v0 is not None
@@ -479,7 +515,7 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
     def make_extend(j_start):
         body = functools.partial(_extend_mnmg_body, j_start=j_start,
                                  ncv=ncv, n_local=n_local, n_true=n,
-                                 axis=axis)
+                                 axis=axis, use_ell=use_ell)
         return jax.jit(jax.shard_map(
             body, mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(None, axis), P(axis),

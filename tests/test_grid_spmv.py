@@ -244,6 +244,26 @@ class TestMNMGLanczos:
                                    np.sort(np.asarray(v2)),
                                    rtol=5e-4, atol=5e-4)
 
+    def test_eigsh_mnmg_segment_gate_on_hub_row(self, mesh8):
+        # a hub row blows the ELL width gate: the band formulation falls
+        # back to segment sums and must still match scipy
+        import scipy.sparse.linalg as spla
+
+        from raft_tpu.sparse.solver import eigsh_mnmg
+
+        rng = np.random.default_rng(9)
+        n = 400
+        dense = rng.normal(size=(n, n)).astype(np.float32)
+        dense[rng.uniform(size=(n, n)) > 0.03] = 0.0
+        dense[5, :] = rng.normal(size=n)
+        A = sp.csr_matrix(dense + dense.T)
+        vals, _ = eigsh_mnmg(CSRMatrix.from_scipy(A), k=3, mesh=mesh8,
+                             which="LA")
+        ref = np.sort(spla.eigsh(A.astype(np.float64), k=3, which="LA",
+                                 return_eigenvectors=False))
+        np.testing.assert_allclose(np.sort(np.asarray(vals)), ref,
+                                   rtol=3e-4, atol=3e-4)
+
     def test_eigsh_mnmg_requires_mesh(self):
         from raft_tpu.sparse.solver import eigsh_mnmg
 
