@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from raft_tpu.core import logger
 from raft_tpu.util.precision import with_matmul_precision
 
 EigVecUsage = ("OVERWRITE_INPUT", "COPY_INPUT")
@@ -140,39 +141,126 @@ def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15):
 # subset solver's cost is ~restarts * ncv MXU matvecs (O(n^2 * ncv)) vs
 # the full decomposition's O(n^3) — the same trade syevdx makes with
 # bisection + inverse iteration on the tridiagonalization.
-_EIG_SEL_ITERATIVE_MIN_N = 2048
+_EIG_SEL_ITERATIVE_MIN_N = 512
+
+
+def _eig_dc_slice(res, m, n_eig_vals: int, largest: bool):
+    w, v = eig_dc(res, m)
+    if largest:
+        return w[-n_eig_vals:], v[:, -n_eig_vals:]
+    return w[:n_eig_vals], v[:, :n_eig_vals]
 
 
 def eig_sel(res, matrix, n_eig_vals: int, largest: bool = True,
-            tol: float = 1e-6):
+            tol: float = 1e-6, exact=None):
     """Subset eigendecomposition (ref: eig.cuh eig_sel → syevdx).
 
     Returns the ``n_eig_vals`` largest (or smallest) eigenpairs, eigenvalues
     ascending within the selection, vectors as columns.
 
-    For large matrices with a small subset (n >= 2048, k <= n/8) the full
-    spectrum is never materialized: a dense-operator thick-restart Lanczos
+    For f32 matrices with n >= 512 and k <= n/3 (k <= n/2 when
+    ``exact=False`` forces it) the full spectrum is never materialized: a
+    dense-operator thick-restart Lanczos with soft locking
     (sparse/solver/lanczos.py) runs the extremal subspace to ``tol`` on MXU
     matvecs — the TPU shape of the reference's windowed syevdx
-    (detail/cusolver_wrappers.hpp syevdx family); below the threshold the
-    full QDWH-eig is MXU-bound and slicing it is faster.
+    (detail/cusolver_wrappers.hpp syevdx family). Past k ~ n/3 the restart
+    matvec volume crosses the full QDWH-eig's cost, so the auto dispatch
+    slices the full decomposition instead.
+
+    Accuracy contract: the reference's syevdx is an EXACT subset solver,
+    while Lanczos resolves one Krylov direction per distinct eigenvalue —
+    locking deflates converged pairs so degenerate copies emerge as
+    separate Ritz pairs (the solve carries a small overshoot buffer so
+    boundary clusters have room to surface), and every iterative result
+    is VERIFIED before return: per-pair residuals ``|A v - w v|`` and the
+    pairwise orthogonality of the returned vectors are checked on host —
+    duplicate eigenvalues with orthogonal vectors are a correctly
+    resolved multiplicity, while near-parallel vectors or residuals above
+    ~10*tol*|A| (e.g. an unconverged pair) fall back to the exact eig_dc
+    slice. ``exact``:
+
+    * ``None`` (default) — auto: iterative inside the envelope above,
+      exact slice elsewhere; iterative results always verified.
+    * ``True`` — always the exact eig_dc slice (the strict syevdx
+      contract). f64 input on the TPU backend additionally routes the
+      decomposition to host LAPACK (``np.linalg.eigh``) — TPU f64 is
+      emulated, and parity-critical f64 callers want the exact result.
+    * ``False`` — force the iterative path whenever it applies
+      (f32, k <= n/2); still verified with fallback.
     """
     m = jnp.asarray(matrix)
     n = m.shape[0]
-    if (n >= _EIG_SEL_ITERATIVE_MIN_N and 0 < n_eig_vals <= n // 8
-            and jnp.dtype(m.dtype) == jnp.dtype(jnp.float32)):
+    k = n_eig_vals
+    if not 0 < k <= n:
+        raise ValueError(f"need 0 < n_eig_vals <= n, got {k} vs {n}")
+    if isinstance(m, jax.core.Tracer):
+        # under jit only the pure-XLA slice traces (the iterative driver
+        # and the f64-host fallback are host-driven — same guard as
+        # sparse.linalg.spmv_method's "never auto-build under jit")
+        return _eig_dc_slice(res, m, k, largest)
+    is_f32 = jnp.dtype(m.dtype) == jnp.dtype(jnp.float32)
+    want_iter = k < n and is_f32 and (
+        (exact is False and k <= n // 2)
+        or (exact is None and n >= _EIG_SEL_ITERATIVE_MIN_N
+            and k <= n // 3))
+    if want_iter:
         # f32 only: the Lanczos driver computes in f32, and an f64 input
-        # (x64 mode) must keep the full-precision eig_dc slice
+        # (x64 mode) must keep the full-precision exact slice
         from raft_tpu.sparse.solver.lanczos import (LanczosConfig,
                                                     lanczos_compute_eigenpairs)
 
-        cfg = LanczosConfig(n_components=n_eig_vals, max_iterations=200,
+        # overshoot buffer: a few extra pairs give a boundary cluster
+        # room to surface all its copies before the selection cuts
+        k_solve = min(k + 4, n - 1)
+        cfg = LanczosConfig(n_components=k_solve, max_iterations=200,
                             tolerance=tol,
                             which="LA" if largest else "SA")
         w, v = lanczos_compute_eigenpairs(res, m, cfg)
-        order = jnp.argsort(w)          # ascending within the selection
-        return w[order], v[:, order]
-    w, v = eig_dc(res, m)
-    if largest:
-        return w[-n_eig_vals:], v[:, -n_eig_vals:]
-    return w[:n_eig_vals], v[:, :n_eig_vals]
+        order = jnp.argsort(w)          # ascending; slice the k requested
+        sel = order[-k:] if largest else order[:k]
+        w, v = w[sel], v[:, sel]
+        # --- verification (ADVICE r4 medium) -----------------------------
+        # residuals: one n×k MXU matmul, fetched with the values; the
+        # k×k Gram matrix checks the returned vectors are genuinely
+        # distinct directions (duplicate VALUES with orthogonal vectors
+        # are a correctly resolved multiplicity — not a failure).
+        # full-f32 precision pinned: at JAX DEFAULT a TPU matmul runs one
+        # bf16 pass, whose ~1e-3 noise would fail these checks spuriously
+        # and demote every call to the exact slice
+        with jax.default_matmul_precision("float32"):
+            resid = jnp.linalg.norm(m @ v - v * w[None, :], axis=0)
+            gram = v.T @ v
+        w_h = np.asarray(w, np.float64)
+        resid_h = np.asarray(resid, np.float64)
+        gram_h = np.asarray(gram, np.float64)
+        # operator-scale estimate: max |selected w| alone collapses for
+        # smallest-pair queries on matrices whose small eigenvalues sit
+        # near zero (the bound would demand absolute accuracy the f32
+        # matvec cannot deliver); ||A||_F / sqrt(n) <= ||A||_2 restores a
+        # spectrum-wide floor while staying a LOWER bound (conservative)
+        scale = max(float(np.abs(w_h).max(initial=0.0)),
+                    float(jnp.linalg.norm(m)) / float(np.sqrt(n)),
+                    float(np.finfo(np.float32).tiny))
+        sqrt_eps = float(np.sqrt(np.finfo(np.float32).eps))
+        resid_ok = resid_h.max(initial=0.0) <= max(10.0 * tol,
+                                                   sqrt_eps) * scale
+        offdiag = float(np.abs(gram_h - np.eye(k)).max()) if k > 1 else 0.0
+        ortho_ok = offdiag < 1e-3
+        if resid_ok and ortho_ok:
+            return w, v
+        logger.warn(
+            "eig_sel: iterative subset failed verification (max residual "
+            "%.3e, max Gram offdiag %.3e, scale %.3e) — falling back to "
+            "the exact eig_dc slice", float(resid_h.max(initial=0.0)),
+            offdiag, scale)
+    if (jnp.dtype(m.dtype) == jnp.dtype(jnp.float64)
+            and jax.default_backend() == "tpu"):
+        # f64-on-host parity fallback: TPU f64 is emulated; callers that
+        # pass f64 want the reference's exact contract (VERDICT r4 #8)
+        w_h, v_h = np.linalg.eigh(np.asarray(m))
+        if largest:
+            w_h, v_h = w_h[-k:], v_h[:, -k:]
+        else:
+            w_h, v_h = w_h[:k], v_h[:, :k]
+        return jnp.asarray(w_h), jnp.asarray(v_h)
+    return _eig_dc_slice(res, m, k, largest)

@@ -47,13 +47,27 @@ def _segment_spmv(row_ids, cols, data, x, n_rows: int, limit=None):
 # plan build (host packing) costs more than the gather it saves
 _GRID_MIN_NNZ = 1 << 18
 
+# pad-ratio acceptance bound for the auto grid upgrade (ADVICE r4):
+# packing pads a full 1024-slot tile whenever consecutive rows in a
+# shard's stream are >8 row-windows apart, so scattered patterns on tall
+# matrices can expand slots by orders of magnitude — ballooning device
+# memory and running slower than the segment path. The auto path builds
+# the plan once and accepts it only under this expansion.
+_GRID_MAX_PAD_RATIO = 8.0
 
-def spmv_method(a=None) -> str:
+
+def spmv_method(a=None, x=None) -> str:
     """Resolve the SpMV formulation. ``RAFT_TPU_SPMV`` ∈ {auto, grid, ell,
     segment} forces a path; ``auto`` picks the slot-grid Pallas plan
-    (grid_spmv.py) for large-nnz matrices on the compiled backend and the
+    (grid_spmv.py) for large-nnz matrices on the compiled backend —
+    subject to the plan's measured ``pad_ratio`` (≤ 8×) — and the
     ell/segment pair elsewhere. Returns the forced name, or "grid"/"auto"
-    for the auto decision."""
+    for the auto decision.
+
+    ``x`` (optional): the dense operand. The auto upgrade requires f32 on
+    BOTH sides — under ``jax_enable_x64`` a f64 operand promotes the
+    segment result to f64, and the grid plan (f32 compute) must not flip
+    the output dtype based on nnz crossing the threshold."""
     import os
 
     m = os.environ.get("RAFT_TPU_SPMV", "auto").lower()
@@ -69,12 +83,23 @@ def spmv_method(a=None) -> str:
         return "auto"   # plans are host-built; never auto-build under jit
     if jnp.dtype(a.data.dtype) != jnp.dtype(jnp.float32):
         return "auto"   # the grid plan computes in f32; keep f64 exact
+    if x is not None and jnp.dtype(jnp.asarray(x).dtype) != jnp.dtype(
+            jnp.float32):
+        return "auto"   # keep x64 promotion semantics on the segment path
     cached = getattr(a, "_spmv_auto_method", None)
     if cached is not None:
         return cached   # one device fetch per MATRIX, not per call
     nnz = int(np.asarray(a.indptr)[-1])
-    method = ("grid" if nnz >= _GRID_MIN_NNZ and not use_interpret()
-              else "auto")
+    method = "auto"
+    if nnz >= _GRID_MIN_NNZ and not use_interpret():
+        plan = _cached_plan(a)
+        if plan.pad_ratio <= _GRID_MAX_PAD_RATIO:
+            method = "grid"     # plan stays memoized for the apply
+        else:
+            try:                # reject: free the oversized grid arrays
+                del a._grid_plan
+            except AttributeError:
+                pass
     try:
         a._spmv_auto_method = method
     except AttributeError:
@@ -99,7 +124,7 @@ def spmv(a, x) -> jnp.ndarray:
         return grid_apply(a, x)
     if isinstance(a, ELLMatrix):
         return ell_spmv(a, x)
-    method = spmv_method(a)
+    method = spmv_method(a, x)
     if method == "grid":
         return grid_apply(_cached_plan(a), x)
     if method == "ell":
@@ -149,11 +174,18 @@ def spmm(a, b, alpha=1.0, beta=0.0, c=None) -> jnp.ndarray:
         out = grid_spmm(a, jnp.asarray(b))
     elif isinstance(a, ELLMatrix):
         out = ell_spmm(a, jnp.asarray(b))
-    elif spmv_method(a) == "grid":   # same plan cache as spmv
-        out = grid_spmm(_cached_plan(a), jnp.asarray(b))
     else:
-        out = _segment_spmm(a.row_ids(), a.indices, a.data,
-                            jnp.asarray(b), a.n_rows, limit=a.indptr[-1])
+        method = spmv_method(a, b)   # same dispatch vocabulary as spmv
+        if method == "grid":         # same plan cache as spmv
+            out = grid_spmm(_cached_plan(a), jnp.asarray(b))
+        elif method == "ell":        # forced RAFT_TPU_SPMV=ell: honor it
+            from raft_tpu.sparse.ell import from_csr
+
+            out = ell_spmm(from_csr(a), jnp.asarray(b))
+        else:
+            out = _segment_spmm(a.row_ids(), a.indices, a.data,
+                                jnp.asarray(b), a.n_rows,
+                                limit=a.indptr[-1])
     out = alpha * out
     if c is not None and beta != 0.0:
         out = out + beta * jnp.asarray(c)

@@ -208,12 +208,15 @@ def _eigsh_csr(csr, cfg: LanczosConfig, v0,
                     jnp.zeros((), dtype))
         use_dense = True
     elif method == "grid":
-        # slot-grid Pallas plan: build once per pattern, every restart
-        # reuses it (the cusparseSpMV_preprocess amortization of
-        # detail/lanczos.cuh:603)
-        from raft_tpu.sparse import grid_spmv
+        # slot-grid Pallas plan via the shared per-matrix cache: the auto
+        # decision in spmv_method has already built AND pad-ratio-gated
+        # the plan (ADVICE r4 — a scattered pattern whose slot grid blows
+        # past 8x nnz never reaches here on auto), so this reuses it; a
+        # forced RAFT_TPU_SPMV=grid builds through the same cache (the
+        # cusparseSpMV_preprocess amortization of detail/lanczos.cuh:603)
+        from raft_tpu.sparse.linalg import _cached_plan
 
-        mat_args = (grid_spmv.prepare(csr), jnp.zeros((), dtype),
+        mat_args = (_cached_plan(csr), jnp.zeros((), dtype),
                     jnp.zeros((), dtype))
         use_grid = True
     else:
@@ -316,6 +319,15 @@ def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype):
         t = np.zeros_like(t)
         t[np.arange(k), np.arange(k)] = ritz_vals
         border = beta_last * s[-1, :]           # couplings to residual row
+        # Soft locking (Stathopoulos): a pair whose residual is already
+        # below tol is an (numerically) exact invariant direction — zero
+        # its coupling so later restarts stop perturbing it, and the
+        # Krylov continuation explores only the orthogonal complement.
+        # This is what lets DEGENERATE eigenvalues resolve to their full
+        # multiplicity: once one copy is locked, the deflated operator's
+        # extremal value is the next copy, which plain Lanczos then finds
+        # as a separate Ritz pair (ADVICE r4 / VERDICT r4 #8).
+        border = np.where(np.abs(border) < cfg.tolerance, 0.0, border)
         t[:k, k] = border
         t[k, :k] = border
         # Extend from row k: the device loop's first step IS the Lanczos

@@ -189,6 +189,82 @@ class TestIntegration:
         with pytest.raises(ValueError):
             slinalg.spmv_method(csr)
 
+    def test_spmm_honors_forced_ell(self, monkeypatch):
+        # ADVICE r4: a forced RAFT_TPU_SPMV=ell must route spmm through
+        # the ELL slab formulation, not silently fall to segment —
+        # env-forced A/B comparisons must measure the path they name
+        monkeypatch.setenv("RAFT_TPU_SPMV", "ell")
+        rng = np.random.default_rng(22)
+        A = _random_csr(rng, 120, 90, 0.06)
+        csr = CSRMatrix.from_scipy(A)
+        from raft_tpu.sparse import linalg as slinalg
+
+        B = rng.normal(size=(90, 5)).astype(np.float32)
+        called = {}
+        from raft_tpu.sparse import ell as ell_mod
+
+        real_spmm = ell_mod.spmm
+
+        def spy(a, b):
+            called["ell"] = True
+            return real_spmm(a, b)
+
+        monkeypatch.setattr(ell_mod, "spmm", spy)
+        out = np.asarray(slinalg.spmm(csr, jnp.asarray(B)))
+        assert called.get("ell")
+        np.testing.assert_allclose(out, A @ B, rtol=2e-5, atol=2e-5)
+
+    def test_auto_grid_pad_ratio_gate(self, monkeypatch, request):
+        # ADVICE r4: the auto upgrade must reject a plan whose slot grid
+        # blows past the pad-ratio bound (scattered rows >8 windows apart
+        # pad a full 1024-slot tile per entry) and fall back to segment
+        from raft_tpu.sparse import linalg as slinalg
+        from raft_tpu.util.pallas_utils import use_interpret
+
+        monkeypatch.setattr(slinalg, "_GRID_MIN_NNZ", 32)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_INTERPRET", "0")
+        use_interpret.cache_clear()          # env change must be seen
+        request.addfinalizer(use_interpret.cache_clear)
+        n_rows = 200_000
+        rows = np.arange(64) * 3000          # 23 windows apart each
+        cols = np.arange(64) % 128
+        A = sp.csr_matrix((np.ones(64, np.float32), (rows, cols)),
+                          shape=(n_rows, 128))
+        csr = CSRMatrix.from_scipy(A)
+        assert slinalg.spmv_method(csr) == "auto"
+        assert getattr(csr, "_grid_plan", None) is None  # rejected → freed
+        # dense consecutive pattern: accepted, plan memoized
+        B = _random_csr(np.random.default_rng(5), 64, 128, 0.5)
+        csr2 = CSRMatrix.from_scipy(B)
+        assert slinalg.spmv_method(csr2) == "grid"
+        assert csr2._grid_plan is not None
+        assert csr2._grid_plan.pad_ratio <= slinalg._GRID_MAX_PAD_RATIO
+
+    def test_auto_grid_keeps_x64_promotion(self, monkeypatch, request):
+        # ADVICE r4: with f32 data and f64 x under x64, the result dtype
+        # must not flip to f32 because nnz crossed the grid threshold —
+        # the auto path requires f32 on both sides
+        from raft_tpu.sparse import linalg as slinalg
+        from raft_tpu.util.pallas_utils import use_interpret
+
+        monkeypatch.setattr(slinalg, "_GRID_MIN_NNZ", 16)
+        monkeypatch.setenv("RAFT_TPU_PALLAS_INTERPRET", "0")
+        use_interpret.cache_clear()          # env change must be seen
+        request.addfinalizer(use_interpret.cache_clear)
+        rng = np.random.default_rng(23)
+        A = _random_csr(rng, 100, 100, 0.08)
+        csr = CSRMatrix.from_scipy(A)
+        x64 = rng.normal(size=100)            # float64
+        prev = jax.config.jax_enable_x64
+        jax.config.update("jax_enable_x64", True)
+        try:
+            y = slinalg.spmv(csr, jnp.asarray(x64))
+            assert y.dtype == jnp.float64     # segment path, promoted
+            np.testing.assert_allclose(np.asarray(y), A @ x64,
+                                       rtol=1e-6, atol=1e-6)
+        finally:
+            jax.config.update("jax_enable_x64", prev)
+
     def test_eigsh_on_grid_matches_scipy(self, monkeypatch):
         import scipy.sparse.linalg as spla
 
