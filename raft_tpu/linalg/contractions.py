@@ -234,7 +234,7 @@ def _metric_tile_split(xh, xl, xn, yh, yl, yn, metric: str,
     raise ValueError(f"unknown metric {metric!r}")
 
 
-def _mask_argmin(d, n_valid: int):
+def _mask_argmin(d, n_valid: int, finite: bool = False):
     """Shared masking + fused argmin over a distance tile (see
     :func:`_distance_tile` for the tie rule and index-dtype rationale)."""
     col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
@@ -257,17 +257,24 @@ def _mask_argmin(d, n_valid: int):
     # gate: the smoke tier's test_fused_argmin[257-31-19] at this sha. NaN positions count as minimal (lax.argmin/numpy parity —
     # XLA reduce-min propagates NaN, so minval is NaN and only the NaN
     # columns survive the candidate mask).
-    cand = (d == minval) | (d != d)
+    # ``finite`` statically declares NaN-free distances (the Lloyd paths:
+    # k-means on non-finite data is undefined anyway) and skips the NaN
+    # candidate clause — two dead (tm, np_) VPU passes per tile on the
+    # epilogue-bound kernel (BASELINE.md roofline, r5 lever).
+    cand = d == minval
+    if not finite:
+        cand = cand | (d != d)
     sentinel = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
     arg = jnp.min(jnp.where(cand, col, sentinel), axis=1, keepdims=True)
     return col, minval, arg
 
 
 def _distance_tile_split(xh, xl, xn, yh, yl, yn, n_valid: int,
-                         metric: str = "l2", packed: bool = False):
+                         metric: str = "l2", packed: bool = False,
+                         finite: bool = False):
     return _mask_argmin(
         _metric_tile_split(xh, xl, xn, yh, yl, yn, metric, packed=packed),
-        n_valid)
+        n_valid, finite=finite)
 
 
 def _sq_norms(a):
@@ -636,7 +643,8 @@ def pairwise_unexpanded_pallas(x, y, metric: str, p: float = 2.0,
 # ---------------------------------------------------------------------------
 
 
-def _distance_tile(x, y, n_valid: int, metric: str = "l2"):
+def _distance_tile(x, y, n_valid: int, metric: str = "l2",
+                   finite: bool = False):
     """Masked metric tile + its per-row (min, argmin). Shapes:
     x (tm, kp), y (np_, kp) → col (tm, np_) column iota,
     minval (tm, 1), arg (tm, 1).
@@ -651,7 +659,8 @@ def _distance_tile(x, y, n_valid: int, metric: str = "l2"):
     (the value-then-key reduce op of the cuVS fused-distance lineage;
     note kvp.hpp's operator< itself orders key-then-value — it is the
     reduce op, not operator<, that defines the tie rule)."""
-    return _mask_argmin(_metric_tile(x, y, metric), n_valid)
+    return _mask_argmin(_metric_tile(x, y, metric), n_valid,
+                        finite=finite)
 
 
 def _fold_running_min(val_ref, idx_ref, minval, arg, offset):
@@ -950,7 +959,9 @@ def _lloyd_kernel(x_ref, y_ref, sums_ref, counts_ref, val_ref, idx_ref, *,
         counts_ref[:] = jnp.zeros_like(counts_ref)
 
     x = x_ref[:]
-    col, minval, arg = _distance_tile(x, y_ref[:], n_valid)
+    # finite=True: k-means on non-finite data is undefined — the NaN
+    # argmin clause is dead weight on the epilogue-bound kernel
+    col, minval, arg = _distance_tile(x, y_ref[:], n_valid, finite=True)
     val_ref[:] = jnp.maximum(minval, 0.0).T
     idx_ref[:] = arg.T
 
@@ -964,6 +975,8 @@ def _lloyd_kernel(x_ref, y_ref, sums_ref, counts_ref, val_ref, idx_ref, *,
     oh = oh.astype(jnp.float32)
     sums_ref[:] += _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32))
     counts_ref[:] += jnp.sum(oh, axis=0, keepdims=True)
+    # (counts ride the already-f32 one-hot here; the split kernel fuses
+    # its bf16→f32 convert into the reduce — see _lloyd_kernel_split)
 
 
 def _lloyd_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
@@ -979,7 +992,7 @@ def _lloyd_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
 
     col, minval, arg = _distance_tile_split(
         xh_ref[:], xl_ref[:], xn_ref[:].T, yh_ref[:], yl_ref[:],
-        yn_ref[:], n_valid, packed=packed)
+        yn_ref[:], n_valid, packed=packed, finite=True)
     val_ref[:] = jnp.maximum(minval, 0.0).T
     idx_ref[:] = arg.T
 
@@ -1005,7 +1018,10 @@ def _lloyd_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
                         + jnp.dot(ohb.T, xl_ref[:],
                                   preferred_element_type=f32,
                                   precision=_ONE_PASS))
-    counts_ref[:] += jnp.sum(ohb.astype(f32), axis=0, keepdims=True)
+    # convert-on-reduce: one fused pass (accumulate bf16 inputs into an
+    # f32 sum) instead of a full (tm, np_) astype pass + a reduce —
+    # counts <= tm are exact in f32
+    counts_ref[:] += jnp.sum(ohb, axis=0, keepdims=True, dtype=f32)
 
 
 @functools.partial(jax.jit,

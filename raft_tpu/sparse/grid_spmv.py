@@ -192,9 +192,17 @@ jax.tree_util.register_pytree_node(GridSpMV, _grid_flatten, _grid_unflatten)
 
 
 def prepare(csr, span_windows: int = SPAN_WINDOWS,
-            shard_w: int = SHARD_W) -> GridSpMV:
+            shard_w: int = SHARD_W, _collect: dict = None) -> GridSpMV:
     """Build the slot-grid plan from a CSRMatrix (host-side, once per
-    pattern — the cusparseSpMV_preprocess analogue)."""
+    pattern — the cusparseSpMV_preprocess analogue).
+
+    ``_collect`` (internal, used by sparse/solver/mst_grid.py): a dict
+    that receives host-side per-slot metadata the SpMV apply does not
+    need — ``eid`` (ntile, 8, 128) original-edge index per real slot
+    (-1 on pads), ``srow_local`` (ntile, 8, 128) row offset from the
+    tile's base window (0 on pads, < 1024 on real slots by the packer's
+    span contract), and ``edges`` = the (rows, cols, data) host triple
+    (so the caller need not re-expand the CSR)."""
     rows, cols, data = csr.host_edges()
     data = data.astype(np.float32)
     nnz_log = len(rows)
@@ -209,6 +217,7 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
     all_src_col: list = []        # per-slot column (shard-local), 0 pad
     all_src_data: list = []
     all_src_row: list = []        # per-slot row, -1 pad
+    all_src_eid: list = []        # per-slot original edge id, -1 pad
     all_bases: list = []
     chunk_shard: list = []
 
@@ -230,6 +239,10 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
         all_src_data.append(
             np.where(real, sdat[idx], 0).astype(np.float32))
         all_src_row.append(np.where(real, srow[idx], -1).astype(np.int32))
+        if _collect is not None:
+            orig = np.nonzero(m)[0].astype(np.int32)
+            all_src_eid.append(np.where(real, orig[idx], -1
+                                        ).astype(np.int32))
         all_bases.append(bases)
         chunk_shard.extend([s] * (npad // chunk_slots))
 
@@ -237,6 +250,7 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
         all_src_col = [np.zeros(chunk_slots, np.int32)]
         all_src_data = [np.zeros(chunk_slots, np.float32)]
         all_src_row = [np.full(chunk_slots, -1, np.int32)]
+        all_src_eid = [np.full(chunk_slots, -1, np.int32)]
         all_bases = [np.zeros(chunk_slots // TILE_SLOTS, np.int32)]
         chunk_shard = [0]
 
@@ -274,6 +288,18 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
     emit = np.full((n_tiles, TILE_SLOTS), -1, np.int32)
     emit[t_i, q] = (s_i * LANES + l_i).astype(np.int32)
     emit = emit.reshape(n_tiles, SUBROWS, LANES)
+
+    if _collect is not None:
+        eid_flat = np.concatenate(all_src_eid) if all_src_eid else \
+            np.full(n_slots, -1, np.int32)
+        real_flat = srow >= 0
+        srow_local = np.where(
+            real_flat,
+            srow - np.repeat(tile_base, TILE_SLOTS) * LANES, 0)
+        _collect["eid"] = eid_flat.reshape(n_tiles, SUBROWS, LANES)
+        _collect["srow_local"] = srow_local.astype(np.int32).reshape(
+            n_tiles, SUBROWS, LANES)
+        _collect["edges"] = (rows, cols, data)
 
     # --- tile ordering + visited masks for the window planes ---
     perm = np.argsort(tile_base, kind="stable").astype(np.int32)

@@ -52,6 +52,28 @@ class GraphCOO:
     n_edges: int
 
 
+def _merge_colors(colors, has_edge, other, cid, n: int):
+    """Merge supervertices by GATHER-ONLY pointer doubling (shared by the
+    XLA and grid Borůvka rounds).
+
+    The chosen-edge functional graph f(c) = other(c) has, under the
+    strict total order on undirected edges, EXACTLY ONE cycle per weak
+    component — the mutual 2-cycle at the component's minimum edge
+    (both endpoint colors of that edge pick it; any longer cycle would
+    need strictly decreasing minima around the loop). Forward chasing
+    therefore lands every color in its component's 2-cycle, and
+    min(f^K(c), f(f^K(c))) is a consistent component label. Doubling
+    f ← f∘f reaches K = 2^ceil(log2 n) ≥ any chain length in
+    ceil(log2 n) steps — each a dense V-gather, NO scatter (the r4
+    merge ran scatter-min + path-halving to a fixpoint; scatters
+    serialize on TPU, gathers don't — VERDICT r4 #5)."""
+    f0 = jnp.where(has_edge, other, cid)
+    steps = max(1, int(np.ceil(np.log2(max(n, 2)))))
+    fk = lax.fori_loop(0, steps, lambda _, f: f[f], f0)
+    r = jnp.minimum(fk, f0[fk])
+    return r[colors]
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def _boruvka_round(colors, src, dst, weights, n: int):
     """One Borůvka round, entirely on device.
@@ -95,38 +117,48 @@ def _boruvka_round(colors, src, dst, weights, n: int):
               & (my_a[other] == my_a) & (my_b[other] == my_b))
     include = has_edge & (~mutual | (cid < other))
 
-    # --- merge supervertices: scatter-min + path halving to fixpoint ---
-    lo = jnp.minimum(cid, other)
-    upd = jnp.where(has_edge, lo, _I32_MAX)
-    safe_other = jnp.where(has_edge, other, 0)
-    r0 = jnp.arange(n, dtype=jnp.int32)
-    r0 = r0.at[cid].min(upd)
-    r0 = r0.at[safe_other].min(upd)
-    r0 = jnp.minimum(r0, r0[r0])
-
-    def cond(state):
-        i, r, changed = state
-        # diameter-safe cap (see sparse/csr.py weak_cc): chosen-edge
-        # chains with adversarial color ids propagate one hop per round
-        return changed & (i < jnp.int32(n + 2))
-
-    def body(state):
-        i, r, _ = state
-        ra = r[cid]
-        rb = r[safe_other]
-        lo2 = jnp.minimum(ra, rb)
-        upd2 = jnp.where(has_edge, lo2, _I32_MAX)
-        nr = r.at[cid].min(upd2)
-        nr = nr.at[safe_other].min(upd2)
-        nr = jnp.minimum(nr, nr[nr])
-        return i + 1, nr, jnp.any(nr != r)
-
-    _, r, _ = lax.while_loop(cond, body, (jnp.int32(0), r0, jnp.bool_(True)))
-    new_colors = r[colors]
+    # --- merge supervertices (shared gather-only doubling) -------------
+    new_colors = _merge_colors(colors, has_edge, other, cid, n)
     # surviving cross-edge count under the NEW coloring: the driver's
     # compaction schedule (and its termination poll) read this scalar
     n_cross = jnp.sum(new_colors[src] != new_colors[dst])
     return new_colors, seg_e, include, n_cross
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _boruvka_round_grid(colors, mp, n: int):
+    """One Borůvka round with the Pallas E-stage (sparse/solver/
+    mst_grid.py): per-vertex winners from the slot-grid KVP scan, then a
+    V-sized per-color lexicographic cascade, mutual-pair dedup by rank
+    equality, and the gather-only pointer-doubling merge. Termination
+    signal: the number of included edges (zero ⟺ no cross edge exists —
+    any cross edge gives some color a winner, and a winner is included
+    unless it loses a mutual pair to a color that includes it)."""
+    from raft_tpu.sparse.solver.mst_grid import per_vertex_min_edge
+
+    vw, vr, ve = per_vertex_min_edge(mp, colors)
+    big = jnp.asarray(jnp.inf, vw.dtype)
+    cid = jnp.arange(n, dtype=jnp.int32)
+
+    # per-color lexicographic (w, rank, eid) cascade — V-sized (19x
+    # smaller than the r4 E-sized cascade at the BASELINE graph)
+    seg_w = jax.ops.segment_min(vw, colors, num_segments=n)
+    sel = vw == seg_w[colors]
+    r_m = jnp.where(sel, vr, _I32_MAX)
+    seg_r = jax.ops.segment_min(r_m, colors, num_segments=n)
+    sel &= vr == seg_r[colors]
+    e_m = jnp.where(sel, ve, _I32_MAX)
+    seg_e = jax.ops.segment_min(e_m, colors, num_segments=n)
+
+    has_edge = seg_w < big
+    safe_e = jnp.where(has_edge, seg_e, 0)
+    other = jnp.where(has_edge, colors[mp.dst[safe_e]], cid)
+    my_rank = jnp.where(has_edge, seg_r, -1)
+    mutual = has_edge & has_edge[other] & (my_rank[other] == my_rank)
+    include = has_edge & (~mutual | (cid < other))
+
+    new_colors = _merge_colors(colors, has_edge, other, cid, n)
+    return new_colors, seg_e, include, jnp.sum(include)
 
 
 @jax.jit
@@ -154,6 +186,72 @@ def _compact(colors, src, dst, weights, eids, out_size: int):
     return s2, d2, w2, e2
 
 
+# auto-dispatch threshold for the Pallas E-stage: below this the per-call
+# plan pack costs more than the XLA rounds it replaces
+_MST_GRID_MIN_NNZ = 1 << 18
+
+
+def _mst_method(csr) -> str:
+    """Resolve the Borůvka E-stage formulation. ``RAFT_TPU_MST`` ∈
+    {auto, grid, xla} forces a path; ``auto`` picks the slot-grid Pallas
+    E-stage (mst_grid.py) for large f32 graphs on the compiled backend,
+    subject to the plan's pad-ratio gate (same bound as SpMV's)."""
+    import os
+
+    m = os.environ.get("RAFT_TPU_MST", "auto").lower()
+    if m not in ("auto", "grid", "xla"):
+        raise ValueError(f"RAFT_TPU_MST must be auto|grid|xla, got {m}")
+    if m != "auto":
+        return m
+    from raft_tpu.sparse.linalg import _GRID_MAX_PAD_RATIO
+    from raft_tpu.util.pallas_utils import use_interpret
+
+    if use_interpret():
+        return "xla"
+    if jnp.dtype(csr.data.dtype) != jnp.dtype(jnp.float32):
+        return "xla"   # grid weights are f32; keep f64 ordering exact
+    if csr.logical_nnz() < _MST_GRID_MIN_NNZ:
+        return "xla"
+    if getattr(csr, "_mst_grid_reject", False):
+        return "xla"   # remember a pad-gate rejection — the O(E) pack
+                       # must not re-run per call just to re-decide
+    mp = _cached_mst_plan(csr)
+    if mp.plan.pad_ratio > _GRID_MAX_PAD_RATIO:
+        try:
+            del csr._mst_grid_plan
+            csr._mst_grid_reject = True
+        except AttributeError:
+            pass
+        return "xla"
+    return "grid"
+
+
+def _cached_mst_plan(csr):
+    mp = getattr(csr, "_mst_grid_plan", None)
+    if mp is None:
+        from raft_tpu.sparse.solver.mst_grid import prepare_mst
+
+        mp = prepare_mst(csr)
+        try:
+            csr._mst_grid_plan = mp
+        except AttributeError:
+            pass
+    return mp
+
+
+def _forest_output(src_h, dst_h, w_h, edge_mask,
+                   symmetrize_output: bool) -> GraphCOO:
+    ids = np.nonzero(np.asarray(edge_mask))[0]
+    s = np.asarray(src_h)[ids]
+    d = np.asarray(dst_h)[ids]
+    w = np.asarray(w_h)[ids]
+    if symmetrize_output:
+        s, d, w = (np.concatenate([s, d]), np.concatenate([d, s]),
+                   np.concatenate([w, w]))
+    return GraphCOO(jnp.asarray(s), jnp.asarray(d), jnp.asarray(w),
+                    int(s.shape[0]))
+
+
 def mst(res, csr: CSRMatrix, color: Optional[np.ndarray] = None,
         symmetrize_output: bool = True) -> GraphCOO:
     """MST/MSF of an undirected graph in CSR form
@@ -161,8 +259,30 @@ def mst(res, csr: CSRMatrix, color: Optional[np.ndarray] = None,
     in the reference's tests).
 
     Returns the forest as GraphCOO; `color` (if given, len V) is updated
-    in place with final supervertex labels."""
+    in place with final supervertex labels. Large f32 graphs on the
+    compiled backend run the Pallas slot-grid E-stage per round
+    (mst_grid.py, VERDICT r4 #5); ``RAFT_TPU_MST`` forces a path."""
     n = csr.n_rows
+    max_iters = max(1, int(np.ceil(np.log2(max(n, 2)))) + 1)
+    colors = jnp.arange(n, dtype=jnp.int32) if color is None \
+        else jnp.asarray(np.asarray(color, dtype=np.int32))
+
+    if _mst_method(csr) == "grid":
+        mp = _cached_mst_plan(csr)
+        edge_mask = jnp.zeros((mp.n_edges,), jnp.bool_)
+        eids = jnp.arange(mp.n_edges, dtype=jnp.int32)
+        for _ in range(max_iters):
+            colors, seg_e, include, n_incl = _boruvka_round_grid(
+                colors, mp, n)
+            count = int(n_incl)          # the round's single host poll
+            if count:
+                edge_mask = _accumulate(edge_mask, eids, seg_e, include)
+            else:
+                break
+        if color is not None:
+            color[:] = np.asarray(colors)
+        return _forest_output(mp.src, mp.dst, mp.weights, edge_mask,
+                              symmetrize_output)
     src = jnp.asarray(csr.row_ids(), dtype=jnp.int32)
     dst = jnp.asarray(csr.indices, dtype=jnp.int32)
     weights = jnp.asarray(csr.data)
@@ -177,11 +297,7 @@ def mst(res, csr: CSRMatrix, color: Optional[np.ndarray] = None,
                             jnp.asarray(np.inf, weights.dtype))
         dst = jnp.where(valid, dst, src)
 
-    colors = jnp.arange(n, dtype=jnp.int32) if color is None \
-        else jnp.asarray(np.asarray(color, dtype=np.int32))
-
     edge_mask = jnp.zeros((src.shape[0],), jnp.bool_)
-    max_iters = max(1, int(np.ceil(np.log2(max(n, 2)))) + 1)
 
     # Edge filtering (the standard Borůvka compaction, shaped for jit):
     # intra-component edges can never be chosen again, so once the
@@ -214,13 +330,6 @@ def mst(res, csr: CSRMatrix, color: Optional[np.ndarray] = None,
 
     if color is not None:
         color[:] = np.asarray(colors)
-
-    ids = np.nonzero(np.asarray(edge_mask))[0]
-    s = np.asarray(src0)[ids]          # edge_mask lives in ORIGINAL ids
-    d = np.asarray(dst0)[ids]
-    w = np.asarray(weights0)[ids]
-    if symmetrize_output:
-        s, d, w = (np.concatenate([s, d]), np.concatenate([d, s]),
-                   np.concatenate([w, w]))
-    return GraphCOO(jnp.asarray(s), jnp.asarray(d), jnp.asarray(w),
-                    int(s.shape[0]))
+    # edge_mask lives in ORIGINAL ids
+    return _forest_output(src0, dst0, weights0, edge_mask,
+                          symmetrize_output)

@@ -176,3 +176,28 @@ def test_grid_spmv_lowers_for_tpu():
     mod = exp.mlir_module()
     assert mod.count("tpu_custom_call") >= 3, \
         "expected all three grid-SpMV kernels to lower via Mosaic"
+
+
+def test_mst_grid_lowers_for_tpu():
+    """The Borůvka E-stage kernels (sparse/solver/mst_grid.py): the i32
+    replicated-shard gather, the segmented lexicographic (w, rank, eid)
+    min-scan with the own-window color gather, and the 24-plane KVP
+    window accumulation."""
+    import scipy.sparse as sp
+
+    from raft_tpu.core.sparse_types import CSRMatrix
+    from raft_tpu.sparse.solver.mst_grid import (per_vertex_min_edge,
+                                                 prepare_mst)
+
+    rng = np.random.default_rng(8)
+    dense = np.abs(rng.normal(size=(512, 512))).astype(np.float32)
+    dense[rng.uniform(size=dense.shape) > 0.03] = 0.0
+    adj = sp.csr_matrix(np.minimum(dense, dense.T))
+    adj.eliminate_zeros()
+    mp = prepare_mst(CSRMatrix.from_scipy(adj))
+    colors = jnp.arange(512, dtype=jnp.int32)
+    exp = jax.export.export(jax.jit(
+        lambda: per_vertex_min_edge(mp, colors)), platforms=("tpu",))()
+    mod = exp.mlir_module()
+    assert mod.count("tpu_custom_call") >= 3, \
+        "expected all three MST E-stage kernels to lower via Mosaic"

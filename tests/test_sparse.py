@@ -596,3 +596,102 @@ class TestMSTFuzz:
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
         n_comp = csgraph.connected_components(A, directed=False)[0]
         assert out.n_edges // 2 == n - n_comp
+
+
+class TestMSTGrid:
+    """The Pallas Borůvka E-stage (sparse/solver/mst_grid.py) against
+    scipy, forced via RAFT_TPU_MST=grid (the auto gate requires the
+    compiled backend + 2^18 nnz; the kernels run interpreted here)."""
+
+    @pytest.fixture(autouse=True)
+    def _force_grid(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_MST", "grid")
+
+    def _check(self, A, res=None):
+        from raft_tpu.sparse.solver.mst import mst as mst_fn
+
+        ref = csgraph.minimum_spanning_tree(A.astype(np.float64))
+        out = mst_fn(res, CSRMatrix.from_scipy(A),
+                     symmetrize_output=False)
+        got = float(np.asarray(out.weights).sum())
+        np.testing.assert_allclose(got, ref.sum(), rtol=1e-5, atol=1e-5)
+        assert ref.nnz == out.n_edges
+        return out
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_forest_vs_scipy(self, seed):
+        rng = np.random.default_rng(seed)
+        n = 250
+        d = np.abs(rng.normal(size=(n, n))).astype(np.float32) + 0.01
+        d[rng.uniform(size=(n, n)) > 0.03] = 0     # sparse → forest-y
+        A = sp.csr_matrix(np.minimum(d, d.T))
+        A.eliminate_zeros()
+        self._check(A)
+
+    def test_weight_ties_rank_order(self):
+        # every edge weight 1: the (w, rank, eid) order decides every
+        # pick — mutual pairs must dedup by rank equality exactly
+        rng = np.random.default_rng(3)
+        d = (rng.uniform(size=(200, 200)) < 0.05).astype(np.float32)
+        A = sp.csr_matrix(np.maximum(d, d.T))
+        A.setdiag(0)
+        A.eliminate_zeros()
+        self._check(A)
+
+    def test_path_graph_chain_depth(self):
+        # a long path maximizes Borůvka round count AND the pointer-
+        # doubling chain length; also exercises the cross-sub-row carry
+        # of the lexicographic scan (single-row runs span tiles)
+        rng = np.random.default_rng(4)
+        n = 900
+        i = np.arange(n - 1)
+        w = rng.uniform(1, 2, n - 1).astype(np.float32)
+        A = sp.csr_matrix(
+            (np.concatenate([w, w]),
+             (np.concatenate([i, i + 1]), np.concatenate([i + 1, i]))),
+            shape=(n, n))
+        self._check(A)
+
+    def test_hub_star(self):
+        # hub vertex: one long run chaining across many sub-rows/tiles
+        rng = np.random.default_rng(5)
+        n = 600
+        s = np.zeros(n - 1, np.int64)
+        t = np.arange(1, n)
+        w = rng.uniform(1, 2, n - 1).astype(np.float32)
+        A = sp.csr_matrix(
+            (np.concatenate([w, w]),
+             (np.concatenate([s, t]), np.concatenate([t, s]))),
+            shape=(n, n))
+        self._check(A)
+
+    def test_colors_output_and_components(self):
+        from raft_tpu.sparse.solver.mst import mst as mst_fn
+
+        rng = np.random.default_rng(6)
+        n = 150
+        d = np.abs(rng.normal(size=(n, n))).astype(np.float32) + 0.01
+        d[rng.uniform(size=(n, n)) > 0.04] = 0
+        A = sp.csr_matrix(np.minimum(d, d.T))
+        A.eliminate_zeros()
+        colors = np.arange(n, dtype=np.int32)
+        out = mst_fn(None, CSRMatrix.from_scipy(A), color=colors)
+        n_comp = csgraph.connected_components(A, directed=False)[0]
+        assert out.n_edges // 2 == n - n_comp
+        assert len(np.unique(colors)) == n_comp
+
+    def test_auto_dispatch_gate(self, monkeypatch):
+        # auto: interpret mode (CPU suite) must stay on the XLA path;
+        # forcing is what tests the kernels above
+        monkeypatch.setenv("RAFT_TPU_MST", "auto")
+        from raft_tpu.sparse.solver.mst import _mst_method
+
+        rng = np.random.default_rng(8)
+        d = np.abs(rng.normal(size=(64, 64))).astype(np.float32)
+        d[rng.uniform(size=(64, 64)) > 0.2] = 0
+        A = sp.csr_matrix(np.minimum(d, d.T))
+        A.eliminate_zeros()
+        assert _mst_method(CSRMatrix.from_scipy(A)) == "xla"
+        monkeypatch.setenv("RAFT_TPU_MST", "bogus")
+        with pytest.raises(ValueError):
+            _mst_method(CSRMatrix.from_scipy(A))
