@@ -485,6 +485,25 @@ def bench_spmv_large():
         run_case("sparse/spmv_grid", f_grid, x, flops=2 * nnz, nnz=nnz,
                  fmt="grid", pad_ratio=round(plan.pad_ratio, 3),
                  n_shards=plan.n_shards, build_ms=round(build_ms, 1)),
+        *_spmm_k16_rows(plan, rng, n, nnz),
+    ]
+
+
+def _spmm_k16_rows(plan, rng, n, nnz):
+    """k-batched fused SpMM vs the per-column loop at k=16 (VERDICT r4
+    #4 bar: fused >= 4x the column loop on chip). Same plan, same B."""
+    from raft_tpu.sparse import grid_spmv
+
+    k = 16
+    b = jnp.asarray(rng.random((n, k)).astype(np.float32))
+    f_fused = jax.jit(lambda bv: grid_spmv.spmm(plan, bv))
+    f_loop = jax.jit(lambda bv: jax.lax.map(
+        lambda col: grid_spmv._spmv_impl(plan, col), bv.T).T)
+    return [
+        run_case("sparse/spmm_k16_fused", f_fused, b, flops=2 * nnz * k,
+                 nnz=nnz, k=k, fmt="grid-kt"),
+        run_case("sparse/spmm_k16_colloop", f_loop, b, flops=2 * nnz * k,
+                 nnz=nnz, k=k, fmt="grid-colloop"),
     ]
 
 

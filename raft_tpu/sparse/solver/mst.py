@@ -52,6 +52,24 @@ class GraphCOO:
     n_edges: int
 
 
+def _seg_lex_min(lead, keys, seg_ids, n: int):
+    """Per-segment lexicographic minimum by cascade: ``lead`` (f32, inf
+    identity) is reduced first, then each int key in ``keys`` (int32,
+    _I32_MAX identity) refines among the survivors. Shared by the XLA
+    round's (w, a, b, eid) and the grid round's (w, rank, eid) selection
+    — ONE copy of the select-then-refine tie rule. Returns the reduced
+    lead plus each key's per-segment winner, in order."""
+    seg_lead = jax.ops.segment_min(lead, seg_ids, num_segments=n)
+    sel = lead == seg_lead[seg_ids]
+    outs = [seg_lead]
+    for key in keys:
+        masked = jnp.where(sel, key, _I32_MAX)
+        seg_k = jax.ops.segment_min(masked, seg_ids, num_segments=n)
+        sel &= key == seg_k[seg_ids]
+        outs.append(seg_k)
+    return outs
+
+
 def _merge_colors(colors, has_edge, other, cid, n: int):
     """Merge supervertices by GATHER-ONLY pointer doubling (shared by the
     XLA and grid Borůvka rounds).
@@ -91,21 +109,13 @@ def _boruvka_round(colors, src, dst, weights, n: int):
 
     # --- cheapest strict-total-order edge per color --------------------
     w = jnp.where(cross, weights, big)
-    seg_w = jax.ops.segment_min(w, cu, num_segments=n)
+    a_key = jnp.where(cross, jnp.minimum(src, dst), _I32_MAX)  # canonical
+    b_key = jnp.where(cross, jnp.maximum(src, dst), _I32_MAX)  # undirected
+    e_ids = jnp.where(cross, jnp.arange(src.shape[0], dtype=jnp.int32),
+                      _I32_MAX)
+    seg_w, seg_a, seg_b, seg_e = _seg_lex_min(
+        w, (a_key, b_key, e_ids), cu, n)
     has_edge = seg_w < big
-
-    a_key = jnp.minimum(src, dst)          # canonical undirected key, hi
-    b_key = jnp.maximum(src, dst)          # canonical undirected key, lo
-    sel = cross & (w == seg_w[cu])
-    a_m = jnp.where(sel, a_key, _I32_MAX)
-    seg_a = jax.ops.segment_min(a_m, cu, num_segments=n)
-    sel &= a_m == seg_a[cu]
-    b_m = jnp.where(sel, b_key, _I32_MAX)
-    seg_b = jax.ops.segment_min(b_m, cu, num_segments=n)
-    sel &= b_m == seg_b[cu]
-    e_ids = jnp.arange(src.shape[0], dtype=jnp.int32)
-    e_m = jnp.where(sel, e_ids, _I32_MAX)
-    seg_e = jax.ops.segment_min(e_m, cu, num_segments=n)
 
     safe_e = jnp.where(has_edge, seg_e, 0)
     other = jnp.where(has_edge, cv[safe_e], cid)       # partner color
@@ -142,14 +152,7 @@ def _boruvka_round_grid(colors, mp, n: int):
 
     # per-color lexicographic (w, rank, eid) cascade — V-sized (19x
     # smaller than the r4 E-sized cascade at the BASELINE graph)
-    seg_w = jax.ops.segment_min(vw, colors, num_segments=n)
-    sel = vw == seg_w[colors]
-    r_m = jnp.where(sel, vr, _I32_MAX)
-    seg_r = jax.ops.segment_min(r_m, colors, num_segments=n)
-    sel &= vr == seg_r[colors]
-    e_m = jnp.where(sel, ve, _I32_MAX)
-    seg_e = jax.ops.segment_min(e_m, colors, num_segments=n)
-
+    seg_w, seg_r, seg_e = _seg_lex_min(vw, (vr, ve), colors, n)
     has_edge = seg_w < big
     safe_e = jnp.where(has_edge, seg_e, 0)
     other = jnp.where(has_edge, colors[mp.dst[safe_e]], cid)

@@ -135,6 +135,36 @@ class TestGridSpMV:
         C = np.asarray(spmm(fmt, jnp.asarray(B)))
         np.testing.assert_allclose(C, A @ B, rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.parametrize("k", [1, 8, 13, 20])
+    def test_spmm_k_batched(self, k):
+        # the fused KT-group kernels across k < KT, k == KT, k spanning
+        # groups with a ragged tail, and the k == 1 SpMV fall-through;
+        # multi-shard so the chunk->tile 5-D view gets a tpc > 1 case
+        rng = np.random.default_rng(17)
+        A = _random_csr(rng, 350, 900, 0.04)
+        B = rng.normal(size=(900, k)).astype(np.float32)
+        fmt = prepare(CSRMatrix.from_scipy(A), shard_w=256)
+        assert fmt.n_shards == 4
+        C = np.asarray(spmm(fmt, jnp.asarray(B)))
+        np.testing.assert_allclose(C, A @ B, rtol=2e-5, atol=2e-5)
+
+    def test_spmm_k_batched_hub_pattern(self):
+        # hub rows/cols: long runs chain across sub-rows and tiles in
+        # every column of the group (the carry path per q)
+        rng = np.random.default_rng(18)
+        n = 600
+        r = np.concatenate([np.full(400, 37), rng.integers(0, n, 2000),
+                            np.full(300, 599)])
+        c = np.concatenate([rng.integers(0, n, 400), np.full(2000, 11),
+                            rng.integers(0, n, 300)])
+        d = rng.normal(size=r.size).astype(np.float32)
+        A = sp.csr_matrix((d, (r, c)), shape=(n, n))
+        A.sum_duplicates()
+        B = rng.normal(size=(n, 9)).astype(np.float32)
+        fmt = prepare(CSRMatrix.from_scipy(A))
+        C = np.asarray(spmm(fmt, jnp.asarray(B)))
+        np.testing.assert_allclose(C, A @ B, rtol=2e-4, atol=2e-4)
+
     def test_jit_and_pytree_surface(self):
         rng = np.random.default_rng(8)
         A = _random_csr(rng, 200, 200, 0.05)

@@ -201,3 +201,24 @@ def test_mst_grid_lowers_for_tpu():
     mod = exp.mlir_module()
     assert mod.count("tpu_custom_call") >= 3, \
         "expected all three MST E-stage kernels to lower via Mosaic"
+
+
+def test_spmm_kt_lowers_for_tpu():
+    """The k-batched SpMM kernels (grid_spmv.py KT group): the KT-column
+    chunk gather, the (ntile, KT)-grid scan reading the 5-D chunk view,
+    and the (nwp, KT, 128) plane accumulation."""
+    import scipy.sparse as sp
+
+    from raft_tpu.core.sparse_types import CSRMatrix
+    from raft_tpu.sparse.grid_spmv import prepare, spmm
+
+    rng = np.random.default_rng(9)
+    dense = rng.normal(size=(512, 700)).astype(np.float32)
+    dense[rng.uniform(size=dense.shape) > 0.03] = 0.0
+    fmt = prepare(CSRMatrix.from_scipy(sp.csr_matrix(dense)), shard_w=256)
+    b = jnp.asarray(rng.normal(size=(700, 12)), jnp.float32)
+    exp = jax.export.export(jax.jit(lambda: spmm(fmt, b)),
+                            platforms=("tpu",))()
+    mod = exp.mlir_module()
+    assert mod.count("tpu_custom_call") >= 3, \
+        "expected all three k-batched SpMM kernels to lower via Mosaic"
