@@ -49,13 +49,26 @@ def main():
     import jax
     print(f"# devices: {[d.device_kind for d in jax.devices()]}",
           file=sys.stderr)
+    import json
+
+    failed = False
     for name in names:
         try:
             for result in REGISTRY[name]():
                 print(result.json_line(), flush=True)
         except Exception as e:   # keep the sweep going, report the failure
+            # the error row goes to STDOUT as data and the exit code goes
+            # nonzero: the battery must never stamp family_done for a
+            # family that died (round 5: three Mosaic-crash families were
+            # silently skipped this way)
+            print(json.dumps({"bench": name, "error":
+                              f"{type(e).__name__}: {e}"[:500]}),
+                  flush=True)
             print(f"# {name} FAILED: {type(e).__name__}: {e}",
                   file=sys.stderr)
+            failed = True
+    if failed:
+        sys.exit(3)
 
 
 if __name__ == "__main__":

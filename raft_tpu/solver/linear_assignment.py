@@ -13,33 +13,45 @@ epsilon-scaling instead: each bidding round is
     winners = per-object scatter-max      (one scatter)
 
 — all fixed-shape vector work inside a single `lax.while_loop`, `vmap`-ed
-over the batch dimension. Both algorithms are O(n^3)-ish on dense costs; the
-auction's rounds are embarrassingly parallel, which is what the MXU/VPU
-want. Prices play the role of the Hungarian dual variables, so primal and
+over the batch dimension. The whole batched solve — every epsilon phase
+included — is ONE compiled device program (the reference likewise keeps its
+batch inside one state machine, linear_assignment.cuh:125): the epsilon
+schedule is *static* (eps_k = 0.5 / 5^k, clamped per batch lane to that
+lane's final epsilon), phases ride a `lax.scan`, and a lane whose clamp was
+reached skips the phase via a conditional assignment reset — so the only
+host synchronisation is the single convergence check after the program
+returns. Prices play the role of the Hungarian dual variables, so primal and
 dual objectives are available exactly as in the reference
 (`getPrimalObjectiveValue` / `getDualObjectiveValue`).
 
-The solution is optimal to within n*eps of the true minimum; for integer
-costs (or integral float costs) with final eps < 1/n it is exactly optimal
-(standard auction-algorithm guarantee).
+Accuracy contract (standard auction-algorithm guarantee): the returned
+assignment's total cost is within n*eps_final of the true minimum. For
+integral costs (including integer-valued floats) with eps_final < 1/n the
+result is *exactly* optimal. For arbitrary float costs choose
+eps_final < (suboptimality gap)/n for exactness; eps_final below
+~8 ulp of the cost spread is clamped (bids must move prices).
 """
 
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def _auction_phase(benefit, prices, n: int, eps, max_rounds):
+def _phase(benefit, prices, obj_of, person_of, eps, max_rounds):
     """Run one epsilon-phase to completion: all persons assigned.
 
-    benefit: [n, n] person x object payoff (maximization).
-    Returns (prices, obj_of_person, person_of_obj, rounds_used).
+    Unbatched: benefit [n, n] person x object payoff (maximization),
+    prices/obj_of/person_of [n], eps scalar. vmap adds the batch axis; a
+    lane that enters fully assigned performs no-op rounds (no unassigned
+    person -> every bid is -inf -> no winner -> state fixpoint), so mixed
+    convergence across vmapped lanes is safe.
     """
+    n = benefit.shape[0]
     neg_inf = jnp.asarray(-jnp.inf, benefit.dtype)
     person_ids = jnp.arange(n, dtype=jnp.int32)
     obj_ids = jnp.arange(n, dtype=jnp.int32)
@@ -79,50 +91,92 @@ def _auction_phase(benefit, prices, n: int, eps, max_rounds):
         prices = jnp.where(has_winner, best_bid, prices)
         return prices, obj_of, person_of, it + 1
 
-    init = (prices,
-            jnp.full((n,), -1, jnp.int32),
-            jnp.full((n,), -1, jnp.int32),
-            jnp.asarray(0, jnp.int32))
-    return jax.lax.while_loop(cond, body, init)
+    init = (prices, obj_of, person_of, jnp.asarray(0, jnp.int32))
+    out = jax.lax.while_loop(cond, body, init)
+    return out[0], out[1], out[2]
 
 
-def _solve_one(cost, eps_final: float, scaling_factor: float = 5.0):
-    """Auction with epsilon scaling on one [n, n] cost matrix.
+def _num_phases(dtype) -> int:
+    """Phases so the static schedule 0.5/5^k reaches the ulp clamp floor."""
+    floor = 8.0 * float(jnp.finfo(dtype).eps)
+    return max(1, math.ceil(math.log(0.5 / floor) / math.log(5.0))) + 1
 
-    Costs are normalised to unit spread before bidding (the auction is
-    invariant to positive scaling) so price increments never fall below the
-    dtype's ulp — without this, large-magnitude float32 costs with a tiny
-    epsilon stall the bidding and the phase exits unconverged. The scaled
-    epsilon is clamped to a few ulps for the same reason; for integer costs
-    this keeps exactness as long as epsilon < spread / n.
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _solve_batch(cost, eps_final, n_phases: int):
+    """All epsilon phases for the whole [B, n, n] batch, one device program.
+
+    Per-lane unit-spread normalisation (the auction is invariant to positive
+    scaling) keeps price increments above the dtype ulp; per-lane final
+    epsilon is max(eps_final/spread, 8 ulp). Returns
+    (obj_of [B,n], person_of [B,n], prices [B,n] back on the cost scale).
+    obj_of entries of -1 signal non-convergence (caller checks once).
     """
-    n = cost.shape[0]
-    if n == 1:
-        zero = jnp.zeros((1,), jnp.int32)
-        return zero, zero, jnp.zeros((1,), cost.dtype)
-    spread = float(jnp.max(cost) - jnp.min(cost))
-    if spread == 0.0:
-        ident = jnp.arange(n, dtype=jnp.int32)
-        return ident, ident, jnp.zeros((n,), cost.dtype)
-    benefit = -cost / spread                      # spread now exactly 1
-    ulp = float(jnp.finfo(cost.dtype).eps)
-    eps_last = max(eps_final / spread, 8.0 * ulp)
+    bsz, n, _ = cost.shape
+    dt = cost.dtype
+    cmax = jnp.max(cost, axis=(1, 2))
+    cmin = jnp.min(cost, axis=(1, 2))
+    spread = cmax - cmin                               # [B]
+    degenerate = spread == 0                           # constant cost lane
+    bad = ~jnp.isfinite(spread)                        # NaN/inf cost lane
+    safe = jnp.where(degenerate | bad, jnp.ones((), dt), spread)
+    # bad lanes bid on a neutral benefit (cheap, converges) and are forced
+    # back to -1 below so the caller's convergence check raises for them —
+    # an all-NaN benefit would instead spin every phase to max_rounds
+    benefit = jnp.where(bad[:, None, None], jnp.zeros((), dt),
+                        -cost / safe[:, None, None])   # spread now exactly 1
+    ulp = jnp.asarray(8.0 * float(jnp.finfo(dt).eps), dt)
+    eps_last = jnp.maximum(eps_final.astype(dt) / safe, ulp)   # [B]
     max_rounds = jnp.asarray(50 * n * max(1, int(np.log2(n + 1))), jnp.int32)
 
-    eps = max(0.5, eps_last)
-    prices = jnp.zeros((n,), cost.dtype)
-    while True:
-        prices, obj_of, person_of, _ = _auction_phase(
-            benefit, prices, n, jnp.asarray(eps, cost.dtype), max_rounds)
-        if eps <= eps_last:
-            break
-        eps = max(eps / scaling_factor, eps_last)
-    if bool(jnp.any(obj_of < 0)):
+    schedule = (0.5 / 5.0 ** np.arange(n_phases)).astype(np.float32)
+    vphase = jax.vmap(_phase, in_axes=(0, 0, 0, 0, 0, None))
+
+    def step(carry, eps_k):
+        prices, obj_of, person_of, eps_prev = carry
+        eps_b = jnp.maximum(jnp.asarray(eps_k, dt), eps_last)  # [B]
+        # a lane whose epsilon actually decreased restarts its assignment
+        # (standard scaling keeps only prices); a clamped lane keeps its
+        # converged assignment and the phase is a no-op for it
+        fresh = eps_b < eps_prev
+        obj_of = jnp.where(fresh[:, None], -1, obj_of)
+        person_of = jnp.where(fresh[:, None], -1, person_of)
+        prices, obj_of, person_of = vphase(
+            benefit, prices, obj_of, person_of, eps_b, max_rounds)
+        return (prices, obj_of, person_of, eps_b), None
+
+    init = (jnp.zeros((bsz, n), dt),
+            jnp.full((bsz, n), -1, jnp.int32),
+            jnp.full((bsz, n), -1, jnp.int32),
+            jnp.full((bsz,), jnp.inf, dt))
+    (prices, obj_of, person_of, _), _ = jax.lax.scan(
+        step, init, schedule)
+
+    ident = jnp.arange(n, dtype=jnp.int32)[None, :]
+    obj_of = jnp.where(degenerate[:, None], ident, obj_of)
+    obj_of = jnp.where(bad[:, None], -1, obj_of)       # raises at the caller
+    person_of = jnp.where(degenerate[:, None], ident, person_of)
+    prices = jnp.where(degenerate[:, None], jnp.zeros((), dt),
+                       prices * safe[:, None])
+    return obj_of, person_of, prices
+
+
+def _solve_many(cost, eps_final: float):
+    """Driver: one `_solve_batch` launch + one host convergence check."""
+    n = cost.shape[1]
+    if n == 1:
+        zero = jnp.zeros(cost.shape[:1] + (1,), jnp.int32)
+        return zero, zero, jnp.zeros_like(zero, cost.dtype)
+    obj_of, person_of, prices = _solve_batch(
+        cost, jnp.asarray(eps_final, cost.dtype), _num_phases(cost.dtype))
+    if bool(jnp.any(obj_of < 0)):                      # the only host sync
+        bad = np.nonzero(np.asarray(jnp.any(obj_of < 0, axis=1)))[0]
         raise RuntimeError(
-            "auction LAP did not converge (persons left unassigned after "
-            f"the final epsilon phase, eps={eps_last * spread:g}); "
-            "increase epsilon or check the cost matrix for NaN/inf")
-    return obj_of, person_of, prices * spread
+            "auction LAP did not converge for batch element(s) "
+            f"{bad.tolist()} (persons left unassigned after the final "
+            f"epsilon phase, eps_final={eps_final:g}); increase epsilon or "
+            "check the cost matrix for NaN/inf")
+    return obj_of, person_of, prices
 
 
 class LinearAssignmentProblem:
@@ -130,7 +184,10 @@ class LinearAssignmentProblem:
 
     solve() takes cost matrices [batchsize, size, size] (or [size, size])
     and computes row assignments (person -> object), column assignments
-    (object -> person) and primal/dual objective values.
+    (object -> person) and primal/dual objective values. The entire batch is
+    solved by one compiled device program (mirroring the reference's
+    one-state-machine batch, linear_assignment.cuh:125), not a per-element
+    host loop.
     """
 
     def __init__(self, res, size: int, batchsize: int = 1,
@@ -153,17 +210,8 @@ class LinearAssignmentProblem:
             raise ValueError(
                 f"expected cost shape {(self._batch, self._size, self._size)}"
                 f", got {cost.shape}")
-        obj_of = []
-        person_of = []
-        prices = []
-        for b in range(self._batch):
-            o, p, pr = _solve_one(cost[b], self._eps)
-            obj_of.append(o)
-            person_of.append(p)
-            prices.append(pr)
-        self._row_assign = jnp.stack(obj_of)
-        self._col_assign = jnp.stack(person_of)
-        self._col_duals = jnp.stack(prices)
+        self._row_assign, self._col_assign, self._col_duals = _solve_many(
+            cost, self._eps)
         # row duals: slack left to each person at final prices
         self._row_duals = jnp.max(-cost - self._col_duals[:, None, :],
                                   axis=2)
@@ -210,8 +258,8 @@ def solve_linear_assignment(res, cost_matrix, epsilon: float = 1e-6):
     lap = LinearAssignmentProblem(res, cost.shape[1], cost.shape[0],
                                   epsilon)
     rows, _ = lap.solve(cost)
-    totals = jnp.stack([lap.get_primal_objective_value(b)
-                        for b in range(cost.shape[0])])
+    totals = jnp.sum(jnp.take_along_axis(cost, rows[:, :, None],
+                                         axis=2)[:, :, 0], axis=1)
     if squeeze:
         return rows[0], totals[0]
     return rows, totals

@@ -530,14 +530,17 @@ KT = 8              # columns per fused pass (sublane-aligned)
 
 
 def _gather_kt_kernel(shard_ref, bt_ref, i_ref, o_ref):
-    """Gather KT B-columns for one chunk: the slot indices are fetched
-    once and reused for every column — 'gather once per pattern
-    position, broadcast across a k-tile of B lanes'."""
+    """Gather one B-column of the KT group for one chunk. The grid is
+    (nchunk, KT) with the slot-index block a function of the chunk only,
+    so Pallas keeps it resident across the KT steps — the indices are
+    fetched from HBM once per pattern position and reused for every
+    column ('gather once per pattern position, broadcast across a k-tile
+    of B lanes') while the per-step VMEM footprint stays at the SpMV
+    path's (one (SUBROWS, shard_w) plane, not KT of them)."""
     del shard_ref
     idx = i_ref[0]
-    for q in range(KT):
-        src = jnp.broadcast_to(bt_ref[q:q + 1, :], idx.shape)
-        o_ref[0, q] = _lane_gather(src, idx)
+    src = jnp.broadcast_to(bt_ref[0:1, :], idx.shape)
+    o_ref[0, 0] = _lane_gather(src, idx)
 
 
 def _segsum_kt_kernel(g_ref, d_ref, f_ref, e_ref, o_ref):
@@ -578,15 +581,16 @@ def _spmm_kt_impl(fmt: GridSpMV, bt):
 
     grid1 = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(nchunk,),
+        grid=(nchunk, KT),
         in_specs=[
-            pl.BlockSpec((KT, shard_w), lambda c, sh: (0, sh[c]),
+            pl.BlockSpec((1, shard_w), lambda c, q, sh: (q, sh[c]),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, SUBROWS, shard_w), lambda c, sh: (c, 0, 0),
+            pl.BlockSpec((1, SUBROWS, shard_w),
+                         lambda c, q, sh: (c, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, KT, SUBROWS, shard_w),
-                               lambda c, sh: (c, 0, 0, 0),
+        out_specs=pl.BlockSpec((1, 1, SUBROWS, shard_w),
+                               lambda c, q, sh: (c, q, 0, 0),
                                memory_space=pltpu.VMEM),
     )
     gathered = pallas_call(
@@ -594,7 +598,7 @@ def _spmm_kt_impl(fmt: GridSpMV, bt):
         out_shape=jax.ShapeDtypeStruct((nchunk, KT, SUBROWS, shard_w),
                                        jnp.float32),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+            dimension_semantics=("arbitrary", "arbitrary")),
     )(fmt.chunk_shard, bt, fmt.cols_grid)
 
     # free 5-D view: the (q, stream) chunk layout re-read per tile —

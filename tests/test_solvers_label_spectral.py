@@ -173,6 +173,89 @@ class TestLAP:
         np.testing.assert_array_equal(np.asarray(row), np.arange(n))
         assert float(total) == pytest.approx(n * 1.0)
 
+    @pytest.mark.parametrize("n,seed", [(32, 5), (64, 6), (96, 7)])
+    def test_exact_agreement_vs_scipy(self, res, n, seed):
+        """VERDICT r4 #9: exact agreement with scipy's Hungarian on float
+        costs when eps < spread/n^2 (the n*eps suboptimality bound then
+        falls below any realistic assignment gap)."""
+        from scipy.optimize import linear_sum_assignment
+
+        rng = np.random.default_rng(seed)
+        cost = rng.random((n, n)).astype(np.float32)
+        spread = float(cost.max() - cost.min())
+        eps = 0.5 * spread / n**2
+        row, total = solve_linear_assignment(res, cost, epsilon=eps)
+        ri, ci = linear_sum_assignment(cost.astype(np.float64))
+        exact = float(cost.astype(np.float64)[ri, ci].sum())
+        got = float(cost.astype(np.float64)[np.arange(n),
+                                            np.asarray(row)].sum())
+        assert got == pytest.approx(exact, abs=5e-5), (got, exact)
+
+    def test_batch_is_one_compiled_program(self, res):
+        """The batched solve must not retrace per element: one _solve_batch
+        trace covers the whole batch (VERDICT r4 weak #8: the old per-
+        element host loop serialized large batches)."""
+        import jax
+
+        from raft_tpu.solver import linear_assignment as la
+
+        rng = np.random.default_rng(9)
+        costs = rng.random((6, 12, 12)).astype(np.float32)
+        traces = []
+        orig = la._solve_batch.__wrapped__
+
+        def counting(cost, eps_final, n_phases):
+            traces.append(cost.shape)
+            return orig(cost, eps_final, n_phases)
+
+        counted = jax.jit(counting, static_argnums=(2,))
+        old = la._solve_batch
+        la._solve_batch = counted
+        try:
+            lap = LinearAssignmentProblem(res, 12, 6, epsilon=1e-4)
+            rows, cols = lap.solve(costs)
+        finally:
+            la._solve_batch = old
+        assert traces == [(6, 12, 12)]        # one trace, full batch
+        from scipy.optimize import linear_sum_assignment
+        for b in range(6):
+            ri, ci = linear_sum_assignment(costs[b])
+            got = float(lap.get_primal_objective_value(b))
+            assert got == pytest.approx(float(costs[b][ri, ci].sum()),
+                                        abs=1e-3)
+
+    def test_batch_mixed_spreads(self, res):
+        """Lanes with wildly different cost scales (and one constant lane)
+        share the static epsilon schedule via per-lane clamping."""
+        rng = np.random.default_rng(13)
+        n = 10
+        costs = np.stack([
+            rng.random((n, n)).astype(np.float32),          # spread ~1
+            rng.random((n, n)).astype(np.float32) * 1e6,    # huge spread
+            np.full((n, n), 7.0, np.float32),               # zero spread
+            rng.random((n, n)).astype(np.float32) * 1e-4,   # tiny spread
+        ])
+        lap = LinearAssignmentProblem(res, n, 4, epsilon=1e-6)
+        rows, cols = lap.solve(costs)
+        from scipy.optimize import linear_sum_assignment
+        for b in (0, 1, 3):
+            ri, ci = linear_sum_assignment(costs[b].astype(np.float64))
+            exact = float(costs[b].astype(np.float64)[ri, ci].sum())
+            got = float(lap.get_primal_objective_value(b))
+            assert got == pytest.approx(exact, rel=1e-5), b
+        # constant lane: identity assignment by convention
+        np.testing.assert_array_equal(np.asarray(rows[2]), np.arange(n))
+
+    def test_nan_costs_raise(self, res):
+        """A NaN cost lane must raise (not silently return identity), and
+        must not stall the program for max_rounds on an all-NaN benefit."""
+        rng = np.random.default_rng(17)
+        costs = rng.random((3, 8, 8)).astype(np.float32)
+        costs[1, 2, 3] = np.nan
+        lap = LinearAssignmentProblem(res, 8, 3, epsilon=1e-4)
+        with pytest.raises(RuntimeError, match="NaN/inf"):
+            lap.solve(costs)
+
 
 class TestSpectral:
     def test_partition_two_cliques(self, res):
