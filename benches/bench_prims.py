@@ -476,14 +476,17 @@ def bench_spmv_large():
         csr.row_ids(), csr.indices, csr.data, v, csr.n_rows,
         limit=csr.indptr[-1]))
     f_ell = jax.jit(lambda v: ell_spmv(ell, v))
-    f_grid = jax.jit(lambda v: grid_spmv.spmv(plan, v))
+    # the plan rides as a jit ARGUMENT, never a closure: closed-over plan
+    # arrays become HLO constants and the serialized compile request blows
+    # the tunnel's size cap (round-5 capture: HTTP 413 at 10M nnz)
+    f_grid = jax.jit(grid_spmv.spmv)
     return [
         run_case("sparse/spmv_csr_segment", f_csr, x, flops=2 * nnz,
                  nnz=nnz, fmt="csr"),
         run_case("sparse/spmv_ell_slab", f_ell, x, flops=2 * nnz,
                  nnz=nnz, fmt="ell", width=int(ell.width)),
-        run_case("sparse/spmv_grid", f_grid, x, flops=2 * nnz, nnz=nnz,
-                 fmt="grid", pad_ratio=round(plan.pad_ratio, 3),
+        run_case("sparse/spmv_grid", f_grid, plan, x, flops=2 * nnz,
+                 nnz=nnz, fmt="grid", pad_ratio=round(plan.pad_ratio, 3),
                  n_shards=plan.n_shards, build_ms=round(build_ms, 1)),
         *_spmm_k16_rows(plan, rng, n, nnz),
     ]
@@ -491,19 +494,20 @@ def bench_spmv_large():
 
 def _spmm_k16_rows(plan, rng, n, nnz):
     """k-batched fused SpMM vs the per-column loop at k=16 (VERDICT r4
-    #4 bar: fused >= 4x the column loop on chip). Same plan, same B."""
+    #4 bar: fused >= 4x the column loop on chip). Same plan, same B;
+    the plan is a jit argument in both (see the HTTP-413 note above)."""
     from raft_tpu.sparse import grid_spmv
 
     k = 16
     b = jnp.asarray(rng.random((n, k)).astype(np.float32))
-    f_fused = jax.jit(lambda bv: grid_spmv.spmm(plan, bv))
-    f_loop = jax.jit(lambda bv: jax.lax.map(
-        lambda col: grid_spmv._spmv_impl(plan, col), bv.T).T)
+    f_fused = jax.jit(grid_spmv.spmm)
+    f_loop = jax.jit(lambda p, bv: jax.lax.map(
+        lambda col: grid_spmv._spmv_impl(p, col), bv.T).T)
     return [
-        run_case("sparse/spmm_k16_fused", f_fused, b, flops=2 * nnz * k,
-                 nnz=nnz, k=k, fmt="grid-kt"),
-        run_case("sparse/spmm_k16_colloop", f_loop, b, flops=2 * nnz * k,
-                 nnz=nnz, k=k, fmt="grid-colloop"),
+        run_case("sparse/spmm_k16_fused", f_fused, plan, b,
+                 flops=2 * nnz * k, nnz=nnz, k=k, fmt="grid-kt"),
+        run_case("sparse/spmm_k16_colloop", f_loop, plan, b,
+                 flops=2 * nnz * k, nnz=nnz, k=k, fmt="grid-colloop"),
     ]
 
 

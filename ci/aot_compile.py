@@ -1,0 +1,56 @@
+"""Deviceless TPU AOT compile harness (round 5).
+
+The axon terminal's compile helper is chipless — and so is libtpu's own
+AOT path, reachable locally via a v5e TopologyDescription. That gives a
+Mosaic-compile repro loop that NEVER touches the tunnel (safe to run
+while the chip is busy) and catches the class of failure jax.export
+lowering cannot: VectorLayoutInferer crashes, 'Not implemented' Mosaic
+rejections, VMEM overflows.
+
+Usage:
+    from ci.aot_compile import tpu_aot_compile
+    tpu_aot_compile(fn, arg_struct_or_array, ...)   # raises on failure
+
+Run under:  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+(plus TPU_SKIP_MDS_QUERY=1 TPU_ACCELERATOR_TYPE=v5litepod-1 to quiet
+libtpu's metadata probing; set automatically when imported as a main
+harness via ci/probe_mosaic.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(None)
+def _topology():
+    from jax.experimental import topologies
+
+    return topologies.get_topology_desc(
+        "v5e:1x1x1", "tpu",
+        chips_per_host_bounds=[1, 1, 1], wrap=[False, False, False])
+
+
+@functools.lru_cache(None)
+def _sharding():
+    return jax.sharding.SingleDeviceSharding(_topology().devices[0])
+
+
+def tpu_struct(shape, dtype=jnp.float32):
+    """ShapeDtypeStruct pinned to the abstract v5e device."""
+    return jax.ShapeDtypeStruct(shape, dtype, sharding=_sharding())
+
+
+def tpu_aot_compile(fn, *args):
+    """Compile fn for v5e (deviceless). args: arrays or (shape, dtype)
+    tuples. Returns the Compiled object; raises on Mosaic failure."""
+    structs = []
+    for a in args:
+        if isinstance(a, tuple):
+            structs.append(tpu_struct(*a))
+        else:
+            structs.append(tpu_struct(jnp.shape(a), a.dtype))
+    return jax.jit(fn).lower(*structs).compile()

@@ -1,0 +1,149 @@
+"""Compile-only Mosaic capability probes against the local v5e AOT
+toolchain (ci/aot_compile.py — chipless, tunnel-free).
+
+Answers:
+  1. dynamic_gather legality envelope: lane widths, sublane-dim gather,
+     in-vreg 2-D gather, the select-tree fallback.
+  2. which radix_select_k shapes crash VectorLayoutInferer (the
+     matrix/select_k battery family SIGABRT at len 8192).
+  3. grid_spmv kernel legality at several shard widths.
+
+Each probe compiles in a SUBPROCESS so a compiler SIGABRT is one line of
+output, not the end of the probe run.
+
+Run:  python ci/probe_mosaic.py [probe ...]
+(handles its own env scrubbing for the subprocesses)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+HEADER = """
+import jax, jax.numpy as jnp
+import sys
+sys.path.insert(0, %r)
+from ci.aot_compile import tpu_aot_compile, tpu_struct
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GATHER_KERN = """
+def kern(x_ref, i_ref, o_ref):
+    o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=%d)
+def run(x, i):
+    return pl.pallas_call(kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((%d, %d), jnp.float32))(x, i)
+tpu_aot_compile(run, ((%d, %d), jnp.float32), ((%d, %d), jnp.int32))
+print("PROBE_OK")
+"""
+
+
+def gather_probe(rows, cols, axis):
+    return HEADER + GATHER_KERN % (axis, rows, cols, rows, cols, rows,
+                                   cols)
+
+
+PROBES = {
+    "dg_lane_8x128": gather_probe(8, 128, 1),
+    "dg_lane_8x256": gather_probe(8, 256, 1),
+    "dg_lane_8x512": gather_probe(8, 512, 1),
+    "dg_lane_32x128": gather_probe(32, 128, 1),
+    "dg_sublane_8x128": gather_probe(8, 128, 0),
+    "dg_sublane_32x128": gather_probe(32, 128, 0),
+    "tree_gather_1024": HEADER + """
+def kern(x_ref, i_ref, o_ref):
+    idx = i_ref[:]
+    hi = idx >> 7
+    lo = idx & 127
+    acc = jnp.zeros((8, 128), jnp.float32)
+    for v in range(8):
+        row = x_ref[v, :].reshape(1, 128)
+        src = jnp.broadcast_to(row, (8, 128))
+        g = jnp.take_along_axis(src, lo, axis=1)
+        acc = acc + jnp.where(hi == v, g, 0.0)
+    o_ref[:] = acc
+def run(x, i):
+    return pl.pallas_call(kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(x, i)
+tpu_aot_compile(run, ((8, 128), jnp.float32), ((8, 128), jnp.int32))
+print("PROBE_OK")
+""",
+    # two-step sublane-then-lane composition (separable 2-D gather)
+    "dg_compose_8x128": HEADER + """
+def kern(x_ref, si_ref, li_ref, o_ref):
+    g = jnp.take_along_axis(x_ref[:], si_ref[:], axis=0)
+    o_ref[:] = jnp.take_along_axis(g, li_ref[:], axis=1)
+def run(x, si, li):
+    return pl.pallas_call(kern,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(x, si, li)
+tpu_aot_compile(run, ((8, 128), jnp.float32), ((8, 128), jnp.int32),
+                ((8, 128), jnp.int32))
+print("PROBE_OK")
+""",
+    "radix_8192_k16": HEADER + """
+import functools
+from raft_tpu.matrix import radix_select
+f = functools.partial(radix_select.radix_select_k, k=16, select_min=True)
+tpu_aot_compile(f, ((8192, 8192), jnp.float32))
+print("PROBE_OK")
+""",
+    "radix_65536_k256": HEADER + """
+import functools
+from raft_tpu.matrix import radix_select
+f = functools.partial(radix_select.radix_select_k, k=256, select_min=True)
+tpu_aot_compile(f, ((64, 65536), jnp.float32))
+print("PROBE_OK")
+""",
+    "radix_1M_k16": HEADER + """
+import functools
+from raft_tpu.matrix import radix_select
+f = functools.partial(radix_select.radix_select_k, k=16, select_min=True)
+tpu_aot_compile(f, ((64, 1048576), jnp.float32))
+print("PROBE_OK")
+""",
+}
+
+
+def run_probe(name):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPU_SKIP_MDS_QUERY"] = "1"
+    env["TPU_ACCELERATOR_TYPE"] = "v5litepod-1"
+    env["RAFT_TPU_PALLAS_INTERPRET"] = "0"
+    r = subprocess.run([sys.executable, "-c", PROBES[name]],
+                       capture_output=True, text=True, timeout=600,
+                       env=env)
+    ok = r.returncode == 0 and "PROBE_OK" in (r.stdout or "")
+    if ok:
+        print(json.dumps({"probe": name, "ok": True}), flush=True)
+        return True
+    key = ""
+    for line in (r.stderr or "").splitlines():
+        if ("Not implemented" in line or "Check failed" in line
+                or "NotImplementedError" in line
+                or "INTERNAL" in line or "RET_CHECK" in line):
+            key = line.strip()[:300]
+            break
+    print(json.dumps({"probe": name, "ok": False, "rc": r.returncode,
+                      "key": key,
+                      "tail": "" if key else (r.stderr or "")[-1200:]}),
+          flush=True)
+    return False
+
+
+if __name__ == "__main__":
+    for nm in (sys.argv[1:] or list(PROBES)):
+        run_probe(nm)

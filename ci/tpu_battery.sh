@@ -162,8 +162,9 @@ for fam in $PRIORITY $REST; do
     # budget — in round 2 these were exactly the ones rc=124'd
     case "$fam" in
         sparse/lanczos|sparse/mst|sparse/spmv_large|\
-        matrix/select_k_large|matrix/select_k|neighbors/brute_force)
-            BUDGET=900 ;;
+        matrix/select_k_large|matrix/select_k|neighbors/brute_force|\
+        cluster/kmeans_iter)
+            BUDGET=900 ;;   # kmeans_iter rc=124'd at 420 in round 5
         *)  BUDGET=420 ;;
     esac
     echo "[battery] run $fam (budget ${BUDGET}s) $(date +%H:%M:%S)"

@@ -168,14 +168,31 @@ def _threshold_kernel(key_ref, t_ref, ntie_ref, *, k: int):
     INT32_MAX; probes only reach INT32_MAX where the answer is forced
     (count >= k trivially), so the padding never biases a decision."""
     kk = jnp.float32(k)
+    tm = t_ref.shape[0]
+    blk = key_ref.shape                  # (tm, ls, 128)
 
     def count_le(t):
-        # t (tm, 1, 1); re-read the block per call: keeps its live range
-        # inside one loop iteration instead of spanning the fori_loop
-        return jnp.sum((key_ref[:] <= t).astype(jnp.float32),
-                       axis=(1, 2), keepdims=True)
+        # t (tm, 1) — broadcast_in_dim, NOT a reshape: a (tm,) -> (tm,1,1)
+        # reshape crashes Mosaic's VectorLayoutInferer for tm > 1
+        # ("arr.size() >= layout_rank(implicit_dim)", layout.h:320; round-5
+        # deviceless-AOT bisect), so every intermediate here stays rank-2
+        # and the block compare broadcasts the rank-2 threshold directly.
+        # Re-read the block per call: keeps its live range inside one loop
+        # iteration instead of spanning the fori_loop.
+        if tm == 1:
+            # the MAX_LEN single-row block: rank-3 reductions with a unit
+            # leading dim leave implicit-dim layouts Mosaic rejects either
+            # way it is reduced; drop to 2-D by reading off the unit dim
+            tb = jax.lax.broadcast_in_dim(t, blk[1:], (0, 1))
+            m = (key_ref[0] <= tb).astype(jnp.float32)     # (ls, 128)
+            c2 = jnp.sum(m, axis=0, keepdims=True)         # (1, 128)
+        else:
+            tb = jax.lax.broadcast_in_dim(t, blk, (0, 1))
+            m = (key_ref[:] <= tb).astype(jnp.float32)
+            c2 = jnp.sum(m, axis=2)                        # (tm, ls)
+        return jnp.sum(c2, axis=1, keepdims=True)          # (tm, 1)
 
-    neg = count_le(jnp.full(t_ref.shape, -1, jnp.int32))
+    neg = count_le(jnp.full((tm, 1), -1, jnp.int32))
     prefix = jnp.where(neg >= kk, jnp.int32(_I32_MIN), jnp.int32(0))
 
     # The probed bit rides in the CARRY (2^30 halving each step) instead
@@ -195,8 +212,13 @@ def _threshold_kernel(key_ref, t_ref, ntie_ref, *, k: int):
     # count(key < T) — at T = INT32_MIN nothing is below
     c_less = jnp.where(t == jnp.int32(_I32_MIN), jnp.float32(0.0),
                        count_le(t - jnp.int32(1)))
-    t_ref[:] = t
-    ntie_ref[:] = jnp.int32(k) - c_less.astype(jnp.int32)
+    # stores via broadcast_in_dim to the (tm, 1, 1) refs — the 3-D ref
+    # shape is the only BlockSpec legal at every tm (trailing dims must
+    # be (8,128)-divisible or equal the array's), and broadcast avoids
+    # the rank-changing reshape that crashes the layout inferer
+    t_ref[:] = jax.lax.broadcast_in_dim(t, (tm, 1, 1), (0, 1))
+    ntie = jnp.int32(k) - c_less.astype(jnp.int32)
+    ntie_ref[:] = jax.lax.broadcast_in_dim(ntie, (tm, 1, 1), (0, 1))
 
 
 def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
@@ -234,7 +256,12 @@ def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
     tri = (ci < cj).astype(jnp.bfloat16)               # tri[c', c] = c' < c
     masks = jnp.concatenate(
         [strict.astype(jnp.bfloat16), tie.astype(jnp.bfloat16)], axis=0)
-    excl = jnp.dot(masks, tri, preferred_element_type=jnp.float32)
+    # precision pinned: bf16 x bf16 -> f32 is exact at DEFAULT, and an
+    # ambient jax_default_matmul_precision of HIGH (set by knn's
+    # with_matmul_precision scope) would otherwise ride into this dot —
+    # Mosaic rejects Precision.HIGH on kernel dots
+    excl = jnp.dot(masks, tri, preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.DEFAULT)
     excl_strict = excl[:tm].astype(jnp.int32)          # (tm, tl)
     excl_tie = excl[tm:].astype(jnp.int32)
 
@@ -269,7 +296,8 @@ def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
     ohlo = (lo[:, :, None] == iota_l).astype(jnp.bfloat16)  # (tm, tl, 128)
     slabs = jax.lax.dot_general(
         a, ohlo, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)            # (tm, 3kh, 128)
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)           # (tm, 3kh, 128)
     slab = (slabs[:, :kh] + slabs[:, kh:2 * kh] + slabs[:, 2 * kh:]
             ).reshape(tm, kh * 128)
     out_ref[:] += slab
@@ -328,7 +356,11 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
         out_shape=[out_struct((rp, 1, 1), jnp.int32, vma),
                    out_struct((rp, 1, 1), jnp.int32, vma)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+            dimension_semantics=("arbitrary",),
+            # the count intermediates at the VMEM-filling tm_a sit just
+            # over the default 16M scoped budget (16.87M observed at
+            # tm_a=64, lp=8192 — round-5 deviceless AOT)
+            vmem_limit_bytes=32 * 1024 * 1024),
     )(kpad.reshape(rp, ls, 128))
     t = t3.reshape(rp, 1)
     ntie = ntie3.reshape(rp, 1)

@@ -9,11 +9,17 @@ scatter both serialize through XLA's generic element-at-a-time paths.
 
 This module replaces both sides with Mosaic-expressible structure:
 
-* **Gather** — Mosaic's vector gather (``tpu.dynamic_gather``) requires
-  same-shape source/index operands, so x is tiled into column shards of
-  65536, replicated across the 8 sublanes, and each kernel-1 grid step
-  gathers 8x65536 slots from its shard in ONE ``take_along_axis``: no
-  per-element address generation, no XLA gather.
+* **Gather** — Mosaic's vector gather (``tpu.dynamic_gather``) is
+  LANE-LOCAL: the source may span at most one vreg (128 lanes) along the
+  gather dimension ("Multiple source vregs along gather dimension", round-5
+  hardware capture; the round-3 width-128 probe did not generalize).  So x
+  is tiled into column shards of SHARD_W=8192 held as a (64, 128) VMEM
+  block, and kernel 1 gathers each slot tile through a ROW-BROADCAST SELECT
+  TREE: for each of the 64 shard rows, broadcast the row across the block's
+  sublanes, one legal 128-wide ``take_along_axis`` on the low 7 index bits,
+  and a mask-accumulate where the high bits match the row.  Tiles are
+  packed per shard in groups of GROUP_TILES so one grid step amortizes the
+  tree over GROUP_TILES*1024 slots with the shard block resident.
 * **Scatter** — there is no scatter on TPU.  Entries are packed (host-side,
   once per sparsity pattern — the analogue of cusparseSpMV_preprocess) into
   a (tile, sub-row, lane) grid in CSR row order, so each row's products are
@@ -56,7 +62,12 @@ LANES = 128
 SUBROWS = 8
 TILE_SLOTS = LANES * SUBROWS          # 1024
 SPAN_WINDOWS = 8                      # emission range: 8 x 128 rows per tile
-SHARD_W = 65536                       # columns per x shard (VMEM-sized)
+SHARD_W = 8192                        # columns per x shard: the gather
+                                      # tree walks shard_w/128 = 64 rows,
+                                      # the VPU cost per slot of the
+                                      # Mosaic-legal lane-local gather
+GROUP_TILES = 8                       # tiles per kernel-1 grid step (one
+                                      # shard per group; pad granularity)
 
 _F_CONT = 1                           # slot continues the run from lane-1
 _F_REAL = 2                           # slot holds a real entry
@@ -142,20 +153,26 @@ class GridSpMV:
     """
 
     def __init__(self, *, cols_grid, data_grid, flags_grid, emit_grid,
-                 chunk_shard, tile_base, perm_sorted, base_sorted,
-                 visited, shape, nnz, n_shards, pad_ratio):
-        self.cols_grid = cols_grid        # (nchunk, SUBROWS, SHARD_W) i32
+                 group_shard, tile_base, perm_sorted, base_sorted,
+                 visited, shape, nnz, n_shards, shard_w, pad_ratio):
+        self.cols_grid = cols_grid        # (ntile, 8, 128) i32 shard-local
         self.data_grid = data_grid        # (ntile, 8, 128) f32
         self.flags_grid = flags_grid      # (ntile, 8, 128) i32
         self.emit_grid = emit_grid        # (ntile, 8, 128) i32, -1 = none
-        self.chunk_shard = chunk_shard    # (nchunk,) i32
+        self.group_shard = group_shard    # (ntile//GROUP_TILES,) i32
         self.tile_base = tile_base        # (ntile,) i32 (build order)
         self.perm_sorted = perm_sorted    # (ntile,) i32: tiles by base
         self.base_sorted = base_sorted    # (ntile,) i32
         self.visited = visited            # (8, NWP) bool (host constant)
+        # flatten aux cached once: _grid_flatten runs on EVERY dispatch
+        # when the plan is a jit argument (the supported pattern — see
+        # the HTTP-413 note in benches), and tobytes() would otherwise
+        # copy+hash ~n_rows/16 bytes per call
+        self._vis_aux = (visited.tobytes(), visited.shape)
         self.shape = shape
         self.nnz = nnz                    # logical nnz packed
         self.n_shards = n_shards
+        self.shard_w = shard_w            # columns per x shard (static)
         self.pad_ratio = pad_ratio        # slots / nnz (build diagnostic)
 
     @property
@@ -172,19 +189,21 @@ class GridSpMV:
 
 def _grid_flatten(g: GridSpMV):
     leaves = (g.cols_grid, g.data_grid, g.flags_grid, g.emit_grid,
-              g.chunk_shard, g.tile_base, g.perm_sorted, g.base_sorted)
-    aux = (g.visited.tobytes(), g.visited.shape, g.shape, g.nnz,
-           g.n_shards, g.pad_ratio)
+              g.group_shard, g.tile_base, g.perm_sorted, g.base_sorted)
+    aux = (g._vis_aux, g.shape, g.nnz,
+           g.n_shards, g.shard_w, g.pad_ratio)
     return leaves, aux
 
 
 def _grid_unflatten(aux, leaves):
-    vis_bytes, vis_shape, shape, nnz, n_shards, pad_ratio = aux
+    vis_aux, shape, nnz, n_shards, shard_w, pad_ratio = aux
     g = GridSpMV.__new__(GridSpMV)
     (g.cols_grid, g.data_grid, g.flags_grid, g.emit_grid,
-     g.chunk_shard, g.tile_base, g.perm_sorted, g.base_sorted) = leaves
-    g.visited = np.frombuffer(vis_bytes, np.bool_).reshape(vis_shape)
-    g.shape, g.nnz, g.n_shards, g.pad_ratio = shape, nnz, n_shards, pad_ratio
+     g.group_shard, g.tile_base, g.perm_sorted, g.base_sorted) = leaves
+    g.visited = np.frombuffer(vis_aux[0], np.bool_).reshape(vis_aux[1])
+    g._vis_aux = vis_aux
+    g.shape, g.nnz, g.n_shards, g.shard_w, g.pad_ratio = (
+        shape, nnz, n_shards, shard_w, pad_ratio)
     return g
 
 
@@ -208,18 +227,19 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
     nnz_log = len(rows)
     n_rows, n_cols = csr.shape
 
-    # a chunk is SUBROWS * shard_w slots — shrink the shard to the matrix
-    # so small patterns don't pad up to the 64K-column chunk minimum
+    # shrink the shard to the matrix so small patterns don't pad up to
+    # the full shard width; a kernel-1 group is GROUP_TILES tiles drawing
+    # from ONE shard, so per-shard streams pad to group granularity
     shard_w = min(shard_w, round_up_to_multiple(max(n_cols, 1), 128))
     n_shards = max(1, cdiv(n_cols, shard_w))
-    chunk_slots = SUBROWS * shard_w
+    group_slots = GROUP_TILES * TILE_SLOTS
 
     all_src_col: list = []        # per-slot column (shard-local), 0 pad
     all_src_data: list = []
     all_src_row: list = []        # per-slot row, -1 pad
     all_src_eid: list = []        # per-slot original edge id, -1 pad
     all_bases: list = []
-    chunk_shard: list = []
+    group_shard: list = []
 
     for s in range(n_shards):
         m = (cols >= s * shard_w) & (cols < (s + 1) * shard_w)
@@ -227,10 +247,10 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
         if len(srow) == 0:
             continue
         slot_src, bases = _pack(srow, span_windows)
-        # pad the shard's slot stream to a kernel-1 chunk multiple; pad
+        # pad the shard's slot stream to a kernel-1 group multiple; pad
         # tiles carry base 0 and no real slots
         n = len(slot_src)
-        npad = round_up_to_multiple(n, chunk_slots)
+        npad = round_up_to_multiple(n, group_slots)
         slot_src = np.pad(slot_src, (0, npad - n), constant_values=-1)
         bases = np.pad(bases, (0, npad // TILE_SLOTS - len(bases)))
         real = slot_src >= 0
@@ -244,15 +264,15 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
             all_src_eid.append(np.where(real, orig[idx], -1
                                         ).astype(np.int32))
         all_bases.append(bases)
-        chunk_shard.extend([s] * (npad // chunk_slots))
+        group_shard.extend([s] * (npad // group_slots))
 
-    if not all_src_col:   # empty matrix: a single all-pad chunk
-        all_src_col = [np.zeros(chunk_slots, np.int32)]
-        all_src_data = [np.zeros(chunk_slots, np.float32)]
-        all_src_row = [np.full(chunk_slots, -1, np.int32)]
-        all_src_eid = [np.full(chunk_slots, -1, np.int32)]
-        all_bases = [np.zeros(chunk_slots // TILE_SLOTS, np.int32)]
-        chunk_shard = [0]
+    if not all_src_col:   # empty matrix: a single all-pad group
+        all_src_col = [np.zeros(group_slots, np.int32)]
+        all_src_data = [np.zeros(group_slots, np.float32)]
+        all_src_row = [np.full(group_slots, -1, np.int32)]
+        all_src_eid = [np.full(group_slots, -1, np.int32)]
+        all_bases = [np.zeros(group_slots // TILE_SLOTS, np.int32)]
+        group_shard = [0]
 
     scol = np.concatenate(all_src_col)
     sdat = np.concatenate(all_src_data)
@@ -311,16 +331,17 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
 
     return GridSpMV(
         cols_grid=jnp.asarray(
-            scol.reshape(-1, SUBROWS, shard_w)),
+            scol.reshape(n_tiles, SUBROWS, LANES)),
         data_grid=jnp.asarray(sdat.reshape(n_tiles, SUBROWS, LANES)),
         flags_grid=jnp.asarray(flags),
         emit_grid=jnp.asarray(emit),
-        chunk_shard=jnp.asarray(np.asarray(chunk_shard, np.int32)),
+        group_shard=jnp.asarray(np.asarray(group_shard, np.int32)),
         tile_base=jnp.asarray(tile_base),
         perm_sorted=jnp.asarray(perm),
         base_sorted=jnp.asarray(base_sorted),
         visited=visited,
         shape=(n_rows, n_cols), nnz=nnz_log, n_shards=n_shards,
+        shard_w=shard_w,
         pad_ratio=float(n_slots) / max(nnz_log, 1))
 
 
@@ -332,7 +353,9 @@ def _lane_gather(src, idx):
     """Same-shape gather along lanes (take_along_axis axis=1) spelled as
     the exact lax.gather form Mosaic lowers to tpu.dynamic_gather —
     jnp.take_along_axis canonicalizes indices to int64 under x64, which
-    Mosaic rejects; idx stays int32 here."""
+    Mosaic rejects; idx stays int32 here.  LANE-LOCAL ONLY: legal when
+    the source's lane dimension is <= 128 (one vreg along the gather
+    dim); wider sources must go through :func:`_tree_gather`."""
     dnums = jax.lax.GatherDimensionNumbers(
         offset_dims=(), collapsed_slice_dims=(1,), start_index_map=(1,),
         operand_batching_dims=(0,), start_indices_batching_dims=(0,))
@@ -341,9 +364,32 @@ def _lane_gather(src, idx):
         mode=jax.lax.GatherScatterMode.PROMISE_IN_BOUNDS)
 
 
-def _gather_kernel(shard_ref, x_ref, i_ref, o_ref):
+def _tree_gather(src_rows, idx, out_sublanes: int):
+    """out[s, l] = src_rows[idx[s, l] >> 7, idx[s, l] & 127] via the
+    row-broadcast select tree — the Mosaic-legal wide-range gather.
+
+    src_rows: (S, 128); idx: (out_sublanes, 128) i32 in [0, S*128).
+    Each step is one legal lane-local gather plus a mask-accumulate, so
+    VPU cost is ~5 vector ops per source row per block of sublanes."""
+    n_rows = src_rows.shape[0]
+    hi = jax.lax.shift_right_logical(idx, jnp.int32(7))
+    lo = jax.lax.bitwise_and(idx, jnp.int32(127))
+    acc = jnp.zeros((out_sublanes, LANES), src_rows.dtype)
+    zero = jnp.zeros((), src_rows.dtype)
+    for r in range(n_rows):
+        row = jax.lax.broadcast_in_dim(
+            src_rows[r:r + 1, :], (out_sublanes, LANES), (0, 1))
+        g = _lane_gather(row, lo)
+        acc = acc + jnp.where(hi == r, g, zero)
+    return acc
+
+
+def _tree_gather_kernel(shard_ref, x_ref, i_ref, o_ref):
+    """Kernel 1: gather a GROUP_TILES-tile block of slots from the
+    group's x shard.  x_ref (1, S, 128): the shard, un-replicated;
+    i_ref/o_ref (1, GROUP_TILES*SUBROWS, 128)."""
     del shard_ref
-    o_ref[0] = _lane_gather(x_ref[0], i_ref[0])
+    o_ref[0] = _tree_gather(x_ref[0], i_ref[0], i_ref.shape[1])
 
 
 def _f0():
@@ -352,21 +398,47 @@ def _f0():
     return jnp.float32(0.0)
 
 
+def _roll32(x, d, axis):
+    """tpu.rotate via pltpu.roll — 32-bit only on current Mosaic, so
+    bools round-trip through i32; the shift amount is pinned i32 (a bare
+    python int becomes an i64 rotate operand under jax_enable_x64)."""
+    d = jnp.int32(d)
+    if x.dtype == jnp.bool_:
+        return pltpu.roll(x.astype(jnp.int32), d, axis) != 0
+    return pltpu.roll(x, d, axis)
+
+
 def _shift_lanes(x, d):
-    """Shift right along lanes by d, zero/False fill."""
-    pad = jnp.zeros_like(x[:, :d])
-    return jnp.concatenate([pad, x[:, :-d]], axis=1)
+    """Shift right along lanes by d, zero/False fill.
+
+    Spelled as rotate+mask: the concat-of-slices spelling needs an
+    unaligned-lane relayout Mosaic cannot do ("Invalid vector register
+    cast" — round-5 deviceless-AOT bisect, the reason no segsum kernel
+    ever compiled on hardware before this round)."""
+    rolled = _roll32(x, d, x.ndim - 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    if x.dtype == jnp.bool_:
+        return rolled & (lane >= d)
+    return jnp.where(lane < d, jnp.zeros((), x.dtype), rolled)
 
 
 def _shift_subs(x, d):
-    """Shift down along sub-rows by d, zero/False fill."""
-    pad = jnp.zeros_like(x[:d, :])
-    return jnp.concatenate([pad, x[:-d, :]], axis=0)
+    """Shift down along sub-rows by d, zero/False fill (rotate+mask; see
+    :func:`_shift_lanes`)."""
+    rolled = _roll32(x, d, x.ndim - 2)
+    sub = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 2)
+    if x.dtype == jnp.bool_:
+        return rolled & (sub >= d)
+    return jnp.where(sub < d, jnp.zeros((), x.dtype), rolled)
 
 
-def _segsum_body(g, dat, f, e):
+def _segsum_body(g, dat, f, e, s_ref):
     """Exact segmented-scan tile reduction + flat emission relocation —
     the shared body of the SpMV scan kernel and its k-batched SpMM twin.
+    ``s_ref``: an (8, 128) f32 VMEM scratch; the scan result is round-
+    tripped through it so the emission tree's sublane slices see a
+    canonical vreg layout (slicing the scan's live value directly is an
+    "Invalid vector register cast" in Mosaic — round-5 AOT bisect).
     Returns the tile's (8, 128) per-(window, row%128) contribution."""
     real = (f & _F_REAL) != 0
     cont = (f & _F_CONT) != 0
@@ -393,16 +465,18 @@ def _segsum_body(g, dat, f, e):
     c = c + jnp.where(crossm, car, _f0())
 
     # emission: relocate each row's final partial to its (window, row%128)
-    # slot via one flat same-shape gather
-    flat = c.reshape(1, TILE_SLOTS)
-    ef = e.reshape(1, TILE_SLOTS)
-    gath = _lane_gather(flat, jnp.maximum(ef, 0))
-    contrib = jnp.where(ef >= 0, gath, _f0())
-    return contrib.reshape(SUBROWS, LANES)
+    # slot. The emission position space is the whole 1024-slot tile, so a
+    # flat lane gather is Mosaic-illegal (source > 1 vreg along the
+    # gather dim); the in-tile relocation rides the same row-broadcast
+    # select tree as kernel 1 (8 sublane rows -> 8 legal lane gathers)
+    s_ref[:] = c
+    contrib = _tree_gather(s_ref[:], jnp.maximum(e, 0), SUBROWS)
+    return jnp.where(e >= 0, contrib, _f0())
 
 
-def _segsum_kernel(g_ref, d_ref, f_ref, e_ref, o_ref):
-    o_ref[0] = _segsum_body(g_ref[0], d_ref[0], f_ref[0], e_ref[0])
+def _segsum_kernel(g_ref, d_ref, f_ref, e_ref, o_ref, s_ref):
+    o_ref[0] = _segsum_body(g_ref[0], d_ref[0], f_ref[0], e_ref[0],
+                            s_ref)
 
 
 def _reduce_kernel(perm_ref, base_ref, c_ref, *o_refs):
@@ -423,42 +497,49 @@ def _reduce_kernel(perm_ref, base_ref, c_ref, *o_refs):
             o_refs[d][0] += contrib[d:d + 1]
 
 
+def _shard_rows(fmt: GridSpMV, v):
+    """Pad a length-n_cols vector to the shard grid: (n_shards, S, 128)."""
+    total = fmt.n_shards * fmt.shard_w
+    vpad = jnp.zeros(total, v.dtype).at[:fmt.n_cols].set(v)
+    return vpad.reshape(fmt.n_shards, fmt.shard_w // LANES, LANES)
+
+
+def _gather_grid_spec(fmt: GridSpMV):
+    """Kernel-1 grid spec: one step per GROUP_TILES-tile group, the
+    group's shard block chosen by scalar prefetch."""
+    s_rows = fmt.shard_w // LANES
+    gsub = GROUP_TILES * SUBROWS
+    ngroup = fmt.data_grid.shape[0] // GROUP_TILES
+    return ngroup, pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ngroup,),
+        in_specs=[
+            pl.BlockSpec((1, s_rows, LANES), lambda g, sh: (sh[g], 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, gsub, LANES), lambda g, sh: (g, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, gsub, LANES), lambda g, sh: (g, 0, 0),
+                               memory_space=pltpu.VMEM),
+    )
+
+
 @jax.jit
 def _spmv_impl(fmt: GridSpMV, x):
     n_rows, n_cols = fmt.shape
-    shard_w = fmt.cols_grid.shape[2]
-    n_shards = fmt.n_shards
-    nchunk = fmt.cols_grid.shape[0]
     ntile = fmt.data_grid.shape[0]
     nwp = fmt.visited.shape[1]
+    gsub = GROUP_TILES * SUBROWS
 
-    xpad = jnp.zeros(n_shards * shard_w, jnp.float32
-                     ).at[:n_cols].set(x.astype(jnp.float32))
-    # replicate each shard across the 8 sublanes (same-shape gather source)
-    x_rep = jnp.broadcast_to(xpad.reshape(n_shards, 1, shard_w),
-                             (n_shards, SUBROWS, shard_w))
-
-    grid1 = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(nchunk,),
-        in_specs=[
-            pl.BlockSpec((1, SUBROWS, shard_w),
-                         lambda c, sh: (sh[c], 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, SUBROWS, shard_w), lambda c, sh: (c, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, SUBROWS, shard_w),
-                               lambda c, sh: (c, 0, 0),
-                               memory_space=pltpu.VMEM),
-    )
+    x_sh = _shard_rows(fmt, x.astype(jnp.float32))
+    ngroup, grid1 = _gather_grid_spec(fmt)
     gathered = pallas_call(
-        _gather_kernel, grid_spec=grid1,
-        out_shape=jax.ShapeDtypeStruct((nchunk, SUBROWS, shard_w),
+        _tree_gather_kernel, grid_spec=grid1,
+        out_shape=jax.ShapeDtypeStruct((ngroup, gsub, LANES),
                                        jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
-    )(fmt.chunk_shard, x_rep, fmt.cols_grid)
+    )(fmt.group_shard, x_sh, fmt.cols_grid.reshape(ngroup, gsub, LANES))
 
     prod_tiles = gathered.reshape(ntile, SUBROWS, LANES)
 
@@ -479,6 +560,7 @@ def _spmv_impl(fmt: GridSpMV, x):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((ntile, SUBROWS, LANES),
                                        jnp.float32),
+        scratch_shapes=[pltpu.VMEM((SUBROWS, LANES), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )(prod_tiles, fmt.data_grid, fmt.flags_grid, fmt.emit_grid)
@@ -530,24 +612,22 @@ KT = 8              # columns per fused pass (sublane-aligned)
 
 
 def _gather_kt_kernel(shard_ref, bt_ref, i_ref, o_ref):
-    """Gather one B-column of the KT group for one chunk. The grid is
-    (nchunk, KT) with the slot-index block a function of the chunk only,
-    so Pallas keeps it resident across the KT steps — the indices are
-    fetched from HBM once per pattern position and reused for every
+    """Gather one B-column of the KT group for one tile group. The grid
+    is (ngroup, KT) with the slot-index block a function of the group
+    only, so Pallas keeps it resident across the KT steps — the indices
+    are fetched from HBM once per pattern position and reused for every
     column ('gather once per pattern position, broadcast across a k-tile
     of B lanes') while the per-step VMEM footprint stays at the SpMV
-    path's (one (SUBROWS, shard_w) plane, not KT of them)."""
+    path's (one group plane, not KT of them)."""
     del shard_ref
-    idx = i_ref[0]
-    src = jnp.broadcast_to(bt_ref[0:1, :], idx.shape)
-    o_ref[0, 0] = _lane_gather(src, idx)
+    o_ref[0, 0] = _tree_gather(bt_ref[0, 0], i_ref[0], i_ref.shape[1])
 
 
-def _segsum_kt_kernel(g_ref, d_ref, f_ref, e_ref, o_ref):
+def _segsum_kt_kernel(g_ref, d_ref, f_ref, e_ref, o_ref, s_ref):
     # grid (ntile, KT): the flags/emit/data blocks depend on the tile
     # index only, so Pallas keeps them resident across the KT steps
     o_ref[0, 0] = _segsum_body(g_ref[0, 0, 0], d_ref[0], f_ref[0],
-                               e_ref[0])
+                               e_ref[0], s_ref)
 
 
 def _reduce_kt_kernel(perm_ref, base_ref, c_ref, *o_refs):
@@ -570,41 +650,43 @@ def _reduce_kt_kernel(perm_ref, base_ref, c_ref, *o_refs):
 
 @jax.jit
 def _spmm_kt_impl(fmt: GridSpMV, bt):
-    """One fused KT-column pass. ``bt`` is (KT, n_shards * shard_w) f32
-    (transposed, shard-padded columns of B)."""
+    """One fused KT-column pass. ``bt`` is (KT, n_shards, S, 128) f32
+    (transposed, shard-gridded columns of B)."""
     n_rows, _ = fmt.shape
-    shard_w = fmt.cols_grid.shape[2]
-    nchunk = fmt.cols_grid.shape[0]
+    s_rows = fmt.shard_w // LANES
     ntile = fmt.data_grid.shape[0]
     nwp = fmt.visited.shape[1]
-    tpc = (SUBROWS * shard_w) // TILE_SLOTS   # tiles per chunk
+    gsub = GROUP_TILES * SUBROWS
+    ngroup = ntile // GROUP_TILES
 
     grid1 = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(nchunk, KT),
+        grid=(ngroup, KT),
         in_specs=[
-            pl.BlockSpec((1, shard_w), lambda c, q, sh: (q, sh[c]),
+            pl.BlockSpec((1, 1, s_rows, LANES),
+                         lambda g, q, sh: (q, sh[g], 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, SUBROWS, shard_w),
-                         lambda c, q, sh: (c, 0, 0),
+            pl.BlockSpec((1, gsub, LANES), lambda g, q, sh: (g, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1, SUBROWS, shard_w),
-                               lambda c, q, sh: (c, q, 0, 0),
+        out_specs=pl.BlockSpec((1, 1, gsub, LANES),
+                               lambda g, q, sh: (g, q, 0, 0),
                                memory_space=pltpu.VMEM),
     )
     gathered = pallas_call(
         _gather_kt_kernel, grid_spec=grid1,
-        out_shape=jax.ShapeDtypeStruct((nchunk, KT, SUBROWS, shard_w),
+        out_shape=jax.ShapeDtypeStruct((ngroup, KT, gsub, LANES),
                                        jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
-    )(fmt.chunk_shard, bt, fmt.cols_grid)
+    )(fmt.group_shard, bt,
+      fmt.cols_grid.reshape(ngroup, gsub, LANES))
 
-    # free 5-D view: the (q, stream) chunk layout re-read per tile —
-    # tile t lives at chunk t // tpc, local slab t % tpc (the slot
-    # stream is chunk-consecutive, so no transpose is materialized)
-    g5 = gathered.reshape(nchunk, KT, tpc, SUBROWS, LANES)
+    # free 5-D view: the (q, stream) group layout re-read per tile —
+    # tile t lives at group t // GROUP_TILES, local slab t % GROUP_TILES
+    # (the slot stream is group-consecutive, so no transpose is
+    # materialized)
+    g5 = gathered.reshape(ngroup, KT, GROUP_TILES, SUBROWS, LANES)
 
     contrib = pallas_call(
         _segsum_kt_kernel,
@@ -616,8 +698,8 @@ def _spmm_kt_impl(fmt: GridSpMV, bt):
             # radix-select fori-index workaround)
             pl.BlockSpec((1, 1, 1, SUBROWS, LANES),
                          lambda t, q: (
-                             jax.lax.div(t, jnp.int32(tpc)), q,
-                             jax.lax.rem(t, jnp.int32(tpc)), 0, 0),
+                             jax.lax.div(t, jnp.int32(GROUP_TILES)), q,
+                             jax.lax.rem(t, jnp.int32(GROUP_TILES)), 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, SUBROWS, LANES), lambda t, q: (t, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -631,6 +713,7 @@ def _spmm_kt_impl(fmt: GridSpMV, bt):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((ntile, KT, SUBROWS, LANES),
                                        jnp.float32),
+        scratch_shapes=[pltpu.VMEM((SUBROWS, LANES), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
     )(g5, fmt.data_grid, fmt.flags_grid, fmt.emit_grid)
@@ -678,12 +761,12 @@ def spmm(fmt: GridSpMV, b) -> jnp.ndarray:
     if k < 2:
         cols = jax.lax.map(lambda col: _spmv_impl(fmt, col), b.T)
         return cols.T
-    shard_w = fmt.cols_grid.shape[2]
+    shard_w = fmt.shard_w
     n_shards = fmt.n_shards
     kg = cdiv(k, KT)
     bp = jnp.zeros((n_shards * shard_w, kg * KT), jnp.float32)
     bp = bp.at[:fmt.n_cols, :k].set(b.astype(jnp.float32))
-    bt_groups = bp.T.reshape(kg, KT, n_shards * shard_w)
+    bt_groups = bp.T.reshape(kg, KT, n_shards, shard_w // LANES, LANES)
     # static unroll over the (small) group count: kg is ceil(k / 8) and
     # the per-group executable is reused across the unrolled calls
     outs = [_spmm_kt_impl(fmt, bt_groups[g]) for g in range(kg)]

@@ -40,7 +40,7 @@ import numpy as np
 from raft_tpu.sparse import grid_spmv
 from raft_tpu.sparse.grid_spmv import (LANES, SPAN_WINDOWS, SUBROWS,
                                        TILE_SLOTS, _F_CONT, _F_CROSS,
-                                       _F_REAL, _lane_gather, _shift_lanes,
+                                       _F_REAL, _tree_gather, _shift_lanes,
                                        _shift_subs)
 from raft_tpu.util.pallas_utils import pallas_call
 
@@ -146,7 +146,8 @@ def _mst_scan_kernel(tb_ref, cdst_ref, w_ref, rank_ref, eid_ref, f_ref,
     Outputs: the per-(row, tile) winner triple relocated to its
     (window, row%128) slot — identity (inf / int32 max) elsewhere."""
     win_refs = win_and_out_refs[:SPAN_WINDOWS]
-    ow_ref, or_ref, oe_ref = win_and_out_refs[SPAN_WINDOWS:]
+    (ow_ref, or_ref, oe_ref,
+     sw8_ref, sw_ref, sr_ref, se_ref) = win_and_out_refs[SPAN_WINDOWS:]
     del tb_ref
 
     f = f_ref[0]
@@ -154,10 +155,15 @@ def _mst_scan_kernel(tb_ref, cdst_ref, w_ref, rank_ref, eid_ref, f_ref,
     cont = (f & _F_CONT) != 0
     crossm = (f & _F_CROSS) != 0
 
-    # colors[src]: flat gather from this tile's own 8-window color slab
-    win = jnp.concatenate([r[0] for r in win_refs], axis=1)   # (1, 1024)
-    sl = sl_ref[0].reshape(1, TILE_SLOTS)
-    csrc = _lane_gather(win, sl).reshape(SUBROWS, LANES)
+    # colors[src]: in-tile gather from this tile's own 8-window color
+    # slab; the 1024-position space exceeds Mosaic's lane-local gather,
+    # so it rides the row-broadcast select tree (slot p -> window p>>7,
+    # lane p&127, matching the axis-0 stack of the window rows). All
+    # tree sources round-trip through VMEM scratch: sublane-slicing a
+    # live computed vector is an "Invalid vector register cast" in
+    # Mosaic (round-5 AOT bisect; same fix as grid_spmv._segsum_body)
+    sw8_ref[:] = jnp.concatenate([r[0] for r in win_refs], axis=0)
+    csrc = _tree_gather(sw8_ref[:], sl_ref[0], SUBROWS)
 
     is_cross = real & (csrc != cdst_ref[0])
     wv = jnp.where(is_cross, w_ref[0], _idw())
@@ -193,15 +199,19 @@ def _mst_scan_kernel(tb_ref, cdst_ref, w_ref, rank_ref, eid_ref, f_ref,
                        jnp.where(crossm, care, _idi()))
 
     # emission: relocate each row's winner to its (window, row%128) slot
-    e = e_ref[0].reshape(1, TILE_SLOTS)
+    # via the same in-tile select tree (Mosaic-legal lane gathers only)
+    e = e_ref[0]                                          # (8, 128)
     idx = jnp.maximum(e, 0)
-    gw = _lane_gather(cw.reshape(1, TILE_SLOTS), idx)
-    gr = _lane_gather(cr.reshape(1, TILE_SLOTS), idx)
-    ge = _lane_gather(ce.reshape(1, TILE_SLOTS), idx)
     keep = e >= 0
-    ow_ref[0] = jnp.where(keep, gw, _idw()).reshape(SUBROWS, LANES)
-    or_ref[0] = jnp.where(keep, gr, _idi()).reshape(SUBROWS, LANES)
-    oe_ref[0] = jnp.where(keep, ge, _idi()).reshape(SUBROWS, LANES)
+    sw_ref[:] = cw
+    sr_ref[:] = cr
+    se_ref[:] = ce
+    gw = _tree_gather(sw_ref[:], idx, SUBROWS)
+    gr = _tree_gather(sr_ref[:], idx, SUBROWS)
+    ge = _tree_gather(se_ref[:], idx, SUBROWS)
+    ow_ref[0] = jnp.where(keep, gw, _idw())
+    or_ref[0] = jnp.where(keep, gr, _idi())
+    oe_ref[0] = jnp.where(keep, ge, _idi())
 
 
 def _mst_reduce_kernel(perm_ref, base_ref, cw_ref, cr_ref, ce_ref,
@@ -244,39 +254,23 @@ def per_vertex_min_edge(mp: MSTGridPlan, colors):
     identity (inf / int32 max) where a vertex has no cross edge."""
     plan = mp.plan
     n = mp.n
-    shard_w = plan.cols_grid.shape[2]
-    n_shards = plan.n_shards
-    nchunk = plan.cols_grid.shape[0]
     ntile = plan.data_grid.shape[0]
     nwp = plan.visited.shape[1]
     colors = colors.astype(jnp.int32)
 
-    # ---- kernel A: colors[dst] via the replicated-shard dynamic gather
-    cpad = jnp.zeros(n_shards * shard_w, jnp.int32).at[:n].set(colors)
-    c_rep = jnp.broadcast_to(cpad.reshape(n_shards, 1, shard_w),
-                             (n_shards, SUBROWS, shard_w))
-    grid1 = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(nchunk,),
-        in_specs=[
-            pl.BlockSpec((1, SUBROWS, shard_w),
-                         lambda c, sh: (sh[c], 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, SUBROWS, shard_w), lambda c, sh: (c, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((1, SUBROWS, shard_w),
-                               lambda c, sh: (c, 0, 0),
-                               memory_space=pltpu.VMEM),
-    )
+    # ---- kernel A: colors[dst] via the shard-blocked tree gather (the
+    # same Mosaic-legal kernel as SpMV's kernel 1; dtype-agnostic)
+    gsub = grid_spmv.GROUP_TILES * SUBROWS
+    c_sh = grid_spmv._shard_rows(plan, colors)
+    ngroup, grid1 = grid_spmv._gather_grid_spec(plan)
     cdst = pallas_call(
-        grid_spmv._gather_kernel,   # dtype-agnostic: i32 via out_shape
+        grid_spmv._tree_gather_kernel,
         grid_spec=grid1,
-        out_shape=jax.ShapeDtypeStruct((nchunk, SUBROWS, shard_w),
-                                       jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((ngroup, gsub, LANES), jnp.int32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
-    )(plan.chunk_shard, c_rep, plan.cols_grid)
+    )(plan.group_shard, c_sh,
+      plan.cols_grid.reshape(ngroup, gsub, LANES))
     cdst_tiles = cdst.reshape(ntile, SUBROWS, LANES)
 
     # ---- kernel B: segmented lexicographic min-scan + emission
@@ -303,6 +297,12 @@ def per_vertex_min_edge(mp: MSTGridPlan, colors):
                          memory_space=pltpu.VMEM)
             for _ in range(3)
         ],
+        # scratch rides the grid spec (pallas rejects the kwarg with
+        # grid_spec): layout round-trips for the select-tree sources
+        scratch_shapes=[pltpu.VMEM((SUBROWS, LANES), jnp.int32),
+                        pltpu.VMEM((SUBROWS, LANES), jnp.float32),
+                        pltpu.VMEM((SUBROWS, LANES), jnp.int32),
+                        pltpu.VMEM((SUBROWS, LANES), jnp.int32)],
     )
     cw, cr, ce = pallas_call(
         _mst_scan_kernel, grid_spec=grid2,
