@@ -304,9 +304,13 @@ def bench_select_k():
 @bench("matrix/select_k_large")
 def bench_select_k_large():
     """Large-length (1M-row) half incl. the k=10^4 wide regime
-    (MATRIX_SELECT_LARGE analogue; ref: cpp/tests/matrix/select_large_k.cu)."""
+    (MATRIX_SELECT_LARGE analogue; ref: cpp/tests/matrix/select_large_k.cu)
+    and, at full size, one past-VMEM row length exercising the two-level
+    chunked radix (ref: multi-block radix_topk, select_radix.cuh:877)."""
     n = SIZES["rows"]
-    lens = ((n, 16), (n, 256), (n, 2048), (n, 10_000))
+    lens = [(n, 16), (n, 256), (n, 2048), (n, 10_000)]
+    if n >= (1 << 20):
+        lens.append((1 << 22, 256))
     yield from _select_k_grid(lens)
 
 

@@ -103,10 +103,14 @@ def _emit_tiles(kh: int) -> Tuple[int, int]:
 
 # One row lives VMEM-resident in the threshold kernel: 1M * 4 B = 4 MB,
 # ~8 MB with Pallas double-buffering — inside the same ~10 MB working-set
-# budget every other kernel sizes to (contractions._VMEM_BUDGET). Index
-# exactness would allow 2^24; the VMEM residency bound binds first.
-# Longer rows fall back to the tournament paths.
-MAX_LEN = 1 << 20
+# budget every other kernel sizes to (contractions._VMEM_BUDGET). Rows
+# past CHUNK_LEN run the exact two-level scheme (per-chunk select, then
+# one merge select over the C*k pool — see radix_select_k), so the
+# supported length is bounded by index exactness (the emission encodes
+# columns in three bf16 parts: 24 mantissa bits), the reference
+# radix_topk's multi-block role (matrix/detail/select_radix.cuh:877).
+CHUNK_LEN = 1 << 20
+MAX_LEN = 1 << 24
 MAX_K = 16384
 
 
@@ -118,6 +122,11 @@ def supports(dtype, n_cols: int, k: int) -> bool:
                 jnp.dtype(jnp.int16), jnp.dtype(jnp.int32),
                 jnp.dtype(jnp.uint8), jnp.dtype(jnp.uint16),
                 jnp.dtype(jnp.uint32))
+    if n_cols > CHUNK_LEN:
+        # two-level: the merge pool must itself be a supported problem
+        n_chunks = cdiv(n_cols, CHUNK_LEN)
+        if n_chunks * k > CHUNK_LEN:
+            return False
     return ok and k <= n_cols and n_cols <= MAX_LEN and k <= MAX_K
 
 
@@ -332,7 +341,7 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
     # grow only while the resulting row padding stays at the emission
     # minimum — a bigger threshold block must never force extra pad rows
     # (they would ride through BOTH kernels)
-    while (tm_a * 2 * lp * 4 <= MAX_LEN * 4 and tm_a < 128
+    while (tm_a * 2 * lp * 4 <= CHUNK_LEN * 4 and tm_a < 128
            and round_up_to_multiple(n_rows, max(tm_a * 2, tm_e))
            == row_cap):
         tm_a *= 2
@@ -399,14 +408,50 @@ def radix_select_k(values: jnp.ndarray, k: int,
     must check :func:`supports` first.
     """
     values = jnp.asarray(values)
-    if not supports(values.dtype, values.shape[1], k):
+    n_rows, n_cols = values.shape
+    if not supports(values.dtype, n_cols, k):
         raise ValueError(
             f"radix_select_k: unsupported problem (dtype={values.dtype}, "
-            f"n_cols={values.shape[1]}, k={k}); check supports()")
+            f"n_cols={n_cols}, k={k}); check supports()")
     keys = _to_key(values, select_min)
-    idx = _radix_ranks(keys, k)
-    out_v = jnp.take_along_axis(values, idx, axis=1)
-    out_k = jnp.take_along_axis(keys, idx, axis=1)
+
+    if n_cols > CHUNK_LEN:
+        # Two-level exact select for rows past the VMEM-resident bound
+        # (the reference's multi-block radix_topk role,
+        # matrix/detail/select_radix.cuh:877): per-chunk exact top-k,
+        # then ONE exact merge select over the C*k candidate pool. The
+        # pool is laid out chunk-major with each chunk's winners in
+        # ascending-column order, so the merge pass's position-order tie
+        # rule reproduces the global lowest-column tie contract exactly.
+        n_chunks = cdiv(n_cols, CHUNK_LEN)
+        lc = round_up_to_multiple(cdiv(n_cols, n_chunks), 1024)
+        kc = jnp.pad(keys, ((0, 0), (0, n_chunks * lc - n_cols)),
+                     constant_values=_I32_MAX
+                     ).reshape(n_rows * n_chunks, lc)
+        idx_c = _radix_ranks(kc, k)
+        # every downstream gather stays CHUNK-LOCAL — a gather from the
+        # full-width row fuses the whole row into VMEM (274M > 128M at
+        # 2^22 cols, observed on the v5e AOT compile)
+        pool_k = jnp.take_along_axis(kc, idx_c, axis=1
+                                     ).reshape(n_rows, n_chunks * k)
+        vc = jnp.pad(values, ((0, 0), (0, n_chunks * lc - n_cols))
+                     ).reshape(n_rows * n_chunks, lc)
+        pool_v = jnp.take_along_axis(vc, idx_c, axis=1
+                                     ).reshape(n_rows, n_chunks * k)
+        # global column ids of the pool candidates (chunk-major)
+        base = (jnp.arange(n_chunks, dtype=jnp.int32) * lc)[None, :, None]
+        pool_i = (idx_c.reshape(n_rows, n_chunks, k) + base
+                  ).reshape(n_rows, n_chunks * k)
+        # pad-chunk winners carry _I32_MAX keys, so they cannot win the
+        # merge while any real candidate remains (k <= n_cols contract)
+        idx_m = _radix_ranks(pool_k, k)
+        idx = jnp.take_along_axis(pool_i, idx_m, axis=1)
+        out_k = jnp.take_along_axis(pool_k, idx_m, axis=1)
+        out_v = jnp.take_along_axis(pool_v, idx_m, axis=1)
+    else:
+        idx = _radix_ranks(keys, k)
+        out_v = jnp.take_along_axis(values, idx, axis=1)
+        out_k = jnp.take_along_axis(keys, idx, axis=1)
     # Best-first ordering: stable sort by sortable key keeps the
     # emission's ascending-column order among equal values.
     out_k, out_v, idx = jax.lax.sort((out_k, out_v, idx), dimension=1,
