@@ -1,0 +1,186 @@
+"""Deviceless v5e AOT preflight: compile every bench-critical entry
+point against the real TPU toolchain WITHOUT the chip or tunnel
+(ci/aot_compile.py). Run before arming the battery — a case that fails
+here WILL fail on hardware with the same Mosaic error.
+
+Each case compiles in a subprocess (a compiler SIGABRT must not kill the
+sweep). Exit code 0 iff every case compiles.
+
+Usage:  python ci/aot_preflight.py [case ...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+HDR = """
+import sys; sys.path.insert(0, %r)
+import functools
+import numpy as np
+import jax, jax.numpy as jnp
+from ci.aot_compile import tpu_aot_compile, tpu_struct
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CASES = {
+    # -- the north star: fused Lloyd at the headline shape, tier high --
+    "lloyd_northstar": HDR + """
+import raft_tpu
+from raft_tpu.cluster.kmeans import lloyd_step
+raft_tpu.set_matmul_precision("high")
+f = functools.partial(lloyd_step, n_clusters=1024)
+tpu_aot_compile(f, ((1 << 20, 128), jnp.float32), ((1024, 128),
+                jnp.float32))
+print("PRE_OK")
+""",
+    # -- chunked-radix kNN at the bench shape ------------------------
+    "knn_chunked_bench": HDR + """
+from raft_tpu.neighbors import knn
+f = functools.partial(knn, None, k=64)
+tpu_aot_compile(f, ((1 << 20, 128), jnp.float32),
+                ((4096, 128), jnp.float32))
+print("PRE_OK")
+""",
+    # -- unexpanded pairwise metrics tile engine ----------------------
+    "pairwise_unexpanded": HDR + """
+from raft_tpu.linalg.contractions import pairwise_unexpanded_pallas
+f = functools.partial(pairwise_unexpanded_pallas, metric="l1")
+tpu_aot_compile(f, ((4096, 1024), jnp.float32), ((256, 1024),
+                jnp.float32))
+print("PRE_OK")
+""",
+    # -- select_k four ways at battery shapes -------------------------
+    "select_k_paths": HDR + """
+from raft_tpu.matrix.select_k import (_direct_select, _stream_select,
+                                      _tiled_select)
+from raft_tpu.matrix import radix_select
+for impl, L, k in ((_tiled_select, 65536, 256),
+                   (_direct_select, 65536, 256),
+                   (_stream_select, 65536, 256)):
+    tpu_aot_compile(functools.partial(impl, k=k, select_min=True),
+                    ((64, L), jnp.float32))
+for L, k in ((8192, 16), (65536, 2048), (1 << 20, 10000),
+             (1 << 22, 256)):
+    tpu_aot_compile(functools.partial(radix_select.radix_select_k,
+                                      k=k, select_min=True),
+                    ((16, L), jnp.float32))
+print("PRE_OK")
+""",
+    # -- grid SpMV / fused SpMM / lanczos-grid ------------------------
+    "grid_sparse": HDR + """
+import scipy.sparse as sp
+from raft_tpu.core.sparse_types import CSRMatrix
+from raft_tpu.sparse import grid_spmv
+rng = np.random.default_rng(0)
+n = 1 << 15
+deg = 10
+cols = rng.integers(0, n, size=(n, deg)).astype(np.int32)
+data = rng.random((n, deg)).astype(np.float32)
+indptr = np.arange(n + 1, dtype=np.int64) * deg
+a = sp.csr_matrix((data.ravel(), cols.ravel(), indptr), shape=(n, n))
+plan = grid_spmv.prepare(CSRMatrix.from_scipy(a))
+jax.jit(grid_spmv.spmv).lower(plan, tpu_struct((n,), jnp.float32)
+                              ).compile()
+jax.jit(grid_spmv.spmm).lower(plan, tpu_struct((n, 16), jnp.float32)
+                              ).compile()
+print("PRE_OK")
+""",
+    # -- MST grid E-stage ---------------------------------------------
+    "mst_grid": HDR + """
+import scipy.sparse as sp
+from raft_tpu.core.sparse_types import CSRMatrix
+from raft_tpu.sparse.solver import mst_grid
+rng = np.random.default_rng(0)
+n = 1 << 13
+m = 6 * n
+r = rng.integers(0, n, m); c = rng.integers(0, n, m)
+keep = r != c
+r, c = r[keep], c[keep]
+w = rng.random(len(r)).astype(np.float32)
+a = sp.csr_matrix((np.concatenate([w, w]),
+                   (np.concatenate([r, c]), np.concatenate([c, r]))),
+                  shape=(n, n))
+a.sum_duplicates()
+mp = mst_grid.prepare_mst(CSRMatrix.from_scipy(a))
+jax.jit(mst_grid.per_vertex_min_edge).lower(
+    mp, tpu_struct((n,), jnp.int32)).compile()
+print("PRE_OK")
+""",
+    # -- segment SpMV + ELL (the baselines the bench compares) --------
+    "sparse_baselines": HDR + """
+import scipy.sparse as sp
+from raft_tpu.core.sparse_types import CSRMatrix
+from raft_tpu.sparse.ell import from_csr, spmv as ell_spmv
+from raft_tpu.sparse.linalg import _segment_spmv
+rng = np.random.default_rng(0)
+n = 1 << 14
+deg = 10
+cols = rng.integers(0, n, size=(n, deg)).astype(np.int32)
+data = rng.random((n, deg)).astype(np.float32)
+indptr = np.arange(n + 1, dtype=np.int64) * deg
+a = sp.csr_matrix((data.ravel(), cols.ravel(), indptr), shape=(n, n))
+csr = CSRMatrix.from_scipy(a)
+ell = from_csr(csr)
+rid = csr.row_ids()
+def seg(r, i, d, v):
+    return _segment_spmv(r, i, d, v, csr.n_rows, limit=csr.indptr[-1])
+jax.jit(seg).lower(tpu_struct(rid.shape, rid.dtype),
+                   tpu_struct(csr.indices.shape, csr.indices.dtype),
+                   tpu_struct(csr.data.shape, csr.data.dtype),
+                   tpu_struct((n,), jnp.float32)).compile()
+jax.jit(lambda v: ell_spmv(ell, v)).lower(
+    tpu_struct((n,), jnp.float32)).compile()
+print("PRE_OK")
+""",
+    # -- histogram strategies + keyed rowsum --------------------------
+    "stats_kernels": HDR + """
+from raft_tpu.stats import histogram
+from raft_tpu.stats.histogram import HistType
+f1 = functools.partial(histogram, n_bins=64,
+                       binner=lambda v, r, c: v * 64,
+                       hist_type=HistType.Smem)
+f2 = functools.partial(histogram, n_bins=2048,
+                       binner=lambda v, r, c: v * 2048)
+tpu_aot_compile(f1, ((1 << 18, 8), jnp.float32))
+tpu_aot_compile(f2, ((1 << 18, 8), jnp.float32))
+print("PRE_OK")
+""",
+}
+
+
+def run_case(name):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPU_SKIP_MDS_QUERY"] = "1"
+    env["TPU_ACCELERATOR_TYPE"] = "v5litepod-1"
+    env["RAFT_TPU_PALLAS_INTERPRET"] = "0"
+    try:
+        r = subprocess.run([sys.executable, "-c", CASES[name]],
+                           capture_output=True, text=True, timeout=1200,
+                           env=env)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"case": name, "ok": False, "key": "timeout"}),
+              flush=True)
+        return False
+    ok = r.returncode == 0 and "PRE_OK" in (r.stdout or "")
+    key = ""
+    if not ok:
+        for line in (r.stderr or "").splitlines():
+            if ("Not implemented" in line or "Check failed" in line
+                    or "RESOURCE_EXHAUSTED" in line
+                    or "INTERNAL" in line or "Invalid" in line
+                    or "Error" in line):
+                key = line.strip()[:250]
+                break
+    print(json.dumps({"case": name, "ok": ok,
+                      "key": key if not ok else ""}), flush=True)
+    return ok
+
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or list(CASES)
+    bad = [n for n in names if not run_case(n)]
+    sys.exit(1 if bad else 0)

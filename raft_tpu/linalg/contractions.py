@@ -317,22 +317,31 @@ def _tm_fits(tm: int, kp: int, np_: int, mn_bufs: int, const_bytes: int,
     return need <= _VMEM_BUDGET
 
 
+_LLOYD_TM_ORDER = (1024, 512, 256, 128, 64, 32, 16, 8)
+
+
 def _pick_tm(kp: int, np_: int, mn_bufs: int, const_bytes: int,
-             itemsize: int = 4) -> Optional[int]:
+             itemsize: int = 4,
+             order: tuple = (512, 256, 1024, 128, 64, 32, 16, 8)
+             ) -> Optional[int]:
     """Largest row-tile that keeps the kernel working set under budget.
 
     Working set ≈ const (resident Y/accumulators) + double-buffered X tile
     + ``mn_bufs`` (tm × np_) f32 intermediates (distance tile, one-hot).
 
-    512 leads the preference order: measured fastest on v5e at the BASELINE
-    shape at the FIXED bf16x3 kernel (r3 tune artifact
+    512 leads the default preference order: measured fastest on v5e at
+    the BASELINE shape at the FIXED bf16x3 kernel (r3 tune artifact
     `tpu_battery_out/northstar_tune.jsonl` tm_sweep @ tier 'high':
     12.29 ms at tm=512 vs 13.84 at 256, 13.9 at 1024, 15.5 at 128 for
     1M×128 k=1024). The r2 sweep that put 256 first (10.7 ms) was
     measured while XLA's excess-precision pass had folded the split to a
     single bf16 pass — a different (lighter) kernel; at the real 5-pass
-    working set the larger tile amortizes Y-resident reloads better."""
-    for tm in (512, 256, 1024, 128, 64, 32, 16, 8):
+    working set the larger tile amortizes Y-resident reloads better.
+    The LLOYD plan overrides with _LLOYD_TM_ORDER (1024 first): the r5
+    tune at the leaner epilogue flipped the ranking (12.06 ms at 1024 vs
+    13.38 at 512 — the epilogue no longer dominates the bigger tile's
+    intermediate traffic)."""
+    for tm in order:
         need = const_bytes + 2 * tm * kp * itemsize + mn_bufs * tm * np_ * 4
         if need <= _VMEM_BUDGET:
             return tm
@@ -1122,7 +1131,7 @@ def _lloyd_tile_plan(m: int, k: int, n: int, itemsize: int,
     np_ = round_up_to_multiple(n, 128)
     const = np_ * kp * (itemsize + 4) + 4 * np_   # y + sums + counts
     auto_tm = _pick_tm(kp, np_, mn_bufs=2, const_bytes=const,
-                       itemsize=itemsize)
+                       itemsize=itemsize, order=_LLOYD_TM_ORDER)
     # explicit tm (the tuning sweep's knob) is honored whenever it fits
     # VMEM — NOT min()'d against the preference order, which would cap
     # every request at the preferred 256; unsafe requests fall back to

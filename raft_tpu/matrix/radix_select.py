@@ -189,7 +189,7 @@ def _threshold_kernel(key_ref, t_ref, ntie_ref, *, k: int):
         # Re-read the block per call: keeps its live range inside one loop
         # iteration instead of spanning the fori_loop.
         if tm == 1:
-            # the MAX_LEN single-row block: rank-3 reductions with a unit
+            # the CHUNK_LEN single-row block: rank-3 reductions with a unit
             # leading dim leave implicit-dim layouts Mosaic rejects either
             # way it is reduced; drop to 2-D by reading off the unit dim
             tb = jax.lax.broadcast_in_dim(t, blk[1:], (0, 1))
@@ -419,10 +419,15 @@ def radix_select_k(values: jnp.ndarray, k: int,
         # Two-level exact select for rows past the VMEM-resident bound
         # (the reference's multi-block radix_topk role,
         # matrix/detail/select_radix.cuh:877): per-chunk exact top-k,
-        # then ONE exact merge select over the C*k candidate pool. The
-        # pool is laid out chunk-major with each chunk's winners in
-        # ascending-column order, so the merge pass's position-order tie
-        # rule reproduces the global lowest-column tie contract exactly.
+        # then ONE exact merge select over the C*k candidate pool. Tie
+        # contract: within a chunk, EQUAL-key winners keep ascending
+        # column order (equal keys share a strict/tie segment, and each
+        # segment is emitted column-ordered — the full emission is NOT
+        # column-sorted, strict-belows precede ties), and the pool is
+        # chunk-major, so pool position ascends with global column among
+        # equal keys; the merge pass's position-order tie rule therefore
+        # reproduces the global lowest-column contract exactly. The
+        # final stable sort must stay keyed on the sortable key alone.
         n_chunks = cdiv(n_cols, CHUNK_LEN)
         lc = round_up_to_multiple(cdiv(n_cols, n_chunks), 1024)
         kc = jnp.pad(keys, ((0, 0), (0, n_chunks * lc - n_cols)),
