@@ -182,5 +182,9 @@ def run_case(name):
 
 if __name__ == "__main__":
     names = sys.argv[1:] or list(CASES)
-    bad = [n for n in names if not run_case(n)]
-    sys.exit(1 if bad else 0)
+    unknown = [n for n in names if n not in CASES]
+    for n in unknown:
+        print(json.dumps({"case": n, "ok": False, "key": "unknown case"}),
+              flush=True)
+    bad = [n for n in names if n in CASES and not run_case(n)]
+    sys.exit(1 if (bad or unknown) else 0)

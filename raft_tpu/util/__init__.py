@@ -30,7 +30,10 @@ from raft_tpu.util.input_validation import (  # noqa: F401
     expect_same_shape,
 )
 from raft_tpu.util.itertools import product_of_lists  # noqa: F401
-from raft_tpu.util.cache import VectorCache  # noqa: F401
+from raft_tpu.util.cache import (DeviceCacheState,  # noqa: F401
+                                 VectorCache, device_cache_init,
+                                 device_cache_insert,
+                                 device_cache_lookup)
 from raft_tpu.util.precision import (  # noqa: F401
     set_matmul_precision,
     get_matmul_precision,

@@ -474,3 +474,99 @@ class TestSerializeDtypeGrid:
         np.save(buf, a)
         back = np.asarray(serialize.loads(buf.getvalue(), to_device=False))
         np.testing.assert_array_equal(back, a)
+
+
+class TestDeviceCache:
+    """Jit-usable functional cache (ref device primitive:
+    util/cache_util.cuh in-kernel lookup/assign)."""
+
+    def test_insert_lookup_roundtrip(self):
+        from raft_tpu.util import (device_cache_init, device_cache_insert,
+                                   device_cache_lookup)
+
+        st = device_cache_init(n_vec=4, capacity=32, associativity=4)
+        keys = jnp.asarray([3, 7, 100], jnp.int32)
+        vecs = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+        st = device_cache_insert(st, keys, vecs)
+        out, hit, st = device_cache_lookup(st, jnp.asarray([7, 3, 5]))
+        np.testing.assert_array_equal(np.asarray(hit), [True, True, False])
+        np.testing.assert_array_equal(np.asarray(out[0]), vecs[1])
+        np.testing.assert_array_equal(np.asarray(out[1]), vecs[0])
+        np.testing.assert_array_equal(np.asarray(out[2]), np.zeros(4))
+
+    def test_lru_eviction_respects_touch(self):
+        from raft_tpu.util import (device_cache_init, device_cache_insert,
+                                   device_cache_lookup)
+
+        # one set, two ways: insert a,b; touch a; insert c -> b evicted
+        st = device_cache_init(n_vec=2, capacity=2, associativity=2)
+        st = device_cache_insert(st, jnp.asarray([10]),
+                                 jnp.asarray([[1.0, 1.0]]))
+        st = device_cache_insert(st, jnp.asarray([20]),
+                                 jnp.asarray([[2.0, 2.0]]))
+        _, hit, st = device_cache_lookup(st, jnp.asarray([10]))  # touch a
+        assert bool(hit[0])
+        st = device_cache_insert(st, jnp.asarray([30]),
+                                 jnp.asarray([[3.0, 3.0]]))
+        _, hit, st = device_cache_lookup(st, jnp.asarray([10, 20, 30]))
+        np.testing.assert_array_equal(np.asarray(hit),
+                                      [True, False, True])
+
+    def test_overwrite_existing_key(self):
+        from raft_tpu.util import (device_cache_init, device_cache_insert,
+                                   device_cache_lookup)
+
+        st = device_cache_init(n_vec=2, capacity=8, associativity=2)
+        st = device_cache_insert(st, jnp.asarray([5]),
+                                 jnp.asarray([[1.0, 1.0]]))
+        st = device_cache_insert(st, jnp.asarray([5]),
+                                 jnp.asarray([[9.0, 9.0]]))
+        out, hit, _ = device_cache_lookup(st, jnp.asarray([5]))
+        assert bool(hit[0])
+        np.testing.assert_array_equal(np.asarray(out[0]), [9.0, 9.0])
+        # overwrote in place: no second copy of the key in its set
+        assert int((np.asarray(st.keys) == 5).sum()) == 1
+
+    def test_scan_carry_inside_jit(self):
+        """The property the host-driven VectorCache cannot offer: the
+        cache state rides a lax.scan carry with zero host syncs."""
+        from raft_tpu.util import (device_cache_init, device_cache_insert,
+                                   device_cache_lookup)
+
+        st = device_cache_init(n_vec=2, capacity=16, associativity=4)
+
+        @jax.jit
+        def run(st, keys):
+            def step(carry, k):
+                kb = k[None]
+                out, hit, carry = device_cache_lookup(carry, kb)
+                vec = jnp.where(hit[0], out[0],
+                                jnp.stack([k, k]).astype(jnp.float32))
+                carry = device_cache_insert(carry, kb, vec[None])
+                return carry, hit[0]
+            return jax.lax.scan(step, st, keys)
+
+        keys = jnp.asarray([1, 2, 1, 3, 2, 1], jnp.int32)
+        st, hits = run(st, keys)
+        np.testing.assert_array_equal(
+            np.asarray(hits), [False, False, True, False, True, True])
+
+    def test_negative_keys_are_inert(self):
+        """-1 is the empty-slot sentinel: lookups of negative keys always
+        miss (a fresh cache must not 'hit' its own empty markers) and
+        inserts of them are dropped."""
+        from raft_tpu.util import (device_cache_init, device_cache_insert,
+                                   device_cache_lookup)
+
+        st = device_cache_init(n_vec=2, capacity=4, associativity=2)
+        out, hit, st = device_cache_lookup(st, jnp.asarray([-1, -5]))
+        assert not bool(hit[0]) and not bool(hit[1])
+        st = device_cache_insert(st, jnp.asarray([-1]),
+                                 jnp.asarray([[9.0, 9.0]]))
+        assert int((np.asarray(st.keys) >= 0).sum()) == 0  # still empty
+
+    def test_capacity_rounds_up(self):
+        from raft_tpu.util import device_cache_init
+
+        st = device_cache_init(n_vec=1, capacity=48, associativity=32)
+        assert st.keys.size >= 48
