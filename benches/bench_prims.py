@@ -565,11 +565,10 @@ def bench_sparse_prim_probe():
 
     f_pallas_gather = _pallas_same_shape_gather()
 
-    def _pallas_width_gather(width, depth=8):
-        # dynamic_gather rate vs source-row WIDTH: the grid-SpMV kernel-1
-        # runs the (8, 65536) replicated form; (8, 128) is the narrow
-        # single-vreg form a windowed redesign would use. The rate curve
-        # over width is the decision data for shard_w / a window rework.
+    def _pallas_lane_gather(depth=64):
+        # the Mosaic-LEGAL gather form: lane-local (width 128) — wider
+        # sources are "Multiple source vregs along gather dimension"
+        # (round-5 capture falsified the r3 same-shape generalization)
         from raft_tpu.sparse.grid_spmv import _lane_gather
         from raft_tpu.util.pallas_utils import pallas_call
         from jax.experimental import pallas as pl
@@ -579,8 +578,8 @@ def bench_sparse_prim_probe():
             o_ref[:] = _lane_gather(x_ref[:], i_ref[:])
 
         def run(xv, iv):
-            x2 = jnp.broadcast_to(xv[:width][None, :], (depth, width))
-            i2 = (iv % width).reshape(-1, depth, width)
+            x2 = jnp.broadcast_to(xv[:128][None, :], (depth, 128))
+            i2 = (iv % 128).reshape(-1, depth, 128)
 
             def one(i_blk):
                 return pallas_call(
@@ -588,7 +587,37 @@ def bench_sparse_prim_probe():
                     in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
                               pl.BlockSpec(memory_space=pltpu.VMEM)],
                     out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-                    out_shape=jax.ShapeDtypeStruct((depth, width),
+                    out_shape=jax.ShapeDtypeStruct((depth, 128),
+                                                   jnp.float32),
+                )(x2, i_blk)
+
+            return jax.lax.map(one, i2)
+
+        return jax.jit(run)
+
+    def _pallas_tree_gather(shard_w, depth=64):
+        # the production wide-range form: row-broadcast select tree over
+        # a (shard_w/128, 128) source — grid SpMV kernel 1's primitive;
+        # the rate curve over shard_w prices the tree depth
+        from raft_tpu.sparse.grid_spmv import _tree_gather
+        from raft_tpu.util.pallas_utils import pallas_call
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kern(x_ref, i_ref, o_ref):
+            o_ref[:] = _tree_gather(x_ref[:], i_ref[:], i_ref.shape[0])
+
+        def run(xv, iv):
+            x2 = xv[:shard_w].reshape(shard_w // 128, 128)
+            i2 = (iv % shard_w).reshape(-1, depth, 128)
+
+            def one(i_blk):
+                return pallas_call(
+                    kern,
+                    in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                              pl.BlockSpec(memory_space=pltpu.VMEM)],
+                    out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((depth, 128),
                                                    jnp.float32),
                 )(x2, i_blk)
 
@@ -598,9 +627,12 @@ def bench_sparse_prim_probe():
 
     n_probe = min(e, 1 << 22)
     probes_w = [
-        run_case(f"sparse/probe_dg_width{w}", _pallas_width_gather(w),
+        run_case("sparse/probe_dg_width128", _pallas_lane_gather(),
+                 x, idx[:n_probe], items=n_probe, width=128)
+    ] + [
+        run_case(f"sparse/probe_tree_gather{w}", _pallas_tree_gather(w),
                  x, idx[:n_probe], items=n_probe, width=w)
-        for w in (128, 2048, 65536) if w <= n
+        for w in (1024, 8192, 65536) if w <= n
     ]
 
     f_gather = jax.jit(lambda v, i: v[i])

@@ -217,6 +217,15 @@ def device_cache_init(n_vec: int, capacity: int, associativity: int = 32,
         clock=jnp.zeros((), jnp.int32))
 
 
+def _cache_set_of(keys, n_sets):
+    """ONE spelling of the sentinel contract for lookup AND insert:
+    returns (valid, set_index) with invalid (negative) keys mapped to the
+    out-of-range index n_sets — scatters drop them (mode='drop') and
+    gathers clamp them (the matching mask is already False)."""
+    valid = keys >= 0
+    return valid, jnp.where(valid, keys % n_sets, n_sets)
+
+
 def device_cache_lookup(state: DeviceCacheState, keys):
     """Batched lookup: ``(vecs [B, n_vec], hit [B] bool, new_state)``.
 
@@ -225,13 +234,14 @@ def device_cache_lookup(state: DeviceCacheState, keys):
     update); missed rows return zeros with ``hit=False``.
     """
     keys = jnp.asarray(keys, jnp.int32)
-    valid = keys >= 0          # negative = the empty-slot sentinel domain
-    s = jnp.where(valid, keys, 0) % state.n_sets       # [B]
-    set_keys = state.keys[s]                           # [B, assoc]
+    valid, s = _cache_set_of(keys, state.n_sets)
+    set_keys = state.keys[jnp.minimum(s, state.n_sets - 1)]  # [B, assoc]
     match = (set_keys == keys[:, None]) & valid[:, None]
     hit = jnp.any(match, axis=1)
     way = jnp.argmax(match, axis=1)
-    vecs = jnp.where(hit[:, None], state.store[s, way], 0)
+    vecs = jnp.where(hit[:, None],
+                     state.store[jnp.minimum(s, state.n_sets - 1), way],
+                     0)
     clock = state.clock + 1
     # touch hits (duplicate (s, way) pairs collapse to one write — any
     # winner carries the same new timestamp)
@@ -249,14 +259,15 @@ def device_cache_insert(state: DeviceCacheState, keys, vecs
     the set's LRU way (empty ways first). Batch contract (same as the
     reference's AssignCacheIdx batching): keys within one batch should
     be distinct; two same-set keys in one batch may pick the same victim
-    way, in which case the later row wins. Negative keys (the empty-slot
-    sentinel domain) are dropped.
+    way, in which case WHICH row wins is unspecified (XLA leaves
+    duplicate-index scatter order open) — dedup batches for
+    deterministic contents. Negative keys (the empty-slot sentinel
+    domain) are dropped.
     """
     keys = jnp.asarray(keys, jnp.int32)
     vecs = jnp.asarray(vecs)
-    valid = keys >= 0
-    s = jnp.where(valid, keys % state.n_sets, state.n_sets)
-    set_keys = state.keys[s]                           # [B, assoc]
+    valid, s = _cache_set_of(keys, state.n_sets)
+    set_keys = state.keys[jnp.minimum(s, state.n_sets - 1)]  # [B, assoc]
     match = set_keys == keys[:, None]
     present = jnp.any(match, axis=1)
     hit_way = jnp.argmax(match, axis=1)
