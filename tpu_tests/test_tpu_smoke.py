@@ -7,6 +7,8 @@ where it asserts the REAL kernel lowered (no fallback; VERDICT #3's
 "fails if the fallback triggers" test).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -463,3 +465,90 @@ class TestRadixSelectMaxKOnChip:
         np.testing.assert_array_equal(np.asarray(gi), order)
         np.testing.assert_array_equal(
             np.asarray(gv), np.take_along_axis(v, order, 1))
+
+
+class TestTwoLevelRadixOnChip:
+    def test_two_level_radix_past_chunk_bound(self):
+        """Rows past CHUNK_LEN run the per-chunk + merge scheme (round
+        5); exact agreement with the host oracle incl. cross-chunk
+        duplicate minima."""
+        import jax.numpy as jnp
+
+        from raft_tpu.matrix.radix_select import CHUNK_LEN, radix_select_k
+
+        rng = np.random.default_rng(47)
+        L = CHUNK_LEN + 65536
+        v = rng.normal(size=(4, L)).astype(np.float32)
+        v[0, 3] = v[0, L - 2] = v[0].min() - 1.0   # cross-chunk dupes
+        gv, gi = radix_select_k(jnp.asarray(v), 32)
+        order = np.argsort(v, axis=1, kind="stable")[:, :32]
+        np.testing.assert_array_equal(np.asarray(gi), order)
+        np.testing.assert_array_equal(
+            np.asarray(gv), np.take_along_axis(v, order, 1))
+
+
+class TestFusedSpMMOnChip:
+    def test_spmm_fused_matches_column_loop(self):
+        """The KT-fused SpMM against the per-column SpMV loop and
+        scipy, on the compiled kernels (round 5: both ride the tree
+        gather; the fused pass additionally exercises the KT grid and
+        the 5-D chunk view)."""
+        import jax
+        import jax.numpy as jnp
+        import scipy.sparse as sp
+
+        from raft_tpu.core.sparse_types import CSRMatrix
+        from raft_tpu.sparse import grid_spmv
+
+        rng = np.random.default_rng(48)
+        n, e = 60_000, 300_000
+        r = rng.integers(0, n, e)
+        c = rng.integers(0, n, e)
+        d = rng.normal(size=e).astype(np.float32)
+        A = sp.csr_matrix((d, (r, c)), shape=(n, n))
+        A.sum_duplicates()
+        plan = grid_spmv.prepare(CSRMatrix.from_scipy(A))
+        B = rng.normal(size=(n, 16)).astype(np.float32)
+        fused = np.asarray(jax.jit(grid_spmv.spmm)(plan, jnp.asarray(B)))
+        loop = np.stack([np.asarray(grid_spmv.spmv(plan, B[:, j]))
+                         for j in range(16)], axis=1)
+        np.testing.assert_allclose(fused, loop, rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(fused, A @ B, rtol=3e-4, atol=3e-4)
+
+
+class TestMSTGridOnChip:
+    def test_mst_grid_agrees_with_xla_and_scipy(self):
+        """The Pallas Borůvka E-stage (forced RAFT_TPU_MST=grid) against
+        the XLA cascade and scipy's MST total weight, on the compiled
+        kernels."""
+        import scipy.sparse as sp
+        from scipy.sparse.csgraph import minimum_spanning_tree
+
+        from raft_tpu.core.sparse_types import CSRMatrix
+        from raft_tpu.sparse.solver import mst
+
+        rng = np.random.default_rng(49)
+        n, m = 30_000, 120_000
+        r = rng.integers(0, n, m)
+        c = rng.integers(0, n, m)
+        keep = r != c
+        r, c = r[keep], c[keep]
+        w = (rng.random(len(r)) + 0.01).astype(np.float32)
+        A = sp.csr_matrix(
+            (np.concatenate([w, w]),
+             (np.concatenate([r, c]), np.concatenate([c, r]))),
+            shape=(n, n))
+        A.sum_duplicates()
+        want = minimum_spanning_tree(A).sum()
+        totals = {}
+        for method in ("grid", "xla"):
+            os.environ["RAFT_TPU_MST"] = method
+            try:
+                csr = CSRMatrix.from_scipy(A)   # fresh: no cached plan
+                out = mst(None, csr,
+                          color=np.arange(n, dtype=np.int32))
+                totals[method] = float(np.asarray(out.weights).sum()) / 2
+            finally:
+                os.environ.pop("RAFT_TPU_MST", None)
+        assert abs(totals["grid"] - totals["xla"]) <= 1e-3
+        assert abs(totals["grid"] - want) <= 1e-3 * max(1.0, want)
