@@ -107,6 +107,25 @@ def main():
             emit(case="prepared_loop", tier="high",
                  ms_per_iter=round(ms, 3),
                  iters_per_s=round(1e3 / ms, 2))
+            # counts on the MXU (ones @ one-hot) vs the VPU reduce — the
+            # round-5 epilogue lever candidate: the epilogue is VPU-bound
+            # (BASELINE roofline), this trades its counts pass onto the
+            # matrix unit (raw kernel, not the full step: the delta is
+            # what matters)
+            from raft_tpu.linalg.contractions import fused_lloyd_prepared
+
+            for cm in (False, True):
+                try:
+                    ms2 = time_loop(
+                        lambda: fused_lloyd_prepared(ops_prep, c, **meta,
+                                                     counts_mxu=cm),
+                        iters)
+                    emit(case="counts_mxu", counts_mxu=cm, tier="high",
+                         ms_per_iter=round(ms2, 3),
+                         iters_per_s=round(1e3 / ms2, 2))
+                except Exception as e:   # noqa: BLE001
+                    emit(case="counts_mxu", counts_mxu=cm,
+                         error=f"{type(e).__name__}: {e}"[:200])
     except Exception as e:   # noqa: BLE001
         emit(case="prepared_loop", error=f"{type(e).__name__}: {e}"[:200])
     finally:

@@ -991,7 +991,7 @@ def _lloyd_kernel(x_ref, y_ref, sums_ref, counts_ref, val_ref, idx_ref, *,
 def _lloyd_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
                         sums_ref, counts_ref, val_ref, idx_ref, *,
                         tm: int, n_valid: int, m_valid: int,
-                        packed: bool = False):
+                        packed: bool = False, counts_mxu: bool = False):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -1027,22 +1027,34 @@ def _lloyd_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
                         + jnp.dot(ohb.T, xl_ref[:],
                                   preferred_element_type=f32,
                                   precision=_ONE_PASS))
-    # convert-on-reduce: one fused pass (accumulate bf16 inputs into an
-    # f32 sum) instead of a full (tm, np_) astype pass + a reduce —
-    # counts <= tm are exact in f32
-    counts_ref[:] += jnp.sum(ohb, axis=0, keepdims=True, dtype=f32)
+    if counts_mxu:
+        # counts as ONE MXU row-vector dot (1s @ one-hot) instead of a
+        # (tm, np_) VPU reduce — the epilogue is VPU-bound (BASELINE
+        # roofline), so trading the reduce onto the matrix unit is a
+        # candidate lever; tune case 'counts_mxu' prices it (r5)
+        ones = jnp.ones((1, tm), jnp.bfloat16)
+        counts_ref[:] += jnp.dot(ones, ohb, preferred_element_type=f32,
+                                 precision=_ONE_PASS)
+    else:
+        # convert-on-reduce: one fused pass (accumulate bf16 inputs into
+        # an f32 sum) instead of a full (tm, np_) astype pass + a reduce
+        # — counts <= tm are exact in f32
+        counts_ref[:] += jnp.sum(ohb, axis=0, keepdims=True, dtype=f32)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("tm", "n_valid", "m_valid", "packed"))
+                   static_argnames=("tm", "n_valid", "m_valid", "packed",
+                                    "counts_mxu"))
 def _fused_lloyd_padded_split(xh, xl, xn, yh, yl, yn, tm: int,
                               n_valid: int, m_valid: int,
-                              packed: bool = False):
+                              packed: bool = False,
+                              counts_mxu: bool = False):
     m, kp = xh.shape
     np_ = yh.shape[0]
     vma, (xh, xl, xn, yh, yl, yn) = join_vma(xh, xl, xn, yh, yl, yn)
     kernel = functools.partial(_lloyd_kernel_split, tm=tm, n_valid=n_valid,
-                               m_valid=m_valid, packed=packed)
+                               m_valid=m_valid, packed=packed,
+                               counts_mxu=counts_mxu)
     return pallas_call(
         kernel,
         grid=(m // tm,),
@@ -1184,6 +1196,7 @@ def lloyd_prepare(x, n_clusters: int, tm: Optional[int] = None):
 
 @with_matmul_precision
 def fused_lloyd_prepared(ops, y, *, tm: int, m: int,
+                         counts_mxu: bool = False,
                          packed: Optional[bool] = None
                          ) -> Tuple[jnp.ndarray, jnp.ndarray,
                                     jnp.ndarray, jnp.ndarray]:
@@ -1202,7 +1215,8 @@ def fused_lloyd_prepared(ops, y, *, tm: int, m: int,
     yh, yl = _split_hi_lo(yp)
     yn = _sq_norms(yp)[None, :]
     sums, counts, val, idx = _fused_lloyd_padded_split(
-        xh, xl, xn, yh, yl, yn, tm, n, m, packed=packed)
+        xh, xl, xn, yh, yl, yn, tm, n, m, packed=packed,
+        counts_mxu=counts_mxu)
     return (sums[:n, :k], counts[0, :n],
             jnp.maximum(val[0, :m], 0.0), idx[0, :m])
 

@@ -139,8 +139,13 @@ def preferred(n_cols: int, k: int) -> bool:
     """The single source of truth for the dispatch band where radix is
     expected to win (select_k AUTO and the chunked kNN path both gate on
     this): the round-3 grid showed lax.top_k ~50x under the bandwidth
-    roofline exactly at 16 < k <= 2048 on long rows. Re-derive from
-    ci/derive_select_k.py when the four-way grid rows land."""
+    roofline exactly at 16 < k <= 2048 on long rows, and the round-5
+    1M-length capture extends the win past 2048 (k=10000: radix 65.5 ms
+    vs direct 115, tiled 270; k=2048: 45.9 vs 59.6) — the wide-k band is
+    gated to long rows where that evidence exists. Re-derive from
+    ci/derive_select_k.py when the radix-inclusive four-way grid lands."""
+    if n_cols >= (1 << 20) and 2048 < k <= MAX_K:
+        return True
     return n_cols >= MIN_COLS and 16 < k <= 2048
 
 

@@ -232,12 +232,14 @@ def select_k(res, values, k: int, select_min: bool = True,
                 and not interpret_needs_ref(values))
 
     if algo == SelectAlgo.AUTO:
-        # Roofline-motivated dispatch, pending the four-way hardware
-        # grid: radix takes the band where the measured grid showed
-        # lax.top_k ~50x under the bandwidth roofline
-        # (radix_select.preferred — shared with the chunked kNN gate).
-        # Outside the band the grid's measured winners stand (direct at
-        # (1M, 10^4)); thresholds re-derive from ci/derive_select_k.py.
+        # Roofline-motivated dispatch: radix takes the band where the
+        # measured grids showed lax.top_k ~50x under the bandwidth
+        # roofline, extended past k=2048 on 1M rows by the round-5
+        # capture (radix won every k >= 256 there, incl. 10^4:
+        # 65.5 ms vs direct 115) — radix_select.preferred is the single
+        # source of truth, shared with the chunked kNN gate. Outside
+        # the band: direct for small k, tiled per _choose_tiled;
+        # thresholds re-derive from ci/derive_select_k.py.
         if radix_select.preferred(n_cols, k) and _radix_ok():
             mode = "radix"
         elif _choose_tiled(n_rows, n_cols, k):

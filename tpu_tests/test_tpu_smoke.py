@@ -541,6 +541,7 @@ class TestMSTGridOnChip:
         A.sum_duplicates()
         want = minimum_spanning_tree(A).sum()
         totals = {}
+        prev = os.environ.get("RAFT_TPU_MST")
         for method in ("grid", "xla"):
             os.environ["RAFT_TPU_MST"] = method
             try:
@@ -549,6 +550,9 @@ class TestMSTGridOnChip:
                           color=np.arange(n, dtype=np.int32))
                 totals[method] = float(np.asarray(out.weights).sum()) / 2
             finally:
-                os.environ.pop("RAFT_TPU_MST", None)
+                if prev is None:
+                    os.environ.pop("RAFT_TPU_MST", None)
+                else:
+                    os.environ["RAFT_TPU_MST"] = prev
         assert abs(totals["grid"] - totals["xla"]) <= 1e-3
         assert abs(totals["grid"] - want) <= 1e-3 * max(1.0, want)
