@@ -85,6 +85,12 @@ jax.jit(grid_spmv.spmv).lower(plan, tpu_struct((n,), jnp.float32)
                               ).compile()
 jax.jit(grid_spmv.spmm).lower(plan, tpu_struct((n, 16), jnp.float32)
                               ).compile()
+# the WIDE auto-shard variant (512-row unrolled tree) that full-scale
+# benches pick — a narrow-only preflight would miss its failures
+plan_w = grid_spmv.prepare(CSRMatrix.from_scipy(a),
+                           shard_w=grid_spmv.SHARD_W_MAX)
+jax.jit(grid_spmv.spmv).lower(plan_w, tpu_struct((n,), jnp.float32)
+                              ).compile()
 print("PRE_OK")
 """,
     # -- MST grid E-stage ---------------------------------------------

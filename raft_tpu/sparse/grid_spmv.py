@@ -13,13 +13,17 @@ This module replaces both sides with Mosaic-expressible structure:
   LANE-LOCAL: the source may span at most one vreg (128 lanes) along the
   gather dimension ("Multiple source vregs along gather dimension", round-5
   hardware capture; the round-3 width-128 probe did not generalize).  So x
-  is tiled into column shards of SHARD_W=8192 held as a (64, 128) VMEM
-  block, and kernel 1 gathers each slot tile through a ROW-BROADCAST SELECT
-  TREE: for each of the 64 shard rows, broadcast the row across the block's
-  sublanes, one legal 128-wide ``take_along_axis`` on the low 7 index bits,
-  and a mask-accumulate where the high bits match the row.  Tiles are
-  packed per shard in groups of GROUP_TILES so one grid step amortizes the
-  tree over GROUP_TILES*1024 slots with the shard block resident.
+  is tiled into column shards held as (shard_w/128, 128) VMEM blocks,
+  and kernel 1 gathers each slot tile through a ROW-BROADCAST SELECT
+  TREE: for each shard row, broadcast the row across the block's
+  sublanes, one legal 128-wide ``take_along_axis`` on the low 7 index
+  bits, and a mask-accumulate where the high bits match the row.  Tiles
+  are packed per shard in groups of GROUP_TILES so one grid step
+  amortizes the tree over GROUP_TILES*1024 slots with the shard block
+  resident.  shard_w is DENSITY-ADAPTIVE (_auto_shard_w, 8192..65536):
+  sparse per-shard streams starve the 1024-row tile window and explode
+  padding at narrow shards, while the tree's VPU cost grows with
+  shard_w — the chooser targets ~50% tile fill.
 * **Scatter** — there is no scatter on TPU.  Entries are packed (host-side,
   once per sparsity pattern — the analogue of cusparseSpMV_preprocess) into
   a (tile, sub-row, lane) grid in CSR row order, so each row's products are
@@ -62,10 +66,11 @@ LANES = 128
 SUBROWS = 8
 TILE_SLOTS = LANES * SUBROWS          # 1024
 SPAN_WINDOWS = 8                      # emission range: 8 x 128 rows per tile
-SHARD_W = 8192                        # columns per x shard: the gather
-                                      # tree walks shard_w/128 = 64 rows,
-                                      # the VPU cost per slot of the
-                                      # Mosaic-legal lane-local gather
+SHARD_W_MAX = 65536                   # widest x shard the gather tree
+                                      # walks (512 rows unrolled — the
+                                      # VPU cost per slot scales with
+                                      # shard_w/128)
+SHARD_W_MIN = 8192
 GROUP_TILES = 8                       # tiles per kernel-1 grid step (one
                                       # shard per group; pad granularity)
 
@@ -210,8 +215,33 @@ def _grid_unflatten(aux, leaves):
 jax.tree_util.register_pytree_node(GridSpMV, _grid_flatten, _grid_unflatten)
 
 
+def _auto_shard_w(n_rows: int, n_cols: int, nnz: int,
+                  span_windows: int = SPAN_WINDOWS) -> int:
+    """Density-adaptive shard width. A tile spans <= SPAN_WINDOWS*128
+    rows, so the slots available to fill it are the nnz falling in a
+    (1024-row x shard_w-col) rectangle ~= nnz * (1024/n_rows) *
+    (shard_w/n_cols); below ~50% fill the packer must cut tiles early
+    and padding explodes (measured round 5: uniform 10 nnz/row at 1M^2
+    packs at pad 14.2x with shard_w=8192 but ~1.6x at 65536 — the
+    row-window constraint binds, not the stream). The tree gather's VPU
+    cost scales the OTHER way (shard_w/128 rows walked per block), so
+    pick the narrowest shard whose estimated fill reaches 50%."""
+    if nnz <= 0:
+        return SHARD_W_MIN
+    # fill >= 50%: nnz * (span_windows*LANES rows)/n_rows * (w/n_cols)
+    # >= TILE_SLOTS/2  =>  w >= n_rows*n_cols*TILE_SLOTS /
+    # (2*nnz*span_windows*LANES)
+    span_rows = max(1, span_windows * LANES)
+    need = max(1, (n_rows * max(n_cols, 1) * TILE_SLOTS)
+               // (2 * nnz * span_rows))
+    w = SHARD_W_MIN
+    while w < SHARD_W_MAX and w < need:
+        w *= 2
+    return w
+
+
 def prepare(csr, span_windows: int = SPAN_WINDOWS,
-            shard_w: int = SHARD_W, _collect: dict = None) -> GridSpMV:
+            shard_w: int = None, _collect: dict = None) -> GridSpMV:
     """Build the slot-grid plan from a CSRMatrix (host-side, once per
     pattern — the cusparseSpMV_preprocess analogue).
 
@@ -230,6 +260,8 @@ def prepare(csr, span_windows: int = SPAN_WINDOWS,
     # shrink the shard to the matrix so small patterns don't pad up to
     # the full shard width; a kernel-1 group is GROUP_TILES tiles drawing
     # from ONE shard, so per-shard streams pad to group granularity
+    if shard_w is None:
+        shard_w = _auto_shard_w(n_rows, n_cols, nnz_log, span_windows)
     shard_w = min(shard_w, round_up_to_multiple(max(n_cols, 1), 128))
     n_shards = max(1, cdiv(n_cols, shard_w))
     group_slots = GROUP_TILES * TILE_SLOTS
