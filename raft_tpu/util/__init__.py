@@ -30,6 +30,9 @@ from raft_tpu.util.input_validation import (  # noqa: F401
     expect_same_shape,
 )
 from raft_tpu.util.itertools import product_of_lists  # noqa: F401
+from raft_tpu.util.arch import (ArchRange, TpuArch,  # noqa: F401
+                                mxu_dim, runtime_arch, vmem_bytes,
+                                vreg_shape)
 from raft_tpu.util.cache import (DeviceCacheState,  # noqa: F401
                                  VectorCache, device_cache_init,
                                  device_cache_insert,

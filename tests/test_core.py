@@ -570,3 +570,41 @@ class TestDeviceCache:
 
         st = device_cache_init(n_vec=1, capacity=48, associativity=32)
         assert st.keys.size >= 48
+
+
+class TestTpuArch:
+    """Generation dispatch (ref: util/arch.cuh SM_compute_arch/SM_range)."""
+
+    def test_parse_kinds(self):
+        from raft_tpu.util import TpuArch
+
+        a = TpuArch("TPU v5 lite")
+        assert a.gen == 5 and a.lite
+        b = TpuArch("TPU v4")
+        assert b.gen == 4 and not b.lite
+        c = TpuArch("TPU v5p")
+        assert c.gen == 5 and not c.lite
+        d = TpuArch("cpu")
+        assert d.gen == 0
+        assert TpuArch("TPU v6e") .lite
+
+    def test_range_gate(self):
+        from raft_tpu.util import ArchRange, TpuArch
+
+        r = ArchRange(min_gen=5)
+        assert r.contains(TpuArch("TPU v5 lite"))
+        assert not r.contains(TpuArch("TPU v4"))
+        assert r.contains(TpuArch("cpu"))            # unknown passes
+        assert not ArchRange(min_gen=5, allow_unknown=False).contains(
+            TpuArch("cpu"))
+        assert not ArchRange(min_gen=4, max_gen=4).contains(
+            TpuArch("TPU v5p"))
+
+    def test_capabilities(self):
+        from raft_tpu.util import (TpuArch, mxu_dim, runtime_arch,
+                                   vmem_bytes, vreg_shape)
+
+        assert vmem_bytes(TpuArch("TPU v5 lite")) == 128 * 1024 * 1024
+        assert mxu_dim() == 128
+        assert vreg_shape() == (8, 128)
+        assert runtime_arch().gen >= 0
