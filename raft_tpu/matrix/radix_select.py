@@ -235,8 +235,9 @@ def _threshold_kernel(key_ref, t_ref, ntie_ref, *, k: int):
     ntie_ref[:] = jax.lax.broadcast_in_dim(ntie, (tm, 1, 1), (0, 1))
 
 
-def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
-                 k: int, kh: int, tl: int, tm: int):
+def _emit_kernel(key_ref, t_ref, ntie_ref, lt_ref, eq_ref, out_ref,
+                 less_run, tie_run, *,
+                 k: int, kh: int, tl: int, tm: int, wc: int):
     """Emit each candidate's global column index into its output slot.
 
     rank(candidate) = #earlier-candidates; strict-below-threshold
@@ -248,7 +249,17 @@ def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
     (kh*128,) output block f32-exactly (each slot receives exactly one
     candidate). Batching the rows through one dot keeps the kernel body
     compact (the earlier per-row unrolled loop grew the module with tm
-    and serialized tm small matmuls per grid step)."""
+    and serialized tm small matmuls per grid step).
+
+    DEAD-CHUNK SKIP (round 5): ``lt_ref``/``eq_ref`` hold resident
+    (tm, wc) per-chunk strict/tie counts (precomputed in XLA from the
+    threshold). A chunk with no strict candidate and no tie quota left
+    emits nothing — its whole body (the triangular cumsum matmul, both
+    one-hot builds, the slab dot: the emission's fixed cost) is skipped
+    and the running ranks advance from the precomputed counts. At small
+    k over long rows most chunks are dead (k=16 at 1M: ~2 live of 1024);
+    at k ~ tl all chunks are live and the only cost is the column
+    extraction (~wc/128 vector ops)."""
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -257,9 +268,39 @@ def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
         less_run[:] = jnp.zeros_like(less_run)
         tie_run[:] = jnp.zeros_like(tie_run)
 
+    ntie = ntie_ref[:]                                 # (tm, 1)
+    run_less = less_run[:]                             # (tm, 1) i32
+    run_tie = tie_run[:]
+    iota_w = jax.lax.broadcasted_iota(jnp.int32, (tm, wc), 1)
+    selj = iota_w == j
+    zf = jnp.float32(0.0)
+    lt_j = jnp.sum(jnp.where(selj, lt_ref[:].astype(jnp.float32), zf),
+                   axis=1, keepdims=True).astype(jnp.int32)
+    eq_j = jnp.sum(jnp.where(selj, eq_ref[:].astype(jnp.float32), zf),
+                   axis=1, keepdims=True).astype(jnp.int32)
+    # 32-bit reduction: jnp.any's bool proxy reduces through f64 under
+    # jax_enable_x64 and the scalar squeeze fails Mosaic export (same
+    # class as the fori-index pitfall above)
+    live_v = (lt_j > 0) | ((eq_j > 0) & (run_tie < ntie))
+    live = jnp.max(live_v.astype(jnp.int32)) > 0
+
+    @pl.when(jnp.logical_not(live))
+    def _skip():
+        less_run[:] = run_less + lt_j
+        tie_run[:] = run_tie + eq_j
+
+    @pl.when(live)
+    def _process():
+        _emit_chunk_body(key_ref, t_ref, out_ref, less_run, tie_run,
+                         run_less, run_tie, ntie, lt_j, eq_j, j,
+                         k=k, kh=kh, tl=tl, tm=tm)
+
+
+def _emit_chunk_body(key_ref, t_ref, out_ref, less_run, tie_run,
+                     run_less, run_tie, ntie, lt_j, eq_j, j, *,
+                     k: int, kh: int, tl: int, tm: int):
     key = key_ref[:]                                   # (tm, tl) i32
     t = t_ref[:]                                       # (tm, 1)
-    ntie = ntie_ref[:]                                 # (tm, 1)
     strict = key < t
     tie = key == t
 
@@ -279,8 +320,6 @@ def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
     excl_strict = excl[:tm].astype(jnp.int32)          # (tm, tl)
     excl_tie = excl[tm:].astype(jnp.int32)
 
-    run_less = less_run[:]                             # (tm, 1) i32
-    run_tie = tie_run[:]
     member_tie = tie & ((run_tie + excl_tie) < ntie)
     c_less_total = jnp.int32(k) - ntie
     rank = jnp.where(strict, run_less + excl_strict,
@@ -316,10 +355,10 @@ def _emit_kernel(key_ref, t_ref, ntie_ref, out_ref, less_run, tie_run, *,
             ).reshape(tm, kh * 128)
     out_ref[:] += slab
 
-    less_run[:] = run_less + jnp.sum(
-        strict.astype(jnp.float32), axis=1, keepdims=True).astype(jnp.int32)
-    tie_run[:] = run_tie + jnp.sum(
-        tie.astype(jnp.float32), axis=1, keepdims=True).astype(jnp.int32)
+    # the precomputed per-chunk counts ARE this chunk's strict/tie sums
+    # (same compare against the same threshold) — no extra reductions
+    less_run[:] = run_less + lt_j
+    tie_run[:] = run_tie + eq_j
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -380,15 +419,32 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
     ntie = ntie3.reshape(rp, 1)
 
     tm, tl = tm_e, tl_e
+    # per-chunk strict/tie counts for the emission's dead-chunk skip —
+    # computed in plain XLA (layout-free; one extra streaming pass over
+    # the keys) and held resident in the kernel as (tm, wc) blocks
+    nch = lp // tl
+    wc = round_up_to_multiple(nch, 128)
+    lt_map = jnp.sum((kpad < t).reshape(rp, nch, tl), axis=2,
+                     dtype=jnp.int32)
+    le_map = jnp.sum((kpad <= t).reshape(rp, nch, tl), axis=2,
+                     dtype=jnp.int32)
+    eq_map = le_map - lt_map
+    lt_map = jnp.pad(lt_map, ((0, 0), (0, wc - nch)))
+    eq_map = jnp.pad(eq_map, ((0, 0), (0, wc - nch)))
+
     idx_f = pallas_call(
-        functools.partial(_emit_kernel, k=k, kh=kh, tl=tl, tm=tm),
-        grid=(rp // tm, lp // tl),
+        functools.partial(_emit_kernel, k=k, kh=kh, tl=tl, tm=tm, wc=wc),
+        grid=(rp // tm, nch),
         in_specs=[
             pl.BlockSpec((tm, tl), lambda i, j: (i, j),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((tm, 1), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((tm, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, wc), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, wc), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((tm, kh * 128), lambda i, j: (i, 0),
@@ -398,7 +454,7 @@ def _radix_ranks(keys: jnp.ndarray, k: int) -> jnp.ndarray:
                         pltpu.VMEM((tm, 1), jnp.int32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
-    )(kpad, t, ntie)
+    )(kpad, t, ntie, lt_map, eq_map)
 
     return idx_f[:n_rows, :k].astype(jnp.int32)
 

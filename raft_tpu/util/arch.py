@@ -33,8 +33,10 @@ class TpuArch:
         self.device_kind = str(device_kind)
         low = self.device_kind.lower()
         # anchored to TPU kinds: a bare v\d+ would parse GPU kinds like
-        # "Tesla V100" to a bogus high generation
-        m = re.search(r"tpu\s*v(\d+)", low)
+        # "Tesla V100" to a bogus high generation. Two spellings exist:
+        # "TPU v5 lite"/"TPU v4" and the v7-era "TPU7x"
+        m = (re.search(r"tpu\s*v(\d+)", low)
+             or re.search(r"tpu(\d+)", low))
         self.gen = int(m.group(1)) if m else 0
         self.lite = self.gen > 0 and (
             "lite" in low or bool(re.search(r"v\d+e", low)))
