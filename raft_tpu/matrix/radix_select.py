@@ -20,8 +20,11 @@ Two Pallas kernels over a precomputed sortable-key array:
    `n_tie` = how many threshold-equal elements belong in the output.
 2. `_emit_kernel` — streams the rows once more; per chunk it computes
    each candidate's output slot (a running rank carried across grid
-   steps; the in-chunk exclusive cumsum is a triangular matmul, NOT a
-   lane-shift scan), factorizes the slot one-hot as rank = 128*hi + lo,
+   steps; the in-chunk exclusive cumsum is a rotate+mask log-scan —
+   round 3 used a (tl, tl) triangular matmul because the concat-shift
+   spelling could not lower, round 5's legal pltpu.roll shifts cut that
+   ~2K-cycle MXU cost to ~0.25K VPU), factorizes the slot one-hot as
+   rank = 128*hi + lo,
    and contracts (one-hot_hi * column-index-part) against one-hot_lo on
    the MXU — emitting winner indices without a sort, scatter, or
    variable-length compaction.  Column indices (< 2^24) ride exactly in
@@ -65,21 +68,22 @@ _I32_MAX = 0x7FFFFFFF
 _I32_MIN = -0x80000000
 
 # The emission chunk is deliberately wide (tl = 1024 where it fits):
-# each grid step pays fixed overhead, and the in-chunk cumsum rides a
-# (tl, tl) triangular matmul whose MXU cost (tl MACs/element) stays
-# cheap next to the 128-wide one-hot VPU work.
+# each grid step pays fixed overhead (dominated by the 128-wide one-hot
+# builds; the in-chunk cumsum is a log-step roll scan, ~10 VPU passes).
 
 
 def _emit_live_set_bytes(tm: int, tl: int, kh: int) -> int:
     """Simultaneously-live VMEM of one _emit_kernel grid step: the
     one-hot/index operand `a` (tm, 3kh, tl) bf16 + ohhi (tm, kh, tl)
-    bf16 ride the kh axis; ohlo (tm, tl, 128) bf16, the triangular
-    cumsum mask (tl, tl) bf16, masks/excl (~12 B/elem over (tm, tl)),
-    slabs (tm, 3kh, 128) f32 and the (tm, kh*128) f32 output block."""
+    bf16 ride the kh axis; ohlo (tm, tl, 128) bf16; the roll-scan
+    masks/carry (2tm, tl) f32 x ~2 live + key/excl/rank temporaries
+    (~24 B/elem over (tm, tl)); the per-chunk count blocks
+    (2 x (tm, wc<=1024) i32); slabs (tm, 3kh, 128) f32 and the
+    (tm, kh*128) f32 output block."""
     return (8 * tm * kh * tl          # a + ohhi
             + 256 * tm * tl           # ohlo
-            + 2 * tl * tl             # tri
-            + 16 * tm * tl            # key/masks/excl/rank temporaries
+            + 24 * tm * tl            # key/masks/scan carry/excl/rank
+            + 8 * tm * 1024           # lt/eq count blocks (wc cap)
             + 1536 * tm * kh          # slabs
             + 512 * tm * kh)          # out block
 
@@ -304,19 +308,23 @@ def _emit_chunk_body(key_ref, t_ref, out_ref, less_run, tie_run,
     strict = key < t
     tie = key == t
 
-    # In-chunk EXCLUSIVE cumsums via one triangular matmul (lane-shift
-    # scans need relayouts Mosaic handles poorly; the MXU does not).
-    ci = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
-    cj = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
-    tri = (ci < cj).astype(jnp.bfloat16)               # tri[c', c] = c' < c
+    # In-chunk EXCLUSIVE cumsums via a log-step roll scan — rotate+mask
+    # is the legal lane-shift spelling (round 5; the concat-of-slices
+    # shift needed relayouts Mosaic cannot do, which is why round 3 used
+    # a (tl, tl) triangular MATMUL here: ~tl MACs per element, the
+    # dominant live-chunk cost at ~2K MXU cycles per step vs ~0.25K VPU
+    # for the scan). Counts are integers in f32 — exact under any
+    # association. One fused scan covers both masks (sublane stack).
     masks = jnp.concatenate(
-        [strict.astype(jnp.bfloat16), tie.astype(jnp.bfloat16)], axis=0)
-    # precision pinned: bf16 x bf16 -> f32 is exact at DEFAULT, and an
-    # ambient jax_default_matmul_precision of HIGH (set by knn's
-    # with_matmul_precision scope) would otherwise ride into this dot —
-    # Mosaic rejects Precision.HIGH on kernel dots
-    excl = jnp.dot(masks, tri, preferred_element_type=jnp.float32,
-                   precision=jax.lax.Precision.DEFAULT)
+        [strict.astype(jnp.float32), tie.astype(jnp.float32)], axis=0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, masks.shape, 1)
+    c = masks
+    d = 1
+    while d < tl:
+        r = pltpu.roll(c, jnp.int32(d), 1)
+        c = c + jnp.where(lane >= d, r, jnp.float32(0.0))
+        d *= 2
+    excl = c - masks                                   # exclusive
     excl_strict = excl[:tm].astype(jnp.int32)          # (tm, tl)
     excl_tie = excl[tm:].astype(jnp.int32)
 
