@@ -65,3 +65,17 @@ def ring_of_cliques(n_cliques=4, size=8):
         v = ((i + 1) % n_cliques) * size + 1
         a[u, v] = a[v, u] = 1.0
     return CSRMatrix.from_scipy(sp.csr_matrix(a).astype(np.float32))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jax_caches_per_module():
+    """Round-5 regression guard: with the suite at ~690 tests, the CPU
+    PJRT client segfaults DETERMINISTICALLY inside an XLA compile near
+    test #669 (jax compiler.py backend_compile_and_load — reproduced 3x
+    at the same test, never in any subset; the accumulated live-
+    executable state is the only full-suite-scale variable). Dropping
+    the jit caches at module boundaries keeps the executable population
+    bounded; per-module recompiles cost seconds against a ~30-minute
+    suite."""
+    yield
+    jax.clear_caches()
