@@ -518,13 +518,15 @@ def _spmm_k16_rows(plan, rng, n, nnz):
 @bench("sparse/prim_probe")
 def bench_sparse_prim_probe():
     """On-chip throughput of the primitives a TPU SpMV redesign could
-    be built from. Mosaic's vector gather requires SAME-SHAPE
-    source/index operands (probed in round 3), which rules out a
-    narrow-index gather from a wide resident x — but NOT a same-shape
-    formulation: probe_pallas_rowwise_gather measures a (rows, W)-from-
-    (rows, W) in-kernel gather, the primitive an nnz-blocked SpMV would
-    be built on. The XLA gather / segment-sum / sort / scan rates bound
-    the non-Pallas alternatives; the redesign verdict gets written into
+    be built from. Mosaic's `tpu.dynamic_gather` is LANE-LOCAL: at most
+    one source vreg (width 128) along the gather dimension — the round-3
+    same-shape "(rows, W)-from-(rows, W)" generalization was falsified
+    on hardware in the round-5 capture ("Multiple source vregs along
+    gather dimension" at W=16384), so the wide rowwise probe is gone.
+    What remains: the legal lane-128 gather, the production tree-gather
+    rate curve over shard widths (grid SpMV kernel 1's primitive), and
+    the XLA gather / segment-sum / sort / scan rates that bound the
+    non-Pallas alternatives; the redesign verdict gets written into
     sparse/ell.py from these rows."""
     full = SIZES["rows"] >= (1 << 20)
     n = (1 << 20) if full else (1 << 14)
@@ -534,36 +536,6 @@ def bench_sparse_prim_probe():
     idx = jnp.asarray(rng.integers(0, n, size=e).astype(np.int32))
     seg = jnp.asarray(np.sort(rng.integers(0, n, size=e)).astype(np.int32))
     vals = jnp.asarray(rng.random(e).astype(np.float32))
-
-    def _pallas_same_shape_gather():
-        # Mosaic's vector gather REQUIRES same-shape source/index. A
-        # (1, n)-from-(1, n) gather is therefore expressible — if its
-        # on-chip rate is good, SpMV can gather x for nnz in n-sized
-        # blocks (src = x itself). This kernel measures that rate.
-        from jax.experimental import pallas as pl
-        from jax.experimental.pallas import tpu as pltpu
-
-        from raft_tpu.util.pallas_utils import pallas_call
-
-        rows = max(n // (128 * 128), 8)
-
-        def kern(x_ref, i_ref, o_ref):
-            o_ref[:] = jnp.take_along_axis(x_ref[:], i_ref[:], axis=1)
-
-        def run(xv, iv):
-            x2 = xv.reshape(rows, -1)
-            i2 = (iv % x2.shape[1]).reshape(rows, -1)
-            return pallas_call(
-                kern,
-                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
-                          pl.BlockSpec(memory_space=pltpu.VMEM)],
-                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-                out_shape=jax.ShapeDtypeStruct(x2.shape, jnp.float32),
-            )(x2, i2)
-
-        return jax.jit(run)
-
-    f_pallas_gather = _pallas_same_shape_gather()
 
     def _pallas_lane_gather(depth=64):
         # the Mosaic-LEGAL gather form: lane-local (width 128) — wider
@@ -645,8 +617,6 @@ def bench_sparse_prim_probe():
     f_cumsum = jax.jit(jnp.cumsum)
 
     return probes_w + [
-        run_case("sparse/probe_pallas_rowwise_gather", f_pallas_gather,
-                 x, idx[:n], items=n),
         run_case("sparse/probe_gather", f_gather, x, idx, items=e),
         run_case("sparse/probe_take", f_take, x, idx, items=e),
         run_case("sparse/probe_take_sorted", f_gather_sorted, x, seg,
