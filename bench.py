@@ -154,26 +154,30 @@ def run():
 
     # Prepared path when it applies (tier 'high', f32, resident): the
     # loop-invariant X split+norms are hoisted exactly as kmeans_fit's
-    # own loop does — bit-identical steps, ~1.3 GB/iter less HBM traffic.
-    from raft_tpu.cluster.kmeans import lloyd_step_prepared
+    # own loop does — bit-identical steps, ~1.3 GB/iter less HBM
+    # traffic — and the whole iteration block rides ONE compiled scan
+    # (kmeans_fit's between-polls structure: one launch per block, so
+    # neither tunnel RTT nor lost cross-launch overlap taxes the
+    # chain — see lloyd_iterate_prepared).
+    from raft_tpu.cluster.kmeans import lloyd_iterate_prepared
     from raft_tpu.linalg.contractions import lloyd_prepare
 
     ops, meta = lloyd_prepare(x, n_clusters)
     if ops is not None:
         jax.block_until_ready(ops)
-        cc, inertia, _ = lloyd_step_prepared(ops, c, **meta)
-        float(inertia)                       # warm the prepared executable
+        cc, inertia, _ = lloyd_iterate_prepared(ops, c, iters, **meta)
+        float(inertia)                       # warm the scanned executable
 
-        def step(cc):
-            return lloyd_step_prepared(ops, cc, **meta)
+        def run_block(cc):
+            return lloyd_iterate_prepared(ops, cc, iters, **meta)
     else:
-        def step(cc):
-            return lloyd_step(x, cc, n_clusters)
+        def run_block(cc):
+            for _ in range(iters):
+                cc, inertia, labels = lloyd_step(x, cc, n_clusters)
+            return cc, inertia, labels
 
     t0 = time.perf_counter()
-    cc = c
-    for _ in range(iters):
-        cc, inertia, labels = step(cc)
+    cc, inertia, labels = run_block(c)
     float(inertia)  # true synchronization point
     dt = time.perf_counter() - t0
 

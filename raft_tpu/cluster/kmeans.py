@@ -139,6 +139,41 @@ def lloyd_step_prepared(ops, centroids, *, tm: int, m: int):
     return new_centroids, jnp.sum(dist), labels
 
 
+@with_matmul_precision
+@functools.partial(jax.jit, static_argnames=("n_steps", "tm", "m"))
+def lloyd_iterate_prepared(ops, centroids, n_steps: int, *, tm: int, m: int):
+    """``n_steps`` prepared Lloyd iterations compiled as ONE device
+    program — ``lax.scan`` over :func:`lloyd_step_prepared`'s body.
+
+    On a remote-dispatch runtime every program launch pays tunnel RTT
+    and forfeits the cross-launch overlap the on-device scheduler gets
+    inside one program, so the iterations between convergence polls
+    (``KMeansParams.check_every``) should ride a single launch. The scan
+    chains the centroid carry on device and returns the final step's
+    ``(centroids, inertia, labels)`` — the same triple a sequence of
+    ``n_steps`` :func:`lloyd_step_prepared` calls ends with,
+    bit-identically (same kernel, same operand bytes, same order).
+    Reference lineage: the host loop enqueueing fused kernels
+    back-to-back (SURVEY §3 kmeans fit call stack); the scan is the
+    jit-native spelling of "enqueue N".
+    """
+    if n_steps < 1:
+        raise ValueError(f"n_steps must be >= 1, got {n_steps}")
+    from raft_tpu.linalg.contractions import fused_lloyd_prepared
+
+    def body(carry, _):
+        c = carry[0]
+        sums, counts, dist, labels = fused_lloyd_prepared(
+            ops, c, tm=tm, m=m)
+        new_c = _finish_update(sums, counts, c)
+        return (new_c, jnp.sum(dist), labels), None
+
+    init = (centroids, jnp.asarray(jnp.inf, jnp.float32),
+            jnp.zeros((m,), jnp.int32))
+    (c, inertia, labels), _ = jax.lax.scan(body, init, None, length=n_steps)
+    return c, inertia, labels
+
+
 def _weighted_sums(x, w, labels, dist, n_clusters: int):
     """Weighted (sums, counts, inertia_term) from an assignment — the
     scatter-free one-hot contraction with w-scaled rows, shared by the
@@ -352,21 +387,36 @@ def kmeans_fit(res, params: KMeansParams, x,
 
     ops, meta = (None, None) if w is not None \
         else lloyd_prepare(x, params.n_clusters)
-    for n_iter in range(1, params.max_iter + 1):
-        if ops is not None:
-            c, inertia, labels = lloyd_step_prepared(ops, c, **meta)
-        elif w is None:
-            c, inertia, labels = lloyd_step(x, c, params.n_clusters)
-        else:
-            c, inertia, labels = weighted_lloyd_step(
-                x, w, c, params.n_clusters)
-        if n_iter % check and n_iter != params.max_iter:
-            continue                     # no host sync between polls
-        if prev_inertia is not None and \
-                abs(prev_inertia - float(inertia)) <= \
-                params.tol * max(prev_inertia, 1e-30):
-            break
-        prev_inertia = float(inertia)
+    if ops is not None:
+        # Prepared path: run each between-polls block of iterations as
+        # ONE compiled scan (one launch per block instead of per step —
+        # see lloyd_iterate_prepared). Identical iteration sequence and
+        # poll points as the per-step loop below.
+        n_iter = 0
+        while n_iter < params.max_iter:
+            block = min(check, params.max_iter - n_iter)
+            c, inertia, labels = lloyd_iterate_prepared(
+                ops, c, block, **meta)
+            n_iter += block
+            if prev_inertia is not None and \
+                    abs(prev_inertia - float(inertia)) <= \
+                    params.tol * max(prev_inertia, 1e-30):
+                break
+            prev_inertia = float(inertia)
+    else:
+        for n_iter in range(1, params.max_iter + 1):
+            if w is None:
+                c, inertia, labels = lloyd_step(x, c, params.n_clusters)
+            else:
+                c, inertia, labels = weighted_lloyd_step(
+                    x, w, c, params.n_clusters)
+            if n_iter % check and n_iter != params.max_iter:
+                continue                 # no host sync between polls
+            if prev_inertia is not None and \
+                    abs(prev_inertia - float(inertia)) <= \
+                    params.tol * max(prev_inertia, 1e-30):
+                break
+            prev_inertia = float(inertia)
     # lloyd_step's labels/inertia are measured against its *input* centroids;
     # re-assign ONCE so the returned triple is self-consistent (one pass
     # serves both labels and the [weighted] inertia).

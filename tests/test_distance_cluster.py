@@ -501,3 +501,36 @@ def test_lloyd_prepared_bit_identical():
         assert lloyd_prepare(big, 20000) == (None, None)
     finally:
         raft_tpu.set_matmul_precision(old)
+
+
+def test_lloyd_iterate_prepared_matches_stepped():
+    """The scanned iteration block (lloyd_iterate_prepared) must end at
+    the SAME (centroids, inertia, labels) as the same number of chained
+    lloyd_step_prepared calls, bit-identically — it is the one-launch
+    spelling of the between-polls loop, not a different algorithm."""
+    import jax.numpy as jnp
+    import raft_tpu
+    from raft_tpu.cluster.kmeans import (lloyd_iterate_prepared,
+                                         lloyd_step_prepared)
+    from raft_tpu.linalg.contractions import lloyd_prepare
+
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(700, 33)).astype(np.float32))
+    c0 = jnp.asarray(rng.normal(size=(37, 33)).astype(np.float32))
+    old = raft_tpu.get_matmul_precision()
+    try:
+        raft_tpu.set_matmul_precision("high")
+        ops, meta = lloyd_prepare(x, 37)
+        assert ops is not None
+        c = c0
+        for _ in range(3):
+            c, inertia, labels = lloyd_step_prepared(ops, c, **meta)
+        got = lloyd_iterate_prepared(ops, c0, 3, **meta)
+        for a, b, name in zip((c, inertia, labels), got,
+                              ("centroids", "inertia", "labels")):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+        with pytest.raises(ValueError):
+            lloyd_iterate_prepared(ops, c0, 0, **meta)
+    finally:
+        raft_tpu.set_matmul_precision(old)
