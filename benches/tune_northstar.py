@@ -126,6 +126,35 @@ def main():
                 except Exception as e:   # noqa: BLE001
                     emit(case="counts_mxu", counts_mxu=cm,
                          error=f"{type(e).__name__}: {e}"[:200])
+            # scanned block (lloyd_iterate_prepared): the whole chain in
+            # ONE launch — prices what per-launch overhead + lost cross-
+            # launch overlap cost the per-step loop above. Also reports
+            # the fetch RTT so the uncompensated time_loop numbers can
+            # be read net of apparatus (benches/harness.py subtracts it;
+            # time_loop here deliberately does not, so A/B deltas stay
+            # directly comparable across this file's cases).
+            try:
+                from raft_tpu.cluster.kmeans import lloyd_iterate_prepared
+
+                blk = jax.jit(functools.partial(
+                    lloyd_iterate_prepared, n_steps=iters, **meta))
+                out = blk(ops_prep, c)
+                sync(out[1])
+                t0 = time.perf_counter()
+                sync(out[1])
+                rtt_ms = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+                out = blk(ops_prep, c)
+                sync(out[1])
+                total_ms = (time.perf_counter() - t0) * 1e3
+                emit(case="scan_prepared", tier="high", n_steps=iters,
+                     ms_per_iter=round(total_ms / iters, 3),
+                     ms_per_iter_net_rtt=round(
+                         max(total_ms - rtt_ms, total_ms * 0.5) / iters, 3),
+                     fetch_rtt_ms=round(rtt_ms, 2))
+            except Exception as e:   # noqa: BLE001
+                emit(case="scan_prepared",
+                     error=f"{type(e).__name__}: {e}"[:200])
     except Exception as e:   # noqa: BLE001
         emit(case="prepared_loop", error=f"{type(e).__name__}: {e}"[:200])
     finally:
