@@ -2,6 +2,15 @@
 # ci/diag_then_battery.sh — ONE definition of "TPU reachable" so the
 # gate and the battery can't drift apart.
 
+# Persistent XLA compilation cache for every battery child process:
+# matrix/select_k's four-way grid rc=124'd at 900 s with the whole
+# budget in compiles (17:38 window, round 5). Caching executables
+# across family processes and battery passes turns reruns into
+# replays; if the backend can't serialize an executable the cache
+# degrades to a no-op warning, never an error.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/root/repo/.jax_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-2}"
+
 probe() {
     timeout -k 15 240 python -c "import jax; assert jax.default_backend()=='tpu'" \
         >/dev/null 2>&1
