@@ -35,12 +35,16 @@ tpu_aot_compile(f, ((1 << 20, 128), jnp.float32), ((1024, 128),
                 jnp.float32))
 print("PRE_OK")
 """,
-    # -- chunked-radix kNN at the bench shape ------------------------
-    "knn_chunked_bench": HDR + """
+    # -- kNN at the bench shape: fused path (k=64) + the chunked-radix
+    #    fallback arm (k=256 > fused MAX_K) -----------------------------
+    "knn_bench": HDR + """
+import raft_tpu
 from raft_tpu.neighbors import knn
-f = functools.partial(knn, None, k=64)
-tpu_aot_compile(f, ((1 << 20, 128), jnp.float32),
-                ((4096, 128), jnp.float32))
+raft_tpu.set_matmul_precision("high")
+for k in (64, 256):
+    f = functools.partial(knn, None, k=k)
+    tpu_aot_compile(f, ((1 << 20, 128), jnp.float32),
+                    ((4096, 128), jnp.float32))
 print("PRE_OK")
 """,
     # -- unexpanded pairwise metrics tile engine ----------------------

@@ -199,11 +199,13 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     on the chunked path's distance block (an explicit small tile forces
     the scan path rather than being silently ignored). Default: auto.
 
-    Dispatch: long databases at 16 < k <= 2048 run the chunked-radix
-    path (:func:`_knn_chunked`); otherwise the streaming scan with
-    per-tile top_k (:func:`_knn_scan`). knn_mnmg's per-shard body stays
-    on the scan path until the radix-specific shard_map smoke case
-    (tpu_tests TestShardMapRadixSelect) is green on hardware.
+    Dispatch: k <= 128 runs the fused distance+top-k kernel
+    (:mod:`raft_tpu.neighbors.fused_topk` — distances never leave VMEM,
+    merges bound-gated; round-5 capture showed every materializing
+    formulation select-bound at ~1.3 G items/s). Larger k at long
+    databases runs the chunked-radix path (:func:`_knn_chunked`);
+    otherwise the streaming scan with per-tile top_k
+    (:func:`_knn_scan`).
 
     >>> import numpy as np
     >>> from raft_tpu.neighbors import knn
@@ -218,10 +220,19 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     queries = jnp.asarray(queries)
     _validate(db, queries, k)
     kernel_metric = _resolve_metric(metric)
+    # interpret+vma cannot replay vma-carrying kernels — only there does
+    # the dispatch fall back (compiled shard_map uses the fused path)
+    from raft_tpu.neighbors import fused_topk
+
+    if (fused_topk.supports(k) and (tile is None or tile >= 128)
+            and kernel_metric in ("l2", "cosine", "inner")
+            and not interpret_needs_ref(db, queries)):
+        vals, idx = fused_topk.knn_fused(
+            queries.astype(jnp.float32), db.astype(jnp.float32), k,
+            kernel_metric, tn=min(tile or 1024, 1024))
+        return _finalize(vals, metric), idx
     chunk = _chunk_for(queries.shape[0], db.shape[0], k,
                        tile_cap=tile or 0)
-    # interpret+vma cannot replay vma-carrying kernels — only there does
-    # the dispatch fall back (compiled shard_map uses the radix path)
     if chunk and not interpret_needs_ref(db, queries):
         vals, idx = _knn_chunked(queries.astype(jnp.float32),
                                  db.astype(jnp.float32), k, chunk,

@@ -252,3 +252,83 @@ class TestUnexpandedMetricsKnn:
             np.asarray(d), np.take_along_axis(ref, ri, 1),
             rtol=2e-4, atol=2e-4)
         np.testing.assert_array_equal(np.asarray(i), ri)
+
+
+class TestFusedTopK:
+    """The fused distance+top-k kernel (neighbors/fused_topk.py) — the
+    k <= 128 kNN hot path. Oracle: numpy stable argsort."""
+
+    def _oracle(self, q, db, k):
+        d = ((q[:, None, :].astype(np.float64)
+              - db[None, :, :].astype(np.float64)) ** 2).sum(-1)
+        oi = np.argsort(d, axis=1, kind="stable")[:, :k]
+        return np.take_along_axis(d, oi, 1), oi
+
+    @pytest.mark.parametrize("tier", ["default", "high", "highest"])
+    def test_vs_oracle_all_tiers(self, tier):
+        import raft_tpu
+        from raft_tpu.neighbors.fused_topk import knn_fused
+
+        rng = np.random.default_rng(7)
+        q = rng.normal(size=(43, 21)).astype(np.float32)
+        db = rng.normal(size=(2333, 21)).astype(np.float32)
+        old = raft_tpu.get_matmul_precision()
+        try:
+            raft_tpu.set_matmul_precision(tier)
+            v, i = knn_fused(jnp.asarray(q), jnp.asarray(db), 11, tn=512)
+        finally:
+            raft_tpu.set_matmul_precision(old)
+        ov, oi = self._oracle(q, db, 11)
+        np.testing.assert_array_equal(np.asarray(i), oi)
+        np.testing.assert_allclose(np.asarray(v), ov, rtol=2e-3,
+                                   atol=2e-3)
+
+    def test_adversarial_descending_quality(self):
+        """Rows sorted so every later tile IMPROVES the bound — the
+        bound gate never skips and every tile merges; correctness must
+        not depend on the gate firing."""
+        from raft_tpu.neighbors.fused_topk import knn_fused
+
+        rng = np.random.default_rng(8)
+        q = np.zeros((9, 6), np.float32)
+        db = rng.normal(size=(1500, 6)).astype(np.float32)
+        norms = (db ** 2).sum(1)
+        db = db[np.argsort(-norms)]         # best candidates LAST
+        v, i = knn_fused(jnp.asarray(q), jnp.asarray(db), 13, tn=256)
+        ov, oi = self._oracle(q, db, 13)
+        np.testing.assert_array_equal(np.asarray(i), oi)
+
+    def test_ties_smallest_global_index(self):
+        from raft_tpu.neighbors.fused_topk import knn_fused
+
+        q = np.ones((3, 8), np.float32)
+        base = np.arange(40, dtype=np.float32).reshape(5, 8)
+        db = np.tile(base, (60, 1))          # 300 rows, 60 exact copies
+        v, i = knn_fused(jnp.asarray(q), jnp.asarray(db), 7, tn=128)
+        d = ((q[:1, None, :] - db[None, :, :]) ** 2).sum(-1)[0]
+        oi = np.argsort(d, kind="stable")[:7]
+        np.testing.assert_array_equal(np.asarray(i)[0], oi)
+
+    def test_k_equals_max_and_short_db(self):
+        from raft_tpu.neighbors.fused_topk import MAX_K, knn_fused
+
+        rng = np.random.default_rng(9)
+        q = rng.normal(size=(5, 12)).astype(np.float32)
+        db = rng.normal(size=(200, 12)).astype(np.float32)
+        v, i = knn_fused(jnp.asarray(q), jnp.asarray(db), MAX_K)
+        ov, oi = self._oracle(q, db, MAX_K)
+        np.testing.assert_array_equal(np.asarray(i), oi)
+
+    def test_dispatch_prefers_fused(self):
+        """knn() routes k <= 128 through the fused kernel; results match
+        the chunked/scan paths it replaced."""
+        from raft_tpu.neighbors.brute_force import _knn_scan
+
+        rng = np.random.default_rng(10)
+        q = rng.normal(size=(17, 16)).astype(np.float32)
+        db = rng.normal(size=(900, 16)).astype(np.float32)
+        v, i = knn(None, db, q, 6)
+        sv, si = _knn_scan(jnp.asarray(q), jnp.asarray(db), 6, 512, "l2")
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(si))
+        np.testing.assert_allclose(np.asarray(v), np.asarray(sv),
+                                   rtol=1e-5, atol=1e-6)
