@@ -161,10 +161,15 @@ for fam in $PRIORITY $REST; do
     # heavy families (graph generation + many compiles) get a bigger
     # budget — in round 2 these were exactly the ones rc=124'd
     case "$fam" in
-        sparse/lanczos|sparse/mst|sparse/spmv_large|\
-        matrix/select_k_large|matrix/select_k|neighbors/brute_force|\
+        matrix/select_k)
+            BUDGET=1500 ;;  # four-way grid: 900 s was all compiles
+                            # (17:38 pass, zero completed rows)
+        sparse/lanczos|sparse/mst|sparse/spmv_large|sparse/spmv|\
+        matrix/select_k_large|neighbors/brute_force|\
         cluster/kmeans_iter)
-            BUDGET=900 ;;   # kmeans_iter rc=124'd at 420 in round 5
+            BUDGET=900 ;;   # kmeans_iter rc=124'd at 420 in round 5;
+                            # sparse/spmv rc=124'd at 420 (18:43, grid
+                            # plan pack + compiles)
         *)  BUDGET=420 ;;
     esac
     echo "[battery] run $fam (budget ${BUDGET}s) $(date +%H:%M:%S)"

@@ -142,14 +142,16 @@ MIN_COLS = 8192
 def preferred(n_cols: int, k: int) -> bool:
     """The single source of truth for the dispatch band where radix is
     expected to win (select_k AUTO and the chunked kNN path both gate on
-    this): the round-3 grid showed lax.top_k ~50x under the bandwidth
-    roofline exactly at 16 < k <= 2048 on long rows, and the round-5
-    1M-length capture extends the win past 2048 (k=10000: radix 65.5 ms
-    vs direct 115, tiled 270; k=2048: 45.9 vs 59.6) — the wide-k band is
-    gated to long rows where that evidence exists. Re-derive from
-    ci/derive_select_k.py when the radix-inclusive four-way grid lands."""
-    if n_cols >= (1 << 20) and 2048 < k <= MAX_K:
-        return True
+    this). Long rows (>= 2^20): the 17:11 round-5 four-way grid
+    (tpu_battery_out/select_k_derive.txt) shows radix winning from
+    k=2048 up (53.4 ms vs direct 60.4/tiled 68.2; k=10^4: 72.6 vs
+    114.8/269.7) while TILED edges it at k=256 (47.7 vs 49.5, and 48.9
+    vs 56.0 at 4M) — the band starts above 256 (512-1024 interpolated:
+    radix's cost is near-flat in k, direct's grows). Short rows keep
+    the round-3-derived (16, 2048] band until the select_k family's
+    65k grid lands (rc=124 both round-5 passes)."""
+    if n_cols >= (1 << 20):
+        return 256 < k <= MAX_K
     return n_cols >= MIN_COLS and 16 < k <= 2048
 
 

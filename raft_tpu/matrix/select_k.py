@@ -82,12 +82,15 @@ def _choose_tiled(n_rows: int, n_cols: int, k: int,
                   tile: int = 8192) -> bool:
     """Heuristic analogue of choose_select_k_algorithm
     (detail/select_k-inl.cuh:38-63), re-derived from the round-3 v5e grid
-    (module docstring): tiled wins wide rows at k > 16 as long as the
-    stage-2 candidate pool (n_tiles · k) stays bounded — at (1M, 2048)
-    the 262k-wide pool hands the win back to direct (59.9 vs 66.4 ms),
-    while (65k, 2048)'s 16k pool and (1M, 256)'s 32k pool keep it."""
+    and the round-5 17:11 four-way capture: tiled wins wide rows at
+    k > 16 as long as the stage-2 candidate pool (n_tiles · k) stays
+    bounded — the (4M, 256) cell's 131k pool still wins (48.9 ms vs
+    52.2 direct, select_k_derive.txt), so the cap sits just above it;
+    at (1M, 2048) the 262k pool handed the win back to direct in r3
+    (that band now belongs to radix via radix_select.preferred, checked
+    first)."""
     pool = cdiv(n_cols, tile) * k
-    return n_cols >= 64 * 1024 and k > 16 and pool <= 64 * 1024
+    return n_cols >= 64 * 1024 and k > 16 and pool <= 144 * 1024
 
 
 def _order_flip(values: jnp.ndarray) -> jnp.ndarray:
