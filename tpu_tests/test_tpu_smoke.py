@@ -574,11 +574,21 @@ class TestFusedTopKOnChip:
         d = ((q[:, None, :].astype(np.float64)
               - db[None, :, :].astype(np.float64)) ** 2).sum(-1)
         oi = np.argsort(d, axis=1, kind="stable")[:, :64]
+        ov = np.take_along_axis(d, oi, 1)
         old = raft_tpu.get_matmul_precision()
         try:
-            for tier in ("high", "default"):
+            # index agreement is tier-bounded: neighbors whose distance
+            # gap sits below the tier's distance error legitimately swap
+            # (0.04% observed at 'high' on this data — the 19:09 round-5
+            # capture); the chunked-kNN smoke case uses the same bar.
+            # The VALUES must still be tier-accurate everywhere.
+            for tier, agree_min, rtol in (("high", 0.999, 1e-4),
+                                          ("default", 0.99, 2e-2)):
                 raft_tpu.set_matmul_precision(tier)
                 gv, gi = knn_fused(jnp.asarray(q), jnp.asarray(db), 64)
-                np.testing.assert_array_equal(np.asarray(gi), oi)
+                agree = (np.asarray(gi) == oi).mean()
+                assert agree > agree_min, (tier, agree)
+                np.testing.assert_allclose(np.asarray(gv), ov,
+                                           rtol=rtol, atol=rtol)
         finally:
             raft_tpu.set_matmul_precision(old)
