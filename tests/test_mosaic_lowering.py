@@ -48,14 +48,22 @@ def _lowers_with_mosaic(fn):
 
 @pytest.mark.parametrize("tier", ["default", "high", "highest"])
 def test_knn_scan_lowers_for_tpu(tier, xy):
-    """Pallas kernel inside lax.scan (the knn database streaming loop)."""
+    """Pallas kernel inside lax.scan (the knn database streaming loop).
+
+    tile=64 pins the SCAN path: at tile >= 128 knn dispatches to the
+    fused top-k kernel, whose 32 gated merge regions overflow
+    jax.export's recursive jaxpr walk (RecursionError in
+    util.weakrefs_to_sentinel — a serialization-path limit, not a
+    Mosaic one). The fused kernel's TPU lowering is proven the stronger
+    way: ci/aot_preflight.py knn_bench compiles it against the real
+    libtpu toolchain at the 1M-row bench shape."""
     from raft_tpu.neighbors import knn
 
     x, y = xy
     old = raft_tpu.get_matmul_precision()
     try:
         raft_tpu.set_matmul_precision(tier)
-        _lowers_with_mosaic(lambda: knn(None, x, y, k=5, tile=256)[0])
+        _lowers_with_mosaic(lambda: knn(None, x, y, k=5, tile=64)[0])
     finally:
         raft_tpu.set_matmul_precision(old)
         jax.config.update("jax_default_matmul_precision", None)
