@@ -332,3 +332,33 @@ class TestFusedTopK:
         np.testing.assert_array_equal(np.asarray(i), np.asarray(si))
         np.testing.assert_allclose(np.asarray(v), np.asarray(sv),
                                    rtol=1e-5, atol=1e-6)
+
+    def test_ragged_db_and_single_query(self):
+        """n not a multiple of tn (padding masked by n_valid) and q=1
+        (row padding sliced off)."""
+        from raft_tpu.neighbors.fused_topk import knn_fused
+
+        rng = np.random.default_rng(11)
+        q = rng.normal(size=(1, 7)).astype(np.float32)
+        db = rng.normal(size=(1337, 7)).astype(np.float32)
+        v, i = knn_fused(jnp.asarray(q), jnp.asarray(db), 21, tn=512)
+        ov, oi = self._oracle(q, db, 21)
+        np.testing.assert_array_equal(np.asarray(i), oi)
+
+    def test_metrics_through_dispatch(self):
+        """cosine and inner ride the fused path with the right ordering
+        (inner: largest first via the negated kernel metric)."""
+        rng = np.random.default_rng(12)
+        q = rng.normal(size=(9, 15)).astype(np.float32)
+        db = rng.normal(size=(700, 15)).astype(np.float32)
+        for metric in ("cosine", "inner"):
+            d, i = knn(None, db, q, 5, metric=metric)
+            if metric == "cosine":
+                qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+                dn = db / np.linalg.norm(db, axis=1, keepdims=True)
+                ref = 1.0 - qn @ dn.T
+                oi = np.argsort(ref, axis=1, kind="stable")[:, :5]
+            else:
+                ref = q.astype(np.float64) @ db.T.astype(np.float64)
+                oi = np.argsort(-ref, axis=1, kind="stable")[:, :5]
+            np.testing.assert_array_equal(np.asarray(i), oi)
