@@ -276,16 +276,40 @@ def knn_mnmg(res, db, queries, k: int, metric: str = "l2",
         # a single shard cannot hold k candidates; degenerate scale —
         # run single-device (the reference's MNMG paths assume k ≪ n/dev)
         return knn(res, db, queries, k, metric=metric, tile=tile)
-    dbp = jnp.pad(db, ((0, per * ndev - n), (0, 0)))
+    from raft_tpu.neighbors import fused_topk
+
+    # L2 shards ride the fused distance+top-k kernel: its n_valid is
+    # compile-static, so instead of a traced per-shard row count the
+    # pad rows carry a LARGE sentinel coordinate — their distances are
+    # astronomically large but finite (1e15² · d ≈ 1e32 ≪ f32 max), so
+    # they can never survive a top-k that has k real candidates
+    # anywhere in the pool. Cosine/inner pad rows are NOT self-excluding
+    # (angle/sign of a sentinel is data-dependent), so those metrics
+    # keep the scan body with its traced n_valid mask.
+    # gate on interpret mode itself (not on these pre-shard_map plain
+    # arrays): the shard body's operands ALWAYS carry vma, which the
+    # interpreter cannot replay — compiled backend only
+    from raft_tpu.util.pallas_utils import use_interpret
+
+    use_fused = (fused_topk.supports(k) and kernel_metric == "l2"
+                 and (tile is None or tile >= 128)
+                 and not use_interpret())
+    pad_val = 1e15 if use_fused else 0.0
+    dbp = jnp.pad(db, ((0, per * ndev - n), (0, 0)),
+                  constant_values=pad_val)
     tile_ = _clamp_tile(tile or 8192, k, per)
 
     def shard_fn(db_shard, q):
         me = lax.axis_index(data_axis)
         start = me * per
-        # this shard's real row count (last shard may be short)
-        n_local = jnp.clip(jnp.int32(n) - start, 0, per)
-        v, i = _knn_scan(q, db_shard, k, tile_, kernel_metric,
-                         n_valid=n_local)
+        if use_fused:
+            v, i = fused_topk.knn_fused(q, db_shard, k, kernel_metric,
+                                        tn=min(tile or 1024, 1024))
+        else:
+            # this shard's real row count (last shard may be short)
+            n_local = jnp.clip(jnp.int32(n) - start, 0, per)
+            v, i = _knn_scan(q, db_shard, k, tile_, kernel_metric,
+                             n_valid=n_local)
         return v[None], (i + start)[None]            # [1, q, k] per shard
 
     @jax.jit

@@ -604,3 +604,25 @@ class TestFusedTopKOnChip:
                                           np.asarray(si))
         finally:
             raft_tpu.set_matmul_precision(old)
+
+
+class TestFusedTopKMnmgOnChip:
+    def test_knn_mnmg_fused_body_matches_single_device(self):
+        """knn_mnmg's shard body rides the fused top-k kernel inside
+        shard_map (vma plumbing + sentinel-padded shards) — must agree
+        with the single-device fused path on the same data."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from raft_tpu.neighbors import knn, knn_mnmg
+
+        rng = np.random.default_rng(59)
+        db = rng.normal(size=(4100, 24)).astype(np.float32)  # ragged
+        q = rng.normal(size=(64, 24)).astype(np.float32)
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        sv, si = knn(None, db, q, 16)
+        mv, mi = knn_mnmg(None, db, q, 16, mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(mi), np.asarray(si))
+        np.testing.assert_allclose(np.asarray(mv), np.asarray(sv),
+                                   rtol=1e-6, atol=1e-6)
