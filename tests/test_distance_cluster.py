@@ -534,3 +534,31 @@ def test_lloyd_iterate_prepared_matches_stepped():
             lloyd_iterate_prepared(ops, c0, 0, **meta)
     finally:
         raft_tpu.set_matmul_precision(old)
+
+
+def test_kmeans_fit_block_size_invariant():
+    """kmeans_fit's scanned between-polls blocks must not change the
+    result: check_every=7 (blocks of 7 + remainder) and check_every=1
+    run the same iteration sequence bit-identically at tol=0."""
+    import jax.numpy as jnp
+    import raft_tpu
+    from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.normal(size=(600, 17)).astype(np.float32))
+    old = raft_tpu.get_matmul_precision()
+    try:
+        raft_tpu.set_matmul_precision("high")
+        res = []
+        for ce in (1, 7):
+            p = KMeansParams(n_clusters=23, max_iter=10, tol=0.0,
+                             seed=3, check_every=ce)
+            c, inertia, labels, n_iter = kmeans_fit(None, p, x)
+            assert n_iter == 10
+            res.append((np.asarray(c), float(inertia),
+                        np.asarray(labels)))
+        np.testing.assert_array_equal(res[0][0], res[1][0])
+        assert res[0][1] == res[1][1]
+        np.testing.assert_array_equal(res[0][2], res[1][2])
+    finally:
+        raft_tpu.set_matmul_precision(old)
