@@ -183,10 +183,17 @@ def run():
     # never fabricate speed). Median of 3 timed blocks.
     rtt = 0.0
     if on_tpu:
-        ready = inertia          # warmed output: fetching it is pure RTT
-        float(ready)
+        import jax.numpy as _jnp
+
+        # fetching a READY buffer is pure RTT — but it must be a FRESH
+        # fetch: float() on the same Array object returns the client-
+        # cached value (measured 0.0 ms where the true RTT is ~72 ms),
+        # so ravel-index like benches/harness.py to force the wire.
+        ready = cc
+        jax.block_until_ready(ready)
+        jax.device_get(_jnp.ravel(ready)[0])
         t0 = time.perf_counter()
-        float(ready)
+        jax.device_get(_jnp.ravel(ready)[0])
         rtt = time.perf_counter() - t0
     times = []
     for _ in range(3 if on_tpu else 1):
