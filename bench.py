@@ -168,42 +168,81 @@ def run():
         cc, inertia, _ = lloyd_iterate_prepared(ops, c, iters, **meta)
         float(inertia)                       # warm the scanned executable
 
-        def run_block(cc):
-            return lloyd_iterate_prepared(ops, cc, iters, **meta)
+        def run_block(cc, n):
+            return lloyd_iterate_prepared(ops, cc, n, **meta)
     else:
-        def run_block(cc):
-            for _ in range(iters):
+        def run_block(cc, n):
+            for _ in range(n):
                 cc, inertia, labels = lloyd_step(x, cc, n_clusters)
             return cc, inertia, labels
 
-    # Timing discipline (docs/architecture.md "remote-TPU tunnel", same
-    # as benches/harness.py): the sync barrier is a device->host scalar
-    # fetch, and the one fetch RTT the measured region pays is
-    # subtracted (floored at half the measurement so RTT variance can
-    # never fabricate speed). Median of 3 timed blocks.
+    # Timing discipline (docs/architecture.md "remote-TPU tunnel"): the
+    # sync barrier is a device->host scalar fetch, and the per-iteration
+    # cost comes from TWO-POINT MARGINAL timing — time a block of
+    # ``iters`` and a block of ``iters//2`` and divide the DIFFERENCE of
+    # the medians by the iteration difference. Every fixed cost of the
+    # measured region (tunnel RTT, dispatch, result delivery, the sync
+    # fetch itself) appears identically in both blocks and cancels, so
+    # no RTT model is needed. The previous probe-and-subtract scheme
+    # broke both ways as tunnel topology shifted between windows (72 ms
+    # one evening; ~0 the next night while an eager-dispatch probe
+    # measured 493 ms — subtracting it fabricated mxu_util > 1.0, which
+    # is how the bug was caught). The probe survives as a DIAGNOSTIC
+    # field only. The marginal estimate is clamped into
+    # [0.5, 1.0] × (T_full / iters): the same can't-fabricate-speed
+    # floor as before, plus a ceiling because fixed overhead can't be
+    # negative.
     rtt = 0.0
     if on_tpu:
         import jax.numpy as _jnp
 
-        # fetching a READY buffer is pure RTT — but it must be a FRESH
+        # fetching a READY buffer ~ pure RTT — but it must be a FRESH
         # fetch: float() on the same Array object returns the client-
-        # cached value (measured 0.0 ms where the true RTT is ~72 ms),
-        # so ravel-index like benches/harness.py to force the wire.
-        ready = cc
+        # cached value, so ravel-index to force the wire. Diagnostic
+        # only (an eager dispatch can cost more round-trips than the
+        # timed region's own sync fetch does).
+        ready = c1   # warmup output: defined on both prepared/fallback paths
         jax.block_until_ready(ready)
         jax.device_get(_jnp.ravel(ready)[0])
         t0 = time.perf_counter()
         jax.device_get(_jnp.ravel(ready)[0])
         rtt = time.perf_counter() - t0
-    times = []
-    for _ in range(3 if on_tpu else 1):
+
+    half = max(1, iters // 2)
+    if on_tpu and ops is not None:
+        _, ih, _ = lloyd_iterate_prepared(ops, c, half, **meta)
+        float(ih)                        # warm the half-length scan too
+
+    def timed(n):
         t0 = time.perf_counter()
-        cc, inertia, labels = run_block(c)
+        _, inertia, _ = run_block(c, n)
         float(inertia)  # true synchronization point
-        total = time.perf_counter() - t0
-        times.append(max(total - rtt, total * 0.5))
-    times.sort()
-    dt = times[len(times) // 2]
+        return time.perf_counter() - t0
+
+    t_full, t_half = [], []
+    for _ in range(3 if on_tpu else 1):
+        t_full.append(timed(iters))
+        if on_tpu and iters > half:
+            t_half.append(timed(half))
+    t_full.sort()
+    tf = t_full[len(t_full) // 2]
+    if t_half:
+        from benches.harness import marginal_per_call
+
+        t_half.sort()
+        th = t_half[len(t_half) // 2]
+        # floor_frac 0.5: the headline artifact keeps the strictest
+        # can't-fabricate-speed bar (its 100-iter block is ~99% work,
+        # so a legitimately binding floor is impossible — a binding
+        # floor means apparatus corruption and marks the line invalid
+        # via is_valid_northstar_line)
+        per_iter, ns_floor_bound = marginal_per_call(tf, th, iters, half,
+                                                     floor_frac=0.5)
+    else:
+        per_iter = tf / iters
+        ns_floor_bound = False
+    overhead_ms = max(tf - per_iter * iters, 0.0) * 1e3
+    dt = per_iter * iters
 
     iters_per_sec = iters / dt
     # FLOP accounting (single source: BASELINE.md "FLOP accounting"):
@@ -234,8 +273,12 @@ def run():
         "flops_4mnk_logical_gflops": round(2.0 * gflops_2mnk, 1),
         "mxu_util_4mnk": round(2.0 * gflops_2mnk / peak, 4),
         "iters": iters,
-        "fetch_rtt_ms": round(rtt * 1e3, 2),
+        "timing": "marginal-2point" if t_half else "single-point",
+        "fixed_overhead_ms": round(overhead_ms, 2),
+        "fetch_rtt_ms": round(rtt * 1e3, 2),   # diagnostic only
     }
+    if ns_floor_bound:
+        line["floor_bound"] = True
     if probe_rel_err is not None:
         line["probe_rel_err"] = probe_rel_err
     if backend != "tpu":
@@ -252,9 +295,17 @@ def is_valid_northstar_line(d: dict) -> bool:
     """Single source of truth for what counts as a machine-captured
     on-TPU north-star measurement — shared by the battery's artifact
     validator (ci/tpu_battery.sh) and the relay below, so the two can't
-    drift: backend really tpu, not an error line, not itself a relay."""
+    drift: backend really tpu, not an error line, not itself a relay,
+    and physically possible (mxu_util_4mnk > 1.0 means the timing
+    scheme over-subtracted overhead — exactly how the round-5 RTT-probe
+    bug announced itself; such a line must never become the artifact)."""
+    try:
+        util_ok = float(d.get("mxu_util_4mnk", 0.0)) <= 1.0
+    except (TypeError, ValueError):
+        util_ok = False
     return (d.get("backend") == "tpu" and "error" not in d
-            and "relay" not in d)
+            and "relay" not in d and util_ok
+            and not d.get("floor_bound"))
 
 
 def _relay_battery_artifact():

@@ -55,6 +55,29 @@ class BenchResult:
         return json.dumps(out)
 
 
+def marginal_per_call(t_full: float, t_half: float, n_full: int,
+                      n_half: int, floor_frac: float = 0.25):
+    """Two-point marginal per-call time, with sanity clamps.
+
+    ``(t_full - t_half) / (n_full - n_half)`` cancels every per-block
+    fixed cost (tunnel RTT, the sync fetch, dispatch, result delivery)
+    because both blocks pay it identically — no RTT model needed. The
+    single spelling of the scheme, shared by run_case, bench.py and
+    benches/tune_northstar.py so a future timing fix can't drift
+    between harnesses (the probe-and-subtract predecessor had to be
+    excised from three files in lockstep).
+
+    Clamped into ``[floor_frac, 1.0] × (t_full / n_full)``: the ceiling
+    because fixed overhead can't be negative, the floor because a
+    correctly sized block is mostly work. Returns ``(per_call,
+    floor_bound)`` — a binding floor means the sizing probe misfired
+    and the caller should flag the row as suspect.
+    """
+    per = (t_full - t_half) / (n_full - n_half)
+    lo = floor_frac * t_full / n_full
+    return min(max(per, lo), t_full / n_full), per < lo
+
+
 def _sync(out) -> None:
     """Synchronize by fetching one element to host.
 
@@ -75,41 +98,87 @@ def run_case(name: str, fn: Callable, *args, repeats: int = 5,
              flops: Optional[int] = None, **params) -> BenchResult:
     """Time fn(*args) with warmup + median-of-repeats.
 
-    Through the tunnel (tpu backend), each timed repeat batches enough
-    back-to-back calls that the ~70 ms fetch RTT stays <10% of the
-    measurement; per-call time is total/inner."""
+    Through the tunnel (tpu backend), each timed repeat batches
+    back-to-back calls and the per-call cost comes from TWO-POINT
+    MARGINAL timing (see marginal_per_call): a block of ``inner`` calls
+    and a block of ``inner//2`` calls; per-block fixed costs cancel in
+    the difference. The former probe-and-subtract scheme mismeasured as
+    tunnel topology shifted between windows (a ready-buffer refetch
+    probe read 493 ms in a window where the timed region's own sync
+    paid ~0 — subtracting it fabricated >1.0-of-peak utilization in
+    bench.py, same scheme). Three regimes by the raw single-call time:
+    < 0.45 s → a 4-call marginal probe sizes inner (≥ 2, so the
+    marginal always runs, even in a window where the RTT dwarfs the
+    op); 0.45-2 s → inner pinned to 2 with the half block measured once
+    and ≤3 repeats (keeps per-case wall time near the old budget);
+    ≥ 2 s → "single-point-raw": raw block time, which includes ≤1
+    fetch RTT — at that scale a ≤25% honest-in-the-slow-direction
+    overhead."""
     for _ in range(warmup):
         out = fn(*args)
         _sync(out)
-    inner = 1
-    rtt = 0.0
-    if jax.default_backend() == "tpu":
-        out = fn(*args)
-        _sync(out)
-        t0 = time.perf_counter()
-        _sync(out)                       # ready buffer → pure fetch RTT
-        rtt = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        _sync(fn(*args))
-        t_one = time.perf_counter() - t0
-        t_est = max(t_one - rtt, 2e-5)
-        inner = max(1, min(20000, int(round(0.7 / t_est))))
-    times = []
-    for _ in range(repeats):
+
+    def timed(n):
         t0 = time.perf_counter()
         out = None
-        for _ in range(inner):
+        for _ in range(n):
             out = fn(*args)
         _sync(out)
-        total = time.perf_counter() - t0
-        # subtract the one fetch RTT the batch pays (keep half as a floor
-        # against RTT variance underestimating real work)
-        times.append(max(total - rtt, total * 0.5) / inner)
+        return time.perf_counter() - t0
+
+    inner = 1
+    reps = repeats
+    if jax.default_backend() == "tpu":
+        t1 = timed(1)
+        if t1 >= 2.0:
+            pass          # truly slow: single-shot raw (≤25% overhead)
+        elif t1 >= 0.45:
+            # mid-range op: a 4-call sizing probe would cost more than
+            # the measurement. Pin inner=2 (half=1), measure the half
+            # block ONCE and reuse it (fixed costs are per-block
+            # constants), and cap repeats — total ≈ the old per-case
+            # wall time instead of ~3x it.
+            inner = 2
+            reps = min(repeats, 3)
+        else:
+            # Size batches from a MARGINAL probe — (4 calls − 1 call)/3
+            # is a work-per-call estimate with the per-block fixed costs
+            # already cancelled: the same arithmetic as the measurement
+            # itself. (Sizing from the raw single-call time collapses
+            # inner toward 1 in a high-RTT window, starving the marginal
+            # of work signal.) 0.45 s of work per full block keeps
+            # full+half near the old 0.7 s per-repeat budget so family
+            # timeouts don't shift.
+            t4 = timed(4)
+            per1 = max((t4 - t1) / 3, 2e-5)
+            inner = max(2, min(20000, int(round(0.45 / per1))))
+    half = inner // 2
+
+    times = []
+    floor_bound = False
+    t_half_once = None
+    for _ in range(reps):
+        t_full = timed(inner)
+        if inner >= 2:
+            if inner == 2:
+                if t_half_once is None:
+                    t_half_once = timed(half)
+                t_half = t_half_once
+            else:
+                t_half = timed(half)
+            per, bound = marginal_per_call(t_full, t_half, inner, half)
+            floor_bound |= bound
+        else:
+            per = t_full
+        times.append(per)
     times.sort()
     med = times[len(times) // 2]
+    params["timing"] = "marginal-2point" if inner >= 2 else "single-point-raw"
+    if floor_bound:
+        params["floor_bound"] = True
     res = BenchResult(
         name=name, median_ms=med * 1e3, best_ms=times[0] * 1e3,
-        repeats=repeats, params=params)
+        repeats=reps, params=params)
     if items is not None:
         res.items_per_s = items / med
     if bytes_moved is not None:

@@ -128,30 +128,38 @@ def main():
                          error=f"{type(e).__name__}: {e}"[:200])
             # scanned block (lloyd_iterate_prepared): the whole chain in
             # ONE launch — prices what per-launch overhead + lost cross-
-            # launch overlap cost the per-step loop above. Also reports
-            # the fetch RTT so the uncompensated time_loop numbers can
-            # be read net of apparatus (benches/harness.py subtracts it;
-            # time_loop here deliberately does not, so A/B deltas stay
-            # directly comparable across this file's cases).
+            # launch overlap cost the per-step loop above. Per-iter cost
+            # is TWO-POINT MARGINAL (full-length block minus half-length
+            # block, like bench.py and benches/harness.py): every fixed
+            # cost of a block — tunnel RTT, dispatch, the sync fetch —
+            # cancels in the difference, so no RTT probe is needed (the
+            # former ready-buffer refetch probe read 493 ms in a window
+            # where the region's own sync paid ~0; subtracting it
+            # fabricated impossible speeds).
             try:
                 from raft_tpu.cluster.kmeans import lloyd_iterate_prepared
 
-                blk = jax.jit(functools.partial(
+                halfn = max(1, iters // 2)
+                blk_f = jax.jit(functools.partial(
                     lloyd_iterate_prepared, n_steps=iters, **meta))
-                out = blk(ops_prep, c)
-                sync(out[1])
+                blk_h = jax.jit(functools.partial(
+                    lloyd_iterate_prepared, n_steps=halfn, **meta))
+                sync(blk_f(ops_prep, c)[1])      # warm both executables
+                sync(blk_h(ops_prep, c)[1])
                 t0 = time.perf_counter()
-                sync(out[1])
-                rtt_ms = (time.perf_counter() - t0) * 1e3
-                t0 = time.perf_counter()
-                out = blk(ops_prep, c)
-                sync(out[1])
+                sync(blk_f(ops_prep, c)[1])
                 total_ms = (time.perf_counter() - t0) * 1e3
+                t0 = time.perf_counter()
+                sync(blk_h(ops_prep, c)[1])
+                half_ms = (time.perf_counter() - t0) * 1e3
+                from benches.harness import marginal_per_call
+
+                marg, fb = marginal_per_call(total_ms, half_ms, iters,
+                                             halfn, floor_frac=0.5)
                 emit(case="scan_prepared", tier="high", n_steps=iters,
                      ms_per_iter=round(total_ms / iters, 3),
-                     ms_per_iter_net_rtt=round(
-                         max(total_ms - rtt_ms, total_ms * 0.5) / iters, 3),
-                     fetch_rtt_ms=round(rtt_ms, 2))
+                     ms_per_iter_marginal=round(marg, 3),
+                     **({"floor_bound": True} if fb else {}))
             except Exception as e:   # noqa: BLE001
                 emit(case="scan_prepared",
                      error=f"{type(e).__name__}: {e}"[:200])
