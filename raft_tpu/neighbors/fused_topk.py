@@ -18,16 +18,35 @@ matrix/detail/select_k variants) — same fusion idea, re-derived for a
 machine whose selection primitive is VPU passes instead of warp shuffles,
 which makes BOUND-GATING (not a faster sorter) the structural win.
 
-Merge algorithm (per live tile): the running best (val, idx) lanes are
-kept SORTED ascending; the tile's candidates are consumed by k rounds of
-a vectorized two-pointer merge — row-min + first-min argmin over the
-tile pool, a masked one-lane reduce reads each row's current best at its
-own pointer (Mosaic's vector gather demands same-shape operands, so a
-(tm, 1)-index gather from the (tm, 128) best is NOT legal — the masked
-reduce is), the smaller of the two is appended, and the consumed source
-is masked (pool) or advanced past (pointer). Every op class is proven on
-this backend: reduce-min, masked-iota argmin (contractions._mask_argmin
-rationale), scalar any-reduce under pl.when (radix dead-chunk skip).
+Epilogue algorithm (v3, round 5): INSERTION, not merge. The running best
+(val, idx) lanes are kept SORTED ascending; each tile's distance block
+becomes a candidate pool, and a `lax.while_loop` extracts the per-row
+pool minimum and inserts it into the sorted best by one compare-shift
+(`pltpu.roll` + prefix mask) per round, until no row's pool holds
+anything below its own k-th bound. Work is O(actual updates): a tile
+with no improving candidate costs ZERO rounds (the while condition is
+the gate), and a tile with c of them costs ~c rounds at full 256-row
+vector width.
+
+Two prior shapes measured worse on chip (bench_full.jsonl,
+neighbors/knn_l2 1M×128 q=4096 k=64): (a) block-gated k-round merges —
+gates never skip at 256-row granularity, 1883 ms; (b) per-8-row-gated
+merges — gates still fire ~60% of the time at k=64 (P(fire) =
+1-e^{-8k/j} over j = 1..1024 db tiles) and each fired merge pays all
+k rounds at 1/32 the vector width, 6193 ms. Insertion keeps the full
+vector width AND pays per candidate, not per k: expected rounds per
+256-row block stream are ~sum_j max_rows(Poisson(k/j)) ≈ k·ln(k) +
+few·n_tiles ≈ thousands, not the merge formulations' hundreds of
+thousands of vector passes.
+
+Mosaic legality notes: reduce-min + masked-iota argmin
+(contractions._mask_argmin rationale), `pltpu.roll` lane shifts (the
+concat-of-slices alternative needs illegal relayouts), and
+`lax.while_loop` with (tm, tn) vector carries + any-reduce condition —
+probed via the deviceless AOT harness (ci/aot_compile.py) before this
+kernel was written; a (tm, 1)-index vector gather from the (tm, 128)
+best is NOT legal (same-shape operand rule), which is why the k-th
+bound is read by a masked one-lane reduce.
 """
 
 from __future__ import annotations
@@ -60,106 +79,68 @@ def _row_min_arg(pool, col):
     return pm, pidx
 
 
-def _merge_subgroup(val_ref, idx_ref, dist, col_g, g: int, k: int):
-    """Merge one gated subgroup's candidate pool into its sorted
-    running best (rows [g, g+GATE_ROWS) of the block).
-
-    k rounds of vectorized two-pointer merge; O(k) passes over the
-    subgroup's pool slice. The pool is READ-ONLY: instead of masking
-    consumed elements (k live temporaries — a Mosaic stack-VMEM OOM at
-    the bench shape), a per-row lexicographic (value, index) cursor
-    excludes everything already taken, so per-round state is a handful
-    of (rows, 1) vectors and the rounds ride a fori_loop. Ties prefer
-    the running best (earlier database tiles, then smaller index within
-    a tile via the first-min argmin) — the global smallest-index-wins
-    rule."""
-    tm = dist.shape[0]
-    inf = jnp.asarray(jnp.inf, jnp.float32)
-    sent = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
-    best_v = val_ref[g:g + tm]
-    best_i = idx_ref[g:g + tm]
-
-    def round_(r, carry):
-        out_v, out_i, bptr, pv, pi = carry
-        # pool elements strictly after the (pv, pi) cursor, (value, col)
-        # lexicographic — exactly the not-yet-consumed candidates
-        elig = (dist > pv) | ((dist == pv) & (col_g > pi))
-        pool = jnp.where(elig, dist, inf)
-        pm, pidx = _row_min_arg(pool, col_g)
-        sel = lane == bptr                    # exactly one lane per row
-        bv = jnp.min(jnp.where(sel, best_v, inf), axis=1, keepdims=True)
-        bi = jnp.min(jnp.where(sel, best_i, sent), axis=1, keepdims=True)
-        use_b = bv <= pm
-        pick_v = jnp.where(use_b, bv, pm)
-        pick_i = jnp.where(use_b, bi, pidx)
-        out_v = jnp.where(lane == r, pick_v, out_v)
-        out_i = jnp.where(lane == r, pick_i, out_i)
-        bptr = bptr + use_b.astype(jnp.int32)
-        pv = jnp.where(use_b, pv, pm)
-        pi = jnp.where(use_b, pi, pidx)
-        return out_v, out_i, bptr, pv, pi
-
-    init = (jnp.full((tm, LANES), jnp.inf, jnp.float32),
-            jnp.zeros((tm, LANES), jnp.int32),
-            jnp.zeros((tm, 1), jnp.int32),
-            jnp.full((tm, 1), -jnp.inf, jnp.float32),
-            jnp.full((tm, 1), -1, jnp.int32))
-    out_v, out_i, _, _, _ = jax.lax.fori_loop(0, k, round_, init)
-    val_ref[g:g + tm] = out_v
-    idx_ref[g:g + tm] = out_i
-
-
-GATE_ROWS = 8   # merge-gating granularity: one vreg of sublanes
-
-
 def _topk_body(dist, val_ref, idx_ref, j, tn: int, k: int,
                n_valid: int):
     """Shared epilogue of the plain and split kernels: mask the tile's
-    padding columns, then merge PER 8-QUERY SUBGROUP, each gated on its
-    own rows' running k-th bound.
+    padding columns, then drain the candidate pool by sorted INSERTION
+    (module docstring: O(actual updates), full 256-row vector width,
+    the while condition is the gate).
 
-    Gating granularity is the whole design (round-5 capture, 19:20):
-    one gate across a tm=256 block fires when ANY of 256 queries
-    improves — probability 1-exp(-256·k/t) at database tile t, ~1 for
-    every tile in a 1024-tile database, so the first version's merge
-    NEVER skipped (1883 ms). Per-8-row gates skip with probability
-    exp(-8·k/t): expected live merge events are ~sum_t 32·(1-e^{-512/t})
-    ≈ 28k for the 1M-row bench — ~100 ms of merges instead of 16k full-
-    block merges. Correctness never depends on a gate: a gate fires iff
-    its rows have an improving candidate, and each merge runs the full
-    k rounds."""
+    Each round: per-row pool min + first-min argmin (smallest column
+    wins ties), consume that lane, and for rows where the minimum beats
+    their k-th bound, compare-shift it into the sorted best. Rows whose
+    pool holds nothing below their bound extract dead mins into a
+    guarded no-op — progress is global (every looping row consumes one
+    lane per round), and the loop exits when no row can improve. Tie
+    contract (smallest index wins globally): within a tile the first-min
+    argmin inserts equal values in column order; across tiles, earlier
+    insertions win because ``keep = best <= candidate`` leaves existing
+    entries to the left of an equal newcomer."""
     tm = dist.shape[0]
     col = jax.lax.broadcasted_iota(jnp.int32, dist.shape, 1)
     col_g = col + j * tn
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
     inf = jnp.asarray(jnp.inf, jnp.float32)
-    dist = jnp.where(col_g < n_valid, dist, inf)
 
     @pl.when(j == 0)
     def _init():
         val_ref[:] = jnp.full((tm, LANES), jnp.inf, jnp.float32)
         idx_ref[:] = jnp.zeros((tm, LANES), jnp.int32)
 
-    th = val_ref[:, k - 1:k]                          # current k-th best
-    # one full-tile compare pass; per-subgroup any-reduces over its rows
-    # (i32 max: bool any reduces through f64 under x64 — radix_select
-    # precedent)
-    upd = (dist < th).astype(jnp.int32)
-    # column indices are row-independent: ONE fresh (GATE_ROWS, tn)
-    # iota serves every subgroup — a sublane-SLICED iota value crashes
-    # Mosaic's layout inference (Check failed: limits[i] <= dim(i),
-    # bisected 19:28 via the deviceless harness); dist row-slices are
-    # fine
-    col_sub = (jax.lax.broadcasted_iota(jnp.int32, (GATE_ROWS,
-                                                    dist.shape[1]), 1)
-               + j * tn)
-    for g in range(0, tm, GATE_ROWS):
-        live_g = jnp.max(upd[g:g + GATE_ROWS]) > 0
+    def kth(bv):
+        # masked one-lane reduce: a (tm, 1)-index gather from (tm, 128)
+        # is not Mosaic-legal (same-shape operand rule)
+        return jnp.min(jnp.where(lane == k - 1, bv, inf), axis=1,
+                       keepdims=True)
 
-        @pl.when(live_g)
-        def _merge(g=g):
-            _merge_subgroup(val_ref, idx_ref, dist[g:g + GATE_ROWS],
-                            col_sub, g, k)
+    def cond(carry):
+        pool, bv, _ = carry
+        # i32 max, not bool any: jnp.any's bool proxy reduces through
+        # f64 under jax_enable_x64 and fails Mosaic lowering
+        # (radix_select precedent)
+        return jnp.max((pool < kth(bv)).astype(jnp.int32)) > 0
+
+    def body(carry):
+        pool, bv, bi = carry
+        pm, pidx = _row_min_arg(pool, col_g)
+        pool = jnp.where(col_g == pidx, inf, pool)   # consume the lane
+        improving = pm < kth(bv)
+        keep = bv <= pm                     # prefix mask (sorted best)
+        pos = jnp.sum(keep.astype(jnp.int32), axis=1, keepdims=True)
+        shv = pltpu.roll(bv, 1, axis=1)
+        shi = pltpu.roll(bi, 1, axis=1)
+        nv = jnp.where(lane < pos, bv, jnp.where(lane == pos, pm, shv))
+        ni = jnp.where(lane < pos, bi, jnp.where(lane == pos, pidx,
+                                                 shi))
+        bv = jnp.where(improving, nv, bv)
+        bi = jnp.where(improving, ni, bi)
+        return pool, bv, bi
+
+    pool = jnp.where(col_g < n_valid, dist, inf)
+    _, bv, bi = jax.lax.while_loop(
+        cond, body, (pool, val_ref[:], idx_ref[:]))
+    val_ref[:] = bv
+    idx_ref[:] = bi
 
 
 def _topk_kernel(x_ref, y_ref, val_ref, idx_ref, *, tn: int, k: int,
