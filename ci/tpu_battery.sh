@@ -129,6 +129,21 @@ else
     echo "[battery] tune already recorded at $HEAD_SHA; skipping"
 fi
 
+# fused-kNN tuning sweep (tile grid × minonly floor × tier × strip width):
+# the decision data for fused_topk defaults — once per code state
+if [ "$(cat tpu_battery_out/knn_tune_done 2>/dev/null)" != "$HEAD_SHA" ]; then
+    echo "[battery] running fused-kNN tuning sweep"
+    timeout -k 30 2400 python benches/tune_knn.py \
+        > tpu_battery_out/knn_tune.jsonl \
+        2>> tpu_battery_out/knn_tune.err
+    rc=$?
+    echo "[battery] knn tune rc=$rc"
+    tail -6 tpu_battery_out/knn_tune.jsonl
+    [ "$rc" = 0 ] && echo "$HEAD_SHA" > tpu_battery_out/knn_tune_done
+else
+    echo "[battery] knn tune already recorded at $HEAD_SHA; skipping"
+fi
+
 echo "[battery] running full bench sweep (per-family processes)"
 # decision-bearing families first (they gate standing design choices:
 # select_k thresholds, ELL auto-select, segment-spmv, north-star shape),

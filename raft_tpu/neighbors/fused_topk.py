@@ -68,6 +68,34 @@ LANES = 128
 MAX_K = LANES  # one vreg of best per query row; larger k takes other paths
 
 
+def _tile_in_specs(tm: int, tn: int, kp: int, split: bool):
+    """The (query-tile, db-tile) input BlockSpecs shared by every kernel
+    in this file — ONE spelling so the tune probes price the same
+    operand pipeline as the fused kernels (plain: x, y; split: xh, xl,
+    xn, yh, yl, yn with norms as (1, t) lane rows)."""
+    if not split:
+        return [
+            pl.BlockSpec((tm, kp), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, kp), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+    return [
+        pl.BlockSpec((tm, kp), lambda i, j: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((tm, kp), lambda i, j: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, tm), lambda i, j: (0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((tn, kp), lambda i, j: (j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((tn, kp), lambda i, j: (j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, tn), lambda i, j: (0, j),
+                     memory_space=pltpu.VMEM),
+    ]
+
+
 def _row_min_arg(pool, col):
     """Per-row (min, first-min argmin) of a (tm, tn) pool — reduce-min +
     masked-iota, the Mosaic-safe argmin spelling (see
@@ -80,7 +108,7 @@ def _row_min_arg(pool, col):
 
 
 def _topk_body(dist, val_ref, idx_ref, j, tn: int, k: int,
-               n_valid: int):
+               n_valid: int, sw: int = 0):
     """Shared epilogue of the plain and split kernels: mask the tile's
     padding columns, then drain the candidate pool by sorted INSERTION
     (module docstring: O(actual updates), full 256-row vector width,
@@ -95,10 +123,16 @@ def _topk_body(dist, val_ref, idx_ref, j, tn: int, k: int,
     contract (smallest index wins globally): within a tile the first-min
     argmin inserts equal values in column order; across tiles, earlier
     insertions win because ``keep = best <= candidate`` leaves existing
-    entries to the left of an equal newcomer."""
+    entries to the left of an equal newcomer.
+
+    ``sw`` (strip width, 0 = whole tile): drain the tile in static
+    lane-aligned strips so the per-round vector work is O(tm·sw) while
+    the distance tile keeps its MXU-friendly width — the matmul tile
+    and the drain width are INDEPENDENT knobs. Round count is
+    unchanged (a candidate is a candidate in any strip); only the
+    dead-lane extraction width shrinks. Strips see ascending global
+    columns, preserving the tie contract."""
     tm = dist.shape[0]
-    col = jax.lax.broadcasted_iota(jnp.int32, dist.shape, 1)
-    col_g = col + j * tn
     lane = jax.lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
     inf = jnp.asarray(jnp.inf, jnp.float32)
 
@@ -120,63 +154,69 @@ def _topk_body(dist, val_ref, idx_ref, j, tn: int, k: int,
         # (radix_select precedent)
         return jnp.max((pool < kth(bv)).astype(jnp.int32)) > 0
 
-    def body(carry):
-        pool, bv, bi = carry
-        pm, pidx = _row_min_arg(pool, col_g)
-        pool = jnp.where(col_g == pidx, inf, pool)   # consume the lane
-        improving = pm < kth(bv)
-        keep = bv <= pm                     # prefix mask (sorted best)
-        pos = jnp.sum(keep.astype(jnp.int32), axis=1, keepdims=True)
-        shv = pltpu.roll(bv, 1, axis=1)
-        shi = pltpu.roll(bi, 1, axis=1)
-        nv = jnp.where(lane < pos, bv, jnp.where(lane == pos, pm, shv))
-        ni = jnp.where(lane < pos, bi, jnp.where(lane == pos, pidx,
-                                                 shi))
-        bv = jnp.where(improving, nv, bv)
-        bi = jnp.where(improving, ni, bi)
-        return pool, bv, bi
+    def drain(pool, col_g, bv, bi):
+        def body(carry):
+            pool, bv, bi = carry
+            pm, pidx = _row_min_arg(pool, col_g)
+            pool = jnp.where(col_g == pidx, inf, pool)  # consume lane
+            improving = pm < kth(bv)
+            keep = bv <= pm                 # prefix mask (sorted best)
+            pos = jnp.sum(keep.astype(jnp.int32), axis=1, keepdims=True)
+            shv = pltpu.roll(bv, 1, axis=1)
+            shi = pltpu.roll(bi, 1, axis=1)
+            nv = jnp.where(lane < pos, bv,
+                           jnp.where(lane == pos, pm, shv))
+            ni = jnp.where(lane < pos, bi,
+                           jnp.where(lane == pos, pidx, shi))
+            bv = jnp.where(improving, nv, bv)
+            bi = jnp.where(improving, ni, bi)
+            return pool, bv, bi
 
-    pool = jnp.where(col_g < n_valid, dist, inf)
-    _, bv, bi = jax.lax.while_loop(
-        cond, body, (pool, val_ref[:], idx_ref[:]))
+        _, bv, bi = jax.lax.while_loop(cond, body, (pool, bv, bi))
+        return bv, bi
+
+    sw = sw or tn
+    bv, bi = val_ref[:], idx_ref[:]
+    for s in range(0, tn, sw):              # static: unrolled strips
+        strip = dist[:, s:s + sw]
+        col_g = (jax.lax.broadcasted_iota(jnp.int32, strip.shape, 1)
+                 + j * tn + s)
+        pool = jnp.where(col_g < n_valid, strip, inf)
+        bv, bi = drain(pool, col_g, bv, bi)
     val_ref[:] = bv
     idx_ref[:] = bi
 
 
 def _topk_kernel(x_ref, y_ref, val_ref, idx_ref, *, tn: int, k: int,
-                 n_valid: int, metric: str):
+                 n_valid: int, metric: str, sw: int = 0):
     j = pl.program_id(1)
     dist = _metric_tile(x_ref[:], y_ref[:], metric)
-    _topk_body(dist, val_ref, idx_ref, j, tn, k, n_valid)
+    _topk_body(dist, val_ref, idx_ref, j, tn, k, n_valid, sw)
 
 
 def _topk_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
                        val_ref, idx_ref, *, tn: int, k: int,
-                       n_valid: int, metric: str):
+                       n_valid: int, metric: str, sw: int = 0):
     j = pl.program_id(1)
     dist = _metric_tile_split(xh_ref[:], xl_ref[:], xn_ref[:].T,
                               yh_ref[:], yl_ref[:], yn_ref[:], metric)
-    _topk_body(dist, val_ref, idx_ref, j, tn, k, n_valid)
+    _topk_body(dist, val_ref, idx_ref, j, tn, k, n_valid, sw)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("tm", "tn", "k", "n_valid", "metric"))
+                   static_argnames=("tm", "tn", "k", "n_valid", "metric",
+                                    "sw"))
 def _fused_topk_padded(x, y, tm: int, tn: int, k: int, n_valid: int,
-                       metric: str):
+                       metric: str, sw: int = 0):
     m, kp = x.shape
     n = y.shape[0]
     vma, (x, y) = join_vma(x, y)
     kernel = functools.partial(_topk_kernel, tn=tn, k=k, n_valid=n_valid,
-                               metric=metric)
+                               metric=metric, sw=sw)
     return pallas_call(
         kernel,
         grid=(m // tm, n // tn),
-        in_specs=[
-            pl.BlockSpec((tm, kp), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tn, kp), lambda i, j: (j, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=_tile_in_specs(tm, tn, kp, split=False),
         out_specs=[
             pl.BlockSpec((tm, LANES), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -193,31 +233,20 @@ def _fused_topk_padded(x, y, tm: int, tn: int, k: int, n_valid: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("tm", "tn", "k", "n_valid", "metric"))
+                   static_argnames=("tm", "tn", "k", "n_valid", "metric",
+                                    "sw"))
 def _fused_topk_padded_split(xh, xl, xn, yh, yl, yn, tm: int, tn: int,
-                             k: int, n_valid: int, metric: str):
+                             k: int, n_valid: int, metric: str,
+                             sw: int = 0):
     m, kp = xh.shape
     n = yh.shape[0]
     vma, (xh, xl, xn, yh, yl, yn) = join_vma(xh, xl, xn, yh, yl, yn)
     kernel = functools.partial(_topk_kernel_split, tn=tn, k=k,
-                               n_valid=n_valid, metric=metric)
+                               n_valid=n_valid, metric=metric, sw=sw)
     return pallas_call(
         kernel,
         grid=(m // tm, n // tn),
-        in_specs=[
-            pl.BlockSpec((tm, kp), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tm, kp), lambda i, j: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tm), lambda i, j: (0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tn, kp), lambda i, j: (j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tn, kp), lambda i, j: (j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tn), lambda i, j: (0, j),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=_tile_in_specs(tm, tn, kp, split=True),
         out_specs=[
             pl.BlockSpec((tm, LANES), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -233,32 +262,130 @@ def _fused_topk_padded_split(xh, xl, xn, yh, yl, yn, tm: int, tn: int,
     )(xh, xl, xn, yh, yl, yn)
 
 
+def _minonly_body(dist, val_ref, idx_ref, j, tn: int, n_valid: int):
+    """Single running min-fold epilogue — the floor any fused
+    formulation pays at these tiles (matmul rate + one vector pass per
+    tile). benches/tune_knn.py times this against the full insertion
+    kernel; the gap IS the epilogue's price."""
+    col = jax.lax.broadcasted_iota(jnp.int32, dist.shape, 1) + j * tn
+    pool = jnp.where(col < n_valid, dist,
+                     jnp.asarray(jnp.inf, jnp.float32))
+    pm, pidx = _row_min_arg(pool, col)
+
+    @pl.when(j == 0)
+    def _init():
+        val_ref[:] = jnp.full(val_ref.shape, jnp.inf, jnp.float32)
+        idx_ref[:] = jnp.zeros(idx_ref.shape, jnp.int32)
+
+    # outputs ride (1, tm) blocks — tm on lanes, the proven _lloyd_kernel
+    # layout (a 1-wide lane dim forces degenerate vreg tiling)
+    better = pm.T < val_ref[:]
+    val_ref[:] = jnp.where(better, pm.T, val_ref[:])
+    idx_ref[:] = jnp.where(better, pidx.T, idx_ref[:])
+
+
+def _minonly_kernel(x_ref, y_ref, val_ref, idx_ref, *, tn: int,
+                    n_valid: int, metric: str):
+    j = pl.program_id(1)
+    dist = _metric_tile(x_ref[:], y_ref[:], metric)
+    _minonly_body(dist, val_ref, idx_ref, j, tn, n_valid)
+
+
+def _minonly_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
+                          val_ref, idx_ref, *, tn: int, n_valid: int,
+                          metric: str):
+    j = pl.program_id(1)
+    dist = _metric_tile_split(xh_ref[:], xl_ref[:], xn_ref[:].T,
+                              yh_ref[:], yl_ref[:], yn_ref[:], metric)
+    _minonly_body(dist, val_ref, idx_ref, j, tn, n_valid)
+
+
+@functools.partial(jax.jit, static_argnames=("tm", "tn"))
+def _minonly_probe(queries, db, tm: int = 256, tn: int = 1024):
+    """Tune-only probe: 1-NN by running min at the fused kernel's grid
+    (NOT a user API — knn callers want k results; see tune_knn.py).
+    Mirrors knn_fused's precision dispatch (pre-split operands at tier
+    'high') so the floor it measures prices the SAME distance pipeline
+    as the kernel it is compared against."""
+    q, d = queries.shape
+    n = db.shape[0]
+    tm = max(128, tm - tm % 128)   # (1, tm) output blocks: tm on lanes
+    tn = max(128, min(tn - tn % 128, round_up_to_multiple(n, 128)))
+    mp = round_up_to_multiple(q, tm)
+    np_ = round_up_to_multiple(n, tn)
+    kp = round_up_to_multiple(d, 128)
+    grid = (mp // tm, np_ // tn)
+    out_specs = [
+        pl.BlockSpec((1, tm), lambda i, j: (0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, tm), lambda i, j: (0, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary"))
+    if _use_split(queries, db):
+        ops = _split_operands(queries, db, mp, np_, kp)
+        vma, ops = join_vma(*ops)
+        vals, idx = pallas_call(
+            functools.partial(_minonly_kernel_split, tn=tn, n_valid=n,
+                              metric="l2"),
+            grid=grid,
+            in_specs=_tile_in_specs(tm, tn, kp, split=True),
+            out_specs=out_specs,
+            out_shape=[
+                out_struct((1, mp), jnp.float32, vma),
+                out_struct((1, mp), jnp.int32, vma),
+            ],
+            compiler_params=params,
+        )(*ops)
+    else:
+        x, y = _pad2(queries, mp, kp), _pad2(db, np_, kp)
+        vma, (x, y) = join_vma(x, y)
+        vals, idx = pallas_call(
+            functools.partial(_minonly_kernel, tn=tn, n_valid=n,
+                              metric="l2"),
+            grid=grid,
+            in_specs=_tile_in_specs(tm, tn, kp, split=False),
+            out_specs=out_specs,
+            out_shape=[
+                out_struct((1, mp), jnp.float32, vma),
+                out_struct((1, mp), jnp.int32, vma),
+            ],
+            compiler_params=params,
+        )(x, y)
+    return vals[0, :q], idx[0, :q]
+
+
 def supports(k: int) -> bool:
     """The fused path holds one vreg of sorted best per query row."""
     return 1 <= k <= MAX_K
 
 
 def knn_fused(queries, db, k: int, metric: str = "l2",
-              tm: int = 256, tn: int = 1024):
+              tm: int = 256, tn: int = 1024, sw: int = 0):
     """Fused-kernel kNN: (vals [q, k], idx [q, k]), nearest first.
 
     Callers dispatch here for k <= 128 on the compiled backend (see
     brute_force.knn); inputs are f32 (cast by the caller), metric is the
-    kernel vocabulary ('l2' squared / 'cosine' / 'inner')."""
+    kernel vocabulary ('l2' squared / 'cosine' / 'inner'). ``sw`` sets
+    the drain-strip width (0 = whole tile; see _topk_body)."""
     q, d = queries.shape
     n = db.shape[0]
     tm = min(tm, round_up_to_multiple(q, 8))
     tn = max(128, tn - tn % 128)          # lane-aligned working width
     tn = min(tn, round_up_to_multiple(n, 128))
+    if sw and (sw < 0 or sw % 128 or tn % sw):
+        raise ValueError(f"sw must be a positive lane-aligned divisor "
+                         f"of tn={tn}")
     mp = round_up_to_multiple(q, tm)
     np_ = round_up_to_multiple(n, tn)
     kp = round_up_to_multiple(d, 128)
     if _use_split(queries, db):
         vals, idx = _fused_topk_padded_split(
             *_split_operands(queries, db, mp, np_, kp), tm, tn, k, n,
-            metric)
+            metric, sw)
     else:
         vals, idx = _fused_topk_padded(
             _pad2(queries, mp, kp), _pad2(db, np_, kp), tm, tn, k, n,
-            metric)
+            metric, sw)
     return vals[:q, :k], idx[:q, :k]

@@ -345,6 +345,39 @@ class TestFusedTopK:
         ov, oi = self._oracle(q, db, 21)
         np.testing.assert_array_equal(np.asarray(i), oi)
 
+    @pytest.mark.parametrize("sw", [128, 256])
+    def test_strip_drain_matches_whole_tile(self, sw):
+        """sw splits the drain into static strips (matmul width and
+        drain width decoupled); results must be bit-identical to the
+        whole-tile drain, including the global tie contract and on the
+        adversarial best-candidates-last ordering."""
+        from raft_tpu.neighbors.fused_topk import knn_fused
+
+        rng = np.random.default_rng(13)
+        q = rng.normal(size=(11, 9)).astype(np.float32)
+        db = rng.normal(size=(1100, 9)).astype(np.float32)
+        norms = (db ** 2).sum(1)
+        db = db[np.argsort(-norms)]          # best candidates LAST
+        v0, i0 = knn_fused(jnp.asarray(q), jnp.asarray(db), 9, tn=512)
+        v1, i1 = knn_fused(jnp.asarray(q), jnp.asarray(db), 9, tn=512,
+                           sw=sw)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        ov, oi = self._oracle(q, db, 9)
+        np.testing.assert_array_equal(np.asarray(i1), oi)
+
+    def test_strip_drain_tie_contract(self):
+        from raft_tpu.neighbors.fused_topk import knn_fused
+
+        q = np.ones((3, 8), np.float32)
+        base = np.arange(40, dtype=np.float32).reshape(5, 8)
+        db = np.tile(base, (60, 1))          # 300 rows, 60 exact copies
+        v, i = knn_fused(jnp.asarray(q), jnp.asarray(db), 7, tn=256,
+                         sw=128)
+        d = ((q[:1, None, :] - db[None, :, :]) ** 2).sum(-1)[0]
+        oi = np.argsort(d, kind="stable")[:7]
+        np.testing.assert_array_equal(np.asarray(i)[0], oi)
+
     def test_metrics_through_dispatch(self):
         """cosine and inner ride the fused path with the right ordering
         (inner: largest first via the negated kernel metric)."""
