@@ -157,7 +157,7 @@ def main():
                      error=f"{type(e).__name__}: {e}"[:200])
 
     # -- k sensitivity at the best tiles ---------------------------------
-    for kk in (16, 64, 128):
+    for kk in (16, 64, 128, 256):
         f = jax.jit(functools.partial(knn_fused, k=kk, tm=btm, tn=btn))
         try:
             ms, fb = time_marginal(lambda: f(queries, db))

@@ -35,13 +35,14 @@ tpu_aot_compile(f, ((1 << 20, 128), jnp.float32), ((1024, 128),
                 jnp.float32))
 print("PRE_OK")
 """,
-    # -- kNN at the bench shape: fused path (k=64) + the chunked-radix
-    #    fallback arm (k=256 > fused MAX_K) -----------------------------
+    # -- kNN at the bench shape: fused path (k=64 one-vreg, k=200/256
+    #    two-vreg best) + the chunked-radix fallback arm
+    #    (k=512 > fused MAX_K) ------------------------------------------
     "knn_bench": HDR + """
 import raft_tpu
 from raft_tpu.neighbors import knn
 raft_tpu.set_matmul_precision("high")
-for k in (64, 256):
+for k in (64, 200, 256, 512):
     f = functools.partial(knn, None, k=k)
     tpu_aot_compile(f, ((1 << 20, 128), jnp.float32),
                     ((4096, 128), jnp.float32))

@@ -199,7 +199,7 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     on the chunked path's distance block (an explicit small tile forces
     the scan path rather than being silently ignored). Default: auto.
 
-    Dispatch: k <= 128 runs the fused distance+top-k kernel
+    Dispatch: k <= 256 runs the fused distance+top-k kernel
     (:mod:`raft_tpu.neighbors.fused_topk` — distances never leave VMEM,
     merges bound-gated; round-5 capture showed every materializing
     formulation select-bound at ~1.3 G items/s). Larger k at long

@@ -65,7 +65,15 @@ from raft_tpu.util.math import round_up_to_multiple
 from raft_tpu.util.pallas_utils import (join_vma, out_struct, pallas_call)
 
 LANES = 128
-MAX_K = LANES  # one vreg of best per query row; larger k takes other paths
+MAX_K = 2 * LANES   # up to two vregs of sorted best per query row
+                    # (k > 256 takes the chunked-radix path)
+
+
+def _best_width(k: int) -> int:
+    """Lane-aligned width of the sorted-best buffer: one vreg for
+    k <= 128, two for k <= 256 (insert cost scales with the width, so
+    the buffer is as narrow as k allows)."""
+    return LANES * ((k + LANES - 1) // LANES)
 
 
 def _tile_in_specs(tm: int, tn: int, kp: int, split: bool):
@@ -133,13 +141,14 @@ def _topk_body(dist, val_ref, idx_ref, j, tn: int, k: int,
     dead-lane extraction width shrinks. Strips see ascending global
     columns, preserving the tie contract."""
     tm = dist.shape[0]
-    lane = jax.lax.broadcasted_iota(jnp.int32, (tm, LANES), 1)
+    bw = _best_width(k)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tm, bw), 1)
     inf = jnp.asarray(jnp.inf, jnp.float32)
 
     @pl.when(j == 0)
     def _init():
-        val_ref[:] = jnp.full((tm, LANES), jnp.inf, jnp.float32)
-        idx_ref[:] = jnp.zeros((tm, LANES), jnp.int32)
+        val_ref[:] = jnp.full((tm, bw), jnp.inf, jnp.float32)
+        idx_ref[:] = jnp.zeros((tm, bw), jnp.int32)
 
     def kth(bv):
         # masked one-lane reduce: a (tm, 1)-index gather from (tm, 128)
@@ -210,6 +219,7 @@ def _fused_topk_padded(x, y, tm: int, tn: int, k: int, n_valid: int,
                        metric: str, sw: int = 0):
     m, kp = x.shape
     n = y.shape[0]
+    bw = _best_width(k)
     vma, (x, y) = join_vma(x, y)
     kernel = functools.partial(_topk_kernel, tn=tn, k=k, n_valid=n_valid,
                                metric=metric, sw=sw)
@@ -218,14 +228,14 @@ def _fused_topk_padded(x, y, tm: int, tn: int, k: int, n_valid: int,
         grid=(m // tm, n // tn),
         in_specs=_tile_in_specs(tm, tn, kp, split=False),
         out_specs=[
-            pl.BlockSpec((tm, LANES), lambda i, j: (i, 0),
+            pl.BlockSpec((tm, bw), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tm, LANES), lambda i, j: (i, 0),
+            pl.BlockSpec((tm, bw), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            out_struct((m, LANES), jnp.float32, vma),
-            out_struct((m, LANES), jnp.int32, vma),
+            out_struct((m, bw), jnp.float32, vma),
+            out_struct((m, bw), jnp.int32, vma),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
@@ -240,6 +250,7 @@ def _fused_topk_padded_split(xh, xl, xn, yh, yl, yn, tm: int, tn: int,
                              sw: int = 0):
     m, kp = xh.shape
     n = yh.shape[0]
+    bw = _best_width(k)
     vma, (xh, xl, xn, yh, yl, yn) = join_vma(xh, xl, xn, yh, yl, yn)
     kernel = functools.partial(_topk_kernel_split, tn=tn, k=k,
                                n_valid=n_valid, metric=metric, sw=sw)
@@ -248,14 +259,14 @@ def _fused_topk_padded_split(xh, xl, xn, yh, yl, yn, tm: int, tn: int,
         grid=(m // tm, n // tn),
         in_specs=_tile_in_specs(tm, tn, kp, split=True),
         out_specs=[
-            pl.BlockSpec((tm, LANES), lambda i, j: (i, 0),
+            pl.BlockSpec((tm, bw), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tm, LANES), lambda i, j: (i, 0),
+            pl.BlockSpec((tm, bw), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            out_struct((m, LANES), jnp.float32, vma),
-            out_struct((m, LANES), jnp.int32, vma),
+            out_struct((m, bw), jnp.float32, vma),
+            out_struct((m, bw), jnp.int32, vma),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
@@ -357,7 +368,7 @@ def _minonly_probe(queries, db, tm: int = 256, tn: int = 1024):
 
 
 def supports(k: int) -> bool:
-    """The fused path holds one vreg of sorted best per query row."""
+    """The fused path holds <= 2 vregs of sorted best per query row."""
     return 1 <= k <= MAX_K
 
 
@@ -365,7 +376,7 @@ def knn_fused(queries, db, k: int, metric: str = "l2",
               tm: int = 256, tn: int = 1024, sw: int = 0):
     """Fused-kernel kNN: (vals [q, k], idx [q, k]), nearest first.
 
-    Callers dispatch here for k <= 128 on the compiled backend (see
+    Callers dispatch here for k <= 256 on the compiled backend (see
     brute_force.knn); inputs are f32 (cast by the caller), metric is the
     kernel vocabulary ('l2' squared / 'cosine' / 'inner'). ``sw`` sets
     the drain-strip width (0 = whole tile; see _topk_body)."""
@@ -374,9 +385,13 @@ def knn_fused(queries, db, k: int, metric: str = "l2",
     tm = min(tm, round_up_to_multiple(q, 8))
     tn = max(128, tn - tn % 128)          # lane-aligned working width
     tn = min(tn, round_up_to_multiple(n, 128))
-    if sw and (sw < 0 or sw % 128 or tn % sw):
-        raise ValueError(f"sw must be a positive lane-aligned divisor "
-                         f"of tn={tn}")
+    if sw and (sw < 0 or sw % 128):
+        raise ValueError("sw must be a positive multiple of 128")
+    if sw and tn % sw:
+        # the small-db clamp above can shrink tn below the caller's
+        # request and break divisibility — a perf knob degrades to the
+        # whole-tile drain rather than erroring on small inputs
+        sw = 0
     mp = round_up_to_multiple(q, tm)
     np_ = round_up_to_multiple(n, tn)
     kp = round_up_to_multiple(d, 128)

@@ -256,7 +256,7 @@ class TestUnexpandedMetricsKnn:
 
 class TestFusedTopK:
     """The fused distance+top-k kernel (neighbors/fused_topk.py) — the
-    k <= 128 kNN hot path. Oracle: numpy stable argsort."""
+    k <= 256 kNN hot path. Oracle: numpy stable argsort."""
 
     def _oracle(self, q, db, k):
         d = ((q[:, None, :].astype(np.float64)
@@ -313,14 +313,33 @@ class TestFusedTopK:
         from raft_tpu.neighbors.fused_topk import MAX_K, knn_fused
 
         rng = np.random.default_rng(9)
-        q = rng.normal(size=(5, 12)).astype(np.float32)
-        db = rng.normal(size=(200, 12)).astype(np.float32)
+        # integer grid data: expanded-form f32 distances are exact, so
+        # index equality is well-defined even at rank depth ~ n
+        q = rng.integers(-5, 6, size=(5, 12)).astype(np.float32)
+        db = rng.integers(-5, 6, size=(MAX_K + 144, 12)).astype(np.float32)
         v, i = knn_fused(jnp.asarray(q), jnp.asarray(db), MAX_K)
         ov, oi = self._oracle(q, db, MAX_K)
         np.testing.assert_array_equal(np.asarray(i), oi)
 
+    @pytest.mark.parametrize("k", [129, 256])
+    def test_two_vreg_best_k_beyond_128(self, k):
+        """k in (128, 256] widens the sorted best to two vregs; integer
+        data makes the expanded-form f32 distances exact, so the index
+        compare is valid through near-rank ties."""
+        from raft_tpu.neighbors.fused_topk import knn_fused
+
+        rng = np.random.default_rng(15)
+        q = rng.integers(-6, 7, size=(9, 16)).astype(np.float32)
+        db = rng.integers(-6, 7, size=(1100, 16)).astype(np.float32)
+        d = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+        oi = np.argsort(d, axis=1, kind="stable")[:, :k]
+        for sw in (0, 128):
+            v, i = knn_fused(jnp.asarray(q), jnp.asarray(db), k, tn=512,
+                             sw=sw)
+            np.testing.assert_array_equal(np.asarray(i), oi)
+
     def test_dispatch_prefers_fused(self):
-        """knn() routes k <= 128 through the fused kernel; results match
+        """knn() routes k <= 256 through the fused kernel; results match
         the chunked/scan paths it replaced."""
         from raft_tpu.neighbors.brute_force import _knn_scan
 
@@ -366,6 +385,24 @@ class TestFusedTopK:
         ov, oi = self._oracle(q, db, 9)
         np.testing.assert_array_equal(np.asarray(i1), oi)
 
+    def test_strip_width_validation_and_clamp(self):
+        """Malformed sw raises; an sw made indivisible only by the
+        small-db tn clamp degrades to the whole-tile drain (perf knob,
+        not a correctness contract)."""
+        from raft_tpu.neighbors.fused_topk import knn_fused
+
+        rng = np.random.default_rng(16)
+        q = jnp.asarray(rng.normal(size=(5, 8)).astype(np.float32))
+        db = jnp.asarray(rng.normal(size=(300, 8)).astype(np.float32))
+        for bad in (-128, 100):
+            with pytest.raises(ValueError):
+                knn_fused(q, db, 5, sw=bad)
+        # tn clamps to 384 here; sw=256 no longer divides it -> falls
+        # back to sw=0 and must still be correct
+        v, i = knn_fused(q, db, 5, tn=1024, sw=256)
+        v0, i0 = knn_fused(q, db, 5, tn=1024)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i0))
+
     def test_strip_drain_tie_contract(self):
         from raft_tpu.neighbors.fused_topk import knn_fused
 
@@ -377,6 +414,27 @@ class TestFusedTopK:
         d = ((q[:1, None, :] - db[None, :, :]) ** 2).sum(-1)[0]
         oi = np.argsort(d, kind="stable")[:7]
         np.testing.assert_array_equal(np.asarray(i)[0], oi)
+
+    @pytest.mark.parametrize("tier", ["default", "high"])
+    def test_minonly_probe_both_dispatch_paths(self, tier):
+        """The tune-only 1-NN floor probe must stay oracle-correct on
+        both the plain and pre-split operand pipelines (it exists to
+        price the SAME distance path the fused kernel runs)."""
+        import raft_tpu
+        from raft_tpu.neighbors.fused_topk import _minonly_probe
+
+        rng = np.random.default_rng(14)
+        q = rng.normal(size=(21, 10)).astype(np.float32)
+        db = rng.normal(size=(900, 10)).astype(np.float32)
+        old = raft_tpu.get_matmul_precision()
+        try:
+            raft_tpu.set_matmul_precision(tier)
+            v, i = _minonly_probe(jnp.asarray(q), jnp.asarray(db),
+                                  tm=128, tn=256)
+        finally:
+            raft_tpu.set_matmul_precision(old)
+        _, oi = self._oracle(q, db, 1)
+        np.testing.assert_array_equal(np.asarray(i), oi[:, 0])
 
     def test_metrics_through_dispatch(self):
         """cosine and inner ride the fused path with the right ordering

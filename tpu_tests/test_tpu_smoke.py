@@ -605,6 +605,38 @@ class TestFusedTopKOnChip:
         finally:
             raft_tpu.set_matmul_precision(old)
 
+    def test_knn_fused_two_vreg_best_k200(self):
+        """k in (128, 256]: the sorted best spans TWO vregs — the
+        pltpu.roll lane shift, the lane==k-1 masked kth reduce, and the
+        while-loop carries all run at 256-lane width on real Mosaic
+        (AOT-probed before the dispatch widened; this pins it on chip).
+        Exactness claim vs the scan path at the same tier, like the
+        k=64 case; strip drain must agree bit-exactly too."""
+        import jax.numpy as jnp
+        import raft_tpu
+        from raft_tpu.neighbors.brute_force import _knn_scan
+        from raft_tpu.neighbors.fused_topk import knn_fused
+
+        rng = np.random.default_rng(54)
+        q = rng.normal(size=(300, 40)).astype(np.float32)
+        db = rng.normal(size=(5000, 40)).astype(np.float32)
+        old = raft_tpu.get_matmul_precision()
+        try:
+            raft_tpu.set_matmul_precision("default")
+            gv, gi = knn_fused(jnp.asarray(q), jnp.asarray(db), 200)
+            sv, si = _knn_scan(jnp.asarray(q), jnp.asarray(db), 200,
+                               1024, "l2")
+            np.testing.assert_array_equal(np.asarray(gi),
+                                          np.asarray(si))
+            wv, wi = knn_fused(jnp.asarray(q), jnp.asarray(db), 200,
+                               sw=256)
+            np.testing.assert_array_equal(np.asarray(wi),
+                                          np.asarray(gi))
+            np.testing.assert_array_equal(np.asarray(wv),
+                                          np.asarray(gv))
+        finally:
+            raft_tpu.set_matmul_precision(old)
+
 
 class TestFusedTopKMnmgOnChip:
     def test_knn_mnmg_fused_body_matches_single_device(self):
