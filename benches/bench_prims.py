@@ -268,7 +268,7 @@ def _select_k_grid(lens_ks):
     algo enums) so a dispatch change can never silently relabel a row.
     Batch is scaled so every case streams ~the same element count —
     throughput comparisons are then apples-to-apples."""
-    from raft_tpu.matrix import radix_select
+    from raft_tpu.matrix import radix_select, topk_insert
     from raft_tpu.matrix.select_k import (_direct_select, _stream_select,
                                           _tiled_select)
 
@@ -285,6 +285,9 @@ def _select_k_grid(lens_ks):
             algos.append(("stream", _stream_select))
         if radix_select.supports(x.dtype, length, k):
             algos.append(("radix", radix_select.radix_select_k))
+        if topk_insert.supports(x.dtype, k):
+            # the round-5 bound-gated insertion contender (k <= 256)
+            algos.append(("insert", topk_insert.insert_select))
         for tag, impl in algos:
             f = jax.jit(functools.partial(impl, k=k, select_min=True))
             yield run_case(f"matrix/select_k_len{length}_k{k}_{tag}", f, x,

@@ -61,9 +61,12 @@ print("PRE_OK")
 from raft_tpu.matrix.select_k import (_direct_select, _stream_select,
                                       _tiled_select)
 from raft_tpu.matrix import radix_select
+from raft_tpu.matrix.topk_insert import insert_select
 for impl, L, k in ((_tiled_select, 65536, 256),
                    (_direct_select, 65536, 256),
-                   (_stream_select, 65536, 256)):
+                   (_stream_select, 65536, 256),
+                   (insert_select, 65536, 256),
+                   (insert_select, 65536, 64)):
     tpu_aot_compile(functools.partial(impl, k=k, select_min=True),
                     ((64, L), jnp.float32))
 for L, k in ((8192, 16), (65536, 2048), (1 << 20, 10000),

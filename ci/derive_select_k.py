@@ -1,8 +1,8 @@
 #!/usr/bin/env python
 """Derive select_k dispatch thresholds from the hardware tournament.
 
-Reads matrix/select_k* rows from a bench JSONL (the four-way
-direct/tiled/stream/radix tournament per (len, k) cell), prints the
+Reads matrix/select_k* rows from a bench JSONL (the five-way
+direct/tiled/stream/radix/insert tournament per (len, k) cell), prints the
 winner map + a recommended dispatch predicate, and quotes the winner's
 HBM fraction — the roofline evidence that originally triggered building
 the Pallas radix-rank kernel (raft_tpu/matrix/radix_select.py; ref
@@ -40,15 +40,15 @@ def main(path):
         return
 
     print(f"{'len':>9} {'k':>6} {'direct ms':>10} {'tiled ms':>9} "
-          f"{'stream ms':>10} {'radix ms':>9} {'winner':>7} "
-          f"{'win GB/s':>9} {'hbm frac':>9}")
+          f"{'stream ms':>10} {'radix ms':>9} {'insert ms':>10} "
+          f"{'winner':>7} {'win GB/s':>9} {'hbm frac':>9}")
     wins = {}
     for (length, k), algos in sorted(cells.items()):
         d = algos.get("direct")
         if not d:
             continue
         times = {a: algos[a]["median_ms"]
-                 for a in ("direct", "tiled", "stream", "radix")
+                 for a in ("direct", "tiled", "stream", "radix", "insert")
                  if a in algos}
         win = min(times, key=times.get)
         wins.setdefault(win, []).append((length, k, times))
@@ -59,11 +59,12 @@ def main(path):
         def fmt(a):
             return f"{times[a]:.2f}" if a in times else "-"
         print(f"{length:>9} {k:>6} {fmt('direct'):>10} {fmt('tiled'):>9} "
-              f"{fmt('stream'):>10} {fmt('radix'):>9} {win:>7} "
+              f"{fmt('stream'):>10} {fmt('radix'):>9} "
+              f"{fmt('insert'):>10} {win:>7} "
               f"{gbs:>9.1f} {gbs / HBM_GB_S:>9.2f}")
 
     print()
-    for algo in ("tiled", "stream", "radix"):
+    for algo in ("tiled", "stream", "radix", "insert"):
         if wins.get(algo):
             cells_won = [(w[0], w[1]) for w in wins[algo]]
             print(f"{algo} wins at: {cells_won}")

@@ -51,6 +51,14 @@ the roofline-indicted band (16 < k <= 2048, long rows) PENDING its own
 four-way grid rows — its cells re-derive from ci/derive_select_k.py
 when the next battery window records them; the radix algo enums map to
 it directly.
+
+Round 5 added a FIFTH contender: bound-gated sorted insertion
+(:mod:`raft_tpu.matrix.topk_insert`, k <= 256) — the drain that took
+the fused kNN kernel from 1.9 s to 98 ms, applied to materialized
+input. It maps to the kWarpsortFiltered/Distributed enums (the
+reference's filtered warpsort IS the insert-if-beats-bound family,
+select_warpsort.cuh:129) and joins the bench tournament as algo
+"insert"; AUTO adopts it where the re-derived grid says it wins.
 """
 
 from __future__ import annotations
@@ -259,16 +267,30 @@ def select_k(res, values, k: int, select_min: bool = True,
     elif algo in (SelectAlgo.WARPSORT_FILTERED,
                   SelectAlgo.WARPSORT_DISTRIBUTED,
                   SelectAlgo.WARPSORT_DISTRIBUTED_EXT):
-        # the streaming running-top-k contender (the reference's filtered/
-        # distributed warpsort variants are likewise the stream-and-merge
-        # family, select_warpsort.cuh:129)
-        mode = "stream" if n_cols > 8192 else "direct"
+        # the reference's "filtered" warpsort inserts only candidates
+        # that beat the current k-th bound (select_warpsort.cuh:129) —
+        # exactly the bound-gated insertion drain, so these slots map
+        # to matrix/topk_insert when it applies (f32-family, k <= 256,
+        # not the interpret-under-shard_map tier); the streaming
+        # running-top-k keeps the remainder of the family
+        from raft_tpu.matrix import topk_insert
+
+        if (topk_insert.supports(values.dtype, k)
+                and not interpret_needs_ref(values)):
+            mode = "insert"
+        else:
+            mode = "stream" if n_cols > 8192 else "direct"
     else:
         mode = "direct"
 
     if mode == "radix":
         out_val, out_idx = radix_select.radix_select_k(values, k,
                                                        select_min)
+    elif mode == "insert":
+        from raft_tpu.matrix import topk_insert
+
+        out_val, out_idx = topk_insert.insert_select(values, k,
+                                                     select_min)
     elif mode == "tiled":
         out_val, out_idx = _tiled_select(values, k, select_min)
     elif mode == "stream":

@@ -1,0 +1,260 @@
+"""Sorted-insertion top-k drain: the shared epilogue primitive behind the
+fused kNN kernel (neighbors/fused_topk.py) and the materialized-input
+``insert_select`` path of matrix/select_k.
+
+The drain keeps the running best (val, idx) lanes SORTED ascending in one
+or two vregs per row. Each round a `lax.while_loop` extracts the per-row
+pool minimum and compare-shifts it into the sorted best (`pltpu.roll` +
+prefix mask); the while condition — "some row's pool still holds a value
+below that row's k-th bound" — is the gate, so a dead tile costs ZERO
+rounds and a tile with c improving candidates costs ~c rounds at full
+vector width. Worst case (rows sorted best-last) degrades to ~k rounds
+per tile — the k-round merge cost, never the pool width.
+
+Reference lineage: the warpsort "filtered" insertion queues
+(matrix/detail/select_warpsort.cuh:129 — insert only when the candidate
+beats the current k-th bound) — same structural idea, re-derived for a
+machine whose selection primitive is VPU passes instead of warp
+shuffles. Hardware evidence for the shape: the kNN capture went
+1883 ms (gated k-round merges) -> 97.7 ms (this drain) at 1M x 128,
+q=4096, k=64 (tpu_battery_out/bench_full.jsonl, round 5).
+
+Mosaic legality notes (probed via ci/aot_compile.py): reduce-min +
+masked-iota argmin (contractions._mask_argmin rationale), `pltpu.roll`
+lane shifts across one and two vregs, `lax.while_loop` with (tm, tn)
+vector carries + i32 any-reduce condition; a (tm, 1)-index vector
+gather from the (tm, bw) best is NOT legal (same-shape operand rule),
+which is why the k-th bound is read by a masked one-lane reduce.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.util.math import round_up_to_multiple
+from raft_tpu.util.pallas_utils import join_vma, out_struct, pallas_call
+
+LANES = 128
+MAX_K = 2 * LANES   # up to two vregs of sorted best per query row
+                    # (larger k takes the radix / tournament paths)
+
+
+def best_width(k: int) -> int:
+    """Lane-aligned width of the sorted-best buffer: one vreg for
+    k <= 128, two for k <= 256 (insert cost scales with the width, so
+    the buffer is as narrow as k allows)."""
+    return LANES * ((k + LANES - 1) // LANES)
+
+
+def row_min_arg(pool, col):
+    """Per-row (min, first-min argmin) of a (tm, tn) pool — reduce-min +
+    masked-iota, the Mosaic-safe argmin spelling (see
+    contractions._mask_argmin for why lax.argmin is not used)."""
+    pm = jnp.min(pool, axis=1, keepdims=True)
+    sentinel = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
+    pidx = jnp.min(jnp.where(pool == pm, col, sentinel), axis=1,
+                   keepdims=True)
+    return pm, pidx
+
+
+def insertion_topk_body(dist, val_ref, idx_ref, j, tn: int, k: int,
+                        n_valid: int, sw: int = 0):
+    """Drain a (tm, tn) candidate tile into the sorted (tm, bw) best.
+
+    Each round: per-row pool min + first-min argmin (smallest column
+    wins ties), consume that lane, and for rows where the minimum beats
+    their k-th bound, compare-shift it into the sorted best. Rows whose
+    pool holds nothing below their bound extract dead mins into a
+    guarded no-op — progress is global (every looping row consumes one
+    lane per round), and the loop exits when no row can improve. Tie
+    contract (smallest index wins globally): within a tile the first-min
+    argmin inserts equal values in column order; across tiles, earlier
+    insertions win because ``keep = best <= candidate`` leaves existing
+    entries to the left of an equal newcomer.
+
+    ``sw`` (strip width, 0 = whole tile): drain the tile in static
+    lane-aligned strips so the per-round vector work is O(tm·sw) while
+    the producer tile keeps its full width — the tile width and the
+    drain width are INDEPENDENT knobs. Round count is unchanged (a
+    candidate is a candidate in any strip); only the dead-lane
+    extraction width shrinks. Strips see ascending global columns,
+    preserving the tie contract.
+
+    NaN candidates are mapped to +inf HERE, for every producer: a NaN
+    pool minimum would match no lane (nothing consumed) and the while
+    loop could spin forever on the DEVICE while any finite candidate
+    sits below the bound — a hang, not a wrong answer. One compare+
+    select per tile element buys termination; +inf is the drain's own
+    never-selected sentinel (NaN sorts last)."""
+    tm = dist.shape[0]
+    dist = jnp.where(jnp.isnan(dist), jnp.asarray(jnp.inf, jnp.float32),
+                     dist)
+    bw = best_width(k)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tm, bw), 1)
+    inf = jnp.asarray(jnp.inf, jnp.float32)
+
+    @pl.when(j == 0)
+    def _init():
+        val_ref[:] = jnp.full((tm, bw), jnp.inf, jnp.float32)
+        idx_ref[:] = jnp.zeros((tm, bw), jnp.int32)
+
+    def kth(bv):
+        # masked one-lane reduce: a (tm, 1)-index gather from (tm, bw)
+        # is not Mosaic-legal (same-shape operand rule)
+        return jnp.min(jnp.where(lane == k - 1, bv, inf), axis=1,
+                       keepdims=True)
+
+    def cond(carry):
+        pool, bv, _ = carry
+        # i32 max, not bool any: jnp.any's bool proxy reduces through
+        # f64 under jax_enable_x64 and fails Mosaic lowering
+        # (radix_select precedent)
+        return jnp.max((pool < kth(bv)).astype(jnp.int32)) > 0
+
+    def drain(pool, col_g, bv, bi):
+        def body(carry):
+            pool, bv, bi = carry
+            pm, pidx = row_min_arg(pool, col_g)
+            pool = jnp.where(col_g == pidx, inf, pool)  # consume lane
+            improving = pm < kth(bv)
+            keep = bv <= pm                 # prefix mask (sorted best)
+            pos = jnp.sum(keep.astype(jnp.int32), axis=1, keepdims=True)
+            shv = pltpu.roll(bv, 1, axis=1)
+            shi = pltpu.roll(bi, 1, axis=1)
+            nv = jnp.where(lane < pos, bv,
+                           jnp.where(lane == pos, pm, shv))
+            ni = jnp.where(lane < pos, bi,
+                           jnp.where(lane == pos, pidx, shi))
+            bv = jnp.where(improving, nv, bv)
+            bi = jnp.where(improving, ni, bi)
+            return pool, bv, bi
+
+        _, bv, bi = jax.lax.while_loop(cond, body, (pool, bv, bi))
+        return bv, bi
+
+    sw = sw or tn
+    bv, bi = val_ref[:], idx_ref[:]
+    for s in range(0, tn, sw):              # static: unrolled strips
+        strip = dist[:, s:s + sw]
+        col_g = (jax.lax.broadcasted_iota(jnp.int32, strip.shape, 1)
+                 + j * tn + s)
+        pool = jnp.where(col_g < n_valid, strip, inf)
+        bv, bi = drain(pool, col_g, bv, bi)
+    val_ref[:] = bv
+    idx_ref[:] = bi
+
+
+# ---------------------------------------------------------------------------
+# insert_select: the drain over a MATERIALIZED [rows, len] input — the
+# select_k contender for k <= 256 (ref: the warpsort-filtered slot of
+# matrix/detail/select_k-inl.cuh's algo table)
+# ---------------------------------------------------------------------------
+
+
+def _insert_kernel(v_ref, val_ref, idx_ref, *, tn: int, k: int,
+                   n_valid: int, sw: int, select_min: bool):
+    j = pl.program_id(1)
+    d = v_ref[:].astype(jnp.float32)
+    if not select_min:
+        d = -d                     # drain extracts minima
+    # (NaN -> +inf sanitization lives in the drain itself)
+    insertion_topk_body(d, val_ref, idx_ref, j, tn, k, n_valid, sw)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select_min", "tm",
+                                             "tn", "sw"))
+def _insert_padded(v, k: int, select_min: bool, tm: int, tn: int,
+                   sw: int):
+    m, n = v.shape
+    bw = best_width(k)
+    vma, (v,) = join_vma(v)
+    kernel = functools.partial(_insert_kernel, tn=tn, k=k, n_valid=n,
+                               sw=sw, select_min=select_min)
+    mp = round_up_to_multiple(m, tm)
+    np_ = round_up_to_multiple(n, tn)
+    if (mp, np_) != (m, n):
+        # row padding: zeros are fine (their outputs are sliced off);
+        # column padding is masked by n_valid inside the body
+        v = jnp.pad(v, ((0, mp - m), (0, np_ - n)))
+    return pallas_call(
+        kernel,
+        grid=(mp // tm, np_ // tn),
+        in_specs=[
+            pl.BlockSpec((tm, tn), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tm, bw), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, bw), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            out_struct((mp, bw), jnp.float32, vma),
+            out_struct((mp, bw), jnp.int32, vma),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(v)
+
+
+def supports(dtype, k: int) -> bool:
+    """f32/bf16/f16 only (the drain compares in f32 — exact for these;
+    wide integers would round above 2^24), k within the 2-vreg best."""
+    dtype = jnp.dtype(dtype)
+    return (jnp.issubdtype(dtype, jnp.floating)
+            and dtype.itemsize <= 4 and 1 <= k <= MAX_K)
+
+
+def insert_select(values, k: int, select_min: bool = True,
+                  tm: int = 256, tn: int = 2048, sw: int = 256):
+    """Top-k of each row by bound-gated sorted insertion.
+
+    Returns (vals [m, k], idx [m, k]), best-first, idx = positions.
+    Contract notes: NaNs never insert (they compare false), i.e. they
+    sort strictly last; rows with fewer than k candidates below the
+    drain's +inf sentinel (k-th best would be +inf for select_min /
+    -inf for select_max, or NaN-saturated) are DETECTED and re-answered
+    through the direct lax.top_k path inside a ``lax.cond`` — full
+    index parity with the direct path on degenerate data, one
+    any-reduce of cost on clean data. Candidate pool cost is O(actual
+    updates); adversarial best-last rows degrade to ~k rounds per tile
+    (the merge cost), never the pool width."""
+    v = jnp.asarray(values)
+    m, n = v.shape
+    if not supports(v.dtype, k):
+        raise ValueError(f"insert_select: unsupported {v.dtype}/k={k}")
+    tm = max(128, tm - tm % 128)            # (tm, bw) out blocks
+    tn_req = max(128, tn - tn % 128)        # caller's lane-aligned ask
+    tn = min(tn_req, round_up_to_multiple(n, 128))
+    if sw and (sw < 0 or sw % 128 or tn_req % sw):
+        # an sw that never divided the REQUESTED tn is a caller error;
+        # only clamp-induced indivisibility degrades silently below
+        raise ValueError(f"sw must be a positive lane-aligned divisor "
+                         f"of tn={tn_req}")
+    if sw and tn % sw:
+        sw = 0                  # small-db clamp broke divisibility
+    vals, idx = _insert_padded(v, k, select_min, tm, tn, sw)
+    vals, idx = vals[:m, :k], idx[:m, :k]
+
+    from raft_tpu.matrix.select_k import _direct_select
+
+    def _fallback(_):
+        dv, di = _direct_select(v, k, select_min)
+        return dv.astype(jnp.float32), di.astype(jnp.int32)
+
+    # unfilled slots still hold the drain's +inf sentinel (vals are in
+    # the drain's sign convention only AFTER the negate below, so test
+    # the raw buffer): lax.cond executes the direct path only when a
+    # degenerate row exists
+    degenerate = jnp.any(jnp.isinf(vals) & (vals > 0))
+    vals, idx = jax.lax.cond(
+        degenerate, _fallback,
+        lambda _: ((-vals if not select_min else vals), idx),
+        operand=None)
+    return vals.astype(v.dtype), idx

@@ -61,19 +61,13 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.linalg.contractions import (_metric_tile, _metric_tile_split,
                                           _pad2, _split_operands,
                                           _use_split)
+from raft_tpu.matrix.topk_insert import (LANES, MAX_K,
+                                         best_width as _best_width,
+                                         insertion_topk_body as
+                                         _topk_body,
+                                         row_min_arg as _row_min_arg)
 from raft_tpu.util.math import round_up_to_multiple
 from raft_tpu.util.pallas_utils import (join_vma, out_struct, pallas_call)
-
-LANES = 128
-MAX_K = 2 * LANES   # up to two vregs of sorted best per query row
-                    # (k > 256 takes the chunked-radix path)
-
-
-def _best_width(k: int) -> int:
-    """Lane-aligned width of the sorted-best buffer: one vreg for
-    k <= 128, two for k <= 256 (insert cost scales with the width, so
-    the buffer is as narrow as k allows)."""
-    return LANES * ((k + LANES - 1) // LANES)
 
 
 def _tile_in_specs(tm: int, tn: int, kp: int, split: bool):
@@ -102,98 +96,6 @@ def _tile_in_specs(tm: int, tn: int, kp: int, split: bool):
         pl.BlockSpec((1, tn), lambda i, j: (0, j),
                      memory_space=pltpu.VMEM),
     ]
-
-
-def _row_min_arg(pool, col):
-    """Per-row (min, first-min argmin) of a (tm, tn) pool — reduce-min +
-    masked-iota, the Mosaic-safe argmin spelling (see
-    contractions._mask_argmin for why lax.argmin is not used)."""
-    pm = jnp.min(pool, axis=1, keepdims=True)
-    sentinel = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
-    pidx = jnp.min(jnp.where(pool == pm, col, sentinel), axis=1,
-                   keepdims=True)
-    return pm, pidx
-
-
-def _topk_body(dist, val_ref, idx_ref, j, tn: int, k: int,
-               n_valid: int, sw: int = 0):
-    """Shared epilogue of the plain and split kernels: mask the tile's
-    padding columns, then drain the candidate pool by sorted INSERTION
-    (module docstring: O(actual updates), full 256-row vector width,
-    the while condition is the gate).
-
-    Each round: per-row pool min + first-min argmin (smallest column
-    wins ties), consume that lane, and for rows where the minimum beats
-    their k-th bound, compare-shift it into the sorted best. Rows whose
-    pool holds nothing below their bound extract dead mins into a
-    guarded no-op — progress is global (every looping row consumes one
-    lane per round), and the loop exits when no row can improve. Tie
-    contract (smallest index wins globally): within a tile the first-min
-    argmin inserts equal values in column order; across tiles, earlier
-    insertions win because ``keep = best <= candidate`` leaves existing
-    entries to the left of an equal newcomer.
-
-    ``sw`` (strip width, 0 = whole tile): drain the tile in static
-    lane-aligned strips so the per-round vector work is O(tm·sw) while
-    the distance tile keeps its MXU-friendly width — the matmul tile
-    and the drain width are INDEPENDENT knobs. Round count is
-    unchanged (a candidate is a candidate in any strip); only the
-    dead-lane extraction width shrinks. Strips see ascending global
-    columns, preserving the tie contract."""
-    tm = dist.shape[0]
-    bw = _best_width(k)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (tm, bw), 1)
-    inf = jnp.asarray(jnp.inf, jnp.float32)
-
-    @pl.when(j == 0)
-    def _init():
-        val_ref[:] = jnp.full((tm, bw), jnp.inf, jnp.float32)
-        idx_ref[:] = jnp.zeros((tm, bw), jnp.int32)
-
-    def kth(bv):
-        # masked one-lane reduce: a (tm, 1)-index gather from (tm, 128)
-        # is not Mosaic-legal (same-shape operand rule)
-        return jnp.min(jnp.where(lane == k - 1, bv, inf), axis=1,
-                       keepdims=True)
-
-    def cond(carry):
-        pool, bv, _ = carry
-        # i32 max, not bool any: jnp.any's bool proxy reduces through
-        # f64 under jax_enable_x64 and fails Mosaic lowering
-        # (radix_select precedent)
-        return jnp.max((pool < kth(bv)).astype(jnp.int32)) > 0
-
-    def drain(pool, col_g, bv, bi):
-        def body(carry):
-            pool, bv, bi = carry
-            pm, pidx = _row_min_arg(pool, col_g)
-            pool = jnp.where(col_g == pidx, inf, pool)  # consume lane
-            improving = pm < kth(bv)
-            keep = bv <= pm                 # prefix mask (sorted best)
-            pos = jnp.sum(keep.astype(jnp.int32), axis=1, keepdims=True)
-            shv = pltpu.roll(bv, 1, axis=1)
-            shi = pltpu.roll(bi, 1, axis=1)
-            nv = jnp.where(lane < pos, bv,
-                           jnp.where(lane == pos, pm, shv))
-            ni = jnp.where(lane < pos, bi,
-                           jnp.where(lane == pos, pidx, shi))
-            bv = jnp.where(improving, nv, bv)
-            bi = jnp.where(improving, ni, bi)
-            return pool, bv, bi
-
-        _, bv, bi = jax.lax.while_loop(cond, body, (pool, bv, bi))
-        return bv, bi
-
-    sw = sw or tn
-    bv, bi = val_ref[:], idx_ref[:]
-    for s in range(0, tn, sw):              # static: unrolled strips
-        strip = dist[:, s:s + sw]
-        col_g = (jax.lax.broadcasted_iota(jnp.int32, strip.shape, 1)
-                 + j * tn + s)
-        pool = jnp.where(col_g < n_valid, strip, inf)
-        bv, bi = drain(pool, col_g, bv, bi)
-    val_ref[:] = bv
-    idx_ref[:] = bi
 
 
 def _topk_kernel(x_ref, y_ref, val_ref, idx_ref, *, tn: int, k: int,
@@ -383,14 +285,15 @@ def knn_fused(queries, db, k: int, metric: str = "l2",
     q, d = queries.shape
     n = db.shape[0]
     tm = min(tm, round_up_to_multiple(q, 8))
-    tn = max(128, tn - tn % 128)          # lane-aligned working width
-    tn = min(tn, round_up_to_multiple(n, 128))
-    if sw and (sw < 0 or sw % 128):
-        raise ValueError("sw must be a positive multiple of 128")
+    tn_req = max(128, tn - tn % 128)      # caller's lane-aligned ask
+    tn = min(tn_req, round_up_to_multiple(n, 128))
+    if sw and (sw < 0 or sw % 128 or tn_req % sw):
+        # an sw that never divided the REQUESTED tn is a caller error
+        raise ValueError(f"sw must be a positive lane-aligned divisor "
+                         f"of tn={tn_req}")
     if sw and tn % sw:
-        # the small-db clamp above can shrink tn below the caller's
-        # request and break divisibility — a perf knob degrades to the
-        # whole-tile drain rather than erroring on small inputs
+        # only the small-db clamp's indivisibility degrades silently:
+        # a perf knob should not error on small inputs
         sw = 0
     mp = round_up_to_multiple(q, tm)
     np_ = round_up_to_multiple(n, tn)

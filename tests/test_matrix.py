@@ -181,6 +181,106 @@ class TestMiscOps:
         np.testing.assert_allclose(r, 1.0 / (m + 10.0), rtol=1e-5)
 
 
+class TestInsertSelect:
+    """matrix/topk_insert.insert_select — the bound-gated insertion
+    contender for k <= 256 (the reference's warpsort-filtered slot,
+    select_warpsort.cuh:129), sharing the drain with the fused kNN
+    kernel."""
+
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_exact_vs_stable_argsort(self, rng, select_min):
+        from raft_tpu.matrix.topk_insert import insert_select
+
+        x = rng.normal(size=(70, 900)).astype(np.float32)
+        v, i = insert_select(jnp.asarray(x), 17, select_min=select_min,
+                             tn=256)
+        order = np.argsort(x if select_min else -x, axis=1,
+                           kind="stable")[:, :17]
+        np.testing.assert_array_equal(np.asarray(i), order)
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.take_along_axis(x, order, 1))
+
+    def test_ties_smallest_index_and_strips(self, rng):
+        from raft_tpu.matrix.topk_insert import insert_select
+
+        x = np.tile(rng.normal(size=(4, 100)).astype(np.float32), (1, 6))
+        order = np.argsort(x, axis=1, kind="stable")[:, :9]
+        for sw in (0, 128):
+            v, i = insert_select(jnp.asarray(x), 9, tn=128, sw=sw)
+            np.testing.assert_array_equal(np.asarray(i), order)
+
+    def test_nan_sorts_last_and_terminates(self, rng):
+        """NaNs map to +inf inside the drain — without that a NaN pool
+        minimum consumes no lane and the device while-loop would hang
+        whenever a finite candidate stays below the bound."""
+        from raft_tpu.matrix.topk_insert import insert_select
+
+        x = rng.normal(size=(20, 600)).astype(np.float32)
+        x[:, ::7] = np.nan
+        v, i = insert_select(jnp.asarray(x), 5, tn=256)
+        assert not np.isnan(np.asarray(v)).any()
+        order = np.argsort(np.where(np.isnan(x), np.inf, x), 1,
+                           kind="stable")[:, :5]
+        np.testing.assert_array_equal(np.asarray(i), order)
+
+    def test_two_vreg_k200_and_dtype_roundtrip(self, rng):
+        from raft_tpu.matrix.topk_insert import insert_select
+
+        x = rng.normal(size=(16, 700)).astype(np.float32)
+        v, i = insert_select(jnp.asarray(x), 200, tn=256, sw=128)
+        order = np.argsort(x, 1, kind="stable")[:, :200]
+        np.testing.assert_array_equal(np.asarray(i), order)
+        vb, ib = insert_select(jnp.asarray(x, jnp.bfloat16), 7, tn=256)
+        assert vb.dtype == jnp.bfloat16
+
+    def test_unsupported_raises(self):
+        from raft_tpu.matrix.topk_insert import insert_select, supports
+
+        assert not supports(jnp.int32, 5) and not supports(jnp.float32,
+                                                           257)
+        with pytest.raises(ValueError):
+            insert_select(jnp.ones((2, 500), jnp.int32), 5)
+        # sw that never divided the requested tn is a caller error...
+        with pytest.raises(ValueError):
+            insert_select(jnp.ones((2, 5000), jnp.float32), 5, tn=1024,
+                          sw=384)
+        # ...but clamp-induced indivisibility degrades to whole-tile
+        v, i = insert_select(jnp.ones((2, 300), jnp.float32), 3,
+                             tn=1024, sw=256)
+        assert i.shape == (2, 3)
+
+    def test_inf_saturated_rows_get_direct_semantics(self, rng):
+        """Rows whose k-th best is +/-inf would leave drain slots
+        unfilled; the lax.cond fallback re-answers the whole call via
+        the direct path, so indices stay REAL positions (parity with
+        the old WARPSORT_FILTERED routing)."""
+        from raft_tpu.matrix.topk_insert import insert_select
+
+        x = np.full((3, 500), np.inf, np.float32)
+        x[:, 7] = 1.0                      # one finite candidate
+        v, i = insert_select(jnp.asarray(x), 3, tn=256)
+        assert np.asarray(i)[0, 0] == 7
+        # remaining slots: real inf positions, not filler zeros
+        assert set(np.asarray(i)[0, 1:]) <= {0, 1}
+        dv, di = matrix.select_k(None, x, 3,
+                                 algo=SelectAlgo.WARPSORT_IMMEDIATE)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(di))
+        # select_max mirror: -inf saturation
+        xm = -x
+        v, i = insert_select(jnp.asarray(xm), 3, select_min=False,
+                             tn=256)
+        dv, di = matrix.select_k(None, xm, 3, select_min=False,
+                                 algo=SelectAlgo.WARPSORT_IMMEDIATE)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(di))
+
+    def test_select_k_warpsort_filtered_routes_here(self, rng):
+        x = rng.normal(size=(8, 600)).astype(np.float32)
+        v, i = matrix.select_k(None, x, 17,
+                               algo=SelectAlgo.WARPSORT_FILTERED)
+        order = np.argsort(x, 1, kind="stable")[:, :17]
+        np.testing.assert_array_equal(np.asarray(i), order)
+
+
 def test_select_k_int_min_extremes(res):
     """Regression: integer select_min must not wrap at INT32_MIN
     (order-flip uses bitwise NOT, not negation)."""
