@@ -930,8 +930,15 @@ def bench_knn():
     queries = _data(q, d, seed=22)
     f = jax.jit(functools.partial(knn, None, k=k))
     flops = 2 * q * n * d
-    return [run_case("neighbors/knn_l2", f, db, queries, flops=flops,
-                     n=n, q=q, d=d, k=k)]
+    out = [run_case("neighbors/knn_l2", f, db, queries, flops=flops,
+                    n=n, q=q, d=d, k=k)]
+    if full:
+        # the two-vreg fused path (k in (128, 256] rode chunked-radix
+        # until round 5 widened MAX_K)
+        g = jax.jit(functools.partial(knn, None, k=256))
+        out.append(run_case("neighbors/knn_l2_k256", g, db, queries,
+                            flops=flops, n=n, q=q, d=d, k=256))
+    return out
 
 
 # -- stats (ref: bench/prims/stats/*.cu — the domain had no bench family

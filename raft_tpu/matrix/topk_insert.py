@@ -44,6 +44,24 @@ MAX_K = 2 * LANES   # up to two vregs of sorted best per query row
                     # (larger k takes the radix / tournament paths)
 
 
+def resolve_tn_sw(tn: int, sw: int, n: int):
+    """One spelling of the tile-width clamp + strip-width contract for
+    every drain consumer (knn_fused, insert_select): lane-align tn,
+    clamp it to the data width, and validate sw against the REQUESTED
+    tn — an sw that never divided the caller's tn is an error, while
+    indivisibility introduced only by the small-data clamp degrades to
+    the whole-tile drain (a perf knob must not error on small inputs).
+    Returns (tn, sw)."""
+    tn_req = max(128, tn - tn % 128)        # caller's lane-aligned ask
+    tn = min(tn_req, round_up_to_multiple(n, 128))
+    if sw and (sw < 0 or sw % 128 or tn_req % sw):
+        raise ValueError(f"sw must be a positive lane-aligned divisor "
+                         f"of tn={tn_req}")
+    if sw and tn % sw:
+        sw = 0                  # clamp-induced indivisibility only
+    return tn, sw
+
+
 def best_width(k: int) -> int:
     """Lane-aligned width of the sorted-best buffer: one vreg for
     k <= 128, two for k <= 256 (insert cost scales with the width, so
@@ -178,9 +196,12 @@ def _insert_padded(v, k: int, select_min: bool, tm: int, tn: int,
     mp = round_up_to_multiple(m, tm)
     np_ = round_up_to_multiple(n, tn)
     if (mp, np_) != (m, n):
-        # row padding: zeros are fine (their outputs are sliced off);
-        # column padding is masked by n_valid inside the body
-        v = jnp.pad(v, ((0, mp - m), (0, np_ - n)))
+        # NaN padding: the drain's NaN->inf sanitization turns padded
+        # rows into zero-round no-ops in BOTH select directions (zeros
+        # would insert up to k bogus rounds per block in the first
+        # tile); column padding is masked by n_valid inside the body
+        v = jnp.pad(v, ((0, mp - m), (0, np_ - n)),
+                    constant_values=jnp.nan)
     return pallas_call(
         kernel,
         grid=(mp // tm, np_ // tn),
@@ -230,15 +251,7 @@ def insert_select(values, k: int, select_min: bool = True,
     if not supports(v.dtype, k):
         raise ValueError(f"insert_select: unsupported {v.dtype}/k={k}")
     tm = max(128, tm - tm % 128)            # (tm, bw) out blocks
-    tn_req = max(128, tn - tn % 128)        # caller's lane-aligned ask
-    tn = min(tn_req, round_up_to_multiple(n, 128))
-    if sw and (sw < 0 or sw % 128 or tn_req % sw):
-        # an sw that never divided the REQUESTED tn is a caller error;
-        # only clamp-induced indivisibility degrades silently below
-        raise ValueError(f"sw must be a positive lane-aligned divisor "
-                         f"of tn={tn_req}")
-    if sw and tn % sw:
-        sw = 0                  # small-db clamp broke divisibility
+    tn, sw = resolve_tn_sw(tn, sw, n)
     vals, idx = _insert_padded(v, k, select_min, tm, tn, sw)
     vals, idx = vals[:m, :k], idx[:m, :k]
 

@@ -65,6 +65,7 @@ from raft_tpu.matrix.topk_insert import (LANES, MAX_K,
                                          best_width as _best_width,
                                          insertion_topk_body as
                                          _topk_body,
+                                         resolve_tn_sw,
                                          row_min_arg as _row_min_arg)
 from raft_tpu.util.math import round_up_to_multiple
 from raft_tpu.util.pallas_utils import (join_vma, out_struct, pallas_call)
@@ -285,16 +286,7 @@ def knn_fused(queries, db, k: int, metric: str = "l2",
     q, d = queries.shape
     n = db.shape[0]
     tm = min(tm, round_up_to_multiple(q, 8))
-    tn_req = max(128, tn - tn % 128)      # caller's lane-aligned ask
-    tn = min(tn_req, round_up_to_multiple(n, 128))
-    if sw and (sw < 0 or sw % 128 or tn_req % sw):
-        # an sw that never divided the REQUESTED tn is a caller error
-        raise ValueError(f"sw must be a positive lane-aligned divisor "
-                         f"of tn={tn_req}")
-    if sw and tn % sw:
-        # only the small-db clamp's indivisibility degrades silently:
-        # a perf knob should not error on small inputs
-        sw = 0
+    tn, sw = resolve_tn_sw(tn, sw, n)     # shared strip-width contract
     mp = round_up_to_multiple(q, tm)
     np_ = round_up_to_multiple(n, tn)
     kp = round_up_to_multiple(d, 128)
