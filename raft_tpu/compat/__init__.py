@@ -22,3 +22,6 @@ from raft_tpu.compat.outputs import auto_convert_output  # noqa: F401
 from raft_tpu.compat.interruptible import interruptible  # noqa: F401
 from raft_tpu.compat.random_api import rmat  # noqa: F401
 from raft_tpu.compat.sparse_api import eigsh  # noqa: F401
+from raft_tpu.compat.input_validation import (  # noqa: F401
+    do_cols_match, do_dtypes_match, do_rows_match, do_shapes_match,
+    is_c_contiguous)

@@ -1,0 +1,80 @@
+"""Input-validation helpers with pylibraft's names (ref:
+python/pylibraft/pylibraft/common/input_validation.py:13-60).
+
+The reference reads `__cuda_array_interface__` metadata; here the same
+predicates run on anything `jnp.asarray` accepts (jax arrays, numpy,
+`device_ndarray`) — dtype/shape live on the array itself, and
+contiguity is trivially true for jax arrays (XLA owns layout; dlpack
+exports are dense row-major), checked via numpy flags when the object
+exposes them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _as_array(a):
+    if hasattr(a, "values") and hasattr(a, "_arr"):
+        a = a.values                             # device_ndarray unwrap
+    # read metadata WITHOUT jnp.asarray: under the default x64-off
+    # config that conversion silently downcasts f64 -> f32, making
+    # genuinely mismatched dtypes "match"
+    if hasattr(a, "dtype") and hasattr(a, "shape"):
+        return a
+    return np.asarray(a)
+
+
+def _dtype_of(a):
+    d = _as_array(a).dtype
+    try:
+        return np.dtype(d)
+    except TypeError:                    # torch.float32 etc.
+        return np.dtype(str(d).rsplit(".", 1)[-1])
+
+
+def do_dtypes_match(*arrays) -> bool:
+    dtypes = {_dtype_of(a) for a in arrays}
+    return len(dtypes) <= 1
+
+
+def do_rows_match(*arrays) -> bool:
+    rows = {_as_array(a).shape[0] for a in arrays}
+    return len(rows) <= 1
+
+
+def do_cols_match(*arrays) -> bool:
+    cols = {_as_array(a).shape[1] for a in arrays}
+    return len(cols) <= 1
+
+
+def do_shapes_match(*arrays) -> bool:
+    shapes = {tuple(_as_array(a).shape) for a in arrays}
+    return len(shapes) <= 1
+
+
+def is_c_contiguous(a) -> bool:
+    """True for jax arrays (dense row-major through dlpack); strided
+    hosts (numpy, torch) answer from their actual strides — the
+    reference computes this from the array-interface strides too
+    (common/input_validation.py:53)."""
+    a = _as_array(a)
+    if isinstance(a, np.ndarray):
+        return a.flags["C_CONTIGUOUS"]
+    stride = getattr(a, "stride", None)
+    if callable(stride):                 # torch-style: strides in ELEMENTS
+        strides, shape = tuple(stride()), tuple(a.shape)
+        expect, acc = [], 1
+        for dim in reversed(shape):
+            expect.append(acc)
+            acc *= dim
+        return strides == tuple(reversed(expect))
+    strides = getattr(a, "strides", None)
+    if strides is not None:              # numpy-style: strides in BYTES
+        itemsize = np.dtype(a.dtype).itemsize
+        expect, acc = [], itemsize
+        for dim in reversed(tuple(a.shape)):
+            expect.append(acc)
+            acc *= dim
+        return tuple(strides) == tuple(reversed(expect))
+    return True                          # jax arrays: XLA owns layout

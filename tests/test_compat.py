@@ -188,3 +188,31 @@ def test_sparse_linalg_import_path_parity():
     from raft_tpu.compat import eigsh as e1
 
     assert e1 is e2
+
+
+def test_input_validation_parity():
+    """pylibraft.common.input_validation predicate names work on jax
+    arrays and device_ndarray (ref: common/input_validation.py:13-60)."""
+    import numpy as np
+
+    from raft_tpu.compat import (device_ndarray, do_cols_match,
+                                 do_dtypes_match, do_rows_match,
+                                 do_shapes_match, is_c_contiguous)
+
+    a = np.zeros((3, 4), np.float32)
+    b = np.zeros((3, 5), np.float32)
+    c = device_ndarray(np.zeros((3, 4), np.float32))
+    assert do_dtypes_match(a, b, c)
+    assert not do_dtypes_match(a, b.astype(np.float64))
+    assert do_rows_match(a, b, c)
+    assert do_cols_match(a, c) and not do_cols_match(a, b)
+    assert do_shapes_match(a, c) and not do_shapes_match(a, b)
+    assert is_c_contiguous(a) and is_c_contiguous(c)
+    assert not is_c_contiguous(np.asfortranarray(np.zeros((3, 4))))
+    # torch interop: stride-based contiguity + dtype normalization
+    import torch
+
+    t = torch.zeros(3, 4)
+    assert is_c_contiguous(t) and not is_c_contiguous(t.T)
+    assert do_dtypes_match(t, a)
+    assert not do_dtypes_match(t, t.to(torch.float64))
