@@ -304,6 +304,26 @@ def bench_select_k():
             (65536, 16), (65536, 256), (65536, 2048))
     yield from _select_k_grid(lens)
 
+    # insertion worst case: rows sorted DESCENDING, so every tile
+    # improves the bound (~k rounds per tile — the merge cost). The
+    # AUTO adoption of "insert" needs this margin quantified, not just
+    # the random-data cells.
+    from raft_tpu.matrix import topk_insert
+    from raft_tpu.matrix.select_k import _tiled_select
+
+    full = SIZES["rows"] >= (1 << 20)
+    # small tier keeps length > the 8192 tile so the "tiled" leg really
+    # runs the tournament (at <= 8192 _tiled_select dispatches to
+    # direct and the row label would lie)
+    length, k, batch = (65536, 64, 1024) if full else (16384, 16, 8)
+    x = jnp.sort(_data(batch, length), axis=1)[:, ::-1]
+    jax.block_until_ready(x)
+    for tag, impl in (("insert", topk_insert.insert_select),
+                      ("tiled", _tiled_select)):
+        f = jax.jit(functools.partial(impl, k=k, select_min=True))
+        yield run_case(f"matrix/select_k_adversarial_{tag}", f, x,
+                       items=batch * length, k=k, length=length, algo=tag)
+
 
 @bench("matrix/select_k_large")
 def bench_select_k_large():

@@ -156,6 +156,22 @@ def main():
                 emit(case="strip_sweep", tm=tm, tn=tn, sw=sw,
                      error=f"{type(e).__name__}: {e}"[:200])
 
+    # -- adversarial db ordering: rows sorted so EVERY tile improves the
+    # bound (best candidates last) — the drain's worst case (~k rounds
+    # per tile, the merge cost). Quantifies the safety margin the AUTO
+    # adoption of insertion needs for a general primitive.
+    try:
+        norms = jnp.sum(db * db, axis=1)
+        db_adv = db[jnp.argsort(-norms)]
+        jax.block_until_ready(db_adv)
+        f = jax.jit(functools.partial(knn_fused, k=k, tm=btm, tn=btn))
+        ms, fb = time_marginal(lambda: f(queries, db_adv))
+        emit(case="adversarial_sorted", tm=btm, tn=btn, ms=round(ms, 2),
+             **({"floor_bound": True} if fb else {}))
+    except Exception as e:   # noqa: BLE001
+        emit(case="adversarial_sorted",
+             error=f"{type(e).__name__}: {e}"[:200])
+
     # -- k sensitivity at the best tiles ---------------------------------
     for kk in (16, 64, 128, 256):
         f = jax.jit(functools.partial(knn_fused, k=kk, tm=btm, tn=btn))

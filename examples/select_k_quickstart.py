@@ -37,12 +37,15 @@ def main():
                       in_idx=payload)
     print("select_max top-5 payload ids, row 0:", np.asarray(ids)[0])
 
-    # explicit algorithm choice mirrors the reference's SelectAlgo enum
-    for algo in (SelectAlgo.RADIX_11BITS, SelectAlgo.WARPSORT_IMMEDIATE):
+    # explicit algorithm choice mirrors the reference's SelectAlgo enum;
+    # WARPSORT_FILTERED is the bound-gated insertion drain (the fused
+    # kNN epilogue over materialized input, matrix/topk_insert.py)
+    for algo in (SelectAlgo.RADIX_11BITS, SelectAlgo.WARPSORT_IMMEDIATE,
+                 SelectAlgo.WARPSORT_FILTERED):
         v, _ = select_k(None, scores[:4], k=10, algo=algo)
         np.testing.assert_allclose(np.asarray(v),
                                    np.sort(scores[:4], 1)[:, :10])
-    print("explicit algos agree (radix kernel vs direct top_k)")
+    print("explicit algos agree (radix / direct top_k / insertion)")
 
 
 if __name__ == "__main__":
