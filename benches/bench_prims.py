@@ -1016,6 +1016,35 @@ def bench_stats_metrics():
 
 # -- util (ref: bench/prims/util/popc.cu) -----------------------------------
 
+@bench("util/cache")
+def bench_device_cache():
+    """Device-resident functional cache (ref: util/cache.cuh:102 Cache;
+    the in-kernel lookup/assign of cache_util.cuh). One steady-state
+    cycle = batched lookup + insert-the-batch (the get_or_compute shape)
+    as ONE jitted program threading the cache state."""
+    from raft_tpu.util.cache import (device_cache_init, device_cache_insert,
+                                     device_cache_lookup)
+
+    n_vec, cap, batch = 128, 8192, 4096
+    st = device_cache_init(n_vec=n_vec, capacity=cap, associativity=32)
+    rng = np.random.default_rng(5)
+    # distinct keys: device_cache_insert's batch contract (duplicate
+    # same-set keys race for one victim way, XLA-unspecified winner)
+    keys = jnp.asarray(rng.choice(cap * 2, batch,
+                                  replace=False).astype(np.int32))
+    vecs = _data(batch, n_vec, seed=6)
+    st = device_cache_insert(st, keys, vecs)   # warm ~50% of the key space
+
+    @jax.jit
+    def cycle(st, keys, vecs):
+        out, hit, st = device_cache_lookup(st, keys)
+        st = device_cache_insert(st, keys, vecs)
+        return out, hit, st
+
+    return [run_case("util/device_cache_cycle", cycle, st, keys, vecs,
+                     items=batch, n_vec=n_vec, capacity=cap)]
+
+
 @bench("util/popc")
 def bench_popc():
     from raft_tpu.core.bitset import popc
