@@ -1033,7 +1033,27 @@ def bench_device_cache():
     keys = jnp.asarray(rng.choice(cap * 2, batch,
                                   replace=False).astype(np.int32))
     vecs = _data(batch, n_vec, seed=6)
-    st = device_cache_insert(st, keys, vecs)   # warm ~50% of the key space
+    # Warm to ~50% occupancy.  Deterministic insert needs at most one NEW
+    # key per set per batch — distinctness is not enough: new same-set keys
+    # elect the same argmin victim way, so one 4096-key insert into 256
+    # empty sets would retain only ~1 entry per set (~3% occupancy).
+    # Round-robin keys into per-set rounds (round j = each set's j-th key),
+    # padded with the negative drop sentinel to keep one jit shape.
+    keys_np, n_sets = np.asarray(keys), int(st.n_sets)
+    sets = keys_np % n_sets
+    order = np.lexsort((np.arange(batch), sets))
+    start = np.r_[0, np.flatnonzero(np.diff(sets[order])) + 1]
+    rounds = np.empty(batch, np.int64)
+    rounds[order] = np.arange(batch) - np.repeat(start, np.diff(
+        np.r_[start, batch]))
+    n_rounds = int(rounds.max()) + 1
+    pad_keys = np.full((n_rounds, n_sets), -1, np.int32)
+    pad_rows = np.zeros((n_rounds, n_sets), np.int64)
+    pad_keys[rounds, sets] = keys_np
+    pad_rows[rounds, sets] = np.arange(batch)
+    warm = jax.jit(device_cache_insert)
+    for j in range(n_rounds):
+        st = warm(st, jnp.asarray(pad_keys[j]), vecs[pad_rows[j]])
 
     @jax.jit
     def cycle(st, keys, vecs):

@@ -28,5 +28,30 @@ print('import OK; native runtime available:', native_available())
 import raft_tpu.cluster.kmeans, raft_tpu.sparse.solver, raft_tpu.comms
 print('subsystem imports OK')
 "
+# Error-hygiene lint for the comms stack: the resilience layer exists so
+# failures surface as typed CommsError subclasses — reject reintroduced
+# blanket handlers (`except Exception`) and silently swallowed socket
+# errors (`except OSError: pass`; use contextlib.suppress(OSError) at
+# well-understood shutdown sites instead).
+python - <<'PYEOF'
+import pathlib, re, sys
+bad = []
+for p in sorted(pathlib.Path("raft_tpu/comms").glob("*.py")):
+    text = p.read_text()
+    for m in re.finditer(r"except\s+Exception\b", text):
+        bad.append(f"{p}:{text.count(chr(10), 0, m.start()) + 1}: "
+                   "bare 'except Exception' (catch typed CommsError kinds)")
+    for m in re.finditer(r"except\s+OSError\s*:\s*\n\s*pass\b", text):
+        bad.append(f"{p}:{text.count(chr(10), 0, m.start()) + 1}: "
+                   "silent 'except OSError: pass' (use "
+                   "contextlib.suppress or surface a typed error)")
+print("\n".join(bad) if bad else "comms error-hygiene lint: clean")
+sys.exit(1 if bad else 0)
+PYEOF
+
 python -m pytest tests/ -x -q
+
+# Chaos smoke: the comms fault-injection suite on the CPU backend —
+# deterministic fault schedules, typed errors, fast dead-peer detection.
+JAX_PLATFORMS=cpu python -m pytest tests/test_comms_faults.py -q
 echo "smoke: PASS"

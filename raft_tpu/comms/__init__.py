@@ -24,8 +24,26 @@ UCX tag-matched p2p, the TPU design has *two* surfaces:
 The self-test suite mirroring comms/detail/test.hpp:31-513 lives in
 :mod:`raft_tpu.comms.test_suite` and is runnable on any mesh (including the
 8-virtual-CPU-device test mesh) — the analogue of ``perform_test_comms_*``.
+
+Resilience layer (docs/architecture.md "Comms resilience"): a typed
+error taxonomy (:mod:`raft_tpu.comms.errors` — ``CommsError`` →
+``CommsTimeoutError`` / ``PeerFailedError`` / ``CommsAbortedError``,
+mirroring the reference ``status_t`` contract), retry/backoff
+(:mod:`raft_tpu.comms.resilience` ``RetryPolicy``), peer liveness
+(heartbeats + failure detection in :mod:`raft_tpu.comms.tcp_mailbox`),
+and seedable rank-scoped fault injection
+(:mod:`raft_tpu.comms.faults` ``FaultInjector``) behind both mailbox
+transports.
 """
 
+from raft_tpu.comms.errors import (  # noqa: F401
+    CommsError,
+    CommsTimeoutError,
+    PeerFailedError,
+    CommsAbortedError,
+)
+from raft_tpu.comms.resilience import RetryPolicy, TagStore  # noqa: F401
+from raft_tpu.comms.faults import FaultInjector  # noqa: F401
 from raft_tpu.comms.comms import (  # noqa: F401
     Op,
     Datatype,
@@ -50,6 +68,7 @@ from raft_tpu.comms.test_suite import (  # noqa: F401
     perform_test_comms_device_multicast_sendrecv,
     perform_test_comm_split,
 )
+from raft_tpu.comms.tcp_mailbox import TcpMailbox  # noqa: F401
 from raft_tpu.comms.bootstrap import (  # noqa: F401
     Comms,
     initialize_distributed,

@@ -35,6 +35,7 @@ from jax.sharding import Mesh
 from raft_tpu.core import logger
 from raft_tpu.core import resources as core_res
 from raft_tpu.comms.comms import MeshComms
+from raft_tpu.comms.resilience import BOOTSTRAP_POLICY, RetryPolicy
 
 # sessionId -> {"comms": weakref.ref(Comms), "handles": {rank: Resources},
 # ...}; get_raft_comm_state dereferences the weakref before returning
@@ -44,22 +45,42 @@ _session_state: dict = {}
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
-                           process_id: Optional[int] = None) -> None:
+                           process_id: Optional[int] = None,
+                           retry_policy: Optional[RetryPolicy] = None
+                           ) -> None:
     """Multi-host process-group init — the analogue of the NCCL-uniqueId
     broadcast (comms.py:126-142): on TPU pods, `jax.distributed.initialize`
     wires every host into one XLA runtime; afterwards `jax.devices()`
-    spans the whole slice. No-op if already initialized."""
-    try:
-        jax.distributed.initialize(coordinator_address, num_processes,
-                                   process_id)
-    except RuntimeError as e:
-        # Only the benign re-init case may be swallowed; a coordinator
-        # timeout (XlaRuntimeError is a RuntimeError subclass) must
-        # propagate or the job would silently run single-host.
-        if "already" in str(e).lower():
-            logger.debug("jax.distributed already initialized: %s", e)
-        else:
-            raise
+    spans the whole slice. No-op if already initialized.
+
+    Failure handling: the coordinator process routinely comes up *after*
+    some workers (the same bootstrap race TcpMailbox._connect tolerates),
+    so transient failures — connection refused/reset, XLA runtime errors
+    from an absent coordinator — are retried under ``retry_policy``
+    (default :data:`resilience.BOOTSTRAP_POLICY`: 3 attempts, exponential
+    backoff, 60 s budget).  Structural errors (bad arguments raise
+    ``ValueError``) propagate immediately; a deadline overrun raises
+    ``CommsTimeoutError`` chaining the last runtime error, so the job can
+    never silently fall back to running single-host."""
+    policy = retry_policy or BOOTSTRAP_POLICY
+
+    def attempt() -> None:
+        try:
+            jax.distributed.initialize(coordinator_address, num_processes,
+                                       process_id)
+        except RuntimeError as e:
+            # Only the benign re-init case may be swallowed; a coordinator
+            # timeout (XlaRuntimeError is a RuntimeError subclass) must
+            # propagate (and be retried) or the job would silently run
+            # single-host.
+            if "already" in str(e).lower():
+                logger.debug("jax.distributed already initialized: %s", e)
+            else:
+                raise
+
+    policy.call(attempt, retry_on=(RuntimeError, ConnectionError, OSError),
+                describe="jax.distributed.initialize",
+                seed=process_id if process_id is not None else 0)
 
 
 def inject_comms_on_handle(handle, mesh: Mesh, axis_name: str, rank: int,

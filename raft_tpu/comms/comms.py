@@ -25,8 +25,8 @@ multihost utilities instead.
 from __future__ import annotations
 
 import enum
-import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -36,6 +36,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tpu.comms import device as dev
 from raft_tpu.comms.device import Op
+from raft_tpu.comms.errors import CommsAbortedError, CommsError
+from raft_tpu.comms.resilience import TagStore
+from raft_tpu.core import logger
+from raft_tpu.core.interruptible import InterruptedException
 
 
 class Datatype(enum.Enum):
@@ -69,23 +73,47 @@ class _Mailbox:
 
     Keyed by (source, dest, tag); each key is a FIFO. Shared across all rank
     views of one clique.
+
+    Resilience semantics (see :mod:`raft_tpu.comms.resilience`): ``get``
+    raises the typed taxonomy — ``CommsTimeoutError`` at the deadline,
+    ``PeerFailedError`` fast when the source is declared failed,
+    ``CommsAbortedError`` when the blocked thread is cancelled — never a
+    bare ``queue.Empty``.  A :class:`raft_tpu.comms.faults.FaultInjector`
+    on ``faults`` chaos-tests the delivery path; an in-process
+    "disconnect" has no physical link to cut, so it reports the source
+    rank failed (the observable a cut link produces on the TCP
+    transport).
     """
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._queues: Dict[Tuple[int, int, int], "queue.Queue"] = {}
-
-    def _q(self, key):
-        with self._lock:
-            if key not in self._queues:
-                self._queues[key] = queue.Queue()
-            return self._queues[key]
+    def __init__(self, faults=None):
+        self._store = TagStore(name="mailbox")
+        self.faults = faults
 
     def put(self, source: int, dest: int, tag: int, payload) -> None:
-        self._q((source, dest, tag)).put(payload)
+        injector = self.faults
+        if injector is not None:
+            decision = injector.on_send(source, dest, tag, payload)
+            if decision.delay_s:
+                time.sleep(decision.delay_s)
+            for p in decision.payloads:
+                if decision.corrupt:
+                    from raft_tpu.comms.faults import corrupt_array
+                    p = corrupt_array(np.asarray(p))
+                self._store.deliver(source, dest, tag, p)
+            if decision.disconnect:
+                self._store.fail_peer(
+                    source, "fault-injected disconnect")
+            return
+        self._store.deliver(source, dest, tag, payload)
 
     def get(self, source: int, dest: int, tag: int, timeout: float = 30.0):
-        return self._q((source, dest, tag)).get(timeout=timeout)
+        return self._store.get(source, dest, tag, timeout=timeout)
+
+    def fail_peer(self, rank: int, reason: str) -> None:
+        self._store.fail_peer(rank, reason)
+
+    def revive_peer(self, rank: int) -> None:
+        self._store.revive_peer(rank)
 
 
 class _Request:
@@ -203,7 +231,14 @@ class MeshComms:
     # -- sync / barrier (ref: core/comms.hpp:269-276) -----------------------
 
     def sync_stream(self, *arrays) -> Status:
-        """Block until enqueued device work completes (ref: sync_stream)."""
+        """Block until enqueued device work completes (ref: sync_stream).
+
+        Folds the typed comms taxonomy back onto the ``status_t``
+        contract: cancellation → ``ABORT`` (ref status_t::ABORT via
+        interruptible), any comms/runtime failure → ``ERROR`` — logged,
+        never silently swallowed (the round-1 blanket catch-all handler
+        is gone; the ci/smoke.sh hygiene lint keeps it out).
+        """
         try:
             for a in arrays:
                 if hasattr(a, "block_until_ready"):
@@ -211,7 +246,11 @@ class MeshComms:
             if not arrays:
                 jax.effects_barrier()
             return Status.SUCCESS
-        except Exception:  # noqa: BLE001 — mirror status_t::ERROR contract
+        except (CommsAbortedError, InterruptedException):
+            return Status.ABORT
+        except (CommsError, RuntimeError, ValueError, OSError) as e:
+            # RuntimeError covers jax's XlaRuntimeError hierarchy
+            logger.error("sync_stream failed: %r", e)
             return Status.ERROR
 
     def barrier(self) -> None:
@@ -227,9 +266,17 @@ class MeshComms:
         self._mailbox.put(self._rank, dest, tag, payload)
         return _Request(None)
 
-    def irecv(self, source: int, tag: int) -> _Request:
+    def irecv(self, source: int, tag: int,
+              timeout: Optional[float] = None) -> _Request:
+        """``timeout`` overrides the transport's default recv deadline;
+        the wait raises the typed taxonomy (CommsTimeoutError /
+        PeerFailedError / CommsAbortedError) on failure."""
+        if timeout is None:
+            return _Request(
+                lambda: self._mailbox.get(source, self._rank, tag))
         return _Request(
-            lambda: self._mailbox.get(source, self._rank, tag))
+            lambda: self._mailbox.get(source, self._rank, tag,
+                                      timeout=timeout))
 
     def waitall(self, requests: Sequence[_Request]) -> List[Any]:
         return [r.wait() for r in requests]

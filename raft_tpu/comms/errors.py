@@ -1,0 +1,71 @@
+"""Typed error taxonomy for the comms stack (ref: core/comms.hpp:31-35
+``status_t`` + raft::interruptible's ``interrupted_exception``).
+
+The reference surfaces distributed failure through a tri-state
+``status_t`` (SUCCESS / ERROR / ABORT) returned from ``sync_stream``;
+richer context travels as exceptions.  Here every comms failure mode is
+an exception type carrying the peer rank (where one is attributable) and
+the tag-matched endpoint (where p2p context exists), and
+``MeshComms.sync_stream`` folds the taxonomy back onto the ``Status``
+enum for status_t-contract callers:
+
+========================  ==========================================
+type                      meaning / status_t mapping
+========================  ==========================================
+``CommsError``            base of the taxonomy (→ ``Status.ERROR``)
+``CommsTimeoutError``     a deadline elapsed with the peer apparently
+                          alive (→ ``Status.ERROR``); also a stdlib
+                          ``TimeoutError`` for pre-taxonomy callers
+``PeerFailedError``       the failure detector declared a peer dead;
+                          ``.rank`` names it (→ ``Status.ERROR``)
+``CommsAbortedError``     the operation was cancelled through
+                          ``core.interruptible`` (→ ``Status.ABORT``);
+                          also an ``InterruptedException`` so existing
+                          cancellation-point handlers keep working
+========================  ==========================================
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from raft_tpu.core.interruptible import InterruptedException
+
+
+class CommsError(RuntimeError):
+    """Base comms failure (maps to ``status_t::ERROR``).
+
+    Parameters
+    ----------
+    message : human-readable description.
+    rank : peer rank the failure is attributed to, when known.
+    endpoint : the ``(source, dest, tag)`` of the tag-matched op that
+        observed the failure, when p2p context exists.
+    """
+
+    def __init__(self, message: str, *, rank: Optional[int] = None,
+                 endpoint: Optional[Tuple[int, int, int]] = None):
+        super().__init__(message)
+        self.rank = rank
+        self.endpoint = tuple(endpoint) if endpoint is not None else None
+
+
+class CommsTimeoutError(CommsError, TimeoutError):
+    """A comms deadline elapsed (blocking recv, retry budget, connect).
+
+    Distinct from :class:`PeerFailedError`: a timeout means the peer has
+    not been *proven* dead — it may merely be slow (the loaded-host case
+    the mailbox deadlines are sized for)."""
+
+
+class PeerFailedError(CommsError):
+    """A peer was detected dead (connection lost without a goodbye,
+    heartbeat silence, or fault-injected disconnect).  ``.rank`` always
+    names the dead peer; pending receives matched against it fail fast
+    with this instead of waiting out their full timeout."""
+
+
+class CommsAbortedError(CommsError, InterruptedException):
+    """The blocking comms op was cancelled via ``interruptible.cancel()``
+    (maps to ``status_t::ABORT``).  Subclasses ``InterruptedException``
+    so code treating cancellation points uniformly catches it too."""

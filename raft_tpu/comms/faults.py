@@ -1,0 +1,147 @@
+"""Fault injection for the comms transports (the chaos hook the
+reference never needed to expose: NCCL/UCX failures are injected with
+real network tooling; a re-owned host p2p layer must ship its own).
+
+A :class:`FaultInjector` installs behind *both* transports — the
+in-process ``_Mailbox`` (comms.comms) and ``TcpMailbox``
+(comms.tcp_mailbox) — via their ``faults`` attribute, so one chaos suite
+drives both.  Every send consults :meth:`FaultInjector.on_send`, which
+rolls a seeded RNG against the configured probabilities and returns a
+:class:`FaultDecision` describing what the transport must do:
+
+=============  =============================================================
+fault          transport behavior
+=============  =============================================================
+``drop``       the message is never delivered / never hits the wire
+``delay``      sender sleeps ``delay_s`` before delivery (reordering
+               against other links; kept on the send path so a fixed
+               seed gives a deterministic per-link schedule)
+``duplicate``  the message is delivered / sent twice (at-least-once
+               delivery stress — real TCP reconnect resends can do this)
+``corrupt``    in-process: the payload is bit-flipped and *delivered*
+               (memory-corruption model); on the wire: the frame body is
+               flipped after CRC computation, so the receiver's
+               integrity check detects and drops it (wire-damage model)
+``disconnect`` the link is torn after the send: ``TcpMailbox`` force-
+               closes the connection (peer sees EOF without a goodbye →
+               failure detector fires); ``_Mailbox`` has no physical
+               link, so it reports the source rank failed directly
+=============  =============================================================
+
+Determinism: the RNG is advanced by a fixed number of rolls per
+*in-scope* send regardless of configuration, so the same seed and send
+sequence replay the same fault schedule even as probabilities change.
+Rank scoping (``source_ranks`` / ``dest_ranks``) confines the chaos to
+chosen links; out-of-scope sends neither fault nor advance the RNG.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Set
+
+import numpy as np
+
+from raft_tpu.core import trace
+
+KINDS = ("drop", "delay", "duplicate", "corrupt", "disconnect")
+
+
+@dataclass
+class FaultDecision:
+    """What the transport must do with one send."""
+
+    payloads: List  # 0 entries = dropped, 2 = duplicated
+    delay_s: float = 0.0
+    disconnect: bool = False
+    corrupt: bool = False
+    kinds: tuple = ()  # which fault kinds fired (for logging/tests)
+
+
+def corrupt_array(arr: np.ndarray) -> np.ndarray:
+    """Deterministically bit-flip the first byte of a copy of ``arr``
+    (the in-process corruption model)."""
+    arr = np.asarray(arr)
+    if arr.nbytes == 0:
+        return arr
+    raw = bytearray(arr.tobytes())
+    raw[0] ^= 0xFF
+    return np.frombuffer(bytes(raw), dtype=arr.dtype).reshape(arr.shape)
+
+
+def corrupt_bytes(raw: bytes) -> bytes:
+    """Bit-flip one byte of a serialized frame body (the wire-damage
+    model — applied after CRC computation so the receiver detects it)."""
+    if not raw:
+        return raw
+    buf = bytearray(raw)
+    buf[len(buf) // 2] ^= 0xFF
+    return bytes(buf)
+
+
+class FaultInjector:
+    """Seedable, rank-scoped fault plan for a mailbox transport.
+
+    Parameters are per-send probabilities in [0, 1] for each kind in
+    :data:`KINDS`; ``delay_s`` is the sleep applied when a delay fires;
+    ``source_ranks`` / ``dest_ranks`` scope which links can fault
+    (``None`` = all).  ``counts`` tallies fired faults for assertions.
+    """
+
+    def __init__(self, *, seed: int = 0, drop: float = 0.0,
+                 delay: float = 0.0, duplicate: float = 0.0,
+                 corrupt: float = 0.0, disconnect: float = 0.0,
+                 delay_s: float = 0.02,
+                 source_ranks: Optional[Set[int]] = None,
+                 dest_ranks: Optional[Set[int]] = None):
+        self.probs = {"drop": drop, "delay": delay, "duplicate": duplicate,
+                      "corrupt": corrupt, "disconnect": disconnect}
+        for k, p in self.probs.items():
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{k} probability {p} outside [0, 1]")
+        self.delay_s = float(delay_s)
+        self.source_ranks = (set(source_ranks)
+                             if source_ranks is not None else None)
+        self.dest_ranks = set(dest_ranks) if dest_ranks is not None else None
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.counts: collections.Counter = collections.Counter()
+
+    def in_scope(self, source: int, dest: int) -> bool:
+        return ((self.source_ranks is None or source in self.source_ranks)
+                and (self.dest_ranks is None or dest in self.dest_ranks))
+
+    def on_send(self, source: int, dest: int, tag: int,
+                payload) -> FaultDecision:
+        """Roll the fault plan for one send (transport-agnostic: the
+        caller applies the decision in its own delivery terms)."""
+        if not self.in_scope(source, dest):
+            return FaultDecision(payloads=[payload])
+        with self._lock:
+            # fixed roll order/count per send → deterministic replay
+            rolls = {k: self._rng.random() for k in KINDS}
+            fired = tuple(k for k in KINDS if rolls[k] < self.probs[k])
+            for k in fired:
+                self.counts[k] += 1
+            self.counts["sends"] += 1
+        if fired:
+            trace.record_event("comms.fault", kinds=fired, source=source,
+                               dest=dest, tag=tag)
+        # payloads carries fan-out only (drop/duplicate); corruption is a
+        # *flag* — each transport applies its own damage model
+        # (corrupt_array in-process, corrupt_bytes on the wire)
+        payloads: List = [payload]
+        if "duplicate" in fired:
+            payloads = payloads * 2
+        if "drop" in fired:
+            payloads = []
+        return FaultDecision(
+            payloads=payloads,
+            delay_s=self.delay_s if "delay" in fired else 0.0,
+            disconnect="disconnect" in fired,
+            corrupt="corrupt" in fired,
+            kinds=fired)

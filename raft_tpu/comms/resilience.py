@@ -1,0 +1,246 @@
+"""Retry/backoff and failure-aware blocking — the resilience layer under
+both comms transports.
+
+Two pieces:
+
+* :class:`RetryPolicy` — exponential backoff with jitter, deadline-aware
+  and seedable, used by ``TcpMailbox`` connect/send and by
+  ``bootstrap.initialize_distributed``.  The reference gets this for
+  free from NCCL/UCX internals; re-owning the host p2p layer means
+  re-owning its retry discipline.
+
+* :class:`TagStore` — the tag-matched FIFO store shared by the
+  in-process ``_Mailbox`` (comms.comms) and ``TcpMailbox``
+  (comms.tcp_mailbox).  Unlike the ``queue.Queue``-per-key design it
+  replaces, a single condition variable guards all keys, so a blocked
+  ``get`` can be woken by *any* of: a matching message, the failure
+  detector declaring the awaited peer dead (→ fast
+  :class:`PeerFailedError` instead of a full-deadline stall), or an
+  ``interruptible.cancel()`` aimed at the blocked thread (→
+  :class:`CommsAbortedError`, the ref ``interruptible::synchronize``
+  contract extended to host p2p).
+
+Every retry / failure transition is recorded via
+``core.trace.record_event`` (landing in the emitting thread's active
+trace range) and logged through ``core.logger``.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Deque, Dict, Optional, Tuple
+
+from raft_tpu.core import interruptible, logger, trace
+from raft_tpu.comms.errors import (
+    CommsAbortedError,
+    CommsTimeoutError,
+    PeerFailedError,
+)
+
+_log = logger.child("comms")
+
+# How long a blocked get sleeps between wake checks when nothing stirs
+# the condition variable. Wakeups (message arrival, fail_peer, cancel)
+# interrupt this immediately; the cap only bounds clock-driven checks
+# (deadline expiry) on a quiet store.
+_POLL_CAP_S = 0.1
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff + jitter, deadline-aware (ref: the rendezvous
+    loops UCX/NCCL run internally; raft_dask ucx.py:47 blocks similarly).
+
+    ``delay(attempt)`` grows ``base_delay * multiplier**attempt`` capped
+    at ``max_delay``; ``jitter`` subtracts a uniformly random fraction of
+    up to that share of the delay (decorrelating peer retry storms).
+    ``deadline`` bounds the *total* wall time budget across attempts;
+    when the next backoff would overrun it, the retry loop raises
+    :class:`CommsTimeoutError` chaining the last underlying error.
+    """
+
+    max_attempts: int = 5
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    deadline: Optional[float] = None
+
+    def delay(self, attempt: int, rng: Optional[random.Random] = None
+              ) -> float:
+        d = min(self.max_delay, self.base_delay * self.multiplier ** attempt)
+        if self.jitter and rng is not None:
+            d *= 1.0 - self.jitter * rng.random()
+        return d
+
+    def call(self, fn: Callable, *, retry_on=(OSError,), describe: str = "",
+             seed: Optional[int] = None,
+             on_retry: Optional[Callable[[int, BaseException], None]] = None):
+        """Run ``fn()`` retrying transient failures under this policy.
+
+        ``retry_on`` names the exception types considered transient; any
+        other exception propagates immediately.  ``seed`` makes the
+        jitter sequence reproducible.  Each retry emits a
+        ``comms.retry`` trace event in the caller's active range;
+        exhaustion re-raises the last transient error, while a deadline
+        overrun raises :class:`CommsTimeoutError` chaining it.
+        Cancellation (``interruptible.cancel``) is observed between
+        attempts.
+        """
+        rng = random.Random(seed)
+        start = time.monotonic()
+        last: Optional[BaseException] = None
+        for attempt in range(max(1, self.max_attempts)):
+            interruptible.yield_now()
+            try:
+                return fn()
+            except retry_on as e:
+                last = e
+                wait = self.delay(attempt, rng)
+                elapsed = time.monotonic() - start
+                if (self.deadline is not None
+                        and elapsed + wait > self.deadline):
+                    trace.record_event("comms.retry.deadline",
+                                       what=describe, attempt=attempt + 1,
+                                       elapsed=round(elapsed, 3),
+                                       error=repr(e))
+                    raise CommsTimeoutError(
+                        f"{describe or 'comms op'}: retry deadline "
+                        f"{self.deadline}s overrun after {attempt + 1} "
+                        f"attempt(s): {e!r}") from e
+                if attempt + 1 >= max(1, self.max_attempts):
+                    break
+                trace.record_event("comms.retry", what=describe,
+                                   attempt=attempt + 1,
+                                   delay=round(wait, 4), error=repr(e))
+                _log.debug("retrying %s (attempt %d, backoff %.3fs): %r",
+                           describe, attempt + 1, wait, e)
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                time.sleep(wait)
+        trace.record_event("comms.retry.exhausted", what=describe,
+                           attempts=max(1, self.max_attempts),
+                           error=repr(last))
+        _log.warning("%s failed after %d attempt(s): %r",
+                     describe or "comms op", max(1, self.max_attempts), last)
+        assert last is not None
+        raise last
+
+
+# Connect during bootstrap tolerates slow peers (multi-second XLA
+# compiles before a listener binds — see TcpMailbox.get's deadline
+# rationale); send-path reconnects after an established link drops get
+# a much shorter leash, as a vanished *established* peer is the failure
+# detector's business.
+CONNECT_POLICY = RetryPolicy(max_attempts=60, base_delay=0.1, max_delay=1.0,
+                             multiplier=1.5, jitter=0.3, deadline=120.0)
+RECONNECT_POLICY = RetryPolicy(max_attempts=4, base_delay=0.05,
+                               max_delay=0.5, deadline=5.0)
+BOOTSTRAP_POLICY = RetryPolicy(max_attempts=3, base_delay=0.5, max_delay=4.0,
+                               jitter=0.3, deadline=60.0)
+
+
+class TagStore:
+    """Tag-matched FIFO store with failure-, cancel- and deadline-aware
+    blocking gets (the resilience-layer core shared by both mailboxes).
+
+    Keys are ``(source, dest, tag)``; each key is a FIFO.  Messages
+    already delivered are always drained before failure state is
+    consulted, so a peer's parting messages remain readable after its
+    death is recorded.
+    """
+
+    def __init__(self, name: str = "mailbox"):
+        self.name = name
+        self._cv = threading.Condition()
+        self._queues: Dict[Tuple[int, int, int], Deque] = {}
+        self._failed: Dict[int, str] = {}
+
+    # -- producers ----------------------------------------------------------
+
+    def deliver(self, source: int, dest: int, tag: int, payload) -> None:
+        with self._cv:
+            self._queues.setdefault((source, dest, tag),
+                                    collections.deque()).append(payload)
+            self._cv.notify_all()
+
+    def stir(self) -> None:
+        """Wake every blocked getter to re-check its exit conditions
+        (registered as an ``interruptible`` waker during gets)."""
+        with self._cv:
+            self._cv.notify_all()
+
+    # -- failure detector interface -----------------------------------------
+
+    def fail_peer(self, rank: int, reason: str) -> None:
+        """Declare ``rank`` dead: pending and future gets matched against
+        it raise :class:`PeerFailedError` fast (after draining anything
+        it already delivered)."""
+        with self._cv:
+            if rank not in self._failed:
+                self._failed[rank] = reason
+                trace.record_event("comms.peer_failed", store=self.name,
+                                   rank=rank, reason=reason)
+                _log.warning("%s: peer rank %d declared failed: %s",
+                             self.name, rank, reason)
+            self._cv.notify_all()
+
+    def revive_peer(self, rank: int) -> None:
+        """Clear failure state on fresh liveness evidence (a frame from
+        the peer after a transient disconnect)."""
+        with self._cv:
+            if self._failed.pop(rank, None) is not None:
+                trace.record_event("comms.peer_revived", store=self.name,
+                                   rank=rank)
+                _log.warning("%s: peer rank %d revived", self.name, rank)
+
+    def peer_failed(self, rank: int) -> Optional[str]:
+        with self._cv:
+            return self._failed.get(rank)
+
+    # -- consumer -----------------------------------------------------------
+
+    def get(self, source: int, dest: int, tag: int, timeout: float = 30.0):
+        """Blocking tag-matched receive.
+
+        Raises :class:`PeerFailedError` as soon as the failure detector
+        declares ``source`` dead, :class:`CommsAbortedError` when this
+        thread's ``interruptible`` token is cancelled (the cancel wakes
+        the wait immediately), and :class:`CommsTimeoutError` at the
+        deadline.
+        """
+        key = (source, dest, tag)
+        token = interruptible.get_token()
+        token.add_waker(self.stir)
+        deadline = time.monotonic() + timeout
+        try:
+            with self._cv:
+                while True:
+                    dq = self._queues.get(key)
+                    if dq:
+                        return dq.popleft()
+                    if token.cancelled():
+                        token.clear()
+                        raise CommsAbortedError(
+                            f"{self.name}: recv {key} cancelled",
+                            endpoint=key)
+                    reason = self._failed.get(source)
+                    if reason is not None:
+                        raise PeerFailedError(
+                            f"{self.name}: peer rank {source} failed "
+                            f"({reason}) with recv {key} pending",
+                            rank=source, endpoint=key)
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise CommsTimeoutError(
+                            f"{self.name}: recv {key} timed out after "
+                            f"{timeout}s (peer not proven dead — see "
+                            f"PeerFailedError vs timeout semantics)",
+                            rank=source, endpoint=key)
+                    self._cv.wait(min(remaining, _POLL_CAP_S))
+        finally:
+            token.remove_waker(self.stir)

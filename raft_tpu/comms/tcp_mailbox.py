@@ -7,7 +7,26 @@ multi-process SPMD job (one controller per host, wired together with
 `jax.distributed` — see comms.bootstrap.initialize_distributed) uses this
 `TcpMailbox` instead: same (source, dest, tag) FIFO semantics, but
 messages to remote ranks travel over TCP. Payloads are numpy arrays in
-``.npy`` wire format (no pickle: nothing executable crosses the wire).
+``.npy`` wire format (no pickle: nothing executable crosses the wire),
+each framed with a CRC32 so wire damage is *detected* and dropped rather
+than delivered.
+
+Resilience (ref: the reliability NCCL/UCX provide internally, which a
+re-owned transport must rebuild — see docs/architecture.md "Comms
+resilience"):
+
+* connect/send retries ride :class:`raft_tpu.comms.resilience.RetryPolicy`
+  (exponential backoff + jitter, deadline-aware);
+* every connection opens with a HELLO frame naming the sender's rank, so
+  the receiving side can attribute the connection — and its death — to a
+  peer; periodic HEARTBEAT frames keep attributed peers provably alive,
+  and a failure detector declares a peer dead on connection loss without
+  a GOODBYE or on heartbeat silence, failing pending ``get``s fast with
+  :class:`PeerFailedError` (dead rank attached) instead of letting them
+  wait out the full deadline;
+* a :class:`raft_tpu.comms.faults.FaultInjector` on ``faults``
+  chaos-tests the wire path (drop / delay / duplicate / corrupt /
+  disconnect) — the same injector drives the in-process `_Mailbox`.
 
 Design note (the committed multi-process story, VERDICT #7): device-side
 collectives in a multi-process job are XLA's own — a jitted computation
@@ -19,16 +38,34 @@ module is that layer's TPU-stack equivalent.
 
 from __future__ import annotations
 
+import contextlib
 import io
-import queue
 import socket
 import struct
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+import zlib
+from typing import Dict, List, Optional, Set
 
 import numpy as np
 
-_HDR = struct.Struct("<iiiq")  # source, dest, tag, nbytes
+from raft_tpu.comms.errors import CommsTimeoutError, PeerFailedError
+from raft_tpu.comms.faults import corrupt_array, corrupt_bytes
+from raft_tpu.comms.resilience import (
+    CONNECT_POLICY,
+    RECONNECT_POLICY,
+    RetryPolicy,
+    TagStore,
+)
+from raft_tpu.core import logger, trace
+
+# kind, source, dest, tag, crc32(body), nbytes
+_HDR = struct.Struct("<iiiiIq")
+
+_DATA = 0       # tag-matched payload frame (body = .npy bytes)
+_HELLO = 1      # connection preamble: attributes the stream to a rank
+_HEARTBEAT = 2  # periodic liveness proof on idle/busy links alike
+_GOODBYE = 3    # graceful departure: peer is leaving, not crashing
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -50,12 +87,29 @@ class TcpMailbox:
     addrs : per-rank "host:port" listen addresses (every rank gets the
         same list — the analogue of the worker address exchange in
         raft_dask comms.py:144's worker_info).
+    faults : optional FaultInjector installed on the send path.
+    heartbeat_interval : seconds between HEARTBEAT frames on each open
+        outbound connection.
+    heartbeat_timeout : silence (no frame of any kind) from an attributed
+        peer after which the failure detector declares it dead.  Sized
+        generously by default: a loaded host can stall user threads for
+        seconds (the same rationale as ``get``'s deadline); the *fast*
+        detection path is connection EOF, which needs no timer.
+    connect_policy : RetryPolicy for first-contact connects (default
+        tolerates slow bootstrap, resilience.CONNECT_POLICY).
     """
 
-    def __init__(self, rank: int, addrs: List[str]):
+    def __init__(self, rank: int, addrs: List[str], *, faults=None,
+                 heartbeat_interval: float = 2.0,
+                 heartbeat_timeout: float = 10.0,
+                 connect_policy: Optional[RetryPolicy] = None):
         self.rank = int(rank)
         self.addrs = list(addrs)
-        self._queues: Dict[Tuple[int, int, int], "queue.Queue"] = {}
+        self.faults = faults
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.connect_policy = connect_policy or CONNECT_POLICY
+        self._store = TagStore(name=f"tcp-mailbox[rank {self.rank}]")
         self._lock = threading.Lock()
         # One persistent connection per destination, guarded by a per-dest
         # lock: all messages to a peer travel one ordered byte stream, and
@@ -64,64 +118,133 @@ class TcpMailbox:
         # FIFO contract across processes.
         self._conns: Dict[int, socket.socket] = {}
         self._conn_locks: Dict[int, threading.Lock] = {}
+        self._inbound: Set[socket.socket] = set()
+        self._last_seen: Dict[int, float] = {}
+        self._departed: Set[int] = set()
+        self.corrupt_frames = 0
         host, port = self.addrs[self.rank].rsplit(":", 1)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, int(port)))
         self._srv.listen(64)
         self._closed = False
+        self._stop = threading.Event()
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
+        self._maint_thread = threading.Thread(target=self._maintenance,
+                                              daemon=True)
+        self._maint_thread.start()
 
     # -- the _Mailbox interface (comms.comms) ------------------------------
 
-    def _connect(self, dest: int) -> socket.socket:
+    def _connect(self, dest: int,
+                 policy: Optional[RetryPolicy] = None) -> socket.socket:
+        """Dial a peer under a RetryPolicy (peers come up at different
+        speeds during bootstrap — refused before the listener binds, SYN
+        drops past the backlog, peer resets; the reference's UCX endpoint
+        creation likewise blocks in a rendezvous, ucx.py:47).  Exhaustion
+        marks the peer failed and raises PeerFailedError."""
         host, port = self.addrs[dest].rsplit(":", 1)
-        # Peers come up at different speeds during bootstrap; retry any
-        # transient connect failure (refused before the listener binds,
-        # SYN drops past the backlog → timeout, peer resets) — the
-        # reference's UCX endpoint creation likewise blocks in a
-        # rendezvous (ucx.py:47).
-        last: Optional[OSError] = None
-        for _ in range(40):
-            try:
-                return socket.create_connection((host, int(port)),
-                                                timeout=30)
-            except OSError as e:
-                last = e
-                import time
-                time.sleep(0.25)
-        raise last
+        policy = policy or self.connect_policy
+
+        def attempt() -> socket.socket:
+            return socket.create_connection((host, int(port)), timeout=30)
+
+        try:
+            s = policy.call(attempt, retry_on=(OSError,),
+                            describe=f"connect rank {self.rank}->{dest}",
+                            seed=(self.rank << 16) | dest)
+        except (OSError, CommsTimeoutError) as e:
+            self._store.fail_peer(dest, f"connect failed: {e!r}")
+            raise PeerFailedError(
+                f"tcp-mailbox rank {self.rank}: rank {dest} unreachable: "
+                f"{e!r}", rank=dest) from e
+        with contextlib.suppress(OSError):
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # identify this stream so the peer can attribute its death to us
+        s.sendall(_HDR.pack(_HELLO, self.rank, dest, 0, 0, 0))
+        return s
 
     def put(self, source: int, dest: int, tag: int, payload) -> None:
         arr = np.asarray(payload)
+        decision = (self.faults.on_send(source, dest, tag, arr)
+                    if self.faults is not None else None)
+        if decision is not None and decision.delay_s:
+            time.sleep(decision.delay_s)
+        payloads = [arr] if decision is None else decision.payloads
         if dest == self.rank:
-            self._q((source, dest, tag)).put(arr)
+            for p in payloads:
+                if decision is not None and decision.corrupt:
+                    p = corrupt_array(np.asarray(p))
+                self._store.deliver(source, dest, tag, p)
+            if decision is not None and decision.disconnect:
+                self._store.fail_peer(source, "fault-injected disconnect")
             return
-        bio = io.BytesIO()
-        np.save(bio, arr, allow_pickle=False)
-        raw = bio.getvalue()
+        frames = []
+        for p in payloads:
+            bio = io.BytesIO()
+            np.save(bio, np.asarray(p), allow_pickle=False)
+            raw = bio.getvalue()
+            crc = zlib.crc32(raw)
+            if decision is not None and decision.corrupt:
+                # damage the body after CRC: the receiver detects + drops
+                raw = corrupt_bytes(raw)
+            frames.append((crc, raw))
         with self._lock:
             lock = self._conn_locks.setdefault(dest, threading.Lock())
         with lock:
-            s = self._conns.get(dest)
-            if s is None:
-                s = self._connect(dest)
-                self._conns[dest] = s
+            s = self._get_conn(dest)
             try:
-                s.sendall(_HDR.pack(source, dest, tag, len(raw)))
-                s.sendall(raw)
-            except OSError:
-                # peer restarted: reconnect once and resend
-                try:
+                self._send_frames(s, source, dest, tag, frames)
+            except OSError as e:
+                # established link dropped under us: one short-leash
+                # reconnect + resend (at-least-once — a partially sent
+                # frame may duplicate; receivers needing exactly-once
+                # dedupe by tag protocol), then give the peer up
+                with contextlib.suppress(OSError):
                     s.close()
-                except OSError:
-                    pass
-                s = self._connect(dest)
+                with self._lock:
+                    self._conns.pop(dest, None)
+                trace.record_event("comms.send_reconnect", dest=dest,
+                                   tag=tag, error=repr(e))
+                s = self._connect(dest, policy=RECONNECT_POLICY)
+                with self._lock:
+                    self._conns[dest] = s
+                try:
+                    self._send_frames(s, source, dest, tag, frames)
+                except OSError as e2:
+                    self._store.fail_peer(
+                        dest, f"send failed after reconnect: {e2!r}")
+                    raise PeerFailedError(
+                        f"tcp-mailbox rank {self.rank}: send to rank "
+                        f"{dest} failed after reconnect: {e2!r}",
+                        rank=dest, endpoint=(source, dest, tag)) from e2
+            if decision is not None and decision.disconnect:
+                # chaos: cut the link mid-stream; the peer sees EOF with
+                # no GOODBYE and its failure detector fires
+                with contextlib.suppress(OSError):
+                    s.shutdown(socket.SHUT_RDWR)
+                with contextlib.suppress(OSError):
+                    s.close()
+                with self._lock:
+                    self._conns.pop(dest, None)
+
+    def _get_conn(self, dest: int) -> socket.socket:
+        with self._lock:
+            s = self._conns.get(dest)
+        if s is None:
+            s = self._connect(dest)
+            with self._lock:
                 self._conns[dest] = s
-                s.sendall(_HDR.pack(source, dest, tag, len(raw)))
-                s.sendall(raw)
+        return s
+
+    @staticmethod
+    def _send_frames(s: socket.socket, source: int, dest: int, tag: int,
+                     frames) -> None:
+        for crc, raw in frames:
+            s.sendall(_HDR.pack(_DATA, source, dest, tag, crc, len(raw)))
+            s.sendall(raw)
 
     def get(self, source: int, dest: int, tag: int,
             timeout: float = 120.0):
@@ -130,18 +253,22 @@ class TcpMailbox:
         compiles or a saturated CPU before it sends (observed: the
         30 s default flaked the multiprocess tier when the full test
         suite and bench battery shared the machine). It is a
-        deadlock-detection bound, not a latency promise."""
+        deadlock-detection bound, not a latency promise — a peer proven
+        dead fails the wait *immediately* with PeerFailedError via the
+        failure detector; cancellation raises CommsAbortedError; only
+        the no-evidence case waits out the deadline into
+        CommsTimeoutError."""
         assert dest == self.rank, \
             f"rank {self.rank} cannot receive for rank {dest}"
-        return self._q((source, dest, tag)).get(timeout=timeout)
+        return self._store.get(source, dest, tag, timeout=timeout)
+
+    def fail_peer(self, rank: int, reason: str) -> None:
+        self._store.fail_peer(rank, reason)
+
+    def revive_peer(self, rank: int) -> None:
+        self._store.revive_peer(rank)
 
     # -- plumbing ----------------------------------------------------------
-
-    def _q(self, key):
-        with self._lock:
-            if key not in self._queues:
-                self._queues[key] = queue.Queue()
-            return self._queues[key]
 
     def _accept_loop(self):
         while not self._closed:
@@ -149,35 +276,132 @@ class TcpMailbox:
                 conn, _ = self._srv.accept()
             except OSError:
                 return                      # listener closed
+            with self._lock:
+                self._inbound.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    def _mark_alive(self, source: int) -> None:
+        with self._lock:
+            self._last_seen[source] = time.monotonic()
+            self._departed.discard(source)
+        # fresh liveness evidence clears any (possibly transient) failure
+        self._store.revive_peer(source)
+
     def _serve(self, conn: socket.socket):
+        peer: Optional[int] = None
+        graceful = False
+        reason = "connection closed"
         try:
             with conn:
                 while True:                 # messages stream until close
                     hdr = _recv_exact(conn, _HDR.size)
-                    source, dest, tag, nbytes = _HDR.unpack(hdr)
+                    kind, source, dest, tag, crc, nbytes = _HDR.unpack(hdr)
+                    peer = source
+                    self._mark_alive(source)
+                    if kind == _GOODBYE:
+                        graceful = True
+                        break
+                    if kind in (_HELLO, _HEARTBEAT):
+                        continue
                     raw = _recv_exact(conn, nbytes)
+                    if zlib.crc32(raw) != crc:
+                        self.corrupt_frames += 1
+                        trace.record_event("comms.frame_corrupt",
+                                           source=source, dest=dest,
+                                           tag=tag)
+                        logger.warn_once(
+                            ("tcp-mailbox-corrupt", self.rank, source),
+                            "tcp-mailbox rank %d: corrupt frame from rank"
+                            " %d dropped (crc mismatch); further drops "
+                            "logged at debug", self.rank, source)
+                        continue
                     arr = np.load(io.BytesIO(raw), allow_pickle=False)
-                    self._q((source, dest, tag)).put(arr)
-        except (ConnectionError, OSError, ValueError):
-            pass                            # peer closed / torn connection
+                    self._store.deliver(source, dest, tag, arr)
+        except (ConnectionError, OSError, ValueError) as e:
+            reason = repr(e)
+        finally:
+            with self._lock:
+                self._inbound.discard(conn)
+        if self._closed or peer is None:
+            return
+        if graceful:
+            with self._lock:
+                self._departed.add(peer)
+                self._last_seen.pop(peer, None)
+            self._store.fail_peer(peer, "peer departed (graceful close)")
+        else:
+            self._store.fail_peer(peer, f"connection lost ({reason})")
+
+    def _maintenance(self):
+        """Heartbeat sender + failure detector (one thread per mailbox)."""
+        period = max(0.05, min(self.heartbeat_interval / 2.0, 1.0))
+        next_hb = 0.0
+        while not self._stop.wait(period):
+            now = time.monotonic()
+            if now >= next_hb:
+                next_hb = now + self.heartbeat_interval
+                self._send_heartbeats()
+            self._check_liveness(now)
+
+    def _send_heartbeats(self):
+        with self._lock:
+            dests = list(self._conns)
+        for dest in dests:
+            with self._lock:
+                lock = self._conn_locks.setdefault(dest, threading.Lock())
+            with lock:
+                with self._lock:
+                    s = self._conns.get(dest)
+                if s is None:
+                    continue
+                try:
+                    s.sendall(_HDR.pack(_HEARTBEAT, self.rank, dest,
+                                        0, 0, 0))
+                except OSError:
+                    # link torn under us: drop the cached conn (the next
+                    # put re-dials); the peer's own detector covers their
+                    # side of the stream
+                    with contextlib.suppress(OSError):
+                        s.close()
+                    with self._lock:
+                        self._conns.pop(dest, None)
+
+    def _check_liveness(self, now: float):
+        with self._lock:
+            stale = [(r, t) for r, t in self._last_seen.items()
+                     if now - t > self.heartbeat_timeout]
+            for r, _ in stale:
+                self._last_seen.pop(r, None)
+        for r, t in stale:
+            self._store.fail_peer(
+                r, f"no heartbeat for {now - t:.1f}s "
+                   f"(timeout {self.heartbeat_timeout}s)")
 
     def close(self):
+        if self._closed:
+            return
         self._closed = True
-        try:
-            self._srv.close()
-        except OSError:
-            pass
+        self._stop.set()
         with self._lock:
-            conns = list(self._conns.values())
+            conns = dict(self._conns)
             self._conns.clear()
-        for s in conns:
-            try:
+            inbound = list(self._inbound)
+            self._inbound.clear()
+        for dest, s in conns.items():
+            # a parting GOODBYE distinguishes departure from death on the
+            # peer's failure detector
+            with contextlib.suppress(OSError):
+                s.sendall(_HDR.pack(_GOODBYE, self.rank, dest, 0, 0, 0))
+            with contextlib.suppress(OSError):
                 s.close()
-            except OSError:
-                pass
+        for s in inbound:
+            with contextlib.suppress(OSError):
+                s.close()
+        with contextlib.suppress(OSError):
+            self._srv.close()
+        self._store.stir()
 
     def __del__(self):
-        self.close()
+        with contextlib.suppress(Exception):
+            self.close()

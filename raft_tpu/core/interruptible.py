@@ -14,7 +14,7 @@ each iteration; ``cancel()`` from any thread makes the next check raise.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 import jax
 
@@ -24,16 +24,38 @@ class InterruptedException(RuntimeError):
 
 
 class CancelToken:
-    """Per-thread cancellation flag (ref: interruptible token store)."""
+    """Per-thread cancellation flag (ref: interruptible token store).
+
+    Beyond the reference's poll-only contract, a token carries *wakers*:
+    callbacks fired by ``cancel()`` so threads blocked in interruptible
+    waits (the comms mailbox ``get``, resilience backoff sleeps) are
+    nudged immediately instead of at their next poll.  A waker must be
+    cheap and thread-safe — typically ``Event.set`` or a condition-
+    variable ``notify_all`` wrapper.
+    """
 
     def __init__(self):
         self._event = threading.Event()
+        self._wakers: list = []
+        self._wlock = threading.Lock()
 
     def cancel(self) -> None:
         self._event.set()
+        with self._wlock:
+            wakers = list(self._wakers)
+        for w in wakers:
+            try:
+                w()
+            except Exception as e:  # one bad waker must not mask the rest
+                from raft_tpu.core import logger
+                logger.warn("interruptible: waker %r raised %r", w, e)
 
     def cancelled(self) -> bool:
         return self._event.is_set()
+
+    def clear(self) -> None:
+        """Consume the cancellation flag (what ``check`` does on raise)."""
+        self._event.clear()
 
     def check(self) -> None:
         """Cancellation point: raise and clear if cancelled
@@ -41,6 +63,20 @@ class CancelToken:
         if self._event.is_set():
             self._event.clear()
             raise InterruptedException("raft_tpu: operation cancelled")
+
+    def add_waker(self, waker: Callable[[], None]) -> None:
+        """Register a callback fired (once) by a subsequent ``cancel()``.
+        Duplicates are allowed; pair every add with ``remove_waker`` in a
+        ``finally`` so tokens don't accumulate dead wakers."""
+        with self._wlock:
+            self._wakers.append(waker)
+
+    def remove_waker(self, waker: Callable[[], None]) -> None:
+        with self._wlock:
+            try:
+                self._wakers.remove(waker)
+            except ValueError:
+                pass  # already removed — benign double-unregister
 
 
 _registry_lock = threading.Lock()

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 
 LEVELS = {
     "trace": 5,
@@ -62,3 +63,31 @@ def error(msg, *args):
 
 def critical(msg, *args):
     logger.critical(msg, *args)
+
+
+# -- throttled warnings ------------------------------------------------------
+#
+# Per-frame failure conditions (corrupt frames on a flaky link, repeated
+# connect retries) would otherwise log at line rate; warn_once emits the
+# first occurrence per key at WARNING and the rest at DEBUG.
+
+_once_lock = threading.Lock()
+_once_seen: set = set()
+
+
+def warn_once(key, msg, *args):
+    """Warn once per process for ``key``; later repeats demote to debug."""
+    with _once_lock:
+        first = key not in _once_seen
+        if first:
+            _once_seen.add(key)
+    if first:
+        logger.warning(msg, *args)
+    else:
+        logger.debug(msg, *args)
+
+
+def child(name: str) -> "logging.Logger":
+    """Namespaced child logger (``raft_tpu.<name>``) sharing the sink and
+    level configuration of the package logger."""
+    return logger.getChild(name)

@@ -9,9 +9,11 @@ observers (e.g. the memory resource_monitor) can ask "what range am I in?".
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import threading
-from typing import List, Optional
+import time
+from typing import Deque, List, Optional
 
 import jax
 
@@ -48,6 +50,45 @@ def push_range(name: str):
 
 # Alias matching the reference's free functions.
 range = push_range
+
+
+# -- host-side instantaneous events (ref: nvtx mark) ------------------------
+#
+# Retry/failure/fault events from the comms resilience layer land here,
+# attributed to the innermost active range of the emitting thread, so an
+# observer can answer "what was the system doing when rank 3 died?".
+# Bounded ring buffer: observability, not an audit log.
+
+_events_lock = threading.Lock()
+_events: Deque[dict] = collections.deque(maxlen=1024)
+
+
+def record_event(name: str, **attrs) -> None:
+    """Record an instantaneous host-side event in the active range.
+
+    The event carries the emitting thread's innermost range (``range``)
+    and full range stack (``range_stack``) at emission time, a monotonic
+    timestamp, plus any keyword attributes."""
+    ev = {"name": name, "range": current_range(),
+          "range_stack": tuple(_stack()), "t": time.monotonic()}
+    ev.update(attrs)
+    with _events_lock:
+        _events.append(ev)
+
+
+def events(name: Optional[str] = None) -> List[dict]:
+    """Snapshot of recorded events, newest last; optionally filtered by
+    event name."""
+    with _events_lock:
+        evs = list(_events)
+    if name is None:
+        return evs
+    return [e for e in evs if e["name"] == name]
+
+
+def clear_events() -> None:
+    with _events_lock:
+        _events.clear()
 
 
 def annotate(name: Optional[str] = None):

@@ -1,0 +1,29 @@
+"""Victim process for the peer-death chaos test (test_comms_faults.py).
+
+Binds a TcpMailbox at the given rank, announces readiness to rank 0
+(which also attributes its TCP stream to this rank via the HELLO/DATA
+frames), then blocks until killed — modelling a peer dying mid-exchange.
+
+Usage: python _fault_worker.py <rank> <addr0> <addr1> ...
+"""
+
+import sys
+import time
+
+
+def main():
+    rank = int(sys.argv[1])
+    addrs = sys.argv[2:]
+
+    import numpy as np
+
+    from raft_tpu.comms.tcp_mailbox import TcpMailbox
+
+    box = TcpMailbox(rank, addrs)
+    box.put(rank, 0, 0, np.int32(rank))     # ready signal
+    print(f"FAULT_WORKER_READY {rank}", flush=True)
+    time.sleep(300)                          # hold the link until killed
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,494 @@
+"""Chaos suite for the comms resilience layer (ISSUE 1).
+
+Exercises the fault-injection hooks (drop / delay / duplicate / corrupt /
+disconnect) against BOTH transports — the in-process ``_Mailbox`` and the
+cross-process ``TcpMailbox`` — and asserts the typed error taxonomy
+surfaces with the correct rank attribution:
+
+* ``CommsTimeoutError`` when a message never arrives (dropped, corrupted
+  on the wire) but the peer is not proven dead;
+* ``PeerFailedError`` (dead rank attached) when the failure detector
+  fires — connection lost, heartbeat silence, or a real peer process
+  killed mid-exchange (< 5 s detection, the acceptance bar);
+* ``CommsAbortedError`` when ``interruptible.cancel()`` is aimed at a
+  thread blocked in a mailbox ``get``.
+
+Everything here must stay inside the tier-1 ``not slow`` budget: each
+case uses sub-second timeouts; the single subprocess test is bounded by
+worker startup (one jax import), in line with test_multiprocess.py.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.comms.comms import _Mailbox, MeshComms
+from raft_tpu.comms.errors import (
+    CommsAbortedError,
+    CommsError,
+    CommsTimeoutError,
+    PeerFailedError,
+)
+from raft_tpu.comms.faults import FaultInjector
+from raft_tpu.comms.resilience import RetryPolicy, TagStore
+from raft_tpu.comms.tcp_mailbox import TcpMailbox
+from raft_tpu.core import interruptible, trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture
+def tcp_pair():
+    """Two live TcpMailbox ranks on localhost; closed at teardown."""
+    boxes = []
+
+    def make(rank1_kwargs=None, **kwargs):
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+        b0 = TcpMailbox(0, addrs, **kwargs)
+        b1 = TcpMailbox(1, addrs, **(rank1_kwargs if rank1_kwargs is not None
+                                     else kwargs))
+        boxes.extend([b0, b1])
+        return b0, b1
+
+    yield make
+    for b in boxes:
+        b.close()
+
+
+def _run_to_completion(th, timeout=5.0):
+    th.join(timeout=timeout)
+    assert not th.is_alive(), "blocked thread never woke"
+
+
+# -- error taxonomy ---------------------------------------------------------
+
+
+def test_taxonomy_shape():
+    """The typed hierarchy mirrors the status_t contract (ISSUE tentpole
+    part 1): every comms failure isinstance-checks as CommsError; the
+    timeout doubles as a stdlib TimeoutError and the abort as an
+    InterruptedException."""
+    assert issubclass(CommsTimeoutError, CommsError)
+    assert issubclass(CommsTimeoutError, TimeoutError)
+    assert issubclass(PeerFailedError, CommsError)
+    assert issubclass(CommsAbortedError, CommsError)
+    assert issubclass(CommsAbortedError, interruptible.InterruptedException)
+    e = PeerFailedError("x", rank=3, endpoint=(3, 0, 7))
+    assert e.rank == 3 and e.endpoint == (3, 0, 7)
+
+
+# -- RetryPolicy ------------------------------------------------------------
+
+
+def test_retry_policy_succeeds_after_transients():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.005, max_delay=0.01)
+    assert policy.call(flaky, describe="flaky", seed=0) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_policy_exhaustion_reraises_last():
+    def always():
+        raise OSError("nope")
+
+    policy = RetryPolicy(max_attempts=3, base_delay=0.001, max_delay=0.002)
+    with pytest.raises(OSError, match="nope"):
+        policy.call(always, describe="always", seed=0)
+
+
+def test_retry_policy_deadline_raises_timeout():
+    def always():
+        raise OSError("nope")
+
+    policy = RetryPolicy(max_attempts=100, base_delay=0.05, max_delay=0.05,
+                         jitter=0.0, deadline=0.12)
+    t0 = time.monotonic()
+    with pytest.raises(CommsTimeoutError):
+        policy.call(always, describe="deadline", seed=0)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_retry_policy_backoff_deterministic_and_capped():
+    import random
+
+    policy = RetryPolicy(max_attempts=8, base_delay=0.1, max_delay=0.4,
+                         multiplier=2.0, jitter=0.5)
+    a = [policy.delay(i, random.Random(42)) for i in range(6)]
+    b = [policy.delay(i, random.Random(42)) for i in range(6)]
+    assert a == b                       # seeded jitter replays
+    assert max(a) <= 0.4                # cap holds under jitter
+    nojit = RetryPolicy(max_attempts=8, base_delay=0.1, max_delay=0.4,
+                        jitter=0.0)
+    assert [nojit.delay(i) for i in range(4)] == [0.1, 0.2, 0.4, 0.4]
+
+
+def test_retry_events_land_in_active_trace_range():
+    """Tentpole part 5: retry observability rides core.trace — events
+    carry the active range of the emitting thread."""
+    trace.clear_events()
+
+    def flaky(state=[]):
+        state.append(1)
+        if len(state) < 2:
+            raise OSError("transient")
+
+    policy = RetryPolicy(max_attempts=3, base_delay=0.001)
+    with trace.push_range("chaos-test-range"):
+        policy.call(flaky, describe="traced", seed=0)
+    evs = trace.events("comms.retry")
+    assert evs, "no retry event recorded"
+    assert evs[-1]["range"] == "chaos-test-range"
+    assert evs[-1]["what"] == "traced"
+
+
+# -- cancellation integration ----------------------------------------------
+
+
+def test_cancel_unblocks_pending_recv_inprocess():
+    """Tentpole part 5: interruptible.cancel() wakes a blocked mailbox
+    get promptly (not at the timeout) with CommsAbortedError."""
+    mb = _Mailbox()
+    caught = {}
+
+    def blocked():
+        try:
+            mb.get(0, 1, 0, timeout=30.0)
+        except CommsAbortedError as e:
+            caught["err"] = e
+            caught["t"] = time.monotonic()
+
+    th = threading.Thread(target=blocked)
+    th.start()
+    time.sleep(0.15)                      # let it block
+    t0 = time.monotonic()
+    interruptible.cancel(th.ident)
+    _run_to_completion(th)
+    interruptible.get_token(th.ident).clear()   # don't poison reused idents
+    assert isinstance(caught["err"], CommsAbortedError)
+    assert caught["t"] - t0 < 1.0, "cancel did not wake the get promptly"
+
+
+def test_cancel_unblocks_pending_recv_tcp(tcp_pair):
+    b0, b1 = tcp_pair()
+    caught = {}
+
+    def blocked():
+        try:
+            b0.get(1, 0, 5, timeout=30.0)
+        except CommsAbortedError as e:
+            caught["err"] = e
+
+    th = threading.Thread(target=blocked)
+    th.start()
+    time.sleep(0.15)
+    interruptible.cancel(th.ident)
+    _run_to_completion(th)
+    interruptible.get_token(th.ident).clear()
+    assert isinstance(caught["err"], CommsAbortedError)
+
+
+def test_cancel_token_wakers_fire_once_registered():
+    token = interruptible.CancelToken()
+    fired = []
+    token.add_waker(lambda: fired.append(1))
+    token.cancel()
+    assert fired == [1]
+    token.clear()
+    token.remove_waker(token.remove_waker)  # unknown waker: benign
+
+
+# -- fault injection: in-process _Mailbox -----------------------------------
+
+
+def test_inprocess_drop_surfaces_timeout():
+    inj = FaultInjector(seed=1, drop=1.0)
+    mb = _Mailbox(faults=inj)
+    mb.put(0, 1, 0, np.int32(5))
+    with pytest.raises(CommsTimeoutError) as ei:
+        mb.get(0, 1, 0, timeout=0.2)
+    assert ei.value.endpoint == (0, 1, 0)
+    assert inj.counts["drop"] == 1
+
+
+def test_inprocess_duplicate_delivers_twice():
+    inj = FaultInjector(seed=2, duplicate=1.0)
+    mb = _Mailbox(faults=inj)
+    mb.put(0, 1, 0, np.int32(7))
+    assert int(mb.get(0, 1, 0, timeout=1.0)) == 7
+    assert int(mb.get(0, 1, 0, timeout=1.0)) == 7
+    assert inj.counts["duplicate"] == 1
+
+
+def test_inprocess_delay_applies_on_send_path():
+    inj = FaultInjector(seed=3, delay=1.0, delay_s=0.05)
+    mb = _Mailbox(faults=inj)
+    t0 = time.monotonic()
+    mb.put(0, 1, 0, np.int32(1))
+    assert time.monotonic() - t0 >= 0.04
+    assert int(mb.get(0, 1, 0, timeout=1.0)) == 1
+
+
+def test_inprocess_disconnect_fails_peer_with_rank():
+    inj = FaultInjector(seed=4, disconnect=1.0)
+    mb = _Mailbox(faults=inj)
+    mb.put(0, 1, 0, np.int32(1))
+    # parting message drains before the failure is consulted
+    assert int(mb.get(0, 1, 0, timeout=1.0)) == 1
+    t0 = time.monotonic()
+    with pytest.raises(PeerFailedError) as ei:
+        mb.get(0, 1, 1, timeout=30.0)
+    assert time.monotonic() - t0 < 1.0, "failure did not fail fast"
+    assert ei.value.rank == 0
+
+
+def test_inprocess_corrupt_delivers_damaged_payload():
+    """In-process corruption models memory damage: delivered, not
+    detected (the wire transport is the one with a CRC — see
+    test_tcp_corrupt_detected_and_dropped)."""
+    inj = FaultInjector(seed=5, corrupt=1.0)
+    mb = _Mailbox(faults=inj)
+    sent = np.arange(4, dtype=np.float32)
+    mb.put(0, 1, 0, sent)
+    got = mb.get(0, 1, 0, timeout=1.0)
+    assert got.shape == sent.shape and not np.array_equal(got, sent)
+
+
+def test_rank_scoping_confines_faults():
+    inj = FaultInjector(seed=6, drop=1.0, source_ranks={2})
+    mb = _Mailbox(faults=inj)
+    mb.put(0, 1, 0, np.int32(1))          # out of scope: delivered
+    mb.put(2, 1, 0, np.int32(2))          # in scope: dropped
+    assert int(mb.get(0, 1, 0, timeout=1.0)) == 1
+    with pytest.raises(CommsTimeoutError):
+        mb.get(2, 1, 0, timeout=0.2)
+    assert inj.counts["drop"] == 1 and inj.counts["sends"] == 1
+
+
+def _chaos_sequence(mailbox, n=24):
+    """Fixed send sequence; returns which tags arrived (None = error)."""
+    arrived = []
+    for tag in range(n):
+        mailbox.put(0, 1, tag, np.int32(tag))
+    for tag in range(n):
+        try:
+            arrived.append(int(mailbox.get(0, 1, tag, timeout=0.15)))
+        except CommsError:
+            arrived.append(None)
+    return arrived
+
+
+def test_inprocess_chaos_deterministic_under_fixed_seed():
+    """Acceptance bar: the chaos suite replays identically under a fixed
+    fault seed (same drops, same survivors, same counters)."""
+    runs = []
+    for _ in range(2):
+        inj = FaultInjector(seed=1234, drop=0.4, duplicate=0.2)
+        runs.append((_chaos_sequence(_Mailbox(faults=inj)),
+                     dict(inj.counts)))
+    assert runs[0] == runs[1]
+    assert runs[0][1]["drop"] > 0          # the plan actually fired
+
+
+def test_tcp_chaos_deterministic_under_fixed_seed(tcp_pair):
+    runs = []
+    for _ in range(2):
+        b0, b1 = tcp_pair()
+        b0.faults = FaultInjector(seed=1234, drop=0.4, duplicate=0.2)
+        arrived = []
+        for tag in range(16):
+            b0.put(0, 1, tag, np.int32(tag))
+        for tag in range(16):
+            try:
+                arrived.append(int(b1.get(0, 1, tag, timeout=0.3)))
+            except CommsError:
+                arrived.append(None)
+        runs.append((arrived, dict(b0.faults.counts)))
+    assert runs[0] == runs[1]
+    assert runs[0][1]["drop"] > 0
+
+
+# -- fault injection: TcpMailbox --------------------------------------------
+
+
+def test_tcp_drop_surfaces_timeout(tcp_pair):
+    b0, b1 = tcp_pair()
+    b0.faults = FaultInjector(seed=1, drop=1.0)
+    b0.put(0, 1, 0, np.int32(5))
+    with pytest.raises(CommsTimeoutError) as ei:
+        b1.get(0, 1, 0, timeout=0.3)
+    assert ei.value.endpoint == (0, 1, 0)
+
+
+def test_tcp_duplicate_delivers_twice(tcp_pair):
+    b0, b1 = tcp_pair()
+    b0.faults = FaultInjector(seed=2, duplicate=1.0)
+    b0.put(0, 1, 0, np.int32(9))
+    assert int(b1.get(0, 1, 0, timeout=2.0)) == 9
+    assert int(b1.get(0, 1, 0, timeout=2.0)) == 9
+
+
+def test_tcp_delay_applies(tcp_pair):
+    b0, b1 = tcp_pair()
+    b0.faults = FaultInjector(seed=3, delay=1.0, delay_s=0.05)
+    t0 = time.monotonic()
+    b0.put(0, 1, 0, np.int32(1))
+    assert time.monotonic() - t0 >= 0.04
+    assert int(b1.get(0, 1, 0, timeout=2.0)) == 1
+
+
+def test_tcp_corrupt_detected_and_dropped(tcp_pair):
+    """Wire corruption model: the CRC32 frame check detects the damage,
+    drops the frame (counted on the receiver), and the recv times out —
+    corrupted data is never delivered."""
+    b0, b1 = tcp_pair()
+    b0.faults = FaultInjector(seed=4, corrupt=1.0)
+    b0.put(0, 1, 0, np.arange(8, dtype=np.float32))
+    with pytest.raises(CommsTimeoutError):
+        b1.get(0, 1, 0, timeout=0.5)
+    deadline = time.monotonic() + 2.0
+    while b1.corrupt_frames == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert b1.corrupt_frames == 1
+
+
+def test_tcp_disconnect_fails_peer_fast_with_rank(tcp_pair):
+    b0, b1 = tcp_pair()
+    b0.faults = FaultInjector(seed=5, disconnect=1.0)
+    b0.put(0, 1, 0, np.int32(1))
+    assert int(b1.get(0, 1, 0, timeout=2.0)) == 1   # parting message drains
+    t0 = time.monotonic()
+    with pytest.raises(PeerFailedError) as ei:
+        b1.get(0, 1, 1, timeout=30.0)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.rank == 0
+    # fresh traffic revives the peer (transient suspicion, not a
+    # tombstone) — but fail-fast means a get can race the revive frame,
+    # so poll briefly
+    b0.faults = None
+    b0.put(0, 1, 2, np.int32(2))
+    deadline = time.monotonic() + 5.0
+    while True:
+        try:
+            assert int(b1.get(0, 1, 2, timeout=1.0)) == 2
+            break
+        except PeerFailedError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.02)
+
+
+def test_tcp_heartbeat_silence_detected(tcp_pair):
+    """A peer that goes silent (no frames, no heartbeats) without closing
+    its socket is declared dead by the heartbeat failure detector."""
+    b0, b1 = tcp_pair(heartbeat_interval=0.05, heartbeat_timeout=0.3,
+                      rank1_kwargs=dict(heartbeat_interval=100.0))
+    b1.put(1, 0, 0, np.int32(1))          # attributes the stream to rank 1
+    assert int(b0.get(1, 0, 0, timeout=5.0)) == 1
+    t0 = time.monotonic()
+    with pytest.raises(PeerFailedError) as ei:
+        b0.get(1, 0, 1, timeout=30.0)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.rank == 1
+
+
+def test_tcp_graceful_close_is_attributed(tcp_pair):
+    b0, b1 = tcp_pair()
+    b0.put(0, 1, 0, np.int32(1))
+    assert int(b1.get(0, 1, 0, timeout=2.0)) == 1
+    b0.close()
+    with pytest.raises(PeerFailedError) as ei:
+        b1.get(0, 1, 1, timeout=30.0)
+    assert ei.value.rank == 0
+    assert "departed" in str(ei.value)
+
+
+# -- the acceptance scenario: a peer killed mid-exchange --------------------
+
+
+def test_killed_peer_produces_peerfailederror_under_5s():
+    """ISSUE acceptance: a TcpMailbox peer killed mid-exchange produces a
+    PeerFailedError naming the dead rank in < 5 s — not a 120 s timeout."""
+    addrs = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+    b0 = TcpMailbox(0, addrs)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(_REPO, "tests", "_fault_worker.py")
+    proc = subprocess.Popen([sys.executable, worker, "1"] + addrs,
+                            cwd=_REPO, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        # worker up + stream attributed (ready frame arrives)
+        assert int(b0.get(1, 0, 0, timeout=60.0)) == 1
+        proc.kill()
+        proc.wait(timeout=10)
+        t0 = time.monotonic()
+        with pytest.raises(PeerFailedError) as ei:
+            b0.get(1, 0, 1, timeout=120.0)
+        detection = time.monotonic() - t0
+        assert detection < 5.0, f"took {detection:.1f}s to detect the kill"
+        assert ei.value.rank == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        b0.close()
+
+
+# -- typed errors through the MeshComms façade ------------------------------
+
+
+def test_meshcomms_typed_errors_and_rank(mesh8):
+    """The taxonomy surfaces through isend/irecv rank views exactly as it
+    does on the raw mailboxes (tentpole: one contract, both layers)."""
+    inj = FaultInjector(seed=7, disconnect=1.0)
+    comm = MeshComms(mesh8, rank=0, _mailbox=_Mailbox(faults=inj))
+    v1 = comm.rank_view(1)
+    comm.isend(np.int32(1), dest=1, tag=0)
+    assert int(v1.irecv(source=0, tag=0).wait()) == 1
+    with pytest.raises(PeerFailedError) as ei:
+        v1.irecv(source=0, tag=1, timeout=30.0).wait()
+    assert ei.value.rank == 0
+
+    clean = MeshComms(mesh8, rank=0, _mailbox=_Mailbox())
+    with pytest.raises(CommsTimeoutError):
+        clean.rank_view(1).irecv(source=0, tag=9, timeout=0.2).wait()
+
+
+def test_tagstore_peer_failed_then_revived():
+    st = TagStore(name="unit")
+    st.fail_peer(3, "test")
+    assert st.peer_failed(3) == "test"
+    with pytest.raises(PeerFailedError):
+        st.get(3, 0, 0, timeout=5.0)
+    st.revive_peer(3)
+    assert st.peer_failed(3) is None
+    st.deliver(3, 0, 0, "x")
+    assert st.get(3, 0, 0, timeout=1.0) == "x"
