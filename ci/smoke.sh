@@ -54,4 +54,24 @@ python -m pytest tests/ -x -q
 # Chaos smoke: the comms fault-injection suite on the CPU backend —
 # deterministic fault schedules, typed errors, fast dead-peer detection.
 JAX_PLATFORMS=cpu python -m pytest tests/test_comms_faults.py -q
+
+# Checkpoint-format gate: the committed v1 fixture must keep loading —
+# a failure here means the format changed without a VERSION bump.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+from raft_tpu.core.checkpoint import restore_checkpoint
+out = restore_checkpoint("tests/data/checkpoint_v1.ckpt")
+assert out["n_iter"] == 17 and out["prev_inertia"] == 123.4375
+assert out["centroids"].shape == (3, 4)
+np.testing.assert_array_equal(
+    out["centroids"],
+    np.arange(12, dtype=np.float32).reshape(3, 4) / 7.0)
+print("checkpoint v1 fixture: loads OK")
+PYEOF
+
+# Kill-a-rank chaos smoke: 4 real processes, one SIGKILL'd mid-iteration,
+# survivors shrink + resume from checkpoint bit-for-bit (the elastic
+# acceptance run).
+JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_elastic.py::TestMultiprocessSigkill -q
 echo "smoke: PASS"

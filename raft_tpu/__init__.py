@@ -30,6 +30,35 @@ util     : host/device helper utilities   (ref: cpp/include/raft/util/)
 
 __version__ = "0.2.0"
 
+import jax as _jax
+
+# jax moved shard_map from jax.experimental to the top-level namespace;
+# the MNMG layers call `jax.shard_map` (the long-term spelling). Alias
+# it on older jax so the same call sites work across versions.
+if not hasattr(_jax, "shard_map"):
+    import functools as _functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @_functools.wraps(_shard_map)
+    def _compat_shard_map(f, **kwargs):
+        # the old check_rep analysis predates pcast/vma typing and
+        # rejects carries the new checker accepts; disable it (runtime
+        # semantics are unchanged — it is a static well-formedness check)
+        kwargs.pop("check_vma", None)   # new-jax spelling of check_rep
+        kwargs["check_rep"] = False
+        return _shard_map(f, **kwargs)
+
+    _jax.shard_map = _compat_shard_map
+
+# Same treatment for the Pallas-TPU params rename
+# (TPUCompilerParams → CompilerParams): kernels use the new spelling.
+from jax.experimental.pallas import tpu as _pltpu
+
+if not hasattr(_pltpu, "CompilerParams") and hasattr(_pltpu,
+                                                    "TPUCompilerParams"):
+    _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+
 from raft_tpu.core.resources import (  # noqa: F401
     Resources,
     device_resources,

@@ -18,4 +18,5 @@ from raft_tpu.cluster.kmeans import (  # noqa: F401
     weighted_lloyd_step,
     mnmg_lloyd_step,
     kmeans_fit_mnmg,
+    kmeans_fit_elastic,
 )

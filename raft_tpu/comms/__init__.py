@@ -34,6 +34,14 @@ mirroring the reference ``status_t`` contract), retry/backoff
 and seedable rank-scoped fault injection
 (:mod:`raft_tpu.comms.faults` ``FaultInjector``) behind both mailbox
 transports.
+
+Elastic layer (ISSUE 2): ``MeshComms.abort`` broadcasts a poison frame
+(all ranks fail within one heartbeat), ``agree_on_survivors`` is the
+failure-consensus barrier, ``shrink`` rebuilds a survivors-only clique
+via the comm_split machinery, and ``bootstrap.reinitialize_survivors``
+re-injects handles over the survivor mesh — together with
+:mod:`raft_tpu.core.checkpoint` this lets iterative MNMG solvers finish
+on fewer ranks after a rank loss.
 """
 
 from raft_tpu.comms.errors import (  # noqa: F401
@@ -42,7 +50,11 @@ from raft_tpu.comms.errors import (  # noqa: F401
     PeerFailedError,
     CommsAbortedError,
 )
-from raft_tpu.comms.resilience import RetryPolicy, TagStore  # noqa: F401
+from raft_tpu.comms.resilience import (  # noqa: F401
+    RetryPolicy,
+    TagStore,
+    default_recv_timeout,
+)
 from raft_tpu.comms.faults import FaultInjector  # noqa: F401
 from raft_tpu.comms.comms import (  # noqa: F401
     Op,
@@ -76,4 +88,5 @@ from raft_tpu.comms.bootstrap import (  # noqa: F401
     inject_comms_on_handle_coll_only,
     local_handle,
     get_raft_comm_state,
+    reinitialize_survivors,
 )

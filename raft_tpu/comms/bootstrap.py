@@ -180,6 +180,48 @@ class Comms:
         self.destroy()
 
 
+def reinitialize_survivors(sessionId, survivors):
+    """Rebuild a comms session in place for the surviving ranks (the
+    bootstrap leg of elastic recovery, ISSUE 2).
+
+    After ``agree_on_survivors()`` names the live set, every survivor's
+    rank view is shrunk (``MeshComms.shrink`` → comm_split over the
+    survivor devices), fresh handles are injected over the survivor
+    mesh, and the session registry entry is updated so
+    ``get_raft_comm_state`` / ``local_handle`` keep working under the
+    *new* dense ranks.  ``old_ranks`` in the session state maps new rank
+    → pre-shrink rank, which resharding code needs to relocate data.
+
+    Raises ``KeyError`` for an unknown/destroyed session and
+    ``ValueError`` for an empty survivor set.
+    """
+    state = _session_state.get(sessionId)
+    if state is None:
+        raise KeyError(f"unknown comms session {sessionId!r}")
+    survivors = sorted(int(r) for r in survivors)
+    if not survivors:
+        raise ValueError("survivor set is empty")
+    old_views = state["comms_views"]
+    handles = {}
+    comms_views = {}
+    for new_rank, old_rank in enumerate(survivors):
+        sub = old_views[old_rank].shrink(survivors)
+        assert sub.get_rank() == new_rank
+        handle = core_res.Resources()
+        core_res.set_mesh(handle, sub.mesh)
+        core_res.set_comms(handle, sub)
+        handles[new_rank] = handle
+        comms_views[new_rank] = sub
+    state["mesh"] = comms_views[0].mesh
+    state["nranks"] = len(survivors)
+    state["handles"] = handles
+    state["comms_views"] = comms_views
+    state["old_ranks"] = {new: old for new, old in enumerate(survivors)}
+    logger.info("comms session reinitialized for %d survivor(s): %s",
+                len(survivors), survivors)
+    return handles
+
+
 def local_handle(sessionId, rank: int = 0):
     """Simple helper to retrieve the rank's handle for a comms session
     (ref: comms.py:236 `local_handle`)."""

@@ -27,6 +27,7 @@ from __future__ import annotations
 import enum
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -36,10 +37,20 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tpu.comms import device as dev
 from raft_tpu.comms.device import Op
-from raft_tpu.comms.errors import CommsAbortedError, CommsError
-from raft_tpu.comms.resilience import TagStore
-from raft_tpu.core import logger
+from raft_tpu.comms.errors import (CommsAbortedError, CommsError,
+                                   CommsTimeoutError, PeerFailedError)
+from raft_tpu.comms.resilience import TagStore, default_recv_timeout
+from raft_tpu.core import logger, trace
 from raft_tpu.core.interruptible import InterruptedException
+
+# Reserved host-p2p tag namespaces (kept below the split-remap bases in
+# comm_split so elastic control traffic never collides with user tags):
+_CONSENSUS_TAG = 1 << 20   # survivor-consensus PROPOSE/DECIDE frames
+_PROBE_TAG = (1 << 20) + (1 << 18)   # liveness probe sweep
+# Child communicators over a non-shared transport (TcpMailbox) remap
+# their tags into a per-split band so parent and child traffic share one
+# wire without matching each other (see _RankMappedMailbox).
+_SPLIT_TAG_SPAN = 1 << 28
 
 
 class Datatype(enum.Enum):
@@ -85,9 +96,18 @@ class _Mailbox:
     transport).
     """
 
-    def __init__(self, faults=None):
+    # one failure detector / abort domain serves every rank view (the
+    # single-controller regime); consensus can read it directly instead
+    # of running the wire protocol (see MeshComms.agree_on_survivors)
+    shared_store = True
+
+    def __init__(self, faults=None, default_timeout: Optional[float] = None):
         self._store = TagStore(name="mailbox")
         self.faults = faults
+        # satellite: the old hard-coded 30.0 s literal, now resolved via
+        # build_mesh_comms(default_recv_timeout=) / RAFT_TPU_RECV_TIMEOUT
+        self.default_timeout = (default_timeout if default_timeout is not None
+                                else default_recv_timeout(30.0))
 
     def put(self, source: int, dest: int, tag: int, payload) -> None:
         injector = self.faults
@@ -106,14 +126,143 @@ class _Mailbox:
             return
         self._store.deliver(source, dest, tag, payload)
 
-    def get(self, source: int, dest: int, tag: int, timeout: float = 30.0):
+    def get(self, source: int, dest: int, tag: int,
+            timeout: Optional[float] = None):
+        if timeout is None:
+            timeout = self.default_timeout
         return self._store.get(source, dest, tag, timeout=timeout)
+
+    def get_nowait(self, source: int, dest: int, tag: int):
+        return self._store.get_nowait(source, dest, tag)
 
     def fail_peer(self, rank: int, reason: str) -> None:
         self._store.fail_peer(rank, reason)
 
     def revive_peer(self, rank: int) -> None:
         self._store.revive_peer(rank)
+
+    def peer_failed(self, rank: int) -> Optional[str]:
+        return self._store.peer_failed(rank)
+
+    def failed_peers(self) -> Dict[int, str]:
+        return self._store.failed_peers()
+
+    def abort(self, reason: str) -> None:
+        """In-process abort propagation: the store is shared by every
+        rank view, so poisoning it IS the broadcast."""
+        self._store.abort(reason)
+
+    def clear_abort(self) -> None:
+        self._store.clear_abort()
+
+    def aborted(self) -> Optional[str]:
+        return self._store.aborted()
+
+
+class _RankMappedMailbox:
+    """Child-communicator view of a cross-process transport.
+
+    ``comm_split`` over the in-process ``_Mailbox`` hands each color
+    group a fresh store, but a ``TcpMailbox`` owns real sockets — a
+    survivors-only sub-communicator (``MeshComms.shrink``) must keep
+    riding the parent's established links.  This adapter remaps the
+    child's dense ranks onto the parent's (``members[new] == old``) and
+    shifts tags into a per-split band (``tag_base``) so parent and child
+    traffic share the wire without tag-matching each other.  Failure /
+    abort state delegates to the parent transport: a peer dead on the
+    wire is dead in every communicator built over it.
+    """
+
+    shared_store = False
+
+    def __init__(self, base, members: Sequence[int], tag_base: int):
+        self._base = base
+        self._members = list(members)
+        self._tag_base = int(tag_base)
+
+    def _old(self, rank: int) -> int:
+        return self._members[rank]
+
+    def _new(self, old_rank: int) -> Optional[int]:
+        try:
+            return self._members.index(old_rank)
+        except ValueError:
+            return None
+
+    def _tag(self, tag: int) -> int:
+        # mask keeps composed bases inside the int32 wire header; nested
+        # splits therefore share a wrapped namespace (documented, and
+        # fine for control-plane traffic volumes)
+        return (self._tag_base + tag) & 0x7FFFFFFF
+
+    def _remap_error(self, e: CommsError) -> CommsError:
+        if isinstance(e, PeerFailedError) and e.rank is not None:
+            new = self._new(e.rank)
+            if new is not None:
+                raise PeerFailedError(str(e), rank=new,
+                                      endpoint=e.endpoint) from e
+        raise e
+
+    @property
+    def faults(self):
+        return getattr(self._base, "faults", None)
+
+    @property
+    def default_timeout(self):
+        return getattr(self._base, "default_timeout", None)
+
+    @property
+    def heartbeat_interval(self):
+        return getattr(self._base, "heartbeat_interval", None)
+
+    @property
+    def heartbeat_timeout(self):
+        return getattr(self._base, "heartbeat_timeout", None)
+
+    def put(self, source: int, dest: int, tag: int, payload) -> None:
+        try:
+            self._base.put(self._old(source), self._old(dest),
+                           self._tag(tag), payload)
+        except PeerFailedError as e:
+            self._remap_error(e)
+
+    def get(self, source: int, dest: int, tag: int,
+            timeout: Optional[float] = None):
+        try:
+            return self._base.get(self._old(source), self._old(dest),
+                                  self._tag(tag), timeout=timeout)
+        except (PeerFailedError, CommsTimeoutError) as e:
+            self._remap_error(e)
+
+    def get_nowait(self, source: int, dest: int, tag: int):
+        return self._base.get_nowait(self._old(source), self._old(dest),
+                                     self._tag(tag))
+
+    def fail_peer(self, rank: int, reason: str) -> None:
+        self._base.fail_peer(self._old(rank), reason)
+
+    def revive_peer(self, rank: int) -> None:
+        self._base.revive_peer(self._old(rank))
+
+    def peer_failed(self, rank: int) -> Optional[str]:
+        return self._base.peer_failed(self._old(rank))
+
+    def failed_peers(self) -> Dict[int, str]:
+        out: Dict[int, str] = {}
+        for old, reason in self._base.failed_peers().items():
+            new = self._new(old)
+            if new is not None:
+                out[new] = reason
+        return out
+
+    def abort(self, reason: str) -> None:
+        self._base.abort(reason)
+
+    def clear_abort(self) -> None:
+        self._base.clear_abort()
+
+    def aborted(self) -> Optional[str]:
+        return self._base.aborted()
 
 
 class _Request:
@@ -190,6 +339,18 @@ class MeshComms:
         my_color = color[self._rank]
         members = sorted((r for r in range(n) if color[r] == my_color),
                          key=lambda r: (key[r], r))
+        # A failed peer inside my color group makes the sub-clique
+        # unusable: fail fast here instead of letting the first child
+        # collective hang out its deadline (ISSUE 2 satellite; peers of
+        # *other* colors may be dead — shrink() relies on that to carve
+        # the survivor group around them).
+        for r in members:
+            if r != self._rank:
+                reason = self._mailbox.peer_failed(r)
+                if reason is not None:
+                    raise PeerFailedError(
+                        f"comm_split: rank {r} in color group {my_color} "
+                        f"already failed ({reason})", rank=r)
         axis_devs = self._axis_devices()
         sub_devices = np.asarray([axis_devs[r] for r in members])
         sub_mesh = Mesh(sub_devices, axis_names=(self.axis_name,))
@@ -202,8 +363,22 @@ class MeshComms:
         with self._shared["lock"]:
             entry = self._shared["split"].get(split_key)
             if entry is None:
+                if getattr(self._mailbox, "shared_store", False):
+                    # single-controller: a fresh store per color group
+                    # gives the child a clean failure/abort domain
+                    mbox = _Mailbox(
+                        default_timeout=self._mailbox.default_timeout)
+                else:
+                    # cross-process transport (TcpMailbox): the child
+                    # must keep riding the parent's sockets — remap its
+                    # dense ranks and shift tags into a per-split band
+                    tag_base = _SPLIT_TAG_SPAN | (
+                        zlib.crc32(repr(split_key).encode())
+                        & (_SPLIT_TAG_SPAN - 1))
+                    mbox = _RankMappedMailbox(self._mailbox, members,
+                                              tag_base)
                 entry = {
-                    "mailbox": _Mailbox(),
+                    "mailbox": mbox,
                     "shared": {"jit": {}, "split": {},
                                "lock": threading.Lock()},
                 }
@@ -280,6 +455,231 @@ class MeshComms:
 
     def waitall(self, requests: Sequence[_Request]) -> List[Any]:
         return [r.wait() for r in requests]
+
+    def host_allreduce(self, x, tag: int) -> np.ndarray:
+        """Deterministic host-side sum-allreduce over the mailbox
+        (tags ``tag`` for the gather leg, ``tag + 1`` for the bcast
+        leg; all ranks must call with the same tag).
+
+        Partials gather to rank 0 of this clique and are summed in
+        ascending rank order — a *fixed* floating-point reduction
+        order, so results are bit-for-bit reproducible for a given
+        clique size.  The elastic solvers use this instead of a device
+        psum when the clique must outlive rank death: XLA collectives
+        over a global mesh cannot complete once a participating
+        process is gone, host mailbox traffic can."""
+        n = self.get_size()
+        x = np.asarray(x)
+        if n == 1:
+            return x.copy()
+        if self._rank == 0:
+            total = x.copy()
+            for r in range(1, n):
+                part = np.asarray(self._mailbox.get(r, 0, tag))
+                total = total + part.astype(total.dtype)
+            for r in range(1, n):
+                self._mailbox.put(0, r, tag + 1, total)
+            return total
+        self._mailbox.put(self._rank, 0, tag, x)
+        return np.asarray(self._mailbox.get(0, self._rank, tag + 1))
+
+    # -- elastic execution (ISSUE 2 tentpole) -------------------------------
+    #
+    # The reference comms_t surfaces failure through sync_stream's
+    # status_t (SUCCESS/ERROR/ABORT, core/comms.hpp:31) and expects the
+    # algorithm to react; these methods give MNMG rank loops the verbs to
+    # do so: abort() poisons every rank's host p2p within a heartbeat,
+    # agree_on_survivors() is the failure-consensus barrier, shrink()
+    # rebuilds a survivors-only clique over the comm_split machinery.
+
+    @property
+    def heartbeat_interval(self) -> float:
+        """Transport heartbeat period (drives abort-latency contracts);
+        in-process transports have no heartbeats — poisoning the shared
+        store is instantaneous — so they report 0."""
+        hb = getattr(self._mailbox, "heartbeat_interval", None)
+        return float(hb) if hb else 0.0
+
+    def abort(self, reason: str) -> None:
+        """Broadcast a poison frame: every pending and future host recv
+        on *every* rank raises :class:`CommsAbortedError` within one
+        heartbeat, instead of each rank discovering the failure through
+        its own staggered recv timeout (the comms_t status_t::Abort
+        contract, propagated instead of polled)."""
+        trace.record_event("comms.mesh_abort", rank=self._rank,
+                           reason=reason)
+        self._mailbox.abort(reason)
+
+    def clear_abort(self) -> None:
+        """Re-arm host p2p after recovery (survivors of a shrink start
+        from a clean abort domain)."""
+        self._mailbox.clear_abort()
+
+    def aborted(self) -> Optional[str]:
+        return self._mailbox.aborted()
+
+    def ensure_healthy(self) -> None:
+        """Raise the pending failure, if any: CommsAbortedError when the
+        clique is aborted, PeerFailedError when a peer of this clique is
+        dead.  Runs a :meth:`probe_peers` sweep — passive on wire
+        transports (the heartbeat detector is authoritative), an active
+        fault-path probe on shared-store ones — so iterative solvers
+        calling this at poll boundaries discover injected disconnects
+        without waiting for organic traffic from the dead rank.
+        """
+        reason = self._mailbox.aborted()
+        if reason is not None:
+            raise CommsAbortedError(
+                f"rank {self._rank}: clique aborted ({reason})")
+        for r, why in self.probe_peers().items():
+            if r != self._rank:
+                raise PeerFailedError(
+                    f"rank {self._rank}: peer rank {r} failed ({why})",
+                    rank=r)
+
+    def probe_peers(self) -> Dict[int, str]:
+        """Active liveness sweep; returns {rank: reason} for dead peers.
+
+        On a shared-store transport the sweep pushes a probe *from* each
+        peer's rank through the fault-injected send path, so an injected
+        per-rank disconnect is discovered here rather than at that
+        rank's next real send.  On wire transports the heartbeat failure
+        detector is already authoritative — this just snapshots it.
+        """
+        n = self.get_size()
+        if getattr(self._mailbox, "shared_store", False):
+            for r in range(n):
+                if r == self._rank or self._mailbox.peer_failed(r):
+                    continue
+                self._mailbox.put(r, self._rank, _PROBE_TAG + r,
+                                  np.zeros(1, np.int8))
+                while self._mailbox.get_nowait(
+                        r, self._rank, _PROBE_TAG + r) is not None:
+                    pass
+        return {r: why for r, why in self._mailbox.failed_peers().items()
+                if 0 <= r < n}
+
+    def _recv_latest(self, source: int, tag: int, timeout: float):
+        """Drain queued messages for (source, tag), keeping the newest;
+        block only when none is queued.  Consensus rounds re-send under
+        one tag after a leader change — only the latest frame matters."""
+        msg = None
+        while True:
+            nxt = self._mailbox.get_nowait(source, self._rank, tag)
+            if nxt is None:
+                break
+            msg = nxt
+        if msg is not None:
+            return msg
+        return self._mailbox.get(source, self._rank, tag, timeout=timeout)
+
+    def agree_on_survivors(self, timeout: Optional[float] = None
+                           ) -> Tuple[int, ...]:
+        """Failure-consensus barrier: returns the live-rank set every
+        surviving peer agrees on (sorted old ranks).  All live ranks
+        must call this; a rank evicted by the decision raises
+        :class:`CommsAbortedError`.
+
+        Shared-store transports read the (single) failure detector
+        directly — one failure domain needs no protocol.  Wire
+        transports run a leader-based two-phase exchange: every rank
+        proposes its live-view bitmap to the lowest live rank, the
+        leader intersects proposals with its responder set and
+        broadcasts the decision.  A leader death mid-round triggers
+        re-election (next-lowest live rank) with the same tags;
+        ``_recv_latest`` makes re-sent frames idempotent.
+        """
+        n = self.get_size()
+        failed = self.probe_peers()
+        live = [r for r in range(n) if r not in failed]
+        if getattr(self._mailbox, "shared_store", False):
+            survivors = tuple(live)
+            trace.record_event("comms.consensus", rank=self._rank,
+                               mode="shared", survivors=survivors)
+            return survivors
+        hb_timeout = getattr(self._mailbox, "heartbeat_timeout", None)
+        base_t = timeout if timeout is not None else 2.0 * float(
+            hb_timeout or 10.0)
+        with self._shared["lock"]:
+            epoch = int(self._shared.get("consensus_epoch", 0))
+            self._shared["consensus_epoch"] = epoch + 1
+        propose_tag = _CONSENSUS_TAG + 2 * epoch
+        decide_tag = propose_tag + 1
+        while True:
+            if not live or self._rank not in live:
+                raise CommsAbortedError(
+                    f"rank {self._rank}: no quorum of live peers")
+            leader = min(live)
+            bitmap = np.zeros(n, np.int8)
+            bitmap[live] = 1
+            if self._rank == leader:
+                views = [set(live)]
+                responders = [self._rank]
+                for r in live:
+                    if r == self._rank:
+                        continue
+                    try:
+                        bm = np.asarray(self._recv_latest(
+                            r, propose_tag, timeout=base_t))
+                        views.append(
+                            {i for i in range(min(n, bm.shape[0]))
+                             if bm[i]})
+                        responders.append(r)
+                    except (CommsTimeoutError, PeerFailedError) as e:
+                        logger.warn(
+                            "consensus leader %d: no proposal from rank "
+                            "%d (%r); excluding", self._rank, r, e)
+                decided = set(responders)
+                for v in views:
+                    decided &= v
+                out = np.zeros(n, np.int8)
+                out[sorted(decided)] = 1
+                for r in sorted(decided):
+                    if r != self._rank:
+                        self._mailbox.put(self._rank, r, decide_tag, out)
+                survivors = tuple(sorted(decided))
+                trace.record_event("comms.consensus", rank=self._rank,
+                                   mode="leader", survivors=survivors)
+                return survivors
+            try:
+                self._mailbox.put(self._rank, leader, propose_tag, bitmap)
+                decision = np.asarray(self._recv_latest(
+                    leader, decide_tag, timeout=base_t * (len(live) + 1)))
+            except (PeerFailedError, CommsTimeoutError) as e:
+                # leader died mid-round: exclude it and re-elect
+                logger.warn("consensus rank %d: leader %d lost (%r); "
+                            "re-electing", self._rank, leader, e)
+                live = [r for r in live if r != leader]
+                continue
+            survivors = tuple(
+                int(i) for i in range(min(n, decision.shape[0]))
+                if decision[i])
+            if self._rank not in survivors:
+                raise CommsAbortedError(
+                    f"rank {self._rank}: evicted by survivor consensus "
+                    f"(decision {survivors})")
+            trace.record_event("comms.consensus", rank=self._rank,
+                               mode="follower", survivors=survivors)
+            return survivors
+
+    def shrink(self, survivors: Sequence[int]) -> "MeshComms":
+        """Survivors-only clique over the comm_split machinery (the
+        elastic analogue of ncclCommShrink): survivors keep their
+        relative order but get dense new ranks; dead ranks land in a
+        discard color.  The new clique's abort domain starts clean.
+        """
+        survivors = sorted(int(r) for r in survivors)
+        n = self.get_size()
+        if self._rank not in survivors:
+            raise CommsAbortedError(
+                f"rank {self._rank}: not in survivor set {survivors}")
+        color = [0 if r in set(survivors) else 1 for r in range(n)]
+        sub = self.comm_split(color, list(range(n)))
+        sub.clear_abort()
+        trace.record_event("comms.shrink", rank=self._rank,
+                           new_rank=sub.get_rank(),
+                           survivors=tuple(survivors))
+        return sub
 
     # -- eager collectives over stacked per-rank buffers --------------------
     #
@@ -466,7 +866,9 @@ def _build_eager_collective(mesh, axis_name, shard_fn, replicate_out=False):
 
 
 def build_mesh_comms(res=None, mesh: Optional[Mesh] = None,
-                     axis_name: str = "data", rank: int = 0) -> MeshComms:
+                     axis_name: str = "data", rank: int = 0,
+                     default_recv_timeout: Optional[float] = None
+                     ) -> MeshComms:
     """Create a MeshComms and inject it into the handle.
 
     The analogue of ``build_comms_nccl_only`` / ``build_comms_nccl_ucx``
@@ -474,6 +876,11 @@ def build_mesh_comms(res=None, mesh: Optional[Mesh] = None,
     bootstrapped ncclComm and call ``resource::set_comms``, this wraps the
     handle's mesh — no rendezvous needed; device discovery is XLA's job
     (``jax.distributed.initialize`` on multi-host).
+
+    ``default_recv_timeout`` sets the clique's blocking-recv deadline;
+    None resolves via the RAFT_TPU_RECV_TIMEOUT env var, falling back
+    to 30 s (the transport deadline previously hard-coded in
+    ``_Mailbox.get``).
     """
     from raft_tpu.core import resources as core_res
 
@@ -482,7 +889,8 @@ def build_mesh_comms(res=None, mesh: Optional[Mesh] = None,
     if mesh is None:
         devs = np.asarray(jax.devices())
         mesh = Mesh(devs, axis_names=(axis_name,))
-    comms = MeshComms(mesh, axis_name=axis_name, rank=rank)
+    comms = MeshComms(mesh, axis_name=axis_name, rank=rank,
+                      _mailbox=_Mailbox(default_timeout=default_recv_timeout))
     if res is not None:
         core_res.set_comms(res, comms)
     return comms

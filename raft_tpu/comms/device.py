@@ -43,7 +43,10 @@ class Op(enum.Enum):
 
 
 def _axis_size(axis_name) -> int:
-    return lax.axis_size(axis_name)
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    # pre-axis_size jax: psum of a unit literal folds to the static size
+    return lax.psum(1, axis_name)
 
 
 def rank(axis_name="data"):

@@ -28,6 +28,7 @@ trace range) and logged through ``core.logger``.
 from __future__ import annotations
 
 import collections
+import os
 import random
 import threading
 import time
@@ -48,6 +49,24 @@ _log = logger.child("comms")
 # interrupt this immediately; the cap only bounds clock-driven checks
 # (deadline expiry) on a quiet store.
 _POLL_CAP_S = 0.1
+
+
+def default_recv_timeout(fallback: float) -> float:
+    """Resolve the default blocking-recv deadline for a transport.
+
+    ``RAFT_TPU_RECV_TIMEOUT`` (seconds) overrides the per-transport
+    fallback (30 s in-process, 120 s TCP — the latter sized for loaded
+    hosts, see TcpMailbox.get).  Explicit ``default_recv_timeout=``
+    arguments on the mailbox constructors / ``build_mesh_comms`` win
+    over both.
+    """
+    env = os.environ.get("RAFT_TPU_RECV_TIMEOUT", "").strip()
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            _log.warning("ignoring malformed RAFT_TPU_RECV_TIMEOUT=%r", env)
+    return fallback
 
 
 @dataclass(frozen=True)
@@ -159,6 +178,7 @@ class TagStore:
         self._cv = threading.Condition()
         self._queues: Dict[Tuple[int, int, int], Deque] = {}
         self._failed: Dict[int, str] = {}
+        self._abort_reason: Optional[str] = None
 
     # -- producers ----------------------------------------------------------
 
@@ -202,7 +222,47 @@ class TagStore:
         with self._cv:
             return self._failed.get(rank)
 
+    def failed_peers(self) -> Dict[int, str]:
+        """Snapshot of the failure detector's current suspicions."""
+        with self._cv:
+            return dict(self._failed)
+
+    # -- abort propagation (ISSUE 2 tentpole part 1) ------------------------
+
+    def abort(self, reason: str) -> None:
+        """Poison the store: every pending and future ``get`` raises
+        :class:`CommsAbortedError` immediately (the store-local leg of
+        ``MeshComms.abort`` — one rank's cancellation surfaces on every
+        blocked peer within a wakeup, not a recv-timeout staircase).
+        Unlike ``fail_peer``, abort wins over queued messages: a job
+        being torn down must not keep draining stale data."""
+        with self._cv:
+            if self._abort_reason is None:
+                self._abort_reason = reason
+                trace.record_event("comms.abort", store=self.name,
+                                   reason=reason)
+                _log.warning("%s: aborted: %s", self.name, reason)
+            self._cv.notify_all()
+
+    def clear_abort(self) -> None:
+        """Re-arm the store after recovery (a shrunken survivor clique
+        starts from a clean slate)."""
+        with self._cv:
+            self._abort_reason = None
+
+    def aborted(self) -> Optional[str]:
+        with self._cv:
+            return self._abort_reason
+
     # -- consumer -----------------------------------------------------------
+
+    def get_nowait(self, source: int, dest: int, tag: int):
+        """Pop a matching message if one is already queued, else None.
+        Consults neither the failure detector nor abort state — used by
+        drain-latest consumers (consensus, probe sweeps)."""
+        with self._cv:
+            dq = self._queues.get((source, dest, tag))
+            return dq.popleft() if dq else None
 
     def get(self, source: int, dest: int, tag: int, timeout: float = 30.0):
         """Blocking tag-matched receive.
@@ -220,6 +280,10 @@ class TagStore:
         try:
             with self._cv:
                 while True:
+                    if self._abort_reason is not None:
+                        raise CommsAbortedError(
+                            f"{self.name}: aborted ({self._abort_reason}) "
+                            f"with recv {key} pending", endpoint=key)
                     dq = self._queues.get(key)
                     if dq:
                         return dq.popleft()

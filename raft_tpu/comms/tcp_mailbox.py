@@ -56,6 +56,7 @@ from raft_tpu.comms.resilience import (
     RECONNECT_POLICY,
     RetryPolicy,
     TagStore,
+    default_recv_timeout as _default_recv_timeout,
 )
 from raft_tpu.core import logger, trace
 
@@ -66,6 +67,9 @@ _DATA = 0       # tag-matched payload frame (body = .npy bytes)
 _HELLO = 1      # connection preamble: attributes the stream to a rank
 _HEARTBEAT = 2  # periodic liveness proof on idle/busy links alike
 _GOODBYE = 3    # graceful departure: peer is leaving, not crashing
+_ABORT = 4      # poison frame: body = utf-8 reason; every pending and
+                # future get on the receiver raises CommsAbortedError
+                # (the wire leg of MeshComms.abort — ref status_t::Abort)
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -97,18 +101,30 @@ class TcpMailbox:
         detection path is connection EOF, which needs no timer.
     connect_policy : RetryPolicy for first-contact connects (default
         tolerates slow bootstrap, resilience.CONNECT_POLICY).
+    default_recv_timeout : default blocking-get deadline; None resolves
+        via RAFT_TPU_RECV_TIMEOUT / the 120 s loaded-host fallback (see
+        ``get``'s deadline rationale).
     """
+
+    # each process owns its own store: abort/failure state must cross
+    # the wire (the _ABORT frame), and survivor consensus must run the
+    # real protocol instead of reading a shared detector
+    shared_store = False
 
     def __init__(self, rank: int, addrs: List[str], *, faults=None,
                  heartbeat_interval: float = 2.0,
                  heartbeat_timeout: float = 10.0,
-                 connect_policy: Optional[RetryPolicy] = None):
+                 connect_policy: Optional[RetryPolicy] = None,
+                 default_recv_timeout: Optional[float] = None):
         self.rank = int(rank)
         self.addrs = list(addrs)
         self.faults = faults
         self.heartbeat_interval = float(heartbeat_interval)
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.connect_policy = connect_policy or CONNECT_POLICY
+        self.default_timeout = (
+            default_recv_timeout if default_recv_timeout is not None
+            else _default_recv_timeout(120.0))
         self._store = TagStore(name=f"tcp-mailbox[rank {self.rank}]")
         self._lock = threading.Lock()
         # One persistent connection per destination, guarded by a per-dest
@@ -247,7 +263,7 @@ class TcpMailbox:
             s.sendall(raw)
 
     def get(self, source: int, dest: int, tag: int,
-            timeout: float = 120.0):
+            timeout: Optional[float] = None):
         """Blocking tag-matched receive. The default deadline is sized
         for a LOADED host: the peer may be stuck behind multi-second XLA
         compiles or a saturated CPU before it sends (observed: the
@@ -260,13 +276,58 @@ class TcpMailbox:
         CommsTimeoutError."""
         assert dest == self.rank, \
             f"rank {self.rank} cannot receive for rank {dest}"
+        if timeout is None:
+            timeout = self.default_timeout
         return self._store.get(source, dest, tag, timeout=timeout)
+
+    def get_nowait(self, source: int, dest: int, tag: int):
+        return self._store.get_nowait(source, dest, tag)
 
     def fail_peer(self, rank: int, reason: str) -> None:
         self._store.fail_peer(rank, reason)
 
     def revive_peer(self, rank: int) -> None:
         self._store.revive_peer(rank)
+
+    def peer_failed(self, rank: int) -> Optional[str]:
+        return self._store.peer_failed(rank)
+
+    def failed_peers(self) -> Dict[int, str]:
+        return self._store.failed_peers()
+
+    # -- abort propagation (the wire leg of MeshComms.abort) ----------------
+
+    def abort(self, reason: str) -> None:
+        """Poison this store AND broadcast an _ABORT frame to every
+        peer, so a blocked get on any live rank raises
+        CommsAbortedError within a delivery, not a recv-timeout
+        staircase.  Best-effort per peer: a rank that is already dead or
+        unreachable simply misses the frame (its own failure detector is
+        someone else's problem by then)."""
+        self._store.abort(reason)
+        body = reason.encode("utf-8", "replace")[:4096]
+        crc = zlib.crc32(body)
+        for dest in range(len(self.addrs)):
+            if dest == self.rank or self._store.peer_failed(dest) is not None:
+                continue
+            try:
+                with self._lock:
+                    lock = self._conn_locks.setdefault(dest,
+                                                       threading.Lock())
+                with lock:
+                    s = self._get_conn(dest)
+                    s.sendall(_HDR.pack(_ABORT, self.rank, dest, 0, crc,
+                                        len(body)))
+                    s.sendall(body)
+            except (OSError, PeerFailedError) as e:
+                trace.record_event("comms.abort_send_failed", dest=dest,
+                                   error=repr(e))
+
+    def clear_abort(self) -> None:
+        self._store.clear_abort()
+
+    def aborted(self) -> Optional[str]:
+        return self._store.aborted()
 
     # -- plumbing ----------------------------------------------------------
 
@@ -303,6 +364,13 @@ class TcpMailbox:
                         graceful = True
                         break
                     if kind in (_HELLO, _HEARTBEAT):
+                        continue
+                    if kind == _ABORT:
+                        raw = _recv_exact(conn, nbytes)
+                        why = (raw.decode("utf-8", "replace")
+                               if zlib.crc32(raw) == crc else "(corrupt)")
+                        self._store.abort(
+                            f"abort from rank {source}: {why}")
                         continue
                     raw = _recv_exact(conn, nbytes)
                     if zlib.crc32(raw) != crc:

@@ -16,6 +16,12 @@ import numpy as np
 
 from raft_tpu.core.mdarray import MdArray
 
+# np.save of an ml_dtypes bfloat16 array silently degrades the dtype to
+# raw void bytes ('|V2') — loads would come back typeless. Wire bf16 as
+# a one-field structured dtype instead: same bytes, self-describing
+# name, detectable on load without guessing.
+_BF16_WIRE = np.dtype([("bfloat16", np.uint16)])
+
 
 def _to_numpy(array: Any) -> np.ndarray:
     if isinstance(array, MdArray):
@@ -28,13 +34,20 @@ def _to_numpy(array: Any) -> np.ndarray:
 def serialize_mdspan(res, stream: BinaryIO, array: Any) -> None:
     """Write an array (host or device) in .npy format
     (ref: serialize_mdspan, core/serialize.hpp:26-68)."""
-    np.save(stream, _to_numpy(array), allow_pickle=False)
+    arr = _to_numpy(array)
+    if arr.dtype.name == "bfloat16":
+        arr = np.ascontiguousarray(arr).view(np.uint16).view(_BF16_WIRE)
+    np.save(stream, arr, allow_pickle=False)
 
 
 def deserialize_mdspan(res, stream: BinaryIO, to_device: bool = True):
     """Read a .npy stream back (ref: deserialize_mdspan,
     core/serialize.hpp:70-112)."""
     arr = np.load(stream, allow_pickle=False)
+    if arr.dtype.names == ("bfloat16",):
+        import ml_dtypes
+
+        arr = arr.view(np.uint16).view(ml_dtypes.bfloat16)
     if to_device:
         import jax.numpy as jnp
 
@@ -47,7 +60,12 @@ def serialize_scalar(res, stream: BinaryIO, value) -> None:
 
 
 def deserialize_scalar(res, stream: BinaryIO):
-    return np.load(stream, allow_pickle=False)[()]
+    """Read a scalar back as the *native* Python value (ref semantics:
+    deserialize_scalar<T> returns T, not an array wrapper — returning
+    ``np.float64``/``np.int64`` here leaked NumPy scalars into params
+    structs and comparison code)."""
+    val = np.load(stream, allow_pickle=False)[()]
+    return val.item() if isinstance(val, np.generic) else val
 
 
 def dumps(array: Any) -> bytes:

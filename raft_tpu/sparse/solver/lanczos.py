@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from raft_tpu.core import logger
+from raft_tpu.core import logger, trace
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.sparse import convert
 from raft_tpu.sparse.linalg import _segment_spmv as _spmv_kernel
@@ -265,15 +265,31 @@ def _eigsh_csr(csr, cfg: LanczosConfig, v0,
     return _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype)
 
 
-def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype):
+def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
+                  on_iteration=None, resume=None):
     """Host-driven thick-restart outer loop (ref: detail/lanczos.cuh:537
     `while (res > tol && iter < maxIter)`), shared by the single-device and
     MNMG drivers: `basis` may be a mesh-sharded global array — the Ritz
     back-transform (basis.T @ s), QR and row assignments are plain XLA ops
-    that GSPMD partitions along the existing sharding."""
-    basis, t, beta_last, v = extend(0, basis, t, v, it=-1)
+    that GSPMD partitions along the existing sharding.
 
-    for it in range(cfg.max_iterations):
+    Elastic hooks (ISSUE 2): ``on_iteration(it, basis, t, beta_last, v)``
+    fires at the top of each outer iteration — the state at that point
+    fully determines the rest of the run (the extension keys derive from
+    (seed, it, j_start), not from an ambient RNG), which is what makes
+    checkpoints taken there resume bit-for-bit.  ``resume=(it0,
+    beta_last)`` skips the initial extension and re-enters the loop at
+    ``it0`` with the caller-provided ``basis``/``t``/``v``.
+    """
+    if resume is None:
+        basis, t, beta_last, v = extend(0, basis, t, v, it=-1)
+        it0 = 0
+    else:
+        it0, beta_last = resume
+
+    for it in range(it0, cfg.max_iterations):
+        if on_iteration is not None:
+            on_iteration(it, basis, t, beta_last, v)
         evals, evecs = np.linalg.eigh(t)
         # Ritz selection per `which` (ref: lanczos_solve_ritz
         # detail/lanczos.cuh:182-223 — SM/LM sort Ritz values by magnitude
@@ -432,7 +448,12 @@ def _extend_mnmg_body(rows_l, cols_g, data_l, basis_l, v_l, key,
 def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
                which: str = "SA", v0=None, ncv: int = 0,
                maxiter: int = 1000, tol: float = 1e-7,
-               seed: int = 42) -> Tuple[jnp.ndarray, jnp.ndarray]:
+               seed: int = 42, comms=None,
+               checkpoint_every: Optional[int] = None,
+               checkpoint_dir: Optional[str] = None,
+               checkpoint_keep: int = 2,
+               resume_from: Optional[str] = None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Multi-device eigsh: A row-partitioned over ``mesh[axis]``, the
     Lanczos extension shard_mapped (SpMV = local band product over an
     all-gathered v; dots/norms psum'd), the restart loop's dense algebra
@@ -440,8 +461,20 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
 
     Composes BASELINE config 4 with config 5's mesh: the same row-band
     convention as the MNMG k-means/kNN paths
-    (ref: docs/source/using_raft_comms.rst:1-40)."""
+    (ref: docs/source/using_raft_comms.rst:1-40).
+
+    Elastic execution (ISSUE 2): ``checkpoint_every=n`` saves restart
+    state (unpadded basis, projected matrix t, residual vector,
+    beta_last, iteration) every n-th outer restart; with a ``comms``
+    clique attached, each restart health-checks the peers, and on a
+    failure the survivors agree → shrink → the row bands are REBUILT
+    for the smaller device count (n_local = ceil(n / n_dev) changes) →
+    the last checkpoint resumes the restart loop on fewer ranks.
+    ``resume_from`` accepts a checkpoint file or directory."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raft_tpu.comms.errors import CommsAbortedError, PeerFailedError
+    from raft_tpu.core import checkpoint as core_ckpt
 
     if mesh is None:
         raise ValueError("eigsh_mnmg requires a jax.sharding.Mesh")
@@ -450,7 +483,6 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
         from raft_tpu.sparse import op as sparse_op
         csr = convert.sorted_coo_to_csr(sparse_op.coo_sort(csr))
     n = csr.n_rows
-    n_dev = mesh.shape[axis]
     cfg = LanczosConfig(n_components=k, max_iterations=maxiter, ncv=ncv,
                         tolerance=tol, which=which.upper(), seed=seed)
     if k <= 0 or k >= n:
@@ -465,91 +497,187 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
         raise ValueError(f"which must be LA|LM|SA|SM, got {which}")
     dtype = jnp.float32
 
-    # --- host: row bands with equal local size + equal padded nnz -------
     from raft_tpu.util.math import cdiv
 
-    n_local = cdiv(n, n_dev)
-    n_pad = n_local * n_dev
+    manager = None
+    if checkpoint_every is not None:
+        if checkpoint_dir is None:
+            raise ValueError("checkpoint_every requires checkpoint_dir")
+        manager = core_ckpt.CheckpointManager(
+            checkpoint_dir, prefix="eigsh", keep=checkpoint_keep)
+
     rows_h, cols_h, data_h = csr.host_edges()
     data_h = data_h.astype(np.float32)
-    band = rows_h // n_local
 
-    shard = NamedSharding(mesh, P(axis))
-    # Per-band ELL slab when the padding trade is favorable (the same
-    # <= 4x stored/actual gate as maybe_ell): gather + dense row reduce,
-    # no scatter — otherwise the segment-sum band formulation.
-    from raft_tpu.sparse.ell import MAX_AUTO_PADDING
+    def build_extend(cur_mesh):
+        """Everything that depends on the device count, bundled so a
+        post-shrink survivor mesh can rebuild it: row bands with equal
+        local size + equal padded nnz, the jitted shard_map extension,
+        and `place` to (re-)pad host state onto the mesh. `n_local =
+        ceil(n / n_dev)` changes when the mesh shrinks, so the band
+        layout and padding are NOT reusable across meshes — but the
+        unpadded state (basis[:, :n], v[:n]) is, because padding rows
+        of the operator are zero and every code path (initial v, spmv,
+        breakdown restarts) keeps the padded slots exactly 0."""
+        n_dev = cur_mesh.shape[axis]
+        n_local = cdiv(n, n_dev)
+        n_pad = n_local * n_dev
+        band = rows_h // n_local
 
-    row_len_h = np.zeros(n_pad, np.int64)
-    np.add.at(row_len_h, rows_h, 1)
-    width = int(row_len_h.max()) if len(rows_h) else 0
-    width = max(8 * -(-max(width, 1) // 8), 8)
-    use_ell = (len(rows_h) > 0
-               and n_pad * width <= MAX_AUTO_PADDING * len(rows_h))
-    if use_ell:
-        cols_e = np.zeros((n_pad, width), np.int32)
-        data_e = np.zeros((n_pad, width), np.float32)
-        lanes = (np.arange(len(rows_h))
-                 - np.concatenate([[0], np.cumsum(row_len_h)[:-1]]
-                                  )[rows_h])
-        cols_e[rows_h, lanes] = cols_h
-        data_e[rows_h, lanes] = data_h
-        rows_g = jax.device_put(
-            jnp.asarray(row_len_h.astype(np.int32)), shard)
-        cols_g = jax.device_put(jnp.asarray(cols_e), shard)
-        data_g = jax.device_put(jnp.asarray(data_e), shard)
-    else:
-        counts = np.bincount(band, minlength=n_dev)
-        nnz_max = max(int(counts.max()), 1)
-        rows_b = np.full((n_dev, nnz_max), -1, np.int32)
-        cols_b = np.zeros((n_dev, nnz_max), np.int32)
-        data_b = np.zeros((n_dev, nnz_max), np.float32)
-        for d in range(n_dev):
-            m = band == d
-            c = int(counts[d])
-            rows_b[d, :c] = rows_h[m] - d * n_local
-            cols_b[d, :c] = cols_h[m]
-            data_b[d, :c] = data_h[m]
-        rows_g = jax.device_put(rows_b.reshape(-1), shard)
-        cols_g = jax.device_put(cols_b.reshape(-1), shard)
-        data_g = jax.device_put(data_b.reshape(-1), shard)
+        shard = NamedSharding(cur_mesh, P(axis))
+        # Per-band ELL slab when the padding trade is favorable (the same
+        # <= 4x stored/actual gate as maybe_ell): gather + dense row
+        # reduce, no scatter — otherwise the segment-sum band formulation.
+        from raft_tpu.sparse.ell import MAX_AUTO_PADDING
 
-    rng = np.random.default_rng(cfg.seed)
-    v_h = (np.asarray(v0, np.float32) if v0 is not None
-           else rng.standard_normal(n).astype(np.float32))
-    v_h = np.pad(v_h, (0, n_pad - n))
-    v_h = v_h / np.linalg.norm(v_h)
-    v = jax.device_put(jnp.asarray(v_h), shard)
-    basis = jax.device_put(jnp.zeros((ncv, n_pad), dtype),
-                           NamedSharding(mesh, P(None, axis)))
+        row_len_h = np.zeros(n_pad, np.int64)
+        np.add.at(row_len_h, rows_h, 1)
+        width = int(row_len_h.max()) if len(rows_h) else 0
+        width = max(8 * -(-max(width, 1) // 8), 8)
+        use_ell = (len(rows_h) > 0
+                   and n_pad * width <= MAX_AUTO_PADDING * len(rows_h))
+        if use_ell:
+            cols_e = np.zeros((n_pad, width), np.int32)
+            data_e = np.zeros((n_pad, width), np.float32)
+            lanes = (np.arange(len(rows_h))
+                     - np.concatenate([[0], np.cumsum(row_len_h)[:-1]]
+                                      )[rows_h])
+            cols_e[rows_h, lanes] = cols_h
+            data_e[rows_h, lanes] = data_h
+            rows_g = jax.device_put(
+                jnp.asarray(row_len_h.astype(np.int32)), shard)
+            cols_g = jax.device_put(jnp.asarray(cols_e), shard)
+            data_g = jax.device_put(jnp.asarray(data_e), shard)
+        else:
+            counts = np.bincount(band, minlength=n_dev)
+            nnz_max = max(int(counts.max()), 1)
+            rows_b = np.full((n_dev, nnz_max), -1, np.int32)
+            cols_b = np.zeros((n_dev, nnz_max), np.int32)
+            data_b = np.zeros((n_dev, nnz_max), np.float32)
+            for d in range(n_dev):
+                m = band == d
+                c = int(counts[d])
+                rows_b[d, :c] = rows_h[m] - d * n_local
+                cols_b[d, :c] = cols_h[m]
+                data_b[d, :c] = data_h[m]
+            rows_g = jax.device_put(rows_b.reshape(-1), shard)
+            cols_g = jax.device_put(cols_b.reshape(-1), shard)
+            data_g = jax.device_put(data_b.reshape(-1), shard)
+
+        def make_extend(j_start):
+            body = functools.partial(_extend_mnmg_body, j_start=j_start,
+                                     ncv=ncv, n_local=n_local, n_true=n,
+                                     axis=axis, use_ell=use_ell)
+            return jax.jit(jax.shard_map(
+                body, mesh=cur_mesh,
+                in_specs=(P(axis), P(axis), P(axis), P(None, axis),
+                          P(axis), P()),
+                out_specs=(P(None, axis), P(), P(), P(axis))))
+
+        extend_cache = {}
+
+        def extend(j_start, basis, t, v, it):
+            key = jax.random.key(cfg.seed + 7919 * (it + 1) + j_start)
+            if j_start not in extend_cache:
+                extend_cache[j_start] = make_extend(j_start)
+            basis, ab, brk, v = extend_cache[j_start](
+                rows_g, cols_g, data_g, basis, v, key)
+            ab_h = np.asarray(ab, dtype=np.float64)
+            brk_h = np.asarray(brk)
+            for j in range(j_start, ncv):
+                t[j, j] = ab_h[0, j]
+                if j + 1 < ncv:
+                    t[j, j + 1] = t[j + 1, j] = ab_h[1, j]
+            beta_last = 0.0 if brk_h[ncv - 1] else float(ab_h[1, ncv - 1])
+            return basis, t, beta_last, v
+
+        def place(basis_h, v_h):
+            b = np.zeros((ncv, n_pad), np.float32)
+            b[:, :n] = basis_h
+            vp = np.zeros(n_pad, np.float32)
+            vp[:n] = v_h
+            return (jax.device_put(jnp.asarray(b),
+                                   NamedSharding(cur_mesh, P(None, axis))),
+                    jax.device_put(jnp.asarray(vp), shard))
+
+        return extend, place
+
     t = np.zeros((ncv, ncv), dtype=np.float64)
+    resume = None
+    if resume_from is not None:
+        entries = _load_eigsh_checkpoint(resume_from)
+        basis_h = np.asarray(entries["basis"], np.float32)
+        v_h = np.asarray(entries["v"], np.float32)
+        t = np.asarray(entries["t"], np.float64).copy()
+        resume = (int(entries["it"]), float(entries["beta_last"]))
+    else:
+        rng = np.random.default_rng(cfg.seed)
+        v_h = (np.asarray(v0, np.float32) if v0 is not None
+               else rng.standard_normal(n).astype(np.float32))
+        v_h = v_h / np.linalg.norm(v_h)
+        basis_h = np.zeros((ncv, n), np.float32)
 
-    def make_extend(j_start):
-        body = functools.partial(_extend_mnmg_body, j_start=j_start,
-                                 ncv=ncv, n_local=n_local, n_true=n,
-                                 axis=axis, use_ell=use_ell)
-        return jax.jit(jax.shard_map(
-            body, mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(None, axis), P(axis),
-                      P()),
-            out_specs=(P(None, axis), P(), P(), P(axis))))
+    extend, place = build_extend(mesh)
+    basis, v = place(basis_h, v_h)
+    ckpt_stride = (max(1, int(checkpoint_every))
+                   if checkpoint_every is not None else None)
 
-    extend_cache = {}
+    def on_iteration(it, basis_d, t_d, beta_last_d, v_d):
+        # checkpoint FIRST, then health-probe: a failure surfaced by the
+        # probe recovers from exactly this state, so the shrunken rerun
+        # and a clean resume from the same file agree bit-for-bit
+        if manager is not None and it % ckpt_stride == 0:
+            manager.save(it, {
+                "basis": np.asarray(basis_d)[:, :n],
+                "t": np.asarray(t_d, np.float64),
+                "v": np.asarray(v_d)[:n],
+                "beta_last": float(beta_last_d),
+                "it": int(it),
+            })
+        if comms is not None:
+            comms.ensure_healthy()
 
-    def extend(j_start, basis, t, v, it):
-        key = jax.random.key(cfg.seed + 7919 * (it + 1) + j_start)
-        if j_start not in extend_cache:
-            extend_cache[j_start] = make_extend(j_start)
-        basis, ab, brk, v = extend_cache[j_start](
-            rows_g, cols_g, data_g, basis, v, key)
-        ab_h = np.asarray(ab, dtype=np.float64)
-        brk_h = np.asarray(brk)
-        for j in range(j_start, ncv):
-            t[j, j] = ab_h[0, j]
-            if j + 1 < ncv:
-                t[j, j + 1] = t[j + 1, j] = ab_h[1, j]
-        beta_last = 0.0 if brk_h[ncv - 1] else float(ab_h[1, ncv - 1])
-        return basis, t, beta_last, v
-
-    w, vecs = _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype)
+    hook = (on_iteration if (manager is not None or comms is not None)
+            else None)
+    while True:
+        try:
+            w, vecs = _restart_loop(extend, basis, t, v, cfg, k, ncv,
+                                    which, dtype, on_iteration=hook,
+                                    resume=resume)
+            break
+        except (PeerFailedError, CommsAbortedError) as err:
+            if comms is None or manager is None:
+                raise
+            latest = manager.restore_latest()
+            if latest is None:
+                raise
+            step, entries = latest
+            survivors = comms.agree_on_survivors()
+            comms = comms.shrink(survivors)
+            mesh = comms.mesh
+            logger.warn(
+                "eigsh_mnmg: peer failure (%s); resuming restart %d on "
+                "%d survivors", err, step, len(survivors))
+            trace.record_event("eigsh.elastic_resume", step=step,
+                               survivors=len(survivors))
+            extend, place = build_extend(mesh)
+            basis, v = place(np.asarray(entries["basis"], np.float32),
+                             np.asarray(entries["v"], np.float32))
+            t = np.asarray(entries["t"], np.float64).copy()
+            resume = (int(entries["it"]), float(entries["beta_last"]))
     return w, vecs[:n]
+
+
+def _load_eigsh_checkpoint(resume_from):
+    import os
+
+    from raft_tpu.core import checkpoint as core_ckpt
+
+    if os.path.isdir(resume_from):
+        mgr = core_ckpt.CheckpointManager(resume_from, prefix="eigsh")
+        latest = mgr.restore_latest()
+        if latest is None:
+            raise FileNotFoundError(
+                f"no eigsh checkpoints under {resume_from!r}")
+        return latest[1]
+    return core_ckpt.restore_checkpoint(resume_from)

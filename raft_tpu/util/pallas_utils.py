@@ -41,7 +41,12 @@ def use_interpret() -> bool:
 
 
 def _vma(a):
-    return getattr(jax.typeof(a), "vma", frozenset()) or frozenset()
+    # jax versions without jax.typeof predate the vma type system:
+    # nothing varies explicitly, pcast plumbing degrades to a no-op
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(a), "vma", frozenset()) or frozenset()
 
 
 def join_vma(*arrays):

@@ -1,0 +1,84 @@
+"""Worker for the multiprocess elastic k-means chaos test (ISSUE 2
+acceptance: a 4-rank fit survives one SIGKILL'd rank and finishes on the
+3 survivors from the last checkpoint).
+
+Each worker builds a TcpMailbox clique (fast heartbeats: the detection →
+abort → consensus → shrink round-trip must fit a test budget) over a
+local CPU-device mesh — deliberately NOT `jax.distributed`: the global
+XLA runtime cannot outlive a killed participant, which is exactly why
+`kmeans_fit_elastic` keeps its reduction on the host mailbox.
+
+Usage: python _elastic_worker.py <rank> <ckpt_dir> <mode> <addr0> ...
+
+mode "faulted": checkpoint every iteration; rank 2 SIGKILLs itself at
+iteration 4 (after the update, before the rank-0 checkpoint probe).
+mode "clean:<path>": no failures, no checkpointing; resume from the
+named checkpoint file on a (smaller) clique.
+"""
+
+import os
+import signal
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                           + os.environ.get("XLA_FLAGS", ""))
+
+KILL_AT = 4
+
+
+def dataset():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    return np.concatenate(
+        [rng.normal(c, 0.35, (200, 6)) for c in range(5)])
+
+
+def main():
+    rank = int(sys.argv[1])
+    ckpt_dir = sys.argv[2]
+    mode = sys.argv[3]
+    addrs = sys.argv[4:]
+    nranks = len(addrs)
+
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit_elastic
+    from raft_tpu.comms.comms import MeshComms
+    from raft_tpu.comms.tcp_mailbox import TcpMailbox
+
+    box = TcpMailbox(rank, addrs, heartbeat_interval=0.3,
+                     heartbeat_timeout=1.5, default_recv_timeout=60.0)
+    mesh = Mesh(np.asarray(jax.devices()[:nranks]), axis_names=("data",))
+    comms = MeshComms(mesh, "data", rank, _mailbox=box)
+
+    x = dataset()
+    params = KMeansParams(n_clusters=5, max_iter=12, tol=1e-12, seed=11)
+
+    def chaos(it, c):
+        if rank == 2 and it == KILL_AT:
+            print("ELASTIC_WORKER_SUICIDE", flush=True)
+            sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    if mode.startswith("clean:"):
+        c, inertia, n_iter, comms = kmeans_fit_elastic(
+            comms, params, x, resume_from=mode.split(":", 1)[1])
+    else:
+        c, inertia, n_iter, comms = kmeans_fit_elastic(
+            comms, params, x, checkpoint_every=1, checkpoint_dir=ckpt_dir,
+            checkpoint_keep=100, on_iteration=chaos)
+
+    import zlib
+
+    crc = zlib.crc32(np.ascontiguousarray(c).tobytes())
+    print(f"ELASTIC_WORKER_OK rank={rank} size={comms.get_size()} "
+          f"n_iter={n_iter} inertia={inertia:.17g} crc={crc}", flush=True)
+    box.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+"""Checkpoint container tests (ISSUE 2 tentpole part 3).
+
+Covers the v1 binary format (round-trip of every entry kind, CRC
+corruption detection, version gating), the atomic-save contract, the
+retention manager, and the COMMITTED format fixture
+(tests/data/checkpoint_v1.ckpt): readers must keep loading v1 bytes
+produced before any future change — the format is frozen, changes bump
+the version.
+"""
+
+import io
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import checkpoint as ckpt
+from raft_tpu.core.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointManager,
+    CheckpointVersionError,
+    dump_checkpoint,
+    load_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from raft_tpu.random.rng_state import GeneratorType, RngState
+
+_FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "data", "checkpoint_v1.ckpt")
+
+
+def _sample_entries():
+    import ml_dtypes
+
+    return {
+        "centroids": np.arange(12, dtype=np.float32).reshape(3, 4) / 7.0,
+        "t": np.linspace(-1.0, 1.0, 9, dtype=np.float64).reshape(3, 3),
+        "mask": np.array([True, False, True]),
+        "soft": np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16),
+        "n_iter": 17,
+        "prev_inertia": 123.4375,
+        "label": np.int64(-5),
+        "rng": RngState(seed=99, base_subsequence=3,
+                        type=GeneratorType.RBG),
+    }
+
+
+class TestContainer:
+    def test_round_trip_all_kinds(self):
+        buf = io.BytesIO()
+        entries = _sample_entries()
+        dump_checkpoint(entries, buf)
+        buf.seek(0)
+        out = load_checkpoint(buf)
+        assert set(out) == set(entries)
+        np.testing.assert_array_equal(out["centroids"],
+                                      entries["centroids"])
+        assert out["t"].dtype == np.float64
+        np.testing.assert_array_equal(out["t"], entries["t"])
+        np.testing.assert_array_equal(out["mask"], entries["mask"])
+        assert out["soft"].dtype.name == "bfloat16"
+        np.testing.assert_array_equal(
+            out["soft"].astype(np.float32),
+            entries["soft"].astype(np.float32))
+        # scalars come back as NATIVE python values (serialize satellite)
+        assert out["n_iter"] == 17 and type(out["n_iter"]) is int
+        assert out["prev_inertia"] == 123.4375
+        assert type(out["prev_inertia"]) is float
+        assert out["label"] == -5 and type(out["label"]) is int
+        rng = out["rng"]
+        assert isinstance(rng, RngState)
+        assert (rng.seed, rng.base_subsequence, rng.type) == (
+            99, 3, GeneratorType.RBG)
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(CheckpointCorruptError, match="magic"):
+            load_checkpoint(io.BytesIO(b"NOTRAFT1" + b"\0" * 8))
+
+    def test_future_version_rejected(self):
+        buf = io.BytesIO()
+        buf.write(struct.pack("<8sII", ckpt.MAGIC, ckpt.VERSION + 1, 0))
+        buf.seek(0)
+        with pytest.raises(CheckpointVersionError):
+            load_checkpoint(buf)
+
+    def test_truncation_detected(self):
+        buf = io.BytesIO()
+        dump_checkpoint({"a": np.arange(100.0)}, buf)
+        raw = buf.getvalue()
+        with pytest.raises(CheckpointCorruptError, match="truncated"):
+            load_checkpoint(io.BytesIO(raw[:-10]))
+
+    def test_bitflip_detected_by_crc(self):
+        buf = io.BytesIO()
+        dump_checkpoint({"a": np.arange(100.0)}, buf)
+        raw = bytearray(buf.getvalue())
+        raw[len(raw) // 2] ^= 0x01          # damage the payload
+        with pytest.raises(CheckpointCorruptError, match="crc"):
+            load_checkpoint(io.BytesIO(bytes(raw)))
+
+
+class TestSaveRestore:
+    def test_atomic_save_leaves_no_tmp(self, tmp_path):
+        path = tmp_path / "state.ckpt"
+        save_checkpoint(path, {"x": np.ones(4)})
+        assert sorted(os.listdir(tmp_path)) == ["state.ckpt"]
+        out = restore_checkpoint(path)
+        np.testing.assert_array_equal(out["x"], np.ones(4))
+
+    def test_overwrite_replaces_whole_file(self, tmp_path):
+        path = tmp_path / "state.ckpt"
+        save_checkpoint(path, {"x": np.ones(1000)})
+        save_checkpoint(path, {"x": np.zeros(2)})
+        out = restore_checkpoint(path)
+        np.testing.assert_array_equal(out["x"], np.zeros(2))
+
+
+class TestManager:
+    def test_latest_and_retention(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, prefix="km", keep=2)
+        for step in (2, 4, 6):
+            mgr.save(step, {"s": float(step)})
+        assert mgr.steps() == [4, 6]        # keep=2 pruned step 2
+        step, entries = mgr.restore_latest()
+        assert step == 6 and entries["s"] == 6.0
+
+    def test_empty_dir_latest_is_none(self, tmp_path):
+        mgr = CheckpointManager(tmp_path, prefix="km")
+        assert mgr.latest() is None
+        assert mgr.restore_latest() is None
+
+    def test_foreign_files_ignored(self, tmp_path):
+        (tmp_path / "km-notastep.ckpt").write_bytes(b"junk")
+        (tmp_path / "other-00000001.ckpt").write_bytes(b"junk")
+        mgr = CheckpointManager(tmp_path, prefix="km", keep=1)
+        mgr.save(3, {"s": 3.0})
+        assert mgr.steps() == [3]
+
+    def test_keep_validated(self, tmp_path):
+        with pytest.raises(ValueError):
+            CheckpointManager(tmp_path, keep=0)
+
+
+class TestFrozenFixture:
+    """The committed v1 artifact must load forever (ci/smoke.sh checks
+    this too); regenerating it instead of bumping VERSION is a format
+    break."""
+
+    def test_fixture_loads(self):
+        out = restore_checkpoint(_FIXTURE)
+        ref = _sample_entries()
+        assert set(out) == set(ref)
+        np.testing.assert_array_equal(out["centroids"], ref["centroids"])
+        np.testing.assert_array_equal(out["t"], ref["t"])
+        np.testing.assert_array_equal(
+            out["soft"].astype(np.float32),
+            ref["soft"].astype(np.float32))
+        assert out["n_iter"] == 17
+        assert out["rng"].type == GeneratorType.RBG

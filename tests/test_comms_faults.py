@@ -492,3 +492,31 @@ def test_tagstore_peer_failed_then_revived():
     assert st.peer_failed(3) is None
     st.deliver(3, 0, 0, "x")
     assert st.get(3, 0, 0, timeout=1.0) == "x"
+
+
+def test_comm_split_failed_peer_in_color_group_fast_fails(mesh8):
+    """A dead rank inside the caller's color group fails the split
+    immediately with the dead rank attached — not after the first child
+    collective hangs out its deadline (ISSUE 2 satellite)."""
+    box = _Mailbox()
+    box.fail_peer(1, "heartbeat silence")
+    comm = MeshComms(mesh8, rank=0, _mailbox=box)
+    color = [0, 0, 0, 0, 1, 1, 1, 1]
+    key = list(range(8))
+    t0 = time.monotonic()
+    with pytest.raises(PeerFailedError) as ei:
+        comm.comm_split(color, key)
+    assert time.monotonic() - t0 < 1.0      # fast-fail, no deadline wait
+    assert ei.value.rank == 1
+    assert "color group 0" in str(ei.value)
+
+
+def test_comm_split_failed_peer_in_other_color_is_ignored(mesh8):
+    """shrink() carves survivors AROUND the dead: a failure in the
+    discarded color group must not poison the surviving sub-clique."""
+    box = _Mailbox()
+    box.fail_peer(5, "connection reset")
+    comm = MeshComms(mesh8, rank=0, _mailbox=box)
+    sub = comm.comm_split([0, 0, 0, 0, 1, 1, 1, 1], list(range(8)))
+    assert sub.get_size() == 4
+    assert sub.get_rank() == 0

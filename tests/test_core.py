@@ -239,6 +239,33 @@ class TestSerialize:
         y = serialize.loads(data, to_device=False)
         np.testing.assert_array_equal(x, y)
 
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_dumps_loads_low_precision(self, dtype):
+        """bf16 rides the wire as a named one-field structured dtype
+        (np.save would otherwise degrade it to typeless '|V2' bytes) and
+        round-trips exactly; f32 stays a plain .npy."""
+        import ml_dtypes
+
+        dt = np.float32 if dtype == "float32" else ml_dtypes.bfloat16
+        x = (np.arange(20, dtype=np.float32).reshape(4, 5) / 3.0).astype(dt)
+        y = serialize.loads(serialize.dumps(x), to_device=False)
+        assert y.dtype == x.dtype
+        np.testing.assert_array_equal(y.astype(np.float32),
+                                      x.astype(np.float32))
+
+    def test_scalar_roundtrip_native_types(self, res):
+        """deserialize_scalar returns NATIVE python values (the ref's
+        deserialize_scalar<T> returns T): np.float64/np.int64 leaking
+        into params structs broke ==/is comparisons downstream."""
+        for val, want in ((3, int), (2.5, float), (True, bool),
+                         (np.int64(-7), int), (np.float32(1.5), float)):
+            buf = io.BytesIO()
+            serialize.serialize_scalar(res, buf, val)
+            buf.seek(0)
+            out = serialize.deserialize_scalar(res, buf)
+            assert out == val
+            assert type(out) is want, (val, type(out))
+
 
 class TestInterruptible:
     def test_cancel_raises_on_next_check(self):

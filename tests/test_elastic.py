@@ -1,0 +1,290 @@
+"""Elastic MNMG tests (ISSUE 2 tentpole): abort propagation, survivor
+consensus + shrink, and checkpoint/resume wired through the iterative
+solvers.
+
+Acceptance criteria exercised here:
+
+* a 4-rank ``kmeans_fit_mnmg`` with one fault-injected disconnected rank
+  completes on the 3 survivors from the last checkpoint, centroids
+  bit-for-bit equal to a fault-free run resumed from the same
+  checkpoint on a fresh 3-device mesh;
+* the same for ``eigsh_mnmg`` (bands REBUILT for the smaller device
+  count — n_local changes with the divisor);
+* a 4-process ``kmeans_fit_elastic`` clique with one rank SIGKILL'd
+  mid-iteration finishes on the 3 survivors, bit-for-bit equal to a
+  clean 3-process run resumed from the kill-boundary checkpoint
+  (tests/_elastic_worker.py);
+* ``abort()`` wakes a blocked peer recv well inside 2x the heartbeat
+  interval (the propagation contract: poison frames, not staggered
+  timeouts).
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.cluster.kmeans import (KMeansParams, kmeans_fit_elastic,
+                                     kmeans_fit_mnmg)
+from raft_tpu.comms.comms import MeshComms, _Mailbox
+from raft_tpu.comms.errors import CommsAbortedError, PeerFailedError
+from raft_tpu.comms.faults import FaultInjector
+from raft_tpu.core import resources as core_res
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _submesh(n):
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:n]), axis_names=("data",))
+
+
+def _blobs(seed=0, per=300, k=4, d=5):
+    rng = np.random.default_rng(seed)
+    return np.concatenate(
+        [rng.normal(c, 0.3, (per, d)) for c in range(k)]).astype(np.float32)
+
+
+class TestElasticKMeansMnmg:
+    def test_disconnect_recovers_bit_for_bit(self, tmp_path):
+        """Rank 2 disconnects at the first health probe; survivors agree,
+        shrink to 3 devices, reload the checkpoint and finish — equal to
+        a clean run resumed from that same checkpoint on a fresh
+        3-device mesh (device prefix == survivor mesh determinism)."""
+        x = _blobs()
+        params = KMeansParams(n_clusters=4, max_iter=30, tol=1e-6, seed=3,
+                              check_every=2)
+        d = str(tmp_path)
+
+        res = core_res.Resources()
+        core_res.set_mesh(res, _submesh(4))
+        inj = FaultInjector(seed=0, disconnect=1.0, source_ranks={2})
+        comms = MeshComms(_submesh(4), "data", 0,
+                          _mailbox=_Mailbox(faults=inj))
+        core_res.set_comms(res, comms)
+        c_f, in_f, _, it_f = kmeans_fit_mnmg(
+            res, params, x, mesh=_submesh(4), checkpoint_every=1,
+            checkpoint_dir=d, checkpoint_keep=50)
+        # the fit recovered: its handle now carries the survivor clique
+        assert core_res.get_comms(res).get_size() == 3
+        assert inj.counts["disconnect"] >= 1
+
+        first = sorted(f for f in os.listdir(d) if f.endswith(".ckpt"))[0]
+        res2 = core_res.Resources()
+        c_c, in_c, _, it_c = kmeans_fit_mnmg(
+            res2, params, x, mesh=_submesh(3),
+            resume_from=os.path.join(d, first))
+        assert np.array_equal(np.asarray(c_f), np.asarray(c_c))
+        assert it_f == it_c
+        assert float(in_f) == float(in_c)
+
+    def test_resume_requires_checkpoint(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            kmeans_fit_mnmg(core_res.Resources(),
+                            KMeansParams(n_clusters=2, max_iter=2),
+                            _blobs(per=20, k=2), mesh=_submesh(2),
+                            resume_from=str(tmp_path))
+
+    def test_checkpoint_every_requires_dir(self):
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            kmeans_fit_mnmg(core_res.Resources(),
+                            KMeansParams(n_clusters=2, max_iter=2),
+                            _blobs(per=20, k=2), mesh=_submesh(2),
+                            checkpoint_every=1)
+
+
+class TestElasticEigsh:
+    def test_disconnect_recovers_bit_for_bit(self, tmp_path):
+        """The eigsh recovery additionally re-pads: n_local = ceil(n/3)
+        differs from ceil(n/4), so the row bands and basis placement are
+        rebuilt from the unpadded checkpoint state."""
+        import scipy.sparse as sp
+
+        from raft_tpu.core.sparse_types import CSRMatrix
+        from raft_tpu.sparse.solver import eigsh_mnmg
+
+        n = 96
+        A = sp.random(n, n, density=0.08, random_state=2, format="csr",
+                      dtype=np.float64)
+        A = ((A + A.T) * 0.5).astype(np.float32)
+        csr = CSRMatrix.from_scipy(A)
+        d = str(tmp_path)
+
+        inj = FaultInjector(seed=0, disconnect=1.0, source_ranks={2})
+        comms = MeshComms(_submesh(4), "data", 0,
+                          _mailbox=_Mailbox(faults=inj))
+        w_f, v_f = eigsh_mnmg(csr, k=4, mesh=_submesh(4), which="SA",
+                              maxiter=50, tol=1e-6, comms=comms,
+                              checkpoint_every=1, checkpoint_dir=d,
+                              checkpoint_keep=50)
+        assert inj.counts["disconnect"] >= 1
+
+        first = sorted(f for f in os.listdir(d) if f.endswith(".ckpt"))[0]
+        w_c, v_c = eigsh_mnmg(csr, k=4, mesh=_submesh(3), which="SA",
+                              maxiter=50, tol=1e-6,
+                              resume_from=os.path.join(d, first))
+        assert np.array_equal(np.asarray(w_f), np.asarray(w_c))
+        assert np.array_equal(np.asarray(v_f), np.asarray(v_c))
+
+        from scipy.sparse.linalg import eigsh as scipy_eigsh
+
+        ws = scipy_eigsh(A.astype(np.float64), k=4, which="SA")[0]
+        np.testing.assert_allclose(np.sort(np.asarray(w_f)), np.sort(ws),
+                                   atol=1e-4)
+
+
+class TestAbortPropagation:
+    def test_abort_wakes_blocked_recv_within_two_heartbeats(self):
+        """A rank blocked in a long recv learns of a remote abort within
+        2x the heartbeat interval — propagation, not timeout expiry."""
+        from raft_tpu.comms.tcp_mailbox import TcpMailbox
+
+        hb = 0.5
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+        b0 = TcpMailbox(0, addrs, heartbeat_interval=hb)
+        b1 = TcpMailbox(1, addrs, heartbeat_interval=hb)
+        try:
+            woke = {}
+
+            def blocked():
+                t0 = time.monotonic()
+                try:
+                    b0.get(1, 0, 7, timeout=30.0)
+                except CommsAbortedError as e:
+                    woke["dt"] = time.monotonic() - t0
+                    woke["err"] = e
+
+            th = threading.Thread(target=blocked)
+            th.start()
+            time.sleep(0.2)                  # let the recv block
+            t_abort = time.monotonic()
+            b1.abort("solver rank died")
+            th.join(timeout=5.0)
+            assert not th.is_alive()
+            assert "solver rank died" in str(woke["err"])
+            assert time.monotonic() - t_abort < 2 * hb
+        finally:
+            b0.close()
+            b1.close()
+
+
+class TestHostElasticKMeans:
+    def test_threaded_ranks_agree(self):
+        """Three in-process rank views over one shared mailbox run the
+        host-driven Lloyd in lock step and return identical results
+        (the deterministic-reduction contract host_allreduce makes)."""
+        x = _blobs(seed=7, per=200, k=5, d=6)
+        params = KMeansParams(n_clusters=5, max_iter=12, tol=1e-12,
+                              seed=11)
+        mesh = _submesh(3)
+        box = _Mailbox()
+        results = {}
+
+        def run(r):
+            comms = MeshComms(mesh, "data", r, _mailbox=box)
+            results[r] = kmeans_fit_elastic(comms, params, x)
+
+        ths = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=60)
+        assert len(results) == 3
+        c0, i0, n0, _ = results[0]
+        for r in (1, 2):
+            cr, ir, nr, _ = results[r]
+            assert np.array_equal(c0, cr)
+            assert (i0, n0) == (ir, nr)
+        assert 0 < n0 <= params.max_iter
+
+    def test_checkpoint_every_requires_dir(self):
+        comms = MeshComms(_submesh(1), "data", 0, _mailbox=_Mailbox())
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            kmeans_fit_elastic(comms, KMeansParams(n_clusters=2),
+                               _blobs(per=10, k=2), checkpoint_every=1)
+
+
+_OK_RE = (r"ELASTIC_WORKER_OK rank=\d+ size=(\d+) n_iter=(\d+) "
+          r"inertia=(\S+) crc=(\d+)")
+
+
+class TestMultiprocessSigkill:
+    def test_sigkilled_rank_survived_bit_for_bit(self, tmp_path):
+        """The headline acceptance run: 4 real processes, rank 2
+        SIGKILLs itself mid-iteration, the 3 survivors detect → abort →
+        agree → shrink → resume from the kill-boundary checkpoint and
+        finish; a clean 3-process run resumed from that same checkpoint
+        reproduces the centroids bit-for-bit."""
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        worker = os.path.join(_REPO, "tests", "_elastic_worker.py")
+        d = str(tmp_path)
+
+        def launch(nproc, mode):
+            addrs = [f"127.0.0.1:{p}" for p in _free_ports(nproc)]
+            procs = [subprocess.Popen(
+                [sys.executable, worker, str(r), d, mode] + addrs,
+                cwd=_REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+                for r in range(nproc)]
+            outs = []
+            try:
+                for p in procs:
+                    outs.append(p.communicate(timeout=180)[0])
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+            return procs, outs
+
+        procs, outs = launch(4, "faulted")
+        assert procs[2].returncode == -9, outs[2]   # actually SIGKILLed
+        assert "ELASTIC_WORKER_SUICIDE" in outs[2]
+        results = set()
+        for r in (0, 1, 3):
+            assert procs[r].returncode == 0, \
+                f"survivor {r} failed:\n{outs[r]}"
+            m = re.search(_OK_RE, outs[r])
+            assert m, outs[r]
+            assert m.group(1) == "3"                # finished on 3 ranks
+            results.add(m.groups()[1:])
+        assert len(results) == 1                    # survivors agree
+
+        # the kill fires at iteration 4 of the faulted run, AFTER the
+        # update but before rank 0's boundary save/probe — so the newest
+        # complete checkpoint every survivor resumed from is step 4
+        from tests._elastic_worker import KILL_AT
+
+        resume = os.path.join(d, f"kmeans_host-{KILL_AT:08d}.ckpt")
+        assert os.path.exists(resume), sorted(os.listdir(d))
+
+        procs, outs = launch(3, f"clean:{resume}")
+        clean = set()
+        for r in range(3):
+            assert procs[r].returncode == 0, outs[r]
+            m = re.search(_OK_RE, outs[r])
+            assert m, outs[r]
+            clean.add(m.groups()[1:])
+        assert clean == results                     # bit-for-bit
