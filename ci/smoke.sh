@@ -49,7 +49,47 @@ print("\n".join(bad) if bad else "comms error-hygiene lint: clean")
 sys.exit(1 if bad else 0)
 PYEOF
 
+# Numeric error-hygiene lint (ISSUE 3, the solver-layer mirror of the
+# comms lint above): in linalg/ and sparse/solver/, reject blanket
+# handlers and UNANNOTATED breakdown sites — a sqrt or norm-divide whose
+# operand sign/zero is not visibly handled (maximum/abs/clip/eps floor)
+# must either grow a guard or carry a `# guarded:` comment naming why it
+# cannot go negative/zero.
+python - <<'PYEOF'
+import pathlib, re, sys
+GUARD_TOKENS = ("maximum", "abs", "clip", "eps", "finfo", "1.0 +",
+                "guarded:")
+bad = []
+files = sorted(pathlib.Path("raft_tpu/linalg").glob("*.py")) + \
+    sorted(pathlib.Path("raft_tpu/sparse/solver").glob("*.py"))
+for p in files:
+    lines = p.read_text().splitlines()
+    for i, line in enumerate(lines, 1):
+        if re.search(r"except\s+Exception\b", line):
+            bad.append(f"{p}:{i}: bare 'except Exception' (catch typed "
+                       "NumericalError kinds from core/guards.py)")
+        # sqrt of a quantity that can silently go negative: require a
+        # guard token on the line or an explanatory `# guarded:` comment
+        if "jnp.sqrt(" in line and not any(t in line for t in GUARD_TOKENS):
+            bad.append(f"{p}:{i}: unguarded jnp.sqrt — clamp the operand "
+                       "(jnp.maximum(x, 0)) or annotate '# guarded: <why>'")
+        # division by a computed norm: zero vectors divide to NaN/inf
+        if re.search(r"/\s*jnp\.linalg\.norm\(", line) and \
+                not any(t in line for t in GUARD_TOKENS):
+            bad.append(f"{p}:{i}: unguarded divide by jnp.linalg.norm — "
+                       "floor it or annotate '# guarded: <why>'")
+print("\n".join(bad) if bad else "numeric error-hygiene lint: clean")
+sys.exit(1 if bad else 0)
+PYEOF
+
 python -m pytest tests/ -x -q
+
+# Guard-mode gate (ISSUE 3): the solver tests must also pass with the
+# numerical sentinels ARMED — 'check' raising on any non-finite value a
+# solver manufactures internally is exactly the regression this catches.
+RAFT_TPU_GUARD_MODE=check JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_guards.py tests/test_linalg.py \
+    tests/test_solvers_label_spectral.py -q
 
 # Chaos smoke: the comms fault-injection suite on the CPU backend —
 # deterministic fault schedules, typed errors, fast dead-peer detection.

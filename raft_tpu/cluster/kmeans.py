@@ -344,9 +344,32 @@ def _init_centroids(params: KMeansParams, state: RngState, x,
 
 
 @with_matmul_precision
+def _finish_report(converged: bool, n_iter: int, rel_change: float,
+                   params: KMeansParams, strict: bool, op: str):
+    """Shared convergence-report epilogue for the Lloyd fits: build the
+    uniform :class:`~raft_tpu.core.guards.ConvergenceReport`, raise under
+    ``strict`` or warn (matching the solver-layer contract of ISSUE 3)."""
+    from raft_tpu.core.guards import ConvergenceError, ConvergenceReport
+
+    report = ConvergenceReport(converged=converged, n_iter=int(n_iter),
+                               residual=float(rel_change),
+                               tol=float(params.tol))
+    if not converged:
+        if strict:
+            raise ConvergenceError(
+                f"{op}: inertia change {rel_change:.3e} still above tol "
+                f"{params.tol:.3e} after max_iter={params.max_iter} "
+                "Lloyd iterations (strict=True)", report=report, op=op)
+        logger.warn("%s: not converged after %d iterations (relative "
+                    "inertia change %.3e > tol %.3e)", op, n_iter,
+                    rel_change, params.tol)
+    return report
+
+
 def kmeans_fit(res, params: KMeansParams, x,
                centroids: Optional[jnp.ndarray] = None,
-               sample_weights=None
+               sample_weights=None, strict: bool = False,
+               return_report: bool = False
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
     """Lloyd's algorithm. Returns (centroids, inertia, labels, n_iter).
 
@@ -356,6 +379,12 @@ def kmeans_fit(res, params: KMeansParams, x,
     ``sample_weights`` [m] (ref/cuVS parity: fit's ``sample_weight``):
     points contribute proportionally to the centroid update and the
     inertia; None (the default) is the unweighted fused-kernel hot path.
+
+    Numerical guardrails (ISSUE 3): ``strict=True`` raises
+    :class:`~raft_tpu.core.guards.ConvergenceError` when ``max_iter``
+    elapses without the inertia stabilizing below ``tol``;
+    ``return_report=True`` appends the
+    :class:`~raft_tpu.core.guards.ConvergenceReport` to the return tuple.
 
     >>> import numpy as np
     >>> from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
@@ -370,7 +399,11 @@ def kmeans_fit(res, params: KMeansParams, x,
     """
     import numpy as np
 
+    from raft_tpu.util.input_validation import expect_2d, expect_finite
+
     x = jnp.asarray(x)
+    expect_2d(x, name="kmeans_fit: x")
+    expect_finite(x, name="kmeans_fit: x")
     w = None if sample_weights is None else jnp.asarray(sample_weights)
     if w is not None:
         _validate_sample_weights(w, x.shape[0])
@@ -381,6 +414,8 @@ def kmeans_fit(res, params: KMeansParams, x,
     labels = None
     check = max(1, int(params.check_every))
     inertia = jnp.asarray(jnp.inf, x.dtype)
+    converged = False
+    rel_change = float("inf")
     # Hoist the loop-invariant X operand work (tier-'high' split + norms)
     # out of the Lloyd loop; (None, None) when the prepared path doesn't
     # apply and the plain step is used unchanged.
@@ -399,10 +434,12 @@ def kmeans_fit(res, params: KMeansParams, x,
             c, inertia, labels = lloyd_iterate_prepared(
                 ops, c, block, **meta)
             n_iter += block
-            if prev_inertia is not None and \
-                    abs(prev_inertia - float(inertia)) <= \
-                    params.tol * max(prev_inertia, 1e-30):
-                break
+            if prev_inertia is not None:
+                rel_change = abs(prev_inertia - float(inertia)) / \
+                    max(prev_inertia, 1e-30)
+                if rel_change <= params.tol:
+                    converged = True
+                    break
             prev_inertia = float(inertia)
     else:
         for n_iter in range(1, params.max_iter + 1):
@@ -413,10 +450,12 @@ def kmeans_fit(res, params: KMeansParams, x,
                     x, w, c, params.n_clusters)
             if n_iter % check and n_iter != params.max_iter:
                 continue                 # no host sync between polls
-            if prev_inertia is not None and \
-                    abs(prev_inertia - float(inertia)) <= \
-                    params.tol * max(prev_inertia, 1e-30):
-                break
+            if prev_inertia is not None:
+                rel_change = abs(prev_inertia - float(inertia)) / \
+                    max(prev_inertia, 1e-30)
+                if rel_change <= params.tol:
+                    converged = True
+                    break
             prev_inertia = float(inertia)
     # lloyd_step's labels/inertia are measured against its *input* centroids;
     # re-assign ONCE so the returned triple is self-consistent (one pass
@@ -424,6 +463,10 @@ def kmeans_fit(res, params: KMeansParams, x,
     dist, labels = _assign(x, c)
     inertia = jnp.sum(dist) if w is None \
         else jnp.sum(dist * w.astype(dist.dtype))
+    report = _finish_report(converged, n_iter, rel_change, params, strict,
+                            op="cluster.kmeans_fit")
+    if return_report:
+        return c, inertia, labels, n_iter, report
     return c, inertia, labels, n_iter
 
 
@@ -446,10 +489,11 @@ def kmeans_transform(res, x, centroids):
 @with_matmul_precision
 def kmeans_fit_predict(res, params: KMeansParams, x,
                        centroids: Optional[jnp.ndarray] = None,
-                       sample_weights=None):
-    c, inertia, labels, n_iter = kmeans_fit(
-        res, params, x, centroids, sample_weights=sample_weights)
-    return c, inertia, labels, n_iter
+                       sample_weights=None, strict: bool = False,
+                       return_report: bool = False):
+    return kmeans_fit(
+        res, params, x, centroids, sample_weights=sample_weights,
+        strict=strict, return_report=return_report)
 
 
 @with_matmul_precision
@@ -540,7 +584,9 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
                     checkpoint_every: Optional[int] = None,
                     checkpoint_dir: Optional[str] = None,
                     checkpoint_keep: int = 2,
-                    resume_from: Optional[str] = None):
+                    resume_from: Optional[str] = None,
+                    strict: bool = False,
+                    return_report: bool = False):
     """MNMG Lloyd over a row-partitioned dataset (ref workload: raft-dask
     MNMG k-means; BASELINE config 5).
 
@@ -572,10 +618,13 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
     from raft_tpu.core import checkpoint as core_ckpt
     from raft_tpu.core import resources as core_res
     from raft_tpu.comms.errors import CommsAbortedError, PeerFailedError
+    from raft_tpu.util.input_validation import expect_2d, expect_finite
 
     import numpy as np
 
     x = jnp.asarray(x)
+    expect_2d(x, name="kmeans_fit_mnmg: x")
+    expect_finite(x, name="kmeans_fit_mnmg: x")
     w = None if sample_weights is None else jnp.asarray(sample_weights)
     if w is not None:
         _validate_sample_weights(w, x.shape[0])
@@ -667,6 +716,8 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
                    else check * max(1, int(checkpoint_every)))
     inertia = jnp.asarray(0.0)
     labels = None
+    converged = False
+    rel_change = float("inf")
     while n_iter < params.max_iter:
         try:
             converged = False
@@ -688,10 +739,12 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
                     })
                 if comms is not None:
                     comms.ensure_healthy()
-                if prev is not None and abs(prev - float(inertia)) <= \
-                        params.tol * max(prev, 1e-30):
-                    converged = True
-                    break
+                if prev is not None:
+                    rel_change = abs(prev - float(inertia)) / \
+                        max(prev, 1e-30)
+                    if rel_change <= params.tol:
+                        converged = True
+                        break
                 prev = float(inertia)
             if converged or n_iter >= params.max_iter:
                 break
@@ -721,6 +774,10 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
     # one more step gives labels + inertia vs c (its centroid update is
     # discarded) — works identically on 1-D and 2-D meshes
     _, inertia, labels = run(c)
+    report = _finish_report(converged, n_iter, rel_change, params, strict,
+                            op="cluster.kmeans_fit_mnmg")
+    if return_report:
+        return c, inertia, labels, n_iter, report
     return c, inertia, labels, n_iter
 
 

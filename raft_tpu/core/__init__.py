@@ -53,6 +53,18 @@ from raft_tpu.core.interruptible import (  # noqa: F401
     CancelToken,
     synchronize,
 )
+from raft_tpu.core.guards import (  # noqa: F401
+    NumericalError,
+    NonFiniteError,
+    IllConditionedError,
+    ConvergenceError,
+    ConvergenceReport,
+    ArtifactCorruptError,
+    guard_mode,
+    set_guard_mode,
+    guard_scope,
+    finite_sentinel,
+)
 from raft_tpu.core import operators  # noqa: F401
 from raft_tpu.core import serialize  # noqa: F401
 from raft_tpu.core import trace  # noqa: F401

@@ -1,0 +1,318 @@
+"""Numerical guardrails: typed failure taxonomy, in-graph sentinels, and
+precision-escalation recovery (the numeric mirror of ``comms/errors.py`` +
+``comms/resilience.py``; ref: core/error.hpp ``RAFT_EXPECTS``/``status_t``
+and the cuSOLVER ``info`` out-parameter contract).
+
+The reference fails loudly: every entry point validates through
+RAFT_EXPECTS and every cuSOLVER factorization returns an ``info`` code the
+wrappers check. Our compute path inherited neither — a non-PSD Cholesky
+update produced silent NaN, an unconverged Lanczos solve produced a
+``logger.warn``. This module gives the numeric layer the same discipline
+the comms layer got: a typed taxonomy, cheap in-graph sentinels at output
+boundaries, and a recovery choreography that re-runs a failing step one
+tier up the precision ladder (``util/numerics.py``).
+
+Taxonomy (every type a ``RuntimeError`` so pre-taxonomy ``except
+RuntimeError`` callers keep working):
+
+==========================  =============================================
+type                        meaning / reference analogue
+==========================  =============================================
+``NumericalError``          base of the numeric taxonomy
+``NonFiniteError``          NaN/Inf crossed an output (or entered an
+                            input) boundary — cuSOLVER ``info > 0`` class
+``IllConditionedError``     a factorization breakdown attributable to
+                            conditioning (negative Cholesky pivot, zero
+                            norm) — ``potrf`` ``info > 0``
+``ConvergenceError``        an iterative solver exhausted its budget;
+                            carries a :class:`ConvergenceReport`
+                            (``syevj``/``gesvdj`` ``info = n+1`` class)
+``ArtifactCorruptError``    a persisted compiled artifact failed its
+                            integrity check (truncation, bit rot)
+==========================  =============================================
+
+Guard modes (env ``RAFT_TPU_GUARD_MODE``, :func:`set_guard_mode`,
+:func:`guard_scope`, or a per-call ``guard_mode=`` override):
+
+``off``      hot path pays nothing; outputs bit-identical to the
+             unguarded library (NaN propagates, as today).
+``check``    cheap sentinels at output-transfer boundaries — one fused
+             ``isfinite(...).all()`` reduction folded into work the op
+             already does, fetched as a single scalar; failures raise
+             typed errors.
+``recover``  ``check`` + on a non-finite output or factorization
+             breakdown, the failing step is re-run one tier up the
+             precision ladder (bf16 → f32 → f64-emulated-on-host),
+             logging a ``guards.escalate`` trace event; the error is
+             raised only if the top of the ladder still fails.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import logger, trace
+
+__all__ = [
+    "NumericalError", "NonFiniteError", "IllConditionedError",
+    "ConvergenceError", "ArtifactCorruptError", "ConvergenceReport",
+    "guard_mode", "set_guard_mode", "guard_scope", "resolve_guard_mode",
+    "finite_sentinel", "check_finite", "guard_output",
+]
+
+GUARD_MODES = ("off", "check", "recover")
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+class NumericalError(RuntimeError):
+    """Base numeric failure (the ``RAFT_FAIL`` of the solver layer).
+
+    Parameters
+    ----------
+    message : human-readable description (always names the operation).
+    op : dotted name of the operation that observed the failure, when
+        known (e.g. ``"linalg.cholesky_r1_update"``).
+    """
+
+    def __init__(self, message: str, *, op: Optional[str] = None):
+        super().__init__(message)
+        self.op = op
+
+
+class NonFiniteError(NumericalError):
+    """NaN or Inf crossed a guarded boundary.
+
+    ``stage`` attributes the failure: ``"input"`` means the caller handed
+    the op poisoned data (garbage-in — escalation cannot help and is not
+    attempted); ``"output"`` means the op manufactured the non-finite
+    values from finite inputs (overflow/cancellation — the escalation
+    ladder's case)."""
+
+    def __init__(self, message: str, *, op: Optional[str] = None,
+                 stage: str = "output"):
+        super().__init__(message, op=op)
+        self.stage = stage
+
+
+class IllConditionedError(NumericalError):
+    """A direct factorization broke down in a way attributable to the
+    conditioning of the input (negative Cholesky pivot on a non-PSD
+    update, zero starting vector) — the ``potrf info > 0`` class."""
+
+
+@dataclasses.dataclass
+class ConvergenceReport:
+    """Uniform iterative-solver outcome (the typed replacement for the
+    scattered ``logger.warn`` + positional ``n_iter`` returns).
+
+    residual is the solver's own convergence measure: max Ritz residual
+    for Lanczos, relative inertia change for k-means, off-diagonal
+    Frobenius ratio for Jacobi sweeps, unassigned-lane count for LAP.
+    ``escalated`` marks a result produced by precision-escalation
+    recovery; ``breakdowns`` counts classified breakdown events the
+    solver recovered from internally (Lanczos β≈0 restarts)."""
+
+    converged: bool
+    n_iter: int
+    residual: float
+    tol: float
+    escalated: bool = False
+    breakdowns: int = 0
+    detail: str = ""
+
+
+class ConvergenceError(NumericalError):
+    """An iterative solver exhausted its budget under ``strict=True``.
+
+    Carries the full :class:`ConvergenceReport` as ``.report`` — the
+    caller that catches it still gets the diagnostic the warn-and-return
+    contract used to bury in the log."""
+
+    def __init__(self, message: str, *,
+                 report: Optional[ConvergenceReport] = None,
+                 op: Optional[str] = None):
+        super().__init__(message, op=op)
+        self.report = report
+
+
+class ArtifactCorruptError(RuntimeError):
+    """A persisted compiled artifact failed its integrity check (sha256
+    mismatch, truncation, or a deserialize failure). ``.path`` names the
+    artifact on disk."""
+
+    def __init__(self, message: str, *, path: Optional[str] = None):
+        super().__init__(message)
+        self.path = path
+
+
+# ---------------------------------------------------------------------------
+# guard-mode knob
+# ---------------------------------------------------------------------------
+
+_env_mode = os.environ.get("RAFT_TPU_GUARD_MODE", "off").lower()
+if _env_mode not in GUARD_MODES:
+    import warnings
+
+    warnings.warn(
+        f"RAFT_TPU_GUARD_MODE={_env_mode!r} is not one of {GUARD_MODES}; "
+        "using 'off'", stacklevel=2)
+    _env_mode = "off"
+
+_mode = _env_mode
+_tls = threading.local()
+
+
+def _scope_stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def guard_mode() -> str:
+    """The effective guard mode: innermost :func:`guard_scope` override
+    if any, else the process-wide setting."""
+    st = _scope_stack()
+    return st[-1] if st else _mode
+
+
+def set_guard_mode(mode: str) -> None:
+    """Set the process-wide guard mode ('off' | 'check' | 'recover')."""
+    global _mode
+    mode = str(mode).lower()
+    if mode not in GUARD_MODES:
+        raise ValueError(
+            f"unknown guard mode {mode!r}; want one of {GUARD_MODES}")
+    _mode = mode
+
+
+@contextlib.contextmanager
+def guard_scope(mode: str):
+    """Thread-local guard-mode override for a region (the per-call
+    analogue of a ``RAFT_EXPECTS``-compiled-out build)."""
+    mode = str(mode).lower()
+    if mode not in GUARD_MODES:
+        raise ValueError(
+            f"unknown guard mode {mode!r}; want one of {GUARD_MODES}")
+    _scope_stack().append(mode)
+    try:
+        yield
+    finally:
+        _scope_stack().pop()
+
+
+def resolve_guard_mode(override: Optional[str] = None) -> str:
+    """Per-call override resolution: an explicit ``guard_mode=`` argument
+    wins; None defers to :func:`guard_mode`."""
+    if override is None:
+        return guard_mode()
+    override = str(override).lower()
+    if override not in GUARD_MODES:
+        raise ValueError(
+            f"unknown guard mode {override!r}; want one of {GUARD_MODES}")
+    return override
+
+
+# ---------------------------------------------------------------------------
+# in-graph sentinels
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _all_finite(a) -> jnp.ndarray:
+    # jitted so the isfinite map and the all() reduce fuse into a single
+    # pass with no materialized boolean intermediate
+    return jnp.isfinite(a).all()
+
+
+def finite_sentinel(*arrays) -> jnp.ndarray:
+    """One fused all-finite reduction over the given arrays.
+
+    Stays IN the graph — a scalar ``jnp.isfinite(...).all()`` folded into
+    the op's existing output transfer, not a separate device pass; the
+    host fetches one bool alongside data it was fetching anyway. Integer
+    and bool arrays are finite by construction and contribute nothing."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        a = jnp.asarray(a)
+        if jnp.issubdtype(a.dtype, jnp.inexact):
+            ok = ok & _all_finite(a)
+    return ok
+
+
+def _has_tracer(arrays) -> bool:
+    return any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def check_finite(op: str, *arrays, mode: Optional[str] = None,
+                 stage: str = "input") -> None:
+    """Host-side finite check at a guarded boundary.
+
+    No-op under ``off`` or inside a jit trace (abstract values carry no
+    data; guarded entry points are host-driven). Raises
+    :class:`NonFiniteError` naming ``op`` otherwise."""
+    mode = resolve_guard_mode(mode)
+    if mode == "off" or _has_tracer(arrays):
+        return
+    if not bool(finite_sentinel(*arrays)):
+        raise NonFiniteError(
+            f"{op}: non-finite values detected at the {stage} boundary "
+            f"(guard_mode={mode!r}; run with guard_mode='off' to restore "
+            "silent NaN propagation)", op=op, stage=stage)
+
+
+def guard_output(op: str, out, *, inputs=(), recover=None,
+                 mode: Optional[str] = None):
+    """The sentinel choreography at an output-transfer boundary.
+
+    Under ``off`` (or inside a jit trace) returns ``out`` untouched —
+    bit-identical, zero added work. Under ``check``/``recover`` fetches
+    the fused finite sentinel; on failure it first attributes the fault
+    (poisoned ``inputs`` raise ``stage='input'`` — escalation cannot fix
+    garbage-in), then, in ``recover`` mode with a ``recover`` thunk, logs
+    a ``guards.escalate`` trace event and returns the re-run's output if
+    the retry is finite. Raises :class:`NonFiniteError` otherwise."""
+    mode = resolve_guard_mode(mode)
+    if mode == "off":
+        return out
+    leaves = [x for x in jax.tree_util.tree_leaves(out)
+              if hasattr(x, "dtype")]
+    if _has_tracer(leaves):
+        return out
+    if bool(finite_sentinel(*leaves)):
+        return out
+    in_leaves = [x for x in jax.tree_util.tree_leaves(tuple(inputs))
+                 if hasattr(x, "dtype")]
+    if in_leaves and not _has_tracer(in_leaves) \
+            and not bool(finite_sentinel(*in_leaves)):
+        raise NonFiniteError(
+            f"{op}: non-finite values in the INPUT operands "
+            f"(guard_mode={mode!r}) — the output is poisoned by "
+            "garbage-in; precision escalation is not attempted",
+            op=op, stage="input")
+    if mode == "recover" and recover is not None:
+        trace.record_event("guards.escalate", op=op)
+        logger.warn(
+            "%s: non-finite output with finite inputs; re-running one "
+            "tier up the precision ladder (guard_mode='recover')", op)
+        out2 = recover()
+        leaves2 = [x for x in jax.tree_util.tree_leaves(out2)
+                   if hasattr(x, "dtype")]
+        if not _has_tracer(leaves2) and bool(finite_sentinel(*leaves2)):
+            return out2
+        raise NonFiniteError(
+            f"{op}: output still non-finite after precision escalation "
+            "(top of the ladder reached)", op=op, stage="output")
+    raise NonFiniteError(
+        f"{op}: non-finite values in the output (guard_mode={mode!r}; "
+        "inputs were finite — likely overflow or catastrophic "
+        "cancellation; guard_mode='recover' re-runs at higher precision)",
+        op=op, stage="output")

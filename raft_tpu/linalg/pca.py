@@ -63,7 +63,13 @@ def pca_fit(res, X, n_components: int,
     Returns components as rows, explained variance (unbiased, n-1 divisor),
     singular values and the column mean — matching the reference's outputs.
     """
+    from raft_tpu.util.input_validation import (expect_2d, expect_finite,
+                                                expect_positive)
+
     X = jnp.asarray(X)
+    expect_2d(X, name="pca_fit: X")
+    expect_positive(n_components, name="pca_fit: n_components")
+    expect_finite(X, name="pca_fit: X")
     n_rows, n_cols = X.shape
     mu = jnp.mean(X, axis=0)
     Xc = X - mu[None, :]

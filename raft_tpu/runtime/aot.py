@@ -8,15 +8,24 @@ embedded calling conventions; `deserialize(...).call` runs it with no
 Python retracing. Artifacts are portable across processes and across
 compatible jax versions, and may target multiple platforms at once
 (`platforms=("tpu", "cpu")`).
+
+Integrity (ISSUE 3): :func:`save_computation` writes a ``<path>.sha256``
+sidecar next to the artifact; :func:`load_computation` verifies it when
+present and wraps truncation/bit-rot/deserialize failures in
+:class:`~raft_tpu.core.guards.ArtifactCorruptError` naming the path —
+a corrupt compiled program must never be half-loaded into the runtime.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Callable, Optional, Sequence
 
 import jax
 from jax import export as _jexport
+
+from raft_tpu.core.guards import ArtifactCorruptError
 
 
 def aot_export(fn: Callable, *example_args,
@@ -52,13 +61,53 @@ def deserialize_computation(blob: bytes) -> Callable:
     return exp.call
 
 
+def _sidecar(path: str) -> str:
+    return f"{path}.sha256"
+
+
 def save_computation(exported, path: str) -> None:
+    """Persist an Exported atomically (tmp + rename) with a sha256
+    sidecar for load-time integrity verification."""
+    blob = serialize_computation(exported)
+    digest = hashlib.sha256(blob).hexdigest()
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "wb") as f:
-        f.write(serialize_computation(exported))
+        f.write(blob)
     os.replace(tmp, path)
+    tmp = f"{_sidecar(path)}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{digest}\n")
+    os.replace(tmp, _sidecar(path))
 
 
 def load_computation(path: str) -> Callable:
+    """Load + verify a persisted computation.
+
+    Raises :class:`~raft_tpu.core.guards.ArtifactCorruptError` when the
+    sha256 sidecar (if present) does not match the artifact bytes, or
+    when deserialization rejects them (truncation, bit flips). Artifacts
+    saved without a sidecar (pre-guardrails) still load; the deserialize
+    failure wrapping applies either way."""
     with open(path, "rb") as f:
-        return deserialize_computation(f.read())
+        blob = f.read()
+    sidecar = _sidecar(path)
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            want = f.read().strip()
+        got = hashlib.sha256(blob).hexdigest()
+        if got != want:
+            raise ArtifactCorruptError(
+                f"compiled artifact {path!r} failed its sha256 integrity "
+                f"check (sidecar {sidecar!r}: expected {want}, got {got}) "
+                "— the file was truncated or corrupted on disk; re-export "
+                "the computation", path=path)
+    try:
+        return deserialize_computation(blob)
+    except ArtifactCorruptError:
+        raise
+    except Exception as e:
+        raise ArtifactCorruptError(
+            f"compiled artifact {path!r} failed to deserialize "
+            f"({type(e).__name__}: {e}); the file is corrupt or was "
+            "produced by an incompatible serialization version — "
+            "re-export the computation", path=path) from e

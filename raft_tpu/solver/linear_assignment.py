@@ -161,22 +161,45 @@ def _solve_batch(cost, eps_final, n_phases: int):
     return obj_of, person_of, prices
 
 
-def _solve_many(cost, eps_final: float):
-    """Driver: one `_solve_batch` launch + one host convergence check."""
+def _solve_many(cost, eps_final: float, strict: bool = True):
+    """Driver: one `_solve_batch` launch + one host convergence check.
+
+    Returns (obj_of, person_of, prices, report). The convergence failure
+    is a :class:`~raft_tpu.core.guards.ConvergenceError` (a
+    ``RuntimeError`` subclass, so pre-taxonomy callers keep working)
+    carrying the uniform report; ``strict=False`` downgrades it to a
+    warn, leaving the unassigned lanes at -1 for the caller to inspect.
+    """
+    from raft_tpu.core import logger
+    from raft_tpu.core.guards import ConvergenceError, ConvergenceReport
+
     n = cost.shape[1]
+    n_phases = _num_phases(cost.dtype)
     if n == 1:
         zero = jnp.zeros(cost.shape[:1] + (1,), jnp.int32)
-        return zero, zero, jnp.zeros_like(zero, cost.dtype)
+        return zero, zero, jnp.zeros_like(zero, cost.dtype), \
+            ConvergenceReport(converged=True, n_iter=0, residual=0.0,
+                              tol=float(eps_final))
     obj_of, person_of, prices = _solve_batch(
-        cost, jnp.asarray(eps_final, cost.dtype), _num_phases(cost.dtype))
-    if bool(jnp.any(obj_of < 0)):                      # the only host sync
+        cost, jnp.asarray(eps_final, cost.dtype), n_phases)
+    unassigned = jnp.any(obj_of < 0)
+    report = ConvergenceReport(converged=True, n_iter=n_phases,
+                               residual=0.0, tol=float(eps_final))
+    if bool(unassigned):                               # the only host sync
         bad = np.nonzero(np.asarray(jnp.any(obj_of < 0, axis=1)))[0]
-        raise RuntimeError(
-            "auction LAP did not converge for batch element(s) "
-            f"{bad.tolist()} (persons left unassigned after the final "
-            f"epsilon phase, eps_final={eps_final:g}); increase epsilon or "
-            "check the cost matrix for NaN/inf")
-    return obj_of, person_of, prices
+        report.converged = False
+        report.residual = float(len(bad))   # unassigned-lane count
+        report.detail = f"unconverged batch elements: {bad.tolist()}"
+        msg = ("auction LAP did not converge for batch element(s) "
+               f"{bad.tolist()} (persons left unassigned after the final "
+               f"epsilon phase, eps_final={eps_final:g}); increase epsilon "
+               "or check the cost matrix for NaN/inf")
+        if strict:
+            raise ConvergenceError(msg, report=report,
+                                   op="solver.linear_assignment")
+        logger.warn("solver.linear_assignment: %s (strict=False; "
+                    "unassigned lanes returned as -1)", msg)
+    return obj_of, person_of, prices, report
 
 
 class LinearAssignmentProblem:
@@ -191,16 +214,18 @@ class LinearAssignmentProblem:
     """
 
     def __init__(self, res, size: int, batchsize: int = 1,
-                 epsilon: float = 1e-6):
+                 epsilon: float = 1e-6, strict: bool = True):
         self._res = res
         self._size = size
         self._batch = batchsize
         self._eps = float(epsilon)
+        self._strict = bool(strict)
         self._row_assign = None
         self._col_assign = None
         self._row_duals = None
         self._col_duals = None
         self._costs = None
+        self._report = None
 
     def solve(self, cost_matrix):
         cost = jnp.asarray(cost_matrix)
@@ -210,8 +235,8 @@ class LinearAssignmentProblem:
             raise ValueError(
                 f"expected cost shape {(self._batch, self._size, self._size)}"
                 f", got {cost.shape}")
-        self._row_assign, self._col_assign, self._col_duals = _solve_many(
-            cost, self._eps)
+        (self._row_assign, self._col_assign, self._col_duals,
+         self._report) = _solve_many(cost, self._eps, strict=self._strict)
         # row duals: slack left to each person at final prices
         self._row_duals = jnp.max(-cost - self._col_duals[:, None, :],
                                   axis=2)
@@ -225,6 +250,12 @@ class LinearAssignmentProblem:
     @property
     def col_assignments(self):
         return self._col_assign
+
+    @property
+    def report(self):
+        """The :class:`~raft_tpu.core.guards.ConvergenceReport` of the
+        last :meth:`solve` (None before the first solve)."""
+        return self._report
 
     def get_primal_objective_value(self, batch_id: int = 0):
         """Sum of costs along the assignment
@@ -241,8 +272,15 @@ class LinearAssignmentProblem:
                  + jnp.sum(self._col_duals[batch_id]))
 
 
-def solve_linear_assignment(res, cost_matrix, epsilon: float = 1e-6):
+def solve_linear_assignment(res, cost_matrix, epsilon: float = 1e-6,
+                            strict: bool = True,
+                            return_report: bool = False):
     """Functional one-shot front-end: returns (row_assignment, total_cost).
+
+    ``strict=False`` downgrades a convergence failure from
+    :class:`~raft_tpu.core.guards.ConvergenceError` to a warn (unassigned
+    rows come back as -1); ``return_report=True`` appends the
+    :class:`~raft_tpu.core.guards.ConvergenceReport`.
 
     >>> import numpy as np
     >>> from raft_tpu.solver import solve_linear_assignment
@@ -256,10 +294,12 @@ def solve_linear_assignment(res, cost_matrix, epsilon: float = 1e-6):
     if squeeze:
         cost = cost[None]
     lap = LinearAssignmentProblem(res, cost.shape[1], cost.shape[0],
-                                  epsilon)
+                                  epsilon, strict=strict)
     rows, _ = lap.solve(cost)
     totals = jnp.sum(jnp.take_along_axis(cost, rows[:, :, None],
                                          axis=2)[:, :, 0], axis=1)
     if squeeze:
-        return rows[0], totals[0]
+        rows, totals = rows[0], totals[0]
+    if return_report:
+        return rows, totals, lap.report
     return rows, totals

@@ -107,7 +107,7 @@ def spmv_method(a=None, x=None) -> str:
     return method
 
 
-def spmv(a, x) -> jnp.ndarray:
+def spmv(a, x, guard_mode=None) -> jnp.ndarray:
     """y = A·x for sparse A (ref: sparse/linalg/spmv — cusparseSpMV wrapper
     in detail/cusparse_wrappers.h).
 
@@ -115,24 +115,46 @@ def spmv(a, x) -> jnp.ndarray:
     raft_tpu.sparse.grid_spmv; build with ``grid_spmv.prepare``), a
     CSRMatrix (gather + segment_sum; auto-upgraded to a fresh grid plan
     for large nnz on the compiled backend — prefer preparing once for
-    repeated products), or an ELLMatrix (dense row-slab reduction)."""
-    from raft_tpu.sparse.ell import ELLMatrix, spmv as ell_spmv
-    from raft_tpu.sparse.grid_spmv import GridSpMV
-    from raft_tpu.sparse.grid_spmv import spmv as grid_apply
+    repeated products), or an ELLMatrix (dense row-slab reduction).
 
-    if isinstance(a, GridSpMV):
-        return grid_apply(a, x)
-    if isinstance(a, ELLMatrix):
-        return ell_spmv(a, x)
-    method = spmv_method(a, x)
-    if method == "grid":
-        return grid_apply(_cached_plan(a), x)
-    if method == "ell":
-        from raft_tpu.sparse.ell import from_csr
+    ``guard_mode`` overrides the numeric guard (core/guards.py): under
+    ``check``/``recover`` a fused finite sentinel rides the product and
+    a non-finite result with finite operands raises
+    :class:`~raft_tpu.core.guards.NonFiniteError` (``recover`` retries
+    one matmul tier up first). ``off`` (default) adds nothing."""
 
-        return ell_spmv(from_csr(a), x)
-    return _segment_spmv(a.row_ids(), a.indices, a.data, x, a.n_rows,
-                         limit=a.indptr[-1])
+    def compute():
+        from raft_tpu.sparse.ell import ELLMatrix, spmv as ell_spmv
+        from raft_tpu.sparse.grid_spmv import GridSpMV
+        from raft_tpu.sparse.grid_spmv import spmv as grid_apply
+
+        if isinstance(a, GridSpMV):
+            return grid_apply(a, x)
+        if isinstance(a, ELLMatrix):
+            return ell_spmv(a, x)
+        method = spmv_method(a, x)
+        if method == "grid":
+            return grid_apply(_cached_plan(a), x)
+        if method == "ell":
+            from raft_tpu.sparse.ell import from_csr
+
+            return ell_spmv(from_csr(a), x)
+        return _segment_spmv(a.row_ids(), a.indices, a.data, x, a.n_rows,
+                             limit=a.indptr[-1])
+
+    out = compute()
+    from raft_tpu.core.guards import guard_output, resolve_guard_mode
+
+    if resolve_guard_mode(guard_mode) == "off":
+        return out
+    from raft_tpu.util.numerics import matmul_escalation
+
+    vals = getattr(a, "data", None)
+    inputs = (x,) if vals is None else (vals, x)
+    return guard_output("sparse.linalg.spmv", out, inputs=inputs,
+                        recover=matmul_escalation(compute,
+                                                  op="sparse.linalg.spmv"),
+                        mode=guard_mode)
 
 
 def _cached_plan(a):

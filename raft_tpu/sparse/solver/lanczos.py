@@ -27,6 +27,8 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core import logger, trace
+from raft_tpu.core.guards import (ConvergenceError, ConvergenceReport,
+                                  IllConditionedError, resolve_guard_mode)
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.sparse import convert
 from raft_tpu.sparse.linalg import _segment_spmv as _spmv_kernel
@@ -35,13 +37,18 @@ from raft_tpu.util.precision import with_matmul_precision
 
 @dataclasses.dataclass
 class LanczosConfig:
-    """ref: lanczos_types.hpp:20-50 `lanczos_solver_config`."""
+    """ref: lanczos_types.hpp:20-50 `lanczos_solver_config`.
+
+    ``strict`` upgrades the exhausted-budget warn-and-return to a typed
+    :class:`~raft_tpu.core.guards.ConvergenceError` carrying the full
+    :class:`~raft_tpu.core.guards.ConvergenceReport`."""
     n_components: int
     max_iterations: int = 1000
     ncv: int = 0          # 0 → min(n, max(2*k + 1, 20))
     tolerance: float = 1e-7
     which: str = "SA"     # LA | LM | SA | SM
     seed: int = 42
+    strict: bool = False
 
 
 @jax.jit
@@ -147,31 +154,59 @@ def _extend_device(m1, m2, m3, basis, v, key,
 @with_matmul_precision
 def lanczos_compute_eigenpairs(res, a, config: LanczosConfig,
                                v0: Optional[jnp.ndarray] = None,
-                               rank1=None) -> Tuple[jnp.ndarray,
-                                                    jnp.ndarray]:
+                               rank1=None,
+                               return_report: bool = False
+                               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Compute k eigenpairs of symmetric sparse A
     (ref: sparse/solver/lanczos.cuh:34-86, CSR/COO overloads).
 
     ``rank1`` = (u, w, alpha): solve for A + alpha·u·wᵀ instead, applied
     matrix-free inside the device loop (the modularity matrix's form).
 
-    Returns (eigenvalues [k], eigenvectors [n, k]) sorted per `which`."""
+    Returns (eigenvalues [k], eigenvectors [n, k]) sorted per `which`;
+    with ``return_report=True`` a third element, the
+    :class:`~raft_tpu.core.guards.ConvergenceReport` (converged, n_iter,
+    max Ritz residual, β≈0 breakdown-restart count)."""
     if isinstance(a, COOMatrix):
         from raft_tpu.sparse import op as sparse_op
         a = convert.sorted_coo_to_csr(sparse_op.coo_sort(a))
     # dense symmetric operators ride the same restart loop (eig_sel path)
-    return _eigsh_csr(a, config, v0, rank1=rank1)
+    w, v, report = _eigsh_csr(a, config, v0, rank1=rank1)
+    if return_report:
+        return w, v, report
+    return w, v
 
 
 @with_matmul_precision
 def eigsh(a, k: int = 6, which: str = "SA", v0=None, ncv: int = 0,
           maxiter: int = 1000, tol: float = 1e-7, seed: int = 42,
-          res=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+          res=None, strict: bool = False,
+          return_report: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """scipy-compatible front-end (ref: pylibraft sparse/linalg/lanczos.pyx:85
-    `eigsh`)."""
+    `eigsh`).
+
+    ``strict=True`` raises
+    :class:`~raft_tpu.core.guards.ConvergenceError` when the restart
+    budget is exhausted (instead of the warn-and-return reference
+    parity); ``return_report=True`` appends the
+    :class:`~raft_tpu.core.guards.ConvergenceReport` to the result."""
+    from raft_tpu.util.input_validation import expect_finite
+
+    if isinstance(a, (CSRMatrix, COOMatrix)):
+        expect_finite(a.data, name="eigsh: A.data")
+    else:
+        from raft_tpu.util.input_validation import expect_square
+
+        arr = jnp.asarray(a)
+        expect_square(arr, name="eigsh: A")
+        expect_finite(arr, name="eigsh: A")
+    if v0 is not None:
+        expect_finite(jnp.asarray(v0), name="eigsh: v0")
     cfg = LanczosConfig(n_components=k, max_iterations=maxiter, ncv=ncv,
-                        tolerance=tol, which=which.upper(), seed=seed)
-    return lanczos_compute_eigenpairs(res, a, cfg, v0)
+                        tolerance=tol, which=which.upper(), seed=seed,
+                        strict=strict)
+    return lanczos_compute_eigenpairs(res, a, cfg, v0,
+                                      return_report=return_report)
 
 
 def _eigsh_csr(csr, cfg: LanczosConfig, v0,
@@ -239,10 +274,19 @@ def _eigsh_csr(csr, cfg: LanczosConfig, v0,
         v = jnp.asarray(rng.standard_normal(n), dtype=dtype)
     else:
         v = jnp.asarray(v0, dtype=dtype)
-    v = v / jnp.linalg.norm(v)
+        if resolve_guard_mode() != "off":
+            nv = float(jnp.linalg.norm(v))
+            if not nv > 0 or not np.isfinite(nv):
+                raise IllConditionedError(
+                    f"eigsh: starting vector v0 has norm {nv!r} — cannot "
+                    "normalize a zero/non-finite direction",
+                    op="sparse.solver.eigsh")
+    v = v / jnp.linalg.norm(v)   # guarded: v0 validated above; random
+    #                              v0 has unit-scale norm by construction
 
     basis = jnp.zeros((ncv, n), dtype=dtype)
     t = np.zeros((ncv, ncv), dtype=np.float64)   # projected matrix
+    stats = {"breakdowns": 0}
 
     def extend(j_start: int, basis, t, v, it: int):
         """Device-batched Lanczos steps for rows [j_start, ncv); one small
@@ -254,6 +298,13 @@ def _eigsh_csr(csr, cfg: LanczosConfig, v0,
             use_dense=use_dense)
         ab_h = np.asarray(ab, dtype=np.float64)   # the fetch: [2, ncv]
         brk_h = np.asarray(brk)
+        # classify β≈0 restarts: recovered-from breakdowns, not failures —
+        # counted into the ConvergenceReport and traced (ISSUE 3)
+        n_brk = int(brk_h[j_start:].sum())
+        if n_brk:
+            stats["breakdowns"] += n_brk
+            trace.record_event("lanczos.breakdown", iteration=it,
+                               count=n_brk)
         for j in range(j_start, ncv):
             t[j, j] = ab_h[0, j]
             if j + 1 < ncv:
@@ -262,11 +313,12 @@ def _eigsh_csr(csr, cfg: LanczosConfig, v0,
         beta_last = 0.0 if brk_h[ncv - 1] else float(ab_h[1, ncv - 1])
         return basis, t, beta_last, v
 
-    return _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype)
+    return _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
+                         stats=stats)
 
 
 def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
-                  on_iteration=None, resume=None):
+                  on_iteration=None, resume=None, stats=None):
     """Host-driven thick-restart outer loop (ref: detail/lanczos.cuh:537
     `while (res > tol && iter < maxIter)`), shared by the single-device and
     MNMG drivers: `basis` may be a mesh-sharded global array — the Ritz
@@ -308,7 +360,18 @@ def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
         residuals = np.abs(beta_last * s[-1, :])
         converged = float(residuals.max()) < cfg.tolerance
         if converged or it == cfg.max_iterations - 1:
+            report = ConvergenceReport(
+                converged=converged, n_iter=it + 1,
+                residual=float(residuals.max()), tol=float(cfg.tolerance),
+                breakdowns=0 if stats is None
+                else int(stats.get("breakdowns", 0)))
             if not converged:
+                if getattr(cfg, "strict", False):
+                    raise ConvergenceError(
+                        f"lanczos: max_iterations={cfg.max_iterations} "
+                        f"reached with residual {report.residual:.3e} > "
+                        f"tol {cfg.tolerance:.3e} (strict=True)",
+                        report=report, op="sparse.solver.lanczos")
                 # Reference parity: lanczos_smallest exits its
                 # `while (res > tol && iter < maxIter)` loop and returns the
                 # best available pairs without throwing
@@ -319,11 +382,13 @@ def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
                     cfg.max_iterations, float(residuals.max()),
                     cfg.tolerance)
             ritz_vecs = basis.T @ jnp.asarray(s, dtype=dtype)
-            # normalize (f32 drift) and sort ascending like scipy eigsh
-            ritz_vecs = ritz_vecs / jnp.linalg.norm(ritz_vecs, axis=0)
+            # normalize (f32 drift) and sort ascending like scipy eigsh;
+            # Ritz columns come from an orthonormal-by-construction basis
+            # and soft locking keeps directions nonzero
+            ritz_vecs = ritz_vecs / jnp.linalg.norm(ritz_vecs, axis=0)  # guarded: orthonormal basis
             asc = np.argsort(ritz_vals)
             return (jnp.asarray(ritz_vals[asc], dtype=dtype),
-                    ritz_vecs[:, asc])
+                    ritz_vecs[:, asc], report)
 
         # -- thick restart (ref: detail/lanczos.cuh:537-700) --------------
         ritz_vecs = basis.T @ jnp.asarray(s, dtype=dtype)   # [n, k]
@@ -398,7 +463,7 @@ def _extend_mnmg_body(rows_l, cols_g, data_l, basis_l, v_l, key,
         return w_l - basis_l.T @ coeffs, coeffs
 
     def gnorm(w_l):
-        return jnp.sqrt(psum(jnp.sum(w_l * w_l)))
+        return jnp.sqrt(psum(jnp.sum(w_l * w_l)))   # guarded: sum of squares
 
     def step(j, carry):
         basis_l, v_l, alphas, betas, brk, key, scale = carry
@@ -452,7 +517,9 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
                checkpoint_every: Optional[int] = None,
                checkpoint_dir: Optional[str] = None,
                checkpoint_keep: int = 2,
-               resume_from: Optional[str] = None
+               resume_from: Optional[str] = None,
+               strict: bool = False,
+               return_report: bool = False
                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Multi-device eigsh: A row-partitioned over ``mesh[axis]``, the
     Lanczos extension shard_mapped (SpMV = local band product over an
@@ -484,7 +551,8 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
         csr = convert.sorted_coo_to_csr(sparse_op.coo_sort(csr))
     n = csr.n_rows
     cfg = LanczosConfig(n_components=k, max_iterations=maxiter, ncv=ncv,
-                        tolerance=tol, which=which.upper(), seed=seed)
+                        tolerance=tol, which=which.upper(), seed=seed,
+                        strict=strict)
     if k <= 0 or k >= n:
         raise ValueError(f"need 0 < k < n, got {k} vs {n}")
     if cfg.max_iterations < 1:
@@ -584,6 +652,11 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
                 rows_g, cols_g, data_g, basis, v, key)
             ab_h = np.asarray(ab, dtype=np.float64)
             brk_h = np.asarray(brk)
+            n_brk = int(brk_h[j_start:].sum())
+            if n_brk:
+                stats["breakdowns"] += n_brk
+                trace.record_event("lanczos.breakdown", iteration=it,
+                                   count=n_brk)
             for j in range(j_start, ncv):
                 t[j, j] = ab_h[0, j]
                 if j + 1 < ncv:
@@ -603,6 +676,7 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
         return extend, place
 
     t = np.zeros((ncv, ncv), dtype=np.float64)
+    stats = {"breakdowns": 0}
     resume = None
     if resume_from is not None:
         entries = _load_eigsh_checkpoint(resume_from)
@@ -641,9 +715,10 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
             else None)
     while True:
         try:
-            w, vecs = _restart_loop(extend, basis, t, v, cfg, k, ncv,
-                                    which, dtype, on_iteration=hook,
-                                    resume=resume)
+            w, vecs, report = _restart_loop(extend, basis, t, v, cfg, k,
+                                            ncv, which, dtype,
+                                            on_iteration=hook,
+                                            resume=resume, stats=stats)
             break
         except (PeerFailedError, CommsAbortedError) as err:
             if comms is None or manager is None:
@@ -665,6 +740,8 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
                              np.asarray(entries["v"], np.float32))
             t = np.asarray(entries["t"], np.float64).copy()
             resume = (int(entries["it"]), float(entries["beta_last"]))
+    if return_report:
+        return w, vecs[:n], report
     return w, vecs[:n]
 
 

@@ -28,7 +28,12 @@ from raft_tpu.util.input_validation import (  # noqa: F401
     expect_shape,
     expect_2d,
     expect_same_shape,
+    expect_square,
+    expect_dtype,
+    expect_positive,
+    expect_finite,
 )
+from raft_tpu.util import numerics  # noqa: F401
 from raft_tpu.util.itertools import product_of_lists  # noqa: F401
 from raft_tpu.util.arch import (ArchRange, TpuArch,  # noqa: F401
                                 mxu_dim, runtime_arch, vmem_bytes,

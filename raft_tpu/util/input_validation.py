@@ -1,4 +1,10 @@
-"""Argument validation (ref: util/input_validation.hpp, RAFT_EXPECTS)."""
+"""Argument validation (ref: util/input_validation.hpp, RAFT_EXPECTS).
+
+Shape/dtype expecters are metadata-only and always on. ``expect_finite``
+scans values, so it is gated on the guard mode (``core/guards.py``):
+under ``off`` the entry point pays nothing and NaN propagates exactly as
+before the guardrails landed.
+"""
 
 from __future__ import annotations
 
@@ -27,3 +33,53 @@ def expect_same_shape(a, b, names=("a", "b")) -> None:
         raise ValueError(
             f"{names[0]} shape {tuple(a.shape)} != {names[1]} shape "
             f"{tuple(b.shape)}")
+
+
+def expect_square(arr, name: str = "array") -> None:
+    """A 2-D array with equal dims (eigensolver/factorization inputs)."""
+    shape = tuple(arr.shape)
+    if len(shape) != 2 or shape[0] != shape[1]:
+        raise ValueError(f"{name}: expected a square matrix, got shape "
+                         f"{shape}")
+
+
+def expect_dtype(arr, dtypes, name: str = "array") -> None:
+    """Dtype membership check (TypeError, matching the runtime layer's
+    foreign-dtype rejections). ``dtypes`` is one dtype-like or a
+    sequence."""
+    import numpy as np
+
+    if not isinstance(dtypes, (tuple, list, set)):
+        dtypes = (dtypes,)
+    want = {np.dtype(d) for d in dtypes}
+    got = np.dtype(arr.dtype)
+    if got not in want:
+        raise TypeError(
+            f"{name}: dtype {got} not in {sorted(str(d) for d in want)}")
+
+
+def expect_positive(value, name: str = "value",
+                    strict: bool = True) -> None:
+    """A host scalar (or 0-d array) that must be > 0 (>= 0 when
+    ``strict=False``) and finite."""
+    import math
+
+    v = float(value)
+    ok = v > 0.0 if strict else v >= 0.0
+    if not (math.isfinite(v) and ok):
+        bound = ">" if strict else ">="
+        raise ValueError(f"{name}: expected a finite value {bound} 0, "
+                         f"got {v!r}")
+
+
+def expect_finite(arr, name: str = "array", guard_mode=None) -> None:
+    """All-finite value check, gated on the guard mode.
+
+    Under guard mode ``off`` (the default) this is a no-op — entry
+    points stay bit-identical and pay nothing. Under ``check``/
+    ``recover`` a non-finite input raises ``NonFiniteError`` naming the
+    argument, attributing garbage-in at the boundary instead of letting
+    it surface as a NaN result ten ops downstream."""
+    from raft_tpu.core.guards import check_finite
+
+    check_finite(name, arr, mode=guard_mode, stage="input")

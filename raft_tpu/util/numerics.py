@@ -1,0 +1,93 @@
+"""Precision-escalation ladder and host-f64 helpers for the numerical
+guardrails (``core/guards.py``).
+
+The escalation ladder extends ``util/precision.py``'s matmul tiers with a
+final host-f64 rung:
+
+    ``default`` (one bf16 pass) → ``high`` (bf16x3) → ``highest``
+    (full f32) → ``f64`` (float64, emulated on host — TPU f64 is
+    software-emulated, and escalation targets are small corrective
+    re-runs, not hot-path work)
+
+``recover``-mode guards walk this ladder one rung at a time: a matmul-
+shaped op (pairwise, gemm, spmv) retries under the next
+``jax.default_matmul_precision`` tier; direct factorizations whose
+breakdown is *dtype*-limited rather than matmul-tier-limited (the
+Cholesky rank-1 pivot, the Jacobi sweep) jump to the ``f64`` rung and
+recompute the failing step with float64 host arithmetic.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import numpy as np
+
+from raft_tpu.util import precision
+
+__all__ = ["LADDER", "next_tier", "tier_scope", "matmul_escalation",
+           "f64_host"]
+
+#: bf16 → f32 → f64-emulated, lowest to highest.
+LADDER = ("default", "high", "highest", "f64")
+
+
+def next_tier(tier: Optional[str] = None) -> Optional[str]:
+    """The rung above ``tier`` (default: the matmul tier currently in
+    effect), or None at the top of the ladder."""
+    if tier is None:
+        tier = precision.current_mode()
+    try:
+        i = LADDER.index(str(tier).lower())
+    except ValueError:
+        # JAX-only spellings (dot-algorithm presets) already map to
+        # 'highest' in precision.current_mode(); anything else unknown
+        # is treated as already-maximal matmul accuracy.
+        return "f64"
+    return LADDER[i + 1] if i + 1 < len(LADDER) else None
+
+
+@contextlib.contextmanager
+def tier_scope(tier: str):
+    """Run a region at an explicit ladder rung.
+
+    Matmul rungs install ``jax.default_matmul_precision``; the ``f64``
+    rung is a no-op context — f64 escalation is per-op host arithmetic
+    (see :func:`f64_host`), not a trace-wide dtype flip."""
+    tier = str(tier).lower()
+    if tier == "f64":
+        yield
+    elif tier in ("default", "high", "highest"):
+        with jax.default_matmul_precision(tier):
+            yield
+    else:
+        raise ValueError(f"unknown ladder tier {tier!r}; want one of "
+                         f"{LADDER}")
+
+
+def matmul_escalation(compute, op: str = ""):
+    """A retry thunk one *matmul* rung up, or None when matmul accuracy
+    is already maximal ('highest'): the generic ``recover`` hook for
+    GEMM-shaped guarded ops. ``compute`` must be a nullary closure over
+    the original operands (re-running it under the escalated scope
+    re-traces with the higher tier in the jit cache key)."""
+    nt = next_tier()
+    if nt is None or nt == "f64":
+        return None
+
+    def rerun():
+        with tier_scope(nt):
+            return compute()
+
+    return rerun
+
+
+def f64_host(*arrays):
+    """Operands as float64 numpy arrays — the top ladder rung.
+
+    Escalated steps compute with these on host (LAPACK/numpy), then cast
+    back to the original dtype; TPU f64 emulation is never entered."""
+    out = tuple(np.asarray(a, np.float64) for a in arrays)
+    return out[0] if len(out) == 1 else out

@@ -2,6 +2,8 @@
 pylibraft links against — cpp/include/raft_runtime/, SURVEY.md §2.11; the
 AOT tier is the explicit-instantiation discipline's analogue)."""
 
+import os
+
 import numpy as np
 import pytest
 import scipy.sparse as sp
@@ -39,6 +41,77 @@ class TestAotExport:
         save_computation(aot_export(f, x), p)
         call = load_computation(p)
         np.testing.assert_allclose(np.asarray(call(x)), x * 2 + 1)
+
+    def test_sha256_sidecar_written(self, tmp_path):
+        import hashlib
+
+        def f(x):
+            return x - 3.0
+
+        x = np.ones((5,), np.float32)
+        p = str(tmp_path / "artifact.stablehlo")
+        save_computation(aot_export(f, x), p)
+        sidecar = p + ".sha256"
+        assert os.path.exists(sidecar)
+        with open(p, "rb") as fh:
+            blob = fh.read()
+        with open(sidecar) as fh:
+            assert fh.read().strip() == hashlib.sha256(blob).hexdigest()
+
+    def test_bit_flip_raises_typed_corrupt_error(self, tmp_path):
+        from raft_tpu.core.guards import ArtifactCorruptError
+
+        def f(x):
+            return x * x
+
+        x = np.ones((3,), np.float32)
+        p = str(tmp_path / "artifact.stablehlo")
+        save_computation(aot_export(f, x), p)
+        with open(p, "rb") as fh:
+            blob = bytearray(fh.read())
+        blob[len(blob) // 2] ^= 0xFF          # flip one byte mid-artifact
+        with open(p, "wb") as fh:
+            fh.write(blob)
+        with pytest.raises(ArtifactCorruptError) as ei:
+            load_computation(p)
+        assert ei.value.path == p
+        assert p in str(ei.value)
+
+    def test_truncation_raises_typed_corrupt_error(self, tmp_path):
+        from raft_tpu.core.guards import ArtifactCorruptError
+
+        def f(x):
+            return x + 7.0
+
+        x = np.ones((3,), np.float32)
+        p = str(tmp_path / "artifact.stablehlo")
+        save_computation(aot_export(f, x), p)
+        with open(p, "rb") as fh:
+            blob = fh.read()
+        with open(p, "wb") as fh:
+            fh.write(blob[: len(blob) // 2])  # torn write / partial copy
+        with pytest.raises(ArtifactCorruptError):
+            load_computation(p)
+
+    def test_truncation_without_sidecar_still_typed(self, tmp_path):
+        """Pre-guardrails artifacts have no sidecar: the deserialize
+        failure itself must still surface as ArtifactCorruptError."""
+        from raft_tpu.core.guards import ArtifactCorruptError
+
+        def f(x):
+            return x + 7.0
+
+        x = np.ones((3,), np.float32)
+        p = str(tmp_path / "artifact.stablehlo")
+        save_computation(aot_export(f, x), p)
+        os.remove(p + ".sha256")
+        with open(p, "rb") as fh:
+            blob = fh.read()
+        with open(p, "wb") as fh:
+            fh.write(blob[: len(blob) // 3])
+        with pytest.raises(ArtifactCorruptError) as ei:
+            load_computation(p)
+        assert ei.value.path == p
 
     def test_shape_signature_enforced(self):
         def f(x):
