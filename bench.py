@@ -347,6 +347,16 @@ def main():
             "error": f"{type(e).__name__}: {e}",
             "traceback": traceback.format_exc()[-1500:],
         }
+    try:
+        # observability rider (ISSUE 4): with RAFT_TPU_METRICS=on the
+        # north-star line carries the full metrics snapshot (solver
+        # iteration counters, collective latencies, cache stats)
+        from raft_tpu import obs
+
+        if obs.enabled():
+            line["metrics"] = obs.snapshot()
+    except Exception:  # noqa: BLE001 — never block the north-star line
+        pass
     print(json.dumps(line))
 
 

@@ -114,4 +114,151 @@ PYEOF
 # acceptance run).
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_elastic.py::TestMultiprocessSigkill -q
+
+# Observability API lint (ISSUE 4): instrumented modules go through the
+# raft_tpu.obs facade (obs.inc / obs.observe / obs.span /
+# obs.record_convergence ...). Importing obs internals or constructing
+# registries/sinks inside library code bypasses the single on/off knob
+# and the process-global registry — reject it everywhere but obs/ itself.
+python - <<'PYEOF'
+import pathlib, re, sys
+RULES = (
+    (r"from\s+raft_tpu\.obs\.\w+\s+import",
+     "import the facade (from raft_tpu import obs), not obs internals"),
+    (r"from\s+raft_tpu\.obs\s+import\s+(metrics|spans|export|schema)\b",
+     "import the facade (from raft_tpu import obs), not obs submodules"),
+    (r"\bMetricsRegistry\s*\(",
+     "library code must use the process-global registry (obs.inc/...)"),
+    (r"\bJsonlSink\s*\(",
+     "sinks attach via obs.set_sink / RAFT_TPU_METRICS_JSONL, not inline"),
+)
+bad = []
+for p in sorted(pathlib.Path("raft_tpu").rglob("*.py")):
+    if p.parts[:2] == ("raft_tpu", "obs"):
+        continue
+    text = p.read_text()
+    for pat, why in RULES:
+        for m in re.finditer(pat, text):
+            line = text.count("\n", 0, m.start()) + 1
+            bad.append(f"{p}:{line}: {why}")
+print("\n".join(bad) if bad else "obs API lint: clean")
+sys.exit(1 if bad else 0)
+PYEOF
+
+# Observability gate (ISSUE 4 acceptance): a real MNMG kmeans + eigsh
+# run with RAFT_TPU_METRICS=on must export (a) a schema-valid JSONL
+# stream and (b) a snapshot/Prometheus exposition carrying comms byte
+# counters, solver iteration counters, compile-cache stats, and a
+# populated per-collective latency histogram.
+OBS_JSONL=$(mktemp -d)/obs.jsonl
+RAFT_TPU_METRICS=on RAFT_TPU_METRICS_JSONL="$OBS_JSONL" \
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'PYEOF'
+import os
+import threading
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from raft_tpu import obs
+from raft_tpu.obs.schema import validate_jsonl
+
+assert obs.enabled(), "RAFT_TPU_METRICS=on must arm the subsystem"
+assert obs.get_sink() is not None, \
+    "RAFT_TPU_METRICS_JSONL sink must auto-attach at import"
+
+mesh = Mesh(np.asarray(jax.devices()[:8]), axis_names=("data",))
+
+# -- MNMG kmeans with a live comms clique (inproc transport) ------------
+from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit_mnmg
+from raft_tpu.comms.comms import MeshComms, _Mailbox
+from raft_tpu.core import resources as core_res
+
+rng = np.random.default_rng(0)
+x = np.concatenate([rng.normal(c, 0.3, (200, 5)) for c in range(4)]
+                   ).astype(np.float32)
+res = core_res.Resources()
+core_res.set_mesh(res, mesh)
+comms = MeshComms(mesh, "data", 0, _mailbox=_Mailbox())
+core_res.set_comms(res, comms)
+comms.barrier()
+comms.allreduce(np.ones((8, 4), np.float32))
+comms.allreduce(np.ones((8, 4), np.float32))   # second call: a cache hit
+
+# host mailbox traffic (inproc byte counters + the host_allreduce span):
+# all 8 rank views over one shared mailbox, one thread per rank
+n = comms.get_size()
+results = [None] * n
+
+
+def _rank_body(r):
+    results[r] = comms.rank_view(r).host_allreduce(
+        np.full(3, float(r), np.float32), tag=900)
+
+
+threads = [threading.Thread(target=_rank_body, args=(r,))
+           for r in range(n)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+assert all(np.allclose(out, sum(range(n))) for out in results)
+
+kmeans_fit_mnmg(res, KMeansParams(n_clusters=4, max_iter=10, seed=0),
+                x, mesh=mesh)
+
+# -- single-device eigsh (solver convergence metrics) -------------------
+import scipy.sparse as sp
+
+from raft_tpu.core.sparse_types import CSRMatrix
+from raft_tpu.sparse.solver import eigsh
+
+dense = rng.normal(size=(120, 120)).astype(np.float32)
+dense[rng.uniform(size=dense.shape) > 0.08] = 0.0
+A = sp.csr_matrix(dense + dense.T)
+eigsh(CSRMatrix.from_scipy(A), k=2, which="SA", maxiter=40)
+
+snap = obs.snapshot()
+fams = snap["metrics"]
+
+
+def _total(name):
+    f = fams.get(name)
+    if f is None:
+        return 0.0
+    return sum(s.get("value", s.get("count", 0)) for s in f["series"])
+
+
+required = ["comms_bytes_sent_total", "comms_messages_sent_total",
+            "solver_iterations_total", "solver_runs_total",
+            "runtime_compile_cache_total"]
+missing = [name for name in required if _total(name) <= 0]
+assert not missing, \
+    f"metric families absent/empty after MNMG run: {missing}"
+
+hits = [s for s in fams["runtime_compile_cache_total"]["series"]
+        if s["labels"].get("outcome") == "hit"]
+assert hits and hits[0]["value"] > 0, \
+    "expected at least one eager-cache hit"
+
+hist = fams.get("comms_collective_seconds")
+assert hist and hist["type"] == "histogram" \
+    and sum(s["count"] for s in hist["series"]) > 0, \
+    "collective latency histogram must have samples"
+
+text = obs.render_prometheus()
+for name in required + ["comms_collective_seconds_bucket"]:
+    assert name in text, f"{name} missing from Prometheus exposition"
+
+sink = obs.set_sink(None)
+sink.close()
+n_ok, problems = validate_jsonl(os.environ["RAFT_TPU_METRICS_JSONL"])
+assert not problems, \
+    "JSONL schema violations:\n" + "\n".join(problems[:10])
+assert n_ok > 0, "empty JSONL export"
+print(f"obs gate: {len(fams)} metric families, "
+      f"{n_ok} schema-valid JSONL records")
+PYEOF
 echo "smoke: PASS"

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core import logger, trace
+from raft_tpu import obs
 from raft_tpu.linalg.contractions import (_kernel_dot_exact_lhs,
                                           fused_l2_argmin_pallas,
                                           fused_lloyd_pallas)
@@ -354,6 +355,7 @@ def _finish_report(converged: bool, n_iter: int, rel_change: float,
     report = ConvergenceReport(converged=converged, n_iter=int(n_iter),
                                residual=float(rel_change),
                                tol=float(params.tol))
+    obs.record_convergence(op, report)
     if not converged:
         if strict:
             raise ConvergenceError(

@@ -42,6 +42,7 @@ from raft_tpu.comms.errors import (CommsAbortedError, CommsError,
 from raft_tpu.comms.resilience import TagStore, default_recv_timeout
 from raft_tpu.core import logger, trace
 from raft_tpu.core.interruptible import InterruptedException
+from raft_tpu import obs
 
 # Reserved host-p2p tag namespaces (kept below the split-remap bases in
 # comm_split so elastic control traffic never collides with user tags):
@@ -110,6 +111,12 @@ class _Mailbox:
                                 else default_recv_timeout(30.0))
 
     def put(self, source: int, dest: int, tag: int, payload) -> None:
+        if obs.enabled():
+            obs.inc("comms_messages_sent_total", 1, transport="inproc")
+            obs.inc("comms_bytes_sent_total",
+                    getattr(payload, "nbytes",
+                            np.asarray(payload).nbytes),
+                    transport="inproc")
         injector = self.faults
         if injector is not None:
             decision = injector.on_send(source, dest, tag, payload)
@@ -472,16 +479,17 @@ class MeshComms:
         x = np.asarray(x)
         if n == 1:
             return x.copy()
-        if self._rank == 0:
-            total = x.copy()
-            for r in range(1, n):
-                part = np.asarray(self._mailbox.get(r, 0, tag))
-                total = total + part.astype(total.dtype)
-            for r in range(1, n):
-                self._mailbox.put(0, r, tag + 1, total)
-            return total
-        self._mailbox.put(self._rank, 0, tag, x)
-        return np.asarray(self._mailbox.get(0, self._rank, tag + 1))
+        with obs.span("comms.host_allreduce", tag=tag, n=n):
+            if self._rank == 0:
+                total = x.copy()
+                for r in range(1, n):
+                    part = np.asarray(self._mailbox.get(r, 0, tag))
+                    total = total + part.astype(total.dtype)
+                for r in range(1, n):
+                    self._mailbox.put(0, r, tag + 1, total)
+                return total
+            self._mailbox.put(self._rank, 0, tag, x)
+            return np.asarray(self._mailbox.get(0, self._rank, tag + 1))
 
     # -- elastic execution (ISSUE 2 tentpole) -------------------------------
     #
@@ -508,6 +516,7 @@ class MeshComms:
         contract, propagated instead of polled)."""
         trace.record_event("comms.mesh_abort", rank=self._rank,
                            reason=reason)
+        obs.inc("comms_aborts_total", 1, transport="mesh")
         self._mailbox.abort(reason)
 
     def clear_abort(self) -> None:
@@ -738,6 +747,9 @@ class MeshComms:
         cache = self._shared["jit"]
         with self._shared["lock"]:
             f = cache.get(full_key)
+        if obs.enabled():
+            obs.inc("runtime_compile_cache_total", 1, cache="comms_eager",
+                    outcome=("hit" if f is not None else "miss"))
         if f is None:
             f = _build_eager_collective(self.mesh, self.axis_name, shard_fn,
                                         replicate_out=multi)
@@ -749,8 +761,17 @@ class MeshComms:
             sharding = NamedSharding(self.mesh, P(self.axis_name))
             ga = jax.make_array_from_callback(
                 host.shape, sharding, lambda idx: host[idx])
-            return f(ga)
-        return f(x)
+            x = ga
+        if not obs.enabled():
+            return f(x)
+        # metrics-on path trades dispatch asynchrony for a real latency
+        # sample: eager collectives are semantically synchronous anyway
+        t0 = time.monotonic()
+        out = f(x)
+        jax.block_until_ready(out)
+        obs.observe("comms_collective_seconds", time.monotonic() - t0,
+                    op=str(cache_key[0]))
+        return out
 
     def allreduce(self, x, op: Op = Op.SUM):
         """ref: comms_t::allreduce → ncclAllReduce (std_comms.hpp:366-374)."""

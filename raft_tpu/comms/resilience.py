@@ -36,6 +36,7 @@ from dataclasses import dataclass
 from typing import Callable, Deque, Dict, Optional, Tuple
 
 from raft_tpu.core import interruptible, logger, trace
+from raft_tpu import obs
 from raft_tpu.comms.errors import (
     CommsAbortedError,
     CommsTimeoutError,
@@ -127,6 +128,7 @@ class RetryPolicy:
                                        what=describe, attempt=attempt + 1,
                                        elapsed=round(elapsed, 3),
                                        error=repr(e))
+                    obs.inc("comms_retries_total", 1, outcome="deadline")
                     raise CommsTimeoutError(
                         f"{describe or 'comms op'}: retry deadline "
                         f"{self.deadline}s overrun after {attempt + 1} "
@@ -136,6 +138,7 @@ class RetryPolicy:
                 trace.record_event("comms.retry", what=describe,
                                    attempt=attempt + 1,
                                    delay=round(wait, 4), error=repr(e))
+                obs.inc("comms_retries_total", 1, outcome="retried")
                 _log.debug("retrying %s (attempt %d, backoff %.3fs): %r",
                            describe, attempt + 1, wait, e)
                 if on_retry is not None:
@@ -144,6 +147,7 @@ class RetryPolicy:
         trace.record_event("comms.retry.exhausted", what=describe,
                            attempts=max(1, self.max_attempts),
                            error=repr(last))
+        obs.inc("comms_retries_total", 1, outcome="exhausted")
         _log.warning("%s failed after %d attempt(s): %r",
                      describe or "comms op", max(1, self.max_attempts), last)
         assert last is not None
@@ -205,6 +209,7 @@ class TagStore:
                 self._failed[rank] = reason
                 trace.record_event("comms.peer_failed", store=self.name,
                                    rank=rank, reason=reason)
+                obs.inc("comms_peer_failures_total", 1)
                 _log.warning("%s: peer rank %d declared failed: %s",
                              self.name, rank, reason)
             self._cv.notify_all()

@@ -59,6 +59,7 @@ from raft_tpu.comms.resilience import (
     default_recv_timeout as _default_recv_timeout,
 )
 from raft_tpu.core import logger, trace
+from raft_tpu import obs
 
 # kind, source, dest, tag, crc32(body), nbytes
 _HDR = struct.Struct("<iiiiIq")
@@ -194,6 +195,11 @@ class TcpMailbox:
                 if decision is not None and decision.corrupt:
                     p = corrupt_array(np.asarray(p))
                 self._store.deliver(source, dest, tag, p)
+                if obs.enabled():
+                    obs.inc("comms_messages_sent_total", 1,
+                            transport="tcp-local")
+                    obs.inc("comms_bytes_sent_total",
+                            np.asarray(p).nbytes, transport="tcp-local")
             if decision is not None and decision.disconnect:
                 self._store.fail_peer(source, "fault-injected disconnect")
             return
@@ -224,6 +230,7 @@ class TcpMailbox:
                     self._conns.pop(dest, None)
                 trace.record_event("comms.send_reconnect", dest=dest,
                                    tag=tag, error=repr(e))
+                obs.inc("comms_reconnects_total", 1, transport="tcp")
                 s = self._connect(dest, policy=RECONNECT_POLICY)
                 with self._lock:
                     self._conns[dest] = s
@@ -236,6 +243,12 @@ class TcpMailbox:
                         f"tcp-mailbox rank {self.rank}: send to rank "
                         f"{dest} failed after reconnect: {e2!r}",
                         rank=dest, endpoint=(source, dest, tag)) from e2
+            if obs.enabled():
+                obs.inc("comms_messages_sent_total", len(frames),
+                        transport="tcp")
+                obs.inc("comms_bytes_sent_total",
+                        sum(len(raw) + _HDR.size for _, raw in frames),
+                        transport="tcp")
             if decision is not None and decision.disconnect:
                 # chaos: cut the link mid-stream; the peer sees EOF with
                 # no GOODBYE and its failure detector fires
@@ -305,6 +318,7 @@ class TcpMailbox:
         unreachable simply misses the frame (its own failure detector is
         someone else's problem by then)."""
         self._store.abort(reason)
+        obs.inc("comms_aborts_total", 1, transport="tcp")
         body = reason.encode("utf-8", "replace")[:4096]
         crc = zlib.crc32(body)
         for dest in range(len(self.addrs)):
@@ -375,6 +389,8 @@ class TcpMailbox:
                     raw = _recv_exact(conn, nbytes)
                     if zlib.crc32(raw) != crc:
                         self.corrupt_frames += 1
+                        obs.inc("comms_frames_corrupt_total", 1,
+                                transport="tcp")
                         trace.record_event("comms.frame_corrupt",
                                            source=source, dest=dest,
                                            tag=tag)
@@ -386,6 +402,11 @@ class TcpMailbox:
                         continue
                     arr = np.load(io.BytesIO(raw), allow_pickle=False)
                     self._store.deliver(source, dest, tag, arr)
+                    if obs.enabled():
+                        obs.inc("comms_messages_recv_total", 1,
+                                transport="tcp")
+                        obs.inc("comms_bytes_recv_total",
+                                nbytes + _HDR.size, transport="tcp")
         except (ConnectionError, OSError, ValueError) as e:
             reason = repr(e)
         finally:
@@ -441,6 +462,9 @@ class TcpMailbox:
                      if now - t > self.heartbeat_timeout]
             for r, _ in stale:
                 self._last_seen.pop(r, None)
+        if stale:
+            obs.inc("comms_heartbeat_misses_total", len(stale),
+                    transport="tcp")
         for r, t in stale:
             self._store.fail_peer(
                 r, f"no heartbeat for {now - t:.1f}s "

@@ -44,11 +44,13 @@ import io
 import os
 import re
 import struct
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from raft_tpu import obs
 from raft_tpu.core import logger, serialize, trace
 from raft_tpu.random.rng_state import GeneratorType, RngState
 
@@ -166,11 +168,16 @@ def save_checkpoint(path: Union[str, os.PathLike],
     checkpoints (a writer killed mid-save leaves the previous file)."""
     path = os.fspath(path)
     tmp = path + ".tmp"
+    t0 = time.monotonic()
     with open(tmp, "wb") as f:
         dump_checkpoint(entries, f)
         f.flush()
         os.fsync(f.fileno())
+        nbytes = f.tell()
     os.replace(tmp, path)
+    if obs.enabled():
+        obs.inc("checkpoint_bytes_written_total", nbytes)
+        obs.observe("checkpoint_write_seconds", time.monotonic() - t0)
     trace.record_event("checkpoint.save", path=path, entries=len(entries))
 
 

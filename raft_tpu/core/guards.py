@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core import logger, trace
+from raft_tpu import obs
 
 __all__ = [
     "NumericalError", "NonFiniteError", "IllConditionedError",
@@ -263,6 +264,7 @@ def check_finite(op: str, *arrays, mode: Optional[str] = None,
     if mode == "off" or _has_tracer(arrays):
         return
     if not bool(finite_sentinel(*arrays)):
+        obs.inc("guards_sentinel_trips_total", 1, op=op, stage=stage)
         raise NonFiniteError(
             f"{op}: non-finite values detected at the {stage} boundary "
             f"(guard_mode={mode!r}; run with guard_mode='off' to restore "
@@ -293,13 +295,16 @@ def guard_output(op: str, out, *, inputs=(), recover=None,
                  if hasattr(x, "dtype")]
     if in_leaves and not _has_tracer(in_leaves) \
             and not bool(finite_sentinel(*in_leaves)):
+        obs.inc("guards_sentinel_trips_total", 1, op=op, stage="input")
         raise NonFiniteError(
             f"{op}: non-finite values in the INPUT operands "
             f"(guard_mode={mode!r}) — the output is poisoned by "
             "garbage-in; precision escalation is not attempted",
             op=op, stage="input")
+    obs.inc("guards_sentinel_trips_total", 1, op=op, stage="output")
     if mode == "recover" and recover is not None:
         trace.record_event("guards.escalate", op=op)
+        obs.inc("guards_escalations_total", 1, op=op)
         logger.warn(
             "%s: non-finite output with finite inputs; re-running one "
             "tier up the precision ladder (guard_mode='recover')", op)

@@ -9,11 +9,9 @@ observers (e.g. the memory resource_monitor) can ask "what range am I in?".
 
 from __future__ import annotations
 
-import collections
 import contextlib
 import threading
-import time
-from typing import Deque, List, Optional
+from typing import List, Optional
 
 import jax
 
@@ -57,38 +55,32 @@ range = push_range
 # Retry/failure/fault events from the comms resilience layer land here,
 # attributed to the innermost active range of the emitting thread, so an
 # observer can answer "what was the system doing when rank 3 died?".
-# Bounded ring buffer: observability, not an audit log.
-
-_events_lock = threading.Lock()
-_events: Deque[dict] = collections.deque(maxlen=1024)
-
+# Since ISSUE 4 the ring itself lives in raft_tpu.obs.export (one emit
+# path shared with obs spans and the JSONL sink); these functions are
+# thin shims kept for every pre-obs caller. Record shape is unchanged.
 
 def record_event(name: str, **attrs) -> None:
     """Record an instantaneous host-side event in the active range.
 
     The event carries the emitting thread's innermost range (``range``)
     and full range stack (``range_stack``) at emission time, a monotonic
-    timestamp, plus any keyword attributes."""
-    ev = {"name": name, "range": current_range(),
-          "range_stack": tuple(_stack()), "t": time.monotonic()}
-    ev.update(attrs)
-    with _events_lock:
-        _events.append(ev)
+    timestamp, plus any keyword attributes. Shim over
+    :func:`raft_tpu.obs.export.emit_event` (lazy import — obs reads this
+    module's range stack)."""
+    from raft_tpu import obs
+    obs.emit_event(name, **attrs)
 
 
 def events(name: Optional[str] = None) -> List[dict]:
     """Snapshot of recorded events, newest last; optionally filtered by
     event name."""
-    with _events_lock:
-        evs = list(_events)
-    if name is None:
-        return evs
-    return [e for e in evs if e["name"] == name]
+    from raft_tpu import obs
+    return obs.events(name)
 
 
 def clear_events() -> None:
-    with _events_lock:
-        _events.clear()
+    from raft_tpu import obs
+    obs.clear_events()
 
 
 def annotate(name: Optional[str] = None):

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from raft_tpu.core import logger
+from raft_tpu import obs
 from raft_tpu.util.precision import with_matmul_precision
 
 EigVecUsage = ("OVERWRITE_INPUT", "COPY_INPUT")
@@ -167,7 +168,9 @@ def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15,
             converged=off_h <= tol * norm_h, n_iter=int(n_sweeps),
             residual=off_h / norm_h if norm_h > 0 else 0.0,
             tol=float(tol))
-        if not report.converged:
+        if report.converged:
+            obs.record_convergence("linalg.eig_jacobi", report)
+        else:
             if mode == "recover":
                 # sweep-limit breakdown → escalate to the f64 host rung
                 # (exact LAPACK eigh — "matches the f64 reference")
@@ -175,12 +178,16 @@ def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15,
 
                 trace.record_event("guards.escalate", op="linalg.eig_jacobi",
                                    tier="f64", residual=report.residual)
+                obs.inc("guards_escalations_total", 1,
+                        op="linalg.eig_jacobi")
                 w64, v64 = np.linalg.eigh(f64_host(a))
                 report.escalated = True
                 report.converged = True
                 report.detail = "escalated to f64 host eigh"
+                obs.record_convergence("linalg.eig_jacobi", report)
                 return finish(jnp.asarray(w64, dtype),
                               jnp.asarray(v64, dtype), report)
+            obs.record_convergence("linalg.eig_jacobi", report)
             if strict:
                 raise ConvergenceError(
                     f"eig_jacobi: sweep limit {sweeps} reached with "

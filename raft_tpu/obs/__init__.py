@@ -1,0 +1,47 @@
+"""Unified observability (ISSUE 4): metrics, spans, exporters.
+
+One layer answers "what is this system doing" across every subsystem
+the previous PRs instrumented ad hoc — the comms trace ring, guard
+escalation events, checkpoint logs. Three parts:
+
+:mod:`raft_tpu.obs.metrics`
+    thread-safe registry of labeled Counter/Gauge/Histogram families
+    (fixed log-spaced buckets, per-family cardinality cap), plus the
+    ``RAFT_TPU_METRICS=off|on`` toggle — ``off`` (the default) makes
+    every emit helper a no-op behind one bool check.
+:mod:`raft_tpu.obs.spans`
+    recorded host-side regions parented off the ``core/trace.py`` range
+    stack, with bounded retention and deterministic sampling.
+:mod:`raft_tpu.obs.export`
+    ``snapshot()``, the process JSONL sink (``RAFT_TPU_METRICS_JSONL``),
+    Prometheus text exposition, and the process-wide event ring that
+    ``trace.record_event`` feeds.
+
+Everything any instrumented module needs is re-exported here; emitting
+through private internals (or a second bespoke registry) is a lint
+failure in ci/smoke.sh.
+"""
+
+from raft_tpu.obs.metrics import (          # noqa: F401
+    enabled, set_enabled, MetricsRegistry, get_registry, set_registry,
+    log_buckets, DEFAULT_BUCKETS, RESIDUAL_BUCKETS,
+    inc, set_gauge, observe, record_convergence,
+)
+from raft_tpu.obs.spans import (            # noqa: F401
+    span, spans, clear_spans, set_sample_rate, set_retention,
+)
+from raft_tpu.obs.export import (           # noqa: F401
+    emit_event, events, clear_events,
+    JsonlSink, get_sink, set_sink,
+    snapshot, render_prometheus,
+)
+
+__all__ = [
+    "enabled", "set_enabled", "MetricsRegistry", "get_registry",
+    "set_registry", "log_buckets", "DEFAULT_BUCKETS", "RESIDUAL_BUCKETS",
+    "inc", "set_gauge", "observe", "record_convergence",
+    "span", "spans", "clear_spans", "set_sample_rate", "set_retention",
+    "emit_event", "events", "clear_events",
+    "JsonlSink", "get_sink", "set_sink",
+    "snapshot", "render_prometheus",
+]

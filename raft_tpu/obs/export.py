@@ -1,0 +1,234 @@
+"""Export surface (ISSUE 4 tentpole part 3): ``snapshot()`` dict, JSONL
+event sink, Prometheus text-exposition rendering — plus the single
+process-wide event ring that :func:`raft_tpu.core.trace.record_event`
+now feeds (satellite: one emit path for comms trace events, guard
+escalations, checkpoint events, and obs spans).
+
+The event ring keeps the exact record shape the old ``core/trace.py``
+ring kept (``name``/``range``/``range_stack``/``t`` + attrs) so every
+existing ``trace.events(...)`` consumer keeps working, and it is NOT
+gated by ``RAFT_TPU_METRICS`` — the ring is part of the library's
+always-on error-path observability (tests assert on it with metrics
+off). Only the JSONL sink fan-out is additive.
+
+JSONL stream: one JSON object per line, each carrying ``kind``
+(``"event"`` | ``"span"``), ``ts`` (wall clock) and ``t`` (monotonic).
+``RAFT_TPU_METRICS_JSONL=<path>`` attaches a file sink at import when
+metrics are on, so any workload can be observed without code changes —
+the contract ci/smoke.sh validates via :mod:`raft_tpu.obs.schema`.
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import json
+import threading
+import time
+from typing import Deque, List, Optional
+
+from raft_tpu.obs import metrics as _metrics
+
+__all__ = [
+    "emit_event", "events", "clear_events",
+    "JsonlSink", "get_sink", "set_sink",
+    "snapshot", "render_prometheus",
+]
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink
+# ---------------------------------------------------------------------------
+
+def _json_safe(v):
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    try:                       # np scalars and friends
+        return v.item()
+    except (AttributeError, ValueError):
+        return repr(v)
+
+
+class JsonlSink:
+    """Thread-safe JSON-lines writer (one event per line, flushed so a
+    crash loses at most the line being written)."""
+
+    def __init__(self, target):
+        """``target`` is a path (opened for append) or a file-like
+        object with ``write``/``flush``."""
+        self._lock = threading.Lock()
+        if isinstance(target, (str, bytes)) or hasattr(target, "__fspath__"):
+            self._fh = open(target, "a", encoding="utf-8")
+            self._owns = True
+        else:
+            self._fh = target
+            self._owns = False
+
+    def write(self, record: dict) -> None:
+        line = json.dumps(_json_safe(record), separators=(",", ":"))
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._owns:
+                self._fh.close()
+
+
+_sink_lock = threading.Lock()
+_sink: Optional[JsonlSink] = None
+
+
+def get_sink() -> Optional[JsonlSink]:
+    return _sink
+
+
+def set_sink(sink: Optional[JsonlSink]) -> Optional[JsonlSink]:
+    """Install (or with None, detach) the process JSONL sink; returns
+    the previous sink (caller owns closing it)."""
+    global _sink
+    with _sink_lock:
+        old, _sink = _sink, sink
+    return old
+
+
+# ---------------------------------------------------------------------------
+# the unified event ring (rehomed from core/trace.py; record shape is
+# frozen — trace.events() consumers depend on it)
+# ---------------------------------------------------------------------------
+
+_events_lock = threading.Lock()
+_events: Deque[dict] = collections.deque(maxlen=1024)
+
+
+def emit_event(name: str, **attrs) -> None:
+    """Record an instantaneous host-side event in the active range.
+
+    Always appends to the bounded in-memory ring (the pre-obs
+    ``trace.record_event`` contract); additionally writes a
+    ``kind="event"`` JSONL line when a sink is attached."""
+    from raft_tpu.core import trace
+    ev = {"name": name, "range": trace.current_range(),
+          "range_stack": tuple(trace.range_stack()),
+          "t": time.monotonic()}
+    ev.update(attrs)
+    with _events_lock:
+        _events.append(ev)
+    sink = _sink
+    if sink is not None:
+        rec = dict(ev)
+        rec["kind"] = "event"
+        rec["ts"] = time.time()
+        sink.write(rec)
+
+
+def events(name: Optional[str] = None) -> List[dict]:
+    """Snapshot of recorded events, newest last; optionally filtered by
+    event name."""
+    with _events_lock:
+        evs = list(_events)
+    if name is None:
+        return evs
+    return [e for e in evs if e["name"] == name]
+
+
+def clear_events() -> None:
+    with _events_lock:
+        _events.clear()
+
+
+def _sink_span(rec: dict) -> None:
+    """Fan a completed span out to the JSONL sink (spans.py calls this;
+    the in-memory retention lives there)."""
+    sink = _sink
+    if sink is None:
+        return
+    out = dict(rec)
+    out["kind"] = "span"
+    out["ts"] = time.time()
+    sink.write(out)
+
+
+# ---------------------------------------------------------------------------
+# snapshot + Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+def snapshot(registry: Optional[_metrics.MetricsRegistry] = None) -> dict:
+    """One JSON-able dict of everything: enabled flag, every metric
+    family/series, and span-ring occupancy. This is what ``bench.py``
+    attaches to its output line."""
+    from raft_tpu.obs.spans import spans as _list_spans
+    reg = registry or _metrics.get_registry()
+    return {
+        "enabled": _metrics.enabled(),
+        "metrics": reg.snapshot(),
+        "spans_retained": len(_list_spans()),
+        "events_retained": len(events()),
+    }
+
+
+def _esc_label(v: str) -> str:
+    return v.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _fmt_labels(names, values) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{k}="{_esc_label(str(v))}"'
+                     for k, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(
+        registry: Optional[_metrics.MetricsRegistry] = None) -> str:
+    """Prometheus text exposition (version 0.0.4) of the registry:
+    ``# HELP`` / ``# TYPE`` headers, one line per series, histograms as
+    cumulative ``_bucket{le=...}`` plus ``_sum`` / ``_count``."""
+    reg = registry or _metrics.get_registry()
+    out = io.StringIO()
+    for name, fam in sorted(reg.families().items()):
+        if fam.help:
+            out.write(f"# HELP {name} {fam.help}\n")
+        out.write(f"# TYPE {name} {fam.kind}\n")
+        with fam._lock:
+            children = list(fam._children.values())
+        for child in sorted(children, key=lambda c: c.labels):
+            lbl = _fmt_labels(fam.labelnames, child.labels)
+            if fam.kind != "histogram":
+                out.write(f"{name}{lbl} {_fmt_value(child.value)}\n")
+                continue
+            cum = 0
+            for bound, n in zip(list(fam.buckets) + ["+Inf"],
+                                child.bucket_counts):
+                cum += n
+                blbl = _fmt_labels(
+                    list(fam.labelnames) + ["le"],
+                    list(child.labels) + [bound])
+                out.write(f"{name}_bucket{blbl} {cum}\n")
+            out.write(f"{name}_sum{lbl} {_fmt_value(child.sum)}\n")
+            out.write(f"{name}_count{lbl} {child.count}\n")
+    return out.getvalue()
+
+
+# -- import-time sink attachment (env-driven, metrics-on only) --------------
+
+def _maybe_attach_env_sink() -> None:
+    import os
+    path = os.environ.get("RAFT_TPU_METRICS_JSONL")
+    if path and _metrics.enabled() and get_sink() is None:
+        set_sink(JsonlSink(path))
+
+
+_maybe_attach_env_sink()

@@ -1,0 +1,92 @@
+"""JSONL event-stream schema (ISSUE 4 satellite: the contract
+ci/smoke.sh validates the exported stream against).
+
+Every line the :class:`raft_tpu.obs.export.JsonlSink` writes is one
+JSON object with a ``kind`` discriminator:
+
+``kind="event"``
+    ``name`` str, ``ts`` wall-clock float, ``t`` monotonic float,
+    ``range`` str|null, ``range_stack`` list[str]; any further keys are
+    free-form event attributes.
+``kind="span"``
+    ``name`` str, ``ts`` float, ``t`` monotonic float,
+    ``duration`` float >= 0, ``parent`` str|null, ``thread`` int|null,
+    ``attrs`` dict.
+
+The validator is deliberately dependency-free (no jsonschema in the
+image): it returns human-readable problem strings instead of raising,
+so the CI gate can report every violation in one pass.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Tuple
+
+__all__ = ["validate_record", "validate_jsonl"]
+
+KINDS = ("event", "span")
+
+
+def _check(problems, cond, msg):
+    if not cond:
+        problems.append(msg)
+
+
+def validate_record(obj) -> List[str]:
+    """Problems with one decoded JSONL record ([] when valid)."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"record is {type(obj).__name__}, not an object"]
+    kind = obj.get("kind")
+    if kind not in KINDS:
+        return [f"kind={kind!r} not in {KINDS}"]
+    _check(problems, isinstance(obj.get("name"), str) and obj["name"],
+           "name must be a non-empty string")
+    _check(problems, isinstance(obj.get("ts"), (int, float)),
+           "ts (wall clock) must be a number")
+    _check(problems, isinstance(obj.get("t"), (int, float)),
+           "t (monotonic) must be a number")
+    if kind == "event":
+        rng = obj.get("range")
+        _check(problems, rng is None or isinstance(rng, str),
+               "range must be a string or null")
+        st = obj.get("range_stack")
+        _check(problems,
+               isinstance(st, list) and all(isinstance(s, str)
+                                            for s in st),
+               "range_stack must be a list of strings")
+    else:  # span
+        dur = obj.get("duration")
+        _check(problems,
+               isinstance(dur, (int, float)) and dur >= 0,
+               "duration must be a non-negative number")
+        parent = obj.get("parent")
+        _check(problems, parent is None or isinstance(parent, str),
+               "parent must be a string or null")
+        _check(problems, isinstance(obj.get("attrs"), dict),
+               "attrs must be an object")
+    return problems
+
+
+def validate_jsonl(path: str) -> Tuple[int, List[str]]:
+    """Validate a JSONL file; returns (n_valid_records, problems).
+    Problems are prefixed with their 1-based line number."""
+    n_ok = 0
+    problems: List[str] = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                problems.append(f"line {lineno}: not JSON ({e.msg})")
+                continue
+            probs = validate_record(obj)
+            if probs:
+                problems.extend(f"line {lineno}: {p}" for p in probs)
+            else:
+                n_ok += 1
+    return n_ok, problems

@@ -1,0 +1,177 @@
+"""Structured spans (ISSUE 4 tentpole part 2): named host-side regions
+with monotonic start/duration, parented off the existing
+:mod:`raft_tpu.core.trace` range stack.
+
+``trace.push_range`` is the NVTX analogue — it marks a region for Xprof
+but records nothing the host can query afterwards. A span is the
+recorded counterpart: entering one pushes the name onto the same
+thread-local range stack (so nested ranges, spans, and
+``trace.record_event`` events all attribute consistently), and exiting
+appends a completed-span record to a bounded in-memory ring and to the
+JSONL sink when one is attached (:mod:`raft_tpu.obs.export`).
+
+Cost model matches the metrics registry: with ``RAFT_TPU_METRICS=off``
+:func:`span` returns a shared null context manager — no allocation, no
+range-stack push, bit-identical behavior.
+
+Retention and sampling are bounded by construction:
+
+* the ring keeps the newest ``RAFT_TPU_SPAN_RETAIN`` spans (default
+  2048) — observability, not an audit log;
+* ``RAFT_TPU_SPAN_SAMPLE`` (a rate in (0, 1], default 1.0) keeps
+  deterministically every ``round(1/rate)``-th span per name — a
+  counter-stride, not a coin flip, so runs are reproducible.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+from raft_tpu.obs import metrics as _metrics
+
+__all__ = ["span", "spans", "clear_spans", "set_sample_rate",
+           "set_retention"]
+
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}      # per-name emission counter (sampling)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _env_rate(name: str, default: float) -> float:
+    try:
+        rate = float(os.environ.get(name, default))
+    except ValueError:
+        return default
+    return min(1.0, max(0.0, rate))
+
+
+_spans: Deque[dict] = collections.deque(
+    maxlen=_env_int("RAFT_TPU_SPAN_RETAIN", 2048))
+_sample_stride = (
+    0 if (_r := _env_rate("RAFT_TPU_SPAN_SAMPLE", 1.0)) == 0.0
+    else max(1, int(round(1.0 / _r))))
+
+
+def set_sample_rate(rate: float) -> None:
+    """Keep every ``round(1/rate)``-th span per name (rate in [0, 1];
+    0 drops all spans)."""
+    global _sample_stride
+    rate = float(rate)
+    if not (0.0 <= rate <= 1.0):
+        raise ValueError("sample rate must be in [0, 1]")
+    _sample_stride = 0 if rate == 0.0 else max(1, int(round(1.0 / rate)))
+
+
+def set_retention(maxlen: int) -> None:
+    """Resize the in-memory span ring (drops current contents)."""
+    global _spans
+    with _lock:
+        _spans = collections.deque(maxlen=max(1, int(maxlen)))
+
+
+class _NullSpan:
+    """Zero-cost stand-in when metrics are off (shared singleton)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attr(self, **attrs) -> None:
+        pass
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "parent", "t_start", "duration",
+                 "_thread")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.parent: Optional[str] = None
+        self.t_start = 0.0
+        self.duration = 0.0
+        self._thread = None
+
+    def set_attr(self, **attrs) -> None:
+        """Attach attributes discovered mid-span (iteration counts,
+        byte totals)."""
+        self.attrs.update(attrs)
+
+    def __enter__(self):
+        from raft_tpu.core import trace
+        self.parent = trace.current_range()
+        self._thread = threading.get_ident()
+        trace._stack().append(self.name)
+        self.t_start = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.duration = time.monotonic() - self.t_start
+        from raft_tpu.core import trace
+        st = trace._stack()
+        if st and st[-1] == self.name:
+            st.pop()
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        _record(self)
+        return False
+
+
+def _record(sp: _Span) -> None:
+    with _lock:
+        n = _counts.get(sp.name, 0) + 1
+        _counts[sp.name] = n
+        if _sample_stride == 0 or (n - 1) % _sample_stride != 0:
+            return
+        rec = {"name": sp.name, "t": sp.t_start,
+               "duration": sp.duration, "parent": sp.parent,
+               "thread": sp._thread, "attrs": dict(sp.attrs)}
+        _spans.append(rec)
+    # sink write happens outside the span lock (the sink has its own)
+    from raft_tpu.obs import export
+    export._sink_span(rec)
+
+
+def span(name: str, **attrs):
+    """Context manager recording a completed span on exit.
+
+    Returns a shared no-op object when metrics are off; the recorded
+    span's parent is the innermost :func:`raft_tpu.core.trace.push_range`
+    range (or enclosing span) at entry time."""
+    if not _metrics.enabled():
+        return _NULL
+    return _Span(name, dict(attrs))
+
+
+def spans(name: Optional[str] = None) -> List[dict]:
+    """Snapshot of retained spans, newest last; optionally filtered by
+    span name."""
+    with _lock:
+        out = list(_spans)
+    if name is None:
+        return out
+    return [s for s in out if s["name"] == name]
+
+
+def clear_spans() -> None:
+    with _lock:
+        _spans.clear()
+        _counts.clear()

@@ -20,11 +20,13 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time
 from typing import Callable, Optional, Sequence
 
 import jax
 from jax import export as _jexport
 
+from raft_tpu import obs
 from raft_tpu.core.guards import ArtifactCorruptError
 
 
@@ -39,10 +41,15 @@ def aot_export(fn: Callable, *example_args,
     """
     jfn = fn if isinstance(fn, jax.stages.Wrapped) \
         else jax.jit(fn, **jit_kwargs)
+    t0 = time.monotonic()
     if platforms is not None:
-        return _jexport.export(jfn, platforms=tuple(platforms))(
+        exported = _jexport.export(jfn, platforms=tuple(platforms))(
             *example_args)
-    return _jexport.export(jfn)(*example_args)
+    else:
+        exported = _jexport.export(jfn)(*example_args)
+    obs.observe("runtime_compile_seconds", time.monotonic() - t0,
+                what="aot_export")
+    return exported
 
 
 def serialize_computation(exported) -> bytes:
@@ -69,6 +76,7 @@ def save_computation(exported, path: str) -> None:
     """Persist an Exported atomically (tmp + rename) with a sha256
     sidecar for load-time integrity verification."""
     blob = serialize_computation(exported)
+    obs.inc("runtime_artifact_bytes_written_total", len(blob))
     digest = hashlib.sha256(blob).hexdigest()
     tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "wb") as f:
@@ -96,6 +104,7 @@ def load_computation(path: str) -> Callable:
             want = f.read().strip()
         got = hashlib.sha256(blob).hexdigest()
         if got != want:
+            obs.inc("runtime_artifact_corrupt_total", 1, check="sha256")
             raise ArtifactCorruptError(
                 f"compiled artifact {path!r} failed its sha256 integrity "
                 f"check (sidecar {sidecar!r}: expected {want}, got {got}) "
@@ -106,6 +115,7 @@ def load_computation(path: str) -> Callable:
     except ArtifactCorruptError:
         raise
     except Exception as e:
+        obs.inc("runtime_artifact_corrupt_total", 1, check="deserialize")
         raise ArtifactCorruptError(
             f"compiled artifact {path!r} failed to deserialize "
             f"({type(e).__name__}: {e}); the file is corrupt or was "

@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core import logger, trace
+from raft_tpu import obs
 from raft_tpu.core.guards import (ConvergenceError, ConvergenceReport,
                                   IllConditionedError, resolve_guard_mode)
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
@@ -171,7 +172,9 @@ def lanczos_compute_eigenpairs(res, a, config: LanczosConfig,
         from raft_tpu.sparse import op as sparse_op
         a = convert.sorted_coo_to_csr(sparse_op.coo_sort(a))
     # dense symmetric operators ride the same restart loop (eig_sel path)
-    w, v, report = _eigsh_csr(a, config, v0, rank1=rank1)
+    with obs.span("sparse.solver.eigsh", n=int(a.shape[0]),
+                  k=int(config.n_components)):
+        w, v, report = _eigsh_csr(a, config, v0, rank1=rank1)
     if return_report:
         return w, v, report
     return w, v
@@ -365,6 +368,7 @@ def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
                 residual=float(residuals.max()), tol=float(cfg.tolerance),
                 breakdowns=0 if stats is None
                 else int(stats.get("breakdowns", 0)))
+            obs.record_convergence("sparse.solver.lanczos", report)
             if not converged:
                 if getattr(cfg, "strict", False):
                     raise ConvergenceError(

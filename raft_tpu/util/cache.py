@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu import obs
+
 
 class VectorCache:
     """Cache for n-dimensional vectors addressed by integer keys.
@@ -67,6 +69,14 @@ class VectorCache:
                         sets * self.associativity + lane, -1)
         hits = np.asarray(idx)
         hits = hits[hits >= 0]
+        if obs.enabled():
+            if hits.size:
+                obs.inc("cache_lookups_total", int(hits.size),
+                        cache="vector", outcome="hit")
+            misses = int(keys.shape[0]) - int(hits.size)
+            if misses:
+                obs.inc("cache_lookups_total", misses,
+                        cache="vector", outcome="miss")
         if hits.size:
             self._clock += 1
             self.time = self.time.at[jnp.asarray(hits)].set(self._clock)

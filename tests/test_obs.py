@@ -1,0 +1,457 @@
+"""Tests for the observability subsystem (ISSUE 4): metrics registry
+semantics, label cardinality cap, histogram bucketing, span parenting,
+Prometheus/JSONL export schema, thread-safety under concurrent emitters,
+and the metrics-off no-op identity. Also covers the trace.record_event
+shim over the unified obs event ring."""
+
+from __future__ import annotations
+
+import io
+import json
+import math
+import threading
+
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.core import trace
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.obs import schema as obs_schema
+
+
+@pytest.fixture
+def live_obs():
+    """Metrics on, a fresh private registry, clean span/event state;
+    everything restored afterwards so other tests see the default
+    (off, empty) world."""
+    was_enabled = obs.enabled()
+    old_reg = obs_metrics.set_registry(obs.MetricsRegistry())
+    old_sink = obs.set_sink(None)
+    obs.set_enabled(True)
+    obs.clear_spans()
+    obs.clear_events()
+    obs.set_sample_rate(1.0)
+    try:
+        yield obs_metrics.get_registry()
+    finally:
+        obs.set_enabled(was_enabled)
+        obs_metrics.set_registry(old_reg)
+        obs.set_sink(old_sink)
+        obs.clear_spans()
+        obs.clear_events()
+        obs.set_sample_rate(1.0)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_get_or_create_and_inc(self, live_obs):
+        fam = live_obs.counter("c_total", "help text", ("op",))
+        fam.labels(op="a").inc()
+        fam.labels(op="a").inc(2.5)
+        fam.labels(op="b").inc()
+        snap = live_obs.snapshot()["c_total"]
+        by_op = {s["labels"]["op"]: s["value"] for s in snap["series"]}
+        assert by_op == {"a": 3.5, "b": 1.0}
+        # same name returns the same family object
+        assert live_obs.counter("c_total", "help text", ("op",)) is fam
+
+    def test_counter_rejects_negative(self, live_obs):
+        fam = live_obs.counter("c2_total")
+        with pytest.raises(ValueError, match="increase"):
+            fam.labels().inc(-1)
+
+    def test_gauge_set_inc_dec(self, live_obs):
+        g = live_obs.gauge("g").labels()
+        g.set(5)
+        g.inc(2)
+        g.dec(3)
+        assert live_obs.snapshot()["g"]["series"][0]["value"] == 4.0
+
+    def test_reregistration_conflicts_raise(self, live_obs):
+        live_obs.counter("name1", labelnames=("a",))
+        with pytest.raises(ValueError, match="already registered"):
+            live_obs.gauge("name1", labelnames=("a",))
+        with pytest.raises(ValueError, match="labels"):
+            live_obs.counter("name1", labelnames=("b",))
+        live_obs.histogram("h1", buckets=(1.0, 2.0))
+        with pytest.raises(ValueError, match="buckets"):
+            live_obs.histogram("h1", buckets=(1.0, 3.0))
+
+    def test_label_schema_enforced(self, live_obs):
+        fam = live_obs.counter("c3_total", labelnames=("op", "stage"))
+        with pytest.raises(ValueError, match="expects labels"):
+            fam.labels(op="x")          # missing 'stage'
+        with pytest.raises(ValueError, match="expects labels"):
+            fam.labels(op="x", other="y")
+
+    def test_emit_helpers_autocreate(self, live_obs):
+        obs.inc("auto_total", 2, op="x")
+        obs.set_gauge("auto_gauge", 7.0)
+        obs.observe("auto_hist", 0.5)
+        snap = live_obs.snapshot()
+        assert snap["auto_total"]["series"][0]["value"] == 2.0
+        assert snap["auto_gauge"]["series"][0]["value"] == 7.0
+        assert snap["auto_hist"]["series"][0]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# cardinality cap
+# ---------------------------------------------------------------------------
+
+class TestCardinality:
+    def test_overflow_collapses(self, live_obs):
+        reg = obs.MetricsRegistry(max_series_per_family=3)
+        fam = reg.counter("peers_total", labelnames=("peer",))
+        for i in range(10):
+            fam.labels(peer=f"host{i}").inc()
+        snap = reg.snapshot()["peers_total"]
+        # 3 real series + the single <overflow> series
+        assert len(snap["series"]) == 4
+        assert snap["dropped_series"] == 7
+        over = [s for s in snap["series"]
+                if s["labels"]["peer"] == "<overflow>"]
+        assert len(over) == 1 and over[0]["value"] == 7.0
+
+    def test_existing_series_unaffected_by_cap(self, live_obs):
+        reg = obs.MetricsRegistry(max_series_per_family=2)
+        fam = reg.counter("x_total", labelnames=("k",))
+        fam.labels(k="a").inc()
+        fam.labels(k="b").inc()
+        fam.labels(k="c").inc()        # rerouted
+        fam.labels(k="a").inc()        # still lands on the real series
+        snap = {s["labels"]["k"]: s["value"]
+                for s in reg.snapshot()["x_total"]["series"]}
+        assert snap["a"] == 2.0 and snap["b"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# histogram bucketing
+# ---------------------------------------------------------------------------
+
+class TestHistogram:
+    def test_log_buckets_shape(self):
+        b = obs.log_buckets(1e-3, 1e3, per_decade=1)
+        assert b[0] == pytest.approx(1e-3)
+        assert b[-1] == pytest.approx(1e3)
+        assert len(b) == 7
+        assert list(b) == sorted(b)
+        with pytest.raises(ValueError):
+            obs.log_buckets(0.0, 1.0)
+        with pytest.raises(ValueError):
+            obs.log_buckets(1.0, 1.0)
+
+    def test_observation_lands_in_first_le_bucket(self, live_obs):
+        fam = live_obs.histogram("h_test", buckets=(0.1, 1.0, 10.0))
+        child = fam.labels()
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            child.observe(v)
+        # bucket_counts are per-slot (non-cumulative): (0.1, 1, 10, +Inf)
+        assert child.bucket_counts == [1, 2, 1, 1]
+        assert child.count == 5
+        assert child.sum == pytest.approx(56.05)
+
+    def test_boundary_goes_to_its_own_bucket(self, live_obs):
+        # le semantics: an observation equal to a bound belongs to it
+        child = live_obs.histogram("h_edge", buckets=(1.0, 2.0)).labels()
+        child.observe(1.0)
+        assert child.bucket_counts == [1, 0, 0]
+
+    def test_nonfinite_counts_but_does_not_poison_sum(self, live_obs):
+        child = live_obs.histogram("h_nan", buckets=(1.0,)).labels()
+        child.observe(math.nan)
+        child.observe(math.inf)
+        child.observe(0.5)
+        assert child.count == 3
+        assert child.bucket_counts == [1, 2]   # both non-finite in +Inf
+        assert math.isfinite(child.sum) and child.sum == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class TestSpans:
+    def test_span_records_duration_and_attrs(self, live_obs):
+        with obs.span("work", n=3) as sp:
+            sp.set_attr(extra="yes")
+        recs = obs.spans("work")
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["duration"] >= 0
+        assert rec["attrs"] == {"n": 3, "extra": "yes"}
+        assert rec["parent"] is None
+
+    def test_span_parents_off_range_stack(self, live_obs):
+        with trace.push_range("outer"):
+            with obs.span("child"):
+                pass
+        assert obs.spans("child")[0]["parent"] == "outer"
+
+    def test_nested_spans_parent_each_other(self, live_obs):
+        with obs.span("outer"):
+            # the enclosing span is on the range stack, so events and
+            # inner spans attribute to it
+            trace.record_event("tick")
+            with obs.span("inner"):
+                pass
+        assert obs.spans("inner")[0]["parent"] == "outer"
+        assert obs.spans("outer")[0]["parent"] is None
+        ev = trace.events("tick")[-1]
+        assert ev["range"] == "outer"
+        assert "outer" in ev["range_stack"]
+
+    def test_span_error_attr_on_exception(self, live_obs):
+        with pytest.raises(RuntimeError):
+            with obs.span("boom"):
+                raise RuntimeError("x")
+        assert obs.spans("boom")[0]["attrs"]["error"] == "RuntimeError"
+        # the range stack is unwound despite the exception
+        assert trace.current_range() is None
+
+    def test_sampling_stride(self, live_obs):
+        obs.set_sample_rate(0.5)      # keep every 2nd span per name
+        for _ in range(10):
+            with obs.span("sampled"):
+                pass
+        assert len(obs.spans("sampled")) == 5
+        obs.set_sample_rate(0.0)      # drop everything
+        for _ in range(5):
+            with obs.span("dropped"):
+                pass
+        assert obs.spans("dropped") == []
+
+    def test_retention_bound(self, live_obs):
+        obs.set_retention(4)
+        try:
+            for i in range(10):
+                with obs.span("ring", i=i):
+                    pass
+            recs = obs.spans("ring")
+            assert len(recs) == 4
+            assert [r["attrs"]["i"] for r in recs] == [6, 7, 8, 9]
+        finally:
+            obs.set_retention(2048)
+
+
+# ---------------------------------------------------------------------------
+# export: snapshot, Prometheus, JSONL
+# ---------------------------------------------------------------------------
+
+class TestExport:
+    def test_snapshot_is_json_serializable(self, live_obs):
+        obs.inc("snap_total", op="a")
+        obs.observe("snap_seconds", 0.01)
+        with obs.span("s"):
+            pass
+        snap = obs.snapshot()
+        json.dumps(snap)    # must not raise
+        assert snap["enabled"] is True
+        assert snap["metrics"]["snap_total"]["type"] == "counter"
+        assert snap["spans_retained"] == 1
+
+    def test_prometheus_rendering(self, live_obs):
+        obs.inc("req_total", 3, help="requests", op="get")
+        obs.observe("lat_seconds", 0.5, buckets=(0.1, 1.0))
+        text = obs.render_prometheus()
+        assert "# HELP req_total requests\n" in text
+        assert "# TYPE req_total counter\n" in text
+        assert 'req_total{op="get"} 3\n' in text
+        assert "# TYPE lat_seconds histogram\n" in text
+        # cumulative le buckets + +Inf, then sum/count
+        assert 'lat_seconds_bucket{le="0.1"} 0\n' in text
+        assert 'lat_seconds_bucket{le="1.0"} 1\n' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 1\n' in text
+        assert "lat_seconds_sum 0.5\n" in text
+        assert "lat_seconds_count 1\n" in text
+
+    def test_prometheus_label_escaping(self, live_obs):
+        obs.inc("esc_total", 1, op='a"b\nc\\d')
+        text = obs.render_prometheus()
+        assert r'esc_total{op="a\"b\nc\\d"} 1' in text
+
+    def test_jsonl_sink_stream_is_schema_valid(self, live_obs, tmp_path):
+        path = tmp_path / "events.jsonl"
+        old = obs.set_sink(obs.JsonlSink(str(path)))
+        try:
+            trace.record_event("comms.retry", attempt=1)
+            with trace.push_range("solver"):
+                with obs.span("iteration", k=2):
+                    pass
+        finally:
+            sink = obs.set_sink(old)
+            sink.close()
+        n_ok, problems = obs_schema.validate_jsonl(str(path))
+        assert problems == []
+        assert n_ok == 2
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        kinds = {l["kind"] for l in lines}
+        assert kinds == {"event", "span"}
+        span_rec = next(l for l in lines if l["kind"] == "span")
+        assert span_rec["parent"] == "solver"
+
+    def test_schema_rejects_malformed(self):
+        assert obs_schema.validate_record([1, 2]) != []
+        assert obs_schema.validate_record({"kind": "nope"}) != []
+        assert obs_schema.validate_record(
+            {"kind": "span", "name": "", "ts": 0, "t": 0,
+             "duration": -1, "parent": None, "attrs": {}}) != []
+        ok_event = {"kind": "event", "name": "e", "ts": 1.0, "t": 2.0,
+                    "range": None, "range_stack": []}
+        assert obs_schema.validate_record(ok_event) == []
+
+    def test_jsonl_sink_json_safe_fallback(self, live_obs):
+        buf = io.StringIO()
+        sink = obs.JsonlSink(buf)
+        sink.write({"name": "x", "obj": object(), "tup": (1, 2)})
+        rec = json.loads(buf.getvalue())
+        assert rec["tup"] == [1, 2]
+        assert isinstance(rec["obj"], str)
+
+
+# ---------------------------------------------------------------------------
+# trace shim unification
+# ---------------------------------------------------------------------------
+
+class TestTraceShim:
+    def test_trace_and_obs_share_one_ring(self):
+        trace.clear_events()
+        trace.record_event("via.trace", a=1)
+        obs.emit_event("via.obs", b=2)
+        names = [e["name"] for e in trace.events()]
+        assert names == ["via.trace", "via.obs"]
+        assert trace.events() == obs.events()
+        obs.clear_events()
+        assert trace.events() == []
+
+    def test_event_record_shape_unchanged(self):
+        trace.clear_events()
+        with trace.push_range("r1"):
+            trace.record_event("shaped", code=7)
+        ev = trace.events("shaped")[-1]
+        assert ev["range"] == "r1"
+        assert ev["range_stack"] == ("r1",)
+        assert ev["code"] == 7
+        assert isinstance(ev["t"], float)
+        trace.clear_events()
+
+    def test_ring_lives_with_metrics_off(self):
+        # error-path observability is not gated by RAFT_TPU_METRICS
+        assert not obs.enabled()
+        trace.clear_events()
+        trace.record_event("always.on")
+        assert len(trace.events("always.on")) == 1
+        trace.clear_events()
+
+
+# ---------------------------------------------------------------------------
+# thread safety
+# ---------------------------------------------------------------------------
+
+class TestThreadSafety:
+    def test_concurrent_counter_increments_are_exact(self, live_obs):
+        n_threads, n_iter = 8, 500
+
+        def work():
+            for _ in range(n_iter):
+                obs.inc("race_total", op="x")
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = live_obs.snapshot()["race_total"]
+        assert snap["series"][0]["value"] == n_threads * n_iter
+
+    def test_concurrent_histograms_and_spans(self, live_obs):
+        n_threads, n_iter = 4, 200
+        errors = []
+
+        def work(i):
+            try:
+                for k in range(n_iter):
+                    obs.observe("h_race", 0.001 * (k + 1), op=str(i % 2))
+                    with obs.span("t_span", worker=i):
+                        pass
+            except Exception as e:   # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        snap = live_obs.snapshot()["h_race"]
+        assert sum(s["count"] for s in snap["series"]) \
+            == n_threads * n_iter
+        # spans survived concurrent recording (ring is bounded at 2048
+        # >= 800 total, all retained at rate 1.0)
+        assert len(obs.spans("t_span")) == n_threads * n_iter
+
+
+# ---------------------------------------------------------------------------
+# metrics-off identity
+# ---------------------------------------------------------------------------
+
+class TestOffIdentity:
+    def test_off_is_default_and_emits_nothing(self):
+        assert not obs.enabled()
+        old = obs_metrics.set_registry(obs.MetricsRegistry())
+        try:
+            obs.inc("ghost_total")
+            obs.set_gauge("ghost_gauge", 1.0)
+            obs.observe("ghost_seconds", 0.1)
+            obs.record_convergence("ghost", None)
+            assert obs_metrics.get_registry().snapshot() == {}
+        finally:
+            obs_metrics.set_registry(old)
+
+    def test_off_span_is_shared_null(self):
+        # note: `from raft_tpu.obs import spans` would resolve to the
+        # re-exported *function*, not the submodule
+        import importlib
+        spans_mod = importlib.import_module("raft_tpu.obs.spans")
+        assert not obs.enabled()
+        s1 = obs.span("a", k=1)
+        s2 = obs.span("b")
+        assert s1 is s2 is spans_mod._NULL
+        with s1 as sp:
+            sp.set_attr(x=1)       # accepted, discarded
+        assert obs.spans() == []
+        # and it never touches the range stack
+        with obs.span("c"):
+            assert trace.current_range() is None
+
+    def test_cached_children_noop_after_disable(self, live_obs):
+        fam = live_obs.counter("flip_total")
+        child = fam.labels()
+        child.inc()
+        obs.set_enabled(False)
+        child.inc(100)             # cached handle must go dead too
+        obs.set_enabled(True)
+        assert live_obs.snapshot()["flip_total"]["series"][0]["value"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# record_convergence
+# ---------------------------------------------------------------------------
+
+class TestRecordConvergence:
+    def test_report_feeds_solver_families(self, live_obs):
+        from raft_tpu.core.guards import ConvergenceReport
+        rep = ConvergenceReport(converged=True, n_iter=12, residual=1e-9,
+                                tol=1e-8)
+        obs.record_convergence("test.solver", rep)
+        snap = live_obs.snapshot()
+        assert snap["solver_iterations_total"]["series"][0]["value"] == 12
+        runs = snap["solver_runs_total"]["series"][0]
+        assert runs["labels"] == {"converged": "true", "solver":
+                                  "test.solver"}
+        assert runs["value"] == 1.0
+        res = snap["solver_residual"]["series"][0]
+        assert res["count"] == 1 and res["sum"] == pytest.approx(1e-9)
