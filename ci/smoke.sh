@@ -261,4 +261,119 @@ assert n_ok > 0, "empty JSONL export"
 print(f"obs gate: {len(fams)} metric families, "
       f"{n_ok} schema-valid JSONL records")
 PYEOF
+
+# Deadline-chaos gate (ISSUE 5 acceptance): a 10 s FaultInjector.stall
+# against a 2 s deadline_scope must raise the typed DeadlineExceededError
+# on EVERY rank well before the stall clears — no hang, no bare timeout —
+# and the expiry counter must tick.
+RAFT_TPU_METRICS=on JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'PYEOF'
+import threading
+import time
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from raft_tpu import obs
+from raft_tpu.comms.comms import MeshComms, _Mailbox
+from raft_tpu.comms.faults import FaultInjector
+from raft_tpu.runtime import limits
+
+mesh = Mesh(np.asarray(jax.devices()[:4]), axis_names=("data",))
+inj = FaultInjector(seed=0)
+inj.stall(10.0)                       # every send now sleeps 10 s
+comms = MeshComms(mesh, "data", 0, _mailbox=_Mailbox(faults=inj))
+n = comms.get_size()
+errs = [None] * n
+
+
+def _rank_body(r):
+    try:
+        with limits.deadline_scope(2.0):
+            comms.rank_view(r).host_allreduce(
+                np.full(3, float(r), np.float32), tag=900)
+    except Exception as exc:          # noqa: BLE001 — gate records verbatim
+        errs[r] = exc
+
+
+t0 = time.monotonic()
+threads = [threading.Thread(target=_rank_body, args=(r,))
+           for r in range(n)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=8.0)
+elapsed = time.monotonic() - t0
+
+bad = [(r, type(e).__name__) for r, e in enumerate(errs)
+       if not isinstance(e, limits.DeadlineExceededError)]
+assert not bad, f"ranks without typed deadline error: {bad}"
+assert elapsed < 6.0, \
+    f"deadline contract violated: {elapsed:.1f}s to unwind a 2s budget"
+fam = obs.snapshot()["metrics"].get("limits_deadline_exceeded_total")
+assert fam and sum(s["value"] for s in fam["series"]) > 0, \
+    "limits_deadline_exceeded_total must tick under chaos"
+limits.reset_breakers()
+print(f"deadline-chaos gate: {n} ranks raised typed errors "
+      f"in {elapsed:.1f}s against a 10s stall")
+PYEOF
+
+# Admission gate (ISSUE 5 acceptance): a tiny HBM budget must degrade
+# pairwise/kNN to tiled paths that are bit-for-bit equal to the
+# monolithic ones; an unfittable launch must raise RejectedError with
+# the estimate attached; a malformed RAFT_TPU_HBM_BUDGET must fail at
+# import, not at first launch.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from raft_tpu.distance import pairwise_distance
+from raft_tpu.neighbors import knn
+from raft_tpu.runtime import limits
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(300, 16)).astype(np.float32)
+y = rng.normal(size=(257, 16)).astype(np.float32)
+base = np.asarray(pairwise_distance(None, x, y))
+est = limits.estimate_bytes("distance.pairwise_distance",
+                            m=300, n=257, k=16, itemsize=4)
+with limits.budget_scope(est // 2):
+    tiled = np.asarray(pairwise_distance(None, x, y))
+assert np.array_equal(base, tiled), \
+    "degraded pairwise must be bit-identical to monolithic"
+
+db = rng.normal(size=(2048, 8)).astype(np.float32)
+q = rng.normal(size=(64, 8)).astype(np.float32)
+bd, bi = knn(None, db, q, k=8)
+kest = limits.estimate_bytes("neighbors.brute_force_knn", n_queries=64,
+                             n_db=2048, n_dims=8, k=8, itemsize=4)
+with limits.budget_scope(kest // 3):
+    dd, di = knn(None, db, q, k=8)
+assert np.array_equal(np.asarray(bd), np.asarray(dd)) \
+    and np.array_equal(np.asarray(bi), np.asarray(di)), \
+    "degraded kNN must be bit-identical to monolithic"
+
+try:
+    with limits.budget_scope(1024):
+        pairwise_distance(None, x, y)
+    raise AssertionError("unfittable launch must be rejected")
+except limits.RejectedError as exc:
+    assert exc.estimate == est and exc.budget == 1024, \
+        "RejectedError must carry the estimate and the budget"
+
+limits.reset_breakers()
+
+rc = subprocess.run(
+    [sys.executable, "-c", "import raft_tpu.runtime.limits"],
+    env={**os.environ, "RAFT_TPU_HBM_BUDGET": "banana"},
+    capture_output=True, text=True).returncode
+assert rc != 0, "malformed RAFT_TPU_HBM_BUDGET must fail at import"
+print("admission gate: tiled == monolithic bit-for-bit; "
+      "rejection carries estimate; malformed budget fails loud")
+PYEOF
 echo "smoke: PASS"

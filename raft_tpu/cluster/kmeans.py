@@ -401,6 +401,7 @@ def kmeans_fit(res, params: KMeansParams, x,
     """
     import numpy as np
 
+    from raft_tpu.runtime import limits
     from raft_tpu.util.input_validation import expect_2d, expect_finite
 
     x = jnp.asarray(x)
@@ -432,6 +433,7 @@ def kmeans_fit(res, params: KMeansParams, x,
         # poll points as the per-step loop below.
         n_iter = 0
         while n_iter < params.max_iter:
+            limits.check_deadline("cluster.kmeans_fit")
             block = min(check, params.max_iter - n_iter)
             c, inertia, labels = lloyd_iterate_prepared(
                 ops, c, block, **meta)
@@ -452,6 +454,7 @@ def kmeans_fit(res, params: KMeansParams, x,
                     x, w, c, params.n_clusters)
             if n_iter % check and n_iter != params.max_iter:
                 continue                 # no host sync between polls
+            limits.check_deadline("cluster.kmeans_fit")
             if prev_inertia is not None:
                 rel_change = abs(prev_inertia - float(inertia)) / \
                     max(prev_inertia, 1e-30)
@@ -620,6 +623,7 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
     from raft_tpu.core import checkpoint as core_ckpt
     from raft_tpu.core import resources as core_res
     from raft_tpu.comms.errors import CommsAbortedError, PeerFailedError
+    from raft_tpu.runtime import limits
     from raft_tpu.util.input_validation import expect_2d, expect_finite
 
     import numpy as np
@@ -741,6 +745,11 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
                     })
                 if comms is not None:
                     comms.ensure_healthy()
+                # deadline poll after checkpoint + health probe: an
+                # expiring budget leaves the checkpoint resumable, and
+                # DeadlineExceededError is NOT a clique failure — it
+                # propagates past the elastic handler below
+                limits.check_deadline("cluster.kmeans_fit_mnmg")
                 if prev is not None:
                     rel_change = abs(prev - float(inertia)) / \
                         max(prev, 1e-30)
@@ -839,6 +848,7 @@ def kmeans_fit_elastic(comms, params: KMeansParams, x,
 
     from raft_tpu.comms.errors import CommsAbortedError, PeerFailedError
     from raft_tpu.core import checkpoint as core_ckpt
+    from raft_tpu.runtime import limits
 
     import numpy as np
 
@@ -872,6 +882,10 @@ def kmeans_fit_elastic(comms, params: KMeansParams, x,
     while it < params.max_iter:
         try:
             while it < params.max_iter:
+                # per-iteration poll (the allreduce below is ALSO
+                # deadline-capped through TagStore.get, so a rank
+                # blocked mid-collective still observes the budget)
+                limits.check_deadline("cluster.kmeans_fit_elastic")
                 it += 1
                 size, rank = comms.get_size(), comms.get_rank()
                 bounds = np.linspace(0, n, size + 1).astype(np.int64)

@@ -121,7 +121,10 @@ class _Mailbox:
         if injector is not None:
             decision = injector.on_send(source, dest, tag, payload)
             if decision.delay_s:
-                time.sleep(decision.delay_s)
+                # deadline-aware: an injected stall must not hold the
+                # sender past an active runtime.limits deadline scope
+                from raft_tpu.runtime.limits import sleep_within_deadline
+                sleep_within_deadline(decision.delay_s, op="comms.send")
             for p in decision.payloads:
                 if decision.corrupt:
                     from raft_tpu.comms.faults import corrupt_array

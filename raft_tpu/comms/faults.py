@@ -28,9 +28,15 @@ fault          transport behavior
                link, so it reports the source rank failed directly
 =============  =============================================================
 
+Beyond the probabilistic kinds, :meth:`FaultInjector.stall` arms a
+deterministic latency-spike mode: every in-scope send carries at least
+the given delay — the *slow* peer (as opposed to the dead one) that
+deadline contracts must be tested against.
+
 Determinism: the RNG is advanced by a fixed number of rolls per
 *in-scope* send regardless of configuration, so the same seed and send
-sequence replay the same fault schedule even as probabilities change.
+sequence replay the same fault schedule even as probabilities change
+(``stall`` consumes no rolls — arming it never perturbs the schedule).
 Rank scoping (``source_ranks`` / ``dest_ranks``) confines the chaos to
 chosen links; out-of-scope sends neither fault nor advance the RNG.
 """
@@ -109,7 +115,22 @@ class FaultInjector:
         self.seed = seed
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
+        self._stall_s = 0.0
         self.counts: collections.Counter = collections.Counter()
+
+    def stall(self, seconds: float) -> None:
+        """Arm the latency-spike mode: every subsequent in-scope send
+        sleeps at least ``seconds`` before delivery (0 disarms).
+
+        Unlike the probabilistic ``delay`` kind this is unconditional
+        and consumes no RNG rolls, so a chaos schedule replays
+        identically with or without the stall — the knob deadline tests
+        turn to make a peer *slow* rather than dead."""
+        seconds = float(seconds)
+        if seconds < 0.0:
+            raise ValueError(f"stall seconds must be >= 0, got {seconds}")
+        with self._lock:
+            self._stall_s = seconds
 
     def in_scope(self, source: int, dest: int) -> bool:
         return ((self.source_ranks is None or source in self.source_ranks)
@@ -128,6 +149,13 @@ class FaultInjector:
             for k in fired:
                 self.counts[k] += 1
             self.counts["sends"] += 1
+            # stall rides outside the roll block: no RNG advance, so the
+            # probabilistic schedule is identical with or without it
+            stall_s = self._stall_s
+            if stall_s:
+                self.counts["stall"] += 1
+        if stall_s:
+            fired = fired + ("stall",)
         if fired:
             trace.record_event("comms.fault", kinds=fired, source=source,
                                dest=dest, tag=tag)
@@ -141,7 +169,7 @@ class FaultInjector:
             payloads = []
         return FaultDecision(
             payloads=payloads,
-            delay_s=self.delay_s if "delay" in fired else 0.0,
+            delay_s=max(self.delay_s if "delay" in fired else 0.0, stall_s),
             disconnect="disconnect" in fired,
             corrupt="corrupt" in fired,
             kinds=fired)

@@ -52,6 +52,14 @@ _log = logger.child("comms")
 _POLL_CAP_S = 0.1
 
 
+def _limits():
+    # function-level so importing comms never drags in the runtime
+    # package (runtime.solver imports the solvers, which import comms)
+    from raft_tpu.runtime import limits
+
+    return limits
+
+
 def default_recv_timeout(fallback: float) -> float:
     """Resolve the default blocking-recv deadline for a transport.
 
@@ -59,14 +67,17 @@ def default_recv_timeout(fallback: float) -> float:
     fallback (30 s in-process, 120 s TCP — the latter sized for loaded
     hosts, see TcpMailbox.get).  Explicit ``default_recv_timeout=``
     arguments on the mailbox constructors / ``build_mesh_comms`` win
-    over both.
+    over both.  A malformed value raises ``ValueError`` — a typo'd
+    timeout must never silently become the default.
     """
     env = os.environ.get("RAFT_TPU_RECV_TIMEOUT", "").strip()
     if env:
         try:
             return float(env)
         except ValueError:
-            _log.warning("ignoring malformed RAFT_TPU_RECV_TIMEOUT=%r", env)
+            raise ValueError(
+                "RAFT_TPU_RECV_TIMEOUT must be a number of seconds, "
+                f"got {env!r}") from None
     return fallback
 
 
@@ -109,13 +120,17 @@ class RetryPolicy:
         exhaustion re-raises the last transient error, while a deadline
         overrun raises :class:`CommsTimeoutError` chaining it.
         Cancellation (``interruptible.cancel``) is observed between
-        attempts.
+        attempts, and so is the caller's ``runtime.limits`` deadline
+        scope: backoff sleeps are capped by ``Deadline.remaining()`` and
+        an expired scope raises ``DeadlineExceededError`` instead of
+        burning further attempts.
         """
         rng = random.Random(seed)
         start = time.monotonic()
         last: Optional[BaseException] = None
         for attempt in range(max(1, self.max_attempts)):
             interruptible.yield_now()
+            _limits().check_deadline("comms.retry")
             try:
                 return fn()
             except retry_on as e:
@@ -143,7 +158,7 @@ class RetryPolicy:
                            describe, attempt + 1, wait, e)
                 if on_retry is not None:
                     on_retry(attempt, e)
-                time.sleep(wait)
+                _limits().sleep_within_deadline(wait, op="comms.retry")
         trace.record_event("comms.retry.exhausted", what=describe,
                            attempts=max(1, self.max_attempts),
                            error=repr(last))
@@ -276,12 +291,17 @@ class TagStore:
         declares ``source`` dead, :class:`CommsAbortedError` when this
         thread's ``interruptible`` token is cancelled (the cancel wakes
         the wait immediately), and :class:`CommsTimeoutError` at the
-        deadline.
+        deadline.  A ``runtime.limits`` deadline scope on the calling
+        thread tightens the wait further: once it expires the recv
+        raises ``DeadlineExceededError`` (within one poll cap), so a
+        request deadline bounds the whole collective instead of racing
+        the fixed transport timeout.
         """
         key = (source, dest, tag)
         token = interruptible.get_token()
         token.add_waker(self.stir)
         deadline = time.monotonic() + timeout
+        limit = _limits().current_deadline()
         try:
             with self._cv:
                 while True:
@@ -303,6 +323,11 @@ class TagStore:
                             f"{self.name}: peer rank {source} failed "
                             f"({reason}) with recv {key} pending",
                             rank=source, endpoint=key)
+                    if limit is not None and limit.expired():
+                        # raises DeadlineExceededError with the op key
+                        # (and counts it) — queued messages above still
+                        # win, so data already delivered stays readable
+                        _limits().check_deadline("comms.recv")
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         raise CommsTimeoutError(

@@ -188,7 +188,10 @@ class TcpMailbox:
         decision = (self.faults.on_send(source, dest, tag, arr)
                     if self.faults is not None else None)
         if decision is not None and decision.delay_s:
-            time.sleep(decision.delay_s)
+            # deadline-aware: an injected stall must not hold the sender
+            # past an active runtime.limits deadline scope
+            from raft_tpu.runtime.limits import sleep_within_deadline
+            sleep_within_deadline(decision.delay_s, op="comms.send")
         payloads = [arr] if decision is None else decision.payloads
         if dest == self.rank:
             for p in payloads:

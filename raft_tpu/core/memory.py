@@ -105,6 +105,7 @@ class ResourceMonitor:
         self.device = device
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._file = None
         # The sampler tags rows with the *starting* thread's range stack.
         self._range_fn = trace.current_range
 
@@ -116,8 +117,15 @@ class ResourceMonitor:
         self.stop()
 
     def start(self) -> None:
-        f = open(self.path, "w", newline="")
-        writer = csv.writer(f)
+        if self._thread is not None:
+            raise RuntimeError(
+                "ResourceMonitor already started; stop() it first")
+        # The file handle lives on self so stop() — not the sampler
+        # thread — owns flush/close: a daemon thread torn down at
+        # interpreter exit must not be the only thing between buffered
+        # rows and the disk.
+        self._file = open(self.path, "w", newline="")
+        writer = csv.writer(self._file)
         writer.writerow(["time_s", "range", "host_bytes", "host_peak",
                          "device_bytes", "device_peak"])
         t0 = time.monotonic()
@@ -135,16 +143,23 @@ class ResourceMonitor:
                     dstats["bytes_in_use"], dstats["peak_bytes_in_use"],
                 ])
                 self._stop.wait(self.interval_s)
-            f.flush()
-            f.close()
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
+        """Join the sampler thread, then flush and close the CSV writer.
+        Idempotent; after stop() the monitor can be start()ed again
+        (a fresh file is opened, truncating the path)."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join()
+            self._thread = None
+        if self._file is not None:
+            self._file.flush()
+            self._file.close()
+            self._file = None
+        self._stop.clear()
 
 
 class MmapBuffer:
@@ -152,9 +167,21 @@ class MmapBuffer:
     (ref: mr/mmap_memory_resource.hpp:31,86)."""
 
     def __init__(self, nbytes: int, dir: Optional[str] = None):
-        self._file = tempfile.TemporaryFile(dir=dir)
+        # mkstemp + immediate unlink (rather than TemporaryFile, whose
+        # unlink timing is platform-dependent): the backing file has no
+        # name from the first moment, so no path can leak even if the
+        # process dies mid-use; the space is reclaimed when the last fd
+        # and mapping go away.
+        fd, path = tempfile.mkstemp(dir=dir, prefix="raft_tpu_mmap_")
+        try:
+            os.unlink(path)
+        except OSError:
+            os.close(fd)
+            raise
+        self._file = os.fdopen(fd, "r+b")
         self._file.truncate(nbytes)
         self.nbytes = nbytes
+        self._closed = False
         self._mmap = mmap.mmap(self._file.fileno(), nbytes)
 
     def as_array(self, dtype=np.uint8, shape=None) -> np.ndarray:
@@ -162,13 +189,21 @@ class MmapBuffer:
         return arr.reshape(shape) if shape is not None else arr
 
     def close(self) -> None:
+        """Release the mapping and the backing descriptor. Idempotent —
+        and the descriptor is closed even when live array views keep the
+        mapping itself alive, so repeated create/close cycles never
+        accumulate fds (the file was unlinked at creation)."""
+        if self._closed:
+            return
+        self._closed = True
         try:
             self._mmap.close()
         except BufferError:
             # Arrays still view the mapping; the OS reclaims it when they
             # are garbage collected (the tmpfile is already unlinked).
             pass
-        self._file.close()
+        finally:
+            self._file.close()
 
     def __enter__(self):
         return self

@@ -193,8 +193,17 @@ def pairwise_distance(res, x, y=None,
     finite inputs raises :class:`~raft_tpu.core.guards.NonFiniteError`
     (``recover`` first re-runs one matmul tier up the precision ladder).
     Mode ``off`` (default) pays nothing and is bit-identical.
+
+    Admission (ISSUE 5): with a ``runtime.limits`` work budget active, a
+    monolithic m×n launch that would overrun it degrades to the bit-equal
+    row-tiled path (each output row depends only on its x row and all of
+    y, so tiling the m axis cannot change a single bit); a request whose
+    operands alone overflow the budget raises
+    :class:`~raft_tpu.runtime.limits.RejectedError` with the estimate.
+    With no budget active this path is untouched.
     """
     from raft_tpu.core.guards import guard_output, resolve_guard_mode
+    from raft_tpu.runtime import limits
     from raft_tpu.util.numerics import matmul_escalation
 
     x = _as2d(x)
@@ -203,16 +212,46 @@ def pairwise_distance(res, x, y=None,
     if x.shape[1] != y.shape[1]:
         raise ValueError(f"feature dims differ: {x.shape[1]} vs {y.shape[1]}")
 
+    block = None
+    budget = limits.active_budget()
+    if budget is not None:
+        op = "distance.pairwise_distance"
+        itemsize = x.dtype.itemsize
+        est = limits.estimate_bytes(op, m=x.shape[0], n=y.shape[0],
+                                    k=x.shape[1], itemsize=itemsize)
+        if not limits.admit(op, est, budget=budget):
+            # degrade: the largest x-row block whose working set —
+            # resident operand panels plus one [block, n] output strip —
+            # fits the budget
+            fixed = (x.shape[0] + y.shape[0]) * x.shape[1] * itemsize
+            per_row = max(y.shape[0] * itemsize, 1)
+            block = (budget.limit_bytes - fixed) // per_row
+            if block >= 8:
+                block -= block % 8
+            if block < 1:
+                limits.reject(op, est, budget=budget,
+                              detail="operands alone overflow the budget "
+                                     "(no row tiling can fit)")
+            block = int(block)
+            limits.record_degraded(op)
+
+    def _metric(a, b):
+        if block is None:
+            return _dispatch_metric(a, b, metric, p, sqrt)
+        return _blocked_rowwise(
+            a, b, lambda ab, bb: _dispatch_metric(ab, bb, metric, p, sqrt),
+            block=block)
+
     def compute():
         # InnerProduct is a similarity and RusselRao's self-"distance" is
         # legitimately nonzero ((k - #ones)/k) — only true metrics get the
         # exact-zero diagonal.
         if self_dist and metric not in (DistanceType.InnerProduct,
                                         DistanceType.RusselRaoExpanded):
-            d = _dispatch_metric(x, x, metric, p, sqrt)
+            d = _metric(x, x)
             eye = jnp.eye(d.shape[0], dtype=bool)
             return jnp.where(eye, jnp.zeros((), d.dtype), d)
-        return _dispatch_metric(x, y, metric, p, sqrt)
+        return _metric(x, y)
 
     out = compute()
     if resolve_guard_mode(guard_mode) == "off":

@@ -38,6 +38,13 @@ def gemm(res, A, B, alpha: float = 1.0, beta: float = 0.0, C=None,
     guard (core/guards.py): 'check' fetches a fused finite sentinel with
     the result; 'recover' re-runs one matmul tier up on a non-finite
     output with finite inputs.
+
+    Admission (ISSUE 5): with a ``runtime.limits`` work budget active, a
+    gemm whose operands + accumulator would overrun it raises
+    :class:`~raft_tpu.runtime.limits.RejectedError` carrying the byte
+    estimate — a dense matmul has no bit-equal tiled fallback here, so
+    over-budget requests are refused rather than attempted. With no
+    budget active this path is untouched.
     """
     A = jnp.asarray(A)
     B = jnp.asarray(B)
@@ -47,6 +54,17 @@ def gemm(res, A, B, alpha: float = 1.0, beta: float = 0.0, C=None,
         B = B.T
     if compute_type is None:
         compute_type = jnp.float64 if A.dtype == jnp.float64 else jnp.float32
+
+    from raft_tpu.runtime import limits
+
+    budget = limits.active_budget()
+    if budget is not None:
+        est = limits.estimate_bytes(
+            "linalg.gemm", m=A.shape[0], n=B.shape[1], k=A.shape[1],
+            itemsize=A.dtype.itemsize,
+            out_itemsize=jnp.dtype(compute_type).itemsize)
+        if not limits.admit("linalg.gemm", est, budget=budget):
+            limits.reject("linalg.gemm", est, budget=budget)
 
     def compute():
         out = lax.dot_general(A, B, (((1,), (0,)), ((), ())),

@@ -126,6 +126,7 @@ def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15,
     from raft_tpu.core import trace
     from raft_tpu.core.guards import (ConvergenceError, ConvergenceReport,
                                       resolve_guard_mode)
+    from raft_tpu.runtime import limits
 
     def finish(w, v, report):
         if return_report:
@@ -133,6 +134,9 @@ def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15,
         return w, v
 
     a = jnp.asarray(matrix)
+    # eig_jacobi runs its sweeps as ONE device launch — the deadline
+    # polls bracket it (entry + the post-launch host fetch below)
+    limits.check_deadline("linalg.eig_jacobi")
     if jnp.issubdtype(a.dtype, jnp.complexfloating):
         # the real-rotation sweeps below would silently drop the imaginary
         # part; Hermitian input goes to the QDWH path (syevj handles
@@ -159,6 +163,7 @@ def eig_jacobi(res, matrix, tol: float = 1e-7, sweeps: int = 15,
     # the padded slot stays exactly decoupled (every rotation touching it
     # sees a zero off-diagonal → identity), so dropping row/col n is exact
     w, v = w[:n], v[:n, :n]
+    limits.check_deadline("linalg.eig_jacobi")
     mode = resolve_guard_mode(guard_mode)
     traced = isinstance(w, jax.core.Tracer)
     if (mode != "off" or strict or return_report) and not traced:

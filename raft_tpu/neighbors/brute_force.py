@@ -207,6 +207,16 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     otherwise the streaming scan with per-tile top_k
     (:func:`_knn_scan`).
 
+    Admission (ISSUE 5): with a ``runtime.limits`` work budget active, a
+    launch whose monolithic q×n distance block would overrun it is
+    degraded by tightening ``tile`` to the largest budget-fitting width
+    — the existing streamed top-k machinery then bounds the materialized
+    block, and per-element distances (hence the selected top-k values)
+    are identical across tile widths. A request that cannot fit even the
+    minimum k-wide tile raises
+    :class:`~raft_tpu.runtime.limits.RejectedError` with the estimate.
+    With no budget active the dispatch is untouched.
+
     >>> import numpy as np
     >>> from raft_tpu.neighbors import knn
     >>> db = np.array([[0., 0.], [1., 0.], [5., 5.]], np.float32)
@@ -214,12 +224,34 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     >>> np.asarray(i).tolist()
     [[1, 0]]
     """
+    from raft_tpu.runtime import limits
     from raft_tpu.util.pallas_utils import interpret_needs_ref
 
     db = jnp.asarray(db)
     queries = jnp.asarray(queries)
     _validate(db, queries, k)
     kernel_metric = _resolve_metric(metric)
+
+    budget = limits.active_budget()
+    if budget is not None:
+        op = "neighbors.brute_force_knn"
+        q, n = queries.shape[0], db.shape[0]
+        est = limits.estimate_bytes(op, n_queries=q, n_db=n,
+                                    n_dims=db.shape[1], k=k,
+                                    itemsize=db.dtype.itemsize)
+        if not limits.admit(op, est, budget=budget):
+            # degrade: cap the db tile so the streamed (q, tile) f32
+            # distance block + resident operands + running best fit
+            fixed = ((q + n) * db.shape[1] * db.dtype.itemsize
+                     + q * k * 8)
+            cap = (budget.limit_bytes - fixed) // max(q * 4, 1)
+            cap -= cap % 128              # round DOWN: honor the bound
+            if cap < round_up_to_multiple(k, 128):
+                limits.reject(op, est, budget=budget,
+                              detail="even the minimum k-wide tile "
+                                     "overflows the budget")
+            tile = int(cap if tile is None else min(tile, cap))
+            limits.record_degraded(op)
     # interpret+vma cannot replay vma-carrying kernels — only there does
     # the dispatch fall back (compiled shard_map uses the fused path)
     from raft_tpu.neighbors import fused_topk

@@ -1,0 +1,573 @@
+"""Workload limits: deadlines, budget-aware admission, and OOM-safe
+degraded execution (the request-level contract layered over the
+elastic/guarded core; ref: core/interruptible.hpp and the mr/ resource
+layer — ``interruptible::synchronize`` bounds *time*, the limiting
+resource adaptors bound *memory*; this module grows both into a serving
+contract: every call finishes, fails typed before its deadline, or is
+refused up front).
+
+Three cooperating pieces:
+
+``Deadline`` / :func:`deadline_scope`
+    An absolute-time budget carried in a thread-local scope (the same
+    scope idiom as ``core/guards.py``). Host-driver loops poll
+    :func:`check_deadline` at their existing cancellation/checkpoint
+    boundaries; the comms layer caps blocking-recv timeouts and retry
+    backoff with :func:`remaining` so a deadline on rank 0 bounds the
+    whole collective instead of racing a fixed ``default_recv_timeout``.
+
+``WorkBudget`` / :func:`budget_scope`
+    An HBM-bytes admission limit, seeded from an explicit byte count,
+    ``device_memory_stats()``, or the ``RAFT_TPU_HBM_BUDGET`` env var
+    (malformed values raise at import — fail loud, never a silent
+    fallback). Instrumented entry points (pairwise_distance, brute-force
+    kNN, gemm, spmv) consult :func:`estimate_bytes` *before* launching:
+    over-budget monolithic launches are never attempted — they degrade
+    to a bit-equal row-tiled/streamed path or raise
+    :class:`RejectedError` with the estimate attached.
+
+``CircuitBreaker``
+    N consecutive typed failures per op key → fast-fail with cooldown,
+    protecting callers from retry storms against an op that keeps
+    missing its deadline or budget.
+
+Taxonomy (both ``RuntimeError`` subclasses, consistent with
+``core/guards.py`` and ``comms/errors.py`` so pre-taxonomy ``except
+RuntimeError`` callers keep working):
+
+==========================  =============================================
+type                        meaning
+==========================  =============================================
+``DeadlineExceededError``   the active :class:`Deadline` expired before
+                            the op finished (typed, never a hang)
+``RejectedError``           the op was refused up front — over budget
+                            even tiled (``reason='over_budget'``) or the
+                            circuit breaker is open
+                            (``reason='breaker_open'``); carries the
+                            byte ``estimate`` when known
+==========================  =============================================
+
+With **no limits scope active** (no deadline, no budget — the default),
+every instrumented op takes its exact pre-limits code path: the fast
+path pays one thread-local read and nothing else, and outputs are
+bit-identical to the un-instrumented library.
+
+Observability (through the ``obs`` facade only):
+``limits_deadline_exceeded_total{op}``, ``limits_rejected_total{reason,
+op}``, ``limits_degraded_total{op}``, ``limits_breaker_state{op}``
+(0 closed / 1 open), and a ``deadline_slack_seconds`` histogram
+(time left when a deadline scope exits cleanly — the headroom a
+latency SLO actually has).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from raft_tpu import obs
+
+__all__ = [
+    "DeadlineExceededError", "RejectedError",
+    "Deadline", "deadline_scope", "current_deadline", "remaining",
+    "check_deadline", "sleep_within_deadline",
+    "WorkBudget", "budget_scope", "active_budget", "set_default_budget",
+    "parse_bytes", "estimate_bytes", "admit", "reject", "record_degraded",
+    "CircuitBreaker", "get_breaker", "reset_breakers",
+]
+
+# breaker policy: consecutive typed failures before opening, and how
+# long an open breaker fast-fails before allowing a half-open probe
+BREAKER_THRESHOLD = 5
+BREAKER_COOLDOWN_S = 30.0
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+class DeadlineExceededError(RuntimeError):
+    """The active :class:`Deadline` expired before the operation
+    finished.
+
+    Parameters
+    ----------
+    message : human-readable description (always names the operation).
+    op : dotted name of the operation that observed the expiry.
+    budget_s : the scope's original time budget in seconds.
+    """
+
+    def __init__(self, message: str, *, op: Optional[str] = None,
+                 budget_s: Optional[float] = None):
+        super().__init__(message)
+        self.op = op
+        self.budget_s = budget_s
+
+
+class RejectedError(RuntimeError):
+    """The operation was refused up front — admission control, not a
+    mid-flight failure.
+
+    ``reason`` is ``'over_budget'`` (the footprint estimate exceeds the
+    active :class:`WorkBudget` even for the tiled path) or
+    ``'breaker_open'`` (the op's circuit breaker is fast-failing).
+    ``estimate`` / ``budget`` carry the byte counts when known, so the
+    caller can shrink the request instead of blind-retrying."""
+
+    def __init__(self, message: str, *, op: Optional[str] = None,
+                 estimate: Optional[int] = None,
+                 budget: Optional[int] = None,
+                 reason: str = "over_budget"):
+        super().__init__(message)
+        self.op = op
+        self.estimate = estimate
+        self.budget = budget
+        self.reason = reason
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+class Deadline:
+    """An absolute-time budget on the monotonic clock.
+
+    Created with a relative budget in seconds; queried as
+    :meth:`remaining`. Instances are immutable facts about wall time —
+    scoping and nesting live in :func:`deadline_scope`."""
+
+    __slots__ = ("budget_s", "expires_at", "_ops")
+
+    def __init__(self, seconds: float):
+        seconds = float(seconds)
+        if not seconds >= 0.0:
+            raise ValueError(
+                f"deadline budget must be >= 0 seconds, got {seconds!r}")
+        self.budget_s = seconds
+        self.expires_at = time.monotonic() + seconds
+        # op keys that polled this deadline — a clean scope exit counts
+        # as a breaker success for each of them
+        self._ops: set = set()
+
+    def remaining(self) -> float:
+        """Seconds left (negative once expired)."""
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+
+_tls = threading.local()
+
+
+def _deadline_stack():
+    if not hasattr(_tls, "deadlines"):
+        _tls.deadlines = []
+    return _tls.deadlines
+
+
+def _budget_stack():
+    if not hasattr(_tls, "budgets"):
+        _tls.budgets = []
+    return _tls.budgets
+
+
+def current_deadline() -> Optional[Deadline]:
+    """The binding deadline: of every scope on this thread's stack, the
+    one that expires first (a nested scope can tighten the budget but
+    never extend past an enclosing one). None when no scope is active —
+    the caller's fast path."""
+    st = _deadline_stack()
+    if not st:
+        return None
+    return min(st, key=lambda d: d.expires_at)
+
+
+def remaining(default: Optional[float] = None) -> Optional[float]:
+    """Seconds left on the binding deadline, or ``default`` when no
+    deadline scope is active. The comms layer uses this to cap recv
+    timeouts and retry backoff."""
+    d = current_deadline()
+    return default if d is None else d.remaining()
+
+
+@contextlib.contextmanager
+def deadline_scope(seconds: float):
+    """Thread-local deadline for a region.
+
+    Everything under the scope — solver host loops, blocking recvs,
+    retry backoff — observes the budget through
+    :func:`check_deadline` / :func:`remaining`. On a clean exit the
+    remaining slack is recorded in the ``deadline_slack_seconds``
+    histogram and the breakers of every op polled under the scope see a
+    success."""
+    d = Deadline(seconds)
+    _deadline_stack().append(d)
+    try:
+        yield d
+    except BaseException:
+        _deadline_stack().pop()
+        raise
+    else:
+        _deadline_stack().pop()
+        if obs.enabled():
+            obs.observe("deadline_slack_seconds", max(d.remaining(), 0.0),
+                        help="time left when a deadline scope exits "
+                             "cleanly (seconds)")
+        for op in d._ops:
+            get_breaker(op).record_success()
+
+
+def check_deadline(op: str) -> None:
+    """The deadline poll: no-op (one thread-local read) when no scope is
+    active; raises :class:`DeadlineExceededError` once the binding
+    deadline expires, and :class:`RejectedError` (``breaker_open``) when
+    ``op``'s breaker is fast-failing.
+
+    Rides the same host-sync boundaries as ``CancelToken.check()`` —
+    solvers call it where they already poll for cancellation,
+    checkpoints, or peer health."""
+    d = current_deadline()
+    if d is None:
+        return
+    br = get_breaker(op)
+    if not br.allow():
+        obs.inc("limits_rejected_total", 1, reason="breaker_open", op=op)
+        raise RejectedError(
+            f"{op}: circuit breaker open after "
+            f"{br.threshold} consecutive typed failures "
+            f"(cooldown {br.cooldown_s:g}s) — fast-failing instead of "
+            "burning the deadline", op=op, reason="breaker_open")
+    d._ops.add(op)
+    rem = d.remaining()
+    if rem <= 0.0:
+        br.record_failure()
+        obs.inc("limits_deadline_exceeded_total", 1, op=op)
+        raise DeadlineExceededError(
+            f"{op}: deadline exceeded ({d.budget_s:g}s budget, "
+            f"{-rem:.3f}s over)", op=op, budget_s=d.budget_s)
+
+
+def sleep_within_deadline(seconds: float, *, op: str = "sleep") -> None:
+    """``time.sleep`` that honors the active deadline scope.
+
+    With no scope active it is exactly ``time.sleep(seconds)``. Under a
+    scope it sleeps in short slices and raises
+    :class:`DeadlineExceededError` the moment the deadline expires —
+    so a fault-injected stall (or any long backoff) cannot hold a
+    sender past its budget."""
+    if current_deadline() is None:
+        time.sleep(seconds)
+        return
+    end = time.monotonic() + float(seconds)
+    while True:
+        check_deadline(op)
+        rem = end - time.monotonic()
+        if rem <= 0.0:
+            return
+        time.sleep(min(rem, 0.05))
+
+
+# ---------------------------------------------------------------------------
+# work budgets (HBM admission)
+# ---------------------------------------------------------------------------
+
+_BYTE_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_bytes(text, *, name: str = "byte count") -> int:
+    """Parse a byte count: a plain number or a number with a k/m/g/t
+    binary suffix (``"512m"``, ``"2g"``). Raises ``ValueError`` on
+    anything else — the fail-loud contract for ``RAFT_TPU_HBM_BUDGET``
+    (and the same discipline as ``RAFT_TPU_SPMV`` / ``RAFT_TPU_MST``
+    parsing: a typo'd limit must never silently become 'unlimited')."""
+    s = str(text).strip().lower()
+    mult = 1
+    if s and s[-1] in _BYTE_SUFFIX:
+        mult = _BYTE_SUFFIX[s[-1]]
+        s = s[:-1]
+    try:
+        val = float(s)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a byte count (optionally with a k/m/g/t "
+            f"suffix, e.g. '512m'), got {text!r}") from None
+    n = int(val * mult)
+    if n <= 0:
+        raise ValueError(f"{name} must be positive, got {text!r}")
+    return n
+
+
+class WorkBudget:
+    """An HBM-bytes admission limit.
+
+    Holds a single number — the largest transient working set an
+    instrumented op may plan for. Seed it explicitly, from the env
+    (``RAFT_TPU_HBM_BUDGET``), or from live device telemetry via
+    :meth:`from_device`."""
+
+    __slots__ = ("limit_bytes",)
+
+    def __init__(self, limit_bytes: int):
+        limit_bytes = int(limit_bytes)
+        if limit_bytes <= 0:
+            raise ValueError(
+                f"budget must be a positive byte count, got {limit_bytes}")
+        self.limit_bytes = limit_bytes
+
+    @classmethod
+    def from_device(cls, device=None, *,
+                    fraction: float = 0.9) -> "WorkBudget":
+        """Seed from ``device_memory_stats()``: ``fraction`` of the
+        bytes not currently in use. Raises ``RuntimeError`` when the
+        backend reports no memory limit (host CPU test backends) —
+        pass an explicit byte count there instead."""
+        from raft_tpu.core.memory import device_memory_stats
+
+        stats = device_memory_stats(device)
+        limit = int(stats.get("bytes_limit", 0) or 0)
+        if limit <= 0:
+            raise RuntimeError(
+                "device reports no memory limit; seed WorkBudget with an "
+                "explicit byte count or RAFT_TPU_HBM_BUDGET")
+        free = limit - int(stats.get("bytes_in_use", 0) or 0)
+        return cls(max(int(free * float(fraction)), 1))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"WorkBudget(limit_bytes={self.limit_bytes})"
+
+
+# process-global default budget, seeded from the env at import. A
+# malformed value raises HERE (import time) — loud, immediate, and
+# impossible to mistake for "unlimited".
+_env_budget = os.environ.get("RAFT_TPU_HBM_BUDGET")
+_default_budget: Optional[WorkBudget] = (
+    WorkBudget(parse_bytes(_env_budget, name="RAFT_TPU_HBM_BUDGET"))
+    if _env_budget is not None and _env_budget.strip() != "" else None)
+
+
+def set_default_budget(budget) -> Optional[WorkBudget]:
+    """Set (or clear, with None) the process-wide admission budget —
+    the programmatic twin of ``RAFT_TPU_HBM_BUDGET``. Accepts a
+    :class:`WorkBudget` or a byte count. Returns the previous value."""
+    global _default_budget
+    prev = _default_budget
+    if budget is None:
+        _default_budget = None
+    elif isinstance(budget, WorkBudget):
+        _default_budget = budget
+    else:
+        _default_budget = WorkBudget(budget)
+    return prev
+
+
+def active_budget() -> Optional[WorkBudget]:
+    """The binding budget: of every scope on this thread's stack the
+    smallest limit, else the process-wide default (env-seeded), else
+    None — in which case admission is disabled and instrumented ops run
+    their exact pre-limits path."""
+    st = _budget_stack()
+    if st:
+        return min(st, key=lambda b: b.limit_bytes)
+    return _default_budget
+
+
+@contextlib.contextmanager
+def budget_scope(budget):
+    """Thread-local admission budget for a region. Accepts a
+    :class:`WorkBudget`, a byte count, or None to seed from the current
+    device's live memory telemetry (:meth:`WorkBudget.from_device`)."""
+    if budget is None:
+        b = WorkBudget.from_device()
+    elif isinstance(budget, WorkBudget):
+        b = budget
+    else:
+        b = WorkBudget(budget)
+    _budget_stack().append(b)
+    try:
+        yield b
+    finally:
+        _budget_stack().pop()
+
+
+# ---------------------------------------------------------------------------
+# footprint estimation + admission
+# ---------------------------------------------------------------------------
+
+def _est_pairwise(*, m, n, k, itemsize):
+    # both operands resident + the full m×n output block
+    return (m * k + n * k + m * n) * itemsize
+
+
+def _est_knn(*, n_queries, n_db, n_dims, k, itemsize,
+             dist_itemsize=4):
+    # operands + the monolithic q×n f32 distance block the fused/chunked
+    # paths would otherwise materialize per launch, + top-k outputs
+    return ((n_queries * n_dims + n_db * n_dims) * itemsize
+            + n_queries * n_db * dist_itemsize
+            + n_queries * k * (dist_itemsize + 4))
+
+
+def _est_gemm(*, m, n, k, itemsize, out_itemsize=None):
+    out_itemsize = itemsize if out_itemsize is None else out_itemsize
+    return (m * k + k * n) * itemsize + m * n * out_itemsize
+
+
+def _est_spmv(*, n_rows, n_cols, nnz, itemsize, index_itemsize=4):
+    return (nnz * (itemsize + index_itemsize)
+            + (n_cols + n_rows) * itemsize)
+
+
+_ESTIMATORS = {
+    "distance.pairwise_distance": _est_pairwise,
+    "neighbors.brute_force_knn": _est_knn,
+    "linalg.gemm": _est_gemm,
+    "sparse.spmv": _est_spmv,
+}
+
+
+def estimate_bytes(op: str, **dims) -> int:
+    """Per-op HBM footprint estimate for the *monolithic* launch, from
+    static shapes only (never touches the device). Known ops:
+    ``distance.pairwise_distance(m, n, k, itemsize)``,
+    ``neighbors.brute_force_knn(n_queries, n_db, n_dims, k, itemsize)``,
+    ``linalg.gemm(m, n, k, itemsize[, out_itemsize])``,
+    ``sparse.spmv(n_rows, n_cols, nnz, itemsize[, index_itemsize])``."""
+    try:
+        fn = _ESTIMATORS[op]
+    except KeyError:
+        raise ValueError(
+            f"no footprint estimator for op {op!r}; known: "
+            f"{sorted(_ESTIMATORS)}") from None
+    return int(fn(**dims))
+
+
+def admit(op: str, estimate: int, *,
+          budget: Optional[WorkBudget] = None) -> bool:
+    """Admission check at an instrumented entry point.
+
+    True → the monolithic launch fits (counts a breaker success).
+    False → over budget; the caller degrades to its tiled path or calls
+    :func:`reject`. Raises :class:`RejectedError` (``breaker_open``)
+    immediately when the op's breaker is fast-failing. With no budget
+    active, always True (and touches no breaker — the no-scope fast
+    path stays bit-identical)."""
+    b = budget if budget is not None else active_budget()
+    if b is None:
+        return True
+    br = get_breaker(op)
+    if not br.allow():
+        obs.inc("limits_rejected_total", 1, reason="breaker_open", op=op)
+        raise RejectedError(
+            f"{op}: circuit breaker open after {br.threshold} "
+            f"consecutive typed failures (cooldown {br.cooldown_s:g}s)",
+            op=op, estimate=int(estimate), reason="breaker_open")
+    if int(estimate) <= b.limit_bytes:
+        br.record_success()
+        return True
+    return False
+
+
+def reject(op: str, estimate: int, *,
+           budget: Optional[WorkBudget] = None,
+           detail: str = "") -> None:
+    """Refuse the request: even the tiled path cannot fit. Records a
+    breaker failure, counts ``limits_rejected_total{reason=
+    'over_budget'}``, and raises :class:`RejectedError` carrying the
+    byte estimate."""
+    b = budget if budget is not None else active_budget()
+    limit = b.limit_bytes if b is not None else None
+    get_breaker(op).record_failure()
+    obs.inc("limits_rejected_total", 1, reason="over_budget", op=op)
+    raise RejectedError(
+        f"{op}: estimated footprint {int(estimate)} bytes exceeds the "
+        f"admission budget ({limit} bytes) even for the tiled path"
+        + (f"; {detail}" if detail else ""),
+        op=op, estimate=int(estimate), budget=limit)
+
+
+def record_degraded(op: str) -> None:
+    """Count a degraded (tiled/streamed) execution the admission layer
+    chose instead of the monolithic launch."""
+    obs.inc("limits_degraded_total", 1, op=op)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Consecutive-typed-failure breaker for one op key.
+
+    Closed (normal) until ``threshold`` consecutive failures, then open:
+    :meth:`allow` returns False (callers fast-fail with
+    ``RejectedError(reason='breaker_open')``) until ``cooldown_s`` has
+    elapsed, after which one half-open probe is allowed — a success
+    closes the breaker, a failure re-opens it immediately."""
+
+    def __init__(self, op: str, *, threshold: int = BREAKER_THRESHOLD,
+                 cooldown_s: float = BREAKER_COOLDOWN_S):
+        self.op = op
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if time.monotonic() - self._opened_at < self.cooldown_s:
+                return False
+            # half-open: let one probe through; a failure re-opens
+            self._opened_at = None
+            self._failures = self.threshold - 1
+            self._set_gauge(0)
+            return True
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._failures >= self.threshold and self._opened_at is None:
+                self._opened_at = time.monotonic()
+                self._set_gauge(1)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self._opened_at is not None:
+                self._opened_at = None
+                self._set_gauge(0)
+
+    @property
+    def open(self) -> bool:
+        with self._lock:
+            return self._opened_at is not None
+
+    def _set_gauge(self, state: int) -> None:
+        # called under self._lock; obs is itself thread-safe
+        obs.set_gauge("limits_breaker_state", state, op=self.op,
+                      help="circuit breaker state (0 closed, 1 open)")
+
+
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def get_breaker(op: str) -> CircuitBreaker:
+    """The process-global breaker for an op key (created on first use)."""
+    br = _breakers.get(op)
+    if br is None:
+        with _breakers_lock:
+            br = _breakers.setdefault(op, CircuitBreaker(op))
+    return br
+
+
+def reset_breakers() -> None:
+    """Drop all breaker state (tests and REPL hygiene)."""
+    with _breakers_lock:
+        _breakers.clear()

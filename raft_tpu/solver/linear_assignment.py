@@ -172,7 +172,10 @@ def _solve_many(cost, eps_final: float, strict: bool = True):
     """
     from raft_tpu.core import logger
     from raft_tpu.core.guards import ConvergenceError, ConvergenceReport
+    from raft_tpu.runtime import limits
 
+    # one launch + one host sync: the deadline polls bracket the launch
+    limits.check_deadline("solver.linear_assignment")
     n = cost.shape[1]
     n_phases = _num_phases(cost.dtype)
     if n == 1:
@@ -182,6 +185,7 @@ def _solve_many(cost, eps_final: float, strict: bool = True):
                               tol=float(eps_final))
     obj_of, person_of, prices = _solve_batch(
         cost, jnp.asarray(eps_final, cost.dtype), n_phases)
+    limits.check_deadline("solver.linear_assignment")
     unassigned = jnp.any(obj_of < 0)
     report = ConvergenceReport(converged=True, n_iter=n_phases,
                                residual=0.0, tol=float(eps_final))

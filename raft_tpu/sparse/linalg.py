@@ -121,7 +121,28 @@ def spmv(a, x, guard_mode=None) -> jnp.ndarray:
     ``check``/``recover`` a fused finite sentinel rides the product and
     a non-finite result with finite operands raises
     :class:`~raft_tpu.core.guards.NonFiniteError` (``recover`` retries
-    one matmul tier up first). ``off`` (default) adds nothing."""
+    one matmul tier up first). ``off`` (default) adds nothing.
+
+    Admission (ISSUE 5): with a ``runtime.limits`` work budget active
+    and a matrix exposing its nnz/shape (CSR, ELL), a product whose
+    resident footprint (values + indices + vectors) would overrun the
+    budget raises :class:`~raft_tpu.runtime.limits.RejectedError` with
+    the estimate — sparse operands admit no bit-equal tiling here. With
+    no budget active this path is untouched."""
+    from raft_tpu.runtime import limits
+
+    budget = limits.active_budget()
+    if budget is not None:
+        data = getattr(a, "data", None)
+        n_rows = getattr(a, "n_rows", None)
+        if data is not None and n_rows is not None:
+            xv = jnp.asarray(x)
+            est = limits.estimate_bytes(
+                "sparse.spmv", n_rows=int(n_rows),
+                n_cols=int(xv.shape[0]), nnz=int(jnp.asarray(data).size),
+                itemsize=xv.dtype.itemsize)
+            if not limits.admit("sparse.spmv", est, budget=budget):
+                limits.reject("sparse.spmv", est, budget=budget)
 
     def compute():
         from raft_tpu.sparse.ell import ELLMatrix, spmv as ell_spmv

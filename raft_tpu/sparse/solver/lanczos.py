@@ -336,6 +336,8 @@ def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
     beta_last)`` skips the initial extension and re-enters the loop at
     ``it0`` with the caller-provided ``basis``/``t``/``v``.
     """
+    from raft_tpu.runtime import limits
+
     if resume is None:
         basis, t, beta_last, v = extend(0, basis, t, v, it=-1)
         it0 = 0
@@ -345,6 +347,11 @@ def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
     for it in range(it0, cfg.max_iterations):
         if on_iteration is not None:
             on_iteration(it, basis, t, beta_last, v)
+        # deadline poll AFTER the elastic hook: an expiring deadline
+        # leaves the just-saved checkpoint behind, so the caller can
+        # resume_from it with a fresh budget (ISSUE 5 rides the ISSUE 2
+        # checkpoint-first ordering)
+        limits.check_deadline("sparse.solver.lanczos")
         evals, evecs = np.linalg.eigh(t)
         # Ritz selection per `which` (ref: lanczos_solve_ritz
         # detail/lanczos.cuh:182-223 — SM/LM sort Ritz values by magnitude

@@ -1,0 +1,484 @@
+"""Workload robustness layer tests (ISSUE 5): deadlines, budget-aware
+admission, degraded execution, and the circuit breaker.
+
+Acceptance criteria exercised here:
+
+* the deadline contract under chaos — every rank of a stalled clique
+  raises the typed ``DeadlineExceededError`` within the budget plus one
+  poll interval, never a hang or a bare timeout;
+* the admission contract — over-budget ``pairwise_distance`` / ``knn``
+  degrade to tiled paths that are **bit-for-bit** equal to the
+  monolithic ones; an unfittable launch raises ``RejectedError``
+  carrying the estimate; with no limits configured every instrumented
+  op is bit-identical to the unlimited library;
+* satellite 4 — ``CancelToken.cancel()`` racing ``check()`` / waker
+  registration from 8 threads stays corruption-free, and a deadline
+  expiring mid-``eigsh_mnmg`` leaves a usable checkpoint behind
+  (resume completes and matches scipy).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.runtime import limits
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_breakers():
+    """Deadline/rejection tests record breaker failures; never let one
+    test's failure streak open the breaker on a later test's op key."""
+    limits.reset_breakers()
+    yield
+    limits.reset_breakers()
+
+
+def _submesh(n):
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()[:n]), axis_names=("data",))
+
+
+# -- deadline scopes --------------------------------------------------------
+
+
+class TestDeadline:
+    def test_no_scope_is_inert(self):
+        assert limits.current_deadline() is None
+        assert limits.remaining() is None
+        assert limits.remaining(default=7.0) == 7.0
+        limits.check_deadline("test.noop")  # must not raise
+
+    def test_scope_counts_down(self):
+        with limits.deadline_scope(5.0):
+            d = limits.current_deadline()
+            assert d is not None
+            r = d.remaining()
+            assert 0.0 < r <= 5.0
+            assert limits.remaining() == pytest.approx(r, abs=0.5)
+        assert limits.current_deadline() is None
+
+    def test_nesting_innermost_expiring_wins(self):
+        with limits.deadline_scope(60.0):
+            with limits.deadline_scope(1.0):
+                assert limits.remaining() <= 1.0
+            assert limits.remaining() > 30.0
+
+    def test_expiry_raises_typed_with_attribution(self):
+        with limits.deadline_scope(0.0):
+            with pytest.raises(limits.DeadlineExceededError) as ei:
+                limits.check_deadline("test.op")
+        assert ei.value.op == "test.op"
+        assert ei.value.budget_s == 0.0
+        assert isinstance(ei.value, RuntimeError)
+
+    def test_sleep_within_deadline_raises_before_oversleeping(self):
+        t0 = time.monotonic()
+        with limits.deadline_scope(0.2):
+            with pytest.raises(limits.DeadlineExceededError):
+                limits.sleep_within_deadline(10.0, op="test.sleep")
+        assert time.monotonic() - t0 < 2.0
+
+    def test_sleep_without_scope_is_plain_sleep(self):
+        t0 = time.monotonic()
+        limits.sleep_within_deadline(0.05)
+        assert 0.04 <= time.monotonic() - t0 < 1.0
+
+    def test_retry_policy_backoff_respects_deadline(self):
+        from raft_tpu.comms.resilience import RetryPolicy
+
+        policy = RetryPolicy(max_attempts=50, base_delay=0.5,
+                             max_delay=0.5, deadline=30.0)
+        calls = []
+
+        def always_fails():
+            calls.append(1)
+            raise OSError("transient")
+
+        t0 = time.monotonic()
+        with limits.deadline_scope(0.3):
+            with pytest.raises(limits.DeadlineExceededError):
+                policy.call(always_fails, seed=0)
+        assert time.monotonic() - t0 < 3.0
+        assert calls  # at least one attempt ran before the budget cut in
+
+    def test_tagstore_get_raises_deadline_not_timeout(self):
+        from raft_tpu.comms.resilience import TagStore
+
+        store = TagStore()
+        t0 = time.monotonic()
+        with limits.deadline_scope(0.2):
+            with pytest.raises(limits.DeadlineExceededError):
+                store.get(0, 1, 42, timeout=30.0)
+        assert time.monotonic() - t0 < 2.0
+
+    def test_tagstore_queued_message_beats_expired_deadline(self):
+        from raft_tpu.comms.resilience import TagStore
+
+        store = TagStore()
+        store.deliver(0, 1, 42, "payload")
+        with limits.deadline_scope(0.0):
+            assert store.get(0, 1, 42, timeout=1.0) == "payload"
+
+
+# -- budgets and estimates --------------------------------------------------
+
+
+class TestBudget:
+    def test_parse_bytes_suffixes(self):
+        assert limits.parse_bytes("1024", name="t") == 1024
+        assert limits.parse_bytes("4k", name="t") == 4 << 10
+        assert limits.parse_bytes("2M", name="t") == 2 << 20
+        assert limits.parse_bytes("3g", name="t") == 3 << 30
+        assert limits.parse_bytes("1t", name="t") == 1 << 40
+
+    @pytest.mark.parametrize("bad", ["banana", "", "-5", "0", "12q", "k"])
+    def test_parse_bytes_fails_loud(self, bad):
+        with pytest.raises(ValueError, match="t"):
+            limits.parse_bytes(bad, name="t")
+
+    def test_malformed_env_budget_fails_at_import(self):
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable, "-c", "import raft_tpu.runtime.limits"],
+            env={**os.environ, "RAFT_TPU_HBM_BUDGET": "banana"},
+            capture_output=True, text=True, cwd=_REPO)
+        assert proc.returncode != 0
+        assert "RAFT_TPU_HBM_BUDGET" in proc.stderr
+
+    def test_estimate_bytes_pairwise(self):
+        est = limits.estimate_bytes("distance.pairwise_distance",
+                                    m=10, n=20, k=4, itemsize=4)
+        assert est == (10 * 4 + 20 * 4 + 10 * 20) * 4
+
+    def test_estimate_bytes_unknown_op(self):
+        with pytest.raises(ValueError, match="no footprint estimator"):
+            limits.estimate_bytes("not.an.op", m=1)
+
+    def test_active_budget_scoped_min_wins(self):
+        prev = limits.set_default_budget(None)
+        try:
+            assert limits.active_budget() is None
+            with limits.budget_scope(1 << 30):
+                with limits.budget_scope(1 << 20):
+                    assert limits.active_budget().limit_bytes == 1 << 20
+                assert limits.active_budget().limit_bytes == 1 << 30
+            assert limits.active_budget() is None
+        finally:
+            limits.set_default_budget(prev)
+
+    def test_admit_without_budget_is_unconditional(self):
+        assert limits.admit("test.op", 1 << 60) is True
+
+
+# -- admission: degrade bit-for-bit or reject -------------------------------
+
+
+class TestAdmission:
+    def _xy(self, m=300, n=257, d=16):
+        rng = np.random.default_rng(0)
+        return (rng.normal(size=(m, d)).astype(np.float32),
+                rng.normal(size=(n, d)).astype(np.float32))
+
+    def test_pairwise_degraded_bit_identical(self):
+        from raft_tpu.distance import pairwise_distance
+
+        x, y = self._xy()
+        base = np.asarray(pairwise_distance(None, x, y))
+        est = limits.estimate_bytes("distance.pairwise_distance",
+                                    m=300, n=257, k=16, itemsize=4)
+        with limits.budget_scope(est // 2):
+            tiled = np.asarray(pairwise_distance(None, x, y))
+        assert np.array_equal(base, tiled)
+
+    def test_pairwise_self_distance_degraded_bit_identical(self):
+        from raft_tpu.distance import pairwise_distance
+
+        x, _ = self._xy()
+        base = np.asarray(pairwise_distance(None, x))
+        est = limits.estimate_bytes("distance.pairwise_distance",
+                                    m=300, n=300, k=16, itemsize=4)
+        with limits.budget_scope(est // 2):
+            tiled = np.asarray(pairwise_distance(None, x))
+        assert np.array_equal(base, tiled)
+
+    def test_pairwise_unfittable_rejected_with_estimate(self):
+        from raft_tpu.distance import pairwise_distance
+
+        x, y = self._xy()
+        est = limits.estimate_bytes("distance.pairwise_distance",
+                                    m=300, n=257, k=16, itemsize=4)
+        with limits.budget_scope(1024):
+            with pytest.raises(limits.RejectedError) as ei:
+                pairwise_distance(None, x, y)
+        assert ei.value.estimate == est
+        assert ei.value.budget == 1024
+        assert ei.value.reason == "over_budget"
+        assert isinstance(ei.value, RuntimeError)
+
+    def test_knn_degraded_bit_identical(self):
+        from raft_tpu.neighbors import knn
+
+        rng = np.random.default_rng(1)
+        db = rng.normal(size=(2048, 8)).astype(np.float32)
+        q = rng.normal(size=(64, 8)).astype(np.float32)
+        bd, bi = knn(None, db, q, k=8)
+        est = limits.estimate_bytes("neighbors.brute_force_knn",
+                                    n_queries=64, n_db=2048, n_dims=8,
+                                    k=8, itemsize=4)
+        with limits.budget_scope(est // 3):
+            dd, di = knn(None, db, q, k=8)
+        assert np.array_equal(np.asarray(bd), np.asarray(dd))
+        assert np.array_equal(np.asarray(bi), np.asarray(di))
+
+    def test_knn_unfittable_rejected(self):
+        from raft_tpu.neighbors import knn
+
+        rng = np.random.default_rng(1)
+        db = rng.normal(size=(2048, 8)).astype(np.float32)
+        q = rng.normal(size=(64, 8)).astype(np.float32)
+        with limits.budget_scope(256):
+            with pytest.raises(limits.RejectedError) as ei:
+                knn(None, db, q, k=8)
+        assert ei.value.estimate is not None and ei.value.estimate > 256
+
+    def test_gemm_over_budget_rejected(self):
+        from raft_tpu.linalg.blas import gemm
+
+        A = np.ones((64, 64), np.float32)
+        with limits.budget_scope(1024):
+            with pytest.raises(limits.RejectedError):
+                gemm(None, A, A)
+
+    def test_spmv_over_budget_rejected(self):
+        import scipy.sparse as sp
+
+        from raft_tpu.core.sparse_types import CSRMatrix
+        from raft_tpu.sparse.linalg import spmv
+
+        rng = np.random.default_rng(2)
+        dense = rng.normal(size=(100, 100)).astype(np.float32)
+        dense[rng.uniform(size=dense.shape) > 0.1] = 0.0
+        csr = CSRMatrix.from_scipy(sp.csr_matrix(dense))
+        v = rng.normal(size=100).astype(np.float32)
+        with limits.budget_scope(64):
+            with pytest.raises(limits.RejectedError):
+                spmv(csr, v)
+
+    def test_within_budget_runs_monolithic(self):
+        from raft_tpu.distance import pairwise_distance
+
+        x, y = self._xy()
+        base = np.asarray(pairwise_distance(None, x, y))
+        with limits.budget_scope(1 << 40):
+            out = np.asarray(pairwise_distance(None, x, y))
+        assert np.array_equal(base, out)
+
+
+# -- circuit breaker --------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_recovers(self):
+        br = limits.CircuitBreaker("test.op", threshold=3, cooldown_s=0.1)
+        for _ in range(2):
+            br.record_failure()
+        assert br.allow() and not br.open
+        br.record_failure()
+        assert br.open and not br.allow()
+        time.sleep(0.15)
+        assert br.allow()          # half-open: one probe admitted
+        br.record_success()
+        assert not br.open and br.allow()
+
+    def test_half_open_failure_reopens(self):
+        br = limits.CircuitBreaker("test.op", threshold=2, cooldown_s=0.05)
+        br.record_failure()
+        br.record_failure()
+        assert not br.allow()
+        time.sleep(0.1)
+        assert br.allow()
+        br.record_failure()        # the probe fails → snap back open
+        assert not br.allow()
+
+    def test_check_deadline_fast_fails_when_open(self):
+        br = limits.get_breaker("test.breaker_op")
+        for _ in range(br.threshold):
+            br.record_failure()
+        with limits.deadline_scope(60.0):
+            with pytest.raises(limits.RejectedError) as ei:
+                limits.check_deadline("test.breaker_op")
+        assert ei.value.reason == "breaker_open"
+
+    def test_deadline_expiries_feed_the_breaker(self):
+        # pytest.raises sits OUTSIDE the scope: catching the expiry
+        # inside would make the scope exit clean, which counts as a
+        # breaker success and resets the streak
+        for _ in range(limits.BREAKER_THRESHOLD):
+            with pytest.raises(limits.DeadlineExceededError):
+                with limits.deadline_scope(0.0):
+                    limits.check_deadline("test.flaky_op")
+        assert limits.get_breaker("test.flaky_op").open
+
+    def test_clean_scope_exit_closes_the_streak(self):
+        with pytest.raises(limits.DeadlineExceededError):
+            with limits.deadline_scope(0.0):
+                limits.check_deadline("test.healing_op")
+        with limits.deadline_scope(60.0):
+            limits.check_deadline("test.healing_op")
+        assert limits.get_breaker("test.healing_op")._failures == 0
+
+
+# -- deadline chaos: the stalled clique ------------------------------------
+
+
+class TestDeadlineChaos:
+    def test_stalled_clique_every_rank_raises_typed_within_budget(self):
+        """A 10 s stall against a 1 s deadline: all 4 ranks must raise
+        ``DeadlineExceededError`` (senders via the sliced fault sleep,
+        receivers via the TagStore deadline exit) well before the stall
+        clears — the no-hang contract."""
+        from raft_tpu.comms.comms import MeshComms, _Mailbox
+        from raft_tpu.comms.faults import FaultInjector
+
+        inj = FaultInjector(seed=0)
+        inj.stall(10.0)
+        comms = MeshComms(_submesh(4), "data", 0,
+                          _mailbox=_Mailbox(faults=inj))
+        n = comms.get_size()
+        errs = [None] * n
+
+        def body(r):
+            try:
+                with limits.deadline_scope(1.0):
+                    comms.rank_view(r).host_allreduce(
+                        np.full(3, float(r), np.float32), tag=910)
+            except limits.DeadlineExceededError as exc:
+                errs[r] = exc
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=body, args=(r,))
+                   for r in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=6.0)
+        elapsed = time.monotonic() - t0
+        assert all(isinstance(e, limits.DeadlineExceededError)
+                   for e in errs), errs
+        assert elapsed < 5.0, elapsed
+        assert inj.counts["stall"] >= 1
+
+
+# -- satellite 4: cancellation race ----------------------------------------
+
+
+class TestCancelTokenRace:
+    def test_cancel_races_check_and_wakers_from_8_threads(self):
+        """8 threads hammer ``check()`` + waker add/remove while the main
+        thread fires ``cancel()`` repeatedly: no deadlock, no waker-list
+        corruption, every raise is the typed ``InterruptedException``."""
+        from raft_tpu.core.interruptible import (CancelToken,
+                                                 InterruptedException)
+
+        token = CancelToken()
+        stop = threading.Event()
+        interrupts = [0] * 8
+        foreign = []
+        woken = threading.Event()
+
+        def body(i):
+            def waker():
+                woken.set()
+
+            while not stop.is_set():
+                token.add_waker(waker)
+                try:
+                    token.check()
+                except InterruptedException:
+                    interrupts[i] += 1
+                except Exception as exc:  # noqa: BLE001 — the assertion
+                    foreign.append(exc)
+                    return
+                finally:
+                    token.remove_waker(waker)
+
+        threads = [threading.Thread(target=body, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            token.cancel()
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert not any(t.is_alive() for t in threads), "worker deadlocked"
+        assert not foreign, foreign
+        assert sum(interrupts) > 0, "no thread ever observed the cancel"
+        assert woken.is_set(), "no waker ever fired"
+        assert token._wakers == [], "waker list leaked entries"
+
+
+# -- satellite 4: deadline expiry leaves a usable checkpoint ---------------
+
+
+class TestDeadlineLeavesCheckpointUsable:
+    def test_eigsh_deadline_expiry_then_resume_completes(self, tmp_path):
+        """A zero deadline expires on the very first restart — but the
+        solver polls AFTER the checkpoint hook, so the it=0 state is on
+        disk; resuming with a fresh (absent) budget completes and matches
+        scipy. This is the ISSUE 5 + ISSUE 2 composition contract."""
+        import scipy.sparse as sp
+
+        from raft_tpu.core.sparse_types import CSRMatrix
+        from raft_tpu.sparse.solver import eigsh_mnmg
+
+        n = 96
+        A = sp.random(n, n, density=0.08, random_state=2, format="csr",
+                      dtype=np.float64)
+        A = ((A + A.T) * 0.5).astype(np.float32)
+        csr = CSRMatrix.from_scipy(A)
+        d = str(tmp_path)
+
+        with limits.deadline_scope(0.0):
+            with pytest.raises(limits.DeadlineExceededError) as ei:
+                eigsh_mnmg(csr, k=4, mesh=_submesh(2), which="SA",
+                           maxiter=50, tol=1e-6, checkpoint_every=1,
+                           checkpoint_dir=d, checkpoint_keep=50)
+        assert ei.value.op == "sparse.solver.lanczos"
+        ckpts = sorted(f for f in os.listdir(d) if f.endswith(".ckpt"))
+        assert ckpts, "expiry must leave the it=0 checkpoint behind"
+
+        limits.reset_breakers()
+        w, _ = eigsh_mnmg(csr, k=4, mesh=_submesh(2), which="SA",
+                          maxiter=50, tol=1e-6,
+                          resume_from=os.path.join(d, ckpts[0]))
+
+        from scipy.sparse.linalg import eigsh as scipy_eigsh
+
+        ws = scipy_eigsh(A.astype(np.float64), k=4, which="SA")[0]
+        np.testing.assert_allclose(np.sort(np.asarray(w)), np.sort(ws),
+                                   atol=1e-4)
+
+    def test_kmeans_deadline_expiry_is_typed(self):
+        import raft_tpu
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        rng = np.random.default_rng(0)
+        x = np.concatenate(
+            [rng.normal(c, 0.3, (100, 5)) for c in range(3)]
+        ).astype(np.float32)
+        res = raft_tpu.device_resources(seed=0)
+        with limits.deadline_scope(0.0):
+            with pytest.raises(limits.DeadlineExceededError):
+                kmeans_fit(res, KMeansParams(n_clusters=3, max_iter=20,
+                                             seed=0), x)
