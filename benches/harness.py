@@ -16,6 +16,22 @@ from typing import Callable, Optional
 
 import jax
 
+# Provenance era: bumped when a PR changes what a bench row MEANS
+# (timing scheme, FLOP convention, workload shape). Readers treat a row
+# whose era is below the newest era seen for that bench family — or one
+# carrying a ``superseded_by`` marker — as historical, never current.
+BENCH_ERA = 6
+
+
+def is_current_row(d: dict, newest_era: int) -> bool:
+    """Shared row-validity predicate for BENCH_r0*.json readers: a row
+    is current iff nothing supersedes it and it belongs to the newest
+    era present for its bench family (rows predating era stamping count
+    as era 0)."""
+    if d.get("superseded_by"):
+        return False
+    return int(d.get("era", 0) or 0) >= newest_era
+
 
 @dataclass
 class BenchResult:
@@ -37,7 +53,8 @@ class BenchResult:
     MXU_GFLOPS = 197_000.0
 
     def json_line(self) -> str:
-        out = {"bench": self.name, "median_ms": round(self.median_ms, 4),
+        out = {"bench": self.name, "era": BENCH_ERA,
+               "median_ms": round(self.median_ms, 4),
                "best_ms": round(self.best_ms, 4), "repeats": self.repeats}
         on_tpu = jax.default_backend() == "tpu"
         if self.items_per_s is not None:

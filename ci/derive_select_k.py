@@ -18,8 +18,22 @@ from collections import defaultdict
 HBM_GB_S = 819.0     # v5e
 
 
+def current_rows(rows):
+    """Provenance filter (mirrors benches.harness.is_current_row —
+    inlined because ci/ scripts run outside the package path): drop
+    superseded rows and, per bench name, rows older than the newest
+    era present (pre-stamping rows count as era 0)."""
+    rows = [r for r in rows if not r.get("superseded_by")]
+    newest = {}
+    for r in rows:
+        e = int(r.get("era", 0) or 0)
+        newest[r["bench"]] = max(newest.get(r["bench"], 0), e)
+    return [r for r in rows
+            if int(r.get("era", 0) or 0) >= newest[r["bench"]]]
+
+
 def main(path):
-    cells = defaultdict(dict)    # (length, k) -> {algo: row}
+    rows = []
     for line in open(path):
         line = line.strip()
         if not line.startswith("{"):
@@ -33,6 +47,9 @@ def main(path):
             continue
         if r.get("partial"):
             continue
+        rows.append(r)
+    cells = defaultdict(dict)    # (length, k) -> {algo: row}
+    for r in current_rows(rows):
         cells[(r["length"], r["k"])][r["algo"]] = r
 
     if not cells:

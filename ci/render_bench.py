@@ -9,6 +9,21 @@ import json
 import sys
 
 
+def current_rows(rows):
+    """Provenance filter (mirrors benches.harness.is_current_row —
+    inlined because ci/ scripts run outside the package path): drop
+    rows a later measurement retired (``superseded_by``) and, per bench
+    name, rows older than the newest era present in the file (rows
+    predating era stamping count as era 0)."""
+    rows = [r for r in rows if not r.get("superseded_by")]
+    newest = {}
+    for r in rows:
+        e = int(r.get("era", 0) or 0)
+        newest[r["bench"]] = max(newest.get(r["bench"], 0), e)
+    return [r for r in rows
+            if int(r.get("era", 0) or 0) >= newest[r["bench"]]]
+
+
 def main(path: str) -> None:
     rows = []
     with open(path) as f:
@@ -22,12 +37,13 @@ def main(path: str) -> None:
                 continue
             if "bench" in row:          # skip family_done marker lines
                 rows.append(row)
+    rows = current_rows(rows)
     if not rows:
         print("(no results)")
         return
     print("| bench | median ms | throughput | params |")
     print("|---|---|---|---|")
-    skip = {"bench", "median_ms", "best_ms", "repeats"}
+    skip = {"bench", "median_ms", "best_ms", "repeats", "era"}
     for r in sorted(rows, key=lambda r: r["bench"]):
         thr = ""
         for k, unit in (("GFLOP_per_s", "GFLOP/s"), ("GB_per_s", "GB/s"),

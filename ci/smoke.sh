@@ -376,4 +376,79 @@ assert rc != 0, "malformed RAFT_TPU_HBM_BUDGET must fail at import"
 print("admission gate: tiled == monolithic bit-for-bit; "
       "rejection carries estimate; malformed budget fails loud")
 PYEOF
+
+# Serving gate (ISSUE 6 acceptance): a few seconds of load generation on
+# CPU must show real coalescing (factor > 1) with a reported p99, zero
+# recompiles after AOT warmup, at least one typed RejectedError under a
+# forced tiny queue, and a JSONL obs stream that validates against the
+# schema.
+SERVE_JSONL=$(mktemp /tmp/serve_obs.XXXXXX.jsonl)
+RAFT_TPU_METRICS=on RAFT_TPU_METRICS_JSONL="$SERVE_JSONL" \
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+
+from raft_tpu import obs, serve
+from raft_tpu.runtime import limits
+
+rng = np.random.default_rng(0)
+db = rng.standard_normal((1024, 32)).astype(np.float32)
+
+ex = serve.Executor(
+    [serve.KnnService(db, k=8)],
+    policy=serve.BatchPolicy(max_batch=64, max_wait_ms=2.0))
+ex.warm()
+traces_at_warm = ex.stats.traces
+with ex:
+    rep = serve.closed_loop(ex, "knn_k8_l2", clients=6, rows=4,
+                            duration_s=1.5)
+
+assert rep.completed > 0, "loadgen completed no requests"
+assert rep.coalescing_factor > 1.0, \
+    f"no coalescing happened (factor={rep.coalescing_factor:.2f})"
+assert np.isfinite(rep.p99_ms) and rep.p99_ms > 0, "p99 must be reported"
+assert ex.stats.traces == traces_at_warm, (
+    f"{ex.stats.traces - traces_at_warm} recompiles after AOT warmup")
+
+# backpressure: a 2-deep queue with no executor draining it must refuse
+# the third submit with the typed, metered rejection
+tiny = serve.Executor(
+    [serve.KnnService(db, k=8)],
+    policy=serve.BatchPolicy(max_batch=64, max_wait_ms=1000.0,
+                             max_queue=2))
+tiny.submit("knn_k8_l2", rng.standard_normal((1, 32)))
+tiny.submit("knn_k8_l2", rng.standard_normal((1, 32)))
+rejections = 0
+try:
+    tiny.submit("knn_k8_l2", rng.standard_normal((1, 32)))
+except limits.RejectedError as exc:
+    assert exc.reason == "queue_full", exc.reason
+    rejections += 1
+assert rejections >= 1, "tiny queue must raise typed RejectedError"
+fam = obs.snapshot()["metrics"].get("limits_rejected_total")
+assert fam and sum(
+    s["value"] for s in fam["series"]
+    if s["labels"].get("reason") == "queue_full") >= 1, \
+    "queue_full rejection must be metered through limits_rejected_total"
+
+# flush the env-attached JSONL sink (atexit would too; be explicit)
+sink = obs.get_sink()
+if sink is not None:
+    sink.close()
+print(f"serving gate: {rep.completed} reqs at {rep.qps:.0f} q/s, "
+      f"coalescing {rep.coalescing_factor:.1f}, p99 {rep.p99_ms:.2f} ms, "
+      f"0 recompiles, {rejections} typed rejection(s)")
+PYEOF
+
+JAX_PLATFORMS=cpu python - "$SERVE_JSONL" <<'PYEOF'
+import sys
+
+from raft_tpu.obs.schema import validate_jsonl
+
+path = sys.argv[1]
+n, errors = validate_jsonl(path)
+assert n > 0, f"serving run wrote no JSONL records to {path}"
+assert not errors, f"obs JSONL schema violations: {errors[:5]}"
+print(f"serving obs stream: {n} JSONL records validate against schema")
+PYEOF
+rm -f "$SERVE_JSONL"
 echo "smoke: PASS"

@@ -20,6 +20,7 @@ the contract ci/smoke.sh validates via :mod:`raft_tpu.obs.schema`.
 
 from __future__ import annotations
 
+import atexit
 import collections
 import io
 import json
@@ -61,6 +62,7 @@ class JsonlSink:
         """``target`` is a path (opened for append) or a file-like
         object with ``write``/``flush``."""
         self._lock = threading.Lock()
+        self._closed = False
         if isinstance(target, (str, bytes)) or hasattr(target, "__fspath__"):
             self._fh = open(target, "a", encoding="utf-8")
             self._owns = True
@@ -71,11 +73,28 @@ class JsonlSink:
     def write(self, record: dict) -> None:
         line = json.dumps(_json_safe(record), separators=(",", ":"))
         with self._lock:
+            if self._closed:
+                return
             self._fh.write(line + "\n")
             self._fh.flush()
 
-    def close(self) -> None:
+    def flush(self) -> None:
         with self._lock:
+            if not self._closed:
+                self._fh.flush()
+
+    def close(self) -> None:
+        """Flush and (when this sink opened the file) close it.
+        Idempotent — safe to call from both user code and the atexit
+        hook."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._fh.flush()
+            except ValueError:      # underlying stream already closed
+                pass
             if self._owns:
                 self._fh.close()
 
@@ -229,6 +248,17 @@ def _maybe_attach_env_sink() -> None:
     path = os.environ.get("RAFT_TPU_METRICS_JSONL")
     if path and _metrics.enabled() and get_sink() is None:
         set_sink(JsonlSink(path))
+
+
+@atexit.register
+def _atexit_close_sink() -> None:
+    """Flush+close the attached sink at interpreter shutdown so a
+    short-lived process (a serving bench, a smoke gate) never drops its
+    final buffered lines. close() is idempotent, so a sink the caller
+    already closed is a no-op here."""
+    sink = get_sink()
+    if sink is not None:
+        sink.close()
 
 
 _maybe_attach_env_sink()

@@ -1,0 +1,42 @@
+"""raft_tpu.serve — shape-bucketed dynamic batching with multi-tenant
+QoS on top of the PR 3–5 robustness stack.
+
+The serving runtime coalesces many small per-user query blocks into the
+large padded batches the accelerator is fast at, while keeping the
+per-request contract: bit-identical results, typed errors
+(``RejectedError`` backpressure, ``DeadlineExceededError`` expiry),
+and weighted-fair scheduling across tenants.
+
+Quickstart::
+
+    from raft_tpu import serve
+
+    ex = serve.Executor(
+        [serve.KnnService(db, k=10)],
+        policy=serve.BatchPolicy(max_batch=256, max_wait_ms=5.0),
+        qos=serve.QosPolicy({"gold": serve.TenantPolicy(weight=4.0)}),
+    )
+    ex.warm()                       # zero compiles after this
+    with ex:                        # start/stop the drain thread
+        fut = ex.submit("knn_k10_l2", queries, tenant="gold",
+                        deadline_s=0.1)
+        dist, idx = fut.result(timeout=1.0)
+"""
+
+from raft_tpu.serve.executor import (Executor, ExecutorStats, KnnService,
+                                     KMeansPredictService,
+                                     PairwiseService, Service)
+from raft_tpu.serve.loadgen import LoadReport, closed_loop, open_loop
+from raft_tpu.serve.qos import QosPolicy, TenantPolicy
+from raft_tpu.serve.queue import (BUCKET_FLOOR, Batch, BatchPolicy,
+                                  Request, RequestQueue, ResultFuture,
+                                  bucket_ladder, bucket_rows)
+
+__all__ = [
+    "BUCKET_FLOOR", "bucket_rows", "bucket_ladder",
+    "Request", "ResultFuture", "Batch", "BatchPolicy", "RequestQueue",
+    "TenantPolicy", "QosPolicy",
+    "Service", "KnnService", "PairwiseService", "KMeansPredictService",
+    "Executor", "ExecutorStats",
+    "LoadReport", "closed_loop", "open_loop",
+]

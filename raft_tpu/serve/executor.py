@@ -1,0 +1,535 @@
+"""Batch executor: per-bucket warmed executables, one device launch per
+coalesced batch (serving tentpole, part 2).
+
+Three service kinds wrap the library's row-independent query primitives
+— brute-force kNN (:class:`KnnService`), pairwise distance
+(:class:`PairwiseService`), and kmeans assignment
+(:class:`KMeansPredictService`). Row independence is the whole game:
+each output row depends only on its own query row plus the service's
+fixed operand (database / corpus / centroids), so concatenating many
+tenants' rows, padding to the shape bucket, launching once, and slicing
+back per request is BIT-IDENTICAL to running each request alone (the
+same invariant the PR-5 row-tiled degraded paths are CI-gated on).
+
+Compile discipline: a serving executable is built once per
+(service, bucket) through :mod:`raft_tpu.runtime.aot` —
+``aot_export`` lowers the traced function to a versioned StableHLO
+artifact, and the executor runs ``jax.jit(exported.call)`` so repeat
+launches hit the jit cache with zero Python retracing (functions whose
+lowering cannot serialize fall back to plain ``jax.jit``, same
+warm-once contract). :meth:`Executor.warm` walks the bucket ladder and
+invokes every executable once, so steady-state serving performs ZERO
+compiles — asserted by tests via the executor's trace counter (the
+Python-trace hook that ticks exactly when a jit cache misses) and
+metered through ``runtime_compile_cache_total{cache="serve"}``.
+
+QoS enforcement at dispatch (policy in ``serve/qos.py``):
+
+- requests that expired in queue fail fast with
+  ``DeadlineExceededError`` before any padding or launch;
+- a batch whose footprint estimate exceeds the serving budget is SPLIT
+  in half recursively (each half re-buckets to a smaller warmed
+  executable — the serve-layer spelling of row tiling);
+- a single request that cannot fit even alone runs EAGERLY under
+  ``limits.budget_scope``, where the PR-5 instrumented entry points
+  degrade to their bit-identical row-tiled paths or raise the typed
+  ``RejectedError`` the caller's future surfaces.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.runtime import limits
+from raft_tpu.serve.queue import (Batch, BatchPolicy, Request,
+                                  RequestQueue, bucket_ladder,
+                                  bucket_rows)
+
+__all__ = [
+    "Service", "KnnService", "PairwiseService", "KMeansPredictService",
+    "Executor", "ExecutorStats",
+]
+
+
+class Service:
+    """One servable query op: a fixed operand (database, corpus,
+    centroids) plus a pure row-independent function of the query block.
+
+    Subclasses define ``_build()`` returning the traced function (first
+    arguments = the fixed operands, last = the query block) and
+    :meth:`unpack` mapping (batched output, row span) to one request's
+    result."""
+
+    name: str = "service"
+
+    def __init__(self, fixed_args: Tuple, dim: int, dtype=jnp.float32):
+        self.fixed_args = tuple(jnp.asarray(a) for a in fixed_args)
+        self.dim = int(dim)
+        self.dtype = jnp.dtype(dtype)
+
+    # -- subclass surface ---------------------------------------------
+
+    def _build(self) -> Callable:
+        raise NotImplementedError
+
+    def unpack(self, out, start: int, rows: int):
+        """Slice one request's rows back out of the batched output."""
+        raise NotImplementedError
+
+    def estimate_bytes(self, rows: int) -> int:
+        """HBM footprint estimate for a ``rows``-row launch (feeds the
+        batch budget check)."""
+        raise NotImplementedError
+
+    def eager(self, queries):
+        """Unbatched reference path — the public API call the degraded
+        (budget_scope) route takes. Must return exactly what
+        :meth:`unpack` returns for those rows."""
+        raise NotImplementedError
+
+    # -- shared -------------------------------------------------------
+
+    def example(self, rows: int) -> jnp.ndarray:
+        return jnp.zeros((rows, self.dim), self.dtype)
+
+    def validate(self, queries: np.ndarray) -> None:
+        if queries.ndim != 2 or queries.shape[1] != self.dim:
+            raise ValueError(
+                f"{self.name}: queries must be [rows, {self.dim}], "
+                f"got {queries.shape}")
+
+
+class KnnService(Service):
+    """Batched brute-force kNN against a fixed database
+    (:func:`raft_tpu.neighbors.knn`). Per-request result:
+    ``(distances [rows, k], indices [rows, k])``."""
+
+    def __init__(self, db, k: int, metric: str = "l2"):
+        db = jnp.asarray(db)
+        super().__init__((db,), dim=db.shape[1], dtype=db.dtype)
+        self.k = int(k)
+        self.metric = metric
+        self.name = f"knn_k{k}_{metric}"
+
+    def _build(self):
+        from raft_tpu.neighbors import knn
+
+        k, metric = self.k, self.metric
+
+        def fn(db, q):
+            return knn(None, db, q, k=k, metric=metric)
+        return fn
+
+    def unpack(self, out, start, rows):
+        d, i = out
+        return d[start:start + rows], i[start:start + rows]
+
+    def estimate_bytes(self, rows):
+        db = self.fixed_args[0]
+        return limits.estimate_bytes(
+            "neighbors.brute_force_knn", n_queries=rows,
+            n_db=db.shape[0], n_dims=self.dim, k=self.k,
+            itemsize=self.dtype.itemsize)
+
+    def eager(self, queries):
+        from raft_tpu.neighbors import knn
+
+        return knn(None, self.fixed_args[0], jnp.asarray(queries),
+                   k=self.k, metric=self.metric)
+
+
+class PairwiseService(Service):
+    """Batched pairwise distance rows against a fixed corpus
+    (:func:`raft_tpu.distance.pairwise_distance`). Per-request result:
+    the ``[rows, n_corpus]`` distance block."""
+
+    def __init__(self, corpus, metric=None):
+        from raft_tpu.distance import DistanceType
+
+        corpus = jnp.asarray(corpus)
+        super().__init__((corpus,), dim=corpus.shape[1],
+                         dtype=corpus.dtype)
+        self.metric = metric or DistanceType.L2Expanded
+        self.name = f"pairwise_{self.metric.value}"
+
+    def _build(self):
+        from raft_tpu.distance import pairwise_distance
+
+        metric = self.metric
+
+        def fn(corpus, q):
+            return pairwise_distance(None, q, corpus, metric=metric)
+        return fn
+
+    def unpack(self, out, start, rows):
+        return out[start:start + rows]
+
+    def estimate_bytes(self, rows):
+        corpus = self.fixed_args[0]
+        return limits.estimate_bytes(
+            "distance.pairwise_distance", m=rows, n=corpus.shape[0],
+            k=self.dim, itemsize=self.dtype.itemsize)
+
+    def eager(self, queries):
+        from raft_tpu.distance import pairwise_distance
+
+        return pairwise_distance(None, jnp.asarray(queries),
+                                 self.fixed_args[0], metric=self.metric)
+
+
+class KMeansPredictService(Service):
+    """Batched nearest-centroid assignment against fixed centroids.
+    Per-request result: ``(labels [rows], inertia)`` — the
+    :func:`raft_tpu.cluster.kmeans.kmeans_predict` contract, with the
+    inertia summed over the request's own rows only."""
+
+    def __init__(self, centroids):
+        centroids = jnp.asarray(centroids)
+        super().__init__((centroids,), dim=centroids.shape[1],
+                         dtype=centroids.dtype)
+        self.name = f"kmeans_predict_k{centroids.shape[0]}"
+
+    def _build(self):
+        from raft_tpu.cluster.kmeans import _assign
+        from raft_tpu.util import precision
+
+        def fn(centroids, q):
+            # same precision scope as the public kmeans_predict — the
+            # per-row (dist, label) pairs must match it bit-for-bit
+            with precision.scope():
+                dist, labels = _assign(q, centroids)
+            return dist, labels
+        return fn
+
+    def unpack(self, out, start, rows):
+        dist, labels = out
+        sl = slice(start, start + rows)
+        return labels[sl], jnp.sum(dist[sl])
+
+    def estimate_bytes(self, rows):
+        c = self.fixed_args[0]
+        return limits.estimate_bytes(
+            "distance.pairwise_distance", m=rows, n=c.shape[0],
+            k=self.dim, itemsize=self.dtype.itemsize)
+
+    def eager(self, queries):
+        from raft_tpu.cluster.kmeans import kmeans_predict
+
+        return kmeans_predict(None, jnp.asarray(queries),
+                              self.fixed_args[0])
+
+
+@dataclass
+class ExecutorStats:
+    """Serving counters (process-local, metrics-independent — the load
+    generator reads these even with ``RAFT_TPU_METRICS=off``)."""
+
+    batches: int = 0
+    requests: int = 0
+    rows: int = 0                   # real rows launched
+    padded_rows: int = 0            # pad overhead launched
+    splits: int = 0                 # budget-driven batch splits
+    degraded: int = 0               # eager budget_scope fallbacks
+    deadline_failed: int = 0
+    traces: int = 0                 # Python retraces (compile events)
+    exec_hits: int = 0              # executable-cache hits
+    exec_misses: int = 0
+    per_batch_rows: List[int] = field(default_factory=list)
+
+    def coalescing_factor(self) -> float:
+        """Mean real rows per device launch — the number the bench
+        reports (1.0 = no coalescing happening)."""
+        return self.rows / self.batches if self.batches else 0.0
+
+
+class Executor:
+    """Drains a :class:`RequestQueue` and issues one device launch per
+    coalesced batch, through per-bucket AOT-warmed executables."""
+
+    def __init__(self, services: Sequence[Service],
+                 queue: Optional[RequestQueue] = None, *,
+                 policy: Optional[BatchPolicy] = None, qos=None,
+                 use_aot: bool = True):
+        self.services: Dict[str, Service] = {s.name: s for s in services}
+        self.qos = qos
+        self.queue = queue or RequestQueue(policy, qos=qos)
+        if self.queue.qos is None:
+            self.queue.qos = qos
+        self.use_aot = use_aot
+        self.stats = ExecutorStats()
+        self._executables: Dict[Tuple[str, int], Callable] = {}
+        self._exec_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- client surface -----------------------------------------------
+
+    def submit(self, op: str, queries, *, tenant: str = "default",
+               deadline_s: Optional[float] = None):
+        """Validate against the service and enqueue; returns the
+        request's :class:`~raft_tpu.serve.queue.ResultFuture`."""
+        svc = self._service(op)
+        q = np.asarray(queries, svc.dtype)
+        svc.validate(q)
+        return self.queue.submit(op, q, tenant=tenant,
+                                 deadline_s=deadline_s)
+
+    def _service(self, op: str) -> Service:
+        svc = self.services.get(op)
+        if svc is None:
+            raise ValueError(f"unknown serve op {op!r}; registered: "
+                             f"{sorted(self.services)}")
+        return svc
+
+    # -- executable cache ---------------------------------------------
+
+    def _get_executable(self, svc: Service, rows: int) -> Callable:
+        key = (svc.name, rows)
+        exe = self._executables.get(key)
+        if exe is not None:
+            self.stats.exec_hits += 1
+            obs.inc("runtime_compile_cache_total", 1, cache="serve",
+                    outcome="hit")
+            return exe
+        with self._exec_lock:
+            exe = self._executables.get(key)
+            if exe is None:
+                exe = self._build_executable(svc, rows)
+                self._executables[key] = exe
+        return exe
+
+    def _build_executable(self, svc: Service, rows: int) -> Callable:
+        self.stats.exec_misses += 1
+        obs.inc("runtime_compile_cache_total", 1, cache="serve",
+                outcome="miss")
+        fn = svc._build()
+        stats = self.stats
+
+        def traced(*args):
+            # host side effect runs at TRACE time only: this is the
+            # compile-count hook the zero-recompile assertion reads
+            stats.traces += 1
+            return fn(*args)
+
+        example = (*svc.fixed_args, svc.example(rows))
+        if self.use_aot:
+            from raft_tpu.runtime.aot import aot_export
+
+            try:
+                exported = aot_export(traced, *example)
+                return jax.jit(exported.call)
+            except Exception:
+                # lowering not serializable (some interpret-mode Pallas
+                # bodies): same warm-once contract via plain jit
+                obs.emit_event("serve.aot_fallback", service=svc.name,
+                               rows=rows)
+        return jax.jit(traced)
+
+    def warm(self, buckets: Optional[Sequence[int]] = None) -> int:
+        """Build AND invoke the executable for every (service, bucket)
+        so steady-state serving never compiles. Default buckets: the
+        ladder up to the queue's ``max_batch``. Returns the number of
+        executables warmed."""
+        if buckets is None:
+            buckets = bucket_ladder(self.queue.policy.max_batch)
+        n = 0
+        for svc in self.services.values():
+            t0 = time.monotonic()
+            for b in buckets:
+                exe = self._get_executable(svc, b)
+                out = exe(*svc.fixed_args, svc.example(b))
+                jax.block_until_ready(out)
+                n += 1
+            dt = time.monotonic() - t0
+            obs.observe("serve_warmup_seconds", dt, service=svc.name)
+            obs.emit_event("serve.warmed", service=svc.name,
+                           buckets=list(buckets), seconds=round(dt, 4))
+        return n
+
+    # -- dispatch -----------------------------------------------------
+
+    def _fail(self, req: Request, exc: BaseException) -> None:
+        req.future.set_exception(exc)
+
+    def _expire_check(self, reqs: List[Request]) -> List[Request]:
+        live = []
+        for r in reqs:
+            if r.expired():
+                self.stats.deadline_failed += 1
+                obs.inc("limits_deadline_exceeded_total", 1,
+                        op=f"serve.{r.op}")
+                self._fail(r, limits.DeadlineExceededError(
+                    f"serve.{r.op}: deadline expired in queue "
+                    f"({r.deadline.budget_s:g}s budget, waited "
+                    f"{time.monotonic() - r.t_enqueue:.3f}s)",
+                    op=f"serve.{r.op}", budget_s=r.deadline.budget_s))
+            else:
+                live.append(r)
+        return live
+
+    def dispatch(self, batch: Batch) -> None:
+        """Run one coalesced batch to completion (expiry fast-fail,
+        budget split/degrade, pad-to-bucket, launch, unpad)."""
+        svc = self._service(batch.op)
+        live = self._expire_check(batch.requests)
+        if not live:
+            return
+        self._dispatch_within_budget(svc, live)
+
+    def _dispatch_within_budget(self, svc: Service,
+                                reqs: List[Request]) -> None:
+        rows = sum(r.rows for r in reqs)
+        budget = self.qos.batch_budget() if self.qos is not None \
+            else limits.active_budget()
+        if budget is not None and \
+                svc.estimate_bytes(bucket_rows(rows)) > budget.limit_bytes:
+            if len(reqs) > 1:
+                # split: both halves land on smaller, already-warm
+                # buckets — the serve-layer row tiling
+                self.stats.splits += 1
+                obs.inc("serve_batch_splits_total", 1, op=svc.name)
+                mid = len(reqs) // 2
+                self._dispatch_within_budget(svc, reqs[:mid])
+                self._dispatch_within_budget(svc, reqs[mid:])
+                return
+            self._dispatch_degraded(svc, reqs[0], budget)
+            return
+        self._launch(svc, reqs, rows)
+
+    def _dispatch_degraded(self, svc: Service, req: Request,
+                           budget: limits.WorkBudget) -> None:
+        """Single request over the batch budget: run the public API
+        eagerly under ``budget_scope`` — the PR-5 row-tiled degraded
+        path keeps the footprint bounded and the bits identical, or
+        raises the typed rejection this future surfaces."""
+        self.stats.degraded += 1
+        obs.inc("serve_degraded_total", 1, op=svc.name)
+        try:
+            scope_s = req.deadline.remaining() if req.deadline else None
+            with limits.budget_scope(budget):
+                if scope_s is not None:
+                    with limits.deadline_scope(max(scope_s, 0.0)):
+                        out = svc.eager(req.queries)
+                else:
+                    out = svc.eager(req.queries)
+            jax.block_until_ready(out)
+        except (limits.RejectedError,
+                limits.DeadlineExceededError) as exc:
+            self._fail(req, exc)
+            return
+        except Exception as exc:  # noqa: BLE001 — future must resolve
+            self._fail(req, exc)
+            return
+        self._finish(svc, [req], out, batched=False)
+
+    def _launch(self, svc: Service, reqs: List[Request],
+                rows: int) -> None:
+        brows = bucket_rows(rows)
+        padded = np.zeros((brows, svc.dim), svc.dtype)
+        at = 0
+        for r in reqs:
+            padded[at:at + r.rows] = r.queries
+            at += r.rows
+        exe = self._get_executable(svc, brows)
+        t0 = time.monotonic()
+        try:
+            out = exe(*svc.fixed_args, jnp.asarray(padded))
+            jax.block_until_ready(out)
+        except Exception as exc:  # noqa: BLE001 — futures must resolve
+            for r in reqs:
+                self._fail(r, exc)
+            return
+        dt = time.monotonic() - t0
+        self.stats.batches += 1
+        self.stats.rows += rows
+        self.stats.padded_rows += brows - rows
+        self.stats.per_batch_rows.append(rows)
+        if obs.enabled():
+            obs.observe("serve_batch_rows", rows,
+                        help="real rows per coalesced device launch")
+            obs.observe("serve_launch_seconds", dt, op=svc.name)
+            now = time.monotonic()
+            for r in reqs:
+                obs.observe("serve_queue_wait_seconds",
+                            now - r.t_enqueue,
+                            help="submit-to-launch-complete wait")
+        self._finish(svc, reqs, out, batched=True)
+
+    def _finish(self, svc: Service, reqs: List[Request], out,
+                batched: bool) -> None:
+        at = 0
+        for r in reqs:
+            if r.expired():
+                # computed but missed its SLO: the contract is the
+                # deadline, not best-effort delivery
+                self.stats.deadline_failed += 1
+                obs.inc("limits_deadline_exceeded_total", 1,
+                        op=f"serve.{r.op}")
+                self._fail(r, limits.DeadlineExceededError(
+                    f"serve.{r.op}: deadline expired during execution",
+                    op=f"serve.{r.op}", budget_s=r.deadline.budget_s))
+            elif batched:
+                r.future.set_result(svc.unpack(out, at, r.rows))
+            else:
+                r.future.set_result(out)
+            self.stats.requests += 1
+            obs.inc("serve_requests_total", 1, op=svc.name,
+                    tenant=r.tenant)
+            at += r.rows
+
+    # -- worker loop --------------------------------------------------
+
+    def start(self) -> "Executor":
+        """Spawn the drain thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="raft-tpu-serve",
+                                        daemon=True)
+        self._thread.start()
+        obs.emit_event("serve.start", services=sorted(self.services))
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self.queue.next_batch(timeout=0.05)
+            if batch is None:
+                continue
+            self.dispatch(batch)
+        # drain what is left so no future hangs across stop()
+        while True:
+            batch = self.queue.next_batch(timeout=0.0)
+            if batch is None or not batch.requests:
+                break
+            self.dispatch(batch)
+
+    def stop(self, *, close_queue: bool = True) -> None:
+        """Stop the worker; by default also closes the queue (new
+        submits fail) and drains pending requests first."""
+        if close_queue:
+            self.queue.close()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        s = self.stats
+        obs.emit_event(
+            "serve.stop", batches=s.batches, requests=s.requests,
+            rows=s.rows, coalescing=round(s.coalescing_factor(), 3),
+            splits=s.splits, degraded=s.degraded,
+            deadline_failed=s.deadline_failed)
+
+    def __enter__(self) -> "Executor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

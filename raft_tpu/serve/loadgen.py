@@ -1,0 +1,224 @@
+"""Load generator for the serving runtime (serving tentpole, part 4).
+
+Two standard modes, both against a live :class:`~raft_tpu.serve.Executor`:
+
+closed loop
+    N client threads, each submit → wait → submit. Offered load tracks
+    service rate automatically, so the measured queries/sec IS the
+    saturation throughput for that concurrency; latency is the classic
+    closed-loop response time.
+open loop
+    requests arrive on a fixed schedule (Poisson or uniform) regardless
+    of completions — the arrival process real traffic has. Latency
+    percentiles under open loop expose queueing delay that closed loop
+    hides (coordinated omission).
+
+Both report p50/p99 latency, achieved queries/sec and rows/sec, the
+executor's coalescing factor (real rows per device launch), and the
+typed-error counts (rejections, deadline expiries) — the numbers the
+acceptance bench (``bench.py --serve``) emits to ``BENCH_r06.json``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from raft_tpu.runtime import limits
+
+__all__ = ["LoadReport", "closed_loop", "open_loop"]
+
+
+@dataclass
+class LoadReport:
+    """One load-generation run, summarized."""
+
+    mode: str
+    duration_s: float
+    completed: int = 0
+    rejected: int = 0                   # typed RejectedError
+    deadline_failed: int = 0            # typed DeadlineExceededError
+    rows: int = 0
+    latencies_ms: List[float] = field(default_factory=list)
+    coalescing_factor: float = 0.0
+    batches: int = 0
+    pad_overhead: float = 0.0           # padded rows / real rows
+
+    @property
+    def qps(self) -> float:
+        return self.completed / self.duration_s if self.duration_s else 0.0
+
+    @property
+    def rows_per_s(self) -> float:
+        return self.rows / self.duration_s if self.duration_s else 0.0
+
+    def percentile_ms(self, q: float) -> float:
+        if not self.latencies_ms:
+            return float("nan")
+        return float(np.percentile(np.asarray(self.latencies_ms), q))
+
+    @property
+    def p50_ms(self) -> float:
+        return self.percentile_ms(50.0)
+
+    @property
+    def p99_ms(self) -> float:
+        return self.percentile_ms(99.0)
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "mode": self.mode,
+            "duration_s": round(self.duration_s, 3),
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "deadline_failed": self.deadline_failed,
+            "rows": self.rows,
+            "qps": round(self.qps, 2),
+            "rows_per_s": round(self.rows_per_s, 1),
+            "p50_ms": round(self.p50_ms, 3),
+            "p99_ms": round(self.p99_ms, 3),
+            "coalescing_factor": round(self.coalescing_factor, 3),
+            "batches": self.batches,
+            "pad_overhead": round(self.pad_overhead, 4),
+        }
+
+
+def _snapshot(executor) -> tuple:
+    s = executor.stats
+    return (s.batches, s.rows, s.padded_rows)
+
+
+def _finalize(report: LoadReport, executor, before: tuple,
+              t0: float) -> LoadReport:
+    report.duration_s = time.monotonic() - t0
+    b0, r0, p0 = before
+    s = executor.stats
+    db, dr, dp = s.batches - b0, s.rows - r0, s.padded_rows - p0
+    report.batches = db
+    report.coalescing_factor = dr / db if db else 0.0
+    report.pad_overhead = dp / dr if dr else 0.0
+    return report
+
+
+def _record(report: LoadReport, lock: threading.Lock, rows: int,
+            t_submit: float, future, wait_s: float) -> None:
+    """Wait one future out and fold the outcome into the report."""
+    try:
+        future.result(timeout=wait_s)
+        ok, kind = True, None
+    except limits.RejectedError:
+        ok, kind = False, "rejected"
+    except limits.DeadlineExceededError:
+        ok, kind = False, "deadline"
+    except TimeoutError:
+        ok, kind = False, None
+    lat_ms = (time.monotonic() - t_submit) * 1e3
+    with lock:
+        if ok:
+            report.completed += 1
+            report.rows += rows
+            report.latencies_ms.append(lat_ms)
+        elif kind == "rejected":
+            report.rejected += 1
+        elif kind == "deadline":
+            report.deadline_failed += 1
+
+
+def closed_loop(executor, op: str, *, clients: int = 8,
+                rows: int = 4, duration_s: float = 2.0,
+                tenants: Optional[Sequence[str]] = None,
+                deadline_s: Optional[float] = None,
+                seed: int = 0, wait_s: float = 30.0) -> LoadReport:
+    """``clients`` threads in a submit→wait loop for ``duration_s``.
+    Tenant ``i`` is ``tenants[i % len(tenants)]`` (default: one shared
+    tenant), so a skewed tenant list doubles as a fairness workload."""
+    svc = executor._service(op)
+    tenants = list(tenants) if tenants else ["default"]
+    report = LoadReport(mode="closed", duration_s=0.0)
+    lock = threading.Lock()
+    stop = threading.Event()
+    before = _snapshot(executor)
+    t0 = time.monotonic()
+
+    def client(i: int) -> None:
+        rng = np.random.default_rng(seed + i)
+        tenant = tenants[i % len(tenants)]
+        while not stop.is_set():
+            q = rng.standard_normal((rows, svc.dim)).astype(svc.dtype)
+            t_submit = time.monotonic()
+            try:
+                fut = executor.submit(op, q, tenant=tenant,
+                                      deadline_s=deadline_s)
+            except limits.RejectedError:
+                with lock:
+                    report.rejected += 1
+                time.sleep(0.001)       # brief backoff, stay closed-loop
+                continue
+            _record(report, lock, rows, t_submit, fut, wait_s)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=wait_s)
+    return _finalize(report, executor, before, t0)
+
+
+def open_loop(executor, op: str, *, rate_qps: float = 200.0,
+              rows: int = 4, duration_s: float = 2.0,
+              tenants: Optional[Sequence[str]] = None,
+              deadline_s: Optional[float] = None,
+              poisson: bool = True, seed: int = 0,
+              wait_s: float = 30.0) -> LoadReport:
+    """Submit on a fixed arrival schedule (Poisson by default) without
+    waiting for completions — each in-flight request is awaited by a
+    collector thread, so measured latency includes queueing delay
+    (no coordinated omission)."""
+    svc = executor._service(op)
+    tenants = list(tenants) if tenants else ["default"]
+    rng = np.random.default_rng(seed)
+    report = LoadReport(mode="open", duration_s=0.0)
+    lock = threading.Lock()
+    collectors: List[threading.Thread] = []
+    before = _snapshot(executor)
+    t0 = time.monotonic()
+    end = t0 + duration_s
+    next_at = t0
+    i = 0
+    while True:
+        now = time.monotonic()
+        if now >= end:
+            break
+        if now < next_at:
+            time.sleep(min(next_at - now, 0.005))
+            continue
+        gap = (rng.exponential(1.0 / rate_qps) if poisson
+               else 1.0 / rate_qps)
+        next_at += gap
+        q = rng.standard_normal((rows, svc.dim)).astype(svc.dtype)
+        tenant = tenants[i % len(tenants)]
+        i += 1
+        t_submit = time.monotonic()
+        try:
+            fut = executor.submit(op, q, tenant=tenant,
+                                  deadline_s=deadline_s)
+        except limits.RejectedError:
+            with lock:
+                report.rejected += 1
+            continue
+        c = threading.Thread(
+            target=_record,
+            args=(report, lock, rows, t_submit, fut, wait_s),
+            daemon=True)
+        c.start()
+        collectors.append(c)
+    for c in collectors:
+        c.join(timeout=wait_s)
+    return _finalize(report, executor, before, t0)

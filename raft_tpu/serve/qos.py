@@ -1,0 +1,112 @@
+"""Multi-tenant QoS: per-tenant admission, deadlines, and budget-aware
+batch sizing (serving tentpole, part 3).
+
+This module owns POLICY; enforcement lives where the information is:
+
+- **Admission** (here + ``queue.py``): a tenant's share of the queue is
+  bounded (``max_queued``); an over-share submit raises
+  :class:`~raft_tpu.runtime.limits.RejectedError`
+  (``reason="queue_full"``) and ticks
+  ``limits_rejected_total{reason="queue_full"}`` — backpressure is the
+  same typed refusal the HBM admission layer gives an over-budget
+  launch, so callers need exactly one retry/shed policy.
+- **Deadlines** (``queue.py`` submit + ``executor.py`` drain): each
+  request is wired into a :class:`~raft_tpu.runtime.limits.Deadline`
+  (tenant default or per-request override). A request that expires in
+  queue fast-fails with ``DeadlineExceededError`` at drain — the launch
+  it would have wasted goes to requests that can still meet their SLO —
+  and the executor runs each batch under
+  :func:`~raft_tpu.runtime.limits.deadline_scope` of the tightest
+  surviving deadline so host-side work stays polled.
+- **Memory budget** (``executor.py`` dispatch): a coalesced batch whose
+  footprint estimate (``limits.estimate_bytes``) exceeds
+  :meth:`QosPolicy.batch_budget` is SPLIT into smaller (still-warm)
+  buckets; a single request that cannot fit even alone degrades through
+  the PR-5 row-tiled path by running eagerly under
+  :func:`~raft_tpu.runtime.limits.budget_scope` — bit-identical output,
+  bounded footprint — and only raises ``RejectedError`` when even that
+  cannot fit.
+- **Fairness** (``queue.py``): tenant ``weight`` feeds the weighted-fair
+  virtual clock; a heavy tenant gets proportionally more rows per unit
+  time, never the whole pipe.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from raft_tpu import obs
+from raft_tpu.runtime import limits
+
+__all__ = ["TenantPolicy", "QosPolicy"]
+
+
+@dataclass(frozen=True)
+class TenantPolicy:
+    """Per-tenant serving contract.
+
+    weight
+        fair-share weight (relative rows per unit time under load).
+    deadline_s
+        default request deadline; None = no deadline unless the request
+        carries one.
+    max_queued
+        per-tenant cap on queued requests (None = only the global
+        ``max_queue`` bounds it).
+    """
+
+    weight: float = 1.0
+    deadline_s: Optional[float] = None
+    max_queued: Optional[int] = None
+
+    def __post_init__(self):
+        if not self.weight > 0:
+            raise ValueError(f"tenant weight must be > 0, "
+                             f"got {self.weight}")
+        if self.max_queued is not None and self.max_queued < 1:
+            raise ValueError("max_queued must be >= 1 when set")
+
+
+class QosPolicy:
+    """Tenant policy table + the serving-side memory budget.
+
+    ``tenants`` maps tenant name -> :class:`TenantPolicy`; unknown
+    tenants get ``default``. ``budget`` is a
+    :class:`~raft_tpu.runtime.limits.WorkBudget` (or byte count) that
+    bounds one coalesced launch; None defers to the ambient
+    ``limits.active_budget()`` (env/scope), which may itself be None —
+    unbudgeted serving, the default."""
+
+    def __init__(self, tenants: Optional[Dict[str, TenantPolicy]] = None,
+                 *, default: Optional[TenantPolicy] = None, budget=None):
+        self.tenants = dict(tenants or {})
+        self.default = default or TenantPolicy()
+        if budget is None or isinstance(budget, limits.WorkBudget):
+            self._budget = budget
+        else:
+            self._budget = limits.WorkBudget(budget)
+
+    def policy(self, tenant: str) -> TenantPolicy:
+        return self.tenants.get(tenant, self.default)
+
+    def batch_budget(self) -> Optional[limits.WorkBudget]:
+        """The budget one coalesced launch must fit: the explicit
+        serving budget when set, else the ambient limits scope."""
+        return self._budget if self._budget is not None \
+            else limits.active_budget()
+
+    def check_tenant_share(self, op: str, tenant: str,
+                           tenant_pending: int) -> None:
+        """Per-tenant queue-share admission (called by
+        :meth:`~raft_tpu.serve.queue.RequestQueue.submit` under its
+        lock). Raises the same typed ``queue_full`` rejection as the
+        global cap, labeled with the tenant."""
+        cap = self.policy(tenant).max_queued
+        if cap is not None and tenant_pending >= cap:
+            obs.inc("limits_rejected_total", 1, reason="queue_full",
+                    op=f"serve.{op}")
+            raise limits.RejectedError(
+                f"serve.{op}: tenant {tenant!r} queue share full "
+                f"({tenant_pending} >= max_queued={cap})",
+                op=f"serve.{op}", reason="queue_full")

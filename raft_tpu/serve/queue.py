@@ -1,0 +1,373 @@
+"""Request queue with shape-bucket coalescing (serving tentpole, part 1).
+
+The unit of admission is a :class:`Request` — one tenant's small query
+block (a handful of rows) against a named service. The unit of device
+work is a *coalesced batch*: every drained request's rows concatenated,
+padded up to a shape bucket (:func:`bucket_rows` — power-of-two-ish row
+counts so the executor's per-bucket executables stay a small, warmable
+set), launched once, and sliced back per request. The queue is the
+boundary between the two: callers see per-request futures and typed
+errors; the executor sees batches.
+
+Batching policy (the classic dynamic-batching pair):
+
+``max_batch``
+    coalescing cap in ROWS — a batch is dispatched as soon as the
+    drained rows reach it (an oversize single request still forms its
+    own batch: the cap bounds coalescing, not request size).
+``max_wait_ms``
+    latency bound — a non-empty queue never holds its OLDEST request
+    longer than this before dispatch, however empty the batch.
+
+Backpressure and QoS are wired into the existing ``runtime/limits``
+taxonomy: a full queue raises
+:class:`~raft_tpu.runtime.limits.RejectedError` with
+``reason="queue_full"`` and ticks ``limits_rejected_total`` — the same
+typed refusal an over-budget launch gets — and every request carries a
+:class:`~raft_tpu.runtime.limits.Deadline` so expiry-in-queue fails fast
+instead of wasting a launch (the executor polls it at drain).
+
+Fairness: dequeue order across tenants is weighted fair queuing over a
+per-tenant virtual time (rows served divided by tenant weight; the
+lowest virtual time goes first). One tenant flooding the queue advances
+only its own clock, so a light tenant's next request dequeues almost
+immediately — starvation-freedom under a hog is a test, not a hope
+(``tests/test_serve.py``).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.runtime import limits
+
+__all__ = [
+    "BUCKET_FLOOR", "bucket_rows", "bucket_ladder",
+    "Request", "ResultFuture", "Batch", "BatchPolicy", "RequestQueue",
+]
+
+
+# Smallest bucket: one sublane group. Buckets ascend power-of-two-ish
+# (8, 12, 16, 24, 32, 48, 64, ...): each step is x1.5 or x1.33, so
+# pad-to-bucket waste is bounded at 33% while the number of distinct
+# executables per service stays logarithmic in max_batch.
+BUCKET_FLOOR = 8
+
+
+def bucket_rows(n: int, floor: int = BUCKET_FLOOR) -> int:
+    """Round a row count up to its shape bucket.
+
+    Buckets are ``floor * {1, 1.5, 2, 3, 4, 6, 8, ...}`` — powers of two
+    and their midpoints. Deterministic, monotone, and idempotent
+    (``bucket_rows(bucket_rows(n)) == bucket_rows(n)``).
+
+    >>> [bucket_rows(n) for n in (1, 8, 9, 12, 13, 17, 25, 100)]
+    [8, 8, 12, 12, 16, 24, 32, 128]
+    """
+    if n <= 0:
+        raise ValueError(f"row count must be positive, got {n}")
+    b = int(floor)
+    while b < n:
+        # alternate x1.5 (pow2 -> midpoint) and x4/3 (midpoint -> pow2)
+        b = b * 3 // 2 if (b & (b - 1)) == 0 else b * 4 // 3
+    return b
+
+
+def bucket_ladder(max_rows: int, floor: int = BUCKET_FLOOR) -> List[int]:
+    """Every bucket up to (and including) the one covering ``max_rows``
+    — the set the executor pre-warms so steady-state serving never meets
+    an unseen shape."""
+    out = [int(floor)]
+    while out[-1] < max_rows:
+        b = out[-1]
+        out.append(b * 3 // 2 if (b & (b - 1)) == 0 else b * 4 // 3)
+    return out
+
+
+class ResultFuture:
+    """One request's completion slot: the caller blocks on
+    :meth:`result`, the executor fulfills exactly once with either a
+    value or a typed exception."""
+
+    __slots__ = ("_event", "_value", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self, value) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the outcome; raises the typed serving error
+        (``DeadlineExceededError`` / ``RejectedError``) when the request
+        failed, ``TimeoutError`` when nothing arrived in ``timeout``."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request still pending")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+@dataclass
+class Request:
+    """One enqueued query block (internal to serve/)."""
+
+    op: str
+    queries: np.ndarray                 # [rows, dim], service dtype
+    tenant: str
+    seq: int                            # global arrival order
+    t_enqueue: float                    # monotonic
+    deadline: Optional[limits.Deadline] = None
+    future: ResultFuture = field(default_factory=ResultFuture)
+
+    @property
+    def rows(self) -> int:
+        return int(self.queries.shape[0])
+
+    def expired(self) -> bool:
+        return self.deadline is not None and self.deadline.expired()
+
+
+@dataclass
+class Batch:
+    """A drained, same-op set of requests the executor launches once."""
+
+    op: str
+    requests: List[Request]
+
+    @property
+    def rows(self) -> int:
+        return sum(r.rows for r in self.requests)
+
+
+@dataclass
+class BatchPolicy:
+    max_batch: int = 256                # coalescing cap, in rows
+    max_wait_ms: float = 5.0            # oldest-request latency bound
+    max_queue: int = 1024               # queued requests before backpressure
+
+    def __post_init__(self):
+        if self.max_batch < 1 or self.max_queue < 1:
+            raise ValueError("max_batch and max_queue must be >= 1")
+        if self.max_wait_ms < 0:
+            raise ValueError("max_wait_ms must be >= 0")
+
+
+class _OpState:
+    """Per-op pending requests + the weighted-fair clock."""
+
+    __slots__ = ("tenants", "vtime", "oldest_seq", "oldest_t", "rows")
+
+    def __init__(self):
+        self.tenants: Dict[str, Deque[Request]] = {}
+        self.vtime: Dict[str, float] = {}
+        self.rows = 0
+
+    def push(self, req: Request, weight: float) -> None:
+        dq = self.tenants.get(req.tenant)
+        if dq is None:
+            dq = self.tenants[req.tenant] = collections.deque()
+        if not dq:
+            # (re)activation: no banked credit — an idle tenant's clock
+            # catches up to the busiest floor so it cannot burst-starve
+            # others, but keeps its fair-share head start
+            active = [self.vtime[t] for t, d in self.tenants.items()
+                      if d and t != req.tenant]
+            floor = min(active) if active else 0.0
+            self.vtime[req.tenant] = max(
+                self.vtime.get(req.tenant, 0.0), floor)
+        dq.append(req)
+        self.rows += req.rows
+
+    def oldest(self) -> Optional[Request]:
+        head = [d[0] for d in self.tenants.values() if d]
+        return min(head, key=lambda r: r.seq) if head else None
+
+    def peek_fair(self) -> Optional[Request]:
+        """The head request of the lowest-virtual-time tenant (ties go
+        to arrival order) — the next fair pop, without committing."""
+        live = [t for t, d in self.tenants.items() if d]
+        if not live:
+            return None
+        t = min(live, key=lambda t: (self.vtime.get(t, 0.0),
+                                     self.tenants[t][0].seq))
+        return self.tenants[t][0]
+
+    def pop(self, req: Request, weight: float) -> None:
+        """Commit a :meth:`peek_fair` choice: dequeue it and advance its
+        tenant's virtual clock by rows/weight."""
+        popped = self.tenants[req.tenant].popleft()
+        assert popped is req
+        self.vtime[req.tenant] = (self.vtime.get(req.tenant, 0.0)
+                                  + req.rows / weight)
+        self.rows -= req.rows
+
+    def empty(self) -> bool:
+        return self.rows == 0 and not any(self.tenants.values())
+
+
+class RequestQueue:
+    """Thread-safe multi-tenant request queue with shape-bucket
+    coalescing. Producers call :meth:`submit`; the executor's worker
+    thread calls :meth:`next_batch`."""
+
+    def __init__(self, policy: Optional[BatchPolicy] = None, *,
+                 qos=None):
+        self.policy = policy or BatchPolicy()
+        self.qos = qos
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._ops: Dict[str, _OpState] = {}
+        self._seq = 0
+        self._pending = 0
+        self._closed = False
+
+    # -- producer side ------------------------------------------------
+
+    def submit(self, op: str, queries, *, tenant: str = "default",
+               deadline_s: Optional[float] = None) -> ResultFuture:
+        """Enqueue one query block; returns its :class:`ResultFuture`.
+
+        Raises :class:`~raft_tpu.runtime.limits.RejectedError`
+        (``reason="queue_full"``) when the queue — or the tenant's QoS
+        share of it — is at capacity: backpressure is an admission
+        decision, typed and metered exactly like an over-budget launch.
+        """
+        queries = np.asarray(queries)
+        if queries.ndim != 2 or queries.shape[0] < 1:
+            raise ValueError(
+                f"queries must be [rows>=1, dim], got {queries.shape}")
+        if deadline_s is None and self.qos is not None:
+            deadline_s = self.qos.policy(tenant).deadline_s
+        dl = limits.Deadline(deadline_s) if deadline_s is not None else None
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("queue is closed")
+            if self._pending >= self.policy.max_queue:
+                obs.inc("limits_rejected_total", 1, reason="queue_full",
+                        op=f"serve.{op}")
+                raise limits.RejectedError(
+                    f"serve.{op}: queue full ({self._pending} requests "
+                    f">= max_queue={self.policy.max_queue}) — retry with "
+                    "backoff or shed load", op=f"serve.{op}",
+                    reason="queue_full")
+            if self.qos is not None:
+                self.qos.check_tenant_share(
+                    op, tenant, self._tenant_pending(op, tenant))
+            st = self._ops.get(op)
+            if st is None:
+                st = self._ops[op] = _OpState()
+            req = Request(op=op, queries=queries, tenant=tenant,
+                          seq=self._seq, t_enqueue=time.monotonic(),
+                          deadline=dl)
+            self._seq += 1
+            st.push(req, self._weight(tenant))
+            self._pending += 1
+            obs.set_gauge("serve_queue_depth", self._pending,
+                          help="requests waiting in the serving queue")
+            self._cond.notify_all()
+        return req.future
+
+    def _weight(self, tenant: str) -> float:
+        if self.qos is None:
+            return 1.0
+        return self.qos.policy(tenant).weight
+
+    def _tenant_pending(self, op: str, tenant: str) -> int:
+        st = self._ops.get(op)
+        if st is None:
+            return 0
+        dq = st.tenants.get(tenant)
+        return len(dq) if dq else 0
+
+    # -- consumer (executor) side -------------------------------------
+
+    def next_batch(self, timeout: Optional[float] = None
+                   ) -> Optional[Batch]:
+        """Block until a batch is due, then drain and return it.
+
+        A batch becomes due when drained rows would reach ``max_batch``,
+        or the oldest pending request has waited ``max_wait_ms``, or the
+        queue is closing. Returns None on ``timeout`` (executor idles)
+        or when closed and empty (executor exits)."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._cond:
+            while True:
+                req = self._oldest_request()
+                if req is not None:
+                    st = self._ops[req.op]
+                    age_ms = (time.monotonic() - req.t_enqueue) * 1e3
+                    if (st.rows >= self.policy.max_batch
+                            or age_ms >= self.policy.max_wait_ms
+                            or self._closed):
+                        return self._drain(req.op)
+                    wait = (self.policy.max_wait_ms - age_ms) / 1e3
+                elif self._closed:
+                    return None
+                else:
+                    wait = None
+                if deadline is not None:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        return None
+                    wait = rem if wait is None else min(wait, rem)
+                self._cond.wait(wait)
+
+    def _oldest_request(self) -> Optional[Request]:
+        heads = [st.oldest() for st in self._ops.values()]
+        heads = [h for h in heads if h is not None]
+        return min(heads, key=lambda r: r.seq) if heads else None
+
+    def _drain(self, op: str) -> Batch:
+        """Assemble one batch for ``op`` under the lock: weighted-fair
+        pops until the row cap (the first request always ships, however
+        large — the cap bounds coalescing, not request size)."""
+        st = self._ops[op]
+        reqs: List[Request] = []
+        rows = 0
+        while rows < self.policy.max_batch:
+            head = st.peek_fair()
+            if head is None:
+                break
+            if reqs and rows + head.rows > self.policy.max_batch:
+                break
+            st.pop(head, self._weight(head.tenant))
+            reqs.append(head)
+            rows += head.rows
+        self._pending -= len(reqs)
+        if st.empty():
+            del self._ops[op]
+        obs.set_gauge("serve_queue_depth", self._pending,
+                      help="requests waiting in the serving queue")
+        return Batch(op=op, requests=reqs)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def close(self) -> None:
+        """Stop accepting submissions; wake the executor so it drains
+        what is left and exits."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

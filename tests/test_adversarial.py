@@ -217,3 +217,83 @@ class TestStreamSelect:
                         algo=SelectAlgo.WARPSORT_FILTERED)
         ref = np.sort(x, 1)[:, ::-1][:, :9]
         np.testing.assert_array_equal(np.asarray(v), ref)
+
+
+class TestEigSelDegenerateSpectrum:
+    """eig_sel on clustered / repeated eigenvalues (VERDICT item 9).
+
+    The reference's syevdx is an exact subset solver, so multiplicity is
+    free there; the TPU iterative path resolves one Krylov direction per
+    DISTINCT eigenvalue and relies on locking + verification-with-
+    fallback to surface degenerate copies. These tests pin the user-
+    visible contract on the hardest spectra: the returned pairs must be
+    the true extremal ones, with orthonormal vectors and small
+    residuals, whether the iterative path resolved the cluster itself
+    or verification routed it to the exact slice."""
+
+    def _spd_with_spectrum(self, w, seed, dtype=np.float32):
+        """Symmetric matrix with EXACTLY the eigenvalues ``w`` (built as
+        Q diag(w) Q^T with Q orthogonal from a QR of Gaussian noise)."""
+        n = len(w)
+        rng = np.random.default_rng(seed)
+        q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+        a = (q * np.asarray(w)) @ q.T
+        return ((a + a.T) / 2).astype(dtype)
+
+    def _check(self, a, w_got, v_got, w_want, *, tol):
+        w_got = np.asarray(w_got, np.float64)
+        v_got = np.asarray(v_got, np.float64)
+        # values: ascending within the selection, equal to the designed
+        # extremal set (multiplicity included)
+        assert np.all(np.diff(w_got) >= -tol)
+        np.testing.assert_allclose(w_got, np.sort(w_want),
+                                   rtol=tol, atol=tol)
+        # vectors: orthonormal even within a degenerate cluster (near-
+        # parallel copies of one eigvec would pass a residual check but
+        # not this one)
+        gram = v_got.T @ v_got
+        np.testing.assert_allclose(gram, np.eye(gram.shape[0]), atol=tol)
+        # residuals: every returned pair really is an eigenpair
+        res = np.abs(a.astype(np.float64) @ v_got - v_got * w_got)
+        scale = np.abs(np.asarray(a)).max()
+        assert res.max() <= tol * max(scale, 1.0), \
+            f"residual {res.max():.3e} vs tol {tol * scale:.3e}"
+
+    def test_repeated_top_eigenvalue_exact_path(self):
+        # n=64 is far below the iterative envelope: exercises the exact
+        # slice on a 4-fold degenerate dominant eigenvalue
+        from raft_tpu.linalg import eig_sel
+
+        w = np.concatenate([np.linspace(0.1, 1.0, 60), [5.0] * 4])
+        a = self._spd_with_spectrum(w, seed=0)
+        vals, vecs = eig_sel(None, a, 6, largest=True)
+        self._check(a, vals, vecs, np.sort(w)[-6:], tol=5e-4)
+
+    def test_clustered_spectrum_iterative_path(self):
+        # n=512 f32, k<=n/3: inside the Lanczos envelope. The top of the
+        # spectrum is a tight cluster (gap 1e-4) PLUS an exact 3-fold
+        # multiplicity — the worst case for Krylov separation. Forcing
+        # exact=False means any success here is either the iterative
+        # solver resolving the cluster or its verifier correctly
+        # refusing and falling back — both are the documented contract.
+        from raft_tpu.linalg import eig_sel
+
+        n = 512
+        bulk = np.linspace(0.01, 1.0, n - 8)
+        cluster = 2.0 + 1e-4 * np.arange(5)          # 5 nearly-equal
+        triple = [3.0] * 3                           # exact multiplicity
+        w = np.concatenate([bulk, cluster, triple])
+        a = self._spd_with_spectrum(w, seed=1)
+        vals, vecs = eig_sel(None, a, 8, largest=True, exact=False)
+        self._check(a, vals, vecs, np.sort(w)[-8:], tol=2e-3)
+
+    def test_flat_spectrum_smallest_end(self):
+        # repeated eigenvalues at the SMALL end with largest=False, on
+        # the exact path: the selection must return the full degenerate
+        # block, not k copies of one direction
+        from raft_tpu.linalg import eig_sel
+
+        w = np.concatenate([[0.5] * 5, np.linspace(1.0, 4.0, 59)])
+        a = self._spd_with_spectrum(w, seed=2)
+        vals, vecs = eig_sel(None, a, 5, largest=False)
+        self._check(a, vals, vecs, np.array([0.5] * 5), tol=5e-4)

@@ -1,0 +1,471 @@
+"""Serving runtime tests (ISSUE 6): shape buckets, coalesced-batch
+bit-identity, batching-policy timing, weighted fairness, typed QoS
+failures, the zero-recompile-after-warmup contract, and the bench
+provenance (era / superseded_by) satellite."""
+
+import importlib.util
+import json
+import pathlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs, serve
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.runtime import limits
+
+DIM = 16
+
+
+@pytest.fixture
+def live_obs():
+    """Metrics on with a fresh private registry; restored afterwards."""
+    was_enabled = obs.enabled()
+    old_reg = obs_metrics.set_registry(obs.MetricsRegistry())
+    old_sink = obs.set_sink(None)
+    obs.set_enabled(True)
+    try:
+        yield obs_metrics.get_registry()
+    finally:
+        obs.set_enabled(was_enabled)
+        obs_metrics.set_registry(old_reg)
+        obs.set_sink(old_sink)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    return {
+        "db": rng.standard_normal((128, DIM)).astype(np.float32),
+        "centroids": rng.standard_normal((6, DIM)).astype(np.float32),
+        "rng": rng,
+    }
+
+
+def _queries(rng, rows):
+    return rng.standard_normal((rows, DIM)).astype(np.float32)
+
+
+def _counter_value(reg, name, **labels):
+    fam = reg.snapshot().get(name)
+    if not fam:
+        return 0.0
+    total = 0.0
+    for s in fam["series"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+class TestBuckets:
+    def test_ladder_values(self):
+        got = [serve.bucket_rows(n) for n in (1, 8, 9, 12, 13, 17, 25, 100)]
+        assert got == [8, 8, 12, 12, 16, 24, 32, 128]
+
+    def test_idempotent_and_monotone(self):
+        prev = 0
+        for n in range(1, 300):
+            b = serve.bucket_rows(n)
+            assert b >= n
+            assert b >= prev - 0  # monotone in n
+            assert serve.bucket_rows(b) == b
+            prev = b
+
+    def test_pad_waste_bounded(self):
+        # the x1.5 / x1.33 ladder bounds pad waste at 50% of rows
+        for n in range(1, 2000):
+            assert serve.bucket_rows(n) <= max(8, int(np.ceil(n * 1.5)))
+
+    def test_ladder_covers_max(self):
+        ladder = serve.bucket_ladder(200)
+        assert ladder[0] == serve.BUCKET_FLOOR
+        assert ladder[-1] >= 200
+        assert ladder == sorted(set(ladder))
+        # every bucket_rows() answer for n <= 200 is on the ladder
+        assert {serve.bucket_rows(n) for n in range(1, 201)} <= set(ladder)
+
+    def test_nonpositive_rejected(self):
+        with pytest.raises(ValueError):
+            serve.bucket_rows(0)
+
+
+class TestBitIdentity:
+    """Coalesced+padded serving returns the same bits as one
+    unbatched call per request, for every served op."""
+
+    def _run(self, services, ops, data, rows_list):
+        rng = np.random.default_rng(3)
+        ex = serve.Executor(
+            services,
+            policy=serve.BatchPolicy(max_batch=64, max_wait_ms=5.0))
+        ex.warm()
+        with ex:
+            subs = []
+            for i, rows in enumerate(rows_list):
+                q = _queries(rng, rows)
+                op = ops[i % len(ops)]
+                subs.append((op, q, ex.submit(op, q)))
+            outs = [(op, q, f.result(timeout=60)) for op, q, f in subs]
+        for op, q, got in outs:
+            want = ex.services[op].eager(q)
+            got_l = [np.asarray(x) for x in np.atleast_1d(got)] \
+                if not isinstance(got, tuple) else [np.asarray(x) for x in got]
+            want_l = [np.asarray(x) for x in np.atleast_1d(want)] \
+                if not isinstance(want, tuple) else [np.asarray(x) for x in want]
+            assert len(got_l) == len(want_l)
+            for g, w in zip(got_l, want_l):
+                np.testing.assert_array_equal(g, w)
+
+    def test_knn_bit_identical(self, data):
+        self._run([serve.KnnService(data["db"], k=4)], ["knn_k4_l2"],
+                  data, [1, 3, 5, 8, 2, 7, 11, 4])
+
+    def test_pairwise_bit_identical(self, data):
+        self._run([serve.PairwiseService(data["db"])],
+                  ["pairwise_l2_expanded"], data, [2, 6, 1, 9, 3])
+
+    def test_kmeans_predict_bit_identical(self, data):
+        self._run([serve.KMeansPredictService(data["centroids"])],
+                  ["kmeans_predict_k6"], data, [4, 1, 7, 2, 5])
+
+    def test_mixed_ops_route_correctly(self, data):
+        self._run([serve.KnnService(data["db"], k=4),
+                   serve.PairwiseService(data["db"])],
+                  ["knn_k4_l2", "pairwise_l2_expanded"],
+                  data, [3, 3, 5, 5, 2, 2])
+
+
+class TestBatchingPolicy:
+    def test_max_wait_flushes_partial_batch(self, data):
+        """A lone small request must NOT wait for max_batch — it ships
+        once its age reaches max_wait_ms."""
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=10_000, max_wait_ms=60.0))
+        ex.warm([8])
+        with ex:
+            t0 = time.monotonic()
+            fut = ex.submit("knn_k4_l2", _queries(np.random.default_rng(0), 3))
+            fut.result(timeout=30)
+            dt = time.monotonic() - t0
+        assert 0.05 <= dt < 10.0
+        assert ex.stats.batches == 1
+
+    def test_full_batch_dispatches_before_wait(self, data):
+        """Once queued rows reach max_batch the batch goes immediately,
+        long before a generous max_wait_ms."""
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=16, max_wait_ms=5_000.0))
+        ex.warm([16])
+        rng = np.random.default_rng(1)
+        with ex:
+            t0 = time.monotonic()
+            futs = [ex.submit("knn_k4_l2", _queries(rng, 8))
+                    for _ in range(2)]
+            for f in futs:
+                f.result(timeout=30)
+            dt = time.monotonic() - t0
+        assert dt < 4.0
+        assert ex.stats.batches == 1
+        assert ex.stats.coalescing_factor() == 16.0
+
+
+class TestFairness:
+    def test_hog_tenant_cannot_starve_light_tenant(self):
+        """40 hog requests queued ahead of 4 light ones: weighted-fair
+        dequeue interleaves the light tenant instead of serving it
+        dead last (FIFO would put it at positions 41-44)."""
+        qos = serve.QosPolicy({"hog": serve.TenantPolicy(weight=1.0),
+                               "light": serve.TenantPolicy(weight=1.0)})
+        q = serve.RequestQueue(
+            serve.BatchPolicy(max_batch=16, max_wait_ms=0.0,
+                              max_queue=10_000), qos=qos)
+        rng = np.random.default_rng(2)
+        for _ in range(40):
+            q.submit("knn", _queries(rng, 8), tenant="hog")
+        for _ in range(4):
+            q.submit("knn", _queries(rng, 8), tenant="light")
+        order = []
+        while q.pending():
+            batch = q.next_batch(timeout=1.0)
+            assert batch is not None
+            order.extend(r.tenant for r in batch.requests)
+        assert len(order) == 44
+        light_pos = [i for i, t in enumerate(order) if t == "light"]
+        assert len(light_pos) == 4
+        assert max(light_pos) < 12, (
+            f"light tenant starved: served at positions {light_pos}")
+
+    def test_weights_shift_share(self):
+        """A weight-3 tenant gets ~3x the rows of a weight-1 tenant in
+        any drain prefix while both are backlogged."""
+        qos = serve.QosPolicy({"gold": serve.TenantPolicy(weight=3.0),
+                               "bronze": serve.TenantPolicy(weight=1.0)})
+        q = serve.RequestQueue(
+            serve.BatchPolicy(max_batch=8, max_wait_ms=0.0,
+                              max_queue=10_000), qos=qos)
+        rng = np.random.default_rng(3)
+        for _ in range(30):
+            q.submit("op", _queries(rng, 8), tenant="gold")
+            q.submit("op", _queries(rng, 8), tenant="bronze")
+        first = []
+        for _ in range(12):            # 12 single-request batches
+            first.extend(r.tenant for r in q.next_batch(timeout=1.0).requests)
+        gold = first.count("gold")
+        assert gold >= 8, f"expected ~3:1 split, got {first}"
+
+
+class TestQos:
+    def test_deadline_expired_in_queue_fast_fails(self, data, live_obs):
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=8, max_wait_ms=0.0))
+        ex.warm([8])
+        fut = ex.submit("knn_k4_l2",
+                        _queries(np.random.default_rng(0), 2),
+                        deadline_s=0.005)
+        time.sleep(0.05)               # expire while queued
+        batch = ex.queue.next_batch(timeout=1.0)
+        launches_before = ex.stats.batches
+        ex.dispatch(batch)
+        with pytest.raises(limits.DeadlineExceededError) as ei:
+            fut.result(timeout=1.0)
+        assert ei.value.op == "serve.knn_k4_l2"
+        assert ex.stats.batches == launches_before, \
+            "expired request must not burn a device launch"
+        assert _counter_value(live_obs, "limits_deadline_exceeded_total",
+                              op="serve.knn_k4_l2") == 1.0
+
+    def test_tenant_default_deadline_applies(self, data):
+        qos = serve.QosPolicy(
+            {"slo": serve.TenantPolicy(deadline_s=0.004)})
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=8, max_wait_ms=0.0),
+            qos=qos)
+        ex.warm([8])
+        fut = ex.submit("knn_k4_l2",
+                        _queries(np.random.default_rng(0), 2),
+                        tenant="slo")
+        time.sleep(0.05)
+        ex.dispatch(ex.queue.next_batch(timeout=1.0))
+        with pytest.raises(limits.DeadlineExceededError):
+            fut.result(timeout=1.0)
+
+    def test_queue_full_typed_rejection(self, data, live_obs):
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=8, max_wait_ms=1_000.0,
+                                     max_queue=2))
+        rng = np.random.default_rng(0)
+        ex.submit("knn_k4_l2", _queries(rng, 1))
+        ex.submit("knn_k4_l2", _queries(rng, 1))
+        with pytest.raises(limits.RejectedError) as ei:
+            ex.submit("knn_k4_l2", _queries(rng, 1))
+        assert ei.value.reason == "queue_full"
+        assert ei.value.op == "serve.knn_k4_l2"
+        assert _counter_value(live_obs, "limits_rejected_total",
+                              reason="queue_full") == 1.0
+
+    def test_tenant_share_cap_rejects(self, data):
+        qos = serve.QosPolicy(
+            {"capped": serve.TenantPolicy(max_queued=1)})
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=8, max_wait_ms=1_000.0),
+            qos=qos)
+        rng = np.random.default_rng(0)
+        ex.submit("knn_k4_l2", _queries(rng, 1), tenant="capped")
+        with pytest.raises(limits.RejectedError) as ei:
+            ex.submit("knn_k4_l2", _queries(rng, 1), tenant="capped")
+        assert ei.value.reason == "queue_full"
+        # other tenants are unaffected by the capped tenant's share
+        ex.submit("knn_k4_l2", _queries(rng, 1), tenant="other")
+
+    def test_over_budget_batch_splits_and_stays_bit_identical(self, data):
+        """A coalesced batch whose footprint exceeds the serving budget
+        splits into smaller warmed buckets; results unchanged."""
+        svc = serve.KnnService(data["db"], k=4)
+        # budget fits a 16-row launch but not the 64-row coalesced one
+        budget = limits.WorkBudget(svc.estimate_bytes(16) + 1)
+        assert svc.estimate_bytes(64) > budget.limit_bytes
+        qos = serve.QosPolicy(budget=budget)
+        ex = serve.Executor(
+            [svc], policy=serve.BatchPolicy(max_batch=64,
+                                            max_wait_ms=20.0),
+            qos=qos)
+        ex.warm()
+        rng = np.random.default_rng(5)
+        with ex:
+            subs = [(q := _queries(rng, 8), ex.submit("knn_k4_l2", q))
+                    for _ in range(8)]
+            outs = [(q, f.result(timeout=60)) for q, f in subs]
+        assert ex.stats.splits >= 1
+        for q, (d, i) in outs:
+            wd, wi = svc.eager(q)
+            np.testing.assert_array_equal(np.asarray(d), np.asarray(wd))
+            np.testing.assert_array_equal(np.asarray(i), np.asarray(wi))
+
+
+class TestAotWarm:
+    def test_zero_compiles_after_warmup(self, data):
+        """Steady-state serving must never recompile: the trace-time
+        hook (which ticks exactly on jit cache misses) stays flat over
+        requests of every size after warm()."""
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=32, max_wait_ms=1.0))
+        warmed = ex.warm()
+        assert warmed == len(serve.bucket_ladder(32))
+        traces_at_warm = ex.stats.traces
+        misses_at_warm = ex.stats.exec_misses
+        rng = np.random.default_rng(9)
+        with ex:
+            futs = [ex.submit("knn_k4_l2", _queries(rng, rows))
+                    for rows in (1, 3, 8, 13, 2, 30, 5, 17, 9, 21)]
+            for f in futs:
+                f.result(timeout=60)
+        assert ex.stats.traces == traces_at_warm, (
+            f"{ex.stats.traces - traces_at_warm} recompiles after warmup")
+        assert ex.stats.exec_misses == misses_at_warm
+        assert ex.stats.exec_hits > 0
+        assert ex.stats.batches >= 1
+
+    def test_compile_cache_metrics(self, data, live_obs):
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=8, max_wait_ms=1.0))
+        ex.warm([8])
+        assert _counter_value(live_obs, "runtime_compile_cache_total",
+                              cache="serve", outcome="miss") == 1.0
+        ex._get_executable(ex.services["knn_k4_l2"], 8)
+        assert _counter_value(live_obs, "runtime_compile_cache_total",
+                              cache="serve", outcome="hit") >= 1.0
+
+
+class TestLoadgen:
+    def test_closed_loop_reports(self, data):
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=32, max_wait_ms=2.0))
+        ex.warm()
+        with ex:
+            rep = serve.closed_loop(ex, "knn_k4_l2", clients=4, rows=4,
+                                    duration_s=0.5)
+        assert rep.completed > 0
+        assert rep.qps > 0
+        assert np.isfinite(rep.p50_ms) and np.isfinite(rep.p99_ms)
+        assert rep.p99_ms >= rep.p50_ms
+        d = rep.as_dict()
+        assert d["mode"] == "closed"
+        json.dumps(d)                  # bench-line serializable
+
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load(name, relpath):
+    import sys
+
+    spec = importlib.util.spec_from_file_location(name, ROOT / relpath)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod            # dataclasses resolve via sys.modules
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(name, None)
+        raise
+    return mod
+
+
+class TestBenchProvenance:
+    """Era / superseded_by stamping satellite: stale rows cannot be
+    read as current by any BENCH_r0*.json reader."""
+
+    def _tpu_line(self, **over):
+        line = {"metric": "kmeans_lloyd", "backend": "tpu",
+                "mxu_util_4mnk": 0.5, "value": 100.0, "era": 6}
+        line.update(over)
+        return line
+
+    def test_superseded_rows_are_invalid(self):
+        bench = _load("_bench_prov", "bench.py")
+        assert bench.is_valid_northstar_line(self._tpu_line())
+        assert not bench.is_valid_northstar_line(
+            self._tpu_line(superseded_by="era 7 remeasure"))
+
+    def test_relay_prefers_newest_era(self, tmp_path, monkeypatch):
+        bench = _load("_bench_prov2", "bench.py")
+        art_dir = tmp_path / "tpu_battery_out"
+        art_dir.mkdir()
+        lines = [self._tpu_line(era=0, value=1.0),
+                 self._tpu_line(era=6, value=6.0),
+                 self._tpu_line(era=3, value=3.0),
+                 self._tpu_line(era=9, value=9.0,
+                                superseded_by="bad apparatus")]
+        (art_dir / "bench_northstar.json").write_text(
+            "\n".join(json.dumps(d) for d in lines) + "\n")
+        monkeypatch.setattr(bench, "__file__",
+                            str(tmp_path / "bench.py"))
+        got = bench._relay_battery_artifact()
+        assert got is not None
+        assert got["value"] == 6.0 and got["era"] == 6
+        assert got["relay"]
+
+    def test_harness_stamps_era(self):
+        harness = _load("_harness_prov", "benches/harness.py")
+        row = json.loads(harness.BenchResult(
+            name="x", median_ms=1.0, best_ms=1.0, repeats=1).json_line())
+        assert row["era"] == harness.BENCH_ERA >= 6
+        assert harness.is_current_row(row, harness.BENCH_ERA)
+        assert not harness.is_current_row(
+            dict(row, superseded_by="retired"), harness.BENCH_ERA)
+        assert not harness.is_current_row({"bench": "x"},
+                                          harness.BENCH_ERA)
+
+    def test_render_bench_filters_stale_rows(self):
+        rb = _load("_render_bench", "ci/render_bench.py")
+        rows = [{"bench": "a", "era": 6, "median_ms": 1.0},
+                {"bench": "a", "era": 0, "median_ms": 9.0},
+                {"bench": "a", "era": 6, "median_ms": 2.0,
+                 "superseded_by": "x"},
+                {"bench": "b", "median_ms": 3.0}]   # pre-era family: kept
+        got = rb.current_rows(rows)
+        assert got == [{"bench": "a", "era": 6, "median_ms": 1.0},
+                       {"bench": "b", "median_ms": 3.0}]
+
+
+class TestJsonlSinkShutdown:
+    """atexit-flush satellite: the sink closes idempotently and the
+    shutdown hook flushes whatever sink is still attached."""
+
+    def test_close_is_idempotent_and_write_after_close_is_noop(self, tmp_path):
+        from raft_tpu.obs import export
+
+        path = tmp_path / "events.jsonl"
+        sink = export.JsonlSink(str(path))
+        sink.write({"kind": "event", "name": "a"})
+        sink.close()
+        sink.close()                    # second close: no error
+        sink.write({"kind": "event", "name": "dropped"})
+        sink.flush()                    # flush after close: no error
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 1
+
+    def test_atexit_hook_closes_attached_sink(self, tmp_path):
+        from raft_tpu.obs import export
+
+        path = tmp_path / "events.jsonl"
+        sink = export.JsonlSink(str(path))
+        old = export.set_sink(sink)
+        try:
+            sink.write({"kind": "event", "name": "final"})
+            export._atexit_close_sink()
+            assert sink._closed
+            assert json.loads(path.read_text().splitlines()[-1])[
+                "name"] == "final"
+        finally:
+            export.set_sink(old)
