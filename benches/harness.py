@@ -20,7 +20,11 @@ import jax
 # (timing scheme, FLOP convention, workload shape). Readers treat a row
 # whose era is below the newest era seen for that bench family — or one
 # carrying a ``superseded_by`` marker — as historical, never current.
-BENCH_ERA = 6
+# Era 7: the radix threshold stage became the digit-histogram kernel —
+# every matrix/select_k* radix row and chunked-kNN row measures a
+# different kernel, so the 3.6-6.4 GB/s binary-search-era rows read as
+# superseded the moment an era-7 row lands in their family.
+BENCH_ERA = 7
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

@@ -1,0 +1,58 @@
+"""HBM-traffic cost model for the selection kernels (CPU-measurable
+proxy for the digit-histogram rebuild).
+
+The radix threshold stage is bandwidth-bound: its cost is the number of
+times the (R, L) key array streams through HBM. The retired binary
+search held rows VMEM-resident but paid 32 serial VPU compare+reduce
+sweeps over them — on hardware it measured 3.6-6.4 GB/s (~0.5-0.8% of
+the v5e's 819 GB/s, ~25x off this model; VERDICT Weak #1) because the
+sweeps serialized behind each other instead of overlapping with the
+stream. The digit-histogram kernel makes the model's pass count real:
+NPASS (=4) streamed passes, each narrowing one 8-bit digit.
+
+Model (bytes READ per selection, itemsize-4 keys):
+
+- binary search:  (1 + 32) . R.L.4      one stream in + 32 resident
+                                        sweeps (counted as passes: each
+                                        sweep touches every element)
+- digit histogram: (NPASS + 1 + 1) . R.L.4   NPASS threshold passes
+                                        + the XLA chunk-count maps
+                                        + the emission stream
+
+The ratio (33/6 = 5.5x at NPASS=4) is the ISSUE's >= 4x acceptance
+floor; ci/smoke.sh asserts it so a pass-count regression (e.g. a
+5th digit pass growing the model) trips CI before hardware does.
+"""
+
+from __future__ import annotations
+
+from raft_tpu.matrix.radix_select import NPASS
+
+# Element-touch counts per selection formulation. "Pass" = every key
+# element is read (from HBM or swept in place — the retired kernel's
+# sweeps serialized exactly like re-reads, which is what the hardware
+# grid measured).
+BINARY_SEARCH_PASSES = 1 + 32          # stream-in + 32 bit probes
+DIGIT_HIST_PASSES = NPASS + 1 + 1      # threshold + chunk maps + emit
+
+
+def selection_bytes(n_rows: int, n_cols: int, *, itemsize: int = 4,
+                    algo: str = "digit") -> int:
+    """Modeled bytes READ for one exact batched top-k threshold+emit."""
+    passes = {"digit": DIGIT_HIST_PASSES,
+              "binary": BINARY_SEARCH_PASSES}[algo]
+    return passes * n_rows * n_cols * itemsize
+
+
+def traffic_ratio() -> float:
+    """binary-search bytes / digit-histogram bytes (the >= 4x bar)."""
+    return BINARY_SEARCH_PASSES / DIGIT_HIST_PASSES
+
+
+def bytes_per_s(n_rows: int, n_cols: int, ms: float, *,
+                itemsize: int = 4, algo: str = "digit") -> float:
+    """Achieved selection bandwidth against the model's byte count —
+    the `select_k_bytes_per_s` gauge the serving loadgen report and
+    the bench rows record."""
+    return selection_bytes(n_rows, n_cols, itemsize=itemsize,
+                           algo=algo) / (ms / 1e3)

@@ -8,6 +8,17 @@ HBM fraction — the roofline evidence that originally triggered building
 the Pallas radix-rank kernel (raft_tpu/matrix/radix_select.py; ref
 heuristic being replaced: detail/select_k-inl.cuh:38-63).
 
+Adjudication contract (ISSUE 7): a contender the bench grid ARMS for a
+cell (see expected_algos — the same predicates bench_prims uses to
+enter an algo into the tournament) must have a row for that cell.
+``partial: true`` rows (smoke-scale, e.g. the CPU tier's
+matrix/select_k_smoke family) populate a column structurally — they
+render with a ``~`` marker and only break ties when no full-scale row
+exists — but an armed contender with NO row at all (the round-5 empty
+insert column) is a loud failure: exit 2 listing every missing
+(cell, algo), so a battery that silently dropped a column can never
+adjudicate.
+
 Usage: python ci/derive_select_k.py tpu_battery_out/bench_full.jsonl
 """
 
@@ -16,6 +27,24 @@ import sys
 from collections import defaultdict
 
 HBM_GB_S = 819.0     # v5e
+
+ALGOS = ("direct", "tiled", "stream", "radix", "insert")
+
+
+def expected_algos(length, k):
+    """Which contenders the bench grid arms for a (len, k) cell —
+    mirrors benches.bench_prims._select_k_grid (inlined because ci/
+    scripts run outside the package path): stream only above the 8192
+    tile (below it the stream path dispatches to direct), radix inside
+    its supports() envelope, insert at k <= 256 (topk_insert.MAX_K)."""
+    algos = {"direct", "tiled"}
+    if length > 8192:
+        algos.add("stream")
+    if k <= length and k <= 16384 and length <= (1 << 24):
+        algos.add("radix")
+    if k <= 256:
+        algos.add("insert")
+    return algos
 
 
 def current_rows(rows):
@@ -45,42 +74,56 @@ def main(path):
         name = r.get("bench", "")
         if not name.startswith("matrix/select_k_len"):
             continue
-        if r.get("partial"):
-            continue
         rows.append(r)
-    cells = defaultdict(dict)    # (length, k) -> {algo: row}
+    # (length, k) -> {algo: row}; a full-scale row always beats a
+    # partial (smoke-scale) row for the same cell+algo
+    cells = defaultdict(dict)
     for r in current_rows(rows):
-        cells[(r["length"], r["k"])][r["algo"]] = r
+        cell, algo = (r["length"], r["k"]), r["algo"]
+        prev = cells[cell].get(algo)
+        if prev is None or (prev.get("partial") and not r.get("partial")):
+            cells[cell][algo] = r
 
     if not cells:
         print("(no select_k tournament rows found)")
-        return
+        return 0
 
     print(f"{'len':>9} {'k':>6} {'direct ms':>10} {'tiled ms':>9} "
           f"{'stream ms':>10} {'radix ms':>9} {'insert ms':>10} "
           f"{'winner':>7} {'win GB/s':>9} {'hbm frac':>9}")
     wins = {}
+    missing = []
     for (length, k), algos in sorted(cells.items()):
+        for a in sorted(expected_algos(length, k) - set(algos)):
+            missing.append(((length, k), a))
         d = algos.get("direct")
         if not d:
             continue
-        times = {a: algos[a]["median_ms"]
-                 for a in ("direct", "tiled", "stream", "radix", "insert")
-                 if a in algos}
-        win = min(times, key=times.get)
+        times = {a: algos[a]["median_ms"] for a in ALGOS if a in algos}
+        # partial rows adjudicate only among themselves: a smoke-scale
+        # timing must never outvote a hardware row in the same cell
+        full = {a: t for a, t in times.items()
+                if not algos[a].get("partial")}
+        win = min(full or times, key=(full or times).get)
+        cell_partial = not full
         wins.setdefault(win, []).append((length, k, times))
         # the selection streams batch*len f32 once: the bandwidth floor
         # quoted for the WINNER (is the best algo leaving bandwidth idle?)
         gbs = d["batch"] * length * 4 / (times[win] / 1e3) / 1e9
 
         def fmt(a):
-            return f"{times[a]:.2f}" if a in times else "-"
+            if a not in times:
+                return "-"
+            mark = "~" if algos[a].get("partial") else ""
+            return f"{mark}{times[a]:.2f}"
         print(f"{length:>9} {k:>6} {fmt('direct'):>10} {fmt('tiled'):>9} "
               f"{fmt('stream'):>10} {fmt('radix'):>9} "
-              f"{fmt('insert'):>10} {win:>7} "
+              f"{fmt('insert'):>10} "
+              f"{('~' if cell_partial else '') + win:>7} "
               f"{gbs:>9.1f} {gbs / HBM_GB_S:>9.2f}")
 
-    print()
+    print("\n(~ = partial/smoke-scale row: populates the column, "
+          "never outvotes a full-scale row)")
     for algo in ("tiled", "stream", "radix", "insert"):
         if wins.get(algo):
             cells_won = [(w[0], w[1]) for w in wins[algo]]
@@ -95,7 +138,19 @@ def main(path):
           "below ~0.5 at len >= 64k is evidence lax.top_k leaves "
           "bandwidth on the table (see select_k.py design note).")
 
+    if missing:
+        print("\nERROR: armed-but-unmeasured contenders — the tournament "
+              "cannot adjudicate with an empty column:", file=sys.stderr)
+        for (length, k), algo in missing:
+            print(f"  (len={length}, k={k}): no '{algo}' row "
+                  f"(not even partial)", file=sys.stderr)
+        print("  -> re-run the battery family, or populate smoke-scale "
+              "partial rows (benches matrix/select_k_smoke)",
+              file=sys.stderr)
+        return 2
+    return 0
+
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else
-         "tpu_battery_out/bench_full.jsonl")
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
+                  "tpu_battery_out/bench_full.jsonl"))

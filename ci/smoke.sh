@@ -451,4 +451,111 @@ assert not errors, f"obs JSONL schema violations: {errors[:5]}"
 print(f"serving obs stream: {n} JSONL records validate against schema")
 PYEOF
 rm -f "$SERVE_JSONL"
+
+# Radix-parity gate (ISSUE 7 acceptance): the digit-histogram threshold
+# must pick bit-identical winners vs lax.top_k on adversarial tie-heavy
+# inputs, order NaN/inf by the sign-magnitude total order, and the cost
+# model must show the >= 4x byte-traffic cut over the retired binary
+# search.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from benches import select_model
+from raft_tpu.matrix.radix_select import radix_select_k
+
+rng = np.random.default_rng(7)
+
+# adversarial: rows drawn from 4 distinct values -> the threshold digit
+# carries a deep tie run in every row
+v = rng.choice(np.asarray([-1.0, 0.0, 0.5, 2.0], np.float32),
+               size=(16, 4096))
+for k in (1, 37, 256, 1000):
+    gv, gi = radix_select_k(jnp.asarray(v), k, select_min=False)
+    tv, ti = jax.lax.top_k(jnp.asarray(v), k)
+    np.testing.assert_array_equal(np.asarray(gv), np.asarray(tv)), \
+        f"k={k}: selected values diverge from lax.top_k"
+    # winners are bit-identical as a set: every selected index holds the
+    # selected value (tie ORDER is radix's documented first-come rule;
+    # top_k leaves its own unspecified)
+    np.testing.assert_array_equal(
+        np.take_along_axis(v, np.asarray(gi), 1), np.asarray(gv))
+
+# NaN/inf: IEEE total order via the sign-magnitude fold -> -NaN sorts
+# below -inf, +NaN above +inf (lax.top_k has no defined NaN rule, so
+# the oracle is the fold itself)
+w = np.array([[np.nan, -np.nan, np.inf, -np.inf, 0.0, -0.0, 1.0, -1.0]],
+             np.float32)
+b = w.view(np.int32)
+key = b ^ ((b >> 31) & 0x7FFFFFFF)
+oi = np.argsort(key, axis=1, kind="stable")
+gv, gi = radix_select_k(jnp.asarray(w), 8)
+np.testing.assert_array_equal(np.asarray(gi), oi), \
+    "NaN/inf ordering diverges from the sign-magnitude total order"
+
+ratio = select_model.traffic_ratio()
+assert ratio >= 4.0, \
+    f"cost model: digit-histogram must move >=4x fewer bytes ({ratio:.1f}x)"
+print(f"radix-parity gate: tie/NaN winners bit-identical; "
+      f"{ratio:.1f}x selection-traffic cut over binary search")
+PYEOF
+
+# Five-way adjudication gate (ISSUE 7): the CPU smoke grid must populate
+# ALL armed tournament columns (incl. the round-5 empty insert column)
+# and derive_select_k must adjudicate; stripping a column must turn into
+# the loud exit-2 failure, never a silent drop.
+SELECT_ROWS=$(mktemp /tmp/select_rows.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python benches/run_benches.py \
+    --family matrix/select_k_smoke > "$SELECT_ROWS"
+JAX_PLATFORMS=cpu python ci/derive_select_k.py "$SELECT_ROWS" \
+    > "$SELECT_ROWS.out"
+grep -q "insert" "$SELECT_ROWS.out" || {
+    echo "adjudication gate: insert column absent from derive output"
+    exit 1
+}
+grep -v '"algo": "insert"' "$SELECT_ROWS" > "$SELECT_ROWS.stripped"
+if JAX_PLATFORMS=cpu python ci/derive_select_k.py \
+        "$SELECT_ROWS.stripped" >/dev/null 2>&1; then
+    echo "adjudication gate: derive must exit 2 on an armed-but-"\
+         "unmeasured contender (stripped insert column went unnoticed)"
+    exit 1
+fi
+rm -f "$SELECT_ROWS" "$SELECT_ROWS.out" "$SELECT_ROWS.stripped"
+echo "adjudication gate: five columns populated; stripped column fails loud"
+
+# Serve-path gate (ISSUE 7 acceptance): a k=512 KnnService dispatches
+# through the radix epilogue (trace-event assertion at warm) and the
+# batched serve answer is bit-identical to the unbatched knn call.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu import serve
+from raft_tpu.core import trace
+from raft_tpu.neighbors import knn
+
+rng = np.random.default_rng(0)
+db = rng.standard_normal((16384, 16)).astype(np.float32)
+svc = serve.KnnService(jnp.asarray(db), k=512)
+ex = serve.Executor([svc],
+                    policy=serve.BatchPolicy(max_batch=8, max_wait_ms=1.0))
+trace.clear_events()
+ex.warm(buckets=(8,))
+disp = [e for e in trace.events("knn.dispatch") if e["k"] == 512]
+assert disp and all(e["path"] == "radix" for e in disp), \
+    f"k=512 service must warm onto the radix epilogue: {disp}"
+warmed = trace.events("serve.warmed")
+assert warmed and warmed[-1].get("epilogue") == "radix"
+
+q = rng.standard_normal((4, 16)).astype(np.float32)
+with ex:
+    got = ex.submit("knn_k512_l2", q).result(timeout=120)
+want = knn(None, jnp.asarray(db), jnp.asarray(q), k=512)
+for g, w in zip(got, want):
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+print("serve-path gate: k=512 warmed onto radix epilogue; "
+      "batched answer bit-identical to unbatched knn")
+PYEOF
+
 echo "smoke: PASS"

@@ -43,14 +43,17 @@ beat `lax.top_k`. The grid REFUTES the premise that top_k is
 bandwidth-shaped: every k ≥ 256 winner sits at ~1% of HBM bandwidth
 (e.g. 8192×8192 f32 = 256 MB selected in 46 ms ≈ 5.8 GB/s, a ~50×
 roofline gap). That triggered the gate the note named, and the Pallas
-two-pass radix-rank kernel now exists: :mod:`raft_tpu.matrix.radix_select`
-(histogram passes find the exact k-th key; a factorized one-hot rank
-contraction emits winners through the MXU — compaction WITHOUT a sort,
-the step the old note thought inexpressible). kAuto dispatches to it in
-the roofline-indicted band (16 < k <= 2048, long rows) PENDING its own
-four-way grid rows — its cells re-derive from ci/derive_select_k.py
-when the next battery window records them; the radix algo enums map to
-it directly.
+radix kernel exists: :mod:`raft_tpu.matrix.radix_select`. Its round-5
+threshold stage (a 32-step binary search) itself measured 3.6-6.4 GB/s
+on hardware — the era-7 rebuild replaced it with the reference's true
+multi-pass DIGIT-HISTOGRAM walk (NPASS=4 streamed passes, 256-bin
+per-row histograms as factorized one-hot MXU contractions — see the
+radix_select module docstring), and kAuto dispatches to it across the
+full roofline-indicted band (radix_select.preferred: long rows above
+k=256, short rows 16 < k <= MAX_K); the radix algo enums map to it
+directly. The era-7 armed battery rows (matrix/select_k_bars encodes
+the VERDICT hardware bars) re-adjudicate the bands on the next TPU
+window through ci/derive_select_k.py.
 
 Round 5 added a FIFTH contender: bound-gated sorted insertion
 (:mod:`raft_tpu.matrix.topk_insert`, k <= 256) — the drain that took
@@ -58,7 +61,12 @@ the fused kNN kernel from 1.9 s to 98 ms, applied to materialized
 input. It maps to the kWarpsortFiltered/Distributed enums (the
 reference's filtered warpsort IS the insert-if-beats-bound family,
 select_warpsort.cuh:129) and joins the bench tournament as algo
-"insert"; AUTO adopts it where the re-derived grid says it wins.
+"insert". The five-way adjudication is structural now: the CPU tier
+populates smoke-scale ``partial: true`` insert rows
+(matrix/select_k_smoke) and ci/derive_select_k.py fails loudly on any
+armed-but-unmeasured contender, so the empty-column round-5 state
+(VERDICT Weak #2) cannot recur; AUTO adopts insert where the
+re-derived grid says it wins.
 """
 
 from __future__ import annotations
@@ -247,10 +255,13 @@ def select_k(res, values, k: int, select_min: bool = True,
         # measured grids showed lax.top_k ~50x under the bandwidth
         # roofline, extended past k=2048 on 1M rows by the round-5
         # capture (radix won every k >= 256 there, incl. 10^4:
-        # 65.5 ms vs direct 115) — radix_select.preferred is the single
-        # source of truth, shared with the chunked kNN gate. Outside
-        # the band: direct for small k, tiled per _choose_tiled;
-        # thresholds re-derive from ci/derive_select_k.py.
+        # 65.5 ms vs direct 115) and to MAX_K on short rows by the
+        # era-7 digit-histogram rebuild — radix_select.preferred is
+        # the single source of truth, shared with the chunked kNN
+        # gate. Outside the band: direct for small k, tiled per
+        # _choose_tiled; thresholds re-derive from
+        # ci/derive_select_k.py (which now fails loudly on any
+        # armed-but-unmeasured contender, incl. the insert column).
         if radix_select.preferred(n_cols, k) and _radix_ok():
             mode = "radix"
         elif _choose_tiled(n_rows, n_cols, k):

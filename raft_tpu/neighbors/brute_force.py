@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.core import trace
 from raft_tpu.linalg.contractions import pairwise_pallas
 from raft_tpu.util.math import cdiv, round_up_to_multiple
 from raft_tpu.util.precision import with_matmul_precision
@@ -199,13 +200,16 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     on the chunked path's distance block (an explicit small tile forces
     the scan path rather than being silently ignored). Default: auto.
 
-    Dispatch: k <= 256 runs the fused distance+top-k kernel
+    Dispatch (:func:`knn_plan` is the single source of truth): k <= 256
+    runs the fused distance+top-k kernel
     (:mod:`raft_tpu.neighbors.fused_topk` — distances never leave VMEM,
     merges bound-gated; round-5 capture showed every materializing
-    formulation select-bound at ~1.3 G items/s). Larger k at long
-    databases runs the chunked-radix path (:func:`_knn_chunked`);
-    otherwise the streaming scan with per-tile top_k
-    (:func:`_knn_scan`).
+    formulation select-bound at ~1.3 G items/s). Above k=256 the
+    digit-histogram radix chains as the epilogue (:func:`_knn_chunked`:
+    per-chunk distance blocks selected at bandwidth class — distances
+    never round-trip through materialize+full-select); only databases
+    too short for the radix floor fall to the streaming scan with
+    per-tile top_k (:func:`_knn_scan`).
 
     Admission (ISSUE 5): with a ``runtime.limits`` work budget active, a
     launch whose monolithic q×n distance block would overrun it is
@@ -256,16 +260,20 @@ def knn(res, db, queries, k: int, metric: str = "l2",
     # the dispatch fall back (compiled shard_map uses the fused path)
     from raft_tpu.neighbors import fused_topk
 
-    if (fused_topk.supports(k) and (tile is None or tile >= 128)
-            and kernel_metric in ("l2", "cosine", "inner")
-            and not interpret_needs_ref(db, queries)):
+    path, chunk = knn_plan(queries.shape[0], db.shape[0], k,
+                           metric=metric, tile=tile,
+                           vma_blocked=interpret_needs_ref(db, queries))
+    # host-side dispatch record (the serve-path gate and the dispatch
+    # tests assert on it); under jit this fires once per compile
+    trace.record_event("knn.dispatch", path=path, k=k,
+                       n_queries=queries.shape[0], n_db=db.shape[0],
+                       chunk=chunk)
+    if path == "fused":
         vals, idx = fused_topk.knn_fused(
             queries.astype(jnp.float32), db.astype(jnp.float32), k,
             kernel_metric, tn=min(tile or 1024, 1024))
         return _finalize(vals, metric), idx
-    chunk = _chunk_for(queries.shape[0], db.shape[0], k,
-                       tile_cap=tile or 0)
-    if chunk and not interpret_needs_ref(db, queries):
+    if path == "radix":
         vals, idx = _knn_chunked(queries.astype(jnp.float32),
                                  db.astype(jnp.float32), k, chunk,
                                  kernel_metric)
@@ -275,6 +283,32 @@ def knn(res, db, queries, k: int, metric: str = "l2",
                               db.astype(jnp.float32), k, tile_w,
                               kernel_metric)
     return _finalize(vals, metric), idx
+
+
+def knn_plan(n_queries: int, n_db: int, k: int, metric: str = "l2",
+             tile: Optional[int] = None, vma_blocked: bool = False
+             ) -> Tuple[str, int]:
+    """Pure dispatch predictor for :func:`knn`: ("fused" | "radix" |
+    "scan", chunk). knn() itself routes through this, so the answer can
+    never drift from the real dispatch — the serving executor quotes it
+    per warmed service and the dispatch tests assert on it. "radix" is
+    the digit-histogram epilogue (:func:`_knn_chunked`): above the
+    fused kernel's k <= 256 it is the only non-materialize+full-select
+    path, per-chunk distances bounded and selected at bandwidth class.
+    ``vma_blocked``: the caller saw vma-carrying operands under the
+    interpreter (pallas_utils.interpret_needs_ref) — both Pallas paths
+    fall back to the scan there."""
+    from raft_tpu.neighbors import fused_topk
+
+    kernel_metric = _resolve_metric(metric)
+    if (fused_topk.supports(k) and (tile is None or tile >= 128)
+            and kernel_metric in ("l2", "cosine", "inner")
+            and not vma_blocked):
+        return "fused", 0
+    chunk = _chunk_for(n_queries, n_db, k, tile_cap=tile or 0)
+    if chunk and not vma_blocked:
+        return "radix", chunk
+    return "scan", 0
 
 
 @with_matmul_precision

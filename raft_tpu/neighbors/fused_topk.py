@@ -275,6 +275,18 @@ def supports(k: int) -> bool:
     return 1 <= k <= MAX_K
 
 
+def epilogue(k: int) -> str:
+    """Which selection epilogue serves this k on the kNN hot path:
+    "insert" (this kernel's in-VMEM bound-gated insertion, k <= MAX_K
+    = 256) or "radix" — above the insertion band the digit-histogram
+    radix select chains as the epilogue (brute_force._knn_chunked
+    materializes bounded per-chunk distance blocks and selects each at
+    bandwidth class; brute_force.knn_plan decides whether a concrete
+    (q, n, k) actually clears the radix floor). The two bands share
+    the boundary here so neither side can drift."""
+    return "insert" if supports(k) else "radix"
+
+
 def knn_fused(queries, db, k: int, metric: str = "l2",
               tm: int = 256, tn: int = 1024, sw: int = 0):
     """Fused-kernel kNN: (vals [q, k], idx [q, k]), nearest first.

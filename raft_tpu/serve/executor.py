@@ -145,6 +145,30 @@ class KnnService(Service):
         return knn(None, self.fixed_args[0], jnp.asarray(queries),
                    k=self.k, metric=self.metric)
 
+    def epilogue(self) -> str:
+        """Which selection epilogue this service's launches take —
+        "fused" (k <= 256), "radix" (the digit-histogram chunked path
+        above it), or "scan" — quoted straight from
+        :func:`raft_tpu.neighbors.brute_force.knn_plan`, the predicate
+        knn() itself routes through, so the warm-path report can never
+        drift from the compiled dispatch."""
+        from raft_tpu.neighbors.brute_force import knn_plan
+
+        path, _ = knn_plan(1, int(self.fixed_args[0].shape[0]), self.k,
+                           metric=self.metric)
+        return path
+
+    def selection_bytes(self, rows: int) -> int:
+        """Modeled selection-stage HBM bytes for a ``rows``-row launch
+        on the radix epilogue ((NPASS+2) streamed passes over the
+        (rows, n_db) distance block — benches/select_model.py is the
+        canonical statement of the model); 0 off the radix path."""
+        if self.epilogue() != "radix":
+            return 0
+        from raft_tpu.matrix.radix_select import NPASS
+
+        return (NPASS + 2) * rows * int(self.fixed_args[0].shape[0]) * 4
+
 
 class PairwiseService(Service):
     """Batched pairwise distance rows against a fixed corpus
@@ -350,8 +374,13 @@ class Executor:
                 n += 1
             dt = time.monotonic() - t0
             obs.observe("serve_warmup_seconds", dt, service=svc.name)
+            # kNN services also report which selection epilogue their
+            # warmed executables compiled (the serve-path CI gate
+            # asserts k > 256 services warm onto "radix")
+            ep = getattr(svc, "epilogue", None)
             obs.emit_event("serve.warmed", service=svc.name,
-                           buckets=list(buckets), seconds=round(dt, 4))
+                           buckets=list(buckets), seconds=round(dt, 4),
+                           **({"epilogue": ep()} if ep else {}))
         return n
 
     # -- dispatch -----------------------------------------------------
@@ -461,6 +490,16 @@ class Executor:
                 obs.observe("serve_queue_wait_seconds",
                             now - r.t_enqueue,
                             help="submit-to-launch-complete wait")
+            # selection-stage achieved bandwidth for services whose
+            # launches ride the radix epilogue (modeled bytes from the
+            # benches/select_model.py pass count over the launch time)
+            sel = getattr(svc, "selection_bytes", None)
+            sel_bytes = sel(brows) if sel else 0
+            if sel_bytes and dt > 0:
+                obs.set_gauge("select_k_bytes_per_s", sel_bytes / dt,
+                              help="modeled selection bytes / launch "
+                                   "seconds on the radix epilogue",
+                              op=svc.name)
         self._finish(svc, reqs, out, batched=True)
 
     def _finish(self, svc: Service, reqs: List[Request], out,

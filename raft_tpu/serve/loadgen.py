@@ -47,6 +47,7 @@ class LoadReport:
     coalescing_factor: float = 0.0
     batches: int = 0
     pad_overhead: float = 0.0           # padded rows / real rows
+    select_k_bytes_per_s: float = 0.0   # radix-epilogue selection bandwidth
 
     @property
     def qps(self) -> float:
@@ -84,6 +85,7 @@ class LoadReport:
             "coalescing_factor": round(self.coalescing_factor, 3),
             "batches": self.batches,
             "pad_overhead": round(self.pad_overhead, 4),
+            "select_k_bytes_per_s": round(self.select_k_bytes_per_s, 1),
         }
 
 
@@ -101,6 +103,15 @@ def _finalize(report: LoadReport, executor, before: tuple,
     report.batches = db
     report.coalescing_factor = dr / db if db else 0.0
     report.pad_overhead = dp / dr if dr else 0.0
+    # selection-stage bandwidth: the Executor._launch gauge for kNN
+    # services on the radix epilogue (last-observed value per service;
+    # report the peak across services — stays 0.0 with metrics off)
+    from raft_tpu import obs
+
+    fam = obs.snapshot()["metrics"].get("select_k_bytes_per_s")
+    if fam and fam.get("series"):
+        report.select_k_bytes_per_s = max(
+            float(s["value"]) for s in fam["series"])
     return report
 
 

@@ -12,11 +12,13 @@ import numpy as np
 
 from raft_tpu.core.sparse_types import COOMatrix, CSRMatrix
 from raft_tpu.matrix import select_k as dense_select_k
+from raft_tpu.matrix.select_k import SelectAlgo
 from raft_tpu.sparse import convert
 
 
 def select_k(res, csr: CSRMatrix, k: int, select_min: bool = True,
-             in_idx=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+             in_idx=None, algo=SelectAlgo.AUTO
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-row top-k over a CSR matrix with logical shape [batch, len]
     (ref: sparse/matrix/select_k.cuh:64).
 
@@ -24,7 +26,14 @@ def select_k(res, csr: CSRMatrix, k: int, select_min: bool = True,
     entries are padded with the dummy bound value and index -1.  TPU
     formulation: scatter the ragged rows into a padded [batch, max_row_len]
     tile (static shape), then run the dense select_k path — the irregular
-    part is a single scatter, the selection rides the tuned dense kernel."""
+    part is a single scatter, the selection rides the tuned dense kernel.
+    Dense-band cells (max_row_len inside radix_select.preferred's band)
+    therefore ride the digit-histogram radix kernel under the default
+    AUTO dispatch; ``algo`` passes an explicit SelectAlgo through to the
+    dense tournament, and the selection is bit-identical to dense
+    select_k over the same materialized rows (the pad sentinel sorts
+    strictly last and can only surface on under-filled rows, where both
+    paths emit it)."""
     indptr = np.asarray(csr.indptr)
     row_len = np.diff(indptr)
     max_len = max(int(row_len.max()) if row_len.size else 0, k)
@@ -52,7 +61,8 @@ def select_k(res, csr: CSRMatrix, k: int, select_min: bool = True,
     padded_idx = jnp.full((n_rows, max_len), -1, dtype=csr.indices.dtype)
     padded_idx = padded_idx.at[row_ids, offsets].set(col_src, mode="drop")
 
-    vals, pos = dense_select_k(res, padded_val, k, select_min=select_min)
+    vals, pos = dense_select_k(res, padded_val, k, select_min=select_min,
+                               algo=algo)
     idx = jnp.take_along_axis(padded_idx, pos, axis=1)
     # positions selected from padding keep index -1
     valid = pos < jnp.asarray(row_len)[:, None]

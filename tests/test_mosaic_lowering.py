@@ -121,14 +121,16 @@ def test_packed_split_lowers_for_tpu(xy):
 @pytest.mark.parametrize("kcase", [(9000, 64), (1000, 7), (600, 5),
                                    (32768, 16384)])
 def test_radix_select_lowers_for_tpu(kcase):
-    """Both radix-select kernels: the fori_loop bit walk with in-loop
-    VMEM re-reads (threshold) and the triangular-matmul cumsum +
+    """Both radix-select kernels: the digit-histogram threshold (grid-
+    axis passes, factorized 16x16 one-hot MXU histogram in scratch,
+    triangular cumsum narrowing) and the triangular-matmul cumsum +
     factorized one-hot contraction with scratch carry (emission).
 
     This tier runs under jax_enable_x64 (conftest), which is exactly the
-    configuration where referencing the fori index inside a pallas_call
-    body recurses in jax.export lowering — the kernel's carry-the-bit
-    workaround (radix_select.py:_threshold_kernel) is pinned here."""
+    configuration where referencing a fori_loop index inside a
+    pallas_call body recurses in jax.export lowering — the threshold
+    kernel drives its passes from a grid axis (pl.program_id) instead
+    of a fori index, and that avoidance is pinned here."""
     from raft_tpu.matrix.radix_select import radix_select_k
 
     n_cols, k = kcase

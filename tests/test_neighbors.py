@@ -181,9 +181,10 @@ class TestKnnAdversarial:
 
 
 class TestChunkedRadixPath:
-    """The chunked-radix kNN path (dispatched at long databases with
-    16 < k <= 2048 — CPU suite shapes are below the dispatch gate, so
-    these call the internals directly plus one through-the-gate case)."""
+    """The chunked-radix kNN path (dispatched at long databases for
+    16 < k <= radix_select.MAX_K — CPU suite shapes are below the
+    dispatch gate, so these call the internals directly plus one
+    through-the-gate case)."""
 
     def test_multi_chunk_matches_oracle(self):
         from raft_tpu.neighbors.brute_force import _knn_chunked
@@ -233,6 +234,62 @@ class TestChunkedRadixPath:
         v, i = _knn_chunked(jnp.asarray(q), jnp.asarray(db), 20, 4096,
                             "l2")
         assert np.asarray(i)[0].tolist() == list(range(20))
+
+
+class TestLargeKEpilogue:
+    """Era-7 large-k epilogue: knn_plan is the single dispatch
+    predicate, k > 256 chains the digit-histogram radix select, and the
+    routed path is bit-identical to the scan reference."""
+
+    def test_knn_plan_bands(self):
+        from raft_tpu.neighbors.brute_force import knn_plan
+
+        # small k on a clean metric -> fused insert path
+        assert knn_plan(8, 20000, 64)[0] == "fused"
+        assert knn_plan(8, 20000, 256)[0] == "fused"
+        # above the insert capacity the radix epilogue takes over
+        path, chunk = knn_plan(8, 20000, 257)
+        assert path == "radix" and chunk > 0
+        path, chunk = knn_plan(4, 16384, 512)
+        assert path == "radix"
+        # vma-blocked (interpreter replay) falls off the pallas paths
+        assert knn_plan(8, 20000, 64, vma_blocked=True)[0] == "scan"
+        # tiny databases have nothing to chunk
+        assert knn_plan(8, 500, 300)[0] == "scan"
+
+    def test_fused_topk_epilogue_band(self):
+        from raft_tpu.neighbors import fused_topk
+
+        assert fused_topk.epilogue(256) == "insert"
+        assert fused_topk.epilogue(257) == "radix"
+        assert fused_topk.epilogue(1) == "insert"
+
+    def test_k512_dispatches_radix_and_matches_scan(self):
+        from raft_tpu.core import trace
+        from raft_tpu.neighbors.brute_force import _knn_scan
+
+        rng = np.random.default_rng(24)
+        db = rng.normal(size=(16384, 12)).astype(np.float32)
+        q = rng.normal(size=(3, 12)).astype(np.float32)
+        trace.clear_events()
+        d, i = knn(None, db, q, k=512)
+        evs = trace.events("knn.dispatch")
+        assert evs and evs[-1]["path"] == "radix"
+        assert evs[-1]["k"] == 512
+        sv, si = _knn_scan(jnp.asarray(q), jnp.asarray(db), 512,
+                           evs[-1]["chunk"], "l2")
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(si))
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(sv))
+
+    def test_small_k_dispatch_event_says_fused(self):
+        from raft_tpu.core import trace
+
+        rng = np.random.default_rng(25)
+        db = rng.normal(size=(700, 8)).astype(np.float32)
+        q = rng.normal(size=(2, 8)).astype(np.float32)
+        trace.clear_events()
+        knn(None, db, q, k=5)
+        assert trace.events("knn.dispatch")[-1]["path"] == "fused"
 
 
 class TestUnexpandedMetricsKnn:

@@ -264,3 +264,124 @@ class TestRadixFuzz:
                 np.asarray(gi), oi,
                 err_msg=f"trial={trial} shape={(n_rows, n_cols)} "
                         f"k={k} sm={sm}")
+
+
+class TestDigitHistogramThreshold:
+    """Era-7 digit-histogram threshold stage: pass-count provenance,
+    lax.top_k parity across dtypes, and envelope fallbacks."""
+
+    def test_trace_event_pass_count(self):
+        from raft_tpu.core import trace
+        from raft_tpu.matrix import radix_select as rs
+        rng = np.random.default_rng(70)
+        v = rng.normal(size=(3, 1000)).astype(np.float32)
+        trace.clear_events()
+        radix_select_k(jnp.asarray(v), 20)
+        evs = trace.events("radix.select")
+        assert evs, "radix_select_k must record its dispatch event"
+        ev = evs[-1]
+        # acceptance bar: the selected set is identified in <= 5 full-
+        # row passes (NPASS digit passes; emission adds one more read)
+        assert ev["threshold_passes"] == rs.NPASS
+        assert ev["threshold_passes"] + 1 <= 5
+        assert ev["path"] == "single"
+        assert (ev["rows"], ev["cols"], ev["k"]) == (3, 1000, 20)
+
+    def test_trace_event_two_level_path(self):
+        from raft_tpu.core import trace
+        from raft_tpu.matrix import radix_select as rs
+        old = rs.CHUNK_LEN
+        rs.CHUNK_LEN = 1024
+        try:
+            rng = np.random.default_rng(71)
+            v = rng.normal(size=(2, 3000)).astype(np.float32)
+            trace.clear_events()
+            radix_select_k(jnp.asarray(v), 8)
+            assert trace.events("radix.select")[-1]["path"] == "two_level"
+        finally:
+            rs.CHUNK_LEN = old
+
+    @pytest.mark.parametrize("dt", [np.float32, jnp.bfloat16, np.int32])
+    def test_lax_top_k_value_parity(self, dt):
+        """Selected VALUES match lax.top_k bit-for-bit per dtype (index
+        tie rules differ: top_k has no documented tie order, so parity
+        is on the sorted value multiset)."""
+        rng = np.random.default_rng(72)
+        v = rng.integers(-50, 50, size=(5, 2000)) if dt == np.int32 \
+            else rng.normal(size=(5, 2000))
+        x = jnp.asarray(v).astype(dt)
+        gv, _ = radix_select_k(x, 37, select_min=False)
+        tv, _ = jax.lax.top_k(x, 37)
+        np.testing.assert_array_equal(
+            np.asarray(gv).astype(np.float64),
+            np.asarray(tv).astype(np.float64))
+
+    def test_tie_count_is_exact(self):
+        """Heavy-tie input where the threshold digit is shared by most
+        of the row: exactly k columns come back, ties resolved
+        first-come (the ntie quota cannot over- or under-emit)."""
+        v = np.zeros((4, 1024), np.float32)
+        v[:, ::3] = -1.0          # below-threshold mass
+        gv, gi = radix_select_k(jnp.asarray(v), 400)
+        below = (np.asarray(gv) == -1.0).sum(axis=1)
+        np.testing.assert_array_equal(below, np.full(4, 342))
+        # tie quota filled strictly first-come among the zeros
+        zero_cols = np.setdiff1d(np.arange(1024), np.arange(0, 1024, 3))
+        for r in range(4):
+            got_zero = np.sort(np.asarray(gi)[r][np.asarray(gv)[r] == 0.0])
+            np.testing.assert_array_equal(got_zero, zero_cols[:400 - 342])
+
+    def test_envelope_k_above_max_falls_back(self):
+        """k > MAX_K: supports() refuses, and the explicit radix enum
+        falls back to a tournament path that still answers correctly."""
+        from raft_tpu.matrix import radix_select as rs
+        assert not supports(np.float32, 1 << 15, rs.MAX_K + 1)
+        rng = np.random.default_rng(73)
+        v = rng.normal(size=(2, 1 << 15)).astype(np.float32)
+        k = rs.MAX_K + 1
+        gv, gi = select_k(None, v, k, algo=SelectAlgo.RADIX_8BITS)
+        ov, oi = _oracle(v, k)
+        np.testing.assert_array_equal(np.asarray(gi), oi)
+
+    def test_envelope_cols_above_max_len(self):
+        from raft_tpu.matrix import radix_select as rs
+        assert not supports(np.float32, rs.MAX_LEN + 1, 512)
+        assert not rs.preferred(rs.MAX_LEN + 1, 512)
+
+    def test_preferred_band_extends_to_max_k(self):
+        """Era-7 band: short rows (>= MIN_COLS) prefer radix for the
+        whole 16 < k <= MAX_K band; long rows keep the k > 256 gate."""
+        from raft_tpu.matrix import radix_select as rs
+        assert rs.preferred(rs.MIN_COLS, rs.MAX_K)
+        assert rs.preferred(rs.MIN_COLS, 17)
+        assert not rs.preferred(rs.MIN_COLS, 16)
+        assert not rs.preferred(rs.MIN_COLS - 1, 512)
+        assert rs.preferred(1 << 20, 257)
+        assert rs.preferred(1 << 20, rs.MAX_K)
+        assert not rs.preferred(1 << 20, 16)
+
+    def test_hist_tiles_fit_budget(self):
+        """Every (tm, tl) the threshold sizer can pick stays inside the
+        shared VMEM budget."""
+        from raft_tpu.matrix import radix_select as rs
+        from raft_tpu.linalg.contractions import _VMEM_BUDGET
+        for lp in (1024, 2048, 4096, 8192, 1 << 20):
+            for n_rows in (1, 7, 8, 64, 1000):
+                tm, tl = rs._hist_tiles(n_rows, lp, 8)
+                assert lp % tl == 0
+                assert rs._hist_live_set_bytes(tm, tl) <= _VMEM_BUDGET
+
+
+class TestSelectionCostModel:
+    def test_traffic_ratio_meets_bar(self):
+        """Acceptance bar: the digit-histogram walk moves >= 4x fewer
+        selection-stage bytes than the binary-search threshold."""
+        from benches import select_model
+        assert select_model.traffic_ratio() >= 4.0
+
+    def test_bytes_scale_with_shape(self):
+        from benches import select_model
+        b = select_model.selection_bytes(64, 1 << 20)
+        assert b == select_model.DIGIT_HIST_PASSES * 64 * (1 << 20) * 4
+        assert select_model.selection_bytes(64, 1 << 20, algo="binary") \
+            == select_model.BINARY_SEARCH_PASSES * 64 * (1 << 20) * 4

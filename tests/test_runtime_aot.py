@@ -204,8 +204,9 @@ def test_lloyd_packed_spelling_exports(tmp_path):
 
 
 def test_radix_select_exports(tmp_path):
-    """The radix-select kernels (fori bit walk + batched-dot emission +
-    scratch carry) survive the AOT serialize/reload boundary with
+    """The radix-select kernels (grid-axis digit-histogram threshold +
+    batched-dot emission + scratch carry) survive the AOT
+    serialize/reload boundary with
     identical results — the runtime layer's contract for every shipped
     kernel family."""
     import numpy as np

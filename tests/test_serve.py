@@ -364,6 +364,51 @@ class TestLoadgen:
         json.dumps(d)                  # bench-line serializable
 
 
+class TestRadixEpilogueServePath:
+    """Era-7 serve wiring: a k > 256 KnnService warms onto the radix
+    epilogue (trace-visible), its launches set the
+    select_k_bytes_per_s gauge, and the loadgen report carries it."""
+
+    @pytest.fixture
+    def big_db(self):
+        rng = np.random.default_rng(77)
+        return rng.standard_normal((16384, DIM)).astype(np.float32)
+
+    def test_epilogue_and_selection_bytes(self, big_db, data):
+        from raft_tpu.matrix.radix_select import NPASS
+
+        svc = serve.KnnService(big_db, k=512)
+        assert svc.epilogue() == "radix"
+        assert svc.selection_bytes(8) == (NPASS + 2) * 8 * 16384 * 4
+        small = serve.KnnService(data["db"], k=4)
+        assert small.epilogue() != "radix"
+        assert small.selection_bytes(8) == 0
+
+    def test_warm_event_and_launch_gauge(self, big_db, live_obs):
+        from raft_tpu.core import trace
+
+        ex = serve.Executor(
+            [serve.KnnService(big_db, k=512)],
+            policy=serve.BatchPolicy(max_batch=8, max_wait_ms=1.0))
+        trace.clear_events()
+        ex.warm(buckets=(8,))
+        warmed = trace.events("serve.warmed")
+        assert warmed and warmed[-1]["epilogue"] == "radix"
+        rng = np.random.default_rng(78)
+        with ex:
+            fut = ex.submit("knn_k512_l2", _queries(rng, 4))
+            fut.result(timeout=120)
+        fam = live_obs.snapshot().get("select_k_bytes_per_s")
+        assert fam and fam["series"], \
+            "radix-epilogue launch must set the bandwidth gauge"
+        assert fam["series"][0]["value"] > 0
+        assert fam["series"][0]["labels"]["op"] == "knn_k512_l2"
+        rep = serve.LoadReport(mode="x", duration_s=1.0)
+        from raft_tpu.serve.loadgen import _finalize
+        rep = _finalize(rep, ex, (0, 0, 0), 0.0)
+        assert rep.as_dict()["select_k_bytes_per_s"] > 0
+
+
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 
