@@ -1012,6 +1012,50 @@ def bench_kmeans():
                            rows=1 << 20, k=1024, tier=tier)
 
 
+@bench("cluster/mnmg_lloyd_sync")
+def bench_mnmg_lloyd_sync():
+    """MULTICHIP Lloyd per-iteration wall time, host-driven
+    (sync_every=1, one shard_map launch + convergence fetch per
+    iteration) vs compiled chunks (sync_every=8, one program per 8
+    iterations with the psum epilogues and convergence test fused
+    in-graph). The sync=8 row approximates pure device time per
+    iteration; the row-pair difference is the host overhead (dispatch +
+    sync fetch) the compiled inner loop removes."""
+    from jax.sharding import Mesh
+    from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit_mnmg
+
+    devs = np.asarray(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    n = len(devs)
+    rows = 1 << (18 if SIZES["rows"] >= (1 << 20) else 12)
+    iters = 16
+    x = _data(rows, 64, seed=40)
+    p = KMeansParams(n_clusters=SIZES["k"], seed=0, max_iter=iters,
+                     tol=-1.0)  # tol<0: never converges → exactly iters
+
+    out = []
+    per_iter_ms = {}
+    for sync in (1, 8):
+        f = functools.partial(kmeans_fit_mnmg, None, p, x, mesh=mesh,
+                              sync_every=sync)
+        r = run_case(f"cluster/mnmg_lloyd_sync{sync}", f,
+                     items=rows * iters, rows=rows, k=SIZES["k"],
+                     nranks=n, iters=iters, sync_every=sync,
+                     host_syncs=-(-iters // sync))
+        per_iter_ms[sync] = r.median_ms / iters
+        out.append(r)
+    # Device/host split, stamped on BOTH rows so either alone tells the
+    # story: device_ms/iter ≈ the chunked per-iter time, host overhead
+    # ≈ what sync_every=1 pays on top of it (clamped ≥0: on a fast host
+    # the two medians can cross within noise).
+    dev = per_iter_ms[8]
+    host = max(per_iter_ms[1] - per_iter_ms[8], 0.0)
+    for r in out:
+        r.params["device_ms_per_iter"] = round(dev, 4)
+        r.params["host_overhead_ms_per_iter"] = round(host, 4)
+    return out
+
+
 @bench("neighbors/brute_force")
 def bench_knn():
     """Brute-force k-NN (the cuVS consumer workload rebuilt from the

@@ -24,7 +24,12 @@ import jax
 # every matrix/select_k* radix row and chunked-kNN row measures a
 # different kernel, so the 3.6-6.4 GB/s binary-search-era rows read as
 # superseded the moment an era-7 row lands in their family.
-BENCH_ERA = 7
+# Era 8: MNMG solver rows split per-iteration wall time into device
+# work vs host overhead (compiled inner loops with donated carries —
+# sync_every chunks run as ONE program, host touched per chunk, not per
+# iteration). Host-driven-era MULTICHIP rows bundled both costs into
+# one number and read as superseded once an era-8 row lands.
+BENCH_ERA = 8
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

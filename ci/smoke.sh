@@ -558,4 +558,49 @@ print("serve-path gate: k=512 warmed onto radix epilogue; "
       "batched answer bit-identical to unbatched knn")
 PYEOF
 
+# Compiled-driver gate (single-program multichip): a 32-iteration kmeans
+# fit at sync_every=8 must touch the host exactly ceil(32/8)=4 times
+# (trace events AND the solver_host_syncs_total counter agree), and
+# sync_every=1 must stay bit-for-bit the host-driven loop.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+import jax
+
+from raft_tpu import obs
+from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+from raft_tpu.core import trace
+from raft_tpu.obs import metrics as obs_metrics
+
+rng = np.random.default_rng(0)
+x = rng.standard_normal((512, 16)).astype(np.float32)
+
+obs_metrics.set_registry(obs.MetricsRegistry())
+obs.set_enabled(True)
+trace.clear_events()
+p = KMeansParams(n_clusters=8, seed=0, max_iter=32, tol=-1.0)
+kmeans_fit(None, p, x, sync_every=8)
+chunks = trace.events("compiled_driver.chunk")
+assert len(chunks) == 4, \
+    f"32 iters at sync_every=8 must be 4 chunks, saw {len(chunks)}"
+assert sum(e["steps"] for e in chunks) == 32
+snap = obs_metrics.get_registry().snapshot()
+series = snap["solver_host_syncs_total"]["series"]
+got = {tuple(s["labels"].items()): s["value"] for s in series}
+assert got.get((("op", "cluster.kmeans_fit"),)) == 4, \
+    f"solver_host_syncs_total must read 4, saw {got}"
+obs.set_enabled(False)
+
+p2 = KMeansParams(n_clusters=8, seed=0, max_iter=20)
+c1, i1, l1, n1 = kmeans_fit(None, p2, x, sync_every=1)
+trace.clear_events()
+c0, i0, l0, n0 = kmeans_fit(None, p2, x)  # default: host-driven on cpu
+assert not trace.events("compiled_driver.chunk"), \
+    "cpu default must stay the host-driven loop"
+np.testing.assert_array_equal(np.asarray(c1), np.asarray(c0))
+np.testing.assert_array_equal(np.asarray(l1), np.asarray(l0))
+assert (i1, n1) == (i0, n0)
+print("compiled-driver gate: 4 host syncs for 32 iters at sync_every=8 "
+      "(trace+counter agree); sync_every=1 bit-identical to host loop")
+PYEOF
+
 echo "smoke: PASS"

@@ -217,6 +217,131 @@ def weighted_lloyd_step(x, w, centroids, n_clusters: int):
     return new_centroids, winertia, labels
 
 
+# ---------------------------------------------------------------------------
+# compiled inner loop (runtime/compiled_driver): sync_every > 1 runs a
+# chunk of Lloyd iterations as ONE device program with a donated carry
+# ---------------------------------------------------------------------------
+
+
+def _lloyd_convergence_step(lloyd_fn, carry, tol: float):
+    """In-graph half of the host loops' convergence poll, shared by the
+    compiled single-chip and MNMG chunk bodies: one Lloyd update, then
+    the host loops' relative-inertia test. ``prev`` is +inf until the
+    first completed iteration (the host's ``prev is None``); the
+    accumulator dtype is float64 when x64 is on, so the in-graph test
+    matches the host loops' Python-float arithmetic on the test meshes.
+    """
+    c, prev, _ = carry
+    new_c, inertia = lloyd_fn(c)
+    cur = inertia.astype(prev.dtype)
+    rel = jnp.abs(prev - cur) / jnp.maximum(prev, 1e-30)
+    rel = jnp.where(jnp.isfinite(prev), rel, jnp.inf)
+    done = jnp.isfinite(prev) & (rel <= tol)
+    return (new_c, cur, rel), done
+
+
+@with_matmul_precision
+@functools.partial(jax.jit, static_argnames=("n_clusters", "tol"),
+                   donate_argnums=(1,))
+def _lloyd_chunk(x, carry, steps, *, n_clusters: int, tol: float):
+    """Up to ``steps`` plain Lloyd iterations as one device program —
+    the compiled twin of the :func:`lloyd_step` host loop, with the
+    convergence test fused in-graph and the carry donated."""
+    from raft_tpu.runtime.compiled_driver import chunk_while
+
+    def step(carry):
+        def lloyd(c):
+            sums, counts, dist, _ = _lloyd_sums(x, c)
+            return _finish_update(sums, counts, c), jnp.sum(dist)
+
+        return _lloyd_convergence_step(lloyd, carry, tol)
+
+    return chunk_while(step, carry, steps)
+
+
+@with_matmul_precision
+@functools.partial(jax.jit, static_argnames=("tm", "m", "tol"),
+                   donate_argnums=(1,))
+def _lloyd_chunk_prepared(ops, carry, steps, *, tm: int, m: int,
+                          tol: float):
+    """Prepared-operand variant of :func:`_lloyd_chunk` (tier-'high'
+    hoisted X split — see :func:`lloyd_step_prepared`)."""
+    from raft_tpu.linalg.contractions import fused_lloyd_prepared
+    from raft_tpu.runtime.compiled_driver import chunk_while
+
+    def step(carry):
+        def lloyd(c):
+            sums, counts, dist, _ = fused_lloyd_prepared(
+                ops, c, tm=tm, m=m)
+            return _finish_update(sums, counts, c), jnp.sum(dist)
+
+        return _lloyd_convergence_step(lloyd, carry, tol)
+
+    return chunk_while(step, carry, steps)
+
+
+@with_matmul_precision
+@functools.partial(jax.jit, static_argnames=("n_clusters", "tol"),
+                   donate_argnums=(2,))
+def _weighted_lloyd_chunk(x, w, carry, steps, *, n_clusters: int,
+                          tol: float):
+    """Sample-weighted variant of :func:`_lloyd_chunk` (the
+    :func:`weighted_lloyd_step` body in-graph)."""
+    from raft_tpu.runtime.compiled_driver import chunk_while
+
+    def step(carry):
+        def lloyd(c):
+            dist, labels = _assign(x, c)
+            sums, counts, winertia = _weighted_sums(
+                x, w, labels, dist, n_clusters)
+            return _finish_update(sums, counts, c), winertia
+
+        return _lloyd_convergence_step(lloyd, carry, tol)
+
+    return chunk_while(step, carry, steps)
+
+
+def _lloyd_sentinel(carry, steps_done: int):
+    """Guard-mode boundary check for the compiled Lloyd chunks: after at
+    least one completed iteration the carried inertia must be finite —
+    a NaN/Inf here means the update diverged, surfaced as the typed
+    error at the chunk boundary instead of NaN centroids at the end."""
+    import numpy as np
+
+    from raft_tpu.core.guards import NonFiniteError
+
+    val = float(np.asarray(carry[1]))
+    if steps_done > 0 and not np.isfinite(val):
+        raise NonFiniteError(
+            f"cluster.kmeans: non-finite inertia {val!r} at compiled "
+            f"chunk boundary (iteration {steps_done})",
+            op="cluster.kmeans_fit")
+
+
+class _LazyHostMirror:
+    """Deferred host copy of a device operand.
+
+    The MNMG fit used to materialize ``np.asarray(x)`` unconditionally —
+    a full extra dataset copy in host RSS — even though only a
+    shrink/resume rebuild ever reads it. The copy now happens on first
+    :meth:`get`; the common single-process fit never pays it."""
+
+    def __init__(self, arr):
+        self._arr = arr
+        self._host = None
+
+    @property
+    def built(self) -> bool:
+        return self._host is not None
+
+    def get(self):
+        if self._host is None:
+            import numpy as np
+
+            self._host = np.asarray(self._arr)
+        return self._host
+
+
 def _weighted_plus_plus(rng, cand, w, n_clusters: int):
     """Classic weighted k-means++ on the (small) candidate set — host-side
     numpy; candidate count is O(rounds · oversampling · k)."""
@@ -371,12 +496,21 @@ def _finish_report(converged: bool, n_iter: int, rel_change: float,
 def kmeans_fit(res, params: KMeansParams, x,
                centroids: Optional[jnp.ndarray] = None,
                sample_weights=None, strict: bool = False,
-               return_report: bool = False
+               return_report: bool = False,
+               sync_every: Optional[int] = None
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
     """Lloyd's algorithm. Returns (centroids, inertia, labels, n_iter).
 
     Host-driven convergence loop around the jitted `lloyd_step` — the same
     structure as the reference lineage's host loop enqueueing fused kernels.
+
+    ``sync_every``: with n > 1, chunks of n Lloyd iterations run as ONE
+    jitted ``lax.while_loop`` with a donated carry and the convergence
+    test in-graph; the host (and its deadline poll) is touched only at
+    chunk boundaries (see :mod:`raft_tpu.runtime.compiled_driver`).
+    ``sync_every=1`` IS the host-driven path above, bit-for-bit. The
+    default ``None`` asks the cost model: 1 on CPU, 8–16 on an
+    accelerator.
 
     ``sample_weights`` [m] (ref/cuVS parity: fit's ``sample_weight``):
     points contribute proportionally to the centroid update and the
@@ -426,7 +560,37 @@ def kmeans_fit(res, params: KMeansParams, x,
 
     ops, meta = (None, None) if w is not None \
         else lloyd_prepare(x, params.n_clusters)
-    if ops is not None:
+    from raft_tpu.runtime import compiled_driver
+
+    sync = compiled_driver.resolve_sync_every(sync_every)
+    if sync > 1:
+        # Compiled inner loop: sync_every iterations per launch, carry
+        # donated, convergence tested in-graph — host syncs once per
+        # chunk (deadline poll + slack recording ride the boundary).
+        acc = compiled_driver.host_float_dtype()
+        tol = float(params.tol)
+        if ops is not None:
+            chunk_call = functools.partial(_lloyd_chunk_prepared, ops,
+                                           tol=tol, **meta)
+        elif w is not None:
+            chunk_call = functools.partial(
+                _weighted_lloyd_chunk, x, w,
+                n_clusters=params.n_clusters, tol=tol)
+        else:
+            chunk_call = functools.partial(
+                _lloyd_chunk, x, n_clusters=params.n_clusters, tol=tol)
+        est = limits.estimate_seconds(
+            "cluster.lloyd_step", m=int(x.shape[0]), k=int(x.shape[1]),
+            n_clusters=params.n_clusters, itemsize=x.dtype.itemsize)
+        carry = (c, jnp.asarray(jnp.inf, acc), jnp.asarray(jnp.inf, acc))
+        carry, n_iter, done = compiled_driver.run_chunked(
+            chunk_call, carry, max_steps=params.max_iter,
+            sync_every=sync, op="cluster.kmeans_fit",
+            est_step_seconds=est, sentinel=_lloyd_sentinel)
+        c = carry[0]
+        rel_change = float(np.asarray(carry[2]))
+        converged = bool(done)
+    elif ops is not None:
         # Prepared path: run each between-polls block of iterations as
         # ONE compiled scan (one launch per block instead of per step —
         # see lloyd_iterate_prepared). Identical iteration sequence and
@@ -591,9 +755,21 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
                     checkpoint_keep: int = 2,
                     resume_from: Optional[str] = None,
                     strict: bool = False,
-                    return_report: bool = False):
+                    return_report: bool = False,
+                    sync_every: Optional[int] = None):
     """MNMG Lloyd over a row-partitioned dataset (ref workload: raft-dask
     MNMG k-means; BASELINE config 5).
+
+    ``sync_every``: with n > 1, the per-iteration ``shard_map`` launch
+    becomes ONE program per n iterations — a ``lax.while_loop`` INSIDE
+    the shard_map body, so the per-iteration ``lax.psum`` epilogues and
+    the convergence test fuse in-graph and the host is touched once per
+    chunk. The checkpoint hook, comms health probe and deadline poll all
+    move to the chunk boundary (same checkpoint-before-probe-before-poll
+    ordering as the host loop, so expiry still leaves a resumable file).
+    ``sync_every=1`` (and the CPU default) is the host-driven loop below,
+    bit-for-bit; the host-mailbox :func:`kmeans_fit_elastic` stays the
+    rank-death-tolerant fallback, unchanged.
 
     x: global [m, k] array (sharded or to-be-sharded along rows over
     ``data_axis``). Returns (centroids, inertia, labels, n_iter).
@@ -659,10 +835,16 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
                                               prefix="kmeans",
                                               keep=checkpoint_keep)
 
-    # host copies survive any mesh: resharding after a shrink re-places
-    # them over the survivor devices
-    x_host = np.asarray(x)
-    w_host = None if w is None else np.asarray(w)
+    # Host mirrors survive any mesh (a shrink re-places them over the
+    # survivor devices) — but only a shrink/resume rebuild ever reads
+    # them, so they are LAZY: the common single-process fit never pays
+    # the extra dataset copy in host RSS.
+    x_mirror = _LazyHostMirror(x)
+    w_mirror = None if w is None else _LazyHostMirror(w)
+
+    from raft_tpu.runtime import compiled_driver
+
+    sync = compiled_driver.resolve_sync_every(sync_every)
 
     state = RngState(seed=params.seed)
     prev = None
@@ -682,16 +864,23 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
     per_shard_k = (params.n_clusters if model_axis is None
                    else params.n_clusters // mesh.shape[model_axis])
 
-    def build_run(cur_mesh, c_host):
+    def build_run(cur_mesh, c_host, from_host: bool = False):
         """(Re)build the jitted step over ``cur_mesh`` and place the
-        data + centroids on it; returns (run, centroids_on_device)."""
-        xd = jax.device_put(jnp.asarray(x_host),
-                            NamedSharding(cur_mesh, P(data_axis)))
+        data + centroids on it; returns (run, centroids_on_device,
+        run_chunk). ``from_host=True`` re-places from the lazy host
+        mirrors — the shrink/resume rebuild path, the only consumer of
+        the host copies. ``run_chunk`` is the compiled chunk program
+        (None when ``sync_every <= 1``)."""
+        xd = jax.device_put(
+            jnp.asarray(x_mirror.get()) if from_host else x,
+            NamedSharding(cur_mesh, P(data_axis)))
         cd = jax.device_put(jnp.asarray(c_host),
                             NamedSharding(cur_mesh, c_spec))
-        wd = (None if w_host is None else
-              jax.device_put(jnp.asarray(w_host),
-                             NamedSharding(cur_mesh, P(data_axis))))
+        wd = None
+        if w is not None:
+            wd = jax.device_put(
+                jnp.asarray(w_mirror.get()) if from_host else w,
+                NamedSharding(cur_mesh, P(data_axis)))
         # per-shard cluster count: the model-axis branch derives its
         # block from the sharded centroids' shape, but the WEIGHTED
         # data-parallel branch uses n_clusters as the one-hot width —
@@ -713,9 +902,52 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
             args = (xd, cc) if wd is None else (xd, cc, wd)
             return step(*args)
 
-        return run, cd
+        if sync <= 1:
+            return run, cd, None
 
-    run, c = build_run(mesh, c_init)
+        # Compiled chunk: the while_loop sits INSIDE the shard_map body,
+        # so the per-iteration psums fuse into one program and XLA
+        # schedules the collectives across iterations. The carry's
+        # convergence scalars are psum products — replicated, so the
+        # P() specs hold.
+        from raft_tpu.runtime.compiled_driver import chunk_while
+
+        tol = float(params.tol)
+        carry_specs = (c_spec, P(), P())
+        if wd is None:
+            def chunk_body(xs, carry, steps):
+                def one(car):
+                    return _lloyd_convergence_step(
+                        lambda cc: step_fn(xs, cc)[:2], car, tol)
+
+                return chunk_while(one, carry, steps)
+
+            chunk_in = (P(data_axis), carry_specs, P())
+            donate = 1
+        else:
+            def chunk_body(xs, ws, carry, steps):
+                def one(car):
+                    return _lloyd_convergence_step(
+                        lambda cc: step_fn(xs, cc, w_shard=ws)[:2],
+                        car, tol)
+
+                return chunk_while(one, carry, steps)
+
+            chunk_in = (P(data_axis), P(data_axis), carry_specs, P())
+            donate = 2
+        chunk = jax.jit(jax.shard_map(
+            chunk_body, mesh=cur_mesh, in_specs=chunk_in,
+            out_specs=(carry_specs, P(), P())),
+            donate_argnums=(donate,))
+
+        def run_chunk(carry, steps):
+            args = ((xd, carry, steps) if wd is None
+                    else (xd, wd, carry, steps))
+            return chunk(*args)
+
+        return run, cd, run_chunk
+
+    run, c, run_chunk = build_run(mesh, c_init)
     n_iter = start_iter
     check = max(1, int(params.check_every))
     ckpt_stride = (None if manager is None
@@ -724,63 +956,139 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
     labels = None
     converged = False
     rel_change = float("inf")
-    while n_iter < params.max_iter:
-        try:
-            converged = False
-            for n_iter in range(n_iter + 1, params.max_iter + 1):
-                c, inertia, labels = run(c)
-                if n_iter % check and n_iter != params.max_iter:
-                    continue             # no host sync between polls
-                # checkpoint BEFORE the health probe: recovery resumes
-                # from this very boundary, re-running nothing older
-                if ckpt_stride is not None and (
-                        n_iter % ckpt_stride == 0
-                        or n_iter == params.max_iter):
-                    manager.save(n_iter, {
-                        "centroids": np.asarray(c),
-                        "prev_inertia": (float("inf") if prev is None
-                                         else float(prev)),
-                        "n_iter": int(n_iter),
-                        "rng": state,
-                    })
-                if comms is not None:
-                    comms.ensure_healthy()
-                # deadline poll after checkpoint + health probe: an
-                # expiring budget leaves the checkpoint resumable, and
-                # DeadlineExceededError is NOT a clique failure — it
-                # propagates past the elastic handler below
-                limits.check_deadline("cluster.kmeans_fit_mnmg")
-                if prev is not None:
-                    rel_change = abs(prev - float(inertia)) / \
-                        max(prev, 1e-30)
-                    if rel_change <= params.tol:
-                        converged = True
-                        break
-                prev = float(inertia)
-            if converged or n_iter >= params.max_iter:
+    if sync > 1:
+        # Compiled path: every robustness hook fires at chunk
+        # boundaries via run_chunked — checkpoint then health probe
+        # (the boundary closure, same ordering as the host loop below)
+        # then the deadline poll, so expiry always leaves a resumable
+        # file and a peer failure recovers from the newest boundary.
+        acc = compiled_driver.host_float_dtype()
+        chunk_stride = (None if manager is None
+                        else sync * max(1, int(checkpoint_every)))
+        est = limits.estimate_seconds(
+            "cluster.lloyd_step",
+            m=-(-int(x.shape[0]) // mesh.shape[data_axis]),
+            k=int(x.shape[1]), n_clusters=params.n_clusters,
+            itemsize=x.dtype.itemsize)
+        carry = (c,
+                 jnp.asarray(np.inf if prev is None else prev, acc),
+                 jnp.asarray(np.inf, acc))
+        last_saved = [start_iter if resume_from is not None else -1]
+
+        def boundary(cr, steps_done, done_flag):
+            if chunk_stride is not None and steps_done > 0 and (
+                    steps_done - max(last_saved[0], 0) >= chunk_stride
+                    or ((done_flag or steps_done >= params.max_iter)
+                        and steps_done != last_saved[0])):
+                manager.save(steps_done, {
+                    "centroids": np.asarray(cr[0]),
+                    "prev_inertia": float(np.asarray(cr[1])),
+                    "n_iter": int(steps_done),
+                    "rng": state,
+                })
+                last_saved[0] = steps_done
+            if comms is not None:
+                comms.ensure_healthy()
+
+        while True:
+            try:
+                carry, n_iter, conv = compiled_driver.run_chunked(
+                    run_chunk, carry, max_steps=params.max_iter,
+                    sync_every=sync, op="cluster.kmeans_fit_mnmg",
+                    steps_done=n_iter, est_step_seconds=est,
+                    boundary=boundary, sentinel=_lloyd_sentinel)
+                converged = bool(conv)
+                c = carry[0]
+                rel_change = float(np.asarray(carry[2]))
                 break
-        except (PeerFailedError, CommsAbortedError) as e:
-            if comms is None or manager is None:
-                raise
-            latest = manager.restore_latest()
-            if latest is None:
-                raise
-            logger.warn("kmeans_fit_mnmg: clique failure at iteration "
-                        "%d (%r); recovering on survivors", n_iter, e)
-            survivors = comms.agree_on_survivors()
-            comms = comms.shrink(survivors)
-            core_res.set_comms(handle, comms)
-            mesh = comms.mesh
-            step_at, entries = latest
-            prev = entries["prev_inertia"]
-            if not np.isfinite(prev):
-                prev = None
-            state = entries.get("rng", state)
-            run, c = build_run(mesh, entries["centroids"])
-            n_iter = int(entries["n_iter"])
-            trace.record_event("kmeans.elastic_resume", iteration=n_iter,
-                               checkpoint_step=step_at,
-                               survivors=tuple(survivors))
+            except (PeerFailedError, CommsAbortedError) as e:
+                if comms is None or manager is None:
+                    raise
+                latest = manager.restore_latest()
+                if latest is None:
+                    raise
+                logger.warn("kmeans_fit_mnmg: clique failure at "
+                            "iteration %d (%r); recovering on "
+                            "survivors", n_iter, e)
+                survivors = comms.agree_on_survivors()
+                comms = comms.shrink(survivors)
+                core_res.set_comms(handle, comms)
+                mesh = comms.mesh
+                step_at, entries = latest
+                state = entries.get("rng", state)
+                run, c, run_chunk = build_run(
+                    mesh, entries["centroids"], from_host=True)
+                n_iter = int(entries["n_iter"])
+                last_saved[0] = n_iter
+                carry = (c,
+                         jnp.asarray(entries["prev_inertia"], acc),
+                         jnp.asarray(np.inf, acc))
+                trace.record_event("kmeans.elastic_resume",
+                                   iteration=n_iter,
+                                   checkpoint_step=step_at,
+                                   survivors=tuple(survivors))
+    else:
+        while n_iter < params.max_iter:
+            try:
+                converged = False
+                for n_iter in range(n_iter + 1, params.max_iter + 1):
+                    c, inertia, labels = run(c)
+                    if n_iter % check and n_iter != params.max_iter:
+                        continue         # no host sync between polls
+                    # checkpoint BEFORE the health probe: recovery
+                    # resumes from this very boundary, re-running
+                    # nothing older
+                    if ckpt_stride is not None and (
+                            n_iter % ckpt_stride == 0
+                            or n_iter == params.max_iter):
+                        manager.save(n_iter, {
+                            "centroids": np.asarray(c),
+                            "prev_inertia": (float("inf") if prev is None
+                                             else float(prev)),
+                            "n_iter": int(n_iter),
+                            "rng": state,
+                        })
+                    if comms is not None:
+                        comms.ensure_healthy()
+                    # deadline poll after checkpoint + health probe: an
+                    # expiring budget leaves the checkpoint resumable,
+                    # and DeadlineExceededError is NOT a clique failure
+                    # — it propagates past the elastic handler below
+                    limits.check_deadline("cluster.kmeans_fit_mnmg")
+                    if prev is not None:
+                        rel_change = abs(prev - float(inertia)) / \
+                            max(prev, 1e-30)
+                        if rel_change <= params.tol:
+                            converged = True
+                            break
+                    prev = float(inertia)
+                if converged or n_iter >= params.max_iter:
+                    break
+            except (PeerFailedError, CommsAbortedError) as e:
+                if comms is None or manager is None:
+                    raise
+                latest = manager.restore_latest()
+                if latest is None:
+                    raise
+                logger.warn("kmeans_fit_mnmg: clique failure at "
+                            "iteration %d (%r); recovering on "
+                            "survivors", n_iter, e)
+                survivors = comms.agree_on_survivors()
+                comms = comms.shrink(survivors)
+                core_res.set_comms(handle, comms)
+                mesh = comms.mesh
+                step_at, entries = latest
+                prev = entries["prev_inertia"]
+                if not np.isfinite(prev):
+                    prev = None
+                state = entries.get("rng", state)
+                run, c, _ = build_run(mesh, entries["centroids"],
+                                      from_host=True)
+                n_iter = int(entries["n_iter"])
+                trace.record_event("kmeans.elastic_resume",
+                                   iteration=n_iter,
+                                   checkpoint_step=step_at,
+                                   survivors=tuple(survivors))
     # re-assign against the FINAL centroids for a self-consistent return:
     # one more step gives labels + inertia vs c (its centroid update is
     # discarded) — works identically on 1-D and 2-D meshes

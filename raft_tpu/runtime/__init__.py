@@ -22,9 +22,10 @@ from raft_tpu.runtime.aot import (aot_export, deserialize_computation,
                                   load_computation, save_computation,
                                   serialize_computation)
 from raft_tpu.runtime import limits, random_gen, solver
+from raft_tpu.runtime import compiled_driver
 
 __all__ = [
     "aot_export", "serialize_computation", "deserialize_computation",
     "save_computation", "load_computation", "solver", "random_gen",
-    "limits",
+    "limits", "compiled_driver",
 ]

@@ -1,0 +1,200 @@
+"""Compiled solver inner loops with donated carries (ROADMAP item 4).
+
+Every iterative solver in this repo was host-driven: one jitted step per
+iteration, paying a dispatch + device→host readback + deadline-poll
+round-trip each time (~70 ms tunnel RTT on the remote-dispatch TPU setup
+vs ~12 ms of device work at the BASELINE kmeans shape). The exemplar
+repos are all pjit-shaped — compile the whole sharded computation once,
+donate the carry, let XLA schedule the ICI collectives.
+
+This module is the shared chunk-runner both solver families wire into:
+
+- :func:`chunk_while` — the in-graph half: up to ``steps`` iterations of
+  a ``step_fn(carry) -> (carry, done)`` body inside ONE
+  ``lax.while_loop`` with an early-exit flag, embeddable inside ``jit``
+  or ``shard_map`` bodies (the caller owns compilation and donation, so
+  the MNMG paths can fuse their per-iteration ``lax.psum`` epilogues
+  into the same program).
+- :func:`run_chunked` — the host half: drives a compiled chunk program
+  until convergence or a step budget, touching the host ONCE per chunk.
+  Every host-side robustness hook moves to the chunk boundary: the
+  deadline poll, the checkpoint/health ``boundary`` callback (fired
+  BEFORE the poll, so an expiring budget always leaves a resumable
+  checkpoint behind), and the guard-mode ``sentinel``. Each boundary
+  records a span, bumps ``solver_host_syncs_total`` and emits a
+  ``compiled_driver.chunk`` trace event — the always-on signal CI uses
+  to catch a reintroduced per-iteration ``block_until_ready``.
+- :func:`default_sync_every` / :func:`resolve_sync_every` — the cost
+  model for the chunk length: 1 on CPU (host dispatch is cheap there,
+  and 1 routes callers through their unchanged host-driven path
+  bit-for-bit), 8–16 on an accelerator sized so the per-chunk
+  dispatch+readback overhead stays under ~5% of device work.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+import warnings
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu import obs
+from raft_tpu.core import trace
+from raft_tpu.core.guards import resolve_guard_mode
+from raft_tpu.runtime import limits
+
+# Donation is a no-op on backends without buffer aliasing (CPU); the
+# resulting "Some donated buffers were not usable" UserWarning is noise
+# for the virtual-device test meshes, not a correctness signal.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+# Nominal per-launch host overhead (dispatch + small-scalar readback) by
+# backend, seconds. The tpu figure is the measured tunnel RTT the bench
+# harness documents (benches/harness.py::_sync); it only seeds the cost
+# model — run_chunked refines with measured per-chunk wall time.
+DISPATCH_OVERHEAD_S = {"tpu": 0.070, "gpu": 5e-4, "cpu": 5e-5}
+
+# Accelerator chunk-length clamp: below 8 the per-chunk overhead still
+# shows at the north-star shape; above 16 a converged fit wastes up to a
+# chunk of dead iterations and deadline granularity degrades.
+SYNC_EVERY_LO = 8
+SYNC_EVERY_HI = 16
+
+# Fraction of a chunk's device time the per-chunk host overhead is
+# allowed to cost before the cost model grows the chunk.
+_OVERHEAD_FRAC = 0.05
+
+
+def host_float_dtype():
+    """Accumulator dtype for in-graph convergence scalars: float64 when
+    x64 is enabled (matches the host loops' Python-float math bit-for-
+    bit in the test meshes), float32 otherwise (TPU f64 is emulated)."""
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def default_sync_every(*, step_seconds: Optional[float] = None,
+                       backend: Optional[str] = None) -> int:
+    """Pick a chunk length. CPU → 1 (callers route through their
+    host-driven path unchanged). Accelerators → the smallest chunk that
+    keeps the per-launch overhead under ~5% of device work, clamped to
+    [8, 16]; with no step estimate, the top of the clamp."""
+    backend = backend or jax.default_backend()
+    if backend == "cpu":
+        return 1
+    overhead = DISPATCH_OVERHEAD_S.get(backend, 1e-3)
+    if step_seconds is None or step_seconds <= 0.0:
+        return SYNC_EVERY_HI
+    n = math.ceil(overhead / (_OVERHEAD_FRAC * step_seconds))
+    return max(SYNC_EVERY_LO, min(SYNC_EVERY_HI, n))
+
+
+def resolve_sync_every(sync_every: Optional[int], *,
+                       step_seconds: Optional[float] = None,
+                       backend: Optional[str] = None) -> int:
+    """Validate an explicit ``sync_every`` or fall back to the cost
+    model. Every chunked entry point funnels through here so the
+    default policy has one spelling."""
+    if sync_every is None:
+        return default_sync_every(step_seconds=step_seconds,
+                                  backend=backend)
+    n = int(sync_every)
+    if n < 1:
+        raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+    return n
+
+
+def chunk_while(step_fn: Callable[[Any], Tuple[Any, Any]], carry,
+                steps) -> Tuple[Any, Any, Any]:
+    """Run up to ``steps`` iterations of ``step_fn`` in-graph.
+
+    ``step_fn(carry) -> (carry, done)``; the loop exits early once
+    ``done`` goes true, so a converged chunk stops doing work instead of
+    burning its remaining iterations. ``steps`` is a TRACED int32 — one
+    executable serves full chunks and the tail chunk alike. Returns
+    ``(carry, ran, done)`` with ``ran`` the number of body executions.
+
+    This is the in-graph half only: callers wrap it in ``jax.jit``
+    (donating the carry) or embed it inside a ``shard_map`` body so the
+    per-iteration collectives fuse into the same program.
+    """
+    def cond(state):
+        i, _, done = state
+        return jnp.logical_and(i < steps, jnp.logical_not(done))
+
+    def body(state):
+        i, carry, _ = state
+        carry, done = step_fn(carry)
+        return i + 1, carry, done
+
+    init = (jnp.zeros((), jnp.int32), carry, jnp.zeros((), jnp.bool_))
+    ran, carry, done = lax.while_loop(cond, body, init)
+    return carry, ran, done
+
+
+def run_chunked(chunk_call: Callable, carry, *, max_steps: int,
+                sync_every: int, op: str, steps_done: int = 0,
+                est_step_seconds: Optional[float] = None,
+                boundary: Optional[Callable] = None,
+                sentinel: Optional[Callable] = None):
+    """Drive a compiled chunk program to convergence or ``max_steps``.
+
+    ``chunk_call(carry, steps) -> (carry, ran, done)`` is the caller's
+    jitted chunk (typically :func:`chunk_while` under ``jit`` or
+    ``shard_map``); ``ran``/``done`` are device scalars and fetching
+    them is THE host sync of the chunk. Per boundary, in order:
+
+    1. ``boundary(carry, steps_done, done)`` — checkpoint then health
+       probe, exactly the host-loop ordering: the checkpoint lands
+       before anything below can raise, so deadline expiry and peer
+       failure both leave a resumable file.
+    2. ``limits.check_deadline(op)`` — the deadline poll.
+    3. ``limits.check_chunk_budget`` — fast-fail BEFORE launching a
+       chunk whose estimated cost exceeds the remaining slack
+       (``est_step_seconds`` seeds the estimate; measured per-chunk
+       wall time refines it), so ``sync_every > 1`` cannot blow a
+       deadline by a whole chunk.
+    4. launch, under an obs span; then ``solver_host_syncs_total``,
+       the ``compiled_driver.chunk`` trace event, and the
+       ``deadline_slack_seconds`` histogram.
+    5. ``sentinel(carry, steps_done)`` — guard-mode numeric check,
+       invoked only when guards are armed (the off mode costs nothing).
+
+    Returns ``(carry, steps_done, done)``. ``steps_done`` starts at the
+    caller's offset so a resumed fit keeps global iteration counts.
+    """
+    done = False
+    per_step = est_step_seconds
+    while True:
+        if boundary is not None:
+            boundary(carry, steps_done, done)
+        limits.check_deadline(op)
+        if done or steps_done >= max_steps:
+            return carry, steps_done, done
+        n = min(int(sync_every), max_steps - steps_done)
+        if per_step is not None and per_step > 0.0:
+            limits.check_chunk_budget(op, per_step * n)
+        t0 = time.monotonic()
+        with obs.span(op + ".chunk", steps=n):
+            carry, ran_d, done_d = chunk_call(
+                carry, jnp.asarray(n, jnp.int32))
+            ran = int(ran_d)          # the chunk's single host sync
+            done = bool(done_d)
+        wall = time.monotonic() - t0
+        steps_done += ran
+        if ran > 0:
+            per_step = wall / ran     # measured refinement of the model
+        obs.inc("solver_host_syncs_total", 1, op=op)
+        trace.record_event("compiled_driver.chunk", op=op, steps=ran,
+                           done=bool(done))
+        rem = limits.remaining()
+        if rem is not None and obs.enabled():
+            obs.observe("deadline_slack_seconds", max(rem, 0.0),
+                        help="time left on the binding deadline at a "
+                             "compiled-chunk boundary (seconds)")
+        if sentinel is not None and resolve_guard_mode() != "off":
+            sentinel(carry, steps_done)

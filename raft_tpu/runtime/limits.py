@@ -76,6 +76,7 @@ __all__ = [
     "check_deadline", "sleep_within_deadline",
     "WorkBudget", "budget_scope", "active_budget", "set_default_budget",
     "parse_bytes", "estimate_bytes", "admit", "reject", "record_degraded",
+    "estimate_seconds", "check_chunk_budget",
     "CircuitBreaker", "get_breaker", "reset_breakers",
 ]
 
@@ -443,6 +444,90 @@ def estimate_bytes(op: str, **dims) -> int:
             f"no footprint estimator for op {op!r}; known: "
             f"{sorted(_ESTIMATORS)}") from None
     return int(fn(**dims))
+
+
+# ---------------------------------------------------------------------------
+# chunk-seconds estimation (the time twin of estimate_bytes, for the
+# compiled-inner-loop driver's pre-launch deadline admission)
+# ---------------------------------------------------------------------------
+
+# Order-of-magnitude sustained throughput by backend: FLOP/s and HBM
+# bytes/s. Intentionally coarse — these seed a FAST-FAIL decision (can
+# this chunk possibly fit the remaining deadline slack?), never a
+# measurement; run_chunked replaces the estimate with measured per-chunk
+# wall time after the first launch.
+_PEAK_FLOP_S = {"cpu": 5e10, "gpu": 5e13, "tpu": 6e13}
+_PEAK_BYTES_S = {"cpu": 2e10, "gpu": 1e12, "tpu": 8.19e11}
+
+
+def _sec_lloyd_step(*, m, k, n_clusters, itemsize=4):
+    # fused assignment+update: one [m,k]·[k,K] distance contraction plus
+    # the one-hot [K,m]·[m,k] update, both MXU passes over X
+    flops = 4.0 * m * k * n_clusters
+    bytes_ = (m * k + 2.0 * n_clusters * k) * itemsize
+    return flops, bytes_
+
+
+def _sec_lanczos_restart(*, n, ncv, nnz, k=0, itemsize=4):
+    # one thick restart: up to ncv extension steps of SpMV (2·nnz) plus
+    # two Gram-Schmidt passes (4 matvecs against the [ncv, n] basis),
+    # the Ritz back-transform/QR, and the ncv³ projected eigenproblem
+    flops = ncv * (2.0 * nnz + 8.0 * n * ncv) + 4.0 * n * ncv * max(k, 1) \
+        + 30.0 * ncv ** 3
+    bytes_ = ncv * (nnz * (itemsize + 4) + n * ncv * itemsize)
+    return flops, bytes_
+
+
+_SECONDS_ESTIMATORS = {
+    "cluster.lloyd_step": _sec_lloyd_step,
+    "sparse.lanczos_restart": _sec_lanczos_restart,
+}
+
+
+def estimate_seconds(op: str, *, backend: Optional[str] = None,
+                     **dims) -> float:
+    """Per-step wall-clock estimate for a compiled chunk's admission
+    check — the seconds twin of :func:`estimate_bytes`: the op's inner
+    step is costed as ``max(flops/peak_flops, bytes/peak_bandwidth)``
+    on ``backend`` (default: the active JAX backend) from static shapes
+    only. Known ops: ``cluster.lloyd_step(m, k, n_clusters[,
+    itemsize])``, ``sparse.lanczos_restart(n, ncv, nnz[, k,
+    itemsize])``."""
+    try:
+        fn = _SECONDS_ESTIMATORS[op]
+    except KeyError:
+        raise ValueError(
+            f"no seconds estimator for op {op!r}; known: "
+            f"{sorted(_SECONDS_ESTIMATORS)}") from None
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    flops, bytes_ = fn(**dims)
+    return max(flops / _PEAK_FLOP_S.get(backend, 5e10),
+               bytes_ / _PEAK_BYTES_S.get(backend, 2e10))
+
+
+def check_chunk_budget(op: str, est_seconds: float) -> None:
+    """Pre-launch admission for a compiled chunk: raise
+    :class:`DeadlineExceededError` when the chunk's cost estimate
+    exceeds the binding deadline's remaining slack — failing BEFORE the
+    launch instead of discovering the expiry a whole chunk later. No-op
+    without an active deadline scope. Counts into the same breaker and
+    ``limits_deadline_exceeded_total`` series as an observed expiry."""
+    d = current_deadline()
+    if d is None:
+        return
+    d._ops.add(op)
+    rem = d.remaining()
+    if est_seconds > rem:
+        get_breaker(op).record_failure()
+        obs.inc("limits_deadline_exceeded_total", 1, op=op)
+        raise DeadlineExceededError(
+            f"{op}: compiled chunk estimated at {est_seconds:.3f}s "
+            f"exceeds the {max(rem, 0.0):.3f}s left on the "
+            f"{d.budget_s:g}s deadline — failing before launch",
+            op=op, budget_s=d.budget_s)
 
 
 def admit(op: str, estimate: int, *,

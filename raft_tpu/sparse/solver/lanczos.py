@@ -152,17 +152,150 @@ def _extend_device(m1, m2, m3, basis, v, key,
     return basis, jnp.stack([alphas, betas]), brk, v
 
 
+# ---------------------------------------------------------------------------
+# compiled restart chunks (runtime/compiled_driver): sync_every > 1 runs
+# a chunk of thick restarts as ONE device program with a donated carry —
+# the Ritz solve, convergence test, QR and re-extension all in-graph
+# ---------------------------------------------------------------------------
+
+
+def _ritz_order_device(evals, which: str):
+    """In-graph twin of :func:`_np_ritz_order` (``which`` is static)."""
+    if which == "LM":
+        return jnp.argsort(-jnp.abs(evals))
+    if which == "SM":
+        return jnp.argsort(jnp.abs(evals))
+    if which == "LA":
+        return jnp.argsort(-evals)
+    return jnp.argsort(evals)
+
+
+def _fill_t_extension(t, ab, k: int, ncv: int):
+    """Write the extension's tridiagonal entries (rows [k, ncv), zeroed
+    by the restart) into the projected matrix — the in-graph twin of the
+    host ``extend()``'s fill loop over ``ab_h``."""
+    alphas = ab[0].astype(t.dtype)
+    betas = ab[1].astype(t.dtype)
+    idx = jnp.arange(ncv)
+    t = t.at[idx, idx].add(jnp.where(idx >= k, alphas, 0.0))
+    off = jnp.where((idx >= k) & (idx < ncv - 1), betas, 0.0)[:-1]
+    t = t.at[idx[:-1], idx[:-1] + 1].add(off)
+    t = t.at[idx[:-1] + 1, idx[:-1]].add(off)
+    return t
+
+
+def _restart_step_device(mat_args, r1, carry, *, k: int, ncv: int,
+                         n: int, which: str, tol: float,
+                         max_iterations: int, seed: int, use_ell: bool,
+                         use_grid: bool, use_dense: bool,
+                         use_rank1: bool):
+    """One host-loop iteration of :func:`_restart_loop`, entirely
+    in-graph: Ritz solve of the carried projected matrix, the residual
+    convergence test, and — unless converged or out of budget — the
+    thick restart (QR with the host path's positive-diagonal sign
+    convention) plus the next basis extension. ``carry.it`` counts
+    consumed outer iterations, so at exit ``carry.it == n_iter``."""
+    basis, t, v, beta_last, it, brk_count = carry
+    evals, evecs = jnp.linalg.eigh(t)
+    keep = _ritz_order_device(evals, which)[:k]
+    ritz_vals = evals[keep]
+    s = evecs[:, keep]
+    residuals = jnp.abs(beta_last * s[-1, :])
+    conv = jnp.max(residuals) < tol
+    # the host loop never restarts on its LAST iteration — it finalizes
+    # from the top-of-iteration state; mirror that so the carry handed
+    # back for the host finalize is the same state
+    done = conv | (it >= max_iterations - 1)
+
+    def restart(args):
+        basis, t, v, beta_last, brk_count = args
+        ritz_vecs = basis.T @ s.astype(basis.dtype)
+        q, r = jnp.linalg.qr(ritz_vecs)
+        signs = jnp.sign(jnp.diagonal(r))
+        signs = jnp.where(signs == 0, 1.0, signs)
+        q = q * signs[None, :]                  # keep original directions
+        basis = jnp.zeros_like(basis).at[:k].set(q.T)
+        border = beta_last * s[-1, :]
+        # soft locking, as in the host loop
+        border = jnp.where(jnp.abs(border) < tol, 0.0, border)
+        t = jnp.zeros_like(t)
+        t = t.at[jnp.arange(k), jnp.arange(k)].set(
+            ritz_vals.astype(t.dtype))
+        t = t.at[:k, k].set(border.astype(t.dtype))
+        t = t.at[k, :k].set(border.astype(t.dtype))
+        key = jax.random.key(seed + 7919 * (it + 1) + k)
+        basis, ab, brk, v = _extend_device(
+            *mat_args, basis, v, key, k, ncv, n, use_ell, rank1=r1,
+            use_rank1=use_rank1, use_grid=use_grid, use_dense=use_dense)
+        t = _fill_t_extension(t, ab, k, ncv)
+        beta_last = jnp.where(brk[ncv - 1], 0.0,
+                              ab[1, ncv - 1]).astype(beta_last.dtype)
+        brk_count = brk_count + jnp.sum(brk[k:]).astype(brk_count.dtype)
+        return basis, t, v, beta_last, brk_count
+
+    basis, t, v, beta_last, brk_count = lax.cond(
+        done, lambda a: a, restart, (basis, t, v, beta_last, brk_count))
+    return (basis, t, v, beta_last, it + 1, brk_count), done
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "ncv", "n", "which", "tol", "max_iterations",
+                     "seed", "use_ell", "use_grid", "use_dense",
+                     "use_rank1"),
+    donate_argnums=(4,))
+def _eigsh_chunk(m1, m2, m3, r1, carry, steps, *, k: int, ncv: int,
+                 n: int, which: str, tol: float, max_iterations: int,
+                 seed: int, use_ell: bool, use_grid: bool,
+                 use_dense: bool, use_rank1: bool):
+    """Up to ``steps`` thick restarts as one device program (donated
+    carry) — the compiled twin of the single-device restart loop."""
+    from raft_tpu.runtime.compiled_driver import chunk_while
+
+    def step(carry):
+        return _restart_step_device(
+            (m1, m2, m3), r1, carry, k=k, ncv=ncv, n=n, which=which,
+            tol=tol, max_iterations=max_iterations, seed=seed,
+            use_ell=use_ell, use_grid=use_grid, use_dense=use_dense,
+            use_rank1=use_rank1)
+
+    return chunk_while(step, carry, steps)
+
+
+def _lanczos_sentinel(carry, steps_done: int):
+    """Guard-mode boundary check for the compiled restart chunks: the
+    carried residual coupling must stay finite — a NaN here means the
+    basis degenerated, surfaced as the typed error at the chunk boundary
+    instead of NaN Ritz pairs at the end."""
+    from raft_tpu.core.guards import NonFiniteError
+
+    beta = float(np.asarray(carry[3]))
+    if not np.isfinite(beta):
+        raise NonFiniteError(
+            f"lanczos: non-finite residual coupling {beta!r} at compiled "
+            f"chunk boundary (restart {steps_done})",
+            op="sparse.solver.lanczos")
+
+
 @with_matmul_precision
 def lanczos_compute_eigenpairs(res, a, config: LanczosConfig,
                                v0: Optional[jnp.ndarray] = None,
                                rank1=None,
-                               return_report: bool = False
+                               return_report: bool = False,
+                               sync_every: Optional[int] = None
                                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Compute k eigenpairs of symmetric sparse A
     (ref: sparse/solver/lanczos.cuh:34-86, CSR/COO overloads).
 
     ``rank1`` = (u, w, alpha): solve for A + alpha·u·wᵀ instead, applied
     matrix-free inside the device loop (the modularity matrix's form).
+
+    ``sync_every``: with n > 1, chunks of n thick restarts run as ONE
+    jitted program with a donated carry — Ritz solve, convergence test,
+    QR and re-extension in-graph, host touched once per chunk (see
+    :mod:`raft_tpu.runtime.compiled_driver`). ``sync_every=1`` is the
+    host-driven restart loop, bit-for-bit; ``None`` asks the cost
+    model (1 on CPU, 8–16 on an accelerator).
 
     Returns (eigenvalues [k], eigenvectors [n, k]) sorted per `which`;
     with ``return_report=True`` a third element, the
@@ -174,7 +307,8 @@ def lanczos_compute_eigenpairs(res, a, config: LanczosConfig,
     # dense symmetric operators ride the same restart loop (eig_sel path)
     with obs.span("sparse.solver.eigsh", n=int(a.shape[0]),
                   k=int(config.n_components)):
-        w, v, report = _eigsh_csr(a, config, v0, rank1=rank1)
+        w, v, report = _eigsh_csr(a, config, v0, rank1=rank1,
+                                  sync_every=sync_every)
     if return_report:
         return w, v, report
     return w, v
@@ -183,8 +317,9 @@ def lanczos_compute_eigenpairs(res, a, config: LanczosConfig,
 @with_matmul_precision
 def eigsh(a, k: int = 6, which: str = "SA", v0=None, ncv: int = 0,
           maxiter: int = 1000, tol: float = 1e-7, seed: int = 42,
-          res=None, strict: bool = False,
-          return_report: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+          res=None, strict: bool = False, return_report: bool = False,
+          sync_every: Optional[int] = None
+          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """scipy-compatible front-end (ref: pylibraft sparse/linalg/lanczos.pyx:85
     `eigsh`).
 
@@ -209,11 +344,12 @@ def eigsh(a, k: int = 6, which: str = "SA", v0=None, ncv: int = 0,
                         tolerance=tol, which=which.upper(), seed=seed,
                         strict=strict)
     return lanczos_compute_eigenpairs(res, a, cfg, v0,
-                                      return_report=return_report)
+                                      return_report=return_report,
+                                      sync_every=sync_every)
 
 
 def _eigsh_csr(csr, cfg: LanczosConfig, v0,
-               rank1=None) -> Tuple:
+               rank1=None, sync_every: Optional[int] = None) -> Tuple:
     """Thick-restart driver. ``csr`` may also be a DENSE symmetric array:
     the same restart loop then runs on an MXU matvec — the eig_sel subset
     path (ref: syevdx), which needs k extremal pairs of a dense matrix
@@ -316,8 +452,104 @@ def _eigsh_csr(csr, cfg: LanczosConfig, v0,
         beta_last = 0.0 if brk_h[ncv - 1] else float(ab_h[1, ncv - 1])
         return basis, t, beta_last, v
 
+    from raft_tpu.runtime import compiled_driver
+
+    sync = compiled_driver.resolve_sync_every(sync_every)
+    if sync > 1:
+        from raft_tpu.runtime import limits
+
+        acc = compiled_driver.host_float_dtype()
+        # initial basis growth stays host-driven (fills t rows [0, ncv));
+        # the compiled chunks take over at the first restart
+        basis, t, beta_last, v = extend(0, basis, t, v, it=-1)
+        carry = (basis, jnp.asarray(t, acc), v,
+                 jnp.asarray(beta_last, acc),
+                 jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32))
+        chunk_call = functools.partial(
+            _eigsh_chunk, *mat_args, r1, k=k, ncv=ncv, n=n, which=which,
+            tol=float(cfg.tolerance), max_iterations=cfg.max_iterations,
+            seed=cfg.seed, use_ell=use_ell, use_grid=use_grid,
+            use_dense=use_dense, use_rank1=r1 is not None)
+        nnz = n * n if dense else int(csr.data.shape[0])
+        est = limits.estimate_seconds("sparse.lanczos_restart", n=n,
+                                      ncv=ncv, nnz=max(nnz, 1), k=k)
+        carry, _, _ = compiled_driver.run_chunked(
+            chunk_call, carry, max_steps=cfg.max_iterations,
+            sync_every=sync, op="sparse.solver.lanczos",
+            est_step_seconds=est, sentinel=_lanczos_sentinel)
+        basis = carry[0]
+        t_h = np.asarray(carry[1], np.float64)
+        beta_last = float(np.asarray(carry[3]))
+        n_iter = int(np.asarray(carry[4]))
+        n_brk = int(np.asarray(carry[5]))
+        if n_brk:
+            stats["breakdowns"] += n_brk
+            trace.record_event("lanczos.breakdown", iteration=n_iter,
+                               count=n_brk)
+        return _finalize_ritz(basis, t_h, beta_last, n_iter, cfg, k,
+                              which, dtype, stats=stats)
+
     return _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
                          stats=stats)
+
+
+def _np_ritz_order(evals, which: str):
+    """Ritz selection order per ``which`` (ref: lanczos_solve_ritz
+    detail/lanczos.cuh:182-223 — SM/LM sort by magnitude inside the
+    Krylov space; no spectral shift), shared by the host restart loop
+    and the compiled chunk's finalize."""
+    if which == "LM":
+        return np.argsort(-np.abs(evals))
+    if which == "SM":
+        return np.argsort(np.abs(evals))
+    if which == "LA":
+        return np.argsort(-evals)
+    return np.argsort(evals)
+
+
+def _finalize_ritz(basis, t, beta_last, n_iter, cfg, k, which, dtype,
+                   stats=None):
+    """Host float64 Ritz epilogue shared by the host-driven restart loop
+    and the compiled-chunk drivers: solve the projected problem, test
+    convergence, back-transform the kept pairs, and build the
+    :class:`~raft_tpu.core.guards.ConvergenceReport` (warn or raise per
+    ``cfg.strict`` on an exhausted budget)."""
+    evals, evecs = np.linalg.eigh(t)
+    keep = _np_ritz_order(evals, which)[:k]
+    ritz_vals = evals[keep]
+    s = evecs[:, keep]                          # [ncv, k]
+    residuals = np.abs(beta_last * s[-1, :])
+    converged = float(residuals.max()) < cfg.tolerance
+    report = ConvergenceReport(
+        converged=converged, n_iter=n_iter,
+        residual=float(residuals.max()), tol=float(cfg.tolerance),
+        breakdowns=0 if stats is None
+        else int(stats.get("breakdowns", 0)))
+    obs.record_convergence("sparse.solver.lanczos", report)
+    if not converged:
+        if getattr(cfg, "strict", False):
+            raise ConvergenceError(
+                f"lanczos: max_iterations={cfg.max_iterations} "
+                f"reached with residual {report.residual:.3e} > "
+                f"tol {cfg.tolerance:.3e} (strict=True)",
+                report=report, op="sparse.solver.lanczos")
+        # Reference parity: lanczos_smallest exits its
+        # `while (res > tol && iter < maxIter)` loop and returns the
+        # best available pairs without throwing
+        # (detail/lanczos.cuh:537); we surface it via the logger.
+        logger.warn(
+            "lanczos: max_iterations=%d reached with residual %.3e "
+            "> tol %.3e; returning unconverged eigenpairs",
+            cfg.max_iterations, float(residuals.max()),
+            cfg.tolerance)
+    ritz_vecs = basis.T @ jnp.asarray(s, dtype=dtype)
+    # normalize (f32 drift) and sort ascending like scipy eigsh;
+    # Ritz columns come from an orthonormal-by-construction basis
+    # and soft locking keeps directions nonzero
+    ritz_vecs = ritz_vecs / jnp.linalg.norm(ritz_vecs, axis=0)  # guarded: orthonormal basis
+    asc = np.argsort(ritz_vals)
+    return (jnp.asarray(ritz_vals[asc], dtype=dtype),
+            ritz_vecs[:, asc], report)
 
 
 def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
@@ -353,53 +585,14 @@ def _restart_loop(extend, basis, t, v, cfg, k, ncv, which, dtype,
         # checkpoint-first ordering)
         limits.check_deadline("sparse.solver.lanczos")
         evals, evecs = np.linalg.eigh(t)
-        # Ritz selection per `which` (ref: lanczos_solve_ritz
-        # detail/lanczos.cuh:182-223 — SM/LM sort Ritz values by magnitude
-        # inside the Krylov space; no spectral shift is used).
-        if which == "LM":
-            order = np.argsort(-np.abs(evals))
-        elif which == "SM":
-            order = np.argsort(np.abs(evals))
-        elif which == "LA":
-            order = np.argsort(-evals)
-        else:
-            order = np.argsort(evals)
-        keep = order[:k]
+        keep = _np_ritz_order(evals, which)[:k]
         ritz_vals = evals[keep]
         s = evecs[:, keep]                      # [ncv, k]
         residuals = np.abs(beta_last * s[-1, :])
         converged = float(residuals.max()) < cfg.tolerance
         if converged or it == cfg.max_iterations - 1:
-            report = ConvergenceReport(
-                converged=converged, n_iter=it + 1,
-                residual=float(residuals.max()), tol=float(cfg.tolerance),
-                breakdowns=0 if stats is None
-                else int(stats.get("breakdowns", 0)))
-            obs.record_convergence("sparse.solver.lanczos", report)
-            if not converged:
-                if getattr(cfg, "strict", False):
-                    raise ConvergenceError(
-                        f"lanczos: max_iterations={cfg.max_iterations} "
-                        f"reached with residual {report.residual:.3e} > "
-                        f"tol {cfg.tolerance:.3e} (strict=True)",
-                        report=report, op="sparse.solver.lanczos")
-                # Reference parity: lanczos_smallest exits its
-                # `while (res > tol && iter < maxIter)` loop and returns the
-                # best available pairs without throwing
-                # (detail/lanczos.cuh:537); we surface it via the logger.
-                logger.warn(
-                    "lanczos: max_iterations=%d reached with residual %.3e "
-                    "> tol %.3e; returning unconverged eigenpairs",
-                    cfg.max_iterations, float(residuals.max()),
-                    cfg.tolerance)
-            ritz_vecs = basis.T @ jnp.asarray(s, dtype=dtype)
-            # normalize (f32 drift) and sort ascending like scipy eigsh;
-            # Ritz columns come from an orthonormal-by-construction basis
-            # and soft locking keeps directions nonzero
-            ritz_vecs = ritz_vecs / jnp.linalg.norm(ritz_vecs, axis=0)  # guarded: orthonormal basis
-            asc = np.argsort(ritz_vals)
-            return (jnp.asarray(ritz_vals[asc], dtype=dtype),
-                    ritz_vecs[:, asc], report)
+            return _finalize_ritz(basis, t, beta_last, it + 1, cfg, k,
+                                  which, dtype, stats=stats)
 
         # -- thick restart (ref: detail/lanczos.cuh:537-700) --------------
         ritz_vecs = basis.T @ jnp.asarray(s, dtype=dtype)   # [n, k]
@@ -521,6 +714,72 @@ def _extend_mnmg_body(rows_l, cols_g, data_l, basis_l, v_l, key,
     return basis_l, jnp.stack([alphas, betas]), brk, v_l
 
 
+def _cholqr2(a_l, axis: str):
+    """Distributed thin QR of a row-sharded [n_local, k] block via
+    CholeskyQR2 (two rounds — enough for f32 at the k ≪ n shapes here):
+    Gram ``psum`` → Cholesky → triangular solve, twice. The implicit R
+    (a product of Cholesky factors) has a positive diagonal, which is
+    exactly the convention the host restart path enforces by sign-fixing
+    Householder QR — so the compiled MNMG restart reproduces the same Q
+    without a collectives-hostile Householder factorization."""
+    from jax.scipy.linalg import solve_triangular
+
+    def one_round(q_l):
+        g = lax.psum(q_l.T @ q_l, axis)
+        ell = jnp.linalg.cholesky(g)
+        return solve_triangular(ell, q_l.T, lower=True).T
+
+    return one_round(one_round(a_l))
+
+
+def _mnmg_restart_step(rows_l, cols_g, data_l, carry, *, k: int,
+                       ncv: int, n_local: int, n_true: int, axis: str,
+                       use_ell: bool, which: str, tol: float,
+                       max_iterations: int, seed: int):
+    """One outer restart of the MNMG loop inside a ``shard_map`` body —
+    the sharded twin of :func:`_restart_step_device`: the projected
+    solve and convergence test run replicated (the carry's ``t`` and
+    ``beta_last`` are psum products), the Ritz back-transform and QR
+    stay row-sharded (:func:`_cholqr2`), and the re-extension is the
+    same :func:`_extend_mnmg_body` the host loop shard_maps."""
+    basis_l, t, v_l, beta_last, it, brk_count = carry
+    evals, evecs = jnp.linalg.eigh(t)
+    keep = _ritz_order_device(evals, which)[:k]
+    ritz_vals = evals[keep]
+    s = evecs[:, keep]
+    residuals = jnp.abs(beta_last * s[-1, :])
+    conv = jnp.max(residuals) < tol
+    done = conv | (it >= max_iterations - 1)
+
+    def restart(args):
+        basis_l, t, v_l, beta_last, brk_count = args
+        ritz_l = basis_l.T @ s.astype(basis_l.dtype)    # [n_local, k]
+        q_l = _cholqr2(ritz_l, axis)
+        basis_l = jnp.zeros_like(basis_l).at[:k].set(q_l.T)
+        border = beta_last * s[-1, :]
+        border = jnp.where(jnp.abs(border) < tol, 0.0, border)
+        t = jnp.zeros_like(t)
+        t = t.at[jnp.arange(k), jnp.arange(k)].set(
+            ritz_vals.astype(t.dtype))
+        t = t.at[:k, k].set(border.astype(t.dtype))
+        t = t.at[k, :k].set(border.astype(t.dtype))
+        key = jax.random.key(seed + 7919 * (it + 1) + k)
+        basis_l, ab, brk, v_l = _extend_mnmg_body(
+            rows_l, cols_g, data_l, basis_l, v_l, key, j_start=k,
+            ncv=ncv, n_local=n_local, n_true=n_true, axis=axis,
+            use_ell=use_ell)
+        t = _fill_t_extension(t, ab, k, ncv)
+        beta_last = jnp.where(brk[ncv - 1], 0.0,
+                              ab[1, ncv - 1]).astype(beta_last.dtype)
+        brk_count = brk_count + jnp.sum(brk[k:]).astype(brk_count.dtype)
+        return basis_l, t, v_l, beta_last, brk_count
+
+    basis_l, t, v_l, beta_last, brk_count = lax.cond(
+        done, lambda a: a, restart,
+        (basis_l, t, v_l, beta_last, brk_count))
+    return (basis_l, t, v_l, beta_last, it + 1, brk_count), done
+
+
 def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
                which: str = "SA", v0=None, ncv: int = 0,
                maxiter: int = 1000, tol: float = 1e-7,
@@ -530,7 +789,8 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
                checkpoint_keep: int = 2,
                resume_from: Optional[str] = None,
                strict: bool = False,
-               return_report: bool = False
+               return_report: bool = False,
+               sync_every: Optional[int] = None
                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Multi-device eigsh: A row-partitioned over ``mesh[axis]``, the
     Lanczos extension shard_mapped (SpMV = local band product over an
@@ -587,6 +847,10 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
 
     rows_h, cols_h, data_h = csr.host_edges()
     data_h = data_h.astype(np.float32)
+
+    from raft_tpu.runtime import compiled_driver
+
+    sync = compiled_driver.resolve_sync_every(sync_every)
 
     def build_extend(cur_mesh):
         """Everything that depends on the device count, bundled so a
@@ -684,7 +948,35 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
                                    NamedSharding(cur_mesh, P(None, axis))),
                     jax.device_put(jnp.asarray(vp), shard))
 
-        return extend, place
+        run_chunk = None
+        if sync > 1:
+            from raft_tpu.runtime.compiled_driver import chunk_while
+
+            restart_body = functools.partial(
+                _mnmg_restart_step, k=k, ncv=ncv, n_local=n_local,
+                n_true=n, axis=axis, use_ell=use_ell, which=which,
+                tol=float(cfg.tolerance),
+                max_iterations=cfg.max_iterations, seed=cfg.seed)
+
+            def chunk_body(rows_l, cols_l, data_l, carry, steps):
+                def one(car):
+                    return restart_body(rows_l, cols_l, data_l, car)
+
+                return chunk_while(one, carry, steps)
+
+            # carry = (basis_l, t, v_l, beta_last, it, brk_count): t and
+            # the scalars are psum products — replicated, P() holds
+            carry_specs = (P(None, axis), P(), P(axis), P(), P(), P())
+            chunk = jax.jit(jax.shard_map(
+                chunk_body, mesh=cur_mesh,
+                in_specs=(P(axis), P(axis), P(axis), carry_specs, P()),
+                out_specs=(carry_specs, P(), P())),
+                donate_argnums=(3,))
+
+            def run_chunk(carry, steps):
+                return chunk(rows_g, cols_g, data_g, carry, steps)
+
+        return extend, place, run_chunk
 
     t = np.zeros((ncv, ncv), dtype=np.float64)
     stats = {"breakdowns": 0}
@@ -702,10 +994,99 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
         v_h = v_h / np.linalg.norm(v_h)
         basis_h = np.zeros((ncv, n), np.float32)
 
-    extend, place = build_extend(mesh)
+    extend, place, run_chunk = build_extend(mesh)
     basis, v = place(basis_h, v_h)
     ckpt_stride = (max(1, int(checkpoint_every))
                    if checkpoint_every is not None else None)
+
+    if sync > 1:
+        from raft_tpu.runtime import limits
+
+        acc = compiled_driver.host_float_dtype()
+        if resume is None:
+            # initial basis growth stays host-driven; chunks take over
+            # at the first restart
+            basis, t, beta_last, v = extend(0, basis, t, v, it=-1)
+            it0 = 0
+        else:
+            it0, beta_last = resume
+        carry = (basis, jnp.asarray(t, acc), v,
+                 jnp.asarray(beta_last, acc),
+                 jnp.asarray(it0, jnp.int32), jnp.asarray(0, jnp.int32))
+        n_iter = it0
+        last_saved = [it0 if resume_from is not None else -1]
+        est = limits.estimate_seconds(
+            "sparse.lanczos_restart", n=n, ncv=ncv,
+            nnz=max(len(rows_h), 1), k=k)
+
+        def boundary(cr, steps_done, done_flag):
+            # checkpoint FIRST, then health-probe — the on_iteration
+            # ordering of the host loop, at chunk granularity; the saved
+            # entries use the same format, so resume_from round-trips
+            # between the host-driven and compiled paths
+            if manager is not None and (
+                    (last_saved[0] < 0 and steps_done == 0)
+                    or steps_done - max(last_saved[0], 0) >= ckpt_stride):
+                manager.save(steps_done, {
+                    "basis": np.asarray(cr[0])[:, :n],
+                    "t": np.asarray(cr[1], np.float64),
+                    "v": np.asarray(cr[2])[:n],
+                    "beta_last": float(np.asarray(cr[3])),
+                    "it": int(steps_done),
+                })
+                last_saved[0] = steps_done
+            if comms is not None:
+                comms.ensure_healthy()
+
+        while True:
+            try:
+                carry, n_iter, _ = compiled_driver.run_chunked(
+                    run_chunk, carry, max_steps=cfg.max_iterations,
+                    sync_every=sync, op="sparse.solver.lanczos",
+                    steps_done=n_iter, est_step_seconds=est,
+                    boundary=boundary, sentinel=_lanczos_sentinel)
+                break
+            except (PeerFailedError, CommsAbortedError) as err:
+                if comms is None or manager is None:
+                    raise
+                latest = manager.restore_latest()
+                if latest is None:
+                    raise
+                step, entries = latest
+                survivors = comms.agree_on_survivors()
+                comms = comms.shrink(survivors)
+                mesh = comms.mesh
+                logger.warn(
+                    "eigsh_mnmg: peer failure (%s); resuming restart "
+                    "%d on %d survivors", err, step, len(survivors))
+                trace.record_event("eigsh.elastic_resume", step=step,
+                                   survivors=len(survivors))
+                extend, place, run_chunk = build_extend(mesh)
+                basis, v = place(
+                    np.asarray(entries["basis"], np.float32),
+                    np.asarray(entries["v"], np.float32))
+                n_iter = int(entries["it"])
+                last_saved[0] = n_iter
+                carry = (basis,
+                         jnp.asarray(np.asarray(entries["t"],
+                                                np.float64), acc),
+                         v, jnp.asarray(float(entries["beta_last"]), acc),
+                         jnp.asarray(n_iter, jnp.int32),
+                         jnp.asarray(0, jnp.int32))
+        basis = carry[0]
+        t_h = np.asarray(carry[1], np.float64)
+        beta_last = float(np.asarray(carry[3]))
+        n_brk = int(np.asarray(carry[5]))
+        if n_brk:
+            stats["breakdowns"] += n_brk
+            trace.record_event("lanczos.breakdown", iteration=n_iter,
+                               count=n_brk)
+        w, vecs, report = _finalize_ritz(
+            basis, t_h, beta_last, int(np.asarray(carry[4])), cfg, k,
+            which, dtype, stats=stats)
+        if return_report:
+            return w, vecs[:n], report
+        return w, vecs[:n]
 
     def on_iteration(it, basis_d, t_d, beta_last_d, v_d):
         # checkpoint FIRST, then health-probe: a failure surfaced by the
@@ -746,7 +1127,7 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
                 "%d survivors", err, step, len(survivors))
             trace.record_event("eigsh.elastic_resume", step=step,
                                survivors=len(survivors))
-            extend, place = build_extend(mesh)
+            extend, place, run_chunk = build_extend(mesh)
             basis, v = place(np.asarray(entries["basis"], np.float32),
                              np.asarray(entries["v"], np.float32))
             t = np.asarray(entries["t"], np.float64).copy()

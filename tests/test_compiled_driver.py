@@ -1,0 +1,411 @@
+"""Compiled solver inner loops (runtime/compiled_driver, ISSUE 8).
+
+Covers the chunk-runner contract (in-graph early exit, cost-model
+defaults, chunk-budget admission) and the wiring into both solver
+families: ``sync_every=1`` must be bit-identical to the host-driven
+seed paths, ``sync_every=8`` must converge to the same state in the
+same number of iterations, chunk-boundary checkpoints must resume
+bit-for-bit, and deadline expiry mid-fit must leave a loadable
+checkpoint behind.
+"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.core import trace
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.runtime import compiled_driver, limits
+
+
+@pytest.fixture
+def clean_events():
+    trace.clear_events()
+    yield
+    trace.clear_events()
+
+
+@pytest.fixture
+def live_obs():
+    was_enabled = obs.enabled()
+    old_reg = obs_metrics.set_registry(obs.MetricsRegistry())
+    obs.set_enabled(True)
+    try:
+        yield obs_metrics.get_registry()
+    finally:
+        obs.set_enabled(was_enabled)
+        obs_metrics.set_registry(old_reg)
+
+
+def _blobs(m=320, k=8, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=6.0, size=(5, k))
+    x = (centers[rng.integers(0, 5, m)]
+         + rng.normal(size=(m, k))).astype(np.float32)
+    return x
+
+
+def _sym_csr(n=150, seed=0):
+    import scipy.sparse as sp
+
+    from raft_tpu.core.sparse_types import CSRMatrix
+
+    a = sp.random(n, n, density=0.06, random_state=seed, format="csr",
+                  dtype=np.float32)
+    a = (a + a.T) * 0.5
+    return CSRMatrix.from_scipy(sp.csr_matrix(a))
+
+
+# ---------------------------------------------------------------------------
+# chunk-runner unit contract
+# ---------------------------------------------------------------------------
+
+
+class TestChunkWhile:
+    def test_early_exit_counts_executions(self):
+        def step(c):
+            c = c + 1
+            return c, c >= 3
+
+        @jax.jit
+        def chunk(c, steps):
+            return compiled_driver.chunk_while(step, c, steps)
+
+        c, ran, done = chunk(jnp.asarray(0), jnp.asarray(10, jnp.int32))
+        assert int(c) == 3 and int(ran) == 3 and bool(done)
+
+    def test_traced_steps_serves_tail_chunk(self):
+        def step(c):
+            return c + 1, jnp.asarray(False)
+
+        @jax.jit
+        def chunk(c, steps):
+            return compiled_driver.chunk_while(step, c, steps)
+
+        for n in (8, 3):          # same executable, full + tail chunk
+            _, ran, done = chunk(jnp.asarray(0), jnp.asarray(n, jnp.int32))
+            assert int(ran) == n and not bool(done)
+
+
+class TestSyncEveryPolicy:
+    def test_cpu_defaults_to_host_driven(self):
+        assert compiled_driver.default_sync_every(backend="cpu") == 1
+        assert compiled_driver.resolve_sync_every(None, backend="cpu") == 1
+
+    def test_accelerator_clamped_8_16(self):
+        assert compiled_driver.default_sync_every(backend="tpu") == 16
+        # slow step: overhead amortizes fast, clamp floor binds
+        assert compiled_driver.default_sync_every(
+            backend="tpu", step_seconds=1.0) == 8
+        # fast step: overhead dominates, clamp ceiling binds
+        assert compiled_driver.default_sync_every(
+            backend="tpu", step_seconds=1e-5) == 16
+
+    def test_explicit_value_validated(self):
+        assert compiled_driver.resolve_sync_every(4) == 4
+        with pytest.raises(ValueError):
+            compiled_driver.resolve_sync_every(0)
+
+
+class TestChunkBudget:
+    def test_estimate_seconds_known_ops(self):
+        s = limits.estimate_seconds("cluster.lloyd_step", backend="cpu",
+                                    m=1000, k=64, n_clusters=32)
+        assert s > 0.0
+        s2 = limits.estimate_seconds("sparse.lanczos_restart",
+                                     backend="cpu", n=1000, ncv=20,
+                                     nnz=5000, k=4)
+        assert s2 > 0.0
+
+    def test_estimate_seconds_unknown_op_raises(self):
+        with pytest.raises(ValueError, match="no seconds estimator"):
+            limits.estimate_seconds("nope.unknown", backend="cpu", m=1)
+
+    def test_fast_fail_before_launch(self, clean_events):
+        """A chunk whose cost estimate exceeds the remaining slack must
+        fail BEFORE launching (no chunk trace event)."""
+        def chunk_call(carry, steps):     # pragma: no cover - must not run
+            raise AssertionError("chunk launched past its budget")
+
+        with limits.deadline_scope(1.0):
+            with pytest.raises(limits.DeadlineExceededError):
+                compiled_driver.run_chunked(
+                    chunk_call, jnp.zeros(()), max_steps=100,
+                    sync_every=10, op="test.budget",
+                    est_step_seconds=100.0)
+        assert not [e for e in trace.events()
+                    if e["name"] == "compiled_driver.chunk"]
+
+    def test_slack_recorded_at_boundaries(self, clean_events, live_obs):
+        def step(c):
+            return c + 1, jnp.asarray(False)
+
+        @jax.jit
+        def chunk(c, steps):
+            return compiled_driver.chunk_while(step, c, steps)
+
+        with limits.deadline_scope(60.0):
+            compiled_driver.run_chunked(chunk, jnp.asarray(0),
+                                        max_steps=8, sync_every=4,
+                                        op="test.slack")
+        snap = live_obs.snapshot()
+        assert "deadline_slack_seconds" in snap
+        # one observation per chunk boundary (2 chunks of 4), plus the
+        # deadline_scope exit's own slack observation
+        assert snap["deadline_slack_seconds"]["series"][0]["count"] == 3
+
+
+# ---------------------------------------------------------------------------
+# kmeans_fit / kmeans_fit_mnmg
+# ---------------------------------------------------------------------------
+
+
+class TestKMeansChunked:
+    def test_sync1_bit_identical_and_hostdriven(self, clean_events):
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        x = _blobs()
+        p = KMeansParams(n_clusters=5, seed=0, max_iter=25)
+        c0, i0, l0, n0 = kmeans_fit(None, p, x)     # default: cpu -> 1
+        c1, i1, l1, n1 = kmeans_fit(None, p, x, sync_every=1)
+        assert np.asarray(c0).tobytes() == np.asarray(c1).tobytes()
+        assert float(i0) == float(i1) and n0 == n1
+        assert np.array_equal(np.asarray(l0), np.asarray(l1))
+        # sync_every=1 IS the host-driven path: no chunk events at all
+        assert not [e for e in trace.events()
+                    if e["name"] == "compiled_driver.chunk"]
+
+    def test_sync8_same_niter_allclose(self):
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        x = _blobs()
+        p = KMeansParams(n_clusters=5, seed=0, max_iter=25)
+        c1, i1, _, n1 = kmeans_fit(None, p, x, sync_every=1)
+        c8, i8, _, n8 = kmeans_fit(None, p, x, sync_every=8)
+        assert n1 == n8
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c8),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(i1), float(i8), rtol=1e-5)
+
+    def test_weighted_chunked_allclose(self):
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        x = _blobs()
+        w = np.random.default_rng(3).uniform(0.5, 2.0,
+                                             x.shape[0]).astype(np.float32)
+        p = KMeansParams(n_clusters=5, seed=0, max_iter=25)
+        c1, _, _, n1 = kmeans_fit(None, p, x, sample_weights=w,
+                                  sync_every=1)
+        c8, _, _, n8 = kmeans_fit(None, p, x, sample_weights=w,
+                                  sync_every=8)
+        assert n1 == n8
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c8),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_host_sync_count_is_chunk_count(self, clean_events, live_obs):
+        """32 never-converging iterations at sync_every=8 must touch the
+        host exactly ceil(32/8) = 4 times (the CI regression gate for a
+        reintroduced per-iteration block_until_ready)."""
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        x = _blobs()
+        p = KMeansParams(n_clusters=5, seed=0, max_iter=32, tol=-1.0)
+        _, _, _, n_iter = kmeans_fit(None, p, x, sync_every=8)
+        assert n_iter == 32
+        ev = [e for e in trace.events()
+              if e["name"] == "compiled_driver.chunk"]
+        assert len(ev) == 4
+        assert sum(e["steps"] for e in ev) == 32
+        snap = live_obs.snapshot()["solver_host_syncs_total"]["series"]
+        counts = {tuple(s["labels"].items()): s["value"] for s in snap}
+        assert counts[(("op", "cluster.kmeans_fit"),)] == 4
+
+    def test_mnmg_sync1_bit_identical(self, mesh8, clean_events):
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit_mnmg
+
+        x = _blobs()
+        p = KMeansParams(n_clusters=8, seed=0, max_iter=20)
+        c0, i0, _, n0 = kmeans_fit_mnmg(None, p, x, mesh=mesh8)
+        c1, i1, _, n1 = kmeans_fit_mnmg(None, p, x, mesh=mesh8,
+                                        sync_every=1)
+        assert np.asarray(c0).tobytes() == np.asarray(c1).tobytes()
+        assert n0 == n1
+        assert not [e for e in trace.events()
+                    if e["name"] == "compiled_driver.chunk"]
+
+    def test_mnmg_chunked_allclose(self, mesh8):
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit_mnmg
+
+        x = _blobs()
+        p = KMeansParams(n_clusters=8, seed=0, max_iter=20)
+        c1, _, _, n1 = kmeans_fit_mnmg(None, p, x, mesh=mesh8,
+                                       sync_every=1)
+        c8, _, _, n8 = kmeans_fit_mnmg(None, p, x, mesh=mesh8,
+                                       sync_every=8)
+        assert n1 == n8
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c8),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_mnmg_checkpoint_boundary_resumes_bits(self, mesh8):
+        """A checkpoint written at a chunk boundary resumes bit-for-bit
+        on the same mesh — same executable, same state."""
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit_mnmg
+        from raft_tpu.core.checkpoint import CheckpointManager
+
+        x = _blobs()
+        p = KMeansParams(n_clusters=8, seed=0, max_iter=20)
+        with tempfile.TemporaryDirectory() as d:
+            full = kmeans_fit_mnmg(None, p, x, mesh=mesh8, sync_every=4,
+                                   checkpoint_every=1, checkpoint_dir=d,
+                                   checkpoint_keep=16)
+            # resume from a MID-fit boundary (step 4), not the final
+            # checkpoint: the replayed iterations must land on the same
+            # bits and the same iteration count
+            pth = CheckpointManager(d, prefix="kmeans").path_for(4)
+            assert os.path.exists(pth)
+            res = kmeans_fit_mnmg(None, p, x, mesh=mesh8, sync_every=4,
+                                  resume_from=pth)
+        assert np.asarray(full[0]).tobytes() == np.asarray(res[0]).tobytes()
+        assert full[3] == res[3]
+
+    def test_mnmg_deadline_expiry_leaves_checkpoint(self, mesh8):
+        """Deadline expiry mid-fit must leave a loadable checkpoint: the
+        boundary hook saves BEFORE the deadline poll raises."""
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit_mnmg
+        from raft_tpu.core.checkpoint import CheckpointManager
+
+        x = _blobs()
+        p = KMeansParams(n_clusters=8, seed=0, max_iter=50_000, tol=-1.0)
+        with tempfile.TemporaryDirectory() as d:
+            with pytest.raises(limits.DeadlineExceededError):
+                with limits.deadline_scope(0.5):
+                    kmeans_fit_mnmg(None, p, x, mesh=mesh8,
+                                    sync_every=25, checkpoint_every=1,
+                                    checkpoint_dir=d)
+            latest = CheckpointManager(d, prefix="kmeans").restore_latest()
+            assert latest is not None
+            step, entries = latest
+            assert step > 0 and entries["n_iter"] == step
+            # and it actually resumes
+            res = kmeans_fit_mnmg(
+                None, KMeansParams(n_clusters=8, seed=0,
+                                   max_iter=step + 4, tol=-1.0),
+                x, mesh=mesh8, sync_every=4,
+                resume_from=CheckpointManager(
+                    d, prefix="kmeans").path_for(step))
+            assert res[3] == step + 4
+
+    def test_lazy_host_mirror_not_built_on_plain_fit(self, mesh8,
+                                                     monkeypatch):
+        """The common single-process MNMG fit must never materialize the
+        host dataset copy (the eager np.asarray(x) it used to pay)."""
+        from raft_tpu.cluster import kmeans as km
+
+        def boom(self):        # pragma: no cover - failure is the test
+            raise AssertionError("host mirror materialized on plain fit")
+
+        monkeypatch.setattr(km._LazyHostMirror, "get", boom)
+        x = _blobs()
+        p = km.KMeansParams(n_clusters=8, seed=0, max_iter=5)
+        km.kmeans_fit_mnmg(None, p, x, mesh=mesh8, sync_every=1)
+        km.kmeans_fit_mnmg(None, p, x, mesh=mesh8, sync_every=4)
+
+    def test_lazy_host_mirror_unit(self):
+        from raft_tpu.cluster.kmeans import _LazyHostMirror
+
+        m = _LazyHostMirror(jnp.arange(4))
+        assert not m.built
+        got = m.get()
+        assert m.built and isinstance(got, np.ndarray)
+        assert m.get() is got
+
+
+# ---------------------------------------------------------------------------
+# eigsh / eigsh_mnmg
+# ---------------------------------------------------------------------------
+
+
+class TestEigshChunked:
+    def test_sync1_bit_identical(self, clean_events):
+        from raft_tpu.sparse.solver.lanczos import eigsh
+
+        csr = _sym_csr()
+        w0, v0, r0 = eigsh(csr, k=4, maxiter=60, return_report=True)
+        w1, v1, r1 = eigsh(csr, k=4, maxiter=60, sync_every=1,
+                           return_report=True)
+        assert np.asarray(w0).tobytes() == np.asarray(w1).tobytes()
+        assert np.asarray(v0).tobytes() == np.asarray(v1).tobytes()
+        assert r0.n_iter == r1.n_iter
+        assert not [e for e in trace.events()
+                    if e["name"] == "compiled_driver.chunk"]
+
+    def test_sync8_same_niter_allclose(self):
+        from raft_tpu.sparse.solver.lanczos import eigsh
+
+        csr = _sym_csr()
+        w1, v1, r1 = eigsh(csr, k=4, maxiter=60, sync_every=1,
+                           return_report=True)
+        w8, v8, r8 = eigsh(csr, k=4, maxiter=60, sync_every=8,
+                           return_report=True)
+        assert r1.n_iter == r8.n_iter
+        assert r1.converged and r8.converged
+        np.testing.assert_allclose(np.asarray(w1), np.asarray(w8),
+                                   rtol=1e-5, atol=1e-6)
+        for i in range(4):        # eigenvectors match up to sign
+            a, b = np.asarray(v1)[:, i], np.asarray(v8)[:, i]
+            s = np.sign(np.dot(a, b))
+            np.testing.assert_allclose(a, s * b, rtol=1e-3, atol=2e-3)
+
+    def test_dense_operator_chunked(self):
+        from raft_tpu.sparse.solver.lanczos import eigsh
+
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(80, 80)).astype(np.float32)
+        a = (a + a.T) * 0.5
+        w1, _, r1 = eigsh(a, k=3, maxiter=60, sync_every=1,
+                          return_report=True)
+        w8, _, r8 = eigsh(a, k=3, maxiter=60, sync_every=8,
+                          return_report=True)
+        assert r1.n_iter == r8.n_iter
+        np.testing.assert_allclose(np.asarray(w1), np.asarray(w8),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_mnmg_chunked_allclose(self, mesh8):
+        from raft_tpu.sparse.solver.lanczos import eigsh_mnmg
+
+        csr = _sym_csr()
+        w1, v1, r1 = eigsh_mnmg(csr, k=4, mesh=mesh8, maxiter=60,
+                                sync_every=1, return_report=True)
+        w8, v8, r8 = eigsh_mnmg(csr, k=4, mesh=mesh8, maxiter=60,
+                                sync_every=8, return_report=True)
+        assert r1.n_iter == r8.n_iter
+        np.testing.assert_allclose(np.asarray(w1), np.asarray(w8),
+                                   rtol=1e-4, atol=1e-5)
+        for i in range(4):
+            a, b = np.asarray(v1)[:, i], np.asarray(v8)[:, i]
+            s = np.sign(np.dot(a, b))
+            np.testing.assert_allclose(a, s * b, rtol=1e-3, atol=2e-3)
+
+    def test_mnmg_checkpoint_boundary_resumes_bits(self, mesh8):
+        from raft_tpu.core.checkpoint import CheckpointManager
+        from raft_tpu.sparse.solver.lanczos import eigsh_mnmg
+
+        csr = _sym_csr()
+        with tempfile.TemporaryDirectory() as d:
+            full = eigsh_mnmg(csr, k=4, mesh=mesh8, maxiter=60,
+                              sync_every=2, checkpoint_every=1,
+                              checkpoint_dir=d, checkpoint_keep=16,
+                              return_report=True)
+            pth = CheckpointManager(d, prefix="eigsh").path_for(4)
+            assert os.path.exists(pth)
+            res = eigsh_mnmg(csr, k=4, mesh=mesh8, maxiter=60,
+                             sync_every=2, resume_from=pth,
+                             return_report=True)
+        assert np.asarray(full[0]).tobytes() == np.asarray(res[0]).tobytes()
+        assert np.asarray(full[1]).tobytes() == np.asarray(res[1]).tobytes()
+        assert full[2].n_iter == res[2].n_iter
