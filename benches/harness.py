@@ -29,7 +29,12 @@ import jax
 # sync_every chunks run as ONE program, host touched per chunk, not per
 # iteration). Host-driven-era MULTICHIP rows bundled both costs into
 # one number and read as superseded once an era-8 row lands.
-BENCH_ERA = 8
+# Era 9: neighbors rows gained the IVF-Flat probe-scan path — the
+# neighbors/ivf_recall family stamps recall@k alongside latency (an
+# approximate row without its recall column is not comparable to an
+# exact one), and brute-force baselines re-measured next to it belong
+# to the same era so speedup ratios never mix timing schemes.
+BENCH_ERA = 9
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

@@ -41,15 +41,19 @@ def main(path: str) -> None:
     if not rows:
         print("(no results)")
         return
-    print("| bench | median ms | throughput | dev/host ms per iter "
-          "| params |")
-    print("|---|---|---|---|---|")
+    print("| bench | median ms | throughput | recall@k "
+          "| dev/host ms per iter | params |")
+    print("|---|---|---|---|---|---|")
     # device_ms_per_iter / host_overhead_ms_per_iter: the era-8
     # compiled-inner-loop split on MULTICHIP solver rows. Rendered as
     # its own column so a collective-overhead claim has to show the
-    # split, not a bundled per-iteration number.
+    # split, not a bundled per-iteration number. recall_at_k: the era-9
+    # ANN column — an approximate-search row's throughput is
+    # meaningless without the recall it was bought at, so the pair
+    # renders side by side (blank for exact rows).
     skip = {"bench", "median_ms", "best_ms", "repeats", "era",
-            "device_ms_per_iter", "host_overhead_ms_per_iter"}
+            "device_ms_per_iter", "host_overhead_ms_per_iter",
+            "recall_at_k"}
     for r in sorted(rows, key=lambda r: r["bench"]):
         thr = ""
         for k, unit in (("GFLOP_per_s", "GFLOP/s"), ("GB_per_s", "GB/s"),
@@ -61,12 +65,15 @@ def main(path: str) -> None:
         if r.get("device_ms_per_iter") is not None:
             split = (f"{r['device_ms_per_iter']} / "
                      f"{r.get('host_overhead_ms_per_iter', 0.0)}")
+        recall = ""
+        if r.get("recall_at_k") is not None:
+            recall = f"{r['recall_at_k']}"
         params = ", ".join(f"{k}={v}" for k, v in r.items()
                            if k not in skip and f"{k} {v}" not in thr
                            and k not in ("GFLOP_per_s", "GB_per_s",
                                          "items_per_s"))
-        print(f"| {r['bench']} | {r['median_ms']} | {thr} | {split} "
-              f"| {params} |")
+        print(f"| {r['bench']} | {r['median_ms']} | {thr} | {recall} "
+              f"| {split} | {params} |")
 
 
 if __name__ == "__main__":

@@ -603,4 +603,57 @@ print("compiled-driver gate: 4 host syncs for 32 iters at sync_every=8 "
       "(trace+counter agree); sync_every=1 bit-identical to host loop")
 PYEOF
 
+# IVF gate (ISSUE 9 acceptance): CPU build+search clears the recall
+# floor at a partial probe, nprobe=n_lists is BIT-identical to
+# brute_force.knn on the same db, and the serving IvfKnnService warms to
+# zero post-warm recompiles with batched answers bit-identical to the
+# eager search.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+import jax.numpy as jnp
+
+import raft_tpu
+from raft_tpu import serve
+from raft_tpu.neighbors import ivf_flat, knn
+from raft_tpu.random import RngState, make_blobs
+
+res = raft_tpu.device_resources(seed=0)
+X, _, _ = make_blobs(res, RngState(5), 8192, 32, n_clusters=64)
+idx = ivf_flat.build(res, X, 64, seed=0)
+q = np.asarray(X[:128])
+
+# exactness boundary: full probe == brute force, bit for bit
+bd, bi = knn(res, X, q, k=10)
+ad, ai = ivf_flat.search(res, idx, q, k=10, nprobe=idx.n_lists)
+np.testing.assert_array_equal(np.asarray(bd), np.asarray(ad))
+np.testing.assert_array_equal(np.asarray(bi), np.asarray(ai))
+
+# recall floor at a partial probe (16/64 lists scanned)
+_, pi = ivf_flat.search(res, idx, q, k=10, nprobe=16)
+gi, pi = np.asarray(bi), np.asarray(pi)
+recall = float(np.mean([len(set(a) & set(b)) / 10
+                        for a, b in zip(gi, pi)]))
+assert recall >= 0.95, f"recall@10 at nprobe=16 fell to {recall}"
+
+# serve path: warmed IvfKnnService, zero post-warm recompiles,
+# batched bits == eager bits
+svc = serve.IvfKnnService(idx, k=10, nprobe=16)
+assert svc.epilogue() == "ivf"
+ex = serve.Executor([svc],
+                    policy=serve.BatchPolicy(max_batch=32,
+                                             max_wait_ms=1.0))
+ex.warm()
+t0 = ex.stats.traces
+with ex:
+    got = ex.submit(svc.name, q[:24]).result(timeout=120)
+assert ex.stats.traces == t0, \
+    f"steady-state serve must not recompile: {ex.stats.traces} != {t0}"
+want = ivf_flat.search(res, idx, q[:24], k=10, nprobe=16)
+for g, w in zip(got, want):
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+print(f"ivf gate: nprobe=n_lists bit-identical to brute force; "
+      f"recall@10={recall:.3f} at nprobe=16; IvfKnnService warmed with "
+      f"zero post-warm recompiles, batched bits == eager bits")
+PYEOF
+
 echo "smoke: PASS"

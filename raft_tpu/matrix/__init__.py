@@ -2,7 +2,8 @@
 
 from raft_tpu.matrix.select_k import SelectAlgo, select_k  # noqa: F401
 from raft_tpu.matrix.argminmax import argmin, argmax  # noqa: F401
-from raft_tpu.matrix.gather import gather, gather_if, scatter  # noqa: F401
+from raft_tpu.matrix.gather import (gather, gather_if, scatter,  # noqa: F401
+                                    take_rows)
 from raft_tpu.matrix.linewise_op import linewise_op  # noqa: F401
 from raft_tpu.matrix.ops import (  # noqa: F401
     copy,

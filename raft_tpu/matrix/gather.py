@@ -35,6 +35,36 @@ def gather_if(res, matrix, indices, stencil, pred: Callable,
                                                      dtype=out.dtype))
 
 
+def take_rows(res, matrix, starts, counts, max_count: int,
+              fill_value=0):
+    """Batched variable-count row-block gather: for each batch element
+    ``b``, read ``counts[b]`` consecutive rows of ``matrix`` beginning at
+    ``starts[b]``, padded out to a static ``max_count`` (ref: gatherv —
+    the reference's variable-length gather, collapsed here to ONE padded
+    index matrix so every block lands in a dense, MXU-friendly tile
+    instead of a per-block host loop).
+
+    ``starts``/``counts`` may carry arbitrary leading batch dims; the
+    result block axis is appended after them. Returns ``(blocks, valid)``
+    where ``blocks[..., j]`` is ``matrix[starts[...] + j]`` for
+    ``j < counts[...]`` and ``fill_value`` beyond, and ``valid`` is the
+    ``j < counts[...]`` mask. Out-of-range reads (a start+count that
+    would run past the matrix) are clipped in-bounds before the gather
+    and masked by ``valid`` — pure jnp, safe under jit.
+    """
+    m = jnp.asarray(matrix)
+    starts = jnp.asarray(starts, jnp.int32)
+    counts = jnp.asarray(counts, jnp.int32)
+    offs = jnp.arange(max_count, dtype=jnp.int32)
+    idx = starts[..., None] + offs                  # [..., max_count]
+    valid = (offs < counts[..., None]) & (idx < m.shape[0])
+    idx = jnp.clip(idx, 0, m.shape[0] - 1)
+    out = m[idx]                                    # [..., max_count(, d)]
+    mask = valid[..., None] if m.ndim == 2 else valid
+    fill = jnp.asarray(fill_value, dtype=out.dtype)
+    return jnp.where(mask, out, fill), valid
+
+
 def scatter(res, matrix, indices, updates=None):
     """out[indices[i], :] = updates[i, :] — or a permutation-scatter of
     matrix itself when updates is None (ref: scatter.cuh in-place kernel)."""

@@ -5,9 +5,13 @@ The reference migrated its k-NN/ANN algorithm tier to cuVS
 contraction engine and select_k. This module is the canonical consumer
 composition (cuvs::neighbors::brute_force lineage): tiled fused-metric
 distances + running top-k merges, the same way the kmeans flagship
-composes fused L2-argmin + one-hot updates.
+composes fused L2-argmin + one-hot updates. :mod:`ivf_flat` stacks the
+next layer — the coarse-quantized inverted-file index that turns the
+O(n) scan into probes over a few lists.
 """
 
+from raft_tpu.neighbors import ivf_flat  # noqa: F401
 from raft_tpu.neighbors.brute_force import knn, knn_mnmg  # noqa: F401
+from raft_tpu.neighbors.ivf_flat import IvfFlatIndex  # noqa: F401
 
-__all__ = ["knn", "knn_mnmg"]
+__all__ = ["knn", "knn_mnmg", "ivf_flat", "IvfFlatIndex"]

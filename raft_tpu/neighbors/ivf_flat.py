@@ -1,0 +1,400 @@
+"""IVF-Flat: sub-linear kNN composed from this tree's own primitives
+(lineage: cuvs::neighbors::ivf_flat, the inverted-file design of Jégou
+et al.'s IVFADC — RAFT's ANN layer was always built FROM the layers this
+repo owns: kmeans coarse quantizer, pairwise distance, select_k, gather).
+
+Index layout (the TPU formulation): the database is partitioned by the
+coarse quantizer into ``n_lists`` inverted lists, packed back-to-back in
+one dense ``[cap_total, d]`` matrix. Each list's slot span is padded to
+``SLOT_ALIGN`` so list tails stay bucket-aligned (``extend`` appends
+in-place until a tail overflows) and CSR-style ``starts``/``sizes``
+describe the spans. Probe scans then gather ``nprobe`` whole spans with
+ONE padded index matrix (:func:`raft_tpu.matrix.take_rows`) into a dense
+``[q, nprobe·cap_max, d]`` candidate tile — fine distances stay MXU
+work, pad slots are masked to +inf, and the PR-7 radix / top-k epilogue
+selects per query. Rows within a list are stored in ascending original
+id, so ``extend`` followed by ``search`` is bit-identical to a rebuild
+with the same centroids whenever the new rows fit the padded tails (new
+ids sort after every old id by construction; an overflowing tail
+triggers a full repack, which IS the rebuild).
+
+Exactness boundary: ``nprobe >= n_lists`` means every list is scanned —
+the search delegates to :func:`raft_tpu.neighbors.brute_force.knn` on
+the exactly-reconstructed database (packed rows are the original rows,
+unmodified), so the full-scan setting is bit-identical to brute force,
+ties and NaN rows included. Partial probes are approximate: a query's
+true neighbor in an unprobed list is missed — the recall-vs-latency
+trade the ``neighbors/ivf_recall`` bench family quantifies. Rows with
+fewer than k reachable candidates pad with id -1 / +inf distance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core import trace
+from raft_tpu.matrix.gather import take_rows
+from raft_tpu.util import precision
+from raft_tpu.util.math import round_up_to_multiple
+from raft_tpu.util.precision import with_matmul_precision
+
+__all__ = ["IvfFlatIndex", "build", "search", "extend", "SLOT_ALIGN"]
+
+# List capacities round up to this many slots: tails absorb extends
+# without repacking, and every span stays aligned for the padded gather.
+SLOT_ALIGN = 8
+
+# metric -> fine-distance kernel family ("l2" expanded / "inner"), the
+# subset whose coarse routing is well-defined by the same quantizer.
+_METRICS = {"l2": "l2", "sqeuclidean": "l2", "euclidean": "l2",
+            "inner": "inner"}
+
+
+def _resolve_metric(metric: str) -> str:
+    kernel = _METRICS.get(metric)
+    if kernel is None:
+        raise ValueError(
+            f"ivf_flat supports metrics {sorted(_METRICS)}, got "
+            f"{metric!r} (cosine et al.: normalize + 'inner', or use "
+            f"brute force)")
+    return kernel
+
+
+@dataclasses.dataclass
+class IvfFlatIndex:
+    """Built IVF-Flat index: coarse centroids + packed inverted lists.
+
+    ``packed_db`` keeps the ORIGINAL row dtype and bytes (reconstruction
+    is exact — the nprobe=n_lists path depends on it); ``packed_ids`` is
+    -1 in pad slots; ``starts``/``sizes`` are the CSR span table; the
+    host-side ``caps`` mirror (padded span widths) is what ``extend``
+    consults without a device sync."""
+
+    centroids: jnp.ndarray          # [n_lists, d] float32
+    packed_db: jnp.ndarray          # [cap_total, d] original dtype
+    packed_ids: jnp.ndarray         # [cap_total] int32, -1 = pad slot
+    starts: jnp.ndarray             # [n_lists] int32 (exclusive cumsum)
+    sizes: jnp.ndarray              # [n_lists] int32 live rows per list
+    caps: np.ndarray                # [n_lists] host int64 padded widths
+    cap_max: int                    # static gather width = caps.max()
+    n_db: int                       # live database rows
+    metric: str
+    _db_cache: Optional[jnp.ndarray] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    @property
+    def n_lists(self) -> int:
+        return int(self.centroids.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.centroids.shape[1])
+
+    def scanned_fraction(self, nprobe: int) -> float:
+        """Fraction of the index a search at ``nprobe`` plans to scan
+        (list-count fraction — the number the ``ivf.search`` trace event
+        carries)."""
+        return min(1.0, nprobe / max(self.n_lists, 1))
+
+    def reconstruct(self) -> jnp.ndarray:
+        """The database in original row order, bit-exact (inverse of the
+        packing permutation). Cached; ``extend`` invalidates."""
+        if self._db_cache is None:
+            ids = np.asarray(self.packed_ids)
+            live = ids >= 0
+            db = np.empty((self.n_db, self.dim),
+                          np.asarray(self.packed_db).dtype)
+            db[ids[live]] = np.asarray(self.packed_db)[live]
+            self._db_cache = jnp.asarray(db)
+        return self._db_cache
+
+
+def _coarse_labels(db, centroids):
+    """Nearest-centroid assignment through the SAME fused path kmeans
+    uses (:func:`raft_tpu.cluster.kmeans._assign` under the shared
+    precision scope) — build and extend must route a row to the same
+    list or extend==rebuild breaks."""
+    from raft_tpu.cluster.kmeans import _assign
+
+    with precision.scope():
+        _, labels = _assign(jnp.asarray(db, jnp.float32),
+                            jnp.asarray(centroids, jnp.float32))
+    return np.asarray(labels)
+
+
+def _pack(db_np: np.ndarray, ids_np: np.ndarray, labels: np.ndarray,
+          n_lists: int):
+    """Stable-pack rows into padded spans: within a list, ascending
+    original id (stable sort key). Returns the packed arrays + host
+    span table."""
+    counts = np.bincount(labels, minlength=n_lists).astype(np.int64)
+    caps = np.asarray([round_up_to_multiple(int(c), SLOT_ALIGN)
+                       for c in counts], np.int64)
+    starts = np.zeros(n_lists, np.int64)
+    np.cumsum(caps[:-1], out=starts[1:])
+    order = np.argsort(labels, kind="stable")       # (label, id) order
+    excl = np.zeros(n_lists, np.int64)
+    np.cumsum(counts[:-1], out=excl[1:])
+    within = np.arange(len(labels)) - np.repeat(excl, counts)
+    slots = starts[labels[order]] + within
+    cap_total = int(caps.sum())
+    packed_db = np.zeros((cap_total, db_np.shape[1]), db_np.dtype)
+    packed_ids = np.full(cap_total, -1, np.int32)
+    packed_db[slots] = db_np[order]
+    packed_ids[slots] = ids_np[order]
+    return packed_db, packed_ids, starts, counts, caps
+
+
+def build(res, db, n_lists: int, metric: str = "l2", *,
+          max_iter: int = 25, seed: int = 0,
+          centroids=None) -> IvfFlatIndex:
+    """Train the coarse quantizer and pack the inverted lists.
+
+    The quantizer is :func:`raft_tpu.cluster.kmeans.kmeans_fit` on the
+    database (the PR-8 compiled-driver path — ``sync_every`` defaults
+    from the cost model), unless ``centroids`` are supplied (a repack /
+    extend-rebuild passes the trained ones through so assignment is
+    identical). Final list assignment always re-runs the fused
+    nearest-centroid pass against the FINAL centroids.
+    """
+    db = jnp.asarray(db)
+    if db.ndim != 2:
+        raise ValueError(f"db must be [n, d], got {db.shape}")
+    n = int(db.shape[0])
+    if not 0 < n_lists <= n:
+        raise ValueError(f"need 0 < n_lists <= n_db, got n_lists="
+                         f"{n_lists}, n_db={n}")
+    _resolve_metric(metric)
+    if centroids is None:
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        params = KMeansParams(n_clusters=n_lists, max_iter=max_iter,
+                              seed=seed)
+        centroids, _, _, _ = kmeans_fit(res, params,
+                                        db.astype(jnp.float32))
+    centroids = jnp.asarray(centroids, jnp.float32)
+    if centroids.shape != (n_lists, db.shape[1]):
+        raise ValueError(f"centroids must be [{n_lists}, {db.shape[1]}]"
+                         f", got {centroids.shape}")
+    labels = _coarse_labels(db, centroids)
+    packed_db, packed_ids, starts, counts, caps = _pack(
+        np.asarray(db), np.arange(n, dtype=np.int32), labels, n_lists)
+    return IvfFlatIndex(
+        centroids=centroids,
+        packed_db=jnp.asarray(packed_db),
+        packed_ids=jnp.asarray(packed_ids),
+        starts=jnp.asarray(starts, jnp.int32),
+        sizes=jnp.asarray(counts, jnp.int32),
+        caps=caps, cap_max=int(caps.max(initial=0)), n_db=n,
+        metric=metric)
+
+
+def extend(res, index: IvfFlatIndex, new_rows) -> IvfFlatIndex:
+    """Append rows to the index (new ids continue from ``n_db``).
+
+    New rows land in their lists' padded tails when they fit — a pure
+    append, no repartitioning. Any overflowing tail triggers a full
+    repack: rebuild from the reconstructed database + new rows with the
+    SAME centroids. Both branches produce bit-identical search results
+    to that rebuild (tail appends preserve the ascending-id pack order
+    because every new id exceeds every old id, and a fitting append
+    leaves every padded span width unchanged:
+    round_up(old+new, SLOT_ALIGN) == round_up(old, SLOT_ALIGN) whenever
+    old+new still fits the old span)."""
+    new_rows = jnp.asarray(new_rows, index.packed_db.dtype)
+    if new_rows.ndim != 2 or new_rows.shape[1] != index.dim:
+        raise ValueError(f"new_rows must be [m, {index.dim}], got "
+                         f"{new_rows.shape}")
+    labels = _coarse_labels(new_rows, index.centroids)
+    sizes = np.asarray(index.sizes, np.int64)
+    add = np.bincount(labels, minlength=index.n_lists).astype(np.int64)
+    if np.any(sizes + add > index.caps):
+        full = jnp.concatenate([index.reconstruct(), new_rows], axis=0)
+        return build(res, full, index.n_lists, index.metric,
+                     centroids=index.centroids)
+    starts = np.asarray(index.starts, np.int64)
+    order = np.argsort(labels, kind="stable")
+    excl = np.zeros(index.n_lists, np.int64)
+    np.cumsum(add[:-1], out=excl[1:])
+    within = np.arange(len(labels)) - np.repeat(excl, add)
+    slots = (starts + sizes)[labels[order]] + within
+    packed_db = np.asarray(index.packed_db).copy()
+    packed_ids = np.asarray(index.packed_ids).copy()
+    new_ids = np.arange(index.n_db, index.n_db + len(labels), dtype=np.int32)
+    packed_db[slots] = np.asarray(new_rows)[order]
+    packed_ids[slots] = new_ids[order]
+    return IvfFlatIndex(
+        centroids=index.centroids,
+        packed_db=jnp.asarray(packed_db),
+        packed_ids=jnp.asarray(packed_ids),
+        starts=index.starts,
+        sizes=jnp.asarray(sizes + add, jnp.int32),
+        caps=index.caps, cap_max=index.cap_max,
+        n_db=index.n_db + int(new_rows.shape[0]), metric=index.metric)
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+def _search_body(queries, centroids, packed_db, packed_ids, starts,
+                 sizes, *, k: int, nprobe: int, cap_max: int,
+                 metric: str, use_radix: bool):
+    """The traced probe scan: coarse pairwise -> top-nprobe lists ->
+    one padded span gather -> masked fine distances -> radix / top_k
+    epilogue. Row-independent per query (the serving invariant: a
+    batched launch is bit-identical to per-request launches)."""
+    kernel = _METRICS[metric]
+    with precision.scope():
+        q = queries.astype(jnp.float32)
+        c = centroids.astype(jnp.float32)
+        # coarse routing: expanded metric against the centroid table
+        # (tiny [q, n_lists] block — select_k AUTO would hand this
+        # shape to lax.top_k, so use it directly)
+        ip = q @ c.T
+        if kernel == "l2":
+            coarse = (jnp.sum(c * c, axis=1)[None, :] - 2.0 * ip
+                      + jnp.sum(q * q, axis=1)[:, None])
+        else:
+            coarse = -ip
+        _, probed = lax.top_k(-coarse, nprobe)          # [q, nprobe]
+        # one padded index matrix gathers all probed spans densely
+        blocks, _ = take_rows(None, packed_db, starts[probed],
+                              sizes[probed], cap_max)
+        ids, valid = take_rows(None, packed_ids, starts[probed],
+                               sizes[probed], cap_max, fill_value=-1)
+        L = nprobe * cap_max
+        cand = blocks.astype(jnp.float32).reshape(q.shape[0], L, -1)
+        ids = ids.reshape(q.shape[0], L)
+        valid = valid.reshape(q.shape[0], L)
+        ipf = jnp.einsum("qd,qld->ql", q, cand)
+        if kernel == "l2":
+            dist = (jnp.sum(cand * cand, axis=-1) - 2.0 * ipf
+                    + jnp.sum(q * q, axis=1)[:, None])
+        else:
+            dist = -ipf
+        dist = jnp.where(valid, dist, jnp.inf)
+        if use_radix:
+            from raft_tpu.matrix.radix_select import radix_select_k
+
+            vals, pos = radix_select_k(dist, k)
+        else:
+            neg, pos = lax.top_k(-dist, k)
+            vals = -neg
+        out_ids = jnp.take_along_axis(ids, pos, axis=1)
+        # pad-slot picks (underfull candidate rows) -> id -1, dist +inf
+        out_ids = jnp.where(jnp.isfinite(vals), out_ids, -1)
+        from raft_tpu.neighbors.brute_force import _finalize
+
+        return _finalize(vals, metric), out_ids
+
+
+_search_jit = functools.partial(
+    jax.jit, static_argnames=("k", "nprobe", "cap_max", "metric",
+                              "use_radix"))(_search_body)
+
+
+def _use_radix(n_candidates: int, k: int, *arrays) -> bool:
+    from raft_tpu.matrix import radix_select
+    from raft_tpu.util.pallas_utils import interpret_needs_ref
+
+    return (radix_select.preferred(n_candidates, k)
+            and radix_select.supports(jnp.float32, n_candidates, k)
+            and not interpret_needs_ref(*arrays))
+
+
+@with_matmul_precision
+def search(res, index: IvfFlatIndex, queries, k: int, nprobe: int
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k nearest database rows per query over ``nprobe`` probed lists.
+    Returns (distances [q, k], indices [q, k]) nearest first, indices in
+    original database row numbering; rows with fewer than k reachable
+    candidates pad with index -1 / distance +inf (similarity -inf for
+    'inner'). Ties within the candidate tile resolve in probe order.
+
+    ``nprobe >= n_lists`` scans everything: delegates to
+    :func:`raft_tpu.neighbors.brute_force.knn` on the reconstructed
+    database — bit-identical to brute force (ties/NaN included), the
+    exactness boundary CI gates on.
+
+    Admission (the PR-5 contract): with a ``runtime.limits`` budget
+    active, a launch whose gathered candidate tile would overrun it
+    degrades to query-row chunks (bit-identical — rows are independent)
+    or raises :class:`~raft_tpu.runtime.limits.RejectedError` when even
+    one row cannot fit. Every search records an ``ivf.search`` trace
+    event carrying nprobe and the scanned fraction.
+    """
+    from raft_tpu.runtime import limits
+
+    queries = jnp.asarray(queries)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries must be [q, {index.dim}], got "
+                         f"{queries.shape}")
+    if not 0 < k <= index.n_db:
+        raise ValueError(f"need 0 < k <= n_db, got k={k}, "
+                         f"n_db={index.n_db}")
+    if nprobe <= 0:
+        raise ValueError(f"need nprobe > 0, got {nprobe}")
+    metric = index.metric
+    if nprobe >= index.n_lists:
+        from raft_tpu.neighbors.brute_force import knn
+
+        trace.record_event("ivf.search", nprobe=index.n_lists,
+                           n_lists=index.n_lists, k=k,
+                           scanned_frac=1.0, path="exact")
+        return knn(res, index.reconstruct(), queries, k, metric=metric)
+    probe_rows = nprobe * index.cap_max
+    if probe_rows < k:
+        raise ValueError(
+            f"nprobe={nprobe} reaches at most {probe_rows} candidates "
+            f"< k={k}; raise nprobe (>= n_lists scans exactly)")
+    trace.record_event("ivf.search", nprobe=nprobe,
+                       n_lists=index.n_lists, k=k,
+                       scanned_frac=round(
+                           index.scanned_fraction(nprobe), 4),
+                       path="ivf")
+    fixed = (index.centroids, index.packed_db, index.packed_ids,
+             index.starts, index.sizes)
+    use_radix = _use_radix(probe_rows, k, index.packed_db, queries)
+    run = functools.partial(_search_jit, centroids=fixed[0],
+                            packed_db=fixed[1], packed_ids=fixed[2],
+                            starts=fixed[3], sizes=fixed[4], k=k,
+                            nprobe=nprobe, cap_max=index.cap_max,
+                            metric=metric, use_radix=use_radix)
+    budget = limits.active_budget()
+    if budget is not None:
+        op = "neighbors.ivf_search"
+        qn = int(queries.shape[0])
+        itemsize = index.packed_db.dtype.itemsize
+        est = limits.estimate_bytes(
+            op, n_queries=qn, probe_rows=probe_rows, n_dims=index.dim,
+            k=k, itemsize=itemsize,
+            packed_rows=int(index.packed_db.shape[0]))
+        if not limits.admit(op, est, budget=budget):
+            # degrade: row-chunk the queries — per-row results are
+            # independent of batch shape, so the bits are identical
+            fixed_bytes = (index.packed_db.size * itemsize
+                           + index.packed_ids.size * 4)
+            per_row = limits.estimate_bytes(
+                op, n_queries=1, probe_rows=probe_rows,
+                n_dims=index.dim, k=k, itemsize=itemsize)
+            chunk = (budget.limit_bytes - fixed_bytes) // max(per_row, 1)
+            if chunk < 1:
+                limits.reject(op, est, budget=budget,
+                              detail="even a single query row's "
+                                     "gathered candidate tile overflows "
+                                     "the budget")
+            limits.record_degraded(op)
+            outs = [run(queries=queries[i:i + int(chunk)])
+                    for i in range(0, qn, int(chunk))]
+            return (jnp.concatenate([o[0] for o in outs], axis=0),
+                    jnp.concatenate([o[1] for o in outs], axis=0))
+    return run(queries=queries)

@@ -412,6 +412,17 @@ def _est_knn(*, n_queries, n_db, n_dims, k, itemsize,
             + n_queries * k * (dist_itemsize + 4))
 
 
+def _est_ivf_search(*, n_queries, probe_rows, n_dims, k, itemsize,
+                    packed_rows=0, dist_itemsize=4):
+    # resident packed lists + queries + the gathered q×probe_rows
+    # candidate tile (rows, fine-distance block, ids, valid mask) the
+    # probe scan materializes per launch, + top-k outputs
+    return ((packed_rows * n_dims + n_queries * n_dims) * itemsize
+            + n_queries * probe_rows
+            * (n_dims * itemsize + dist_itemsize + 4 + 1)
+            + n_queries * k * (dist_itemsize + 4))
+
+
 def _est_gemm(*, m, n, k, itemsize, out_itemsize=None):
     out_itemsize = itemsize if out_itemsize is None else out_itemsize
     return (m * k + k * n) * itemsize + m * n * out_itemsize
@@ -425,6 +436,7 @@ def _est_spmv(*, n_rows, n_cols, nnz, itemsize, index_itemsize=4):
 _ESTIMATORS = {
     "distance.pairwise_distance": _est_pairwise,
     "neighbors.brute_force_knn": _est_knn,
+    "neighbors.ivf_search": _est_ivf_search,
     "linalg.gemm": _est_gemm,
     "sparse.spmv": _est_spmv,
 }
@@ -435,6 +447,8 @@ def estimate_bytes(op: str, **dims) -> int:
     static shapes only (never touches the device). Known ops:
     ``distance.pairwise_distance(m, n, k, itemsize)``,
     ``neighbors.brute_force_knn(n_queries, n_db, n_dims, k, itemsize)``,
+    ``neighbors.ivf_search(n_queries, probe_rows, n_dims, k, itemsize[,
+    packed_rows])``,
     ``linalg.gemm(m, n, k, itemsize[, out_itemsize])``,
     ``sparse.spmv(n_rows, n_cols, nnz, itemsize[, index_itemsize])``."""
     try:

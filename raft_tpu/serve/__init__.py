@@ -23,7 +23,8 @@ Quickstart::
         dist, idx = fut.result(timeout=1.0)
 """
 
-from raft_tpu.serve.executor import (Executor, ExecutorStats, KnnService,
+from raft_tpu.serve.executor import (Executor, ExecutorStats,
+                                     IvfKnnService, KnnService,
                                      KMeansPredictService,
                                      PairwiseService, Service)
 from raft_tpu.serve.loadgen import LoadReport, closed_loop, open_loop
@@ -36,7 +37,7 @@ __all__ = [
     "BUCKET_FLOOR", "bucket_rows", "bucket_ladder",
     "Request", "ResultFuture", "Batch", "BatchPolicy", "RequestQueue",
     "TenantPolicy", "QosPolicy",
-    "Service", "KnnService", "PairwiseService", "KMeansPredictService",
-    "Executor", "ExecutorStats",
+    "Service", "KnnService", "IvfKnnService", "PairwiseService",
+    "KMeansPredictService", "Executor", "ExecutorStats",
     "LoadReport", "closed_loop", "open_loop",
 ]

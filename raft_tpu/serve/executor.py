@@ -54,8 +54,8 @@ from raft_tpu.serve.queue import (Batch, BatchPolicy, Request,
                                   bucket_rows)
 
 __all__ = [
-    "Service", "KnnService", "PairwiseService", "KMeansPredictService",
-    "Executor", "ExecutorStats",
+    "Service", "KnnService", "IvfKnnService", "PairwiseService",
+    "KMeansPredictService", "Executor", "ExecutorStats",
 ]
 
 
@@ -168,6 +168,81 @@ class KnnService(Service):
         from raft_tpu.matrix.radix_select import NPASS
 
         return (NPASS + 2) * rows * int(self.fixed_args[0].shape[0]) * 4
+
+
+class IvfKnnService(Service):
+    """Batched IVF-Flat kNN against a fixed index
+    (:func:`raft_tpu.neighbors.ivf_flat.search`). One instance per
+    (k, nprobe) — the executor's (service, bucket) executable cache then
+    holds one warmed executable per (bucket, nprobe), so sweeping nprobe
+    at steady state never compiles. Per-request result:
+    ``(distances [rows, k], indices [rows, k])`` in original database
+    row numbering. Row independence holds (each query row's coarse
+    probe, gather and epilogue see only its own row), so the batched
+    launch is bit-identical to per-request eager searches.
+
+    Full scans (nprobe >= n_lists) are exact brute force by definition —
+    serve those through :class:`KnnService` on the reconstructed
+    database instead; this service rejects the degenerate setting."""
+
+    def __init__(self, index, k: int, nprobe: int):
+        super().__init__((index.centroids, index.packed_db,
+                          index.packed_ids, index.starts, index.sizes),
+                         dim=index.dim, dtype=index.packed_db.dtype)
+        if not 0 < nprobe < index.n_lists:
+            raise ValueError(
+                f"IvfKnnService needs 0 < nprobe < n_lists "
+                f"(got nprobe={nprobe}, n_lists={index.n_lists}); "
+                f"nprobe >= n_lists is a full scan — use KnnService on "
+                f"index.reconstruct()")
+        self.index = index
+        self.k = int(k)
+        self.nprobe = int(nprobe)
+        self.name = f"ivf_knn_k{k}_np{nprobe}_{index.metric}"
+
+    def _build(self):
+        from raft_tpu.neighbors.ivf_flat import _search_body, _use_radix
+
+        k, nprobe = self.k, self.nprobe
+        cap_max, metric = self.index.cap_max, self.index.metric
+        use_radix = _use_radix(nprobe * cap_max, k, self.fixed_args[1])
+
+        def fn(centroids, packed_db, packed_ids, starts, sizes, q):
+            return _search_body(q, centroids, packed_db, packed_ids,
+                                starts, sizes, k=k, nprobe=nprobe,
+                                cap_max=cap_max, metric=metric,
+                                use_radix=use_radix)
+        return fn
+
+    def unpack(self, out, start, rows):
+        d, i = out
+        return d[start:start + rows], i[start:start + rows]
+
+    def estimate_bytes(self, rows):
+        return limits.estimate_bytes(
+            "neighbors.ivf_search", n_queries=rows,
+            probe_rows=self.nprobe * self.index.cap_max,
+            n_dims=self.dim, k=self.k, itemsize=self.dtype.itemsize,
+            packed_rows=int(self.index.packed_db.shape[0]))
+
+    def eager(self, queries):
+        from raft_tpu.neighbors import ivf_flat
+
+        return ivf_flat.search(None, self.index, jnp.asarray(queries),
+                               self.k, self.nprobe)
+
+    def epilogue(self) -> str:
+        """"ivf" — quoted from :func:`knn_plan` with this service's
+        (n_lists, nprobe), the same predicate the brute-force services
+        quote, so the warm-path report and the compiled dispatch share
+        one source of truth."""
+        from raft_tpu.neighbors.brute_force import knn_plan
+
+        path, _ = knn_plan(1, self.index.n_db, self.k,
+                           metric=self.index.metric,
+                           n_lists=self.index.n_lists,
+                           nprobe=self.nprobe)
+        return path
 
 
 class PairwiseService(Service):

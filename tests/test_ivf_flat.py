@@ -1,0 +1,273 @@
+"""IVF-Flat index: the exactness boundary (nprobe = n_lists bit-identical
+to brute force, ties/NaN included), recall floor at partial probes,
+extend == rebuild (both the tail-append and the repack branch),
+admission degrade/reject, the ivf.search trace event, the knn_plan ivf
+band, and the serving IvfKnnService (batched == eager bits, zero
+post-warm recompiles)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.core import trace
+from raft_tpu.neighbors import ivf_flat, knn
+from raft_tpu.neighbors.brute_force import knn_plan
+from raft_tpu.random import RngState, make_blobs
+from raft_tpu.runtime import limits
+
+
+@pytest.fixture(scope="module")
+def blob_index(res):
+    X, _, _ = make_blobs(res, RngState(3), 4096, 24, n_clusters=32)
+    return np.asarray(X), ivf_flat.build(res, X, 32, seed=0, max_iter=6)
+
+
+class TestBuildLayout:
+    def test_packed_is_a_permutation(self, res, blob_index):
+        X, idx = blob_index
+        ids = np.asarray(idx.packed_ids)
+        live = ids[ids >= 0]
+        assert sorted(live.tolist()) == list(range(len(X)))
+        # packed rows are the ORIGINAL rows, bit-exact
+        np.testing.assert_array_equal(np.asarray(idx.reconstruct()), X)
+
+    def test_spans_aligned_and_consistent(self, res, blob_index):
+        _, idx = blob_index
+        caps = idx.caps
+        assert (caps % ivf_flat.SLOT_ALIGN == 0).all()
+        sizes = np.asarray(idx.sizes)
+        assert (sizes <= caps).all()
+        starts = np.asarray(idx.starts)
+        np.testing.assert_array_equal(
+            starts, np.concatenate([[0], np.cumsum(caps)[:-1]]))
+        assert int(sizes.sum()) == idx.n_db
+        # within each list, ascending original id (the stable pack
+        # order extend's tail appends rely on)
+        ids = np.asarray(idx.packed_ids)
+        for li in range(idx.n_lists):
+            span = ids[starts[li]:starts[li] + sizes[li]]
+            assert (np.diff(span) > 0).all()
+
+    def test_bad_args(self, res, blob_index):
+        X, idx = blob_index
+        with pytest.raises(ValueError, match="n_lists"):
+            ivf_flat.build(res, X[:4], 8)
+        with pytest.raises(ValueError, match="metric"):
+            ivf_flat.build(res, X[:64], 4, metric="canberra")
+        with pytest.raises(ValueError, match="queries"):
+            ivf_flat.search(res, idx, X[:2, :5], k=4, nprobe=2)
+        with pytest.raises(ValueError, match="nprobe"):
+            ivf_flat.search(res, idx, X[:2], k=4, nprobe=0)
+        with pytest.raises(ValueError, match="n_db"):
+            ivf_flat.search(res, idx, X[:2], k=0, nprobe=2)
+
+
+class TestExactnessBoundary:
+    def test_full_probe_bit_identical_to_brute(self, res, blob_index):
+        X, idx = blob_index
+        q = X[:96]
+        bd, bi = knn(res, X, q, k=12)
+        ad, ai = ivf_flat.search(res, idx, q, k=12, nprobe=idx.n_lists)
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(ad))
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(ai))
+
+    def test_full_probe_ties_and_nan_identical(self, res):
+        # adversarial db: exact duplicate rows (ties) and NaN rows —
+        # the delegation to brute force on the reconstructed db must
+        # reproduce its tie ordering and NaN behavior bit-for-bit
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(512, 8)).astype(np.float32)
+        X[100] = X[7]                     # exact tie pair
+        X[200] = X[7]
+        X[300] = np.nan                   # NaN row
+        # quantizer training validates finiteness (kmeans_fit contract)
+        # — a dirty database builds against supplied centroids; the NaN
+        # row still lands in SOME list deterministically and survives
+        # reconstruction bit-for-bit
+        idx = ivf_flat.build(res, X, 8, centroids=X[:8])
+        q = np.concatenate([X[7:8], X[300:301], X[40:44]])
+        bd, bi = knn(res, X, q, k=8)
+        ad, ai = ivf_flat.search(res, idx, q, k=8, nprobe=8)
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(ad))
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(ai))
+
+    def test_overprobe_clamps_to_full_scan(self, res, blob_index):
+        X, idx = blob_index
+        d1 = ivf_flat.search(res, idx, X[:8], k=4, nprobe=idx.n_lists)
+        d2 = ivf_flat.search(res, idx, X[:8], k=4,
+                             nprobe=idx.n_lists + 7)
+        np.testing.assert_array_equal(np.asarray(d1[1]),
+                                      np.asarray(d2[1]))
+
+
+class TestRecall:
+    @pytest.mark.slow  # also gated in ci/smoke.sh at the same shape
+    def test_recall_floor_nprobe16(self, res):
+        X, _, _ = make_blobs(res, RngState(9), 8192, 32, n_clusters=64)
+        idx = ivf_flat.build(res, X, 64, seed=0)
+        q = np.asarray(X[:128])
+        _, gi = knn(res, X, q, k=10)
+        _, ai = ivf_flat.search(res, idx, q, k=10, nprobe=16)
+        gi, ai = np.asarray(gi), np.asarray(ai)
+        recall = np.mean([len(set(a) & set(b)) / 10
+                          for a, b in zip(gi, ai)])
+        assert recall >= 0.95
+
+    @pytest.mark.slow
+    def test_inner_metric_full_probe_matches_brute(self, res):
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(1024, 16)).astype(np.float32)
+        idx = ivf_flat.build(res, X, 16, metric="inner", seed=0)
+        q = X[:32]
+        bd, bi = knn(res, X, q, k=5, metric="inner")
+        ad, ai = ivf_flat.search(res, idx, q, k=5, nprobe=16)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(ai))
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(ad))
+
+    def test_underfull_candidates_pad(self, res):
+        # k reaches past one probed list's capacity: require the
+        # explicit error, not silent truncation
+        rng = np.random.default_rng(13)
+        X = rng.normal(size=(256, 8)).astype(np.float32)
+        idx = ivf_flat.build(res, X, 32, seed=0)
+        with pytest.raises(ValueError, match="candidates"):
+            ivf_flat.search(res, idx, X[:4], k=idx.cap_max + 1,
+                            nprobe=1)
+        # a sparse query row that probes a short list still returns k
+        # columns, padded with id -1 / +inf
+        d, i = ivf_flat.search(res, idx, X[:4], k=idx.cap_max, nprobe=1)
+        i = np.asarray(i)
+        d = np.asarray(d)
+        pad = i == -1
+        assert np.isinf(d[pad]).all()
+        assert (i[~pad] >= 0).all()
+
+
+class TestExtend:
+    @pytest.mark.slow
+    def test_extend_fitting_tail_equals_rebuild(self, res):
+        # craft new rows next to the centroid whose padded tail has the
+        # most headroom, so the append branch is exercised
+        # deterministically (no repack)
+        rng = np.random.default_rng(17)
+        X = rng.normal(size=(1003, 12)).astype(np.float32)
+        idx = ivf_flat.build(res, X, 8, seed=0)
+        head = idx.caps - np.asarray(idx.sizes)
+        li = int(np.argmax(head))
+        assert head[li] >= 2, "all tails full; pick another seed"
+        c = np.asarray(idx.centroids)[li]
+        Y = (c + 0.01 * rng.normal(size=(2, 12))).astype(np.float32)
+        ext = ivf_flat.extend(res, idx, Y)
+        reb = ivf_flat.build(res, np.concatenate([X, Y]), 8,
+                             centroids=idx.centroids)
+        assert np.array_equal(ext.caps, idx.caps)   # append, no repack
+        np.testing.assert_array_equal(np.asarray(ext.packed_ids),
+                                      np.asarray(reb.packed_ids))
+        q = X[:40]
+        ed, ei = ivf_flat.search(res, ext, q, k=8, nprobe=3)
+        rd, ri = ivf_flat.search(res, reb, q, k=8, nprobe=3)
+        np.testing.assert_array_equal(np.asarray(ei), np.asarray(ri))
+        np.testing.assert_array_equal(np.asarray(ed), np.asarray(rd))
+
+    @pytest.mark.slow
+    def test_extend_overflow_repacks_and_equals_rebuild(self, res):
+        rng = np.random.default_rng(19)
+        X = rng.normal(size=(512, 12)).astype(np.float32)
+        Y = rng.normal(size=(300, 12)).astype(np.float32)  # overflows
+        idx = ivf_flat.build(res, X, 8, seed=0)
+        ext = ivf_flat.extend(res, idx, Y)
+        reb = ivf_flat.build(res, np.concatenate([X, Y]), 8,
+                             centroids=idx.centroids)
+        np.testing.assert_array_equal(np.asarray(ext.packed_ids),
+                                      np.asarray(reb.packed_ids))
+        q = X[:40]
+        ed, ei = ivf_flat.search(res, ext, q, k=8, nprobe=3)
+        rd, ri = ivf_flat.search(res, reb, q, k=8, nprobe=3)
+        np.testing.assert_array_equal(np.asarray(ei), np.asarray(ri))
+        np.testing.assert_array_equal(np.asarray(ed), np.asarray(rd))
+
+    def test_extend_full_probe_still_exact(self, res, blob_index):
+        X, idx = blob_index
+        rng = np.random.default_rng(23)
+        Y = rng.normal(size=(50, X.shape[1])).astype(np.float32)
+        ext = ivf_flat.extend(res, idx, Y)
+        assert ext.n_db == len(X) + 50
+        full = np.concatenate([X, Y])
+        np.testing.assert_array_equal(np.asarray(ext.reconstruct()),
+                                      full)
+        q = full[-8:]
+        bd, bi = knn(res, full, q, k=6)
+        ad, ai = ivf_flat.search(res, ext, q, k=6, nprobe=ext.n_lists)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(ai))
+
+
+class TestAdmissionAndObs:
+    def test_degraded_bit_identical(self, res, blob_index):
+        X, idx = blob_index
+        q = X[:64]
+        bd, bi = ivf_flat.search(res, idx, q, k=8, nprobe=4)
+        est = limits.estimate_bytes(
+            "neighbors.ivf_search", n_queries=64,
+            probe_rows=4 * idx.cap_max, n_dims=idx.dim, k=8,
+            itemsize=4, packed_rows=int(idx.packed_db.shape[0]))
+        with limits.budget_scope(est // 2 + int(idx.packed_db.nbytes)):
+            dd, di = ivf_flat.search(res, idx, q, k=8, nprobe=4)
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(dd))
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(di))
+
+    def test_unfittable_rejected(self, res, blob_index):
+        X, idx = blob_index
+        with limits.budget_scope(1024):
+            with pytest.raises(limits.RejectedError):
+                ivf_flat.search(res, idx, X[:4], k=8, nprobe=4)
+
+    def test_trace_event_carries_probe_plan(self, res, blob_index):
+        X, idx = blob_index
+        trace.clear_events()
+        ivf_flat.search(res, idx, X[:4], k=8, nprobe=4)
+        ev = trace.events("ivf.search")
+        assert len(ev) == 1
+        assert ev[0]["nprobe"] == 4 and ev[0]["path"] == "ivf"
+        assert ev[0]["scanned_frac"] == pytest.approx(4 / idx.n_lists)
+        trace.clear_events()
+        ivf_flat.search(res, idx, X[:4], k=8, nprobe=idx.n_lists)
+        ev = trace.events("ivf.search")
+        assert ev[0]["path"] == "exact"
+        assert ev[0]["scanned_frac"] == 1.0
+
+    def test_knn_plan_ivf_band(self):
+        assert knn_plan(64, 4096, 10, n_lists=64, nprobe=8) == ("ivf", 0)
+        # full scan is not an ivf plan — it IS the brute-force plan
+        path, _ = knn_plan(64, 4096, 10, n_lists=64, nprobe=64)
+        assert path != "ivf"
+        assert knn_plan(64, 4096, 10)[0] != "ivf"
+
+
+class TestIvfServe:
+    def test_batched_bits_and_zero_recompiles(self, res, blob_index):
+        from raft_tpu import serve
+
+        X, idx = blob_index
+        svc = serve.IvfKnnService(idx, k=10, nprobe=8)
+        assert svc.epilogue() == "ivf"
+        ex = serve.Executor(
+            [svc], policy=serve.BatchPolicy(max_batch=64,
+                                            max_wait_ms=2.0))
+        ex.warm()
+        traces_after_warm = ex.stats.traces
+        q = X[:48]
+        with ex:
+            fut = ex.submit(svc.name, q)
+            d, i = fut.result(timeout=60.0)
+        assert ex.stats.traces == traces_after_warm
+        ed, ei = ivf_flat.search(res, idx, q, k=10, nprobe=8)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ei))
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(ed))
+
+    def test_full_scan_service_rejected(self, res, blob_index):
+        from raft_tpu import serve
+
+        _, idx = blob_index
+        with pytest.raises(ValueError, match="KnnService"):
+            serve.IvfKnnService(idx, k=4, nprobe=idx.n_lists)

@@ -118,6 +118,38 @@ class TestGatherScatter:
         out = np.asarray(matrix.scatter(None, m, perm))
         np.testing.assert_array_equal(out[perm], m)
 
+    def test_take_rows_variable_blocks(self, rng):
+        m = rng.normal(size=(20, 4)).astype(np.float32)
+        starts = np.array([2, 10, 17], dtype=np.int32)
+        counts = np.array([3, 0, 5], dtype=np.int32)
+        blocks, valid = matrix.take_rows(None, m, starts, counts,
+                                         max_count=5)
+        assert blocks.shape == (3, 5, 4) and valid.shape == (3, 5)
+        np.testing.assert_array_equal(np.asarray(blocks[0, :3]), m[2:5])
+        np.testing.assert_array_equal(np.asarray(blocks[0, 3:]),
+                                      np.zeros((2, 4)))
+        assert not np.asarray(valid[1]).any()       # zero-count block
+        # block 3 runs past the matrix end: clipped + masked invalid
+        np.testing.assert_array_equal(np.asarray(valid[2]),
+                                      [True, True, True, False, False])
+        np.testing.assert_array_equal(np.asarray(blocks[2, :3]),
+                                      m[17:20])
+
+    def test_take_rows_batched_and_1d(self, rng):
+        m = rng.normal(size=(16, 3)).astype(np.float32)
+        starts = np.array([[0, 4], [8, 12]], dtype=np.int32)
+        counts = np.array([[2, 2], [2, 2]], dtype=np.int32)
+        blocks, valid = matrix.take_rows(None, m, starts, counts,
+                                         max_count=2)
+        assert blocks.shape == (2, 2, 2, 3)
+        np.testing.assert_array_equal(np.asarray(blocks[1, 0]), m[8:10])
+        v = np.arange(9, dtype=np.int32)
+        blocks1, valid1 = matrix.take_rows(
+            None, v, np.array([4]), np.array([3]), max_count=4,
+            fill_value=-1)
+        np.testing.assert_array_equal(np.asarray(blocks1[0]),
+                                      [4, 5, 6, -1])
+
 
 class TestMiscOps:
     def test_diagonal(self, rng):
