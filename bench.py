@@ -31,7 +31,7 @@ import numpy as np
 try:
     from benches.harness import BENCH_ERA
 except Exception:  # noqa: BLE001 — provenance must not break the bench
-    BENCH_ERA = 9
+    BENCH_ERA = 10
 
 
 def _tpu_usable(deadline_s: float = 150.0) -> bool:

@@ -34,7 +34,7 @@ import jax
 # approximate row without its recall column is not comparable to an
 # exact one), and brute-force baselines re-measured next to it belong
 # to the same era so speedup ratios never mix timing schemes.
-BENCH_ERA = 9
+BENCH_ERA = 10
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

@@ -656,4 +656,198 @@ print(f"ivf gate: nprobe=n_lists bit-identical to brute force; "
       f"zero post-warm recompiles, batched bits == eager bits")
 PYEOF
 
+# Tracing gate (ISSUE 10 acceptance): a metrics+tracing-on loadgen run
+# must give EVERY completed request a full trace — a serve.request span
+# whose queue_wait/execute children share its trace_id, request_id, and
+# synthetic tid — with every traced request linked by exactly one
+# serve.batch span, and the whole ring must render as a valid Perfetto
+# document. Tenant SLO accounting must cover the run.
+RAFT_TPU_METRICS=on RAFT_TPU_TRACING=on JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import numpy as np
+
+from raft_tpu import obs, serve
+from raft_tpu.obs.schema import validate_chrome_trace
+
+assert obs.tracing_enabled(), "RAFT_TPU_TRACING=on must arm tracing"
+rng = np.random.default_rng(0)
+db = rng.standard_normal((1024, 32)).astype(np.float32)
+
+qos = serve.QosPolicy(default=serve.TenantPolicy(slo_latency_s=30.0))
+ex = serve.Executor(
+    [serve.KnnService(db, k=8)],
+    policy=serve.BatchPolicy(max_batch=64, max_wait_ms=2.0), qos=qos)
+ex.warm()
+# a 1 s CPU loadgen run mints a few thousand spans (3+ per request) —
+# size the ring so the whole run is auditable, not just its tail
+obs.set_retention(65536)
+obs.clear_spans()
+with ex:
+    rep = serve.closed_loop(ex, "knn_k8_l2", clients=4, rows=4,
+                            duration_s=1.0,
+                            tenants=["gold", "bronze"])
+assert rep.completed > 0, "loadgen completed no requests"
+
+req_spans = {s["request_id"]: s for s in obs.spans("serve.request")}
+assert len(req_spans) >= rep.completed, (
+    f"{rep.completed} completions but only {len(req_spans)} "
+    "serve.request spans")
+for fam in ("serve.queue_wait", "serve.execute"):
+    children = obs.spans(fam)
+    by_rid = {}
+    for s in children:
+        assert s["parent"] == "serve.request", \
+            f"{fam} span parent broke: {s['parent']!r}"
+        parent = req_spans.get(s["request_id"])
+        assert parent is not None, f"orphan {fam} span"
+        assert s["trace_id"] == parent["trace_id"], "trace_id split"
+        assert s["thread"] == parent["thread"], "tid split"
+        by_rid[s["request_id"]] = s
+    assert set(by_rid) == set(req_spans), \
+        f"{fam}: {len(by_rid)} spans for {len(req_spans)} requests"
+
+linked = [rid for b in obs.spans("serve.batch")
+          for rid in b["attrs"]["request_ids"]]
+assert set(req_spans) <= set(linked), \
+    "every traced request must appear in a serve.batch span"
+assert len(linked) == len(set(linked)), \
+    "a request_id appeared in two batches"
+
+doc = obs.render_chrome_trace()
+problems = validate_chrome_trace(doc)
+assert not problems, "chrome trace invalid:\n" + "\n".join(problems[:5])
+
+slo = qos.slo_snapshot()
+total = sum(t["window_requests"] for t in slo.values())
+assert set(slo) == {"gold", "bronze"} and total >= rep.completed, \
+    f"SLO window missed requests: {slo}"
+print(f"tracing gate: {len(req_spans)} traced requests across "
+      f"{len(obs.spans('serve.batch'))} batches; "
+      f"{len(doc['traceEvents'])} chrome events validate; "
+      f"SLO window covers {total} outcomes")
+PYEOF
+
+# Flight-recorder gate (ISSUE 10 acceptance): a request stalled in queue
+# past its deadline must dump a bundle that schema-validates, whose
+# header names the trace the failure killed, and whose span snapshot
+# still holds the pre-failure serving spans.
+FLIGHT_DIR=$(mktemp -d)
+RAFT_TPU_METRICS=on RAFT_TPU_TRACING=on \
+    RAFT_TPU_FLIGHT_DIR="$FLIGHT_DIR" JAX_PLATFORMS=cpu \
+    python - <<'PYEOF'
+import glob
+import os
+
+import numpy as np
+
+from raft_tpu import obs, serve
+from raft_tpu.obs.schema import validate_flight_bundle
+from raft_tpu.runtime import limits
+
+rng = np.random.default_rng(0)
+db = rng.standard_normal((1024, 32)).astype(np.float32)
+ex = serve.Executor(
+    [serve.KnnService(db, k=8)],
+    policy=serve.BatchPolicy(max_batch=64, max_wait_ms=50.0))
+ex.warm()
+with ex:
+    ex.submit("knn_k8_l2", rng.standard_normal((4, 32))
+              ).result(timeout=60)          # healthy request first
+    # injected fault: a 0.5 ms deadline stalls in the 50 ms coalescing
+    # window — expiry is detected at dispatch, before any launch
+    fut = ex.submit("knn_k8_l2", rng.standard_normal((4, 32)),
+                    deadline_s=5e-4)
+    try:
+        fut.result(timeout=60)
+        raise AssertionError("stalled request must expire")
+    except limits.DeadlineExceededError:
+        pass
+
+bundles = obs.flight_bundles("DeadlineExceededError")
+assert bundles, "expiry must flight-record"
+header = bundles[-1]["header"]
+assert header.get("trace_id", "").startswith("t-"), \
+    f"bundle must name the dead trace: {header}"
+assert header["op"] == "serve.knn_k8_l2", header["op"]
+assert any(s["name"] == "serve.batch" for s in bundles[-1]["spans"]), \
+    "pre-failure serving spans must be inside the snapshot"
+
+path = header.get("path")
+assert path and os.path.dirname(path) == os.environ["RAFT_TPU_FLIGHT_DIR"]
+n_ok, problems = validate_flight_bundle(path)
+assert not problems, \
+    "flight bundle schema violations:\n" + "\n".join(problems[:10])
+assert n_ok == 2 + header["n_spans"] + header["n_events"]
+assert len(glob.glob(os.path.join(os.path.dirname(path),
+                                  "flight-*.jsonl"))) >= 1
+print(f"flight gate: bundle {os.path.basename(path)} validates "
+      f"({n_ok} records) and names trace {header['trace_id']}")
+PYEOF
+rm -rf "$FLIGHT_DIR"
+
+# Fail-loud span knobs (ISSUE 10 satellite, the RAFT_TPU_HBM_BUDGET
+# pattern): malformed retention/sampling values must fail at import.
+for spec in "RAFT_TPU_SPAN_RETAIN=lots" "RAFT_TPU_SPAN_RETAIN=0" \
+            "RAFT_TPU_SPAN_SAMPLE=often" "RAFT_TPU_SPAN_SAMPLE=1.5"; do
+    if env "$spec" JAX_PLATFORMS=cpu \
+            python -c "import raft_tpu.obs" >/dev/null 2>&1; then
+        echo "span-knob gate: $spec must fail at import"
+        exit 1
+    fi
+done
+echo "span-knob gate: malformed RETAIN/SAMPLE values fail at import"
+
+# Obs-overhead row (ISSUE 10 acceptance, BENCH_ERA=10): the north-star
+# kmeans fit with metrics+tracing ON must stay within 2% of the
+# everything-off wall time — the single-bool no-op discipline, measured.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import json
+import logging
+import time
+
+import numpy as np
+
+from benches.harness import BENCH_ERA
+from raft_tpu import obs
+from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+# tol=-1 pins the iteration count; the not-converged warning is expected
+logging.getLogger("raft_tpu").setLevel(logging.ERROR)
+rng = np.random.default_rng(0)
+x = rng.standard_normal((8192, 32)).astype(np.float32)
+p = KMeansParams(n_clusters=16, seed=0, max_iter=25, tol=-1.0)
+
+
+def one(armed):
+    obs.set_enabled(armed)
+    obs.set_tracing(armed)
+    t0 = time.monotonic()
+    kmeans_fit(None, p, x)
+    return time.monotonic() - t0
+
+
+assert not obs.enabled() and not obs.tracing_enabled()
+one(False), one(True)                     # warm both modes' jit caches
+# interleaved off/on pairs: adjacent runs see the same machine state,
+# so the per-pair ratio cancels CPU-frequency / container drift that a
+# sequential A-then-B timing misreads as obs overhead
+pairs = [(one(False), one(True)) for _ in range(9)]
+obs.set_enabled(False)
+obs.set_tracing(False)
+
+off_s = float(np.median([o for o, _ in pairs]))
+on_s = float(np.median([n for _, n in pairs]))
+delta = float(np.median([(n - o) / o for o, n in pairs]))
+row = {"metric": "obs_overhead_kmeans_8192x32_k16", "era": BENCH_ERA,
+       "value": round(delta * 100.0, 3), "unit": "percent",
+       "off_ms": round(off_s * 1e3, 3), "on_ms": round(on_s * 1e3, 3),
+       "backend": "cpu"}
+print(json.dumps(row))
+assert delta < 0.02, (
+    f"metrics+tracing overhead {delta * 100:.2f}% exceeds the 2% "
+    f"budget (off {off_s * 1e3:.1f} ms, on {on_s * 1e3:.1f} ms)")
+print(f"obs-overhead gate: {delta * 100:+.2f}% "
+      f"(off {off_s * 1e3:.1f} ms, on {on_s * 1e3:.1f} ms)")
+PYEOF
+
 echo "smoke: PASS"

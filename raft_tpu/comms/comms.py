@@ -117,6 +117,10 @@ class _Mailbox:
                     getattr(payload, "nbytes",
                             np.asarray(payload).nbytes),
                     transport="inproc")
+        if obs.tracing_enabled():
+            # in-process cross-rank propagation: the sender's context
+            # rides the shared store instead of a wire header
+            self._store.note_ctx(source, obs.current_context())
         injector = self.faults
         if injector is not None:
             decision = injector.on_send(source, dest, tag, payload)

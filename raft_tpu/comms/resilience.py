@@ -198,6 +198,9 @@ class TagStore:
         self._queues: Dict[Tuple[int, int, int], Deque] = {}
         self._failed: Dict[int, str] = {}
         self._abort_reason: Optional[str] = None
+        # latest trace context noted per source rank (ISSUE 10
+        # cross-rank propagation) — populated only when tracing is on
+        self._ctx: Dict[int, "obs.TraceContext"] = {}
 
     # -- producers ----------------------------------------------------------
 
@@ -206,6 +209,20 @@ class TagStore:
             self._queues.setdefault((source, dest, tag),
                                     collections.deque()).append(payload)
             self._cv.notify_all()
+
+    def note_ctx(self, source: int, ctx) -> None:
+        """Record the trace context ``source``'s latest frames carried
+        (the transport's context header / the in-process sender's
+        thread-local). A matched ``get`` adopts it so a collective's
+        spans on every rank share one trace_id."""
+        if ctx is None:
+            return
+        with self._cv:
+            self._ctx[source] = ctx
+
+    def noted_ctx(self, source: int):
+        with self._cv:
+            return self._ctx.get(source)
 
     def stir(self) -> None:
         """Wake every blocked getter to re-check its exit conditions
@@ -311,7 +328,16 @@ class TagStore:
                             f"with recv {key} pending", endpoint=key)
                     dq = self._queues.get(key)
                     if dq:
-                        return dq.popleft()
+                        msg = dq.popleft()
+                        if self._ctx and obs.tracing_enabled() \
+                                and obs.current_context() is None:
+                            # join the sender's trace: a rank thread
+                            # blocked in a collective inherits the
+                            # context its peer's frames carried
+                            ctx = self._ctx.get(source)
+                            if ctx is not None:
+                                obs.adopt(ctx)
+                        return msg
                     if token.cancelled():
                         token.clear()
                         raise CommsAbortedError(
@@ -319,10 +345,20 @@ class TagStore:
                             endpoint=key)
                     reason = self._failed.get(source)
                     if reason is not None:
-                        raise PeerFailedError(
+                        # name the trace this death kills (the dead
+                        # peer's noted context, else the waiter's own)
+                        ctx = self._ctx.get(source) \
+                            or obs.current_context()
+                        suffix = (f" [trace {ctx.trace_id}]"
+                                  if ctx is not None else "")
+                        exc = PeerFailedError(
                             f"{self.name}: peer rank {source} failed "
-                            f"({reason}) with recv {key} pending",
+                            f"({reason}) with recv {key} pending"
+                            f"{suffix}",
                             rank=source, endpoint=key)
+                        with obs.use_context(ctx):
+                            obs.record_failure(exc, op="comms.recv")
+                        raise exc
                     if limit is not None and limit.expired():
                         # raises DeadlineExceededError with the op key
                         # (and counts it) — queued messages above still

@@ -71,6 +71,12 @@ _GOODBYE = 3    # graceful departure: peer is leaving, not crashing
 _ABORT = 4      # poison frame: body = utf-8 reason; every pending and
                 # future get on the receiver raises CommsAbortedError
                 # (the wire leg of MeshComms.abort — ref status_t::Abort)
+_CTX = 5        # optional trace-context header (ISSUE 10): body =
+                # TraceContext.to_header() utf-8; sent ahead of DATA
+                # frames when tracing is on, so a collective's spans on
+                # every rank share one trace_id. A corrupt or malformed
+                # context frame is dropped silently — tracing is
+                # best-effort metadata, never a delivery failure.
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -193,7 +199,10 @@ class TcpMailbox:
             from raft_tpu.runtime.limits import sleep_within_deadline
             sleep_within_deadline(decision.delay_s, op="comms.send")
         payloads = [arr] if decision is None else decision.payloads
+        ctx = obs.current_context() if obs.tracing_enabled() else None
         if dest == self.rank:
+            if ctx is not None:
+                self._store.note_ctx(source, ctx)
             for p in payloads:
                 if decision is not None and decision.corrupt:
                     p = corrupt_array(np.asarray(p))
@@ -207,6 +216,11 @@ class TcpMailbox:
                 self._store.fail_peer(source, "fault-injected disconnect")
             return
         frames = []
+        if ctx is not None:
+            # context header travels as a frame in the same list so the
+            # reconnect-resend path replays it ahead of the data
+            hdr_raw = ctx.to_header().encode("utf-8")
+            frames.append((_CTX, zlib.crc32(hdr_raw), hdr_raw))
         for p in payloads:
             bio = io.BytesIO()
             np.save(bio, np.asarray(p), allow_pickle=False)
@@ -215,7 +229,7 @@ class TcpMailbox:
             if decision is not None and decision.corrupt:
                 # damage the body after CRC: the receiver detects + drops
                 raw = corrupt_bytes(raw)
-            frames.append((crc, raw))
+            frames.append((_DATA, crc, raw))
         with self._lock:
             lock = self._conn_locks.setdefault(dest, threading.Lock())
         with lock:
@@ -247,10 +261,11 @@ class TcpMailbox:
                         f"{dest} failed after reconnect: {e2!r}",
                         rank=dest, endpoint=(source, dest, tag)) from e2
             if obs.enabled():
-                obs.inc("comms_messages_sent_total", len(frames),
+                obs.inc("comms_messages_sent_total",
+                        sum(1 for k, _, _ in frames if k == _DATA),
                         transport="tcp")
                 obs.inc("comms_bytes_sent_total",
-                        sum(len(raw) + _HDR.size for _, raw in frames),
+                        sum(len(raw) + _HDR.size for _, _, raw in frames),
                         transport="tcp")
             if decision is not None and decision.disconnect:
                 # chaos: cut the link mid-stream; the peer sees EOF with
@@ -274,8 +289,8 @@ class TcpMailbox:
     @staticmethod
     def _send_frames(s: socket.socket, source: int, dest: int, tag: int,
                      frames) -> None:
-        for crc, raw in frames:
-            s.sendall(_HDR.pack(_DATA, source, dest, tag, crc, len(raw)))
+        for kind, crc, raw in frames:
+            s.sendall(_HDR.pack(kind, source, dest, tag, crc, len(raw)))
             s.sendall(raw)
 
     def get(self, source: int, dest: int, tag: int,
@@ -388,6 +403,16 @@ class TcpMailbox:
                                if zlib.crc32(raw) == crc else "(corrupt)")
                         self._store.abort(
                             f"abort from rank {source}: {why}")
+                        continue
+                    if kind == _CTX:
+                        raw = _recv_exact(conn, nbytes)
+                        if zlib.crc32(raw) == crc:
+                            try:
+                                self._store.note_ctx(
+                                    source, obs.TraceContext.from_header(
+                                        raw.decode("utf-8")))
+                            except (ValueError, UnicodeDecodeError):
+                                pass    # best-effort metadata: drop
                         continue
                     raw = _recv_exact(conn, nbytes)
                     if zlib.crc32(raw) != crc:

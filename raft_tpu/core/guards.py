@@ -265,10 +265,12 @@ def check_finite(op: str, *arrays, mode: Optional[str] = None,
         return
     if not bool(finite_sentinel(*arrays)):
         obs.inc("guards_sentinel_trips_total", 1, op=op, stage=stage)
-        raise NonFiniteError(
+        exc = NonFiniteError(
             f"{op}: non-finite values detected at the {stage} boundary "
             f"(guard_mode={mode!r}; run with guard_mode='off' to restore "
             "silent NaN propagation)", op=op, stage=stage)
+        obs.record_failure(exc)
+        raise exc
 
 
 def guard_output(op: str, out, *, inputs=(), recover=None,
@@ -296,11 +298,13 @@ def guard_output(op: str, out, *, inputs=(), recover=None,
     if in_leaves and not _has_tracer(in_leaves) \
             and not bool(finite_sentinel(*in_leaves)):
         obs.inc("guards_sentinel_trips_total", 1, op=op, stage="input")
-        raise NonFiniteError(
+        exc = NonFiniteError(
             f"{op}: non-finite values in the INPUT operands "
             f"(guard_mode={mode!r}) — the output is poisoned by "
             "garbage-in; precision escalation is not attempted",
             op=op, stage="input")
+        obs.record_failure(exc)
+        raise exc
     obs.inc("guards_sentinel_trips_total", 1, op=op, stage="output")
     if mode == "recover" and recover is not None:
         trace.record_event("guards.escalate", op=op)
@@ -313,11 +317,15 @@ def guard_output(op: str, out, *, inputs=(), recover=None,
                    if hasattr(x, "dtype")]
         if not _has_tracer(leaves2) and bool(finite_sentinel(*leaves2)):
             return out2
-        raise NonFiniteError(
+        exc = NonFiniteError(
             f"{op}: output still non-finite after precision escalation "
             "(top of the ladder reached)", op=op, stage="output")
-    raise NonFiniteError(
+        obs.record_failure(exc)
+        raise exc
+    exc = NonFiniteError(
         f"{op}: non-finite values in the output (guard_mode={mode!r}; "
         "inputs were finite — likely overflow or catastrophic "
         "cancellation; guard_mode='recover' re-runs at higher precision)",
         op=op, stage="output")
+    obs.record_failure(exc)
+    raise exc

@@ -14,8 +14,18 @@ escalation events, checkpoint logs. Three parts:
     stack, with bounded retention and deterministic sampling.
 :mod:`raft_tpu.obs.export`
     ``snapshot()``, the process JSONL sink (``RAFT_TPU_METRICS_JSONL``),
-    Prometheus text exposition, and the process-wide event ring that
-    ``trace.record_event`` feeds.
+    Prometheus text exposition, the chrome://tracing exporter, and the
+    process-wide event ring that ``trace.record_event`` feeds.
+:mod:`raft_tpu.obs.tracectx`
+    request-scoped :class:`TraceContext` (ISSUE 10) minted at serve
+    enqueue, propagated thread-locally and across comms ranks —
+    ``RAFT_TPU_TRACING=off`` (the default) keeps minting a single-bool
+    no-op.
+:mod:`raft_tpu.obs.flight`
+    the always-on failure flight recorder: ``record_failure(exc)`` at
+    a typed raise site snapshots the span/event rings + registry into
+    a bounded bundle ring (and a JSONL file under
+    ``RAFT_TPU_FLIGHT_DIR``).
 
 Everything any instrumented module needs is re-exported here; emitting
 through private internals (or a second bespoke registry) is a lint
@@ -28,20 +38,34 @@ from raft_tpu.obs.metrics import (          # noqa: F401
     inc, set_gauge, observe, record_convergence,
 )
 from raft_tpu.obs.spans import (            # noqa: F401
-    span, spans, clear_spans, set_sample_rate, set_retention,
+    span, spans, clear_spans, record_span, set_sample_rate,
+    set_retention,
 )
 from raft_tpu.obs.export import (           # noqa: F401
     emit_event, events, clear_events,
     JsonlSink, get_sink, set_sink,
-    snapshot, render_prometheus,
+    snapshot, render_prometheus, render_chrome_trace,
+)
+from raft_tpu.obs.tracectx import (         # noqa: F401
+    TraceContext, tracing_enabled, set_tracing, mint,
+    current_context, use_context, adopt,
+)
+from raft_tpu.obs.flight import (           # noqa: F401
+    record_failure, flight_bundles, clear_flight_bundles,
+    set_flight_dir, flight_dir,
 )
 
 __all__ = [
     "enabled", "set_enabled", "MetricsRegistry", "get_registry",
     "set_registry", "log_buckets", "DEFAULT_BUCKETS", "RESIDUAL_BUCKETS",
     "inc", "set_gauge", "observe", "record_convergence",
-    "span", "spans", "clear_spans", "set_sample_rate", "set_retention",
+    "span", "spans", "clear_spans", "record_span", "set_sample_rate",
+    "set_retention",
     "emit_event", "events", "clear_events",
     "JsonlSink", "get_sink", "set_sink",
-    "snapshot", "render_prometheus",
+    "snapshot", "render_prometheus", "render_chrome_trace",
+    "TraceContext", "tracing_enabled", "set_tracing", "mint",
+    "current_context", "use_context", "adopt",
+    "record_failure", "flight_bundles", "clear_flight_bundles",
+    "set_flight_dir", "flight_dir",
 ]

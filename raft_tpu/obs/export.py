@@ -29,11 +29,12 @@ import time
 from typing import Deque, List, Optional
 
 from raft_tpu.obs import metrics as _metrics
+from raft_tpu.obs import tracectx as _tracectx
 
 __all__ = [
     "emit_event", "events", "clear_events",
     "JsonlSink", "get_sink", "set_sink",
-    "snapshot", "render_prometheus",
+    "snapshot", "render_prometheus", "render_chrome_trace",
 ]
 
 
@@ -135,6 +136,10 @@ def emit_event(name: str, **attrs) -> None:
     ev = {"name": name, "range": trace.current_range(),
           "range_stack": tuple(trace.range_stack()),
           "t": time.monotonic()}
+    if _tracectx.tracing_enabled():
+        ctx = _tracectx.current_context()
+        if ctx is not None:
+            ev.update(ctx.attrs())
     ev.update(attrs)
     with _events_lock:
         _events.append(ev)
@@ -239,6 +244,72 @@ def render_prometheus(
             out.write(f"{name}_sum{lbl} {_fmt_value(child.sum)}\n")
             out.write(f"{name}_count{lbl} {child.count}\n")
     return out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# chrome://tracing / Perfetto exporter
+# ---------------------------------------------------------------------------
+
+_CHROME_TRACE_FIELDS = ("trace_id", "request_id", "tenant")
+
+
+def render_chrome_trace(path: Optional[str] = None, *,
+                        spans: Optional[List[dict]] = None) -> dict:
+    """Render the span ring as a Perfetto / ``chrome://tracing`` JSON
+    document (the NVTX → Nsight-Systems timeline analogue).
+
+    Every span record becomes a ``"ph": "X"`` complete duration event —
+    host monotonic seconds scaled to the microseconds the format wants,
+    keyed on the recorded thread id so nesting within a thread renders
+    as the stack it was. ``*.chunk`` spans (compiled-driver device-wall
+    chunks) additionally emit an async ``"b"``/``"e"`` slice pair on a
+    per-op track, which Perfetto draws as a separate device lane.
+    Trace-context fields and span attrs land in ``args`` so the UI's
+    selection panel shows which request a slice belonged to.
+
+    ``spans`` overrides the ring (e.g. a flight bundle's span list);
+    ``path`` additionally writes the JSON document to a file. Returns
+    the document either way."""
+    import os as _os
+
+    recs = spans if spans is not None else _list_all_spans()
+    pid = _os.getpid()
+    out: List[dict] = []
+    async_id = 0
+    for rec in recs:
+        args = dict(rec.get("attrs") or {})
+        if rec.get("parent"):
+            args["parent"] = rec["parent"]
+        for f in _CHROME_TRACE_FIELDS:
+            if rec.get(f):
+                args[f] = rec[f]
+        ts_us = float(rec["t"]) * 1e6
+        dur_us = max(0.0, float(rec["duration"])) * 1e6
+        out.append({
+            "name": rec["name"], "ph": "X", "cat": "host",
+            "ts": ts_us, "dur": dur_us, "pid": pid,
+            "tid": rec.get("thread") or 0,
+            "args": _json_safe(args),
+        })
+        if rec["name"].endswith(".chunk"):
+            # device-wall lane: one async slice per chunk, tracked per
+            # op so concurrent solvers get separate rows
+            async_id += 1
+            base = {"name": rec["name"], "cat": "device",
+                    "id": async_id, "pid": pid, "tid": 0,
+                    "args": _json_safe(args)}
+            out.append({**base, "ph": "b", "ts": ts_us})
+            out.append({**base, "ph": "e", "ts": ts_us + dur_us})
+    doc = {"traceEvents": out, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, separators=(",", ":"))
+    return doc
+
+
+def _list_all_spans() -> List[dict]:
+    from raft_tpu.obs.spans import spans as _list_spans
+    return _list_spans()
 
 
 # -- import-time sink attachment (env-driven, metrics-on only) --------------

@@ -1,0 +1,170 @@
+"""Failure flight recorder (ISSUE 10 tentpole part 3): on every typed
+failure, snapshot what the process was doing in the seconds before —
+the span ring, the always-on event ring, the metrics registry, and the
+failing thread's trace context — into a bounded in-memory ring and
+(when a dump directory is configured) a schema-validated JSONL bundle.
+
+This is the black-box role: ALWAYS ON, like the event ring it snapshots
+— a crash with ``RAFT_TPU_METRICS=off`` still leaves the event history
+behind (spans/metrics are simply empty then). The raise sites in
+``runtime/limits.py`` (deadline, budget, breaker), ``core/guards.py``
+(non-finite sentinels), ``serve/`` (queue-full, in-queue expiry) and
+``comms/resilience.py`` (dead peers) call :func:`record_failure` just
+before raising; the call is bounded, lock-scoped, and can never itself
+raise into the failure path.
+
+Bundle file format (one JSONL stream, validated by
+:func:`raft_tpu.obs.schema.validate_flight_bundle`): line 1 is the
+``kind="flight"`` header (error type/message/op, the trace context that
+died, ring occupancy counts), then one ``kind="span"`` line per
+retained span, one ``kind="event"`` line per retained event, and a
+final ``kind="metrics"`` line carrying the registry snapshot.
+
+Bounded by construction: at most ``_RETAIN`` bundles in memory and
+``_MAX_FILES`` files per process on disk — a failure storm degrades
+recording, never memory or the filesystem.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Deque, List, Optional
+
+from raft_tpu.obs import metrics as _metrics
+from raft_tpu.obs import tracectx as _tracectx
+
+__all__ = [
+    "record_failure", "flight_bundles", "clear_flight_bundles",
+    "set_flight_dir", "flight_dir",
+]
+
+_RETAIN = 16        # in-memory bundle ring
+_MAX_FILES = 32     # on-disk bundles per process (storm bound)
+
+_lock = threading.Lock()
+_bundles: Deque[dict] = collections.deque(maxlen=_RETAIN)
+_seq = 0
+_files_written = 0
+_dir: Optional[str] = os.environ.get("RAFT_TPU_FLIGHT_DIR") or None
+
+
+def set_flight_dir(path: Optional[str]) -> Optional[str]:
+    """Set (or with None, disable) the on-disk bundle directory — the
+    programmatic twin of ``RAFT_TPU_FLIGHT_DIR``. Returns the previous
+    value. The in-memory ring records regardless."""
+    global _dir
+    with _lock:
+        prev, _dir = _dir, (str(path) if path else None)
+    return prev
+
+
+def flight_dir() -> Optional[str]:
+    return _dir
+
+
+def flight_bundles(error_type: Optional[str] = None) -> List[dict]:
+    """Snapshot of in-memory bundles, newest last; optionally filtered
+    by the failing exception's type name."""
+    with _lock:
+        out = list(_bundles)
+    if error_type is None:
+        return out
+    return [b for b in out
+            if b["header"]["error_type"] == error_type]
+
+
+def clear_flight_bundles() -> None:
+    global _files_written
+    with _lock:
+        _bundles.clear()
+        _files_written = 0
+
+
+def record_failure(exc: BaseException, *, op: Optional[str] = None,
+                   **attrs) -> Optional[dict]:
+    """Snapshot the rings + registry for one typed failure.
+
+    Called at the raise site, just before ``raise exc``: the thread's
+    current trace context (or one already attached to ``exc``) names
+    the trace the failure killed. Returns the bundle dict (None only if
+    recording itself failed — this function NEVER raises into the
+    caller's failure path)."""
+    global _seq
+    try:
+        # note: `import raft_tpu.obs.spans as m` resolves through the
+        # facade, whose re-exported spans() *function* shadows the
+        # submodule attribute — import the ring accessors directly
+        from raft_tpu.obs.export import events as _list_events
+        from raft_tpu.obs.spans import spans as _list_spans
+
+        ctx = _tracectx.current_context()
+        with _lock:
+            _seq += 1
+            seq = _seq
+        span_recs = _list_spans()
+        event_recs = _list_events()
+        header = {
+            "kind": "flight",
+            "seq": seq,
+            "ts": time.time(),
+            "t": time.monotonic(),
+            "error_type": type(exc).__name__,
+            "error": str(exc)[:2000],
+            "op": op if op is not None else getattr(exc, "op", None),
+            "n_spans": len(span_recs),
+            "n_events": len(event_recs),
+        }
+        if ctx is not None:
+            header.update(ctx.attrs())
+        for k, v in attrs.items():
+            header.setdefault(k, v)
+        bundle = {
+            "header": header,
+            "spans": span_recs,
+            "events": event_recs,
+            "metrics": _metrics.get_registry().snapshot(),
+        }
+        with _lock:
+            _bundles.append(bundle)
+        _maybe_dump(bundle, seq)
+        return bundle
+    except Exception:  # noqa: BLE001 — the recorder must never compound
+        return None    # the failure it is recording
+
+
+def _maybe_dump(bundle: dict, seq: int) -> None:
+    global _files_written
+    with _lock:
+        path_dir = _dir
+        if path_dir is None or _files_written >= _MAX_FILES:
+            return
+        _files_written += 1
+    from raft_tpu.obs.export import _json_safe, JsonlSink
+
+    os.makedirs(path_dir, exist_ok=True)
+    name = (f"flight-{os.getpid()}-{seq:04d}-"
+            f"{bundle['header']['error_type']}.jsonl")
+    path = os.path.join(path_dir, name)
+    sink = JsonlSink(path)
+    try:
+        sink.write(bundle["header"])
+        ts = bundle["header"]["ts"]
+        for rec in bundle["spans"]:
+            out = dict(_json_safe(rec))
+            out["kind"] = "span"
+            out.setdefault("ts", ts)
+            sink.write(out)
+        for rec in bundle["events"]:
+            out = dict(_json_safe(rec))
+            out["kind"] = "event"
+            out.setdefault("ts", ts)
+            sink.write(out)
+        sink.write({"kind": "metrics", "ts": ts,
+                    "t": bundle["header"]["t"],
+                    "metrics": bundle["metrics"]})
+    finally:
+        sink.close()
+    bundle["header"]["path"] = path

@@ -21,6 +21,16 @@ Retention and sampling are bounded by construction:
 * ``RAFT_TPU_SPAN_SAMPLE`` (a rate in (0, 1], default 1.0) keeps
   deterministically every ``round(1/rate)``-th span per name — a
   counter-stride, not a coin flip, so runs are reproducible.
+
+Both env knobs fail loud: a malformed or out-of-range value raises
+``ValueError`` at import (the PR-5 policy of ``RAFT_TPU_RECV_TIMEOUT``
+and ``RAFT_TPU_HBM_BUDGET`` — a typo'd retention silently falling back
+to the default is a debugging session, not a convenience).
+
+When tracing is on (:mod:`raft_tpu.obs.tracectx`), a span entered under
+an active :class:`TraceContext` records ``trace_id`` / ``request_id`` /
+``tenant`` as top-level record keys, so a span ring (or flight bundle,
+or chrome trace) can be sliced by request.
 """
 
 from __future__ import annotations
@@ -33,27 +43,45 @@ import time
 from typing import Deque, Dict, List, Optional
 
 from raft_tpu.obs import metrics as _metrics
+from raft_tpu.obs import tracectx as _tracectx
 
-__all__ = ["span", "spans", "clear_spans", "set_sample_rate",
-           "set_retention"]
+__all__ = ["span", "spans", "clear_spans", "record_span",
+           "set_sample_rate", "set_retention"]
 
 _lock = threading.Lock()
 _counts: Dict[str, int] = {}      # per-name emission counter (sampling)
 
 
 def _env_int(name: str, default: int) -> int:
-    try:
-        return max(1, int(os.environ.get(name, default)))
-    except ValueError:
+    """Parse a positive-int env knob; malformed or < 1 raises at import
+    (fail-loud, matching RAFT_TPU_RECV_TIMEOUT / RAFT_TPU_HBM_BUDGET)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
         return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer") from None
+    if val < 1:
+        raise ValueError(f"{name}={raw!r} must be >= 1")
+    return val
 
 
 def _env_rate(name: str, default: float) -> float:
-    try:
-        rate = float(os.environ.get(name, default))
-    except ValueError:
+    """Parse a [0, 1] rate env knob; malformed or out-of-range raises
+    at import (fail-loud)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
         return default
-    return min(1.0, max(0.0, rate))
+    try:
+        rate = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not a number") from None
+    if not (0.0 <= rate <= 1.0):
+        raise ValueError(f"{name}={raw!r} must be in [0, 1]")
+    return rate
 
 
 _spans: Deque[dict] = collections.deque(
@@ -100,7 +128,7 @@ _NULL = _NullSpan()
 
 class _Span:
     __slots__ = ("name", "attrs", "parent", "t_start", "duration",
-                 "_thread")
+                 "_thread", "_ctx")
 
     def __init__(self, name: str, attrs: dict):
         self.name = name
@@ -109,6 +137,7 @@ class _Span:
         self.t_start = 0.0
         self.duration = 0.0
         self._thread = None
+        self._ctx = None
 
     def set_attr(self, **attrs) -> None:
         """Attach attributes discovered mid-span (iteration counts,
@@ -119,6 +148,8 @@ class _Span:
         from raft_tpu.core import trace
         self.parent = trace.current_range()
         self._thread = threading.get_ident()
+        if _tracectx.tracing_enabled():
+            self._ctx = _tracectx.current_context()
         trace._stack().append(self.name)
         self.t_start = time.monotonic()
         return self
@@ -144,10 +175,43 @@ def _record(sp: _Span) -> None:
         rec = {"name": sp.name, "t": sp.t_start,
                "duration": sp.duration, "parent": sp.parent,
                "thread": sp._thread, "attrs": dict(sp.attrs)}
+        if sp._ctx is not None:
+            rec.update(sp._ctx.attrs())
         _spans.append(rec)
     # sink write happens outside the span lock (the sink has its own)
     from raft_tpu.obs import export
     export._sink_span(rec)
+
+
+def record_span(name: str, *, t_start: float, duration: float,
+                parent: Optional[str] = None,
+                thread: Optional[int] = None,
+                ctx: Optional["_tracectx.TraceContext"] = None,
+                **attrs) -> Optional[dict]:
+    """Record a manufactured span — one whose lifetime was measured
+    outside a ``with`` block (e.g. per-request queue-wait/execute slices
+    derived after a batch launch completes).
+
+    No-op (returns None) when metrics are off. NOT subject to
+    counter-stride sampling: manufactured spans are explicit, their
+    caller already decided they matter. ``ctx`` defaults to the calling
+    thread's active :class:`TraceContext`."""
+    if not _metrics.enabled():
+        return None
+    if ctx is None and _tracectx.tracing_enabled():
+        ctx = _tracectx.current_context()
+    rec = {"name": name, "t": float(t_start),
+           "duration": float(duration), "parent": parent,
+           "thread": thread if thread is not None
+           else threading.get_ident(),
+           "attrs": dict(attrs)}
+    if ctx is not None:
+        rec.update(ctx.attrs())
+    with _lock:
+        _spans.append(rec)
+    from raft_tpu.obs import export
+    export._sink_span(rec)
+    return rec
 
 
 def span(name: str, **attrs):

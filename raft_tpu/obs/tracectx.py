@@ -1,0 +1,178 @@
+"""Request-scoped trace context (ISSUE 10 tentpole part 1): the identity
+a request carries from `serve.RequestQueue` enqueue through coalescing,
+dispatch, the limits checks, compiled-driver chunk boundaries, and —
+via the comms context header — across ranks.
+
+A :class:`TraceContext` is three strings: ``trace_id`` (one logical
+request flow, shared by every rank that touches it), ``request_id``
+(this enqueued block — batch spans link the member request_ids), and
+``tenant``. Contexts are immutable facts; PROPAGATION is a thread-local
+(:func:`use_context` scoped, :func:`adopt` unscoped for message-receipt
+threads), which spans (:mod:`raft_tpu.obs.spans`) and events
+(:mod:`raft_tpu.obs.export`) read at emission time.
+
+Cost model matches the metrics registry: ``RAFT_TPU_TRACING=off`` (the
+default) makes :func:`mint` return None behind one module-level bool —
+no ids, no thread-local writes, bit-identical behavior. Everything
+downstream keys off ``ctx is None``, so the off path never allocates.
+
+Minting is collision-free by construction: a per-process random prefix
+(so two processes in an MNMG job cannot collide) plus a lock-protected
+counter (so eight submitting threads cannot either).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Optional
+
+__all__ = [
+    "TraceContext", "tracing_enabled", "set_tracing", "mint",
+    "current_context", "use_context", "adopt",
+]
+
+
+# -- the on/off knob (pattern: metrics.RAFT_TPU_METRICS — env read once
+# at import, bad values warn and fall back to the safe default) ------------
+
+_TRACING_MODES = ("off", "on")
+
+_env = os.environ.get("RAFT_TPU_TRACING", "off").lower()
+if _env in ("1", "true", "yes"):
+    _env = "on"
+elif _env in ("0", "false", "no", ""):
+    _env = "off"
+if _env not in _TRACING_MODES:
+    import warnings
+
+    warnings.warn(
+        f"RAFT_TPU_TRACING={_env!r} is not one of {_TRACING_MODES}; "
+        "using 'off'", stacklevel=2)
+    _env = "off"
+
+_tracing = _env == "on"
+
+
+def tracing_enabled() -> bool:
+    """True when trace contexts are minted and propagated
+    (``RAFT_TPU_TRACING=on``). When False, :func:`mint` returns None
+    and every propagation site is a ``ctx is None`` no-op."""
+    return _tracing
+
+
+def set_tracing(on: bool) -> None:
+    """Flip context minting at runtime (tests; long-lived services)."""
+    global _tracing
+    _tracing = bool(on)
+
+
+# -- the context itself ----------------------------------------------------
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One request's tracing identity (immutable)."""
+
+    trace_id: str
+    request_id: str
+    tenant: str = "default"
+
+    def attrs(self) -> dict:
+        """The bounded label/attr set spans, events, and flight bundles
+        attach — exactly these three keys, never free-form."""
+        return {"trace_id": self.trace_id, "request_id": self.request_id,
+                "tenant": self.tenant}
+
+    def to_header(self) -> str:
+        """Compact wire form for the comms context frame (JSON array —
+        tenant names may contain any delimiter a hand-rolled format
+        would pick)."""
+        return json.dumps([self.trace_id, self.request_id, self.tenant],
+                          separators=(",", ":"))
+
+    @classmethod
+    def from_header(cls, header: str) -> "TraceContext":
+        """Parse :meth:`to_header` output; raises ``ValueError`` on
+        anything malformed (a corrupt context frame is dropped by the
+        transport, never half-adopted)."""
+        try:
+            parts = json.loads(header)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"malformed trace header: {e.msg}") from None
+        if (not isinstance(parts, list) or len(parts) != 3
+                or not all(isinstance(p, str) and p for p in parts)):
+            raise ValueError(
+                f"trace header must be [trace_id, request_id, tenant] "
+                f"strings, got {header!r}")
+        return cls(trace_id=parts[0], request_id=parts[1],
+                   tenant=parts[2])
+
+
+# -- minting ---------------------------------------------------------------
+
+# process-unique prefix: two ranks of an MNMG job mint disjoint id
+# spaces without coordination
+_PREFIX = uuid.uuid4().hex[:10]
+_mint_lock = threading.Lock()
+_mint_counter = 0
+
+
+def mint(*, tenant: str = "default",
+         trace_id: Optional[str] = None) -> Optional[TraceContext]:
+    """Mint a fresh context (None when tracing is off — the single-bool
+    no-op).
+
+    ``trace_id`` joins an existing trace (a retry, a fan-out child)
+    under a new request_id; default is a fresh trace. Thread-safe and
+    collision-free across threads and processes."""
+    if not _tracing:
+        return None
+    global _mint_counter
+    with _mint_lock:
+        _mint_counter += 1
+        n = _mint_counter
+    rid = f"r-{_PREFIX}-{n:08x}"
+    return TraceContext(
+        trace_id=trace_id if trace_id is not None
+        else f"t-{_PREFIX}-{n:08x}",
+        request_id=rid, tenant=str(tenant))
+
+
+# -- thread-local propagation ----------------------------------------------
+
+_tls = threading.local()
+
+
+def current_context() -> Optional[TraceContext]:
+    """The thread's active context (None outside any request)."""
+    return getattr(_tls, "ctx", None)
+
+
+def adopt(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Unscoped set: make ``ctx`` the thread's active context and return
+    the previous one. This is the message-receipt form — a comms rank
+    thread that just received a context header adopts it for everything
+    it does next (no scope exit exists there). Request-scoped code wants
+    :func:`use_context` instead."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    return prev
+
+
+@contextlib.contextmanager
+def use_context(ctx: Optional[TraceContext]):
+    """Scoped propagation: ``ctx`` is the thread's active context inside
+    the block, the previous context is restored on exit. ``None`` is a
+    true no-op (the tracing-off path pays one ``is None`` check)."""
+    if ctx is None:
+        yield None
+        return
+    prev = adopt(ctx)
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev

@@ -179,11 +179,15 @@ def run_chunked(chunk_call: Callable, carry, *, max_steps: int,
         if per_step is not None and per_step > 0.0:
             limits.check_chunk_budget(op, per_step * n)
         t0 = time.monotonic()
-        with obs.span(op + ".chunk", steps=n):
+        with obs.span(op + ".chunk", steps=n) as sp:
             carry, ran_d, done_d = chunk_call(
                 carry, jnp.asarray(n, jnp.int32))
             ran = int(ran_d)          # the chunk's single host sync
             done = bool(done_d)
+            # device-wall attrs for the chrome-trace async lane (the
+            # span's own duration is host wall across the sync)
+            sp.set_attr(ran=ran,
+                        wall_s=round(time.monotonic() - t0, 6))
         wall = time.monotonic() - t0
         steps_done += ran
         if ran > 0:

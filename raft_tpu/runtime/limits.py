@@ -237,19 +237,23 @@ def check_deadline(op: str) -> None:
     br = get_breaker(op)
     if not br.allow():
         obs.inc("limits_rejected_total", 1, reason="breaker_open", op=op)
-        raise RejectedError(
+        exc = RejectedError(
             f"{op}: circuit breaker open after "
             f"{br.threshold} consecutive typed failures "
             f"(cooldown {br.cooldown_s:g}s) — fast-failing instead of "
             "burning the deadline", op=op, reason="breaker_open")
+        obs.record_failure(exc)
+        raise exc
     d._ops.add(op)
     rem = d.remaining()
     if rem <= 0.0:
         br.record_failure()
         obs.inc("limits_deadline_exceeded_total", 1, op=op)
-        raise DeadlineExceededError(
+        exc = DeadlineExceededError(
             f"{op}: deadline exceeded ({d.budget_s:g}s budget, "
             f"{-rem:.3f}s over)", op=op, budget_s=d.budget_s)
+        obs.record_failure(exc)
+        raise exc
 
 
 def sleep_within_deadline(seconds: float, *, op: str = "sleep") -> None:
@@ -537,11 +541,13 @@ def check_chunk_budget(op: str, est_seconds: float) -> None:
     if est_seconds > rem:
         get_breaker(op).record_failure()
         obs.inc("limits_deadline_exceeded_total", 1, op=op)
-        raise DeadlineExceededError(
+        exc = DeadlineExceededError(
             f"{op}: compiled chunk estimated at {est_seconds:.3f}s "
             f"exceeds the {max(rem, 0.0):.3f}s left on the "
             f"{d.budget_s:g}s deadline — failing before launch",
             op=op, budget_s=d.budget_s)
+        obs.record_failure(exc)
+        raise exc
 
 
 def admit(op: str, estimate: int, *,
@@ -560,10 +566,12 @@ def admit(op: str, estimate: int, *,
     br = get_breaker(op)
     if not br.allow():
         obs.inc("limits_rejected_total", 1, reason="breaker_open", op=op)
-        raise RejectedError(
+        exc = RejectedError(
             f"{op}: circuit breaker open after {br.threshold} "
             f"consecutive typed failures (cooldown {br.cooldown_s:g}s)",
             op=op, estimate=int(estimate), reason="breaker_open")
+        obs.record_failure(exc)
+        raise exc
     if int(estimate) <= b.limit_bytes:
         br.record_success()
         return True
@@ -581,11 +589,13 @@ def reject(op: str, estimate: int, *,
     limit = b.limit_bytes if b is not None else None
     get_breaker(op).record_failure()
     obs.inc("limits_rejected_total", 1, reason="over_budget", op=op)
-    raise RejectedError(
+    exc = RejectedError(
         f"{op}: estimated footprint {int(estimate)} bytes exceeds the "
         f"admission budget ({limit} bytes) even for the tiled path"
         + (f"; {detail}" if detail else ""),
         op=op, estimate=int(estimate), budget=limit)
+    obs.record_failure(exc)
+    raise exc
 
 
 def record_degraded(op: str) -> None:

@@ -14,7 +14,8 @@ Quickstart::
     ex = serve.Executor(
         [serve.KnnService(db, k=10)],
         policy=serve.BatchPolicy(max_batch=256, max_wait_ms=5.0),
-        qos=serve.QosPolicy({"gold": serve.TenantPolicy(weight=4.0)}),
+        qos=serve.QosPolicy({"gold": serve.TenantPolicy(
+            weight=4.0, slo_latency_s=0.05)}),   # 99% under 50 ms
     )
     ex.warm()                       # zero compiles after this
     with ex:                        # start/stop the drain thread

@@ -470,23 +470,42 @@ class Executor:
                 self.stats.deadline_failed += 1
                 obs.inc("limits_deadline_exceeded_total", 1,
                         op=f"serve.{r.op}")
-                self._fail(r, limits.DeadlineExceededError(
+                wait = time.monotonic() - r.t_enqueue
+                exc = limits.DeadlineExceededError(
                     f"serve.{r.op}: deadline expired in queue "
                     f"({r.deadline.budget_s:g}s budget, waited "
-                    f"{time.monotonic() - r.t_enqueue:.3f}s)",
-                    op=f"serve.{r.op}", budget_s=r.deadline.budget_s))
+                    f"{wait:.3f}s)",
+                    op=f"serve.{r.op}", budget_s=r.deadline.budget_s)
+                with obs.use_context(r.ctx):
+                    obs.record_failure(exc, tenant=r.tenant)
+                if self.qos is not None and obs.enabled():
+                    self.qos.record_outcome(r.op, r.tenant, wait,
+                                            failed=True)
+                self._fail(r, exc)
             else:
                 live.append(r)
         return live
 
     def dispatch(self, batch: Batch) -> None:
         """Run one coalesced batch to completion (expiry fast-fail,
-        budget split/degrade, pad-to-bucket, launch, unpad)."""
+        budget split/degrade, pad-to-bucket, launch, unpad).
+
+        When metrics are on the whole batch runs under a
+        ``serve.batch`` span that links the member request_ids — the
+        coalescing join point of the per-request traces."""
         svc = self._service(batch.op)
         live = self._expire_check(batch.requests)
         if not live:
             return
-        self._dispatch_within_budget(svc, live)
+        if obs.enabled():
+            ids = [r.ctx.request_id for r in live if r.ctx is not None]
+            with obs.span("serve.batch", op=batch.op,
+                          requests=len(live),
+                          rows=sum(r.rows for r in live),
+                          request_ids=ids):
+                self._dispatch_within_budget(svc, live)
+        else:
+            self._dispatch_within_budget(svc, live)
 
     def _dispatch_within_budget(self, svc: Service,
                                 reqs: List[Request]) -> None:
@@ -518,7 +537,10 @@ class Executor:
         obs.inc("serve_degraded_total", 1, op=svc.name)
         try:
             scope_s = req.deadline.remaining() if req.deadline else None
-            with limits.budget_scope(budget):
+            # the degraded path runs library entry points on this
+            # thread — adopting the request's context means every span,
+            # limits check, and chunk boundary below carries its ids
+            with obs.use_context(req.ctx), limits.budget_scope(budget):
                 if scope_s is not None:
                     with limits.deadline_scope(max(scope_s, 0.0)):
                         out = svc.eager(req.queries)
@@ -562,9 +584,30 @@ class Executor:
             obs.observe("serve_launch_seconds", dt, op=svc.name)
             now = time.monotonic()
             for r in reqs:
-                obs.observe("serve_queue_wait_seconds",
-                            now - r.t_enqueue,
-                            help="submit-to-launch-complete wait")
+                wait = t0 - r.t_enqueue
+                obs.observe("serve_queue_wait_seconds", wait,
+                            help="submit-to-launch-start wait (the "
+                                 "queue side of the wait/execute "
+                                 "split)")
+                if r.ctx is not None:
+                    # per-request trace slices, manufactured from the
+                    # shared launch timing: request = queue_wait +
+                    # execute. Each request gets a synthetic tid so
+                    # overlapping requests nest correctly in the
+                    # chrome-trace rendering.
+                    tid = 1_000_000 + (r.seq % 1_000_000)
+                    obs.record_span(
+                        "serve.request", t_start=r.t_enqueue,
+                        duration=now - r.t_enqueue, parent=None,
+                        thread=tid, ctx=r.ctx, op=svc.name,
+                        rows=r.rows, tenant=r.tenant)
+                    obs.record_span(
+                        "serve.queue_wait", t_start=r.t_enqueue,
+                        duration=wait, parent="serve.request",
+                        thread=tid, ctx=r.ctx)
+                    obs.record_span(
+                        "serve.execute", t_start=t0, duration=dt,
+                        parent="serve.request", thread=tid, ctx=r.ctx)
             # selection-stage achieved bandwidth for services whose
             # launches ride the radix epilogue (modeled bytes from the
             # benches/select_model.py pass count over the launch time)
@@ -580,6 +623,8 @@ class Executor:
     def _finish(self, svc: Service, reqs: List[Request], out,
                 batched: bool) -> None:
         at = 0
+        now = time.monotonic()
+        meter_slo = self.qos is not None and obs.enabled()
         for r in reqs:
             if r.expired():
                 # computed but missed its SLO: the contract is the
@@ -587,13 +632,24 @@ class Executor:
                 self.stats.deadline_failed += 1
                 obs.inc("limits_deadline_exceeded_total", 1,
                         op=f"serve.{r.op}")
-                self._fail(r, limits.DeadlineExceededError(
+                exc = limits.DeadlineExceededError(
                     f"serve.{r.op}: deadline expired during execution",
-                    op=f"serve.{r.op}", budget_s=r.deadline.budget_s))
-            elif batched:
-                r.future.set_result(svc.unpack(out, at, r.rows))
+                    op=f"serve.{r.op}", budget_s=r.deadline.budget_s)
+                with obs.use_context(r.ctx):
+                    obs.record_failure(exc, tenant=r.tenant)
+                if meter_slo:
+                    self.qos.record_outcome(r.op, r.tenant,
+                                            now - r.t_enqueue,
+                                            failed=True)
+                self._fail(r, exc)
             else:
-                r.future.set_result(out)
+                if batched:
+                    r.future.set_result(svc.unpack(out, at, r.rows))
+                else:
+                    r.future.set_result(out)
+                if meter_slo:
+                    self.qos.record_outcome(r.op, r.tenant,
+                                            now - r.t_enqueue)
             self.stats.requests += 1
             obs.inc("serve_requests_total", 1, op=svc.name,
                     tenant=r.tenant)

@@ -48,6 +48,8 @@ class LoadReport:
     batches: int = 0
     pad_overhead: float = 0.0           # padded rows / real rows
     select_k_bytes_per_s: float = 0.0   # radix-epilogue selection bandwidth
+    slo: Dict[str, dict] = field(default_factory=dict)  # tenant -> SLO state
+    obs_snapshot: Optional[Dict[str, object]] = None    # when metrics on
 
     @property
     def qps(self) -> float:
@@ -71,7 +73,7 @@ class LoadReport:
         return self.percentile_ms(99.0)
 
     def as_dict(self) -> Dict[str, object]:
-        return {
+        out = {
             "mode": self.mode,
             "duration_s": round(self.duration_s, 3),
             "completed": self.completed,
@@ -87,6 +89,14 @@ class LoadReport:
             "pad_overhead": round(self.pad_overhead, 4),
             "select_k_bytes_per_s": round(self.select_k_bytes_per_s, 1),
         }
+        if self.slo:
+            out["slo"] = self.slo
+        if self.obs_snapshot is not None:
+            # parity with the bench.py north-star line: serving
+            # artifacts carry the counter families that explain their
+            # latency numbers
+            out["obs"] = self.obs_snapshot
+        return out
 
 
 def _snapshot(executor) -> tuple:
@@ -112,6 +122,13 @@ def _finalize(report: LoadReport, executor, before: tuple,
     if fam and fam.get("series"):
         report.select_k_bytes_per_s = max(
             float(s["value"]) for s in fam["series"])
+    # per-tenant SLO state (ISSUE 10): burn rate + window counts from
+    # the executor's QosPolicy, when one is wired and metering
+    qos = getattr(executor, "qos", None)
+    if qos is not None and hasattr(qos, "slo_snapshot"):
+        report.slo = qos.slo_snapshot()
+    if obs.enabled():
+        report.obs_snapshot = obs.snapshot()
     return report
 
 

@@ -29,12 +29,23 @@ This module owns POLICY; enforcement lives where the information is:
 - **Fairness** (``queue.py``): tenant ``weight`` feeds the weighted-fair
   virtual clock; a heavy tenant gets proportionally more rows per unit
   time, never the whole pipe.
+- **SLOs** (ISSUE 10, here + ``executor.py`` finish): a tenant may carry
+  a latency objective (``slo_latency_s`` at ``slo_target``). Every
+  completed/failed request records an outcome —
+  ``slo_requests_total{tenant,outcome}`` with outcome ∈
+  ``ok``/``violation``/``deadline`` — and a sliding-window burn-rate
+  gauge ``slo_burn_rate{tenant}`` (windowed violation fraction over the
+  tolerated fraction; >1 means the error budget is burning faster than
+  the objective allows). Surfaced in ``loadgen.LoadReport``.
 """
 
 from __future__ import annotations
 
+import collections
+import threading
+import time
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Deque, Dict, Optional, Tuple
 
 from raft_tpu import obs
 from raft_tpu.runtime import limits
@@ -54,11 +65,20 @@ class TenantPolicy:
     max_queued
         per-tenant cap on queued requests (None = only the global
         ``max_queue`` bounds it).
+    slo_latency_s
+        latency objective: a completed request slower than this is an
+        SLO *violation* (counted, not failed). None = no SLO.
+    slo_target
+        the objective's success fraction (e.g. 0.99 = "99% of requests
+        under ``slo_latency_s``"); the burn-rate gauge is the windowed
+        violation fraction divided by the tolerated ``1 - slo_target``.
     """
 
     weight: float = 1.0
     deadline_s: Optional[float] = None
     max_queued: Optional[int] = None
+    slo_latency_s: Optional[float] = None
+    slo_target: float = 0.99
 
     def __post_init__(self):
         if not self.weight > 0:
@@ -66,6 +86,11 @@ class TenantPolicy:
                              f"got {self.weight}")
         if self.max_queued is not None and self.max_queued < 1:
             raise ValueError("max_queued must be >= 1 when set")
+        if self.slo_latency_s is not None and not self.slo_latency_s > 0:
+            raise ValueError("slo_latency_s must be > 0 when set")
+        if not (0.0 < self.slo_target < 1.0):
+            raise ValueError(f"slo_target must be in (0, 1), "
+                             f"got {self.slo_target}")
 
 
 class QosPolicy:
@@ -78,6 +103,9 @@ class QosPolicy:
     ``limits.active_budget()`` (env/scope), which may itself be None —
     unbudgeted serving, the default."""
 
+    #: sliding window the burn-rate gauge averages over (seconds)
+    SLO_WINDOW_S = 60.0
+
     def __init__(self, tenants: Optional[Dict[str, TenantPolicy]] = None,
                  *, default: Optional[TenantPolicy] = None, budget=None):
         self.tenants = dict(tenants or {})
@@ -86,6 +114,9 @@ class QosPolicy:
             self._budget = budget
         else:
             self._budget = limits.WorkBudget(budget)
+        # per-tenant (t_monotonic, violated) outcome window for burn rate
+        self._slo_lock = threading.Lock()
+        self._slo_window: Dict[str, Deque[Tuple[float, bool]]] = {}
 
     def policy(self, tenant: str) -> TenantPolicy:
         return self.tenants.get(tenant, self.default)
@@ -106,7 +137,74 @@ class QosPolicy:
         if cap is not None and tenant_pending >= cap:
             obs.inc("limits_rejected_total", 1, reason="queue_full",
                     op=f"serve.{op}")
-            raise limits.RejectedError(
+            exc = limits.RejectedError(
                 f"serve.{op}: tenant {tenant!r} queue share full "
                 f"({tenant_pending} >= max_queued={cap})",
                 op=f"serve.{op}", reason="queue_full")
+            obs.record_failure(exc, tenant=tenant)
+            raise exc
+
+    # -- per-tenant SLO accounting (ISSUE 10) --------------------------
+
+    def record_outcome(self, op: str, tenant: str, latency_s: float,
+                       *, failed: bool = False) -> None:
+        """Fold one finished request into the tenant's SLO accounting
+        (executor ``_finish`` / deadline-fail paths call this when
+        metrics are on).
+
+        Outcome taxonomy: ``deadline`` — the request FAILED (expired);
+        ``violation`` — it completed but slower than the tenant's
+        ``slo_latency_s``; ``ok`` otherwise (including tenants with no
+        SLO: without an objective nothing can be violated)."""
+        pol = self.policy(tenant)
+        if failed:
+            outcome = "deadline"
+        elif (pol.slo_latency_s is not None
+                and latency_s > pol.slo_latency_s):
+            outcome = "violation"
+        else:
+            outcome = "ok"
+        obs.inc("slo_requests_total", 1, tenant=tenant, outcome=outcome,
+                help="requests by per-tenant SLO outcome "
+                     "(ok|violation|deadline)")
+        if pol.slo_latency_s is None:
+            return
+        now = time.monotonic()
+        bad = outcome != "ok"
+        with self._slo_lock:
+            win = self._slo_window.get(tenant)
+            if win is None:
+                win = self._slo_window[tenant] = collections.deque()
+            win.append((now, bad))
+            cutoff = now - self.SLO_WINDOW_S
+            while win and win[0][0] < cutoff:
+                win.popleft()
+            n = len(win)
+            n_bad = sum(1 for _, b in win if b)
+        tolerated = 1.0 - pol.slo_target
+        burn = (n_bad / n) / tolerated if n else 0.0
+        obs.set_gauge("slo_burn_rate", burn, tenant=tenant,
+                      help="sliding-window SLO violation fraction over "
+                           "the tolerated fraction (>1 = error budget "
+                           "burning too fast)")
+
+    def slo_snapshot(self) -> Dict[str, dict]:
+        """Per-tenant SLO state for report surfacing: window counts and
+        the current burn rate, keyed by tenant (only tenants that have
+        recorded outcomes appear)."""
+        out: Dict[str, dict] = {}
+        with self._slo_lock:
+            items = [(t, list(w)) for t, w in self._slo_window.items()]
+        for tenant, win in items:
+            pol = self.policy(tenant)
+            n = len(win)
+            n_bad = sum(1 for _, b in win if b)
+            tolerated = 1.0 - pol.slo_target
+            out[tenant] = {
+                "slo_latency_s": pol.slo_latency_s,
+                "slo_target": pol.slo_target,
+                "window_requests": n,
+                "window_bad": n_bad,
+                "burn_rate": (n_bad / n) / tolerated if n else 0.0,
+            }
+        return out

@@ -136,6 +136,9 @@ class Request:
     t_enqueue: float                    # monotonic
     deadline: Optional[limits.Deadline] = None
     future: ResultFuture = field(default_factory=ResultFuture)
+    # minted at submit when RAFT_TPU_TRACING=on; None otherwise — every
+    # downstream propagation site keys off `ctx is None`
+    ctx: Optional[obs.TraceContext] = None
 
     @property
     def rows(self) -> int:
@@ -263,11 +266,13 @@ class RequestQueue:
             if self._pending >= self.policy.max_queue:
                 obs.inc("limits_rejected_total", 1, reason="queue_full",
                         op=f"serve.{op}")
-                raise limits.RejectedError(
+                exc = limits.RejectedError(
                     f"serve.{op}: queue full ({self._pending} requests "
                     f">= max_queue={self.policy.max_queue}) — retry with "
                     "backoff or shed load", op=f"serve.{op}",
                     reason="queue_full")
+                obs.record_failure(exc, tenant=tenant)
+                raise exc
             if self.qos is not None:
                 self.qos.check_tenant_share(
                     op, tenant, self._tenant_pending(op, tenant))
@@ -276,7 +281,7 @@ class RequestQueue:
                 st = self._ops[op] = _OpState()
             req = Request(op=op, queries=queries, tenant=tenant,
                           seq=self._seq, t_enqueue=time.monotonic(),
-                          deadline=dl)
+                          deadline=dl, ctx=obs.mint(tenant=tenant))
             self._seq += 1
             st.push(req, self._weight(tenant))
             self._pending += 1

@@ -1,0 +1,610 @@
+"""End-to-end request tracing + flight recorder tests (ISSUE 10):
+context propagation through a real coalesced batch, cross-rank trace_id
+equality over the TCP transport, forced-fault flight dumps that
+schema-validate, tracing-off bit-identity on the serve paths, concurrent
+mint uniqueness, schema round-trips for the new record shapes, and the
+fail-loud span env knobs."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs, serve
+from raft_tpu.obs import schema
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.obs import tracectx
+from raft_tpu.comms.errors import PeerFailedError
+from raft_tpu.comms.tcp_mailbox import TcpMailbox
+from raft_tpu.runtime import limits
+
+DIM = 16
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def live_tracing():
+    """Metrics + tracing on with fresh private state; restored after."""
+    was_enabled = obs.enabled()
+    was_tracing = obs.tracing_enabled()
+    old_reg = obs_metrics.set_registry(obs.MetricsRegistry())
+    old_sink = obs.set_sink(None)
+    old_dir = obs.set_flight_dir(None)
+    obs.set_enabled(True)
+    obs.set_tracing(True)
+    obs.clear_spans()
+    obs.clear_events()
+    obs.clear_flight_bundles()
+    prev_ctx = obs.adopt(None)
+    try:
+        yield obs_metrics.get_registry()
+    finally:
+        obs.adopt(prev_ctx)
+        obs.set_enabled(was_enabled)
+        obs.set_tracing(was_tracing)
+        obs_metrics.set_registry(old_reg)
+        obs.set_sink(old_sink)
+        obs.set_flight_dir(old_dir)
+        obs.clear_flight_bundles()
+        obs.clear_spans()
+        obs.clear_events()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    return {
+        "db": rng.standard_normal((96, DIM)).astype(np.float32),
+        "centroids": rng.standard_normal((5, DIM)).astype(np.float32),
+        "corpus": rng.standard_normal((48, DIM)).astype(np.float32),
+    }
+
+
+def _queries(seed, rows):
+    return (np.random.default_rng(seed)
+            .standard_normal((rows, DIM)).astype(np.float32))
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+# -- context primitives -----------------------------------------------------
+
+
+class TestTraceContext:
+    def test_mint_off_is_none(self):
+        was = obs.tracing_enabled()
+        obs.set_tracing(False)
+        try:
+            assert obs.mint() is None
+            assert obs.current_context() is None
+        finally:
+            obs.set_tracing(was)
+
+    def test_header_round_trip(self, live_tracing):
+        c = obs.mint(tenant="a,b:\"c\"")     # delimiter-hostile tenant
+        assert obs.TraceContext.from_header(c.to_header()) == c
+
+    @pytest.mark.parametrize("bad", [
+        "", "{", "[]", "[\"a\",\"b\"]", "[\"a\",\"b\",\"\"]",
+        "[\"a\",\"b\",3]", "[\"a\",\"b\",\"c\",\"d\"]", "nope",
+    ])
+    def test_malformed_header_raises(self, bad):
+        with pytest.raises(ValueError):
+            obs.TraceContext.from_header(bad)
+
+    def test_use_context_scoped_and_none_noop(self, live_tracing):
+        outer = obs.mint()
+        inner = obs.mint(trace_id=outer.trace_id)
+        assert inner.trace_id == outer.trace_id
+        assert inner.request_id != outer.request_id
+        with obs.use_context(outer):
+            assert obs.current_context() is outer
+            with obs.use_context(inner):
+                assert obs.current_context() is inner
+            with obs.use_context(None):     # true no-op
+                assert obs.current_context() is outer
+            assert obs.current_context() is outer
+        assert obs.current_context() is None
+
+    def test_concurrent_mint_uniqueness(self, live_tracing):
+        """8 threads x 200 mints: every trace_id / request_id distinct,
+        and each thread's adopted context never leaks to another."""
+        n_threads, n_each = 8, 200
+        ids, errs = [], []
+        lock = threading.Lock()
+
+        def worker(i):
+            try:
+                mine = []
+                for _ in range(n_each):
+                    c = obs.mint(tenant=f"t{i}")
+                    with obs.use_context(c):
+                        cur = obs.current_context()
+                        assert cur is c and cur.tenant == f"t{i}"
+                        mine.append((c.trace_id, c.request_id))
+                assert obs.current_context() is None
+                with lock:
+                    ids.extend(mine)
+            except BaseException as e:  # noqa: BLE001 — surface in main
+                with lock:
+                    errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs, errs
+        assert len(ids) == n_threads * n_each
+        assert len({t for t, _ in ids}) == len(ids)
+        assert len({r for _, r in ids}) == len(ids)
+
+
+# -- serve propagation ------------------------------------------------------
+
+
+class TestServePropagation:
+    def test_coalesced_batch_links_every_request(self, live_tracing, data):
+        """A real coalesced batch: the serve.batch span names every
+        member request_id, and each request gets a consistent
+        request/queue_wait/execute span family carrying its context."""
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=64, max_wait_ms=20.0))
+        ex.warm()
+        obs.clear_spans()
+        with ex:
+            futs = [ex.submit("knn_k4_l2", _queries(s, 4),
+                              tenant=f"tn{s % 2}") for s in range(5)]
+            for f in futs:
+                f.result(timeout=30)
+        batch_spans = obs.spans("serve.batch")
+        assert batch_spans, "no serve.batch span recorded"
+        linked = [rid for b in batch_spans
+                  for rid in b["attrs"]["request_ids"]]
+        assert len(linked) == 5 and len(set(linked)) == 5
+
+        req_spans = obs.spans("serve.request")
+        assert len(req_spans) == 5
+        by_rid = {s["request_id"]: s for s in req_spans}
+        assert set(by_rid) == set(linked)
+        waits = obs.spans("serve.queue_wait")
+        execs = obs.spans("serve.execute")
+        assert len(waits) == 5 and len(execs) == 5
+        for fam in (waits, execs):
+            for s in fam:
+                assert s["parent"] == "serve.request"
+                parent = by_rid[s["request_id"]]
+                assert s["trace_id"] == parent["trace_id"]
+                assert s["thread"] == parent["thread"]
+        # the wait/execute split covers the request span
+        for rid, parent in by_rid.items():
+            w = next(s for s in waits if s["request_id"] == rid)
+            e = next(s for s in execs if s["request_id"] == rid)
+            assert w["duration"] + e["duration"] <= \
+                parent["duration"] + 1e-6
+        # and the histogram metered the queue side of the split
+        fam = live_tracing.snapshot().get("serve_queue_wait_seconds")
+        assert fam and fam["series"][0]["count"] == 5
+
+    def test_tenant_rides_context(self, live_tracing, data):
+        ex = serve.Executor([serve.KnnService(data["db"], k=4)])
+        ex.warm()
+        obs.clear_spans()
+        with ex:
+            ex.submit("knn_k4_l2", _queries(0, 4),
+                      tenant="gold").result(timeout=30)
+        (span,) = obs.spans("serve.request")
+        assert span["tenant"] == "gold"
+
+    def test_slo_outcomes_and_burn_rate(self, live_tracing, data):
+        qos = serve.QosPolicy({"gold": serve.TenantPolicy(
+            weight=2.0, slo_latency_s=1e-6, slo_target=0.9)})
+        ex = serve.Executor([serve.KnnService(data["db"], k=4)], qos=qos)
+        ex.warm()
+        with ex:
+            for s in range(3):
+                ex.submit("knn_k4_l2", _queries(s, 4),
+                          tenant="gold").result(timeout=30)
+        # 1 microsecond objective: every completion is a violation
+        snap = live_tracing.snapshot()
+        fam = snap["slo_requests_total"]
+        got = [s["value"] for s in fam["series"]
+               if s["labels"] == {"tenant": "gold",
+                                  "outcome": "violation"}]
+        assert got == [3]
+        burn = snap["slo_burn_rate"]["series"][0]["value"]
+        assert burn == pytest.approx(1.0 / (1.0 - 0.9))
+        slo = qos.slo_snapshot()["gold"]
+        assert slo["window_requests"] == 3 and slo["window_bad"] == 3
+
+    def test_loadgen_report_carries_slo_and_obs(self, live_tracing, data):
+        qos = serve.QosPolicy(default=serve.TenantPolicy(
+            slo_latency_s=10.0))
+        ex = serve.Executor([serve.KnnService(data["db"], k=4)], qos=qos)
+        ex.warm()
+        with ex:
+            rep = serve.closed_loop(ex, "knn_k4_l2", clients=2, rows=4,
+                                    duration_s=0.3)
+        assert rep.completed > 0
+        d = rep.as_dict()
+        assert "obs" in d and d["obs"]["enabled"]
+        assert "serve_requests_total" in d["obs"]["metrics"]
+        assert d["slo"]["default"]["window_requests"] >= rep.completed
+
+
+# -- tracing-off bit-identity ------------------------------------------------
+
+
+class TestTracingOffBitIdentity:
+    def test_serve_outputs_bit_identical_and_ctx_free(self, data):
+        """With metrics AND tracing off, served results equal the eager
+        reference exactly and no context is ever minted."""
+        assert not obs.enabled() and not obs.tracing_enabled()
+        services = [serve.KnnService(data["db"], k=4),
+                    serve.PairwiseService(data["corpus"]),
+                    serve.KMeansPredictService(data["centroids"])]
+        ex = serve.Executor(services)
+        ex.warm()
+        seen = []
+        orig_dispatch = ex.dispatch
+
+        def spy(batch):
+            seen.extend(batch.requests)
+            orig_dispatch(batch)
+
+        ex.dispatch = spy
+        q = _queries(3, 6)
+        with ex:
+            outs = {svc.name: ex.submit(svc.name, q).result(timeout=30)
+                    for svc in services}
+        assert seen and all(r.ctx is None for r in seen)
+        for svc in services:
+            ref = svc.eager(q)
+            got = outs[svc.name]
+            ref = ref if isinstance(ref, tuple) else (ref,)
+            got = got if isinstance(got, tuple) else (got,)
+            for r, g in zip(ref, got):
+                np.testing.assert_array_equal(np.asarray(r),
+                                              np.asarray(g))
+
+
+# -- cross-rank propagation --------------------------------------------------
+
+
+class TestCrossRank:
+    def test_two_rank_trace_id_equality(self, live_tracing):
+        """Rank 0 sends under a minted context; rank 1's blocked recv
+        adopts the same trace_id from the wire context header."""
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+        b0 = TcpMailbox(0, addrs)
+        b1 = TcpMailbox(1, addrs)
+        got = {}
+
+        def rank1():
+            got["msg"] = b1.get(0, 1, tag=7, timeout=10)
+            got["ctx"] = obs.current_context()
+
+        try:
+            th = threading.Thread(target=rank1, daemon=True)
+            th.start()
+            ctx = obs.mint(tenant="mnmg")
+            with obs.use_context(ctx):
+                b0.put(0, 1, 7, np.arange(8, dtype=np.float32))
+            th.join(timeout=10)
+            assert not th.is_alive()
+            np.testing.assert_array_equal(
+                got["msg"], np.arange(8, dtype=np.float32))
+            assert got["ctx"] is not None
+            assert got["ctx"].trace_id == ctx.trace_id
+            assert got["ctx"].tenant == "mnmg"
+        finally:
+            b0.close()
+            b1.close()
+
+    def test_inproc_mailbox_propagates(self, live_tracing):
+        from raft_tpu.comms.comms import _Mailbox
+
+        box = _Mailbox()
+        got = {}
+
+        def receiver():
+            got["msg"] = box.get(0, 1, tag=3, timeout=10)
+            got["ctx"] = obs.current_context()
+
+        th = threading.Thread(target=receiver, daemon=True)
+        th.start()
+        ctx = obs.mint()
+        with obs.use_context(ctx):
+            box.put(0, 1, 3, np.ones(4))
+        th.join(timeout=10)
+        assert not th.is_alive()
+        assert got["ctx"] is not None
+        assert got["ctx"].trace_id == ctx.trace_id
+
+    def test_dead_peer_error_names_trace(self, live_tracing):
+        """A dead-peer failure while a traced recv is pending names the
+        trace it killed, and flight-records the PeerFailedError."""
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+        b0 = TcpMailbox(0, addrs)
+        b1 = TcpMailbox(1, addrs)
+        errs = {}
+        ctx = obs.mint(tenant="mnmg")
+
+        def rank1():
+            obs.adopt(ctx)
+            try:
+                b1.get(0, 1, tag=9, timeout=10)
+            except PeerFailedError as e:
+                errs["exc"] = e
+            finally:
+                obs.adopt(None)
+
+        try:
+            th = threading.Thread(target=rank1, daemon=True)
+            th.start()
+            time.sleep(0.2)
+            b1.fail_peer(0, "test-induced death")
+            th.join(timeout=10)
+            assert not th.is_alive()
+            exc = errs["exc"]
+            assert f"[trace {ctx.trace_id}]" in str(exc)
+            bundles = obs.flight_bundles("PeerFailedError")
+            assert bundles
+            assert bundles[-1]["header"]["trace_id"] == ctx.trace_id
+            assert bundles[-1]["header"]["op"] == "comms.recv"
+        finally:
+            b0.close()
+            b1.close()
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_forced_fault_dump_validates(self, live_tracing, tmp_path,
+                                         data):
+        """A deadline fault during traced serving dumps a bundle that
+        schema-validates, names the failing trace, and contains spans
+        recorded before the failure."""
+        obs.set_flight_dir(str(tmp_path))
+        ex = serve.Executor([serve.KnnService(data["db"], k=4)])
+        ex.warm()
+        with ex:
+            ex.submit("knn_k4_l2", _queries(0, 4)).result(timeout=30)
+            fut = ex.submit("knn_k4_l2", _queries(1, 4),
+                            deadline_s=1e-4)   # expires in queue
+            with pytest.raises(limits.DeadlineExceededError):
+                fut.result(timeout=30)
+        bundles = obs.flight_bundles("DeadlineExceededError")
+        assert bundles
+        header = bundles[-1]["header"]
+        assert header["trace_id"].startswith("t-")
+        assert header["op"].startswith("serve.")
+        path = header["path"]
+        n_ok, problems = schema.validate_flight_bundle(path)
+        assert not problems, problems
+        assert n_ok == 2 + header["n_spans"] + header["n_events"]
+        # the pre-failure serving spans are inside the snapshot
+        assert any(s["name"] == "serve.batch"
+                   for s in bundles[-1]["spans"])
+        with open(path, encoding="utf-8") as f:
+            first = json.loads(f.readline())
+        assert first["kind"] == "flight"
+        assert first["trace_id"] == header["trace_id"]
+
+    def test_breaker_open_records_flight(self, live_tracing):
+        limits.reset_breakers()
+        try:
+            br = limits.get_breaker("trace.test.op")
+            for _ in range(br.threshold):
+                br.record_failure()
+            with limits.deadline_scope(10.0):
+                with pytest.raises(limits.RejectedError):
+                    limits.check_deadline("trace.test.op")
+            assert obs.flight_bundles("RejectedError")
+        finally:
+            limits.reset_breakers()
+
+    def test_nonfinite_guard_records_flight(self, live_tracing):
+        from raft_tpu.core import guards
+
+        with pytest.raises(guards.NonFiniteError):
+            guards.check_finite("trace.guard.op",
+                                np.array([1.0, np.nan]), mode="check")
+        bundles = obs.flight_bundles("NonFiniteError")
+        assert bundles and bundles[-1]["header"]["op"] == \
+            "trace.guard.op"
+
+    def test_recorder_is_bounded_and_never_raises(self, live_tracing,
+                                                  tmp_path):
+        obs.set_flight_dir(str(tmp_path))
+        for i in range(40):
+            assert obs.record_failure(ValueError(f"boom {i}")) is not None
+        assert len(obs.flight_bundles()) == 16           # memory ring
+        files = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight-")]
+        assert len(files) == 32                          # disk cap
+        # an unwritable dir must not raise into the failure path;
+        # the in-memory ring still records the bundle
+        obs.set_flight_dir("/dev/null/not-a-dir")
+        obs.clear_flight_bundles()
+        obs.record_failure(ValueError("still fine"))
+        assert len(obs.flight_bundles()) == 1
+
+
+# -- chrome trace + schema round-trips ---------------------------------------
+
+
+class TestChromeTrace:
+    def test_span_ring_renders_valid_perfetto(self, live_tracing,
+                                              tmp_path):
+        ctx = obs.mint(tenant="t")
+        with obs.use_context(ctx):
+            with obs.span("outer", x=1):
+                with obs.span("solver.chunk", steps=4):
+                    time.sleep(0.001)
+        path = tmp_path / "trace.json"
+        doc = obs.render_chrome_trace(str(path))
+        assert not schema.validate_chrome_trace(doc)
+        on_disk = json.loads(path.read_text())
+        assert on_disk == json.loads(json.dumps(doc))
+        evs = doc["traceEvents"]
+        x = {e["name"]: e for e in evs if e["ph"] == "X"}
+        assert set(x) == {"outer", "solver.chunk"}
+        # nesting: child wholly inside parent on the same tid
+        assert x["solver.chunk"]["tid"] == x["outer"]["tid"]
+        assert x["solver.chunk"]["ts"] >= x["outer"]["ts"]
+        assert (x["solver.chunk"]["ts"] + x["solver.chunk"]["dur"]
+                <= x["outer"]["ts"] + x["outer"]["dur"] + 1e-3)
+        assert x["solver.chunk"]["args"]["parent"] == "outer"
+        assert x["outer"]["args"]["trace_id"] == ctx.trace_id
+        # *.chunk spans also get the async device lane
+        bs = [e for e in evs if e["ph"] == "b"]
+        es = [e for e in evs if e["ph"] == "e"]
+        assert len(bs) == 1 and len(es) == 1
+        assert bs[0]["cat"] == "device" and bs[0]["id"] == es[0]["id"]
+
+    def test_compiled_driver_chunks_render_async(self, live_tracing):
+        from raft_tpu.runtime import compiled_driver
+        import jax
+        import jax.numpy as jnp
+
+        def step(c):
+            return c + 1.0, jnp.zeros((), jnp.bool_)
+
+        chunk = jax.jit(
+            lambda c, s: compiled_driver.chunk_while(step, c, s))
+        compiled_driver.run_chunked(chunk, jnp.zeros(()), max_steps=8,
+                                    sync_every=4, op="trace.solver")
+        chunk = obs.spans("trace.solver.chunk")
+        assert len(chunk) == 2
+        assert all(s["attrs"]["ran"] == 4 for s in chunk)
+        doc = obs.render_chrome_trace()
+        assert sum(1 for e in doc["traceEvents"] if e["ph"] == "b") == 2
+
+    def test_validator_rejects_garbage(self):
+        assert schema.validate_chrome_trace([])
+        assert schema.validate_chrome_trace({"traceEvents": "nope"})
+        probs = schema.validate_chrome_trace({"traceEvents": [
+            {"name": "a", "ph": "X", "ts": 0, "pid": 1, "tid": 1},
+        ]})
+        assert any("dur" in p for p in probs)
+        probs = schema.validate_chrome_trace({"traceEvents": [
+            {"name": "a", "ph": "b", "ts": 0, "pid": 1, "tid": 1},
+        ]})
+        assert any("id" in p for p in probs)
+
+
+class TestSchemaRoundTrip:
+    def test_ctx_fields_on_span_and_event_records(self, live_tracing,
+                                                  tmp_path):
+        """Every record the sink writes under tracing round-trips
+        through the validator, context fields included."""
+        path = tmp_path / "stream.jsonl"
+        sink = obs.JsonlSink(str(path))
+        old = obs.set_sink(sink)
+        try:
+            with obs.use_context(obs.mint(tenant="rt")):
+                with obs.span("rt.span"):
+                    pass
+                obs.emit_event("rt.event")
+        finally:
+            obs.set_sink(old)
+            sink.close()
+        n_ok, problems = schema.validate_jsonl(str(path))
+        assert not problems, problems
+        assert n_ok == 2
+        recs = [json.loads(line) for line in path.read_text().splitlines()]
+        for rec in recs:
+            assert rec["trace_id"].startswith("t-")
+            assert rec["tenant"] == "rt"
+
+    def test_bad_ctx_fields_rejected(self):
+        base = {"kind": "event", "name": "e", "ts": 1.0, "t": 1.0,
+                "range": None, "range_stack": []}
+        assert not schema.validate_record(base)
+        assert schema.validate_record({**base, "trace_id": ""})
+        assert schema.validate_record({**base, "request_id": 7})
+
+    def test_flight_and_metrics_records(self):
+        flight = {"kind": "flight", "ts": 1.0, "t": 1.0,
+                  "error_type": "ValueError", "error": "boom",
+                  "op": None, "n_spans": 0, "n_events": 2,
+                  "trace_id": "t-x", "request_id": "r-x",
+                  "tenant": "d"}
+        assert not schema.validate_record(flight)
+        assert schema.validate_record({**flight, "error_type": ""})
+        assert schema.validate_record({**flight, "n_spans": -1})
+        assert schema.validate_record({**flight, "n_events": True})
+        metrics = {"kind": "metrics", "ts": 1.0, "t": 1.0, "metrics": {}}
+        assert not schema.validate_record(metrics)
+        assert schema.validate_record({**metrics, "metrics": []})
+
+    def test_bundle_structure_enforced(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        ev = {"kind": "event", "name": "e", "ts": 1.0, "t": 1.0,
+              "range": None, "range_stack": []}
+        p.write_text(json.dumps(ev) + "\n")
+        _, problems = schema.validate_flight_bundle(str(p))
+        assert any("kind='flight'" in q for q in problems)
+        assert any("metrics" in q for q in problems)
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        _, problems = schema.validate_flight_bundle(str(empty))
+        assert any("empty" in q for q in problems)
+
+
+# -- fail-loud env knobs -----------------------------------------------------
+
+
+class TestFailLoudEnv:
+    @staticmethod
+    def _import_obs(env):
+        full = dict(os.environ, JAX_PLATFORMS="cpu", **env)
+        return subprocess.run(
+            [sys.executable, "-c", "import raft_tpu.obs"],
+            env=full, cwd=_REPO, capture_output=True, text=True,
+            timeout=120)
+
+    @pytest.mark.parametrize("env", [
+        {"RAFT_TPU_SPAN_RETAIN": "lots"},
+        {"RAFT_TPU_SPAN_RETAIN": "0"},
+        {"RAFT_TPU_SPAN_RETAIN": "-5"},
+        {"RAFT_TPU_SPAN_SAMPLE": "often"},
+        {"RAFT_TPU_SPAN_SAMPLE": "1.5"},
+        {"RAFT_TPU_SPAN_SAMPLE": "-0.1"},
+    ])
+    def test_malformed_values_fail_import(self, env):
+        res = self._import_obs(env)
+        assert res.returncode != 0
+        name = next(iter(env))
+        assert name in res.stderr       # the error names the knob
+
+    @pytest.mark.parametrize("env", [
+        {"RAFT_TPU_SPAN_RETAIN": "512"},
+        {"RAFT_TPU_SPAN_SAMPLE": "0.25"},
+        {"RAFT_TPU_SPAN_SAMPLE": "0"},
+        {"RAFT_TPU_SPAN_RETAIN": "", "RAFT_TPU_SPAN_SAMPLE": ""},
+    ])
+    def test_valid_values_import(self, env):
+        res = self._import_obs(env)
+        assert res.returncode == 0, res.stderr
