@@ -34,7 +34,11 @@ import jax
 # approximate row without its recall column is not comparable to an
 # exact one), and brute-force baselines re-measured next to it belong
 # to the same era so speedup ratios never mix timing schemes.
-BENCH_ERA = 10
+# Era 11: the neighbors/ivf_mnmg_scaling family lands sharded-serving
+# rows — qps + p99 per rank count plus a recovery-time row — measured
+# through the one-program shard_map path; earlier single-rank IVF rows
+# are not comparable to a sharded row's qps column.
+BENCH_ERA = 11
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

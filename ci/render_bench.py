@@ -42,18 +42,20 @@ def main(path: str) -> None:
         print("(no results)")
         return
     print("| bench | median ms | throughput | recall@k "
-          "| dev/host ms per iter | params |")
-    print("|---|---|---|---|---|---|")
+          "| qps @ ranks | dev/host ms per iter | params |")
+    print("|---|---|---|---|---|---|---|")
     # device_ms_per_iter / host_overhead_ms_per_iter: the era-8
     # compiled-inner-loop split on MULTICHIP solver rows. Rendered as
     # its own column so a collective-overhead claim has to show the
     # split, not a bundled per-iteration number. recall_at_k: the era-9
     # ANN column — an approximate-search row's throughput is
     # meaningless without the recall it was bought at, so the pair
-    # renders side by side (blank for exact rows).
+    # renders side by side (blank for exact rows). serve_qps @ n_ranks:
+    # the era-11 sharded-serving column — a scaling claim has to show
+    # served qps next to the rank count that bought it.
     skip = {"bench", "median_ms", "best_ms", "repeats", "era",
             "device_ms_per_iter", "host_overhead_ms_per_iter",
-            "recall_at_k"}
+            "recall_at_k", "serve_qps"}
     for r in sorted(rows, key=lambda r: r["bench"]):
         thr = ""
         for k, unit in (("GFLOP_per_s", "GFLOP/s"), ("GB_per_s", "GB/s"),
@@ -68,12 +70,16 @@ def main(path: str) -> None:
         recall = ""
         if r.get("recall_at_k") is not None:
             recall = f"{r['recall_at_k']}"
+        qps_ranks = ""
+        if r.get("serve_qps") is not None:
+            qps_ranks = (f"{r['serve_qps']} @ "
+                         f"{r.get('n_ranks', 1)}r")
         params = ", ".join(f"{k}={v}" for k, v in r.items()
                            if k not in skip and f"{k} {v}" not in thr
                            and k not in ("GFLOP_per_s", "GB_per_s",
                                          "items_per_s"))
         print(f"| {r['bench']} | {r['median_ms']} | {thr} | {recall} "
-              f"| {split} | {params} |")
+              f"| {qps_ranks} | {split} | {params} |")
 
 
 if __name__ == "__main__":

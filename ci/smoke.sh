@@ -850,4 +850,131 @@ print(f"obs-overhead gate: {delta * 100:+.2f}% "
       f"(off {off_s * 1e3:.1f} ms, on {on_s * 1e3:.1f} ms)")
 PYEOF
 
+# Sharded-serving gate (ISSUE 11 acceptance): a 2-rank CPU build must
+# answer the full probe bit-identically to the single-rank search (one
+# shard_map program, merge in-graph); every replica executor warms to
+# zero post-warm recompiles; and a kill-a-rank chaos pass through
+# ReplicaGroup.heal() returns the TYPED RecoveryReport — dead ranks,
+# recovery seconds, post-recovery SLO state — with the survivor repack
+# bit-equal to a fresh build and the loadgen's recovery_time_to_slo_s
+# finite after a mid-run kill.
+JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python - <<'PYEOF'
+import numpy as np
+
+import raft_tpu
+from raft_tpu import serve
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors.ivf_mnmg import (build_mnmg, search_mnmg,
+                                         shrink_mnmg)
+from raft_tpu.random import RngState, make_blobs
+from raft_tpu.serve import (BatchPolicy, Executor, IvfMnmgKnnService,
+                            QosPolicy, RecoveryReport, ReplicaGroup,
+                            TenantPolicy, fleet_closed_loop)
+
+from raft_tpu import obs
+
+obs.set_enabled(True)       # SLO burn-rate metering rides the metrics
+res = raft_tpu.device_resources(seed=0)
+X, _, _ = make_blobs(res, RngState(5), 4096, 24, n_clusters=32)
+X = np.asarray(X)
+q = X[:64] + 0.01
+flat = ivf_flat.build(res, X, 32, seed=0)
+
+# full-probe bit-identity: 2-rank sharded == single-rank, ids AND bits
+sd, si = ivf_flat.search(res, flat, q, k=10, nprobe=flat.n_lists)
+idx = build_mnmg(res, X, 32, 2, flat=flat)
+md, mi = search_mnmg(res, idx, q, k=10, nprobe=idx.n_lists)
+np.testing.assert_array_equal(np.asarray(md), np.asarray(sd))
+np.testing.assert_array_equal(np.asarray(mi), np.asarray(si))
+# and the partial probe agrees across rank counts too
+pd1, pi1 = ivf_flat.search(res, flat, q, k=10, nprobe=8)
+pd2, pi2 = search_mnmg(res, idx, q, k=10, nprobe=8)
+np.testing.assert_array_equal(np.asarray(pd2), np.asarray(pd1))
+np.testing.assert_array_equal(np.asarray(pi2), np.asarray(pi1))
+
+
+def make_executor(index):
+    ex = Executor([IvfMnmgKnnService(index, k=10, nprobe=8)],
+                  policy=BatchPolicy(max_batch=32, max_wait_ms=1.0),
+                  qos=QosPolicy({"default": TenantPolicy(
+                      slo_latency_s=5.0)}))
+    ex.warm([8, 32])
+    return ex
+
+
+# three replicas over a 3-rank clique; rank 2 fault-disconnects
+import jax
+from jax.sharding import Mesh
+
+from raft_tpu.comms.comms import MeshComms, _Mailbox
+from raft_tpu.comms.faults import FaultInjector
+
+idx3 = build_mnmg(res, X, 32, 3, flat=flat)
+mesh = Mesh(np.asarray(jax.devices()[:3]), ("data",))
+inj = FaultInjector(seed=0, disconnect=1.0, source_ranks={2})
+comms = MeshComms(mesh, "data", 0, _mailbox=_Mailbox(faults=inj))
+
+repack = {}
+
+
+def on_shrink(new_comms, survivors):
+    repack["idx"] = shrink_mnmg(idx3, survivors)
+    return [make_executor(repack["idx"]) for _ in survivors]
+
+
+replicas = [make_executor(idx3) for _ in range(3)]
+trace_counts = [r.stats.traces for r in replicas]
+group = ReplicaGroup(replicas, comms=comms, on_shrink=on_shrink)
+group.start()
+op3 = f"ivf_mnmg_k10_np8_r3_{idx3.metric}"
+for _ in range(6):
+    group.submit(op3, q[:8]).result(timeout=120)
+# zero post-warm recompiles per replica under routed load
+for r, t0 in zip(replicas, trace_counts):
+    assert r.stats.traces == t0, \
+        f"replica retraced post-warm: {r.stats.traces} != {t0}"
+
+report = group.heal(timeout=5.0)
+assert isinstance(report, RecoveryReport), report
+assert report.dead == (2,) and report.survivors == (0, 1)
+assert report.repacked and report.recovery_s > 0
+assert isinstance(report.slo, dict)     # SLO state rides the report
+fresh = build_mnmg(res, X, 32, 2, flat=flat)
+for a, b in ((repack["idx"].packed_db_sh, fresh.packed_db_sh),
+             (repack["idx"].packed_ids_sh, fresh.packed_ids_sh),
+             (repack["idx"].starts_sh, fresh.starts_sh),
+             (repack["idx"].sizes_sh, fresh.sizes_sh)):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+# survivors answer on the repacked op, bits equal to eager
+op2 = f"ivf_mnmg_k10_np8_r2_{idx3.metric}"
+got = group.submit(op2, q[:8]).result(timeout=120)
+want = search_mnmg(res, repack["idx"], q[:8], k=10, nprobe=8)
+for g, w in zip(got, want):
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+# post-recovery SLO state: survivors answered inside the latency budget
+slo = group.slo_snapshot()
+assert slo["default"]["window_requests"] >= 1
+assert slo["default"]["burn_rate"] == 0.0, slo
+group.stop()
+
+# loadgen recovery clock: kill one of two replicas mid-run, the fleet
+# report's recovery_time_to_slo_s must come back finite
+group2 = ReplicaGroup([make_executor(idx) for _ in range(2)])
+with group2:
+    rep = fleet_closed_loop(group2, f"ivf_mnmg_k10_np8_r2_{idx.metric}",
+                            clients=3, rows=4, duration_s=1.2,
+                            kill_after_s=0.4)
+assert rep.killed is not None
+assert rep.recovery_time_to_slo_s is not None
+assert rep.recovery_time_to_slo_s != float("inf"), \
+    "no post-kill completion met the SLO"
+print(f"sharded-serving gate: 2-rank full probe bit-identical; zero "
+      f"post-warm recompiles across 3 replicas; heal() shrank "
+      f"{report.dead} -> survivors {report.survivors} in "
+      f"{report.recovery_s:.2f}s with repack == fresh build; loadgen "
+      f"recovery_time_to_slo_s={rep.recovery_time_to_slo_s:.3f}s")
+PYEOF
+
 echo "smoke: PASS"

@@ -11,7 +11,13 @@ O(n) scan into probes over a few lists.
 """
 
 from raft_tpu.neighbors import ivf_flat  # noqa: F401
+from raft_tpu.neighbors import ivf_mnmg  # noqa: F401
 from raft_tpu.neighbors.brute_force import knn, knn_mnmg  # noqa: F401
 from raft_tpu.neighbors.ivf_flat import IvfFlatIndex  # noqa: F401
+from raft_tpu.neighbors.ivf_mnmg import (IvfMnmgIndex,  # noqa: F401
+                                         build_mnmg, search_mnmg,
+                                         shrink_mnmg)
 
-__all__ = ["knn", "knn_mnmg", "ivf_flat", "IvfFlatIndex"]
+__all__ = ["knn", "knn_mnmg", "ivf_flat", "IvfFlatIndex",
+           "ivf_mnmg", "IvfMnmgIndex", "build_mnmg", "search_mnmg",
+           "shrink_mnmg"]

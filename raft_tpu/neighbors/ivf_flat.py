@@ -245,13 +245,17 @@ def extend(res, index: IvfFlatIndex, new_rows) -> IvfFlatIndex:
 # ---------------------------------------------------------------------------
 
 
-def _search_body(queries, centroids, packed_db, packed_ids, starts,
-                 sizes, *, k: int, nprobe: int, cap_max: int,
-                 metric: str, use_radix: bool):
-    """The traced probe scan: coarse pairwise -> top-nprobe lists ->
-    one padded span gather -> masked fine distances -> radix / top_k
-    epilogue. Row-independent per query (the serving invariant: a
-    batched launch is bit-identical to per-request launches)."""
+def _probe_topk(queries, centroids, packed_db, packed_ids, starts,
+                sizes, *, k: int, nprobe: int, cap_max: int,
+                metric: str, use_radix: bool):
+    """The probe scan up to (but not including) the metric finalize:
+    coarse pairwise -> top-nprobe lists -> one padded span gather ->
+    masked fine distances -> radix / top_k epilogue. Returns RAW
+    ascending selection keys (smaller = nearer for every metric; +inf =
+    unreachable) plus ids — the mergeable form: the MNMG shard body
+    (:mod:`raft_tpu.neighbors.ivf_mnmg`) pools these keys across ranks
+    and finalizes once after the global merge, so per-rank and
+    single-rank candidates carry identical per-element values."""
     kernel = _METRICS[metric]
     with precision.scope():
         q = queries.astype(jnp.float32)
@@ -292,9 +296,22 @@ def _search_body(queries, centroids, packed_db, packed_ids, starts,
         out_ids = jnp.take_along_axis(ids, pos, axis=1)
         # pad-slot picks (underfull candidate rows) -> id -1, dist +inf
         out_ids = jnp.where(jnp.isfinite(vals), out_ids, -1)
-        from raft_tpu.neighbors.brute_force import _finalize
+        return vals, out_ids
 
-        return _finalize(vals, metric), out_ids
+
+def _search_body(queries, centroids, packed_db, packed_ids, starts,
+                 sizes, *, k: int, nprobe: int, cap_max: int,
+                 metric: str, use_radix: bool):
+    """The traced probe scan (:func:`_probe_topk` + metric finalize).
+    Row-independent per query (the serving invariant: a batched launch
+    is bit-identical to per-request launches)."""
+    from raft_tpu.neighbors.brute_force import _finalize
+
+    vals, out_ids = _probe_topk(
+        queries, centroids, packed_db, packed_ids, starts, sizes, k=k,
+        nprobe=nprobe, cap_max=cap_max, metric=metric,
+        use_radix=use_radix)
+    return _finalize(vals, metric), out_ids
 
 
 _search_jit = functools.partial(

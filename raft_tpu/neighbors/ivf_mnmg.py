@@ -1,0 +1,477 @@
+"""Pod-scale sharded IVF-Flat: the inverted-file index as a distributed
+service primitive (ROADMAP item 1; lineage: cuVS multi-GPU ANN in
+sharded mode, composed from the MNMG comms layer exactly the way
+``knn_mnmg`` shards brute force).
+
+Index layout: :func:`build_mnmg` partitions the packed inverted lists of
+an :class:`~raft_tpu.neighbors.ivf_flat.IvfFlatIndex` across ``n_ranks``
+shards — a deterministic longest-processing-time assignment of whole
+lists by padded capacity (:func:`partition_lists`), so the partition is
+a pure function of (list capacities, rank count). Each rank holds one
+dense ``[cap_rank_max, d]`` packed matrix (its lists repacked
+back-to-back, global slot order preserved within each list) plus full
+``[n_lists]`` CSR mirrors in which non-owned lists have size 0 — the
+``take_rows`` valid mask then erases them for free, and every rank runs
+the *identical* static-shape program. Coarse centroids are replicated.
+
+Query path: :func:`search_mnmg` is ONE compiled ``shard_map`` program —
+the coarse probe replicates per rank (a tiny [q, n_lists] block), each
+rank gathers and scores only its local probed spans via
+:func:`raft_tpu.matrix.take_rows` and selects its local top-k with the
+PR-7 radix / top_k epilogue (:func:`raft_tpu.neighbors.ivf_flat._probe_topk`
+— the same traced body the single-rank search runs, stopped before the
+metric finalize so raw keys stay mergeable), then the per-rank k
+candidates all-gather in-graph and one final select over the
+``[q, n_ranks·k]`` pool produces the replicated answer. No host hop sits
+anywhere in the query path; the query buffer is donated
+(compiled-driver donation pattern — the loadgen's per-launch carry).
+
+Exactness boundary (shared with the single-rank index):
+``nprobe >= n_lists`` delegates to
+:func:`raft_tpu.neighbors.brute_force.knn` on the exactly-reconstructed
+database — the SAME delegation ``ivf_flat.search`` makes, so the
+full-probe setting is bit-identical (ids and distances, ties and NaN
+included) across 1/2/4 ranks, to single-rank search, and to brute
+force, by construction. Partial probes keep per-element distance values
+identical across rank counts (each candidate's fine distance is an
+independent dot product of the same f32 rows in a tile of the same
+static shape); only tie ordering inside the merged pool may differ.
+
+Elasticity: :func:`shrink_mnmg` repacks for the survivor count from the
+host-side flat index — and because :func:`partition_lists` is pure, the
+repacked shards are bit-for-bit the shards a fresh :func:`build_mnmg`
+on that rank count would produce (the chaos gate's equality witness).
+:func:`search_local` / :func:`merge_pool` expose the per-rank half and
+the merge half separately for cross-process serving cliques, where the
+candidate exchange must ride the host mailbox (XLA collectives cannot
+outlive a SIGKILL'd participant; the elastic kmeans made the same
+move).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import warnings
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.core import trace
+from raft_tpu.neighbors.ivf_flat import (IvfFlatIndex, _probe_topk,
+                                         _resolve_metric, _use_radix,
+                                         build as build_flat)
+from raft_tpu.util.precision import with_matmul_precision
+
+__all__ = ["IvfMnmgIndex", "build_mnmg", "search_mnmg", "shrink_mnmg",
+           "partition_lists", "search_local", "merge_pool",
+           "DEFAULT_AXIS"]
+
+#: mesh axis name the sharded index lives on (distinct from the solver
+#: meshes' "data" so a serving mesh can coexist with a compute mesh)
+DEFAULT_AXIS = "shard"
+
+# donating the query buffer on CPU trips XLA's "not usable" warning;
+# same noise-suppression the compiled driver applies (it still donates
+# where the backend supports aliasing)
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+
+def partition_lists(caps, n_ranks: int) -> np.ndarray:
+    """Deterministic list -> rank assignment: longest-processing-time
+    greedy over padded list capacities (largest list first, ties by
+    ascending list id, each placed on the least-loaded rank, ties by
+    lowest rank). A pure function of ``(caps, n_ranks)`` — the property
+    the shrink-equals-fresh-build chaos witness rests on."""
+    caps = np.asarray(caps, np.int64)
+    if n_ranks < 1:
+        raise ValueError(f"need n_ranks >= 1, got {n_ranks}")
+    owner = np.empty(len(caps), np.int32)
+    load = np.zeros(n_ranks, np.int64)
+    for lst in sorted(range(len(caps)), key=lambda i: (-caps[i], i)):
+        r = int(np.argmin(load))              # first occurrence = lowest
+        owner[lst] = r
+        load[r] += caps[lst]
+    return owner
+
+
+@dataclasses.dataclass
+class IvfMnmgIndex:
+    """Sharded IVF-Flat index: one rank-stacked shard set + the host
+    flat index it was carved from.
+
+    ``flat`` stays the source of truth for reconstruction and repack
+    (shrink rebuilds shards from it without touching devices mid-
+    recovery). The stacked arrays are ready for the one-program
+    ``shard_map`` search: leading dim = rank; ``starts``/``sizes`` are
+    LOCAL span tables over the full global list id space, with size 0
+    for lists a rank does not own (the gather's valid mask then masks
+    them to +inf)."""
+
+    flat: IvfFlatIndex
+    owner: np.ndarray               # [n_lists] host int32, list -> rank
+    packed_db_sh: jnp.ndarray       # [R, cap_rank_max, d] original dtype
+    packed_ids_sh: jnp.ndarray      # [R, cap_rank_max] int32, -1 = pad
+    starts_sh: jnp.ndarray          # [R, n_lists] int32 local starts
+    sizes_sh: jnp.ndarray           # [R, n_lists] int32, 0 = not owned
+    cap_rank_max: int               # static per-rank packed rows
+    mesh: Mesh = dataclasses.field(repr=False, compare=False,
+                                   default=None)
+    axis: str = DEFAULT_AXIS
+
+    @property
+    def n_ranks(self) -> int:
+        return int(self.packed_db_sh.shape[0])
+
+    @property
+    def n_lists(self) -> int:
+        return self.flat.n_lists
+
+    @property
+    def dim(self) -> int:
+        return self.flat.dim
+
+    @property
+    def metric(self) -> str:
+        return self.flat.metric
+
+    @property
+    def cap_max(self) -> int:
+        return self.flat.cap_max
+
+    @property
+    def n_db(self) -> int:
+        return self.flat.n_db
+
+    def scanned_fraction(self, nprobe: int) -> float:
+        return self.flat.scanned_fraction(nprobe)
+
+    def reconstruct(self) -> jnp.ndarray:
+        """The database in original row order, bit-exact (delegates to
+        the flat mirror — shard packing never rewrites a row)."""
+        return self.flat.reconstruct()
+
+    def shard(self, rank: int) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                        jnp.ndarray, jnp.ndarray]:
+        """One rank's (packed_db, packed_ids, starts, sizes) — the
+        operand set a cross-process serving rank holds locally."""
+        return (self.packed_db_sh[rank], self.packed_ids_sh[rank],
+                self.starts_sh[rank], self.sizes_sh[rank])
+
+
+def _default_mesh(n_ranks: int, axis: str) -> Mesh:
+    devs = jax.devices()
+    if n_ranks > len(devs):
+        raise ValueError(
+            f"n_ranks={n_ranks} exceeds the {len(devs)} visible devices")
+    return Mesh(np.asarray(devs[:n_ranks]), axis_names=(axis,))
+
+
+def _shard_arrays(flat: IvfFlatIndex, owner: np.ndarray, n_ranks: int):
+    """Carve the flat index's packed arrays into rank-stacked shards on
+    the host (pure numpy — the repack path must not need devices)."""
+    caps = np.asarray(flat.caps, np.int64)
+    gstarts = np.asarray(flat.starts, np.int64)
+    sizes = np.asarray(flat.sizes, np.int64)
+    db_np = np.asarray(flat.packed_db)
+    ids_np = np.asarray(flat.packed_ids)
+    n_lists = flat.n_lists
+    cap_rank = np.asarray([int(caps[owner == r].sum())
+                           for r in range(n_ranks)], np.int64)
+    cap_rank_max = max(int(cap_rank.max(initial=0)), 1)
+    db_sh = np.zeros((n_ranks, cap_rank_max, flat.dim), db_np.dtype)
+    ids_sh = np.full((n_ranks, cap_rank_max), -1, np.int32)
+    starts_sh = np.zeros((n_ranks, n_lists), np.int64)
+    sizes_sh = np.zeros((n_ranks, n_lists), np.int64)
+    for r in range(n_ranks):
+        at = 0
+        for lst in np.flatnonzero(owner == r):
+            c = int(caps[lst])
+            g = int(gstarts[lst])
+            db_sh[r, at:at + c] = db_np[g:g + c]
+            ids_sh[r, at:at + c] = ids_np[g:g + c]
+            starts_sh[r, lst] = at
+            sizes_sh[r, lst] = sizes[lst]
+            at += c
+    return db_sh, ids_sh, starts_sh, sizes_sh, cap_rank_max
+
+
+def _from_flat(flat: IvfFlatIndex, n_ranks: int, *,
+               mesh: Optional[Mesh] = None,
+               axis: str = DEFAULT_AXIS) -> IvfMnmgIndex:
+    """Shared build/repack entry: partition + carve + place. Called by
+    both :func:`build_mnmg` and :func:`shrink_mnmg`, so a post-shrink
+    index IS a fresh build on the survivor count."""
+    if mesh is None:
+        mesh = _default_mesh(n_ranks, axis)
+    elif mesh.shape[axis] != n_ranks:
+        raise ValueError(f"mesh axis {axis!r} has {mesh.shape[axis]} "
+                         f"devices, need n_ranks={n_ranks}")
+    owner = partition_lists(flat.caps, n_ranks)
+    db_sh, ids_sh, starts_sh, sizes_sh, cap_rank_max = _shard_arrays(
+        flat, owner, n_ranks)
+    sharded = NamedSharding(mesh, P(axis))
+    return IvfMnmgIndex(
+        flat=flat, owner=owner,
+        packed_db_sh=jax.device_put(db_sh, sharded),
+        packed_ids_sh=jax.device_put(ids_sh, sharded),
+        starts_sh=jax.device_put(starts_sh.astype(np.int32), sharded),
+        sizes_sh=jax.device_put(sizes_sh.astype(np.int32), sharded),
+        cap_rank_max=cap_rank_max, mesh=mesh, axis=axis)
+
+
+def build_mnmg(res, db, n_lists: int, n_ranks: int,
+               metric: str = "l2", *, mesh: Optional[Mesh] = None,
+               axis: str = DEFAULT_AXIS, max_iter: int = 25,
+               seed: int = 0, centroids=None,
+               flat: Optional[IvfFlatIndex] = None) -> IvfMnmgIndex:
+    """Train (or adopt) a flat IVF index and partition its inverted
+    lists across ``n_ranks`` shards.
+
+    Pass ``flat`` to shard an already-built
+    :class:`~raft_tpu.neighbors.ivf_flat.IvfFlatIndex` without
+    retraining (the serving tier's repack path); otherwise the coarse
+    quantizer trains exactly as :func:`raft_tpu.neighbors.ivf_flat.build`
+    does. The partition is deterministic, so two builds from the same
+    flat index at the same rank count produce bit-identical shards.
+    """
+    if flat is None:
+        flat = build_flat(res, db, n_lists, metric, max_iter=max_iter,
+                          seed=seed, centroids=centroids)
+    else:
+        _resolve_metric(flat.metric)
+    return _from_flat(flat, n_ranks, mesh=mesh, axis=axis)
+
+
+def shrink_mnmg(index: IvfMnmgIndex, survivors: Sequence[int], *,
+                mesh: Optional[Mesh] = None) -> IvfMnmgIndex:
+    """Repack for the survivor set after a rank death: rebuild the
+    shard partition from the host flat mirror at the new rank count.
+    Bit-for-bit equal to ``build_mnmg(flat=index.flat,
+    n_ranks=len(survivors))`` — :func:`partition_lists` is a pure
+    function of (caps, n_ranks), which is what lets the chaos gate
+    compare a survivor repack against a fresh build."""
+    n_ranks = len(set(int(r) for r in survivors))
+    if n_ranks < 1:
+        raise ValueError("need at least one survivor")
+    return _from_flat(index.flat, n_ranks, mesh=mesh, axis=index.axis)
+
+
+# ---------------------------------------------------------------------------
+# search: one shard_map program
+# ---------------------------------------------------------------------------
+
+
+def _merge_body(pool_v, pool_i, *, k: int, metric: str,
+                use_radix: bool):
+    """Final select over the all-gathered [q, R·k] raw-key pool + the
+    single metric finalize (the PR-7 epilogue applied once, globally)."""
+    from raft_tpu.neighbors.brute_force import _finalize
+
+    if use_radix:
+        from raft_tpu.matrix.radix_select import radix_select_k
+
+        vals, pos = radix_select_k(pool_v, k)
+    else:
+        neg, pos = lax.top_k(-pool_v, k)
+        vals = -neg
+    out_ids = jnp.take_along_axis(pool_i, pos, axis=1)
+    out_ids = jnp.where(jnp.isfinite(vals), out_ids, -1)
+    return _finalize(vals, metric), out_ids
+
+
+@functools.lru_cache(maxsize=None)
+def _mnmg_searcher(mesh: Mesh, axis: str, n_ranks: int, k: int,
+                   nprobe: int, cap_max: int, metric: str,
+                   use_radix: bool, use_radix_merge: bool):
+    """Compiled sharded search program for one (mesh, config): per-rank
+    probe scan inside ``shard_map``, in-graph all-gather of the k
+    candidates per rank (XLA inserts the collective for the replicated
+    merge — same idiom as ``knn_mnmg``), one global select, one
+    finalize. The query buffer is donated: searches stream through the
+    serving loop and the previous launch's queries are dead weight."""
+
+    def shard_fn(db_s, ids_s, st_s, sz_s, q, c):
+        vals, ids = _probe_topk(
+            q, c, db_s[0], ids_s[0], st_s[0], sz_s[0], k=k,
+            nprobe=nprobe, cap_max=cap_max, metric=metric,
+            use_radix=use_radix)
+        return vals[None], ids[None]              # [1, q, k] per rank
+
+    def body(queries, centroids, db_sh, ids_sh, starts_sh, sizes_sh):
+        av, ai = jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
+            out_specs=(P(axis), P(axis)))(
+                db_sh, ids_sh, starts_sh, sizes_sh, queries, centroids)
+        pool_v = jnp.moveaxis(av, 0, 1).reshape(
+            queries.shape[0], n_ranks * k)
+        pool_i = jnp.moveaxis(ai, 0, 1).reshape(
+            queries.shape[0], n_ranks * k)
+        return _merge_body(pool_v, pool_i, k=k, metric=metric,
+                           use_radix=use_radix_merge)
+
+    return jax.jit(body, donate_argnums=(0,))
+
+
+def _radix_flags(index: IvfMnmgIndex, k: int, nprobe: int, *arrays):
+    """(local, merge) radix gating, through the same predicate the
+    single-rank search uses — local select over the nprobe·cap_max tile,
+    merge select over the n_ranks·k pool. The local select runs INSIDE
+    the shard_map body, whose operands always carry vma — which the
+    Pallas interpreter cannot replay — so interpret mode gates it off
+    directly (same move ``knn_mnmg`` makes for its fused shard kernel);
+    the merge runs outside the shard body and needs no such gate."""
+    from raft_tpu.util.pallas_utils import use_interpret
+
+    return (not use_interpret()
+            and _use_radix(nprobe * index.cap_max, k, *arrays),
+            _use_radix(index.n_ranks * k, k, *arrays))
+
+
+@with_matmul_precision
+def search_mnmg(res, index: IvfMnmgIndex, queries, k: int, nprobe: int
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k nearest database rows per query over the sharded index:
+    replicated (distances [q, k], indices [q, k]) in GLOBAL database
+    row numbering, nearest first, pad id -1 / distance +inf exactly as
+    :func:`raft_tpu.neighbors.ivf_flat.search`.
+
+    ``nprobe >= n_lists`` delegates to brute force on the reconstructed
+    database — the shared exactness boundary, bit-identical to the
+    single-rank full probe at every rank count (the CI-gated claim).
+    Partial probes run the one-program ``shard_map`` path: no host hop,
+    donated query carry, per-element distance values identical across
+    rank counts.
+
+    Admission (PR-5 contract): with a ``runtime.limits`` budget active,
+    an over-budget launch degrades to query-row chunks (rows are
+    independent — bits identical) or raises the typed rejection.
+    """
+    from raft_tpu.runtime import limits
+
+    queries = jnp.asarray(queries)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries must be [q, {index.dim}], got "
+                         f"{queries.shape}")
+    if not 0 < k <= index.n_db:
+        raise ValueError(f"need 0 < k <= n_db, got k={k}, "
+                         f"n_db={index.n_db}")
+    if nprobe <= 0:
+        raise ValueError(f"need nprobe > 0, got {nprobe}")
+    metric = index.metric
+    if nprobe >= index.n_lists:
+        from raft_tpu.neighbors.brute_force import knn
+
+        trace.record_event("ivf_mnmg.search", nprobe=index.n_lists,
+                           n_lists=index.n_lists, k=k,
+                           n_ranks=index.n_ranks, scanned_frac=1.0,
+                           path="exact")
+        return knn(res, index.reconstruct(), queries, k, metric=metric)
+    probe_rows = nprobe * index.cap_max
+    if probe_rows < k:
+        raise ValueError(
+            f"nprobe={nprobe} reaches at most {probe_rows} candidates "
+            f"< k={k}; raise nprobe (>= n_lists scans exactly)")
+    trace.record_event("ivf_mnmg.search", nprobe=nprobe,
+                       n_lists=index.n_lists, k=k,
+                       n_ranks=index.n_ranks,
+                       scanned_frac=round(
+                           index.scanned_fraction(nprobe), 4),
+                       path="ivf_mnmg")
+    use_radix, use_radix_merge = _radix_flags(
+        index, k, nprobe, index.packed_db_sh, queries)
+    run = _mnmg_searcher(index.mesh, index.axis, index.n_ranks, k,
+                         nprobe, index.cap_max, metric, use_radix,
+                         use_radix_merge)
+    fixed = (index.flat.centroids, index.packed_db_sh,
+             index.packed_ids_sh, index.starts_sh, index.sizes_sh)
+
+    def launch(qrows):
+        # a fresh replicated buffer per launch: the donated carry must
+        # be owned by this call, never an alias of the caller's array
+        qbuf = jax.device_put(
+            jnp.array(qrows),
+            NamedSharding(index.mesh, P()))
+        return run(qbuf, *fixed)
+
+    budget = limits.active_budget()
+    if budget is not None:
+        op = "neighbors.ivf_mnmg_search"
+        qn = int(queries.shape[0])
+        itemsize = index.packed_db_sh.dtype.itemsize
+        est = limits.estimate_bytes(
+            op, n_queries=qn, probe_rows=probe_rows, n_dims=index.dim,
+            k=k, n_ranks=index.n_ranks, itemsize=itemsize,
+            packed_rows=index.cap_rank_max)
+        if not limits.admit(op, est, budget=budget):
+            fixed_bytes = (index.cap_rank_max * index.dim * itemsize
+                           + index.cap_rank_max * 4)
+            per_row = limits.estimate_bytes(
+                op, n_queries=1, probe_rows=probe_rows,
+                n_dims=index.dim, k=k, n_ranks=index.n_ranks,
+                itemsize=itemsize)
+            chunk = (budget.limit_bytes - fixed_bytes) // max(per_row, 1)
+            if chunk < 1:
+                limits.reject(op, est, budget=budget,
+                              detail="even a single query row's "
+                                     "per-rank candidate tile overflows "
+                                     "the budget")
+            limits.record_degraded(op)
+            outs = [launch(queries[i:i + int(chunk)])
+                    for i in range(0, qn, int(chunk))]
+            return (jnp.concatenate([o[0] for o in outs], axis=0),
+                    jnp.concatenate([o[1] for o in outs], axis=0))
+    return launch(queries)
+
+
+# ---------------------------------------------------------------------------
+# split halves for cross-process serving cliques
+# ---------------------------------------------------------------------------
+
+_local_jit = functools.partial(
+    jax.jit, static_argnames=("k", "nprobe", "cap_max", "metric",
+                              "use_radix"))(_probe_topk)
+
+_merge_jit = functools.partial(
+    jax.jit, static_argnames=("k", "metric", "use_radix"))(_merge_body)
+
+
+def search_local(index: IvfMnmgIndex, rank: int, queries, k: int,
+                 nprobe: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One rank's half of the sharded search: raw ascending selection
+    keys [q, k] + global ids [q, k] from this rank's shard only
+    (+inf / -1 where the rank owns fewer than k reachable candidates).
+    A cross-process serving clique runs this per rank, exchanges the
+    (keys, ids) pool over the host mailbox — the transport that
+    survives a SIGKILL'd peer, unlike an XLA collective — and merges
+    with :func:`merge_pool`. The numerics are the SAME traced body the
+    one-program ``shard_map`` path runs per rank."""
+    db_s, ids_s, st_s, sz_s = index.shard(rank)
+    use_radix = _use_radix(nprobe * index.cap_max, k, db_s, queries)
+    return _local_jit(jnp.asarray(queries), index.flat.centroids,
+                      db_s, ids_s, st_s, sz_s, k=k, nprobe=nprobe,
+                      cap_max=index.cap_max, metric=index.metric,
+                      use_radix=use_radix)
+
+
+def merge_pool(vals_stack, ids_stack, k: int, metric: str
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge rank-stacked candidate pools ``[R, q, k]`` (raw keys from
+    :func:`search_local`, rank-major order) into the final replicated
+    (distances, ids) — the same global select + single finalize the
+    in-graph merge performs, so in-process and cross-process serving
+    agree bit-for-bit for a given rank order."""
+    vals_stack = jnp.asarray(vals_stack)
+    ids_stack = jnp.asarray(ids_stack)
+    r, q, kk = vals_stack.shape
+    pool_v = jnp.moveaxis(vals_stack, 0, 1).reshape(q, r * kk)
+    pool_i = jnp.moveaxis(ids_stack, 0, 1).reshape(q, r * kk)
+    use_radix = _use_radix(r * kk, k)
+    return _merge_jit(pool_v, pool_i, k=k, metric=metric,
+                      use_radix=use_radix)

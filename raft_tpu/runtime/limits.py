@@ -427,6 +427,21 @@ def _est_ivf_search(*, n_queries, probe_rows, n_dims, k, itemsize,
             + n_queries * k * (dist_itemsize + 4))
 
 
+def _est_ivf_mnmg_search(*, n_queries, probe_rows, n_dims, k, n_ranks,
+                         itemsize, packed_rows=0, dist_itemsize=4):
+    # the sharded search is SPMD: each device holds its own packed shard
+    # (packed_rows = per-rank rows) and runs the same static-shape probe
+    # scan as the single-rank path, plus the replicated all-gathered
+    # [q, n_ranks*k] merge pool and the final top-k outputs — the
+    # estimate bounds ONE device's footprint, which is what admission
+    # protects
+    return ((packed_rows * n_dims + n_queries * n_dims) * itemsize
+            + n_queries * probe_rows
+            * (n_dims * itemsize + dist_itemsize + 4 + 1)
+            + n_queries * n_ranks * k * (dist_itemsize + 4)
+            + n_queries * k * (dist_itemsize + 4))
+
+
 def _est_gemm(*, m, n, k, itemsize, out_itemsize=None):
     out_itemsize = itemsize if out_itemsize is None else out_itemsize
     return (m * k + k * n) * itemsize + m * n * out_itemsize
@@ -441,6 +456,7 @@ _ESTIMATORS = {
     "distance.pairwise_distance": _est_pairwise,
     "neighbors.brute_force_knn": _est_knn,
     "neighbors.ivf_search": _est_ivf_search,
+    "neighbors.ivf_mnmg_search": _est_ivf_mnmg_search,
     "linalg.gemm": _est_gemm,
     "sparse.spmv": _est_spmv,
 }
@@ -453,6 +469,8 @@ def estimate_bytes(op: str, **dims) -> int:
     ``neighbors.brute_force_knn(n_queries, n_db, n_dims, k, itemsize)``,
     ``neighbors.ivf_search(n_queries, probe_rows, n_dims, k, itemsize[,
     packed_rows])``,
+    ``neighbors.ivf_mnmg_search(n_queries, probe_rows, n_dims, k,
+    n_ranks, itemsize[, packed_rows])``,
     ``linalg.gemm(m, n, k, itemsize[, out_itemsize])``,
     ``sparse.spmv(n_rows, n_cols, nnz, itemsize[, index_itemsize])``."""
     try:

@@ -25,11 +25,15 @@ Quickstart::
 """
 
 from raft_tpu.serve.executor import (Executor, ExecutorStats,
-                                     IvfKnnService, KnnService,
-                                     KMeansPredictService,
+                                     IvfKnnService, IvfMnmgKnnService,
+                                     KnnService, KMeansPredictService,
                                      PairwiseService, Service)
-from raft_tpu.serve.loadgen import LoadReport, closed_loop, open_loop
+from raft_tpu.serve.loadgen import (FleetReport, LoadReport,
+                                    closed_loop, fleet_closed_loop,
+                                    open_loop)
 from raft_tpu.serve.qos import QosPolicy, TenantPolicy
+from raft_tpu.serve.replica import (RecoveryReport, Replica,
+                                    ReplicaGroup, ReplicaGroupStats)
 from raft_tpu.serve.queue import (BUCKET_FLOOR, Batch, BatchPolicy,
                                   Request, RequestQueue, ResultFuture,
                                   bucket_ladder, bucket_rows)
@@ -38,7 +42,10 @@ __all__ = [
     "BUCKET_FLOOR", "bucket_rows", "bucket_ladder",
     "Request", "ResultFuture", "Batch", "BatchPolicy", "RequestQueue",
     "TenantPolicy", "QosPolicy",
-    "Service", "KnnService", "IvfKnnService", "PairwiseService",
-    "KMeansPredictService", "Executor", "ExecutorStats",
-    "LoadReport", "closed_loop", "open_loop",
+    "Service", "KnnService", "IvfKnnService", "IvfMnmgKnnService",
+    "PairwiseService", "KMeansPredictService", "Executor",
+    "ExecutorStats",
+    "Replica", "ReplicaGroup", "ReplicaGroupStats", "RecoveryReport",
+    "LoadReport", "FleetReport", "closed_loop", "open_loop",
+    "fleet_closed_loop",
 ]

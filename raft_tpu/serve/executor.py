@@ -54,8 +54,9 @@ from raft_tpu.serve.queue import (Batch, BatchPolicy, Request,
                                   bucket_rows)
 
 __all__ = [
-    "Service", "KnnService", "IvfKnnService", "PairwiseService",
-    "KMeansPredictService", "Executor", "ExecutorStats",
+    "Service", "KnnService", "IvfKnnService", "IvfMnmgKnnService",
+    "PairwiseService", "KMeansPredictService", "Executor",
+    "ExecutorStats",
 ]
 
 
@@ -236,6 +237,105 @@ class IvfKnnService(Service):
         (n_lists, nprobe), the same predicate the brute-force services
         quote, so the warm-path report and the compiled dispatch share
         one source of truth."""
+        from raft_tpu.neighbors.brute_force import knn_plan
+
+        path, _ = knn_plan(1, self.index.n_db, self.k,
+                           metric=self.index.metric,
+                           n_lists=self.index.n_lists,
+                           nprobe=self.nprobe)
+        return path
+
+
+class IvfMnmgKnnService(Service):
+    """Batched sharded IVF-Flat kNN against a fixed
+    :class:`~raft_tpu.neighbors.ivf_mnmg.IvfMnmgIndex`
+    (:func:`raft_tpu.neighbors.ivf_mnmg.search_mnmg`'s one-program
+    ``shard_map`` path as the traced body — coarse probe replicated,
+    per-rank gather/score/select, in-graph candidate all-gather, global
+    merge). Per-request result: ``(distances [rows, k], indices
+    [rows, k])`` in global database row numbering; row independence
+    holds exactly as for the single-rank service, so the batched launch
+    is bit-identical to per-request searches.
+
+    Full scans (nprobe >= n_lists) delegate to brute force by
+    definition — serve those via :class:`KnnService` on
+    ``index.reconstruct()``; this service rejects the degenerate
+    setting just like :class:`IvfKnnService`."""
+
+    def __init__(self, index, k: int, nprobe: int):
+        super().__init__((index.flat.centroids, index.packed_db_sh,
+                          index.packed_ids_sh, index.starts_sh,
+                          index.sizes_sh),
+                         dim=index.dim, dtype=index.packed_db_sh.dtype)
+        if not 0 < nprobe < index.n_lists:
+            raise ValueError(
+                f"IvfMnmgKnnService needs 0 < nprobe < n_lists "
+                f"(got nprobe={nprobe}, n_lists={index.n_lists}); "
+                f"nprobe >= n_lists is a full scan — use KnnService on "
+                f"index.reconstruct()")
+        self.index = index
+        self.k = int(k)
+        self.nprobe = int(nprobe)
+        self.name = (f"ivf_mnmg_k{k}_np{nprobe}_r{index.n_ranks}"
+                     f"_{index.metric}")
+
+    def _build(self):
+        from jax.sharding import PartitionSpec as P
+
+        from raft_tpu.neighbors.ivf_flat import _probe_topk
+        from raft_tpu.neighbors.ivf_mnmg import _merge_body, _radix_flags
+
+        idx = self.index
+        k, nprobe = self.k, self.nprobe
+        cap_max, metric = idx.cap_max, idx.metric
+        mesh, axis, n_ranks = idx.mesh, idx.axis, idx.n_ranks
+        use_radix, use_radix_merge = _radix_flags(
+            idx, k, nprobe, self.fixed_args[1])
+
+        def shard_fn(db_s, ids_s, st_s, sz_s, q, c):
+            vals, ids = _probe_topk(
+                q, c, db_s[0], ids_s[0], st_s[0], sz_s[0], k=k,
+                nprobe=nprobe, cap_max=cap_max, metric=metric,
+                use_radix=use_radix)
+            return vals[None], ids[None]
+
+        def fn(centroids, db_sh, ids_sh, starts_sh, sizes_sh, q):
+            av, ai = jax.shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
+                out_specs=(P(axis), P(axis)))(
+                    db_sh, ids_sh, starts_sh, sizes_sh, q, centroids)
+            pool_v = jnp.moveaxis(av, 0, 1).reshape(
+                q.shape[0], n_ranks * k)
+            pool_i = jnp.moveaxis(ai, 0, 1).reshape(
+                q.shape[0], n_ranks * k)
+            return _merge_body(pool_v, pool_i, k=k, metric=metric,
+                               use_radix=use_radix_merge)
+        return fn
+
+    def unpack(self, out, start, rows):
+        d, i = out
+        return d[start:start + rows], i[start:start + rows]
+
+    def estimate_bytes(self, rows):
+        return limits.estimate_bytes(
+            "neighbors.ivf_mnmg_search", n_queries=rows,
+            probe_rows=self.nprobe * self.index.cap_max,
+            n_dims=self.dim, k=self.k, n_ranks=self.index.n_ranks,
+            itemsize=self.dtype.itemsize,
+            packed_rows=self.index.cap_rank_max)
+
+    def eager(self, queries):
+        from raft_tpu.neighbors import ivf_mnmg
+
+        return ivf_mnmg.search_mnmg(None, self.index,
+                                    jnp.asarray(queries), self.k,
+                                    self.nprobe)
+
+    def epilogue(self) -> str:
+        """"ivf" — quoted from :func:`knn_plan` with this service's
+        (n_lists, nprobe), same source of truth as the single-rank
+        services."""
         from raft_tpu.neighbors.brute_force import knn_plan
 
         path, _ = knn_plan(1, self.index.n_db, self.k,

@@ -30,7 +30,8 @@ import numpy as np
 
 from raft_tpu.runtime import limits
 
-__all__ = ["LoadReport", "closed_loop", "open_loop"]
+__all__ = ["LoadReport", "FleetReport", "closed_loop", "open_loop",
+           "fleet_closed_loop"]
 
 
 @dataclass
@@ -199,6 +200,197 @@ def closed_loop(executor, op: str, *, clients: int = 8,
     return _finalize(report, executor, before, t0)
 
 
+@dataclass
+class FleetReport:
+    """One replica-fleet load run: per-replica rows plus the merged
+    fleet row (ISSUE 11 loadgen satellite)."""
+
+    per_replica: Dict[str, LoadReport] = field(default_factory=dict)
+    fleet: Optional[LoadReport] = None
+    routed: int = 0                     # router counters for the run
+    spills: int = 0
+    router_rejected: int = 0
+    killed: Optional[str] = None        # replica killed mid-run, if any
+    kill_at_s: Optional[float] = None   # offset from run start
+    # seconds from the kill to the first subsequent completion meeting
+    # the tenant's SLO latency (any completion when no SLO is set);
+    # None when nothing was killed, +inf when nothing recovered
+    recovery_time_to_slo_s: Optional[float] = None
+
+    def as_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "mode": "fleet_closed",
+            "replicas": {name: r.as_dict()
+                         for name, r in self.per_replica.items()},
+            "fleet": self.fleet.as_dict() if self.fleet else None,
+            "routed": self.routed,
+            "spills": self.spills,
+            "router_rejected": self.router_rejected,
+        }
+        if self.killed is not None:
+            out["killed"] = self.killed
+            out["kill_at_s"] = round(self.kill_at_s, 3)
+            out["recovery_time_to_slo_s"] = (
+                round(self.recovery_time_to_slo_s, 4)
+                if self.recovery_time_to_slo_s is not None else None)
+        return out
+
+
+def _slo_latency_s(group, tenant: str) -> Optional[float]:
+    """The tenant's SLO latency from the first replica carrying a QoS
+    policy (replicas share one policy table by construction)."""
+    for r in group.replicas:
+        qos = getattr(r.executor, "qos", None)
+        if qos is not None:
+            try:
+                return qos.policy(tenant).slo_latency_s
+            except Exception:
+                return None
+    return None
+
+
+def fleet_closed_loop(group, op: str, *, clients: int = 8,
+                      rows: int = 4, duration_s: float = 2.0,
+                      tenants: Optional[Sequence[str]] = None,
+                      deadline_s: Optional[float] = None,
+                      seed: int = 0, wait_s: float = 30.0,
+                      kill_after_s: Optional[float] = None,
+                      kill=None) -> FleetReport:
+    """Closed-loop load against a :class:`~raft_tpu.serve.ReplicaGroup`.
+
+    Routes every submit through the group's weighted-fair router and
+    attributes each completion to the replica that served it, so the
+    report carries one p50/p99/qps row per replica plus the merged
+    fleet row. With ``kill_after_s`` set, a killer thread fires ``kill``
+    (default: :meth:`ReplicaGroup.fail_replica` on the last healthy
+    replica) mid-run and the report's ``recovery_time_to_slo_s`` is the
+    time from the kill to the first subsequent completion meeting the
+    tenant's SLO latency — the serving-side recovery witness the chaos
+    gate asserts on."""
+    tenants = list(tenants) if tenants else ["default"]
+    svc = None
+    for r in group.healthy():
+        try:
+            svc = r.executor._service(op)
+            break
+        except KeyError:
+            continue
+    if svc is None:
+        raise KeyError(f"no healthy replica serves op {op!r}")
+    slo_s = _slo_latency_s(group, tenants[0])
+
+    fleet = FleetReport()
+    per_rep: Dict[str, LoadReport] = {
+        r.name: LoadReport(mode="fleet_closed", duration_s=0.0)
+        for r in group.replicas}
+    merged = LoadReport(mode="fleet_closed", duration_s=0.0)
+    lock = threading.Lock()
+    stop = threading.Event()
+    # (t_kill, recovery) shared with the record path
+    kill_state: Dict[str, Optional[float]] = {"t_kill": None,
+                                              "recovery": None}
+    snaps = {r.name: (r, _snapshot(r.executor)) for r in group.replicas}
+    routed0, spills0, rej0 = (group.stats.routed, group.stats.spills,
+                              group.stats.rejected)
+    t0 = time.monotonic()
+
+    def record(rep_name: str, t_submit: float, fut) -> None:
+        try:
+            fut.result(timeout=wait_s)
+            ok, kind = True, None
+        except limits.RejectedError:
+            ok, kind = False, "rejected"
+        except limits.DeadlineExceededError:
+            ok, kind = False, "deadline"
+        except TimeoutError:
+            ok, kind = False, None
+        t_done = time.monotonic()
+        lat_ms = (t_done - t_submit) * 1e3
+        with lock:
+            reports = [merged]
+            if rep_name in per_rep:
+                reports.append(per_rep[rep_name])
+            for rep in reports:
+                if ok:
+                    rep.completed += 1
+                    rep.rows += rows
+                    rep.latencies_ms.append(lat_ms)
+                elif kind == "rejected":
+                    rep.rejected += 1
+                elif kind == "deadline":
+                    rep.deadline_failed += 1
+            t_kill = kill_state["t_kill"]
+            if (ok and t_kill is not None
+                    and kill_state["recovery"] is None
+                    and t_submit >= t_kill
+                    and (slo_s is None or lat_ms * 1e-3 <= slo_s)):
+                kill_state["recovery"] = t_done - t_kill
+
+    def client(i: int) -> None:
+        rng = np.random.default_rng(seed + i)
+        tenant = tenants[i % len(tenants)]
+        while not stop.is_set():
+            q = rng.standard_normal((rows, svc.dim)).astype(svc.dtype)
+            t_submit = time.monotonic()
+            try:
+                replica, fut = group.route(op, q, tenant=tenant,
+                                           deadline_s=deadline_s)
+            except limits.RejectedError:
+                with lock:
+                    merged.rejected += 1
+                time.sleep(0.001)
+                continue
+            record(replica.name, t_submit, fut)
+
+    def killer() -> None:
+        if stop.wait(kill_after_s):
+            return                      # run ended before the kill
+        live = group.healthy()
+        if not live:
+            return
+        target = live[-1]
+        with lock:
+            fleet.killed = target.name
+            kill_state["t_kill"] = time.monotonic()
+            fleet.kill_at_s = kill_state["t_kill"] - t0
+        if kill is not None:
+            kill(target)
+        else:
+            group.fail_replica(target, "loadgen kill")
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    if kill_after_s is not None:
+        threads.append(threading.Thread(target=killer, daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=wait_s)
+
+    for name, (replica, before) in snaps.items():
+        _finalize(per_rep[name], replica.executor, before, t0)
+    merged.duration_s = time.monotonic() - t0
+    merged.batches = sum(r.batches for r in per_rep.values())
+    tot_rows = sum(r.coalescing_factor * r.batches
+                   for r in per_rep.values())
+    merged.coalescing_factor = (tot_rows / merged.batches
+                                if merged.batches else 0.0)
+    merged.slo = (group.slo_snapshot()
+                  if hasattr(group, "slo_snapshot") else {})
+    fleet.per_replica = per_rep
+    fleet.fleet = merged
+    fleet.routed = group.stats.routed - routed0
+    fleet.spills = group.stats.spills - spills0
+    fleet.router_rejected = group.stats.rejected - rej0
+    if fleet.killed is not None:
+        fleet.recovery_time_to_slo_s = (
+            kill_state["recovery"] if kill_state["recovery"] is not None
+            else float("inf"))
+    return fleet
+
+
 def open_loop(executor, op: str, *, rate_qps: float = 200.0,
               rows: int = 4, duration_s: float = 2.0,
               tenants: Optional[Sequence[str]] = None,
@@ -250,3 +442,84 @@ def open_loop(executor, op: str, *, rate_qps: float = 200.0,
     for c in collectors:
         c.join(timeout=wait_s)
     return _finalize(report, executor, before, t0)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python -m raft_tpu.serve.loadgen`` — run the generator against
+    a synthetic kNN fleet and print the report as JSON.
+
+    ``--replicas N`` spins up N warmed replicas behind a
+    :class:`~raft_tpu.serve.ReplicaGroup` and runs the fleet closed
+    loop (per-replica rows + merged row); ``--kill-after S`` kills one
+    replica mid-run and reports ``recovery_time_to_slo_s``."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(prog="raft_tpu.serve.loadgen")
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--rows", type=int, default=4)
+    p.add_argument("--duration", type=float, default=2.0)
+    p.add_argument("--mode", choices=("closed", "open"),
+                   default="closed")
+    p.add_argument("--rate-qps", type=float, default=200.0)
+    p.add_argument("--n-db", type=int, default=4096)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--k", type=int, default=10)
+    p.add_argument("--metric", default="l2")
+    p.add_argument("--deadline", type=float, default=None)
+    p.add_argument("--slo-ms", type=float, default=None,
+                   help="default-tenant SLO latency (arms burn-rate "
+                        "metering and the recovery-to-SLO clock)")
+    p.add_argument("--kill-after", type=float, default=None,
+                   help="kill one replica this many seconds into the "
+                        "run (needs --replicas >= 2)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.kill_after is not None and args.replicas < 2:
+        p.error("--kill-after needs --replicas >= 2")
+
+    from raft_tpu.serve import (BatchPolicy, Executor, KnnService,
+                                QosPolicy, ReplicaGroup, TenantPolicy)
+    from raft_tpu.serve.queue import bucket_ladder
+
+    rng = np.random.default_rng(args.seed)
+    db = rng.standard_normal((args.n_db, args.dim)).astype(np.float32)
+    op = f"knn_k{args.k}_{args.metric}"
+
+    def make_executor():
+        qos = None
+        if args.slo_ms is not None:
+            qos = QosPolicy({"default": TenantPolicy(
+                slo_latency_s=args.slo_ms * 1e-3)})
+        ex = Executor([KnnService(db, k=args.k, metric=args.metric)],
+                      policy=BatchPolicy(max_batch=256, max_wait_ms=2.0),
+                      qos=qos)
+        ex.warm(bucket_ladder(256))
+        return ex
+
+    common = dict(clients=args.clients, rows=args.rows,
+                  duration_s=args.duration, deadline_s=args.deadline,
+                  seed=args.seed)
+    if args.replicas > 1:
+        group = ReplicaGroup([make_executor()
+                              for _ in range(args.replicas)])
+        with group:
+            report = fleet_closed_loop(group, op,
+                                       kill_after_s=args.kill_after,
+                                       **common)
+    else:
+        ex = make_executor()
+        with ex:
+            if args.mode == "open":
+                common.pop("clients")
+                report = open_loop(ex, op, rate_qps=args.rate_qps,
+                                   **common)
+            else:
+                report = closed_loop(ex, op, **common)
+    print(json.dumps(report.as_dict(), indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

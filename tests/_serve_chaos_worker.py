@@ -1,0 +1,153 @@
+"""Worker for the multiprocess sharded-serving chaos test (ISSUE 11
+acceptance: a rank SIGKILL'd mid-query-stream leaves the survivors
+answering, with the repacked index bit-equal to a fresh build on the
+survivor count).
+
+Each worker is one serving rank of a cross-process clique: it builds
+the SAME flat IVF index deterministically, holds its shard of the
+rank-count partition, and per query iteration runs ``search_local``,
+exchanges the raw (keys, ids) candidate pools all-to-all over a
+TcpMailbox — the transport that outlives a SIGKILL'd peer, unlike an
+XLA collective — and merges with ``merge_pool``. Fast heartbeats keep
+the detect → abort → consensus → shrink → repack round-trip inside the
+test budget.
+
+Usage: python _serve_chaos_worker.py <rank> <mode> <addr0> <addr1> ...
+
+mode "faulted": the highest rank SIGKILLs itself at iteration KILL_AT
+(after its local probe, before sending); survivors recover and redo
+that iteration on the shrunken clique.
+mode "clean": no failures — the reference run the survivors' results
+must match bit-for-bit.
+"""
+
+import os
+import signal
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                           + os.environ.get("XLA_FLAGS", ""))
+
+KILL_AT = 4
+N_ITER = 8
+N_DB, DIM, N_LISTS, K, NPROBE, Q_ROWS = 512, 12, 8, 6, 3, 8
+_TAG0 = 1000
+
+
+def dataset():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    return rng.standard_normal((N_DB, DIM)).astype(np.float32)
+
+
+def queries(it):
+    import numpy as np
+
+    rng = np.random.default_rng(100 + it)
+    return rng.standard_normal((Q_ROWS, DIM)).astype(np.float32)
+
+
+def main():
+    rank = int(sys.argv[1])
+    mode = sys.argv[2]
+    addrs = sys.argv[3:]
+    nranks = len(addrs)
+
+    import numpy as np
+
+    import raft_tpu
+    from raft_tpu.comms.comms import MeshComms
+    from raft_tpu.comms.errors import (CommsAbortedError,
+                                       CommsTimeoutError,
+                                       PeerFailedError)
+    from raft_tpu.comms.tcp_mailbox import TcpMailbox
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.neighbors.ivf_mnmg import (build_mnmg, merge_pool,
+                                             search_local, shrink_mnmg)
+
+    import jax
+    from jax.sharding import Mesh
+
+    box = TcpMailbox(rank, addrs, heartbeat_interval=0.3,
+                     heartbeat_timeout=1.5, default_recv_timeout=60.0)
+    mesh = Mesh(np.asarray(jax.devices()[:nranks]), axis_names=("data",))
+    comms = MeshComms(mesh, "data", rank, _mailbox=box)
+
+    res = raft_tpu.device_resources(seed=42)
+    db = dataset()
+    # every rank trains the identical coarse quantizer (same inputs,
+    # same seed, same platform) — the partition is then a pure function
+    # of (caps, n_ranks), so all ranks agree on shard ownership without
+    # exchanging a byte of index data
+    flat = ivf_flat.build(res, db, N_LISTS, seed=0, max_iter=4)
+    idx = build_mnmg(res, db, N_LISTS, nranks, flat=flat)
+
+    import zlib
+
+    res_crc = 0
+    recovery_s = 0.0
+    it = 0
+    while it < N_ITER:
+        q = queries(it)
+        my = comms.get_rank()
+        n = comms.get_size()
+        vals, ids = search_local(idx, my, q, k=K, nprobe=NPROBE)
+        vals = np.ascontiguousarray(vals)
+        ids = np.ascontiguousarray(ids)
+        if mode == "faulted" and rank == nranks - 1 and it == KILL_AT:
+            print("SERVE_CHAOS_SUICIDE", flush=True)
+            sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+        try:
+            tag = _TAG0 + 4 * it
+            for peer in range(n):
+                if peer != my:
+                    comms.isend(vals, peer, tag)
+                    comms.isend(ids, peer, tag + 1)
+            pool_v = [None] * n
+            pool_i = [None] * n
+            pool_v[my], pool_i[my] = vals, ids
+            for peer in range(n):
+                if peer == my:
+                    continue
+                pool_v[peer] = np.asarray(
+                    comms.irecv(peer, tag).wait())
+                pool_i[peer] = np.asarray(
+                    comms.irecv(peer, tag + 1).wait())
+        except (PeerFailedError, CommsTimeoutError,
+                CommsAbortedError) as e:
+            t0 = time.monotonic()
+            if not isinstance(e, CommsAbortedError):
+                # first detector poisons the clique so peers blocked in
+                # their own recv wake NOW (kmeans_fit_elastic discipline)
+                comms.abort(f"serve chaos: {e}")
+            time.sleep(2.0 * comms.heartbeat_interval)
+            comms.clear_abort()
+            survivors = comms.agree_on_survivors()
+            comms = comms.shrink(survivors)
+            # repack: bit-equal to a fresh build at the survivor count
+            idx = shrink_mnmg(idx, survivors)
+            recovery_s = time.monotonic() - t0
+            continue                        # redo this iteration
+        d, i = merge_pool(np.stack(pool_v), np.stack(pool_i),
+                          k=K, metric=idx.metric)
+        res_crc = zlib.crc32(np.ascontiguousarray(d).tobytes(), res_crc)
+        res_crc = zlib.crc32(np.ascontiguousarray(i).tobytes(), res_crc)
+        it += 1
+
+    idx_crc = 0
+    for arr in (idx.packed_db_sh, idx.packed_ids_sh, idx.starts_sh,
+                idx.sizes_sh):
+        idx_crc = zlib.crc32(
+            np.ascontiguousarray(np.asarray(arr)).tobytes(), idx_crc)
+    print(f"SERVE_CHAOS_OK rank={rank} size={comms.get_size()} "
+          f"n_iter={it} idx_crc={idx_crc} res_crc={res_crc} "
+          f"recovery_s={recovery_s:.3f}", flush=True)
+    box.close()
+
+
+if __name__ == "__main__":
+    main()

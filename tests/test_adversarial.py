@@ -81,6 +81,10 @@ class TestSelectKAdversarial:
         assert np.asarray(v).tolist() == [[7.0]]
         assert np.asarray(i).tolist() == [[0]]
 
+    # slow: the 1M-column double-algorithm sweep is ~28s of CPU wall —
+    # off the tier-1 budget; TestStreamSelect keeps the tiled path
+    # covered there.
+    @pytest.mark.slow
     def test_select_large_shapes_tiled_vs_direct(self):
         """MATRIX_SELECT_LARGE analogue (select_large_k.cu): 1M+odd-length
         rows, k=2048, both algorithms, against the numpy oracle."""

@@ -1,0 +1,278 @@
+"""Sharded IVF serving index tests (ISSUE 11 tentpole, index half).
+
+Acceptance criteria exercised here:
+
+* the merge epilogue is bit-identical at the exactness boundary:
+  ``search_mnmg`` at ``nprobe = n_lists`` on 1, 2, and 4 ranks returns
+  the same ids AND distances — including tie and NaN handling — as
+  single-rank ``ivf_flat.search`` and as ``brute_force.knn`` on the
+  reconstructed database;
+* partial probes return per-element identical distances at every rank
+  count (independent dot products over identical static tiles);
+* :func:`partition_lists` is deterministic and balanced, and
+  ``shrink_mnmg`` produces shards bit-for-bit equal to a fresh
+  ``build_mnmg`` at the survivor count — the chaos-repack witness;
+* the cross-process halves (``search_local`` + ``merge_pool``) agree
+  with the one-program ``shard_map`` path;
+* :class:`~raft_tpu.serve.IvfMnmgKnnService` warms to zero post-warm
+  retraces and serves results equal to the eager search.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import ivf_flat, ivf_mnmg
+from raft_tpu.neighbors.brute_force import knn
+from raft_tpu.neighbors.ivf_mnmg import (build_mnmg, merge_pool,
+                                         partition_lists, search_local,
+                                         search_mnmg, shrink_mnmg)
+
+RANK_COUNTS = (1, 2, 4)
+
+
+@pytest.fixture(scope="module")
+def blob_db(res):
+    from raft_tpu.random import RngState, make_blobs
+
+    X, _, _ = make_blobs(res, RngState(5), 1536, 24, n_clusters=16)
+    X = np.asarray(X)
+    return X, ivf_flat.build(res, X, 16, seed=0, max_iter=4)
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+class TestPartition:
+    def test_deterministic_and_total(self):
+        caps = np.array([64, 8, 32, 8, 16, 128, 8, 24])
+        a = partition_lists(caps, 3)
+        b = partition_lists(caps, 3)
+        assert np.array_equal(a, b)
+        assert a.shape == (8,)
+        assert set(a.tolist()) <= {0, 1, 2}
+        # every rank owns something when there are enough lists
+        assert len(set(a.tolist())) == 3
+
+    def test_lpt_balance(self):
+        # LPT greedy keeps max rank load within (max cap) of the mean
+        rng = np.random.default_rng(0)
+        caps = rng.integers(8, 256, size=64)
+        owner = partition_lists(caps, 4)
+        loads = np.array([caps[owner == r].sum() for r in range(4)])
+        assert loads.max() - loads.min() <= caps.max()
+
+    def test_bad_args(self):
+        with pytest.raises(ValueError, match="n_ranks"):
+            partition_lists(np.array([8, 8]), 0)
+
+
+class TestBuild:
+    def test_shards_are_a_partition(self, blob_db):
+        X, flat = blob_db
+        idx = build_mnmg(None, X, 16, 2, flat=flat)
+        assert idx.n_ranks == 2
+        ids = _np(idx.packed_ids_sh)
+        real = ids[ids >= 0]
+        assert sorted(real.tolist()) == list(range(len(X)))
+        # sizes split exactly: each list owned by exactly one rank
+        sizes = _np(idx.sizes_sh)
+        assert np.array_equal(sizes.sum(axis=0), _np(flat.sizes))
+        assert ((sizes > 0).sum(axis=0) <= 1).all()
+
+    def test_same_flat_same_shards(self, blob_db):
+        X, flat = blob_db
+        a = build_mnmg(None, X, 16, 2, flat=flat)
+        b = build_mnmg(None, X, 16, 2, flat=flat)
+        for fa, fb in ((a.packed_db_sh, b.packed_db_sh),
+                       (a.packed_ids_sh, b.packed_ids_sh),
+                       (a.starts_sh, b.starts_sh),
+                       (a.sizes_sh, b.sizes_sh)):
+            assert np.array_equal(_np(fa), _np(fb))
+
+    def test_reconstruct_exact(self, blob_db):
+        X, flat = blob_db
+        idx = build_mnmg(None, X, 16, 4, flat=flat)
+        assert np.array_equal(_np(idx.reconstruct()), X)
+
+    def test_mesh_rank_mismatch(self, blob_db):
+        X, flat = blob_db
+        import jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("shard",))
+        with pytest.raises(ValueError, match="need n_ranks"):
+            build_mnmg(None, X, 16, 4, flat=flat, mesh=mesh)
+
+
+class TestFullProbeBitIdentity:
+    @pytest.mark.parametrize("n_ranks", RANK_COUNTS)
+    def test_identical_to_single_rank_and_brute(self, res, blob_db,
+                                                n_ranks):
+        X, flat = blob_db
+        q = X[100:116] + 0.01
+        bd, bi = knn(res, X, q, k=12)
+        sd, si = ivf_flat.search(res, flat, q, k=12,
+                                 nprobe=flat.n_lists)
+        idx = build_mnmg(res, X, 16, n_ranks, flat=flat)
+        md, mi = search_mnmg(res, idx, q, k=12, nprobe=idx.n_lists)
+        assert np.array_equal(_np(md), _np(bd))
+        assert np.array_equal(_np(mi), _np(bi))
+        assert np.array_equal(_np(md), _np(sd))
+        assert np.array_equal(_np(mi), _np(si))
+
+    @pytest.mark.parametrize("n_ranks", RANK_COUNTS)
+    def test_ties_and_nan_identical(self, res, n_ranks):
+        # duplicate rows (exact ties) + a NaN row: the pathological
+        # inputs where "equal up to tie order" would hide a divergence
+        rng = np.random.default_rng(9)
+        X = rng.standard_normal((64, 8)).astype(np.float32)
+        X[32:] = X[:32]                       # every row twice
+        X[7] = np.nan
+        q = np.concatenate([X[:4], X[40:42]])
+        flat = ivf_flat.build(res, X, 8, centroids=X[:8])
+        idx = build_mnmg(res, X, 8, n_ranks, flat=flat)
+        bd, bi = knn(res, X, q, k=8)
+        md, mi = search_mnmg(res, idx, q, k=8, nprobe=8)
+        assert np.array_equal(_np(md), _np(bd), equal_nan=True)
+        assert np.array_equal(_np(mi), _np(bi))
+
+    def test_overprobe_clamps(self, res, blob_db):
+        X, flat = blob_db
+        idx = build_mnmg(res, X, 16, 2, flat=flat)
+        d1, i1 = search_mnmg(res, idx, X[:8], k=4, nprobe=idx.n_lists)
+        d2, i2 = search_mnmg(res, idx, X[:8], k=4,
+                             nprobe=idx.n_lists + 7)
+        assert np.array_equal(_np(d1), _np(d2))
+        assert np.array_equal(_np(i1), _np(i2))
+
+
+class TestPartialProbe:
+    @pytest.mark.parametrize("n_ranks", (2, 4))
+    def test_matches_single_rank(self, res, blob_db, n_ranks):
+        X, flat = blob_db
+        q = X[:32] + 0.02
+        sd, si = ivf_flat.search(res, flat, q, k=10, nprobe=5)
+        idx = build_mnmg(res, X, 16, n_ranks, flat=flat)
+        md, mi = search_mnmg(res, idx, q, k=10, nprobe=5)
+        assert np.array_equal(_np(md), _np(sd))
+        assert np.array_equal(_np(mi), _np(si))
+
+    @pytest.mark.parametrize("metric", ("euclidean", "inner"))
+    def test_metric_finalize_applied_once(self, res, blob_db, metric):
+        # "inner" negates and "euclidean" sqrts in the finalize — a
+        # merge over finalized values would mis-order both
+        X, _ = blob_db
+        flat = ivf_flat.build(res, X, 16, metric, seed=0, max_iter=6)
+        q = X[:16] + 0.05
+        sd, si = ivf_flat.search(res, flat, q, k=8, nprobe=6)
+        idx = build_mnmg(res, X, 16, 2, metric, flat=flat)
+        md, mi = search_mnmg(res, idx, q, k=8, nprobe=6)
+        assert np.array_equal(_np(md), _np(sd))
+        assert np.array_equal(_np(mi), _np(si))
+
+    def test_bad_args(self, res, blob_db):
+        X, flat = blob_db
+        idx = build_mnmg(res, X, 16, 2, flat=flat)
+        with pytest.raises(ValueError, match="queries"):
+            search_mnmg(res, idx, X[:2, :5], k=4, nprobe=2)
+        with pytest.raises(ValueError, match="nprobe"):
+            search_mnmg(res, idx, X[:2], k=4, nprobe=0)
+        with pytest.raises(ValueError, match="k="):
+            search_mnmg(res, idx, X[:2], k=0, nprobe=2)
+
+    def test_budget_degrades_bit_identical(self, res, blob_db):
+        from raft_tpu.runtime import limits
+
+        X, flat = blob_db
+        idx = build_mnmg(res, X, 16, 2, flat=flat)
+        q = X[:16] + 0.02
+        full_d, full_i = search_mnmg(res, idx, q, k=8, nprobe=4)
+        est = limits.estimate_bytes(
+            "neighbors.ivf_mnmg_search", n_queries=16,
+            probe_rows=4 * idx.cap_max, n_dims=idx.dim, k=8,
+            n_ranks=2, itemsize=4, packed_rows=idx.cap_rank_max)
+        with limits.budget_scope(est // 2):
+            cd, ci = search_mnmg(res, idx, q, k=8, nprobe=4)
+        assert np.array_equal(_np(cd), _np(full_d))
+        assert np.array_equal(_np(ci), _np(full_i))
+
+
+class TestShrinkRepack:
+    def test_shrink_equals_fresh_build(self, blob_db):
+        X, flat = blob_db
+        idx4 = build_mnmg(None, X, 16, 4, flat=flat)
+        for survivors in ((0, 1, 3), (1, 2), (0,)):
+            shrunk = shrink_mnmg(idx4, survivors)
+            fresh = build_mnmg(None, X, 16, len(survivors), flat=flat)
+            for a, b in ((shrunk.packed_db_sh, fresh.packed_db_sh),
+                         (shrunk.packed_ids_sh, fresh.packed_ids_sh),
+                         (shrunk.starts_sh, fresh.starts_sh),
+                         (shrunk.sizes_sh, fresh.sizes_sh)):
+                assert np.array_equal(_np(a), _np(b))
+            assert np.array_equal(shrunk.owner, fresh.owner)
+
+    def test_shrunk_index_answers_identically(self, res, blob_db):
+        X, flat = blob_db
+        idx4 = build_mnmg(res, X, 16, 4, flat=flat)
+        shrunk = shrink_mnmg(idx4, (0, 2))
+        q = X[:8] + 0.01
+        sd, si = ivf_flat.search(res, flat, q, k=6, nprobe=4)
+        md, mi = search_mnmg(res, shrunk, q, k=6, nprobe=4)
+        assert np.array_equal(_np(md), _np(sd))
+        assert np.array_equal(_np(mi), _np(si))
+
+    def test_no_survivors(self, blob_db):
+        X, flat = blob_db
+        idx = build_mnmg(None, X, 16, 2, flat=flat)
+        with pytest.raises(ValueError, match="survivor"):
+            shrink_mnmg(idx, ())
+
+
+class TestCrossProcessHalves:
+    def test_local_plus_merge_equals_one_program(self, res, blob_db):
+        # the cross-process serving clique path: per-rank raw pools
+        # merged on the host transport must agree with the in-graph
+        # all-gather merge bit-for-bit
+        X, flat = blob_db
+        idx = build_mnmg(res, X, 16, 2, flat=flat)
+        q = X[:12] + 0.03
+        md, mi = search_mnmg(res, idx, q, k=8, nprobe=5)
+        pools = [search_local(idx, r, q, k=8, nprobe=5)
+                 for r in range(2)]
+        vals = np.stack([_np(v) for v, _ in pools])
+        ids = np.stack([_np(i) for _, i in pools])
+        hd, hi = merge_pool(vals, ids, k=8, metric=idx.metric)
+        assert np.array_equal(_np(hd), _np(md))
+        assert np.array_equal(_np(hi), _np(mi))
+
+
+class TestIvfMnmgService:
+    def test_warm_zero_retrace_equals_eager(self, res, blob_db):
+        from raft_tpu.serve import (BatchPolicy, Executor,
+                                    IvfMnmgKnnService)
+
+        X, flat = blob_db
+        idx = build_mnmg(res, X, 16, 2, flat=flat)
+        svc = IvfMnmgKnnService(idx, k=6, nprobe=4)
+        ex = Executor([svc], policy=BatchPolicy(max_batch=32,
+                                                max_wait_ms=1.0))
+        ex.warm([8, 32])
+        traces0 = ex.stats.traces
+        q = X[:8].astype(np.float32) + 0.01
+        with ex:
+            d, i = ex.submit(svc.name, q).result(timeout=60.0)
+        assert ex.stats.traces == traces0      # zero post-warm retraces
+        ed, ei = search_mnmg(res, idx, q, k=6, nprobe=4)
+        assert np.array_equal(_np(d), _np(ed))
+        assert np.array_equal(_np(i), _np(ei))
+
+    def test_rejects_degenerate_nprobe(self, res, blob_db):
+        from raft_tpu.serve import IvfMnmgKnnService
+
+        X, flat = blob_db
+        idx = build_mnmg(res, X, 16, 2, flat=flat)
+        with pytest.raises(ValueError):
+            IvfMnmgKnnService(idx, k=6, nprobe=0)
+        with pytest.raises(ValueError):
+            IvfMnmgKnnService(idx, k=6, nprobe=16)

@@ -1,0 +1,241 @@
+"""Replica-group serving tests (ISSUE 11 tentpole, serve half):
+weighted-fair routing, rejection spill, health-gated membership, the
+comms-wired heal cycle, and the fleet load generator's recovery clock.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors.ivf_mnmg import build_mnmg, shrink_mnmg
+from raft_tpu.runtime import limits
+from raft_tpu.serve import (BatchPolicy, Executor, IvfMnmgKnnService,
+                            QosPolicy, ReplicaGroup, TenantPolicy,
+                            fleet_closed_loop)
+
+
+@pytest.fixture(scope="module")
+def small_index(res):
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((512, 12)).astype(np.float32)
+    flat = ivf_flat.build(res, X, 8, seed=0, max_iter=4)
+    return X, flat, build_mnmg(res, X, 8, 2, flat=flat)
+
+
+def _make_ex(idx, *, slo_s=None, max_queue=1024):
+    qos = None
+    if slo_s is not None:
+        qos = QosPolicy({"default": TenantPolicy(slo_latency_s=slo_s)})
+    ex = Executor([IvfMnmgKnnService(idx, k=4, nprobe=3)],
+                  policy=BatchPolicy(max_batch=32, max_wait_ms=1.0,
+                                     max_queue=max_queue),
+                  qos=qos)
+    ex.warm([8, 32])
+    return ex
+
+
+def _op(idx):
+    return f"ivf_mnmg_k4_np3_r{idx.n_ranks}_{idx.metric}"
+
+
+class TestRouting:
+    def test_weighted_fair_spread(self, small_index):
+        X, _, idx = small_index
+        group = ReplicaGroup([_make_ex(idx) for _ in range(3)],
+                             weights=[2.0, 1.0, 1.0])
+        q = X[:4]
+        with group:
+            futs = [group.route(_op(idx), q)[1] for _ in range(20)]
+            for f in futs:
+                f.result(timeout=60.0)
+        routed = [r.routed for r in group.replicas]
+        assert sum(routed) == 20
+        # weight 2 replica gets ~2x the requests of each weight 1
+        assert routed[0] == 10 and routed[1] == routed[2] == 5
+
+    def test_route_reports_serving_replica(self, small_index):
+        X, _, idx = small_index
+        group = ReplicaGroup([_make_ex(idx), _make_ex(idx)])
+        with group:
+            rep, fut = group.route(_op(idx), X[:4])
+            fut.result(timeout=60.0)
+        assert rep.name in {r.name for r in group.replicas}
+        assert group.stats.routed == 1
+
+    def test_spill_on_queue_full(self, small_index):
+        X, _, idx = small_index
+        # one-slot queues, no drain threads running: the first two
+        # submits fill both replicas; the third sees the preferred
+        # replica's queue_full rejection, spills to the other, and only
+        # when BOTH refuse does the typed rejection reach the caller
+        a = _make_ex(idx, max_queue=1)
+        b = _make_ex(idx, max_queue=1)
+        group = ReplicaGroup([a, b])
+        op = _op(idx)
+        group.submit(op, X[:4])             # fills one queue
+        group.submit(op, X[:4])             # router prefers the idle one
+        assert group.stats.spills == 0
+        assert [r.routed for r in group.replicas] == [1, 1]
+        with pytest.raises(limits.RejectedError) as ei:
+            group.submit(op, X[:4])
+        assert ei.value.reason == "queue_full"
+        assert group.stats.spills == 2      # both replicas were tried
+        assert group.stats.rejected == 1
+        a.start()
+        b.start()
+        group.stop()
+
+    def test_no_healthy_replica_raises_typed(self, small_index):
+        X, _, idx = small_index
+        group = ReplicaGroup([_make_ex(idx)])
+        group.mark_failed(0, "down")
+        with pytest.raises(limits.RejectedError) as ei:
+            group.submit(_op(idx), X[:4])
+        assert ei.value.reason == "no_replica"
+        assert group.stats.rejected == 1
+
+
+class TestMembership:
+    def test_mark_failed_routes_around(self, small_index):
+        X, _, idx = small_index
+        group = ReplicaGroup([_make_ex(idx), _make_ex(idx)])
+        with group:
+            group.mark_failed("replica0", "test")
+            for _ in range(5):
+                rep, fut = group.route(_op(idx), X[:4])
+                assert rep.name == "replica1"
+                fut.result(timeout=60.0)
+        assert group.stats.failures == 1
+        assert group.replicas[0].failed_reason == "test"
+
+    def test_fail_replica_fails_pending_typed(self, small_index):
+        X, _, idx = small_index
+        group = ReplicaGroup([_make_ex(idx)])
+        fut = group.submit(_op(idx), X[:4])   # queued, no drain thread
+        group.fail_replica(0, "killed")
+        with pytest.raises(limits.RejectedError) as ei:
+            fut.result(timeout=5.0)
+        assert ei.value.reason == "replica_failed"
+        # and new submits find no healthy replica
+        with pytest.raises(limits.RejectedError):
+            group.submit(_op(idx), X[:4])
+
+
+class TestHeal:
+    def test_heal_healthy_clique_is_noop(self, small_index):
+        from raft_tpu.comms.comms import MeshComms, _Mailbox
+
+        import jax
+        from jax.sharding import Mesh
+
+        _, _, idx = small_index
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("data",))
+        comms = MeshComms(mesh, "data", 0, _mailbox=_Mailbox())
+        group = ReplicaGroup([_make_ex(idx), _make_ex(idx)],
+                             comms=comms)
+        assert group.heal(timeout=2.0) is None
+        assert group.stats.recoveries == 0
+
+    def test_heal_requires_comms(self, small_index):
+        _, _, idx = small_index
+        group = ReplicaGroup([_make_ex(idx)])
+        with pytest.raises(ValueError, match="comms"):
+            group.heal()
+
+    def test_heal_shrinks_and_repacks(self, res, small_index):
+        """The in-process chaos cycle: rank 2 fault-disconnects, heal()
+        detects the typed failure, reaches survivor consensus, shrinks
+        the clique, and the on_shrink repack equals a fresh build on
+        the survivor count — survivors answer afterwards."""
+        from raft_tpu.comms.comms import MeshComms, _Mailbox
+        from raft_tpu.comms.faults import FaultInjector
+
+        import jax
+        from jax.sharding import Mesh
+
+        X, flat, _ = small_index
+        idx3 = build_mnmg(res, X, 8, 3, flat=flat)
+        mesh = Mesh(np.asarray(jax.devices()[:3]), ("data",))
+        inj = FaultInjector(seed=0, disconnect=1.0, source_ranks={2})
+        comms = MeshComms(mesh, "data", 0, _mailbox=_Mailbox(faults=inj))
+
+        repacked = {}
+
+        def on_shrink(new_comms, survivors):
+            idx_s = shrink_mnmg(idx3, survivors)
+            repacked["idx"] = idx_s
+            return [_make_ex(idx_s) for _ in survivors]
+
+        group = ReplicaGroup([_make_ex(idx3) for _ in range(3)],
+                             comms=comms, on_shrink=on_shrink)
+        group.start()
+        report = group.heal(timeout=5.0)
+        assert report is not None
+        assert report.dead == (2,)
+        assert report.survivors == (0, 1)
+        assert report.repacked
+        assert report.recovery_s > 0
+        assert group.comms.get_size() == 2
+        assert len(group.healthy()) == 2
+        assert group.stats.recoveries == 1
+
+        fresh = build_mnmg(res, X, 8, 2, flat=flat)
+        idx_s = repacked["idx"]
+        for a, b in ((idx_s.packed_db_sh, fresh.packed_db_sh),
+                     (idx_s.packed_ids_sh, fresh.packed_ids_sh),
+                     (idx_s.starts_sh, fresh.starts_sh),
+                     (idx_s.sizes_sh, fresh.sizes_sh)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+        # survivors keep serving (on the repacked 2-rank op)
+        fut = group.submit(_op(idx_s), X[:4])
+        d, i = fut.result(timeout=60.0)
+        from raft_tpu.neighbors.ivf_mnmg import search_mnmg
+
+        ed, ei = search_mnmg(res, idx_s, X[:4], k=4, nprobe=3)
+        assert np.array_equal(np.asarray(d), np.asarray(ed))
+        assert np.array_equal(np.asarray(i), np.asarray(ei))
+        group.stop()
+
+
+class TestFleetLoadgen:
+    def test_per_replica_rows_and_merged(self, small_index):
+        X, _, idx = small_index
+        group = ReplicaGroup([_make_ex(idx, slo_s=5.0)
+                              for _ in range(2)])
+        with group:
+            rep = fleet_closed_loop(group, _op(idx), clients=3, rows=4,
+                                    duration_s=0.5)
+        d = rep.as_dict()
+        assert set(d["replicas"]) == {"replica0", "replica1"}
+        fleet_completed = d["fleet"]["completed"]
+        assert fleet_completed > 0
+        assert sum(r["completed"] for r in d["replicas"].values()) \
+            == fleet_completed
+        assert d["fleet"]["p99_ms"] >= d["fleet"]["p50_ms"]
+        assert "killed" not in d
+        assert rep.recovery_time_to_slo_s is None
+
+    def test_kill_mid_run_reports_recovery(self, small_index):
+        X, _, idx = small_index
+        group = ReplicaGroup([_make_ex(idx, slo_s=5.0)
+                              for _ in range(3)])
+        with group:
+            rep = fleet_closed_loop(group, _op(idx), clients=4, rows=4,
+                                    duration_s=1.0, kill_after_s=0.3)
+        assert rep.killed is not None
+        assert rep.kill_at_s == pytest.approx(0.3, abs=0.4)
+        # survivors kept answering within the (generous) SLO
+        assert rep.recovery_time_to_slo_s is not None
+        assert rep.recovery_time_to_slo_s < 1.0
+        d = rep.as_dict()
+        assert d["recovery_time_to_slo_s"] == pytest.approx(
+            rep.recovery_time_to_slo_s, abs=1e-3)
+        # the killed replica served strictly less than the survivors
+        killed_row = d["replicas"][rep.killed]
+        others = [r for n, r in d["replicas"].items() if n != rep.killed]
+        assert all(killed_row["completed"] < o["completed"]
+                   for o in others)

@@ -1,0 +1,90 @@
+"""Multiprocess sharded-serving chaos test (ISSUE 11 acceptance): a
+3-process serving clique streaming queries has its highest rank
+SIGKILL'd mid-stream; the 2 survivors detect → abort → agree → shrink →
+repack and keep answering, and BOTH their repacked index and their full
+result stream are bit-for-bit equal to a clean 2-process run.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_OK_RE = (r"SERVE_CHAOS_OK rank=\d+ size=(\d+) n_iter=(\d+) "
+          r"idx_crc=(\d+) res_crc=(\d+) recovery_s=([\d.]+)")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class TestServeChaosSigkill:
+    # slow: boots 5 fresh interpreters (two cliques) at ~22s wall — off
+    # the tier-1 budget like the PR-9 heavyweights; ci/smoke.sh carries
+    # the in-process kill/heal/repack gate on every run.
+    @pytest.mark.slow
+    def test_killed_rank_survivors_answer_bit_for_bit(self):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        worker = os.path.join(_REPO, "tests", "_serve_chaos_worker.py")
+
+        def launch(nproc, mode):
+            addrs = [f"127.0.0.1:{p}" for p in _free_ports(nproc)]
+            procs = [subprocess.Popen(
+                [sys.executable, worker, str(r), mode] + addrs,
+                cwd=_REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+                for r in range(nproc)]
+            outs = []
+            try:
+                for p in procs:
+                    outs.append(p.communicate(timeout=180)[0])
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+            return procs, outs
+
+        procs, outs = launch(3, "faulted")
+        assert procs[2].returncode == -9, outs[2]   # actually SIGKILLed
+        assert "SERVE_CHAOS_SUICIDE" in outs[2]
+        results = set()
+        recoveries = []
+        for r in (0, 1):
+            assert procs[r].returncode == 0, \
+                f"survivor {r} failed:\n{outs[r]}"
+            m = re.search(_OK_RE, outs[r])
+            assert m, outs[r]
+            assert m.group(1) == "2"                # finished on 2 ranks
+            results.add(m.groups()[:4])
+            recoveries.append(float(m.group(5)))
+        assert len(results) == 1                    # survivors agree
+        # detect -> consensus -> shrink -> repack -> redone iteration,
+        # well inside the serving recovery budget
+        assert all(0.0 < s < 60.0 for s in recoveries)
+
+        procs, outs = launch(2, "clean")
+        clean = set()
+        for r in range(2):
+            assert procs[r].returncode == 0, outs[r]
+            m = re.search(_OK_RE, outs[r])
+            assert m, outs[r]
+            clean.add(m.groups()[:4])
+        # post-shrink index AND the merged result stream are bit-equal
+        # to the clean 2-rank run (idx_crc + res_crc both in the tuple)
+        assert clean == results

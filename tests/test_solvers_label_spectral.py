@@ -124,7 +124,11 @@ class TestLAP:
             dual = float(lap.get_dual_objective_value(b))
             assert abs(dual - got) <= n * 0.01 + 1e-3
 
-    @pytest.mark.parametrize("n,seed", [(100, 0), (200, 1), (300, 2)])
+    # n=300 is ~35s of CPU wall on its own — slow tier; n=100/200 keep
+    # the exact-Hungarian comparison on the tier-1 budget.
+    @pytest.mark.parametrize(
+        "n,seed", [(100, 0), (200, 1),
+                   pytest.param(300, 2, marks=pytest.mark.slow)])
     def test_vs_scipy_hungarian_float(self, res, n, seed):
         """Adversarial float costs at n in the hundreds vs scipy's EXACT
         Hungarian (VERDICT weak #7): the auction's n·eps bound must land

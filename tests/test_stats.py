@@ -99,6 +99,10 @@ class TestHistogram:
         np.testing.assert_array_equal(h_fac, h_sct)
         assert h_fac.shape == (n_bins, 3)
 
+    # slow: the 257-row × 16384-col × 4096-bin sweep is ~30s of CPU
+    # wall — off the tier-1 budget; the one-chunk factored tests above
+    # cover the kernel there.
+    @pytest.mark.slow
     def test_factored_multi_chunk_and_padding(self, rng):
         """The scan accumulation across row chunks INCLUDING a padded
         tail — the branch a one-chunk test never reaches. The chunk
