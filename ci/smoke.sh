@@ -28,59 +28,94 @@ print('import OK; native runtime available:', native_available())
 import raft_tpu.cluster.kmeans, raft_tpu.sparse.solver, raft_tpu.comms
 print('subsystem imports OK')
 "
-# Error-hygiene lint for the comms stack: the resilience layer exists so
-# failures surface as typed CommsError subclasses — reject reintroduced
-# blanket handlers (`except Exception`) and silently swallowed socket
-# errors (`except OSError: pass`; use contextlib.suppress(OSError) at
-# well-understood shutdown sites instead).
-python - <<'PYEOF'
-import pathlib, re, sys
-bad = []
-for p in sorted(pathlib.Path("raft_tpu/comms").glob("*.py")):
-    text = p.read_text()
-    for m in re.finditer(r"except\s+Exception\b", text):
-        bad.append(f"{p}:{text.count(chr(10), 0, m.start()) + 1}: "
-                   "bare 'except Exception' (catch typed CommsError kinds)")
-    for m in re.finditer(r"except\s+OSError\s*:\s*\n\s*pass\b", text):
-        bad.append(f"{p}:{text.count(chr(10), 0, m.start()) + 1}: "
-                   "silent 'except OSError: pass' (use "
-                   "contextlib.suppress or surface a typed error)")
-print("\n".join(bad) if bad else "comms error-hygiene lint: clean")
-sys.exit(1 if bad else 0)
-PYEOF
+# Static invariants (ISSUE 12): raftlint subsumes the old grep lints —
+# R4 carries the comms/numeric error hygiene, R8 the annotated
+# breakdown sites, R6 the obs API boundary — and adds jit purity (R1),
+# recompile hazards (R2), lock discipline (R3), off-path purity (R5)
+# and the env-knob registry (R7). The shipped tree must be clean
+# against the checked-in baseline; stale waivers fail too.
+python -m tools.raftlint raft_tpu
 
-# Numeric error-hygiene lint (ISSUE 3, the solver-layer mirror of the
-# comms lint above): in linalg/ and sparse/solver/, reject blanket
-# handlers and UNANNOTATED breakdown sites — a sqrt or norm-divide whose
-# operand sign/zero is not visibly handled (maximum/abs/clip/eps floor)
-# must either grow a guard or carry a `# guarded:` comment naming why it
-# cannot go negative/zero.
-python - <<'PYEOF'
-import pathlib, re, sys
-GUARD_TOKENS = ("maximum", "abs", "clip", "eps", "finfo", "1.0 +",
-                "guarded:")
-bad = []
-files = sorted(pathlib.Path("raft_tpu/linalg").glob("*.py")) + \
-    sorted(pathlib.Path("raft_tpu/sparse/solver").glob("*.py"))
-for p in files:
-    lines = p.read_text().splitlines()
-    for i, line in enumerate(lines, 1):
-        if re.search(r"except\s+Exception\b", line):
-            bad.append(f"{p}:{i}: bare 'except Exception' (catch typed "
-                       "NumericalError kinds from core/guards.py)")
-        # sqrt of a quantity that can silently go negative: require a
-        # guard token on the line or an explanatory `# guarded:` comment
-        if "jnp.sqrt(" in line and not any(t in line for t in GUARD_TOKENS):
-            bad.append(f"{p}:{i}: unguarded jnp.sqrt — clamp the operand "
-                       "(jnp.maximum(x, 0)) or annotate '# guarded: <why>'")
-        # division by a computed norm: zero vectors divide to NaN/inf
-        if re.search(r"/\s*jnp\.linalg\.norm\(", line) and \
-                not any(t in line for t in GUARD_TOKENS):
-            bad.append(f"{p}:{i}: unguarded divide by jnp.linalg.norm — "
-                       "floor it or annotate '# guarded: <why>'")
-print("\n".join(bad) if bad else "numeric error-hygiene lint: clean")
-sys.exit(1 if bad else 0)
-PYEOF
+# Debt inventory (non-fatal): the same scan with the baseline ignored,
+# so the waived backlog stays visible in every CI log.
+python -m tools.raftlint --no-baseline raft_tpu | tail -1 || true
+
+# Gate self-test: a seeded violation per rule, linted from a tempdir
+# copy, must FAIL with that rule id — proves the gate can actually
+# fire, not merely that the tree is clean today.
+seed_violation() {
+    local rule="$1" rel="$2" dir
+    dir=$(mktemp -d)
+    mkdir -p "$dir/raft_tpu/$(dirname "$rel")"
+    cat > "$dir/raft_tpu/$rel"
+    (cd "$dir" && find raft_tpu -type d -exec touch {}/__init__.py \;)
+    if python -m tools.raftlint --root "$dir" --no-baseline \
+            --rules "$rule" raft_tpu > "$dir/out.txt" 2>&1; then
+        echo "raftlint gate: seeded $rule violation went undetected"
+        cat "$dir/out.txt"; exit 1
+    fi
+    grep -q ": $rule " "$dir/out.txt" || {
+        echo "raftlint gate: seeded $rule violation misreported"
+        cat "$dir/out.txt"; exit 1; }
+    rm -rf "$dir"
+}
+seed_violation R1 a.py <<'EOF'
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    return np.sin(x)
+EOF
+seed_violation R2 a.py <<'EOF'
+import jax
+
+def call(x):
+    def inner(y):
+        return y * 2
+    return jax.jit(inner)(x)
+EOF
+seed_violation R3 a.py <<'EOF'
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        self.count += 1
+EOF
+seed_violation R4 a.py <<'EOF'
+def f():
+    raise RuntimeError("boom")
+EOF
+seed_violation R5 obs/metrics.py <<'EOF'
+_enabled = False
+
+def inc(name, value=1, **labels):
+    key = (name, tuple(sorted(labels.items())))
+    if not _enabled:
+        return
+EOF
+seed_violation R6 a.py <<'EOF'
+from raft_tpu.obs.metrics import inc
+
+def f():
+    inc("x")
+EOF
+seed_violation R7 a.py <<'EOF'
+import os
+
+FLAG = os.getenv("RAFT_TPU_FLAG", "0")
+EOF
+seed_violation R8 linalg/a.py <<'EOF'
+import jax.numpy as jnp
+
+def f(x):
+    return jnp.sqrt(x)
+EOF
+echo "raftlint gate: tree clean; all 8 seeded violations fail loud"
 
 python -m pytest tests/ -x -q
 
@@ -114,36 +149,6 @@ PYEOF
 # acceptance run).
 JAX_PLATFORMS=cpu python -m pytest \
     tests/test_elastic.py::TestMultiprocessSigkill -q
-
-# Observability API lint (ISSUE 4): instrumented modules go through the
-# raft_tpu.obs facade (obs.inc / obs.observe / obs.span /
-# obs.record_convergence ...). Importing obs internals or constructing
-# registries/sinks inside library code bypasses the single on/off knob
-# and the process-global registry — reject it everywhere but obs/ itself.
-python - <<'PYEOF'
-import pathlib, re, sys
-RULES = (
-    (r"from\s+raft_tpu\.obs\.\w+\s+import",
-     "import the facade (from raft_tpu import obs), not obs internals"),
-    (r"from\s+raft_tpu\.obs\s+import\s+(metrics|spans|export|schema)\b",
-     "import the facade (from raft_tpu import obs), not obs submodules"),
-    (r"\bMetricsRegistry\s*\(",
-     "library code must use the process-global registry (obs.inc/...)"),
-    (r"\bJsonlSink\s*\(",
-     "sinks attach via obs.set_sink / RAFT_TPU_METRICS_JSONL, not inline"),
-)
-bad = []
-for p in sorted(pathlib.Path("raft_tpu").rglob("*.py")):
-    if p.parts[:2] == ("raft_tpu", "obs"):
-        continue
-    text = p.read_text()
-    for pat, why in RULES:
-        for m in re.finditer(pat, text):
-            line = text.count("\n", 0, m.start()) + 1
-            bad.append(f"{p}:{line}: {why}")
-print("\n".join(bad) if bad else "obs API lint: clean")
-sys.exit(1 if bad else 0)
-PYEOF
 
 # Observability gate (ISSUE 4 acceptance): a real MNMG kmeans + eigsh
 # run with RAFT_TPU_METRICS=on must export (a) a schema-valid JSONL

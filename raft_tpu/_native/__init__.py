@@ -14,6 +14,7 @@ If no toolchain is available the import still succeeds with
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import hashlib
 import os
@@ -87,10 +88,8 @@ def _build_and_load():
         # corrupt cached artifact: drop it so the next import rebuilds,
         # and report unavailable instead of raising out of get_lib()
         _lib_err = str(e)
-        try:
+        with contextlib.suppress(OSError):
             os.remove(so_path)
-        except OSError:
-            pass
         return None
     return lib
 

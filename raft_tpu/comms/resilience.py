@@ -28,7 +28,6 @@ trace range) and logged through ``core.logger``.
 from __future__ import annotations
 
 import collections
-import os
 import random
 import threading
 import time
@@ -70,15 +69,9 @@ def default_recv_timeout(fallback: float) -> float:
     over both.  A malformed value raises ``ValueError`` — a typo'd
     timeout must never silently become the default.
     """
-    env = os.environ.get("RAFT_TPU_RECV_TIMEOUT", "").strip()
-    if env:
-        try:
-            return float(env)
-        except ValueError:
-            raise ValueError(
-                "RAFT_TPU_RECV_TIMEOUT must be a number of seconds, "
-                f"got {env!r}") from None
-    return fallback
+    from raft_tpu.core import env
+
+    return env.read("RAFT_TPU_RECV_TIMEOUT", fallback)
 
 
 @dataclass(frozen=True)

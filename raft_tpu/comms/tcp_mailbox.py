@@ -407,16 +407,17 @@ class TcpMailbox:
                     if kind == _CTX:
                         raw = _recv_exact(conn, nbytes)
                         if zlib.crc32(raw) == crc:
-                            try:
+                            # best-effort metadata: drop on parse error
+                            with contextlib.suppress(ValueError,
+                                                     UnicodeDecodeError):
                                 self._store.note_ctx(
                                     source, obs.TraceContext.from_header(
                                         raw.decode("utf-8")))
-                            except (ValueError, UnicodeDecodeError):
-                                pass    # best-effort metadata: drop
                         continue
                     raw = _recv_exact(conn, nbytes)
                     if zlib.crc32(raw) != crc:
-                        self.corrupt_frames += 1
+                        with self._lock:
+                            self.corrupt_frames += 1
                         obs.inc("comms_frames_corrupt_total", 1,
                                 transport="tcp")
                         trace.record_event("comms.frame_corrupt",
@@ -499,15 +500,15 @@ class TcpMailbox:
                    f"(timeout {self.heartbeat_timeout}s)")
 
     def close(self):
-        if self._closed:
-            return
-        self._closed = True
-        self._stop.set()
         with self._lock:
+            if self._closed:
+                return
+            self._closed = True
             conns = dict(self._conns)
             self._conns.clear()
             inbound = list(self._inbound)
             self._inbound.clear()
+        self._stop.set()
         for dest, s in conns.items():
             # a parting GOODBYE distinguishes departure from death on the
             # peer's failure detector

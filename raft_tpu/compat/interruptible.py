@@ -41,10 +41,8 @@ def interruptible():
         # If the SIGINT arrived while no cancellation checkpoint was
         # reached, the token would stay set and poison the thread's next
         # long-running call — consume any leftover flag on exit.
-        try:
+        with contextlib.suppress(core_interruptible.InterruptedException):
             token.check()
-        except core_interruptible.InterruptedException:
-            pass
 
 
 # pylibraft exposes the name cuda_interruptible; keep an alias with the

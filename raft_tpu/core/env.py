@@ -1,0 +1,247 @@
+"""Central registry of ``RAFT_TPU_*`` environment knobs (ISSUE 12).
+
+Every environment variable the library reads is declared here — name,
+parser, default, and what happens on a malformed value — and read
+through :func:`read`. Library code never touches ``os.environ`` for a
+``RAFT_TPU_*`` key directly; ``tools/raftlint`` rule R7 enforces that
+statically, so a new knob cannot ship without appearing in this table
+(and in ``docs/architecture.md``'s knob inventory by grep).
+
+Malformed-value policy is per-knob and preserves the contracts earlier
+PRs tested:
+
+``raise``
+    the fail-loud family (``RAFT_TPU_HBM_BUDGET``,
+    ``RAFT_TPU_RECV_TIMEOUT``, ``RAFT_TPU_SPAN_RETAIN``,
+    ``RAFT_TPU_SPAN_SAMPLE``, ``RAFT_TPU_MST``, ``RAFT_TPU_SPMV``):
+    a typo'd limit must never silently become "unlimited", so the
+    ``ValueError`` surfaces at the read site — which for import-time
+    knobs means at import.
+``warn``
+    the safe-default family (``RAFT_TPU_METRICS``,
+    ``RAFT_TPU_TRACING``, ``RAFT_TPU_GUARD_MODE``, ...): observability
+    and guard toggles degrade to their off/default mode with a visible
+    warning — a typo must not take the process down, only the feature.
+
+An empty string is treated as unset everywhere (the pre-registry
+readers already did this for every knob whose empty spelling was
+reachable).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+__all__ = ["EnvVar", "register", "registry", "read", "parse_bytes"]
+
+
+# -- parsers ----------------------------------------------------------------
+
+def _parse_str(raw: str) -> str:
+    return raw
+
+
+def _parse_lower(raw: str) -> str:
+    return raw.lower()
+
+
+def _parse_onoff(raw: str) -> bool:
+    """The metrics/tracing toggle spelling: on/1/true/yes vs
+    off/0/false/no."""
+    low = raw.lower()
+    if low in ("on", "1", "true", "yes"):
+        return True
+    if low in ("off", "0", "false", "no"):
+        return False
+    raise ValueError(f"want one of on|off|1|0|true|false|yes|no, "
+                     f"got {raw!r}")
+
+
+def _parse_flag(raw: str) -> bool:
+    """Loose boolean: anything but 0/false is on (the
+    ``RAFT_TPU_PALLAS_INTERPRET`` / ``RAFT_TPU_SPLIT_PACKED`` family)."""
+    return raw.lower() not in ("0", "false")
+
+
+def _parse_pos_int(raw: str) -> int:
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(f"{raw!r} is not an integer") from None
+    if val < 1:
+        raise ValueError(f"{raw!r} must be >= 1")
+    return val
+
+
+def _parse_rate(raw: str) -> float:
+    try:
+        rate = float(raw)
+    except ValueError:
+        raise ValueError(f"{raw!r} is not a number") from None
+    if not (0.0 <= rate <= 1.0):
+        raise ValueError(f"{raw!r} must be in [0, 1]")
+    return rate
+
+
+def _parse_float(raw: str) -> float:
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{raw!r} is not a number") from None
+
+
+def _choice(*options: str) -> Callable[[str], str]:
+    def parse(raw: str) -> str:
+        low = raw.lower()
+        if low not in options:
+            raise ValueError(f"want one of {'|'.join(options)}, "
+                             f"got {raw!r}")
+        return low
+    return parse
+
+
+_BYTE_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_bytes(text, *, name: str = "byte count") -> int:
+    """Parse a byte count: a plain number or a number with a k/m/g/t
+    binary suffix (``"512m"``, ``"2g"``). Raises ``ValueError`` on
+    anything else — the fail-loud contract for ``RAFT_TPU_HBM_BUDGET``
+    (a typo'd limit must never silently become 'unlimited').
+
+    Canonical home of the parser ``runtime.limits.parse_bytes``
+    re-exports (limits imports env; env imports nothing from
+    raft_tpu)."""
+    s = str(text).strip().lower()
+    mult = 1
+    if s and s[-1] in _BYTE_SUFFIX:
+        mult = _BYTE_SUFFIX[s[-1]]
+        s = s[:-1]
+    try:
+        val = float(s)
+    except ValueError:
+        raise ValueError(
+            f"{name} must be a byte count (optionally with a k/m/g/t "
+            f"suffix, e.g. '512m'), got {text!r}") from None
+    n = int(val * mult)
+    if n <= 0:
+        raise ValueError(f"{name} must be positive, got {text!r}")
+    return n
+
+
+# -- registry ---------------------------------------------------------------
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared knob: how to parse it and what a bad value does."""
+
+    name: str
+    parse: Callable[[str], Any]
+    default: Any
+    on_malformed: str               # "raise" | "warn"
+    help: str = ""
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def register(name: str, parse: Callable[[str], Any], default: Any = None,
+             *, on_malformed: str = "raise", help: str = "") -> EnvVar:
+    if not name.startswith("RAFT_TPU_"):
+        raise ValueError(f"env registry is for RAFT_TPU_* knobs, "
+                         f"got {name!r}")
+    if on_malformed not in ("raise", "warn"):
+        raise ValueError(f"on_malformed must be raise|warn, "
+                         f"got {on_malformed!r}")
+    spec = EnvVar(name, parse, default, on_malformed, help)
+    _REGISTRY[name] = spec
+    return spec
+
+
+def registry() -> Dict[str, EnvVar]:
+    """Snapshot of every declared knob (docs and tests iterate this)."""
+    return dict(_REGISTRY)
+
+
+_UNSET = object()
+
+
+def read(name: str, default: Any = _UNSET) -> Any:
+    """Read and parse one registered knob from the process environment.
+
+    Unset or empty returns the default (the registered one, or the
+    call-site override — e.g. the per-transport recv-timeout fallback).
+    A malformed value raises ``ValueError`` naming the variable, or —
+    for ``on_malformed="warn"`` knobs — warns and returns the default.
+    Reading an unregistered name is a programming error and raises
+    ``KeyError``: declare the knob here first.
+    """
+    spec = _REGISTRY.get(name)
+    if spec is None:
+        raise KeyError(f"{name} is not a registered RAFT_TPU_* knob; "
+                       f"declare it in raft_tpu/core/env.py")
+    fallback = spec.default if default is _UNSET else default
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return fallback
+    try:
+        return spec.parse(raw)
+    except ValueError as e:
+        if spec.on_malformed == "warn":
+            warnings.warn(f"{name}={raw!r} is invalid ({e}); using "
+                          f"{fallback!r}", stacklevel=2)
+            return fallback
+        raise ValueError(f"{name}: {e}") from None
+
+
+# -- the knob table ---------------------------------------------------------
+# Observability toggles: degrade to off with a warning.
+register("RAFT_TPU_METRICS", _parse_onoff, False, on_malformed="warn",
+         help="arm the metrics/span subsystem (off = single-bool no-op)")
+register("RAFT_TPU_TRACING", _parse_onoff, False, on_malformed="warn",
+         help="mint + propagate request TraceContexts")
+register("RAFT_TPU_GUARD_MODE", _choice("off", "check", "recover"), "off",
+         on_malformed="warn",
+         help="numerical sentinel mode (core/guards.py)")
+register("RAFT_TPU_MATMUL_PRECISION", _parse_lower, "high",
+         on_malformed="warn",
+         help="matmul precision policy; canonicalized in util/precision")
+register("RAFT_TPU_LOG_LEVEL", _parse_lower, "warn", on_malformed="warn",
+         help="raft_tpu logger level; unknown names fall back to warn "
+              "silently (core/logger.py owns the level table)")
+register("RAFT_TPU_DEBUG_LOG_FILE", _parse_str, None, on_malformed="warn",
+         help="route the raft_tpu logger to a file instead of stderr")
+register("RAFT_TPU_METRICS_JSONL", _parse_str, None, on_malformed="warn",
+         help="auto-attach a JSONL metrics sink at import (metrics on)")
+register("RAFT_TPU_FLIGHT_DIR", _parse_str, None, on_malformed="warn",
+         help="on-disk flight-recorder bundle directory")
+
+# Fail-loud limits and tuning knobs: malformed raises at the read site
+# (import time for the import-read ones) — never a silent fallback.
+register("RAFT_TPU_HBM_BUDGET", _parse_str, None,
+         help="process-wide HBM admission budget; parsed by "
+              "limits.parse_bytes (k/m/g/t suffixes) and raises at "
+              "import on a malformed value")
+register("RAFT_TPU_RECV_TIMEOUT", _parse_float, None,
+         help="default blocking-recv deadline (s) for both transports")
+register("RAFT_TPU_SPAN_RETAIN", _parse_pos_int, 2048,
+         help="span ring retention (newest N spans)")
+register("RAFT_TPU_SPAN_SAMPLE", _parse_rate, 1.0,
+         help="span sampling rate in [0, 1] (counter-stride, "
+              "deterministic)")
+register("RAFT_TPU_MST", _choice("auto", "grid", "xla"), "auto",
+         help="force the Borůvka E-stage formulation")
+register("RAFT_TPU_SPMV", _choice("auto", "grid", "ell", "segment"), "auto",
+         help="force the SpMV formulation")
+
+# Loose flags (any value but 0/false arms them).
+register("RAFT_TPU_PALLAS_INTERPRET", _parse_flag, None,
+         on_malformed="warn",
+         help="force Pallas interpret mode on/off (unset = by backend)")
+register("RAFT_TPU_SPLIT_PACKED", _parse_flag, False, on_malformed="warn",
+         help="packed-operand spelling for the bf16x3 cross terms")
+register("RAFT_TPU_SPARSE_PAD", _parse_flag, True, on_malformed="warn",
+         help="pad sparse buffers to lane-friendly capacities")

@@ -51,13 +51,13 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import os
 import threading
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core import env as _env_mod
 from raft_tpu.core import logger, trace
 from raft_tpu import obs
 
@@ -160,16 +160,7 @@ class ArtifactCorruptError(RuntimeError):
 # guard-mode knob
 # ---------------------------------------------------------------------------
 
-_env_mode = os.environ.get("RAFT_TPU_GUARD_MODE", "off").lower()
-if _env_mode not in GUARD_MODES:
-    import warnings
-
-    warnings.warn(
-        f"RAFT_TPU_GUARD_MODE={_env_mode!r} is not one of {GUARD_MODES}; "
-        "using 'off'", stacklevel=2)
-    _env_mode = "off"
-
-_mode = _env_mode
+_mode = _env_mod.read("RAFT_TPU_GUARD_MODE")
 _tls = threading.local()
 
 

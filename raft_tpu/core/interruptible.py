@@ -13,6 +13,7 @@ each iteration; ``cancel()`` from any thread makes the next check raise.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Callable, Dict, Optional
 
@@ -73,10 +74,9 @@ class CancelToken:
 
     def remove_waker(self, waker: Callable[[], None]) -> None:
         with self._wlock:
-            try:
+            # benign double-unregister: already removed
+            with contextlib.suppress(ValueError):
                 self._wakers.remove(waker)
-            except ValueError:
-                pass  # already removed — benign double-unregister
 
 
 _registry_lock = threading.Lock()

@@ -9,8 +9,9 @@ env-var file sink (``RAFT_DEBUG_LOG_FILE``).  Here: a stdlib logger named
 from __future__ import annotations
 
 import logging
-import os
 import threading
+
+from raft_tpu.core import env as _env_mod
 
 LEVELS = {
     "trace": 5,
@@ -28,13 +29,13 @@ logger = logging.getLogger("raft_tpu")
 
 if not logger.handlers:
     _handler: logging.Handler
-    _file = os.environ.get("RAFT_TPU_DEBUG_LOG_FILE")
+    _file = _env_mod.read("RAFT_TPU_DEBUG_LOG_FILE")
     _handler = logging.FileHandler(_file) if _file else logging.StreamHandler()
     _handler.setFormatter(
         logging.Formatter("[%(levelname)s] [%(asctime)s] %(message)s"))
     logger.addHandler(_handler)
     logger.setLevel(
-        LEVELS.get(os.environ.get("RAFT_TPU_LOG_LEVEL", "warn"), logging.WARNING))
+        LEVELS.get(_env_mod.read("RAFT_TPU_LOG_LEVEL"), logging.WARNING))
 
 
 def set_level(level: str) -> None:

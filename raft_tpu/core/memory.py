@@ -16,6 +16,7 @@ collapse to observability + policy here:
 
 from __future__ import annotations
 
+import contextlib
 import csv
 import mmap
 import os
@@ -197,11 +198,11 @@ class MmapBuffer:
             return
         self._closed = True
         try:
-            self._mmap.close()
-        except BufferError:
-            # Arrays still view the mapping; the OS reclaims it when they
-            # are garbage collected (the tmpfile is already unlinked).
-            pass
+            # Arrays may still view the mapping; the OS reclaims it when
+            # they are garbage collected (the tmpfile is already
+            # unlinked).
+            with contextlib.suppress(BufferError):
+                self._mmap.close()
         finally:
             self._file.close()
 

@@ -16,6 +16,7 @@ core.interruptible remain the implementation.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import threading
 import weakref
@@ -119,15 +120,18 @@ class TrackedHostPool:
         """Observer hook: fn(is_alloc: bool, nbytes: int)
         (ref: mr/notifying_adaptor.hpp)."""
         if fn is None:
-            self._cb = None
+            with self._lock:
+                self._cb = None
             self._lib.rt_pool_set_notify(self._pool, None, None)
             return
         cb_t = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_int64,
                                 ctypes.c_void_p)
-        self._cb = cb_t(lambda is_alloc, nbytes, _:
-                        fn(bool(is_alloc), int(nbytes)))
+        cb = cb_t(lambda is_alloc, nbytes, _:
+                  fn(bool(is_alloc), int(nbytes)))
+        with self._lock:
+            self._cb = cb      # keep the ctypes thunk alive on self
         self._lib.rt_pool_set_notify(
-            self._pool, ctypes.cast(self._cb, ctypes.c_void_p), None)
+            self._pool, ctypes.cast(cb, ctypes.c_void_p), None)
 
     def close(self) -> None:
         if getattr(self, "_pool", None):
@@ -135,9 +139,9 @@ class TrackedHostPool:
                 for _, fin in self._ptrs.values():
                     fin.detach()   # pool destroy frees everything at once
                 self._ptrs.clear()
-            self._alive["pool"] = None
-            self._lib.rt_pool_destroy(self._pool)
-            self._pool = None
+                self._alive["pool"] = None
+                pool, self._pool = self._pool, None
+            self._lib.rt_pool_destroy(pool)
 
     def __del__(self):
         try:
@@ -277,9 +281,8 @@ def native_check_cancelled(thread_id: Optional[int] = None) -> bool:
         token = interruptible.get_token(tid)
         cancelled = token.cancelled()
         if cancelled:
-            try:
+            # consume the flag, mirroring the native check's semantics
+            with contextlib.suppress(interruptible.InterruptedException):
                 token.check()
-            except interruptible.InterruptedException:
-                pass
         return cancelled
     return bool(lib.rt_interruptible_check(tid))

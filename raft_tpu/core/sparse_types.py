@@ -13,7 +13,6 @@ functions with indices/values as leaves.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Optional, Tuple
 
 import jax
@@ -52,7 +51,9 @@ def nnz_bucket(n: int, min_size: int = PAD_MIN_NNZ) -> int:
 
 
 def _default_pad() -> bool:
-    return os.environ.get("RAFT_TPU_SPARSE_PAD", "1") not in ("0", "false")
+    from raft_tpu.core import env
+
+    return env.read("RAFT_TPU_SPARSE_PAD")
 
 
 class CSRMatrix:

@@ -188,9 +188,9 @@ def _packed_split_default() -> bool:
     to vary the spelling at runtime must pass ``packed=`` explicitly
     (what benches/tune_northstar.py does); the env var is a process-level
     default, set before first use."""
-    import os
+    from raft_tpu.core import env
 
-    return os.environ.get("RAFT_TPU_SPLIT_PACKED", "0") not in ("0", "")
+    return env.read("RAFT_TPU_SPLIT_PACKED")
 
 
 def _cross_split(xh, xl, yh_t, yl_t, packed: bool = False):

@@ -21,6 +21,7 @@ the contract ci/smoke.sh validates via :mod:`raft_tpu.obs.schema`.
 from __future__ import annotations
 
 import atexit
+import contextlib
 import collections
 import io
 import json
@@ -92,10 +93,9 @@ class JsonlSink:
             if self._closed:
                 return
             self._closed = True
-            try:
+            # underlying stream may already be closed at interpreter exit
+            with contextlib.suppress(ValueError):
                 self._fh.flush()
-            except ValueError:      # underlying stream already closed
-                pass
             if self._owns:
                 self._fh.close()
 
@@ -315,8 +315,8 @@ def _list_all_spans() -> List[dict]:
 # -- import-time sink attachment (env-driven, metrics-on only) --------------
 
 def _maybe_attach_env_sink() -> None:
-    import os
-    path = os.environ.get("RAFT_TPU_METRICS_JSONL")
+    from raft_tpu.core import env
+    path = env.read("RAFT_TPU_METRICS_JSONL")
     if path and _metrics.enabled() and get_sink() is None:
         set_sink(JsonlSink(path))
 

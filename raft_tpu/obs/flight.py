@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Deque, List, Optional
 
+from raft_tpu.core import env as _env_mod
 from raft_tpu.obs import metrics as _metrics
 from raft_tpu.obs import tracectx as _tracectx
 
@@ -48,7 +49,7 @@ _lock = threading.Lock()
 _bundles: Deque[dict] = collections.deque(maxlen=_RETAIN)
 _seq = 0
 _files_written = 0
-_dir: Optional[str] = os.environ.get("RAFT_TPU_FLIGHT_DIR") or None
+_dir: Optional[str] = _env_mod.read("RAFT_TPU_FLIGHT_DIR")
 
 
 def set_flight_dir(path: Optional[str]) -> Optional[str]:

@@ -33,9 +33,10 @@ from __future__ import annotations
 
 import bisect
 import math
-import os
 import threading
 from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+from raft_tpu.core import env as _env_mod
 
 __all__ = [
     "enabled", "set_enabled", "MetricsRegistry",
@@ -50,22 +51,7 @@ __all__ = [
 # import, bad values warn and fall back to the safe default)
 # ---------------------------------------------------------------------------
 
-_METRICS_MODES = ("off", "on")
-
-_env = os.environ.get("RAFT_TPU_METRICS", "off").lower()
-if _env in ("1", "true", "yes"):
-    _env = "on"
-elif _env in ("0", "false", "no", ""):
-    _env = "off"
-if _env not in _METRICS_MODES:
-    import warnings
-
-    warnings.warn(
-        f"RAFT_TPU_METRICS={_env!r} is not one of {_METRICS_MODES}; "
-        "using 'off'", stacklevel=2)
-    _env = "off"
-
-_enabled = _env == "on"
+_enabled = _env_mod.read("RAFT_TPU_METRICS")
 
 
 def enabled() -> bool:

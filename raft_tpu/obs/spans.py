@@ -37,11 +37,11 @@ from __future__ import annotations
 
 import collections
 import contextlib
-import os
 import threading
 import time
 from typing import Deque, Dict, List, Optional
 
+from raft_tpu.core import env as _env_mod
 from raft_tpu.obs import metrics as _metrics
 from raft_tpu.obs import tracectx as _tracectx
 
@@ -52,42 +52,13 @@ _lock = threading.Lock()
 _counts: Dict[str, int] = {}      # per-name emission counter (sampling)
 
 
-def _env_int(name: str, default: int) -> int:
-    """Parse a positive-int env knob; malformed or < 1 raises at import
-    (fail-loud, matching RAFT_TPU_RECV_TIMEOUT / RAFT_TPU_HBM_BUDGET)."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        val = int(raw)
-    except ValueError:
-        raise ValueError(
-            f"{name}={raw!r} is not an integer") from None
-    if val < 1:
-        raise ValueError(f"{name}={raw!r} must be >= 1")
-    return val
-
-
-def _env_rate(name: str, default: float) -> float:
-    """Parse a [0, 1] rate env knob; malformed or out-of-range raises
-    at import (fail-loud)."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        rate = float(raw)
-    except ValueError:
-        raise ValueError(
-            f"{name}={raw!r} is not a number") from None
-    if not (0.0 <= rate <= 1.0):
-        raise ValueError(f"{name}={raw!r} must be in [0, 1]")
-    return rate
-
-
+# Both knobs are fail-loud at import (matching RAFT_TPU_RECV_TIMEOUT /
+# RAFT_TPU_HBM_BUDGET): a malformed retention or sample rate raises
+# rather than silently keeping the default.
 _spans: Deque[dict] = collections.deque(
-    maxlen=_env_int("RAFT_TPU_SPAN_RETAIN", 2048))
+    maxlen=_env_mod.read("RAFT_TPU_SPAN_RETAIN"))
 _sample_stride = (
-    0 if (_r := _env_rate("RAFT_TPU_SPAN_SAMPLE", 1.0)) == 0.0
+    0 if (_r := _env_mod.read("RAFT_TPU_SPAN_SAMPLE")) == 0.0
     else max(1, int(round(1.0 / _r))))
 
 

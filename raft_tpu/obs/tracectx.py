@@ -25,11 +25,12 @@ from __future__ import annotations
 
 import contextlib
 import json
-import os
 import threading
 import uuid
 from dataclasses import dataclass
 from typing import Optional
+
+from raft_tpu.core import env as _env_mod
 
 __all__ = [
     "TraceContext", "tracing_enabled", "set_tracing", "mint",
@@ -40,22 +41,7 @@ __all__ = [
 # -- the on/off knob (pattern: metrics.RAFT_TPU_METRICS — env read once
 # at import, bad values warn and fall back to the safe default) ------------
 
-_TRACING_MODES = ("off", "on")
-
-_env = os.environ.get("RAFT_TPU_TRACING", "off").lower()
-if _env in ("1", "true", "yes"):
-    _env = "on"
-elif _env in ("0", "false", "no", ""):
-    _env = "off"
-if _env not in _TRACING_MODES:
-    import warnings
-
-    warnings.warn(
-        f"RAFT_TPU_TRACING={_env!r} is not one of {_TRACING_MODES}; "
-        "using 'off'", stacklevel=2)
-    _env = "off"
-
-_tracing = _env == "on"
+_tracing = _env_mod.read("RAFT_TPU_TRACING")
 
 
 def tracing_enabled() -> bool:

@@ -63,12 +63,12 @@ latency SLO actually has).
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
 import time
 from typing import Dict, Optional
 
 from raft_tpu import obs
+from raft_tpu.core import env as _env_mod
 
 __all__ = [
     "DeadlineExceededError", "RejectedError",
@@ -280,30 +280,10 @@ def sleep_within_deadline(seconds: float, *, op: str = "sleep") -> None:
 # work budgets (HBM admission)
 # ---------------------------------------------------------------------------
 
-_BYTE_SUFFIX = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
-
-
-def parse_bytes(text, *, name: str = "byte count") -> int:
-    """Parse a byte count: a plain number or a number with a k/m/g/t
-    binary suffix (``"512m"``, ``"2g"``). Raises ``ValueError`` on
-    anything else — the fail-loud contract for ``RAFT_TPU_HBM_BUDGET``
-    (and the same discipline as ``RAFT_TPU_SPMV`` / ``RAFT_TPU_MST``
-    parsing: a typo'd limit must never silently become 'unlimited')."""
-    s = str(text).strip().lower()
-    mult = 1
-    if s and s[-1] in _BYTE_SUFFIX:
-        mult = _BYTE_SUFFIX[s[-1]]
-        s = s[:-1]
-    try:
-        val = float(s)
-    except ValueError:
-        raise ValueError(
-            f"{name} must be a byte count (optionally with a k/m/g/t "
-            f"suffix, e.g. '512m'), got {text!r}") from None
-    n = int(val * mult)
-    if n <= 0:
-        raise ValueError(f"{name} must be positive, got {text!r}")
-    return n
+# parse_bytes moved to core/env.py (the knob-registry home of every
+# RAFT_TPU_* parser); re-exported here because it has been limits' public
+# API since PR 5.
+parse_bytes = _env_mod.parse_bytes
 
 
 class WorkBudget:
@@ -348,7 +328,7 @@ class WorkBudget:
 # process-global default budget, seeded from the env at import. A
 # malformed value raises HERE (import time) — loud, immediate, and
 # impossible to mistake for "unlimited".
-_env_budget = os.environ.get("RAFT_TPU_HBM_BUDGET")
+_env_budget = _env_mod.read("RAFT_TPU_HBM_BUDGET")
 _default_budget: Optional[WorkBudget] = (
     WorkBudget(parse_bytes(_env_budget, name="RAFT_TPU_HBM_BUDGET"))
     if _env_budget is not None and _env_budget.strip() != "" else None)

@@ -242,9 +242,10 @@ def _slo_latency_s(group, tenant: str) -> Optional[float]:
     for r in group.replicas:
         qos = getattr(r.executor, "qos", None)
         if qos is not None:
+            # a policy table without SLO fields means "no SLO configured"
             try:
                 return qos.policy(tenant).slo_latency_s
-            except Exception:
+            except (AttributeError, KeyError):
                 return None
     return None
 

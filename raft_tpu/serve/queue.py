@@ -262,7 +262,10 @@ class RequestQueue:
         dl = limits.Deadline(deadline_s) if deadline_s is not None else None
         with self._cond:
             if self._closed:
-                raise RuntimeError("queue is closed")
+                raise limits.RejectedError(
+                    f"serve.{op}: queue is closed — the serving runtime "
+                    "is shutting down", op=f"serve.{op}",
+                    reason="queue_closed")
             if self._pending >= self.policy.max_queue:
                 obs.inc("limits_rejected_total", 1, reason="queue_full",
                         op=f"serve.{op}")

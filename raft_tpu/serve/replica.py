@@ -200,7 +200,8 @@ class ReplicaGroup:
         with self._lock:
             order = self._pick_order()
         if not order:
-            self.stats.rejected += 1
+            with self._lock:
+                self.stats.rejected += 1
             raise limits.RejectedError(
                 f"serve.{op}: no healthy replica in the group",
                 op=f"serve.{op}", reason="no_replica")
@@ -226,7 +227,8 @@ class ReplicaGroup:
                 if n_tried:
                     pass            # spill already counted above
             return r, fut
-        self.stats.rejected += 1
+        with self._lock:
+            self.stats.rejected += 1
         raise last_exc
 
     def submit(self, op: str, queries, *, tenant: str = "default",
@@ -259,7 +261,8 @@ class ReplicaGroup:
         survivors = tuple(self.comms.agree_on_survivors(timeout))
         dead = tuple(sorted(set(range(old_size)) - set(survivors)))
         new_comms = self.comms.shrink(survivors)
-        self.comms = new_comms
+        with self._lock:
+            self.comms = new_comms
         for r in dead:
             if r < len(self._replicas):
                 self.mark_failed(r, reason)
@@ -326,7 +329,8 @@ class ReplicaGroup:
         for r in self._replicas:
             if r.healthy:
                 r.executor.start()
-        self._started = True
+        with self._lock:
+            self._started = True
         obs.emit_event("serve.group_start",
                        replicas=[r.name for r in self._replicas])
         return self
@@ -335,7 +339,8 @@ class ReplicaGroup:
         for r in self._replicas:
             if r.healthy:
                 r.executor.stop()
-        self._started = False
+        with self._lock:
+            self._started = False
         s = self.stats
         obs.emit_event("serve.group_stop", routed=s.routed,
                        spills=s.spills, rejected=s.rejected,

@@ -9,6 +9,7 @@ function *is* the preprocessed plan, cached by (shape, nnz, dtype).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional
 
@@ -68,12 +69,9 @@ def spmv_method(a=None, x=None) -> str:
     BOTH sides — under ``jax_enable_x64`` a f64 operand promotes the
     segment result to f64, and the grid plan (f32 compute) must not flip
     the output dtype based on nnz crossing the threshold."""
-    import os
+    from raft_tpu.core import env
 
-    m = os.environ.get("RAFT_TPU_SPMV", "auto").lower()
-    if m not in ("auto", "grid", "ell", "segment"):
-        raise ValueError(f"RAFT_TPU_SPMV must be auto|grid|ell|segment, "
-                         f"got {m}")
+    m = env.read("RAFT_TPU_SPMV")
     if m != "auto" or a is None:
         return m
     from raft_tpu.util.pallas_utils import use_interpret
@@ -96,14 +94,12 @@ def spmv_method(a=None, x=None) -> str:
         if plan.pad_ratio <= _GRID_MAX_PAD_RATIO:
             method = "grid"     # plan stays memoized for the apply
         else:
-            try:                # reject: free the oversized grid arrays
+            # reject: free the oversized grid arrays (frozen containers
+            # that forbid attribute writes simply skip the memo)
+            with contextlib.suppress(AttributeError):
                 del a._grid_plan
-            except AttributeError:
-                pass
-    try:
+    with contextlib.suppress(AttributeError):
         a._spmv_auto_method = method
-    except AttributeError:
-        pass
     return method
 
 
@@ -188,10 +184,8 @@ def _cached_plan(a):
         from raft_tpu.sparse.grid_spmv import prepare
 
         plan = prepare(a)
-        try:
-            a._grid_plan = plan
-        except AttributeError:
-            pass
+        with contextlib.suppress(AttributeError):
+            a._grid_plan = plan    # frozen containers skip the memo
     return plan
 
 

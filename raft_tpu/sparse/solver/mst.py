@@ -22,6 +22,7 @@ TPU formulation — fully device-resident rounds:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Optional
@@ -199,11 +200,9 @@ def _mst_method(csr) -> str:
     {auto, grid, xla} forces a path; ``auto`` picks the slot-grid Pallas
     E-stage (mst_grid.py) for large f32 graphs on the compiled backend,
     subject to the plan's pad-ratio gate (same bound as SpMV's)."""
-    import os
+    from raft_tpu.core import env
 
-    m = os.environ.get("RAFT_TPU_MST", "auto").lower()
-    if m not in ("auto", "grid", "xla"):
-        raise ValueError(f"RAFT_TPU_MST must be auto|grid|xla, got {m}")
+    m = env.read("RAFT_TPU_MST")
     if m != "auto":
         return m
     from raft_tpu.sparse.linalg import _GRID_MAX_PAD_RATIO
@@ -220,11 +219,9 @@ def _mst_method(csr) -> str:
                        # must not re-run per call just to re-decide
     mp = _cached_mst_plan(csr)
     if mp.plan.pad_ratio > _GRID_MAX_PAD_RATIO:
-        try:
+        with contextlib.suppress(AttributeError):
             del csr._mst_grid_plan
             csr._mst_grid_reject = True
-        except AttributeError:
-            pass
         return "xla"
     return "grid"
 
@@ -235,10 +232,8 @@ def _cached_mst_plan(csr):
         from raft_tpu.sparse.solver.mst_grid import prepare_mst
 
         mp = prepare_mst(csr)
-        try:
-            csr._mst_grid_plan = mp
-        except AttributeError:
-            pass
+        with contextlib.suppress(AttributeError):
+            csr._mst_grid_plan = mp    # frozen containers skip the memo
     return mp
 
 

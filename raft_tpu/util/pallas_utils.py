@@ -22,7 +22,6 @@ tier — hardware always runs the real kernel.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 from jax.experimental import pallas as pl
@@ -34,9 +33,11 @@ MIN_BLOCK = (8, 128)
 @functools.lru_cache(maxsize=None)
 def use_interpret() -> bool:
     """True when Pallas must run interpreted (no TPU backend present)."""
-    forced = os.environ.get("RAFT_TPU_PALLAS_INTERPRET")
+    from raft_tpu.core import env
+
+    forced = env.read("RAFT_TPU_PALLAS_INTERPRET")
     if forced is not None:
-        return forced not in ("0", "false", "")
+        return forced
     return jax.default_backend() != "tpu"
 
 

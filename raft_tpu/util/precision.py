@@ -35,10 +35,11 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 
 import jax
 from jax import lax
+
+from raft_tpu.core import env
 
 __all__ = ["set_matmul_precision", "get_matmul_precision", "scope",
            "with_matmul_precision", "resolve"]
@@ -55,7 +56,7 @@ _AS_LAX = {
     "highest": lax.Precision.HIGHEST,
 }
 
-_env = os.environ.get("RAFT_TPU_MATMUL_PRECISION", "high").lower()
+_env = env.read("RAFT_TPU_MATMUL_PRECISION")
 _policy = _CANON.get(_env)
 if _policy is None:
     import warnings

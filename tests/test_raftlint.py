@@ -1,0 +1,475 @@
+"""raftlint: per-rule positive/negative fixtures, baseline semantics,
+and the shipped-baseline-matches-tree self-check.
+
+Fixture trees are written under tmp_path as a package named
+``raft_tpu`` because most rules scope themselves to the real package
+name (R4's taxonomy, R5's helper table, R6's obs boundary, R7's env
+registry, R8's numeric scopes).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.raftlint import cli
+from tools.raftlint.core import Project
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def write_tree(root: Path, files: dict) -> None:
+    for rel, src in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src), encoding="utf-8")
+        # every package dir needs an __init__ for dotted modnames
+        d = path.parent
+        while d != root:
+            init = d / "__init__.py"
+            if not init.exists():
+                init.write_text("", encoding="utf-8")
+            d = d.parent
+
+
+def lint(root: Path, files: dict, *, rules=None) -> list:
+    """Scan a fixture tree and return findings (optionally one rule)."""
+    write_tree(root, files)
+    project = Project(str(root))
+    project.scan(["raft_tpu"])
+    assert not project.errors, project.errors
+    return cli.run_rules(project, {rules} if isinstance(rules, str)
+                         else rules)
+
+
+def rule_ids(findings) -> set:
+    return {f.rule for f in findings}
+
+
+def run_cli(root: Path, *argv) -> tuple:
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), \
+            contextlib.redirect_stderr(buf):
+        code = cli.main(["raft_tpu", "--root", str(root), *argv])
+    return code, buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# R1: jit purity
+
+
+def test_r1_flags_numpy_in_jit_body(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.sin(x)
+    """}, rules="R1")
+    assert rule_ids(findings) == {"R1"}
+    assert findings[0].symbol == "raft_tpu.a:f"
+
+
+def test_r1_follows_the_call_graph(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return float(np.sum(x))
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+    """}, rules="R1")
+    assert any(f.symbol == "raft_tpu.a:helper" for f in findings)
+
+
+def test_r1_clean_jnp_and_static_branching_pass(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        from functools import partial
+
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            if n > 4:          # static arg: host branching is fine
+                return jnp.sin(x)
+            return jnp.cos(x)
+    """}, rules="R1")
+    assert findings == []
+
+
+def test_r1_host_branch_on_traced_param(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x:
+                return x
+            return -x
+    """}, rules="R1")
+    assert rule_ids(findings) == {"R1"}
+
+
+# ---------------------------------------------------------------------------
+# R2: recompile hazards
+
+
+def test_r2_flags_jit_of_local_def(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+
+        def call(x):
+            def inner(y):
+                return y * 2
+            return jax.jit(inner)(x)
+    """}, rules="R2")
+    assert rule_ids(findings) == {"R2"}
+
+
+def test_r2_module_level_jit_and_lru_cache_pass(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import functools
+
+        import jax
+
+        def _impl(y):
+            return y * 2
+
+        g = jax.jit(_impl)
+
+        @functools.lru_cache(maxsize=None)
+        def build(n):
+            def inner(y):
+                return y * n
+            return jax.jit(inner)
+    """}, rules="R2")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# R3: lock discipline
+
+
+LOCKED_CLASS = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def bump(self):
+            %s
+"""
+
+
+def test_r3_flags_unlocked_field_write(tmp_path):
+    findings = lint(tmp_path, {
+        "raft_tpu/a.py": LOCKED_CLASS % "self.count += 1"},
+        rules="R3")
+    assert rule_ids(findings) == {"R3"}
+    assert findings[0].symbol == "raft_tpu.a:Box.bump"
+
+
+def test_r3_locked_write_passes(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": LOCKED_CLASS % (
+        "with self._lock:\n                self.count += 1")},
+        rules="R3")
+    assert findings == []
+
+
+def test_r3_private_helper_called_only_under_lock_passes(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def bump(self):
+                with self._lock:
+                    self._inc()
+
+            def _inc(self):
+                self.count += 1
+    """}, rules="R3")
+    assert findings == []
+
+
+def test_r3_lock_order_cycle(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import threading
+
+        class Two:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """}, rules="R3")
+    assert any("order cycle" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# R4: typed-error taxonomy
+
+
+def test_r4_flags_untyped_raise_and_broad_except(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        def f():
+            raise RuntimeError("boom")
+
+        def g():
+            try:
+                f()
+            except Exception:
+                return None
+
+        def h():
+            try:
+                f()
+            except ValueError:
+                pass
+    """}, rules="R4")
+    assert len(findings) == 3
+    assert rule_ids(findings) == {"R4"}
+
+
+def test_r4_typed_raise_and_narrow_except_pass(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import contextlib
+
+        class CommsError(RuntimeError):
+            pass
+
+        def f():
+            raise CommsError("peer died")
+
+        def g():
+            try:
+                f()
+            except CommsError:
+                return None
+            with contextlib.suppress(ValueError):
+                f()
+    """}, rules="R4")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# R5: off-path purity of the obs emit helpers
+
+
+def test_r5_flags_helper_without_leading_gate(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/obs/metrics.py": """
+        _enabled = False
+
+        def inc(name, value=1, **labels):
+            key = (name, tuple(sorted(labels.items())))
+            if not _enabled:
+                return
+    """}, rules="R5")
+    assert any(f.symbol == "raft_tpu.obs.metrics:inc" for f in findings)
+
+
+def test_r5_leading_gate_passes(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/obs/metrics.py": """
+        _enabled = False
+
+        def inc(name, value=1, **labels):
+            if not _enabled:
+                return
+            key = (name, tuple(sorted(labels.items())))
+    """}, rules="R5")
+    assert not any(f.symbol == "raft_tpu.obs.metrics:inc"
+                   for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# R6: obs API boundary
+
+
+def test_r6_flags_submodule_import_outside_obs(tmp_path):
+    findings = lint(tmp_path, {
+        "raft_tpu/obs/metrics.py": "def inc(*a, **k):\n    pass\n",
+        "raft_tpu/solver.py": """
+            from raft_tpu.obs.metrics import inc
+
+            def f():
+                inc("x")
+        """}, rules="R6")
+    assert rule_ids(findings) == {"R6"}
+    assert findings[0].path.endswith("solver.py")
+
+
+def test_r6_facade_import_and_intra_obs_pass(tmp_path):
+    findings = lint(tmp_path, {
+        "raft_tpu/obs/metrics.py": "def inc(*a, **k):\n    pass\n",
+        "raft_tpu/obs/export.py": (
+            "from raft_tpu.obs import metrics\n"),
+        "raft_tpu/solver.py": """
+            from raft_tpu import obs
+
+            def f():
+                obs.inc("x")
+        """}, rules="R6")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# R7: env knobs go through the registry
+
+
+def test_r7_flags_direct_env_read(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import os
+
+        FLAG = os.getenv("RAFT_TPU_FLAG", "0")
+        OTHER = os.environ.get("RAFT_TPU_OTHER")
+        THIRD = os.environ["RAFT_TPU_THIRD"]
+    """}, rules="R7")
+    assert len(findings) == 3
+    assert rule_ids(findings) == {"R7"}
+
+
+def test_r7_registry_module_and_foreign_vars_pass(tmp_path):
+    findings = lint(tmp_path, {
+        "raft_tpu/core/env.py": """
+            import os
+
+            def read(name):
+                return os.environ.get(name)
+        """,
+        "raft_tpu/a.py": """
+            import os
+
+            HOME = os.environ.get("HOME")
+        """}, rules="R7")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# R8: annotated numerical breakdown sites
+
+
+def test_r8_flags_unguarded_sqrt_in_numeric_scope(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/linalg/a.py": """
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sqrt(x)
+    """}, rules="R8")
+    assert rule_ids(findings) == {"R8"}
+
+
+def test_r8_guard_token_on_line_passes(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/linalg/a.py": """
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sqrt(jnp.maximum(x, 0.0))
+    """}, rules="R8")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics (via the CLI)
+
+
+VIOLATION = {"raft_tpu/a.py": "def f():\n    raise RuntimeError('x')\n"}
+
+
+def test_cli_exit_codes_and_baseline_waiver(tmp_path):
+    write_tree(tmp_path, VIOLATION)
+    bl = tmp_path / "bl.json"
+
+    code, out = run_cli(tmp_path, "--baseline", str(bl))
+    assert code == 1 and "R4" in out
+
+    bl.write_text(json.dumps({"version": 1, "entries": [{
+        "rule": "R4", "file": "raft_tpu/a.py",
+        "symbol": "raft_tpu.a:f", "why": "fixture"}]}))
+    code, out = run_cli(tmp_path, "--baseline", str(bl))
+    assert code == 0 and "1 waived" in out
+
+    # --no-baseline reports the full debt regardless
+    code, out = run_cli(tmp_path, "--baseline", str(bl),
+                        "--no-baseline")
+    assert code == 1 and "R4" in out
+
+
+def test_stale_baseline_entry_fails(tmp_path):
+    write_tree(tmp_path, {"raft_tpu/a.py": "def f():\n    return 1\n"})
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [{
+        "rule": "R4", "file": "raft_tpu/a.py",
+        "symbol": "raft_tpu.a:f", "why": "paid off"}]}))
+    code, out = run_cli(tmp_path, "--baseline", str(bl))
+    assert code == 1 and "stale" in out
+
+
+def test_baseline_rejects_per_line_waivers(tmp_path):
+    write_tree(tmp_path, VIOLATION)
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [{
+        "rule": "R4", "file": "raft_tpu/a.py",
+        "symbol": "raft_tpu.a:f", "why": "x", "line": 2}]}))
+    code, out = run_cli(tmp_path, "--baseline", str(bl))
+    assert code == 2 and "never per line" in out
+
+
+def test_write_baseline_emits_todo_whys(tmp_path):
+    write_tree(tmp_path, VIOLATION)
+    bl = tmp_path / "bl.json"
+    code, _ = run_cli(tmp_path, "--write-baseline", str(bl))
+    assert code == 0
+    doc = json.loads(bl.read_text())
+    assert doc["entries"][0]["symbol"] == "raft_tpu.a:f"
+    assert "TODO" in doc["entries"][0]["why"]
+
+
+def test_unknown_rule_id_is_a_usage_error(tmp_path):
+    write_tree(tmp_path, VIOLATION)
+    code, out = run_cli(tmp_path, "--rules", "R99")
+    assert code == 2 and "unknown rule" in out
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree and baseline agree exactly
+
+
+def test_shipped_tree_is_clean_under_shipped_baseline():
+    """No new findings AND no stale entries: the checked-in baseline is
+    an exact inventory of the tree's remaining debt."""
+    code, out = run_cli(REPO_ROOT)
+    assert code == 0, out
+    assert "0 new finding(s)" in out
+    assert "0 stale" in out
+
+
+def test_shipped_baseline_entries_all_carry_real_whys():
+    doc = json.loads(
+        (REPO_ROOT / "tools/raftlint/baseline.json").read_text())
+    for e in doc["entries"]:
+        assert e["why"] and "TODO" not in e["why"], e
+        assert "line" not in e, e

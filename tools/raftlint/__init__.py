@@ -1,0 +1,11 @@
+"""raftlint: AST-level invariant checker for the raft_tpu tree.
+
+Static teeth for the disciplines the repo's PRs established at runtime:
+jit purity, recompile hazards, lock discipline, the typed-error
+taxonomy, off-path purity, the obs API boundary, env-knob registration,
+and annotated numerical breakdown sites. See docs/raftlint.md for the
+rule catalog and tools/raftlint/baseline.json for the waived debt.
+"""
+
+from tools.raftlint.core import Finding, Project  # noqa: F401
+from tools.raftlint.rules import ALL_RULES        # noqa: F401

@@ -1,0 +1,4 @@
+from tools.raftlint.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

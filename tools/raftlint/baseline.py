@@ -1,0 +1,82 @@
+"""Baseline: waive pre-existing violations per (rule, file, symbol).
+
+The key is deliberately the SYMBOL, never a line number: symbols
+survive refactors that move code around inside a file, so the baseline
+does not rot on every edit — and a waiver cannot silently start
+covering a *new* violation of the same rule elsewhere in the file.
+
+Every entry carries a one-line ``why``. Stale entries (the violation
+they waive no longer exists) FAIL the run by default: a fixed debt must
+be deleted from the baseline in the same change, keeping the file an
+exact inventory of the remaining debt (--no-baseline prints it all).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence, Set, Tuple
+
+from tools.raftlint.core import Finding
+
+DEFAULT_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+Key = Tuple[str, str, str]          # (rule, file, symbol)
+
+
+class Baseline:
+    def __init__(self, entries: List[dict]) -> None:
+        self.entries = entries
+        self.by_key: Dict[Key, dict] = {
+            (e["rule"], e["file"], e["symbol"]): e for e in entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+        entries = doc["entries"] if isinstance(doc, dict) else doc
+        for e in entries:
+            missing = {"rule", "file", "symbol", "why"} - set(e)
+            if missing:
+                raise ValueError(
+                    f"baseline entry {e!r} missing {sorted(missing)}")
+            if "line" in e:
+                raise ValueError(
+                    "baseline entries waive per (rule, file, symbol), "
+                    f"never per line: {e!r}")
+        return cls(entries)
+
+    @classmethod
+    def empty(cls) -> "Baseline":
+        return cls([])
+
+    def split(self, findings: Sequence[Finding]):
+        """(new, waived, stale_entries) for this run."""
+        new: List[Finding] = []
+        waived: List[Finding] = []
+        hit: Set[Key] = set()
+        for f in findings:
+            if f.key() in self.by_key:
+                waived.append(f)
+                hit.add(f.key())
+            else:
+                new.append(f)
+        stale = [e for k, e in self.by_key.items() if k not in hit]
+        return new, waived, stale
+
+    @staticmethod
+    def render(findings: Sequence[Finding]) -> str:
+        """A baseline JSON document waiving exactly these findings —
+        what --write-baseline emits (the 'why' fields start empty and
+        must be filled in by hand)."""
+        seen: Set[Key] = set()
+        entries = []
+        for f in findings:
+            if f.key() in seen:
+                continue
+            seen.add(f.key())
+            entries.append({"rule": f.rule, "file": f.path,
+                            "symbol": f.symbol,
+                            "why": "TODO: justify this waiver"})
+        return json.dumps({"version": 1, "entries": entries}, indent=2,
+                          sort_keys=False) + "\n"

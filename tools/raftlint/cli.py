@@ -1,0 +1,139 @@
+"""raftlint command line.
+
+    python -m tools.raftlint [paths...]        # or just: raftlint
+    raftlint --no-baseline raft_tpu/           # full debt, ignore waivers
+    raftlint --rules R4,R6 raft_tpu/comms/     # subset
+    raftlint --write-baseline                  # regenerate waiver file
+
+Exit codes: 0 clean, 1 new violations or stale baseline entries,
+2 usage error (argparse). CI treats 1 as a gate failure; stale entries
+fail so the baseline stays an exact inventory of the remaining debt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from tools.raftlint.baseline import DEFAULT_PATH, Baseline
+from tools.raftlint.core import Finding, Project
+from tools.raftlint.rules import ALL_RULES
+
+DEFAULT_PATHS = ("raft_tpu",)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="raftlint",
+        description="AST-level invariant checker for the raft_tpu tree")
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                    help="files or directories to scan "
+                         f"(default: {'/'.join(DEFAULT_PATHS)}/)")
+    ap.add_argument("--root", default=os.getcwd(),
+                    help="repo root paths are relative to "
+                         "(default: cwd)")
+    ap.add_argument("--baseline", default=DEFAULT_PATH,
+                    help="baseline JSON waiving pre-existing "
+                         "violations per (rule, file, symbol)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline and report the full "
+                         "debt")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--write-baseline", nargs="?", const=DEFAULT_PATH,
+                    default=None, metavar="PATH",
+                    help="write a baseline waiving every current "
+                         "finding, then exit 0 (fill in the why "
+                         "fields)")
+    return ap
+
+
+def run_rules(project: Project, rule_ids=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for rule_cls in ALL_RULES:
+        if rule_ids and rule_cls.id not in rule_ids:
+            continue
+        findings.extend(rule_cls().run(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}  {rule.summary}")
+            print(f"    protects: {rule.rationale}")
+        return 0
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = {r.strip().upper() for r in args.rules.split(",")
+                    if r.strip()}
+        known = {r.id for r in ALL_RULES}
+        bad = rule_ids - known
+        if bad:
+            print(f"raftlint: unknown rule id(s): {sorted(bad)} "
+                  f"(known: {sorted(known)})", file=sys.stderr)
+            return 2
+
+    project = Project(args.root)
+    project.scan(args.paths)
+    if project.errors:
+        for err in project.errors:
+            print(f"raftlint: {err}", file=sys.stderr)
+        return 2
+
+    findings = run_rules(project, rule_ids)
+
+    if args.write_baseline is not None:
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            fh.write(Baseline.render(findings))
+        print(f"raftlint: wrote {len(findings)} waiver(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    if args.no_baseline:
+        for f in findings:
+            print(f.render())
+        print(f"raftlint: {len(findings)} finding(s) with no baseline "
+              f"applied ({len(project.modules)} modules scanned)")
+        return 1 if findings else 0
+
+    try:
+        baseline = (Baseline.load(args.baseline)
+                    if os.path.exists(args.baseline) else
+                    Baseline.empty())
+    except (ValueError, KeyError, OSError) as e:
+        print(f"raftlint: bad baseline {args.baseline}: {e}",
+              file=sys.stderr)
+        return 2
+
+    new, waived, stale = baseline.split(findings)
+    # a stale entry for a file outside this scan is not evidence the
+    # debt was paid — only fail stale entries we could have re-observed
+    scanned = {m.relpath for m in project.modules.values()}
+    stale = [e for e in stale if e["file"] in scanned]
+
+    for f in new:
+        print(f.render())
+    for e in stale:
+        print(f"{e['file']}: stale baseline entry "
+              f"({e['rule']}, {e['symbol']}): the violation it waives "
+              "no longer exists — delete it from the baseline")
+    status = (f"raftlint: {len(new)} new finding(s), "
+              f"{len(waived)} waived by baseline, "
+              f"{len(stale)} stale entr(ies) "
+              f"({len(project.modules)} modules scanned)")
+    print(status)
+    return 1 if new or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

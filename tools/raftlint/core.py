@@ -1,0 +1,314 @@
+"""raftlint shared visitor core.
+
+One parse of the tree feeds every rule: a :class:`Project` holds per-module
+ASTs plus the three cross-cutting indexes the rules share —
+
+- an import map per module (alias → fully-qualified name), giving cheap
+  qualified-name resolution for dotted expressions (``jnp.sqrt`` →
+  ``jax.numpy.sqrt``) without executing anything;
+- a function table keyed by ``module:Class.method`` symbols (the same
+  symbol spelling the baseline waives on — symbols survive line churn,
+  line numbers do not);
+- per-class lock/field maps (which ``self.X`` attributes hold
+  ``threading.Lock/RLock/Condition`` objects) for the lock-discipline
+  rule;
+- a best-effort call graph (same-module calls, ``self.`` method calls,
+  and cross-module calls resolvable through the import map) that the
+  jit-purity rule closes over from its seeds.
+
+Everything is conservative-by-construction: unresolvable names resolve
+to ``None`` and drop out, so rules over-report only where the code is
+genuinely analyzable.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+LOCK_FACTORIES = (
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation: location + the symbol key the baseline uses."""
+
+    rule: str
+    path: str                 # repo-relative posix path
+    line: int
+    col: int
+    symbol: str               # "pkg.module:Class.method" | "pkg.module:<module>"
+    message: str
+    hint: str = ""
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def render(self) -> str:
+        text = (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.symbol}] {self.message}")
+        if self.hint:
+            text += f"\n    hint: {self.hint}"
+        return text
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """A def (or method) with enough context to name and analyze it."""
+
+    module: "ModuleInfo"
+    qual: str                 # "Class.method", "func", "outer.inner"
+    node: ast.AST             # FunctionDef | AsyncFunctionDef | Lambda
+    class_name: Optional[str] = None
+
+    @property
+    def symbol(self) -> str:
+        return f"{self.module.modname}:{self.qual}"
+
+    @property
+    def name(self) -> str:
+        return self.qual.rsplit(".", 1)[-1]
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    module: "ModuleInfo"
+    name: str
+    node: ast.ClassDef
+    lock_attrs: Set[str] = dataclasses.field(default_factory=set)
+    methods: Dict[str, FunctionInfo] = dataclasses.field(
+        default_factory=dict)
+
+
+class ModuleInfo:
+    """One parsed source file plus its local indexes."""
+
+    def __init__(self, path: str, relpath: str, modname: str,
+                 source: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.modname = modname
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.imports: Dict[str, str] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self._index()
+
+    # -- indexing -----------------------------------------------------------
+
+    def _index(self) -> None:
+        pkg = self.modname.rsplit(".", 1)[0] if "." in self.modname else ""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split(".", 1)[0]
+                    self.imports[name] = (alias.name if alias.asname
+                                          else alias.name.split(".", 1)[0])
+                    if alias.asname:
+                        self.imports[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:          # relative import
+                    parts = self.modname.split(".")
+                    # level=1 → current package; each extra level pops one
+                    anchor = parts[:len(parts) - node.level]
+                    base = ".".join(anchor + ([node.module]
+                                              if node.module else []))
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    self.imports[bound] = (f"{base}.{alias.name}"
+                                           if base else alias.name)
+        self._walk_defs(self.tree, [], None)
+        for cls in self.classes.values():
+            self._find_locks(cls)
+        del pkg
+
+    def _walk_defs(self, node: ast.AST, stack: List[str],
+                   cls: Optional[ClassInfo]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                info = FunctionInfo(self, qual, child,
+                                    cls.name if cls else None)
+                self.functions[qual] = info
+                if cls is not None and len(stack) == 1:
+                    cls.methods[child.name] = info
+                self._walk_defs(child, stack + [child.name], cls)
+            elif isinstance(child, ast.ClassDef):
+                cinfo = ClassInfo(self, child.name, child)
+                self.classes[child.name] = cinfo
+                self._walk_defs(child, stack + [child.name], cinfo)
+            else:
+                self._walk_defs(child, stack, cls)
+
+    def _find_locks(self, cls: ClassInfo) -> None:
+        """Attributes assigned a threading lock anywhere in the class, plus
+        Condition aliases (``self._cond = threading.Condition(self._lock)``
+        makes both names lock-like)."""
+        for meth in cls.methods.values():
+            for node in ast.walk(meth.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                fq = self.resolve(node.value.func)
+                if fq not in LOCK_FACTORIES:
+                    continue
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        cls.lock_attrs.add(tgt.attr)
+
+    # -- name resolution ----------------------------------------------------
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Resolve a Name/Attribute chain to a dotted fully-qualified name
+        through the import map; None when the chain is not static or the
+        root is a plain local name."""
+        parts = dotted_parts(node)
+        if not parts:
+            return None
+        root = self.imports.get(parts[0])
+        if root is None:
+            return None
+        return ".".join([root] + parts[1:])
+
+    def resolve_local(self, node: ast.AST) -> Optional[str]:
+        """Like resolve(), but a bare unimported root name maps to a
+        module-level def in THIS module when one exists."""
+        fq = self.resolve(node)
+        if fq is not None:
+            return fq
+        parts = dotted_parts(node)
+        if parts and len(parts) == 1 and parts[0] in self.functions:
+            return f"{self.modname}.{parts[0]}"
+        return None
+
+
+def dotted_parts(node: ast.AST) -> Optional[List[str]]:
+    """["jnp", "linalg", "norm"] for jnp.linalg.norm; None if the chain
+    has a non-Name root (call results, subscripts, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return list(reversed(parts))
+
+
+def self_attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """["stats", "rejected"] for self.stats.rejected; None unless the
+    chain is rooted at the name ``self``."""
+    parts = dotted_parts(node)
+    if parts and parts[0] == "self" and len(parts) > 1:
+        return parts[1:]
+    return None
+
+
+def body_statements(fn: ast.AST) -> List[ast.stmt]:
+    """The function body minus a leading docstring expression."""
+    body = list(getattr(fn, "body", []))
+    if (body and isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Constant)
+            and isinstance(body[0].value.value, str)):
+        body = body[1:]
+    return body
+
+
+class Project:
+    """Every parsed module under the scanned roots, plus shared lookups."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.errors: List[str] = []
+
+    # -- construction -------------------------------------------------------
+
+    def scan(self, paths: Sequence[str]) -> None:
+        files: List[str] = []
+        for p in paths:
+            ap = os.path.join(self.root, p) if not os.path.isabs(p) else p
+            if os.path.isdir(ap):
+                for dirpath, dirnames, filenames in os.walk(ap):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"
+                                   and not d.startswith(".")]
+                    files.extend(os.path.join(dirpath, f)
+                                 for f in filenames if f.endswith(".py"))
+            elif ap.endswith(".py") and os.path.isfile(ap):
+                files.append(ap)
+            else:
+                self.errors.append(f"no such file or directory: {p}")
+        for f in sorted(set(files)):
+            self._load(f)
+
+    def _load(self, path: str) -> None:
+        rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+        mod = rel[:-3].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[:-len(".__init__")]
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            self.modules[mod] = ModuleInfo(path, rel, mod, source)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            self.errors.append(f"{rel}: parse error: {e}")
+
+    # -- lookups ------------------------------------------------------------
+
+    def iter_functions(self) -> Iterator[FunctionInfo]:
+        for mod in self.modules.values():
+            yield from mod.functions.values()
+
+    def function_by_fq(self, fq: str) -> Optional[FunctionInfo]:
+        """'pkg.mod.Class.method' or 'pkg.mod.func' → FunctionInfo."""
+        for cut in range(len(fq), 0, -1):
+            if fq[cut:cut + 1] not in ("", "."):
+                continue
+            modname, _, rest = fq[:cut], fq[cut:cut + 1], fq[cut + 1:]
+            mod = self.modules.get(modname)
+            if mod is not None and rest and rest in mod.functions:
+                return mod.functions[rest]
+        return None
+
+    # -- call graph ---------------------------------------------------------
+
+    def callees(self, fn: FunctionInfo) -> Set[str]:
+        """Symbols (module:qual) this function may call, best-effort:
+        bare/module-qualified calls through the import map, plus
+        same-class ``self.method()`` calls."""
+        out: Set[str] = set()
+        mod = fn.module
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            chain = self_attr_chain(func)
+            if chain is not None and len(chain) == 1 and fn.class_name:
+                cls = mod.classes.get(fn.class_name)
+                if cls and chain[0] in cls.methods:
+                    out.add(cls.methods[chain[0]].symbol)
+                continue
+            fq = mod.resolve_local(func)
+            if fq is None:
+                continue
+            target = self.function_by_fq(fq)
+            if target is not None:
+                out.add(target.symbol)
+        return out
+
+    def symbol_table(self) -> Dict[str, FunctionInfo]:
+        return {fn.symbol: fn for fn in self.iter_functions()}

@@ -1,0 +1,23 @@
+"""Rule registry. Order is the report order."""
+
+from tools.raftlint.rules.r1_jit_purity import JitPurityRule
+from tools.raftlint.rules.r2_recompile import RecompileRule
+from tools.raftlint.rules.r3_locks import LockDisciplineRule
+from tools.raftlint.rules.r4_errors import ErrorTaxonomyRule
+from tools.raftlint.rules.r5_offpath import OffPathPurityRule
+from tools.raftlint.rules.r6_obs_imports import ObsBoundaryRule
+from tools.raftlint.rules.r7_env import EnvDisciplineRule
+from tools.raftlint.rules.r8_numeric import NumericHygieneRule
+
+ALL_RULES = (
+    JitPurityRule,
+    RecompileRule,
+    LockDisciplineRule,
+    ErrorTaxonomyRule,
+    OffPathPurityRule,
+    ObsBoundaryRule,
+    EnvDisciplineRule,
+    NumericHygieneRule,
+)
+
+__all__ = ["ALL_RULES"]

@@ -1,0 +1,19 @@
+"""Rule plugin protocol: a rule sees the whole Project and returns
+Findings. Rules carry their id/summary/hint as class attributes so the
+CLI's --list-rules and docs stay generated from one source."""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.raftlint.core import Finding, Project
+
+
+class Rule:
+    id = "R0"
+    summary = ""
+    # the PR-era guarantee this rule protects (docs/raftlint.md pulls it)
+    rationale = ""
+
+    def run(self, project: Project) -> List[Finding]:
+        raise NotImplementedError

@@ -1,0 +1,220 @@
+"""R1: no host-side effects reachable from a traced body.
+
+Seeds are functions that jit compiles or a structured-control primitive
+traces: ``@jax.jit`` decorations (bare or ``functools.partial``-wrapped),
+and callables passed to ``jax.jit`` / ``shard_map`` / ``lax.while_loop``
+/ ``lax.scan`` / ``lax.cond`` / ``lax.switch`` / ``lax.fori_loop`` /
+``lax.map`` call sites. The rule closes over the best-effort call graph
+from those seeds, then flags the unambiguous host-sync markers anywhere
+reachable: ``.item()`` / ``.tolist()`` / ``.block_until_ready()``,
+``print``, ``time.*`` clock reads, and ``np.*`` calls (a NumPy call on a
+tracer either crashes or silently constant-folds host-side). Direct
+seed bodies additionally get the coercion/branch checks — ``float(x)``
+/ ``int(x)`` / ``bool(x)`` on a traced parameter and ``if``/``while``
+tests that are a bare traced parameter — with parameters named in
+``static_argnames``/``static_argnums`` excluded, since branching on a
+static arg is exactly what static args are for.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raftlint.core import (
+    Finding, FunctionInfo, Project, dotted_parts)
+from tools.raftlint.rules.base import Rule
+
+JIT_NAMES = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+}
+TRACED_CALLERS = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.lax.while_loop", "jax.lax.scan", "jax.lax.cond",
+    "jax.lax.switch", "jax.lax.fori_loop", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.checkpoint", "jax.remat",
+    "jax.vmap", "jax.grad", "jax.value_and_grad",
+}
+HOST_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+CLOCK_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.sleep",
+}
+
+
+def _partial_of_jit(mod, deco: ast.AST) -> bool:
+    if not isinstance(deco, ast.Call):
+        return False
+    fq = mod.resolve(deco.func)
+    if fq not in ("functools.partial", "partial"):
+        return False
+    return bool(deco.args) and mod.resolve(deco.args[0]) in JIT_NAMES
+
+
+def _static_params(mod, fn: FunctionInfo) -> Set[str]:
+    """Parameter names declared static at the decoration site."""
+    static: Set[str] = set()
+    node = fn.node
+    args = node.args
+    names = [a.arg for a in args.posonlyargs + args.args]
+    for deco in getattr(node, "decorator_list", []):
+        if not isinstance(deco, ast.Call):
+            continue
+        if (mod.resolve(deco.func) not in JIT_NAMES
+                and not _partial_of_jit(mod, deco)):
+            continue
+        for kw in deco.keywords:
+            if kw.arg == "static_argnames":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(
+                            c.value, str):
+                        static.add(c.value)
+            elif kw.arg == "static_argnums":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(
+                            c.value, int) and c.value < len(names):
+                        static.add(names[c.value])
+    return static
+
+
+class JitPurityRule(Rule):
+    id = "R1"
+    summary = ("host sync / NumPy / host branching reachable from a "
+               "jit-traced body")
+    rationale = ("PR 6/9/11's zero-post-warm-recompile and "
+                 "compiled-driver contracts: a .item()/np.* inside a "
+                 "traced body either crashes under trace or forces a "
+                 "silent host round-trip per step")
+
+    def run(self, project: Project) -> List[Finding]:
+        table = project.symbol_table()
+        seeds: Dict[str, FunctionInfo] = {}
+        lambda_seeds: List[Tuple[FunctionInfo, ast.Lambda]] = []
+
+        for fn in project.iter_functions():
+            mod = fn.module
+            for deco in getattr(fn.node, "decorator_list", []):
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                if (mod.resolve(target) in JIT_NAMES
+                        or _partial_of_jit(mod, deco)):
+                    seeds[fn.symbol] = fn
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if mod.resolve(node.func) not in TRACED_CALLERS:
+                    continue
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        lambda_seeds.append((fn, arg))
+                        continue
+                    parts = dotted_parts(arg)
+                    if parts is None:
+                        continue
+                    # local def in the enclosing function?
+                    if len(parts) == 1:
+                        local = mod.functions.get(
+                            f"{fn.qual}.{parts[0]}")
+                        if local is not None:
+                            seeds[local.symbol] = local
+                            continue
+                    fq = mod.resolve_local(arg)
+                    target_fn = (project.function_by_fq(fq)
+                                 if fq else None)
+                    if target_fn is not None:
+                        seeds[target_fn.symbol] = target_fn
+
+        # close over the call graph
+        reachable: Dict[str, str] = {s: s for s in seeds}   # sym → seed
+        frontier = list(seeds)
+        while frontier:
+            sym = frontier.pop()
+            fn = table.get(sym)
+            if fn is None:
+                continue
+            for callee in project.callees(fn):
+                if callee not in reachable:
+                    reachable[callee] = reachable[sym]
+                    frontier.append(callee)
+
+        findings: List[Finding] = []
+        for sym in sorted(reachable):
+            fn = table.get(sym)
+            if fn is None:
+                continue
+            findings.extend(self._check_body(
+                fn, direct=sym in seeds, via=reachable[sym]))
+        for host_fn, lam in lambda_seeds:
+            pseudo = FunctionInfo(host_fn.module, host_fn.qual, lam,
+                                  host_fn.class_name)
+            findings.extend(self._check_body(pseudo, direct=True,
+                                             via=pseudo.symbol))
+        return findings
+
+    def _check_body(self, fn: FunctionInfo, direct: bool,
+                    via: str) -> List[Finding]:
+        mod = fn.module
+        out: List[Finding] = []
+        why = "" if direct else f" (reachable from traced {via})"
+
+        def flag(node: ast.AST, message: str, hint: str) -> None:
+            out.append(Finding(
+                self.id, mod.relpath, node.lineno, node.col_offset,
+                fn.symbol, message + why, hint))
+
+        args = getattr(fn.node, "args", None)
+        params = set()
+        if args is not None:
+            params = {a.arg for a in args.posonlyargs + args.args
+                      + args.kwonlyargs} - {"self", "cls"}
+        if direct and not isinstance(fn.node, ast.Lambda):
+            params -= _static_params(mod, fn)
+
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in HOST_SYNC_ATTRS):
+                    flag(node, f".{func.attr}() in a traced body",
+                         "return the array and sync outside the jit "
+                         "boundary")
+                    continue
+                fq = mod.resolve(func)
+                if fq is None:
+                    if (isinstance(func, ast.Name)
+                            and func.id == "print"):
+                        flag(node, "print() in a traced body",
+                             "use jax.debug.print (traced) or log "
+                             "outside the jit boundary")
+                    elif (direct and isinstance(func, ast.Name)
+                          and func.id in ("float", "int", "bool")
+                          and node.args
+                          and isinstance(node.args[0], ast.Name)
+                          and node.args[0].id in params):
+                        flag(node,
+                             f"{func.id}() coerces traced parameter "
+                             f"{node.args[0].id!r} to a host scalar",
+                             "keep it an array, or declare the arg "
+                             "static if it is genuinely host-side")
+                    continue
+                if fq in CLOCK_CALLS:
+                    flag(node, f"host clock {fq}() in a traced body",
+                         "time outside the jit boundary; traced code "
+                         "must be replayable")
+                elif fq.split(".", 1)[0] == "numpy":
+                    flag(node, f"NumPy call {fq}() in a traced body",
+                         "use jnp.* (traced) — np.* on a tracer "
+                         "crashes or constant-folds on host")
+            elif direct and isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                if isinstance(test, ast.UnaryOp) and isinstance(
+                        test.op, ast.Not):
+                    test = test.operand
+                if isinstance(test, ast.Name) and test.id in params:
+                    flag(node,
+                         f"host branch on traced parameter "
+                         f"{test.id!r}",
+                         "use jax.lax.cond/select, or declare the "
+                         "arg static")
+        return out

@@ -1,0 +1,151 @@
+"""R2: recompile hazards at jit / aot_export call sites.
+
+The serve layer's acceptance gate is ZERO post-warm recompiles; the two
+statically-catchable ways to lose it are (a) constructing a fresh
+jittable per call — ``jax.jit(lambda ...)`` or jit-of-a-local-``def``
+inside a function body, where every invocation makes a new callable
+identity and therefore a new trace-cache entry — and (b) closing a
+jitted local over an array built in the enclosing scope, which
+participates in the cache key by object identity and re-traces whenever
+the enclosing function rebuilds it.
+
+An enclosing function decorated with ``functools.lru_cache``/``cache``
+is exempt from (a): the fresh callable is constructed once per cache
+key and memoized, which is the repo's sanctioned spelling for
+shape-keyed executable caches (serve/executor, ivf searchers). Sites
+that memoize by hand into a dict are real but invisible to this rule —
+they carry a baseline entry instead, with the cache named in the
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.raftlint.core import Finding, Project, dotted_parts
+from tools.raftlint.rules.base import Rule
+
+JIT_LIKE = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+}
+AOT_LIKE = {"aot_export"}       # matched on terminal name (repo helper)
+CACHED_DECOS = {
+    "functools.lru_cache", "functools.cache", "lru_cache", "cache",
+}
+ARRAY_CTORS_PREFIX = ("jax.numpy.", "numpy.")
+ARRAY_CTOR_NAMES = {
+    "array", "asarray", "zeros", "ones", "full", "arange", "linspace",
+    "eye", "empty",
+}
+
+
+def _is_cached(mod, fn_node: ast.AST) -> bool:
+    for deco in getattr(fn_node, "decorator_list", []):
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        fq = mod.resolve(target)
+        if fq in CACHED_DECOS:
+            return True
+        parts = dotted_parts(target)
+        if parts and parts[-1] in ("lru_cache", "cache"):
+            return True
+    return False
+
+
+class RecompileRule(Rule):
+    id = "R2"
+    summary = ("fresh jittable or closure-captured array at a "
+               "jit/aot_export call site")
+    rationale = ("the serve layer's zero-post-warm-recompile gate "
+                 "(PR 6/9/11): a per-call callable identity or an "
+                 "identity-keyed closure array re-traces on every "
+                 "invocation")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in project.iter_functions():
+            mod = fn.module
+            if _is_cached(mod, fn.node):
+                continue
+            # names assigned an array constructor result in THIS function
+            array_locals: Set[str] = set()
+            for node in ast.walk(fn.node):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    fq = mod.resolve(node.value.func)
+                    if fq and (fq.startswith(ARRAY_CTORS_PREFIX)
+                               and fq.rsplit(".", 1)[-1]
+                               in ARRAY_CTOR_NAMES):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                array_locals.add(tgt.id)
+            local_defs = {
+                name.rsplit(".", 1)[-1]: info
+                for name, info in mod.functions.items()
+                if name.startswith(fn.qual + ".")
+                and name.count(".") == fn.qual.count(".") + 1}
+
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fq = mod.resolve(node.func)
+                parts = dotted_parts(node.func)
+                terminal = parts[-1] if parts else None
+                if not (fq in JIT_LIKE or terminal in AOT_LIKE):
+                    continue
+                if not node.args:
+                    continue
+                target = node.args[0]
+                if isinstance(target, ast.Lambda):
+                    findings.append(Finding(
+                        self.id, mod.relpath, node.lineno,
+                        node.col_offset, fn.symbol,
+                        "jit of an inline lambda constructs a fresh "
+                        "callable (new trace-cache entry) per call",
+                        "hoist the lambda to module scope or memoize "
+                        "the jitted result (functools.lru_cache)"))
+                    continue
+                if (isinstance(target, ast.Name)
+                        and target.id in local_defs):
+                    inner = local_defs[target.id]
+                    captured = self._captured_arrays(
+                        inner.node, array_locals)
+                    if captured:
+                        findings.append(Finding(
+                            self.id, mod.relpath, node.lineno,
+                            node.col_offset, fn.symbol,
+                            "jitted local function closes over "
+                            f"array(s) {sorted(captured)} built in the "
+                            "enclosing scope (identity-keyed: every "
+                            "rebuild re-traces)",
+                            "pass the array as an argument instead of "
+                            "capturing it"))
+                    else:
+                        findings.append(Finding(
+                            self.id, mod.relpath, node.lineno,
+                            node.col_offset, fn.symbol,
+                            "jit of a local def constructs a fresh "
+                            "callable (new trace-cache entry) per "
+                            "call",
+                            "hoist the def, or memoize the enclosing "
+                            "builder with functools.lru_cache"))
+        return findings
+
+    @staticmethod
+    def _captured_arrays(inner: ast.AST,
+                         array_locals: Set[str]) -> Set[str]:
+        args = inner.args
+        bound = {a.arg for a in args.posonlyargs + args.args
+                 + args.kwonlyargs}
+        for node in ast.walk(inner):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name):
+                        bound.add(tgt.id)
+        used = {node.id for node in ast.walk(inner)
+                if isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)}
+        return (used - bound) & array_locals

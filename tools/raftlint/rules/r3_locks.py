@@ -1,0 +1,238 @@
+"""R3: lock discipline for the threaded classes.
+
+Any class that owns a lock attribute (``self._lock = threading.Lock()``
+and friends, including a Condition wrapping the lock) promises that its
+mutable fields are written under that lock. The rule flags writes to
+``self``-rooted attribute chains (``self.stats.rejected += 1``,
+``self._started = True``, ``self._q[k] = v``) in method bodies that are
+not lexically inside a ``with self.<lock>`` block.
+
+Two refinements keep it honest on real code:
+
+- ``__init__``/``__new__``/``__enter__`` construct the object before it
+  escapes to other threads, so they are exempt;
+- a private helper whose every intra-class call site sits inside a
+  locked context inherits that context (fixed point over the class's
+  call sites) — the ``RequestQueue._drain`` pattern, where the lock is
+  taken by the public entry points.
+
+The same pass builds a lock-acquisition-order graph — edge A→B when a
+``with B`` (or a call to a method that takes B) appears lexically inside
+a ``with A`` — and reports any cycle: two threads entering the cycle
+from different ends deadlock, which no dynamic test reliably catches.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raftlint.core import (
+    ClassInfo, Finding, FunctionInfo, Project, self_attr_chain)
+from tools.raftlint.rules.base import Rule
+
+CONSTRUCTORS = {"__init__", "__new__", "__post_init__"}
+
+
+def _with_locks(node: ast.With, lock_attrs: Set[str]) -> Set[str]:
+    """Lock attrs acquired by this with-statement (``with self._lock:``,
+    ``with self._cv:``)."""
+    out: Set[str] = set()
+    for item in node.items:
+        chain = self_attr_chain(item.context_expr)
+        if chain and len(chain) == 1 and chain[0] in lock_attrs:
+            out.add(chain[0])
+        # with self._cv.acquire_timeout(...) style: root attr still names
+        # the lock
+        elif chain and chain[0] in lock_attrs:
+            out.add(chain[0])
+    return out
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collect, per method: unlocked self-field writes, self-method call
+    sites with their lock context, and lock nesting edges."""
+
+    def __init__(self, cls: ClassInfo) -> None:
+        self.cls = cls
+        self.lock_stack: List[str] = []
+        self.unlocked_writes: List[Tuple[ast.AST, str]] = []
+        self.calls: List[Tuple[str, bool]] = []   # (method, under_lock)
+        self.edges: Set[Tuple[str, str]] = set()
+        self.acquires_any = False
+
+    # -- helpers ------------------------------------------------------------
+
+    def _record_write(self, target: ast.AST) -> None:
+        node = target
+        while isinstance(node, ast.Subscript):
+            node = node.value          # self._q[k] = v writes self._q
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self._record_write(elt)
+            return
+        if isinstance(node, ast.Starred):
+            self._record_write(node.value)
+            return
+        chain = self_attr_chain(node)
+        if chain is None:
+            return
+        if chain[0] in self.cls.lock_attrs:
+            return                     # assigning the lock itself
+        if not self.lock_stack:
+            self.unlocked_writes.append((target, ".".join(chain)))
+
+    # -- visitors -----------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        got = _with_locks(node, self.cls.lock_attrs)
+        if got:
+            self.acquires_any = True
+            for held in self.lock_stack:
+                for new in got:
+                    if held != new:
+                        self.edges.add((held, new))
+            self.lock_stack.extend(sorted(got))
+            for child in node.body:
+                self.visit(child)
+            del self.lock_stack[len(self.lock_stack) - len(got):]
+        else:
+            self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._record_write(tgt)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_write(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_write(node.target)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = self_attr_chain(node.func)
+        if chain is not None:
+            if len(chain) == 1 and chain[0] in self.cls.methods:
+                self.calls.append((chain[0], bool(self.lock_stack)))
+            elif (len(chain) == 2 and chain[0] in self.cls.lock_attrs
+                    and chain[1] in ("acquire", "acquire_lock")):
+                # manual acquire: treat the whole method as mixed-style
+                # and skip flagging rather than misjudge scopes
+                self.acquires_any = True
+                self.lock_stack.append(chain[0])
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass          # nested defs have their own discipline
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+class LockDisciplineRule(Rule):
+    id = "R3"
+    summary = ("field write outside the owning lock, or a lock-order "
+               "cycle")
+    rationale = ("the threaded serve/comms/obs stack (PR 7/9/10): "
+                 "RequestQueue, Replica, TagStore, and the metric "
+                 "families are mutated from executor threads, router "
+                 "threads, and timeout sweepers concurrently")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        order_edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+        for mod in project.modules.values():
+            for cls in mod.classes.values():
+                if not cls.lock_attrs:
+                    continue
+                scans: Dict[str, _MethodScan] = {}
+                for name, meth in cls.methods.items():
+                    scan = _MethodScan(cls)
+                    for stmt in meth.node.body:
+                        scan.visit(stmt)
+                    scans[name] = scan
+
+                # fixed point: a private method whose every intra-class
+                # call site is under the lock is itself lock-guarded
+                guarded: Set[str] = set()
+                changed = True
+                while changed:
+                    changed = False
+                    callers: Dict[str, List[Tuple[str, bool]]] = {}
+                    for caller, scan in scans.items():
+                        for callee, locked in scan.calls:
+                            callers.setdefault(callee, []).append(
+                                (caller,
+                                 locked or caller in guarded))
+                    for name in cls.methods:
+                        if name in guarded or not name.startswith("_"):
+                            continue
+                        sites = callers.get(name, [])
+                        if sites and all(lk for _, lk in sites):
+                            guarded.add(name)
+                            changed = True
+
+                for name, meth in cls.methods.items():
+                    if name in CONSTRUCTORS or name in guarded:
+                        continue
+                    scan = scans[name]
+                    for node, field in scan.unlocked_writes:
+                        findings.append(Finding(
+                            self.id, mod.relpath, node.lineno,
+                            node.col_offset, meth.symbol,
+                            f"self.{field} written outside "
+                            f"'with self.{sorted(cls.lock_attrs)[0]}' "
+                            f"(class {cls.name} owns "
+                            f"{sorted(cls.lock_attrs)})",
+                            "move the write under the lock, or add a "
+                            "baseline entry explaining why this field "
+                            "is single-threaded"))
+                    for a, b in scan.edges:
+                        key = (f"{mod.modname}.{cls.name}.{a}",
+                               f"{mod.modname}.{cls.name}.{b}")
+                        order_edges.setdefault(
+                            key, (mod.relpath,
+                                  meth.node.lineno, meth.symbol))
+
+        findings.extend(self._order_cycles(order_edges))
+        return findings
+
+    def _order_cycles(self, edges) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        seen: Set[str] = set()
+        findings: List[Finding] = []
+        reported: Set[frozenset] = set()
+
+        def dfs(node: str, stack: List[str]) -> None:
+            if node in stack:
+                cycle = stack[stack.index(node):] + [node]
+                key = frozenset(cycle)
+                if key not in reported:
+                    reported.add(key)
+                    a, b = cycle[0], cycle[1]
+                    rel, line, sym = edges[(a, b)]
+                    findings.append(Finding(
+                        self.id, rel, line, 0, sym,
+                        "lock-acquisition-order cycle: "
+                        + " -> ".join(cycle),
+                        "pick one global order for these locks and "
+                        "acquire in that order everywhere"))
+                return
+            if node in seen:
+                return
+            seen.add(node)
+            for nxt in graph.get(node, ()):
+                dfs(nxt, stack + [node])
+
+        for start in sorted(graph):
+            dfs(start, [])
+        return findings

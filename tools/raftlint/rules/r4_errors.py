@@ -1,0 +1,130 @@
+"""R4: the typed-error taxonomy.
+
+Every operational failure in the library surfaces as one of the typed
+RuntimeError subclasses grown across PRs 1/3/5/7 (CommsError kinds,
+NumericalError kinds, DeadlineExceededError/RejectedError,
+ArtifactCorruptError, ...), so callers can catch by meaning and the
+flight recorder can classify. Three anti-patterns erode it:
+
+- ``raise RuntimeError(...)`` / ``raise Exception(...)`` — an untyped
+  operational error callers can only string-match;
+- ``except Exception`` / ``except BaseException`` / bare ``except:`` —
+  a blanket handler that flattens the taxonomy back into "something
+  went wrong" (the old comms and numeric smoke greps, absorbed here
+  tree-wide);
+- a handler whose body is exactly ``pass`` — a silently swallowed
+  error (``contextlib.suppress(SpecificError)`` is the sanctioned
+  spelling at well-understood shutdown sites).
+
+Intentional blanket handlers (crash-isolation at thread boundaries,
+best-effort probes of optional native runtimes) carry baseline entries
+whose ``why`` names the isolation boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.raftlint.core import Finding, Project, dotted_parts
+from tools.raftlint.rules.base import Rule
+
+UNTYPED_RAISES = {"RuntimeError", "Exception", "BaseException"}
+BLANKET = {"Exception", "BaseException"}
+
+
+class ErrorTaxonomyRule(Rule):
+    id = "R4"
+    summary = ("untyped raise, blanket except, or silently swallowed "
+               "error in library code")
+    rationale = ("the typed-error taxonomy (PR 1/3/5/7): operational "
+                 "failures must stay catchable by meaning — "
+                 "CommsError kinds, NumericalError kinds, deadline/"
+                 "admission errors — not by string-matching "
+                 "RuntimeError")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules.values():
+            if not mod.modname.startswith("raft_tpu"):
+                continue
+            for sym, node in self._walk_with_symbols(mod):
+                if isinstance(node, ast.Raise):
+                    exc = node.exc
+                    name = None
+                    if isinstance(exc, ast.Call):
+                        parts = dotted_parts(exc.func)
+                        name = parts[-1] if parts else None
+                    elif exc is not None:
+                        parts = dotted_parts(exc)
+                        name = parts[-1] if parts else None
+                    if name in UNTYPED_RAISES:
+                        findings.append(Finding(
+                            self.id, mod.relpath, node.lineno,
+                            node.col_offset, sym,
+                            f"raise {name} is outside the typed-error "
+                            "taxonomy",
+                            "raise the matching taxonomy type (a "
+                            "RuntimeError subclass), so callers catch "
+                            "by meaning"))
+                elif isinstance(node, ast.ExceptHandler):
+                    findings.extend(
+                        self._check_handler(mod, sym, node))
+        return findings
+
+    def _check_handler(self, mod, sym: str,
+                       node: ast.ExceptHandler) -> List[Finding]:
+        out: List[Finding] = []
+        names: List[str] = []
+        if node.type is None:
+            names = ["<bare>"]
+        else:
+            exprs = (node.type.elts
+                     if isinstance(node.type, ast.Tuple)
+                     else [node.type])
+            for e in exprs:
+                parts = dotted_parts(e)
+                if parts:
+                    names.append(parts[-1])
+        if "<bare>" in names:
+            out.append(Finding(
+                self.id, mod.relpath, node.lineno, node.col_offset,
+                sym, "bare 'except:' swallows everything including "
+                "KeyboardInterrupt",
+                "catch the typed taxonomy error this site expects"))
+        elif any(n in BLANKET for n in names):
+            bad = next(n for n in names if n in BLANKET)
+            out.append(Finding(
+                self.id, mod.relpath, node.lineno, node.col_offset,
+                sym, f"blanket 'except {bad}' flattens the typed-error "
+                "taxonomy",
+                "catch the typed kinds (CommsError/NumericalError/"
+                "...), or baseline this crash-isolation boundary"))
+        if (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)
+                and "<bare>" not in names
+                and not any(n in BLANKET for n in names)):
+            out.append(Finding(
+                self.id, mod.relpath, node.lineno, node.col_offset,
+                sym, f"silent 'except {'/'.join(names) or '?'}: pass' "
+                "swallows the error invisibly",
+                "use contextlib.suppress(...) at a named shutdown "
+                "site, or surface a typed error"))
+        return out
+
+    @staticmethod
+    def _walk_with_symbols(mod):
+        """(symbol, node) pairs with the enclosing def tracked."""
+        def walk(node, sym):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    inner = None
+                    for qual, info in mod.functions.items():
+                        if info.node is child:
+                            inner = f"{mod.modname}:{qual}"
+                            break
+                    yield from walk(child, inner or sym)
+                else:
+                    yield sym, child
+                    yield from walk(child, sym)
+        yield from walk(mod.tree, f"{mod.modname}:<module>")

@@ -1,0 +1,100 @@
+"""R5: metrics/tracing-off fast paths stay allocation-free.
+
+PR 4/10's bit-identity contract: with ``RAFT_TPU_METRICS=off`` and
+``RAFT_TPU_TRACING=off`` the instrumented code paths must be a single
+boolean test — no label-tuple construction, no f-string formatting, no
+lock acquisition, no registry lookups. The emit helpers implement that
+by gating on the enabled flag as their FIRST statement and returning
+immediately.
+
+The rule pins that shape for the configured helper set: the first
+non-docstring statement must be ``if not <flag-or-call>: return ...``.
+Anything before the gate — or a missing gate — is a violation, because
+every instrumented call site in linalg/solvers pays it even when
+observability is off.
+
+``emit_event`` (error-path events) and ``record_failure`` (flight
+recorder) are intentionally ALWAYS-ON — error-path observability is
+not gated — so they are excluded by construction rather than
+baselined.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from tools.raftlint.core import Finding, Project, body_statements
+from tools.raftlint.rules.base import Rule
+
+# module → helper quals that must lead with an enabled-gate
+GATED_HELPERS: Dict[str, Tuple[str, ...]] = {
+    "raft_tpu.obs.metrics": (
+        "inc", "set_gauge", "observe", "record_convergence",
+        "Counter.inc", "Gauge.set", "Histogram.observe",
+    ),
+    "raft_tpu.obs.spans": ("span", "record_span"),
+    "raft_tpu.obs.tracectx": ("mint",),
+}
+
+
+def _is_enabled_gate(stmt: ast.stmt) -> bool:
+    """``if not <name/attr/call>: return ...`` (optionally ``yield``/
+    ``return <null-object>``) as the whole statement."""
+    if not isinstance(stmt, ast.If):
+        return False
+    test = stmt.test
+    # `if not _enabled or report is None:` — the leading short-circuit
+    # term is the off-path cost, so only it must be the bare flag
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or):
+        test = test.values[0]
+    if not (isinstance(test, ast.UnaryOp)
+            and isinstance(test.op, ast.Not)):
+        return False
+    flag = test.operand
+    if not isinstance(flag, (ast.Name, ast.Attribute, ast.Call)):
+        return False
+    if isinstance(flag, ast.Call) and flag.args:
+        return False            # enabled() takes no args; anything else
+                                # is doing work inside the gate
+    body = stmt.body
+    return bool(body) and isinstance(body[0], (ast.Return, ast.Expr))
+
+
+class OffPathPurityRule(Rule):
+    id = "R5"
+    summary = ("obs emit helper does work before (or without) its "
+               "enabled-flag gate")
+    rationale = ("PR 4/10's off-path bit-identity: with metrics/"
+                 "tracing off the instrumented hot loops must pay one "
+                 "boolean test, not allocation/formatting/locking")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for modname, quals in GATED_HELPERS.items():
+            mod = project.modules.get(modname)
+            if mod is None:
+                continue
+            for qual in quals:
+                fn = mod.functions.get(qual)
+                if fn is None:
+                    findings.append(Finding(
+                        self.id, mod.relpath, 1, 0,
+                        f"{modname}:<module>",
+                        f"gated helper {qual} not found — update the "
+                        "R5 helper table in "
+                        "tools/raftlint/rules/r5_offpath.py",
+                        "the off-path contract is only as good as "
+                        "this list"))
+                    continue
+                body = body_statements(fn.node)
+                if not body or not _is_enabled_gate(body[0]):
+                    findings.append(Finding(
+                        self.id, mod.relpath, fn.node.lineno,
+                        fn.node.col_offset, fn.symbol,
+                        "emit helper must gate on the enabled flag as "
+                        "its first statement (single-bool no-op when "
+                        "off)",
+                        "make `if not <enabled>: return` the first "
+                        "statement; allocate labels only after it"))
+        return findings

@@ -1,0 +1,88 @@
+"""R6: the obs API boundary, as an import-graph rule.
+
+Instrumented library code goes through the ``raft_tpu.obs`` facade
+(``obs.inc`` / ``obs.span`` / ``obs.record_convergence`` ...). Importing
+obs internals — or constructing ``MetricsRegistry``/``JsonlSink``
+inline — bypasses the single on/off knob and the process-global
+registry, so a module could emit metrics the exporter never sees or
+allocate on the off path. The old smoke.sh grep enforced this with four
+regexes; this is the same boundary on the import graph: any import
+that resolves into ``raft_tpu.obs.<submodule>`` from a module outside
+the obs package is a violation, as is a call whose terminal name is one
+of the guarded constructors.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.raftlint.core import Finding, Project, dotted_parts
+from tools.raftlint.rules.base import Rule
+
+OBS_PKG = "raft_tpu.obs"
+GUARDED_CTORS = {"MetricsRegistry", "JsonlSink"}
+
+
+class ObsBoundaryRule(Rule):
+    id = "R6"
+    summary = "obs internals imported (or constructed) outside the facade"
+    rationale = ("PR 4/10's single-knob observability: everything goes "
+                 "through the raft_tpu.obs facade so one flag and one "
+                 "process-global registry govern all emission")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules.values():
+            if not mod.modname.startswith("raft_tpu"):
+                continue
+            if (mod.modname == OBS_PKG
+                    or mod.modname.startswith(OBS_PKG + ".")):
+                continue
+            sym = f"{mod.modname}:<module>"
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ImportFrom):
+                    base = node.module or ""
+                    if node.level:      # resolve relative imports
+                        parts = mod.modname.split(".")
+                        anchor = parts[:len(parts) - node.level]
+                        base = ".".join(
+                            anchor + ([node.module] if node.module
+                                      else []))
+                    if base.startswith(OBS_PKG + "."):
+                        findings.append(self._imp(mod, sym, node,
+                                                  base))
+                    elif base == OBS_PKG:
+                        for alias in node.names:
+                            # only submodules are internals; facade
+                            # helpers re-exported by obs/__init__ are
+                            # the sanctioned surface
+                            if (f"{OBS_PKG}.{alias.name}"
+                                    in project.modules):
+                                findings.append(self._imp(
+                                    mod, sym, node,
+                                    f"{OBS_PKG}.{alias.name}"))
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        if alias.name.startswith(OBS_PKG + "."):
+                            findings.append(self._imp(mod, sym, node,
+                                                      alias.name))
+                elif isinstance(node, ast.Call):
+                    parts = dotted_parts(node.func)
+                    if parts and parts[-1] in GUARDED_CTORS:
+                        findings.append(Finding(
+                            self.id, mod.relpath, node.lineno,
+                            node.col_offset, sym,
+                            f"{parts[-1]}() constructed outside obs/ "
+                            "bypasses the process-global registry",
+                            "use the facade: obs.inc/observe emit to "
+                            "the global registry; sinks attach via "
+                            "obs.set_sink / RAFT_TPU_METRICS_JSONL"))
+        return findings
+
+    def _imp(self, mod, sym: str, node: ast.AST,
+             target: str) -> Finding:
+        return Finding(
+            self.id, mod.relpath, node.lineno, node.col_offset, sym,
+            f"import of obs internal {target} bypasses the facade",
+            "import the facade instead: from raft_tpu import obs")

@@ -1,0 +1,72 @@
+"""R7: all RAFT_TPU_* environment reads go through core/env.py.
+
+The knob registry (``raft_tpu/core/env.py``) is the single place where
+a ``RAFT_TPU_*`` variable's parser, default, and malformed-value policy
+live — that is what makes the fail-loud-vs-warn-fallback contract
+testable and the docs' knob inventory complete. A direct
+``os.environ[...]`` / ``os.environ.get(...)`` / ``os.getenv(...)`` read
+with a ``RAFT_TPU_`` key anywhere else reintroduces an undeclared knob
+with ad-hoc parsing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.raftlint.core import Finding, Project, dotted_parts
+from tools.raftlint.rules.base import Rule
+
+REGISTRY_MODULE = "raft_tpu.core.env"
+PREFIX = "RAFT_TPU_"
+
+
+def _literal_key(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class EnvDisciplineRule(Rule):
+    id = "R7"
+    summary = "direct RAFT_TPU_* env read outside the core/env registry"
+    rationale = ("the knob registry (this PR): one table of name -> "
+                 "parser -> default -> malformed policy, so a typo'd "
+                 "limit can never silently change behavior and the "
+                 "docs' knob inventory stays complete")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules.values():
+            if not mod.modname.startswith("raft_tpu"):
+                continue
+            if mod.modname == REGISTRY_MODULE:
+                continue
+            for node in ast.walk(mod.tree):
+                key = None
+                if isinstance(node, ast.Call):
+                    fq = mod.resolve(node.func)
+                    parts = dotted_parts(node.func)
+                    is_get = (fq in ("os.getenv", "os.environ.get")
+                              or (parts is not None and len(parts) >= 2
+                                  and parts[-2:] in (["environ", "get"],)
+                                  ))
+                    if is_get and node.args:
+                        key = _literal_key(node.args[0])
+                elif isinstance(node, ast.Subscript):
+                    parts = dotted_parts(node.value)
+                    if parts and parts[-1] == "environ":
+                        key = _literal_key(
+                            node.slice if not isinstance(
+                                node.slice, ast.Index)
+                            else node.slice.value)  # py<3.9 compat
+                if key and key.startswith(PREFIX):
+                    findings.append(Finding(
+                        self.id, mod.relpath, node.lineno,
+                        node.col_offset,
+                        f"{mod.modname}:<module>",
+                        f"direct environment read of {key} bypasses "
+                        "the knob registry",
+                        "declare the knob in raft_tpu/core/env.py and "
+                        "call env.read(name)"))
+        return findings

@@ -1,0 +1,96 @@
+"""R8: annotated numerical breakdown sites in the solver layers.
+
+The AST port of the numeric error-hygiene lint (ISSUE 3): in
+``raft_tpu/linalg/`` and ``raft_tpu/sparse/solver/``, a ``jnp.sqrt``
+whose operand can silently go negative, or a division by a computed
+``jnp.linalg.norm`` (zero vectors divide to NaN/inf), must either carry
+a visible guard — ``maximum``/``abs``/``clip``/eps floor — or an
+explanatory ``# guarded: <why>`` comment naming why the operand cannot
+break. The guard/annotation vocabulary is unchanged from the grep so
+every previously-clean line stays clean; the upgrade is that the check
+now fires on the *call site* (AST node), not on raw line text, so
+string literals and comments can no longer satisfy or dodge it by
+accident.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.raftlint.core import Finding, ModuleInfo, Project
+from tools.raftlint.rules.base import Rule
+
+SCOPES = ("raft_tpu.linalg.", "raft_tpu.sparse.solver.")
+GUARD_TOKENS = ("maximum", "abs", "clip", "eps", "finfo", "1.0 +",
+                "guarded:")
+
+
+def _in_scope(modname: str) -> bool:
+    return any(modname.startswith(s) or modname == s.rstrip(".")
+               for s in SCOPES)
+
+
+def _guarded(mod: ModuleInfo, node: ast.AST) -> bool:
+    """Guard token anywhere on the source lines the expression spans
+    (same vocabulary as the original grep, including the `# guarded:`
+    annotation escape hatch)."""
+    start = node.lineno
+    end = getattr(node, "end_lineno", start) or start
+    text = "\n".join(mod.lines[start - 1:end])
+    return any(tok in text for tok in GUARD_TOKENS)
+
+
+class NumericHygieneRule(Rule):
+    id = "R8"
+    summary = ("unguarded sqrt / norm-divide breakdown site in the "
+               "solver layers")
+    rationale = ("ISSUE 3's numerical sentinels: a sqrt of a silently-"
+                 "negative operand or a divide by a zero norm "
+                 "manufactures NaN/inf that the guard machinery then "
+                 "has to chase — annotate or clamp at the source")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules.values():
+            if not _in_scope(mod.modname):
+                continue
+            for sym, node in self._walk(mod):
+                if isinstance(node, ast.Call):
+                    fq = mod.resolve(node.func)
+                    if fq == "jax.numpy.sqrt" and not _guarded(mod,
+                                                               node):
+                        findings.append(Finding(
+                            self.id, mod.relpath, node.lineno,
+                            node.col_offset, sym,
+                            "unguarded jnp.sqrt — the operand can "
+                            "silently go negative",
+                            "clamp it (jnp.maximum(x, 0)) or annotate "
+                            "'# guarded: <why it cannot>'"))
+                elif isinstance(node, ast.BinOp) and isinstance(
+                        node.op, ast.Div):
+                    right = node.right
+                    if (isinstance(right, ast.Call)
+                            and mod.resolve(right.func)
+                            == "jax.numpy.linalg.norm"
+                            and not _guarded(mod, node)):
+                        findings.append(Finding(
+                            self.id, mod.relpath, node.lineno,
+                            node.col_offset, sym,
+                            "unguarded divide by jnp.linalg.norm — "
+                            "zero vectors divide to NaN/inf",
+                            "floor the norm (jnp.maximum(n, eps)) or "
+                            "annotate '# guarded: <why>'"))
+        return findings
+
+    @staticmethod
+    def _walk(mod: ModuleInfo):
+        by_node = {info.node: f"{mod.modname}:{qual}"
+                   for qual, info in mod.functions.items()}
+
+        def walk(node, sym):
+            for child in ast.iter_child_nodes(node):
+                child_sym = by_node.get(child, sym)
+                yield child_sym, child
+                yield from walk(child, child_sym)
+        yield from walk(mod.tree, f"{mod.modname}:<module>")
