@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Bench regression sentry (ISSUE 13): guard the BENCH history.
+
+Reads the repo's bench artifacts — ``BENCH_r*.json`` (north-star rounds;
+the measurement row lives under ``"parsed"``) and
+``bench_small_cpu_r*.jsonl`` (per-bench JSONL rows) — applies the
+era/``superseded_by`` provenance rules from ``benches/harness.py``, and
+keeps the **best current-era row per bench family** as the baseline.
+
+Two modes:
+
+* **audit** (no ``--fresh``): parse everything, print the per-family
+  baselines, exit 0. Exit 2 on unreadable/corrupt artifacts — a silent
+  parse failure would hollow the gate out.
+* **compare** (``--fresh FILE``, repeatable): every row in each fresh
+  file is checked against its family baseline. Failures (exit 1):
+
+  - regression beyond tolerance — ``median_ms`` rows fail when fresh >
+    best × tol (lower is better); ``value`` rows (iters/sec) fail when
+    fresh < best / tol (higher is better);
+  - stale era — a fresh row whose era predates the newest era already
+    shipped for its family is measuring a retired code path, never a
+    valid pass;
+  - rows carrying ``superseded_by`` are skipped (already retired by
+    their own provenance), and families with no shipped baseline pass
+    with a note.
+
+Tolerance is a ratio (>= 1): ``--tol`` for the default (falls back to
+the registered ``RAFT_TPU_SENTRY_TOL`` knob, default 1.5), and
+``--family-tol FAMILY=RATIO`` (repeatable) per family — the shipped CPU
+rounds drift up to ~2x across container sessions, so per-family
+tightening is how a stable family gets a real gate without the noisy
+ones crying wolf.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def _is_current_row(d: dict, newest_era: int) -> bool:
+    """benches.harness.is_current_row, inlined as the fallback for
+    environments where the benches package (which imports jax) cannot
+    load; the import below overrides this with the canonical one."""
+    if d.get("superseded_by"):
+        return False
+    return int(d.get("era", 0) or 0) >= newest_era
+
+
+try:                                      # canonical provenance rules
+    from benches.harness import is_current_row
+except Exception:                         # no jax in this interpreter
+    is_current_row = _is_current_row
+
+
+def _default_tol() -> float:
+    """RAFT_TPU_SENTRY_TOL via the registered env knob (fail-loud on a
+    malformed value), with a registry-free fallback mirroring the same
+    contract."""
+    try:
+        from raft_tpu.core import env as _env_mod
+        return float(_env_mod.read("RAFT_TPU_SENTRY_TOL"))
+    except (ImportError, KeyError):
+        raw = os.environ.get("RAFT_TPU_SENTRY_TOL", "")
+        if not raw:
+            return 1.5
+        val = float(raw)                  # malformed raises — fail loud
+        if not val >= 1.0:
+            raise ValueError(
+                f"RAFT_TPU_SENTRY_TOL: tolerance ratio must be >= 1.0, "
+                f"got {raw!r}")
+        return val
+
+
+# ---------------------------------------------------------------------------
+# row model: one measurement with a family key and a direction
+# ---------------------------------------------------------------------------
+
+def family_of(row: dict):
+    """Family key + (metric value, higher_is_better) for one row, or
+    None for rows that are not measurements (markers, notes)."""
+    backend = row.get("backend")
+    if "bench" in row and row.get("median_ms") is not None:
+        fam = str(row["bench"]) + (f"@{backend}" if backend else "")
+        return fam, float(row["median_ms"]), False
+    if "metric" in row and row.get("value") is not None:
+        fam = str(row["metric"]) + (f"@{backend}" if backend else "")
+        return fam, float(row["value"]), True
+    return None
+
+
+def load_rows(path: str):
+    """Rows from one artifact: a BENCH_r*.json round (dict with a
+    ``parsed`` measurement) or a JSONL file (one row per line).
+    Raises on unreadable/corrupt input — the gate must fail loud."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    stripped = text.lstrip()
+    rows = []
+    if stripped.startswith("{") and "\n{" not in stripped.strip():
+        doc = json.loads(text)
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            rows.append(parsed)
+        elif family_of(doc):
+            rows.append(doc)
+        return rows
+    for ln, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}:{ln}: bad JSON row: {e}") from None
+        if isinstance(row, dict):
+            rows.append(row)
+    return rows
+
+
+def collect_history(history_dir: str):
+    """(families, newest_era_by_family): per family, the best current
+    row (after provenance filtering) and the newest era shipped."""
+    paths = sorted(glob.glob(os.path.join(history_dir, "BENCH_r*.json")))
+    paths += sorted(glob.glob(os.path.join(history_dir,
+                                           "bench_small_cpu_r*.jsonl")))
+    measured = []                         # (family, val, higher, era, row)
+    for path in paths:
+        for row in load_rows(path):
+            fam = family_of(row)
+            if fam is None:
+                continue
+            name, val, higher = fam
+            measured.append((name, val, higher,
+                             int(row.get("era", 0) or 0), row))
+    newest_era = {}
+    for name, _, _, era, row in measured:
+        if not row.get("superseded_by"):
+            newest_era[name] = max(newest_era.get(name, 0), era)
+    best = {}
+    for name, val, higher, _, row in measured:
+        if not is_current_row(row, newest_era.get(name, 0)):
+            continue
+        cur = best.get(name)
+        if cur is None or (val > cur[0] if higher else val < cur[0]):
+            best[name] = (val, higher)
+    return best, newest_era
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+
+def check_fresh(rows, best, newest_era, tol: float, family_tol: dict):
+    """Yield (level, message) findings; level 'fail' trips the gate."""
+    for row in rows:
+        fam = family_of(row)
+        if fam is None:
+            continue
+        name, val, higher = fam
+        if row.get("superseded_by"):
+            yield ("note", f"{name}: fresh row is superseded by "
+                           f"{row['superseded_by']!r}; skipped")
+            continue
+        base = best.get(name)
+        if base is None:
+            yield ("note", f"{name}: no shipped baseline; passes by "
+                           f"default")
+            continue
+        era = int(row.get("era", 0) or 0)
+        newest = newest_era.get(name, 0)
+        if era < newest:
+            yield ("fail", f"{name}: fresh row is era {era} but the "
+                           f"shipped history is already era {newest} — "
+                           f"a stale-era measurement cannot gate "
+                           f"anything")
+            continue
+        base_val, _ = base
+        t = family_tol.get(name, tol)
+        if higher:
+            floor = base_val / t
+            if val < floor:
+                yield ("fail", f"{name}: {val:g} is below the best "
+                               f"current-era baseline {base_val:g} / "
+                               f"tol {t:g} = {floor:g} "
+                               f"(higher is better)")
+            else:
+                yield ("ok", f"{name}: {val:g} vs baseline "
+                             f"{base_val:g} (tol {t:g})")
+        else:
+            ceil = base_val * t
+            if val > ceil:
+                yield ("fail", f"{name}: {val:g} ms exceeds the best "
+                               f"current-era baseline {base_val:g} ms "
+                               f"x tol {t:g} = {ceil:g} ms "
+                               f"(lower is better)")
+            else:
+                yield ("ok", f"{name}: {val:g} ms vs baseline "
+                             f"{base_val:g} ms (tol {t:g})")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--history", default=REPO_ROOT,
+                    help="directory holding BENCH_r*.json / "
+                         "bench_small_cpu_r*.jsonl (default: repo root)")
+    ap.add_argument("--fresh", action="append", default=[],
+                    help="fresh result file (JSONL rows or a BENCH "
+                         "round artifact) to compare; repeatable")
+    ap.add_argument("--tol", type=float, default=None,
+                    help="default tolerance ratio >= 1 (default: the "
+                         "RAFT_TPU_SENTRY_TOL knob, 1.5)")
+    ap.add_argument("--family-tol", action="append", default=[],
+                    metavar="FAMILY=RATIO",
+                    help="per-family tolerance override; repeatable")
+    args = ap.parse_args(argv)
+
+    try:
+        tol = args.tol if args.tol is not None else _default_tol()
+        if not tol >= 1.0:
+            raise ValueError(f"--tol must be >= 1.0, got {tol}")
+        family_tol = {}
+        for spec in args.family_tol:
+            name, sep, ratio = spec.rpartition("=")
+            if not sep or not name:
+                raise ValueError(
+                    f"--family-tol wants FAMILY=RATIO, got {spec!r}")
+            r = float(ratio)
+            if not r >= 1.0:
+                raise ValueError(
+                    f"--family-tol ratio must be >= 1.0, got {spec!r}")
+            family_tol[name] = r
+        best, newest_era = collect_history(args.history)
+    except (OSError, ValueError) as e:
+        print(f"perf_sentry: ERROR: {e}", file=sys.stderr)
+        return 2
+
+    if not best:
+        print(f"perf_sentry: ERROR: no bench history under "
+              f"{args.history}", file=sys.stderr)
+        return 2
+
+    if not args.fresh:
+        print(f"perf_sentry: audit of {len(best)} bench families "
+              f"(best current-era baselines):")
+        for name in sorted(best):
+            val, higher = best[name]
+            unit = "" if higher else " ms"
+            era = newest_era.get(name, 0)
+            print(f"  {name}: {val:g}{unit} (era {era}, "
+                  f"{'higher' if higher else 'lower'} is better)")
+        print("perf_sentry: PASS (audit)")
+        return 0
+
+    failures = 0
+    for path in args.fresh:
+        try:
+            rows = load_rows(path)
+        except (OSError, ValueError) as e:
+            print(f"perf_sentry: ERROR: {e}", file=sys.stderr)
+            return 2
+        for level, msg in check_fresh(rows, best, newest_era, tol,
+                                      family_tol):
+            tag = {"fail": "FAIL", "ok": "ok", "note": "note"}[level]
+            print(f"perf_sentry: {tag}: {msg}")
+            failures += level == "fail"
+    if failures:
+        print(f"perf_sentry: FAIL ({failures} regression(s))",
+              file=sys.stderr)
+        return 1
+    print("perf_sentry: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,9 +41,9 @@ def main(path: str) -> None:
     if not rows:
         print("(no results)")
         return
-    print("| bench | median ms | throughput | recall@k "
+    print("| bench | median ms | throughput | roofline | recall@k "
           "| qps @ ranks | dev/host ms per iter | params |")
-    print("|---|---|---|---|---|---|---|")
+    print("|---|---|---|---|---|---|---|---|")
     # device_ms_per_iter / host_overhead_ms_per_iter: the era-8
     # compiled-inner-loop split on MULTICHIP solver rows. Rendered as
     # its own column so a collective-overhead claim has to show the
@@ -53,9 +53,14 @@ def main(path: str) -> None:
     # renders side by side (blank for exact rows). serve_qps @ n_ranks:
     # the era-11 sharded-serving column — a scaling claim has to show
     # served qps next to the rank count that bought it.
+    # mxu_frac / hbm_frac: harness ceiling fractions (TPU rows);
+    # roofline_frac: the era-13 obs.perf measured fraction. Rendered as
+    # one column — the larger ceiling fraction names the bound a perf
+    # claim is pushing against.
     skip = {"bench", "median_ms", "best_ms", "repeats", "era",
             "device_ms_per_iter", "host_overhead_ms_per_iter",
-            "recall_at_k", "serve_qps"}
+            "recall_at_k", "serve_qps", "mxu_frac", "hbm_frac",
+            "roofline_frac"}
     for r in sorted(rows, key=lambda r: r["bench"]):
         thr = ""
         for k, unit in (("GFLOP_per_s", "GFLOP/s"), ("GB_per_s", "GB/s"),
@@ -67,6 +72,17 @@ def main(path: str) -> None:
         if r.get("device_ms_per_iter") is not None:
             split = (f"{r['device_ms_per_iter']} / "
                      f"{r.get('host_overhead_ms_per_iter', 0.0)}")
+        roof = ""
+        if r.get("roofline_frac") is not None:
+            roof = f"{float(r['roofline_frac']):.2f}"
+        else:
+            mxu = r.get("mxu_frac")
+            hbm = r.get("hbm_frac")
+            if mxu is not None or hbm is not None:
+                mxu = float(mxu or 0.0)
+                hbm = float(hbm or 0.0)
+                roof = (f"{mxu:.2f} mxu" if mxu >= hbm
+                        else f"{hbm:.2f} hbm")
         recall = ""
         if r.get("recall_at_k") is not None:
             recall = f"{r['recall_at_k']}"
@@ -78,8 +94,8 @@ def main(path: str) -> None:
                            if k not in skip and f"{k} {v}" not in thr
                            and k not in ("GFLOP_per_s", "GB_per_s",
                                          "items_per_s"))
-        print(f"| {r['bench']} | {r['median_ms']} | {thr} | {recall} "
-              f"| {qps_ranks} | {split} | {params} |")
+        print(f"| {r['bench']} | {r['median_ms']} | {thr} | {roof} "
+              f"| {recall} | {qps_ranks} | {split} | {params} |")
 
 
 if __name__ == "__main__":

@@ -982,4 +982,126 @@ print(f"sharded-serving gate: 2-rank full probe bit-identical; zero "
       f"recovery_time_to_slo_s={rep.recovery_time_to_slo_s:.3f}s")
 PYEOF
 
+# Fail-loud perf knobs (ISSUE 13 satellite): a malformed peak override
+# or sentry tolerance must raise at the read site, never silently skew
+# every roofline fraction / gate decision.
+for spec in "RAFT_TPU_PERF_PEAKS=banana" "RAFT_TPU_PERF_PEAKS=watts=3" \
+            "RAFT_TPU_SENTRY_TOL=banana" "RAFT_TPU_SENTRY_TOL=0.5"; do
+    if env "$spec" JAX_PLATFORMS=cpu python -c \
+            "from raft_tpu.core import hw, env
+hw.peaks(backend='cpu'); env.read('RAFT_TPU_SENTRY_TOL')" \
+            >/dev/null 2>&1; then
+        echo "perf-knob gate: $spec must fail at the read site"
+        exit 1
+    fi
+done
+echo "perf-knob gate: malformed PEAKS/TOL values fail loud"
+
+# Perf-attribution gate (ISSUE 13 acceptance): the served bits must be
+# identical with RAFT_TPU_PERF off and on; with it on, every warmed
+# (service, bucket) executable must report nonzero static costs plus a
+# measured roofline fraction, with the gauges live in the registry.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+
+from raft_tpu import obs, serve
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.obs import perf
+
+DIM = 16
+rng = np.random.default_rng(7)
+db = rng.standard_normal((128, DIM)).astype(np.float32)
+cen = rng.standard_normal((6, DIM)).astype(np.float32)
+queries = [rng.standard_normal((r, DIM)).astype(np.float32)
+           for r in (1, 3, 8, 2, 6, 5)]
+ops = ["knn_k4_l2", "pairwise_l2_expanded", "kmeans_predict_k6"]
+
+
+def run_serve():
+    ex = serve.Executor(
+        [serve.KnnService(db, k=4), serve.PairwiseService(db),
+         serve.KMeansPredictService(cen)],
+        policy=serve.BatchPolicy(max_batch=64, max_wait_ms=5.0))
+    ex.warm([8, 16])
+    outs = []
+    with ex:
+        futs = [(ops[i % 3], ex.submit(ops[i % 3], q))
+                for i, q in enumerate(queries)]
+        for op, f in futs:
+            got = f.result(timeout=60)
+            got = got if isinstance(got, tuple) else (got,)
+            outs.append([np.asarray(x) for x in got])
+    return outs
+
+
+assert not perf.perf_enabled(), "RAFT_TPU_PERF must default off"
+base = run_serve()
+
+obs_metrics.set_registry(obs.MetricsRegistry())
+obs.set_enabled(True)
+perf.set_perf_enabled(True)
+perf.clear_perf_profiles()
+armed = run_serve()
+
+for b, a in zip(base, armed):
+    for x, y in zip(b, a):
+        np.testing.assert_array_equal(x, y)
+
+profs = perf.perf_profiles()
+for op in ops:
+    for bucket in (8, 16):
+        p = profs[(op, bucket)]
+        assert p.flops > 0 or p.bytes > 0, \
+            f"{op}[{bucket}]: no static costs ({p.source})"
+        assert p.launches >= 1 and p.roofline_frac > 0, \
+            f"{op}[{bucket}]: no measured roofline ({p.as_dict()})"
+snap = obs_metrics.get_registry().snapshot()
+for g in ("perf_roofline_frac", "perf_achieved_bytes_per_s",
+          "perf_achieved_flops_per_s"):
+    assert snap.get(g, {}).get("series"), f"{g} gauge missing"
+sect = obs.snapshot()["perf"]
+assert sect["enabled"] and sect["peaks"]["flops_per_s"] > 0
+n_xla = sum(1 for p in profs.values() if p.source == "xla")
+perf.set_perf_enabled(False)
+obs.set_enabled(False)
+print(f"perf gate: serve bits identical off/on; {len(profs)} warmed "
+      f"executables profiled ({n_xla} via XLA cost analysis), roofline "
+      f"gauges live against {sect['peaks']['name']} peaks")
+PYEOF
+
+# Bench sentry (ISSUE 13): the shipped history must audit clean, a
+# fresh copy of the best row must pass, and a seeded 2x regression of
+# the same row must trip the gate.
+JAX_PLATFORMS=cpu python ci/perf_sentry.py >/dev/null
+SENTRY_TMP=$(mktemp -d)
+python - "$SENTRY_TMP" <<'PYEOF'
+import json
+import sys
+
+sys.path.insert(0, ".")
+from ci.perf_sentry import collect_history
+
+# seed from the sentry's own baseline (shipped rounds drift several x
+# between container sessions, so a literal copy of one round's row is
+# not a guaranteed pass — the best current-era value is, by definition)
+best, newest = collect_history(".")
+val, higher = best["linalg/add"]
+assert not higher
+row = {"bench": "linalg/add", "median_ms": val,
+       "era": newest["linalg/add"]}
+with open(sys.argv[1] + "/fresh_ok.jsonl", "w") as fh:
+    fh.write(json.dumps(row) + "\n")
+with open(sys.argv[1] + "/fresh_bad.jsonl", "w") as fh:
+    fh.write(json.dumps(dict(row, median_ms=val * 2.0)) + "\n")
+PYEOF
+JAX_PLATFORMS=cpu python ci/perf_sentry.py \
+    --fresh "$SENTRY_TMP/fresh_ok.jsonl" >/dev/null
+if JAX_PLATFORMS=cpu python ci/perf_sentry.py \
+        --fresh "$SENTRY_TMP/fresh_bad.jsonl" >/dev/null 2>&1; then
+    echo "sentry gate: seeded regression must exit nonzero"
+    exit 1
+fi
+rm -rf "$SENTRY_TMP"
+echo "sentry gate: shipped history audits clean; seeded regression trips"
+
 echo "smoke: PASS"

@@ -579,14 +579,18 @@ def kmeans_fit(res, params: KMeansParams, x,
         else:
             chunk_call = functools.partial(
                 _lloyd_chunk, x, n_clusters=params.n_clusters, tol=tol)
-        est = limits.estimate_seconds(
-            "cluster.lloyd_step", m=int(x.shape[0]), k=int(x.shape[1]),
-            n_clusters=params.n_clusters, itemsize=x.dtype.itemsize)
+        dims = dict(m=int(x.shape[0]), k=int(x.shape[1]),
+                    n_clusters=params.n_clusters,
+                    itemsize=x.dtype.itemsize)
+        est = limits.estimate_seconds("cluster.lloyd_step", **dims)
+        sf, sb = limits.estimate_flops_bytes("cluster.lloyd_step",
+                                             **dims)
         carry = (c, jnp.asarray(jnp.inf, acc), jnp.asarray(jnp.inf, acc))
         carry, n_iter, done = compiled_driver.run_chunked(
             chunk_call, carry, max_steps=params.max_iter,
             sync_every=sync, op="cluster.kmeans_fit",
-            est_step_seconds=est, sentinel=_lloyd_sentinel)
+            est_step_seconds=est, step_flops=sf, step_bytes=sb,
+            sentinel=_lloyd_sentinel)
         c = carry[0]
         rel_change = float(np.asarray(carry[2]))
         converged = bool(done)
@@ -965,11 +969,12 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
         acc = compiled_driver.host_float_dtype()
         chunk_stride = (None if manager is None
                         else sync * max(1, int(checkpoint_every)))
-        est = limits.estimate_seconds(
-            "cluster.lloyd_step",
-            m=-(-int(x.shape[0]) // mesh.shape[data_axis]),
-            k=int(x.shape[1]), n_clusters=params.n_clusters,
-            itemsize=x.dtype.itemsize)
+        dims = dict(m=-(-int(x.shape[0]) // mesh.shape[data_axis]),
+                    k=int(x.shape[1]), n_clusters=params.n_clusters,
+                    itemsize=x.dtype.itemsize)
+        est = limits.estimate_seconds("cluster.lloyd_step", **dims)
+        sf, sb = limits.estimate_flops_bytes("cluster.lloyd_step",
+                                             **dims)
         carry = (c,
                  jnp.asarray(np.inf if prev is None else prev, acc),
                  jnp.asarray(np.inf, acc))
@@ -996,6 +1001,7 @@ def kmeans_fit_mnmg(res, params: KMeansParams, x,
                     run_chunk, carry, max_steps=params.max_iter,
                     sync_every=sync, op="cluster.kmeans_fit_mnmg",
                     steps_done=n_iter, est_step_seconds=est,
+                    step_flops=sf, step_bytes=sb,
                     boundary=boundary, sentinel=_lloyd_sentinel)
                 converged = bool(conv)
                 c = carry[0]

@@ -93,6 +93,43 @@ def _parse_float(raw: str) -> float:
         raise ValueError(f"{raw!r} is not a number") from None
 
 
+def _parse_peaks(raw: str) -> Dict[str, float]:
+    """``flops=<num>,bytes=<num>`` device-peak override terms (either
+    term may be omitted; at least one must be present, both positive)."""
+    out: Dict[str, float] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, val = part.partition("=")
+        key = key.strip().lower()
+        if not sep or key not in ("flops", "bytes"):
+            raise ValueError(
+                f"want 'flops=<num>,bytes=<num>' terms, got {part!r}")
+        try:
+            num = float(val)
+        except ValueError:
+            raise ValueError(f"{val!r} is not a number") from None
+        if not num > 0.0:
+            raise ValueError(f"{key} peak must be positive, got {val!r}")
+        out[key] = num
+    if not out:
+        raise ValueError(
+            "want at least one 'flops=<num>' or 'bytes=<num>' term")
+    return out
+
+
+def _parse_tolerance(raw: str) -> float:
+    """A regression-tolerance ratio: a float >= 1.0."""
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(f"{raw!r} is not a number") from None
+    if not val >= 1.0:
+        raise ValueError(f"tolerance ratio must be >= 1.0, got {raw!r}")
+    return val
+
+
 def _choice(*options: str) -> Callable[[str], str]:
     def parse(raw: str) -> str:
         low = raw.lower()
@@ -218,6 +255,10 @@ register("RAFT_TPU_METRICS_JSONL", _parse_str, None, on_malformed="warn",
          help="auto-attach a JSONL metrics sink at import (metrics on)")
 register("RAFT_TPU_FLIGHT_DIR", _parse_str, None, on_malformed="warn",
          help="on-disk flight-recorder bundle directory")
+register("RAFT_TPU_PERF", _parse_onoff, False, on_malformed="warn",
+         help="arm per-executable performance attribution "
+              "(obs/perf.py roofline telemetry); off = single-bool "
+              "no-op, bit-identical")
 
 # Fail-loud limits and tuning knobs: malformed raises at the read site
 # (import time for the import-read ones) — never a silent fallback.
@@ -236,6 +277,15 @@ register("RAFT_TPU_MST", _choice("auto", "grid", "xla"), "auto",
          help="force the Borůvka E-stage formulation")
 register("RAFT_TPU_SPMV", _choice("auto", "grid", "ell", "segment"), "auto",
          help="force the SpMV formulation")
+register("RAFT_TPU_PERF_PEAKS", _parse_peaks, None,
+         help="override the core/hw.py device-peak table: "
+              "'flops=<num>,bytes=<num>' per-second peaks (either term "
+              "alone overrides just that axis); malformed raises at the "
+              "read site — a typo'd peak must never silently skew every "
+              "roofline fraction")
+register("RAFT_TPU_SENTRY_TOL", _parse_tolerance, 1.5,
+         help="ci/perf_sentry.py default regression-tolerance ratio "
+              "(>= 1.0); malformed raises at the read site")
 
 # Loose flags (any value but 0/false arms them).
 register("RAFT_TPU_PALLAS_INTERPRET", _parse_flag, None,

@@ -1,0 +1,124 @@
+"""Per-backend hardware peak table (ISSUE 13): the denominator of every
+roofline fraction.
+
+One module owns the device peaks the performance-attribution layer
+(:mod:`raft_tpu.obs.perf`) divides achieved FLOP/s and bytes/s by — so
+a "0.31 of roofline" claim always names the ceiling it was measured
+against. Two tables live here:
+
+* :data:`TPU_PEAKS` — per-generation theoretical peaks (bf16 MXU
+  FLOP/s, HBM bytes/s), matched against ``device.device_kind``. The
+  v5e row is the same ceiling pair ``benches/harness.py`` bakes into
+  its roofline columns (197 TFLOP/s, 819 GB/s), so a bench row's
+  ``mxu_frac`` and a live ``perf_roofline_frac`` gauge are measured
+  against one number.
+* :data:`SUSTAINED_FLOP_S` / :data:`SUSTAINED_BYTES_S` — the coarse
+  order-of-magnitude sustained throughputs ``runtime/limits.py`` uses
+  to seed its fast-fail chunk-seconds estimates (rehomed here from
+  limits so the serving admission model and the roofline denominator
+  can never drift apart silently; limits re-exports them).
+
+``RAFT_TPU_PERF_PEAKS=flops=<num>,bytes=<num>`` overrides the detected
+peaks (either term alone overrides just that axis) — the escape hatch
+for a generation this table predates. Malformed values raise at the
+read site (the ``RAFT_TPU_HBM_BUDGET`` fail-loud policy): a typo'd
+peak silently skewing every roofline fraction is a debugging session.
+
+Dependency discipline: this module imports only ``core/env`` (jax is
+touched lazily inside :func:`peaks`), so obs, limits, and the serving
+layer can all consume it without cycles.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+from raft_tpu.core import env as _env_mod
+
+__all__ = ["HwPeaks", "peaks", "TPU_PEAKS", "CPU_PEAKS", "GPU_PEAKS",
+           "SUSTAINED_FLOP_S", "SUSTAINED_BYTES_S"]
+
+
+@dataclass(frozen=True)
+class HwPeaks:
+    """One device's roofline ceilings: peak FLOP/s (bf16 MXU on TPU),
+    peak HBM bytes/s, and where the numbers came from (``"table"`` —
+    the generation table below; ``"fallback"`` — unrecognized device
+    kind; ``"env"`` — a ``RAFT_TPU_PERF_PEAKS`` override)."""
+
+    name: str
+    flops_per_s: float
+    bytes_per_s: float
+    source: str = "table"
+
+
+# Per-generation theoretical peaks, matched longest-substring-first
+# against the lowercased ``device_kind`` (e.g. "TPU v5 lite"). FLOP/s
+# figures are the bf16 MXU peaks; bytes/s the HBM bandwidth — both per
+# chip. The v5e row matches benches/harness.py's roofline ceilings.
+TPU_PEAKS: Tuple[Tuple[str, HwPeaks], ...] = (
+    ("v6e", HwPeaks("tpu-v6e", 918e12, 1.64e12)),
+    ("v6 lite", HwPeaks("tpu-v6e", 918e12, 1.64e12)),
+    ("v5p", HwPeaks("tpu-v5p", 459e12, 2.765e12)),
+    ("v5e", HwPeaks("tpu-v5e", 197e12, 8.19e11)),
+    ("v5 lite", HwPeaks("tpu-v5e", 197e12, 8.19e11)),
+    ("v4", HwPeaks("tpu-v4", 275e12, 1.228e12)),
+    ("v3", HwPeaks("tpu-v3", 123e12, 9.0e11)),
+    ("v2", HwPeaks("tpu-v2", 45e12, 7.0e11)),
+)
+
+# CPU fallback: the order-of-magnitude sustained figures the limits
+# cost model has used since PR 5 — a host test backend has no stable
+# "theoretical peak" worth pretending to.
+CPU_PEAKS = HwPeaks("cpu", 5e10, 2e10)
+GPU_PEAKS = HwPeaks("gpu", 5e13, 1e12)
+_TPU_FALLBACK = HwPeaks("tpu", 197e12, 8.19e11, source="fallback")
+
+# Coarse sustained throughputs for the limits fast-fail chunk-seconds
+# model (formerly limits._PEAK_FLOP_S/_PEAK_BYTES_S; limits re-exports
+# these). Intentionally below theoretical peak — they seed an admission
+# decision, not a measurement.
+SUSTAINED_FLOP_S = {"cpu": 5e10, "gpu": 5e13, "tpu": 6e13}
+SUSTAINED_BYTES_S = {"cpu": 2e10, "gpu": 1e12, "tpu": 8.19e11}
+
+
+def _detect(device=None, backend: Optional[str] = None) -> HwPeaks:
+    if backend is None or device is not None:
+        import jax
+
+        if device is None:
+            devs = jax.devices()
+            if not devs:
+                return CPU_PEAKS
+            device = devs[0]
+        backend = device.platform
+        kind = (getattr(device, "device_kind", "") or "").lower()
+    else:
+        kind = ""
+    if backend == "tpu":
+        for frag, pk in TPU_PEAKS:
+            if frag in kind:
+                return pk
+        return _TPU_FALLBACK
+    if backend == "gpu":
+        return GPU_PEAKS
+    if backend == "cpu":
+        return CPU_PEAKS
+    return replace(CPU_PEAKS, name=backend or "unknown",
+                   source="fallback")
+
+
+def peaks(device=None, *, backend: Optional[str] = None) -> HwPeaks:
+    """Roofline ceilings for ``device`` (default: the first JAX device;
+    ``backend`` alone skips device inspection — the spelling limits and
+    tests use). ``RAFT_TPU_PERF_PEAKS`` terms override the detected
+    values and raise at this read on a malformed spelling."""
+    pk = _detect(device, backend)
+    override = _env_mod.read("RAFT_TPU_PERF_PEAKS")
+    if override:
+        pk = HwPeaks(pk.name,
+                     override.get("flops", pk.flops_per_s),
+                     override.get("bytes", pk.bytes_per_s),
+                     source="env")
+    return pk

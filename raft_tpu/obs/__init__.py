@@ -26,6 +26,15 @@ escalation events, checkpoint logs. Three parts:
     a typed raise site snapshots the span/event rings + registry into
     a bounded bundle ring (and a JSONL file under
     ``RAFT_TPU_FLIGHT_DIR``).
+:mod:`raft_tpu.obs.perf`
+    performance attribution (ISSUE 13): per-executable static costs
+    (XLA ``cost_analysis`` with a limits-model fallback) keyed like the
+    serve executor's warmed (service, bucket) executables, converted at
+    launch time into achieved FLOP/s / bytes/s / roofline-fraction
+    gauges against the :mod:`raft_tpu.core.hw` peak table, plus HBM
+    watermarks and ``profile_session`` (span-aligned ``jax.profiler``
+    capture). ``RAFT_TPU_PERF=off`` (the default) keeps every helper a
+    single-bool no-op.
 
 Everything any instrumented module needs is re-exported here; emitting
 through private internals (or a second bespoke registry) is a lint
@@ -39,7 +48,7 @@ from raft_tpu.obs.metrics import (          # noqa: F401
 )
 from raft_tpu.obs.spans import (            # noqa: F401
     span, spans, clear_spans, record_span, set_sample_rate,
-    set_retention,
+    set_retention, ring_stats,
 )
 from raft_tpu.obs.export import (           # noqa: F401
     emit_event, events, clear_events,
@@ -54,13 +63,18 @@ from raft_tpu.obs.flight import (           # noqa: F401
     record_failure, flight_bundles, clear_flight_bundles,
     set_flight_dir, flight_dir,
 )
+from raft_tpu.obs.perf import (             # noqa: F401
+    ExecutableProfile, perf_enabled, set_perf_enabled,
+    profile_executable, record_launch, record_hbm_watermark,
+    profile_session, perf_profiles, clear_perf_profiles, perf_snapshot,
+)
 
 __all__ = [
     "enabled", "set_enabled", "MetricsRegistry", "get_registry",
     "set_registry", "log_buckets", "DEFAULT_BUCKETS", "RESIDUAL_BUCKETS",
     "inc", "set_gauge", "observe", "record_convergence",
     "span", "spans", "clear_spans", "record_span", "set_sample_rate",
-    "set_retention",
+    "set_retention", "ring_stats",
     "emit_event", "events", "clear_events",
     "JsonlSink", "get_sink", "set_sink",
     "snapshot", "render_prometheus", "render_chrome_trace",
@@ -68,4 +82,8 @@ __all__ = [
     "current_context", "use_context", "adopt",
     "record_failure", "flight_bundles", "clear_flight_bundles",
     "set_flight_dir", "flight_dir",
+    "ExecutableProfile", "perf_enabled", "set_perf_enabled",
+    "profile_executable", "record_launch", "record_hbm_watermark",
+    "profile_session", "perf_profiles", "clear_perf_profiles",
+    "perf_snapshot",
 ]

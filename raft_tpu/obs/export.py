@@ -124,6 +124,10 @@ def set_sink(sink: Optional[JsonlSink]) -> Optional[JsonlSink]:
 
 _events_lock = threading.Lock()
 _events: Deque[dict] = collections.deque(maxlen=1024)
+# ring-overwrite counter (ISSUE 13 satellite): events evicted by the
+# bounded ring since the last clear_events() — snapshot() surfaces it
+# so a truncated event history is distinguishable from a quiet one
+_events_overwritten = 0
 
 
 def emit_event(name: str, **attrs) -> None:
@@ -141,7 +145,10 @@ def emit_event(name: str, **attrs) -> None:
         if ctx is not None:
             ev.update(ctx.attrs())
     ev.update(attrs)
+    global _events_overwritten
     with _events_lock:
+        if len(_events) == _events.maxlen:
+            _events_overwritten += 1
         _events.append(ev)
     sink = _sink
     if sink is not None:
@@ -162,8 +169,10 @@ def events(name: Optional[str] = None) -> List[dict]:
 
 
 def clear_events() -> None:
+    global _events_overwritten
     with _events_lock:
         _events.clear()
+        _events_overwritten = 0
 
 
 def _sink_span(rec: dict) -> None:
@@ -184,15 +193,26 @@ def _sink_span(rec: dict) -> None:
 
 def snapshot(registry: Optional[_metrics.MetricsRegistry] = None) -> dict:
     """One JSON-able dict of everything: enabled flag, every metric
-    family/series, and span-ring occupancy. This is what ``bench.py``
-    attaches to its output line."""
-    from raft_tpu.obs.spans import spans as _list_spans
+    family/series, span/event ring occupancy *and loss counters* (a
+    truncated flight bundle must be distinguishable from a quiet
+    system), and the performance-attribution section
+    (:mod:`raft_tpu.obs.perf`). This is what ``bench.py`` attaches to
+    its output line."""
+    from raft_tpu.obs import perf as _perf
+    from raft_tpu.obs.spans import ring_stats as _ring_stats
     reg = registry or _metrics.get_registry()
+    st = _ring_stats()
+    with _events_lock:
+        ev_retained, ev_overwritten = len(_events), _events_overwritten
     return {
         "enabled": _metrics.enabled(),
         "metrics": reg.snapshot(),
-        "spans_retained": len(_list_spans()),
-        "events_retained": len(events()),
+        "spans_retained": st["retained"],
+        "spans_dropped": st["dropped"],
+        "spans_sampled_out": st["sampled_out"],
+        "events_retained": ev_retained,
+        "events_overwritten": ev_overwritten,
+        "perf": _perf.perf_snapshot(),
     }
 
 

@@ -1,0 +1,335 @@
+"""Performance attribution (ISSUE 13 tentpole): per-executable static
+costs, achieved-throughput roofline telemetry, and aligned device
+profiling.
+
+The request-level layers (metrics, spans, tracing, flight) answer *what
+happened*; this module answers *how fast* — in the spirit of the
+Roofline model (Williams et al., CACM 2009) and always-on fleet
+profiling (Google-Wide Profiling, Ren et al., IEEE Micro 2010):
+
+* **Static costs at warm time.** :func:`profile_executable` registers
+  an :class:`ExecutableProfile` keyed exactly the way
+  ``serve/executor.py`` keys its warmed executables — ``(op, bucket)``
+  — holding the executable's flops and bytes. When a traceable
+  ``fn``/``example`` pair is given, the costs come from XLA's own
+  ``compiled.cost_analysis()`` (source ``"xla"``); otherwise (or when
+  the compiler declines) they fall back to the caller's model numbers —
+  the same ``limits.estimate_bytes`` / ``estimate_seconds`` cost models
+  the admission layer already trusts (source ``"model"``).
+* **Achieved throughput at launch time.** :func:`record_launch`
+  converts a wall time the executor / compiled-driver already measures
+  into achieved FLOP/s, bytes/s, and a roofline fraction against
+  :func:`raft_tpu.core.hw.peaks`, classifying each launch as
+  ``compute`` / ``bandwidth`` / ``overhead`` bound and emitting
+  ``perf_roofline_frac{op,bucket,bound}``,
+  ``perf_achieved_flops_per_s`` and ``perf_achieved_bytes_per_s``
+  gauges through the one obs registry.
+* **HBM watermarks.** :func:`record_hbm_watermark` polls
+  ``device_memory_stats`` (compiled-driver chunk boundaries call it)
+  into ``perf_hbm_bytes_in_use`` / ``perf_hbm_peak_bytes_in_use``.
+* **Aligned device profiles.** :func:`profile_session` wraps
+  ``jax.profiler`` tracing and records a ``perf.profile_session`` span
+  over the same monotonic clock the span ring uses, so the captured
+  device profile lines up with host spans in the PR-10 Perfetto export
+  (``obs.render_chrome_trace``).
+
+Cost discipline is the established one: ``RAFT_TPU_PERF=off`` (the
+default) makes every helper here a single-bool no-op — bit-identical
+library behavior, pinned by raftlint R5 and the serve-path CI identity
+gate. The knob is independent of ``RAFT_TPU_METRICS``: profiles
+accumulate whenever perf is on, gauges additionally publish when
+metrics are on too.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from raft_tpu.core import env as _env_mod
+from raft_tpu.core import hw as _hw
+from raft_tpu.obs import metrics as _metrics
+
+__all__ = [
+    "ExecutableProfile", "perf_enabled", "set_perf_enabled",
+    "profile_executable", "record_launch", "record_hbm_watermark",
+    "profile_session", "perf_profiles", "clear_perf_profiles",
+    "perf_snapshot",
+]
+
+# the single-bool switch (same discipline as metrics._enabled)
+_enabled: bool = _env_mod.read("RAFT_TPU_PERF")
+
+# a launch whose modeled device time explains less than this fraction
+# of its wall time spent the wall on dispatch/queueing/compile, not the
+# device — classified "overhead" rather than compute/bandwidth bound
+OVERHEAD_FRAC = 0.1
+
+_lock = threading.Lock()
+_profiles: Dict[Tuple[str, Any], "ExecutableProfile"] = {}
+_peaks: Optional[_hw.HwPeaks] = None
+_hbm: Dict[str, int] = {"bytes_in_use": 0, "peak_bytes_in_use": 0,
+                        "polls": 0}
+
+
+def perf_enabled() -> bool:
+    return _enabled
+
+
+def set_perf_enabled(on: bool) -> bool:
+    """Flip performance attribution at runtime (the programmatic twin
+    of ``RAFT_TPU_PERF``); returns the previous state."""
+    global _enabled
+    prev, _enabled = _enabled, bool(on)
+    return prev
+
+
+@dataclass
+class ExecutableProfile:
+    """Static costs + running achieved-throughput attribution for one
+    warmed executable. ``flops``/``bytes`` are per launch at scale 1
+    (per chunk *step* for the compiled-driver entries)."""
+
+    op: str
+    bucket: Any                      # serve row bucket, or "chunk"
+    flops: float = 0.0
+    bytes: float = 0.0
+    source: str = "model"            # "xla" | "model"
+    launches: int = 0
+    wall_s: float = 0.0              # cumulative measured wall
+    steps: float = 0.0               # cumulative launch scale
+    achieved_flops_per_s: float = 0.0
+    achieved_bytes_per_s: float = 0.0
+    roofline_frac: float = 0.0
+    bound: str = ""                  # "compute"|"bandwidth"|"overhead"
+    attrs: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"op": self.op, "bucket": self.bucket,
+                "flops": self.flops, "bytes": self.bytes,
+                "source": self.source, "launches": self.launches,
+                "wall_s": round(self.wall_s, 6),
+                "achieved_flops_per_s": self.achieved_flops_per_s,
+                "achieved_bytes_per_s": self.achieved_bytes_per_s,
+                "roofline_frac": self.roofline_frac,
+                "bound": self.bound, **self.attrs}
+
+
+def _device_peaks() -> _hw.HwPeaks:
+    global _peaks
+    pk = _peaks
+    if pk is None:
+        pk = _peaks = _hw.peaks()
+    return pk
+
+
+def reset_peaks() -> None:
+    """Drop the cached peak table (tests that flip the env override)."""
+    global _peaks
+    _peaks = None
+
+
+def _xla_costs(fn, example) -> Tuple[float, float]:
+    """flops / bytes-accessed from XLA's cost analysis of ``fn`` lowered
+    at ``example``'s shapes. Raises on any compiler refusal — the
+    caller falls back to the model costs."""
+    import jax
+
+    compiled = jax.jit(fn).lower(*example).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):     # older JAX returns [dict]
+        ca = ca[0] if ca else {}
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    bytes_ = float(ca.get("bytes accessed", 0.0) or 0.0)
+    if flops <= 0.0 and bytes_ <= 0.0:
+        raise ValueError("cost analysis returned no flops/bytes")
+    return flops, bytes_
+
+
+def profile_executable(op: str, bucket, *, fn=None, example=None,
+                       model_flops: float = 0.0,
+                       model_bytes: float = 0.0,
+                       **attrs) -> Optional[ExecutableProfile]:
+    """Register (or refresh) the static-cost profile for one
+    ``(op, bucket)`` executable. No-op returning None when perf is off.
+
+    With a ``fn``/``example`` pair the costs come from XLA's
+    ``cost_analysis()`` of a fresh lowering (an extra compile — paid
+    only when perf is on, at warm time, never per launch); on any
+    compiler refusal — or without a pair — the ``model_*`` numbers from
+    the limits cost models are used instead, so every profile always
+    has *some* static cost to attribute launches against."""
+    if not _enabled:
+        return None
+    flops, bytes_, source = float(model_flops), float(model_bytes), "model"
+    if fn is not None and example is not None:
+        try:
+            flops, bytes_ = _xla_costs(fn, example)
+            source = "xla"
+        except Exception:
+            pass                     # model fallback, already loaded
+    key = (op, bucket)
+    with _lock:
+        prof = _profiles.get(key)
+        if prof is None:
+            prof = _profiles[key] = ExecutableProfile(op, bucket)
+        prof.flops, prof.bytes, prof.source = flops, bytes_, source
+        prof.attrs.update(attrs)
+    return prof
+
+
+def record_launch(op: str, bucket, wall_s: float, *,
+                  steps: float = 1.0) -> Optional[ExecutableProfile]:
+    """Attribute one measured launch to its profile: achieved FLOP/s,
+    bytes/s, roofline fraction, and a compute/bandwidth/overhead bound
+    classification, published as gauges. ``steps`` scales the static
+    per-launch costs (the compiled driver passes the number of solver
+    iterations its chunk ran). No-op when perf is off; silently ignores
+    launches with no registered profile or a non-positive wall."""
+    if not _enabled:
+        return None
+    wall_s = float(wall_s)
+    if wall_s <= 0.0:
+        return None
+    with _lock:
+        prof = _profiles.get((op, bucket))
+        if prof is None:
+            return None
+        flops = prof.flops * steps
+        bytes_ = prof.bytes * steps
+        prof.launches += 1
+        prof.wall_s += wall_s
+        prof.steps += steps
+        prof.achieved_flops_per_s = flops / wall_s
+        prof.achieved_bytes_per_s = bytes_ / wall_s
+        pk = _device_peaks()
+        t_f = flops / pk.flops_per_s if pk.flops_per_s > 0 else 0.0
+        t_b = bytes_ / pk.bytes_per_s if pk.bytes_per_s > 0 else 0.0
+        t_dev = max(t_f, t_b)
+        frac = t_dev / wall_s
+        prof.roofline_frac = frac
+        if t_dev < OVERHEAD_FRAC * wall_s:
+            bound = "overhead"
+        elif t_f >= t_b:
+            bound = "compute"
+        else:
+            bound = "bandwidth"
+        prof.bound = bound
+    lbl = str(bucket)
+    _metrics.set_gauge("perf_roofline_frac", frac,
+                       help="achieved fraction of the binding roofline "
+                            "ceiling for the last launch",
+                       op=op, bucket=lbl, bound=bound)
+    _metrics.set_gauge("perf_achieved_flops_per_s",
+                       prof.achieved_flops_per_s,
+                       help="achieved FLOP/s over the last launch",
+                       op=op, bucket=lbl)
+    _metrics.set_gauge("perf_achieved_bytes_per_s",
+                       prof.achieved_bytes_per_s,
+                       help="achieved HBM bytes/s over the last launch",
+                       op=op, bucket=lbl)
+    return prof
+
+
+def record_hbm_watermark(device=None) -> Optional[dict]:
+    """Poll live/peak HBM use into gauges (the compiled driver calls
+    this at chunk boundaries; serving code may call it ad hoc). No-op
+    when perf is off; never raises — a backend without memory stats
+    reports zeros, same as ``device_memory_stats``."""
+    if not _enabled:
+        return None
+    from raft_tpu.core.memory import device_memory_stats
+
+    try:
+        stats = device_memory_stats(device)
+    except Exception:
+        return None
+    with _lock:
+        _hbm["bytes_in_use"] = int(stats["bytes_in_use"])
+        _hbm["peak_bytes_in_use"] = max(
+            _hbm["peak_bytes_in_use"], int(stats["peak_bytes_in_use"]))
+        _hbm["polls"] += 1
+    _metrics.set_gauge("perf_hbm_bytes_in_use", stats["bytes_in_use"],
+                       help="live HBM bytes in use at the last "
+                            "chunk-boundary poll")
+    _metrics.set_gauge("perf_hbm_peak_bytes_in_use",
+                       stats["peak_bytes_in_use"],
+                       help="runtime-reported peak HBM bytes in use")
+    return stats
+
+
+@contextlib.contextmanager
+def profile_session(log_dir: Optional[str] = None):
+    """Capture a device profile aligned with the span ring.
+
+    Wraps ``jax.profiler`` tracing around the body and records a
+    ``perf.profile_session`` span over the same monotonic clock every
+    other span uses — so the Xprof capture under ``log_dir`` and the
+    host timeline ``obs.render_chrome_trace`` exports can be lined up
+    by the session's start/duration. Yields the log directory (a fresh
+    temp dir when none is given), or None when perf is off (the whole
+    manager is then a no-op) or the profiler refuses to start (the body
+    still runs; only the device capture is lost)."""
+    if not _enabled:
+        yield None
+        return
+    if log_dir is None:
+        import tempfile
+
+        log_dir = tempfile.mkdtemp(prefix="raft_tpu_profile_")
+    started = False
+    try:
+        import jax
+
+        jax.profiler.start_trace(str(log_dir))
+        started = True
+    except Exception:
+        pass
+    t0 = time.monotonic()
+    try:
+        yield log_dir if started else None
+    finally:
+        dur = time.monotonic() - t0
+        if started:
+            with contextlib.suppress(Exception):
+                jax.profiler.stop_trace()
+        from raft_tpu.obs.spans import record_span as _record_span
+        _record_span("perf.profile_session", t_start=t0,
+                     duration=dur, log_dir=str(log_dir),
+                     captured=started)
+
+
+def perf_profiles() -> Dict[Tuple[str, Any], ExecutableProfile]:
+    """Snapshot of the live profile registry (the objects themselves —
+    read-only by convention; tests and the smoke gate introspect
+    these)."""
+    with _lock:
+        return dict(_profiles)
+
+
+def clear_perf_profiles() -> None:
+    """Drop all profiles and HBM watermarks (tests and REPL hygiene)."""
+    with _lock:
+        _profiles.clear()
+        _hbm.update(bytes_in_use=0, peak_bytes_in_use=0, polls=0)
+
+
+def perf_snapshot() -> dict:
+    """JSON-able view for ``obs.snapshot()``'s ``"perf"`` section:
+    enabled flag, the peak table in force, every profile, and the HBM
+    watermark. Cheap when off — no device inspection, empty tables."""
+    if not _enabled:
+        return {"enabled": False, "profiles": {}, "hbm": dict(_hbm)}
+    pk = _device_peaks()
+    with _lock:
+        profs = {f"{op}[{bucket}]": p.as_dict()
+                 for (op, bucket), p in _profiles.items()}
+        hbm = dict(_hbm)
+    return {"enabled": True,
+            "peaks": {"name": pk.name, "flops_per_s": pk.flops_per_s,
+                      "bytes_per_s": pk.bytes_per_s,
+                      "source": pk.source},
+            "profiles": profs, "hbm": hbm}

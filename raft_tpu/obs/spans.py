@@ -46,10 +46,18 @@ from raft_tpu.obs import metrics as _metrics
 from raft_tpu.obs import tracectx as _tracectx
 
 __all__ = ["span", "spans", "clear_spans", "record_span",
-           "set_sample_rate", "set_retention"]
+           "set_sample_rate", "set_retention", "ring_stats"]
 
 _lock = threading.Lock()
 _counts: Dict[str, int] = {}      # per-name emission counter (sampling)
+
+# Loss accounting (ISSUE 13 satellite): a truncated flight bundle must
+# be distinguishable from a quiet system, so the ring counts what it
+# sheds — spans evicted by retention (_dropped) and spans the
+# counter-stride never admitted (_sampled_out). obs.snapshot() surfaces
+# both.
+_dropped = 0
+_sampled_out = 0
 
 
 # Both knobs are fail-loud at import (matching RAFT_TPU_RECV_TIMEOUT /
@@ -138,16 +146,20 @@ class _Span:
 
 
 def _record(sp: _Span) -> None:
+    global _sampled_out, _dropped
     with _lock:
         n = _counts.get(sp.name, 0) + 1
         _counts[sp.name] = n
         if _sample_stride == 0 or (n - 1) % _sample_stride != 0:
+            _sampled_out += 1
             return
         rec = {"name": sp.name, "t": sp.t_start,
                "duration": sp.duration, "parent": sp.parent,
                "thread": sp._thread, "attrs": dict(sp.attrs)}
         if sp._ctx is not None:
             rec.update(sp._ctx.attrs())
+        if len(_spans) == _spans.maxlen:
+            _dropped += 1
         _spans.append(rec)
     # sink write happens outside the span lock (the sink has its own)
     from raft_tpu.obs import export
@@ -178,7 +190,10 @@ def record_span(name: str, *, t_start: float, duration: float,
            "attrs": dict(attrs)}
     if ctx is not None:
         rec.update(ctx.attrs())
+    global _dropped
     with _lock:
+        if len(_spans) == _spans.maxlen:
+            _dropped += 1
         _spans.append(rec)
     from raft_tpu.obs import export
     export._sink_span(rec)
@@ -206,7 +221,21 @@ def spans(name: Optional[str] = None) -> List[dict]:
     return [s for s in out if s["name"] == name]
 
 
+def ring_stats() -> dict:
+    """Retention/loss accounting for the span ring: spans currently
+    retained, spans evicted by the retention bound since the last
+    :func:`clear_spans`, and spans the sampling stride never admitted.
+    ``dropped``/``sampled_out`` nonzero means the ring (and any flight
+    bundle snapshotting it) is a truncated view, not a quiet system."""
+    with _lock:
+        return {"retained": len(_spans), "dropped": _dropped,
+                "sampled_out": _sampled_out}
+
+
 def clear_spans() -> None:
+    global _dropped, _sampled_out
     with _lock:
         _spans.clear()
         _counts.clear()
+        _dropped = 0
+        _sampled_out = 0

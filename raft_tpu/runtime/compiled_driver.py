@@ -139,6 +139,8 @@ def chunk_while(step_fn: Callable[[Any], Tuple[Any, Any]], carry,
 def run_chunked(chunk_call: Callable, carry, *, max_steps: int,
                 sync_every: int, op: str, steps_done: int = 0,
                 est_step_seconds: Optional[float] = None,
+                step_flops: Optional[float] = None,
+                step_bytes: Optional[float] = None,
                 boundary: Optional[Callable] = None,
                 sentinel: Optional[Callable] = None):
     """Drive a compiled chunk program to convergence or ``max_steps``.
@@ -164,9 +166,21 @@ def run_chunked(chunk_call: Callable, carry, *, max_steps: int,
     5. ``sentinel(carry, steps_done)`` — guard-mode numeric check,
        invoked only when guards are armed (the off mode costs nothing).
 
+    With ``RAFT_TPU_PERF=on`` and per-step model costs (``step_flops``
+    / ``step_bytes`` — the (flops, bytes) pair behind the same
+    ``limits.estimate_seconds`` call that seeded ``est_step_seconds``),
+    every chunk's measured wall time additionally feeds the roofline
+    attribution under the ``(op, "chunk")`` profile key, and the live
+    HBM watermark is polled at each boundary. Off (the default) both
+    are single-bool no-ops.
+
     Returns ``(carry, steps_done, done)``. ``steps_done`` starts at the
     caller's offset so a resumed fit keeps global iteration counts.
     """
+    if step_flops or step_bytes:
+        obs.profile_executable(op, "chunk",
+                               model_flops=step_flops or 0.0,
+                               model_bytes=step_bytes or 0.0)
     done = False
     per_step = est_step_seconds
     while True:
@@ -192,6 +206,8 @@ def run_chunked(chunk_call: Callable, carry, *, max_steps: int,
         steps_done += ran
         if ran > 0:
             per_step = wall / ran     # measured refinement of the model
+            obs.record_launch(op, "chunk", wall, steps=ran)
+        obs.record_hbm_watermark()
         obs.inc("solver_host_syncs_total", 1, op=op)
         trace.record_event("compiled_driver.chunk", op=op, steps=ran,
                            done=bool(done))

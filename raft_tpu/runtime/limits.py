@@ -69,6 +69,7 @@ from typing import Dict, Optional
 
 from raft_tpu import obs
 from raft_tpu.core import env as _env_mod
+from raft_tpu.core import hw as _hw
 
 __all__ = [
     "DeadlineExceededError", "RejectedError",
@@ -76,7 +77,7 @@ __all__ = [
     "check_deadline", "sleep_within_deadline",
     "WorkBudget", "budget_scope", "active_budget", "set_default_budget",
     "parse_bytes", "estimate_bytes", "admit", "reject", "record_degraded",
-    "estimate_seconds", "check_chunk_budget",
+    "estimate_seconds", "estimate_flops_bytes", "check_chunk_budget",
     "CircuitBreaker", "get_breaker", "reset_breakers",
 ]
 
@@ -471,9 +472,13 @@ def estimate_bytes(op: str, **dims) -> int:
 # bytes/s. Intentionally coarse — these seed a FAST-FAIL decision (can
 # this chunk possibly fit the remaining deadline slack?), never a
 # measurement; run_chunked replaces the estimate with measured per-chunk
-# wall time after the first launch.
-_PEAK_FLOP_S = {"cpu": 5e10, "gpu": 5e13, "tpu": 6e13}
-_PEAK_BYTES_S = {"cpu": 2e10, "gpu": 1e12, "tpu": 8.19e11}
+# wall time after the first launch. The tables live in core/hw.py
+# (ISSUE 13) next to the theoretical-peak roofline table so the
+# admission model and the roofline denominator can't drift apart
+# silently; re-bound here because they have been limits' spelling since
+# PR 5.
+_PEAK_FLOP_S = _hw.SUSTAINED_FLOP_S
+_PEAK_BYTES_S = _hw.SUSTAINED_BYTES_S
 
 
 def _sec_lloyd_step(*, m, k, n_clusters, itemsize=4):
@@ -498,6 +503,23 @@ _SECONDS_ESTIMATORS = {
     "cluster.lloyd_step": _sec_lloyd_step,
     "sparse.lanczos_restart": _sec_lanczos_restart,
 }
+
+
+def estimate_flops_bytes(op: str, **dims) -> tuple:
+    """The per-step ``(flops, bytes)`` pair behind
+    :func:`estimate_seconds` — exposed so the compiled-driver call
+    sites can hand the same model costs to the perf-attribution layer
+    (``obs.profile_executable`` / ``record_launch``) that already seed
+    their chunk admission. Same op vocabulary as
+    :func:`estimate_seconds`."""
+    try:
+        fn = _SECONDS_ESTIMATORS[op]
+    except KeyError:
+        raise ValueError(
+            f"no seconds estimator for op {op!r}; known: "
+            f"{sorted(_SECONDS_ESTIMATORS)}") from None
+    flops, bytes_ = fn(**dims)
+    return float(flops), float(bytes_)
 
 
 def estimate_seconds(op: str, *, backend: Optional[str] = None,

@@ -519,6 +519,15 @@ class Executor:
             return fn(*args)
 
         example = (*svc.fixed_args, svc.example(rows))
+        if obs.perf_enabled():
+            # static-cost extraction (ISSUE 13): profile the RAW fn —
+            # not `traced`, whose retrace hook must only tick for real
+            # serving compiles — under the same (service, bucket) key
+            # this cache uses. The extra lowering is a warm-time cost
+            # paid only with RAFT_TPU_PERF=on.
+            obs.profile_executable(
+                svc.name, rows, fn=fn, example=example,
+                model_bytes=svc.estimate_bytes(rows))
         if self.use_aot:
             from raft_tpu.runtime.aot import aot_export
 
@@ -547,6 +556,15 @@ class Executor:
                 out = exe(*svc.fixed_args, svc.example(b))
                 jax.block_until_ready(out)
                 n += 1
+                if obs.perf_enabled():
+                    # second, compile-free invocation so every warmed
+                    # profile carries a measured roofline fraction (the
+                    # first call's wall time is dominated by compile)
+                    t1 = time.monotonic()
+                    out = exe(*svc.fixed_args, svc.example(b))
+                    jax.block_until_ready(out)
+                    obs.record_launch(svc.name, b,
+                                      time.monotonic() - t1)
             dt = time.monotonic() - t0
             obs.observe("serve_warmup_seconds", dt, service=svc.name)
             # kNN services also report which selection epilogue their
@@ -678,6 +696,7 @@ class Executor:
         self.stats.rows += rows
         self.stats.padded_rows += brows - rows
         self.stats.per_batch_rows.append(rows)
+        obs.record_launch(svc.name, brows, dt)
         if obs.enabled():
             obs.observe("serve_batch_rows", rows,
                         help="real rows per coalesced device launch")

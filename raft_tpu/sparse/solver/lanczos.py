@@ -471,12 +471,15 @@ def _eigsh_csr(csr, cfg: LanczosConfig, v0,
             seed=cfg.seed, use_ell=use_ell, use_grid=use_grid,
             use_dense=use_dense, use_rank1=r1 is not None)
         nnz = n * n if dense else int(csr.data.shape[0])
-        est = limits.estimate_seconds("sparse.lanczos_restart", n=n,
-                                      ncv=ncv, nnz=max(nnz, 1), k=k)
+        dims = dict(n=n, ncv=ncv, nnz=max(nnz, 1), k=k)
+        est = limits.estimate_seconds("sparse.lanczos_restart", **dims)
+        sf, sb = limits.estimate_flops_bytes("sparse.lanczos_restart",
+                                             **dims)
         carry, _, _ = compiled_driver.run_chunked(
             chunk_call, carry, max_steps=cfg.max_iterations,
             sync_every=sync, op="sparse.solver.lanczos",
-            est_step_seconds=est, sentinel=_lanczos_sentinel)
+            est_step_seconds=est, step_flops=sf, step_bytes=sb,
+            sentinel=_lanczos_sentinel)
         basis = carry[0]
         t_h = np.asarray(carry[1], np.float64)
         beta_last = float(np.asarray(carry[3]))
@@ -1015,9 +1018,10 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
                  jnp.asarray(it0, jnp.int32), jnp.asarray(0, jnp.int32))
         n_iter = it0
         last_saved = [it0 if resume_from is not None else -1]
-        est = limits.estimate_seconds(
-            "sparse.lanczos_restart", n=n, ncv=ncv,
-            nnz=max(len(rows_h), 1), k=k)
+        dims = dict(n=n, ncv=ncv, nnz=max(len(rows_h), 1), k=k)
+        est = limits.estimate_seconds("sparse.lanczos_restart", **dims)
+        sf, sb = limits.estimate_flops_bytes("sparse.lanczos_restart",
+                                             **dims)
 
         def boundary(cr, steps_done, done_flag):
             # checkpoint FIRST, then health-probe — the on_iteration
@@ -1044,6 +1048,7 @@ def eigsh_mnmg(a, k: int = 6, mesh=None, axis: str = "data",
                     run_chunk, carry, max_steps=cfg.max_iterations,
                     sync_every=sync, op="sparse.solver.lanczos",
                     steps_done=n_iter, est_step_seconds=est,
+                    step_flops=sf, step_bytes=sb,
                     boundary=boundary, sentinel=_lanczos_sentinel)
                 break
             except (PeerFailedError, CommsAbortedError) as err:

@@ -1,0 +1,579 @@
+"""Performance-attribution tests (ISSUE 13): the hardware peak table,
+per-executable static-cost profiles (XLA cost analysis + model
+fallback), roofline-fraction launch attribution and its
+compute/bandwidth/overhead classification, the perf-off single-bool
+no-op contract (serve-path bit identity), span/event ring loss counters
+in ``obs.snapshot()``, profile_session span alignment, the bench
+regression sentry, and fail-loud env-knob parsing."""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs, serve
+from raft_tpu.core import hw
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.obs import perf
+# NOT `from raft_tpu.obs import spans` — the facade re-exports the
+# spans() *function* under that name, shadowing the submodule
+from raft_tpu.obs.spans import set_retention as set_span_retention
+
+_REPO = str(pathlib.Path(__file__).resolve().parent.parent)
+_SENTRY = os.path.join(_REPO, "ci", "perf_sentry.py")
+
+DIM = 16
+
+
+@pytest.fixture
+def perf_on():
+    """Perf attribution on with a clean profile registry; restored to
+    the ambient (off) state afterwards."""
+    prev = perf.set_perf_enabled(True)
+    perf.clear_perf_profiles()
+    perf.reset_peaks()
+    try:
+        yield
+    finally:
+        perf.set_perf_enabled(prev)
+        perf.clear_perf_profiles()
+        perf.reset_peaks()
+
+
+@pytest.fixture
+def live_obs():
+    """Metrics on with a fresh private registry and clean rings."""
+    was_enabled = obs.enabled()
+    old_reg = obs_metrics.set_registry(obs.MetricsRegistry())
+    old_sink = obs.set_sink(None)
+    obs.set_enabled(True)
+    obs.clear_spans()
+    obs.clear_events()
+    obs.set_sample_rate(1.0)
+    try:
+        yield obs_metrics.get_registry()
+    finally:
+        obs.set_enabled(was_enabled)
+        obs_metrics.set_registry(old_reg)
+        obs.set_sink(old_sink)
+        obs.clear_spans()
+        obs.clear_events()
+        obs.set_sample_rate(1.0)
+        set_span_retention(2048)
+
+
+def _gauge_value(reg, name, **labels):
+    fam = reg.snapshot().get(name)
+    if not fam:
+        return None
+    for s in fam["series"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# hardware peak table
+# ---------------------------------------------------------------------------
+
+class _FakeDevice:
+    def __init__(self, platform, kind):
+        self.platform = platform
+        self.device_kind = kind
+
+
+class TestHwPeaks:
+    def test_cpu_backend(self):
+        pk = hw.peaks(backend="cpu")
+        assert pk.name == "cpu"
+        assert pk.flops_per_s == 5e10 and pk.bytes_per_s == 2e10
+        assert pk.source == "table"
+
+    @pytest.mark.parametrize("kind,name,flops", [
+        ("TPU v5 lite", "tpu-v5e", 197e12),
+        ("TPU v5e", "tpu-v5e", 197e12),
+        ("TPU v5p", "tpu-v5p", 459e12),
+        ("TPU v4", "tpu-v4", 275e12),
+        ("TPU v6 lite", "tpu-v6e", 918e12),
+    ])
+    def test_tpu_generation_match(self, kind, name, flops):
+        pk = hw.peaks(_FakeDevice("tpu", kind))
+        assert (pk.name, pk.flops_per_s) == (name, flops)
+        assert pk.source == "table"
+
+    def test_unknown_tpu_kind_falls_back(self):
+        pk = hw.peaks(_FakeDevice("tpu", "TPU v99 hyper"))
+        assert pk.source == "fallback"
+        assert pk.flops_per_s > 0 and pk.bytes_per_s > 0
+
+    def test_v5e_matches_harness_ceilings(self):
+        """The bench harness's mxu/hbm roofline columns and the live
+        perf gauges must divide by the same v5e ceilings."""
+        from benches.harness import BenchResult
+        pk = hw.peaks(_FakeDevice("tpu", "TPU v5 lite"))
+        assert pk.flops_per_s == BenchResult.MXU_GFLOPS * 1e9
+        assert pk.bytes_per_s == BenchResult.HBM_GB_S * 1e9
+
+    def test_env_override_partial(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PERF_PEAKS", "flops=1e12")
+        pk = hw.peaks(backend="cpu")
+        assert pk.flops_per_s == 1e12
+        assert pk.bytes_per_s == 2e10      # untouched axis keeps table
+        assert pk.source == "env"
+
+    def test_env_override_both(self, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PERF_PEAKS",
+                           "flops=2e12,bytes=3e11")
+        pk = hw.peaks(backend="cpu")
+        assert (pk.flops_per_s, pk.bytes_per_s) == (2e12, 3e11)
+
+    @pytest.mark.parametrize("bad", ["banana", "flops=", "flops=-1",
+                                     "watts=3", "flops=1e12;bytes=2"])
+    def test_env_override_malformed_raises(self, monkeypatch, bad):
+        monkeypatch.setenv("RAFT_TPU_PERF_PEAKS", bad)
+        with pytest.raises(ValueError, match="RAFT_TPU_PERF_PEAKS"):
+            hw.peaks(backend="cpu")
+
+    def test_limits_reexports_sustained_tables(self):
+        from raft_tpu.runtime import limits
+        assert limits._PEAK_FLOP_S is hw.SUSTAINED_FLOP_S
+        assert limits._PEAK_BYTES_S is hw.SUSTAINED_BYTES_S
+
+
+# ---------------------------------------------------------------------------
+# static-cost profiles
+# ---------------------------------------------------------------------------
+
+class TestProfileExecutable:
+    def test_off_is_noop(self):
+        assert not perf.perf_enabled()
+        assert perf.profile_executable("op", 8, model_flops=1.0) is None
+        assert perf.record_launch("op", 8, 0.1) is None
+        assert perf.record_hbm_watermark() is None
+        assert perf.perf_profiles() == {}
+
+    def test_model_source_without_fn(self, perf_on):
+        prof = perf.profile_executable("op", 8, model_flops=100.0,
+                                       model_bytes=200.0)
+        assert prof.source == "model"
+        assert (prof.flops, prof.bytes) == (100.0, 200.0)
+        assert perf.perf_profiles()[("op", 8)] is prof
+
+    def test_xla_source_with_real_fn(self, perf_on):
+        import jax.numpy as jnp
+        a = np.zeros((64, 32), np.float32)
+        b = np.zeros((32, 16), np.float32)
+        prof = perf.profile_executable(
+            "dot", 64, fn=lambda x, y: jnp.dot(x, y), example=(a, b))
+        assert prof.source == "xla"
+        assert prof.flops > 0 and prof.bytes > 0
+
+    def test_compiler_refusal_falls_back_to_model(self, perf_on):
+        def bad(x):
+            raise RuntimeError("untraceable")
+
+        prof = perf.profile_executable(
+            "bad", 4, fn=bad, example=(np.zeros(3, np.float32),),
+            model_flops=7.0, model_bytes=9.0)
+        assert prof.source == "model"
+        assert (prof.flops, prof.bytes) == (7.0, 9.0)
+
+    def test_reprofile_updates_in_place(self, perf_on):
+        p1 = perf.profile_executable("op", 8, model_flops=1.0)
+        perf.record_launch("op", 8, 0.5)
+        p2 = perf.profile_executable("op", 8, model_flops=2.0)
+        assert p2 is p1                   # launch history survives
+        assert p1.flops == 2.0 and p1.launches == 1
+
+
+class TestRecordLaunch:
+    def test_roofline_math_compute_bound(self, perf_on):
+        # CPU peaks: 5e10 flop/s, 2e10 B/s. flops dominate here.
+        perf.profile_executable("op", 8, model_flops=2.5e10,
+                                model_bytes=1e9)
+        prof = perf.record_launch("op", 8, 1.0)
+        assert prof.achieved_flops_per_s == pytest.approx(2.5e10)
+        assert prof.roofline_frac == pytest.approx(0.5)
+        assert prof.bound == "compute"
+
+    def test_roofline_math_bandwidth_bound(self, perf_on):
+        prof_bytes = 1.5e10               # t_b = 0.75 > t_f = 0.02
+        perf.profile_executable("op", 8, model_flops=1e9,
+                                model_bytes=prof_bytes)
+        prof = perf.record_launch("op", 8, 1.0)
+        assert prof.roofline_frac == pytest.approx(0.75)
+        assert prof.bound == "bandwidth"
+
+    def test_tiny_device_time_is_overhead_bound(self, perf_on):
+        perf.profile_executable("op", 8, model_flops=1e6,
+                                model_bytes=1e6)
+        prof = perf.record_launch("op", 8, 1.0)
+        assert prof.bound == "overhead"
+        assert prof.roofline_frac < perf.OVERHEAD_FRAC
+
+    def test_steps_scale_static_costs(self, perf_on):
+        perf.profile_executable("op", "chunk", model_flops=1e9)
+        prof = perf.record_launch("op", "chunk", 1.0, steps=10.0)
+        assert prof.achieved_flops_per_s == pytest.approx(1e10)
+        assert prof.steps == 10.0
+
+    def test_unregistered_or_nonpositive_wall_ignored(self, perf_on):
+        assert perf.record_launch("ghost", 8, 0.5) is None
+        perf.profile_executable("op", 8, model_flops=1.0)
+        assert perf.record_launch("op", 8, 0.0) is None
+        assert perf.perf_profiles()[("op", 8)].launches == 0
+
+    def test_gauges_published_when_metrics_on(self, perf_on, live_obs):
+        perf.profile_executable("op", 8, model_flops=2.5e10,
+                                model_bytes=1e9)
+        perf.record_launch("op", 8, 1.0)
+        assert _gauge_value(live_obs, "perf_roofline_frac", op="op",
+                            bucket="8", bound="compute") \
+            == pytest.approx(0.5)
+        assert _gauge_value(live_obs, "perf_achieved_flops_per_s",
+                            op="op", bucket="8") \
+            == pytest.approx(2.5e10)
+        assert _gauge_value(live_obs, "perf_achieved_bytes_per_s",
+                            op="op", bucket="8") == pytest.approx(1e9)
+
+    def test_hbm_watermark_polls_into_snapshot(self, perf_on):
+        perf.record_hbm_watermark()       # CPU may report zeros; the
+        snap = perf.perf_snapshot()       # poll itself must register
+        assert snap["hbm"]["polls"] == 1
+
+
+# ---------------------------------------------------------------------------
+# obs.snapshot() integration: perf section + ring loss counters
+# ---------------------------------------------------------------------------
+
+class TestSnapshotIntegration:
+    def test_snapshot_off_shape(self):
+        snap = obs.snapshot()
+        assert snap["perf"] == {"enabled": False, "profiles": {},
+                                "hbm": snap["perf"]["hbm"]}
+        for key in ("spans_dropped", "spans_sampled_out",
+                    "events_overwritten"):
+            assert key in snap
+
+    def test_snapshot_perf_section(self, perf_on):
+        perf.profile_executable("op", 8, model_flops=2.5e10,
+                                model_bytes=1e9)
+        perf.record_launch("op", 8, 1.0)
+        sect = obs.snapshot()["perf"]
+        assert sect["enabled"] is True
+        assert sect["peaks"]["flops_per_s"] > 0
+        prof = sect["profiles"]["op[8]"]
+        assert prof["launches"] == 1
+        assert prof["roofline_frac"] == pytest.approx(0.5)
+        json.dumps(sect)                  # JSON-able end to end
+
+    def test_span_ring_drop_counter(self, live_obs):
+        set_span_retention(4)
+        for i in range(7):
+            obs.record_span(f"s{i}", t_start=0.0, duration=0.001)
+        snap = obs.snapshot()
+        assert snap["spans_dropped"] == 3
+        assert obs.ring_stats()["retained"] == 4
+
+    def test_span_sampling_counter(self, live_obs):
+        obs.set_sample_rate(0.5)          # keep every 2nd per name
+        for _ in range(6):
+            with obs.span("sampled.op"):
+                pass
+        assert obs.snapshot()["spans_sampled_out"] == 3
+
+    def test_event_ring_overwrite_counter(self, live_obs):
+        for i in range(1024 + 5):
+            obs.emit_event("evt", i=i)
+        assert obs.snapshot()["events_overwritten"] == 5
+        obs.clear_events()
+        assert obs.snapshot()["events_overwritten"] == 0
+
+
+# ---------------------------------------------------------------------------
+# profile_session
+# ---------------------------------------------------------------------------
+
+class TestProfileSession:
+    def test_off_yields_none_and_no_span(self, live_obs):
+        assert not perf.perf_enabled()
+        with obs.profile_session() as d:
+            assert d is None
+        assert obs.spans("perf.profile_session") == []
+
+    def test_span_alignment(self, perf_on, live_obs, tmp_path):
+        import jax.numpy as jnp
+        t_before = time.monotonic()
+        with obs.profile_session(str(tmp_path)) as d:
+            jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+        t_after = time.monotonic()
+        recs = obs.spans("perf.profile_session")
+        assert len(recs) == 1
+        rec = recs[0]
+        # the span sits on the ring's monotonic clock, inside the
+        # bracketing window, so Perfetto can line it up with host spans
+        assert t_before <= rec["t"] <= t_after
+        assert rec["t"] + rec["duration"] <= t_after + 0.01
+        assert rec["attrs"]["log_dir"] == str(tmp_path)
+        if rec["attrs"]["captured"]:      # CPU profiler availability
+            assert d == str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# serve-path integration: bit identity off, full attribution on
+# ---------------------------------------------------------------------------
+
+class TestServeIntegration:
+    def _serve_outputs(self, data, rows_list):
+        rng = np.random.default_rng(11)
+        queries = [rng.standard_normal((r, DIM)).astype(np.float32)
+                   for r in rows_list]
+        services = [serve.KnnService(data["db"], k=4),
+                    serve.PairwiseService(data["db"]),
+                    serve.KMeansPredictService(data["centroids"])]
+        ops = ["knn_k4_l2", "pairwise_l2_expanded", "kmeans_predict_k6"]
+        ex = serve.Executor(
+            services,
+            policy=serve.BatchPolicy(max_batch=64, max_wait_ms=5.0))
+        ex.warm([8, 16])
+        outs = []
+        with ex:
+            futs = [(ops[i % 3], ex.submit(ops[i % 3], q))
+                    for i, q in enumerate(queries)]
+            for op, f in futs:
+                got = f.result(timeout=60)
+                got = got if isinstance(got, tuple) else (got,)
+                outs.append((op, [np.asarray(x) for x in got]))
+        return outs
+
+    @pytest.fixture(scope="class")
+    def data(self):
+        rng = np.random.default_rng(7)
+        return {
+            "db": rng.standard_normal((96, DIM)).astype(np.float32),
+            "centroids": rng.standard_normal((6, DIM)).astype(np.float32),
+        }
+
+    def test_perf_off_bit_identical_serve(self, data):
+        """Flipping RAFT_TPU_PERF must not change a single served bit
+        across knn / pairwise / kmeans-predict."""
+        rows = [1, 3, 8, 2, 6, 5]
+        base = self._serve_outputs(data, rows)
+        prev = perf.set_perf_enabled(True)
+        perf.clear_perf_profiles()
+        try:
+            on = self._serve_outputs(data, rows)
+        finally:
+            perf.set_perf_enabled(prev)
+            perf.clear_perf_profiles()
+        assert [op for op, _ in base] == [op for op, _ in on]
+        for (_, b), (_, o) in zip(base, on):
+            assert len(b) == len(o)
+            for x, y in zip(b, o):
+                np.testing.assert_array_equal(x, y)
+
+    def test_every_warmed_executable_profiled(self, data, perf_on):
+        """The acceptance bar: with perf on, every warmed (service,
+        bucket) executable reports static costs plus a measured
+        roofline fraction in obs.snapshot()."""
+        self._serve_outputs(data, [1, 3, 8, 2, 6, 5])
+        profs = perf.perf_profiles()
+        for op in ("knn_k4_l2", "pairwise_l2_expanded",
+                   "kmeans_predict_k6"):
+            for bucket in (8, 16):
+                prof = profs[(op, bucket)]
+                assert prof.flops > 0 or prof.bytes > 0
+                assert prof.launches >= 1      # warm() timed invocation
+                assert prof.roofline_frac > 0
+                assert prof.bound in ("compute", "bandwidth",
+                                      "overhead")
+        sect = obs.snapshot()["perf"]
+        assert f"knn_k4_l2[8]" in sect["profiles"]
+
+
+# ---------------------------------------------------------------------------
+# compiled-driver integration
+# ---------------------------------------------------------------------------
+
+class TestCompiledDriverIntegration:
+    def test_chunk_profile_and_hbm_polls(self, perf_on):
+        from raft_tpu.cluster import KMeansParams, kmeans_fit
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((120, 8)).astype(np.float32)
+        kmeans_fit(None, KMeansParams(n_clusters=4, max_iter=6), x,
+                   sync_every=2)
+        profs = perf.perf_profiles()
+        prof = profs[("cluster.kmeans_fit", "chunk")]
+        assert prof.source == "model"
+        assert prof.flops > 0 and prof.bytes > 0
+        assert prof.launches >= 1
+        assert prof.steps >= prof.launches   # chunks run >= 1 step
+        assert perf.perf_snapshot()["hbm"]["polls"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# perf_sentry
+# ---------------------------------------------------------------------------
+
+def _run_sentry(*argv, env=None):
+    return subprocess.run(
+        [sys.executable, _SENTRY, *argv],
+        capture_output=True, text=True, cwd=_REPO,
+        env={**os.environ, **(env or {})})
+
+
+class TestPerfSentry:
+    @pytest.fixture
+    def hist(self, tmp_path):
+        h = tmp_path / "hist"
+        h.mkdir()
+        rows = [
+            {"bench": "fam/a", "median_ms": 10.0, "era": 2},
+            {"bench": "fam/a", "median_ms": 8.0, "era": 2},
+            {"bench": "fam/a", "median_ms": 5.0, "era": 1,
+             "superseded_by": "r2"},      # retired: NOT the baseline
+            {"metric": "fam/tput", "value": 100.0, "backend": "tpu",
+             "era": 2},
+        ]
+        (h / "bench_small_cpu_r1.jsonl").write_text(
+            "\n".join(json.dumps(r) for r in rows) + "\n")
+        return h
+
+    def _fresh(self, tmp_path, rows, name="fresh.jsonl"):
+        p = tmp_path / name
+        p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        return str(p)
+
+    def test_audit_shipped_history_passes(self):
+        proc = _run_sentry()
+        assert proc.returncode == 0, proc.stderr
+        assert "PASS (audit)" in proc.stdout
+
+    def test_no_regression_passes(self, hist, tmp_path):
+        fresh = self._fresh(tmp_path, [
+            {"bench": "fam/a", "median_ms": 9.0, "era": 2},
+            {"metric": "fam/tput", "value": 95.0, "backend": "tpu",
+             "era": 2},
+        ])
+        proc = _run_sentry("--history", str(hist), "--fresh", fresh)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_regression_fails(self, hist, tmp_path):
+        # baseline is the best CURRENT row (8.0), not the superseded
+        # 5.0 — 2x the baseline trips the default 1.5x tolerance
+        fresh = self._fresh(tmp_path,
+                            [{"bench": "fam/a", "median_ms": 16.0,
+                              "era": 2}])
+        proc = _run_sentry("--history", str(hist), "--fresh", fresh)
+        assert proc.returncode == 1
+        assert "fam/a" in proc.stdout
+
+    def test_throughput_regression_fails(self, hist, tmp_path):
+        fresh = self._fresh(tmp_path,
+                            [{"metric": "fam/tput", "value": 40.0,
+                              "backend": "tpu", "era": 2}])
+        proc = _run_sentry("--history", str(hist), "--fresh", fresh)
+        assert proc.returncode == 1
+        assert "higher is better" in proc.stdout
+
+    def test_stale_era_fails_loud(self, hist, tmp_path):
+        fresh = self._fresh(tmp_path,
+                            [{"bench": "fam/a", "median_ms": 1.0,
+                              "era": 1}])
+        proc = _run_sentry("--history", str(hist), "--fresh", fresh)
+        assert proc.returncode == 1
+        assert "stale-era" in proc.stdout
+
+    def test_family_tol_overrides_default(self, hist, tmp_path):
+        fresh = self._fresh(tmp_path,
+                            [{"bench": "fam/a", "median_ms": 16.0,
+                              "era": 2}])
+        proc = _run_sentry("--history", str(hist), "--fresh", fresh,
+                           "--family-tol", "fam/a=2.5")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_env_tolerance_knob(self, hist, tmp_path):
+        fresh = self._fresh(tmp_path,
+                            [{"bench": "fam/a", "median_ms": 16.0,
+                              "era": 2}])
+        proc = _run_sentry("--history", str(hist), "--fresh", fresh,
+                           env={"RAFT_TPU_SENTRY_TOL": "2.5"})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_malformed_tolerance_exits_2(self, hist, tmp_path):
+        fresh = self._fresh(tmp_path,
+                            [{"bench": "fam/a", "median_ms": 9.0,
+                              "era": 2}])
+        proc = _run_sentry("--history", str(hist), "--fresh", fresh,
+                           env={"RAFT_TPU_SENTRY_TOL": "banana"})
+        assert proc.returncode == 2
+        assert "RAFT_TPU_SENTRY_TOL" in proc.stderr
+
+    def test_corrupt_history_exits_2(self, tmp_path):
+        h = tmp_path / "hist"
+        h.mkdir()
+        (h / "bench_small_cpu_r1.jsonl").write_text("{not json\n")
+        proc = _run_sentry("--history", str(h))
+        assert proc.returncode == 2
+
+    def test_superseded_fresh_row_skipped(self, hist, tmp_path):
+        fresh = self._fresh(tmp_path,
+                            [{"bench": "fam/a", "median_ms": 99.0,
+                              "era": 2, "superseded_by": "r3"}])
+        proc = _run_sentry("--history", str(hist), "--fresh", fresh)
+        assert proc.returncode == 0
+        assert "skipped" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# env knobs: fail-loud subprocess contracts
+# ---------------------------------------------------------------------------
+
+class TestEnvKnobs:
+    def _run(self, code, env):
+        return subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, **env}, capture_output=True, text=True,
+            cwd=_REPO)
+
+    def test_malformed_peaks_raises_at_read(self):
+        proc = self._run(
+            "from raft_tpu.core import hw; hw.peaks(backend='cpu')",
+            {"RAFT_TPU_PERF_PEAKS": "banana"})
+        assert proc.returncode != 0
+        assert "RAFT_TPU_PERF_PEAKS" in proc.stderr
+
+    def test_malformed_sentry_tol_raises_at_read(self):
+        proc = self._run(
+            "from raft_tpu.core import env; "
+            "env.read('RAFT_TPU_SENTRY_TOL')",
+            {"RAFT_TPU_SENTRY_TOL": "0.5"})
+        assert proc.returncode != 0
+        assert "RAFT_TPU_SENTRY_TOL" in proc.stderr
+
+    def test_malformed_perf_warns_and_stays_off(self):
+        # observability toggles degrade to off with a warning (the
+        # RAFT_TPU_METRICS policy), they do not crash the import
+        proc = self._run(
+            "import warnings; warnings.simplefilter('error');\n"
+            "try:\n"
+            "    from raft_tpu.obs import perf\n"
+            "    raise SystemExit('expected a warning')\n"
+            "except Warning as w:\n"
+            "    assert 'RAFT_TPU_PERF' in str(w)\n",
+            {"RAFT_TPU_PERF": "banana"})
+        assert proc.returncode == 0, proc.stderr
+
+    def test_perf_on_via_env(self):
+        proc = self._run(
+            "from raft_tpu.obs import perf; "
+            "assert perf.perf_enabled()",
+            {"RAFT_TPU_PERF": "on"})
+        assert proc.returncode == 0, proc.stderr

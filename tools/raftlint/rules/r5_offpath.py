@@ -35,6 +35,12 @@ GATED_HELPERS: Dict[str, Tuple[str, ...]] = {
     ),
     "raft_tpu.obs.spans": ("span", "record_span"),
     "raft_tpu.obs.tracectx": ("mint",),
+    # perf attribution (ISSUE 13) gates on its own RAFT_TPU_PERF bool —
+    # same first-statement shape, independent switch
+    "raft_tpu.obs.perf": (
+        "profile_executable", "record_launch", "record_hbm_watermark",
+        "profile_session",
+    ),
 }
 
 
