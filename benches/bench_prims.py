@@ -413,6 +413,86 @@ def bench_select_k_bars():
         yield res
 
 
+@bench("matrix/epilogue_levers")
+def bench_epilogue_levers():
+    """ISSUE 14 armed lever rows: the unified epilogue layer's two spent
+    levers, measured where they land.
+
+    * ``epilogue/northstar_sharediota`` — the north-star Lloyd iteration
+      through the shared-iota argmin/one-hot epilogue (VERDICT task 6;
+      ``bar_iters_per_s=125`` against the 107.9 BASELINE capture).
+    * ``epilogue/knn_drain_k64`` — fused kNN at the BASELINE drain shape
+      with the strip-width lever armed (sw=None -> DRAIN_SW) next to the
+      whole-tile contrast row (VERDICT task 5; ``bar_ms=50`` /
+      ``bar_mxu_frac=0.15`` against the 97.65 ms / 0.057 capture).
+    * ``epilogue/select_k_insert`` carry-over rows — the same drain
+      under dense select_k's insertion path, strip vs whole tile.
+
+    Off-TPU the rows shrink to code-path smoke shapes and stamp
+    ``partial: true`` plus ``model_cut`` — the DRAIN_SW cost-model
+    prediction ((12.6 + 85) / (12.6 + 85/4) ~ 2.9x, >= the 1.5x
+    floor the ISSUE requires of a proxy row) — so the provenance trail
+    shows an armed bar with a model-backed claim until a TPU window
+    measures it."""
+    from raft_tpu.matrix import epilogue
+    from raft_tpu.matrix.topk_insert import insert_select
+    from raft_tpu.neighbors.fused_topk import knn_fused
+    from raft_tpu.util.precision import get_matmul_precision
+
+    full = jax.default_backend() == "tpu"
+    partial = {} if full else {"partial": True}
+    reps, warm = (3, 2) if full else (1, 1)
+    # DRAIN_SW cost model at the BASELINE kNN shape: ~12.6 ms distance
+    # + ~85 ms drain; a 256-lane strip under tn=1024 cuts the dead-lane
+    # extraction ~4x -> (12.6 + 85) / (12.6 + 85 / 4) per-kernel cut.
+    model_cut = round((12.6 + 85.0) / (12.6 + 85.0 / 4.0), 2)
+
+    # -- north-star shared-iota row (task 6) ---------------------------
+    from raft_tpu.cluster.kmeans import lloyd_step
+
+    rows, dim, k = ((1 << 20, 128, 1024) if full else (4096, 32, 64))
+    x = _data(rows, dim, seed=50)
+    c = _data(k, dim, seed=51)
+    f = jax.jit(functools.partial(lloyd_step, n_clusters=k))
+    r = run_case("epilogue/northstar_sharediota", f, x, c,
+                 repeats=reps, warmup=warm,
+                 flops=2 * rows * k * dim, rows=rows, k=k,
+                 tier=get_matmul_precision(),
+                 bar_iters_per_s=125.0, **partial)
+    r.params["iters_per_s"] = round(1e3 / r.median_ms, 2)
+    yield r
+
+    # -- kNN drain rows (task 5): armed strip vs whole-tile contrast ---
+    nq, ndb = ((4096, 1 << 20) if full else (64, 2048))
+    kk = 64
+    q = _data(nq, dim, seed=52)
+    db = _data(ndb, dim, seed=53)
+    for label, sw in (("strip", None), ("wholetile", 0)):
+        g = jax.jit(functools.partial(knn_fused, k=kk, tn=1024, sw=sw))
+        extra = dict(partial)
+        if sw is None:          # the armed lever row carries the bars
+            extra.update(bar_ms=50.0, bar_mxu_frac=0.15,
+                         model_cut=model_cut)
+        r = run_case(f"epilogue/knn_drain_k64_{label}", g, q, db,
+                     repeats=reps, warmup=warm,
+                     flops=2 * nq * ndb * dim, q=nq, n=ndb, k=kk,
+                     sw=(epilogue.DRAIN_SW if sw is None else sw),
+                     **extra)
+        yield r
+
+    # -- select_k carry-over rows: the same drain under insert_select --
+    m, n = ((4096, 1 << 16) if full else (128, 4096))
+    v = _data(m, n, seed=54)
+    for label, sw in (("strip", epilogue.DRAIN_SW), ("wholetile", 0)):
+        h = jax.jit(functools.partial(insert_select, k=kk, sw=sw))
+        extra = dict(partial)
+        if sw:
+            extra["model_cut"] = model_cut
+        yield run_case(f"epilogue/select_k_insert_{label}", h, v,
+                       repeats=reps, warmup=warm,
+                       items=m * n, m=m, n=n, k=kk, sw=sw, **extra)
+
+
 @bench("matrix/argmin")
 def bench_argmin():
     from raft_tpu.matrix import argmin

@@ -38,7 +38,14 @@ import jax
 # rows — qps + p99 per rank count plus a recovery-time row — measured
 # through the one-program shard_map path; earlier single-rank IVF rows
 # are not comparable to a sharded row's qps column.
-BENCH_ERA = 11
+# Era 14: the unified epilogue layer (matrix/epilogue.py) spends the
+# shared-iota argmin/one-hot fusion and the widened drain strip in
+# every consumer at once — north-star Lloyd, fused-kNN, IVF probe and
+# select_k rows all measure the centralized epilogue, and the
+# matrix/epilogue_levers family carries the armed lever bars
+# (bar_iters_per_s / bar_ms / bar_mxu_frac with the cost-model cut).
+# Pre-era-14 rows for those families measured the hand-rolled copies.
+BENCH_ERA = 14
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

@@ -41,9 +41,9 @@ def main(path: str) -> None:
     if not rows:
         print("(no results)")
         return
-    print("| bench | median ms | throughput | roofline | recall@k "
+    print("| bench | median ms | throughput | roofline | bar | recall@k "
           "| qps @ ranks | dev/host ms per iter | params |")
-    print("|---|---|---|---|---|---|---|---|")
+    print("|---|---|---|---|---|---|---|---|---|")
     # device_ms_per_iter / host_overhead_ms_per_iter: the era-8
     # compiled-inner-loop split on MULTICHIP solver rows. Rendered as
     # its own column so a collective-overhead claim has to show the
@@ -56,11 +56,15 @@ def main(path: str) -> None:
     # mxu_frac / hbm_frac: harness ceiling fractions (TPU rows);
     # roofline_frac: the era-13 obs.perf measured fraction. Rendered as
     # one column — the larger ceiling fraction names the bound a perf
-    # claim is pushing against.
+    # claim is pushing against. bar_*: the era-14 armed lever bars
+    # (matrix/epilogue_levers and the select_k bar rows) — an armed row
+    # renders its acceptance bar beside the measurement, with the
+    # cost-model cut in parentheses on partial (off-TPU proxy) rows.
     skip = {"bench", "median_ms", "best_ms", "repeats", "era",
             "device_ms_per_iter", "host_overhead_ms_per_iter",
             "recall_at_k", "serve_qps", "mxu_frac", "hbm_frac",
-            "roofline_frac"}
+            "roofline_frac", "bar_ms", "bar_gb_s", "bar_iters_per_s",
+            "bar_mxu_frac", "model_cut"}
     for r in sorted(rows, key=lambda r: r["bench"]):
         thr = ""
         for k, unit in (("GFLOP_per_s", "GFLOP/s"), ("GB_per_s", "GB/s"),
@@ -83,6 +87,16 @@ def main(path: str) -> None:
                 hbm = float(hbm or 0.0)
                 roof = (f"{mxu:.2f} mxu" if mxu >= hbm
                         else f"{hbm:.2f} hbm")
+        bars = []
+        for key, fmt in (("bar_ms", "<= {} ms"),
+                         ("bar_gb_s", ">= {} GB/s"),
+                         ("bar_iters_per_s", ">= {} it/s"),
+                         ("bar_mxu_frac", ">= {} mxu")):
+            if r.get(key) is not None:
+                bars.append(fmt.format(r[key]))
+        bar = "; ".join(bars)
+        if bar and r.get("model_cut") is not None:
+            bar += f" (model {r['model_cut']}x)"
         recall = ""
         if r.get("recall_at_k") is not None:
             recall = f"{r['recall_at_k']}"
@@ -95,7 +109,7 @@ def main(path: str) -> None:
                            and k not in ("GFLOP_per_s", "GB_per_s",
                                          "items_per_s"))
         print(f"| {r['bench']} | {r['median_ms']} | {thr} | {roof} "
-              f"| {recall} | {qps_ranks} | {split} | {params} |")
+              f"| {bar} | {recall} | {qps_ranks} | {split} | {params} |")
 
 
 if __name__ == "__main__":

@@ -115,7 +115,21 @@ import jax.numpy as jnp
 def f(x):
     return jnp.sqrt(x)
 EOF
-echo "raftlint gate: tree clean; all 8 seeded violations fail loud"
+seed_violation R9 a.py <<'EOF'
+import jax
+
+def f(labels):
+    return jax.nn.one_hot(labels, 16)
+EOF
+echo "raftlint gate: tree clean; all 9 seeded violations fail loud"
+
+# Epilogue bit-identity gate (ISSUE 14): the unified epilogue layer's
+# primitive oracles + consumer witnesses (kmeans single/mnmg, fused +
+# chunked-radix kNN, IVF full probe, dense + CSR select_k, strip-width
+# invariance) run first and alone — a refactor of the shared argmin /
+# one-hot / drain machinery must fail HERE, with the primitive named,
+# before the full suite runs.
+JAX_PLATFORMS=cpu python -m pytest tests/test_epilogue.py -q
 
 python -m pytest tests/ -x -q
 
@@ -1103,5 +1117,159 @@ if JAX_PLATFORMS=cpu python ci/perf_sentry.py \
 fi
 rm -rf "$SENTRY_TMP"
 echo "sentry gate: shipped history audits clean; seeded regression trips"
+
+# Epilogue-lever bench gate (ISSUE 14, BENCH_ERA=14): the armed lever
+# family must run on the CPU tier with every row stamped era 14 +
+# ``partial`` and the armed rows carrying their bars plus the >= 1.5x
+# cost-model cut; the strip-mined drain must not LOSE to the whole-tile
+# drain (the lever's direction holds even in interpret mode); and the
+# fresh rows must clear the sentry against the shipped era-14 baseline
+# (per-family tolerance 3.0: interpret-mode rows drift between
+# container sessions).
+LEVER_ROWS=$(mktemp /tmp/lever_rows.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python benches/run_benches.py \
+    --family matrix/epilogue_levers > "$LEVER_ROWS"
+python - "$LEVER_ROWS" <<'PYEOF'
+import json
+import sys
+
+from benches.harness import BENCH_ERA
+
+rows = {}
+with open(sys.argv[1]) as fh:
+    for line in fh:
+        line = line.strip()
+        if not line:
+            continue
+        row = json.loads(line)
+        if "bench" in row and row.get("median_ms") is not None:
+            rows[row["bench"]] = row
+
+expected = {"epilogue/northstar_sharediota",
+            "epilogue/knn_drain_k64_strip",
+            "epilogue/knn_drain_k64_wholetile",
+            "epilogue/select_k_insert_strip",
+            "epilogue/select_k_insert_wholetile"}
+missing = expected - set(rows)
+assert not missing, f"lever family dropped rows: {missing}"
+for name, row in rows.items():
+    assert row["era"] == BENCH_ERA == 14, (name, row.get("era"))
+    assert row.get("partial") is True, \
+        f"{name}: CPU proxy row must stamp partial"
+ns = rows["epilogue/northstar_sharediota"]
+assert ns["bar_iters_per_s"] == 125.0 and ns.get("iters_per_s", 0) > 0
+armed = rows["epilogue/knn_drain_k64_strip"]
+assert armed["bar_ms"] == 50.0 and armed["bar_mxu_frac"] == 0.15
+assert armed.get("model_cut", 0) >= 1.5, \
+    "armed drain row must record a >= 1.5x cost-model cut"
+assert rows["epilogue/select_k_insert_strip"].get("model_cut", 0) >= 1.5
+for fam in ("knn_drain_k64", "select_k_insert"):
+    s = rows[f"epilogue/{fam}_strip"]["median_ms"]
+    w = rows[f"epilogue/{fam}_wholetile"]["median_ms"]
+    assert s <= w * 1.10, \
+        f"{fam}: strip drain ({s} ms) lost to whole tile ({w} ms)"
+print(f"lever gate: 5 era-14 rows, armed bars carried, strip <= whole "
+      f"tile on both drain consumers (model cut {armed['model_cut']}x)")
+PYEOF
+JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$LEVER_ROWS" \
+    --family-tol epilogue/northstar_sharediota=3.0 \
+    --family-tol epilogue/knn_drain_k64_strip=3.0 \
+    --family-tol epilogue/knn_drain_k64_wholetile=3.0 \
+    --family-tol epilogue/select_k_insert_strip=3.0 \
+    --family-tol epilogue/select_k_insert_wholetile=3.0 >/dev/null
+rm -f "$LEVER_ROWS"
+echo "lever sentry: fresh era-14 rows clear the shipped baseline"
+
+# Serve-level lever witness (ISSUE 14 satellite): the spent epilogue
+# levers observed from the SERVING side — a loadgen p99 row and a
+# north-star iters/s row, both captured through obs.snapshot()["perf"]
+# so each carries the roofline bound class attributing what the lever
+# moved (overhead-bound on the CPU proxy; a TPU window's rows show the
+# north star's bound flip the fusion buys).
+WITNESS_ROWS=$(mktemp /tmp/witness_rows.XXXXXX.jsonl)
+RAFT_TPU_METRICS=on JAX_PLATFORMS=cpu python - "$WITNESS_ROWS" <<'PYEOF'
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+
+from benches.harness import BENCH_ERA
+from raft_tpu import obs, serve
+from raft_tpu.cluster.kmeans import lloyd_step
+from raft_tpu.obs import perf
+
+perf.set_perf_enabled(True)
+perf.clear_perf_profiles()
+
+rng = np.random.default_rng(14)
+db = rng.standard_normal((2048, 32)).astype(np.float32)
+ex = serve.Executor([serve.KnnService(db, k=64)],
+                    policy=serve.BatchPolicy(max_batch=32,
+                                             max_wait_ms=2.0))
+ex.warm([4, 8])
+with ex:
+    rep = serve.closed_loop(ex, "knn_k64_l2", clients=4, rows=4,
+                            duration_s=1.0)
+assert rep.completed > 0 and np.isfinite(rep.p99_ms) and rep.p99_ms > 0
+
+# north-star proxy iteration through the shared-iota epilogue,
+# attributed against the roofline by obs.perf
+x = jax.numpy.asarray(rng.standard_normal((4096, 32)).astype(np.float32))
+c = jax.numpy.asarray(rng.standard_normal((64, 32)).astype(np.float32))
+f = jax.jit(functools.partial(lloyd_step, n_clusters=64))
+perf.profile_executable("cluster.lloyd_step", 4096, fn=f,
+                        example=(x, c),
+                        model_flops=2.0 * 4096 * 64 * 32,
+                        model_bytes=4.0 * (4096 * 32 + 64 * 32))
+jax.block_until_ready(f(x, c))               # compile outside the clock
+iters = 10
+t0 = time.perf_counter()
+for _ in range(iters):
+    out = f(x, c)
+jax.block_until_ready(out)
+wall = time.perf_counter() - t0
+perf.record_launch("cluster.lloyd_step", 4096, wall, steps=iters)
+iters_per_s = iters / wall
+
+snap = obs.snapshot()["perf"]
+assert snap["enabled"] and snap["profiles"], \
+    "obs.snapshot()['perf'] must carry the witness profiles"
+prof = snap["profiles"]["cluster.lloyd_step[4096]"]
+assert prof["bound"] in ("compute", "bandwidth", "overhead"), prof
+assert prof["roofline_frac"] > 0, prof
+knn_profs = {name: p for name, p in snap["profiles"].items()
+             if name.startswith("knn_k64_l2")}
+assert knn_profs, "warmed KnnService must register perf profiles"
+knn_bound = next(iter(knn_profs.values()))["bound"]
+assert knn_bound in ("compute", "bandwidth", "overhead")
+
+rows = [
+    {"bench": "serve/loadgen_p99_knn_k64", "era": BENCH_ERA,
+     "median_ms": round(rep.p99_ms, 3), "backend": "cpu",
+     "partial": True, "bound": knn_bound, "qps": round(rep.qps, 1),
+     "completed": rep.completed},
+    {"metric": "epilogue/northstar_iters_per_s", "era": BENCH_ERA,
+     "value": round(iters_per_s, 2), "backend": "cpu", "partial": True,
+     "bound": prof["bound"],
+     "roofline_frac": round(prof["roofline_frac"], 4),
+     "bar_iters_per_s": 125.0},
+]
+with open(sys.argv[1], "w") as fh:
+    for row in rows:
+        fh.write(json.dumps(row) + "\n")
+perf.set_perf_enabled(False)
+print(f"serve witness: p99 {rep.p99_ms:.2f} ms ({knn_bound}-bound), "
+      f"north-star proxy {iters_per_s:.1f} iters/s "
+      f"({prof['bound']}-bound, roofline_frac "
+      f"{prof['roofline_frac']:.3f})")
+PYEOF
+JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$WITNESS_ROWS" \
+    --family-tol serve/loadgen_p99_knn_k64@cpu=3.0 \
+    --family-tol epilogue/northstar_iters_per_s@cpu=3.0 >/dev/null
+rm -f "$WITNESS_ROWS"
+echo "witness sentry: serve-side lever rows clear the shipped baseline"
 
 echo "smoke: PASS"

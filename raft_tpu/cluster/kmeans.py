@@ -34,6 +34,7 @@ from raft_tpu import obs
 from raft_tpu.linalg.contractions import (_kernel_dot_exact_lhs,
                                           fused_l2_argmin_pallas,
                                           fused_lloyd_pallas)
+from raft_tpu.matrix.epilogue import host_assign_update, label_onehot
 from raft_tpu.random.rng_state import RngState
 from raft_tpu.util.precision import with_matmul_precision
 
@@ -181,7 +182,7 @@ def _weighted_sums(x, w, labels, dist, n_clusters: int):
     scatter-free one-hot contraction with w-scaled rows, shared by the
     single-chip and both MNMG weighted update paths."""
     wf = w.astype(jnp.float32)
-    oh = jax.nn.one_hot(labels, n_clusters, dtype=jnp.float32)
+    oh = label_onehot(labels, n_clusters)
     sums = _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32)
                                  * wf[:, None])
     counts = oh.T @ wf
@@ -714,9 +715,7 @@ def mnmg_lloyd_step(x_shard, centroids, n_clusters: int,
         # one-hot contraction on the MXU (no scatter).
         in_block = (labels >= mi * kb) & (labels < (mi + 1) * kb)
         local_labels = jnp.where(in_block, labels - mi * kb, 0)
-        oh = ((jax.lax.broadcasted_iota(jnp.int32, (x_shard.shape[0], kb), 1)
-               == local_labels[:, None])
-              & in_block[:, None]).astype(jnp.float32)
+        oh = label_onehot(local_labels, kb, mask=in_block)
         if w_shard is not None:
             wf = w_shard.astype(jnp.float32)
             sums = _kernel_dot_exact_lhs(
@@ -1205,14 +1204,8 @@ def kmeans_fit_elastic(comms, params: KMeansParams, x,
                 bounds = np.linspace(0, n, size + 1).astype(np.int64)
                 lo, hi = int(bounds[rank]), int(bounds[rank + 1])
                 xs, ws = x[lo:hi], w[lo:hi]
-                d2 = ((xs * xs).sum(1)[:, None] - 2.0 * (xs @ c.T)
-                      + (c * c).sum(1)[None, :])
-                labels = np.argmin(d2, axis=1)
-                sums = np.zeros((k, d), np.float64)
-                np.add.at(sums, labels, xs * ws[:, None])
-                counts = np.zeros(k, np.float64)
-                np.add.at(counts, labels, ws)
-                best = np.maximum(d2[np.arange(len(xs)), labels], 0.0)
+                labels, sums, counts, best = host_assign_update(
+                    xs, ws, c)
                 buf = np.concatenate(
                     [sums.ravel(), counts, [float((best * ws).sum())]])
                 tot = comms.host_allreduce(buf, tag=2 * it)

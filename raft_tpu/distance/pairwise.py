@@ -346,5 +346,7 @@ def fused_l2_nn_argmin(res, x, y, sqrt: bool = False):
         val, idx = fused_l2_argmin_pallas(x, y)
     else:
         d = _l2_expanded(x, y, sqrt=False)
-        val, idx = jnp.min(d, axis=1), jnp.argmin(d, axis=1).astype(jnp.int32)
+        from raft_tpu.matrix.epilogue import argmin_ref
+
+        val, idx = argmin_ref(d)
     return (jnp.sqrt(val) if sqrt else val), idx

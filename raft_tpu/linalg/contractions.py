@@ -40,6 +40,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.matrix.epilogue import (argmin_ref, assign_onehot,
+                                      iota_argmin, label_onehot,
+                                      masked_fold)
 from raft_tpu.util.math import round_up_to_multiple
 from raft_tpu.util.pallas_utils import (interpret_needs_ref, join_vma,
                                         out_struct, pallas_call)
@@ -234,39 +237,12 @@ def _metric_tile_split(xh, xl, xn, yh, yl, yn, metric: str,
     raise ValueError(f"unknown metric {metric!r}")
 
 
-def _mask_argmin(d, n_valid: int, finite: bool = False):
-    """Shared masking + fused argmin over a distance tile (see
-    :func:`_distance_tile` for the tie rule and index-dtype rationale)."""
-    col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
-    # dtype-matched inf: a bare jnp.inf is a weak-f64 constant under
-    # jax_enable_x64, and the resulting f64→f32 convert has no Mosaic
-    # lowering (caught by tests/test_mosaic_lowering.py).
-    # When n_valid is STATIC and aligned (the north-star k=1024 exactly
-    # fills its tile) skip the whole masking pass — the epilogue is the
-    # binding resource (BASELINE.md roofline note), so a dead (tm, np_)
-    # compare+select per tile is real time, not hygiene. The tiled-argmin
-    # path passes a TRACED n_valid (per-tile validity): always mask there.
-    if not (isinstance(n_valid, int) and n_valid >= d.shape[1]):
-        d = jnp.where(col < n_valid, d, jnp.asarray(jnp.inf, d.dtype))
-    minval = jnp.min(d, axis=1, keepdims=True)
-    # Manual first-minimum argmin: lax.argmin's variadic-reduce lowering
-    # fails Mosaic legalization at narrow tiles (unresolved f32->i32
-    # materialization, observed on-chip at a (257, 19) tile); min +
-    # masked-iota uses only plain reduce-min/where ops (no variadic
-    # reduce) and keeps the KVP first-minimum tie rule. On-chip evidence
-    # gate: the smoke tier's test_fused_argmin[257-31-19] at this sha. NaN positions count as minimal (lax.argmin/numpy parity —
-    # XLA reduce-min propagates NaN, so minval is NaN and only the NaN
-    # columns survive the candidate mask).
-    # ``finite`` statically declares NaN-free distances (the Lloyd paths:
-    # k-means on non-finite data is undefined anyway) and skips the NaN
-    # candidate clause — two dead (tm, np_) VPU passes per tile on the
-    # epilogue-bound kernel (BASELINE.md roofline, r5 lever).
-    cand = d == minval
-    if not finite:
-        cand = cand | (d != d)
-    sentinel = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
-    arg = jnp.min(jnp.where(cand, col, sentinel), axis=1, keepdims=True)
-    return col, minval, arg
+# Shared masking + fused argmin over a distance tile (see
+# :func:`_distance_tile` for the tie rule and index-dtype rationale).
+# The implementation — including the Mosaic-legality rationale it
+# carries — moved into the unified epilogue layer (ISSUE 14); this
+# alias keeps the kernels' historical spelling.
+_mask_argmin = iota_argmin
 
 
 def _distance_tile_split(xh, xl, xn, yh, yl, yn, n_valid: int,
@@ -291,20 +267,26 @@ def _argmin_jnp(x, y, metric: str = "l2"):
     # decomposition and precomputed norms round differently at the last
     # bit — ties between float-identical distances can differ there).
     d = _metric_tile(x, y, metric)
-    arg = jax.lax.argmin(d, 1, jnp.int32)
-    minval = jnp.min(d, axis=1)
+    minval, arg = argmin_ref(d)
     if metric == "l2":
         minval = jnp.maximum(minval, 0.0)
     return minval, arg
 
 
 def _lloyd_jnp(x, y):
-    val, idx = _argmin_jnp(x, y)
-    oh = (jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], y.shape[0]), 1)
-          == idx[:, None]).astype(jnp.float32)
+    # Shared-iota spelling (epilogue lever, VERDICT task 6) on the jnp
+    # reference path too: iota_argmin's column iota feeds the one-hot,
+    # so the reference prices the same epilogue shape as the kernels.
+    # Bit-identical to the previous lax.argmin + fresh-iota spelling:
+    # iota_argmin keeps the first-minimum tie rule and the static
+    # aligned n_valid skips the masking pass (same d).
+    d = _metric_tile(x, y, "l2")
+    col, minval, arg = iota_argmin(d, y.shape[0])
+    val = jnp.maximum(minval[:, 0], 0.0)
+    oh = assign_onehot(col, arg).astype(jnp.float32)
     sums = _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32))
     counts = jnp.sum(oh, axis=0)
-    return sums, counts, val, idx
+    return sums, counts, val, arg[:, 0]
 
 
 def _tm_fits(tm: int, kp: int, np_: int, mn_bufs: int, const_bytes: int,
@@ -672,24 +654,12 @@ def _distance_tile(x, y, n_valid: int, metric: str = "l2",
                         finite=finite)
 
 
-def _fold_running_min(val_ref, idx_ref, minval, arg, offset):
-    """Tiled-kernel epilogue shared by the split and non-split variants:
-    initialize the revisited (val, idx) block on the first y-tile, then
-    fold this tile's (min, argmin) in (ties keep the earlier tile — the
-    global first-minimum rule)."""
-    j = pl.program_id(1)
-
-    @pl.when(j == 0)
-    def _init():
-        val_ref[:] = jnp.full_like(val_ref, jnp.inf)
-        idx_ref[:] = jnp.zeros_like(idx_ref)
-
-    garg = (arg + offset).T                           # (1, tm)
-    minval = minval.T
-    prev_val = val_ref[:]
-    better = minval < prev_val
-    val_ref[:] = jnp.where(better, minval, prev_val)
-    idx_ref[:] = jnp.where(better, garg, idx_ref[:])
+# Tiled-kernel epilogue shared by the split and non-split variants:
+# initialize the revisited (val, idx) block on the first y-tile, then
+# fold this tile's (min, argmin) in (ties keep the earlier tile — the
+# global first-minimum rule). Implementation: epilogue.masked_fold
+# (ISSUE 14); the alias keeps the kernels' historical spelling.
+_fold_running_min = masked_fold
 
 
 def _argmin_resident_kernel(x_ref, y_ref, val_ref, idx_ref, *,
@@ -977,11 +947,13 @@ def _lloyd_kernel(x_ref, y_ref, sums_ref, counts_ref, val_ref, idx_ref, *,
     # One-hot accumulation on the MXU: padded X rows are zero (no effect
     # on sums) but must not inflate counts — mask them out. The mask is
     # static per shape: aligned m (the north-star 1M at tm=512) skips it.
-    oh = col == arg
+    # assign_onehot REUSES the argmin's column iota (the shared-iota
+    # lever, VERDICT task 6).
+    row_mask = None
     if m_valid < pl.num_programs(0) * tm:
         row = jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0) + i * tm
-        oh = oh & (row < m_valid)
-    oh = oh.astype(jnp.float32)
+        row_mask = row < m_valid
+    oh = assign_onehot(col, arg, row_mask).astype(jnp.float32)
     sums_ref[:] += _kernel_dot_exact_lhs(oh.T, x.astype(jnp.float32))
     counts_ref[:] += jnp.sum(oh, axis=0, keepdims=True)
     # (counts ride the already-f32 one-hot here; the split kernel fuses
@@ -1009,11 +981,11 @@ def _lloyd_kernel_split(xh_ref, xl_ref, xn_ref, yh_ref, yl_ref, yn_ref,
     # update is two one-pass MXU dots against the hi/lo halves — or one
     # depth-packed 2tm-deep dot when ``packed`` (see _cross_split).
     # Row-validity mask statically skipped at aligned m (see _lloyd_kernel).
-    ohb = col == arg
+    row_mask = None
     if m_valid < pl.num_programs(0) * tm:
         row = jax.lax.broadcasted_iota(jnp.int32, (tm, 1), 0) + i * tm
-        ohb = ohb & (row < m_valid)
-    ohb = ohb.astype(jnp.bfloat16)
+        row_mask = row < m_valid
+    ohb = assign_onehot(col, arg, row_mask).astype(jnp.bfloat16)
     f32 = jnp.float32
     if packed:
         ohcat = jnp.concatenate([ohb.T, ohb.T], axis=1)     # (np_, 2tm)
@@ -1267,7 +1239,7 @@ def fused_lloyd_pallas(x, y, tm: Optional[int] = None,
         def body(carry, inp):
             sums, counts = carry
             xc, ic = inp
-            oh = jax.nn.one_hot(ic, n, dtype=jnp.float32)
+            oh = label_onehot(ic, n)
             sums = sums + _kernel_dot_exact_lhs(oh.T, xc.astype(jnp.float32))
             return (sums, counts + jnp.sum(oh, axis=0)), None
 

@@ -1,7 +1,7 @@
 """Dense matrix primitives (ref: cpp/include/raft/matrix/)."""
 
 from raft_tpu.matrix.select_k import SelectAlgo, select_k  # noqa: F401
-from raft_tpu.matrix.argminmax import argmin, argmax  # noqa: F401
+from raft_tpu.matrix.epilogue import argmin, argmax  # noqa: F401
 from raft_tpu.matrix.gather import (gather, gather_if, scatter,  # noqa: F401
                                     take_rows)
 from raft_tpu.matrix.linewise_op import linewise_op  # noqa: F401

@@ -1,18 +1,12 @@
-"""Per-row argmin/argmax (ref: matrix/argmax.cuh, matrix/argmin.cuh).
-
-Tie-breaking: smallest index wins, matching the reference's KVP atomics.
+"""Deprecated shim: per-row argmin/argmax moved into the unified
+epilogue layer (:mod:`raft_tpu.matrix.epilogue`, ISSUE 14). This module
+re-exports the same callables so existing ``matrix.argminmax`` imports
+keep working; new code should import from ``raft_tpu.matrix`` (or
+``raft_tpu.matrix.epilogue``) directly.
 """
 
 from __future__ import annotations
 
-import jax.numpy as jnp
+from raft_tpu.matrix.epilogue import argmax, argmin  # noqa: F401
 
-
-def argmin(res, matrix):
-    """Index of the minimum of each row (ref: argmin.cuh)."""
-    return jnp.argmin(jnp.asarray(matrix), axis=1).astype(jnp.int32)
-
-
-def argmax(res, matrix):
-    """Index of the maximum of each row (ref: argmax.cuh)."""
-    return jnp.argmax(jnp.asarray(matrix), axis=1).astype(jnp.int32)
+__all__ = ["argmin", "argmax"]

@@ -1,0 +1,482 @@
+"""Unified fused-epilogue primitives (ISSUE 14): the ONE spelling of the
+iota-compare argmin, the factorized one-hot contractions, the running
+min-fold, and the bound-gated insertion drain that every selection /
+assignment epilogue in the tree rides.
+
+The contraction engine runs at MXU rate; its consumers are throttled by
+their VPU epilogues (BASELINE roofline: the north star at 57% MXU with
+the argmin/one-hot epilogue binding, kNN at mxu_frac 0.057 with ~85% of
+the kernel in insertion drain). Before this module the same machinery
+was hand-rolled in at least three places (cluster/kmeans.py's mnmg
+one-hot, matrix/radix_select.py's histogram/emission one-hots,
+neighbors/fused_topk.py's drain strip). Centralizing it means a lever
+spent here — the shared-iota argmin/one-hot fusion (VERDICT task 6) and
+the widened drain strip (task 5) — lands in kmeans, kNN, IVF, and
+select_k simultaneously, and raftlint R9 keeps the deleted duplication
+deleted.
+
+Primitive -> consumer map (mirrored in docs/architecture.md):
+
+===================  ====================================================
+primitive            consumers
+===================  ====================================================
+iota_argmin          contractions._distance_tile (fused argmin / Lloyd /
+                     tiled kernels), via the _mask_argmin alias
+argmin_ref           contractions._argmin_jnp (interpret / odd-dtype
+                     reference path), distance.pairwise 1-NN reference
+assign_onehot        contractions._lloyd_kernel(+_split), _lloyd_jnp —
+                     the shared-iota lever: iota_argmin's column iota
+                     feeds BOTH the argmin and the one-hot update
+label_onehot         kmeans._weighted_sums, kmeans.mnmg_lloyd_step
+                     (model-axis block one-hot), contractions'
+                     VMEM-fallback chunked update
+onehot_pair/
+onehot_histogram     radix_select._threshold_kernel (16x16 digit
+                     histogram), _emit_chunk_body (slot x column-value
+                     emission)
+slot_onehot          radix_select threshold narrowing (hi-nibble select)
+masked_fold          contractions tiled argmin kernels,
+                     fused_topk._minonly_body
+insert_drain         topk_insert (insert_select), fused_topk (knn_fused)
+masked_topk          ivf_flat._probe_topk (+ ivf_mnmg / serve via it),
+                     brute_force._knn_chunked / _knn_scan
+host_assign_update   kmeans_fit_elastic (numpy host loop)
+argmin/argmax        matrix API (folded from matrix/argminmax.py)
+===================  ====================================================
+
+Every primitive keeps the tie contract of the fused-NN KVP min-reduce
+lineage: smallest index wins globally — within a tile by first-minimum
+argmin, across tiles because earlier insertions sit left of (and folds
+keep) an equal newcomer.
+
+Mosaic legality notes carried with the code they protect: reduce-min +
+masked-iota argmin (lax.argmin's variadic reduce fails legalization),
+i32 max-reduce instead of jnp.any (bool proxy reduces through f64 under
+x64), dtype-matched inf constants (bare jnp.inf is weak-f64), masked
+one-lane reduce for the k-th bound (a (tm, 1)-index gather from
+(tm, bw) is not legal), `pltpu.roll` lane shifts.
+
+Module-level imports are restricted to jax/pallas/numpy/util so
+linalg.contractions can import this module at the top level; the radix
+import inside :func:`masked_topk` stays lazy (epilogue -> radix ->
+contractions -> epilogue would otherwise cycle).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.util.math import round_up_to_multiple
+
+LANES = 128
+MAX_K = 2 * LANES   # up to two vregs of sorted best per query row
+                    # (larger k takes the radix / tournament paths)
+
+# Default drain-strip width (VERDICT task 5): the per-round extraction
+# cost of the insertion drain is O(tm * strip), independent of the
+# producer tile width, so a 256-lane strip under the measured tn=1024
+# kNN tile cuts the dead-lane extraction work ~4x while the round count
+# (one per improving candidate) is unchanged. Cost model at the
+# BASELINE kNN shape (1M x 128, q=4096, k=64: 97.65 ms total, ~12.6 ms
+# distance + ~85 ms drain): 12.6 + 85/4 = 33.9 ms, a ~2.9x model cut
+# that would put mxu_frac at ~0.16 (the task-5 bar is >= 0.15).
+DRAIN_SW = 256
+
+
+# ---------------------------------------------------------------------------
+# argmin family
+# ---------------------------------------------------------------------------
+
+
+def argmin_ref(d):
+    """jnp reference argmin epilogue: per-row (min, first-min argmin) of
+    a materialized distance block via lax.argmin — the spelling the
+    interpret / odd-dtype paths use (pallas_utils.interpret_needs_ref
+    dispatch). Same tie rule (smallest index) and NaN semantics as
+    :func:`iota_argmin`; the kernels never call this (lax.argmin's
+    variadic-reduce lowering fails Mosaic legalization)."""
+    arg = jax.lax.argmin(d, 1, jnp.int32)
+    minval = jnp.min(d, axis=1)
+    return minval, arg
+
+
+def iota_argmin(d, n_valid, finite: bool = False):
+    """Mosaic-safe fused mask + argmin over a (tm, np_) distance tile.
+
+    Returns ``(col, minval, arg)`` with ``minval``/``arg`` keepdims
+    (tm, 1) — and ``col``, the (tm, np_) column iota, so the caller can
+    REUSE it for the one-hot update (``assign_onehot``): the shared-iota
+    lever (VERDICT task 6) — one iota feeds both the assignment and the
+    centroid-update epilogue instead of each building its own.
+
+    dtype-matched inf: a bare jnp.inf is a weak-f64 constant under
+    jax_enable_x64, and the resulting f64→f32 convert has no Mosaic
+    lowering (caught by tests/test_mosaic_lowering.py).
+    When n_valid is STATIC and aligned (the north-star k=1024 exactly
+    fills its tile) skip the whole masking pass — the epilogue is the
+    binding resource (BASELINE.md roofline note), so a dead (tm, np_)
+    compare+select per tile is real time, not hygiene. The tiled-argmin
+    path passes a TRACED n_valid (per-tile validity): always mask there.
+
+    Manual first-minimum argmin: lax.argmin's variadic-reduce lowering
+    fails Mosaic legalization at narrow tiles (unresolved f32->i32
+    materialization, observed on-chip at a (257, 19) tile); min +
+    masked-iota uses only plain reduce-min/where ops (no variadic
+    reduce) and keeps the KVP first-minimum tie rule. On-chip evidence
+    gate: the smoke tier's test_fused_argmin[257-31-19]. NaN positions
+    count as minimal (lax.argmin/numpy parity — XLA reduce-min
+    propagates NaN, so minval is NaN and only the NaN columns survive
+    the candidate mask).
+
+    ``finite`` statically declares NaN-free distances (the Lloyd paths:
+    k-means on non-finite data is undefined anyway) and skips the NaN
+    candidate clause — two dead (tm, np_) VPU passes per tile on the
+    epilogue-bound kernel (BASELINE.md roofline, r5 lever)."""
+    col = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    if not (isinstance(n_valid, int) and n_valid >= d.shape[1]):
+        d = jnp.where(col < n_valid, d, jnp.asarray(jnp.inf, d.dtype))
+    minval = jnp.min(d, axis=1, keepdims=True)
+    cand = d == minval
+    if not finite:
+        cand = cand | (d != d)
+    sentinel = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
+    arg = jnp.min(jnp.where(cand, col, sentinel), axis=1, keepdims=True)
+    return col, minval, arg
+
+
+def row_min_arg(pool, col):
+    """Per-row (min, first-min argmin) of a (tm, tn) pool whose column
+    indices the caller already holds — reduce-min + masked-iota, the
+    Mosaic-safe argmin spelling (see :func:`iota_argmin` for why
+    lax.argmin is not used)."""
+    pm = jnp.min(pool, axis=1, keepdims=True)
+    sentinel = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
+    pidx = jnp.min(jnp.where(pool == pm, col, sentinel), axis=1,
+                   keepdims=True)
+    return pm, pidx
+
+
+# ---------------------------------------------------------------------------
+# one-hot family
+# ---------------------------------------------------------------------------
+
+
+def assign_onehot(col, arg, row_mask=None):
+    """Boolean assignment one-hot from :func:`iota_argmin`'s outputs —
+    the shared-iota lever: ``col`` is the SAME iota the argmin consumed,
+    so the one-hot costs one (tm, np_) compare instead of a fresh iota +
+    compare. ``row_mask`` (tm, 1) masks padded X rows (they must not
+    inflate counts). Caller picks the accumulation dtype (f32 on the
+    plain path, bf16 on the split path — 0/1 is exact in both)."""
+    oh = col == arg
+    if row_mask is not None:
+        oh = oh & row_mask
+    return oh
+
+
+def label_onehot(labels, n_classes: int, mask=None,
+                 dtype=jnp.float32):
+    """(m, n_classes) one-hot from an (m,) label vector — the XLA-side
+    twin of :func:`assign_onehot` for paths that carry labels instead of
+    a resident distance tile (kmeans weighted/mnmg updates, the
+    VMEM-fallback chunked update). Out-of-range labels (the padded-row
+    ``n_classes`` convention) produce all-zero rows, matching
+    jax.nn.one_hot, whose spelling this replaces 1:1."""
+    col = jax.lax.broadcasted_iota(
+        jnp.int32, (labels.shape[0], n_classes), 1)
+    oh = col == labels[:, None]
+    if mask is not None:
+        oh = oh & mask[:, None]
+    return oh.astype(dtype)
+
+
+def onehot_pair(hi, lo, nh: int, nl: int, active=None,
+                dtype=jnp.bfloat16):
+    """The factorized one-hot operand pair behind every MXU histogram /
+    emission contraction: digit = nl*hi + lo, ``ohhi`` (tm, nh, tl) and
+    ``ohlo`` (tm, tl, nl) such that their row-batched dot lands each
+    (hi, lo) pair in its own output cell. ``active`` (tm, tl) masks
+    elements out of the hi side (a -1 sentinel in ``hi`` matches no row
+    and needs no mask). 0/1 operands are exact in bf16."""
+    iota_h = jax.lax.broadcasted_iota(jnp.int32, (1, nh, 1), 1)
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, 1, nl), 2)
+    hh = iota_h == hi[:, None, :]
+    if active is not None:
+        hh = hh & active[:, None, :]
+    ohhi = hh.astype(dtype)                              # (tm, nh, tl)
+    ohlo = (lo[:, :, None] == iota_l).astype(dtype)      # (tm, tl, nl)
+    return ohhi, ohlo
+
+
+def onehot_histogram(hi, lo, active=None, nh: int = 16, nl: int = 16):
+    """All nh*nl digit bins of a (tm, tl) tile as exact f32 counts in
+    ONE row-batched MXU contraction — the TPU replacement for the
+    reference's shared-memory atomic histogram (radix_select lineage):
+    (tm, nh, tl) @ (tm, tl, nl) of the factorized one-hots. 0/1 bf16
+    operands with f32 accumulate: counts exact to 2^24 > MAX_LEN."""
+    ohhi, ohlo = onehot_pair(hi, lo, nh, nl, active)
+    return jax.lax.dot_general(
+        ohhi, ohlo, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT)             # (tm, nh, nl)
+
+
+def onehot_histogram_ref(hi, lo, active=None, nh: int = 16,
+                         nl: int = 16):
+    """jnp reference for :func:`onehot_histogram` (test oracle): the
+    same counts via a plain compare-and-sum, no MXU contraction."""
+    digit = hi * nl + lo
+    tm, tl = digit.shape
+    oh = digit[:, :, None] == jnp.arange(nh * nl,
+                                         dtype=digit.dtype)[None, None, :]
+    if active is not None:
+        oh = oh & active[:, :, None]
+    return jnp.sum(oh.astype(jnp.float32), axis=1).reshape(tm, nh, nl)
+
+
+def slot_onehot(idx, nbins: int, dtype=jnp.float32):
+    """(tm, nbins, 1) selector one-hot from a (tm, 1) bin index — the
+    histogram-row select of the radix threshold narrowing."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, nbins, 1), 1)
+    return (iota == idx[:, :, None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# running min-fold (tiled-kernel epilogue)
+# ---------------------------------------------------------------------------
+
+
+def masked_fold(val_ref, idx_ref, minval, arg, offset):
+    """Tiled-kernel running-min epilogue shared by the argmin kernels
+    (split and non-split) and the kNN min-only floor probe: initialize
+    the revisited (1, tm) (val, idx) block on the first y-tile, then
+    fold this tile's keepdims (tm, 1) (min, argmin) in — ties keep the
+    earlier tile (strict ``<``), the global first-minimum rule.
+    ``offset`` rebases tile-local argmins to global columns (pass 0 when
+    ``arg`` is already global)."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        val_ref[:] = jnp.full_like(val_ref, jnp.inf)
+        idx_ref[:] = jnp.zeros_like(idx_ref)
+
+    garg = (arg + offset).T                           # (1, tm)
+    minval = minval.T
+    prev_val = val_ref[:]
+    better = minval < prev_val
+    val_ref[:] = jnp.where(better, minval, prev_val)
+    idx_ref[:] = jnp.where(better, garg, idx_ref[:])
+
+
+def masked_fold_ref(best_val, best_idx, minval, arg, offset):
+    """jnp reference twin of :func:`masked_fold` (functional, no refs):
+    one fold step over already-initialized running (val, idx)."""
+    garg = arg + offset
+    better = minval < best_val
+    return (jnp.where(better, minval, best_val),
+            jnp.where(better, garg, best_idx))
+
+
+# ---------------------------------------------------------------------------
+# bound-gated insertion drain
+# ---------------------------------------------------------------------------
+
+
+def resolve_tn_sw(tn: int, sw: Optional[int], n: int):
+    """One spelling of the tile-width clamp + strip-width contract for
+    every drain consumer (knn_fused, insert_select): lane-align tn,
+    clamp it to the data width, and validate sw against the REQUESTED
+    tn — an sw that never divided the caller's tn is an error, while
+    indivisibility introduced only by the small-data clamp degrades to
+    the whole-tile drain (a perf knob must not error on small inputs).
+    ``sw=None`` picks the default lever (:data:`DRAIN_SW` when it
+    divides the requested tile, whole-tile otherwise — an explicit tn
+    the lever cannot strip is the caller's tile choice, not an error).
+    Returns (tn, sw)."""
+    tn_req = max(128, tn - tn % 128)        # caller's lane-aligned ask
+    tn = min(tn_req, round_up_to_multiple(n, 128))
+    if sw is None:
+        sw = DRAIN_SW if tn_req % DRAIN_SW == 0 else 0
+    if sw and (sw < 0 or sw % 128 or tn_req % sw):
+        raise ValueError(f"sw must be a positive lane-aligned divisor "
+                         f"of tn={tn_req}")
+    if sw and tn % sw:
+        sw = 0                  # clamp-induced indivisibility only
+    return tn, sw
+
+
+def best_width(k: int) -> int:
+    """Lane-aligned width of the sorted-best buffer: one vreg for
+    k <= 128, two for k <= 256 (insert cost scales with the width, so
+    the buffer is as narrow as k allows)."""
+    return LANES * ((k + LANES - 1) // LANES)
+
+
+def insert_drain(dist, val_ref, idx_ref, j, tn: int, k: int,
+                 n_valid: int, sw: int = 0):
+    """Drain a (tm, tn) candidate tile into the sorted (tm, bw) best.
+
+    Each round: per-row pool min + first-min argmin (smallest column
+    wins ties), consume that lane, and for rows where the minimum beats
+    their k-th bound, compare-shift it into the sorted best. Rows whose
+    pool holds nothing below their bound extract dead mins into a
+    guarded no-op — progress is global (every looping row consumes one
+    lane per round), and the loop exits when no row can improve. Tie
+    contract (smallest index wins globally): within a tile the first-min
+    argmin inserts equal values in column order; across tiles, earlier
+    insertions win because ``keep = best <= candidate`` leaves existing
+    entries to the left of an equal newcomer.
+
+    ``sw`` (strip width, 0 = whole tile): drain the tile in static
+    lane-aligned strips so the per-round vector work is O(tm·sw) while
+    the producer tile keeps its full width — the tile width and the
+    drain width are INDEPENDENT knobs. Round count is unchanged (a
+    candidate is a candidate in any strip); only the dead-lane
+    extraction width shrinks. Strips see ascending global columns,
+    preserving the tie contract. :data:`DRAIN_SW` is the spent lever
+    default at the drain's call sites (see the module docstring's cost
+    model).
+
+    NaN candidates are mapped to +inf HERE, for every producer: a NaN
+    pool minimum would match no lane (nothing consumed) and the while
+    loop could spin forever on the DEVICE while any finite candidate
+    sits below the bound — a hang, not a wrong answer. One compare+
+    select per tile element buys termination; +inf is the drain's own
+    never-selected sentinel (NaN sorts last)."""
+    tm = dist.shape[0]
+    dist = jnp.where(jnp.isnan(dist), jnp.asarray(jnp.inf, jnp.float32),
+                     dist)
+    bw = best_width(k)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tm, bw), 1)
+    inf = jnp.asarray(jnp.inf, jnp.float32)
+
+    @pl.when(j == 0)
+    def _init():
+        val_ref[:] = jnp.full((tm, bw), jnp.inf, jnp.float32)
+        idx_ref[:] = jnp.zeros((tm, bw), jnp.int32)
+
+    def kth(bv):
+        # masked one-lane reduce: a (tm, 1)-index gather from (tm, bw)
+        # is not Mosaic-legal (same-shape operand rule)
+        return jnp.min(jnp.where(lane == k - 1, bv, inf), axis=1,
+                       keepdims=True)
+
+    def cond(carry):
+        pool, bv, _ = carry
+        # i32 max, not bool any: jnp.any's bool proxy reduces through
+        # f64 under jax_enable_x64 and fails Mosaic lowering
+        # (radix_select precedent)
+        return jnp.max((pool < kth(bv)).astype(jnp.int32)) > 0
+
+    def drain(pool, col_g, bv, bi):
+        def body(carry):
+            pool, bv, bi = carry
+            pm, pidx = row_min_arg(pool, col_g)
+            pool = jnp.where(col_g == pidx, inf, pool)  # consume lane
+            improving = pm < kth(bv)
+            keep = bv <= pm                 # prefix mask (sorted best)
+            pos = jnp.sum(keep.astype(jnp.int32), axis=1, keepdims=True)
+            shv = pltpu.roll(bv, 1, axis=1)
+            shi = pltpu.roll(bi, 1, axis=1)
+            nv = jnp.where(lane < pos, bv,
+                           jnp.where(lane == pos, pm, shv))
+            ni = jnp.where(lane < pos, bi,
+                           jnp.where(lane == pos, pidx, shi))
+            bv = jnp.where(improving, nv, bv)
+            bi = jnp.where(improving, ni, bi)
+            return pool, bv, bi
+
+        _, bv, bi = jax.lax.while_loop(cond, body, (pool, bv, bi))
+        return bv, bi
+
+    sw = sw or tn
+    bv, bi = val_ref[:], idx_ref[:]
+    for s in range(0, tn, sw):              # static: unrolled strips
+        strip = dist[:, s:s + sw]
+        col_g = (jax.lax.broadcasted_iota(jnp.int32, strip.shape, 1)
+                 + j * tn + s)
+        pool = jnp.where(col_g < n_valid, strip, inf)
+        bv, bi = drain(pool, col_g, bv, bi)
+    val_ref[:] = bv
+    idx_ref[:] = bi
+
+
+def insert_drain_ref(values, k: int):
+    """jnp reference twin of the drain's end-to-end contract over a
+    materialized (m, n) block: ascending top-k by value with first-index
+    ties (lax.top_k is stable over the negated input) and NaN mapped to
+    the drain's +inf sentinel (NaN sorts last, never inserts)."""
+    v = jnp.asarray(values).astype(jnp.float32)
+    v = jnp.where(jnp.isnan(v), jnp.inf, v)
+    neg, idx = jax.lax.top_k(-v, k)
+    return -neg, idx
+
+
+# ---------------------------------------------------------------------------
+# masked scoring epilogue (XLA-side: IVF probe scan, chunked-radix kNN)
+# ---------------------------------------------------------------------------
+
+
+def masked_topk(dist, valid, k: int, use_radix: bool):
+    """Validity-masked ascending top-k of a materialized (m, n) score
+    block — the ONE spelling of the mask + select epilogue behind
+    ivf_flat's probe scan and brute_force's chunked/scan formulations.
+    Invalid slots become +inf (never selected; a fully-invalid row
+    returns +inf values, which callers map to id -1). ``use_radix``
+    routes to the digit-histogram radix select (the bandwidth-class
+    epilogue for wide rows) vs lax.top_k (short rows / reference)."""
+    dist = jnp.where(valid, dist, jnp.inf)
+    if use_radix:
+        from raft_tpu.matrix.radix_select import radix_select_k
+
+        return radix_select_k(dist, k)
+    neg, pos = jax.lax.top_k(-dist, k)
+    return -neg, pos
+
+
+# ---------------------------------------------------------------------------
+# host (numpy) twin — the elastic fit's per-rank assignment + update
+# ---------------------------------------------------------------------------
+
+
+def host_assign_update(xs, ws, c):
+    """One rank's Lloyd assignment + weighted one-hot update on the
+    HOST (numpy f64) — the elastic fit's per-iteration body, kept next
+    to its device twins so the tie rule (np.argmin = first minimum) and
+    the expanded-form distances stay in one reviewed place. Returns
+    ``(labels, sums [k, d], counts [k], best [m])`` with ``best`` the
+    clamped per-row squared distance (unweighted; the caller folds
+    weights into its inertia term)."""
+    d2 = ((xs * xs).sum(1)[:, None] - 2.0 * (xs @ c.T)
+          + (c * c).sum(1)[None, :])
+    labels = np.argmin(d2, axis=1)
+    k, d = c.shape
+    sums = np.zeros((k, d), np.float64)
+    np.add.at(sums, labels, xs * ws[:, None])
+    counts = np.zeros(k, np.float64)
+    np.add.at(counts, labels, ws)
+    best = np.maximum(d2[np.arange(len(xs)), labels], 0.0)
+    return labels, sums, counts, best
+
+
+# ---------------------------------------------------------------------------
+# per-row argmin/argmax API (folded from matrix/argminmax.py)
+# ---------------------------------------------------------------------------
+
+
+def argmin(res, matrix):
+    """Index of the minimum of each row (ref: argmin.cuh). Tie-breaking:
+    smallest index wins, matching the reference's KVP atomics."""
+    return jnp.argmin(jnp.asarray(matrix), axis=1).astype(jnp.int32)
+
+
+def argmax(res, matrix):
+    """Index of the maximum of each row (ref: argmax.cuh)."""
+    return jnp.argmax(jnp.asarray(matrix), axis=1).astype(jnp.int32)

@@ -71,6 +71,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.core import trace
 from raft_tpu.linalg.contractions import _VMEM_BUDGET, _round_to_bf16_f32
+from raft_tpu.matrix.epilogue import (onehot_histogram, onehot_pair,
+                                      slot_onehot)
 from raft_tpu.util.math import cdiv, round_up_to_multiple
 from raft_tpu.util.pallas_utils import join_vma, out_struct, pallas_call
 
@@ -282,16 +284,10 @@ def _threshold_kernel(key_ref, t_ref, ntie_ref, hist, prefix, want, *,
     digit = (ukey >> shift) & jnp.int32(_NBINS - 1)
     hi = digit >> 4
     lo = digit & jnp.int32(15)
-    iota_h = jax.lax.broadcasted_iota(jnp.int32, (1, 16, 1), 1)
-    iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 16), 2)
-    ohhi = ((iota_h == hi[:, None, :]) & active[:, None, :]
-            ).astype(jnp.bfloat16)                       # (tm, 16, tl)
-    ohlo = (lo[:, :, None] == iota_l).astype(jnp.bfloat16)  # (tm,tl,16)
     # 0/1 bf16 operands, f32 accumulate: counts exact to 2^24 > MAX_LEN
-    hist[:] += jax.lax.dot_general(
-        ohhi, ohlo, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.DEFAULT)             # (tm, 16, 16)
+    # (the factorized 16x16 contraction is epilogue.onehot_histogram —
+    # one spelling shared with the emission's slot one-hots)
+    hist[:] += onehot_histogram(hi, lo, active)          # (tm, 16, 16)
 
     @pl.when(j == nch - 1)
     def _narrow():
@@ -319,8 +315,7 @@ def _threshold_kernel(key_ref, t_ref, ntie_ref, hist, prefix, want, *,
 
         hstar, below_h = pick(jnp.sum(h2, axis=2), wantf)
         want_l = wantf - below_h
-        ohsel = (jax.lax.broadcasted_iota(jnp.int32, (1, 16, 1), 1)
-                 == hstar[:, :, None]).astype(jnp.float32)
+        ohsel = slot_onehot(hstar, 16)
         lstar, below_l = pick(jnp.sum(h2 * ohsel, axis=1), want_l)
         prefix[:] = ((prefix[:] << jnp.int32(DIGIT_BITS))
                      | (hstar << 4) | lstar)
@@ -441,15 +436,13 @@ def _emit_chunk_body(key_ref, t_ref, out_ref, less_run, tie_run,
     p1 = _round_to_bf16_f32(r1)
     p2 = r1 - p1
 
-    iota_h = jax.lax.broadcasted_iota(jnp.int32, (1, kh, 1), 1)
-    iota_l = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 128), 2)
-    ohhi = (iota_h == hi[:, None, :]).astype(jnp.bfloat16)  # (tm, kh, tl)
+    # hi = -1 (no slot) matches no one-hot row — no active mask needed
+    ohhi, ohlo = onehot_pair(hi, lo, kh, 128)  # (tm,kh,tl) / (tm,tl,128)
     pb0 = p0.astype(jnp.bfloat16)[None, :, :]          # (1, 1, tl)
     pb1 = p1.astype(jnp.bfloat16)[None, :, :]
     pb2 = p2.astype(jnp.bfloat16)[None, :, :]
     a = jnp.concatenate([ohhi * pb0, ohhi * pb1, ohhi * pb2],
                         axis=1)                        # (tm, 3kh, tl)
-    ohlo = (lo[:, :, None] == iota_l).astype(jnp.bfloat16)  # (tm, tl, 128)
     slabs = jax.lax.dot_general(
         a, ohlo, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,

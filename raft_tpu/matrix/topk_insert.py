@@ -1,15 +1,13 @@
-"""Sorted-insertion top-k drain: the shared epilogue primitive behind the
-fused kNN kernel (neighbors/fused_topk.py) and the materialized-input
+"""Sorted-insertion top-k over a MATERIALIZED input — the
 ``insert_select`` path of matrix/select_k.
 
-The drain keeps the running best (val, idx) lanes SORTED ascending in one
-or two vregs per row. Each round a `lax.while_loop` extracts the per-row
-pool minimum and compare-shifts it into the sorted best (`pltpu.roll` +
-prefix mask); the while condition — "some row's pool still holds a value
-below that row's k-th bound" — is the gate, so a dead tile costs ZERO
-rounds and a tile with c improving candidates costs ~c rounds at full
-vector width. Worst case (rows sorted best-last) degrades to ~k rounds
-per tile — the k-round merge cost, never the pool width.
+The drain itself (the bound-gated sorted-insertion body, its strip-width
+contract, and the Mosaic legality notes that protect it) lives in the
+unified epilogue layer — :func:`raft_tpu.matrix.epilogue.insert_drain`
+(ISSUE 14) — shared with the fused kNN kernel
+(neighbors/fused_topk.py). This module keeps the materialized-input
+wrapper: the Pallas grid over (rows, columns) tiles, NaN padding, and
+the degenerate-row fallback.
 
 Reference lineage: the warpsort "filtered" insertion queues
 (matrix/detail/select_warpsort.cuh:129 — insert only when the candidate
@@ -18,13 +16,6 @@ machine whose selection primitive is VPU passes instead of warp
 shuffles. Hardware evidence for the shape: the kNN capture went
 1883 ms (gated k-round merges) -> 97.7 ms (this drain) at 1M x 128,
 q=4096, k=64 (tpu_battery_out/bench_full.jsonl, round 5).
-
-Mosaic legality notes (probed via ci/aot_compile.py): reduce-min +
-masked-iota argmin (contractions._mask_argmin rationale), `pltpu.roll`
-lane shifts across one and two vregs, `lax.while_loop` with (tm, tn)
-vector carries + i32 any-reduce condition; a (tm, 1)-index vector
-gather from the (tm, bw) best is NOT legal (same-shape operand rule),
-which is why the k-th bound is read by a masked one-lane reduce.
 """
 
 from __future__ import annotations
@@ -36,135 +27,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.matrix.epilogue import (LANES, MAX_K,  # noqa: F401
+                                      best_width, insert_drain,
+                                      resolve_tn_sw, row_min_arg)
 from raft_tpu.util.math import round_up_to_multiple
 from raft_tpu.util.pallas_utils import join_vma, out_struct, pallas_call
 
-LANES = 128
-MAX_K = 2 * LANES   # up to two vregs of sorted best per query row
-                    # (larger k takes the radix / tournament paths)
-
-
-def resolve_tn_sw(tn: int, sw: int, n: int):
-    """One spelling of the tile-width clamp + strip-width contract for
-    every drain consumer (knn_fused, insert_select): lane-align tn,
-    clamp it to the data width, and validate sw against the REQUESTED
-    tn — an sw that never divided the caller's tn is an error, while
-    indivisibility introduced only by the small-data clamp degrades to
-    the whole-tile drain (a perf knob must not error on small inputs).
-    Returns (tn, sw)."""
-    tn_req = max(128, tn - tn % 128)        # caller's lane-aligned ask
-    tn = min(tn_req, round_up_to_multiple(n, 128))
-    if sw and (sw < 0 or sw % 128 or tn_req % sw):
-        raise ValueError(f"sw must be a positive lane-aligned divisor "
-                         f"of tn={tn_req}")
-    if sw and tn % sw:
-        sw = 0                  # clamp-induced indivisibility only
-    return tn, sw
-
-
-def best_width(k: int) -> int:
-    """Lane-aligned width of the sorted-best buffer: one vreg for
-    k <= 128, two for k <= 256 (insert cost scales with the width, so
-    the buffer is as narrow as k allows)."""
-    return LANES * ((k + LANES - 1) // LANES)
-
-
-def row_min_arg(pool, col):
-    """Per-row (min, first-min argmin) of a (tm, tn) pool — reduce-min +
-    masked-iota, the Mosaic-safe argmin spelling (see
-    contractions._mask_argmin for why lax.argmin is not used)."""
-    pm = jnp.min(pool, axis=1, keepdims=True)
-    sentinel = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
-    pidx = jnp.min(jnp.where(pool == pm, col, sentinel), axis=1,
-                   keepdims=True)
-    return pm, pidx
-
-
-def insertion_topk_body(dist, val_ref, idx_ref, j, tn: int, k: int,
-                        n_valid: int, sw: int = 0):
-    """Drain a (tm, tn) candidate tile into the sorted (tm, bw) best.
-
-    Each round: per-row pool min + first-min argmin (smallest column
-    wins ties), consume that lane, and for rows where the minimum beats
-    their k-th bound, compare-shift it into the sorted best. Rows whose
-    pool holds nothing below their bound extract dead mins into a
-    guarded no-op — progress is global (every looping row consumes one
-    lane per round), and the loop exits when no row can improve. Tie
-    contract (smallest index wins globally): within a tile the first-min
-    argmin inserts equal values in column order; across tiles, earlier
-    insertions win because ``keep = best <= candidate`` leaves existing
-    entries to the left of an equal newcomer.
-
-    ``sw`` (strip width, 0 = whole tile): drain the tile in static
-    lane-aligned strips so the per-round vector work is O(tm·sw) while
-    the producer tile keeps its full width — the tile width and the
-    drain width are INDEPENDENT knobs. Round count is unchanged (a
-    candidate is a candidate in any strip); only the dead-lane
-    extraction width shrinks. Strips see ascending global columns,
-    preserving the tie contract.
-
-    NaN candidates are mapped to +inf HERE, for every producer: a NaN
-    pool minimum would match no lane (nothing consumed) and the while
-    loop could spin forever on the DEVICE while any finite candidate
-    sits below the bound — a hang, not a wrong answer. One compare+
-    select per tile element buys termination; +inf is the drain's own
-    never-selected sentinel (NaN sorts last)."""
-    tm = dist.shape[0]
-    dist = jnp.where(jnp.isnan(dist), jnp.asarray(jnp.inf, jnp.float32),
-                     dist)
-    bw = best_width(k)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (tm, bw), 1)
-    inf = jnp.asarray(jnp.inf, jnp.float32)
-
-    @pl.when(j == 0)
-    def _init():
-        val_ref[:] = jnp.full((tm, bw), jnp.inf, jnp.float32)
-        idx_ref[:] = jnp.zeros((tm, bw), jnp.int32)
-
-    def kth(bv):
-        # masked one-lane reduce: a (tm, 1)-index gather from (tm, bw)
-        # is not Mosaic-legal (same-shape operand rule)
-        return jnp.min(jnp.where(lane == k - 1, bv, inf), axis=1,
-                       keepdims=True)
-
-    def cond(carry):
-        pool, bv, _ = carry
-        # i32 max, not bool any: jnp.any's bool proxy reduces through
-        # f64 under jax_enable_x64 and fails Mosaic lowering
-        # (radix_select precedent)
-        return jnp.max((pool < kth(bv)).astype(jnp.int32)) > 0
-
-    def drain(pool, col_g, bv, bi):
-        def body(carry):
-            pool, bv, bi = carry
-            pm, pidx = row_min_arg(pool, col_g)
-            pool = jnp.where(col_g == pidx, inf, pool)  # consume lane
-            improving = pm < kth(bv)
-            keep = bv <= pm                 # prefix mask (sorted best)
-            pos = jnp.sum(keep.astype(jnp.int32), axis=1, keepdims=True)
-            shv = pltpu.roll(bv, 1, axis=1)
-            shi = pltpu.roll(bi, 1, axis=1)
-            nv = jnp.where(lane < pos, bv,
-                           jnp.where(lane == pos, pm, shv))
-            ni = jnp.where(lane < pos, bi,
-                           jnp.where(lane == pos, pidx, shi))
-            bv = jnp.where(improving, nv, bv)
-            bi = jnp.where(improving, ni, bi)
-            return pool, bv, bi
-
-        _, bv, bi = jax.lax.while_loop(cond, body, (pool, bv, bi))
-        return bv, bi
-
-    sw = sw or tn
-    bv, bi = val_ref[:], idx_ref[:]
-    for s in range(0, tn, sw):              # static: unrolled strips
-        strip = dist[:, s:s + sw]
-        col_g = (jax.lax.broadcasted_iota(jnp.int32, strip.shape, 1)
-                 + j * tn + s)
-        pool = jnp.where(col_g < n_valid, strip, inf)
-        bv, bi = drain(pool, col_g, bv, bi)
-    val_ref[:] = bv
-    idx_ref[:] = bi
+# Back-compat alias: the drain body kept this name until it moved into
+# the epilogue layer (fused_topk / external tune harnesses import it).
+insertion_topk_body = insert_drain
 
 
 # ---------------------------------------------------------------------------

@@ -21,6 +21,7 @@ from jax import lax
 
 from raft_tpu.core import trace
 from raft_tpu.linalg.contractions import pairwise_pallas
+from raft_tpu.matrix.epilogue import masked_topk
 from raft_tpu.util.math import cdiv, round_up_to_multiple
 from raft_tpu.util.precision import with_matmul_precision
 
@@ -104,11 +105,11 @@ def _knn_scan(queries, db, k: int, tile: int, metric: str, n_valid=None):
         tile_db, off = inp
         dist = _tile_distances(queries, tile_db, metric)
         col = lax.broadcasted_iota(jnp.int32, dist.shape, 1) + off
-        # mask padded db rows out of the tournament
-        dist = jnp.where(col < n_valid, dist, jnp.inf)
-        tv, tp = lax.top_k(-dist, k)                  # tile top-k (min)
+        # padded db rows masked out of the tournament by the shared
+        # scoring epilogue (epilogue.masked_topk); tile top-k (min)
+        tv, tp = masked_topk(dist, col < n_valid, k, use_radix=False)
         ti = jnp.take_along_axis(col, tp, axis=1)
-        pool_v = jnp.concatenate([best_v, -tv], axis=1)
+        pool_v = jnp.concatenate([best_v, tv], axis=1)
         pool_i = jnp.concatenate([best_i, ti], axis=1)
         mv, mp = lax.top_k(-pool_v, k)
         return (-mv, jnp.take_along_axis(pool_i, mp, axis=1)), None
@@ -153,8 +154,6 @@ def _knn_chunked(queries, db, k: int, chunk: int, metric: str,
     lax.top_k ~50x under the bandwidth roofline in this regime — the
     per-TILE top_k of the scan path was the old bottleneck), then merge
     into the running best via one cheap (q, 2k) top_k."""
-    from raft_tpu.matrix.radix_select import radix_select_k
-
     q, d = queries.shape
     n = db.shape[0]
     if n_valid is None:
@@ -176,8 +175,7 @@ def _knn_chunked(queries, db, k: int, chunk: int, metric: str,
         tile_db, off = inp
         dist = _tile_distances(queries, tile_db, metric)
         col = lax.broadcasted_iota(jnp.int32, dist.shape, 1) + off
-        dist = jnp.where(col < n_valid, dist, jnp.inf)
-        tv, tp = radix_select_k(dist, k)
+        tv, tp = masked_topk(dist, col < n_valid, k, use_radix=True)
         pool_v = jnp.concatenate([best_v, tv], axis=1)
         pool_i = jnp.concatenate([best_i, tp + off], axis=1)
         mv, mp = lax.top_k(-pool_v, k)

@@ -61,12 +61,12 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.linalg.contractions import (_metric_tile, _metric_tile_split,
                                           _pad2, _split_operands,
                                           _use_split)
-from raft_tpu.matrix.topk_insert import (LANES, MAX_K,
-                                         best_width as _best_width,
-                                         insertion_topk_body as
-                                         _topk_body,
-                                         resolve_tn_sw,
-                                         row_min_arg as _row_min_arg)
+from raft_tpu.matrix.epilogue import (LANES, MAX_K,  # noqa: F401
+                                      best_width as _best_width,
+                                      insert_drain as _topk_body,
+                                      masked_fold as _masked_fold,
+                                      resolve_tn_sw,
+                                      row_min_arg as _row_min_arg)
 from raft_tpu.util.math import round_up_to_multiple
 from raft_tpu.util.pallas_utils import (join_vma, out_struct, pallas_call)
 
@@ -185,17 +185,11 @@ def _minonly_body(dist, val_ref, idx_ref, j, tn: int, n_valid: int):
     pool = jnp.where(col < n_valid, dist,
                      jnp.asarray(jnp.inf, jnp.float32))
     pm, pidx = _row_min_arg(pool, col)
-
-    @pl.when(j == 0)
-    def _init():
-        val_ref[:] = jnp.full(val_ref.shape, jnp.inf, jnp.float32)
-        idx_ref[:] = jnp.zeros(idx_ref.shape, jnp.int32)
-
     # outputs ride (1, tm) blocks — tm on lanes, the proven _lloyd_kernel
-    # layout (a 1-wide lane dim forces degenerate vreg tiling)
-    better = pm.T < val_ref[:]
-    val_ref[:] = jnp.where(better, pm.T, val_ref[:])
-    idx_ref[:] = jnp.where(better, pidx.T, idx_ref[:])
+    # layout (a 1-wide lane dim forces degenerate vreg tiling); the
+    # init-then-fold is epilogue.masked_fold (pidx is already global:
+    # offset 0)
+    _masked_fold(val_ref, idx_ref, pm, pidx, 0)
 
 
 def _minonly_kernel(x_ref, y_ref, val_ref, idx_ref, *, tn: int,
@@ -288,13 +282,17 @@ def epilogue(k: int) -> str:
 
 
 def knn_fused(queries, db, k: int, metric: str = "l2",
-              tm: int = 256, tn: int = 1024, sw: int = 0):
+              tm: int = 256, tn: int = 1024, sw=None):
     """Fused-kernel kNN: (vals [q, k], idx [q, k]), nearest first.
 
     Callers dispatch here for k <= 256 on the compiled backend (see
     brute_force.knn); inputs are f32 (cast by the caller), metric is the
     kernel vocabulary ('l2' squared / 'cosine' / 'inner'). ``sw`` sets
-    the drain-strip width (0 = whole tile; see _topk_body)."""
+    the drain-strip width (0 = whole tile; None picks the spent
+    epilogue lever — epilogue.DRAIN_SW when it divides the tile — which
+    cuts the per-round drain extraction ~4x at the default tn=1024; see
+    epilogue.insert_drain and the DRAIN_SW cost model). Output is
+    identical for ANY sw (same candidate set, same tie contract)."""
     q, d = queries.shape
     n = db.shape[0]
     tm = min(tm, round_up_to_multiple(q, 8))

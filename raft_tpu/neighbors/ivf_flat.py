@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core import trace
+from raft_tpu.matrix.epilogue import masked_topk
 from raft_tpu.matrix.gather import take_rows
 from raft_tpu.util import precision
 from raft_tpu.util.math import round_up_to_multiple
@@ -285,14 +286,9 @@ def _probe_topk(queries, centroids, packed_db, packed_ids, starts,
                     + jnp.sum(q * q, axis=1)[:, None])
         else:
             dist = -ipf
-        dist = jnp.where(valid, dist, jnp.inf)
-        if use_radix:
-            from raft_tpu.matrix.radix_select import radix_select_k
-
-            vals, pos = radix_select_k(dist, k)
-        else:
-            neg, pos = lax.top_k(-dist, k)
-            vals = -neg
+        # masked scoring epilogue: one spelling shared with the chunked
+        # kNN formulations (epilogue.masked_topk, ISSUE 14)
+        vals, pos = masked_topk(dist, valid, k, use_radix=use_radix)
         out_ids = jnp.take_along_axis(ids, pos, axis=1)
         # pad-slot picks (underfull candidate rows) -> id -1, dist +inf
         out_ids = jnp.where(jnp.isfinite(vals), out_ids, -1)

@@ -1,0 +1,390 @@
+"""ISSUE 14 bit-identity matrix for the unified epilogue layer.
+
+Two tiers:
+
+* primitive oracles — each epilogue primitive against the exact
+  pre-refactor spelling it replaced (jax.lax.argmin, jax.nn.one_hot,
+  the inline iota-compare one-hots, the elastic fit's numpy body),
+  bitwise where the refactor claims expression identity;
+* consumer witnesses — each rewired consumer (kmeans single / mnmg,
+  fused + chunked-radix kNN, IVF full-probe, dense + CSR select_k)
+  against an independent oracle, including tie and NaN rows, plus the
+  strip-width invariance contract (any ``sw`` is output-identical).
+
+Wired into ci/smoke.sh as the refactor's regression gate.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.matrix import epilogue
+from raft_tpu.matrix.epilogue import (argmin_ref, assign_onehot,
+                                      host_assign_update, insert_drain_ref,
+                                      iota_argmin, label_onehot,
+                                      masked_fold_ref, masked_topk,
+                                      onehot_histogram, onehot_histogram_ref,
+                                      onehot_pair, resolve_tn_sw,
+                                      row_min_arg, slot_onehot)
+
+
+def _tie_nan_block(m=16, n=96, seed=0, with_nan=True):
+    """Distance-like block with exact-tie rows and (optionally) NaN."""
+    rng = np.random.default_rng(seed)
+    d = rng.normal(size=(m, n)).astype(np.float32)
+    d[1, 10] = d[1, 70] = d[1].min() - 1.0    # exact tie, two columns
+    d[2, :] = 3.25                            # whole row tied
+    if with_nan:
+        d[3, 5] = np.nan                      # NaN among finite
+        d[4, :4] = np.nan                     # NaNs then finite
+    return d
+
+
+class TestPrimitiveOracles:
+    def test_iota_argmin_matches_lax_argmin(self):
+        d = jnp.asarray(_tie_nan_block())
+        ref_val, ref_arg = argmin_ref(d)
+        col, minval, arg = iota_argmin(d, d.shape[1])
+        assert col.shape == d.shape
+        np.testing.assert_array_equal(np.asarray(minval[:, 0]),
+                                      np.asarray(ref_val))
+        np.testing.assert_array_equal(np.asarray(arg[:, 0]),
+                                      np.asarray(ref_arg))
+
+    def test_iota_argmin_traced_n_valid(self):
+        d = jnp.asarray(_tie_nan_block(with_nan=False))
+        n_valid = jnp.int32(d.shape[1] - 7)
+        _, minval, arg = iota_argmin(d, n_valid)
+        masked = jnp.where(jnp.arange(d.shape[1])[None, :] < n_valid,
+                           d, jnp.inf)
+        ref_val, ref_arg = argmin_ref(masked)
+        np.testing.assert_array_equal(np.asarray(minval[:, 0]),
+                                      np.asarray(ref_val))
+        np.testing.assert_array_equal(np.asarray(arg[:, 0]),
+                                      np.asarray(ref_arg))
+
+    def test_iota_argmin_finite_flag_identical_on_finite(self):
+        d = jnp.asarray(_tie_nan_block(with_nan=False))
+        _, mv0, a0 = iota_argmin(d, d.shape[1], finite=False)
+        _, mv1, a1 = iota_argmin(d, d.shape[1], finite=True)
+        np.testing.assert_array_equal(np.asarray(mv0), np.asarray(mv1))
+        np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+
+    def test_row_min_arg_first_min_ties(self):
+        pool = jnp.asarray(_tie_nan_block(with_nan=False))
+        col = jax.lax.broadcasted_iota(jnp.int32, pool.shape, 1)
+        pm, pidx = row_min_arg(pool, col)
+        ref_val, ref_arg = argmin_ref(pool)
+        np.testing.assert_array_equal(np.asarray(pm[:, 0]),
+                                      np.asarray(ref_val))
+        np.testing.assert_array_equal(np.asarray(pidx[:, 0]),
+                                      np.asarray(ref_arg))
+
+    def test_label_onehot_matches_jax_nn_one_hot(self):
+        rng = np.random.default_rng(1)
+        labels = jnp.asarray(rng.integers(0, 9, size=64), jnp.int32)
+        # out-of-range sentinel (the padded-row convention): zero row
+        labels = labels.at[5].set(8)
+        for dtype in (jnp.float32, jnp.bfloat16):
+            got = label_onehot(labels, 8, dtype=dtype)
+            want = jax.nn.one_hot(labels, 8, dtype=dtype)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+        mask = jnp.asarray(rng.integers(0, 2, size=64), bool)
+        got = label_onehot(labels, 8, mask=mask)
+        want = jax.nn.one_hot(labels, 8) * mask[:, None]
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_assign_onehot_shared_iota_vs_one_hot(self):
+        d = jnp.asarray(_tie_nan_block(with_nan=False))
+        col, _, arg = iota_argmin(d, d.shape[1])
+        got = assign_onehot(col, arg).astype(jnp.float32)
+        want = jax.nn.one_hot(jax.lax.argmin(d, 1, jnp.int32),
+                              d.shape[1])
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        row_mask = (jnp.arange(d.shape[0]) < 10)[:, None]
+        got = assign_onehot(col, arg, row_mask).astype(jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want * row_mask))
+
+    def test_onehot_histogram_matches_ref_and_bincount(self):
+        rng = np.random.default_rng(2)
+        tm, tl = 8, 256
+        hi = jnp.asarray(rng.integers(0, 16, size=(tm, tl)), jnp.int32)
+        lo = jnp.asarray(rng.integers(0, 16, size=(tm, tl)), jnp.int32)
+        active = jnp.asarray(rng.integers(0, 2, size=(tm, tl)), bool)
+        got = onehot_histogram(hi, lo, active)
+        ref = onehot_histogram_ref(hi, lo, active)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        digit = (np.asarray(hi) * 16 + np.asarray(lo))
+        act = np.asarray(active)
+        for r in range(tm):
+            want = np.bincount(digit[r][act[r]], minlength=256)
+            np.testing.assert_array_equal(
+                np.asarray(got)[r].reshape(-1), want.astype(np.float32))
+
+    def test_onehot_pair_sentinel_matches_no_row(self):
+        hi = jnp.asarray([[-1, 3]], jnp.int32)     # -1: emitted-slot mark
+        lo = jnp.asarray([[0, 5]], jnp.int32)
+        ohhi, ohlo = onehot_pair(hi, lo, 16, 16)
+        assert float(jnp.sum(ohhi[:, :, 0])) == 0.0
+        assert float(jnp.sum(ohhi[:, :, 1])) == 1.0
+        assert float(jnp.sum(ohlo)) == 2.0
+
+    def test_slot_onehot(self):
+        idx = jnp.asarray([[3], [0]], jnp.int32)
+        oh = slot_onehot(idx, 16)
+        assert oh.shape == (2, 16, 1)
+        np.testing.assert_array_equal(
+            np.asarray(oh[:, :, 0]),
+            np.asarray(jax.nn.one_hot(idx[:, 0], 16)))
+
+    def test_masked_fold_ref_tie_keeps_earlier(self):
+        bv = jnp.asarray([1.0, 5.0], jnp.float32)
+        bi = jnp.asarray([7, 7], jnp.int32)
+        nv, ni = masked_fold_ref(bv, bi, jnp.asarray([1.0, 4.0]),
+                                 jnp.asarray([2, 2], jnp.int32), 100)
+        # strict <: the tied newcomer (val 1.0, idx 102) loses to idx 7
+        np.testing.assert_array_equal(np.asarray(nv), [1.0, 4.0])
+        np.testing.assert_array_equal(np.asarray(ni), [7, 102])
+
+    def test_insert_drain_ref_ties_and_nan(self):
+        v = _tie_nan_block()
+        vals, idx = insert_drain_ref(v, 4)
+        clean = np.where(np.isnan(v), np.inf, v)
+        order = np.argsort(clean, axis=1, kind="stable")[:, :4]
+        np.testing.assert_array_equal(np.asarray(idx), order)
+        np.testing.assert_array_equal(
+            np.asarray(vals), np.take_along_axis(clean, order, axis=1))
+
+    def test_host_assign_update_matches_inline_spelling(self):
+        rng = np.random.default_rng(3)
+        xs = rng.normal(size=(64, 8))
+        ws = rng.uniform(0.5, 2.0, size=64)
+        c = rng.normal(size=(5, 8))
+        labels, sums, counts, best = host_assign_update(xs, ws, c)
+        # the exact pre-refactor elastic body
+        d2 = ((xs * xs).sum(1)[:, None] - 2.0 * (xs @ c.T)
+              + (c * c).sum(1)[None, :])
+        want_labels = np.argmin(d2, axis=1)
+        want_sums = np.zeros((5, 8), np.float64)
+        np.add.at(want_sums, want_labels, xs * ws[:, None])
+        want_counts = np.zeros(5, np.float64)
+        np.add.at(want_counts, want_labels, ws)
+        np.testing.assert_array_equal(labels, want_labels)
+        np.testing.assert_array_equal(sums, want_sums)
+        np.testing.assert_array_equal(counts, want_counts)
+        np.testing.assert_array_equal(
+            best, np.maximum(d2[np.arange(64), want_labels], 0.0))
+
+    def test_resolve_tn_sw_contract(self):
+        # sw=None picks the spent lever when it divides the request
+        assert resolve_tn_sw(1024, None, 10_000) == (1024, epilogue.DRAIN_SW)
+        assert resolve_tn_sw(2048, None, 10_000) == (2048, epilogue.DRAIN_SW)
+        # ... and degrades to whole-tile when it cannot strip the ask
+        assert resolve_tn_sw(128, None, 10_000) == (128, 0)
+        # clamp-induced indivisibility degrades instead of erroring
+        assert resolve_tn_sw(2048, None, 384) == (384, 0)
+        assert resolve_tn_sw(2048, 256, 384) == (384, 0)
+        # an sw that never divided the caller's ask is an error
+        with pytest.raises(ValueError):
+            resolve_tn_sw(128, 256, 10_000)
+        with pytest.raises(ValueError):
+            resolve_tn_sw(1024, 100, 10_000)
+
+    def test_argminmax_shim_reexports(self):
+        from raft_tpu.matrix import argminmax
+
+        assert argminmax.argmin is epilogue.argmin
+        assert argminmax.argmax is epilogue.argmax
+        m = jnp.asarray([[3.0, 1.0, 1.0], [0.0, 2.0, -5.0]])
+        np.testing.assert_array_equal(
+            np.asarray(argminmax.argmin(None, m)), [1, 2])
+        np.testing.assert_array_equal(
+            np.asarray(argminmax.argmax(None, m)), [0, 1])
+
+
+class TestConsumerBitIdentity:
+    def test_insert_select_sw_invariance_and_ref(self):
+        """Dense select_k drain path: any strip width is bit-identical,
+        and matches the first-index-tie / NaN-sorts-last oracle."""
+        from raft_tpu.matrix.topk_insert import insert_select
+
+        rng = np.random.default_rng(4)
+        v = rng.normal(size=(16, 512)).astype(np.float32)
+        v[0, 100] = v[0, 400] = v[0].min() - 1.0     # cross-strip tie
+        v[1, 7] = np.nan                              # NaN never inserts
+        v[2, :] = 1.5                                 # fully tied row
+        ref_v, ref_i = insert_drain_ref(v, 5)
+        outs = [insert_select(jnp.asarray(v), 5, tn=512, sw=sw)
+                for sw in (0, 128, 256)]
+        for vals, idx in outs:
+            np.testing.assert_array_equal(np.asarray(vals),
+                                          np.asarray(ref_v))
+            np.testing.assert_array_equal(np.asarray(idx),
+                                          np.asarray(ref_i))
+
+    def test_knn_fused_sw_invariance(self):
+        """The spent drain lever (sw=None -> DRAIN_SW) is output-
+        identical to the whole-tile drain, duplicates and all."""
+        from raft_tpu.neighbors.fused_topk import knn_fused
+
+        rng = np.random.default_rng(5)
+        q = rng.normal(size=(8, 16)).astype(np.float32)
+        db = rng.normal(size=(300, 16)).astype(np.float32)
+        db[250] = db[3]                  # duplicate: smallest index wins
+        v0, i0 = knn_fused(q, db, 4, tn=256, sw=0)
+        v1, i1 = knn_fused(q, db, 4, tn=256, sw=128)
+        vd, idd = knn_fused(q, db, 4, tn=256)        # sw=None -> 256
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(vd))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(idd))
+        assert not np.any(np.asarray(i0) == 250)     # 3 wins the tie
+
+    def test_knn_chunked_matches_scan_indices(self):
+        """masked_topk rewire: the chunked-radix and scan formulations
+        agree with the numpy oracle on the same inputs."""
+        from raft_tpu.neighbors.brute_force import _knn_chunked, _knn_scan
+
+        rng = np.random.default_rng(6)
+        q = rng.normal(size=(4, 12)).astype(np.float32)
+        db = rng.normal(size=(700, 12)).astype(np.float32)
+        d2 = ((q ** 2).sum(1)[:, None] - 2.0 * q @ db.T
+              + (db ** 2).sum(1)[None, :])
+        want = np.argsort(d2, axis=1, kind="stable")[:, :5]
+        _, i_scan = _knn_scan(jnp.asarray(q), jnp.asarray(db), 5, 256,
+                              "l2")
+        _, i_chunk = _knn_chunked(jnp.asarray(q), jnp.asarray(db), 5,
+                                  256, "l2")
+        np.testing.assert_array_equal(np.asarray(i_scan), want)
+        np.testing.assert_array_equal(np.asarray(i_chunk), want)
+
+    def test_ivf_full_probe_matches_brute_force(self):
+        """IVF-Flat probe epilogue (masked_topk): full probe == exact."""
+        import raft_tpu
+        from raft_tpu.neighbors import brute_force, ivf_flat
+
+        res = raft_tpu.device_resources(seed=0)
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(512, 16)).astype(np.float32)
+        idx = ivf_flat.build(res, X, 8, seed=0, max_iter=4)
+        _, ivf_i = ivf_flat.search(res, idx, X[:16], k=5, nprobe=8)
+        _, bf_i = brute_force.knn(res, X, X[:16], k=5)
+        for r in range(16):
+            assert set(np.asarray(ivf_i)[r]) == set(np.asarray(bf_i)[r])
+
+    def test_select_k_csr_matches_dense_rows(self):
+        """CSR select_k rides the same dense epilogue: bit-identical to
+        dense select_k over the materialized padded rows."""
+        import scipy.sparse as sp
+
+        import raft_tpu
+        from raft_tpu.core.sparse_types import CSRMatrix
+        from raft_tpu.matrix import select_k as dense_select_k
+        from raft_tpu.sparse.matrix import select_k as csr_select_k
+
+        res = raft_tpu.device_resources(seed=0)
+        rng = np.random.default_rng(8)
+        dense = rng.normal(size=(32, 64)).astype(np.float32)
+        dense[dense > 0.4] = 0.0                     # sparsify
+        dense[3, 10] = dense[3, 50] = dense[3].min() - 1.0   # tie row
+        dense[5, :] = 0.0
+        dense[5, 2] = -1.0                           # short row (1 nnz)
+        csr = CSRMatrix.from_scipy(sp.csr_matrix(dense))
+        vals, idx = csr_select_k(res, csr, 3)
+        # materialize exactly what the CSR path scatters, run dense
+        padded = np.full((32, max(int(np.diff(csr.indptr).max()), 3)),
+                         np.inf, np.float32)
+        cols = np.full_like(padded, -1, dtype=np.int64)
+        for r in range(32):
+            nz = np.flatnonzero(dense[r])
+            padded[r, :len(nz)] = dense[r, nz]
+            cols[r, :len(nz)] = nz
+        dv, dp = dense_select_k(res, jnp.asarray(padded), 3)
+        np.testing.assert_array_equal(np.asarray(vals), np.asarray(dv))
+        want_idx = np.take_along_axis(cols, np.asarray(dp), axis=1)
+        want_idx[np.asarray(vals) == np.inf] = -1
+        np.testing.assert_array_equal(np.asarray(idx), want_idx)
+
+    def test_kmeans_fit_matches_numpy_lloyd(self):
+        """Single-rank consumer: the shared-iota assignment + one-hot
+        update reproduces the numpy Lloyd iteration label-for-label."""
+        import raft_tpu
+        from raft_tpu.cluster.kmeans import (KMeansInit, KMeansParams,
+                                             kmeans_fit)
+
+        res = raft_tpu.device_resources(seed=0)
+        rng = np.random.default_rng(9)
+        X = np.concatenate([rng.normal(loc=4 * i, size=(64, 8))
+                            for i in range(3)]).astype(np.float32)
+        init = X[[0, 64, 128]]
+        params = KMeansParams(n_clusters=3, init=KMeansInit.ARRAY,
+                              max_iter=5, tol=0.0, seed=0)
+        c, inertia, labels, _ = kmeans_fit(res, params, X,
+                                           centroids=init)
+        cn = init.astype(np.float64)
+        for _ in range(5):
+            d2 = ((X ** 2).sum(1)[:, None] - 2.0 * X @ cn.T
+                  + (cn ** 2).sum(1)[None, :])
+            want_labels = d2.argmin(1)
+            cn = np.stack([X[want_labels == i].mean(0)
+                           for i in range(3)])
+        np.testing.assert_array_equal(np.asarray(labels), want_labels)
+        np.testing.assert_allclose(np.asarray(c), cn, atol=1e-3)
+
+    def test_mnmg_block_onehot_spelling(self):
+        """The mnmg model-axis block update's label_onehot call is the
+        exact pre-refactor inline spelling, bit for bit."""
+        rng = np.random.default_rng(10)
+        kb = 8
+        local = jnp.asarray(rng.integers(0, 2 * kb, size=128), jnp.int32)
+        in_block = (local >= 0) & (local < kb)
+        got = label_onehot(local, kb, mask=in_block,
+                           dtype=jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, (128, kb), 1)
+        want = ((col == local[:, None])
+                & in_block[:, None]).astype(jnp.float32)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_kmeans_mnmg_step_matches_oracle(self, mesh8):
+        """mnmg consumer: shard_map Lloyd step over the 2-D mesh lands
+        the numpy labels exactly (the label_onehot rewire)."""
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from raft_tpu.cluster.kmeans import mnmg_lloyd_step
+
+        devs = np.asarray(jax.devices()[:8]).reshape(4, 2)
+        mesh = Mesh(devs, axis_names=("data", "model"))
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(256, 16)).astype(np.float32)
+        C = rng.normal(size=(8, 16)).astype(np.float32)
+
+        def step(x, cblk):
+            return mnmg_lloyd_step(x, cblk, n_clusters=8,
+                                   data_axis="data", model_axis="model")
+
+        f = jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(P("data"), P("model")),
+            out_specs=(P("model"), P(), P("data")), check_vma=False))
+        _, _, labels = f(X, C)
+        d2 = ((X ** 2).sum(1)[:, None] - 2.0 * X @ C.T
+              + (C ** 2).sum(1)[None, :])
+        np.testing.assert_array_equal(np.asarray(labels), d2.argmin(1))
+
+    def test_masked_topk_radix_parity(self):
+        """use_radix routing: both spellings select the same elements
+        under a validity mask (value parity; radix emits its own
+        tie order within equal values)."""
+        rng = np.random.default_rng(12)
+        d = jnp.asarray(rng.normal(size=(8, 1024)).astype(np.float32))
+        valid = jax.lax.broadcasted_iota(jnp.int32, d.shape, 1) < 1000
+        v_top, i_top = masked_topk(d, valid, 6, use_radix=False)
+        v_rad, i_rad = masked_topk(d, valid, 6, use_radix=True)
+        np.testing.assert_allclose(np.asarray(v_top), np.asarray(v_rad),
+                                   rtol=0, atol=0)
+        np.testing.assert_array_equal(np.sort(np.asarray(i_top), 1),
+                                      np.sort(np.asarray(i_rad), 1))
+        assert int(jnp.max(i_rad)) < 1000

@@ -8,6 +8,7 @@ from tools.raftlint.rules.r5_offpath import OffPathPurityRule
 from tools.raftlint.rules.r6_obs_imports import ObsBoundaryRule
 from tools.raftlint.rules.r7_env import EnvDisciplineRule
 from tools.raftlint.rules.r8_numeric import NumericHygieneRule
+from tools.raftlint.rules.r9_epilogue import EpilogueLayerRule
 
 ALL_RULES = (
     JitPurityRule,
@@ -18,6 +19,7 @@ ALL_RULES = (
     ObsBoundaryRule,
     EnvDisciplineRule,
     NumericHygieneRule,
+    EpilogueLayerRule,
 )
 
 __all__ = ["ALL_RULES"]

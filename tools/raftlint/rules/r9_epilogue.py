@@ -1,0 +1,122 @@
+"""R9: epilogue primitives live in matrix/epilogue.py — nowhere else.
+
+ISSUE 14 deleted the hand-rolled copies of the iota-compare argmin and
+the one-hot construction machinery (kmeans' mnmg block one-hot,
+radix_select's histogram/emission one-hots, the fused-kNN drain's
+argmin) and moved the single implementation into
+``raft_tpu.matrix.epilogue``. This rule keeps that duplication deleted:
+outside the epilogue module, raft_tpu code must not
+
+- build a one-hot by wrapping an inline ``jax.lax.broadcasted_iota``
+  equality compare in ``.astype(...)`` (the one-hot histogram /
+  assignment spelling — use ``epilogue.assign_onehot`` /
+  ``label_onehot`` / ``onehot_pair`` / ``onehot_histogram``);
+- call ``jax.nn.one_hot`` (use ``epilogue.label_onehot`` — same 0/1
+  output, one reviewed spelling, and the out-of-range-label contract
+  is documented there);
+- call ``jax.lax.argmin`` / ``jax.lax.argmax`` (use
+  ``epilogue.argmin_ref`` on reference paths and
+  ``epilogue.iota_argmin`` in kernels — lax.argmin's variadic-reduce
+  lowering fails Mosaic legalization, so a stray call is either a
+  future kernel bug or a reference path drifting off the shared tie
+  contract).
+
+Plain iota arithmetic (column masks, offsets, triangular masks,
+ordered compares) stays legal everywhere — only the astype-wrapped
+EQUALITY compare of an inline iota is the one-hot idiom this rule
+polices.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.raftlint.core import Finding, ModuleInfo, Project
+from tools.raftlint.rules.base import Rule
+
+ALLOWED = ("raft_tpu.matrix.epilogue",)
+BANNED_CALLS = {
+    "jax.nn.one_hot": (
+        "jax.nn.one_hot outside the epilogue layer",
+        "use raft_tpu.matrix.epilogue.label_onehot"),
+    "jax.lax.argmin": (
+        "jax.lax.argmin outside the epilogue layer",
+        "use epilogue.argmin_ref (reference) / epilogue.iota_argmin "
+        "(kernels — lax.argmin fails Mosaic legalization)"),
+    "jax.lax.argmax": (
+        "jax.lax.argmax outside the epilogue layer",
+        "use the epilogue argmin family on negated values"),
+}
+
+
+def _in_scope(modname: str) -> bool:
+    return (modname.startswith("raft_tpu.")
+            and modname not in ALLOWED)
+
+
+def _has_inline_iota_eq(mod: ModuleInfo, node: ast.AST) -> bool:
+    """An equality Compare anywhere under ``node`` with an inline
+    jax.lax.broadcasted_iota call in its subtree — the hand-rolled
+    one-hot construction."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Compare):
+            continue
+        if not any(isinstance(op, ast.Eq) for op in sub.ops):
+            continue
+        for part in ast.walk(sub):
+            if (isinstance(part, ast.Call)
+                    and mod.resolve(part.func)
+                    == "jax.lax.broadcasted_iota"):
+                return True
+    return False
+
+
+class EpilogueLayerRule(Rule):
+    id = "R9"
+    summary = ("argmin / one-hot epilogue machinery re-rolled outside "
+               "matrix/epilogue.py")
+    rationale = ("ISSUE 14 unified the iota-argmin, one-hot, and drain "
+                 "epilogues into one measured module so levers land in "
+                 "every consumer at once — a re-rolled copy silently "
+                 "stops receiving them and re-opens the tie/NaN "
+                 "contract drift the bit-identity gates closed")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for mod in project.modules.values():
+            if not _in_scope(mod.modname):
+                continue
+            for sym, node in self._walk(mod):
+                if not isinstance(node, ast.Call):
+                    continue
+                fq = mod.resolve(node.func)
+                if fq in BANNED_CALLS:
+                    msg, hint = BANNED_CALLS[fq]
+                    findings.append(Finding(
+                        self.id, mod.relpath, node.lineno,
+                        node.col_offset, sym, msg, hint))
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "astype"
+                        and _has_inline_iota_eq(mod, node.func.value)):
+                    findings.append(Finding(
+                        self.id, mod.relpath, node.lineno,
+                        node.col_offset, sym,
+                        "hand-rolled one-hot (astype of an inline "
+                        "broadcasted_iota equality compare) outside "
+                        "the epilogue layer",
+                        "use epilogue.assign_onehot / label_onehot / "
+                        "onehot_pair / onehot_histogram / slot_onehot"))
+        return findings
+
+    @staticmethod
+    def _walk(mod: ModuleInfo):
+        by_node = {info.node: f"{mod.modname}:{qual}"
+                   for qual, info in mod.functions.items()}
+
+        def walk(node, sym):
+            for child in ast.iter_child_nodes(node):
+                child_sym = by_node.get(child, sym)
+                yield child_sym, child
+                yield from walk(child, child_sym)
+        yield from walk(mod.tree, f"{mod.modname}:<module>")
