@@ -33,7 +33,10 @@ print('subsystem imports OK')
 # breakdown sites, R6 the obs API boundary — and adds jit purity (R1),
 # recompile hazards (R2), lock discipline (R3), off-path purity (R5)
 # and the env-knob registry (R7). The shipped tree must be clean
-# against the checked-in baseline; stale waivers fail too.
+# against the checked-in baseline; stale waivers fail too. ISSUE 15's
+# dataflow engine adds the semantic rules: donation safety (R10),
+# collective discipline (R11), layout/promotion hazards (R12),
+# cost-model coverage (R13), and import resolution (R14).
 python -m tools.raftlint raft_tpu
 
 # Debt inventory (non-fatal): the same scan with the baseline ignored,
@@ -50,7 +53,8 @@ seed_violation() {
     cat > "$dir/raft_tpu/$rel"
     (cd "$dir" && find raft_tpu -type d -exec touch {}/__init__.py \;)
     if python -m tools.raftlint --root "$dir" --no-baseline \
-            --rules "$rule" raft_tpu > "$dir/out.txt" 2>&1; then
+            --no-cache --rules "$rule" raft_tpu \
+            > "$dir/out.txt" 2>&1; then
         echo "raftlint gate: seeded $rule violation went undetected"
         cat "$dir/out.txt"; exit 1
     fi
@@ -121,7 +125,79 @@ import jax
 def f(labels):
     return jax.nn.one_hot(labels, 16)
 EOF
-echo "raftlint gate: tree clean; all 9 seeded violations fail loud"
+seed_violation R10 a.py <<'EOF'
+import functools
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def consume(buf, delta):
+    return buf + delta
+
+def step(buf, delta):
+    out = consume(buf, delta)
+    return out + buf.sum()
+EOF
+seed_violation R11 a.py <<'EOF'
+import jax
+
+def body(x):
+    return jax.lax.psum(x, "rows")
+
+def run(x):
+    mesh = jax.sharding.Mesh(jax.devices(), axis_names=("data",))
+    mapped = jax.shard_map(body, mesh=mesh, in_specs=None,
+                           out_specs=None)
+    return mapped(x)
+EOF
+seed_violation R12 a.py <<'EOF'
+from raft_tpu.matrix.epilogue import insert_drain
+
+def drain(dist, val_ref, idx_ref, j):
+    return insert_drain(dist, val_ref, idx_ref, j, tn=100, k=64,
+                        n_valid=10)
+EOF
+seed_violation R13 runtime/limits.py <<'EOF'
+def _est_toy(*, m, n, itemsize):
+    return m * n * itemsize
+
+_ESTIMATORS = {
+    "toy.op": _est_toy,
+}
+
+_SECONDS_ESTIMATORS = {}
+EOF
+seed_violation R14 a.py <<'EOF'
+from raft_tpu.gone_module import something
+EOF
+echo "raftlint gate: tree clean; all 14 seeded violations fail loud"
+
+# Cache correctness + runtime budget: a warm .raftlint_cache/ run must
+# reproduce the cold run's findings byte-for-byte and finish inside the
+# single-digit-seconds CI budget (the memoized-findings fast path).
+lintdir=$(mktemp -d)
+rm -rf .raftlint_cache
+python -m tools.raftlint --no-baseline raft_tpu \
+    > "$lintdir/cold.txt" || true
+python -m tools.raftlint --no-baseline raft_tpu \
+    > "$lintdir/warm.txt" || true
+diff "$lintdir/cold.txt" "$lintdir/warm.txt" || {
+    echo "raftlint cache: warm-run findings differ from cold run"
+    exit 1; }
+python - <<'EOF'
+import subprocess, sys, time
+t0 = time.monotonic()
+rc = subprocess.run(
+    [sys.executable, "-m", "tools.raftlint", "raft_tpu"]).returncode
+dt = time.monotonic() - t0
+print(f"raftlint warm gate: {dt:.2f}s (budget 5s)")
+if rc != 0:
+    sys.exit(rc)
+if dt > 5.0:
+    print("raftlint warm gate: exceeded the 5s lint-runtime budget")
+    sys.exit(1)
+EOF
+rm -rf "$lintdir"
+echo "raftlint cache gate: cold==warm findings, warm run in budget"
 
 # Epilogue bit-identity gate (ISSUE 14): the unified epilogue layer's
 # primitive oracles + consumer witnesses (kmeans single/mnmg, fused +

@@ -499,9 +499,72 @@ def _sec_lanczos_restart(*, n, ncv, nnz, k=0, itemsize=4):
     return flops, bytes_
 
 
+# The bytes-priced (admission/warm) ops carry flops/bytes twins so the
+# roofline attribution layer can cost every op the executor warms with
+# the same dim vocabulary estimate_bytes already uses — raftlint R13
+# fails the build if the two tables or their signatures drift.
+
+def _sec_pairwise(*, m, n, k, itemsize):
+    # one m×n×k MXU contraction plus the O(m·n) metric epilogue
+    flops = 2.0 * m * n * k + 3.0 * m * n
+    return flops, _est_pairwise(m=m, n=n, k=k, itemsize=itemsize)
+
+
+def _sec_knn(*, n_queries, n_db, n_dims, k, itemsize,
+             dist_itemsize=4):
+    # the full q×db distance block plus the tiled insert/drain top-k
+    flops = 2.0 * n_queries * n_db * n_dims \
+        + 4.0 * n_queries * n_db
+    return flops, _est_knn(n_queries=n_queries, n_db=n_db,
+                           n_dims=n_dims, k=k, itemsize=itemsize,
+                           dist_itemsize=dist_itemsize)
+
+
+def _sec_ivf_search(*, n_queries, probe_rows, n_dims, k, itemsize,
+                    packed_rows=0, dist_itemsize=4):
+    # fine distances over the gathered probe tile plus its top-k drain
+    flops = 2.0 * n_queries * probe_rows * n_dims \
+        + 4.0 * n_queries * probe_rows
+    return flops, _est_ivf_search(
+        n_queries=n_queries, probe_rows=probe_rows, n_dims=n_dims,
+        k=k, itemsize=itemsize, packed_rows=packed_rows,
+        dist_itemsize=dist_itemsize)
+
+
+def _sec_ivf_mnmg_search(*, n_queries, probe_rows, n_dims, k, n_ranks,
+                         itemsize, packed_rows=0, dist_itemsize=4):
+    # per-device SPMD cost: the local probe scan plus the replicated
+    # [q, n_ranks*k] merge-pool top-k (same ONE-device scope as the
+    # footprint estimate)
+    flops = 2.0 * n_queries * probe_rows * n_dims \
+        + 4.0 * n_queries * (probe_rows + n_ranks * k)
+    return flops, _est_ivf_mnmg_search(
+        n_queries=n_queries, probe_rows=probe_rows, n_dims=n_dims,
+        k=k, n_ranks=n_ranks, itemsize=itemsize,
+        packed_rows=packed_rows, dist_itemsize=dist_itemsize)
+
+
+def _sec_gemm(*, m, n, k, itemsize, out_itemsize=None):
+    return 2.0 * m * n * k, _est_gemm(m=m, n=n, k=k,
+                                      itemsize=itemsize,
+                                      out_itemsize=out_itemsize)
+
+
+def _sec_spmv(*, n_rows, n_cols, nnz, itemsize, index_itemsize=4):
+    return 2.0 * nnz, _est_spmv(n_rows=n_rows, n_cols=n_cols,
+                                nnz=nnz, itemsize=itemsize,
+                                index_itemsize=index_itemsize)
+
+
 _SECONDS_ESTIMATORS = {
     "cluster.lloyd_step": _sec_lloyd_step,
     "sparse.lanczos_restart": _sec_lanczos_restart,
+    "distance.pairwise_distance": _sec_pairwise,
+    "neighbors.brute_force_knn": _sec_knn,
+    "neighbors.ivf_search": _sec_ivf_search,
+    "neighbors.ivf_mnmg_search": _sec_ivf_mnmg_search,
+    "linalg.gemm": _sec_gemm,
+    "sparse.spmv": _sec_spmv,
 }
 
 

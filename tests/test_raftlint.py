@@ -473,3 +473,606 @@ def test_shipped_baseline_entries_all_carry_real_whys():
     for e in doc["entries"]:
         assert e["why"] and "TODO" not in e["why"], e
         assert "line" not in e, e
+
+
+# ---------------------------------------------------------------------------
+# R10: donation safety (dataflow engine)
+
+
+def test_r10_flags_use_after_donate(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def consume(buf, delta):
+            return buf + delta
+
+        def step(buf, delta):
+            out = consume(buf, delta)
+            return out + buf.sum()
+    """}, rules="R10")
+    assert rule_ids(findings) == {"R10"}
+    assert "read after being donated" in findings[0].message
+    assert findings[0].symbol == "raft_tpu.a:step"
+
+
+def test_r10_rebound_result_is_clean(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def consume(buf, delta):
+            return buf + delta
+
+        def step(buf, delta):
+            buf = consume(buf, delta)
+            return buf.sum()
+    """}, rules="R10")
+    assert findings == []
+
+
+def test_r10_resolves_jit_wrap_through_a_variable(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+
+        def body(buf, d):
+            return buf + d
+
+        run = jax.jit(body, donate_argnums=(0,))
+
+        def step(buf, d):
+            out = run(buf, d)
+            return out + buf.sum()
+    """}, rules="R10")
+    assert rule_ids(findings) == {"R10"}
+
+
+def test_r10_flags_stale_loop_carry(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def consume(buf, delta):
+            return buf + delta
+
+        def steps(buf, deltas):
+            acc = 0.0
+            for d in deltas:
+                acc = acc + consume(buf, d)
+            return acc
+    """}, rules="R10")
+    assert any("inside a loop" in f.message for f in findings)
+
+
+def test_r10_per_iteration_buffer_in_loop_is_clean(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def consume(buf, delta):
+            return buf + delta
+
+        def steps(deltas):
+            acc = 0.0
+            for d in deltas:
+                buf = jnp.zeros((8,))
+                acc = acc + consume(buf, d)
+            return acc
+    """}, rules="R10")
+    assert findings == []
+
+
+def test_r10_flags_vacuous_donation(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def f(x, scratch):
+            return x * 2
+    """}, rules="R10")
+    assert any("never consumes" in f.message for f in findings)
+
+
+def test_r10_variable_donate_position_stays_silent(tmp_path):
+    # a branch-dependent donate position is unknowable statically; the
+    # rule must not guess (kmeans' weighted/unweighted chunk builder)
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+
+        def body(a, b, c):
+            return a + b + c
+
+        def build(weighted):
+            donate = 2 if weighted else 1
+            run = jax.jit(body, donate_argnums=(donate,))
+            def step(a, b, c):
+                out = run(a, b, c)
+                return out + b.sum() + c.sum()
+            return step
+    """}, rules="R10")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# R11: collective discipline
+
+
+def test_r11_flags_axis_outside_mesh_scope(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+
+        def body(x):
+            return jax.lax.psum(x, "rows")
+
+        def run(x, devs):
+            mesh = jax.sharding.Mesh(devs, axis_names=("data",))
+            mapped = jax.shard_map(body, mesh=mesh, in_specs=None,
+                                   out_specs=None)
+            return mapped(x)
+    """}, rules="R11")
+    assert rule_ids(findings) == {"R11"}
+    assert "'rows'" in findings[0].message
+
+
+def test_r11_bound_axis_and_nested_meshes_are_clean(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+
+        def inner(x):
+            return jax.lax.psum(x, "model")
+
+        def outer(x, devs):
+            sub = jax.sharding.Mesh(devs, axis_names=("model",))
+            return jax.shard_map(inner, mesh=sub, in_specs=None,
+                                 out_specs=None)(x)
+
+        def body(x, devs):
+            y = jax.lax.psum(x, "data")
+            return outer(y, devs)
+
+        def run(x, devs):
+            mesh = jax.sharding.Mesh(devs, axis_names=("data",))
+            mapped = jax.shard_map(body, mesh=mesh, in_specs=None,
+                                   out_specs=None)
+            return mapped(x, devs)
+    """}, rules="R11")
+    assert findings == []
+
+
+def test_r11_inner_body_using_outer_axis_is_clean(tmp_path):
+    # `inner` reduces over the OUTER mesh's axis from inside a nested
+    # shard_map; the standalone pass of `body` only sees the inner
+    # mesh, so the rule must honor the widest observed scope, not the
+    # narrowest
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+
+        def inner(x):
+            return jax.lax.psum(x, "data")
+
+        def body(x, devs):
+            sub = jax.sharding.Mesh(devs, axis_names=("model",))
+            return jax.shard_map(inner, mesh=sub, in_specs=None,
+                                 out_specs=None)(x)
+
+        def run(x, devs):
+            mesh = jax.sharding.Mesh(devs, axis_names=("data",))
+            return jax.shard_map(body, mesh=mesh, in_specs=None,
+                                 out_specs=None)(x, devs)
+    """}, rules="R11")
+    assert findings == []
+
+
+def test_r11_unknown_scope_stays_silent(tmp_path):
+    # no shard_map context resolvable: the axis may be bound by a
+    # caller outside the scan — conservative silence
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+
+        def body(x):
+            return jax.lax.psum(x, "data")
+    """}, rules="R11")
+    assert findings == []
+
+
+def test_r11_flags_rank_divergent_cond_arm(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+
+        def with_collective(x):
+            return jax.lax.psum(x, "data")
+
+        def without(x):
+            return x
+
+        def body(x):
+            is_root = jax.lax.axis_index("data") == 0
+            return jax.lax.cond(is_root, with_collective, without, x)
+    """}, rules="R11")
+    assert any("axis_index" in f.message for f in findings)
+
+
+def test_r11_rank_uniform_cond_is_clean(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax
+
+        def with_collective(x):
+            return jax.lax.psum(x, "data")
+
+        def without(x):
+            return x
+
+        def body(x, flag):
+            return jax.lax.cond(flag, with_collective, without, x)
+    """}, rules="R11")
+    assert findings == []
+
+
+def test_r11_flags_unmatched_mailbox_tag(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        def push(view, payload, dst):
+            view.isend(payload, dst, tag=7)
+
+        def pull(view, src):
+            return view.irecv(src, tag=9)
+    """}, rules="R11")
+    msgs = " ".join(f.message for f in findings)
+    assert "tag 7" in msgs and "tag 9" in msgs
+
+
+def test_r11_paired_and_computed_tags_are_clean(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        def push(view, payload, dst, base):
+            view.isend(payload, dst, tag=7)
+            view.isend(payload, dst, tag=base + 1)
+
+        def pull(view, src):
+            return view.irecv(src, tag=7)
+    """}, rules="R11")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# R12: layout & promotion hazards
+
+
+def test_r12_flags_unaligned_lane_tile(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        from raft_tpu.matrix.epilogue import insert_drain
+
+        def drain(dist, val_ref, idx_ref, j):
+            return insert_drain(dist, val_ref, idx_ref, j, tn=100,
+                                k=64, n_valid=10)
+    """}, rules="R12")
+    assert rule_ids(findings) == {"R12"}
+    assert "tn=100" in findings[0].message
+
+
+def test_r12_padding_helper_output_is_clean(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        from raft_tpu.matrix.epilogue import insert_drain, \\
+            resolve_tn_sw
+
+        def drain(dist, val_ref, idx_ref, j, n):
+            tn, sw = resolve_tn_sw(100, None, n)
+            return insert_drain(dist, val_ref, idx_ref, j, tn=tn,
+                                k=64, n_valid=10, sw=sw)
+    """}, rules="R12")
+    assert findings == []
+
+
+def test_r12_aligned_literal_and_unknown_are_clean(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        from raft_tpu.matrix.epilogue import insert_drain
+
+        def drain(dist, val_ref, idx_ref, j, tn):
+            a = insert_drain(dist, val_ref, idx_ref, j, tn=256,
+                             k=64, n_valid=10)
+            return insert_drain(a, val_ref, idx_ref, j, tn=tn,
+                                k=64, n_valid=10)
+    """}, rules="R12")
+    assert findings == []
+
+
+def test_r12_shape_const_propagates_through_locals(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        from raft_tpu.matrix.epilogue import insert_drain
+
+        def drain(dist, val_ref, idx_ref, j):
+            width = 64 + 36            # folds to 100
+            return insert_drain(dist, val_ref, idx_ref, j, tn=width,
+                                k=64, n_valid=10)
+    """}, rules="R12")
+    assert rule_ids(findings) == {"R12"}
+
+
+def test_r12_flags_silent_f64_promotion(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def mix(n):
+            a = jnp.zeros((n,), dtype=jnp.float32)
+            b = np.zeros((4,), dtype=np.float64)
+            return a * b
+    """}, rules="R12")
+    assert any("float64" in f.message for f in findings)
+
+
+def test_r12_matching_dtypes_are_clean(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        import jax.numpy as jnp
+
+        def same(n):
+            a = jnp.zeros((n,), dtype=jnp.float32)
+            b = jnp.ones((4,), dtype=jnp.float32)
+            return a * b + 2.0
+    """}, rules="R12")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# R13: cost-model coverage
+
+
+def test_r13_flags_missing_flops_bytes_twin(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/runtime/limits.py": """
+        def _est_toy(*, m, n, itemsize):
+            return m * n * itemsize
+
+        _ESTIMATORS = {
+            "toy.op": _est_toy,
+        }
+
+        _SECONDS_ESTIMATORS = {}
+    """}, rules="R13")
+    assert rule_ids(findings) == {"R13"}
+    assert "no _SECONDS_ESTIMATORS entry" in findings[0].message
+
+
+def test_r13_flags_dim_signature_drift(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/runtime/limits.py": """
+        def _est_toy(*, m, n, itemsize):
+            return m * n * itemsize
+
+        def _sec_toy(*, rows, cols):
+            return 1.0, 2.0
+
+        _ESTIMATORS = {
+            "toy.op": _est_toy,
+        }
+
+        _SECONDS_ESTIMATORS = {
+            "toy.op": _sec_toy,
+        }
+    """}, rules="R13")
+    assert any("drift" in f.message for f in findings)
+
+
+def test_r13_flags_call_site_off_the_table(tmp_path):
+    findings = lint(tmp_path, {
+        "raft_tpu/runtime/limits.py": """
+            def _est_toy(*, m, n, itemsize):
+                return m * n * itemsize
+
+            def _sec_toy(*, m, n, itemsize):
+                return 1.0, 2.0
+
+            _ESTIMATORS = {
+                "toy.op": _est_toy,
+            }
+
+            _SECONDS_ESTIMATORS = {
+                "toy.op": _sec_toy,
+            }
+
+            def estimate_bytes(op, **dims):
+                return _ESTIMATORS[op](**dims)
+        """,
+        "raft_tpu/serve/a.py": """
+            from raft_tpu.runtime import limits
+
+            def quote(rows):
+                bad = limits.estimate_bytes("toy.gone", m=rows, n=1,
+                                            itemsize=4)
+                thin = limits.estimate_bytes("toy.op", m=rows)
+                return bad + thin
+        """}, rules="R13")
+    msgs = " ".join(f.message for f in findings)
+    assert "no such op" in msgs
+    assert "missing dims" in msgs
+
+
+def test_r13_matched_tables_and_call_sites_are_clean(tmp_path):
+    findings = lint(tmp_path, {
+        "raft_tpu/runtime/limits.py": """
+            def _est_toy(*, m, n, itemsize):
+                return m * n * itemsize
+
+            def _sec_toy(*, m, n, itemsize):
+                return 1.0, 2.0
+
+            _ESTIMATORS = {
+                "toy.op": _est_toy,
+            }
+
+            _SECONDS_ESTIMATORS = {
+                "toy.op": _sec_toy,
+            }
+
+            def estimate_bytes(op, **dims):
+                return _ESTIMATORS[op](**dims)
+        """,
+        "raft_tpu/serve/a.py": """
+            from raft_tpu.runtime import limits
+
+            def quote(rows):
+                return limits.estimate_bytes("toy.op", m=rows, n=8,
+                                             itemsize=4)
+        """}, rules="R13")
+    assert findings == []
+
+
+def test_r13_shipped_tables_cover_every_bytes_op():
+    """The real limits.py: every admission-priced op must carry its
+    flops/bytes twin with the same required dims (keeps the roofline
+    denominators honest)."""
+    from raft_tpu.runtime import limits as L
+    for op in L._ESTIMATORS:
+        assert op in L._SECONDS_ESTIMATORS, op
+        flops, bytes_ = L.estimate_flops_bytes(
+            op, **_SMOKE_DIMS[op])
+        assert flops > 0 and bytes_ > 0, op
+        assert bytes_ == L.estimate_bytes(op, **_SMOKE_DIMS[op]), op
+
+
+_SMOKE_DIMS = {
+    "distance.pairwise_distance": dict(m=64, n=32, k=16, itemsize=4),
+    "neighbors.brute_force_knn": dict(n_queries=8, n_db=128,
+                                      n_dims=16, k=4, itemsize=4),
+    "neighbors.ivf_search": dict(n_queries=8, probe_rows=64,
+                                 n_dims=16, k=4, itemsize=4,
+                                 packed_rows=256),
+    "neighbors.ivf_mnmg_search": dict(n_queries=8, probe_rows=64,
+                                      n_dims=16, k=4, n_ranks=2,
+                                      itemsize=4, packed_rows=256),
+    "linalg.gemm": dict(m=32, n=32, k=32, itemsize=4),
+    "sparse.spmv": dict(n_rows=64, n_cols=64, nnz=512, itemsize=4),
+}
+
+
+# ---------------------------------------------------------------------------
+# R14: import resolution
+
+
+def test_r14_flags_import_of_missing_module(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        from raft_tpu.gone_module import something
+    """}, rules="R14")
+    assert rule_ids(findings) == {"R14"}
+    assert "no such module" in findings[0].message
+
+
+def test_r14_flags_import_of_missing_name(tmp_path):
+    findings = lint(tmp_path, {
+        "raft_tpu/b.py": """
+            def real():
+                return 1
+        """,
+        "raft_tpu/a.py": """
+            from raft_tpu.b import real, imaginary
+        """}, rules="R14")
+    assert any("'imaginary' is not defined" in f.message
+               for f in findings)
+
+
+def test_r14_relative_package_init_imports_resolve(tmp_path):
+    # for an __init__.py the modname IS the package: `from . import x`
+    # anchors at the package itself, not its parent
+    findings = lint(tmp_path, {
+        "raft_tpu/sub/x.py": "def f():\n    return 1\n",
+        "raft_tpu/sub/__init__.py": """
+            from . import x
+            from .x import f
+        """}, rules="R14")
+    assert findings == []
+
+
+def test_r14_star_and_getattr_exports_stay_silent(tmp_path):
+    findings = lint(tmp_path, {
+        "raft_tpu/lazy.py": """
+            def __getattr__(name):
+                raise AttributeError(name)
+        """,
+        "raft_tpu/a.py": """
+            from raft_tpu.lazy import anything
+        """}, rules="R14")
+    assert findings == []
+
+
+def test_r14_external_roots_are_out_of_scope(tmp_path):
+    findings = lint(tmp_path, {"raft_tpu/a.py": """
+        from not_a_local_package.sub import thing
+    """}, rules="R14")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# baseline loader: shipped TODO whys are a hard failure
+
+
+def test_baseline_rejects_todo_placeholder_why(tmp_path):
+    write_tree(tmp_path, VIOLATION)
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [{
+        "rule": "R4", "file": "raft_tpu/a.py",
+        "symbol": "raft_tpu.a:f",
+        "why": "TODO: justify this waiver"}]}))
+    code, out = run_cli(tmp_path, "--baseline", str(bl))
+    assert code == 2 and "placeholder" in out
+
+
+def test_baseline_rejects_empty_why(tmp_path):
+    write_tree(tmp_path, VIOLATION)
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({"version": 1, "entries": [{
+        "rule": "R4", "file": "raft_tpu/a.py",
+        "symbol": "raft_tpu.a:f", "why": "  "}]}))
+    code, out = run_cli(tmp_path, "--baseline", str(bl))
+    assert code == 2
+
+
+def test_write_baseline_roundtrip_needs_real_whys(tmp_path):
+    # --write-baseline emits TODOs by design; feeding them back in
+    # unedited must fail, closing the copy-paste loophole
+    write_tree(tmp_path, VIOLATION)
+    bl = tmp_path / "bl.json"
+    code, _ = run_cli(tmp_path, "--write-baseline", str(bl))
+    assert code == 0
+    code, out = run_cli(tmp_path, "--baseline", str(bl))
+    assert code == 2 and "placeholder" in out
+
+
+# ---------------------------------------------------------------------------
+# the .raftlint_cache/ fast path
+
+
+def test_cache_warm_run_matches_cold_run(tmp_path):
+    write_tree(tmp_path, VIOLATION)
+    code_cold, out_cold = run_cli(tmp_path, "--no-baseline",
+                                  "--no-cache")
+    code1, out1 = run_cli(tmp_path, "--no-baseline")   # fills cache
+    code2, out2 = run_cli(tmp_path, "--no-baseline")   # replays memo
+    assert (tmp_path / ".raftlint_cache").is_dir()
+    assert code_cold == code1 == code2 == 1
+    assert out_cold == out1 == out2
+
+
+def test_cache_invalidates_on_edit(tmp_path):
+    write_tree(tmp_path, {"raft_tpu/a.py": "def f():\n    return 1\n"})
+    code, _ = run_cli(tmp_path)
+    assert code == 0
+    # introduce a violation: the content-hash key must miss and the
+    # new finding must surface despite the warm cache
+    (tmp_path / "raft_tpu/a.py").write_text(
+        "def f():\n    raise RuntimeError('boom')\n")
+    code, out = run_cli(tmp_path, "--no-baseline")
+    assert code == 1 and "R4" in out
+
+
+def test_no_cache_flag_writes_nothing(tmp_path):
+    write_tree(tmp_path, {"raft_tpu/a.py": "def f():\n    return 1\n"})
+    code, _ = run_cli(tmp_path, "--no-cache")
+    assert code == 0
+    assert not (tmp_path / ".raftlint_cache").exists()

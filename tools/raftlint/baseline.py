@@ -44,6 +44,14 @@ class Baseline:
                 raise ValueError(
                     "baseline entries waive per (rule, file, symbol), "
                     f"never per line: {e!r}")
+            why = str(e["why"]).strip()
+            if not why or why.upper().startswith("TODO"):
+                raise ValueError(
+                    "baseline entry for "
+                    f"({e['rule']}, {e['file']}, {e['symbol']}) still "
+                    f"carries the --write-baseline placeholder why "
+                    f"({e['why']!r}); a waiver ships with a real "
+                    "justification or not at all")
         return cls(entries)
 
     @classmethod

@@ -1,0 +1,166 @@
+"""raftlint on-disk cache: parsed modules + whole-run findings.
+
+Two levels, both keyed by content so invalidation is automatic:
+
+1. **Per-file** — a pickled :class:`~tools.raftlint.core.ModuleInfo`
+   (AST + import map + symbol/lock tables) keyed by the sha256 of the
+   file's source, so an edit to one module re-parses one module.
+2. **Per-run** — the full findings list keyed by the sha256 of the
+   sorted (relpath, source-hash) set plus the active rule ids, so the
+   common CI case — warm cache, clean tree — skips analysis entirely
+   and replays the memoized findings.
+
+Both levels additionally key on a *tool version hash* folded from every
+``tools/raftlint`` source file: changing a rule, the dataflow engine,
+or the core indexes orphans every cached artifact at once. Entries are
+written atomically (tmp + rename) and corrupt/unreadable entries read
+as misses, so the cache can never make a run wrong — only faster. The
+``--no-cache`` flag simply constructs no cache.
+
+The cache lives under ``<root>/.raftlint_cache/`` (gitignored).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import shutil
+import tempfile
+from typing import List, Optional, Sequence, Tuple
+
+from tools.raftlint.core import Finding, ModuleInfo
+
+CACHE_DIR_NAME = ".raftlint_cache"
+_MAX_FILE_ENTRIES = 4096        # runaway backstop, not an LRU
+
+
+def _tool_version_hash() -> str:
+    """sha256 over every .py source in tools/raftlint — any change to
+    the tool invalidates everything it previously produced."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(here)):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__")
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            fp = os.path.join(dirpath, name)
+            h.update(os.path.relpath(fp, here).encode())
+            try:
+                with open(fp, "rb") as fh:
+                    h.update(fh.read())
+            except OSError:
+                h.update(b"<unreadable>")
+    return h.hexdigest()[:16]
+
+
+def source_hash(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8")).hexdigest()[:24]
+
+
+class FileCache:
+    """Content-addressed store for ModuleInfo pickles and run memos."""
+
+    def __init__(self, root: str) -> None:
+        self.dir = os.path.join(os.path.abspath(root), CACHE_DIR_NAME)
+        self.version = _tool_version_hash()
+        self.files_dir = os.path.join(self.dir, "files", self.version)
+        self.runs_dir = os.path.join(self.dir, "runs", self.version)
+        self.hits = 0
+        self.misses = 0
+        #: (relpath, source-hash) of everything seen this run — the
+        #: run-memo key folds over it
+        self.seen: List[Tuple[str, str]] = []
+        self._gc_stale_versions()
+
+    def _gc_stale_versions(self) -> None:
+        """Drop artifacts from older tool versions — they can never hit
+        again, so the cache dir stays bounded across upgrades."""
+        for sub in ("files", "runs"):
+            base = os.path.join(self.dir, sub)
+            try:
+                for v in os.listdir(base):
+                    if v != self.version:
+                        shutil.rmtree(os.path.join(base, v),
+                                      ignore_errors=True)
+            except OSError:
+                pass
+
+    # -- per-file level ------------------------------------------------------
+
+    def _file_path(self, rel: str, shash: str) -> str:
+        name = hashlib.sha256(rel.encode()).hexdigest()[:16]
+        return os.path.join(self.files_dir, f"{name}-{shash}.pkl")
+
+    def get(self, rel: str, source: str) -> Optional[ModuleInfo]:
+        shash = source_hash(source)
+        self.seen.append((rel, shash))
+        try:
+            with open(self._file_path(rel, shash), "rb") as fh:
+                info = pickle.load(fh)
+        except (OSError, pickle.PickleError, EOFError, AttributeError,
+                ImportError, IndexError):
+            self.misses += 1
+            return None
+        if not isinstance(info, ModuleInfo):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return info
+
+    def put(self, rel: str, source: str, info: ModuleInfo) -> None:
+        self._atomic_dump(info, self._file_path(
+            rel, source_hash(source)))
+
+    # -- per-run level -------------------------------------------------------
+
+    def run_key(self, rule_ids: Optional[Sequence[str]]) -> str:
+        """Key for the findings memo: every scanned file's content hash
+        plus the rule selection. Call after Project.scan()."""
+        h = hashlib.sha256()
+        for rel, shash in sorted(self.seen):
+            h.update(f"{rel}={shash};".encode())
+        rules = ",".join(sorted(rule_ids)) if rule_ids else "ALL"
+        h.update(rules.encode())
+        return h.hexdigest()[:24]
+
+    def get_findings(self, key: str) -> Optional[List[Finding]]:
+        try:
+            with open(os.path.join(self.runs_dir, key + ".pkl"),
+                      "rb") as fh:
+                out = pickle.load(fh)
+        except (OSError, pickle.PickleError, EOFError, AttributeError,
+                ImportError, IndexError):
+            return None
+        if not isinstance(out, list) or not all(
+                isinstance(f, Finding) for f in out):
+            return None
+        return out
+
+    def put_findings(self, key: str, findings: List[Finding]) -> None:
+        self._atomic_dump(findings,
+                          os.path.join(self.runs_dir, key + ".pkl"))
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _atomic_dump(self, obj, path: str) -> None:
+        d = os.path.dirname(path)
+        try:
+            os.makedirs(d, exist_ok=True)
+            if len(os.listdir(d)) >= _MAX_FILE_ENTRIES:
+                return                      # full: stop growing
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    pickle.dump(obj, fh, pickle.HIGHEST_PROTOCOL)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except (OSError, pickle.PickleError):
+            pass                            # cache is best-effort

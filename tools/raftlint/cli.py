@@ -18,6 +18,7 @@ import sys
 from typing import List
 
 from tools.raftlint.baseline import DEFAULT_PATH, Baseline
+from tools.raftlint.cache import FileCache
 from tools.raftlint.core import Finding, Project
 from tools.raftlint.rules import ALL_RULES
 
@@ -50,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write a baseline waiving every current "
                          "finding, then exit 0 (fill in the why "
                          "fields)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="parse and analyze from scratch, ignoring "
+                         "and not writing .raftlint_cache/")
     return ap
 
 
@@ -83,14 +87,25 @@ def main(argv=None) -> int:
                   f"(known: {sorted(known)})", file=sys.stderr)
             return 2
 
-    project = Project(args.root)
+    cache = None if args.no_cache else FileCache(args.root)
+    project = Project(args.root, cache=cache)
     project.scan(args.paths)
     if project.errors:
         for err in project.errors:
             print(f"raftlint: {err}", file=sys.stderr)
         return 2
 
-    findings = run_rules(project, rule_ids)
+    findings = None
+    run_key = None
+    if cache is not None:
+        # warm clean run: replay the memoized findings for this exact
+        # (file-contents, rule-selection) set without analyzing
+        run_key = cache.run_key(sorted(rule_ids) if rule_ids else None)
+        findings = cache.get_findings(run_key)
+    if findings is None:
+        findings = run_rules(project, rule_ids)
+        if cache is not None and run_key is not None:
+            cache.put_findings(run_key, findings)
 
     if args.write_baseline is not None:
         with open(args.write_baseline, "w", encoding="utf-8") as fh:

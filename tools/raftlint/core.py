@@ -89,11 +89,12 @@ class ModuleInfo:
     """One parsed source file plus its local indexes."""
 
     def __init__(self, path: str, relpath: str, modname: str,
-                 source: str) -> None:
+                 source: str, is_package: bool = False) -> None:
         self.path = path
         self.relpath = relpath
         self.modname = modname
         self.source = source
+        self.is_package = is_package
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=relpath)
         self.imports: Dict[str, str] = {}
@@ -117,8 +118,13 @@ class ModuleInfo:
                 base = node.module or ""
                 if node.level:          # relative import
                     parts = self.modname.split(".")
-                    # level=1 → current package; each extra level pops one
-                    anchor = parts[:len(parts) - node.level]
+                    # level=1 → current package; each extra level pops
+                    # one. For an __init__.py the modname IS its
+                    # package, so level=1 keeps every part.
+                    drop = node.level - 1 if self.is_package \
+                        else node.level
+                    anchor = parts[:len(parts) - drop] if drop \
+                        else parts
                     base = ".".join(anchor + ([node.module]
                                               if node.module else []))
                 for alias in node.names:
@@ -230,10 +236,11 @@ def body_statements(fn: ast.AST) -> List[ast.stmt]:
 class Project:
     """Every parsed module under the scanned roots, plus shared lookups."""
 
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str, cache=None) -> None:
         self.root = os.path.abspath(root)
         self.modules: Dict[str, ModuleInfo] = {}
         self.errors: List[str] = []
+        self.cache = cache              # tools.raftlint.cache.FileCache
 
     # -- construction -------------------------------------------------------
 
@@ -258,12 +265,21 @@ class Project:
     def _load(self, path: str) -> None:
         rel = os.path.relpath(path, self.root).replace(os.sep, "/")
         mod = rel[:-3].replace("/", ".")
+        is_pkg = mod.endswith(".__init__") or mod == "__init__"
         if mod.endswith(".__init__"):
             mod = mod[:-len(".__init__")]
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 source = fh.read()
-            self.modules[mod] = ModuleInfo(path, rel, mod, source)
+            cached = self.cache.get(rel, source) if self.cache else None
+            if cached is not None:
+                cached.path = path      # tree may have moved on disk
+                self.modules[mod] = cached
+                return
+            info = ModuleInfo(path, rel, mod, source, is_package=is_pkg)
+            self.modules[mod] = info
+            if self.cache:
+                self.cache.put(rel, source, info)
         except (SyntaxError, UnicodeDecodeError) as e:
             self.errors.append(f"{rel}: parse error: {e}")
 

@@ -1,0 +1,924 @@
+"""raftlint dataflow engine: abstract interpretation over the Project.
+
+PR 12's rules are syntactic — they match spellings. This module gives
+the semantic rules (R10–R13) a shared abstract interpreter that
+propagates a small lattice value per bound name through assignments,
+calls, and ``lax`` control flow, using the per-module symbol tables and
+import maps :mod:`tools.raftlint.core` already builds.
+
+The lattice value (:class:`AV`) tracks, per name:
+
+- ``shape``  — a tuple of per-dim ints (``None`` per unknown dim), or
+  ``None`` when the rank itself is unknown;
+- ``dtype``  — a canonical dtype string (``"float32"``, ``"bfloat16"``,
+  ``"float64"``, …) or ``None``;
+- ``donated`` — whether the value aliases a buffer some call donated
+  (``donate_argnums``) — the bit R10 chases through loop carries;
+- ``const``  — a known python literal (int/str/float/tuple) for shape
+  arithmetic and axis-name / op-string resolution;
+- ``func``   — :class:`FuncFacts` when the value is callable (a
+  ``jax.jit(f, donate_argnums=…)`` result, a ``shard_map``-wrapped
+  body, a resolved def), carrying donation positions and bound axis
+  names;
+- ``tags``   — origin markers (``"axis_index"``, ``"padded"``) that
+  survive arithmetic, for the rank-divergence and padding-helper
+  checks.
+
+Everything joins conservatively: conflicting facts become unknown, so
+rules fire only where the code is genuinely analyzable — the same
+over-report-nothing posture as the syntactic rules.
+
+Interprocedural: each function gets a TOP-argument summary (memoized;
+recursion breaks to TOP), and control-flow carriers
+(``lax.while_loop`` / ``scan`` / ``fori_loop`` / ``cond``) re-interpret
+their body callables with the *actual* carry values, so a donated
+carry keeps its donation bit through the loop and a collective inside
+a cond arm is seen under the enclosing ``shard_map``'s axis scope.
+Loops host-side are interpreted twice with a join back into the entry
+environment (one widening pass), which is enough for the
+straight-line-plus-carries shapes this codebase writes.
+
+Rules consume the recorded event streams (:class:`CallEvent`,
+:class:`BinopEvent`, :class:`CollectiveEvent`) rather than re-walking
+the AST; :func:`analyze` memoizes per Project.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from tools.raftlint.core import (FunctionInfo, ModuleInfo, Project,
+                                 dotted_parts)
+
+MAX_DEPTH = 6               # interprocedural recursion bound
+
+JIT_FQS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+SHARD_MAP_FQS = {"jax.shard_map", "jax.experimental.shard_map.shard_map"}
+MESH_FQS = {"jax.sharding.Mesh", "jax.interpreters.pxla.Mesh", "Mesh"}
+PARTIAL_FQS = {"functools.partial", "partial"}
+
+#: collective primitive → index of the axis-name argument (after the
+#: operand), ``0`` when the axis name is the first positional arg
+COLLECTIVES = {
+    "jax.lax.psum": 1, "jax.lax.pmean": 1, "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1, "jax.lax.psum_scatter": 1,
+    "jax.lax.all_gather": 1, "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1, "jax.lax.axis_index": 0,
+}
+
+CTRL_FLOW = {
+    "jax.lax.while_loop": (1, 2),      # (body position, init position)
+    "jax.lax.fori_loop": (2, 3),
+    "jax.lax.scan": (0, 1),
+}
+
+#: dtype spellings → canonical string
+_DTYPES = {
+    "float32": "float32", "float64": "float64", "float16": "float16",
+    "bfloat16": "bfloat16", "int32": "int32", "int64": "int64",
+    "int16": "int16", "int8": "int8", "uint8": "uint8",
+    "bool": "bool", "bool_": "bool", "complex64": "complex64",
+}
+FLOAT_WIDTH = {"bfloat16": 16, "float16": 16, "float32": 32,
+               "float64": 64}
+
+#: array constructors whose (shape, dtype) args we can often fold
+_SHAPED_CTORS = {
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.empty",
+    "jax.numpy.full", "numpy.zeros", "numpy.ones", "numpy.empty",
+    "numpy.full",
+}
+
+#: the sanctioned padding/alignment helpers — values produced through
+#: them carry the "padded" tag R12 honors
+PADDING_HELPERS = {
+    "raft_tpu.util.math.round_up_to_multiple",
+    "raft_tpu.matrix.epilogue.resolve_tn_sw",
+    "raft_tpu.matrix.epilogue.best_width",
+    "raft_tpu.linalg.contractions._pad2",
+    "raft_tpu.util.pallas_utils.pad_dim",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncFacts:
+    """What we statically know about a callable value."""
+
+    symbol: Optional[str] = None        # module:qual of the body def
+    donate: Tuple[int, ...] = ()        # donated positional indices
+    static_names: FrozenSet[str] = frozenset()
+    axes: Optional[FrozenSet[str]] = None   # shard_map-bound axis names
+    kind: str = "plain"                 # plain | jit | shard_map
+
+
+@dataclasses.dataclass
+class AV:
+    """One abstract value (see module docstring)."""
+
+    shape: Optional[Tuple] = None
+    dtype: Optional[str] = None
+    donated: bool = False
+    const: object = None
+    func: Optional[FuncFacts] = None
+    tags: FrozenSet[str] = frozenset()
+
+    @staticmethod
+    def top() -> "AV":
+        return AV()
+
+    def with_tag(self, tag: str) -> "AV":
+        return dataclasses.replace(self, tags=self.tags | {tag})
+
+
+TOP = AV.top()
+
+
+def join(a: AV, b: AV) -> AV:
+    """Lattice join: agreement survives, conflict goes unknown, the
+    donation bit and tags accumulate (may-analysis)."""
+    if a is b:
+        return a
+    shape = a.shape if a.shape == b.shape else (
+        _join_shapes(a.shape, b.shape))
+    return AV(
+        shape=shape,
+        dtype=a.dtype if a.dtype == b.dtype else None,
+        donated=a.donated or b.donated,
+        const=a.const if _const_eq(a.const, b.const) else None,
+        func=a.func if a.func == b.func else None,
+        tags=a.tags | b.tags)
+
+
+def _const_eq(x, y) -> bool:
+    return type(x) is type(y) and x == y
+
+
+def _join_shapes(sa, sb):
+    if sa is None or sb is None or len(sa) != len(sb):
+        return None
+    return tuple(x if x == y else None for x, y in zip(sa, sb))
+
+
+def promote_dtype(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """NumPy-style result dtype for arithmetic between floats — only
+    the float×float case matters here (the promotion-hazard check)."""
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    wa, wb = FLOAT_WIDTH.get(a), FLOAT_WIDTH.get(b)
+    if wa is None or wb is None:
+        return None
+    if wa == wb:                    # bfloat16 × float16 → float32
+        return a if a == b else "float32"
+    return a if wa > wb else b
+
+
+# -- event records -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CallEvent:
+    """One call site with resolved facts + abstract arguments."""
+
+    fn: FunctionInfo                    # enclosing function (caller)
+    node: ast.Call
+    fq: Optional[str]                   # resolved dotted callee name
+    facts: Optional[FuncFacts]          # callable-value facts, if any
+    args: List[AV]
+    keywords: Dict[str, AV]
+    axes_scope: Optional[FrozenSet[str]]    # shard_map axes in scope
+
+
+@dataclasses.dataclass
+class BinopEvent:
+    fn: FunctionInfo
+    node: ast.AST
+    left: AV
+    right: AV
+    result: AV
+
+
+@dataclasses.dataclass
+class CollectiveEvent:
+    fn: FunctionInfo
+    node: ast.Call
+    fq: str
+    axis: AV                            # abstract axis-name argument
+    axes_scope: Optional[FrozenSet[str]]
+
+
+@dataclasses.dataclass
+class Summary:
+    """Per-function interpretation result under TOP arguments."""
+
+    env: Dict[str, AV]
+    returns: AV
+
+
+class DataflowResult:
+    def __init__(self) -> None:
+        self.calls: List[CallEvent] = []
+        self.binops: List[BinopEvent] = []
+        self.collectives: List[CollectiveEvent] = []
+        self.summaries: Dict[str, Summary] = {}
+        #: symbol → donation positions, for defs decorated with a
+        #: donating jit (``@partial(jax.jit, donate_argnums=…)``)
+        self.donating_defs: Dict[str, Tuple[int, ...]] = {}
+
+    def summary(self, symbol: str) -> Optional[Summary]:
+        return self.summaries.get(symbol)
+
+
+# -- the interpreter ---------------------------------------------------------
+
+
+class _Interp:
+    def __init__(self, project: Project, result: DataflowResult) -> None:
+        self.project = project
+        self.result = result
+        self.table = project.symbol_table()
+        self._memo: Dict[str, Summary] = {}
+        self._module_envs: Dict[str, Dict[str, AV]] = {}
+        self._in_flight: set = set()
+
+    # -- entry points -------------------------------------------------------
+
+    def run(self) -> None:
+        for mod in self.project.modules.values():
+            self._collect_decorated(mod)
+        for mod in self.project.modules.values():
+            self.module_env(mod)
+        for fn in self.project.iter_functions():
+            self.top_summary(fn)
+
+    def _collect_decorated(self, mod: ModuleInfo) -> None:
+        for fn in mod.functions.values():
+            for deco in getattr(fn.node, "decorator_list", []):
+                facts = self._jit_facts_from_deco(mod, deco, fn)
+                if facts and facts.donate:
+                    self.result.donating_defs[fn.symbol] = facts.donate
+
+    def _jit_facts_from_deco(self, mod: ModuleInfo, deco: ast.AST,
+                             fn: FunctionInfo) -> Optional[FuncFacts]:
+        """FuncFacts for a @jax.jit / @partial(jax.jit, …) decoration."""
+        if isinstance(deco, ast.Call):
+            fq = mod.resolve(deco.func)
+            if fq in JIT_FQS:
+                return self._facts_from_jit_kwargs(
+                    deco.keywords, fn.symbol)
+            if (fq in PARTIAL_FQS and deco.args
+                    and mod.resolve(deco.args[0]) in JIT_FQS):
+                return self._facts_from_jit_kwargs(
+                    deco.keywords, fn.symbol)
+        elif mod.resolve(deco) in JIT_FQS:
+            return FuncFacts(symbol=fn.symbol, kind="jit")
+        return None
+
+    @staticmethod
+    def _facts_from_jit_kwargs(keywords, symbol,
+                               inner: Optional[FuncFacts] = None
+                               ) -> FuncFacts:
+        donate: Tuple[int, ...] = ()
+        static: FrozenSet[str] = frozenset()
+        for kw in keywords:
+            lit = _literal(kw.value)
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                if isinstance(lit, int):
+                    donate = (lit,)
+                elif isinstance(lit, tuple) and all(
+                        isinstance(v, int) for v in lit):
+                    donate = tuple(lit)
+                # non-literal positions → unknown → no donation facts
+            elif kw.arg == "static_argnames":
+                if isinstance(lit, str):
+                    static = frozenset((lit,))
+                elif isinstance(lit, tuple):
+                    static = frozenset(v for v in lit
+                                       if isinstance(v, str))
+        axes = inner.axes if inner else None
+        return FuncFacts(symbol=symbol, donate=donate,
+                         static_names=static, axes=axes, kind="jit")
+
+    # -- environments -------------------------------------------------------
+
+    def module_env(self, mod: ModuleInfo) -> Dict[str, AV]:
+        env = self._module_envs.get(mod.modname)
+        if env is None:
+            env = {}
+            self._module_envs[mod.modname] = env    # cycle guard
+            pseudo = FunctionInfo(mod, "<module>", mod.tree, None)
+            self._exec_block(mod.tree.body, env, pseudo, None, 0)
+        return env
+
+    def top_summary(self, fn: FunctionInfo) -> Summary:
+        got = self._memo.get(fn.symbol)
+        if got is not None:
+            return got
+        if fn.symbol in self._in_flight:        # recursion → TOP
+            return Summary({}, TOP)
+        self._in_flight.add(fn.symbol)
+        try:
+            summ = self._interpret(fn, None, None, 1)
+        finally:
+            self._in_flight.discard(fn.symbol)
+        self._memo[fn.symbol] = summ
+        self.result.summaries[fn.symbol] = summ
+        return summ
+
+    def _param_names(self, fn: FunctionInfo) -> List[str]:
+        a = getattr(fn.node, "args", None)
+        if a is None:
+            return []
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    def _interpret(self, fn: FunctionInfo,
+                   args: Optional[Sequence[AV]],
+                   axes_scope: Optional[FrozenSet[str]],
+                   depth: int) -> Summary:
+        """Interpret one function body; ``args`` positionally seeds the
+        parameters (None → all TOP). Records events as it goes."""
+        if depth > MAX_DEPTH:
+            return Summary({}, TOP)
+        env: Dict[str, AV] = {}
+        names = self._param_names(fn)
+        body = getattr(fn.node, "body", [])
+        if isinstance(fn.node, ast.Lambda):
+            body = [ast.Return(value=fn.node.body,
+                               lineno=fn.node.lineno,
+                               col_offset=fn.node.col_offset)]
+        donate = self.result.donating_defs.get(fn.symbol, ())
+        for i, name in enumerate(names):
+            av = TOP
+            if args is not None and i < len(args):
+                av = args[i]
+            if i in donate:
+                av = dataclasses.replace(av, donated=True)
+            env[name] = av
+        ret = _Ret()
+        self._exec_block(body, env, fn, axes_scope, depth, ret)
+        return Summary(env, ret.value if ret.seen else TOP)
+
+    # -- statements ---------------------------------------------------------
+
+    def _exec_block(self, stmts, env, fn, axes, depth, ret=None) -> None:
+        for st in stmts:
+            self._exec_stmt(st, env, fn, axes, depth, ret)
+
+    def _exec_stmt(self, st, env, fn, axes, depth, ret) -> None:
+        if isinstance(st, ast.Assign):
+            val = self._eval(st.value, env, fn, axes, depth)
+            for tgt in st.targets:
+                self._bind(tgt, val, env, fn, axes, depth)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            val = self._eval(st.value, env, fn, axes, depth)
+            self._bind(st.target, val, env, fn, axes, depth)
+        elif isinstance(st, ast.AugAssign):
+            left = self._eval(st.target, env, fn, axes, depth)
+            right = self._eval(st.value, env, fn, axes, depth)
+            out = self._binop_result(st, left, right, fn)
+            if isinstance(st.target, ast.Name):
+                env[st.target.id] = out
+        elif isinstance(st, ast.Return):
+            val = (self._eval(st.value, env, fn, axes, depth)
+                   if st.value is not None else TOP)
+            if ret is not None:
+                ret.add(val)
+        elif isinstance(st, ast.Expr):
+            self._eval(st.value, env, fn, axes, depth)
+        elif isinstance(st, ast.If):
+            test = self._eval(st.test, env, fn, axes, depth)
+            del test
+            benv = dict(env)
+            self._exec_block(st.body, benv, fn, axes, depth, ret)
+            oenv = dict(env)
+            self._exec_block(st.orelse, oenv, fn, axes, depth, ret)
+            _merge_branches(env, benv, oenv)
+        elif isinstance(st, (ast.For, ast.While)):
+            if isinstance(st, ast.For):
+                self._bind(st.target, TOP, env, fn, axes, depth)
+            else:
+                self._eval(st.test, env, fn, axes, depth)
+            # two passes with a join back into the loop-entry env: the
+            # second pass sees the carried (widened) values, so a
+            # changing carry settles at the join instead of looping
+            for _ in range(2):
+                lenv = dict(env)
+                self._exec_block(st.body, lenv, fn, axes, depth, ret)
+                for name, av in lenv.items():
+                    env[name] = join(env[name], av) if name in env \
+                        else av
+            self._exec_block(st.orelse, env, fn, axes, depth, ret)
+        elif isinstance(st, ast.With):
+            for item in st.items:
+                self._eval(item.context_expr, env, fn, axes, depth)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, TOP, env, fn, axes,
+                               depth)
+            self._exec_block(st.body, env, fn, axes, depth, ret)
+        elif isinstance(st, ast.Try):
+            self._exec_block(st.body, env, fn, axes, depth, ret)
+            for h in st.handlers:
+                henv = dict(env)
+                self._exec_block(h.body, henv, fn, axes, depth, ret)
+                _merge_branches(env, env.copy(), henv)
+            self._exec_block(st.orelse, env, fn, axes, depth, ret)
+            self._exec_block(st.finalbody, env, fn, axes, depth, ret)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local = fn.module.functions.get(
+                f"{fn.qual}.{st.name}" if fn.qual != "<module>"
+                else st.name)
+            env[st.name] = AV(func=FuncFacts(
+                symbol=local.symbol if local else None))
+        # class defs / imports / del / raise add no dataflow facts
+
+    def _bind(self, tgt, val: AV, env, fn, axes, depth) -> None:
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = val
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            parts = None
+            if isinstance(val.const, tuple) and \
+                    len(val.const) == len(tgt.elts):
+                parts = [AV(const=c) for c in val.const]
+            for i, el in enumerate(tgt.elts):
+                if isinstance(el, ast.Starred):
+                    el = el.value
+                item = parts[i] if parts else dataclasses.replace(
+                    val, shape=None, const=None, func=None)
+                self._bind(el, item, env, fn, axes, depth)
+        elif isinstance(tgt, ast.Starred):
+            self._bind(tgt.value, val, env, fn, axes, depth)
+        # attribute/subscript stores tracked nowhere (conservative)
+
+    # -- expressions --------------------------------------------------------
+
+    def _eval(self, node, env, fn, axes, depth) -> AV:
+        if node is None:
+            return TOP
+        if isinstance(node, ast.Constant):
+            v = node.value
+            av = AV(const=v if isinstance(
+                v, (int, float, str, bool)) else None)
+            if isinstance(v, bool):
+                av = dataclasses.replace(av, dtype="bool")
+            elif isinstance(v, int):
+                av = dataclasses.replace(av, dtype="int")
+            elif isinstance(v, float):
+                av = dataclasses.replace(av, dtype="float")
+            return av
+        if isinstance(node, (ast.Tuple, ast.List)):
+            items = [self._eval(e, env, fn, axes, depth)
+                     for e in node.elts]
+            consts = tuple(i.const for i in items)
+            return AV(const=consts if all(
+                c is not None for c in consts) else None)
+        if isinstance(node, ast.Name):
+            if node.id in env:
+                return env[node.id]
+            menv = self._module_envs.get(fn.module.modname)
+            if menv is not None and node.id in menv and \
+                    node.id not in fn.module.functions:
+                return menv[node.id]
+            return self._resolve_name(node, fn)
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in env:
+                return TOP          # attribute of a local: unknown
+            return self._resolve_name(node, fn)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env, fn, axes, depth)
+        if isinstance(node, ast.BinOp):
+            left = self._eval(node.left, env, fn, axes, depth)
+            right = self._eval(node.right, env, fn, axes, depth)
+            return self._binop_result(node, left, right, fn)
+        if isinstance(node, ast.UnaryOp):
+            val = self._eval(node.operand, env, fn, axes, depth)
+            if isinstance(node.op, ast.USub) and isinstance(
+                    val.const, (int, float)):
+                return dataclasses.replace(val, const=-val.const)
+            return dataclasses.replace(val, const=None)
+        if isinstance(node, ast.Compare):
+            avs = [self._eval(node.left, env, fn, axes, depth)]
+            avs += [self._eval(c, env, fn, axes, depth)
+                    for c in node.comparators]
+            tags = frozenset().union(*(a.tags for a in avs))
+            return AV(dtype="bool", tags=tags)
+        if isinstance(node, ast.BoolOp):
+            avs = [self._eval(v, env, fn, axes, depth)
+                   for v in node.values]
+            out = avs[0]
+            for a in avs[1:]:
+                out = join(out, a)
+            return out
+        if isinstance(node, ast.IfExp):
+            self._eval(node.test, env, fn, axes, depth)
+            return join(self._eval(node.body, env, fn, axes, depth),
+                        self._eval(node.orelse, env, fn, axes, depth))
+        if isinstance(node, ast.Subscript):
+            base = self._eval(node.value, env, fn, axes, depth)
+            idx = self._eval(node.slice, env, fn, axes, depth)
+            if isinstance(base.const, tuple) and isinstance(
+                    idx.const, int) and -len(base.const) <= idx.const \
+                    < len(base.const):
+                return AV(const=base.const[idx.const],
+                          tags=base.tags)
+            return dataclasses.replace(base, const=None, func=None,
+                                       shape=None)
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, env, fn, axes, depth)
+        if isinstance(node, ast.Lambda):
+            return AV(func=FuncFacts(symbol=None))
+        return TOP
+
+    def _resolve_name(self, node, fn: FunctionInfo) -> AV:
+        """A free Name/Attribute: dtype literals, resolved defs, module
+        constants of other modules."""
+        mod = fn.module
+        parts = dotted_parts(node)
+        fq = mod.resolve_local(node)
+        if fq is not None:
+            dt = _dtype_from_fq(fq)
+            if dt is not None:
+                return AV(const=dt, dtype=dt)
+            target = self.project.function_by_fq(fq)
+            if target is not None:
+                donate = self.result.donating_defs.get(
+                    target.symbol, ())
+                return AV(func=FuncFacts(symbol=target.symbol,
+                                         donate=donate,
+                                         kind="jit" if donate
+                                         else "plain"))
+            # module-level constant in a scanned module?
+            cut = fq.rfind(".")
+            if cut > 0:
+                other = self.project.modules.get(fq[:cut])
+                if other is not None and other is not mod:
+                    oenv = self._module_envs.get(other.modname)
+                    if oenv is not None and fq[cut + 1:] in oenv:
+                        return oenv[fq[cut + 1:]]
+        if parts and len(parts) == 1:
+            local = mod.functions.get(parts[0])
+            if local is not None:
+                donate = self.result.donating_defs.get(
+                    local.symbol, ())
+                return AV(func=FuncFacts(symbol=local.symbol,
+                                         donate=donate))
+        return TOP
+
+    def _binop_result(self, node, left: AV, right: AV,
+                      fn: FunctionInfo) -> AV:
+        const = None
+        if isinstance(left.const, (int, float)) and isinstance(
+                right.const, (int, float)):
+            try:
+                const = _fold(node.op, left.const, right.const)
+            except (ZeroDivisionError, TypeError, ValueError,
+                    OverflowError):
+                const = None
+        dtype = promote_dtype(left.dtype, right.dtype)
+        out = AV(dtype=dtype, const=const,
+                 donated=left.donated or right.donated,
+                 tags=left.tags | right.tags)
+        self.result.binops.append(BinopEvent(fn, node, left, right,
+                                             out))
+        return out
+
+    # -- calls --------------------------------------------------------------
+
+    def _eval_call(self, node: ast.Call, env, fn, axes, depth) -> AV:
+        mod = fn.module
+        func_av = self._eval(node.func, env, fn, axes, depth)
+        fq = mod.resolve_local(node.func)
+        if fq is None and isinstance(node.func, ast.Name) and \
+                node.func.id in env and env[node.func.id].func and \
+                env[node.func.id].func.symbol:
+            pass                            # facts carry the target
+        args = [self._eval(a, env, fn, axes, depth)
+                for a in node.args if not isinstance(a, ast.Starred)]
+        starred = any(isinstance(a, ast.Starred) for a in node.args)
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                self._eval(a.value, env, fn, axes, depth)
+        kwargs = {kw.arg: self._eval(kw.value, env, fn, axes, depth)
+                  for kw in node.keywords if kw.arg is not None}
+
+        facts = func_av.func
+        self.result.calls.append(CallEvent(
+            fn, node, fq, facts, args if not starred else args,
+            kwargs, axes))
+
+        # -- special forms ---------------------------------------------
+        if fq in JIT_FQS and node.args:
+            inner = args[0].func if args else None
+            out = self._facts_from_jit_kwargs(
+                node.keywords,
+                inner.symbol if inner else None, inner)
+            return AV(func=out)
+        if fq in PARTIAL_FQS and node.args:
+            inner_fq = mod.resolve(node.args[0])
+            if inner_fq in JIT_FQS:
+                return AV(func=self._facts_from_jit_kwargs(
+                    node.keywords, None))
+            if args and args[0].func is not None:
+                return args[0]          # partial(f, …) keeps f's facts
+        if fq in SHARD_MAP_FQS:
+            body_facts = args[0].func if args else None
+            mesh_axes = None
+            mesh_av = kwargs.get("mesh") or (args[1] if len(args) > 1
+                                             else None)
+            if mesh_av is not None and mesh_av.func and \
+                    mesh_av.func.axes:
+                mesh_axes = mesh_av.func.axes
+            if mesh_av is not None and mesh_av.const is None and \
+                    mesh_axes is None and isinstance(
+                        mesh_av.tags, frozenset):
+                for t in mesh_av.tags:
+                    if t.startswith("mesh:"):
+                        mesh_axes = frozenset(
+                            t[len("mesh:"):].split(","))
+            return AV(func=FuncFacts(
+                symbol=body_facts.symbol if body_facts else None,
+                axes=mesh_axes, kind="shard_map"))
+        if fq in MESH_FQS or (fq or "").endswith(".Mesh"):
+            ax = kwargs.get("axis_names") or (args[1] if len(args) > 1
+                                              else None)
+            names = None
+            if ax is not None:
+                if isinstance(ax.const, str):
+                    names = frozenset((ax.const,))
+                elif isinstance(ax.const, tuple) and all(
+                        isinstance(v, str) for v in ax.const):
+                    names = frozenset(ax.const)
+            if names:
+                return AV(tags=frozenset(
+                    ("mesh:" + ",".join(sorted(names)),)))
+            return TOP
+        if fq in COLLECTIVES:
+            pos = COLLECTIVES[fq]
+            axis_av = kwargs.get("axis_name") or kwargs.get("axis") \
+                or (args[pos] if len(args) > pos else TOP)
+            self.result.collectives.append(CollectiveEvent(
+                fn, node, fq, axis_av, axes))
+            if fq == "jax.lax.axis_index":
+                return AV(dtype="int32", tags=frozenset(
+                    ("axis_index",)))
+            return args[0] if args else TOP
+        if fq in CTRL_FLOW:
+            body_pos, init_pos = CTRL_FLOW[fq]
+            body_av = args[body_pos] if len(args) > body_pos else TOP
+            init_av = args[init_pos] if len(args) > init_pos else TOP
+            return self._apply(body_av.func, [init_av], axes,
+                               depth) or init_av
+        if fq == "jax.lax.cond":
+            outs = []
+            for branch in args[1:3]:
+                got = self._apply(branch.func,
+                                  [a for a in args[3:]], axes, depth)
+                if got is not None:
+                    outs.append(got)
+            if outs:
+                out = outs[0]
+                for o in outs[1:]:
+                    out = join(out, o)
+                return out
+            return TOP
+        if fq in PADDING_HELPERS or (
+                fq or "").rsplit(".", 1)[-1] in (
+                    "round_up_to_multiple", "resolve_tn_sw"):
+            base = args[0] if args else TOP
+            return dataclasses.replace(
+                base, const=None, tags=base.tags | {"padded"})
+        if fq in _SHAPED_CTORS:
+            shape_av = args[0] if args else kwargs.get("shape", TOP)
+            dtype_av = kwargs.get("dtype") or (
+                args[1] if fq.endswith((".zeros", ".ones", ".empty"))
+                and len(args) > 1 else
+                args[2] if len(args) > 2 else None)
+            shape = None
+            if isinstance(shape_av.const, tuple) and all(
+                    isinstance(v, int) for v in shape_av.const):
+                shape = shape_av.const
+            elif isinstance(shape_av.const, int):
+                shape = (shape_av.const,)
+            dt = _dtype_of_av(dtype_av)
+            if dt is None and dtype_av is None and \
+                    fq.startswith("jax."):
+                dt = "float32"      # jnp default; an EXPLICIT but
+                # unresolvable dtype arg must stay unknown, and numpy
+                # ctors (host-side f64 world) never default
+            return AV(shape=shape, dtype=dt)
+        if fq in ("jax.numpy.asarray", "jax.numpy.array",
+                  "numpy.asarray", "numpy.array"):
+            base = args[0] if args else TOP
+            dt = _dtype_of_av(kwargs.get("dtype") or (
+                args[1] if len(args) > 1 else None))
+            return AV(shape=base.shape, dtype=dt or base.dtype,
+                      tags=base.tags)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "astype":
+            base = self._eval(node.func.value, env, fn, axes, depth)
+            dt = _dtype_of_av(args[0] if args else None)
+            return dataclasses.replace(base, dtype=dt, const=None)
+
+        # -- resolved project function: interprocedural ------------------
+        target_sym = None
+        if facts is not None and facts.symbol:
+            target_sym = facts.symbol
+        elif fq is not None:
+            t = self.project.function_by_fq(fq)
+            if t is not None:
+                target_sym = t.symbol
+        if target_sym is not None:
+            target = self.table.get(target_sym)
+            if target is not None:
+                inner_axes = axes
+                if facts is not None and facts.axes is not None:
+                    inner_axes = (facts.axes if axes is None
+                                  else axes | facts.axes)
+                donated_args = args
+                if facts is not None and facts.donate:
+                    donated_args = list(args)
+                    for i in facts.donate:
+                        if i < len(donated_args):
+                            donated_args[i] = dataclasses.replace(
+                                donated_args[i], donated=True)
+                if args is not None and (
+                        any(a is not TOP for a in donated_args)
+                        or inner_axes is not None):
+                    summ = self._interpret(target, donated_args,
+                                           inner_axes, depth + 1)
+                else:
+                    summ = self.top_summary(target)
+                return summ.returns
+        return TOP
+
+    def _apply(self, facts: Optional[FuncFacts], args: List[AV],
+               axes, depth) -> Optional[AV]:
+        """Interpret a callable value with explicit args (the lax
+        control-flow body path). None when the target is unknown."""
+        if facts is None or facts.symbol is None:
+            return None
+        target = self.table.get(facts.symbol)
+        if target is None:
+            return None
+        inner_axes = axes
+        if facts.axes is not None:
+            inner_axes = (facts.axes if axes is None
+                          else axes | facts.axes)
+        return self._interpret(target, args, inner_axes,
+                               depth + 1).returns
+
+
+class _Ret:
+    def __init__(self) -> None:
+        self.seen = False
+        self.value = TOP
+
+    def add(self, av: AV) -> None:
+        self.value = av if not self.seen else join(self.value, av)
+        self.seen = True
+
+
+def _merge_branches(env, a, b) -> None:
+    for name in set(a) | set(b):
+        if name in a and name in b:
+            env[name] = join(a[name], b[name])
+        else:
+            present = a.get(name, b.get(name))
+            env[name] = join(env[name], present) if name in env \
+                else present
+
+
+def _literal(node):
+    """Fold a Constant / tuple-of-Constant AST node to python."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = [_literal(e) for e in node.elts]
+        if all(v is not None for v in vals):
+            return tuple(vals)
+    return None
+
+
+def _fold(op, a, b):
+    if isinstance(op, ast.Add):
+        return a + b
+    if isinstance(op, ast.Sub):
+        return a - b
+    if isinstance(op, ast.Mult):
+        return a * b
+    if isinstance(op, ast.FloorDiv):
+        return a // b
+    if isinstance(op, ast.Mod):
+        return a % b
+    if isinstance(op, ast.Div):
+        return a / b
+    raise ValueError("unfoldable")
+
+
+def _dtype_from_fq(fq: str) -> Optional[str]:
+    tail = fq.rsplit(".", 1)[-1]
+    root = fq.split(".", 1)[0]
+    if root in ("jax", "numpy") and tail in _DTYPES:
+        return _DTYPES[tail]
+    return None
+
+
+def _dtype_of_av(av: Optional[AV]) -> Optional[str]:
+    if av is None:
+        return None
+    if isinstance(av.const, str) and av.const in _DTYPES:
+        return _DTYPES[av.const]
+    if av.dtype in _DTYPES:
+        return av.dtype
+    return None
+
+
+# -- utilities shared by the rules -------------------------------------------
+
+
+def parent_map(fn: FunctionInfo) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(fn.node):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    return parents
+
+
+def _pos(node) -> Tuple[int, int]:
+    return (getattr(node, "end_lineno", node.lineno),
+            getattr(node, "end_col_offset", node.col_offset))
+
+
+def reads_after(fn: FunctionInfo, call: ast.Call, name: str,
+                ) -> Optional[ast.Name]:
+    """First lexical READ of ``name`` after ``call`` inside ``fn`` that
+    is not preceded by a rebind — the use-after-donate witness. Lexical
+    order approximates execution order (good enough for the
+    straight-line bodies the donation idiom lives in); the containing
+    statement of the call itself is excluded, so ``x = f(x)`` stays
+    clean."""
+    cpos = _pos(call)
+    own = {id(n) for n in ast.walk(call)}
+    first_read = first_store = None
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Name) or node.id != name:
+            continue
+        if id(node) in own:
+            continue
+        npos = (node.lineno, node.col_offset)
+        if npos <= cpos:
+            continue
+        if isinstance(node.ctx, ast.Store):
+            if first_store is None or npos < _pos_key(first_store):
+                first_store = node
+        elif isinstance(node.ctx, ast.Load):
+            if first_read is None or npos < _pos_key(first_read):
+                first_read = node
+    if first_read is None:
+        return None
+    if first_store is not None and \
+            _pos_key(first_store) < _pos_key(first_read):
+        return None
+    return first_read
+
+
+def _pos_key(node) -> Tuple[int, int]:
+    return (node.lineno, node.col_offset)
+
+
+def enclosing_loop(parents: Dict[ast.AST, ast.AST],
+                   node: ast.AST) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.While)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return None
+        cur = parents.get(cur)
+    return None
+
+
+def stores_in(node: ast.AST, name: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == name and \
+                isinstance(sub.ctx, ast.Store):
+            return True
+    return False
+
+
+# -- entry point -------------------------------------------------------------
+
+
+def analyze(project: Project) -> DataflowResult:
+    """Run (or fetch the memoized) dataflow analysis for a Project."""
+    got = getattr(project, "_raftlint_dataflow", None)
+    if got is not None:
+        return got
+    result = DataflowResult()
+    _Interp(project, result).run()
+    project._raftlint_dataflow = result
+    return result

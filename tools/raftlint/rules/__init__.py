@@ -9,6 +9,12 @@ from tools.raftlint.rules.r6_obs_imports import ObsBoundaryRule
 from tools.raftlint.rules.r7_env import EnvDisciplineRule
 from tools.raftlint.rules.r8_numeric import NumericHygieneRule
 from tools.raftlint.rules.r9_epilogue import EpilogueLayerRule
+from tools.raftlint.rules.r10_donation import DonationSafetyRule
+from tools.raftlint.rules.r11_collectives import \
+    CollectiveDisciplineRule
+from tools.raftlint.rules.r12_layout import LayoutPromotionRule
+from tools.raftlint.rules.r13_costmodel import CostModelRule
+from tools.raftlint.rules.r14_imports import ImportResolutionRule
 
 ALL_RULES = (
     JitPurityRule,
@@ -20,6 +26,11 @@ ALL_RULES = (
     EnvDisciplineRule,
     NumericHygieneRule,
     EpilogueLayerRule,
+    DonationSafetyRule,
+    CollectiveDisciplineRule,
+    LayoutPromotionRule,
+    CostModelRule,
+    ImportResolutionRule,
 )
 
 __all__ = ["ALL_RULES"]

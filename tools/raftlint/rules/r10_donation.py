@@ -1,0 +1,173 @@
+"""R10: donation safety — donated buffers die at the call site.
+
+``donate_argnums`` hands the argument's buffer to XLA for reuse; the
+Python name still points at it, but the array is dead. The chaos suites
+catch the resulting garbage reads dynamically; all three shapes of the
+bug are statically decidable once the dataflow engine has resolved
+which callables donate:
+
+- **use-after-donate** — a name passed at a donated position and read
+  again after the call (reads through the rebound result, ``x = f(x)``,
+  are fine; reads of the stale operand are not);
+- **stale loop carry** — a donated name fed to the call from outside a
+  host loop and never rebound inside it: iteration 2 passes the buffer
+  iteration 1 already donated;
+- **vacuous donation** — ``donate_argnums`` naming a parameter the body
+  never consumes: the donation frees nothing and documents an aliasing
+  contract that does not exist.
+
+The engine resolves donation facts through decorators
+(``@partial(jax.jit, donate_argnums=…)``), direct ``jax.jit(f, …)``
+wraps, and jit-of-``shard_map`` stacks; variable donate positions and
+``*args`` call sites stay silent (conservative-by-construction).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.raftlint import dataflow
+from tools.raftlint.core import Finding, FunctionInfo, Project
+from tools.raftlint.rules.base import Rule
+
+
+def _enclosing_stmt(parents, node):
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = parents.get(cur)
+    return cur
+
+
+def _rebinds_name(stmt, name: str) -> bool:
+    """The call's own statement stores the name (``x = f(x)``,
+    ``x, y = f(x)``, ``x += …``) — the donated operand is rebound the
+    moment the call returns, so no stale read through it can follow."""
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        for tgt in targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return True
+    return False
+
+
+class DonationSafetyRule(Rule):
+    id = "R10"
+    summary = ("buffer read after being donated, donated loop carry "
+               "never rebound, or donation on an unconsumed argument")
+    rationale = ("donate_argnums invalidates the operand buffer at the "
+                 "call — a later read through the old name returns "
+                 "whatever XLA wrote into the reused pages, the exact "
+                 "garbage the double-buffer chaos suites hunt "
+                 "dynamically")
+
+    def run(self, project: Project) -> List[Finding]:
+        df = dataflow.analyze(project)
+        findings: List[Finding] = []
+        seen: Set[Tuple] = set()
+        pmaps: Dict[str, dict] = {}
+
+        def emit(kind, path, line, col, sym, msg, hint):
+            key = (kind, path, line, col, sym)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(self.id, path, line, col, sym,
+                                    msg, hint))
+
+        for ev in df.calls:
+            donate = ev.facts.donate if ev.facts else ()
+            if not donate:
+                continue
+            if any(isinstance(a, ast.Starred) for a in ev.node.args):
+                continue            # positions unknowable
+            fn = ev.fn
+            pm = pmaps.get(fn.symbol)
+            if pm is None:
+                pm = dataflow.parent_map(fn)
+                pmaps[fn.symbol] = pm
+            stmt = _enclosing_stmt(pm, ev.node)
+            for pos in donate:
+                if pos >= len(ev.node.args):
+                    continue
+                arg = ev.node.args[pos]
+                if not isinstance(arg, ast.Name):
+                    continue        # temporaries die anyway
+                name = arg.id
+                rebound = stmt is not None and _rebinds_name(stmt, name)
+                if not rebound:
+                    read = dataflow.reads_after(fn, ev.node, name)
+                    if read is not None:
+                        emit("use", fn.module.relpath, read.lineno,
+                             read.col_offset, fn.symbol,
+                             f"'{name}' is read after being donated "
+                             f"at line {ev.node.lineno} "
+                             f"(donate position {pos})",
+                             "rebind the result over the operand "
+                             "(x = f(x)) or stage a fresh buffer per "
+                             "call (device_put before the donating "
+                             "launch)")
+                        continue
+                loop = dataflow.enclosing_loop(pm, ev.node)
+                if loop is not None and not dataflow.stores_in(
+                        loop, name):
+                    emit("loop", fn.module.relpath, ev.node.lineno,
+                         ev.node.col_offset, fn.symbol,
+                         f"'{name}' is donated inside a loop but "
+                         "never rebound in the loop body — iteration "
+                         "2 passes a buffer iteration 1 already gave "
+                         "away",
+                         "carry the call result back into the name "
+                         "(x = f(x)) or allocate per iteration")
+
+        # vacuous donation: donate positions naming params the body
+        # never loads — both decorated defs and jit(f, donate_argnums=…)
+        table = project.symbol_table()
+        vacuous: Dict[str, Set[int]] = {}
+        for sym, positions in df.donating_defs.items():
+            vacuous.setdefault(sym, set()).update(positions)
+        for ev in df.calls:
+            if ev.fq not in dataflow.JIT_FQS or not ev.args:
+                continue
+            inner = ev.args[0].func
+            if inner is None or inner.symbol is None:
+                continue
+            for kw in ev.node.keywords:
+                if kw.arg in ("donate_argnums", "donate_argnames"):
+                    lit = dataflow._literal(kw.value)
+                    pos = (lit,) if isinstance(lit, int) else (
+                        lit if isinstance(lit, tuple) else ())
+                    vacuous.setdefault(inner.symbol, set()).update(
+                        p for p in pos if isinstance(p, int))
+        for sym, positions in sorted(vacuous.items()):
+            fn = table.get(sym)
+            if fn is None:
+                continue
+            params = self._params(fn)
+            loads = {n.id for n in ast.walk(fn.node)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)}
+            for pos in sorted(positions):
+                if pos >= len(params):
+                    continue
+                pname = params[pos]
+                if pname not in loads:
+                    emit("vacuous", fn.module.relpath,
+                         fn.node.lineno, fn.node.col_offset,
+                         fn.symbol,
+                         f"donate_argnums names '{pname}' (position "
+                         f"{pos}) but the body never consumes it — "
+                         "the donation frees nothing",
+                         "drop the position from donate_argnums or "
+                         "consume the buffer")
+        findings.sort(key=lambda f: (f.path, f.line, f.col))
+        return findings
+
+    @staticmethod
+    def _params(fn: FunctionInfo) -> List[str]:
+        a = getattr(fn.node, "args", None)
+        if a is None:
+            return []
+        return [p.arg for p in a.posonlyargs + a.args]

@@ -1,0 +1,214 @@
+"""R11: collective discipline — axis names, rank-uniform reachability,
+and tag-matched mailbox traffic.
+
+Three statically decidable shapes of the deadlock class
+``tests/test_serve_chaos.py`` hunts dynamically:
+
+- **unbound axis name** — ``lax.psum(x, "rows")`` under a
+  ``shard_map`` whose mesh binds only ``("data",)``: the collective
+  either crashes at trace time or, worse, resolves against an outer
+  mesh nobody intended. The dataflow engine tracks the axis names each
+  ``shard_map`` application brings into scope (through jit wrapping and
+  nested maps) and checks every literal axis-name use against them;
+  unknown scopes stay silent.
+- **rank-divergent collective** — a ``lax.cond`` whose predicate is
+  derived from ``lax.axis_index`` and whose arms do not agree on
+  whether a collective runs: ranks take different arms and the
+  collective's rendezvous never completes. The predicate's provenance
+  rides the engine's ``axis_index`` origin tag through arithmetic and
+  compares.
+- **unmatched mailbox tag** — a literal-tag ``isend``/``mailbox.put``
+  with no ``irecv``/``mailbox.get`` anywhere in the scanned tree using
+  the same tag (or vice versa): the peer half of a
+  ``search_local``/``merge_pool``-style pair is missing and the
+  blocking side waits forever. Computed tags stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raftlint import dataflow
+from tools.raftlint.core import Finding, ModuleInfo, Project
+from tools.raftlint.rules.base import Rule
+
+SEND_ATTRS = {"isend": 2, "put": 2}         # attr → positional tag idx
+RECV_ATTRS = {"irecv": 1, "get": 2, "get_nowait": 2}
+
+
+def _mailboxish(func: ast.AST) -> Optional[str]:
+    """'send'/'recv' when the call is mailbox traffic: ``isend``/
+    ``irecv`` on anything, ``put``/``get*`` only on an attribute chain
+    that names a mailbox (``self._mailbox.put``) — bare dict/queue
+    put/get stay out."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    if attr in ("isend",):
+        return "send"
+    if attr in ("irecv",):
+        return "recv"
+    if attr in SEND_ATTRS or attr in RECV_ATTRS:
+        parts = dataflow.dotted_parts(func) or []
+        if any("mailbox" in p.lower() for p in parts[:-1]):
+            return "send" if attr in SEND_ATTRS else "recv"
+    return None
+
+
+def _literal_tag(call: ast.Call, attr: str) -> Optional[int]:
+    for kw in call.keywords:
+        if kw.arg == "tag" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, int):
+            return kw.value.value
+        if kw.arg == "tag":
+            return None
+    pos = SEND_ATTRS.get(attr, RECV_ATTRS.get(attr))
+    if pos is not None and len(call.args) > pos:
+        node = call.args[pos]
+        if isinstance(node, ast.Constant) and isinstance(
+                node.value, int):
+            return node.value
+    return None
+
+
+class CollectiveDisciplineRule(Rule):
+    id = "R11"
+    summary = ("collective axis name unbound by the enclosing "
+               "shard_map, collective under a rank-dependent cond "
+               "arm, or mailbox tag with no matching peer")
+    rationale = ("every one of these is a distributed hang, not a "
+                 "wrong answer: the static forms of the rendezvous "
+                 "deadlocks the serve chaos suite can only catch when "
+                 "the unlucky schedule actually fires")
+
+    def run(self, project: Project) -> List[Finding]:
+        df = dataflow.analyze(project)
+        table = project.symbol_table()
+        findings: List[Finding] = []
+        seen: Set[Tuple] = set()
+
+        def emit(path, line, col, sym, msg, hint):
+            key = (path, line, col, msg)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(self.id, path, line, col, sym,
+                                    msg, hint))
+
+        # -- (a) axis names vs the statically known scope ----------------
+        # one syntactic site can be observed under several contexts
+        # (a nested body is also interpreted standalone, where the
+        # outer mesh is invisible), so flag a name only when NO
+        # observed scope binds it — any binding context vindicates
+        # the site
+        sites: Dict[Tuple, list] = {}
+        for ev in df.collectives:
+            if ev.axes_scope is None:
+                continue            # scope unknown: stay silent
+            key = (ev.fn.module.relpath, ev.node.lineno,
+                   ev.node.col_offset)
+            sites.setdefault(key, [ev, set()])[1] |= ev.axes_scope
+        for key in sorted(sites):
+            ev, scope = sites[key]
+            names = []
+            if isinstance(ev.axis.const, str):
+                names = [ev.axis.const]
+            elif isinstance(ev.axis.const, tuple):
+                names = [a for a in ev.axis.const
+                         if isinstance(a, str)]
+            for name in names:
+                if name not in scope:
+                    emit(ev.fn.module.relpath, ev.node.lineno,
+                         ev.node.col_offset, ev.fn.symbol,
+                         f"{ev.fq.rsplit('.', 1)[-1]} over axis "
+                         f"'{name}' but the enclosing shard_map mesh "
+                         f"binds only "
+                         f"{sorted(scope) or ['<none>']}",
+                         "use an axis name from the mesh spec, or "
+                         "thread the axis through as a parameter")
+
+        # -- (b) rank-divergent lax.cond arms ----------------------------
+        for ev in df.calls:
+            if ev.fq != "jax.lax.cond" or not ev.args:
+                continue
+            if "axis_index" not in ev.args[0].tags:
+                continue
+            counts = []
+            for branch in ev.args[1:3]:
+                sym = branch.func.symbol if branch.func else None
+                fn = table.get(sym) if sym else None
+                counts.append(self._collective_count(fn)
+                              if fn is not None else None)
+            if len(counts) == 2 and None not in counts and \
+                    (counts[0] == 0) != (counts[1] == 0):
+                emit(ev.fn.module.relpath, ev.node.lineno,
+                     ev.node.col_offset, ev.fn.symbol,
+                     "lax.cond predicate derives from lax.axis_index "
+                     "and only one arm runs a collective — ranks "
+                     "taking different arms deadlock the rendezvous",
+                     "hoist the collective out of the cond, or make "
+                     "both arms participate (reduce a zero "
+                     "contribution on the idle arm)")
+
+        # -- (c) mailbox tag pairing -------------------------------------
+        sends: Dict[int, List] = {}
+        recvs: Dict[int, List] = {}
+        for mod in project.modules.values():
+            for sym, node in _walk_with_symbols(mod):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _mailboxish(node.func)
+                if kind is None:
+                    continue
+                tag = _literal_tag(node, node.func.attr)
+                if tag is None:
+                    continue
+                (sends if kind == "send" else recvs).setdefault(
+                    tag, []).append((mod, sym, node))
+        for tag, sites in sorted(sends.items()):
+            if tag in recvs:
+                continue
+            for mod, sym, node in sites:
+                emit(mod.relpath, node.lineno, node.col_offset, sym,
+                     f"mailbox send with literal tag {tag} has no "
+                     "matching tagged recv anywhere in the scanned "
+                     "tree",
+                     "add the peer-half recv, or derive both tags "
+                     "from one shared constant")
+        for tag, sites in sorted(recvs.items()):
+            if tag in sends:
+                continue
+            for mod, sym, node in sites:
+                emit(mod.relpath, node.lineno, node.col_offset, sym,
+                     f"mailbox recv with literal tag {tag} has no "
+                     "matching tagged send anywhere in the scanned "
+                     "tree — the blocking get waits forever",
+                     "add the peer-half send, or derive both tags "
+                     "from one shared constant")
+        findings.sort(key=lambda f: (f.path, f.line, f.col))
+        return findings
+
+    @staticmethod
+    def _collective_count(fn) -> int:
+        mod = fn.module
+        n = 0
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                fq = mod.resolve(node.func)
+                if fq in dataflow.COLLECTIVES and \
+                        fq != "jax.lax.axis_index":
+                    n += 1
+        return n
+
+
+def _walk_with_symbols(mod: ModuleInfo):
+    by_node = {info.node: f"{mod.modname}:{qual}"
+               for qual, info in mod.functions.items()}
+
+    def walk(node, sym):
+        for child in ast.iter_child_nodes(node):
+            child_sym = by_node.get(child, sym)
+            yield child_sym, child
+            yield from walk(child, child_sym)
+    yield from walk(mod.tree, f"{mod.modname}:<module>")

@@ -1,0 +1,150 @@
+"""R12: layout and promotion hazards at the kernel boundary.
+
+Two hazards the Mosaic lowering and the numerics ladder otherwise only
+surface at run time:
+
+- **misaligned tile parameters** — a statically known int flowing into
+  a tile/strip parameter of a ``linalg/contractions.py`` or
+  ``matrix/epilogue.py`` entry point that violates the hardware
+  alignment the kernels assume: lane-dim parameters (``tn``, ``sw``,
+  ``bw``) must divide by 128, sublane-dim parameters (``tm``) by 8.
+  Values produced through the documented padding helpers
+  (``round_up_to_multiple``, ``resolve_tn_sw``, ``best_width``,
+  ``_pad2``) carry the engine's ``padded`` tag and are exempt — the
+  rule polices the *bypass*, not the helpers. Unknown values stay
+  silent; calls from inside the two kernel modules themselves are
+  implementation plumbing and exempt.
+- **silent f64 promotion** — mixed-dtype arithmetic whose NumPy-style
+  result dtype is ``float64`` with a narrower float on the other side:
+  ``util/numerics.py``'s precision ladder tops out at ``highest`` on
+  device (f64 is host-only), so an f32×f64 product silently doubles
+  bandwidth on CPU reference paths and fails to lower on TPU. Python
+  float literals are weakly typed and never flag.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.raftlint import dataflow
+from tools.raftlint.core import Finding, Project
+from tools.raftlint.rules.base import Rule
+
+POLICED_MODULES = ("raft_tpu.linalg.contractions",
+                   "raft_tpu.matrix.epilogue")
+
+#: tile parameter name → required divisor (lane dims 128, sublanes 8)
+PARAM_MODULUS = {"tm": 8, "tn": 128, "sw": 128, "bw": 128}
+
+#: positional signatures for the policed entry points, used when the
+#: target module is outside the scan set (subset lints still resolve
+#: keyword args either way)
+FALLBACK_SIGS: Dict[str, Sequence[str]] = {
+    "raft_tpu.matrix.epilogue.insert_drain":
+        ("dist", "val_ref", "idx_ref", "j", "tn", "k", "n_valid",
+         "sw"),
+    "raft_tpu.matrix.epilogue.resolve_tn_sw": ("tn", "sw", "n"),
+    "raft_tpu.linalg.contractions.pairwise_pallas":
+        ("x", "y", "metric", "tm", "tn"),
+}
+
+#: the sanctioned alignment helpers never flag, even on literal args —
+#: their whole job is taking unaligned values
+HELPER_FQS = dataflow.PADDING_HELPERS | {
+    "raft_tpu.matrix.epilogue.resolve_tn_sw"}
+
+NARROW_FLOATS = ("float32", "bfloat16", "float16")
+
+
+class LayoutPromotionRule(Rule):
+    id = "R12"
+    summary = ("tile parameter with lane dim not divisible by 128 / "
+               "sublane not by 8 bypassing the padding helpers, or "
+               "arithmetic silently promoting to float64")
+    rationale = ("a misaligned tile either fails Mosaic legalization "
+                 "at warm time or pads per-launch inside the kernel; "
+                 "an accidental f64 operand doubles reference-path "
+                 "bandwidth and cannot lower on TPU — both are "
+                 "documented contracts with one sanctioned helper "
+                 "spelling each")
+
+    def run(self, project: Project) -> List[Finding]:
+        df = dataflow.analyze(project)
+        findings: List[Finding] = []
+        seen: Set[Tuple] = set()
+
+        def emit(path, line, col, sym, msg, hint):
+            key = (path, line, col, msg)
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(self.id, path, line, col, sym,
+                                    msg, hint))
+
+        for ev in df.calls:
+            fq = ev.fq
+            if fq is None and ev.facts is not None and ev.facts.symbol:
+                fq = ev.facts.symbol.replace(":", ".")
+            if fq is None or fq in HELPER_FQS:
+                continue
+            owner = fq.rsplit(".", 1)[0]
+            if owner not in POLICED_MODULES:
+                continue
+            if ev.fn.module.modname in POLICED_MODULES:
+                continue            # internal plumbing
+            if any(isinstance(a, ast.Starred) for a in ev.node.args):
+                continue
+            params = self._params_for(project, fq)
+            named = dict(ev.keywords)
+            if params is not None:
+                for i, av in enumerate(ev.args):
+                    if i < len(params):
+                        named.setdefault(params[i], av)
+            for pname, av in named.items():
+                mod = PARAM_MODULUS.get(pname)
+                if mod is None or not isinstance(av.const, int):
+                    continue
+                if pname == "sw" and av.const == 0:
+                    continue        # 0 = whole-tile drain, legal
+                if av.const % mod == 0 or "padded" in av.tags:
+                    continue
+                kind = "sublane" if mod == 8 else "lane"
+                emit(ev.fn.module.relpath, ev.node.lineno,
+                     ev.node.col_offset, ev.fn.symbol,
+                     f"{fq.rsplit('.', 1)[-1]}({pname}={av.const}): "
+                     f"{kind} tile parameter not divisible by {mod} "
+                     "and not produced by a padding helper",
+                     "route the value through "
+                     "epilogue.resolve_tn_sw / "
+                     "util.math.round_up_to_multiple before the "
+                     "kernel boundary")
+
+        for ev in df.binops:
+            if ev.result.dtype != "float64":
+                continue
+            sides = (ev.left.dtype, ev.right.dtype)
+            if not any(d in NARROW_FLOATS for d in sides):
+                continue
+            if ev.fn.module.modname == "raft_tpu.util.numerics":
+                continue            # the ladder itself
+            emit(ev.fn.module.relpath, ev.node.lineno,
+                 ev.node.col_offset, ev.fn.symbol,
+                 f"arithmetic between {sides[0]} and {sides[1]} "
+                 "silently promotes to float64, past the numerics "
+                 "precision ladder (f64 is host-only)",
+                 "cast the f64 side down explicitly, or raise "
+                 "precision through util.numerics' ladder instead "
+                 "of dtype widening")
+        findings.sort(key=lambda f: (f.path, f.line, f.col))
+        return findings
+
+    @staticmethod
+    def _params_for(project: Project,
+                    fq: str) -> Optional[Sequence[str]]:
+        target = project.function_by_fq(fq)
+        if target is not None:
+            a = getattr(target.node, "args", None)
+            if a is not None:
+                return [p.arg for p in a.posonlyargs + a.args]
+        return FALLBACK_SIGS.get(fq)

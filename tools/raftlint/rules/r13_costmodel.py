@@ -1,0 +1,200 @@
+"""R13: cost-model coverage — the estimator tables cannot drift.
+
+``runtime/limits.py`` prices ops twice: ``_ESTIMATORS`` (HBM footprint
+for admission) and ``_SECONDS_ESTIMATORS`` (the ``(flops, bytes)``
+models behind ``estimate_flops_bytes``/``estimate_seconds`` that seed
+chunk admission AND the PR-13 roofline denominators). The serve
+executor warms and quotes against these by string op name. Three drift
+shapes, all statically decidable from the dict literals and the
+estimator signatures:
+
+- an op priced by ``estimate_bytes`` (and therefore warmable by the
+  serve executor) with **no** ``estimate_flops_bytes`` model — its
+  roofline attribution silently falls back or raises at runtime;
+- an op present in both tables whose **required dim signatures
+  disagree** — a call site satisfying one model crashes the other;
+- a **call site** passing a literal op name that is missing from the
+  table it targets, or kwargs that do not satisfy the estimator's
+  required dims.
+
+Keyword-only parameters with defaults are optional dims; ``**dims``
+call sites and non-literal op names stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raftlint.core import Finding, FunctionInfo, ModuleInfo, \
+    Project
+from tools.raftlint.rules.base import Rule
+
+LIMITS_MODULE = "raft_tpu.runtime.limits"
+BYTES_TABLE = "_ESTIMATORS"
+FB_TABLE = "_SECONDS_ESTIMATORS"
+
+#: public pricing entry point → which table serves it
+ENTRY_TABLE = {
+    "estimate_bytes": BYTES_TABLE,
+    "estimate_flops_bytes": FB_TABLE,
+    "estimate_seconds": FB_TABLE,
+}
+#: kwargs of the entry points that are not estimator dims
+NON_DIM_KWARGS = {"backend"}
+
+
+def _dict_literal(mod: ModuleInfo, name: str) -> Optional[Dict]:
+    """{op: FunctionInfo|None} from a module-level ``name = {...}``
+    dict literal with string keys and Name values."""
+    for node in mod.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None
+        out: Dict[str, Tuple[Optional[FunctionInfo], ast.AST]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            fn = None
+            if isinstance(v, ast.Name):
+                fn = mod.functions.get(v.id)
+            out[k.value] = (fn, k)
+        return out
+    return None
+
+
+def _dims(fn: FunctionInfo) -> Tuple[Set[str], Set[str]]:
+    """(required, all) keyword-only dim names of an estimator."""
+    a = fn.node.args
+    names = [p.arg for p in a.kwonlyargs]
+    required = {p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults)
+                if d is None}
+    # positional params count as required dims too (estimators are
+    # conventionally kw-only, but a drifted def should still compare)
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    required |= set(pos[:len(pos) - len(a.defaults or ())])
+    return required, set(names) | set(pos)
+
+
+class CostModelRule(Rule):
+    id = "R13"
+    summary = ("op priced for admission with no flops/bytes model, "
+               "dim-signature drift between the estimator tables, or "
+               "a call site off the table")
+    rationale = ("the serve executor's warm quotes, the chunk "
+                 "admission deadline checks, and the roofline "
+                 "attribution denominators all index these tables by "
+                 "op string — a missing or drifted entry turns a "
+                 "static pre-launch decision into a runtime "
+                 "ValueError on the serving path")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        limits = None
+        for mod in project.modules.values():
+            if mod.modname == LIMITS_MODULE or (
+                    mod.modname.endswith(".runtime.limits")):
+                limits = mod
+                break
+        if limits is None:
+            return findings         # subset scan: nothing to check
+        sym = f"{limits.modname}:<module>"
+        bytes_tab = _dict_literal(limits, BYTES_TABLE) or {}
+        fb_tab = _dict_literal(limits, FB_TABLE) or {}
+
+        for op, (bfn, knode) in sorted(bytes_tab.items()):
+            if op not in fb_tab:
+                findings.append(Finding(
+                    self.id, limits.relpath, knode.lineno,
+                    knode.col_offset, sym,
+                    f"op '{op}' is priced by {BYTES_TABLE} but has no "
+                    f"{FB_TABLE} entry — estimate_flops_bytes raises "
+                    "for an op the executor warms and quotes",
+                    "add a flops/bytes estimator with the same "
+                    "required dims as the footprint estimator"))
+                continue
+            ffn = fb_tab[op][0]
+            if bfn is None or ffn is None:
+                continue
+            breq, _ = _dims(bfn)
+            freq, _ = _dims(ffn)
+            if breq != freq:
+                findings.append(Finding(
+                    self.id, limits.relpath, knode.lineno,
+                    knode.col_offset, sym,
+                    f"op '{op}' dim signature drift: {BYTES_TABLE} "
+                    f"requires {sorted(breq)} but {FB_TABLE} requires "
+                    f"{sorted(freq)}",
+                    "one op string, one dim vocabulary — mirror the "
+                    "required keyword-only params"))
+
+        # call sites across the scanned tree
+        by_table = {BYTES_TABLE: bytes_tab, FB_TABLE: fb_tab}
+        for mod in project.modules.values():
+            for fsym, node in _walk_with_symbols(mod):
+                if not isinstance(node, ast.Call):
+                    continue
+                fq = mod.resolve_local(node.func) or ""
+                entry = fq.rsplit(".", 1)[-1]
+                if entry not in ENTRY_TABLE or \
+                        ".limits." not in f".{fq}" and not \
+                        fq.startswith(f"{limits.modname}."):
+                    continue
+                table = by_table[ENTRY_TABLE[entry]]
+                if not node.args or not (
+                        isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue        # dynamic op name: silent
+                op = node.args[0].value
+                if op not in table:
+                    findings.append(Finding(
+                        self.id, mod.relpath, node.lineno,
+                        node.col_offset, fsym,
+                        f"{entry}({op!r}) but "
+                        f"{ENTRY_TABLE[entry]} has no such op "
+                        f"(known: {sorted(table)})",
+                        "register the op's estimator or fix the "
+                        "string"))
+                    continue
+                fn = table[op][0]
+                if fn is None or any(kw.arg is None
+                                     for kw in node.keywords):
+                    continue        # **dims call site: silent
+                required, allowed = _dims(fn)
+                passed = {kw.arg for kw in node.keywords} \
+                    - NON_DIM_KWARGS
+                missing = required - passed
+                unknown = passed - allowed
+                if missing or unknown:
+                    what = []
+                    if missing:
+                        what.append(f"missing dims {sorted(missing)}")
+                    if unknown:
+                        what.append(f"unknown dims {sorted(unknown)}")
+                    findings.append(Finding(
+                        self.id, mod.relpath, node.lineno,
+                        node.col_offset, fsym,
+                        f"{entry}({op!r}): " + " and ".join(what)
+                        + f" for its estimator (requires "
+                          f"{sorted(required)})",
+                        "pass exactly the estimator's dim "
+                        "vocabulary"))
+        findings.sort(key=lambda f: (f.path, f.line, f.col))
+        return findings
+
+
+def _walk_with_symbols(mod: ModuleInfo):
+    by_node = {info.node: f"{mod.modname}:{qual}"
+               for qual, info in mod.functions.items()}
+
+    def walk(node, sym):
+        for child in ast.iter_child_nodes(node):
+            child_sym = by_node.get(child, sym)
+            yield child_sym, child
+            yield from walk(child, child_sym)
+    yield from walk(mod.tree, f"{mod.modname}:<module>")

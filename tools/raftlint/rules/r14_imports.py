@@ -1,0 +1,172 @@
+"""R14: intra-package import resolution — dead imports fail loud.
+
+The SURVEY's ``trustworthiness_score`` class of rot: a module importing
+a path that no longer exists survives every syntactic lint and only
+explodes when something finally imports *it*. For imports whose root
+package lives under the scanned repo root, this rule checks:
+
+- ``import a.b.c`` / ``from a.b import x`` — the target module/package
+  file must exist on disk (``a/b.py`` or ``a/b/__init__.py``), so the
+  check is robust under subset scans;
+- ``from a.b import x`` where ``a.b`` was scanned — ``x`` must be a
+  function, class, submodule, or module-level binding of ``a.b``.
+  Modules that star-import or define ``__getattr__`` (lazy re-export)
+  are exempt from the name-level check.
+
+Relative imports resolve with the package-``__init__`` anchoring rule
+(for an ``__init__.py`` the module *is* its package) — the same logic
+``core.py`` uses, so a future regression there shows up as churn here.
+External roots (jax, numpy, stdlib) are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from tools.raftlint.core import Finding, ModuleInfo, Project
+from tools.raftlint.rules.base import Rule
+
+
+def _module_exists(root: str, dotted: str) -> bool:
+    rel = dotted.replace(".", os.sep)
+    return (os.path.isfile(os.path.join(root, rel + ".py"))
+            or os.path.isfile(os.path.join(root, rel, "__init__.py")))
+
+
+def _local_root(root: str, dotted: str) -> bool:
+    """True when the import's first segment is a package/module that
+    lives under the scanned repo root."""
+    head = dotted.split(".", 1)[0]
+    return _module_exists(root, head)
+
+
+def _toplevel_bindings(mod: ModuleInfo) -> Set[str]:
+    """Names bound at module scope, descending into top-level control
+    flow but never into function/class bodies."""
+    names: Set[str] = set()
+
+    def visit(stmts):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                names.add(st.name)
+            elif isinstance(st, (ast.Import, ast.ImportFrom)):
+                for alias in getattr(st, "names", []):
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name.split(".")[0]
+                    names.add(bound)
+            elif isinstance(st, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                targets = st.targets if isinstance(st, ast.Assign) \
+                    else [st.target]
+                for tgt in targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name):
+                            names.add(sub.id)
+            elif isinstance(st, (ast.If, ast.Try, ast.For, ast.While,
+                                 ast.With)):
+                for field in ("body", "orelse", "finalbody"):
+                    visit(getattr(st, field, []) or [])
+                for h in getattr(st, "handlers", []) or []:
+                    visit(h.body)
+                if isinstance(st, ast.For) and isinstance(
+                        st.target, ast.Name):
+                    names.add(st.target.id)
+    visit(mod.tree.body)
+    return names
+
+
+def _is_opaque(mod: ModuleInfo) -> bool:
+    """Star imports or a module __getattr__ make the exported-name set
+    statically unknowable — skip name-level checks."""
+    for st in mod.tree.body:
+        if isinstance(st, ast.ImportFrom) and any(
+                a.name == "*" for a in st.names):
+            return True
+        if isinstance(st, (ast.FunctionDef,)) and st.name in (
+                "__getattr__", "__dir__"):
+            return True
+    return False
+
+
+class ImportResolutionRule(Rule):
+    id = "R14"
+    summary = ("intra-package import of a module or name that no "
+               "longer exists")
+    rationale = ("a dead import is a landmine that only detonates "
+                 "when something finally imports the module carrying "
+                 "it — the vestigial-reference rot class the stats/ "
+                 "header parity audit chases by hand")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        bindings: Dict[str, Set[str]] = {}
+        for mod in project.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        dotted = alias.name
+                        if not _local_root(project.root, dotted):
+                            continue
+                        if not _module_exists(project.root, dotted):
+                            findings.append(Finding(
+                                self.id, mod.relpath, node.lineno,
+                                node.col_offset,
+                                f"{mod.modname}:<module>",
+                                f"import of '{dotted}': no such "
+                                "module under the repo root",
+                                "delete the dead import or restore "
+                                "the module"))
+                elif isinstance(node, ast.ImportFrom):
+                    self._check_importfrom(project, mod, node,
+                                           bindings, findings)
+        findings.sort(key=lambda f: (f.path, f.line, f.col))
+        return findings
+
+    def _check_importfrom(self, project: Project, mod: ModuleInfo,
+                          node: ast.ImportFrom, bindings, findings
+                          ) -> None:
+        base = node.module or ""
+        if node.level:
+            parts = mod.modname.split(".")
+            drop = node.level - 1 if mod.is_package else node.level
+            if drop > len(parts):
+                return
+            anchor = parts[:len(parts) - drop] if drop else parts
+            base = ".".join(anchor + ([node.module] if node.module
+                                      else []))
+        if not base or not _local_root(project.root, base):
+            return
+        if not _module_exists(project.root, base):
+            findings.append(Finding(
+                self.id, mod.relpath, node.lineno, node.col_offset,
+                f"{mod.modname}:<module>",
+                f"import from '{base}': no such module under the "
+                "repo root",
+                "delete the dead import or restore the module"))
+            return
+        target = project.modules.get(base)
+        if target is None or _is_opaque(target):
+            return                  # unscanned or dynamic exports
+        names = bindings.get(base)
+        if names is None:
+            names = _toplevel_bindings(target)
+            bindings[base] = names
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            if alias.name in names:
+                continue
+            if _module_exists(project.root,
+                              f"{base}.{alias.name}"):
+                continue            # submodule import
+            findings.append(Finding(
+                self.id, mod.relpath, node.lineno, node.col_offset,
+                f"{mod.modname}:<module>",
+                f"'{alias.name}' is not defined in '{base}' (no "
+                "function, class, module-level binding, or "
+                "submodule by that name)",
+                "fix the name or restore the binding"))
