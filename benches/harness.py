@@ -45,7 +45,14 @@ import jax
 # matrix/epilogue_levers family carries the armed lever bars
 # (bar_iters_per_s / bar_ms / bar_mxu_frac with the cost-model cut).
 # Pre-era-14 rows for those families measured the hand-rolled copies.
-BENCH_ERA = 14
+# Era 16: overload resilience lands in the serving layer — brownout
+# degradation ladders, hedged fleet dispatch and the chaos harness.
+# The serve/overload family's rows measure tail latency WITH those
+# mechanisms armed (a brownout controller and a hedger in the loop),
+# so they are not comparable to any earlier serve row's p99 column;
+# the rows also carry the resilience witnesses (brownout_max_level,
+# hedge_rate) the CI gates assert on.
+BENCH_ERA = 16
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

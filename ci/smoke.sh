@@ -1194,14 +1194,15 @@ fi
 rm -rf "$SENTRY_TMP"
 echo "sentry gate: shipped history audits clean; seeded regression trips"
 
-# Epilogue-lever bench gate (ISSUE 14, BENCH_ERA=14): the armed lever
-# family must run on the CPU tier with every row stamped era 14 +
+# Epilogue-lever bench gate (ISSUE 14): the armed lever family must
+# run on the CPU tier with every row stamped the CURRENT era +
 # ``partial`` and the armed rows carrying their bars plus the >= 1.5x
 # cost-model cut; the strip-mined drain must not LOSE to the whole-tile
 # drain (the lever's direction holds even in interpret mode); and the
-# fresh rows must clear the sentry against the shipped era-14 baseline
-# (per-family tolerance 3.0: interpret-mode rows drift between
-# container sessions).
+# fresh rows must clear the sentry against the shipped era-14 lever
+# baseline (newer eras gate against the best older-era row until a
+# newer artifact ships; per-family tolerance 3.0: interpret-mode rows
+# drift between container sessions).
 LEVER_ROWS=$(mktemp /tmp/lever_rows.XXXXXX.jsonl)
 JAX_PLATFORMS=cpu python benches/run_benches.py \
     --family matrix/epilogue_levers > "$LEVER_ROWS"
@@ -1229,7 +1230,7 @@ expected = {"epilogue/northstar_sharediota",
 missing = expected - set(rows)
 assert not missing, f"lever family dropped rows: {missing}"
 for name, row in rows.items():
-    assert row["era"] == BENCH_ERA == 14, (name, row.get("era"))
+    assert row["era"] == BENCH_ERA == 16, (name, row.get("era"))
     assert row.get("partial") is True, \
         f"{name}: CPU proxy row must stamp partial"
 ns = rows["epilogue/northstar_sharediota"]
@@ -1244,7 +1245,7 @@ for fam in ("knn_drain_k64", "select_k_insert"):
     w = rows[f"epilogue/{fam}_wholetile"]["median_ms"]
     assert s <= w * 1.10, \
         f"{fam}: strip drain ({s} ms) lost to whole tile ({w} ms)"
-print(f"lever gate: 5 era-14 rows, armed bars carried, strip <= whole "
+print(f"lever gate: 5 era-{BENCH_ERA} rows, armed bars carried, strip <= whole "
       f"tile on both drain consumers (model cut {armed['model_cut']}x)")
 PYEOF
 JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$LEVER_ROWS" \
@@ -1254,7 +1255,7 @@ JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$LEVER_ROWS" \
     --family-tol epilogue/select_k_insert_strip=3.0 \
     --family-tol epilogue/select_k_insert_wholetile=3.0 >/dev/null
 rm -f "$LEVER_ROWS"
-echo "lever sentry: fresh era-14 rows clear the shipped baseline"
+echo "lever sentry: fresh current-era rows clear the shipped baseline"
 
 # Serve-level lever witness (ISSUE 14 satellite): the spent epilogue
 # levers observed from the SERVING side — a loadgen p99 row and a
@@ -1347,5 +1348,202 @@ JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$WITNESS_ROWS" \
     --family-tol epilogue/northstar_iters_per_s@cpu=3.0 >/dev/null
 rm -f "$WITNESS_ROWS"
 echo "witness sentry: serve-side lever rows clear the shipped baseline"
+
+
+# Brownout gate (ISSUE 16): a 4x open-loop traffic step against a
+# brownout-armed Executor (capacity throttled by a constant fault
+# stall so the step genuinely overloads). Witnesses: the degradation
+# ladder engages (level > 0 responses served), every transition rides
+# a pre-warmed executable (zero retraces during the chaos run), the
+# min_quality=0 gold tenant is never degraded (no controller step, no
+# floor-violation flight bundle), and after the step the level returns
+# to 0 with p99 back near the base phase.
+RAFT_TPU_METRICS=on JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+
+from raft_tpu import obs, serve
+from raft_tpu.comms.faults import FaultInjector
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.serve import loadgen
+
+obs.set_enabled(True)
+reg = obs.MetricsRegistry()
+obs_metrics.set_registry(reg)
+
+rng = np.random.default_rng(16)
+db = rng.standard_normal((2048, 32)).astype(np.float32)
+ladder = serve.knn_ladder(db, [32, 16, 8])
+qos = serve.QosPolicy({
+    "default": serve.TenantPolicy(slo_latency_s=0.25),
+    "gold": serve.TenantPolicy(slo_latency_s=0.25, min_quality=0),
+})
+qos.SLO_WINDOW_S = 1.5       # gate-speed burn window (default 60 s)
+ctl = serve.BrownoutController(
+    [ladder], qos=qos, queue_high=0.5, step_interval_s=0.1,
+    window_s=0.2, clean_windows=2)
+inj = FaultInjector(seed=0)
+ex = serve.Executor(
+    [], policy=serve.BatchPolicy(max_batch=8, max_wait_ms=2.0,
+                                 max_queue=64),
+    qos=qos, brownout=ctl, faults=inj)
+ex.warm([4, 8])
+inj.stall(0.02)              # throttle capacity so the 4x step overloads
+with ex:
+    rep = loadgen.chaos_traffic_step(
+        ex, "knn_k32_l2", base_qps=40.0, step_factor=4.0, rows=4,
+        phase_s=1.2, recovery_s=3.0, tenants=["default", "gold"],
+        seed=16)
+
+step = rep.phases["step"]
+assert rep.brownout_max_level > 0, \
+    f"4x step never engaged the ladder: {step}"
+assert any(int(lv) > 0
+           for lv in step.get("brownout_levels", {})), \
+    f"no degraded responses served DURING the step: {step}"
+assert rep.retraces_during == 0, \
+    f"brownout stepping recompiled ({rep.retraces_during} retraces) " \
+    f"— every ladder level must be pre-warmed"
+assert rep.brownout_recovered, \
+    f"level did not return to 0 after the step: {rep.notes}"
+base_p99 = rep.phases["base"]["p99_ms"]
+rec_p99 = rep.phases["recovery"]["p99_ms"]
+assert rec_p99 <= 3.0 * base_p99, \
+    f"p99 did not recover: base {base_p99} ms -> recovery {rec_p99} ms"
+snap = reg.snapshot()
+floor = snap.get("serve_brownout_floor_violations_total")
+assert floor is None or not floor["series"], \
+    f"min_quality floor violated: {floor}"
+gauge = snap.get("serve_brownout_level")
+gold = [sr for sr in (gauge["series"] if gauge else [])
+        if sr["labels"].get("tenant") == "gold"]
+assert not gold, \
+    f"gold tenant (min_quality=0) was stepped by the controller: {gold}"
+print(f"brownout gate: 4x step engaged level {rep.brownout_max_level} "
+      f"(0 retraces), gold pinned at full quality, recovered to "
+      f"level 0 (p99 {base_p99:.1f} -> {step['p99_ms']:.1f} -> "
+      f"{rec_p99:.1f} ms)")
+PYEOF
+
+# Slow-replica hedge gate (ISSUE 16): one replica of a hedged
+# 4-replica fleet straggles on a duty cycle (the GC-pause profile
+# hedging is built for — a CONSTANT straggler on a small fleet is more
+# demand than a 5% hedge budget can cover by design, loadgen.py
+# chaos_slow_replica docstring). Witnesses: fleet p99 under the
+# straggler holds within 2x the healthy baseline, the hedge spend
+# stays within the 5% budget, hedges actually issue AND win, and the
+# hedge legs ride pre-warmed executables (zero retraces).
+RAFT_TPU_METRICS=on JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+
+from raft_tpu import obs, serve
+from raft_tpu.comms.faults import FaultInjector
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.serve import loadgen
+
+obs.set_enabled(True)
+reg = obs.MetricsRegistry()
+obs_metrics.set_registry(reg)
+
+rng = np.random.default_rng(17)
+db = rng.standard_normal((2048, 32)).astype(np.float32)
+injs = [FaultInjector(seed=i) for i in range(4)]
+execs = []
+for i in range(4):
+    ex = serve.Executor(
+        [serve.KnnService(db, k=8)],
+        policy=serve.BatchPolicy(max_batch=16, max_wait_ms=2.0,
+                                 max_queue=32),
+        faults=injs[i])
+    ex.warm()
+    execs.append(ex)
+# 0.045: the fractional budget's base window also counts the priming
+# phase's submits, so an exact 0.05 can land a hair over the asserted
+# 5% hedge-rate ceiling
+group = serve.ReplicaGroup(
+    execs, hedge=serve.HedgePolicy(delay_floor_s=0.005,
+                                   min_samples=16,
+                                   budget_fraction=0.045))
+with group:
+    # prime the hedger's delay estimate (and the fractional budget's
+    # base window) at steady state before measuring
+    loadgen._group_closed_loop(group, "knn_k8_l2", clients=8, rows=4,
+                               duration_s=2.0, seed=3)
+    traces0 = sum(ex.stats.traces for ex in execs)
+    rep = loadgen.chaos_slow_replica(
+        group, "knn_k8_l2", stall_s=0.08, victim=0, clients=8,
+        rows=4, phase_s=3.0, stall_duty=0.07, stall_period_s=0.5,
+        seed=17)
+    retraces = sum(ex.stats.traces for ex in execs) - traces0
+
+h = rep.phases["healthy"]["p99_ms"]
+st = rep.phases["stalled"]["p99_ms"]
+hd = rep.phases["healed"]["p99_ms"]
+assert st <= 2.0 * h, \
+    f"straggler broke the fleet p99: healthy {h:.1f} ms -> " \
+    f"stalled {st:.1f} ms (> 2x)"
+assert rep.hedge_rate <= 0.05, \
+    f"hedge spend {rep.hedge_rate:.4f} exceeds the 5% budget"
+assert rep.hedges_issued > 0 and rep.hedges_won > 0, \
+    f"hedging never engaged: issued {rep.hedges_issued}, " \
+    f"won {rep.hedges_won}"
+assert retraces == 0, \
+    f"hedge legs recompiled ({retraces} retraces) — hedges must ride " \
+    f"the same pre-warmed executables"
+assert hd <= 2.0 * h, \
+    f"fleet did not heal: healthy {h:.1f} ms -> healed {hd:.1f} ms"
+print(f"hedge gate: duty-cycled straggler held p99 {h:.1f} -> "
+      f"{st:.1f} ms (<= 2x), hedge rate "
+      f"{rep.hedge_rate:.3f} <= 0.05 "
+      f"({rep.hedges_issued} issued / {rep.hedges_won} won, "
+      f"0 retraces)")
+PYEOF
+
+# Overload bench sentry (ISSUE 16, BENCH_ERA=16): the serve/overload
+# family must run on the CPU tier with every row stamped era 16 +
+# partial and carrying its resilience witnesses, and the fresh rows
+# must clear the sentry against the shipped era-16 baseline
+# (per-family tolerance 3.0: chaos-phase p99 rows drift between
+# container sessions).
+OVERLOAD_ROWS=$(mktemp /tmp/overload_rows.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python benches/run_benches.py \
+    --family serve/overload > "$OVERLOAD_ROWS"
+python - "$OVERLOAD_ROWS" <<'PYEOF'
+import json
+import sys
+
+from benches.harness import BENCH_ERA
+
+rows = {}
+with open(sys.argv[1]) as fh:
+    for line in fh:
+        line = line.strip()
+        if not line:
+            continue
+        row = json.loads(line)
+        if "bench" in row and row.get("median_ms") is not None:
+            rows[row["bench"]] = row
+
+expected = {"serve/overload_step_p99", "serve/overload_slowreplica_p99"}
+missing = expected - set(rows)
+assert not missing, f"overload family dropped rows: {missing}"
+for name, row in rows.items():
+    assert row["era"] == BENCH_ERA == 16, (name, row.get("era"))
+    assert row.get("partial") is True, \
+        f"{name}: CPU proxy row must stamp partial"
+step = rows["serve/overload_step_p99"]
+assert step["brownout_max_level"] > 0, step
+assert step["retraces"] == 0, step
+slow = rows["serve/overload_slowreplica_p99"]
+assert slow["hedge_rate"] <= 0.05, slow
+assert slow["hedges_issued"] > 0, slow
+print(f"overload bench: 2 era-{BENCH_ERA} rows (step engaged level "
+      f"{step['brownout_max_level']}, slow-replica hedge rate "
+      f"{slow['hedge_rate']})")
+PYEOF
+JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$OVERLOAD_ROWS" \
+    --family-tol serve/overload_step_p99=3.0 \
+    --family-tol serve/overload_slowreplica_p99=3.0 >/dev/null
+rm -f "$OVERLOAD_ROWS"
+echo "overload sentry: fresh era-16 rows clear the shipped baseline"
 
 echo "smoke: PASS"

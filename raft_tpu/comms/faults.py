@@ -132,6 +132,13 @@ class FaultInjector:
         with self._lock:
             self._stall_s = seconds
 
+    def current_stall(self) -> float:
+        """The armed stall, in seconds (0 = disarmed). Serving-side
+        chaos (``Executor(faults=...)``) reads this per launch to
+        straggle a replica without touching the transport path."""
+        with self._lock:
+            return self._stall_s
+
     def in_scope(self, source: int, dest: int) -> bool:
         return ((self.source_ranks is None or source in self.source_ranks)
                 and (self.dest_ranks is None or dest in self.dest_ranks))

@@ -31,6 +31,7 @@ import collections
 import random
 import threading
 import time
+import zlib
 from dataclasses import dataclass
 from typing import Callable, Deque, Dict, Optional, Tuple
 
@@ -59,6 +60,40 @@ def _limits():
     return limits
 
 
+# Per-scope retry budgets (ISSUE 16): a sliding-window cap on the
+# *total* retry rate each policy scope may emit, process-wide.  Without
+# one, N callers hitting the same dead peer each run their full backoff
+# schedule — the retry storm is N× the primary load precisely when the
+# peer is least able to absorb it.  The budget bounds the amplification:
+# once the window is spent, further failures fall through to their
+# terminal error immediately (metered, not silently swallowed).
+_retry_budgets: Dict[str, "object"] = {}
+_retry_budgets_lock = threading.Lock()
+
+
+def retry_budget(scope: str, *, max_events: int, window_s: float):
+    """Get-or-create the process-wide retry budget for ``scope``.
+
+    The first caller's sizing wins (scopes are policy-owned constants,
+    not per-call knobs); tests use :func:`reset_retry_budgets` to
+    re-size.  Returns a :class:`raft_tpu.runtime.limits.RateBudget`.
+    """
+    with _retry_budgets_lock:
+        bud = _retry_budgets.get(scope)
+        if bud is None:
+            bud = _limits().RateBudget(max_events=max_events,
+                                       window_s=window_s)
+            _retry_budgets[scope] = bud
+        return bud
+
+
+def reset_retry_budgets() -> None:
+    """Drop all per-scope retry budgets (test hook, mirroring
+    ``limits.reset_breakers``)."""
+    with _retry_budgets_lock:
+        _retry_budgets.clear()
+
+
 def default_recv_timeout(fallback: float) -> float:
     """Resolve the default blocking-recv deadline for a transport.
 
@@ -85,6 +120,15 @@ class RetryPolicy:
     ``deadline`` bounds the *total* wall time budget across attempts;
     when the next backoff would overrun it, the retry loop raises
     :class:`CommsTimeoutError` chaining the last underlying error.
+
+    ``budget_scope`` enrolls the policy in a process-wide retry budget
+    (see :func:`retry_budget`): every retry this policy would sleep for
+    first spends one slot from the scope's sliding window
+    (``budget_max`` events per ``budget_window_s``).  An exhausted
+    budget converts the retry into an immediate re-raise of the last
+    transient error, metered as ``limits_rejected_total{reason=
+    "retry_budget"}`` — bounding the storm N failing callers can aim at
+    one recovering peer.
     """
 
     max_attempts: int = 5
@@ -93,6 +137,9 @@ class RetryPolicy:
     multiplier: float = 2.0
     jitter: float = 0.5
     deadline: Optional[float] = None
+    budget_scope: Optional[str] = None
+    budget_max: int = 0
+    budget_window_s: float = 60.0
 
     def delay(self, attempt: int, rng: Optional[random.Random] = None
               ) -> float:
@@ -108,7 +155,11 @@ class RetryPolicy:
 
         ``retry_on`` names the exception types considered transient; any
         other exception propagates immediately.  ``seed`` makes the
-        jitter sequence reproducible.  Each retry emits a
+        jitter sequence reproducible; when omitted it derives from
+        ``describe`` (crc32), so the whole retry schedule is a pure
+        function of the call site — two peers retrying the same link
+        replay identical backoffs run-to-run, while differently-named
+        links stay decorrelated.  Each retry emits a
         ``comms.retry`` trace event in the caller's active range;
         exhaustion re-raises the last transient error, while a deadline
         overrun raises :class:`CommsTimeoutError` chaining it.
@@ -118,7 +169,15 @@ class RetryPolicy:
         an expired scope raises ``DeadlineExceededError`` instead of
         burning further attempts.
         """
+        if seed is None and describe:
+            # deterministic decorrelation: jitter is a function of the
+            # link's name, not of global RNG state at call time
+            seed = zlib.crc32(describe.encode("utf-8", "replace"))
         rng = random.Random(seed)
+        budget = (retry_budget(self.budget_scope,
+                               max_events=self.budget_max,
+                               window_s=self.budget_window_s)
+                  if self.budget_scope and self.budget_max > 0 else None)
         start = time.monotonic()
         last: Optional[BaseException] = None
         for attempt in range(max(1, self.max_attempts)):
@@ -143,6 +202,21 @@ class RetryPolicy:
                         f"attempt(s): {e!r}") from e
                 if attempt + 1 >= max(1, self.max_attempts):
                     break
+                if budget is not None and not budget.try_spend():
+                    # scope-wide retry budget spent: this caller's storm
+                    # contribution ends here — fail fast, metered
+                    trace.record_event("comms.retry.budget", what=describe,
+                                       scope=self.budget_scope,
+                                       attempt=attempt + 1, error=repr(e))
+                    obs.inc("limits_rejected_total", 1,
+                            reason="retry_budget", op=self.budget_scope)
+                    obs.inc("comms_retries_total", 1, outcome="budget")
+                    _log.warning(
+                        "%s: retry budget for scope %r exhausted "
+                        "(%d/%gs) — failing fast: %r", describe or
+                        "comms op", self.budget_scope, self.budget_max,
+                        self.budget_window_s, e)
+                    raise e
                 trace.record_event("comms.retry", what=describe,
                                    attempt=attempt + 1,
                                    delay=round(wait, 4), error=repr(e))
@@ -166,13 +240,22 @@ class RetryPolicy:
 # compiles before a listener binds — see TcpMailbox.get's deadline
 # rationale); send-path reconnects after an established link drops get
 # a much shorter leash, as a vanished *established* peer is the failure
-# detector's business.
+# detector's business.  Each scope carries a process-wide retry budget
+# sized far above any healthy workload (a full-mesh bootstrap of 16
+# ranks retrying hard stays under 1/10th of it) — they exist to cap
+# pathological amplification, not to shave healthy retries.
 CONNECT_POLICY = RetryPolicy(max_attempts=60, base_delay=0.1, max_delay=1.0,
-                             multiplier=1.5, jitter=0.3, deadline=120.0)
+                             multiplier=1.5, jitter=0.3, deadline=120.0,
+                             budget_scope="comms.connect",
+                             budget_max=2400, budget_window_s=60.0)
 RECONNECT_POLICY = RetryPolicy(max_attempts=4, base_delay=0.05,
-                               max_delay=0.5, deadline=5.0)
+                               max_delay=0.5, deadline=5.0,
+                               budget_scope="comms.reconnect",
+                               budget_max=240, budget_window_s=60.0)
 BOOTSTRAP_POLICY = RetryPolicy(max_attempts=3, base_delay=0.5, max_delay=4.0,
-                               jitter=0.3, deadline=60.0)
+                               jitter=0.3, deadline=60.0,
+                               budget_scope="comms.bootstrap",
+                               budget_max=120, budget_window_s=60.0)
 
 
 class TagStore:

@@ -295,3 +295,12 @@ register("RAFT_TPU_SPLIT_PACKED", _parse_flag, False, on_malformed="warn",
          help="packed-operand spelling for the bf16x3 cross terms")
 register("RAFT_TPU_SPARSE_PAD", _parse_flag, True, on_malformed="warn",
          help="pad sparse buffers to lane-friendly capacities")
+
+# Overload-resilience toggles (ISSUE 16): degrade to the conservative
+# setting (on) with a warning — resilience must not vanish on a typo.
+register("RAFT_TPU_BROWNOUT", _parse_onoff, True, on_malformed="warn",
+         help="arm the adaptive quality-brownout controller "
+              "(serve/brownout.py); off = always full quality")
+register("RAFT_TPU_HEDGE", _parse_onoff, True, on_malformed="warn",
+         help="arm hedged re-issue in ReplicaGroup.submit "
+              "(serve/replica.py); off = single dispatch")

@@ -62,6 +62,7 @@ latency SLO actually has).
 
 from __future__ import annotations
 
+import collections as _collections
 import contextlib
 import threading
 import time
@@ -79,6 +80,7 @@ __all__ = [
     "parse_bytes", "estimate_bytes", "admit", "reject", "record_degraded",
     "estimate_seconds", "estimate_flops_bytes", "check_chunk_budget",
     "CircuitBreaker", "get_breaker", "reset_breakers",
+    "RateBudget",
 ]
 
 # breaker policy: consecutive typed failures before opening, and how
@@ -763,3 +765,100 @@ def reset_breakers() -> None:
     """Drop all breaker state (tests and REPL hygiene)."""
     with _breakers_lock:
         _breakers.clear()
+
+
+# ---------------------------------------------------------------------------
+# rate budgets (ISSUE 16: retry budgets, hedge budgets)
+# ---------------------------------------------------------------------------
+
+class RateBudget:
+    """A sliding-window spend budget for *secondary* work — retries,
+    hedges — that must never amplify an overload.
+
+    Two modes, one mechanism:
+
+    - **absolute** (``max_events``): at most N spends per ``window_s``.
+      The retry-budget shape: a recovering peer sees a bounded retry
+      rate no matter how many callers are failing.
+    - **fractional** (``max_fraction`` of :meth:`note`-recorded base
+      events): spends are capped at a fraction of primary traffic in
+      the window. The hedge-budget shape (Dean & Barroso's ≤5%): with
+      no primaries there is nothing to hedge against, so the budget is
+      empty, and a traffic spike raises the allowance proportionally
+      instead of letting hedges pile on a fixed cap.
+
+    Both can be set; the tighter one wins. :meth:`try_spend` is a
+    check-and-commit — a True return has already consumed the slot, so
+    concurrent spenders can't overshoot."""
+
+    __slots__ = ("max_events", "max_fraction", "window_s",
+                 "_base", "_spent", "_lock")
+
+    def __init__(self, *, max_events: Optional[int] = None,
+                 max_fraction: Optional[float] = None,
+                 window_s: float = 60.0):
+        if max_events is None and max_fraction is None:
+            raise ValueError(
+                "RateBudget needs max_events and/or max_fraction")
+        if max_events is not None and max_events < 0:
+            raise ValueError(f"max_events must be >= 0, got {max_events}")
+        if max_fraction is not None and not (0.0 <= max_fraction <= 1.0):
+            raise ValueError(
+                f"max_fraction must be in [0, 1], got {max_fraction}")
+        if not window_s > 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.max_events = max_events
+        self.max_fraction = max_fraction
+        self.window_s = float(window_s)
+        self._base: "collections.deque" = _collections.deque()
+        self._spent: "collections.deque" = _collections.deque()
+        self._lock = threading.Lock()
+
+    def _trim(self, now: float) -> None:
+        # under self._lock
+        cutoff = now - self.window_s
+        for dq in (self._base, self._spent):
+            while dq and dq[0] < cutoff:
+                dq.popleft()
+
+    def note(self, n: int = 1) -> None:
+        """Record ``n`` base (primary) events — the denominator for
+        ``max_fraction`` mode. No-op cost in absolute mode is fine;
+        callers need not branch."""
+        if self.max_fraction is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._trim(now)
+            self._base.extend([now] * int(n))
+
+    def try_spend(self, n: int = 1) -> bool:
+        """Atomically consume ``n`` budget slots if the window allows
+        it. False means the caller must skip the retry/hedge (and
+        should meter the suppression)."""
+        n = int(n)
+        now = time.monotonic()
+        with self._lock:
+            self._trim(now)
+            spent = len(self._spent)
+            if self.max_events is not None \
+                    and spent + n > self.max_events:
+                return False
+            if self.max_fraction is not None:
+                allowed = int(len(self._base) * self.max_fraction)
+                if spent + n > allowed:
+                    return False
+            self._spent.extend([now] * n)
+            return True
+
+    def spent(self) -> int:
+        """Spends currently inside the window (observability/tests)."""
+        now = time.monotonic()
+        with self._lock:
+            self._trim(now)
+            return len(self._spent)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"RateBudget(max_events={self.max_events}, "
+                f"max_fraction={self.max_fraction}, "
+                f"window_s={self.window_s})")

@@ -24,16 +24,22 @@ Quickstart::
         dist, idx = fut.result(timeout=1.0)
 """
 
+from raft_tpu.serve.brownout import (BrownoutController,
+                                     BrownoutFloorError,
+                                     DegradationLadder, ivf_ladder,
+                                     knn_ladder)
 from raft_tpu.serve.executor import (Executor, ExecutorStats,
                                      IvfKnnService, IvfMnmgKnnService,
                                      KnnService, KMeansPredictService,
                                      PairwiseService, Service)
-from raft_tpu.serve.loadgen import (FleetReport, LoadReport,
-                                    closed_loop, fleet_closed_loop,
-                                    open_loop)
+from raft_tpu.serve.loadgen import (ChaosReport, FleetReport,
+                                    LoadReport, closed_loop,
+                                    fleet_closed_loop, open_loop,
+                                    run_chaos)
 from raft_tpu.serve.qos import QosPolicy, TenantPolicy
-from raft_tpu.serve.replica import (RecoveryReport, Replica,
-                                    ReplicaGroup, ReplicaGroupStats)
+from raft_tpu.serve.replica import (HedgePolicy, RecoveryReport,
+                                    Replica, ReplicaGroup,
+                                    ReplicaGroupStats)
 from raft_tpu.serve.queue import (BUCKET_FLOOR, Batch, BatchPolicy,
                                   Request, RequestQueue, ResultFuture,
                                   bucket_ladder, bucket_rows)
@@ -46,6 +52,9 @@ __all__ = [
     "PairwiseService", "KMeansPredictService", "Executor",
     "ExecutorStats",
     "Replica", "ReplicaGroup", "ReplicaGroupStats", "RecoveryReport",
-    "LoadReport", "FleetReport", "closed_loop", "open_loop",
-    "fleet_closed_loop",
+    "HedgePolicy",
+    "BrownoutController", "BrownoutFloorError", "DegradationLadder",
+    "ivf_ladder", "knn_ladder",
+    "LoadReport", "FleetReport", "ChaosReport", "closed_loop",
+    "open_loop", "fleet_closed_loop", "run_chaos",
 ]

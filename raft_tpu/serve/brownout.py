@@ -1,0 +1,320 @@
+"""Adaptive quality brownout (ISSUE 16 tentpole, control half).
+
+Under overload the serve tier used to be binary: full quality or a
+typed refusal. This module adds the middle ground production ANN
+systems actually live in — *degrade quality before availability*:
+
+- a :class:`DegradationLadder` per logical op: ordered quality levels
+  (level 0 = full quality), each a distinct
+  :class:`~raft_tpu.serve.executor.Service` instance (IVF nprobe
+  32→16→8→4, brute-force k-cap, …). Every level registers with the
+  executor and pre-warms through the normal bucket ladder, so STEPPING
+  DOWN NEVER COMPILES — the zero-recompile contract the serve tier is
+  CI-gated on extends to brownout transitions (asserted via the
+  executor's retrace counter in ci/smoke.sh).
+- a :class:`BrownoutController` running classic hysteresis over the
+  PR-10 signals: engage (step down one level) when a tenant's SLO
+  burn rate exceeds ``engage_burn`` (>1 = error budget burning faster
+  than the objective tolerates) OR the queue is past ``queue_high`` of
+  capacity; recover (step back up) only after ``clean_windows``
+  consecutive clean windows of ``window_s`` — asymmetry is the point:
+  react in one tick, relax slowly enough not to oscillate.
+- a per-tenant contract floor: ``qos.TenantPolicy.min_quality`` caps
+  how deep the controller may degrade that tenant (0 pins full
+  quality). The executor re-checks the floor at finish; a served
+  response below it is a :class:`BrownoutFloorError` flight-recorder
+  bundle, not a silent quality leak.
+
+Every resolved level is observable: gauge
+``serve_brownout_level{service,tenant}``, a ``serve.brownout_step``
+event per transition, the ``level`` stamped on each request's span,
+and a per-level histogram in :class:`ExecutorStats`/loadgen reports.
+
+Kill switch: ``RAFT_TPU_BROWNOUT=off`` pins every resolve to level 0
+(the controller still ticks its signals, so flipping it back on
+engages immediately).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from raft_tpu import obs
+from raft_tpu.core import env as _env_mod
+
+__all__ = [
+    "BrownoutFloorError", "DegradationLadder", "BrownoutController",
+    "ivf_ladder", "knn_ladder",
+]
+
+
+class BrownoutFloorError(RuntimeError):
+    """A response was served BELOW the tenant's ``min_quality`` floor —
+    a contract violation (controller bug), flight-recorded, never
+    expected in a healthy tree."""
+
+    def __init__(self, msg: str, *, op: str, tenant: str, level: int,
+                 floor: int):
+        super().__init__(msg)
+        self.op = op
+        self.tenant = tenant
+        self.level = level
+        self.floor = floor
+
+
+class DegradationLadder:
+    """Ordered quality levels for one logical serve op.
+
+    ``services[0]`` is full quality and its name is the op clients
+    submit; deeper indices are progressively cheaper. Cheapness is
+    validated, not assumed: each level's ``estimate_bytes`` at a
+    reference bucket must be <= its predecessor's — a ladder that gets
+    more expensive as it "degrades" is a configuration bug caught at
+    construction."""
+
+    def __init__(self, services: Sequence, *, check_rows: int = 64):
+        services = list(services)
+        if not services:
+            raise ValueError("a ladder needs at least one level")
+        dims = {s.dim for s in services}
+        if len(dims) != 1:
+            raise ValueError(
+                f"ladder levels disagree on query dim: {sorted(dims)}")
+        for lo, hi in zip(services[1:], services[:-1]):
+            if lo.estimate_bytes(check_rows) > hi.estimate_bytes(
+                    check_rows):
+                raise ValueError(
+                    f"ladder not monotone: level {lo.name!r} costs more "
+                    f"than its predecessor {hi.name!r} "
+                    f"({lo.estimate_bytes(check_rows)} > "
+                    f"{hi.estimate_bytes(check_rows)} bytes at "
+                    f"{check_rows} rows)")
+        self.services = services
+        self.op = services[0].name
+
+    @property
+    def depth(self) -> int:
+        """Number of levels (max level index is ``depth - 1``)."""
+        return len(self.services)
+
+    def service(self, level: int):
+        return self.services[min(max(int(level), 0),
+                                 len(self.services) - 1)]
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"DegradationLadder({self.op!r}, "
+                f"levels={[s.name for s in self.services]})")
+
+
+def ivf_ladder(index, k: int,
+               nprobes: Sequence[int] = (32, 16, 8, 4)
+               ) -> DegradationLadder:
+    """The canonical IVF brownout ladder: one
+    :class:`~raft_tpu.serve.executor.IvfKnnService` per nprobe,
+    descending — fewer probed lists, cheaper search, lower recall.
+    nprobes above ``n_lists - 1`` are clamped out (a full scan is not a
+    quality LEVEL)."""
+    from raft_tpu.serve.executor import IvfKnnService
+
+    nps = [int(np_) for np_ in nprobes if 0 < int(np_) < index.n_lists]
+    if sorted(set(nps), reverse=True) != nps:
+        raise ValueError(
+            f"nprobes must be strictly descending, got {list(nprobes)}")
+    if not nps:
+        raise ValueError(
+            f"no valid nprobe below n_lists={index.n_lists} in "
+            f"{list(nprobes)}")
+    return DegradationLadder(
+        [IvfKnnService(index, k=k, nprobe=np_) for np_ in nps])
+
+
+def knn_ladder(db, ks: Sequence[int],
+               metric: str = "l2") -> DegradationLadder:
+    """Brute-force k-cap ladder: same database, descending k — a
+    degraded response returns FEWER neighbors (``[rows, k_level]``),
+    which callers observe via the stamped level."""
+    from raft_tpu.serve.executor import KnnService
+
+    ks = [int(k) for k in ks]
+    if sorted(set(ks), reverse=True) != ks:
+        raise ValueError(f"ks must be strictly descending, got {ks}")
+    return DegradationLadder(
+        [KnnService(db, k=k, metric=metric) for k in ks])
+
+
+class _TenantState:
+    """Hysteresis state for one (op, tenant) key (controller-internal,
+    mutated only under the controller lock)."""
+
+    __slots__ = ("level", "last_step", "clean_since")
+
+    def __init__(self):
+        self.level = 0
+        self.last_step = 0.0                 # monotonic of last change
+        self.clean_since: Optional[float] = None
+
+
+class BrownoutController:
+    """Hysteresis over (SLO burn rate, queue depth) driving per-
+    (op, tenant) ladder levels.
+
+    engage_burn
+        step DOWN when a tenant's windowed burn rate exceeds this
+        (1.0 = the PR-10 "error budget burning too fast" threshold).
+    queue_high
+        ... or when queue depth exceeds this fraction of ``max_queue``
+        (queue pressure leads the burn signal — it spikes before
+        latencies have even been recorded).
+    step_interval_s
+        at most one step down per key per this interval: the control
+        loop must outrun the spike, not chase its own latency.
+    window_s / clean_windows
+        step UP one level only after ``clean_windows`` consecutive
+        windows of ``window_s`` with both signals clean — and the clean
+        count restarts after each up-step, so recovery walks the ladder
+        gently instead of snapping to full quality and re-browning.
+    """
+
+    def __init__(self, ladders: Sequence[DegradationLadder], *,
+                 qos=None, engage_burn: float = 1.0,
+                 queue_high: float = 0.8, step_interval_s: float = 0.25,
+                 window_s: float = 1.0, clean_windows: int = 3,
+                 enabled: Optional[bool] = None):
+        ladders = list(ladders)
+        self.ladders: Dict[str, DegradationLadder] = {
+            lad.op: lad for lad in ladders}
+        if len(self.ladders) != len(ladders):
+            raise ValueError("duplicate ladder op")
+        self.qos = qos
+        self.engage_burn = float(engage_burn)
+        self.queue_high = float(queue_high)
+        self.step_interval_s = float(step_interval_s)
+        self.window_s = float(window_s)
+        self.clean_windows = int(clean_windows)
+        if enabled is None:
+            enabled = bool(_env_mod.read("RAFT_TPU_BROWNOUT"))
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._state: Dict[Tuple[str, str], _TenantState] = {}
+        self._last_tick = 0.0
+
+    # -- resolution (executor submit path) ----------------------------
+
+    def max_level(self, op: str, tenant: str) -> int:
+        """Deepest level this tenant may be served at for ``op``: the
+        ladder depth capped by the tenant's ``min_quality`` floor."""
+        ladder = self.ladders[op]
+        cap = ladder.depth - 1
+        if self.qos is not None:
+            floor = self.qos.policy(tenant).min_quality
+            if floor is not None:
+                cap = min(cap, int(floor))
+        return cap
+
+    def resolve(self, op: str, tenant: str) -> Tuple[str, int]:
+        """Map a client-requested op to (service op to run, level) for
+        this tenant, under the current controller state. Unknown ops
+        (no ladder) pass through at level 0."""
+        ladder = self.ladders.get(op)
+        if ladder is None or not self.enabled:
+            return op, 0
+        with self._lock:
+            st = self._state.get((op, tenant))
+            level = st.level if st is not None else 0
+        level = min(level, self.max_level(op, tenant))
+        return ladder.service(level).name, level
+
+    def level(self, op: str, tenant: str) -> int:
+        with self._lock:
+            st = self._state.get((op, tenant))
+            return st.level if st is not None else 0
+
+    # -- control loop --------------------------------------------------
+
+    def maybe_tick(self, executor) -> None:
+        """Rate-limited tick driven from the executor drain loop: reads
+        queue fraction and the per-tenant burn rates, then runs the
+        hysteresis step. Cheap enough to call per batch."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_tick < self.step_interval_s / 2:
+                return
+            self._last_tick = now
+        qfrac = (executor.queue.pending()
+                 / executor.queue.policy.max_queue)
+        burn = {}
+        if self.qos is not None:
+            for tenant, row in self.qos.slo_snapshot().items():
+                burn[tenant] = row["burn_rate"]
+        self.tick(queue_frac=qfrac, burn_by_tenant=burn, now=now)
+
+    def tick(self, *, queue_frac: float,
+             burn_by_tenant: Dict[str, float],
+             now: Optional[float] = None) -> None:
+        """One hysteresis step over every (op, tenant) key. Exposed
+        with an injectable clock so tests drive it deterministically."""
+        if now is None:
+            now = time.monotonic()
+        queue_hot = queue_frac > self.queue_high
+        with self._lock:
+            # keys to evaluate: every tenant with a burn signal, plus
+            # every key already degraded (it must keep being evaluated
+            # even after its tenant goes quiet, or it never recovers)
+            keys = {(op, t) for op in self.ladders
+                    for t in burn_by_tenant}
+            keys.update(k for k, st in self._state.items()
+                        if st.level > 0)
+            for key in keys:
+                op, tenant = key
+                hot = queue_hot or (burn_by_tenant.get(tenant, 0.0)
+                                    > self.engage_burn)
+                st = self._state.get(key)
+                if st is None:
+                    if not hot:
+                        continue
+                    st = self._state[key] = _TenantState()
+                if hot:
+                    st.clean_since = None
+                    cap = self.max_level(op, tenant)
+                    if (st.level < cap
+                            and now - st.last_step
+                            >= self.step_interval_s):
+                        self._step(st, key, st.level + 1, now,
+                                   reason="hot")
+                else:
+                    if st.clean_since is None:
+                        st.clean_since = now
+                    elif (st.level > 0
+                          and now - st.clean_since
+                          >= self.clean_windows * self.window_s):
+                        # one step up per clean streak; restart the
+                        # streak so the next up-step earns itself too
+                        self._step(st, key, st.level - 1, now,
+                                   reason="clean")
+                        st.clean_since = now
+
+    def _step(self, st: _TenantState, key: Tuple[str, str],
+              level: int, now: float, *, reason: str) -> None:
+        # under self._lock; obs is itself thread-safe
+        prev, st.level, st.last_step = st.level, level, now
+        op, tenant = key
+        obs.set_gauge("serve_brownout_level", level, service=op,
+                      tenant=tenant,
+                      help="current degradation-ladder level served "
+                           "(0 = full quality)")
+        obs.emit_event("serve.brownout_step", service=op, tenant=tenant,
+                       level=level, prev=prev, reason=reason)
+
+    # -- reporting -----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """Current levels, ``{op: {tenant: level}}`` — only non-zero
+        entries (loadgen report surfacing)."""
+        out: Dict[str, Dict[str, int]] = {}
+        with self._lock:
+            for (op, tenant), st in self._state.items():
+                if st.level > 0:
+                    out.setdefault(op, {})[tenant] = st.level
+        return out

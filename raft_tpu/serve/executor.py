@@ -438,10 +438,14 @@ class ExecutorStats:
     splits: int = 0                 # budget-driven batch splits
     degraded: int = 0               # eager budget_scope fallbacks
     deadline_failed: int = 0
+    cancelled: int = 0              # hedge losers dropped at drain
     traces: int = 0                 # Python retraces (compile events)
     exec_hits: int = 0              # executable-cache hits
     exec_misses: int = 0
     per_batch_rows: List[int] = field(default_factory=list)
+    # responses served per brownout level (level -> count); {0: n} or
+    # empty means brownout never engaged
+    brownout_levels: Dict[int, int] = field(default_factory=dict)
 
     def coalescing_factor(self) -> float:
         """Mean real rows per device launch — the number the bench
@@ -456,9 +460,23 @@ class Executor:
     def __init__(self, services: Sequence[Service],
                  queue: Optional[RequestQueue] = None, *,
                  policy: Optional[BatchPolicy] = None, qos=None,
-                 use_aot: bool = True):
+                 use_aot: bool = True, brownout=None, faults=None):
         self.services: Dict[str, Service] = {s.name: s for s in services}
         self.qos = qos
+        self.brownout = brownout
+        if brownout is not None:
+            if brownout.qos is None:
+                brownout.qos = qos
+            # every ladder level is a first-class service: registered
+            # here, pre-warmed by warm() through the normal bucket
+            # ladder — stepping down at steady state never compiles
+            for ladder in brownout.ladders.values():
+                for svc in ladder.services:
+                    self.services.setdefault(svc.name, svc)
+        # chaos hook (loadgen slow-replica scenario): a
+        # comms.faults.FaultInjector whose armed stall is applied
+        # per-launch, emulating a straggling replica
+        self.faults = faults
         self.queue = queue or RequestQueue(policy, qos=qos)
         if self.queue.qos is None:
             self.queue.qos = qos
@@ -475,11 +493,28 @@ class Executor:
                deadline_s: Optional[float] = None):
         """Validate against the service and enqueue; returns the
         request's :class:`~raft_tpu.serve.queue.ResultFuture`."""
+        return self.submit_request(op, queries, tenant=tenant,
+                                   deadline_s=deadline_s).future
+
+    def submit_request(self, op: str, queries, *,
+                       tenant: str = "default",
+                       deadline_s: Optional[float] = None,
+                       hedge: bool = False) -> Request:
+        """:meth:`submit` returning the :class:`Request` — callers that
+        need the stamped brownout level or cancellation (hedged
+        dispatch) hold the request. When a brownout controller is
+        attached, the requested op resolves through the tenant's
+        current ladder level HERE, at admission: the level is part of
+        the request's identity, not a dispatch-time surprise."""
+        level = 0
+        if self.brownout is not None:
+            op, level = self.brownout.resolve(op, tenant)
         svc = self._service(op)
         q = np.asarray(queries, svc.dtype)
         svc.validate(q)
-        return self.queue.submit(op, q, tenant=tenant,
-                                 deadline_s=deadline_s)
+        return self.queue.submit_request(op, q, tenant=tenant,
+                                         deadline_s=deadline_s,
+                                         level=level, hedge=hedge)
 
     def _service(self, op: str) -> Service:
         svc = self.services.get(op)
@@ -584,7 +619,14 @@ class Executor:
     def _expire_check(self, reqs: List[Request]) -> List[Request]:
         live = []
         for r in reqs:
-            if r.expired():
+            if r.cancelled is not None:
+                # hedge loser (or shutdown): cancel() already resolved
+                # the future with the typed rejection — just drop it so
+                # no launch is spent on a request nobody is waiting for
+                self.stats.cancelled += 1
+                obs.inc("serve_cancelled_total", 1, op=f"serve.{r.op}",
+                        reason=r.cancelled)
+            elif r.expired():
                 self.stats.deadline_failed += 1
                 obs.inc("limits_deadline_exceeded_total", 1,
                         op=f"serve.{r.op}")
@@ -683,6 +725,12 @@ class Executor:
             padded[at:at + r.rows] = r.queries
             at += r.rows
         exe = self._get_executable(svc, brows)
+        if self.faults is not None:
+            # chaos: an armed FaultInjector stall straggles this
+            # replica's launches (the hedge gate's slow-replica lever)
+            stall = self.faults.current_stall()
+            if stall > 0:
+                time.sleep(stall)
         t0 = time.monotonic()
         try:
             out = exe(*svc.fixed_args, jnp.asarray(padded))
@@ -719,7 +767,8 @@ class Executor:
                         "serve.request", t_start=r.t_enqueue,
                         duration=now - r.t_enqueue, parent=None,
                         thread=tid, ctx=r.ctx, op=svc.name,
-                        rows=r.rows, tenant=r.tenant)
+                        rows=r.rows, tenant=r.tenant,
+                        level=r.level, hedge=r.hedge)
                     obs.record_span(
                         "serve.queue_wait", t_start=r.t_enqueue,
                         duration=wait, parent="serve.request",
@@ -738,6 +787,27 @@ class Executor:
                                    "seconds on the radix epilogue",
                               op=svc.name)
         self._finish(svc, reqs, out, batched=True)
+
+    def _check_floor(self, r: Request) -> None:
+        """Post-serve floor audit: a response stamped below the
+        tenant's ``min_quality`` is a controller bug — flight-record
+        the violation (metric + bundle), never silently ship it as
+        normal quality."""
+        if r.level == 0 or self.qos is None:
+            return
+        floor = self.qos.policy(r.tenant).min_quality
+        if floor is None or r.level <= floor:
+            return
+        from raft_tpu.serve.brownout import BrownoutFloorError
+
+        exc = BrownoutFloorError(
+            f"serve.{r.op}: served tenant {r.tenant!r} at brownout "
+            f"level {r.level}, below its min_quality floor {floor}",
+            op=r.op, tenant=r.tenant, level=r.level, floor=floor)
+        obs.inc("serve_brownout_floor_violations_total", 1, op=r.op,
+                tenant=r.tenant)
+        with obs.use_context(r.ctx):
+            obs.record_failure(exc, tenant=r.tenant)
 
     def _finish(self, svc: Service, reqs: List[Request], out,
                 batched: bool) -> None:
@@ -769,7 +839,10 @@ class Executor:
                 if meter_slo:
                     self.qos.record_outcome(r.op, r.tenant,
                                             now - r.t_enqueue)
+                self._check_floor(r)
             self.stats.requests += 1
+            lv = self.stats.brownout_levels
+            lv[r.level] = lv.get(r.level, 0) + 1
             obs.inc("serve_requests_total", 1, op=svc.name,
                     tenant=r.tenant)
             at += r.rows
@@ -790,6 +863,8 @@ class Executor:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            if self.brownout is not None:
+                self.brownout.maybe_tick(self)
             batch = self.queue.next_batch(timeout=0.05)
             if batch is None:
                 continue

@@ -30,8 +30,9 @@ import numpy as np
 
 from raft_tpu.runtime import limits
 
-__all__ = ["LoadReport", "FleetReport", "closed_loop", "open_loop",
-           "fleet_closed_loop"]
+__all__ = ["LoadReport", "FleetReport", "ChaosReport", "closed_loop",
+           "open_loop", "fleet_closed_loop", "run_chaos",
+           "CHAOS_SCENARIOS"]
 
 
 @dataclass
@@ -51,6 +52,9 @@ class LoadReport:
     select_k_bytes_per_s: float = 0.0   # radix-epilogue selection bandwidth
     slo: Dict[str, dict] = field(default_factory=dict)  # tenant -> SLO state
     obs_snapshot: Optional[Dict[str, object]] = None    # when metrics on
+    # responses served per brownout level during the run ({} or {0: n}
+    # means brownout never engaged) — the ISSUE-16 report column
+    brownout_levels: Dict[int, int] = field(default_factory=dict)
 
     @property
     def qps(self) -> float:
@@ -90,6 +94,11 @@ class LoadReport:
             "pad_overhead": round(self.pad_overhead, 4),
             "select_k_bytes_per_s": round(self.select_k_bytes_per_s, 1),
         }
+        if self.brownout_levels:
+            out["brownout_levels"] = {
+                str(k): v for k, v in sorted(
+                    self.brownout_levels.items())}
+            out["brownout_max_level"] = max(self.brownout_levels)
         if self.slo:
             out["slo"] = self.slo
         if self.obs_snapshot is not None:
@@ -102,18 +111,24 @@ class LoadReport:
 
 def _snapshot(executor) -> tuple:
     s = executor.stats
-    return (s.batches, s.rows, s.padded_rows)
+    return (s.batches, s.rows, s.padded_rows,
+            dict(s.brownout_levels))
 
 
 def _finalize(report: LoadReport, executor, before: tuple,
               t0: float) -> LoadReport:
     report.duration_s = time.monotonic() - t0
-    b0, r0, p0 = before
+    b0, r0, p0, *rest = before         # 3-tuple accepted (pre-ISSUE 16)
+    lv0 = rest[0] if rest else {}
     s = executor.stats
     db, dr, dp = s.batches - b0, s.rows - r0, s.padded_rows - p0
     report.batches = db
     report.coalescing_factor = dr / db if db else 0.0
     report.pad_overhead = dp / dr if dr else 0.0
+    report.brownout_levels = {
+        lvl: n - lv0.get(lvl, 0)
+        for lvl, n in s.brownout_levels.items()
+        if n - lv0.get(lvl, 0) > 0}
     # selection-stage bandwidth: the Executor._launch gauge for kNN
     # services on the radix epilogue (last-observed value per service;
     # report the peak across services — stays 0.0 with metrics off)
@@ -210,6 +225,9 @@ class FleetReport:
     routed: int = 0                     # router counters for the run
     spills: int = 0
     router_rejected: int = 0
+    hedges_issued: int = 0              # hedged second legs dispatched
+    hedges_won: int = 0                 # hedge finished before primary
+    hedge_rate: float = 0.0             # issued / routed (the ≤5% cap)
     killed: Optional[str] = None        # replica killed mid-run, if any
     kill_at_s: Optional[float] = None   # offset from run start
     # seconds from the kill to the first subsequent completion meeting
@@ -226,6 +244,9 @@ class FleetReport:
             "routed": self.routed,
             "spills": self.spills,
             "router_rejected": self.router_rejected,
+            "hedges_issued": self.hedges_issued,
+            "hedges_won": self.hedges_won,
+            "hedge_rate": round(self.hedge_rate, 4),
         }
         if self.killed is not None:
             out["killed"] = self.killed
@@ -293,6 +314,7 @@ def fleet_closed_loop(group, op: str, *, clients: int = 8,
     snaps = {r.name: (r, _snapshot(r.executor)) for r in group.replicas}
     routed0, spills0, rej0 = (group.stats.routed, group.stats.spills,
                               group.stats.rejected)
+    hedged0, hwon0 = (group.stats.hedges_issued, group.stats.hedges_won)
     t0 = time.monotonic()
 
     def record(rep_name: str, t_submit: float, fut) -> None:
@@ -385,6 +407,10 @@ def fleet_closed_loop(group, op: str, *, clients: int = 8,
     fleet.routed = group.stats.routed - routed0
     fleet.spills = group.stats.spills - spills0
     fleet.router_rejected = group.stats.rejected - rej0
+    fleet.hedges_issued = group.stats.hedges_issued - hedged0
+    fleet.hedges_won = group.stats.hedges_won - hwon0
+    fleet.hedge_rate = (fleet.hedges_issued / fleet.routed
+                        if fleet.routed else 0.0)
     if fleet.killed is not None:
         fleet.recovery_time_to_slo_s = (
             kill_state["recovery"] if kill_state["recovery"] is not None
@@ -445,6 +471,301 @@ def open_loop(executor, op: str, *, rate_qps: float = 200.0,
     return _finalize(report, executor, before, t0)
 
 
+# ---------------------------------------------------------------------------
+# traffic-chaos scenario pack (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ChaosReport:
+    """One chaos scenario run: named phases (each a LoadReport/
+    FleetReport dict) plus the resilience witnesses the CI gates
+    assert on — typed fields, not log scraping."""
+
+    scenario: str
+    phases: Dict[str, Dict[str, object]] = field(default_factory=dict)
+    brownout_max_level: int = 0         # deepest level actually served
+    brownout_recovered: bool = True     # level back to 0 at scenario end
+    retraces_during: int = 0            # compiles after the warm phase
+    rejected_total: int = 0             # typed rejections, all phases
+    hedges_issued: int = 0
+    hedges_won: int = 0
+    hedge_rate: float = 0.0
+    notes: Dict[str, object] = field(default_factory=dict)
+
+    def as_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "scenario": self.scenario,
+            "phases": self.phases,
+            "brownout_max_level": self.brownout_max_level,
+            "brownout_recovered": self.brownout_recovered,
+            "retraces_during": self.retraces_during,
+            "rejected_total": self.rejected_total,
+            "hedges_issued": self.hedges_issued,
+            "hedges_won": self.hedges_won,
+            "hedge_rate": round(self.hedge_rate, 4),
+        }
+        if self.notes:
+            out["notes"] = self.notes
+        return out
+
+
+def _group_closed_loop(group, op: str, *, clients: int = 8,
+                       rows: int = 4, duration_s: float = 2.0,
+                       tenants: Optional[Sequence[str]] = None,
+                       deadline_s: Optional[float] = None,
+                       seed: int = 0, wait_s: float = 30.0
+                       ) -> LoadReport:
+    """Closed loop through :meth:`ReplicaGroup.submit` — the HEDGED
+    fleet entry point (``fleet_closed_loop`` deliberately routes
+    unhedged for per-replica attribution; this helper measures what a
+    hedging client experiences)."""
+    tenants = list(tenants) if tenants else ["default"]
+    report = LoadReport(mode="group_closed", duration_s=0.0)
+    lock = threading.Lock()
+    stop = threading.Event()
+    dim = None
+    dtype = None
+    for r in group.healthy():
+        try:
+            svc = r.executor._service(op)
+            dim, dtype = svc.dim, svc.dtype
+            break
+        except (KeyError, ValueError):
+            continue
+    if dim is None:
+        raise KeyError(f"no healthy replica serves op {op!r}")
+    t0 = time.monotonic()
+
+    def client(i: int) -> None:
+        rng = np.random.default_rng(seed + i)
+        tenant = tenants[i % len(tenants)]
+        while not stop.is_set():
+            q = rng.standard_normal((rows, dim)).astype(dtype)
+            t_submit = time.monotonic()
+            try:
+                fut = group.submit(op, q, tenant=tenant,
+                                   deadline_s=deadline_s)
+            except limits.RejectedError:
+                with lock:
+                    report.rejected += 1
+                time.sleep(0.001)
+                continue
+            _record(report, lock, rows, t_submit, fut, wait_s)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=wait_s)
+    report.duration_s = time.monotonic() - t0
+    return report
+
+
+def chaos_traffic_step(executor, op: str, *, base_qps: float = 50.0,
+                       step_factor: float = 4.0, rows: int = 4,
+                       phase_s: float = 2.0,
+                       recovery_s: Optional[float] = None,
+                       tenants: Optional[Sequence[str]] = None,
+                       deadline_s: Optional[float] = None,
+                       seed: int = 0) -> ChaosReport:
+    """Open-loop traffic step: ``base_qps`` → ``step_factor`` × → back.
+
+    The witnesses: the brownout controller engages during the step
+    (level > 0 responses served), every transition rides pre-warmed
+    executables (``retraces_during`` stays 0), and the level returns
+    to 0 in the recovery phase."""
+    rep = ChaosReport(scenario="traffic_step")
+    traces0 = executor.stats.traces
+    common = dict(rows=rows, tenants=tenants, deadline_s=deadline_s,
+                  seed=seed)
+    phases = (("base", base_qps, phase_s),
+              ("step", base_qps * step_factor, phase_s),
+              ("recovery", base_qps,
+               phase_s if recovery_s is None else recovery_s))
+    for name, qps, dur in phases:
+        r = open_loop(executor, op, rate_qps=qps, duration_s=dur,
+                      **common)
+        rep.phases[name] = r.as_dict()
+        rep.rejected_total += r.rejected
+        if r.brownout_levels:
+            rep.brownout_max_level = max(rep.brownout_max_level,
+                                         max(r.brownout_levels))
+    rep.retraces_during = executor.stats.traces - traces0
+    ctl = getattr(executor, "brownout", None)
+    rep.brownout_recovered = ctl is None or not ctl.snapshot()
+    if ctl is not None:
+        rep.notes["controller"] = ctl.snapshot()
+    return rep
+
+
+def chaos_slow_replica(group, op: str, *, stall_s: float = 0.05,
+                       victim: int = 0, clients: int = 8,
+                       rows: int = 4, phase_s: float = 2.0,
+                       stall_duty: float = 1.0,
+                       stall_period_s: float = 0.5,
+                       tenants: Optional[Sequence[str]] = None,
+                       deadline_s: Optional[float] = None,
+                       seed: int = 0) -> ChaosReport:
+    """One replica straggles (``FaultInjector.stall`` on its executor);
+    hedged dispatch must hold fleet p99 near the healthy baseline while
+    spending at most the hedge budget. Phases: ``healthy`` (baseline),
+    ``stalled`` (victim straggling), ``healed`` (stall disarmed).
+
+    ``stall_duty`` < 1 makes the straggler INTERMITTENT: the stall is
+    armed for ``stall_duty x stall_period_s`` of every period and
+    disarmed for the rest — the GC-pause/compaction profile hedging is
+    built for. A constant straggler (duty 1.0, the default) slows half
+    a 2-replica fleet's traffic, more demand than a <= 5% hedge budget
+    can cover by design; the duty-cycled profile keeps the latency
+    spikes in the tail where the budget reaches them."""
+    if not 0.0 < stall_duty <= 1.0:
+        raise ValueError(f"stall_duty must be in (0, 1], "
+                         f"got {stall_duty}")
+    if not stall_period_s > 0.0:
+        raise ValueError(f"stall_period_s must be > 0, "
+                         f"got {stall_period_s}")
+    inj = group.replicas[victim].executor.faults
+    if inj is None:
+        raise ValueError(
+            f"replica {victim} has no FaultInjector attached — build "
+            f"its Executor with faults=FaultInjector(...) to run the "
+            f"slow-replica scenario")
+    rep = ChaosReport(scenario="slow_replica")
+    issued0, won0 = (group.stats.hedges_issued, group.stats.hedges_won)
+    routed0 = group.stats.routed
+    common = dict(clients=clients, rows=rows, duration_s=phase_s,
+                  tenants=tenants, deadline_s=deadline_s, seed=seed)
+    r = _group_closed_loop(group, op, **common)
+    rep.phases["healthy"] = r.as_dict()
+    rep.rejected_total += r.rejected
+    toggler: Optional[threading.Thread] = None
+    stop_toggle = threading.Event()
+    if stall_duty < 1.0:
+        def _toggle() -> None:
+            while True:
+                inj.stall(stall_s)
+                if stop_toggle.wait(stall_period_s * stall_duty):
+                    return
+                inj.stall(0.0)
+                if stop_toggle.wait(stall_period_s
+                                    * (1.0 - stall_duty)):
+                    return
+
+        toggler = threading.Thread(target=_toggle, daemon=True,
+                                   name="raft-tpu-stall-toggle")
+        toggler.start()
+    else:
+        inj.stall(stall_s)
+    try:
+        r = _group_closed_loop(group, op, **common)
+        rep.phases["stalled"] = r.as_dict()
+        rep.rejected_total += r.rejected
+    finally:
+        stop_toggle.set()
+        if toggler is not None:
+            toggler.join(timeout=10.0)
+        inj.stall(0.0)
+    r = _group_closed_loop(group, op, **common)
+    rep.phases["healed"] = r.as_dict()
+    rep.rejected_total += r.rejected
+    rep.hedges_issued = group.stats.hedges_issued - issued0
+    rep.hedges_won = group.stats.hedges_won - won0
+    routed = group.stats.routed - routed0
+    rep.hedge_rate = rep.hedges_issued / routed if routed else 0.0
+    rep.notes["victim"] = group.replicas[victim].name
+    rep.notes["stall_s"] = stall_s
+    if stall_duty < 1.0:
+        rep.notes["stall_duty"] = stall_duty
+        rep.notes["stall_period_s"] = stall_period_s
+    return rep
+
+
+def chaos_hog_tenant(executor, op: str, *, hog_clients: int = 6,
+                     light_clients: int = 2, rows: int = 4,
+                     phase_s: float = 2.0,
+                     deadline_s: Optional[float] = None,
+                     seed: int = 0) -> ChaosReport:
+    """One tenant floods the queue while a light tenant keeps its small
+    trickle: weighted-fair scheduling plus per-tenant brownout should
+    degrade the HOG (its burn rate spikes first) while the light
+    tenant — typically pinned by ``min_quality`` — keeps full
+    quality."""
+    rep = ChaosReport(scenario="hog_tenant")
+    tenants = ["hog"] * hog_clients + ["light"] * light_clients
+    r = closed_loop(executor, op, clients=hog_clients + light_clients,
+                    rows=rows, duration_s=phase_s, tenants=tenants,
+                    deadline_s=deadline_s, seed=seed)
+    rep.phases["flood"] = r.as_dict()
+    rep.rejected_total = r.rejected
+    if r.brownout_levels:
+        rep.brownout_max_level = max(r.brownout_levels)
+    ctl = getattr(executor, "brownout", None)
+    if ctl is not None:
+        snap = ctl.snapshot()
+        rep.notes["controller"] = snap
+        rep.notes["light_level"] = max(
+            (lv.get("light", 0) for lv in snap.values()), default=0)
+        rep.brownout_recovered = not snap
+    return rep
+
+
+def chaos_kill_mid_spike(group, op: str, *, clients: int = 8,
+                         rows: int = 4, phase_s: float = 2.0,
+                         kill_after_s: Optional[float] = None,
+                         tenants: Optional[Sequence[str]] = None,
+                         deadline_s: Optional[float] = None,
+                         seed: int = 0) -> ChaosReport:
+    """A replica dies at the peak of a closed-loop spike: heal-path
+    routing, brownout and hedging all under one roof. Wraps
+    :func:`fleet_closed_loop`'s kill machinery and surfaces its
+    recovery-to-SLO clock."""
+    rep = ChaosReport(scenario="kill_mid_spike")
+    fr = fleet_closed_loop(
+        group, op, clients=clients, rows=rows, duration_s=phase_s,
+        tenants=tenants, deadline_s=deadline_s, seed=seed,
+        kill_after_s=kill_after_s
+        if kill_after_s is not None else phase_s / 3)
+    rep.phases["spike"] = fr.as_dict()
+    rep.rejected_total = (fr.fleet.rejected if fr.fleet else 0) \
+        + fr.router_rejected
+    rep.hedges_issued = fr.hedges_issued
+    rep.hedges_won = fr.hedges_won
+    rep.hedge_rate = fr.hedge_rate
+    levels = (fr.fleet.brownout_levels if fr.fleet else {}) or {}
+    for rrep in fr.per_replica.values():
+        for lvl, n in rrep.brownout_levels.items():
+            levels[lvl] = levels.get(lvl, 0) + n
+    if levels:
+        rep.brownout_max_level = max(levels)
+    rep.notes["killed"] = fr.killed
+    rep.notes["recovery_time_to_slo_s"] = fr.recovery_time_to_slo_s
+    return rep
+
+
+#: scenario name -> callable(target, op, **kwargs). ``traffic_step``
+#: and ``hog_tenant`` take an Executor; the fleet scenarios take a
+#: ReplicaGroup.
+CHAOS_SCENARIOS = {
+    "traffic_step": chaos_traffic_step,
+    "slow_replica": chaos_slow_replica,
+    "hog_tenant": chaos_hog_tenant,
+    "kill_mid_spike": chaos_kill_mid_spike,
+}
+
+
+def run_chaos(scenario: str, target, op: str, **kwargs) -> ChaosReport:
+    """Dispatch one named chaos scenario against an Executor or
+    ReplicaGroup (see :data:`CHAOS_SCENARIOS`)."""
+    fn = CHAOS_SCENARIOS.get(scenario)
+    if fn is None:
+        raise ValueError(f"unknown chaos scenario {scenario!r}; have "
+                         f"{sorted(CHAOS_SCENARIOS)}")
+    return fn(target, op, **kwargs)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     """``python -m raft_tpu.serve.loadgen`` — run the generator against
     a synthetic kNN fleet and print the report as JSON.
@@ -475,33 +796,73 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--kill-after", type=float, default=None,
                    help="kill one replica this many seconds into the "
                         "run (needs --replicas >= 2)")
+    p.add_argument("--chaos", choices=sorted(CHAOS_SCENARIOS),
+                   default=None,
+                   help="run one chaos scenario instead of a plain "
+                        "load run (arms brownout + hedging)")
+    p.add_argument("--stall", type=float, default=0.05,
+                   help="slow-replica scenario stall seconds")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
     if args.kill_after is not None and args.replicas < 2:
         p.error("--kill-after needs --replicas >= 2")
 
-    from raft_tpu.serve import (BatchPolicy, Executor, KnnService,
-                                QosPolicy, ReplicaGroup, TenantPolicy)
+    from raft_tpu.serve import (BatchPolicy, BrownoutController,
+                                Executor, HedgePolicy, KnnService,
+                                QosPolicy, ReplicaGroup, TenantPolicy,
+                                knn_ladder)
     from raft_tpu.serve.queue import bucket_ladder
 
     rng = np.random.default_rng(args.seed)
     db = rng.standard_normal((args.n_db, args.dim)).astype(np.float32)
     op = f"knn_k{args.k}_{args.metric}"
 
-    def make_executor():
+    def make_executor(*, with_brownout: bool = False, faults=None):
         qos = None
         if args.slo_ms is not None:
             qos = QosPolicy({"default": TenantPolicy(
                 slo_latency_s=args.slo_ms * 1e-3)})
+        brown = None
+        if with_brownout:
+            ks = sorted({args.k, max(args.k // 2, 1),
+                         max(args.k // 4, 1)}, reverse=True)
+            brown = BrownoutController(
+                [knn_ladder(db, ks, metric=args.metric)], qos=qos)
         ex = Executor([KnnService(db, k=args.k, metric=args.metric)],
                       policy=BatchPolicy(max_batch=256, max_wait_ms=2.0),
-                      qos=qos)
+                      qos=qos, brownout=brown, faults=faults)
         ex.warm(bucket_ladder(256))
         return ex
 
     common = dict(clients=args.clients, rows=args.rows,
                   duration_s=args.duration, deadline_s=args.deadline,
                   seed=args.seed)
+    if args.chaos is not None:
+        import json as _json
+
+        from raft_tpu.comms.faults import FaultInjector
+
+        kw = dict(rows=args.rows, phase_s=args.duration,
+                  deadline_s=args.deadline, seed=args.seed)
+        if args.chaos in ("traffic_step", "hog_tenant"):
+            if args.chaos == "traffic_step":
+                kw["base_qps"] = args.rate_qps
+            ex = make_executor(with_brownout=True)
+            with ex:
+                report = run_chaos(args.chaos, ex, op, **kw)
+        else:
+            n = max(args.replicas, 2)
+            group = ReplicaGroup(
+                [make_executor(faults=FaultInjector(seed=args.seed))
+                 for _ in range(n)],
+                hedge=HedgePolicy())
+            kw["clients"] = args.clients
+            if args.chaos == "slow_replica":
+                kw["stall_s"] = args.stall
+            with group:
+                report = run_chaos(args.chaos, group, op, **kw)
+        print(_json.dumps(report.as_dict(), indent=2, sort_keys=True))
+        return 0
     if args.replicas > 1:
         group = ReplicaGroup([make_executor()
                               for _ in range(args.replicas)])

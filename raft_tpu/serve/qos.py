@@ -72,6 +72,12 @@ class TenantPolicy:
         the objective's success fraction (e.g. 0.99 = "99% of requests
         under ``slo_latency_s``"); the burn-rate gauge is the windowed
         violation fraction divided by the tolerated ``1 - slo_target``.
+    min_quality
+        brownout floor (ISSUE 16): the DEEPEST degradation-ladder level
+        the controller may serve this tenant at. 0 pins full quality
+        (the tenant is exempt from brownout); None = the whole ladder
+        is fair game. Serving below this floor is a contract violation
+        the executor flight-records.
     """
 
     weight: float = 1.0
@@ -79,6 +85,7 @@ class TenantPolicy:
     max_queued: Optional[int] = None
     slo_latency_s: Optional[float] = None
     slo_target: float = 0.99
+    min_quality: Optional[int] = None
 
     def __post_init__(self):
         if not self.weight > 0:
@@ -91,6 +98,9 @@ class TenantPolicy:
         if not (0.0 < self.slo_target < 1.0):
             raise ValueError(f"slo_target must be in (0, 1), "
                              f"got {self.slo_target}")
+        if self.min_quality is not None and self.min_quality < 0:
+            raise ValueError(f"min_quality must be >= 0 when set, "
+                             f"got {self.min_quality}")
 
 
 class QosPolicy:

@@ -94,22 +94,45 @@ def bucket_ladder(max_rows: int, floor: int = BUCKET_FLOOR) -> List[int]:
 class ResultFuture:
     """One request's completion slot: the caller blocks on
     :meth:`result`, the executor fulfills exactly once with either a
-    value or a typed exception."""
+    value or a typed exception. First fulfillment wins; later ones are
+    ignored (a hedged loser may be cancelled and then still complete)."""
 
-    __slots__ = ("_event", "_value", "_exc")
+    __slots__ = ("_event", "_value", "_exc", "_callbacks", "_lock")
 
     def __init__(self):
         self._event = threading.Event()
         self._value = None
         self._exc: Optional[BaseException] = None
+        self._callbacks: List = []
+        self._lock = threading.Lock()
+
+    def _fulfill(self, value, exc) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return                       # first outcome wins
+            self._value = value
+            self._exc = exc
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            cb(self)
 
     def set_result(self, value) -> None:
-        self._value = value
-        self._event.set()
+        self._fulfill(value, None)
 
     def set_exception(self, exc: BaseException) -> None:
-        self._exc = exc
-        self._event.set()
+        self._fulfill(None, exc)
+
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(self)`` when the future resolves (immediately if it
+        already has). Callbacks run on the fulfilling thread — keep
+        them tiny and non-blocking (hedge bookkeeping, latency
+        samples)."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -123,6 +146,14 @@ class ResultFuture:
         if self._exc is not None:
             raise self._exc
         return self._value
+
+    def exception(self, timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        """The outcome's exception (None on success) — the peek
+        :meth:`result` can't offer because it re-raises."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request still pending")
+        return self._exc
 
 
 @dataclass
@@ -139,6 +170,15 @@ class Request:
     # minted at submit when RAFT_TPU_TRACING=on; None otherwise — every
     # downstream propagation site keys off `ctx is None`
     ctx: Optional[obs.TraceContext] = None
+    # brownout quality level this request was admitted at (0 = full
+    # quality); stamped by the executor at submit, echoed on the span
+    level: int = 0
+    # True when this request is a hedge re-issue (Dean & Barroso) — the
+    # second leg of a first-completion-wins pair
+    hedge: bool = False
+    # cancellation reason, or None. Set via :meth:`cancel` (hedge loser,
+    # shutdown); a cancelled request is swept/skipped instead of launched
+    cancelled: Optional[str] = None
 
     @property
     def rows(self) -> int:
@@ -146,6 +186,16 @@ class Request:
 
     def expired(self) -> bool:
         return self.deadline is not None and self.deadline.expired()
+
+    def cancel(self, reason: str) -> None:
+        """Best-effort cancellation: mark the request so the executor
+        drops it at drain instead of launching it. A request already in
+        flight still completes — its future's first outcome wins, so a
+        cancelled-then-completed loser is simply ignored."""
+        self.cancelled = reason
+        self.future.set_exception(limits.RejectedError(
+            f"serve.{self.op}: request cancelled ({reason})",
+            op=f"serve.{self.op}", reason="cancelled"))
 
 
 @dataclass
@@ -253,6 +303,23 @@ class RequestQueue:
         share of it — is at capacity: backpressure is an admission
         decision, typed and metered exactly like an over-budget launch.
         """
+        return self.submit_request(op, queries, tenant=tenant,
+                                   deadline_s=deadline_s).future
+
+    def submit_request(self, op: str, queries, *,
+                       tenant: str = "default",
+                       deadline_s: Optional[float] = None,
+                       level: int = 0,
+                       hedge: bool = False) -> Request:
+        """:meth:`submit`, but returns the :class:`Request` itself —
+        callers that need cancellation (hedged dispatch) or the stamped
+        brownout ``level`` hold the request, everyone else holds just
+        the future.
+
+        Before the capacity check, dead heads (expired in queue, or
+        cancelled hedge losers) are swept out: a request that can no
+        longer be served must not hold a queue slot against a live
+        successor during a spike."""
         queries = np.asarray(queries)
         if queries.ndim != 2 or queries.shape[0] < 1:
             raise ValueError(
@@ -260,38 +327,87 @@ class RequestQueue:
         if deadline_s is None and self.qos is not None:
             deadline_s = self.qos.policy(tenant).deadline_s
         dl = limits.Deadline(deadline_s) if deadline_s is not None else None
-        with self._cond:
-            if self._closed:
-                raise limits.RejectedError(
-                    f"serve.{op}: queue is closed — the serving runtime "
-                    "is shutting down", op=f"serve.{op}",
-                    reason="queue_closed")
-            if self._pending >= self.policy.max_queue:
-                obs.inc("limits_rejected_total", 1, reason="queue_full",
-                        op=f"serve.{op}")
-                exc = limits.RejectedError(
-                    f"serve.{op}: queue full ({self._pending} requests "
-                    f">= max_queue={self.policy.max_queue}) — retry with "
-                    "backoff or shed load", op=f"serve.{op}",
-                    reason="queue_full")
-                obs.record_failure(exc, tenant=tenant)
-                raise exc
-            if self.qos is not None:
-                self.qos.check_tenant_share(
-                    op, tenant, self._tenant_pending(op, tenant))
-            st = self._ops.get(op)
-            if st is None:
-                st = self._ops[op] = _OpState()
-            req = Request(op=op, queries=queries, tenant=tenant,
-                          seq=self._seq, t_enqueue=time.monotonic(),
-                          deadline=dl, ctx=obs.mint(tenant=tenant))
-            self._seq += 1
-            st.push(req, self._weight(tenant))
-            self._pending += 1
+        swept: List[Request] = []
+        try:
+            with self._cond:
+                if self._closed:
+                    raise limits.RejectedError(
+                        f"serve.{op}: queue is closed — the serving "
+                        "runtime is shutting down", op=f"serve.{op}",
+                        reason="queue_closed")
+                self._sweep_dead_locked(swept)
+                if self._pending >= self.policy.max_queue:
+                    obs.inc("limits_rejected_total", 1,
+                            reason="queue_full", op=f"serve.{op}")
+                    exc = limits.RejectedError(
+                        f"serve.{op}: queue full ({self._pending} "
+                        f"requests >= max_queue="
+                        f"{self.policy.max_queue}) — retry with "
+                        "backoff or shed load", op=f"serve.{op}",
+                        reason="queue_full")
+                    obs.record_failure(exc, tenant=tenant)
+                    raise exc
+                if self.qos is not None:
+                    self.qos.check_tenant_share(
+                        op, tenant, self._tenant_pending(op, tenant))
+                st = self._ops.get(op)
+                if st is None:
+                    st = self._ops[op] = _OpState()
+                req = Request(op=op, queries=queries, tenant=tenant,
+                              seq=self._seq, t_enqueue=time.monotonic(),
+                              deadline=dl, ctx=obs.mint(tenant=tenant),
+                              level=int(level), hedge=bool(hedge))
+                self._seq += 1
+                st.push(req, self._weight(tenant))
+                self._pending += 1
+                obs.set_gauge("serve_queue_depth", self._pending,
+                              help="requests waiting in the serving "
+                                   "queue")
+                self._cond.notify_all()
+        finally:
+            # futures resolve OUTSIDE the queue lock: done-callbacks
+            # (hedge bookkeeping) may touch other locks
+            self._resolve_swept(swept)
+        return req
+
+    def _sweep_dead_locked(self, swept: List[Request]) -> None:
+        """Pop expired/cancelled HEAD requests (under the lock) so they
+        stop holding queue slots; the caller resolves their futures
+        after releasing it. Virtual time does not advance — no rows
+        were served."""
+        for op in list(self._ops):
+            st = self._ops[op]
+            for dq in st.tenants.values():
+                while dq and (dq[0].cancelled is not None
+                              or dq[0].expired()):
+                    r = dq.popleft()
+                    st.rows -= r.rows
+                    self._pending -= 1
+                    swept.append(r)
+            if st.empty():
+                del self._ops[op]
+        if swept:
             obs.set_gauge("serve_queue_depth", self._pending,
                           help="requests waiting in the serving queue")
-            self._cond.notify_all()
-        return req.future
+
+    def _resolve_swept(self, swept: List[Request]) -> None:
+        for r in swept:
+            if r.cancelled is not None:
+                continue                 # cancel() resolved it already
+            wait = time.monotonic() - r.t_enqueue
+            obs.inc("limits_deadline_exceeded_total", 1,
+                    op=f"serve.{r.op}")
+            exc = limits.DeadlineExceededError(
+                f"serve.{r.op}: deadline expired in queue (swept at "
+                f"successor enqueue; {r.deadline.budget_s:g}s budget, "
+                f"waited {wait:.3f}s)",
+                op=f"serve.{r.op}", budget_s=r.deadline.budget_s)
+            with obs.use_context(r.ctx):
+                obs.record_failure(exc, tenant=r.tenant)
+            if self.qos is not None and obs.enabled():
+                self.qos.record_outcome(r.op, r.tenant, wait,
+                                        failed=True)
+            r.future.set_exception(exc)
 
     def _weight(self, tenant: str) -> float:
         if self.qos is None:

@@ -30,19 +30,23 @@ same ops — behind one router:
 
 from __future__ import annotations
 
+import collections
+import heapq
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from raft_tpu import obs
+from raft_tpu.core import env as _env_mod
 from raft_tpu.runtime import limits
 from raft_tpu.serve.executor import Executor
+from raft_tpu.serve.queue import Request, ResultFuture, bucket_rows
 
 __all__ = ["Replica", "ReplicaGroup", "ReplicaGroupStats",
-           "RecoveryReport"]
+           "RecoveryReport", "HedgePolicy"]
 
 
 @dataclass
@@ -69,6 +73,266 @@ class ReplicaGroupStats:
     failures: int = 0               # replicas marked failed
     recoveries: int = 0             # completed heal() shrink cycles
     last_recovery_s: float = 0.0
+    hedges_issued: int = 0          # second legs actually dispatched
+    hedges_won: int = 0             # hedge leg finished first
+    hedges_suppressed: int = 0      # budget / no-replica suppressions
+
+    def hedge_rate(self) -> float:
+        """Issued hedges over routed submits — the ≤5% invariant the
+        slow-replica gate asserts."""
+        return self.hedges_issued / self.routed if self.routed else 0.0
+
+
+@dataclass(frozen=True)
+class HedgePolicy:
+    """Hedged-request tuning (Dean & Barroso, "The Tail at Scale").
+
+    A hedge fires only after the request has outlived the adaptive
+    per-bucket delay — the ``quantile`` (default p95) of the last
+    ``window`` primary completion latencies for that row bucket — so
+    ~`1 - quantile` of requests are even eligible, and the per-tenant
+    budget (``budget_fraction`` of primary submits over
+    ``budget_window_s``) hard-caps amplification below that. Until
+    ``min_samples`` completions exist for a bucket there is no delay
+    estimate and no hedging: an unwarmed fleet must not hedge blind."""
+
+    delay_floor_s: float = 0.002    # never hedge earlier than this
+    quantile: float = 0.95
+    window: int = 128               # latency samples kept per bucket
+    min_samples: int = 16
+    budget_fraction: float = 0.05   # hedges / primaries, per tenant
+    budget_window_s: float = 60.0
+
+    def __post_init__(self):
+        if not self.delay_floor_s >= 0:
+            raise ValueError("delay_floor_s must be >= 0")
+        if not (0.0 < self.quantile < 1.0):
+            raise ValueError(
+                f"quantile must be in (0, 1), got {self.quantile}")
+        if self.window < 1 or self.min_samples < 1:
+            raise ValueError("window and min_samples must be >= 1")
+        if not (0.0 < self.budget_fraction <= 1.0):
+            raise ValueError(f"budget_fraction must be in (0, 1], "
+                             f"got {self.budget_fraction}")
+        if not self.budget_window_s > 0:
+            raise ValueError("budget_window_s must be > 0")
+
+
+class _HedgeEntry:
+    """One watched submit: the caller-visible outer future plus up to
+    two legs (primary, hedge). First leg to SUCCEED fulfills the outer
+    future and cancels the other; the outer future fails only when no
+    leg can succeed anymore."""
+
+    __slots__ = ("op", "queries", "tenant", "deadline_s", "outer",
+                 "primary", "primary_replica", "hedge", "t0", "lock",
+                 "decided")
+
+    def __init__(self, op, queries, tenant, deadline_s, outer,
+                 primary: Request, primary_replica: str, t0: float):
+        self.op = op
+        self.queries = queries
+        self.tenant = tenant
+        self.deadline_s = deadline_s
+        self.outer = outer
+        self.primary = primary
+        self.primary_replica = primary_replica
+        self.hedge: Optional[Request] = None
+        self.t0 = t0
+        self.lock = threading.Lock()
+        self.decided = False            # a leg claimed the outcome
+
+
+class _Hedger:
+    """The group's hedge engine: one scheduler thread over a time-heap
+    of watched submits, per-bucket latency windows, and per-tenant
+    :class:`~raft_tpu.runtime.limits.RateBudget` caps."""
+
+    def __init__(self, group: "ReplicaGroup", policy: HedgePolicy):
+        self._group = group
+        self.policy = policy
+        self._cond = threading.Condition()
+        self._heap: List[Tuple[float, int, _HedgeEntry]] = []
+        self._seq = 0
+        self._samples: Dict[int, Deque[float]] = {}
+        self._samples_lock = threading.Lock()
+        self._budgets: Dict[str, limits.RateBudget] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        with self._cond:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run,
+                                            name="raft-tpu-hedge",
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            # join OUTSIDE the condition: the scheduler loop takes it
+            thread.join(timeout=10.0)
+
+    # -- delay estimate ------------------------------------------------
+
+    def _record_sample(self, bucket: int, latency_s: float) -> None:
+        with self._samples_lock:
+            dq = self._samples.get(bucket)
+            if dq is None:
+                dq = self._samples[bucket] = collections.deque(
+                    maxlen=self.policy.window)
+            dq.append(latency_s)
+
+    def hedge_delay(self, bucket: int) -> Optional[float]:
+        """The adaptive delay for a row bucket: the policy quantile of
+        recent primary completions, floored at ``delay_floor_s``; None
+        until ``min_samples`` completions exist (no blind hedging)."""
+        with self._samples_lock:
+            dq = self._samples.get(bucket)
+            if dq is None or len(dq) < self.policy.min_samples:
+                return None
+            samples = sorted(dq)
+        idx = min(int(len(samples) * self.policy.quantile),
+                  len(samples) - 1)
+        return max(samples[idx], self.policy.delay_floor_s)
+
+    def _budget(self, tenant: str) -> limits.RateBudget:
+        b = self._budgets.get(tenant)
+        if b is None:
+            with self._samples_lock:
+                b = self._budgets.setdefault(
+                    tenant, limits.RateBudget(
+                        max_fraction=self.policy.budget_fraction,
+                        window_s=self.policy.budget_window_s))
+        return b
+
+    # -- the watched-submit surface -------------------------------------
+
+    def watch(self, replica: Replica, req: Request) -> ResultFuture:
+        """Wrap one routed primary request: returns the outer future,
+        schedules the hedge timer when a delay estimate exists, and
+        wires the first-success-wins state machine."""
+        outer = ResultFuture()
+        t0 = time.monotonic()
+        entry = _HedgeEntry(req.op, req.queries, req.tenant,
+                            req.deadline.budget_s if req.deadline
+                            else None, outer, req, replica.name, t0)
+        self._budget(req.tenant).note()
+        req.future.add_done_callback(
+            lambda fut: self._on_leg_done(entry, req, fut,
+                                          is_hedge=False))
+        delay = self.hedge_delay(bucket_rows(req.rows))
+        if delay is not None:
+            with self._cond:
+                self._seq += 1
+                heapq.heappush(self._heap, (t0 + delay, self._seq,
+                                            entry))
+                self._cond.notify_all()
+        return outer
+
+    def _on_leg_done(self, entry: _HedgeEntry, req: Request, fut,
+                     is_hedge: bool) -> None:
+        # Runs on the fulfilling (executor drain) thread. Decisions are
+        # made under entry.lock; SIDE EFFECTS run after releasing it —
+        # cancel() fulfills the loser's future, which fires THIS
+        # callback again synchronously on the same thread, so doing it
+        # under the (non-reentrant) lock would deadlock the drain loop.
+        exc = fut.exception(timeout=0)
+        if not is_hedge and exc is None:
+            self._record_sample(bucket_rows(req.rows),
+                                time.monotonic() - entry.t0)
+        win = fail = raced = False
+        to_cancel: Optional[Request] = None
+        with entry.lock:
+            other = entry.primary if is_hedge else entry.hedge
+            if exc is None:
+                if not entry.decided:
+                    entry.decided = True
+                    win = True
+                    raced = entry.hedge is not None
+                    if other is not None and not other.future.done():
+                        to_cancel = other
+            elif not entry.decided and (other is None
+                                        or other.future.done()):
+                # no leg can succeed anymore: surface this failure
+                entry.decided = True
+                fail = True
+        if win:
+            entry.outer.set_result(fut.result(timeout=0))
+            if raced:
+                obs.inc("serve_hedges_total", 1,
+                        outcome="won" if is_hedge else "lost")
+                if is_hedge:
+                    with self._group._lock:
+                        self._group.stats.hedges_won += 1
+            if to_cancel is not None:
+                to_cancel.cancel("hedge_lost")
+        elif fail:
+            entry.outer.set_exception(exc)
+
+    # -- scheduler thread ----------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._cond:
+                if not self._heap:
+                    self._cond.wait(0.1)
+                    continue
+                fire_at, _, entry = self._heap[0]
+                now = time.monotonic()
+                if fire_at > now:
+                    self._cond.wait(min(fire_at - now, 0.1))
+                    continue
+                heapq.heappop(self._heap)
+            self._fire(entry)
+
+    def _fire(self, entry: _HedgeEntry) -> None:
+        with entry.lock:
+            if entry.decided or entry.primary.future.done():
+                return                      # primary made it in time
+        if entry.primary.cancelled is not None:
+            return
+        if not self._budget(entry.tenant).try_spend():
+            with self._group._lock:
+                self._group.stats.hedges_suppressed += 1
+            obs.inc("serve_hedges_total", 1, outcome="suppressed")
+            return
+        try:
+            _, hedge_req = self._group._route_request(
+                entry.op, entry.queries, tenant=entry.tenant,
+                deadline_s=entry.deadline_s, hedge=True,
+                exclude=entry.primary_replica)
+        except limits.RejectedError:
+            with self._group._lock:
+                self._group.stats.hedges_suppressed += 1
+            obs.inc("serve_hedges_total", 1, outcome="suppressed")
+            return
+        issue = False
+        with entry.lock:
+            if not entry.decided:
+                entry.hedge = hedge_req
+                issue = True
+        if not issue:
+            # primary finished while we were routing: the hedge is a
+            # dead leg — cancel it before it burns a launch
+            hedge_req.cancel("hedge_unneeded")
+        if issue:
+            with self._group._lock:
+                self._group.stats.hedges_issued += 1
+            obs.inc("serve_hedges_total", 1, outcome="issued",
+                    help="hedged second legs by outcome "
+                         "(issued|won|lost|suppressed)")
+            hedge_req.future.add_done_callback(
+                lambda fut: self._on_leg_done(entry, hedge_req, fut,
+                                              is_hedge=True))
 
 
 @dataclass(frozen=True)
@@ -101,7 +365,8 @@ class ReplicaGroup:
                  names: Optional[Sequence[str]] = None,
                  weights: Optional[Sequence[float]] = None,
                  comms=None,
-                 on_shrink: Optional[Callable] = None):
+                 on_shrink: Optional[Callable] = None,
+                 hedge: Optional[HedgePolicy] = None):
         if not executors:
             raise ValueError("need at least one replica executor")
         names = list(names) if names else [
@@ -119,6 +384,12 @@ class ReplicaGroup:
         self.stats = ReplicaGroupStats()
         self._lock = threading.Lock()
         self._started = False
+        # hedged dispatch (ISSUE 16): armed by passing a HedgePolicy,
+        # kill-switched fleet-wide by RAFT_TPU_HEDGE=off
+        if hedge is not None and not bool(_env_mod.read("RAFT_TPU_HEDGE")):
+            hedge = None
+        self.hedge = hedge
+        self._hedger = _Hedger(self, hedge) if hedge is not None else None
 
     # -- membership ----------------------------------------------------
 
@@ -153,6 +424,20 @@ class ReplicaGroup:
         obs.inc("serve_replica_failures_total", 1, replica=r.name)
         obs.emit_event("serve.replica_failed", replica=r.name,
                        reason=reason)
+
+    def rejoin(self, which) -> Replica:
+        """Bring a marked-failed replica back into routing (the
+        operator "it's healthy again" signal). Its stale virtual clock
+        snaps to the fleet floor at the next route — the rejoiner gets
+        its fair share immediately, not a catch-up flood."""
+        r = self._resolve(which)
+        with self._lock:
+            r.healthy = True
+            r.failed_reason = None
+        if self._started:
+            r.executor.start()
+        obs.emit_event("serve.replica_rejoin", replica=r.name)
+        return r
 
     def fail_replica(self, which, reason: str = "killed") -> Replica:
         """The in-process kill: gate the replica out, tear its drain
@@ -196,9 +481,23 @@ class ReplicaGroup:
         that need per-replica attribution (the loadgen) get it. Spills
         typed rejections down the virtual-time order; re-raises the last
         rejection when every healthy replica refused."""
+        rep, req = self._route_request(op, queries, tenant=tenant,
+                                       deadline_s=deadline_s)
+        return rep, req.future
+
+    def _route_request(self, op: str, queries, *,
+                       tenant: str = "default",
+                       deadline_s: Optional[float] = None,
+                       hedge: bool = False,
+                       exclude: Optional[str] = None
+                       ) -> Tuple[Replica, Request]:
+        """The routing core: ``(replica, Request)``. ``exclude`` skips
+        one replica by name — a hedge's second leg must land somewhere
+        other than the straggler it is hedging against."""
         rows = int(np.asarray(queries).shape[0])
         with self._lock:
-            order = self._pick_order()
+            order = [r for r in self._pick_order()
+                     if r.name != exclude]
         if not order:
             with self._lock:
                 self.stats.rejected += 1
@@ -206,10 +505,11 @@ class ReplicaGroup:
                 f"serve.{op}: no healthy replica in the group",
                 op=f"serve.{op}", reason="no_replica")
         last_exc: Optional[limits.RejectedError] = None
-        for n_tried, r in enumerate(order):
+        for r in order:
             try:
-                fut = r.executor.submit(op, queries, tenant=tenant,
-                                        deadline_s=deadline_s)
+                req = r.executor.submit_request(
+                    op, queries, tenant=tenant, deadline_s=deadline_s,
+                    hedge=hedge)
             except limits.RejectedError as exc:
                 last_exc = exc
                 with self._lock:
@@ -220,22 +520,30 @@ class ReplicaGroup:
             with self._lock:
                 # weighted-fair advance; a replica rejoining far behind
                 # snaps to the fleet floor instead of absorbing a flood
-                floor = min((o.vtime for o in order), default=0.0)
+                # (floor = the OTHERS' minimum — including r itself
+                # would make the laggard its own floor and never snap)
+                floor = min((o.vtime for o in order if o is not r),
+                            default=r.vtime)
                 r.vtime = max(r.vtime, floor) + rows / r.weight
                 r.routed += 1
                 self.stats.routed += 1
-                if n_tried:
-                    pass            # spill already counted above
-            return r, fut
+            return r, req
         with self._lock:
             self.stats.rejected += 1
         raise last_exc
 
     def submit(self, op: str, queries, *, tenant: str = "default",
                deadline_s: Optional[float] = None):
-        """Fleet submit (router-attributed): the future only."""
-        return self.route(op, queries, tenant=tenant,
-                          deadline_s=deadline_s)[1]
+        """Fleet submit (router-attributed): the future only. With a
+        :class:`HedgePolicy` attached this is the hedged entry point:
+        the returned future is fulfilled by whichever leg succeeds
+        first (the loser is cancelled, typed), and the per-tenant hedge
+        budget bounds second legs at ``budget_fraction`` of submits."""
+        rep, req = self._route_request(op, queries, tenant=tenant,
+                                       deadline_s=deadline_s)
+        if self._hedger is None:
+            return req.future
+        return self._hedger.watch(rep, req)
 
     # -- recovery ------------------------------------------------------
 
@@ -329,6 +637,8 @@ class ReplicaGroup:
         for r in self._replicas:
             if r.healthy:
                 r.executor.start()
+        if self._hedger is not None:
+            self._hedger.start()
         with self._lock:
             self._started = True
         obs.emit_event("serve.group_start",
@@ -336,6 +646,8 @@ class ReplicaGroup:
         return self
 
     def stop(self) -> None:
+        if self._hedger is not None:
+            self._hedger.stop()
         for r in self._replicas:
             if r.healthy:
                 r.executor.stop()
@@ -344,7 +656,10 @@ class ReplicaGroup:
         s = self.stats
         obs.emit_event("serve.group_stop", routed=s.routed,
                        spills=s.spills, rejected=s.rejected,
-                       failures=s.failures, recoveries=s.recoveries)
+                       failures=s.failures, recoveries=s.recoveries,
+                       hedges_issued=s.hedges_issued,
+                       hedges_won=s.hedges_won,
+                       hedge_rate=round(s.hedge_rate(), 4))
 
     def __enter__(self) -> "ReplicaGroup":
         return self.start()

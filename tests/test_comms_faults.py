@@ -150,6 +150,81 @@ def test_retry_policy_backoff_deterministic_and_capped():
     assert [nojit.delay(i) for i in range(4)] == [0.1, 0.2, 0.4, 0.4]
 
 
+def test_retry_budget_exhaustion_fails_fast_and_is_metered():
+    """ISSUE 16 satellite: a scope-wide retry budget converts the
+    (N callers x full backoff) storm into a metered fast-fail once the
+    window is spent — shared across every policy naming the scope."""
+    from raft_tpu import obs
+    from raft_tpu.comms import resilience
+    from raft_tpu.obs import metrics as obs_metrics
+
+    resilience.reset_retry_budgets()
+    was_enabled = obs.enabled()
+    old_reg = obs_metrics.set_registry(obs.MetricsRegistry())
+    obs.set_enabled(True)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("nope")
+
+    try:
+        policy = RetryPolicy(max_attempts=10, base_delay=0.001,
+                             max_delay=0.002,
+                             budget_scope="test.retry_budget",
+                             budget_max=2, budget_window_s=60.0)
+        with pytest.raises(OSError, match="nope"):
+            policy.call(always, describe="budgeted")
+        # 1 try + 2 budgeted retries + the blocked third = 3 calls
+        assert len(calls) == 3
+        # the budget is the SCOPE's, not the policy instance's
+        other = RetryPolicy(max_attempts=10, base_delay=0.001,
+                            budget_scope="test.retry_budget",
+                            budget_max=2)
+        calls.clear()
+        with pytest.raises(OSError):
+            other.call(always, describe="second caller")
+        assert len(calls) == 1          # window spent: zero retries
+        snap = obs_metrics.get_registry().snapshot()
+        rej = snap["limits_rejected_total"]["series"]
+        assert any(s["labels"] == {"op": "test.retry_budget",
+                                   "reason": "retry_budget"}
+                   and s["value"] == 2.0 for s in rej), rej
+        budgeted = [s for s in snap["comms_retries_total"]["series"]
+                    if s["labels"] == {"outcome": "budget"}]
+        assert budgeted and budgeted[0]["value"] == 2.0
+    finally:
+        obs.set_enabled(was_enabled)
+        obs_metrics.set_registry(old_reg)
+        resilience.reset_retry_budgets()
+
+
+def test_retry_jitter_deterministic_from_describe(monkeypatch):
+    """With no explicit seed, the jitter schedule derives from the
+    describe string: same call site -> identical backoffs run-to-run,
+    different call sites -> decorrelated."""
+    from raft_tpu.runtime import limits as rt_limits
+
+    waits = []
+    monkeypatch.setattr(rt_limits, "sleep_within_deadline",
+                        lambda w, op=None: waits.append(round(w, 9)))
+
+    def always():
+        raise OSError("x")
+
+    policy = RetryPolicy(max_attempts=4, base_delay=0.05, jitter=0.5)
+    schedules = []
+    for describe in ("link rank0->rank1", "link rank0->rank1",
+                     "link rank0->rank2"):
+        waits.clear()
+        with pytest.raises(OSError):
+            policy.call(always, describe=describe)
+        schedules.append(tuple(waits))
+    assert len(schedules[0]) == 3       # max_attempts - 1 backoffs
+    assert schedules[0] == schedules[1], "same call site must replay"
+    assert schedules[0] != schedules[2], "distinct links decorrelate"
+
+
 def test_retry_events_land_in_active_trace_range():
     """Tentpole part 5: retry observability rides core.trace — events
     carry the active range of the emitting thread."""

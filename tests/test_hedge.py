@@ -1,0 +1,227 @@
+"""Hedged-dispatch tests (ISSUE 16 tentpole, tail half): the adaptive
+per-bucket delay estimate, first-success-wins with typed loser
+cancellation, bit-identical winners under a stalled replica, the
+per-tenant hedge budget, and the env kill switch.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs, serve
+from raft_tpu.comms.faults import FaultInjector
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.runtime import limits
+from raft_tpu.serve import (BatchPolicy, Executor, HedgePolicy,
+                            KnnService, ReplicaGroup)
+from raft_tpu.serve.queue import bucket_rows
+
+DIM = 16
+OP = "knn_k4_l2"
+
+
+@pytest.fixture
+def live_obs():
+    was_enabled = obs.enabled()
+    old_reg = obs_metrics.set_registry(obs.MetricsRegistry())
+    old_sink = obs.set_sink(None)
+    obs.set_enabled(True)
+    try:
+        yield obs_metrics.get_registry()
+    finally:
+        obs.set_enabled(was_enabled)
+        obs_metrics.set_registry(old_reg)
+        obs.set_sink(old_sink)
+
+
+@pytest.fixture(scope="module")
+def db():
+    rng = np.random.default_rng(21)
+    return rng.standard_normal((128, DIM)).astype(np.float32)
+
+
+def _make_ex(db, inj):
+    ex = Executor([KnnService(db, k=4)],
+                  policy=BatchPolicy(max_batch=32, max_wait_ms=0.5),
+                  faults=inj)
+    ex.warm([8])
+    return ex
+
+
+def _group(db, policy, n=2):
+    injs = [FaultInjector(seed=i) for i in range(n)]
+    group = ReplicaGroup([_make_ex(db, inj) for inj in injs],
+                         hedge=policy)
+    return group, injs
+
+
+def _counter_value(reg, name, **labels):
+    fam = reg.snapshot().get(name)
+    if not fam:
+        return 0.0
+    return sum(s["value"] for s in fam["series"]
+               if all(s["labels"].get(k) == v for k, v in labels.items()))
+
+
+class TestHedgePolicy:
+    def test_validation(self):
+        HedgePolicy()                  # defaults valid
+        with pytest.raises(ValueError):
+            HedgePolicy(quantile=1.0)
+        with pytest.raises(ValueError):
+            HedgePolicy(budget_fraction=0.0)
+        with pytest.raises(ValueError):
+            HedgePolicy(min_samples=0)
+        with pytest.raises(ValueError):
+            HedgePolicy(delay_floor_s=-0.1)
+
+    def test_no_delay_estimate_below_min_samples(self, db):
+        group, _ = _group(db, HedgePolicy(min_samples=100))
+        q = np.random.default_rng(0).standard_normal(
+            (4, DIM)).astype(np.float32)
+        with group:
+            for _ in range(5):
+                group.submit(OP, q).result(timeout=30.0)
+        assert group._hedger.hedge_delay(bucket_rows(4)) is None
+        assert group.stats.hedges_issued == 0, \
+            "an unwarmed fleet must not hedge blind"
+
+    def test_delay_is_quantile_floored(self, db):
+        group, _ = _group(db, HedgePolicy(min_samples=4, quantile=0.5,
+                                          delay_floor_s=10.0))
+        h = group._hedger
+        for v in (0.001, 0.002, 0.003, 0.004):
+            h._record_sample(8, v)
+        # p50 of tiny samples floors at delay_floor_s
+        assert h.hedge_delay(8) == 10.0
+
+
+class TestHedgedDispatch:
+    STALL = 0.5
+
+    def test_stalled_replica_hedges_bit_identical(self, db, live_obs):
+        """The acceptance core: with one replica stalled, hedged
+        submits complete well under the stall via the healthy replica,
+        the winner's payload is bit-identical to the eager answer, and
+        the loser is cancelled (typed) instead of burning a launch."""
+        policy = HedgePolicy(min_samples=4, quantile=0.5,
+                             delay_floor_s=0.002, budget_fraction=1.0,
+                             budget_window_s=60.0)
+        group, injs = _group(db, policy)
+        rng = np.random.default_rng(1)
+        q = rng.standard_normal((4, DIM)).astype(np.float32)
+        svc = group.replicas[0].executor.services[OP]
+        want = svc.eager(q)
+        with group:
+            for _ in range(8):          # prime the delay estimate
+                group.submit(OP, q).result(timeout=30.0)
+            assert group._hedger.hedge_delay(bucket_rows(4)) is not None
+            injs[0].stall(self.STALL)
+            try:
+                lat = []
+                for _ in range(4):
+                    t0 = time.monotonic()
+                    out = group.submit(OP, q).result(timeout=30.0)
+                    lat.append(time.monotonic() - t0)
+                    for g, w in zip(out, want):
+                        np.testing.assert_array_equal(
+                            np.asarray(g), np.asarray(w))
+            finally:
+                injs[0].stall(0.0)
+            # cancelled losers surface at the stalled replica's drain
+            deadline = time.monotonic() + 10.0
+            while (group.replicas[0].executor.stats.cancelled == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        s = group.stats
+        assert s.hedges_issued >= 1
+        assert s.hedges_won >= 1
+        # at least one hedged request beat the stall decisively
+        assert min(lat) < self.STALL * 0.8, lat
+        assert group.replicas[0].executor.stats.cancelled >= 1, \
+            "hedge loser must be cancelled, not executed"
+        issued = _counter_value(live_obs, "serve_hedges_total",
+                                outcome="issued")
+        won = _counter_value(live_obs, "serve_hedges_total",
+                             outcome="won")
+        assert issued == s.hedges_issued and won == s.hedges_won
+
+    def test_budget_suppresses_hedges(self, db, live_obs):
+        """A tiny fractional budget suppresses second legs instead of
+        amplifying: Dean & Barroso's <=5% cap as a hard gate."""
+        policy = HedgePolicy(min_samples=4, quantile=0.5,
+                             delay_floor_s=0.002,
+                             budget_fraction=0.01,
+                             budget_window_s=60.0)
+        group, injs = _group(db, policy)
+        rng = np.random.default_rng(2)
+        q = rng.standard_normal((4, DIM)).astype(np.float32)
+        with group:
+            for _ in range(8):
+                group.submit(OP, q).result(timeout=30.0)
+            injs[0].stall(0.15)
+            injs[1].stall(0.15)         # both slow: every watch fires
+            try:
+                futs = [group.submit(OP, q) for _ in range(3)]
+                for f in futs:
+                    f.result(timeout=30.0)
+            finally:
+                injs[0].stall(0.0)
+                injs[1].stall(0.0)
+        # int(11 * 0.01) == 0 allowed hedges in the window
+        assert group.stats.hedges_issued == 0
+        assert group.stats.hedges_suppressed >= 1
+        assert _counter_value(live_obs, "serve_hedges_total",
+                              outcome="suppressed") >= 1.0
+        assert group.stats.hedge_rate() == 0.0
+
+    def test_unhedged_group_unchanged(self, db):
+        group = ReplicaGroup([_make_ex(db, None), _make_ex(db, None)])
+        assert group._hedger is None
+        q = np.random.default_rng(3).standard_normal(
+            (4, DIM)).astype(np.float32)
+        with group:
+            fut = group.submit(OP, q)
+            fut.result(timeout=30.0)
+        assert group.stats.hedges_issued == 0
+
+    def test_env_kill_switch(self, db, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_HEDGE", "off")
+        group, _ = _group(db, HedgePolicy())
+        assert group._hedger is None and group.hedge is None
+
+
+class TestResultFutureSemantics:
+    """The first-fulfillment-wins contract the hedge state machine
+    leans on."""
+
+    def test_second_result_is_noop(self):
+        fut = serve.ResultFuture()
+        fut.set_result("first")
+        fut.set_result("second")
+        fut.set_exception(RuntimeError("late"))
+        assert fut.result(timeout=0) == "first"
+        assert fut.exception(timeout=0) is None
+
+    def test_done_callback_fires_once_outside_lock(self):
+        fut = serve.ResultFuture()
+        fired = []
+        fut.add_done_callback(lambda f: fired.append(f.result(timeout=0)))
+        fut.set_result(7)
+        fut.set_result(8)
+        assert fired == [7]
+        # late registration fires immediately with the settled value
+        fut.add_done_callback(lambda f: fired.append(f.result(timeout=0)))
+        assert fired == [7, 7]
+
+    def test_cancel_resolves_typed(self, db):
+        ex = _make_ex(db, None)
+        q = np.random.default_rng(4).standard_normal(
+            (2, DIM)).astype(np.float32)
+        req = ex.submit_request(OP, q)
+        req.cancel("hedge_lost")
+        with pytest.raises(limits.RejectedError) as ei:
+            req.future.result(timeout=1.0)
+        assert ei.value.reason == "cancelled"
+        assert req.cancelled == "hedge_lost"

@@ -482,3 +482,55 @@ class TestDeadlineLeavesCheckpointUsable:
             with pytest.raises(limits.DeadlineExceededError):
                 kmeans_fit(res, KMeansParams(n_clusters=3, max_iter=20,
                                              seed=0), x)
+
+
+# -- ISSUE 16: RateBudget (the retry/hedge spend cap) ----------------------
+
+
+class TestRateBudget:
+    def test_absolute_cap(self):
+        b = limits.RateBudget(max_events=2, window_s=60.0)
+        assert b.try_spend()
+        assert b.try_spend()
+        assert not b.try_spend()
+        assert b.spent() == 2
+
+    def test_fractional_cap_tracks_primaries(self):
+        b = limits.RateBudget(max_fraction=0.5, window_s=60.0)
+        assert not b.try_spend(), "no primaries -> nothing to hedge"
+        b.note(4)
+        assert b.try_spend()
+        assert b.try_spend()
+        assert not b.try_spend()        # int(4 * 0.5) == 2
+        b.note(2)                       # more traffic raises allowance
+        assert b.try_spend()
+
+    def test_tighter_mode_wins(self):
+        b = limits.RateBudget(max_events=1, max_fraction=0.5,
+                              window_s=60.0)
+        b.note(10)
+        assert b.try_spend()
+        assert not b.try_spend()        # absolute cap bites first
+
+    def test_window_expiry_refills(self):
+        b = limits.RateBudget(max_events=1, window_s=0.05)
+        assert b.try_spend()
+        assert not b.try_spend()
+        time.sleep(0.08)
+        assert b.try_spend()
+
+    def test_multi_spend_is_atomic(self):
+        b = limits.RateBudget(max_events=3, window_s=60.0)
+        assert b.try_spend(2)
+        assert not b.try_spend(2)       # would overshoot: all-or-nothing
+        assert b.try_spend(1)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            limits.RateBudget()
+        with pytest.raises(ValueError):
+            limits.RateBudget(max_events=-1)
+        with pytest.raises(ValueError):
+            limits.RateBudget(max_fraction=1.5)
+        with pytest.raises(ValueError):
+            limits.RateBudget(max_events=1, window_s=0.0)

@@ -124,6 +124,80 @@ class TestMembership:
             group.submit(_op(idx), X[:4])
 
 
+class TestRejoin:
+    def test_rejoin_vtime_snaps_to_fleet_floor(self, small_index):
+        """ISSUE 16 satellite: a replica rejoining far behind in
+        virtual time gets its FAIR share immediately — not the
+        catch-up flood a stale clock would attract."""
+        X, _, idx = small_index
+        group = ReplicaGroup([_make_ex(idx), _make_ex(idx)])
+        op = _op(idx)
+        with group:
+            for _ in range(10):
+                group.route(op, X[:4])[1].result(timeout=60.0)
+            group.mark_failed(0, "down")
+            for _ in range(30):         # replica1's clock runs ahead
+                group.route(op, X[:4])[1].result(timeout=60.0)
+            assert group.replicas[0].routed == 5
+            before = group.replicas[0].routed
+            group.rejoin(0)
+            assert group.replicas[0].healthy
+            assert group.replicas[0].failed_reason is None
+            for _ in range(20):
+                group.route(op, X[:4])[1].result(timeout=60.0)
+        post0 = group.replicas[0].routed - before
+        assert 8 <= post0 <= 12, (
+            f"rejoined replica took {post0}/20 — expected ~fair share, "
+            f"not a catch-up flood")
+
+    def test_rejoin_under_submit_storm_loses_no_future(self, small_index):
+        """8 submitter threads race a mark_failed/rejoin flapper: every
+        accepted future resolves (served, or typed rejection) — none
+        hang, none are lost."""
+        X, _, idx = small_index
+        group = ReplicaGroup([_make_ex(idx) for _ in range(2)])
+        op = _op(idx)
+        stop = threading.Event()
+        accepted, rejected = [], []
+        acc_lock = threading.Lock()
+
+        def flapper():
+            while not stop.is_set():
+                group.mark_failed(0, "flap")
+                time.sleep(0.0005)
+                group.rejoin(0)
+                time.sleep(0.0005)
+
+        def submitter():
+            for _ in range(25):
+                try:
+                    fut = group.submit(op, X[:4])
+                except limits.RejectedError:
+                    with acc_lock:
+                        rejected.append(1)
+                    continue
+                with acc_lock:
+                    accepted.append(fut)
+
+        with group:
+            flap = threading.Thread(target=flapper)
+            subs = [threading.Thread(target=submitter)
+                    for _ in range(8)]
+            flap.start()
+            for s in subs:
+                s.start()
+            for s in subs:
+                s.join()
+            stop.set()
+            flap.join()
+            if not group.replicas[0].healthy:
+                group.rejoin(0)         # leave the fleet whole
+            for fut in accepted:
+                fut.result(timeout=60.0)
+        assert len(accepted) + len(rejected) == 200
+        assert len(accepted) > 0
+
+
 class TestHeal:
     def test_heal_healthy_clique_is_noop(self, small_index):
         from raft_tpu.comms.comms import MeshComms, _Mailbox

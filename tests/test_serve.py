@@ -285,6 +285,51 @@ class TestQos:
         # other tenants are unaffected by the capped tenant's share
         ex.submit("knn_k4_l2", _queries(rng, 1), tenant="other")
 
+    def test_expired_head_swept_at_enqueue(self, data, live_obs):
+        """ISSUE 16 satellite: a dead request must not hold its queue
+        slot. With max_queue=2 and an expired head, the NEXT submit
+        sweeps the corpse and is admitted instead of queue_full-failing
+        behind it."""
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=8, max_wait_ms=1_000.0,
+                                     max_queue=2))
+        rng = np.random.default_rng(0)
+        dead = ex.submit("knn_k4_l2", _queries(rng, 1),
+                         deadline_s=0.005)
+        live1 = ex.submit("knn_k4_l2", _queries(rng, 1))
+        time.sleep(0.05)               # head expires while queued
+        live2 = ex.submit("knn_k4_l2", _queries(rng, 1))
+        assert ex.queue.pending() == 2
+        with pytest.raises(limits.DeadlineExceededError,
+                           match="swept"):
+            dead.result(timeout=1.0)
+        assert _counter_value(live_obs, "limits_deadline_exceeded_total",
+                              op="serve.knn_k4_l2") == 1.0
+        ex.warm([8])
+        with ex:
+            for f in (live1, live2):
+                f.result(timeout=30.0)
+
+    def test_cancelled_head_swept_without_double_resolution(self, data):
+        ex = serve.Executor(
+            [serve.KnnService(data["db"], k=4)],
+            policy=serve.BatchPolicy(max_batch=8, max_wait_ms=1_000.0,
+                                     max_queue=2))
+        rng = np.random.default_rng(1)
+        r1 = ex.submit_request("knn_k4_l2", _queries(rng, 1))
+        r1.cancel("hedge_lost")
+        with pytest.raises(limits.RejectedError) as ei:
+            r1.future.result(timeout=1.0)
+        assert ei.value.reason == "cancelled"
+        # the sweep drops it from the queue; its already-resolved
+        # future is left alone (first fulfillment won)
+        ex.submit("knn_k4_l2", _queries(rng, 1))
+        ex.submit("knn_k4_l2", _queries(rng, 1))
+        assert ex.queue.pending() == 2
+        with pytest.raises(limits.RejectedError):
+            r1.future.result(timeout=0.1)   # still the cancel, stable
+
     def test_over_budget_batch_splits_and_stays_bit_identical(self, data):
         """A coalesced batch whose footprint exceeds the serving budget
         splits into smaller warmed buckets; results unchanged."""
