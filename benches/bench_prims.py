@@ -1397,6 +1397,85 @@ def bench_serve_overload():
     return out
 
 
+# -- streaming lifecycle (ISSUE 17; no cpp/bench analogue — the rows
+#    witness online mutation + zero-pause compaction + crash recovery) -----
+
+@bench("neighbors/streaming_ingest")
+def bench_streaming_ingest():
+    """BENCH_ERA=17 streaming-lifecycle rows, measured through the
+    serving trio (serve/ingest.py) and the journaled index.
+
+    * ``neighbors/streaming_ingest_p99`` — query p99 while a sustained
+      insert+delete stream drives background compaction; the row
+      carries the lifecycle witnesses the smoke gate asserts on
+      (ingest rate, swaps crossed, per-query recall floor against an
+      exact reference over the snapshot window each query was served
+      from, zero failures).
+    * ``neighbors/streaming_recovery`` — wall-clock to recover a
+      journaled index (newest intact epoch + WAL replay) after a
+      mutation history, with the content-CRC bit-equality witness.
+
+    Rows stamp ``partial: true`` off-TPU: CPU wall-clock smoke of the
+    full code path, not an accelerator claim."""
+    import tempfile
+    import time
+
+    from benches.harness import BenchResult
+    from raft_tpu import serve
+    from raft_tpu.neighbors.streaming import StreamingIndex, stream_build
+
+    full = jax.default_backend() == "tpu"
+    partial = {} if full else {"partial": True}
+    rng = np.random.default_rng(17)
+    db = rng.standard_normal((2048, 16)).astype(np.float32)
+    out = []
+
+    # -- sustained-ingest row (queries racing compaction swaps) --------
+    idx = stream_build(None, db, 16, seed=0, max_iter=8,
+                       repack_slack=96)
+    idx.compact(reason="provision")
+    svc = serve.StreamingKnnService(idx, k=10, nprobe=12)
+    ctl = serve.IngestController(
+        idx, [svc],
+        policy=serve.BatchPolicy(max_batch=16, max_wait_ms=2.0),
+        compact_interval=0.05, refit=False, warm_buckets=[8, 16])
+    with ctl:
+        rep = serve.streaming_loop(
+            ctl, svc.name, clients=4, rows=8, duration_s=2.5,
+            ingest_rows=64, ingest_interval_s=0.02, delete_frac=0.3,
+            seed=17)
+    out.append(BenchResult(
+        name="neighbors/streaming_ingest_p99", repeats=1,
+        median_ms=rep.p99_ms, best_ms=rep.p50_ms,
+        params=dict(partial, qps=round(rep.qps, 2),
+                    ingest_rate=round(rep.ingest_rate, 1),
+                    ingest_rows=rep.ingest_rows,
+                    deleted_rows=rep.deleted_rows,
+                    swaps=rep.swaps, compactions=rep.compactions,
+                    min_recall=round(rep.min_recall, 4),
+                    mean_recall=round(rep.mean_recall, 4),
+                    failed=rep.failed)))
+
+    # -- recovery row (epoch load + WAL replay after a "crash") --------
+    with tempfile.TemporaryDirectory() as d:
+        jidx = stream_build(None, db, 16, seed=0, max_iter=8,
+                            directory=d, repack_slack=128)
+        jidx.insert(rng.standard_normal((256, 16)).astype(np.float32))
+        jidx.delete(np.arange(0, 512, 3))          # WAL: delete record
+        for s in range(3):                         # WAL: fitting inserts
+            jidx.insert(rng.standard_normal((64, 16)).astype(np.float32))
+        crc = jidx.content_crc()
+        t0 = time.perf_counter()
+        rec = StreamingIndex.recover(None, d)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        out.append(BenchResult(
+            name="neighbors/streaming_recovery", repeats=1,
+            median_ms=wall_ms, best_ms=wall_ms,
+            params=dict(partial, n_live=rec.n_live, epoch=rec.epoch,
+                        crc_match=rec.content_crc() == crc)))
+    return out
+
+
 # -- stats (ref: bench/prims/stats/*.cu — the domain had no bench family
 #    until round 3; the round-2 verdict flagged zero on-TPU stats numbers) --
 

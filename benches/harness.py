@@ -52,7 +52,14 @@ import jax
 # so they are not comparable to any earlier serve row's p99 column;
 # the rows also carry the resilience witnesses (brownout_max_level,
 # hedge_rate) the CI gates assert on.
-BENCH_ERA = 16
+# Era 17: the streaming index lifecycle (neighbors/streaming.py +
+# serve/ingest.py) makes the IVF index a mutable, journaled object.
+# The neighbors/streaming_ingest family's rows measure query tail
+# latency WITH online mutation and background compaction running (a
+# live ingest stream and snapshot swaps in the loop), so they are not
+# comparable to any static ivf_search row; rows carry the lifecycle
+# witnesses (swaps, recall floor, crc_match) the CI gates assert on.
+BENCH_ERA = 17
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

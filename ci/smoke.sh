@@ -1230,7 +1230,7 @@ expected = {"epilogue/northstar_sharediota",
 missing = expected - set(rows)
 assert not missing, f"lever family dropped rows: {missing}"
 for name, row in rows.items():
-    assert row["era"] == BENCH_ERA == 16, (name, row.get("era"))
+    assert row["era"] == BENCH_ERA, (name, row.get("era"))
     assert row.get("partial") is True, \
         f"{name}: CPU proxy row must stamp partial"
 ns = rows["epilogue/northstar_sharediota"]
@@ -1498,10 +1498,10 @@ print(f"hedge gate: duty-cycled straggler held p99 {h:.1f} -> "
       f"0 retraces)")
 PYEOF
 
-# Overload bench sentry (ISSUE 16, BENCH_ERA=16): the serve/overload
-# family must run on the CPU tier with every row stamped era 16 +
-# partial and carrying its resilience witnesses, and the fresh rows
-# must clear the sentry against the shipped era-16 baseline
+# Overload bench sentry (ISSUE 16): the serve/overload family must run
+# on the CPU tier with every row stamped the current era + partial and
+# carrying its resilience witnesses, and the fresh rows must clear the
+# sentry against the shipped era-16 baseline
 # (per-family tolerance 3.0: chaos-phase p99 rows drift between
 # container sessions).
 OVERLOAD_ROWS=$(mktemp /tmp/overload_rows.XXXXXX.jsonl)
@@ -1527,7 +1527,7 @@ expected = {"serve/overload_step_p99", "serve/overload_slowreplica_p99"}
 missing = expected - set(rows)
 assert not missing, f"overload family dropped rows: {missing}"
 for name, row in rows.items():
-    assert row["era"] == BENCH_ERA == 16, (name, row.get("era"))
+    assert row["era"] == BENCH_ERA, (name, row.get("era"))
     assert row.get("partial") is True, \
         f"{name}: CPU proxy row must stamp partial"
 step = rows["serve/overload_step_p99"]
@@ -1544,6 +1544,125 @@ JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$OVERLOAD_ROWS" \
     --family-tol serve/overload_step_p99=3.0 \
     --family-tol serve/overload_slowreplica_p99=3.0 >/dev/null
 rm -f "$OVERLOAD_ROWS"
-echo "overload sentry: fresh era-16 rows clear the shipped baseline"
+echo "overload sentry: fresh current-era rows clear the shipped baseline"
+
+# Streaming lifecycle gate (ISSUE 17): sustained ingest + deletes
+# racing concurrent queries through at least one shape-changing
+# snapshot swap, recall scored per query against an exact reference
+# over the snapshot window it was served from. Floors: no failed
+# queries, >= 1 swap crossed, min recall 0.5, mean recall 0.85.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+
+from raft_tpu import serve
+from raft_tpu.neighbors.streaming import stream_build
+
+rng = np.random.default_rng(3)
+db = rng.normal(size=(256, 8)).astype(np.float32)
+idx = stream_build(None, db, 8, seed=0, max_iter=4, repack_slack=48)
+idx.compact(reason="provision")
+svc = serve.StreamingKnnService(idx, k=5, nprobe=7)
+ctl = serve.IngestController(
+    idx, [svc],
+    policy=serve.BatchPolicy(max_batch=8, max_wait_ms=2.0),
+    compact_interval=0.05, refit=False, warm_buckets=[8])
+with ctl:
+    rep = serve.streaming_loop(
+        ctl, svc.name, clients=3, rows=4, duration_s=2.5,
+        ingest_rows=48, ingest_interval_s=0.02, delete_frac=0.3,
+        seed=1)
+assert rep.failed == 0, rep.as_dict()
+assert rep.queries > 0 and rep.ingest_batches >= 2, rep.as_dict()
+assert rep.swaps >= 1, "the run must cross a shape-changing swap"
+assert rep.min_recall >= 0.5, rep.as_dict()
+assert rep.mean_recall >= 0.85, rep.as_dict()
+assert rep.n_live_final == idx.n_live, rep.as_dict()
+print(f"streaming gate: {rep.queries} queries over "
+      f"{rep.ingest_batches} ingest batches, {rep.swaps} swaps, "
+      f"recall min {rep.min_recall:.3f} / mean {rep.mean_recall:.3f}, "
+      f"0 failed")
+PYEOF
+
+# Streaming crash-consistency smoke (ISSUE 17): SIGKILL the mutation
+# worker mid-epoch-write and require recovery to land bit-equal on the
+# last journaled state — never a torn index. The reference CRCs and
+# the recovery CRCs are printed by subprocesses from the same
+# environment so jax config can never skew reference vs witness.
+CHAOS_DIR=$(mktemp -d /tmp/stream_chaos.XXXXXX)
+CLEAN_DIR=$(mktemp -d /tmp/stream_clean.XXXXXX)
+CLEAN_CRCS=$(JAX_PLATFORMS=cpu python tests/_streaming_chaos_worker.py \
+    --dir "$CLEAN_DIR")
+read -r CRC_DEL CRC_INS2 CRC_FINAL <<<"$CLEAN_CRCS"
+rc=0
+JAX_PLATFORMS=cpu python tests/_streaming_chaos_worker.py \
+    --dir "$CHAOS_DIR" --crash compact.mid_write --mode kill || rc=$?
+if [ "$rc" -ne 137 ]; then
+    echo "chaos worker expected SIGKILL (rc 137), got rc=$rc" >&2
+    exit 1
+fi
+REC_CRCS=$(JAX_PLATFORMS=cpu python tests/_streaming_chaos_worker.py \
+    --dir "$CHAOS_DIR" --recover)
+read -r REC_FIRST REC_SECOND <<<"$REC_CRCS"
+if [ "$REC_FIRST" != "$REC_SECOND" ]; then
+    echo "recovery is not deterministic: $REC_FIRST vs $REC_SECOND" >&2
+    exit 1
+fi
+if [ "$REC_FIRST" != "$CRC_INS2" ]; then
+    echo "torn recovery: got $REC_FIRST, want $CRC_INS2" \
+         "(pre-crash journaled state)" >&2
+    exit 1
+fi
+rm -rf "$CHAOS_DIR" "$CLEAN_DIR"
+echo "streaming chaos: SIGKILL at compact.mid_write recovered" \
+     "bit-equal to the journaled epoch (crc $REC_FIRST, deterministic)"
+
+# Streaming bench sentry (ISSUE 17): the neighbors/streaming_ingest
+# family must run on the CPU tier with every row stamped the current
+# era + partial and carrying its lifecycle witnesses (swaps crossed,
+# recall floor held, recovery CRC bit-equal), and the fresh rows must
+# clear the sentry against the shipped baseline (per-family tolerance
+# 3.0: live-loop tail rows drift between container sessions).
+STREAM_ROWS=$(mktemp /tmp/stream_rows.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python benches/run_benches.py \
+    --family neighbors/streaming_ingest > "$STREAM_ROWS"
+python - "$STREAM_ROWS" <<'PYEOF'
+import json
+import sys
+
+from benches.harness import BENCH_ERA
+
+rows = {}
+with open(sys.argv[1]) as fh:
+    for line in fh:
+        line = line.strip()
+        if not line:
+            continue
+        row = json.loads(line)
+        if "bench" in row and row.get("median_ms") is not None:
+            rows[row["bench"]] = row
+
+expected = {"neighbors/streaming_ingest_p99",
+            "neighbors/streaming_recovery"}
+missing = expected - set(rows)
+assert not missing, f"streaming family dropped rows: {missing}"
+for name, row in rows.items():
+    assert row["era"] == BENCH_ERA, (name, row.get("era"))
+    assert row.get("partial") is True, \
+        f"{name}: CPU proxy row must stamp partial"
+ing = rows["neighbors/streaming_ingest_p99"]
+assert ing["failed"] == 0, ing
+assert ing["swaps"] >= 1, ing
+assert ing["min_recall"] >= 0.5, ing
+rec = rows["neighbors/streaming_recovery"]
+assert rec["crc_match"] is True, rec
+print(f"streaming bench: 2 era-{BENCH_ERA} rows (ingest "
+      f"{ing['ingest_rate']:.0f} rows/s across {ing['swaps']} swaps, "
+      f"recall min {ing['min_recall']}, recovery crc bit-equal)")
+PYEOF
+JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$STREAM_ROWS" \
+    --family-tol neighbors/streaming_ingest_p99=3.0 \
+    --family-tol neighbors/streaming_recovery=3.0 >/dev/null
+rm -f "$STREAM_ROWS"
+echo "streaming sentry: fresh current-era rows clear the shipped baseline"
 
 echo "smoke: PASS"

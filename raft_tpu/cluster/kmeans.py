@@ -645,6 +645,112 @@ def kmeans_fit(res, params: KMeansParams, x,
 
 
 @with_matmul_precision
+@functools.partial(jax.jit, static_argnames=("n_clusters", "chunk_rows"),
+                   donate_argnums=(2,))
+def _minibatch_chunk(x, valid, carry, steps, *, n_clusters: int,
+                     chunk_rows: int):
+    """Up to ``steps`` Sculley mini-batch updates as one device program.
+
+    Each step consumes one ``chunk_rows`` slice of the padded batch:
+    nearest-centroid assignment (the same fused kernel the full fit
+    uses), then the count-weighted running-mean update
+    ``c += (sums - n_assigned·c) / counts_new`` — per-cluster learning
+    rate 1/lifetime-count, so a cluster first touched this batch lands
+    exactly on its batch mean and long-lived clusters move gently.
+    ``valid`` zero-weights the pad rows (the :func:`_weighted_sums`
+    contraction — scatter-free, R9's one-hot spelling)."""
+    from raft_tpu.runtime.compiled_driver import chunk_while
+
+    n_chunks = x.shape[0] // chunk_rows
+
+    def step(carry):
+        c, counts, j = carry
+        # index pair must share j's dtype: a literal 0 promotes to
+        # int64 under jax_enable_x64 and dynamic_slice rejects the mix
+        rows = lax.dynamic_slice(
+            x, (j * chunk_rows, jnp.zeros((), j.dtype)),
+            (chunk_rows, x.shape[1]))
+        vw = lax.dynamic_slice(valid, (j * chunk_rows,), (chunk_rows,))
+        dist, labels = _assign(rows, c)
+        sums, cnt, _ = _weighted_sums(rows, vw, labels, dist, n_clusters)
+        new_counts = counts + cnt
+        safe = jnp.where(new_counts > 0, new_counts, 1.0)
+        cf = c.astype(jnp.float32)
+        new_c = (cf + (sums - cnt[:, None] * cf)
+                 / safe[:, None]).astype(c.dtype)
+        return (new_c, new_counts, j + 1), (j + 1) >= n_chunks
+
+    return chunk_while(step, carry, steps)
+
+
+@with_matmul_precision
+def kmeans_partial_fit(res, centroids, batch, *, counts=None,
+                       chunk_rows: int = 256, sync_every=None
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One mini-batch k-means pass over ``batch`` (Sculley 2010): nudge
+    ``centroids`` toward the stream without a full refit. Returns
+    ``(new_centroids, new_counts)`` where ``counts`` is the float32
+    per-cluster lifetime mass — thread it through successive calls so
+    the per-cluster learning rate keeps decaying (``None`` starts cold:
+    the first batch lands each touched cluster on its batch mean).
+
+    The batch is consumed in ``chunk_rows`` slices through the
+    compiled-driver chunk runner, so the streaming refit inherits the
+    driver's checkpoint/deadline/trace boundary hooks for free — the
+    ISSUE-17 drift loop calls this under a serving deadline and a
+    mid-refit SIGKILL costs at most one chunk of progress."""
+    from raft_tpu.runtime import compiled_driver, limits
+
+    centroids = jnp.asarray(centroids)
+    batch = jnp.asarray(batch)
+    if centroids.ndim != 2:
+        raise ValueError(f"centroids must be [k, d], got "
+                         f"{centroids.shape}")
+    if batch.ndim != 2 or batch.shape[1] != centroids.shape[1]:
+        raise ValueError(f"batch must be [n, {centroids.shape[1]}], "
+                         f"got {batch.shape}")
+    if batch.shape[0] < 1:
+        raise ValueError("batch must have at least one row")
+    if chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+    n_clusters = int(centroids.shape[0])
+    if counts is None:
+        counts = jnp.zeros((n_clusters,), jnp.float32)
+    else:
+        counts = jnp.asarray(counts, jnp.float32)
+        if counts.shape != (n_clusters,):
+            raise ValueError(f"counts must be [{n_clusters}], got "
+                             f"{counts.shape}")
+    n = int(batch.shape[0])
+    chunk_rows = min(int(chunk_rows), n)
+    n_chunks = -(-n // chunk_rows)
+    pad = n_chunks * chunk_rows - n
+    valid = jnp.ones((n,), batch.dtype)
+    if pad:
+        batch = jnp.concatenate(
+            [batch, jnp.zeros((pad, batch.shape[1]), batch.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), batch.dtype)])
+    chunk_call = functools.partial(
+        _minibatch_chunk, batch, valid, n_clusters=n_clusters,
+        chunk_rows=chunk_rows)
+    dims = dict(m=chunk_rows, k=int(batch.shape[1]),
+                n_clusters=n_clusters, itemsize=batch.dtype.itemsize)
+    est = limits.estimate_seconds("cluster.lloyd_step", **dims)
+    sf, sb = limits.estimate_flops_bytes("cluster.lloyd_step", **dims)
+    sync = compiled_driver.resolve_sync_every(sync_every)
+    carry = (centroids, counts, jnp.asarray(0, jnp.int32))
+    carry, n_steps, _ = compiled_driver.run_chunked(
+        chunk_call, carry, max_steps=n_chunks, sync_every=sync,
+        op="cluster.kmeans_partial_fit", est_step_seconds=est,
+        step_flops=sf, step_bytes=sb)
+    trace.record_event("kmeans.partial_fit", rows=n,
+                       n_clusters=n_clusters, chunks=int(n_steps),
+                       chunk_rows=chunk_rows)
+    new_c, new_counts, _ = carry
+    return new_c, new_counts
+
+
+@with_matmul_precision
 def kmeans_predict(res, x, centroids):
     """Assignment only. Returns (labels, inertia)."""
     dist, labels = _assign(jnp.asarray(x), jnp.asarray(centroids))

@@ -44,7 +44,9 @@ chosen links; out-of-scope sends neither fault nor advance the RNG.
 from __future__ import annotations
 
 import collections
+import os
 import random
+import signal
 import threading
 from dataclasses import dataclass
 from typing import List, Optional, Set
@@ -54,6 +56,20 @@ import numpy as np
 from raft_tpu.core import trace
 
 KINDS = ("drop", "delay", "duplicate", "corrupt", "disconnect")
+
+#: crash_point modes — "raise" surfaces CrashPointError for in-process
+#: chaos tests; "kill" delivers an uncatchable SIGKILL to this process,
+#: the real torn-state model the crash-consistency witnesses need.
+CRASH_MODES = ("raise", "kill")
+
+
+class CrashPointError(RuntimeError):
+    """An armed :meth:`FaultInjector.crash_point` fired in raise mode
+    (the in-process stand-in for the SIGKILL the kill mode delivers)."""
+
+    def __init__(self, name: str):
+        super().__init__(f"armed crash point {name!r} fired")
+        self.name = name
 
 
 @dataclass
@@ -116,7 +132,56 @@ class FaultInjector:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._stall_s = 0.0
+        self._armed_crashes: dict = {}
+        self._seen_crash_points: list = []
         self.counts: collections.Counter = collections.Counter()
+
+    # -- deterministic crash points (ISSUE 17) ------------------------
+
+    def arm_crash(self, name: str, *, mode: str = "raise") -> None:
+        """Arm the named :meth:`crash_point`: the next time execution
+        reaches it, the process dies there — ``mode="kill"`` delivers
+        a real SIGKILL (the torn-state model: no atexit, no finally),
+        ``mode="raise"`` raises :class:`CrashPointError` for in-process
+        tests. Arming consumes no RNG rolls, so a probabilistic fault
+        schedule replays identically with or without a crash armed —
+        the same determinism discipline as :meth:`stall`."""
+        if mode not in CRASH_MODES:
+            raise ValueError(f"crash mode must be one of {CRASH_MODES}, "
+                             f"got {mode!r}")
+        with self._lock:
+            self._armed_crashes[str(name)] = mode
+
+    def disarm_crash(self, name: str) -> None:
+        with self._lock:
+            self._armed_crashes.pop(str(name), None)
+
+    def seen_crash_points(self) -> List[str]:
+        """Every named crash point execution has reached, in first-seen
+        order (armed or not) — the enumeration the every-named-point
+        crash-consistency witness sweeps over."""
+        with self._lock:
+            return list(self._seen_crash_points)
+
+    def crash_point(self, name: str) -> None:
+        """A named, deterministic kill site. Instrumented code calls
+        this at protocol boundaries (``compact.pre_commit``,
+        ``ingest.post_journal``, ...); unarmed it only records the name
+        and returns — chaos tests then kill at exact protocol states
+        instead of racing a timer against the worker thread."""
+        name = str(name)
+        with self._lock:
+            if name not in self._seen_crash_points:
+                self._seen_crash_points.append(name)
+            mode = self._armed_crashes.get(name)
+            if mode is not None:
+                self.counts[f"crash:{name}"] += 1
+        if mode is None:
+            return
+        trace.record_event("faults.crash_point", point=name, mode=mode)
+        if mode == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise CrashPointError(name)
 
     def stall(self, seconds: float) -> None:
         """Arm the latency-spike mode: every subsequent in-scope send

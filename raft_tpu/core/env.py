@@ -93,6 +93,27 @@ def _parse_float(raw: str) -> float:
         raise ValueError(f"{raw!r} is not a number") from None
 
 
+def _parse_pos_float(raw: str) -> float:
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(f"{raw!r} is not a number") from None
+    if not val > 0.0:
+        raise ValueError(f"{raw!r} must be > 0")
+    return val
+
+
+def _parse_ratio_ge1(raw: str) -> float:
+    """A trigger ratio: a float >= 1.0 (1.0 = trigger immediately)."""
+    try:
+        val = float(raw)
+    except ValueError:
+        raise ValueError(f"{raw!r} is not a number") from None
+    if not val >= 1.0:
+        raise ValueError(f"ratio must be >= 1.0, got {raw!r}")
+    return val
+
+
 def _parse_peaks(raw: str) -> Dict[str, float]:
     """``flops=<num>,bytes=<num>`` device-peak override terms (either
     term may be omitted; at least one must be present, both positive)."""
@@ -295,6 +316,23 @@ register("RAFT_TPU_SPLIT_PACKED", _parse_flag, False, on_malformed="warn",
          help="packed-operand spelling for the bf16x3 cross terms")
 register("RAFT_TPU_SPARSE_PAD", _parse_flag, True, on_malformed="warn",
          help="pad sparse buffers to lane-friendly capacities")
+
+# Streaming-index lifecycle knobs (ISSUE 17): fail-loud — a typo'd
+# compaction threshold must never silently become "never compact" (the
+# index would grow tombstones unbounded) or "always compact" (the
+# background repack would thrash), so malformed values raise at the
+# read site per the R7 registry discipline.
+register("RAFT_TPU_COMPACT_TOMBSTONE_FRAC", _parse_rate, 0.25,
+         help="tombstone fraction (dead/live rows, in [0, 1]) at which "
+              "the background compactor repacks the streaming index")
+register("RAFT_TPU_COMPACT_INTERVAL", _parse_pos_float, 0.25,
+         help="background compactor poll interval in seconds (> 0); "
+              "each tick re-evaluates the tombstone/tail-overflow "
+              "thresholds")
+register("RAFT_TPU_DRIFT_THRESHOLD", _parse_ratio_ge1, 2.0,
+         help="drift trigger: refit the coarse quantizer when the "
+              "EMA of ingested rows' nearest-centroid distance exceeds "
+              "this multiple of the build-time baseline (>= 1.0)")
 
 # Overload-resilience toggles (ISSUE 16): degrade to the conservative
 # setting (on) with a warning — resilience must not vanish on a typo.

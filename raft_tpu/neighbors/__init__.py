@@ -12,12 +12,22 @@ O(n) scan into probes over a few lists.
 
 from raft_tpu.neighbors import ivf_flat  # noqa: F401
 from raft_tpu.neighbors import ivf_mnmg  # noqa: F401
+from raft_tpu.neighbors import streaming  # noqa: F401
 from raft_tpu.neighbors.brute_force import knn, knn_mnmg  # noqa: F401
 from raft_tpu.neighbors.ivf_flat import IvfFlatIndex  # noqa: F401
 from raft_tpu.neighbors.ivf_mnmg import (IvfMnmgIndex,  # noqa: F401
-                                         build_mnmg, search_mnmg,
-                                         shrink_mnmg)
+                                         build_mnmg, rebalance_mnmg,
+                                         search_mnmg, shrink_mnmg)
+from raft_tpu.neighbors.streaming import (Compactor,  # noqa: F401
+                                          DriftGauge, MutationLog,
+                                          RecoveryError,
+                                          StreamingError,
+                                          StreamingIndex,
+                                          StreamingMnmg, stream_build)
 
 __all__ = ["knn", "knn_mnmg", "ivf_flat", "IvfFlatIndex",
            "ivf_mnmg", "IvfMnmgIndex", "build_mnmg", "search_mnmg",
-           "shrink_mnmg"]
+           "shrink_mnmg", "rebalance_mnmg",
+           "streaming", "StreamingIndex", "StreamingMnmg",
+           "stream_build", "Compactor", "DriftGauge", "MutationLog",
+           "StreamingError", "RecoveryError"]

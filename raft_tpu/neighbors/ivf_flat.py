@@ -131,13 +131,18 @@ def _coarse_labels(db, centroids):
 
 
 def _pack(db_np: np.ndarray, ids_np: np.ndarray, labels: np.ndarray,
-          n_lists: int):
+          n_lists: int, slack_slots: int = 0):
     """Stable-pack rows into padded spans: within a list, ascending
     original id (stable sort key). Returns the packed arrays + host
-    span table."""
+    span table. ``slack_slots`` reserves at least that many free tail
+    slots per list beyond alignment padding — the streaming repack
+    passes it so compaction leaves growth headroom (a repack that
+    re-fills every tail would re-trigger the tail-full compaction
+    criterion forever)."""
     counts = np.bincount(labels, minlength=n_lists).astype(np.int64)
-    caps = np.asarray([round_up_to_multiple(int(c), SLOT_ALIGN)
-                       for c in counts], np.int64)
+    caps = np.asarray(
+        [round_up_to_multiple(int(c) + int(slack_slots), SLOT_ALIGN)
+         for c in counts], np.int64)
     starts = np.zeros(n_lists, np.int64)
     np.cumsum(caps[:-1], out=starts[1:])
     order = np.argsort(labels, kind="stable")       # (label, id) order
@@ -248,7 +253,7 @@ def extend(res, index: IvfFlatIndex, new_rows) -> IvfFlatIndex:
 
 def _probe_topk(queries, centroids, packed_db, packed_ids, starts,
                 sizes, *, k: int, nprobe: int, cap_max: int,
-                metric: str, use_radix: bool):
+                metric: str, use_radix: bool, tomb_words=None):
     """The probe scan up to (but not including) the metric finalize:
     coarse pairwise -> top-nprobe lists -> one padded span gather ->
     masked fine distances -> radix / top_k epilogue. Returns RAW
@@ -256,7 +261,15 @@ def _probe_topk(queries, centroids, packed_db, packed_ids, starts,
     unreachable) plus ids — the mergeable form: the MNMG shard body
     (:mod:`raft_tpu.neighbors.ivf_mnmg`) pools these keys across ranks
     and finalizes once after the global merge, so per-rank and
-    single-rank candidates carry identical per-element values."""
+    single-rank candidates carry identical per-element values.
+
+    ``tomb_words`` (streaming-index deletes, ISSUE 17) is an optional
+    packed uint32 tombstone bitset over ORIGINAL row ids
+    (:class:`raft_tpu.core.bitset.Bitset` words): set bits AND into the
+    gather's validity mask exactly like pad slots, so a deleted row is
+    never selected and every untouched id scores bit-identically (an
+    all-zero bitset is a value-level no-op: ``valid & ~0 == valid``).
+    ``None`` keeps the pre-streaming traced graph byte-identical."""
     kernel = _METRICS[metric]
     with precision.scope():
         q = queries.astype(jnp.float32)
@@ -280,6 +293,15 @@ def _probe_topk(queries, centroids, packed_db, packed_ids, starts,
         cand = blocks.astype(jnp.float32).reshape(q.shape[0], L, -1)
         ids = ids.reshape(q.shape[0], L)
         valid = valid.reshape(q.shape[0], L)
+        if tomb_words is not None:
+            from raft_tpu.core.bitset import Bitset
+
+            # pad slots carry id -1: clamp for the word gather — their
+            # bit is irrelevant because valid is already False there
+            tombs = Bitset(int(tomb_words.shape[0]) * 32,
+                           words=tomb_words)
+            dead = tombs.test(jnp.maximum(ids, 0))
+            valid = jnp.logical_and(valid, jnp.logical_not(dead))
         ipf = jnp.einsum("qd,qld->ql", q, cand)
         if kernel == "l2":
             dist = (jnp.sum(cand * cand, axis=-1) - 2.0 * ipf
@@ -296,8 +318,8 @@ def _probe_topk(queries, centroids, packed_db, packed_ids, starts,
 
 
 def _search_body(queries, centroids, packed_db, packed_ids, starts,
-                 sizes, *, k: int, nprobe: int, cap_max: int,
-                 metric: str, use_radix: bool):
+                 sizes, tomb_words=None, *, k: int, nprobe: int,
+                 cap_max: int, metric: str, use_radix: bool):
     """The traced probe scan (:func:`_probe_topk` + metric finalize).
     Row-independent per query (the serving invariant: a batched launch
     is bit-identical to per-request launches)."""
@@ -306,7 +328,7 @@ def _search_body(queries, centroids, packed_db, packed_ids, starts,
     vals, out_ids = _probe_topk(
         queries, centroids, packed_db, packed_ids, starts, sizes, k=k,
         nprobe=nprobe, cap_max=cap_max, metric=metric,
-        use_radix=use_radix)
+        use_radix=use_radix, tomb_words=tomb_words)
     return _finalize(vals, metric), out_ids
 
 

@@ -262,6 +262,20 @@ def shrink_mnmg(index: IvfMnmgIndex, survivors: Sequence[int], *,
     return _from_flat(index.flat, n_ranks, mesh=mesh, axis=index.axis)
 
 
+def rebalance_mnmg(index: IvfMnmgIndex, *,
+                   flat: Optional[IvfFlatIndex] = None,
+                   mesh: Optional[Mesh] = None) -> IvfMnmgIndex:
+    """Repack the current (or a freshly mutated) flat mirror across the
+    SAME rank count — the heal-path repack doubling as the rebalance
+    after skewed streaming ingest (ISSUE 17). :func:`partition_lists`
+    re-runs LPT on the post-ingest caps, so lists that grew under
+    routed inserts redistribute exactly as a fresh build would place
+    them; passing ``flat`` adopts a compacted epoch's arrays."""
+    if flat is None:
+        flat = index.flat
+    return _from_flat(flat, index.n_ranks, mesh=mesh, axis=index.axis)
+
+
 # ---------------------------------------------------------------------------
 # search: one shard_map program
 # ---------------------------------------------------------------------------
@@ -288,27 +302,38 @@ def _merge_body(pool_v, pool_i, *, k: int, metric: str,
 @functools.lru_cache(maxsize=None)
 def _mnmg_searcher(mesh: Mesh, axis: str, n_ranks: int, k: int,
                    nprobe: int, cap_max: int, metric: str,
-                   use_radix: bool, use_radix_merge: bool):
+                   use_radix: bool, use_radix_merge: bool,
+                   masked: bool = False):
     """Compiled sharded search program for one (mesh, config): per-rank
     probe scan inside ``shard_map``, in-graph all-gather of the k
     candidates per rank (XLA inserts the collective for the replicated
     merge — same idiom as ``knn_mnmg``), one global select, one
     finalize. The query buffer is donated: searches stream through the
-    serving loop and the previous launch's queries are dead weight."""
+    serving loop and the previous launch's queries are dead weight.
 
-    def shard_fn(db_s, ids_s, st_s, sz_s, q, c):
+    ``masked=True`` is the streaming-delete variant (ISSUE 17): the
+    body takes one extra replicated operand — the packed tombstone
+    bitset over global ids — which every rank ANDs into its gather
+    validity mask (:func:`ivf_flat._probe_topk`'s ``tomb_words``).
+    The unmasked program is byte-identical to the pre-streaming one."""
+
+    def shard_fn(db_s, ids_s, st_s, sz_s, q, c, *tw):
         vals, ids = _probe_topk(
             q, c, db_s[0], ids_s[0], st_s[0], sz_s[0], k=k,
             nprobe=nprobe, cap_max=cap_max, metric=metric,
-            use_radix=use_radix)
+            use_radix=use_radix, tomb_words=tw[0] if tw else None)
         return vals[None], ids[None]              # [1, q, k] per rank
 
-    def body(queries, centroids, db_sh, ids_sh, starts_sh, sizes_sh):
+    def body(queries, centroids, db_sh, ids_sh, starts_sh, sizes_sh,
+             *tomb):
+        specs = (P(axis), P(axis), P(axis), P(axis), P(), P())
+        if masked:
+            specs = specs + (P(),)
         av, ai = jax.shard_map(
-            shard_fn, mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P()),
+            shard_fn, mesh=mesh, in_specs=specs,
             out_specs=(P(axis), P(axis)))(
-                db_sh, ids_sh, starts_sh, sizes_sh, queries, centroids)
+                db_sh, ids_sh, starts_sh, sizes_sh, queries, centroids,
+                *tomb)
         pool_v = jnp.moveaxis(av, 0, 1).reshape(
             queries.shape[0], n_ranks * k)
         pool_i = jnp.moveaxis(ai, 0, 1).reshape(
@@ -335,7 +360,8 @@ def _radix_flags(index: IvfMnmgIndex, k: int, nprobe: int, *arrays):
 
 
 @with_matmul_precision
-def search_mnmg(res, index: IvfMnmgIndex, queries, k: int, nprobe: int
+def search_mnmg(res, index: IvfMnmgIndex, queries, k: int, nprobe: int,
+                *, tomb_words=None
                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """k nearest database rows per query over the sharded index:
     replicated (distances [q, k], indices [q, k]) in GLOBAL database
@@ -366,6 +392,11 @@ def search_mnmg(res, index: IvfMnmgIndex, queries, k: int, nprobe: int
         raise ValueError(f"need nprobe > 0, got {nprobe}")
     metric = index.metric
     if nprobe >= index.n_lists:
+        if tomb_words is not None:
+            raise ValueError(
+                "tomb_words is only supported on the partial-probe "
+                "path; the streaming layer owns the exact path (it "
+                "brute-forces the live-row reconstruction instead)")
         from raft_tpu.neighbors.brute_force import knn
 
         trace.record_event("ivf_mnmg.search", nprobe=index.n_lists,
@@ -388,9 +419,9 @@ def search_mnmg(res, index: IvfMnmgIndex, queries, k: int, nprobe: int
         index, k, nprobe, index.packed_db_sh, queries)
     run = _mnmg_searcher(index.mesh, index.axis, index.n_ranks, k,
                          nprobe, index.cap_max, metric, use_radix,
-                         use_radix_merge)
-    fixed = (index.flat.centroids, index.packed_db_sh,
-             index.packed_ids_sh, index.starts_sh, index.sizes_sh)
+                         use_radix_merge, tomb_words is not None)
+    tomb = () if tomb_words is None else (jax.device_put(
+        jnp.asarray(tomb_words), NamedSharding(index.mesh, P())),)
 
     def launch(qrows):
         # a fresh replicated buffer per launch: the donated carry must
@@ -398,7 +429,9 @@ def search_mnmg(res, index: IvfMnmgIndex, queries, k: int, nprobe: int
         qbuf = jax.device_put(
             jnp.array(qrows),
             NamedSharding(index.mesh, P()))
-        return run(qbuf, *fixed)
+        return run(qbuf, index.flat.centroids, index.packed_db_sh,
+                   index.packed_ids_sh, index.starts_sh,
+                   index.sizes_sh, *tomb)
 
     budget = limits.active_budget()
     if budget is not None:

@@ -1,0 +1,1154 @@
+"""Streaming index lifecycle: crash-safe mutation, zero-pause
+compaction, drift-aware refit (ISSUE 17 — ROADMAP item 3).
+
+The batch-offline IVF-Flat index (PR 9/11) becomes a mutable, servable
+object with a FreshDiskANN-shaped lifecycle — mutation log + tombstones
++ background consolidation + atomic swap, never a serving pause:
+
+- **insert** rides the padded-tail ``extend`` idiom: rows that fit the
+  aligned list tails append in place (packed shapes unchanged — the
+  serving executable never retraces), an overflowing tail triggers a
+  full repack under a new epoch.
+- **delete** sets a bit in a packed tombstone bitset over GLOBAL row
+  ids. The bitset words AND into the probe scan's validity mask
+  (:func:`raft_tpu.neighbors.ivf_flat._probe_topk` ``tomb_words``) —
+  same array shape every delete, so the compiled search is reused
+  unchanged and untouched ids score bit-identically.
+- **journal**: every mutation is journaled to an epoch-stamped
+  write-ahead log (``core/checkpoint.py`` containers — CRC-checked,
+  atomically renamed) BEFORE it is applied, so a SIGKILL'd process
+  replays to the exact pre-crash index.
+- **compaction** (:class:`Compactor`): when the tombstone or
+  tail-overflow fraction crosses its threshold, live rows repack into a
+  double-buffered packed matrix off the serve path; the commit writes
+  the new epoch file, prunes the superseded WAL, and atomically swaps
+  the serve snapshot. Dying at ANY :meth:`FaultInjector.crash_point`
+  leaves either the old or the new epoch fully intact — the recovery
+  walk (:meth:`StreamingIndex.recover`) loads the newest intact epoch
+  and replays only WAL records stamped with it.
+- **drift → refit** (:class:`DriftGauge`): an EMA of ingested rows'
+  nearest-centroid distance against the build-time baseline, exported
+  as the ``streaming_drift_ratio`` gauge; crossing
+  ``RAFT_TPU_DRIFT_THRESHOLD`` triggers mini-batch
+  :func:`raft_tpu.cluster.kmeans.kmeans_partial_fit` on the recent-row
+  reservoir and a repack under the refitted centroids.
+
+Identity contract: external row ids are assigned at insert in arrival
+order and NEVER renumbered — a repack packs live rows under their
+original ids (:func:`ivf_flat._pack` takes explicit ids), so tombstone
+bits and search results stay stable across compactions. The
+crash-consistency witness is :meth:`StreamingIndex.content_crc`: a CRC
+over the canonical live content (ids ‖ rows in id order ‖ centroids),
+invariant to packing layout — equal before and after a pure compaction,
+and equal between a recovered replica and a clean twin run.
+
+Concurrency: one mutation lock serializes insert/delete/compact-commit;
+searches NEVER take it — they read an immutable snapshot tuple swapped
+atomically at commit (the serve tier reads the same snapshot through
+``serve/ingest.StreamingKnnService.refresh``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import re
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core import env, trace
+from raft_tpu.core.bitset import WORD_BITS
+from raft_tpu.core.checkpoint import (CheckpointError, dump_checkpoint,
+                                      load_checkpoint, save_checkpoint)
+from raft_tpu.neighbors.ivf_flat import (SLOT_ALIGN, IvfFlatIndex,
+                                         _coarse_labels, _pack,
+                                         _resolve_metric, _search_jit,
+                                         _use_radix, build)
+
+__all__ = [
+    "StreamingError", "RecoveryError", "MutationLog", "DriftGauge",
+    "StreamingIndex", "Compactor", "StreamingMnmg", "stream_build",
+    "KIND_INSERT", "KIND_DELETE",
+]
+
+#: WAL record kinds (checkpoint entries carry scalars, not strings).
+KIND_INSERT = 0
+KIND_DELETE = 1
+
+_WAL_RE = re.compile(r"^wal-(\d{8})\.ckpt$")
+_EPOCH_RE = re.compile(r"^epoch-(\d{8})\.ckpt$")
+
+
+class StreamingError(RuntimeError):
+    """Typed base for streaming-lifecycle failures (R4 discipline)."""
+
+
+class RecoveryError(StreamingError):
+    """No intact epoch snapshot could be recovered from the directory."""
+
+
+def _coarse_assign(rows, centroids) -> Tuple[np.ndarray, np.ndarray]:
+    """(nearest-centroid distance, label) per row through the SAME fused
+    path :func:`ivf_flat._coarse_labels` uses — routing and the drift
+    gauge must agree with build/extend or extend==rebuild breaks."""
+    from raft_tpu.cluster.kmeans import _assign
+    from raft_tpu.util import precision
+
+    with precision.scope():
+        dist, labels = _assign(jnp.asarray(rows, jnp.float32),
+                               jnp.asarray(centroids, jnp.float32))
+    return np.asarray(dist), np.asarray(labels)
+
+
+# ---------------------------------------------------------------------------
+# mutation log: epoch snapshots + write-ahead records in one directory
+# ---------------------------------------------------------------------------
+
+
+class MutationLog:
+    """Epoch-stamped WAL + epoch snapshots in one directory.
+
+    WAL records are ``wal-<seq:08d>.ckpt``, epoch snapshots
+    ``epoch-<n:08d>.ckpt`` — both v1 checkpoint containers, both written
+    via atomic replace, so a reader never sees a torn file: a record is
+    either absent or intact (its per-entry CRCs still guard against
+    at-rest damage). Recovery loads the newest intact epoch and replays
+    the WAL records stamped with that epoch, in sequence order;
+    committing a new epoch prunes every record stamped with an older
+    one (they are folded into the snapshot).
+    """
+
+    def __init__(self, directory: str):
+        self.directory = os.fspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._lock = threading.Lock()
+        seqs = [int(m.group(1)) for f in os.listdir(self.directory)
+                if (m := _WAL_RE.match(f))]
+        self._next_seq = max(seqs, default=-1) + 1
+
+    # -- WAL ----------------------------------------------------------
+
+    def append(self, entries: Dict) -> int:
+        """Atomically write one WAL record; returns its sequence number.
+        ``entries`` must not contain ``seq`` (stamped here)."""
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+        rec = dict(entries)
+        rec["seq"] = seq
+        save_checkpoint(
+            os.path.join(self.directory, f"wal-{seq:08d}.ckpt"), rec)
+        return seq
+
+    def wal_records(self) -> List[Dict]:
+        """Every WAL record on disk, ascending sequence order."""
+        names = sorted(f for f in os.listdir(self.directory)
+                       if _WAL_RE.match(f))
+        out = []
+        for name in names:
+            with open(os.path.join(self.directory, name), "rb") as f:
+                out.append(load_checkpoint(f))
+        return out
+
+    def prune_wal(self, *, before_epoch: int) -> int:
+        """Delete records stamped with an epoch older than
+        ``before_epoch`` (they are folded into that epoch's snapshot);
+        returns how many were removed."""
+        removed = 0
+        for name in sorted(f for f in os.listdir(self.directory)
+                           if _WAL_RE.match(f)):
+            path = os.path.join(self.directory, name)
+            with open(path, "rb") as f:
+                rec = load_checkpoint(f)
+            if int(rec["epoch"]) < before_epoch:
+                os.remove(path)
+                removed += 1
+        return removed
+
+    # -- epoch snapshots ----------------------------------------------
+
+    def epoch_path(self, epoch: int) -> str:
+        return os.path.join(self.directory, f"epoch-{epoch:08d}.ckpt")
+
+    def write_epoch(self, epoch: int, entries: Dict, *,
+                    faults=None) -> None:
+        """Two-step atomic epoch write with the ``compact.mid_write``
+        crash point BETWEEN the fsynced temp file and the rename — the
+        torn-state window the protocol must survive: a kill there
+        leaves only ``.tmp`` debris, which recovery never reads."""
+        path = self.epoch_path(epoch)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            dump_checkpoint(entries, f)
+            f.flush()
+            os.fsync(f.fileno())
+        if faults is not None:
+            faults.crash_point("compact.mid_write")
+        os.replace(tmp, path)
+
+    def load_latest_epoch(self) -> Tuple[int, Dict]:
+        """The newest INTACT epoch snapshot (number, entries). Walks
+        newest-first; an at-rest-damaged file is skipped with a trace
+        event and the previous epoch is used. Raises
+        :class:`RecoveryError` when none survives."""
+        nums = sorted((int(m.group(1))
+                       for f in os.listdir(self.directory)
+                       if (m := _EPOCH_RE.match(f))), reverse=True)
+        for n in nums:
+            try:
+                with open(self.epoch_path(n), "rb") as f:
+                    return n, load_checkpoint(f)
+            except CheckpointError as exc:
+                trace.record_event("streaming.epoch_skip", epoch=n,
+                                   error=str(exc))
+        raise RecoveryError(
+            f"no intact epoch snapshot in {self.directory!r} "
+            f"(tried {len(nums)} files)")
+
+    def prune_epochs(self, keep: int = 2) -> None:
+        nums = sorted(int(m.group(1))
+                      for f in os.listdir(self.directory)
+                      if (m := _EPOCH_RE.match(f)))
+        for n in nums[:-keep] if keep else nums:
+            os.remove(self.epoch_path(n))
+
+
+# ---------------------------------------------------------------------------
+# drift gauge
+# ---------------------------------------------------------------------------
+
+
+class DriftGauge:
+    """EMA of ingested rows' mean nearest-centroid distance, as a ratio
+    against the baseline captured at build/refit time. Ratio 1.0 means
+    the stream looks like the training distribution; crossing the
+    threshold (``RAFT_TPU_DRIFT_THRESHOLD``) means the coarse quantizer
+    no longer routes the stream well and a refit is due. Exported as
+    the ``streaming_drift_ratio`` gauge when obs is on."""
+
+    def __init__(self, threshold: Optional[float] = None,
+                 alpha: float = 0.25):
+        self.threshold = float(env.read("RAFT_TPU_DRIFT_THRESHOLD")
+                               if threshold is None else threshold)
+        self.alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._baseline: Optional[float] = None
+        self._ema: Optional[float] = None
+
+    def set_baseline(self, mean_dist: float) -> None:
+        with self._lock:
+            self._baseline = max(float(mean_dist), 1e-30)
+            self._ema = None
+
+    def observe_batch(self, mean_dist: float) -> float:
+        """Fold one ingest batch's mean coarse distance into the EMA;
+        returns the current ratio (1.0 until a baseline exists)."""
+        with self._lock:
+            if self._ema is None:
+                self._ema = float(mean_dist)
+            else:
+                self._ema += self.alpha * (float(mean_dist) - self._ema)
+            ratio = self._ratio_locked()
+        if obs.enabled():
+            obs.set_gauge("streaming_drift_ratio", ratio)
+        return ratio
+
+    def _ratio_locked(self) -> float:
+        if self._baseline is None or self._ema is None:
+            return 1.0
+        return self._ema / self._baseline
+
+    @property
+    def ratio(self) -> float:
+        with self._lock:
+            return self._ratio_locked()
+
+    @property
+    def triggered(self) -> bool:
+        return self.ratio > self.threshold
+
+
+# ---------------------------------------------------------------------------
+# the streaming index
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Snapshot:
+    """Immutable serve-side view, swapped atomically at every commit —
+    the search path reads ONE attribute and never takes the mutation
+    lock (the zero-pause property)."""
+
+    flat: IvfFlatIndex
+    tomb_words: jnp.ndarray       # [n_words] uint32, global-id indexed
+    n_live: int
+    epoch: int
+    version: int
+
+
+class StreamingIndex:
+    """A mutable, crash-safe IVF-Flat index (see module docstring).
+
+    Build with :func:`stream_build` (fresh) or
+    :meth:`StreamingIndex.recover` (from a journal directory after a
+    crash). ``directory=None`` runs in-memory without durability — the
+    mutation/compaction/drift machinery is identical, only the journal
+    writes are skipped.
+    """
+
+    def __init__(self, flat: IvfFlatIndex, *,
+                 log: Optional[MutationLog] = None,
+                 faults=None, res=None,
+                 drift: Optional[DriftGauge] = None,
+                 epoch: int = 0, next_id: Optional[int] = None,
+                 tomb_host: Optional[np.ndarray] = None,
+                 n_live: Optional[int] = None,
+                 reservoir_cap: int = 4096,
+                 repack_slack: int = SLOT_ALIGN):
+        self._lock = threading.RLock()
+        self.log = log
+        self.faults = faults
+        self.res = res
+        self.drift = drift if drift is not None else DriftGauge()
+        self._flat = flat
+        self._epoch = int(epoch)
+        self._version = 0
+        self._next_id = int(flat.n_db if next_id is None else next_id)
+        self._n_live = int(flat.n_db if n_live is None else n_live)
+        if tomb_host is None:
+            tomb_host = np.zeros(self._tomb_n_words(flat, self._next_id),
+                                 np.uint32)
+        self._tomb_host = np.asarray(tomb_host, np.uint32).copy()
+        self._reservoir: List[np.ndarray] = []
+        self._reservoir_rows = 0
+        self._reservoir_cap = int(reservoir_cap)
+        # free tail slots per list every repack provisions — size it
+        # to the expected insert batch so sustained ingest rides the
+        # in-place tail-append path instead of repacking per batch
+        self.repack_slack = max(int(repack_slack), SLOT_ALIGN)
+        self._pf_counts: Optional[np.ndarray] = None
+        self._snapshot = _Snapshot(
+            flat=flat, tomb_words=jnp.asarray(self._tomb_host),
+            n_live=self._n_live, epoch=self._epoch, version=0)
+        self._history: collections.deque = collections.deque(maxlen=8)
+        self._history.append(self._snapshot)
+
+    # -- construction helpers -----------------------------------------
+
+    @staticmethod
+    def _tomb_n_words(flat: IvfFlatIndex, next_id: int) -> int:
+        """Word count covering every id this epoch's arrays can ever
+        hold: ids already assigned plus one per free padded slot (a
+        fitting insert consumes a slot; an overflowing one repacks into
+        a NEW epoch with a new bitset). Fixed per epoch — a delete only
+        swaps same-shape words, so the compiled search never retraces."""
+        free = int(flat.packed_db.shape[0]) - int(flat.n_db)
+        n_bits = max(int(next_id) + max(free, 0), 1)
+        return (n_bits + WORD_BITS - 1) // WORD_BITS
+
+    @classmethod
+    def recover(cls, res, directory: str, *, faults=None,
+                drift: Optional[DriftGauge] = None) -> "StreamingIndex":
+        """Rebuild the exact pre-crash index from the journal: load the
+        newest intact epoch snapshot, then replay WAL records stamped
+        with that epoch in sequence order (records stamped older are
+        already folded in; the atomic-replace write protocol guarantees
+        every file present is whole). The replayed mutations re-journal
+        nothing — the records are already durable."""
+        log = MutationLog(directory)
+        epoch, ent = log.load_latest_epoch()
+        metric = bytes(np.asarray(ent["metric"], np.uint8)).decode()
+        _resolve_metric(metric)
+        caps = np.asarray(ent["caps"], np.int64)
+        flat = IvfFlatIndex(
+            centroids=jnp.asarray(np.asarray(ent["centroids"],
+                                             np.float32)),
+            packed_db=jnp.asarray(np.asarray(ent["packed_db"])),
+            packed_ids=jnp.asarray(np.asarray(ent["packed_ids"],
+                                              np.int32)),
+            starts=jnp.asarray(np.asarray(ent["starts"], np.int32)),
+            sizes=jnp.asarray(np.asarray(ent["sizes"], np.int32)),
+            caps=caps, cap_max=int(caps.max(initial=0)),
+            n_db=int(ent["n_db"]), metric=metric)
+        idx = cls(flat, log=log, faults=faults, res=res, drift=drift,
+                  epoch=epoch, next_id=int(ent["next_id"]),
+                  tomb_host=np.asarray(ent["tomb_words"], np.uint32),
+                  n_live=int(ent["n_live"]))
+        replayed = 0
+        for rec in log.wal_records():
+            if int(rec["epoch"]) != epoch:
+                continue
+            kind = int(rec["kind"])
+            if kind == KIND_INSERT:
+                idx._apply_insert(np.asarray(rec["data"]),
+                                  np.asarray(rec["labels"], np.int64),
+                                  journal=False)
+            elif kind == KIND_DELETE:
+                idx._apply_delete(np.asarray(rec["data"], np.int64),
+                                  journal=False)
+            else:
+                raise RecoveryError(f"unknown WAL record kind {kind}")
+            replayed += 1
+        if obs.enabled():
+            obs.inc("streaming_replay_records_total", replayed)
+        trace.record_event("streaming.recover", epoch=epoch,
+                           replayed=replayed, n_live=idx.n_live)
+        return idx
+
+    # -- read-side properties (snapshot-backed, lock-free) ------------
+
+    @property
+    def snapshot(self) -> _Snapshot:
+        return self._snapshot
+
+    @property
+    def flat(self) -> IvfFlatIndex:
+        return self._snapshot.flat
+
+    @property
+    def n_live(self) -> int:
+        return self._snapshot.n_live
+
+    @property
+    def epoch(self) -> int:
+        return self._snapshot.epoch
+
+    @property
+    def version(self) -> int:
+        return self._snapshot.version
+
+    @property
+    def next_id(self) -> int:
+        with self._lock:
+            return self._next_id
+
+    def tombstone_fraction(self) -> float:
+        """Dead rows still occupying packed slots / packed rows."""
+        snap = self._snapshot
+        packed = int(snap.flat.n_db)
+        return (packed - snap.n_live) / max(packed, 1)
+
+    def tail_full_fraction(self) -> float:
+        """Fraction of lists whose padded tail is exhausted — the
+        overflow pressure that turns the next routed insert into a
+        full repack."""
+        snap = self._snapshot
+        sizes = np.asarray(snap.flat.sizes, np.int64)
+        return float(np.mean(sizes >= snap.flat.caps)) if len(sizes) \
+            else 0.0
+
+    def _dead_host(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        return (self._tomb_host[ids // WORD_BITS]
+                >> (ids % WORD_BITS).astype(np.uint32)) & 1
+
+    def live_rows(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(rows, ids) of every live row, ascending external id — the
+        canonical content order (compaction input, CRC input, and the
+        exact-search database)."""
+        snap = self._snapshot
+        ids = np.asarray(snap.flat.packed_ids, np.int64)
+        db = np.asarray(snap.flat.packed_db)
+        occupied = ids >= 0
+        ids_o = ids[occupied]
+        with self._lock:
+            dead = self._dead_host(ids_o).astype(bool)
+        ids_l = ids_o[~dead]
+        rows_l = db[occupied][~dead]
+        order = np.argsort(ids_l, kind="stable")
+        return rows_l[order], ids_l[order]
+
+    def content_crc(self) -> int:
+        """CRC32 over the canonical live content: ids ‖ rows in id
+        order ‖ centroids. Invariant to packing layout, so a pure
+        compaction leaves it unchanged and a recovered replica matches
+        a clean twin run bit-for-bit — the crash-consistency witness."""
+        rows, ids = self.live_rows()
+        snap = self._snapshot
+        c = zlib.crc32(np.ascontiguousarray(ids, np.int64).tobytes())
+        c = zlib.crc32(np.ascontiguousarray(rows).tobytes(), c)
+        c = zlib.crc32(np.ascontiguousarray(
+            np.asarray(snap.flat.centroids, np.float32)).tobytes(), c)
+        return c
+
+    # -- journaling ----------------------------------------------------
+
+    def _crash(self, name: str) -> None:
+        if self.faults is not None:
+            self.faults.crash_point(name)
+
+    def _journal(self, kind: int, data: np.ndarray,
+                 labels: Optional[np.ndarray] = None) -> None:
+        if self.log is None:
+            return
+        rec: Dict = {"kind": kind, "epoch": self._epoch, "data": data}
+        if labels is not None:
+            rec["labels"] = np.asarray(labels, np.int64)
+        self.log.append(rec)
+
+    def _write_epoch_locked(self, *, crash: bool = True) -> None:
+        """Persist the CURRENT in-memory state as this epoch's snapshot
+        (called after a repack bumped ``self._epoch``), then prune the
+        WAL records the snapshot supersedes. The write itself is the
+        two-step atomic protocol with the mid-write crash point;
+        ``crash=False`` skips the protocol crash points (the epoch-0
+        build write — not part of the compaction state machine)."""
+        if self.log is None:
+            return
+        self.log.write_epoch(self._epoch, _epoch_entries(self),
+                             faults=self.faults if crash else None)
+        if crash:
+            self._crash("compact.post_commit")
+        self.log.prune_wal(before_epoch=self._epoch)
+        self.log.prune_epochs(keep=2)
+
+    # -- mutation ------------------------------------------------------
+
+    def insert(self, rows, labels: Optional[np.ndarray] = None
+               ) -> np.ndarray:
+        """Append rows; returns their external ids (assigned in arrival
+        order, stable forever). Journal-first: the WAL record (rows +
+        routing labels, so replay is deterministic even under MNMG load
+        routing) is durable before the in-memory apply — a kill between
+        the two replays the insert on recovery.
+
+        Rows that fit the padded tails apply as a pure in-place append
+        (same shapes — zero retrace); an overflow repacks live rows
+        + the new rows under a new epoch."""
+        rows = np.asarray(rows)
+        if rows.ndim != 2 or rows.shape[1] != self._snapshot.flat.dim:
+            raise ValueError(
+                f"rows must be [m, {self._snapshot.flat.dim}], got "
+                f"{rows.shape}")
+        if rows.shape[0] == 0:
+            return np.zeros((0,), np.int64)
+        with self._lock:
+            if labels is None:
+                dist, labels = _coarse_assign(rows,
+                                              self._flat.centroids)
+                self.drift.observe_batch(float(np.mean(dist)))
+            labels = np.asarray(labels, np.int64)
+            if labels.shape != (rows.shape[0],) or \
+                    labels.min(initial=0) < 0 or \
+                    labels.max(initial=0) >= self._flat.n_lists:
+                raise ValueError(
+                    f"labels must be [{rows.shape[0]}] list indices in "
+                    f"[0, {self._flat.n_lists})")
+            self._crash("ingest.pre_journal")
+            self._journal(KIND_INSERT, rows, labels)
+            self._crash("ingest.post_journal")
+            ids = self._apply_insert(rows, labels, journal=True)
+        if obs.enabled():
+            obs.inc("streaming_inserts_total", int(rows.shape[0]))
+        return ids
+
+    def _apply_insert(self, rows: np.ndarray, labels: np.ndarray,
+                      *, journal: bool) -> np.ndarray:
+        with self._lock:
+            flat = self._flat
+            m = int(rows.shape[0])
+            ids = np.arange(self._next_id, self._next_id + m,
+                            dtype=np.int64)
+            sizes = np.asarray(flat.sizes, np.int64)
+            add = np.bincount(labels, minlength=flat.n_lists
+                              ).astype(np.int64)
+            tomb_bits = self._tomb_host.shape[0] * WORD_BITS
+            if np.any(sizes + add > flat.caps) or \
+                    ids[-1] >= tomb_bits:
+                # overflow: fold live rows + new rows into a new epoch.
+                # next_id must advance BEFORE the epoch snapshot is
+                # written — the new rows ride into the epoch file, and
+                # a recovery that replayed later WAL records against
+                # the pre-insert next_id would re-assign their ids
+                self._next_id += m
+                self._repack_locked(extra_rows=rows, extra_ids=ids,
+                                    reason="insert_overflow")
+                self._reserve(rows)
+                return ids
+            else:
+                starts = np.asarray(flat.starts, np.int64)
+                order = np.argsort(labels, kind="stable")
+                excl = np.zeros(flat.n_lists, np.int64)
+                np.cumsum(add[:-1], out=excl[1:])
+                within = np.arange(m) - np.repeat(excl, add)
+                slots = (starts + sizes)[labels[order]] + within
+                packed_db = np.asarray(flat.packed_db).copy()
+                packed_ids = np.asarray(flat.packed_ids).copy()
+                packed_db[slots] = rows.astype(packed_db.dtype)[order]
+                packed_ids[slots] = ids[order].astype(np.int32)
+                self._flat = IvfFlatIndex(
+                    centroids=flat.centroids,
+                    packed_db=jnp.asarray(packed_db),
+                    packed_ids=jnp.asarray(packed_ids),
+                    starts=flat.starts,
+                    sizes=jnp.asarray(sizes + add, jnp.int32),
+                    caps=flat.caps, cap_max=flat.cap_max,
+                    n_db=flat.n_db + m, metric=flat.metric)
+                self._n_live += m
+                self._publish_locked()
+            self._next_id += m
+            self._reserve(rows)
+            return ids
+
+    def delete(self, ids) -> int:
+        """Tombstone external ids; returns how many flipped live→dead
+        (already-dead ids are an idempotent no-op, so a replayed delete
+        converges). Journal-first like :meth:`insert`. The device
+        bitset swap is same-shape — the serving executable never
+        retraces on a delete."""
+        ids = np.asarray(ids, np.int64).ravel()
+        if ids.size == 0:
+            return 0
+        with self._lock:
+            if ids.min() < 0 or ids.max() >= self._next_id:
+                raise ValueError(
+                    f"ids must be in [0, {self._next_id}), got range "
+                    f"[{ids.min()}, {ids.max()}]")
+            self._crash("ingest.pre_journal")
+            self._journal(KIND_DELETE, ids)
+            self._crash("ingest.post_journal")
+            flipped = self._apply_delete(ids, journal=True)
+        if obs.enabled():
+            obs.inc("streaming_deletes_total", flipped)
+            obs.set_gauge("streaming_tombstone_frac",
+                          self.tombstone_fraction())
+        return flipped
+
+    def _apply_delete(self, ids: np.ndarray, *, journal: bool) -> int:
+        with self._lock:
+            ids = np.asarray(ids, np.int64).ravel()
+            was_dead = self._dead_host(ids).astype(bool)
+            fresh = np.unique(ids[~was_dead])
+            np.bitwise_or.at(
+                self._tomb_host, fresh // WORD_BITS,
+                np.uint32(1) << (fresh % WORD_BITS).astype(np.uint32))
+            self._n_live -= int(fresh.size)
+            self._publish_locked()
+            return int(fresh.size)
+
+    # -- snapshot publication -----------------------------------------
+
+    def _publish_locked(self) -> None:
+        self._version += 1
+        self._snapshot = _Snapshot(
+            flat=self._flat, tomb_words=jnp.asarray(self._tomb_host),
+            n_live=self._n_live, epoch=self._epoch,
+            version=self._version)
+        self._history.append(self._snapshot)
+
+    def recent_snapshots(self) -> List[_Snapshot]:
+        """The last few published snapshots, oldest first (bounded
+        ring). A query in flight across a swap legitimately serves ANY
+        one consistent version from its submit→complete window — this
+        is what lets loadgen's recall scorer distinguish a stale-but-
+        consistent answer (fine) from a torn one (matches no version)."""
+        with self._lock:
+            return list(self._history)
+
+    # -- compaction / repack ------------------------------------------
+
+    def _repack_locked(self, *, extra_rows: Optional[np.ndarray] = None,
+                       extra_ids: Optional[np.ndarray] = None,
+                       centroids=None, reason: str = "compact") -> None:
+        """Pack live rows (+ optional new rows) under their ORIGINAL
+        external ids into fresh arrays, bump the epoch, persist its
+        snapshot, prune the superseded WAL, and swap the serve
+        snapshot. Every caller already holds the mutation lock; the
+        background compactor does its expensive pack OUTSIDE the lock
+        first and only re-enters here for the commit (see
+        :meth:`compact`)."""
+        t0 = time.monotonic()
+        rows, ids = self.live_rows()
+        if extra_rows is not None:
+            rows = np.concatenate(
+                [rows, np.asarray(extra_rows, rows.dtype)], axis=0)
+            ids = np.concatenate([ids, np.asarray(extra_ids, np.int64)])
+        centroids = self._flat.centroids if centroids is None \
+            else jnp.asarray(centroids, jnp.float32)
+        flat = _flat_from_live(rows, ids, centroids, self._flat.metric,
+                               slack_slots=self.repack_slack)
+        self._flat = flat
+        self._epoch += 1
+        self._n_live = int(rows.shape[0])
+        self._tomb_host = np.zeros(
+            self._tomb_n_words(flat, max(self._next_id,
+                                         int(ids.max(initial=-1)) + 1)),
+            np.uint32)
+        self._crash("compact.pre_commit")
+        self._write_epoch_locked()
+        self._publish_locked()
+        self._crash("compact.post_swap")
+        if obs.enabled():
+            obs.inc("streaming_compactions_total")
+            obs.observe("streaming_compact_seconds",
+                        time.monotonic() - t0)
+        trace.record_event("streaming.compact", reason=reason,
+                           epoch=self._epoch, n_live=self._n_live,
+                           seconds=round(time.monotonic() - t0, 4))
+
+    def compact(self, *, reason: str = "compact") -> None:
+        """One full compaction cycle: double-buffered pack of the live
+        rows off the mutation lock, then a short locked commit that
+        folds in any mutations that raced the pack. Serving never
+        pauses — searches keep reading the old snapshot until the
+        atomic swap at the end of the commit.
+
+        The compile/commit admission is priced through the
+        ``neighbors.streaming_compact`` cost model (R13) so a budget'd
+        deployment sees the repack's bytes before it runs."""
+        from raft_tpu.runtime import limits
+
+        self._crash("compact.pre_pack")
+        with self._lock:
+            snap_version = self._version
+            snap_next = self._next_id
+        # double buffer: pack from the snapshot OUTSIDE the lock
+        rows, ids = self.live_rows()
+        snap = self._snapshot
+        est = limits.estimate_bytes(
+            "neighbors.streaming_compact",
+            packed_rows=int(snap.flat.packed_db.shape[0]),
+            n_dims=snap.flat.dim,
+            itemsize=snap.flat.packed_db.dtype.itemsize)
+        with obs.span("streaming.compact"):
+            new_flat = _flat_from_live(rows, ids, snap.flat.centroids,
+                                       snap.flat.metric,
+                                       slack_slots=self.repack_slack)
+            with self._lock:
+                if self._version != snap_version:
+                    # mutations raced the pack: fold the delta in under
+                    # the lock (rare, small) — rows inserted since the
+                    # snapshot, deletes applied since the snapshot
+                    trace.record_event("streaming.compact_delta",
+                                       from_version=snap_version,
+                                       to_version=self._version)
+                    self._repack_locked(reason=reason + "_delta")
+                else:
+                    self._flat = new_flat
+                    self._epoch += 1
+                    self._n_live = int(rows.shape[0])
+                    self._tomb_host = np.zeros(
+                        self._tomb_n_words(new_flat,
+                                           max(snap_next, 1)),
+                        np.uint32)
+                    self._crash("compact.pre_commit")
+                    self._write_epoch_locked()
+                    self._publish_locked()
+                    self._crash("compact.post_swap")
+                    if obs.enabled():
+                        obs.inc("streaming_compactions_total")
+                    trace.record_event(
+                        "streaming.compact", reason=reason,
+                        epoch=self._epoch, n_live=self._n_live,
+                        est_bytes=int(est))
+
+    # -- drift-aware refit --------------------------------------------
+
+    def _reserve(self, rows: np.ndarray) -> None:
+        """Keep the most recent inserted rows (bounded) as the refit
+        mini-batch reservoir."""
+        self._reservoir.append(np.asarray(rows, np.float32))
+        self._reservoir_rows += int(rows.shape[0])
+        while self._reservoir and \
+                self._reservoir_rows - self._reservoir[0].shape[0] \
+                >= self._reservoir_cap:
+            self._reservoir_rows -= self._reservoir[0].shape[0]
+            self._reservoir.pop(0)
+
+    def maybe_refit(self, *, force: bool = False) -> bool:
+        """Refit the coarse quantizer when the drift gauge crossed its
+        threshold (or ``force``): mini-batch
+        :func:`~raft_tpu.cluster.kmeans.kmeans_partial_fit` on the
+        recent-insert reservoir seeded with the per-list live mass,
+        then a repack under the refitted centroids (a refit epoch) and
+        a baseline reset. Returns True when a refit ran."""
+        if not (force or self.drift.triggered):
+            return False
+        with self._lock:
+            if not self._reservoir:
+                return False
+            batch = np.concatenate(self._reservoir, axis=0)
+            flat = self._flat
+            sizes = np.asarray(flat.sizes, np.float32)
+        from raft_tpu.cluster.kmeans import kmeans_partial_fit
+
+        new_c, counts = kmeans_partial_fit(
+            self.res, flat.centroids, jnp.asarray(batch),
+            counts=jnp.asarray(sizes))
+        with self._lock:
+            self._pf_counts = np.asarray(counts)
+            self._repack_locked(centroids=new_c, reason="refit")
+        dist, _ = _coarse_assign(batch, new_c)
+        self.drift.set_baseline(float(np.mean(dist)))
+        if obs.enabled():
+            obs.inc("streaming_refits_total")
+        trace.record_event("streaming.refit", rows=int(batch.shape[0]),
+                           epoch=self.epoch)
+        return True
+
+    # -- search --------------------------------------------------------
+
+    def search(self, queries, k: int, nprobe: int
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """k nearest LIVE rows per query, external-id numbering, same
+        output contract as :func:`ivf_flat.search`. Tombstoned rows are
+        excluded in-mask on the partial-probe path (bit-identical to a
+        rebuild without them for the candidates scanned) and excluded
+        from the database on the exact path (``nprobe >= n_lists``),
+        which IS brute force over the live rows — ties and NaN rows
+        resolve exactly as a rebuild would."""
+        snap = self._snapshot
+        flat = snap.flat
+        queries = jnp.asarray(queries)
+        if queries.ndim != 2 or queries.shape[1] != flat.dim:
+            raise ValueError(f"queries must be [q, {flat.dim}], got "
+                             f"{queries.shape}")
+        if not 0 < k <= snap.n_live:
+            raise ValueError(f"need 0 < k <= n_live, got k={k}, "
+                             f"n_live={snap.n_live}")
+        if nprobe <= 0:
+            raise ValueError(f"need nprobe > 0, got {nprobe}")
+        if nprobe >= flat.n_lists:
+            from raft_tpu.neighbors.brute_force import knn
+
+            rows, ids = self.live_rows()
+            trace.record_event("streaming.search", nprobe=flat.n_lists,
+                               k=k, path="exact", epoch=snap.epoch)
+            dist, idx = knn(self.res, jnp.asarray(rows), queries, k,
+                            metric=flat.metric)
+            ids_j = jnp.asarray(ids, jnp.int32)
+            ext = jnp.where(idx >= 0,
+                            ids_j[jnp.maximum(idx, 0)], -1)
+            return dist, ext
+        probe_rows = nprobe * flat.cap_max
+        if probe_rows < k:
+            raise ValueError(
+                f"nprobe={nprobe} reaches at most {probe_rows} "
+                f"candidates < k={k}; raise nprobe")
+        trace.record_event("streaming.search", nprobe=nprobe, k=k,
+                           path="ivf", epoch=snap.epoch)
+        use_radix = _use_radix(probe_rows, k, flat.packed_db, queries)
+        return _search_jit(
+            queries, flat.centroids, flat.packed_db, flat.packed_ids,
+            flat.starts, flat.sizes, snap.tomb_words, k=k,
+            nprobe=nprobe, cap_max=flat.cap_max, metric=flat.metric,
+            use_radix=use_radix)
+
+
+def _flat_from_live(rows: np.ndarray, ids: np.ndarray, centroids,
+                    metric: str,
+                    slack_slots: int = SLOT_ALIGN) -> IvfFlatIndex:
+    """Pack (rows, ids) — ids arbitrary but unique — into a fresh
+    IvfFlatIndex under the given centroids. ``slack_slots`` free tail
+    slots per list beyond alignment: a repack must LEAVE headroom, or
+    re-filling every aligned-full tail would re-fire the tail-full
+    compaction criterion forever (size it to the expected insert batch
+    via ``StreamingIndex.repack_slack``). The streaming repack twin
+    of :func:`ivf_flat.build`: same labeling pass, same packer, but
+    ids are PRESERVED, not renumbered (the stable-identity contract)."""
+    centroids = jnp.asarray(centroids, jnp.float32)
+    n_lists = int(centroids.shape[0])
+    if rows.shape[0] == 0:
+        caps = np.zeros(n_lists, np.int64)
+        return IvfFlatIndex(
+            centroids=centroids,
+            packed_db=jnp.zeros((0, int(centroids.shape[1])),
+                                jnp.asarray(rows).dtype),
+            packed_ids=jnp.zeros((0,), jnp.int32),
+            starts=jnp.zeros((n_lists,), jnp.int32),
+            sizes=jnp.zeros((n_lists,), jnp.int32),
+            caps=caps, cap_max=0, n_db=0, metric=metric)
+    labels = _coarse_labels(rows, centroids)
+    # _pack's within-list order key is position in the (label-stable)
+    # sort; feeding rows in ascending external id keeps lists id-sorted,
+    # the invariant extend's tail append relies on
+    order = np.argsort(np.asarray(ids, np.int64), kind="stable")
+    rows = np.asarray(rows)[order]
+    ids32 = np.asarray(ids, np.int64)[order].astype(np.int32)
+    labels = np.asarray(labels)[order]
+    packed_db, packed_ids, starts, counts, caps = _pack(
+        rows, ids32, labels, n_lists, slack_slots=slack_slots)
+    return IvfFlatIndex(
+        centroids=centroids,
+        packed_db=jnp.asarray(packed_db),
+        packed_ids=jnp.asarray(packed_ids),
+        starts=jnp.asarray(starts, jnp.int32),
+        sizes=jnp.asarray(counts, jnp.int32),
+        caps=caps, cap_max=int(caps.max(initial=0)),
+        n_db=int(rows.shape[0]), metric=metric)
+
+
+def stream_build(res, db, n_lists: int, metric: str = "l2", *,
+                 directory: Optional[str] = None, max_iter: int = 25,
+                 seed: int = 0, faults=None,
+                 drift: Optional[DriftGauge] = None,
+                 repack_slack: int = SLOT_ALIGN) -> StreamingIndex:
+    """Build a fresh streaming index (train + pack via
+    :func:`ivf_flat.build`), journal its epoch-0 snapshot when a
+    ``directory`` is given, and seed the drift baseline with the
+    training rows' mean coarse distance."""
+    flat = build(res, db, n_lists, metric, max_iter=max_iter, seed=seed)
+    log = MutationLog(directory) if directory is not None else None
+    idx = StreamingIndex(flat, log=log, faults=faults, res=res,
+                         drift=drift, repack_slack=repack_slack)
+    dist, _ = _coarse_assign(np.asarray(db), flat.centroids)
+    idx.drift.set_baseline(float(np.mean(dist)))
+    if log is not None:
+        with idx._lock:
+            idx._write_epoch_locked(crash=False)
+    return idx
+
+
+def _epoch_entries(idx: StreamingIndex) -> Dict:
+    flat = idx._flat
+    return {
+        "epoch": idx._epoch,
+        "next_id": idx._next_id,
+        "n_live": idx._n_live,
+        "n_db": int(flat.n_db),
+        "metric": np.frombuffer(flat.metric.encode(), np.uint8),
+        "centroids": np.asarray(flat.centroids, np.float32),
+        "packed_db": np.asarray(flat.packed_db),
+        "packed_ids": np.asarray(flat.packed_ids, np.int32),
+        "starts": np.asarray(flat.starts, np.int64),
+        "sizes": np.asarray(flat.sizes, np.int64),
+        "caps": np.asarray(flat.caps, np.int64),
+        "tomb_words": idx._tomb_host.copy(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# background compactor
+# ---------------------------------------------------------------------------
+
+
+class Compactor:
+    """Background compaction worker: polls the streaming index every
+    ``RAFT_TPU_COMPACT_INTERVAL`` seconds and runs one
+    :meth:`StreamingIndex.compact` cycle whenever the tombstone
+    fraction crosses ``RAFT_TPU_COMPACT_TOMBSTONE_FRAC`` or any list
+    tail is exhausted (the next routed insert would repack on the
+    ingest path — doing it here keeps ingest latency flat). Also drives
+    :meth:`StreamingIndex.maybe_refit` so the drift loop needs no extra
+    thread. A worker-side failure is recorded to the obs flight
+    recorder and re-raised from :meth:`stop` — never swallowed."""
+
+    def __init__(self, index: StreamingIndex, *,
+                 interval: Optional[float] = None,
+                 tombstone_frac: Optional[float] = None,
+                 refit: bool = True,
+                 on_change: Optional[Callable[[], None]] = None):
+        self.index = index
+        # serving-side hook: runs after any cycle that changed the
+        # index (compaction or refit), on the worker thread — the
+        # ingest controller uses it to re-snapshot + pre-warm its
+        # serve executables off the query path
+        self.on_change = on_change
+        self.interval = float(env.read("RAFT_TPU_COMPACT_INTERVAL")
+                              if interval is None else interval)
+        self.tombstone_frac = float(
+            env.read("RAFT_TPU_COMPACT_TOMBSTONE_FRAC")
+            if tombstone_frac is None else tombstone_frac)
+        self.refit = bool(refit)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self.cycles = 0
+        self.compactions = 0
+
+    def should_compact(self) -> bool:
+        """Due when dead rows hold too many packed slots OR too many
+        list tails are exhausted (same threshold — both are 'wasted
+        capacity the next insert pays for' fractions)."""
+        return (self.index.tombstone_fraction() >= self.tombstone_frac
+                or self.index.tail_full_fraction()
+                >= self.tombstone_frac)
+
+    def run_once(self) -> bool:
+        """One poll: compact and/or refit if due; returns True when a
+        compaction ran."""
+        self.cycles += 1
+        ran = False
+        if self.should_compact():
+            self.index.compact(reason="background")
+            self.compactions += 1
+            ran = True
+        if self.refit and self.index.maybe_refit():
+            ran = True
+        if ran and self.on_change is not None:
+            self.on_change()
+        return ran
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.run_once()
+            except Exception as exc:  # noqa: BLE001 — surfaced at stop
+                self._error = exc
+                obs.record_failure(exc)
+                trace.record_event("streaming.compactor_error",
+                                   error=str(exc))
+                return
+
+    def start(self) -> "Compactor":
+        if self._thread is not None:
+            raise StreamingError("compactor already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="raft-tpu-compactor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the worker and re-raise any failure it died on."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise StreamingError(
+                "background compactor failed") from err
+
+    def __enter__(self) -> "Compactor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# ---------------------------------------------------------------------------
+# MNMG: routed ingest + rebalance over the sharded index
+# ---------------------------------------------------------------------------
+
+
+class StreamingMnmg:
+    """Streaming facade over the sharded MNMG index: mutations apply to
+    the underlying :class:`StreamingIndex` (single journal, single
+    epoch protocol — replicas recover the flat state and re-shard), the
+    search path re-shards lazily whenever the streaming version moved
+    and serves through :func:`ivf_mnmg.search_mnmg` with the tombstone
+    words replicated to every rank.
+
+    ``route="nearest"`` keeps bit-identity with the single-rank index.
+    ``route="load"`` sends a row whose second-nearest centroid is
+    within ``slack``× of its nearest to whichever of the two lists is
+    owned by the less-loaded rank — skew relief at ingest; the next
+    compaction's :func:`ivf_mnmg.rebalance_mnmg` (the heal-path repack)
+    restores nearest placement while LPT re-levels rank loads."""
+
+    ROUTES = ("nearest", "load")
+
+    def __init__(self, stream: StreamingIndex, n_ranks: int, *,
+                 mesh=None, axis: str = "ranks",
+                 route: str = "nearest", slack: float = 1.05):
+        from raft_tpu.neighbors.ivf_mnmg import _from_flat
+
+        if route not in self.ROUTES:
+            raise ValueError(f"route must be one of {self.ROUTES}, "
+                             f"got {route!r}")
+        self.stream = stream
+        self.n_ranks = int(n_ranks)
+        self.route = route
+        self.slack = float(slack)
+        self._lock = threading.Lock()
+        self._mnmg = _from_flat(stream.flat, self.n_ranks, mesh=mesh,
+                                axis=axis)
+        self._sharded_version = stream.version
+
+    @property
+    def mnmg(self):
+        self._refresh()
+        return self._mnmg
+
+    def _refresh(self) -> None:
+        from raft_tpu.neighbors.ivf_mnmg import rebalance_mnmg
+
+        with self._lock:
+            v = self.stream.version
+            if v != self._sharded_version:
+                self._mnmg = rebalance_mnmg(self._mnmg,
+                                            flat=self.stream.flat,
+                                            mesh=self._mnmg.mesh)
+                self._sharded_version = v
+                trace.record_event("streaming.reshard", version=v,
+                                   n_ranks=self.n_ranks)
+
+    def rank_loads(self) -> np.ndarray:
+        """Packed rows currently owned per rank (the skew the load
+        route levels)."""
+        idx = self.mnmg
+        sizes = np.asarray(self.stream.flat.sizes, np.int64)
+        loads = np.zeros(self.n_ranks, np.int64)
+        np.add.at(loads, idx.owner, sizes)
+        return loads
+
+    def _route_labels(self, rows: np.ndarray) -> np.ndarray:
+        """Per-row list assignment under the configured route. The
+        nearest label always comes from the SAME fused assign pass the
+        single-rank index routes with (ties and precision included), so
+        ``route="nearest"`` stays bit-identical; the load route only
+        ever diverges to the runner-up list when it is a near-tie
+        (within ``slack``×) owned by a less-loaded rank."""
+        centroids = self.stream.flat.centroids
+        dist, labels = _coarse_assign(rows, centroids)
+        self.stream.drift.observe_batch(float(np.mean(dist)))
+        labels = np.asarray(labels, np.int64)
+        n_lists = int(centroids.shape[0])
+        if self.route == "nearest" or n_lists < 2:
+            return labels
+        cents = np.asarray(centroids, np.float32)
+        rows = np.asarray(rows, np.float32)
+        d2 = (np.sum(rows * rows, 1)[:, None]
+              - 2.0 * rows @ cents.T
+              + np.sum(cents * cents, 1)[None, :])
+        ar = np.arange(len(rows))
+        d2[ar, labels] = np.inf                   # mask the winner
+        second = np.argmin(d2, axis=1)
+        owner = self.mnmg.owner
+        loads = self.rank_loads().astype(np.float64)
+        tie = d2[ar, second] <= \
+            np.maximum(dist.astype(np.float64), 1e-30) * self.slack ** 2
+        prefer_second = loads[owner[second]] < loads[owner[labels]]
+        return np.where(tie & prefer_second, second, labels)
+
+    def insert(self, rows) -> np.ndarray:
+        """Routed insert: labels chosen by the route policy and
+        JOURNALED with the rows, so replay reproduces the placement
+        regardless of recovery-time rank loads."""
+        rows = np.asarray(rows)
+        labels = self._route_labels(rows)
+        ids = self.stream.insert(rows, labels=labels)
+        self._refresh()
+        return ids
+
+    def delete(self, ids) -> int:
+        n = self.stream.delete(ids)
+        self._refresh()
+        return n
+
+    def rebalance(self) -> None:
+        """Compact + re-shard: the explicit post-skew rebalance (the
+        same repack :func:`ivf_mnmg.shrink_mnmg` runs after a rank
+        death — heal doubles as rebalance)."""
+        self.stream.compact(reason="rebalance")
+        self._refresh()
+
+    def search(self, res, queries, k: int, nprobe: int
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        from raft_tpu.neighbors.ivf_mnmg import search_mnmg
+
+        snap = self.stream.snapshot
+        if nprobe >= snap.flat.n_lists:
+            # the streaming layer owns the exact path (live rows only)
+            return self.stream.search(queries, k, nprobe)
+        self._refresh()
+        return search_mnmg(res, self._mnmg, queries, k, nprobe,
+                           tomb_words=snap.tomb_words)

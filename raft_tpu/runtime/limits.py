@@ -425,6 +425,16 @@ def _est_ivf_mnmg_search(*, n_queries, probe_rows, n_dims, k, n_ranks,
             + n_queries * k * (dist_itemsize + 4))
 
 
+def _est_streaming_compact(*, packed_rows, n_dims, itemsize,
+                           id_itemsize=4):
+    # the double-buffered repack: old packed matrix + ids resident
+    # while the new buffer fills (bounded by the same capacity), plus
+    # the coarse relabel pass's row reads — 2× packed arrays is the
+    # honest peak the swap window holds
+    return (2 * packed_rows * (n_dims * itemsize + id_itemsize)
+            + packed_rows * n_dims * itemsize)
+
+
 def _est_gemm(*, m, n, k, itemsize, out_itemsize=None):
     out_itemsize = itemsize if out_itemsize is None else out_itemsize
     return (m * k + k * n) * itemsize + m * n * out_itemsize
@@ -440,6 +450,7 @@ _ESTIMATORS = {
     "neighbors.brute_force_knn": _est_knn,
     "neighbors.ivf_search": _est_ivf_search,
     "neighbors.ivf_mnmg_search": _est_ivf_mnmg_search,
+    "neighbors.streaming_compact": _est_streaming_compact,
     "linalg.gemm": _est_gemm,
     "sparse.spmv": _est_spmv,
 }
@@ -546,6 +557,17 @@ def _sec_ivf_mnmg_search(*, n_queries, probe_rows, n_dims, k, n_ranks,
         packed_rows=packed_rows, dist_itemsize=dist_itemsize)
 
 
+def _sec_streaming_compact(*, packed_rows, n_dims, itemsize,
+                           id_itemsize=4):
+    # bandwidth-bound: the repack streams every packed byte through
+    # once out and once in (the coarse relabel contraction is the only
+    # FLOP term — one row×centroid pass, centroids ≪ rows)
+    flops = 2.0 * packed_rows * n_dims
+    return flops, _est_streaming_compact(
+        packed_rows=packed_rows, n_dims=n_dims, itemsize=itemsize,
+        id_itemsize=id_itemsize)
+
+
 def _sec_gemm(*, m, n, k, itemsize, out_itemsize=None):
     return 2.0 * m * n * k, _est_gemm(m=m, n=n, k=k,
                                       itemsize=itemsize,
@@ -565,6 +587,7 @@ _SECONDS_ESTIMATORS = {
     "neighbors.brute_force_knn": _sec_knn,
     "neighbors.ivf_search": _sec_ivf_search,
     "neighbors.ivf_mnmg_search": _sec_ivf_mnmg_search,
+    "neighbors.streaming_compact": _sec_streaming_compact,
     "linalg.gemm": _sec_gemm,
     "sparse.spmv": _sec_spmv,
 }

@@ -32,10 +32,12 @@ from raft_tpu.serve.executor import (Executor, ExecutorStats,
                                      IvfKnnService, IvfMnmgKnnService,
                                      KnnService, KMeansPredictService,
                                      PairwiseService, Service)
+from raft_tpu.serve.ingest import IngestController, StreamingKnnService
 from raft_tpu.serve.loadgen import (ChaosReport, FleetReport,
-                                    LoadReport, closed_loop,
-                                    fleet_closed_loop, open_loop,
-                                    run_chaos)
+                                    LoadReport, StreamingReport,
+                                    closed_loop, fleet_closed_loop,
+                                    open_loop, run_chaos,
+                                    streaming_loop)
 from raft_tpu.serve.qos import QosPolicy, TenantPolicy
 from raft_tpu.serve.replica import (HedgePolicy, RecoveryReport,
                                     Replica, ReplicaGroup,
@@ -55,6 +57,8 @@ __all__ = [
     "HedgePolicy",
     "BrownoutController", "BrownoutFloorError", "DegradationLadder",
     "ivf_ladder", "knn_ladder",
-    "LoadReport", "FleetReport", "ChaosReport", "closed_loop",
-    "open_loop", "fleet_closed_loop", "run_chaos",
+    "StreamingKnnService", "IngestController",
+    "LoadReport", "FleetReport", "ChaosReport", "StreamingReport",
+    "closed_loop", "open_loop", "fleet_closed_loop", "streaming_loop",
+    "run_chaos",
 ]

@@ -72,14 +72,54 @@ class Service:
     name: str = "service"
 
     def __init__(self, fixed_args: Tuple, dim: int, dtype=jnp.float32):
-        self.fixed_args = tuple(jnp.asarray(a) for a in fixed_args)
+        # (serve_epoch, fixed operands, per-epoch statics) swapped as
+        # ONE tuple: a concurrent dispatch reads the whole snapshot in
+        # a single attribute load, so it can never pair new-shape
+        # operands with an executable compiled for the old shapes (the
+        # streaming-compaction torn-swap hazard). The epoch is part of
+        # the executor's executable-cache key.
+        self._serving: Tuple = (
+            0, tuple(jnp.asarray(a) for a in fixed_args), None)
         self.dim = int(dim)
         self.dtype = jnp.dtype(dtype)
+
+    @property
+    def fixed_args(self) -> Tuple:
+        return self._serving[1]
+
+    @property
+    def serve_epoch(self) -> int:
+        return self._serving[0]
+
+    def serving(self) -> Tuple:
+        """Atomic serving snapshot ``(epoch, fixed_args, statics)`` —
+        dispatch reads this once per launch and threads the same
+        snapshot through cache lookup and the call itself."""
+        return self._serving
+
+    def swap_fixed_args(self, fixed_args: Tuple, *, statics=None,
+                        bump_epoch: bool = False) -> int:
+        """Publish new fixed operands (single writer). Same-shape swaps
+        keep the epoch — warmed executables stay valid because AOT
+        bakes shapes, not values; a shape-changing swap must pass
+        ``bump_epoch=True`` so stale-shape executables are never
+        reused. Returns the serving epoch now in force."""
+        epoch = self._serving[0] + (1 if bump_epoch else 0)
+        self._serving = (
+            epoch, tuple(jnp.asarray(a) for a in fixed_args), statics)
+        return epoch
 
     # -- subclass surface ---------------------------------------------
 
     def _build(self) -> Callable:
         raise NotImplementedError
+
+    def _build_for(self, serving: Tuple) -> Callable:
+        """Build the traced function for one serving snapshot. The
+        default ignores the snapshot (static services); epoch-swapping
+        services override this to close over ``serving[2]`` so the
+        compiled statics always match the snapshot's shapes."""
+        return self._build()
 
     def unpack(self, out, start: int, rows: int):
         """Slice one request's rows back out of the batched output."""
@@ -482,7 +522,10 @@ class Executor:
             self.queue.qos = qos
         self.use_aot = use_aot
         self.stats = ExecutorStats()
-        self._executables: Dict[Tuple[str, int], Callable] = {}
+        # keyed (service name, serve epoch, bucket rows) — the epoch
+        # component retires stale-shape executables across streaming
+        # compaction swaps
+        self._executables: Dict[Tuple[str, int, int], Callable] = {}
         self._exec_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -525,8 +568,11 @@ class Executor:
 
     # -- executable cache ---------------------------------------------
 
-    def _get_executable(self, svc: Service, rows: int) -> Callable:
-        key = (svc.name, rows)
+    def _get_executable(self, svc: Service, rows: int,
+                        serving: Optional[Tuple] = None) -> Callable:
+        if serving is None:
+            serving = svc.serving()
+        key = (svc.name, serving[0], rows)
         exe = self._executables.get(key)
         if exe is not None:
             self.stats.exec_hits += 1
@@ -536,15 +582,27 @@ class Executor:
         with self._exec_lock:
             exe = self._executables.get(key)
             if exe is None:
-                exe = self._build_executable(svc, rows)
+                exe = self._build_executable(svc, rows, serving)
                 self._executables[key] = exe
+                # an epoch bump obsoletes every earlier epoch's
+                # executables for this service (their baked shapes no
+                # longer match any serving snapshot) — drop them so the
+                # cache only ever tracks live shapes. Gated on the
+                # PUBLISHED epoch, not this snapshot's: pre-warming a
+                # pending (not yet published) epoch must never evict
+                # the executables still serving traffic.
+                stale = [k for k in self._executables
+                         if k[0] == svc.name and k[1] < svc.serve_epoch]
+                for k in stale:
+                    del self._executables[k]
         return exe
 
-    def _build_executable(self, svc: Service, rows: int) -> Callable:
+    def _build_executable(self, svc: Service, rows: int,
+                          serving: Tuple) -> Callable:
         self.stats.exec_misses += 1
         obs.inc("runtime_compile_cache_total", 1, cache="serve",
                 outcome="miss")
-        fn = svc._build()
+        fn = svc._build_for(serving)
         stats = self.stats
 
         def traced(*args):
@@ -553,7 +611,7 @@ class Executor:
             stats.traces += 1
             return fn(*args)
 
-        example = (*svc.fixed_args, svc.example(rows))
+        example = (*serving[1], svc.example(rows))
         if obs.perf_enabled():
             # static-cost extraction (ISSUE 13): profile the RAW fn —
             # not `traced`, whose retrace hook must only tick for real
@@ -587,8 +645,9 @@ class Executor:
         for svc in self.services.values():
             t0 = time.monotonic()
             for b in buckets:
-                exe = self._get_executable(svc, b)
-                out = exe(*svc.fixed_args, svc.example(b))
+                serving = svc.serving()
+                exe = self._get_executable(svc, b, serving)
+                out = exe(*serving[1], svc.example(b))
                 jax.block_until_ready(out)
                 n += 1
                 if obs.perf_enabled():
@@ -596,7 +655,7 @@ class Executor:
                     # profile carries a measured roofline fraction (the
                     # first call's wall time is dominated by compile)
                     t1 = time.monotonic()
-                    out = exe(*svc.fixed_args, svc.example(b))
+                    out = exe(*serving[1], svc.example(b))
                     jax.block_until_ready(out)
                     obs.record_launch(svc.name, b,
                                       time.monotonic() - t1)
@@ -724,7 +783,11 @@ class Executor:
         for r in reqs:
             padded[at:at + r.rows] = r.queries
             at += r.rows
-        exe = self._get_executable(svc, brows)
+        # one serving snapshot for the whole launch: the executable and
+        # the fixed operands it was compiled for always come from the
+        # SAME epoch, even if a compaction swap lands mid-dispatch
+        serving = svc.serving()
+        exe = self._get_executable(svc, brows, serving)
         if self.faults is not None:
             # chaos: an armed FaultInjector stall straggles this
             # replica's launches (the hedge gate's slow-replica lever)
@@ -733,7 +796,7 @@ class Executor:
                 time.sleep(stall)
         t0 = time.monotonic()
         try:
-            out = exe(*svc.fixed_args, jnp.asarray(padded))
+            out = exe(*serving[1], jnp.asarray(padded))
             jax.block_until_ready(out)
         except Exception as exc:  # noqa: BLE001 — futures must resolve
             for r in reqs:

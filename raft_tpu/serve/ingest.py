@@ -1,0 +1,333 @@
+"""Serving-side streaming ingest (ISSUE 17, serving half): a live
+IVF-Flat serve op whose fixed operands track a
+:class:`~raft_tpu.neighbors.streaming.StreamingIndex` across online
+mutation, background compaction and drift refits — without ever
+pausing the query path on a compile.
+
+The moving part the static serve stack never had to handle: a
+compaction or refit swaps the index's packed arrays, and the swap can
+CHANGE THEIR SHAPES (lists repack to new caps). The executor's warmed
+executables bake shapes at AOT export, so a naive in-place swap of
+``fixed_args`` would either crash the next launch (shape mismatch) or
+force an inline compile (a pause — exactly what zero-pause compaction
+promises away). Two mechanisms close the gap:
+
+- **Epoch-consistent launches** (``serve/executor.py``): every service
+  holds its serving state as ONE atomically-swapped tuple
+  ``(epoch, fixed_args, statics)``; dispatch reads the snapshot once
+  and threads it through executable lookup (cache key includes the
+  epoch) and the call itself, so a swap landing mid-dispatch can never
+  pair new-shape operands with an old-shape executable. Queries racing
+  a swap serve the OLD snapshot — immutable arrays, still-correct
+  results, exactly the "atomic swap between serve batches" contract.
+
+- **Pre-warm, then publish** (:class:`IngestController`): when a swap
+  changes shapes, the controller builds AND invokes the new epoch's
+  executables for the whole bucket ladder while queries continue
+  against the old epoch, and only then publishes the new serving
+  tuple. Same-shape swaps (deletes, fitting inserts) publish
+  immediately — the warmed executables stay valid because AOT bakes
+  shapes, not values.
+
+:class:`StreamingKnnService` is the service: same traced body as
+``IvfKnnService`` plus the tombstone mask operand, rebuilt per epoch
+from the streaming snapshot. :class:`IngestController` owns the trio
+(stream, executor, compactor) and keeps them consistent — foreground
+``insert``/``delete`` re-snapshot inline; background compaction swaps
+arrive through the compactor's ``on_change`` hook on the worker
+thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.neighbors.streaming import Compactor, StreamingIndex
+from raft_tpu.runtime import limits
+from raft_tpu.serve.executor import Executor, Service
+from raft_tpu.serve.queue import bucket_ladder
+
+__all__ = ["StreamingKnnService", "IngestController"]
+
+
+class StreamingKnnService(Service):
+    """Batched IVF-Flat kNN against a LIVE streaming index. The traced
+    body is :func:`ivf_flat._search_body` with the epoch's tombstone
+    words as a sixth fixed operand — deleted rows are masked out
+    in-score, bit-identical to a rebuild without them for the
+    candidates scanned (the PR-9 masked-validity path).
+
+    Unlike the static services, the fixed operands are a *snapshot*
+    that :meth:`prepare`/:meth:`publish` roll forward as the index
+    mutates. ``prepare()`` computes the serving tuple for the stream's
+    current snapshot (bumping the serve epoch iff any operand shape
+    changed); ``publish()`` installs it atomically. The controller
+    interposes a pre-warm between the two for shape-changing swaps;
+    :meth:`refresh` is the immediate prepare+publish for callers that
+    accept an inline compile.
+
+    Caller contract mirrors :class:`IvfKnnService`: one instance per
+    (k, nprobe), ``0 < nprobe < n_lists`` (full scans are brute force
+    over the live rows — serve those through the stream's exact path),
+    and k at most the live-row count."""
+
+    def __init__(self, stream: StreamingIndex, k: int, nprobe: int):
+        flat = stream.flat
+        if not 0 < nprobe < flat.n_lists:
+            raise ValueError(
+                f"StreamingKnnService needs 0 < nprobe < n_lists "
+                f"(got nprobe={nprobe}, n_lists={flat.n_lists}); "
+                f"nprobe >= n_lists is a full scan — serve it through "
+                f"StreamingIndex.search's exact path")
+        self.stream = stream
+        self.k = int(k)
+        self.nprobe = int(nprobe)
+        self.name = f"stream_knn_k{k}_np{nprobe}_{flat.metric}"
+        super().__init__((), dim=flat.dim, dtype=flat.packed_db.dtype)
+        self._version = -1
+        pending, version = self.prepare()
+        self.publish(pending, version)
+
+    # -- snapshot roll-forward ----------------------------------------
+
+    def prepare(self) -> Optional[Tuple[Tuple, int]]:
+        """Compute ``(pending_serving, stream_version)`` for the
+        stream's current snapshot, or None when already serving it.
+        The pending tuple's epoch is bumped iff any fixed operand's
+        shape (or compiled static) differs from what is being served —
+        same-shape swaps reuse the warmed executables."""
+        snap = self.stream.snapshot
+        if snap.version == self._version:
+            return None
+        from raft_tpu.neighbors.ivf_flat import _use_radix
+
+        flat = snap.flat
+        probe_rows = self.nprobe * flat.cap_max
+        if probe_rows < self.k:
+            raise ValueError(
+                f"{self.name}: nprobe={self.nprobe} reaches at most "
+                f"{probe_rows} candidates < k={self.k} after the "
+                f"latest repack; raise nprobe")
+        fixed = tuple(jnp.asarray(a) for a in (
+            flat.centroids, flat.packed_db, flat.packed_ids,
+            flat.starts, flat.sizes, snap.tomb_words))
+        statics: Dict[str, object] = {
+            "cap_max": int(flat.cap_max),
+            "metric": flat.metric,
+            "use_radix": bool(_use_radix(probe_rows, self.k,
+                                         flat.packed_db)),
+        }
+        epoch, cur_fixed, cur_statics = self.serving()
+        same = (cur_statics == statics
+                and len(cur_fixed) == len(fixed)
+                and all(a.shape == b.shape and a.dtype == b.dtype
+                        for a, b in zip(cur_fixed, fixed)))
+        return (epoch + (0 if same else 1), fixed, statics), snap.version
+
+    def publish(self, pending: Tuple, version: int) -> bool:
+        """Install a prepared serving tuple (single writer — the
+        controller's serve lock). One attribute store: concurrent
+        dispatches see either the old snapshot or the new one, never a
+        torn pair. Returns True when the epoch advanced (shapes
+        changed)."""
+        changed = pending[0] != self.serve_epoch
+        self._serving = pending
+        self._version = int(version)
+        return changed
+
+    def refresh(self) -> bool:
+        """Immediate prepare+publish (no pre-warm): the next launch at
+        a bumped epoch compiles inline. Returns True when the epoch
+        advanced."""
+        p = self.prepare()
+        if p is None:
+            return False
+        return self.publish(*p)
+
+    # -- Service surface ----------------------------------------------
+
+    def _build_for(self, serving: Tuple):
+        from raft_tpu.neighbors.ivf_flat import _search_body
+
+        k, nprobe = self.k, self.nprobe
+        st = serving[2]
+        cap_max, metric = st["cap_max"], st["metric"]
+        use_radix = st["use_radix"]
+
+        def fn(centroids, packed_db, packed_ids, starts, sizes,
+               tomb_words, q):
+            return _search_body(q, centroids, packed_db, packed_ids,
+                                starts, sizes, tomb_words, k=k,
+                                nprobe=nprobe, cap_max=cap_max,
+                                metric=metric, use_radix=use_radix)
+        return fn
+
+    def unpack(self, out, start, rows):
+        d, i = out
+        return d[start:start + rows], i[start:start + rows]
+
+    def estimate_bytes(self, rows):
+        _, fixed, st = self.serving()
+        return limits.estimate_bytes(
+            "neighbors.ivf_search", n_queries=rows,
+            probe_rows=self.nprobe * st["cap_max"],
+            n_dims=self.dim, k=self.k, itemsize=self.dtype.itemsize,
+            packed_rows=int(fixed[1].shape[0]))
+
+    def eager(self, queries):
+        return self.stream.search(jnp.asarray(queries), self.k,
+                                  self.nprobe)
+
+    def epilogue(self) -> str:
+        """"ivf" — quoted from :func:`knn_plan` like the static kNN
+        services, so the warm report shares their source of truth."""
+        from raft_tpu.neighbors.brute_force import knn_plan
+
+        flat = self.stream.flat
+        path, _ = knn_plan(1, flat.n_db, self.k, metric=flat.metric,
+                           n_lists=flat.n_lists, nprobe=self.nprobe)
+        return path
+
+
+class IngestController:
+    """The serving trio — :class:`StreamingIndex`, :class:`Executor`,
+    :class:`Compactor` — wired so every index mutation lands on the
+    serve path as an atomic, pre-warmed snapshot swap.
+
+    Foreground :meth:`insert`/:meth:`delete` mutate the stream then
+    re-snapshot the streaming services inline (a shape-changing
+    overflow repack pays its re-warm on the INGEST call, never on a
+    query). Background compaction and refit arrive through the
+    compactor's ``on_change`` hook on the worker thread. Both routes
+    serialize on one serve lock, and shape-changing swaps warm the new
+    epoch's executables across the whole bucket ladder before
+    publishing — the zero-pause half of the ISSUE-17 contract, gated
+    by loadgen's recall floor across swaps."""
+
+    def __init__(self, stream: StreamingIndex,
+                 services: Sequence[StreamingKnnService], *,
+                 queue=None, policy=None, qos=None, use_aot: bool = True,
+                 brownout=None, faults=None,
+                 compact_interval: Optional[float] = None,
+                 tombstone_frac: Optional[float] = None,
+                 refit: bool = True,
+                 warm_buckets: Optional[Sequence[int]] = None,
+                 extra_services: Sequence[Service] = ()):
+        self.stream = stream
+        self.streaming_services: List[StreamingKnnService] = \
+            list(services)
+        for svc in self.streaming_services:
+            if svc.stream is not stream:
+                raise ValueError(
+                    f"service {svc.name} wraps a different "
+                    f"StreamingIndex than this controller's")
+        self.executor = Executor(
+            [*self.streaming_services, *extra_services], queue=queue,
+            policy=policy, qos=qos, use_aot=use_aot, brownout=brownout,
+            faults=faults)
+        self.compactor = Compactor(
+            stream, interval=compact_interval,
+            tombstone_frac=tombstone_frac, refit=refit,
+            on_change=self._on_index_change)
+        self._serve_lock = threading.Lock()
+        self._warm_buckets = (list(warm_buckets)
+                              if warm_buckets is not None else None)
+        self.refreshes = 0   # snapshot publishes (any swap)
+        self.swaps = 0       # epoch-bumped publishes (shape changed)
+
+    def _buckets(self) -> Sequence[int]:
+        if self._warm_buckets is not None:
+            return self._warm_buckets
+        return bucket_ladder(self.executor.queue.policy.max_batch)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def start(self, *, warm: bool = True) -> "IngestController":
+        if warm:
+            self.executor.warm(self._buckets())
+        self.executor.start()
+        self.compactor.start()
+        return self
+
+    def stop(self) -> None:
+        """Compactor first (no swap may land while the executor
+        drains), then the executor; compactor worker failures re-raise
+        here, after the drain."""
+        try:
+            self.compactor.stop()
+        finally:
+            self.executor.stop()
+
+    def __enter__(self) -> "IngestController":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- ingest surface -----------------------------------------------
+
+    def insert(self, rows, labels: Optional[np.ndarray] = None
+               ) -> np.ndarray:
+        """Journal + apply an insert, then roll the serving snapshot
+        forward. Returns the assigned external ids."""
+        ids = self.stream.insert(rows, labels)
+        self._on_index_change()
+        return ids
+
+    def delete(self, ids) -> int:
+        """Tombstone ids, then roll the serving snapshot forward —
+        always same-shape (the per-epoch fixed bitset), so the publish
+        is immediate and the warmed executables survive."""
+        n = self.stream.delete(ids)
+        self._on_index_change()
+        return n
+
+    def compact(self, *, reason: str = "manual") -> None:
+        """Foreground compaction cycle (the background worker's
+        :meth:`Compactor.run_once` does the same off-thread)."""
+        self.stream.compact(reason=reason)
+        self._on_index_change()
+
+    def submit(self, op: str, queries, **kw):
+        return self.executor.submit(op, queries, **kw)
+
+    # -- snapshot roll-forward ----------------------------------------
+
+    def _on_index_change(self) -> None:
+        """Re-snapshot every streaming service; pre-warm before
+        publishing when shapes changed. Runs on whichever thread
+        mutated the index (ingest caller or compactor worker) — the
+        serve lock serializes the two, and queries never block on it
+        (dispatch only reads the published tuple)."""
+        with self._serve_lock:
+            for svc in self.streaming_services:
+                p = svc.prepare()
+                if p is None:
+                    continue
+                pending, version = p
+                bumped = pending[0] != svc.serve_epoch
+                if bumped:
+                    t0 = time.monotonic()
+                    buckets = list(self._buckets())
+                    for b in buckets:
+                        exe = self.executor._get_executable(
+                            svc, b, pending)
+                        out = exe(*pending[1], svc.example(b))
+                        jax.block_until_ready(out)
+                    obs.emit_event(
+                        "serve.ingest_rewarm", service=svc.name,
+                        epoch=pending[0], buckets=buckets,
+                        seconds=round(time.monotonic() - t0, 4))
+                svc.publish(pending, version)
+                self.refreshes += 1
+                if bumped:
+                    self.swaps += 1
+                    obs.inc("serve_streaming_swaps_total", 1,
+                            service=svc.name)

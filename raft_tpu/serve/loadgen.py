@@ -30,8 +30,9 @@ import numpy as np
 
 from raft_tpu.runtime import limits
 
-__all__ = ["LoadReport", "FleetReport", "ChaosReport", "closed_loop",
-           "open_loop", "fleet_closed_loop", "run_chaos",
+__all__ = ["LoadReport", "FleetReport", "ChaosReport",
+           "StreamingReport", "closed_loop", "open_loop",
+           "fleet_closed_loop", "streaming_loop", "run_chaos",
            "CHAOS_SCENARIOS"]
 
 
@@ -743,6 +744,204 @@ def chaos_kill_mid_spike(group, op: str, *, clients: int = 8,
     rep.notes["killed"] = fr.killed
     rep.notes["recovery_time_to_slo_s"] = fr.recovery_time_to_slo_s
     return rep
+
+
+@dataclass
+class StreamingReport:
+    """One streaming-ingest load run (ISSUE 17): sustained inserts +
+    deletes racing concurrent queries, with per-query recall measured
+    against an exact reference over the snapshot the query targeted.
+    ``min_recall`` across the run is the swap-safety witness the CI
+    gate asserts a floor on — it covers every query served while a
+    compaction swap was in flight."""
+
+    duration_s: float
+    queries: int = 0
+    failed: int = 0
+    ingest_rows: int = 0
+    deleted_rows: int = 0
+    ingest_batches: int = 0
+    latencies_ms: List[float] = field(default_factory=list)
+    recalls: List[float] = field(default_factory=list)
+    swaps: int = 0                      # epoch-bumped (shape) swaps
+    refreshes: int = 0                  # all serving-snapshot publishes
+    compactions: int = 0                # background compaction cycles
+    n_live_final: int = 0
+
+    @property
+    def qps(self) -> float:
+        return self.queries / self.duration_s if self.duration_s else 0.0
+
+    @property
+    def ingest_rate(self) -> float:
+        """Inserted rows per second, sustained across the run."""
+        return (self.ingest_rows / self.duration_s
+                if self.duration_s else 0.0)
+
+    def percentile_ms(self, q: float) -> float:
+        if not self.latencies_ms:
+            return float("nan")
+        return float(np.percentile(np.asarray(self.latencies_ms), q))
+
+    @property
+    def p50_ms(self) -> float:
+        return self.percentile_ms(50.0)
+
+    @property
+    def p99_ms(self) -> float:
+        return self.percentile_ms(99.0)
+
+    @property
+    def min_recall(self) -> float:
+        return min(self.recalls) if self.recalls else float("nan")
+
+    @property
+    def mean_recall(self) -> float:
+        return (float(np.mean(self.recalls)) if self.recalls
+                else float("nan"))
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "mode": "streaming",
+            "duration_s": round(self.duration_s, 3),
+            "queries": self.queries,
+            "failed": self.failed,
+            "qps": round(self.qps, 2),
+            "ingest_rows": self.ingest_rows,
+            "deleted_rows": self.deleted_rows,
+            "ingest_batches": self.ingest_batches,
+            "ingest_rate": round(self.ingest_rate, 1),
+            "p50_ms": round(self.p50_ms, 3),
+            "p99_ms": round(self.p99_ms, 3),
+            "min_recall": round(self.min_recall, 4),
+            "mean_recall": round(self.mean_recall, 4),
+            "swaps": self.swaps,
+            "refreshes": self.refreshes,
+            "compactions": self.compactions,
+            "n_live_final": self.n_live_final,
+        }
+
+
+def _snapshot_exact_ids(snap, q: np.ndarray, k: int) -> np.ndarray:
+    """Exact top-k external ids over one streaming snapshot's live
+    rows — the numpy reference the per-query recall is scored against
+    (test/bench scale only: materializes the full distance matrix)."""
+    flat = snap.flat
+    ids = np.asarray(flat.packed_ids)
+    rows = np.asarray(flat.packed_db)
+    live = ids >= 0
+    words = np.asarray(snap.tomb_words)
+    if words.size:
+        safe = np.clip(ids, 0, None)
+        live &= ((words[safe // 32] >> (safe % 32)) & 1) == 0
+    rows, ids = rows[live], ids[live]
+    q = np.asarray(q, np.float32)
+    rows = np.asarray(rows, np.float32)
+    if flat.metric == "ip":
+        d = -(q @ rows.T)
+    else:
+        d = ((q * q).sum(1)[:, None] - 2.0 * (q @ rows.T)
+             + (rows * rows).sum(1)[None, :])
+    top = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return ids[top]
+
+
+def streaming_loop(controller, op: str, *, clients: int = 4,
+                   rows: int = 4, duration_s: float = 2.0,
+                   ingest_rows: int = 32,
+                   ingest_interval_s: float = 0.05,
+                   delete_frac: float = 0.3, seed: int = 0,
+                   wait_s: float = 30.0) -> StreamingReport:
+    """Sustained ingest racing concurrent queries against one
+    :class:`~raft_tpu.serve.ingest.IngestController`.
+
+    One ingester thread inserts ``ingest_rows`` rows every
+    ``ingest_interval_s`` and tombstones ``delete_frac`` of each batch
+    (feeding the background compactor); ``clients`` query threads run
+    the closed loop against ``op``, each scoring its response against
+    an exact reference computed over the snapshot it targeted — so a
+    torn or stale swap shows up as a recall dip, not a silent wrong
+    answer. Recall is relative to exact search over the live rows, so
+    the floor a gate asserts must budget for the op's nprobe (use
+    ``nprobe = n_lists - 1`` for a near-exact probe that still rides
+    the masked partial path)."""
+    svc = controller.executor._service(op)
+    k = svc.k
+    report = StreamingReport(duration_s=0.0)
+    lock = threading.Lock()
+    stop = threading.Event()
+    swaps0 = controller.swaps
+    refreshes0 = controller.refreshes
+    compactions0 = controller.compactor.compactions
+
+    def ingester() -> None:
+        rng = np.random.default_rng(seed + 10_000)
+        while not stop.is_set():
+            batch = rng.standard_normal(
+                (ingest_rows, svc.dim)).astype(svc.dtype)
+            ids = controller.insert(batch)
+            n_del = int(round(len(ids) * delete_frac))
+            if n_del:
+                controller.delete(ids[:n_del])
+            with lock:
+                report.ingest_rows += len(ids)
+                report.ingest_batches += 1
+                report.deleted_rows += n_del
+            if stop.wait(ingest_interval_s):
+                return
+
+    def _recall(got: np.ndarray, ref: np.ndarray) -> float:
+        return float(np.mean(
+            [len(set(got[j].tolist()) & set(ref[j].tolist())) / k
+             for j in range(got.shape[0])]))
+
+    def client(i: int) -> None:
+        rng = np.random.default_rng(seed + i)
+        while not stop.is_set():
+            q = rng.standard_normal((rows, svc.dim)).astype(svc.dtype)
+            before = svc.stream.snapshot
+            t_submit = time.monotonic()
+            try:
+                fut = controller.submit(op, q)
+                d, got = fut.result(timeout=wait_s)
+            except Exception:  # noqa: BLE001 — tallied, loop continues
+                with lock:
+                    report.failed += 1
+                continue
+            lat_ms = (time.monotonic() - t_submit) * 1e3
+            got = np.asarray(got)
+            # a query in flight across swaps legitimately serves ANY
+            # consistent version from its submit→complete window —
+            # score against each and keep the best. A torn swap
+            # matches NO version and still craters the recall.
+            rec = _recall(got, _snapshot_exact_ids(before, q, k))
+            if rec < 1.0:
+                for snap in svc.stream.recent_snapshots():
+                    if snap.version <= before.version or rec >= 1.0:
+                        continue
+                    rec = max(rec, _recall(
+                        got, _snapshot_exact_ids(snap, q, k)))
+            with lock:
+                report.queries += 1
+                report.latencies_ms.append(lat_ms)
+                report.recalls.append(rec)
+
+    threads = [threading.Thread(target=ingester, daemon=True)]
+    threads += [threading.Thread(target=client, args=(i,), daemon=True)
+                for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=wait_s)
+    report.duration_s = time.monotonic() - t0
+    report.swaps = controller.swaps - swaps0
+    report.refreshes = controller.refreshes - refreshes0
+    report.compactions = controller.compactor.compactions - compactions0
+    report.n_live_final = controller.stream.n_live
+    return report
 
 
 #: scenario name -> callable(target, op, **kwargs). ``traffic_step``

@@ -1,0 +1,87 @@
+"""Subprocess worker for the streaming SIGKILL crash-consistency
+witness (tests/test_streaming.py + ci/smoke.sh chaos smoke).
+
+Runs a fixed, deterministic mutation sequence against a journaled
+:class:`~raft_tpu.neighbors.streaming.StreamingIndex`:
+
+    build(seed) → insert 24 → delete every 3rd of ids 0..39
+    → [arm ingest.* here] → insert 16 → [arm compact.* here] → compact
+
+Modes:
+
+``--run`` (default)
+    Execute the sequence. With ``--crash NAME`` the named
+    :meth:`FaultInjector.crash_point` is armed (``--mode kill``
+    delivers a real SIGKILL — no atexit, no finally, torn files are
+    whatever the OS kept). Without a crash, prints the three content
+    CRCs the parent scores recovery against:
+    ``after_delete after_insert2 final``.
+
+``--recover``
+    Recover the index from ``--dir`` twice (two independent
+    :meth:`StreamingIndex.recover` calls) and print both CRCs — the
+    parent asserts the recovered CRC equals a consistent pre/post
+    state AND that replay is deterministic.
+
+All CRC printing happens in subprocesses launched from the same
+environment, so jax config (x64, platform) can never skew the
+reference against the witness.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N_DB, DIM, N_LISTS = 160, 8, 8
+
+
+def _sequence(directory, crash=None, mode="kill"):
+    from raft_tpu.comms.faults import FaultInjector
+    from raft_tpu.neighbors import streaming
+
+    faults = FaultInjector()
+    rng = np.random.default_rng(7)
+    db = rng.normal(size=(N_DB, DIM)).astype(np.float32)
+    idx = streaming.stream_build(None, db, N_LISTS, seed=0,
+                                 max_iter=4, directory=directory,
+                                 faults=faults)
+    idx.insert(rng.normal(size=(24, DIM)).astype(np.float32))
+    idx.delete(np.arange(0, 40, 3))
+    crc_after_delete = idx.content_crc()
+    if crash and crash.startswith("ingest."):
+        faults.arm_crash(crash, mode=mode)
+    idx.insert(rng.normal(size=(16, DIM)).astype(np.float32))
+    crc_after_insert2 = idx.content_crc()
+    if crash and crash.startswith("compact."):
+        faults.arm_crash(crash, mode=mode)
+    idx.compact(reason="chaos")
+    return crc_after_delete, crc_after_insert2, idx.content_crc()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", required=True)
+    p.add_argument("--crash", default=None)
+    p.add_argument("--mode", default="kill")
+    p.add_argument("--recover", action="store_true")
+    a = p.parse_args(argv)
+    if a.recover:
+        from raft_tpu.neighbors.streaming import StreamingIndex
+
+        first = StreamingIndex.recover(None, a.dir).content_crc()
+        second = StreamingIndex.recover(None, a.dir).content_crc()
+        print(f"{first} {second}")
+        return 0
+    crcs = _sequence(a.dir, a.crash, a.mode)
+    print(" ".join(str(c) for c in crcs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,647 @@
+"""Streaming index lifecycle (ISSUE 17): crash-safe online mutation,
+zero-pause compaction, drift-aware refit.
+
+Exactness claims gated here:
+
+- tombstoned-id exclusion is bit-identical to a rebuild WITHOUT the
+  deleted rows — under exact duplicates (ties) and NaN rows, on BOTH
+  fine-select epilogues (fused merge and radix), forced explicitly
+  through ``_search_jit(use_radix=...)``;
+- a delete/fitting-insert never retraces the compiled search (the
+  same-shape swap contract), pinned via ``_cache_size``;
+- recovery replays a journaled mutation history to the exact pre-crash
+  content CRC — a raise-mode sweep over every named crash point
+  in-process, plus a real-SIGKILL subprocess witness
+  (tests/_streaming_chaos_worker.py) whose reference CRCs come from a
+  twin subprocess so jax config can never skew the comparison.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import _streaming_chaos_worker as chaos
+from raft_tpu.comms.faults import CrashPointError, FaultInjector
+from raft_tpu.core import env
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors.ivf_flat import _search_jit
+from raft_tpu.neighbors.streaming import (Compactor, DriftGauge,
+                                          MutationLog, RecoveryError,
+                                          StreamingError,
+                                          StreamingIndex, StreamingMnmg,
+                                          _flat_from_live, stream_build)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CRASH_POINTS = ["ingest.pre_journal", "ingest.post_journal",
+                "compact.pre_pack", "compact.pre_commit",
+                "compact.mid_write", "compact.post_commit",
+                "compact.post_swap"]
+
+
+def _mk(res=None, n=160, d=8, n_lists=8, seed=3, **kw):
+    rng = np.random.default_rng(seed)
+    db = rng.normal(size=(n, d)).astype(np.float32)
+    return db, stream_build(res, db, n_lists, seed=0, max_iter=4, **kw)
+
+
+def _rows(m, d=8, seed=11):
+    return np.random.default_rng(seed).normal(size=(m, d)).astype(
+        np.float32)
+
+
+# ---------------------------------------------------------------------------
+# mutation basics
+# ---------------------------------------------------------------------------
+
+
+class TestMutation:
+    def test_insert_assigns_sequential_ids_and_serves(self, res):
+        db, idx = _mk(res)
+        new = _rows(12)
+        ids = idx.insert(new)
+        np.testing.assert_array_equal(ids, np.arange(160, 172))
+        assert idx.n_live == 172 and idx.next_id == 172
+        # every inserted row is its own nearest live neighbor at full
+        # probe (exact path over live rows)
+        _, got = idx.search(new, k=1, nprobe=idx.flat.n_lists)
+        np.testing.assert_array_equal(np.asarray(got)[:, 0], ids)
+
+    def test_fitting_insert_and_delete_never_retrace(self, res):
+        db, idx = _mk(res)
+        idx.compact(reason="provision")        # tails get repack_slack
+        q = db[:16]
+        idx.search(q, k=4, nprobe=7)
+        before = _search_jit._cache_size()
+        assert idx.delete([3, 5]) == 2
+        idx.search(q, k=4, nprobe=7)
+        epoch0 = idx.epoch
+        idx.insert(_rows(4))                   # fits the provisioned tails
+        assert idx.epoch == epoch0, "fitting insert must not repack"
+        idx.search(q, k=4, nprobe=7)
+        assert _search_jit._cache_size() == before, \
+            "delete / fitting insert changed a compiled-search shape"
+
+    def test_delete_excludes_and_is_idempotent(self, res):
+        db, idx = _mk(res)
+        assert idx.delete([7, 7, 9]) == 2
+        assert idx.delete([7, 9]) == 0
+        assert idx.n_live == 158
+        _, got = idx.search(db[7:8], k=4, nprobe=idx.flat.n_lists)
+        assert 7 not in np.asarray(got)
+        rows, ids = idx.live_rows()
+        assert 7 not in ids and 9 not in ids
+        assert rows.shape[0] == 158
+
+    def test_overflow_insert_repacks_under_new_epoch(self, res):
+        db, idx = _mk(res)
+        epoch0 = idx.epoch
+        big = _rows(200, seed=13)
+        ids = idx.insert(big)
+        assert idx.epoch > epoch0
+        assert idx.n_live == 360 and idx.next_id == 360
+        rows, live = idx.live_rows()
+        np.testing.assert_array_equal(live, np.arange(360))
+        np.testing.assert_array_equal(rows[ids], big)
+
+    def test_validation(self, res):
+        db, idx = _mk(res)
+        with pytest.raises(ValueError, match=r"rows must be"):
+            idx.insert(np.zeros((3, 5), np.float32))
+        with pytest.raises(ValueError, match=r"labels must be"):
+            idx.insert(_rows(2), labels=np.asarray([0, 99]))
+        with pytest.raises(ValueError, match=r"ids must be in"):
+            idx.delete([700])
+        with pytest.raises(ValueError, match=r"n_live"):
+            idx.search(db[:2], k=200, nprobe=8)
+        with pytest.raises(ValueError, match=r"nprobe"):
+            idx.search(db[:2], k=2, nprobe=0)
+        assert idx.insert(np.zeros((0, 8), np.float32)).size == 0
+        assert idx.delete(np.zeros((0,), np.int64)) == 0
+
+
+# ---------------------------------------------------------------------------
+# tombstone exactness: ties + NaN, both epilogues (satellite d)
+# ---------------------------------------------------------------------------
+
+
+def _dirty_stream(res):
+    """The adversarial db from test_ivf_flat: an exact duplicate pair
+    and a NaN row, built against supplied centroids so the quantizer
+    never sees the NaN."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(512, 8)).astype(np.float32)
+    X[100] = X[7]
+    X[200] = X[7]
+    X[300] = np.nan
+    flat = ivf_flat.build(res, X, 8, centroids=X[:8])
+    return X, StreamingIndex(flat)
+
+
+def _force_search(flat, tomb_words, q, k, nprobe, use_radix):
+    return _search_jit(jnp.asarray(q), flat.centroids, flat.packed_db,
+                       flat.packed_ids, flat.starts, flat.sizes,
+                       tomb_words, k=k, nprobe=nprobe,
+                       cap_max=flat.cap_max, metric=flat.metric,
+                       use_radix=use_radix)
+
+
+class TestTombstoneExactness:
+    def _radix_ok(self, k, *flats):
+        from raft_tpu.matrix import radix_select
+        from raft_tpu.util.pallas_utils import interpret_needs_ref
+
+        return all(radix_select.supports(jnp.float32,
+                                         7 * f.cap_max, k)
+                   and not interpret_needs_ref(f.packed_db)
+                   for f in flats)
+
+    @pytest.mark.parametrize("use_radix", [False, True])
+    def test_delete_bit_identical_to_rebuild(self, res, use_radix):
+        X, idx = _dirty_stream(res)
+        # kill one of the tie pair, the NaN row, and a bystander
+        idx.delete([100, 300, 20])
+        snap = idx.snapshot
+        rows, ids = idx.live_rows()
+        rebuilt = _flat_from_live(rows, ids, snap.flat.centroids,
+                                  snap.flat.metric)
+        if use_radix and not self._radix_ok(8, snap.flat, rebuilt):
+            pytest.skip("radix epilogue unsupported at this shape")
+        q = np.concatenate([X[7:8], X[100:101], X[40:44]])
+        md, mi = _force_search(snap.flat, snap.tomb_words, q, 8, 7,
+                               use_radix)
+        rd, ri = _force_search(rebuilt, None, q, 8, 7, use_radix)
+        np.testing.assert_array_equal(np.asarray(md), np.asarray(rd))
+        np.testing.assert_array_equal(np.asarray(mi), np.asarray(ri))
+        assert not np.isin(np.asarray(mi), [100, 300, 20]).any()
+
+    @pytest.mark.parametrize("use_radix", [False, True])
+    def test_zero_bitset_is_value_level_noop(self, res, use_radix):
+        X, idx = _dirty_stream(res)
+        snap = idx.snapshot
+        if use_radix and not self._radix_ok(8, snap.flat):
+            pytest.skip("radix epilogue unsupported at this shape")
+        q = np.concatenate([X[7:8], X[300:301], X[40:44]])
+        zd, zi = _force_search(snap.flat, snap.tomb_words, q, 8, 7,
+                               use_radix)
+        nd, ni = _force_search(snap.flat, None, q, 8, 7, use_radix)
+        np.testing.assert_array_equal(np.asarray(zd), np.asarray(nd))
+        np.testing.assert_array_equal(np.asarray(zi), np.asarray(ni))
+
+    def test_unrelated_delete_leaves_results_bit_identical(self, res):
+        X, idx = _dirty_stream(res)
+        q = np.concatenate([X[7:8], X[40:44]])
+        d0, i0 = idx.search(q, k=4, nprobe=7)
+        victims = sorted(set(range(450, 470))
+                         - set(np.asarray(i0).ravel().tolist()))
+        idx.delete(victims)
+        d1, i1 = idx.search(q, k=4, nprobe=7)
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_exact_path_matches_brute_force_on_live(self, res):
+        from raft_tpu.neighbors.brute_force import knn
+
+        X, idx = _dirty_stream(res)
+        idx.delete([100, 300])
+        rows, ids = idx.live_rows()
+        bd, bi = knn(res, rows, np.concatenate([X[7:8], X[40:44]]), k=8)
+        ad, ai = idx.search(np.concatenate([X[7:8], X[40:44]]), k=8,
+                            nprobe=idx.flat.n_lists)
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(ad))
+        np.testing.assert_array_equal(ids[np.asarray(bi)],
+                                      np.asarray(ai))
+
+
+# ---------------------------------------------------------------------------
+# WAL + recovery
+# ---------------------------------------------------------------------------
+
+
+class TestRecovery:
+    def test_replay_is_bit_identical(self, res, tmp_path):
+        db, idx = _mk(res, directory=str(tmp_path))
+        idx.insert(_rows(24))
+        idx.delete(np.arange(0, 30, 3))
+        rec = StreamingIndex.recover(res, str(tmp_path))
+        assert rec.content_crc() == idx.content_crc()
+        assert rec.next_id == idx.next_id
+        assert rec.n_live == idx.n_live
+        q = db[:8]
+        np.testing.assert_array_equal(
+            np.asarray(idx.search(q, k=4, nprobe=7)[1]),
+            np.asarray(rec.search(q, k=4, nprobe=7)[1]))
+
+    def test_recover_after_compaction_prunes_wal(self, res, tmp_path):
+        db, idx = _mk(res, directory=str(tmp_path))
+        idx.insert(_rows(24))
+        idx.delete(np.arange(10))
+        idx.compact(reason="test")
+        names = os.listdir(tmp_path)
+        assert not [n for n in names if n.startswith("wal-")], \
+            "commit must prune the WAL records the snapshot folded in"
+        assert len([n for n in names if n.startswith("epoch-")]) <= 2
+        rec = StreamingIndex.recover(res, str(tmp_path))
+        assert rec.content_crc() == idx.content_crc()
+
+    @pytest.mark.parametrize("point", CRASH_POINTS)
+    def test_crash_sweep_recovers_consistent(self, tmp_path, point):
+        """Raise-mode crash at every named protocol point: recovery
+        lands on the exact pre- or post-mutation content (pre_journal
+        is the only point where the mutation is not yet durable), and
+        replay is deterministic (two recoveries agree)."""
+        ref = str(tmp_path / "ref")
+        crc_del, crc_ins2, crc_fin = chaos._sequence(ref)
+        assert crc_fin == crc_ins2, "compaction must preserve the CRC"
+        want = crc_del if point == "ingest.pre_journal" else crc_ins2
+        d = str(tmp_path / "crash")
+        with pytest.raises(CrashPointError):
+            chaos._sequence(d, crash=point, mode="raise")
+        assert StreamingIndex.recover(None, d).content_crc() == want
+        assert StreamingIndex.recover(None, d).content_crc() == want
+
+    def test_sigkill_witness(self, tmp_path):
+        """The real-SIGKILL half: the worker dies at
+        compact.mid_write (the torn-file window) under SIGKILL — no
+        atexit, no finally — and two independent recoveries in a fresh
+        process land bit-equal on the post-mutation epoch."""
+        env_ = dict(os.environ, JAX_PLATFORMS="cpu")
+        worker = os.path.join(_REPO, "tests",
+                              "_streaming_chaos_worker.py")
+
+        def run(*args, rc=0):
+            p = subprocess.run([sys.executable, worker, *args],
+                               cwd=_REPO, env=env_, timeout=300,
+                               capture_output=True, text=True)
+            assert p.returncode == rc, p.stderr[-2000:]
+            return p.stdout.split()
+
+        ref = run("--dir", str(tmp_path / "ref"))
+        _, after_insert2, final = (int(c) for c in ref)
+        assert final == after_insert2
+        kill_dir = str(tmp_path / "kill")
+        run("--dir", kill_dir, "--crash", "compact.mid_write",
+            "--mode", "kill", rc=-9)
+        first, second = (int(c) for c in
+                         run("--dir", kill_dir, "--recover"))
+        assert first == second == after_insert2
+
+    def test_corrupt_epoch_falls_back_to_previous(self, res, tmp_path):
+        db, idx = _mk(res, directory=str(tmp_path))
+        idx.insert(_rows(200))            # overflow: folds into epoch 1
+        e1, crc1 = idx.epoch, idx.content_crc()
+        assert e1 >= 1
+        idx.delete(np.arange(0, 80))
+        idx.compact(reason="test")
+        e2 = idx.epoch
+        assert e2 > e1
+        # at-rest damage to the newest epoch: recovery skips it and
+        # serves the previous one (whose WAL was pruned at commit, so
+        # the fallback is that epoch's folded content)
+        path = idx.log.epoch_path(e2)
+        with open(path, "r+b") as f:
+            f.seek(os.path.getsize(path) // 2)
+            f.write(b"\xde\xad\xbe\xef" * 8)
+        rec = StreamingIndex.recover(res, str(tmp_path))
+        assert rec.epoch == e1 and rec.content_crc() == crc1
+        with open(idx.log.epoch_path(e1), "r+b") as f:
+            f.seek(16)
+            f.write(b"\xde\xad\xbe\xef" * 8)
+        with pytest.raises(RecoveryError):
+            StreamingIndex.recover(res, str(tmp_path))
+
+    def test_mutation_log_seq_and_prune(self, tmp_path):
+        log = MutationLog(str(tmp_path))
+        assert log.append({"epoch": 0, "kind": 0,
+                           "data": np.arange(3)}) == 0
+        assert log.append({"epoch": 1, "kind": 1,
+                           "data": np.arange(2)}) == 1
+        # a reopened log continues the sequence
+        assert MutationLog(str(tmp_path)).append(
+            {"epoch": 1, "kind": 0, "data": np.arange(1)}) == 2
+        recs = log.wal_records()
+        assert [int(r["seq"]) for r in recs] == [0, 1, 2]
+        assert log.prune_wal(before_epoch=1) == 1
+        assert [int(r["epoch"]) for r in log.wal_records()] == [1, 1]
+        with pytest.raises(RecoveryError):
+            log.load_latest_epoch()
+
+
+# ---------------------------------------------------------------------------
+# compaction
+# ---------------------------------------------------------------------------
+
+
+class TestCompaction:
+    def test_compact_preserves_content_and_search_bits(self, res):
+        db, idx = _mk(res, n=256)
+        idx.insert(_rows(24))
+        idx.delete(np.arange(0, 60, 2))
+        crc = idx.content_crc()
+        q = db[:16]
+        d0, i0 = idx.search(q, k=4, nprobe=7)
+        frac0 = idx.tombstone_fraction()
+        assert frac0 > 0
+        idx.compact(reason="test")
+        assert idx.content_crc() == crc
+        assert idx.tombstone_fraction() == 0.0
+        d1, i1 = idx.search(q, k=4, nprobe=7)
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_compactor_triggers_then_settles(self, res):
+        db, idx = _mk(res)
+        c = Compactor(idx, interval=0.01, tombstone_frac=0.2,
+                      refit=False)
+        # a fresh build packs aligned-full tails, so the tail-overflow
+        # criterion is due until a provisioning repack reserves slack
+        assert c.should_compact()
+        idx.compact(reason="provision")
+        assert not c.should_compact()
+        idx.delete(np.arange(0, 80))
+        assert c.should_compact()
+        assert c.run_once() is True
+        assert c.compactions == 1
+        assert c.run_once() is False, \
+            "a repack with slack must clear both trigger fractions"
+
+    def test_background_compactor_runs_and_stops(self, res):
+        db, idx = _mk(res)
+        swapped = threading.Event()
+        with Compactor(idx, interval=0.01, tombstone_frac=0.2,
+                       refit=False, on_change=swapped.set):
+            idx.delete(np.arange(0, 80))
+            assert swapped.wait(10.0), "compactor never fired"
+        assert idx.tombstone_fraction() == 0.0
+
+    def test_compactor_error_surfaces_at_stop(self, res, monkeypatch):
+        db, idx = _mk(res)
+        monkeypatch.setattr(idx, "compact",
+                            lambda **kw: (_ for _ in ()).throw(
+                                ValueError("boom")))
+        c = Compactor(idx, interval=0.01, tombstone_frac=0.2,
+                      refit=False)
+        idx.delete(np.arange(0, 80))
+        c.start()
+        deadline = time.monotonic() + 10.0
+        while c._error is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(StreamingError, match="compactor failed"):
+            c.stop()
+
+    def test_double_start_raises(self, res):
+        db, idx = _mk(res)
+        c = Compactor(idx, interval=60.0, refit=False)
+        try:
+            c.start()
+            with pytest.raises(StreamingError, match="already started"):
+                c.start()
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# drift + refit
+# ---------------------------------------------------------------------------
+
+
+class TestDrift:
+    def test_gauge_ratio_and_trigger(self):
+        g = DriftGauge(threshold=1.5, alpha=1.0)
+        assert g.ratio == 1.0 and not g.triggered
+        g.set_baseline(2.0)
+        assert g.observe_batch(2.0) == pytest.approx(1.0)
+        assert not g.triggered
+        assert g.observe_batch(4.0) == pytest.approx(2.0)
+        assert g.triggered
+
+    def test_refit_moves_centroids_and_keeps_ids(self, res):
+        db, idx = _mk(res)
+        before = np.asarray(idx.flat.centroids).copy()
+        shifted = _rows(96, seed=21) + 4.0
+        idx.insert(shifted)
+        epoch0 = idx.epoch
+        assert idx.maybe_refit(force=True) is True
+        assert idx.epoch > epoch0
+        assert not np.array_equal(before,
+                                  np.asarray(idx.flat.centroids))
+        rows, ids = idx.live_rows()
+        assert rows.shape[0] == idx.n_live == 256
+        np.testing.assert_array_equal(ids, np.arange(256))
+        # the refitted quantizer still serves every live row exactly
+        _, got = idx.search(shifted[:8], k=1,
+                            nprobe=idx.flat.n_lists)
+
+    def test_drift_triggered_refit_resets_baseline(self, res):
+        db, idx = _mk(res, drift=DriftGauge(threshold=1.5, alpha=1.0))
+        assert idx.maybe_refit() is False
+        for s in range(4):
+            idx.insert(_rows(48, seed=30 + s) + 6.0)
+        assert idx.drift.triggered
+        assert idx.maybe_refit() is True
+        assert not idx.drift.triggered, \
+            "refit must reset the drift baseline"
+
+
+# ---------------------------------------------------------------------------
+# MNMG: routed ingest + rebalance
+# ---------------------------------------------------------------------------
+
+
+class TestMnmg:
+    def test_nearest_route_matches_single_rank_bits(self, res):
+        db, idx = _mk(res, n=256)
+        sm = StreamingMnmg(idx, n_ranks=2)
+        sm.insert(_rows(24))
+        sm.delete(np.arange(0, 40, 5))
+        q = db[:12]
+        sd, si = idx.search(q, k=6, nprobe=7)
+        md, mi = sm.search(res, q, k=6, nprobe=7)
+        np.testing.assert_array_equal(np.asarray(sd), np.asarray(md))
+        np.testing.assert_array_equal(np.asarray(si), np.asarray(mi))
+        # exact path delegates to the streaming live-row brute force
+        sd, si = idx.search(q, k=6, nprobe=8)
+        md, mi = sm.search(res, q, k=6, nprobe=8)
+        np.testing.assert_array_equal(np.asarray(si), np.asarray(mi))
+
+    def test_load_route_placement_is_journaled(self, res, tmp_path):
+        db, idx = _mk(res, n=256, directory=str(tmp_path))
+        sm = StreamingMnmg(idx, n_ranks=2, route="load", slack=2.0)
+        for s in range(3):
+            sm.insert(_rows(32, seed=40 + s))
+        sizes = np.asarray(idx.flat.sizes, np.int64)
+        rec = StreamingIndex.recover(res, str(tmp_path))
+        assert rec.content_crc() == idx.content_crc()
+        np.testing.assert_array_equal(
+            sizes, np.asarray(rec.flat.sizes, np.int64))
+        # exact search is placement-independent: every row it inserted
+        # is its own nearest neighbor regardless of the routed list
+        probe = _rows(32, seed=40)
+        _, got = sm.search(res, probe, k=1, nprobe=idx.flat.n_lists)
+        np.testing.assert_array_equal(np.asarray(got)[:, 0],
+                                      np.arange(256, 288))
+
+    def test_invalid_route_rejected(self, res):
+        db, idx = _mk(res)
+        with pytest.raises(ValueError, match="route"):
+            StreamingMnmg(idx, n_ranks=2, route="random")
+
+    def test_rebalance_compacts_and_reshards(self, res):
+        db, idx = _mk(res, n=256)
+        sm = StreamingMnmg(idx, n_ranks=2)
+        sm.insert(_rows(24))
+        sm.delete(np.arange(0, 80))
+        crc = idx.content_crc()
+        epoch0 = idx.epoch
+        sm.rebalance()
+        assert idx.epoch > epoch0
+        assert idx.content_crc() == crc
+        assert int(sm.rank_loads().sum()) == idx.flat.n_db
+        q = db[100:108]
+        sd, si = idx.search(q, k=6, nprobe=7)
+        md, mi = sm.search(res, q, k=6, nprobe=7)
+        np.testing.assert_array_equal(np.asarray(si), np.asarray(mi))
+
+
+# ---------------------------------------------------------------------------
+# serving: StreamingKnnService + IngestController
+# ---------------------------------------------------------------------------
+
+
+class TestServe:
+    @pytest.fixture
+    def controller(self, res):
+        from raft_tpu import serve
+
+        db, idx = _mk(res, n=256, repack_slack=64)
+        idx.compact(reason="provision")
+        svc = serve.StreamingKnnService(idx, k=5, nprobe=7)
+        ctl = serve.IngestController(
+            idx, [svc],
+            policy=serve.BatchPolicy(max_batch=8, max_wait_ms=2.0),
+            compact_interval=30.0, refit=False, warm_buckets=[8])
+        with ctl:
+            yield db, idx, svc, ctl
+
+    def test_batched_serve_matches_direct_search_bits(self, controller):
+        db, idx, svc, ctl = controller
+        q = db[:4]
+        d, i = ctl.submit(svc.name, q).result(timeout=30.0)
+        ed, ei = idx.search(q, k=5, nprobe=7)
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(ed))
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ei))
+
+    def test_same_shape_swap_serves_immediately(self, controller):
+        db, idx, svc, ctl = controller
+        q = db[7:11]
+        _, i0 = ctl.submit(svc.name, q).result(timeout=30.0)
+        assert 7 in np.asarray(i0)
+        swaps0, epoch0 = ctl.swaps, svc.serve_epoch
+        ctl.delete([7])
+        assert ctl.swaps == swaps0 and svc.serve_epoch == epoch0, \
+            "a delete is a same-shape swap — no epoch bump"
+        assert ctl.refreshes >= 1
+        _, i1 = ctl.submit(svc.name, q).result(timeout=30.0)
+        assert 7 not in np.asarray(i1)
+
+    def test_shape_changing_swap_prewarms_then_serves(self, controller):
+        db, idx, svc, ctl = controller
+        swaps0 = ctl.swaps
+        new = _rows(700, seed=51)
+        ids = ctl.insert(new)
+        assert ctl.swaps > swaps0, "an overflow repack must bump the epoch"
+        q = new[:4]
+        d, i = ctl.submit(svc.name, q).result(timeout=30.0)
+        ed, ei = idx.search(q, k=5, nprobe=7)
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(ed))
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ei))
+        # a post-swap build evicts executables stranded on dead epochs
+        ctl.executor._get_executable(svc, 16)
+        stale = [key for key in ctl.executor._executables
+                 if key[0] == svc.name and key[1] < svc.serve_epoch]
+        assert not stale
+
+    def test_prepare_publish_protocol(self, controller):
+        db, idx, svc, ctl = controller
+        assert svc.prepare() is None, "serving snapshot already current"
+        idx.delete([3])                       # direct: bypass controller
+        pending, version = svc.prepare()
+        assert pending[0] == svc.serve_epoch  # same shapes, same epoch
+        assert svc.publish(pending, version) is False
+        idx.insert(_rows(700, seed=52))       # overflow: shapes change
+        pending, version = svc.prepare()
+        assert pending[0] == svc.serve_epoch + 1
+        assert svc.publish(pending, version) is True
+
+    def test_validation(self, res):
+        from raft_tpu import serve
+
+        db, idx = _mk(res)
+        with pytest.raises(ValueError, match="nprobe"):
+            serve.StreamingKnnService(idx, k=4, nprobe=8)
+        db2, idx2 = _mk(res, seed=9)
+        svc = serve.StreamingKnnService(idx2, k=4, nprobe=7)
+        with pytest.raises(ValueError, match="different"):
+            serve.IngestController(idx, [svc])
+
+    def test_streaming_loop_recall_floor_across_swaps(self, res):
+        """The CI gate's witness in miniature: sustained ingest +
+        deletes racing concurrent queries through at least one
+        shape-changing swap, recall scored per query against an exact
+        reference over the snapshot window it was served from."""
+        from raft_tpu import serve
+
+        db, idx = _mk(res, n=256, repack_slack=48)
+        idx.compact(reason="provision")
+        svc = serve.StreamingKnnService(idx, k=5, nprobe=7)
+        ctl = serve.IngestController(
+            idx, [svc],
+            policy=serve.BatchPolicy(max_batch=8, max_wait_ms=2.0),
+            compact_interval=0.05, refit=False, warm_buckets=[8])
+        with ctl:
+            rep = serve.streaming_loop(
+                ctl, svc.name, clients=3, rows=4, duration_s=2.0,
+                ingest_rows=48, ingest_interval_s=0.02,
+                delete_frac=0.3, seed=1)
+        assert rep.failed == 0
+        assert rep.queries > 0 and rep.ingest_batches >= 2
+        assert rep.swaps >= 1, "the run must cross a shape swap"
+        assert rep.min_recall >= 0.5, rep.as_dict()
+        assert rep.mean_recall >= 0.85, rep.as_dict()
+        assert rep.n_live_final == idx.n_live
+
+
+# ---------------------------------------------------------------------------
+# env knobs (satellite b)
+# ---------------------------------------------------------------------------
+
+
+class TestEnvKnobs:
+    @pytest.mark.parametrize("name,bad,good,parsed", [
+        ("RAFT_TPU_COMPACT_TOMBSTONE_FRAC", "1.5", "0.4", 0.4),
+        ("RAFT_TPU_COMPACT_INTERVAL", "-1", "0.5", 0.5),
+        ("RAFT_TPU_DRIFT_THRESHOLD", "0.5", "3.0", 3.0),
+    ])
+    def test_registered_fail_loud(self, monkeypatch, name, bad, good,
+                                  parsed):
+        monkeypatch.setenv(name, bad)
+        with pytest.raises(ValueError, match=name):
+            env.read(name)
+        monkeypatch.setenv(name, good)
+        assert env.read(name) == parsed
+
+    def test_compactor_and_gauge_read_knobs(self, res, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_COMPACT_INTERVAL", "7.5")
+        monkeypatch.setenv("RAFT_TPU_COMPACT_TOMBSTONE_FRAC", "0.45")
+        monkeypatch.setenv("RAFT_TPU_DRIFT_THRESHOLD", "4.0")
+        db, idx = _mk(res)
+        c = Compactor(idx)
+        assert c.interval == 7.5 and c.tombstone_frac == 0.45
+        assert DriftGauge().threshold == 4.0
