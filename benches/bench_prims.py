@@ -1476,6 +1476,156 @@ def bench_streaming_ingest():
     return out
 
 
+# -- durable streaming fleet (ISSUE 18; no cpp/bench analogue — the rows
+#    witness WAL shipping, scrub/read-repair and drift maintenance) -------
+
+@bench("serve/durability")
+def bench_durability():
+    """BENCH_ERA=18 durability rows for the replicated streaming fleet.
+
+    * ``serve/durability_catchup_d{64,256}`` — wall-clock for a
+      restarted follower to fold a WAL backlog of that depth through
+      :meth:`WalFollower.catch_up` (the restart-to-converged time the
+      mid-stream SIGKILL witness measures end-to-end), with the
+      content-CRC bit-equality witness and ``snapshot: false`` proving
+      the records path (not a resync) was measured.
+    * ``serve/durability_scrub`` — one clean scrub pass over a
+      journaled directory (the steady-state background cost), plus the
+      ``detect_repair_ok`` witness: a seeded bit-flip in the newest
+      epoch is quarantined + repaired and the next pass is clean.
+    * ``serve/durability_drift_{stream,rebuild}`` — time-to-accuracy
+      under distribution drift: maintenance wall-clock (streaming
+      ``maybe_refit`` per batch vs one full rebuild at the end) against
+      the recall@k each strategy holds mid-stream and finally, at an
+      nprobe where quantizer quality matters.
+
+    Rows stamp ``partial: true`` off-TPU: CPU wall-clock smoke of the
+    full code path, not an accelerator claim."""
+    import tempfile
+    import time
+
+    from benches.harness import BenchResult
+    from raft_tpu.comms.comms import _Mailbox
+    from raft_tpu.comms.faults import FaultInjector
+    from raft_tpu.neighbors.scrub import Scrubber
+    from raft_tpu.neighbors.streaming import stream_build
+    from raft_tpu.neighbors.wal_ship import (WalFollower, WalShipper,
+                                             bootstrap_follower)
+
+    full = jax.default_backend() == "tpu"
+    partial = {} if full else {"partial": True}
+    rng = np.random.default_rng(18)
+    dim, n_lists = 16, 16
+    db = rng.standard_normal((2048, dim)).astype(np.float32)
+    out = []
+
+    # -- catch-up vs WAL depth (deletes: in-place records, never
+    #    folded into an epoch mid-bench, so the backlog depth holds) --
+    with tempfile.TemporaryDirectory() as d:
+        leader = stream_build(None, db, n_lists, seed=0, max_iter=8,
+                              directory=d)
+        mbx = _Mailbox()
+        shipper = WalShipper(leader, mbx, 0, [1],
+                             poll_interval=0.005).attach()
+        shipper.start()
+        # one seeded mutation: a fresh build sits at cursor −1, and a
+        # follower asking "from 0" is indistinguishable from a blank
+        # bootstrap — it would snapshot-resync instead of exercising
+        # the records path this row is supposed to measure
+        leader.delete(leader.live_rows()[1][:1])
+        try:
+            for depth in (64, 256):
+                wf = WalFollower(bootstrap_follower(
+                    None, dim=dim, n_lists=n_lists), mbx, 1, 0)
+                wf.catch_up(timeout=60.0)          # baseline resync
+                live = leader.live_rows()[1]
+                for i in range(depth):             # the WAL backlog
+                    leader.delete(live[i:i + 1])
+                t0 = time.perf_counter()
+                rpt = wf.catch_up(timeout=60.0)
+                wall_ms = (time.perf_counter() - t0) * 1e3
+                out.append(BenchResult(
+                    name=f"serve/durability_catchup_d{depth}",
+                    repeats=1, median_ms=wall_ms, best_ms=wall_ms,
+                    params=dict(partial, wal_depth=depth,
+                                records=rpt.records,
+                                snapshot=rpt.snapshot,
+                                crc_match=wf.index.content_crc()
+                                == leader.content_crc())))
+                # undo the tombstones so the next depth has live rows
+                leader.compact(reason="bench_reset")
+                wf.catch_up(timeout=60.0)
+        finally:
+            shipper.stop()
+            shipper.detach()
+
+    # -- scrub pass cost + detect/repair witness ----------------------
+    with tempfile.TemporaryDirectory() as d:
+        idx = stream_build(None, db, n_lists, seed=0, max_iter=8,
+                           directory=d)
+        ids = idx.insert(rng.standard_normal(
+            (256, dim)).astype(np.float32))
+        idx.delete(ids[::5])
+        sc = Scrubber(idx, interval=60.0)
+        t0 = time.perf_counter()
+        clean = sc.run_once()
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        newest = idx.log.epoch_path(max(idx.log.epoch_steps()))
+        FaultInjector().corrupt_bytes(newest)
+        hit = sc.run_once()
+        ok = (bool(hit.quarantined) and bool(hit.repaired)
+              and not sc.run_once().corrupt)
+        out.append(BenchResult(
+            name="serve/durability_scrub", repeats=1,
+            median_ms=wall_ms, best_ms=wall_ms,
+            params=dict(partial, files_checked=clean.files_checked,
+                        detect_repair_ok=ok)))
+
+    # -- time-to-accuracy under drift: streaming refit vs rebuild -----
+    def _recall(idx, q, k, nprobe):
+        _, exact = idx.search(q, k, idx.flat.n_lists)   # exact path
+        _, got = idx.search(q, k, nprobe)
+        hits = sum(len(np.intersect1d(got[i], exact[i]))
+                   for i in range(q.shape[0]))
+        return hits / float(q.shape[0] * k)
+
+    k, nprobe, n_batches = 10, 3, 6
+    base = rng.standard_normal((1024, dim)).astype(np.float32)
+    shift = np.full((dim,), 4.0, np.float32)           # the drift
+    batches = [(rng.standard_normal((128, dim)) + shift * (b + 1)
+                / n_batches).astype(np.float32)
+               for b in range(n_batches)]
+    queries = (rng.standard_normal((32, dim))
+               + shift).astype(np.float32)             # post-drift load
+
+    for mode in ("stream", "rebuild"):
+        idx = stream_build(None, base, n_lists, seed=0, max_iter=8)
+        maintain_s, refits, recall_mid = 0.0, 0, 1.0
+        for b, batch in enumerate(batches):
+            idx.insert(batch)
+            if mode == "stream":
+                t0 = time.perf_counter()
+                refits += bool(idx.maybe_refit(force=True))
+                maintain_s += time.perf_counter() - t0
+            if b == n_batches - 1:                     # mid = pre-fix
+                recall_mid = _recall(idx, queries, k, nprobe)
+        if mode == "rebuild":
+            rows, _ = idx.live_rows()
+            t0 = time.perf_counter()
+            idx = stream_build(None, np.asarray(rows), n_lists,
+                               seed=0, max_iter=8)
+            maintain_s += time.perf_counter() - t0
+            refits = 1
+        out.append(BenchResult(
+            name=f"serve/durability_drift_{mode}", repeats=1,
+            median_ms=maintain_s * 1e3, best_ms=maintain_s * 1e3,
+            params=dict(partial, refits=refits,
+                        recall_mid=round(recall_mid, 4),
+                        recall_final=round(_recall(idx, queries, k,
+                                                   nprobe), 4))))
+    return out
+
+
 # -- stats (ref: bench/prims/stats/*.cu — the domain had no bench family
 #    until round 3; the round-2 verdict flagged zero on-TPU stats numbers) --
 

@@ -59,7 +59,15 @@ import jax
 # live ingest stream and snapshot swaps in the loop), so they are not
 # comparable to any static ivf_search row; rows carry the lifecycle
 # witnesses (swaps, recall floor, crc_match) the CI gates assert on.
-BENCH_ERA = 17
+# Era 18: the durable streaming fleet (neighbors/wal_ship.py +
+# neighbors/scrub.py) adds WAL shipping, checkpointed replica restart
+# and scrub/read-repair. The serve/durability family's rows measure
+# follower catch-up latency vs WAL depth, scrub pass cost, and the
+# time-to-accuracy tradeoff of streaming maybe_refit vs periodic full
+# rebuild under distribution drift; rows carry the durability
+# witnesses (crc_match, detect_repair_ok, recall floors) the CI gates
+# assert on.
+BENCH_ERA = 18
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

@@ -1665,4 +1665,125 @@ JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$STREAM_ROWS" \
 rm -f "$STREAM_ROWS"
 echo "streaming sentry: fresh current-era rows clear the shipped baseline"
 
+# Durable-fleet restart chaos gate (ISSUE 18 acceptance): a three-role
+# witness — a clean never-killed run, a leader streaming mutations over
+# real TCP WAL shipping, and a follower SIGKILL'd mid-stream that
+# restarts from its mirrored journal and catches up UNDER QUERY LOAD.
+# The orchestrator asserts the follower resumed from a mid-stream
+# cursor, converged past the target sequence, held the recall floor
+# while catching up, and landed content-CRC bit-equal to both the
+# leader and the clean twin.
+DUR_OUT=$(JAX_PLATFORMS=cpu python tests/_durability_worker.py orchestrate) \
+    || { echo "durability orchestrator exited rc=$?" >&2; exit 1; }
+echo "$DUR_OUT" | grep -q "DURABILITY_CHAOS_OK" || {
+    echo "durability chaos gate failed:" >&2
+    echo "$DUR_OUT" >&2
+    exit 1
+}
+echo "durability chaos: $(echo "$DUR_OUT" | grep DURABILITY_CHAOS_OK)"
+
+# Scrub + read-repair gate (ISSUE 18): a seeded bit-flip in the newest
+# epoch snapshot must be DETECTED (container CRC), QUARANTINED (renamed
+# out of every recovery walk), and REPAIRED (fresh epoch rewritten from
+# the healthy live index) — and with no healthy source the damage must
+# surface as the typed ShardCorruptError, never a silent serve.
+JAX_PLATFORMS=cpu python - <<'PYEOF2'
+import os
+import tempfile
+
+import numpy as np
+
+from raft_tpu.comms.faults import FaultInjector
+from raft_tpu.neighbors.scrub import Scrubber
+from raft_tpu.neighbors.streaming import (MutationLog, ShardCorruptError,
+                                          StreamingIndex, _epoch_entries,
+                                          stream_build)
+
+rng = np.random.default_rng(5)
+db = rng.normal(size=(256, 8)).astype(np.float32)
+with tempfile.TemporaryDirectory() as d:
+    idx = stream_build(None, db, 8, seed=0, max_iter=4, directory=d)
+    ids = idx.insert(rng.normal(size=(32, 8)).astype(np.float32))
+    idx.delete(ids[::4])
+    crc = idx.content_crc()
+    newest = idx.log.epoch_path(max(idx.log.epoch_steps()))
+    FaultInjector().corrupt_bytes(newest)
+    sc = Scrubber(idx, interval=60.0)
+    rep = sc.run_once()
+    assert rep.corrupt and rep.quarantined and rep.repaired, vars(rep)
+    assert os.path.exists(newest + ".quarantined"), "not quarantined"
+    assert not sc.run_once().corrupt, "repair did not restore redundancy"
+    rec = StreamingIndex.recover(None, d)
+    assert rec.content_crc() == crc, "repaired journal not bit-equal"
+    # unrepairable: a lone corrupt epoch with no healthy source
+    cold = os.path.join(d, "cold")
+    log = MutationLog(cold)
+    log.write_epoch(0, _epoch_entries(idx))
+    FaultInjector().corrupt_bytes(log.epoch_path(0))
+    try:
+        Scrubber(log=log, interval=60.0).run_once()
+    except ShardCorruptError as e:
+        print(f"scrub gate: bit-flip quarantined + repaired bit-equal "
+              f"(crc {crc}); unrepairable raised typed {type(e).__name__}")
+    else:
+        raise SystemExit("unrepairable damage did not raise")
+PYEOF2
+
+# Durability bench sentry (ISSUE 18): the serve/durability family must
+# run on the CPU tier with every row stamped the current era + partial
+# and carrying its witnesses (catch-up CRC bit-equal over the records
+# path, scrub detect/repair, drift recall floors), and the fresh rows
+# must clear the sentry against the shipped baseline (per-family
+# tolerance 3.0: live-loop rows drift between container sessions).
+DUR_ROWS=$(mktemp /tmp/dur_rows.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python benches/run_benches.py \
+    --family serve/durability > "$DUR_ROWS"
+python - "$DUR_ROWS" <<'PYEOF2'
+import json
+import sys
+
+from benches.harness import BENCH_ERA
+
+rows = {}
+with open(sys.argv[1]) as fh:
+    for line in fh:
+        line = line.strip()
+        if line:
+            row = json.loads(line)
+            if "bench" in row and row.get("median_ms") is not None:
+                rows[row["bench"]] = row
+
+expected = {"serve/durability_catchup_d64",
+            "serve/durability_catchup_d256",
+            "serve/durability_scrub",
+            "serve/durability_drift_stream",
+            "serve/durability_drift_rebuild"}
+missing = expected - set(rows)
+assert not missing, f"durability family dropped rows: {missing}"
+for name, row in rows.items():
+    assert row["era"] == BENCH_ERA, (name, row.get("era"))
+    assert row.get("partial") is True, \
+        f"{name}: CPU proxy row must stamp partial"
+for d in (64, 256):
+    cu = rows[f"serve/durability_catchup_d{d}"]
+    assert cu["crc_match"] is True, cu
+    assert cu["snapshot"] is False and cu["records"] == d, cu
+sc = rows["serve/durability_scrub"]
+assert sc["detect_repair_ok"] is True, sc
+st = rows["serve/durability_drift_stream"]
+assert st["recall_final"] >= 0.9, st
+print(f"durability bench: {len(rows)} era-{BENCH_ERA} rows (catch-up "
+      f"{rows['serve/durability_catchup_d256']['median_ms']:.0f} ms @ "
+      f"depth 256 crc bit-equal, scrub detect/repair ok, drift recall "
+      f"{st['recall_mid']}/{st['recall_final']})")
+PYEOF2
+JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$DUR_ROWS" \
+    --family-tol serve/durability_catchup_d64=3.0 \
+    --family-tol serve/durability_catchup_d256=3.0 \
+    --family-tol serve/durability_scrub=3.0 \
+    --family-tol serve/durability_drift_stream=3.0 \
+    --family-tol serve/durability_drift_rebuild=3.0 >/dev/null
+rm -f "$DUR_ROWS"
+echo "durability sentry: fresh current-era rows clear the shipped baseline"
+
 echo "smoke: PASS"

@@ -685,7 +685,11 @@ def _minibatch_chunk(x, valid, carry, steps, *, n_clusters: int,
 
 @with_matmul_precision
 def kmeans_partial_fit(res, centroids, batch, *, counts=None,
-                       chunk_rows: int = 256, sync_every=None
+                       chunk_rows: int = 256, sync_every=None,
+                       checkpoint_dir: Optional[str] = None,
+                       checkpoint_every: Optional[int] = None,
+                       checkpoint_keep: int = 2,
+                       resume_from: Optional[str] = None
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One mini-batch k-means pass over ``batch`` (Sculley 2010): nudge
     ``centroids`` toward the stream without a full refit. Returns
@@ -698,7 +702,17 @@ def kmeans_partial_fit(res, centroids, batch, *, counts=None,
     compiled-driver chunk runner, so the streaming refit inherits the
     driver's checkpoint/deadline/trace boundary hooks for free — the
     ISSUE-17 drift loop calls this under a serving deadline and a
-    mid-refit SIGKILL costs at most one chunk of progress."""
+    mid-refit SIGKILL costs at most one chunk of progress.
+
+    ``checkpoint_every`` (in boundary units; requires
+    ``checkpoint_dir``) saves ``(centroids, counts, chunk)`` at chunk
+    boundaries through :class:`~raft_tpu.core.checkpoint
+    .CheckpointManager` (prefix ``kmeans_pf``, newest
+    ``checkpoint_keep`` kept), and ``resume_from`` (a checkpoint file
+    or directory) restarts mid-batch from the saved chunk cursor — the
+    SAME ``batch`` must be passed again, since the cursor indexes into
+    it (the streaming refit replays its reservoir, which recovery
+    reconstructs exactly)."""
     from raft_tpu.runtime import compiled_driver, limits
 
     centroids = jnp.asarray(centroids)
@@ -738,11 +752,55 @@ def kmeans_partial_fit(res, centroids, batch, *, counts=None,
     est = limits.estimate_seconds("cluster.lloyd_step", **dims)
     sf, sb = limits.estimate_flops_bytes("cluster.lloyd_step", **dims)
     sync = compiled_driver.resolve_sync_every(sync_every)
-    carry = (centroids, counts, jnp.asarray(0, jnp.int32))
+
+    import numpy as np
+
+    manager = None
+    if checkpoint_every is not None:
+        if checkpoint_dir is None:
+            raise ValueError("checkpoint_every requires checkpoint_dir")
+        from raft_tpu.core import checkpoint as core_ckpt
+
+        manager = core_ckpt.CheckpointManager(
+            checkpoint_dir, prefix="kmeans_pf", keep=checkpoint_keep)
+    start_chunk = 0
+    if resume_from is not None:
+        entries = _load_kmeans_checkpoint(resume_from,
+                                          prefix="kmeans_pf")
+        centroids = jnp.asarray(np.asarray(entries["centroids"]),
+                                centroids.dtype)
+        counts = jnp.asarray(np.asarray(entries["counts"]),
+                             jnp.float32)
+        start_chunk = int(entries["chunk"])
+        if start_chunk > n_chunks:
+            raise ValueError(
+                f"resume_from chunk {start_chunk} beyond this batch's "
+                f"{n_chunks} chunks — pass the SAME batch the "
+                "checkpoint was cut from")
+
+    boundary = None
+    if manager is not None:
+        stride = sync * max(1, int(checkpoint_every))
+        last_saved = [start_chunk if resume_from is not None else -1]
+
+        def boundary(cr, steps_done, done_flag):
+            if steps_done > 0 and (
+                    steps_done - max(last_saved[0], 0) >= stride
+                    or ((done_flag or steps_done >= n_chunks)
+                        and steps_done != last_saved[0])):
+                manager.save(steps_done, {
+                    "centroids": np.asarray(cr[0]),
+                    "counts": np.asarray(cr[1]),
+                    "chunk": int(steps_done),
+                })
+                last_saved[0] = steps_done
+
+    carry = (centroids, counts, jnp.asarray(start_chunk, jnp.int32))
     carry, n_steps, _ = compiled_driver.run_chunked(
         chunk_call, carry, max_steps=n_chunks, sync_every=sync,
-        op="cluster.kmeans_partial_fit", est_step_seconds=est,
-        step_flops=sf, step_bytes=sb)
+        op="cluster.kmeans_partial_fit", steps_done=start_chunk,
+        est_step_seconds=est, step_flops=sf, step_bytes=sb,
+        boundary=boundary)
     trace.record_event("kmeans.partial_fit", rows=n,
                        n_clusters=n_clusters, chunks=int(n_steps),
                        chunk_rows=chunk_rows)

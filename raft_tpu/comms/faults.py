@@ -183,6 +183,32 @@ class FaultInjector:
             os.kill(os.getpid(), signal.SIGKILL)
         raise CrashPointError(name)
 
+    def corrupt_bytes(self, path, *, offset: Optional[int] = None) -> int:
+        """Deterministically flip one byte of the file at ``path`` —
+        the seeded at-rest bit-flip the scrub/read-repair witnesses
+        inject (ISSUE 18). The default offset is the middle byte: past
+        any container magic/header, so detection exercises the
+        per-entry CRC verification, not the cheap magic check. Like
+        :meth:`stall` and :meth:`arm_crash` this consumes no RNG rolls —
+        corrupting a file never perturbs a probabilistic fault
+        schedule. Returns the flipped offset."""
+        path = os.fspath(path)
+        with open(path, "rb") as f:
+            raw = bytearray(f.read())
+        if not raw:
+            raise ValueError(f"cannot corrupt empty file {path!r}")
+        off = len(raw) // 2 if offset is None else int(offset)
+        if not 0 <= off < len(raw):
+            raise ValueError(f"offset {off} outside file of {len(raw)} "
+                             f"bytes")
+        raw[off] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(raw)
+        with self._lock:
+            self.counts["corrupt_file"] += 1
+        trace.record_event("faults.corrupt_bytes", path=path, offset=off)
+        return off
+
     def stall(self, seconds: float) -> None:
         """Arm the latency-spike mode: every subsequent in-scope send
         sleeps at least ``seconds`` before delivery (0 disarms).

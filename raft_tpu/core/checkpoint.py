@@ -161,11 +161,18 @@ def load_checkpoint(stream) -> Dict[str, Any]:
 
 
 def save_checkpoint(path: Union[str, os.PathLike],
-                    entries: Dict[str, Any]) -> None:
+                    entries: Dict[str, Any], *,
+                    pre_replace: Optional[Any] = None) -> None:
     """Atomically write ``entries`` to ``path``: the bytes land in
     ``<path>.tmp`` first and are renamed into place only after a
     successful flush+fsync, so readers only ever see complete
-    checkpoints (a writer killed mid-save leaves the previous file)."""
+    checkpoints (a writer killed mid-save leaves the previous file).
+
+    ``pre_replace`` (a zero-arg callable) runs BETWEEN the fsynced temp
+    file and the rename — the torn-state window a crash-consistency
+    witness must be able to die in (the streaming epoch protocol arms
+    its ``compact.mid_write`` crash point here); a kill inside it
+    leaves only ``.tmp`` debris, which no reader ever opens."""
     path = os.fspath(path)
     tmp = path + ".tmp"
     t0 = time.monotonic()
@@ -174,6 +181,8 @@ def save_checkpoint(path: Union[str, os.PathLike],
         f.flush()
         os.fsync(f.fileno())
         nbytes = f.tell()
+    if pre_replace is not None:
+        pre_replace()
     os.replace(tmp, path)
     if obs.enabled():
         obs.inc("checkpoint_bytes_written_total", nbytes)
@@ -213,11 +222,18 @@ class CheckpointManager:
         return os.path.join(self.directory,
                             f"{self.prefix}-{int(step):08d}.ckpt")
 
-    def save(self, step: int, entries: Dict[str, Any]) -> str:
+    def save(self, step: int, entries: Dict[str, Any], *,
+             pre_replace: Optional[Any] = None) -> str:
         path = self.path_for(step)
-        save_checkpoint(path, entries)
+        save_checkpoint(path, entries, pre_replace=pre_replace)
         self._prune()
         return path
+
+    def verify(self, step: int) -> None:
+        """Parse the step's file end to end (every entry CRC checked),
+        raising the typed :class:`CheckpointError` taxonomy on damage —
+        the scrub walk's per-file primitive."""
+        restore_checkpoint(self.path_for(step))
 
     def steps(self) -> List[int]:
         out = []

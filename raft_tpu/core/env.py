@@ -334,6 +334,20 @@ register("RAFT_TPU_DRIFT_THRESHOLD", _parse_ratio_ge1, 2.0,
               "EMA of ingested rows' nearest-centroid distance exceeds "
               "this multiple of the build-time baseline (>= 1.0)")
 
+# Durable-fleet knobs (ISSUE 18): fail-loud like the rest of the
+# streaming family — a typo'd retention must never silently become
+# "keep everything" (disk fills) or "keep one" (a torn newest-epoch
+# write would leave nothing to fall back to), and a typo'd scrub
+# interval must not silently disable at-rest corruption detection.
+register("RAFT_TPU_WAL_RETAIN", _parse_pos_int, 2,
+         help="epoch snapshots the streaming MutationLog retains "
+              "(>= 1); older snapshots and the WAL records they fold "
+              "are pruned at each epoch commit")
+register("RAFT_TPU_SCRUB_INTERVAL", _parse_pos_float, 1.0,
+         help="background scrubber pass interval in seconds (> 0); "
+              "each pass re-verifies every epoch/WAL container CRC "
+              "and the in-memory packed-list sidecar")
+
 # Overload-resilience toggles (ISSUE 16): degrade to the conservative
 # setting (on) with a warning — resilience must not vanish on a typo.
 register("RAFT_TPU_BROWNOUT", _parse_onoff, True, on_malformed="warn",

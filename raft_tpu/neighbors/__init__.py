@@ -12,22 +12,33 @@ O(n) scan into probes over a few lists.
 
 from raft_tpu.neighbors import ivf_flat  # noqa: F401
 from raft_tpu.neighbors import ivf_mnmg  # noqa: F401
+from raft_tpu.neighbors import scrub  # noqa: F401
 from raft_tpu.neighbors import streaming  # noqa: F401
+from raft_tpu.neighbors import wal_ship  # noqa: F401
 from raft_tpu.neighbors.brute_force import knn, knn_mnmg  # noqa: F401
 from raft_tpu.neighbors.ivf_flat import IvfFlatIndex  # noqa: F401
 from raft_tpu.neighbors.ivf_mnmg import (IvfMnmgIndex,  # noqa: F401
                                          build_mnmg, rebalance_mnmg,
                                          search_mnmg, shrink_mnmg)
+from raft_tpu.neighbors.scrub import Scrubber, ScrubReport  # noqa: F401
 from raft_tpu.neighbors.streaming import (Compactor,  # noqa: F401
                                           DriftGauge, MutationLog,
                                           RecoveryError,
+                                          ShardCorruptError,
                                           StreamingError,
                                           StreamingIndex,
-                                          StreamingMnmg, stream_build)
+                                          StreamingMnmg, WalGapError,
+                                          stream_build)
+from raft_tpu.neighbors.wal_ship import (CatchupReport,  # noqa: F401
+                                         WalFollower, WalShipper,
+                                         bootstrap_follower)
 
 __all__ = ["knn", "knn_mnmg", "ivf_flat", "IvfFlatIndex",
            "ivf_mnmg", "IvfMnmgIndex", "build_mnmg", "search_mnmg",
            "shrink_mnmg", "rebalance_mnmg",
            "streaming", "StreamingIndex", "StreamingMnmg",
            "stream_build", "Compactor", "DriftGauge", "MutationLog",
-           "StreamingError", "RecoveryError"]
+           "StreamingError", "RecoveryError",
+           "wal_ship", "WalShipper", "WalFollower", "CatchupReport",
+           "bootstrap_follower", "WalGapError",
+           "scrub", "Scrubber", "ScrubReport", "ShardCorruptError"]

@@ -1,0 +1,258 @@
+"""Background scrub + read-repair for the streaming journal (ISSUE 18
+tentpole part 3).
+
+The WAL and epoch containers carry per-entry CRC32s, but PR 17 only
+ever *checked* them on the recovery path — damage sat latent until the
+worst possible moment (a restart). The scrubber moves detection to a
+background interval walk:
+
+- every epoch snapshot and WAL record on disk is re-parsed end to end
+  (every entry CRC checked) each pass;
+- a damaged file is QUARANTINED — renamed to ``<name>.quarantined`` so
+  no recovery walk ever reads it again — then repaired up a ladder:
+  the healthy in-memory index rewrites a fresh epoch snapshot
+  (durability restored from RAM), else a ``repair_source`` callback
+  fetches a healthy replica's epoch entries (the WAL-shipping fleet's
+  read-repair), else another intact epoch on disk already covers it;
+  when nothing on the ladder holds, the typed
+  :class:`~raft_tpu.neighbors.streaming.ShardCorruptError` surfaces —
+  corruption is never silently tolerated;
+- the in-memory packed state gets a sidecar check: each pass records
+  ``(snapshot version, CRC over packed_db ‖ packed_ids ‖ tombstones)``;
+  the same version reappearing with a different CRC means RAM damage
+  (nothing mutated, bytes changed) — repaired from ``repair_source``
+  or raised.
+
+Metered through obs: ``scrub_passes_total``,
+``scrub_corruptions_total{outcome=repaired|quarantined}``,
+``scrub_memory_repairs_total``. Injection for the witnesses comes from
+:meth:`raft_tpu.comms.faults.FaultInjector.corrupt_bytes`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import obs
+from raft_tpu.core import env, trace
+from raft_tpu.core.checkpoint import CheckpointError, restore_checkpoint
+from raft_tpu.neighbors.streaming import (MutationLog, ShardCorruptError,
+                                          StreamingError, StreamingIndex,
+                                          _WAL_RE)
+
+__all__ = ["Scrubber", "ScrubReport"]
+
+
+@dataclass
+class ScrubReport:
+    """What one scrub pass found and did."""
+
+    files_checked: int = 0
+    corrupt: List[str] = field(default_factory=list)
+    repaired: List[str] = field(default_factory=list)
+    quarantined: List[str] = field(default_factory=list)
+    memory_repaired: bool = False
+
+
+class Scrubber:
+    """Interval scrub walk over one streaming journal (and optionally
+    the in-memory packed state).
+
+    ``index`` gives the full ladder (in-memory rewrite + sidecar
+    check); ``log`` alone scrubs a cold directory (a dead replica's
+    journal before restart). ``repair_source`` is a zero-arg callable
+    returning a healthy replica's epoch entries — the WAL-shipping
+    fleet passes a leader snapshot fetch here. ``interval`` defaults to
+    the fail-loud ``RAFT_TPU_SCRUB_INTERVAL`` knob. Background worker
+    errors surface at :meth:`stop` (the Compactor discipline).
+    """
+
+    def __init__(self, index: Optional[StreamingIndex] = None, *,
+                 log: Optional[MutationLog] = None,
+                 interval: Optional[float] = None,
+                 repair_source: Optional[Callable[[], Dict]] = None):
+        if index is not None:
+            if log is not None and log is not index.log:
+                raise ValueError("pass index= OR log=, not both")
+            log = index.log
+        if log is None:
+            raise ValueError(
+                "scrubbing needs a journal: a journaled index= or an "
+                "explicit log=")
+        self.index = index
+        self.log = log
+        self.repair_source = repair_source
+        self.interval = float(env.read("RAFT_TPU_SCRUB_INTERVAL")
+                              if interval is None else interval)
+        if self.interval <= 0:
+            raise ValueError(f"interval must be > 0, got {self.interval}")
+        self.passes = 0
+        self.corruptions = 0
+        self._sidecar: Optional[Tuple[int, int]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    # -- one pass ------------------------------------------------------
+
+    def _walk(self) -> List[str]:
+        """Every journal container on disk: epoch snapshots (via the
+        manager's registry) then WAL records, ascending."""
+        paths = [self.log.epoch_path(s) for s in self.log.epoch_steps()]
+        paths += [os.path.join(self.log.directory, f)
+                  for f in sorted(os.listdir(self.log.directory))
+                  if _WAL_RE.match(f)]
+        return paths
+
+    def run_once(self) -> ScrubReport:
+        """One full scrub pass; returns the report. Raises
+        :class:`ShardCorruptError` when damage is found that NOTHING on
+        the repair ladder covers (the shard stays quarantined)."""
+        self.passes += 1
+        if obs.enabled():
+            obs.inc("scrub_passes_total")
+        report = ScrubReport()
+        for path in self._walk():
+            report.files_checked += 1
+            try:
+                restore_checkpoint(path)
+            except FileNotFoundError:
+                continue  # pruned between walk and verify — fine
+            except CheckpointError as exc:
+                self._handle_corrupt(path, str(exc), report)
+        self._check_memory(report)
+        trace.record_event("scrub.pass", files=report.files_checked,
+                           corrupt=len(report.corrupt),
+                           repaired=len(report.repaired))
+        return report
+
+    def _handle_corrupt(self, path: str, detail: str,
+                        report: ScrubReport) -> None:
+        name = os.path.basename(path)
+        self.corruptions += 1
+        report.corrupt.append(name)
+        # quarantine FIRST: the suffix stops every journal regex from
+        # matching, so no recovery walk can ever read the damage —
+        # repair then restores redundancy next to it
+        os.replace(path, path + ".quarantined")
+        report.quarantined.append(name)
+        trace.record_event("scrub.quarantine", file=name, error=detail)
+        repaired = False
+        if self.index is not None:
+            # the in-memory state is the authority while the process
+            # lives: rewrite the current epoch (folds the WAL too, so a
+            # damaged WAL record is also superseded)
+            with self.index._lock:
+                self.index._write_epoch_locked(crash=False)
+            repaired = True
+        elif self._intact_epoch_exists():
+            # redundancy already covers the loss: the newest intact
+            # epoch + surviving WAL reconstruct the state
+            repaired = True
+        elif self.repair_source is not None:
+            # cold directory (dead replica's journal): land a healthy
+            # peer's epoch entries as a fresh snapshot so the next
+            # recover() has something intact to restore
+            steps = self.log.epoch_steps()
+            self.log.write_epoch((max(steps) + 1) if steps else 0,
+                                 dict(self.repair_source()))
+            repaired = True
+        if obs.enabled():
+            obs.inc("scrub_corruptions_total",
+                    outcome="repaired" if repaired else "quarantined")
+        if repaired:
+            report.repaired.append(name)
+        else:
+            raise ShardCorruptError(
+                name, f"{detail} — no healthy index, repair source, or "
+                      "intact epoch to repair from")
+
+    def _intact_epoch_exists(self) -> bool:
+        for step in reversed(self.log.epoch_steps()):
+            try:
+                restore_checkpoint(self.log.epoch_path(step))
+                return True
+            except (CheckpointError, FileNotFoundError):
+                continue
+        return False
+
+    def _check_memory(self, report: ScrubReport) -> None:
+        """Sidecar check on the live packed state: same snapshot
+        version, different bytes ⇒ RAM damage (nothing mutated — the
+        version is bumped by every publish)."""
+        if self.index is None:
+            return
+        with self.index._lock:
+            snap = self.index.snapshot
+            crc = zlib.crc32(np.ascontiguousarray(
+                np.asarray(snap.flat.packed_db)).tobytes())
+            crc = zlib.crc32(np.ascontiguousarray(
+                np.asarray(snap.flat.packed_ids, np.int32)).tobytes(),
+                crc)
+            crc = zlib.crc32(np.ascontiguousarray(
+                self.index._tomb_host).tobytes(), crc)
+            version = snap.version
+        if self._sidecar is not None and self._sidecar[0] == version \
+                and self._sidecar[1] != crc:
+            self.corruptions += 1
+            trace.record_event("scrub.memory_corrupt", version=version)
+            if self.repair_source is None:
+                if obs.enabled():
+                    obs.inc("scrub_corruptions_total",
+                            outcome="quarantined")
+                raise ShardCorruptError(
+                    "memory", f"packed state changed under version "
+                              f"{version} with no mutation — RAM "
+                              "damage and no repair source")
+            self.index.install_snapshot(self.repair_source())
+            report.memory_repaired = True
+            if obs.enabled():
+                obs.inc("scrub_corruptions_total", outcome="repaired")
+                obs.inc("scrub_memory_repairs_total")
+            # re-baseline against the freshly installed state next pass
+            self._sidecar = None
+            return
+        self._sidecar = (version, crc)
+
+    # -- worker thread -------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.run_once()
+            except Exception as exc:  # noqa: BLE001 — surfaced at stop
+                self._error = exc
+                obs.record_failure(exc)
+                trace.record_event("scrub.worker_error", error=str(exc))
+                return
+
+    def start(self) -> "Scrubber":
+        if self._thread is not None:
+            raise StreamingError("scrubber already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="raft-tpu-scrubber")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the worker and re-raise any failure it died on."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise StreamingError("background scrubber failed") from err
+
+    def __enter__(self) -> "Scrubber":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -65,22 +65,29 @@ import numpy as np
 from raft_tpu import obs
 from raft_tpu.core import env, trace
 from raft_tpu.core.bitset import WORD_BITS
-from raft_tpu.core.checkpoint import (CheckpointError, dump_checkpoint,
-                                      load_checkpoint, save_checkpoint)
+from raft_tpu.core.checkpoint import (CheckpointError, CheckpointManager,
+                                      dump_checkpoint, load_checkpoint,
+                                      save_checkpoint)
 from raft_tpu.neighbors.ivf_flat import (SLOT_ALIGN, IvfFlatIndex,
                                          _coarse_labels, _pack,
                                          _resolve_metric, _search_jit,
                                          _use_radix, build)
 
 __all__ = [
-    "StreamingError", "RecoveryError", "MutationLog", "DriftGauge",
+    "StreamingError", "RecoveryError", "WalGapError",
+    "ShardCorruptError", "MutationLog", "DriftGauge",
     "StreamingIndex", "Compactor", "StreamingMnmg", "stream_build",
-    "KIND_INSERT", "KIND_DELETE",
+    "KIND_INSERT", "KIND_DELETE", "KIND_CENTROIDS",
 ]
 
 #: WAL record kinds (checkpoint entries carry scalars, not strings).
 KIND_INSERT = 0
 KIND_DELETE = 1
+#: a refit's new coarse centroids, journaled so WAL SHIPPING carries
+#: the quantizer change to followers (a repack itself emits no WAL —
+#: it's content-neutral — but a refit changes centroids, which are part
+#: of the content_crc witness)
+KIND_CENTROIDS = 2
 
 _WAL_RE = re.compile(r"^wal-(\d{8})\.ckpt$")
 _EPOCH_RE = re.compile(r"^epoch-(\d{8})\.ckpt$")
@@ -92,6 +99,33 @@ class StreamingError(RuntimeError):
 
 class RecoveryError(StreamingError):
     """No intact epoch snapshot could be recovered from the directory."""
+
+
+class WalGapError(StreamingError):
+    """A shipped WAL record skipped ahead of the next expected sequence
+    number — records were lost (pruned at the source, dropped on the
+    wire, or missed while this replica was down). The typed signal the
+    follower answers with a snapshot resync (ISSUE 18)."""
+
+    def __init__(self, *, expected: int, got: int):
+        super().__init__(
+            f"WAL sequence gap: expected record {expected}, got {got} "
+            f"— {got - expected} record(s) missing; snapshot resync "
+            f"required")
+        self.expected = int(expected)
+        self.got = int(got)
+
+
+class ShardCorruptError(StreamingError):
+    """A scrub pass found at-rest damage (a failed container CRC) that
+    no healthy source could repair — the shard is quarantined, not
+    silently served (ISSUE 18)."""
+
+    def __init__(self, shard: str, detail: str):
+        super().__init__(f"shard {shard!r} corrupt and unrepairable: "
+                         f"{detail}")
+        self.shard = shard
+        self.detail = detail
 
 
 def _coarse_assign(rows, centroids) -> Tuple[np.ndarray, np.ndarray]:
@@ -119,25 +153,56 @@ class MutationLog:
     ``epoch-<n:08d>.ckpt`` — both v1 checkpoint containers, both written
     via atomic replace, so a reader never sees a torn file: a record is
     either absent or intact (its per-entry CRCs still guard against
-    at-rest damage). Recovery loads the newest intact epoch and replays
-    the WAL records stamped with that epoch, in sequence order;
-    committing a new epoch prunes every record stamped with an older
-    one (they are folded into the snapshot).
+    at-rest damage). Epoch snapshots live in a
+    :class:`~raft_tpu.core.checkpoint.CheckpointManager` (ISSUE 18):
+    same filenames, but retention (``RAFT_TPU_WAL_RETAIN``, override
+    via ``retain=``) and the atomic write protocol are the shared
+    container machinery every solver checkpoint already rides.
+
+    Recovery loads the newest intact epoch and replays the WAL records
+    past its ``wal_horizon`` (the highest sequence folded into it), in
+    sequence order; committing a new epoch prunes the records it folds.
+    ``on_append`` (callable, one durable record dict) is the WAL-
+    shipping hook: it fires AFTER the record hits disk, so a shipped
+    record is always at least as durable at the source as at any
+    follower.
     """
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, *, retain: Optional[int] = None):
         self.directory = os.fspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
+        self.retain = int(env.read("RAFT_TPU_WAL_RETAIN")
+                          if retain is None else retain)
+        if self.retain < 1:
+            raise ValueError(f"retain must be >= 1, got {self.retain}")
+        self._epochs = CheckpointManager(self.directory, prefix="epoch",
+                                         keep=self.retain)
         self._lock = threading.Lock()
         seqs = [int(m.group(1)) for f in os.listdir(self.directory)
                 if (m := _WAL_RE.match(f))]
         self._next_seq = max(seqs, default=-1) + 1
+        self.on_append: Optional[Callable[[Dict], None]] = None
 
     # -- WAL ----------------------------------------------------------
 
+    @property
+    def last_seq(self) -> int:
+        """Highest sequence number this log has issued or mirrored
+        (-1 when none) — the follower's catch-up cursor."""
+        with self._lock:
+            return self._next_seq - 1
+
+    def bump_seq(self, floor_next: int) -> None:
+        """Raise the next sequence number to at least ``floor_next`` —
+        recovery calls this with the restored snapshot's horizon so a
+        restarted replica never re-issues a sequence number the fleet
+        already saw (its own WAL files may have been pruned away)."""
+        with self._lock:
+            self._next_seq = max(self._next_seq, int(floor_next))
+
     def append(self, entries: Dict) -> int:
         """Atomically write one WAL record; returns its sequence number.
-        ``entries`` must not contain ``seq`` (stamped here)."""
+        ``entries`` must not contain ``seq`` (stamped here). Fires the
+        ``on_append`` shipping hook after the record is durable."""
         with self._lock:
             seq = self._next_seq
             self._next_seq += 1
@@ -145,6 +210,24 @@ class MutationLog:
         rec["seq"] = seq
         save_checkpoint(
             os.path.join(self.directory, f"wal-{seq:08d}.ckpt"), rec)
+        hook = self.on_append
+        if hook is not None:
+            hook(rec)
+        return seq
+
+    def append_mirror(self, rec: Dict) -> int:
+        """Durably mirror one ALREADY-sequenced record (a WAL-shipping
+        follower's journal-first step): the record keeps its origin
+        sequence number, so the follower's on-disk WAL is a verbatim
+        suffix of the leader's and a restart resumes catch-up from
+        exactly the right cursor. Does not fire ``on_append`` — a
+        mirror is a sink, not a source."""
+        seq = int(rec["seq"])
+        with self._lock:
+            self._next_seq = max(self._next_seq, seq + 1)
+        save_checkpoint(
+            os.path.join(self.directory, f"wal-{seq:08d}.ckpt"),
+            dict(rec))
         return seq
 
     def wal_records(self) -> List[Dict]:
@@ -157,17 +240,28 @@ class MutationLog:
                 out.append(load_checkpoint(f))
         return out
 
-    def prune_wal(self, *, before_epoch: int) -> int:
-        """Delete records stamped with an epoch older than
-        ``before_epoch`` (they are folded into that epoch's snapshot);
-        returns how many were removed."""
+    def prune_wal(self, *, before_epoch: Optional[int] = None,
+                  through_seq: Optional[int] = None) -> int:
+        """Delete records folded into an epoch snapshot: either every
+        record with ``seq <= through_seq`` (the horizon stamped into
+        the snapshot — works for mirrored records whose epoch numbers
+        belong to the LEADER), or the legacy epoch-stamp filter
+        (``epoch < before_epoch``). Returns how many were removed."""
+        if (before_epoch is None) == (through_seq is None):
+            raise ValueError(
+                "prune_wal takes exactly one of before_epoch= / "
+                "through_seq=")
         removed = 0
         for name in sorted(f for f in os.listdir(self.directory)
                            if _WAL_RE.match(f)):
             path = os.path.join(self.directory, name)
             with open(path, "rb") as f:
                 rec = load_checkpoint(f)
-            if int(rec["epoch"]) < before_epoch:
+            if through_seq is not None:
+                fold = int(rec["seq"]) <= through_seq
+            else:
+                fold = int(rec["epoch"]) < before_epoch
+            if fold:
                 os.remove(path)
                 removed += 1
         return removed
@@ -175,32 +269,31 @@ class MutationLog:
     # -- epoch snapshots ----------------------------------------------
 
     def epoch_path(self, epoch: int) -> str:
-        return os.path.join(self.directory, f"epoch-{epoch:08d}.ckpt")
+        return self._epochs.path_for(epoch)
+
+    def epoch_steps(self) -> List[int]:
+        """Epoch numbers present on disk, ascending (the scrub walk)."""
+        return self._epochs.steps()
 
     def write_epoch(self, epoch: int, entries: Dict, *,
                     faults=None) -> None:
-        """Two-step atomic epoch write with the ``compact.mid_write``
-        crash point BETWEEN the fsynced temp file and the rename — the
-        torn-state window the protocol must survive: a kill there
-        leaves only ``.tmp`` debris, which recovery never reads."""
-        path = self.epoch_path(epoch)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            dump_checkpoint(entries, f)
-            f.flush()
-            os.fsync(f.fileno())
+        """Two-step atomic epoch write through the checkpoint manager,
+        with the ``compact.mid_write`` crash point BETWEEN the fsynced
+        temp file and the rename — the torn-state window the protocol
+        must survive: a kill there leaves only ``.tmp`` debris, which
+        recovery never reads. The manager's retention prunes epochs
+        older than ``retain`` on the same call."""
+        hook = None
         if faults is not None:
-            faults.crash_point("compact.mid_write")
-        os.replace(tmp, path)
+            hook = lambda: faults.crash_point("compact.mid_write")  # noqa: E731
+        self._epochs.save(epoch, entries, pre_replace=hook)
 
     def load_latest_epoch(self) -> Tuple[int, Dict]:
         """The newest INTACT epoch snapshot (number, entries). Walks
         newest-first; an at-rest-damaged file is skipped with a trace
         event and the previous epoch is used. Raises
         :class:`RecoveryError` when none survives."""
-        nums = sorted((int(m.group(1))
-                       for f in os.listdir(self.directory)
-                       if (m := _EPOCH_RE.match(f))), reverse=True)
+        nums = sorted(self._epochs.steps(), reverse=True)
         for n in nums:
             try:
                 with open(self.epoch_path(n), "rb") as f:
@@ -212,10 +305,12 @@ class MutationLog:
             f"no intact epoch snapshot in {self.directory!r} "
             f"(tried {len(nums)} files)")
 
-    def prune_epochs(self, keep: int = 2) -> None:
-        nums = sorted(int(m.group(1))
-                      for f in os.listdir(self.directory)
-                      if (m := _EPOCH_RE.match(f)))
+    def prune_epochs(self, keep: Optional[int] = None) -> None:
+        """Retention sweep (``keep=None`` uses the log's configured
+        retain). ``write_epoch`` already prunes on every commit; this
+        is the explicit surface for tests and manual compaction."""
+        keep = self.retain if keep is None else int(keep)
+        nums = sorted(self._epochs.steps())
         for n in nums[:-keep] if keep else nums:
             os.remove(self.epoch_path(n))
 
@@ -314,6 +409,12 @@ class StreamingIndex:
                  repack_slack: int = SLOT_ALIGN):
         self._lock = threading.RLock()
         self.log = log
+        # highest WAL sequence folded into the in-memory state — the
+        # horizon an epoch snapshot stamps (NOT log.last_seq: during a
+        # recovery replay the disk holds records ahead of the applied
+        # state, and a mid-replay repack must not claim — or prune —
+        # records it hasn't folded yet)
+        self._applied_seq = log.last_seq if log is not None else -1
         self.faults = faults
         self.res = res
         self.drift = drift if drift is not None else DriftGauge()
@@ -355,37 +456,45 @@ class StreamingIndex:
 
     @classmethod
     def recover(cls, res, directory: str, *, faults=None,
-                drift: Optional[DriftGauge] = None) -> "StreamingIndex":
+                drift: Optional[DriftGauge] = None,
+                retain: Optional[int] = None) -> "StreamingIndex":
         """Rebuild the exact pre-crash index from the journal: load the
-        newest intact epoch snapshot, then replay WAL records stamped
-        with that epoch in sequence order (records stamped older are
-        already folded in; the atomic-replace write protocol guarantees
-        every file present is whole). The replayed mutations re-journal
-        nothing — the records are already durable."""
-        log = MutationLog(directory)
+        newest intact epoch snapshot, then replay WAL records PAST its
+        ``wal_horizon`` (the highest sequence folded into the snapshot)
+        in sequence order; the atomic-replace write protocol guarantees
+        every file present is whole. Snapshots written before ISSUE 18
+        carry no horizon — those fall back to the legacy epoch-stamp
+        filter (the frozen ``streaming_epoch_v1.ckpt`` fixture's
+        contract). The replayed mutations re-journal nothing — the
+        records are already durable — and the WAL cursor is bumped past
+        the horizon so a restarted replica never re-issues a sequence
+        number the fleet already saw."""
+        log = MutationLog(directory, retain=retain)
         epoch, ent = log.load_latest_epoch()
-        metric = bytes(np.asarray(ent["metric"], np.uint8)).decode()
-        _resolve_metric(metric)
-        caps = np.asarray(ent["caps"], np.int64)
-        flat = IvfFlatIndex(
-            centroids=jnp.asarray(np.asarray(ent["centroids"],
-                                             np.float32)),
-            packed_db=jnp.asarray(np.asarray(ent["packed_db"])),
-            packed_ids=jnp.asarray(np.asarray(ent["packed_ids"],
-                                              np.int32)),
-            starts=jnp.asarray(np.asarray(ent["starts"], np.int32)),
-            sizes=jnp.asarray(np.asarray(ent["sizes"], np.int32)),
-            caps=caps, cap_max=int(caps.max(initial=0)),
-            n_db=int(ent["n_db"]), metric=metric)
+        flat = _flat_from_entries(ent)
         idx = cls(flat, log=log, faults=faults, res=res, drift=drift,
                   epoch=epoch, next_id=int(ent["next_id"]),
                   tomb_host=np.asarray(ent["tomb_words"], np.uint32),
                   n_live=int(ent["n_live"]))
+        horizon = int(ent["wal_horizon"]) if "wal_horizon" in ent \
+            else None
+        if horizon is not None:
+            idx._applied_seq = horizon
         replayed = 0
         for rec in log.wal_records():
-            if int(rec["epoch"]) != epoch:
+            if horizon is not None:
+                if int(rec["seq"]) <= horizon:
+                    continue
+            elif int(rec["epoch"]) != epoch:
                 continue
             kind = int(rec["kind"])
+            # mark applied BEFORE the dispatch (journal-first's replay
+            # twin): if the apply itself repacks (insert overflow,
+            # centroids refit), the epoch it commits folds THIS record
+            # — its horizon must cover it, or a re-crash would replay
+            # it a second time against state that already contains it
+            if "seq" in rec:
+                idx._applied_seq = int(rec["seq"])
             if kind == KIND_INSERT:
                 idx._apply_insert(np.asarray(rec["data"]),
                                   np.asarray(rec["labels"], np.int64),
@@ -393,14 +502,50 @@ class StreamingIndex:
             elif kind == KIND_DELETE:
                 idx._apply_delete(np.asarray(rec["data"], np.int64),
                                   journal=False)
+            elif kind == KIND_CENTROIDS:
+                with idx._lock:
+                    idx._repack_locked(
+                        centroids=np.asarray(rec["data"], np.float32),
+                        reason="refit_replay")
             else:
                 raise RecoveryError(f"unknown WAL record kind {kind}")
             replayed += 1
+        if horizon is not None:
+            log.bump_seq(horizon + 1)
         if obs.enabled():
             obs.inc("streaming_replay_records_total", replayed)
         trace.record_event("streaming.recover", epoch=epoch,
                            replayed=replayed, n_live=idx.n_live)
         return idx
+
+    def install_snapshot(self, ent: Dict) -> None:
+        """Replace this index's entire content with a SHIPPED epoch
+        snapshot (a WAL-shipping catch-up whose gap was too wide to
+        replay record-by-record — the leader already pruned the
+        records). Under the mutation lock: rebuild the packed state
+        from the entries, bump the LOCAL epoch (leader and follower
+        epoch counters legitimately diverge — compactions emit no WAL
+        records — but :meth:`content_crc` is packing-invariant, so
+        content equality is still the witness), persist it as a local
+        epoch snapshot, advance the WAL cursor past the snapshot's
+        horizon, and publish."""
+        with self._lock:
+            flat = _flat_from_entries(ent)
+            self._flat = flat
+            self._epoch += 1
+            self._next_id = int(ent["next_id"])
+            self._n_live = int(ent["n_live"])
+            self._tomb_host = np.asarray(ent["tomb_words"],
+                                         np.uint32).copy()
+            self._applied_seq = int(ent.get("wal_horizon", -1))
+            if self.log is not None:
+                self.log.bump_seq(self._applied_seq + 1)
+            self._write_epoch_locked(crash=False)
+            self._publish_locked()
+        if obs.enabled():
+            obs.inc("streaming_snapshot_installs_total")
+        trace.record_event("streaming.install_snapshot",
+                           epoch=self._epoch, n_live=self._n_live)
 
     # -- read-side properties (snapshot-backed, lock-free) ------------
 
@@ -491,7 +636,9 @@ class StreamingIndex:
         rec: Dict = {"kind": kind, "epoch": self._epoch, "data": data}
         if labels is not None:
             rec["labels"] = np.asarray(labels, np.int64)
-        self.log.append(rec)
+        # journal-first: the apply follows under the same lock, so the
+        # applied horizon may advance with the durable write
+        self._applied_seq = self.log.append(rec)
 
     def _write_epoch_locked(self, *, crash: bool = True) -> None:
         """Persist the CURRENT in-memory state as this epoch's snapshot
@@ -502,12 +649,16 @@ class StreamingIndex:
         build write — not part of the compaction state machine)."""
         if self.log is None:
             return
-        self.log.write_epoch(self._epoch, _epoch_entries(self),
+        ent = _epoch_entries(self)
+        self.log.write_epoch(self._epoch, ent,
                              faults=self.faults if crash else None)
         if crash:
             self._crash("compact.post_commit")
-        self.log.prune_wal(before_epoch=self._epoch)
-        self.log.prune_epochs(keep=2)
+        # prune by the horizon STAMPED INTO the snapshot, not by epoch
+        # stamp: a WAL-shipping follower mirrors records carrying the
+        # LEADER's epoch numbers, which its own epoch counter never
+        # matches — sequence numbers are the one fleet-wide ordering
+        self.log.prune_wal(through_seq=int(ent["wal_horizon"]))
 
     # -- mutation ------------------------------------------------------
 
@@ -779,11 +930,26 @@ class StreamingIndex:
             sizes = np.asarray(flat.sizes, np.float32)
         from raft_tpu.cluster.kmeans import kmeans_partial_fit
 
+        # journaled indexes checkpoint the refit at every chunk
+        # boundary (ISSUE 18 satellite): a SIGKILL mid-refit resumes
+        # from the saved (centroids, counts, chunk) cursor instead of
+        # re-running the whole mini-batch pass
+        ckpt: Dict = {}
+        if self.log is not None:
+            ckpt = dict(
+                checkpoint_dir=os.path.join(self.log.directory,
+                                            "refit"),
+                checkpoint_every=1)
         new_c, counts = kmeans_partial_fit(
             self.res, flat.centroids, jnp.asarray(batch),
-            counts=jnp.asarray(sizes))
+            counts=jnp.asarray(sizes), **ckpt)
         with self._lock:
             self._pf_counts = np.asarray(counts)
+            # journal-first like insert/delete: the new quantizer is a
+            # CONTENT change (centroids are in the crc witness), so it
+            # must ship to WAL followers — the repack itself stays
+            # journal-silent (content-neutral)
+            self._journal(KIND_CENTROIDS, np.asarray(new_c, np.float32))
             self._repack_locked(centroids=new_c, reason="refit")
         dist, _ = _coarse_assign(batch, new_c)
         self.drift.set_baseline(float(np.mean(dist)))
@@ -913,6 +1079,9 @@ def _epoch_entries(idx: StreamingIndex) -> Dict:
         "next_id": idx._next_id,
         "n_live": idx._n_live,
         "n_db": int(flat.n_db),
+        # highest WAL sequence folded into this snapshot: recovery
+        # replays strictly past it, the commit prunes through it
+        "wal_horizon": idx._applied_seq,
         "metric": np.frombuffer(flat.metric.encode(), np.uint8),
         "centroids": np.asarray(flat.centroids, np.float32),
         "packed_db": np.asarray(flat.packed_db),
@@ -922,6 +1091,26 @@ def _epoch_entries(idx: StreamingIndex) -> Dict:
         "caps": np.asarray(flat.caps, np.int64),
         "tomb_words": idx._tomb_host.copy(),
     }
+
+
+def _flat_from_entries(ent: Dict) -> IvfFlatIndex:
+    """Rebuild the packed :class:`IvfFlatIndex` from an epoch
+    snapshot's entries — the inverse of :func:`_epoch_entries`, shared
+    by :meth:`StreamingIndex.recover` (disk) and
+    :meth:`StreamingIndex.install_snapshot` (wire)."""
+    metric = bytes(np.asarray(ent["metric"], np.uint8)).decode()
+    _resolve_metric(metric)
+    caps = np.asarray(ent["caps"], np.int64)
+    return IvfFlatIndex(
+        centroids=jnp.asarray(np.asarray(ent["centroids"],
+                                         np.float32)),
+        packed_db=jnp.asarray(np.asarray(ent["packed_db"])),
+        packed_ids=jnp.asarray(np.asarray(ent["packed_ids"],
+                                          np.int32)),
+        starts=jnp.asarray(np.asarray(ent["starts"], np.int32)),
+        sizes=jnp.asarray(np.asarray(ent["sizes"], np.int32)),
+        caps=caps, cap_max=int(caps.max(initial=0)),
+        n_db=int(ent["n_db"]), metric=metric)
 
 
 # ---------------------------------------------------------------------------

@@ -33,11 +33,12 @@ from raft_tpu.serve.executor import (Executor, ExecutorStats,
                                      KnnService, KMeansPredictService,
                                      PairwiseService, Service)
 from raft_tpu.serve.ingest import IngestController, StreamingKnnService
-from raft_tpu.serve.loadgen import (ChaosReport, FleetReport,
-                                    LoadReport, StreamingReport,
-                                    closed_loop, fleet_closed_loop,
-                                    open_loop, run_chaos,
-                                    streaming_loop)
+from raft_tpu.serve.loadgen import (CatchupLoadReport, ChaosReport,
+                                    FleetReport, LoadReport,
+                                    StreamingReport,
+                                    catchup_under_load, closed_loop,
+                                    fleet_closed_loop, open_loop,
+                                    run_chaos, streaming_loop)
 from raft_tpu.serve.qos import QosPolicy, TenantPolicy
 from raft_tpu.serve.replica import (HedgePolicy, RecoveryReport,
                                     Replica, ReplicaGroup,
@@ -59,6 +60,7 @@ __all__ = [
     "ivf_ladder", "knn_ladder",
     "StreamingKnnService", "IngestController",
     "LoadReport", "FleetReport", "ChaosReport", "StreamingReport",
+    "CatchupLoadReport",
     "closed_loop", "open_loop", "fleet_closed_loop", "streaming_loop",
-    "run_chaos",
+    "catchup_under_load", "run_chaos",
 ]

@@ -219,7 +219,8 @@ class IngestController:
                  tombstone_frac: Optional[float] = None,
                  refit: bool = True,
                  warm_buckets: Optional[Sequence[int]] = None,
-                 extra_services: Sequence[Service] = ()):
+                 extra_services: Sequence[Service] = (),
+                 shipper=None):
         self.stream = stream
         self.streaming_services: List[StreamingKnnService] = \
             list(services)
@@ -236,6 +237,15 @@ class IngestController:
             stream, interval=compact_interval,
             tombstone_frac=tombstone_frac, refit=refit,
             on_change=self._on_index_change)
+        # WAL shipping (ISSUE 18): a wal_ship.WalShipper replicating
+        # this stream's journal to follower replicas — attached/started
+        # with the controller so records ship for exactly the window
+        # mutations can arrive through this surface
+        self.shipper = shipper
+        if shipper is not None and shipper.index is not stream:
+            raise ValueError(
+                "shipper replicates a different StreamingIndex than "
+                "this controller's")
         self._serve_lock = threading.Lock()
         self._warm_buckets = (list(warm_buckets)
                               if warm_buckets is not None else None)
@@ -252,18 +262,30 @@ class IngestController:
     def start(self, *, warm: bool = True) -> "IngestController":
         if warm:
             self.executor.warm(self._buckets())
+        if self.shipper is not None:
+            self.shipper.attach()
+            self.shipper.start()
         self.executor.start()
         self.compactor.start()
         return self
 
     def stop(self) -> None:
         """Compactor first (no swap may land while the executor
-        drains), then the executor; compactor worker failures re-raise
+        drains), then the executor, then the shipper (every record the
+        compactor/executor window produced is already shipped — the
+        hook fires synchronously on append); worker failures re-raise
         here, after the drain."""
         try:
             self.compactor.stop()
         finally:
-            self.executor.stop()
+            try:
+                self.executor.stop()
+            finally:
+                if self.shipper is not None:
+                    try:
+                        self.shipper.stop()
+                    finally:
+                        self.shipper.detach()
 
     def __enter__(self) -> "IngestController":
         return self.start()

@@ -31,9 +31,9 @@ import numpy as np
 from raft_tpu.runtime import limits
 
 __all__ = ["LoadReport", "FleetReport", "ChaosReport",
-           "StreamingReport", "closed_loop", "open_loop",
-           "fleet_closed_loop", "streaming_loop", "run_chaos",
-           "CHAOS_SCENARIOS"]
+           "StreamingReport", "CatchupLoadReport", "closed_loop",
+           "open_loop", "fleet_closed_loop", "streaming_loop",
+           "catchup_under_load", "run_chaos", "CHAOS_SCENARIOS"]
 
 
 @dataclass
@@ -941,6 +941,158 @@ def streaming_loop(controller, op: str, *, clients: int = 4,
     report.refreshes = controller.refreshes - refreshes0
     report.compactions = controller.compactor.compactions - compactions0
     report.n_live_final = controller.stream.n_live
+    return report
+
+
+@dataclass
+class CatchupLoadReport:
+    """One WAL catch-up run under query load (ISSUE 18): a follower
+    replays the leader's shipped records while queries race the
+    mirror-applies, each scored against an exact reference over the
+    snapshot it targeted. ``min_recall`` is the recall-floor-during-
+    catch-up witness the acceptance criteria gate on; ``skipped``
+    counts queries deferred while the follower held fewer than ``k``
+    live rows (a snapshot-bootstrapped follower starts empty)."""
+
+    duration_s: float
+    queries: int = 0
+    skipped: int = 0
+    latencies_ms: List[float] = field(default_factory=list)
+    recalls: List[float] = field(default_factory=list)
+    applied_seq: int = -1
+    target_seq: int = -1
+    resyncs: int = 0
+    catchup_seconds: float = float("nan")
+
+    @property
+    def min_recall(self) -> float:
+        return min(self.recalls) if self.recalls else float("nan")
+
+    @property
+    def mean_recall(self) -> float:
+        return (float(np.mean(self.recalls)) if self.recalls
+                else float("nan"))
+
+    def percentile_ms(self, q: float) -> float:
+        if not self.latencies_ms:
+            return float("nan")
+        return float(np.percentile(np.asarray(self.latencies_ms), q))
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "mode": "catchup",
+            "duration_s": round(self.duration_s, 3),
+            "queries": self.queries,
+            "skipped": self.skipped,
+            "p50_ms": round(self.percentile_ms(50.0), 3),
+            "p99_ms": round(self.percentile_ms(99.0), 3),
+            "min_recall": round(self.min_recall, 4),
+            "mean_recall": round(self.mean_recall, 4),
+            "applied_seq": self.applied_seq,
+            "target_seq": self.target_seq,
+            "resyncs": self.resyncs,
+            "catchup_seconds": round(self.catchup_seconds, 3),
+        }
+
+
+def catchup_under_load(follower, *, k: int, nprobe: int,
+                       target_seq: int, rows: int = 4, seed: int = 0,
+                       wait_s: float = 30.0) -> CatchupLoadReport:
+    """Drive one :class:`~raft_tpu.neighbors.wal_ship.WalFollower`
+    through a full catch-up (snapshot resync if gapped, then record
+    drain to ``target_seq``) while querying it the whole time.
+
+    A worker thread runs ``follower.catch_up()`` then drains shipped
+    records until ``follower.applied_seq >= target_seq``; the
+    foreground loop searches the follower's index directly, scoring
+    per-query recall against the exact reference over the snapshot the
+    query targeted (best-of over ``recent_snapshots()`` when a
+    mirror-apply published mid-flight — the :func:`streaming_loop`
+    discipline). Queries are counted as ``skipped`` while the follower
+    holds fewer than ``k`` live rows. The returned report's
+    ``min_recall`` covers every query answered during catch-up."""
+    index = follower.index
+    report = CatchupLoadReport(duration_s=0.0, target_seq=target_seq)
+    done = threading.Event()
+    errors: List[BaseException] = []
+    t0 = time.monotonic()
+
+    def worker() -> None:
+        try:
+            cr = follower.catch_up(timeout=wait_s)
+            report.catchup_seconds = cr.seconds
+            while follower.applied_seq < target_seq:
+                if follower.drain() == 0:
+                    if time.monotonic() - t0 > wait_s:
+                        raise TimeoutError(
+                            f"follower stalled at seq "
+                            f"{follower.applied_seq} < {target_seq}")
+                    time.sleep(0.001)
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            errors.append(exc)
+        finally:
+            done.set()
+
+    def _recall(got: np.ndarray, ref: np.ndarray) -> float:
+        return float(np.mean(
+            [len(set(got[j].tolist()) & set(ref[j].tolist())) / k
+             for j in range(got.shape[0])]))
+
+    rng = np.random.default_rng(seed)
+    # warm the search BEFORE racing it against the apply stream: the
+    # first call's compile can outlast the snapshot ring (applies keep
+    # publishing), which would make its result unscorable
+    warm_snap = index.snapshot
+    if warm_snap.n_live >= k:
+        index.search(rng.standard_normal(
+            (rows, warm_snap.flat.dim)).astype(np.float32), k, nprobe)
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        if errors:
+            break
+        snap = index.snapshot
+        if snap.n_live < k:
+            if done.is_set():
+                # catch-up finished — re-read once (the snapshot
+                # install may have landed after the first read) and
+                # give up only if the follower truly never grew to k
+                snap = index.snapshot
+                if snap.n_live < k:
+                    break
+            else:
+                report.skipped += 1
+                time.sleep(0.001)
+                continue
+        q = rng.standard_normal(
+            (rows, snap.flat.dim)).astype(np.float32)
+        t_q = time.monotonic()
+        _, got = index.search(q, k, nprobe)
+        lat_ms = (time.monotonic() - t_q) * 1e3
+        # grab the candidate versions NOW, before the (slow) exact
+        # scoring — applies keep publishing and would walk the bounded
+        # ring past the version the search actually served
+        cands = [snap] + [s for s in index.recent_snapshots()
+                          if s.version > snap.version]
+        got = np.asarray(got)
+        rec = 0.0
+        # a mirror-apply published mid-flight: any consistent version
+        # from the query window is legitimate
+        for s in cands:
+            rec = max(rec, _recall(got, _snapshot_exact_ids(s, q, k)))
+            if rec >= 1.0:
+                break
+        report.queries += 1
+        report.latencies_ms.append(lat_ms)
+        report.recalls.append(rec)
+        if done.is_set():
+            break  # at least one query answered post-catch-up
+    t.join(timeout=wait_s)
+    if errors:
+        raise errors[0]
+    report.duration_s = time.monotonic() - t0
+    report.applied_seq = follower.applied_seq
+    report.resyncs = follower.resyncs
     return report
 
 

@@ -439,6 +439,37 @@ class ReplicaGroup:
         obs.emit_event("serve.replica_rejoin", replica=r.name)
         return r
 
+    def spawn(self, name: str, executor: Executor, *,
+              weight: float = 1.0, warm: bool = True) -> Replica:
+        """Grow the fleet by one replica — the inverse of :meth:`heal`
+        (ISSUE 18 / ROADMAP item 6): a NEW executor (typically serving
+        a WAL-caught-up or freshly restored index) joins routing.
+
+        The joiner's virtual clock starts at 0 and snaps to the fleet
+        floor on its first route — exactly the :meth:`rejoin`
+        discipline, so a spawn gets its fair share immediately, never a
+        catch-up flood. ``warm=True`` pre-warms the executor's serving
+        buckets BEFORE the replica becomes routable, so the first
+        production query hits a compiled executable (the zero-
+        post-warm-recompile acceptance)."""
+        if not weight > 0:
+            raise ValueError(f"replica weight must be > 0, got {weight}")
+        for r in self._replicas:
+            if r.name == name:
+                raise ValueError(f"replica name {name!r} already in "
+                                 "the group (rejoin it instead)")
+        if warm:
+            executor.warm()
+        rep = Replica(name=name, executor=executor, weight=float(weight))
+        with self._lock:
+            self._replicas.append(rep)
+            started = self._started
+        if started:
+            executor.start()
+        obs.emit_event("serve.replica_spawn", replica=name,
+                       weight=float(weight), warmed=bool(warm))
+        return rep
+
     def fail_replica(self, which, reason: str = "killed") -> Replica:
         """The in-process kill: gate the replica out, tear its drain
         thread down WITHOUT the graceful drain, and fail whatever is

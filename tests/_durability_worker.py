@@ -1,0 +1,291 @@
+"""Worker + orchestrator for the durable-streaming-fleet chaos witness
+(ISSUE 18 acceptance: a follower replica SIGKILL'd mid-stream restarts
+from its mirrored journal, catches up over the wire under query load,
+and converges to the leader's ``content_crc`` bit-for-bit — equal to a
+clean never-killed twin).
+
+Roles (``python tests/_durability_worker.py <role> ...``):
+
+``leader --dir D --addrs A0 A1``
+    Rank 0: builds the journaled index, attaches a
+    :class:`~raft_tpu.neighbors.wal_ship.WalShipper` (live shipping +
+    catch-up service), waits for the follower's READY, streams the
+    deterministic mutation sequence (one forced refit mid-stream so a
+    KIND_CENTROIDS record crosses the wire), then keeps serving
+    catch-up until the follower's DONE. Prints
+    ``LEADER_OK crc=<c> seq=<s> ship_errors=<n>``.
+
+``follower --dir D --addrs A0 A1 --kill-at-seq N``
+    Rank 1, phase 1: bootstraps a blank follower (snapshot resync),
+    drains live records until its applied cursor reaches N, then
+    SIGKILLs itself — no atexit, no finally; the mirrored journal on
+    disk is whatever the OS kept.
+
+``follower --dir D --addrs A0 A1 --resume``
+    Rank 1, phase 2: recovers the SAME index from the mirrored journal
+    (``StreamingIndex.recover``), prints the resume cursor, then
+    catches up to TARGET_SEQ **under query load**
+    (:func:`~raft_tpu.serve.loadgen.catchup_under_load` — the
+    recall-floor-during-catch-up witness), sends DONE, prints
+    ``FOLLOWER_OK crc=<c> applied=<s> resumed=<r> min_recall=<f>
+    queries=<q> resyncs=<n>``.
+
+``clean --dir D``
+    The never-killed twin: runs the identical mutation sequence
+    in-process (no comms) and prints ``CLEAN_OK crc=<c> seq=<s>``.
+
+``orchestrate``
+    Runs the whole dance (clean twin, leader, follower kill at
+    KILL_AT_SEQ with rc −9 asserted, follower resume) in subprocesses
+    and asserts all three CRCs equal and the catch-up recall floor
+    held. Prints ``DURABILITY_CHAOS_OK ...`` — ci/smoke.sh gates on it.
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+N_DB, DIM, N_LISTS = 160, 8, 8
+N_BATCHES = 8           # each batch = 1 insert + 1 delete WAL record
+B_ROWS = 12
+REFIT_AT = 4            # forced refit after this batch (+1 record)
+TARGET_SEQ = 2 * N_BATCHES          # 16 records, seqs 0..16 inclusive
+KILL_AT_SEQ = 6                      # mid-stream, before the refit
+K, NPROBE = 5, N_LISTS               # exact probe: recall floor is 1.0
+TAG_READY, TAG_DONE = 7400, 7401
+
+
+def _mutate(idx, rng):
+    """The deterministic mutation stream both twins run. Yields after
+    every batch so the leader can pace live shipping."""
+    for i in range(N_BATCHES):
+        ids = idx.insert(rng.normal(size=(B_ROWS, DIM)).astype("float32"))
+        idx.delete(ids[::3])
+        if i == REFIT_AT:
+            idx.maybe_refit(force=True)
+        yield i
+
+
+def _build(directory):
+    import numpy as np
+
+    from raft_tpu.neighbors import streaming
+
+    rng = np.random.default_rng(7)
+    db = rng.normal(size=(N_DB, DIM)).astype(np.float32)
+    idx = streaming.stream_build(None, db, N_LISTS, seed=0, max_iter=4,
+                                 directory=directory)
+    return idx, rng
+
+
+def run_clean(directory):
+    idx, rng = _build(directory)
+    for _ in _mutate(idx, rng):
+        pass
+    print(f"CLEAN_OK crc={idx.content_crc()} seq={idx._applied_seq}",
+          flush=True)
+
+
+def run_leader(directory, addrs):
+    import numpy as np
+
+    from raft_tpu.comms.errors import (CommsTimeoutError,
+                                       PeerFailedError)
+    from raft_tpu.comms.tcp_mailbox import TcpMailbox
+    from raft_tpu.neighbors.wal_ship import WalShipper
+
+    box = TcpMailbox(0, addrs, heartbeat_interval=0.3,
+                     heartbeat_timeout=2.0)
+    idx, rng = _build(directory)
+    shipper = WalShipper(idx, box, 0, [1], poll_interval=0.01)
+    shipper.attach()
+    shipper.start()
+    np.asarray(box.get(1, 0, TAG_READY, timeout=120.0))
+    for _ in _mutate(idx, rng):
+        time.sleep(0.03)        # pace: the kill lands mid-stream
+    print(f"LEADER_STREAMED seq={idx._applied_seq}", flush=True)
+    # wait for the restarted follower's DONE; the phase-1 death marks
+    # the peer failed (pending gets fail fast), so revive + retry until
+    # phase 2 reconnects
+    deadline = time.monotonic() + 120.0
+    while True:
+        try:
+            np.asarray(box.get(1, 0, TAG_DONE, timeout=5.0))
+            break
+        except (PeerFailedError, CommsTimeoutError):
+            if time.monotonic() > deadline:
+                raise
+            box.revive_peer(1)
+    print(f"LEADER_OK crc={idx.content_crc()} seq={idx._applied_seq} "
+          f"ship_errors={shipper.ship_errors}", flush=True)
+    shipper.stop()
+    shipper.detach()
+    box.close()
+
+
+def run_follower_phase1(directory, addrs, kill_at_seq):
+    import numpy as np
+
+    from raft_tpu.comms.tcp_mailbox import TcpMailbox
+    from raft_tpu.neighbors.wal_ship import (WalFollower,
+                                             bootstrap_follower)
+
+    box = TcpMailbox(1, addrs, heartbeat_interval=0.3,
+                     heartbeat_timeout=2.0)
+    idx = bootstrap_follower(None, dim=DIM, n_lists=N_LISTS,
+                             directory=directory)
+    wf = WalFollower(idx, box, 1, 0)
+    box.put(1, 0, TAG_READY, np.asarray([1], np.int64))
+    wf.catch_up(timeout=60.0)       # cursor −1 → snapshot resync
+    while wf.applied_seq < kill_at_seq:
+        if wf.drain() == 0:
+            time.sleep(0.005)
+    print(f"FOLLOWER_SUICIDE seq={wf.applied_seq}", flush=True)
+    sys.stdout.flush()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def run_follower_resume(directory, addrs):
+    import numpy as np
+
+    from raft_tpu.comms.tcp_mailbox import TcpMailbox
+    from raft_tpu.neighbors.streaming import StreamingIndex
+    from raft_tpu.neighbors.wal_ship import WalFollower
+    from raft_tpu.serve.loadgen import catchup_under_load
+
+    box = TcpMailbox(1, addrs, heartbeat_interval=0.3,
+                     heartbeat_timeout=2.0)
+    # the SIGKILL'd replica's restart: epoch snapshot + mirrored WAL
+    # suffix reproduce the pre-kill state and cursor exactly
+    idx = StreamingIndex.recover(None, directory)
+    resumed = idx._applied_seq
+    print(f"FOLLOWER_RESUMED seq={resumed}", flush=True)
+    wf = WalFollower(idx, box, 1, 0)
+    rep = catchup_under_load(wf, k=K, nprobe=NPROBE,
+                             target_seq=TARGET_SEQ, rows=4, seed=3,
+                             wait_s=60.0)
+    box.put(1, 0, TAG_DONE, np.asarray([1], np.int64))
+    print(f"FOLLOWER_OK crc={idx.content_crc()} "
+          f"applied={wf.applied_seq} resumed={resumed} "
+          f"min_recall={rep.min_recall:.4f} queries={rep.queries} "
+          f"resyncs={rep.resyncs}", flush=True)
+    time.sleep(0.2)                 # let the DONE frame flush
+    box.close()
+
+
+# -- orchestrator ------------------------------------------------------
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _field(out, marker, key):
+    import re
+
+    m = re.search(rf"{marker}\b.*\b{key}=([\d.+-]+)", out)
+    assert m, f"missing {marker} {key}= in:\n{out}"
+    return m.group(1)
+
+
+def orchestrate():
+    import tempfile
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    me = os.path.abspath(__file__)
+
+    def launch(args):
+        return subprocess.Popen([sys.executable, me] + args, cwd=_REPO,
+                                env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        d_clean = os.path.join(tmp, "clean")
+        d_lead = os.path.join(tmp, "leader")
+        d_foll = os.path.join(tmp, "follower")
+        clean = launch(["clean", "--dir", d_clean])
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(2)]
+        leader = launch(["leader", "--dir", d_lead, "--addrs"] + addrs)
+        f1 = launch(["follower", "--dir", d_foll, "--addrs"] + addrs
+                    + ["--kill-at-seq", str(KILL_AT_SEQ)])
+        out1 = f1.communicate(timeout=180)[0]
+        assert f1.returncode == -9, \
+            f"phase-1 follower was not SIGKILLed (rc={f1.returncode}):" \
+            f"\n{out1}"
+        assert "FOLLOWER_SUICIDE" in out1, out1
+        f2 = launch(["follower", "--dir", d_foll, "--addrs"] + addrs
+                    + ["--resume"])
+        out2 = f2.communicate(timeout=180)[0]
+        assert f2.returncode == 0, f"resume follower failed:\n{out2}"
+        out_l = leader.communicate(timeout=180)[0]
+        assert leader.returncode == 0, f"leader failed:\n{out_l}"
+        out_c = clean.communicate(timeout=180)[0]
+        assert clean.returncode == 0, f"clean twin failed:\n{out_c}"
+
+    crc_clean = _field(out_c, "CLEAN_OK", "crc")
+    crc_lead = _field(out_l, "LEADER_OK", "crc")
+    crc_foll = _field(out2, "FOLLOWER_OK", "crc")
+    assert crc_lead == crc_clean, \
+        f"leader diverged from clean twin: {crc_lead} != {crc_clean}"
+    assert crc_foll == crc_lead, \
+        f"restarted follower diverged: {crc_foll} != {crc_lead}"
+    # the journal cursor survived the SIGKILL: the restart resumed at
+    # least at the kill threshold (drain may overshoot by one queued
+    # batch) and well short of the leader's final horizon
+    resumed = int(_field(out2, "FOLLOWER_OK", "resumed"))
+    assert KILL_AT_SEQ <= resumed < TARGET_SEQ, out2
+    applied = int(_field(out2, "FOLLOWER_OK", "applied"))
+    assert applied >= TARGET_SEQ, out2
+    min_recall = float(_field(out2, "FOLLOWER_OK", "min_recall"))
+    queries = int(_field(out2, "FOLLOWER_OK", "queries"))
+    assert queries >= 1, out2
+    assert min_recall >= 0.99, \
+        f"recall floor broken during catch-up: {min_recall}\n{out2}"
+    print(f"DURABILITY_CHAOS_OK crc={crc_foll} resumed={resumed} "
+          f"applied={applied} min_recall={min_recall:.4f} "
+          f"queries={queries}", flush=True)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("role", choices=["leader", "follower", "clean",
+                                    "orchestrate"])
+    p.add_argument("--dir")
+    p.add_argument("--addrs", nargs="*", default=[])
+    p.add_argument("--kill-at-seq", type=int, default=None)
+    p.add_argument("--resume", action="store_true")
+    a = p.parse_args(argv)
+    if a.role == "orchestrate":
+        orchestrate()
+    elif a.role == "clean":
+        run_clean(a.dir)
+    elif a.role == "leader":
+        run_leader(a.dir, a.addrs)
+    elif a.resume:
+        run_follower_resume(a.dir, a.addrs)
+    else:
+        assert a.kill_at_seq is not None
+        run_follower_phase1(a.dir, a.addrs, a.kill_at_seq)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

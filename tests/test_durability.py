@@ -1,0 +1,603 @@
+"""Durable streaming fleet tests (ISSUE 18): replicated WAL shipping,
+checkpointed mid-stream replica restart, scrub + read-repair.
+
+Acceptance claims gated here:
+
+- a follower converges to the leader's ``content_crc`` bit-for-bit
+  through every path: live record shipping, snapshot resync (blank
+  bootstrap AND pruned-WAL gap), a forced refit's KIND_CENTROIDS
+  record, and a mirror-journal restart;
+- gaps are a typed :class:`WalGapError` and drain() auto-heals them
+  with a catch-up round; duplicates are idempotent;
+- catch-up under live query load never drops below the recall floor
+  (:func:`~raft_tpu.serve.loadgen.catchup_under_load`);
+- the scrubber detects seeded bit-flips (``corrupt_bytes``),
+  quarantines the damaged container, and repairs up the ladder —
+  unrepairable damage raises the typed :class:`ShardCorruptError`;
+  the memory sidecar catches RAM damage (same version, changed bytes);
+- the two-process SIGKILL witness (tests/_durability_worker.py): a
+  follower killed mid-stream restarts from its mirrored journal and
+  converges, CRC-equal to a clean never-killed twin;
+- ``kmeans_partial_fit`` checkpoint/resume is bit-equal to an
+  uninterrupted run; ``ReplicaGroup.spawn`` joins routing at the
+  vtime floor with zero post-warm recompiles; the frozen epoch
+  fixture (tests/data/streaming_epoch_v1.ckpt) loads forever.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.comms.comms import _Mailbox
+from raft_tpu.comms.faults import FaultInjector
+from raft_tpu.core import env
+from raft_tpu.core.checkpoint import restore_checkpoint
+from raft_tpu.neighbors.scrub import Scrubber
+from raft_tpu.neighbors.streaming import (MutationLog, ShardCorruptError,
+                                          StreamingError, StreamingIndex,
+                                          WalGapError, _epoch_entries,
+                                          stream_build)
+from raft_tpu.neighbors.wal_ship import (TAG_WAL, CatchupReport,
+                                         WalFollower, WalShipper,
+                                         bootstrap_follower)
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.serve.loadgen import catchup_under_load
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE = os.path.join(_REPO, "tests", "data",
+                        "streaming_epoch_v1.ckpt")
+
+N, D, L = 160, 8, 8
+
+
+def _leader(tmp_path, n=N, seed=3):
+    rng = np.random.default_rng(seed)
+    db = rng.normal(size=(n, D)).astype(np.float32)
+    idx = stream_build(None, db, L, seed=0, max_iter=4,
+                       directory=str(tmp_path / "leader"))
+    return idx, rng
+
+
+def _rows(rng, m=12):
+    return rng.normal(size=(m, D)).astype(np.float32)
+
+
+@pytest.fixture
+def live_obs():
+    """Metrics on with a private registry (the test_obs pattern)."""
+    was_enabled = obs.enabled()
+    old_reg = obs_metrics.set_registry(obs.MetricsRegistry())
+    obs.set_enabled(True)
+    try:
+        yield obs_metrics.get_registry()
+    finally:
+        obs.set_enabled(was_enabled)
+        obs_metrics.set_registry(old_reg)
+
+
+def _counter(reg, name):
+    fam = reg.snapshot().get(name)
+    if not fam:
+        return 0.0
+    return sum(s["value"] for s in fam["series"])
+
+
+# ---------------------------------------------------------------------------
+# WAL shipping (tentpole part 2)
+# ---------------------------------------------------------------------------
+
+
+class TestWalShipping:
+    def _pair(self, tmp_path, *, follower_dir=True, serve=True):
+        leader, rng = _leader(tmp_path)
+        mbx = _Mailbox()
+        shipper = WalShipper(leader, mbx, 0, [1],
+                             poll_interval=0.01).attach()
+        if serve:
+            shipper.start()                     # answers catch-up reqs
+        fdir = str(tmp_path / "follower") if follower_dir else None
+        fidx = bootstrap_follower(None, dim=D, n_lists=L,
+                                  directory=fdir)
+        wf = WalFollower(fidx, mbx, 1, 0)
+        return leader, rng, mbx, shipper, fidx, wf
+
+    @staticmethod
+    def _down(shipper):
+        if shipper._thread is not None:
+            shipper.stop()
+        shipper.detach()
+
+    def test_live_shipping_converges_bit_equal(self, tmp_path):
+        leader, rng, mbx, shipper, fidx, wf = self._pair(tmp_path)
+        rpt = wf.catch_up(timeout=30.0)         # blank cursor → snapshot
+        assert rpt.snapshot and wf.resyncs == 1
+        assert fidx.content_crc() == leader.content_crc()
+        ids = leader.insert(_rows(rng))
+        leader.delete(ids[::3])
+        assert wf.drain() == 2
+        assert fidx.content_crc() == leader.content_crc()
+        assert wf.applied_seq == leader._applied_seq
+        self._down(shipper)
+
+    def test_refit_ships_centroids(self, tmp_path):
+        leader, rng, mbx, shipper, fidx, wf = self._pair(tmp_path)
+        wf.catch_up(timeout=30.0)
+        leader.insert(_rows(rng, 24))
+        assert leader.maybe_refit(force=True)   # KIND_CENTROIDS record
+        wf.drain()
+        # content_crc covers centroids: equality proves the refit's
+        # quantizer change crossed the wire
+        assert fidx.content_crc() == leader.content_crc()
+        self._down(shipper)
+
+    def test_gap_is_typed_and_drain_heals_it(self, tmp_path):
+        leader, rng, mbx, shipper, fidx, wf = self._pair(tmp_path)
+        wf.catch_up(timeout=30.0)
+        leader.insert(_rows(rng))
+        assert wf.drain() == 1
+        leader.insert(_rows(rng))               # shipped...
+        assert mbx.get_nowait(0, 1, TAG_WAL) is not None  # ...stolen
+        leader.insert(_rows(rng))
+        # resync=False surfaces the typed error with the cursor facts
+        with pytest.raises(WalGapError) as ei:
+            wf.drain(resync=False)
+        assert ei.value.expected == wf.applied_seq + 1
+        assert ei.value.got == ei.value.expected + 1
+        # steady-state drain turns the same gap into a catch-up round
+        leader.insert(_rows(rng))
+        wf.drain()
+        assert fidx.content_crc() == leader.content_crc()
+        assert wf.applied_seq == leader._applied_seq
+        self._down(shipper)
+
+    def test_pruned_wal_gap_resyncs_via_snapshot(self, tmp_path):
+        leader, rng, mbx, shipper, fidx, wf = self._pair(tmp_path)
+        wf.catch_up(timeout=30.0)
+        resyncs0 = wf.resyncs
+        # records shipped while the follower sleeps, then folded into
+        # an epoch and pruned — catch-up MUST fall back to a snapshot
+        while mbx.get_nowait(0, 1, TAG_WAL) is not None:
+            pass
+        ids = leader.insert(_rows(rng))
+        leader.delete(ids[:4])
+        while mbx.get_nowait(0, 1, TAG_WAL) is not None:
+            pass
+        leader.compact(reason="prune")          # WAL pruned to horizon
+        rpt = wf.catch_up(timeout=30.0)
+        assert rpt.snapshot and wf.resyncs == resyncs0 + 1
+        assert fidx.content_crc() == leader.content_crc()
+        self._down(shipper)
+
+    def test_duplicates_are_idempotent(self, tmp_path):
+        leader, rng, mbx, shipper, fidx, wf = self._pair(tmp_path)
+        wf.catch_up(timeout=30.0)
+        leader.insert(_rows(rng))
+        payload = mbx.get_nowait(0, 1, TAG_WAL)
+        mbx.put(0, 1, TAG_WAL, payload)         # deliver once...
+        mbx.put(0, 1, TAG_WAL, payload)         # ...and once again
+        assert wf.drain() == 1
+        assert wf.dups == 1
+        assert fidx.content_crc() == leader.content_crc()
+        self._down(shipper)
+
+    def test_mirror_restart_resumes_cursor(self, tmp_path):
+        leader, rng, mbx, shipper, fidx, wf = self._pair(tmp_path)
+        wf.catch_up(timeout=30.0)
+        ids = leader.insert(_rows(rng))
+        leader.delete(ids[::2])
+        wf.drain()
+        cursor = wf.applied_seq
+        crc = fidx.content_crc()
+        # "SIGKILL": drop the in-memory follower, recover from its
+        # mirrored journal — state AND cursor survive
+        del fidx, wf
+        fidx2 = StreamingIndex.recover(None, str(tmp_path / "follower"))
+        assert fidx2._applied_seq == cursor
+        assert fidx2.content_crc() == crc
+        wf2 = WalFollower(fidx2, mbx, 1, 0)
+        leader.insert(_rows(rng))               # stream continues
+        wf2.drain()
+        assert fidx2.content_crc() == leader.content_crc()
+        self._down(shipper)
+
+    def test_catchup_under_load_holds_recall_floor(self, tmp_path,
+                                                   live_obs):
+        leader, rng, mbx, shipper, fidx, wf = self._pair(
+            tmp_path, follower_dir=False)
+        for _ in range(4):
+            ids = leader.insert(_rows(rng))
+            leader.delete(ids[::4])
+        rep = catchup_under_load(wf, k=5, nprobe=L,
+                                 target_seq=leader._applied_seq,
+                                 rows=4, seed=1)
+        self._down(shipper)
+        assert rep.applied_seq >= rep.target_seq
+        assert rep.queries >= 1
+        assert rep.min_recall >= 0.99, rep.as_dict()
+        assert rep.resyncs == 1                 # blank cursor
+        assert fidx.content_crc() == leader.content_crc()
+        assert _counter(live_obs, "replica_catchups_total") >= 1
+        snap = live_obs.snapshot().get("replica_catchup_seconds")
+        assert snap and snap["series"][0]["count"] >= 1
+
+    def test_shipper_validation(self, tmp_path, res):
+        rng = np.random.default_rng(0)
+        db = rng.normal(size=(96, D)).astype(np.float32)
+        bare = stream_build(None, db, 4, seed=0, max_iter=4)
+        mbx = _Mailbox()
+        with pytest.raises(StreamingError, match="journaled"):
+            WalShipper(bare, mbx, 0, [1])
+        leader, _ = _leader(tmp_path)
+        with pytest.raises(ValueError, match="follow itself"):
+            WalShipper(leader, mbx, 0, [0, 1])
+        with pytest.raises(ValueError, match="follow itself"):
+            WalFollower(leader, mbx, 2, 2)
+        s = WalShipper(leader, mbx, 0, [1]).attach()
+        with pytest.raises(StreamingError, match="on_append"):
+            WalShipper(leader, mbx, 0, [1]).attach()
+        s.detach()
+
+
+# ---------------------------------------------------------------------------
+# scrub + read-repair (tentpole part 3)
+# ---------------------------------------------------------------------------
+
+
+class TestScrub:
+    def test_clean_pass_counts_files(self, tmp_path, live_obs):
+        leader, rng = _leader(tmp_path)
+        leader.insert(_rows(rng))
+        sc = Scrubber(leader, interval=10.0)
+        rep = sc.run_once()
+        assert rep.files_checked >= 2           # epoch(s) + WAL record
+        assert not rep.corrupt and not rep.quarantined
+        assert _counter(live_obs, "scrub_passes_total") == 1
+
+    def test_corrupt_epoch_quarantined_and_repaired(self, tmp_path,
+                                                    live_obs):
+        leader, rng = _leader(tmp_path)
+        leader.insert(_rows(rng))
+        crc = leader.content_crc()
+        faults = FaultInjector()
+        newest = leader.log.epoch_path(max(leader.log.epoch_steps()))
+        faults.corrupt_bytes(newest)
+        sc = Scrubber(leader, interval=10.0)
+        rep = sc.run_once()
+        name = os.path.basename(newest)
+        assert rep.corrupt == [name]
+        assert rep.quarantined == [name]
+        assert rep.repaired == [name]           # rewritten from memory
+        assert os.path.exists(newest + ".quarantined")
+        # redundancy restored: the next pass is clean AND a cold
+        # recover reproduces the live content exactly
+        rep2 = sc.run_once()
+        assert not rep2.corrupt
+        recovered = StreamingIndex.recover(None, leader.log.directory)
+        assert recovered.content_crc() == crc
+        fam = live_obs.snapshot()["scrub_corruptions_total"]
+        outcomes = {s["labels"]["outcome"]: s["value"]
+                    for s in fam["series"]}
+        assert outcomes == {"repaired": 1.0}
+
+    def test_corrupt_wal_superseded_by_epoch_rewrite(self, tmp_path):
+        leader, rng = _leader(tmp_path)
+        ids = leader.insert(_rows(rng))
+        leader.delete(ids[:3])                  # in-place → WAL record
+        crc = leader.content_crc()
+        wal = [os.path.join(leader.log.directory, f)
+               for f in sorted(os.listdir(leader.log.directory))
+               if f.startswith("wal-")]
+        assert wal
+        FaultInjector().corrupt_bytes(wal[-1])
+        rep = Scrubber(leader, interval=10.0).run_once()
+        assert rep.repaired
+        recovered = StreamingIndex.recover(None, leader.log.directory)
+        assert recovered.content_crc() == crc
+
+    def test_cold_directory_repairs_from_source(self, tmp_path):
+        leader, rng = _leader(tmp_path)
+        leader.insert(_rows(rng))
+        crc = leader.content_crc()
+        # clone the journal to a "dead replica" directory, damage every
+        # epoch, and repair from the healthy peer's entries
+        cold = str(tmp_path / "cold")
+        shutil.copytree(leader.log.directory, cold)
+        log = MutationLog(cold)
+        faults = FaultInjector()
+        for step in log.epoch_steps():
+            faults.corrupt_bytes(log.epoch_path(step))
+        for f in sorted(os.listdir(cold)):      # and the WAL suffix
+            if f.startswith("wal-"):
+                faults.corrupt_bytes(os.path.join(cold, f))
+        sc = Scrubber(log=log,
+                      repair_source=lambda: _epoch_entries(leader),
+                      interval=10.0)
+        rep = sc.run_once()
+        assert rep.quarantined and rep.repaired
+        recovered = StreamingIndex.recover(None, cold)
+        assert recovered.content_crc() == crc
+
+    def test_cold_directory_unrepairable_raises_typed(self, tmp_path):
+        leader, rng = _leader(tmp_path)
+        log = MutationLog(str(tmp_path / "dead"))
+        entries = _epoch_entries(leader)
+        log.write_epoch(0, entries)
+        FaultInjector().corrupt_bytes(log.epoch_path(0))
+        sc = Scrubber(log=log, interval=10.0)
+        with pytest.raises(ShardCorruptError) as ei:
+            sc.run_once()
+        assert "epoch-00000000" in ei.value.shard
+        assert os.path.exists(log.epoch_path(0) + ".quarantined")
+
+    def test_memory_sidecar_detects_ram_damage(self, tmp_path):
+        leader, rng = _leader(tmp_path)
+        sc = Scrubber(leader, interval=10.0)
+        sc.run_once()                           # baseline sidecar
+        # flip a tombstone bit behind the index's back: same snapshot
+        # version, different bytes — the RAM-damage signature
+        leader._tomb_host[0] ^= np.uint32(1)
+        with pytest.raises(ShardCorruptError, match="memory"):
+            sc.run_once()
+
+    def test_memory_sidecar_repairs_from_source(self, tmp_path,
+                                                live_obs):
+        leader, rng = _leader(tmp_path)
+        healthy = _epoch_entries(leader)
+        crc = leader.content_crc()
+        sc = Scrubber(leader, repair_source=lambda: dict(healthy),
+                      interval=10.0)
+        sc.run_once()
+        leader._tomb_host[0] ^= np.uint32(1)
+        rep = sc.run_once()
+        assert rep.memory_repaired
+        assert leader.content_crc() == crc
+        assert _counter(live_obs, "scrub_memory_repairs_total") == 1
+
+    def test_background_thread_scrubs_on_interval(self, tmp_path):
+        leader, rng = _leader(tmp_path)
+        sc = Scrubber(leader, interval=0.02)
+        with sc:
+            deadline = time.monotonic() + 5.0
+            while sc.passes < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        assert sc.passes >= 2
+
+    def test_validation(self, tmp_path):
+        leader, rng = _leader(tmp_path)
+        with pytest.raises(ValueError, match="journal"):
+            Scrubber()
+        with pytest.raises(ValueError, match="not both"):
+            Scrubber(leader, log=MutationLog(str(tmp_path / "other")))
+        with pytest.raises(ValueError, match="interval"):
+            Scrubber(leader, interval=0.0)
+
+
+# ---------------------------------------------------------------------------
+# env knobs (satellite: fail-loud configuration)
+# ---------------------------------------------------------------------------
+
+
+class TestEnvKnobs:
+    @pytest.mark.parametrize("name,bad,good,parsed", [
+        ("RAFT_TPU_WAL_RETAIN", "0", "3", 3),
+        ("RAFT_TPU_WAL_RETAIN", "two", "2", 2),
+        ("RAFT_TPU_SCRUB_INTERVAL", "-1", "0.5", 0.5),
+        ("RAFT_TPU_SCRUB_INTERVAL", "fast", "2.0", 2.0),
+    ])
+    def test_registered_fail_loud(self, monkeypatch, name, bad, good,
+                                  parsed):
+        monkeypatch.setenv(name, bad)
+        with pytest.raises(ValueError, match=name):
+            env.read(name)
+        monkeypatch.setenv(name, good)
+        assert env.read(name) == parsed
+
+    def test_malformed_knob_fails_in_subprocess(self, tmp_path):
+        """The knob is read at MutationLog construction — a malformed
+        value must kill the process loudly, not default silently."""
+        code = ("from raft_tpu.neighbors.streaming import MutationLog\n"
+                f"MutationLog({str(tmp_path / 'j')!r})\n")
+        env2 = dict(os.environ)
+        env2["RAFT_TPU_WAL_RETAIN"] = "-2"
+        env2["JAX_PLATFORMS"] = "cpu"
+        env2["PYTHONPATH"] = _REPO + os.pathsep + env2.get(
+            "PYTHONPATH", "")
+        p = subprocess.run([sys.executable, "-c", code], env=env2,
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode != 0
+        assert "RAFT_TPU_WAL_RETAIN" in p.stderr
+
+    def test_retain_knob_drives_wal_pruning(self, tmp_path,
+                                            monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_WAL_RETAIN", "4")
+        log = MutationLog(str(tmp_path / "j"))
+        assert log.retain == 4
+        assert MutationLog(str(tmp_path / "k"), retain=1).retain == 1
+
+    def test_scrub_interval_knob(self, tmp_path, monkeypatch):
+        leader, rng = _leader(tmp_path)
+        monkeypatch.setenv("RAFT_TPU_SCRUB_INTERVAL", "7.5")
+        assert Scrubber(leader).interval == 7.5
+
+
+# ---------------------------------------------------------------------------
+# kmeans_partial_fit checkpointing (satellite: PR-8 boundary pattern)
+# ---------------------------------------------------------------------------
+
+
+class TestPartialFitCheckpoint:
+    def test_resume_is_bit_equal_to_uninterrupted(self, res, tmp_path):
+        from raft_tpu.cluster.kmeans import kmeans_partial_fit
+
+        rng = np.random.default_rng(5)
+        c0 = rng.normal(size=(4, 6)).astype(np.float32)
+        batch = rng.normal(size=(64, 6)).astype(np.float32)
+        ref_c, ref_n = kmeans_partial_fit(res, c0, batch, chunk_rows=8)
+
+        ck = str(tmp_path / "pf")
+        kmeans_partial_fit(res, c0, batch, chunk_rows=8,
+                           checkpoint_dir=ck, checkpoint_every=1)
+        saved = sorted(f for f in os.listdir(ck)
+                       if f.startswith("kmeans_pf-"))
+        assert saved, "boundary hook never saved"
+        # resume from a MID-batch checkpoint (not the final one): the
+        # remaining chunks replay to the exact uninterrupted result
+        mid = os.path.join(ck, saved[0])
+        chunk = int(restore_checkpoint(mid)["chunk"])
+        assert 0 < chunk < 8
+        out_c, out_n = kmeans_partial_fit(res, c0, batch, chunk_rows=8,
+                                          resume_from=mid)
+        np.testing.assert_array_equal(np.asarray(out_c),
+                                      np.asarray(ref_c))
+        np.testing.assert_array_equal(np.asarray(out_n),
+                                      np.asarray(ref_n))
+
+    def test_resume_beyond_batch_raises(self, res, tmp_path):
+        from raft_tpu.cluster.kmeans import kmeans_partial_fit
+
+        rng = np.random.default_rng(5)
+        c0 = rng.normal(size=(4, 6)).astype(np.float32)
+        batch = rng.normal(size=(64, 6)).astype(np.float32)
+        ck = str(tmp_path / "pf")
+        kmeans_partial_fit(res, c0, batch, chunk_rows=8,
+                           checkpoint_dir=ck, checkpoint_every=1)
+        newest = sorted(f for f in os.listdir(ck)
+                        if f.startswith("kmeans_pf-"))[-1]
+        short = rng.normal(size=(8, 6)).astype(np.float32)
+        with pytest.raises(ValueError, match="SAME batch"):
+            kmeans_partial_fit(res, c0, short, chunk_rows=8,
+                               resume_from=os.path.join(ck, newest))
+
+    def test_checkpoint_every_requires_dir(self, res):
+        from raft_tpu.cluster.kmeans import kmeans_partial_fit
+
+        rng = np.random.default_rng(5)
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            kmeans_partial_fit(res,
+                               rng.normal(size=(4, 6)).astype("float32"),
+                               rng.normal(size=(16, 6)).astype("float32"),
+                               checkpoint_every=1)
+
+
+# ---------------------------------------------------------------------------
+# frozen on-disk format (satellite: compat fixture)
+# ---------------------------------------------------------------------------
+
+
+class TestFrozenEpochFixture:
+    # cut by this PR from a deterministic build+insert+delete+compact;
+    # the constants are the fixture's frozen identity — readers must
+    # load these exact bytes forever (format changes bump the version)
+    CRC = 1456153610
+    N_LIVE = 108
+    HORIZON = 1
+
+    def test_fixture_recovers_forever(self, tmp_path):
+        shutil.copyfile(_FIXTURE,
+                        str(tmp_path / "epoch-00000000.ckpt"))
+        idx = StreamingIndex.recover(None, str(tmp_path))
+        assert idx.content_crc() == self.CRC
+        assert idx.n_live == self.N_LIVE
+        assert idx._applied_seq == self.HORIZON
+
+    def test_fixture_entries_schema(self):
+        ent = restore_checkpoint(_FIXTURE)
+        for key in ("epoch", "next_id", "n_live", "n_db", "metric",
+                    "centroids", "packed_db", "packed_ids", "starts",
+                    "sizes", "caps", "tomb_words", "wal_horizon"):
+            assert key in ent, key
+
+
+# ---------------------------------------------------------------------------
+# fleet rejoin (satellite: ReplicaGroup.spawn)
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaSpawn:
+    def _fleet(self, res, n=2):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.neighbors.ivf_mnmg import build_mnmg
+        from raft_tpu.serve import (BatchPolicy, Executor,
+                                    IvfMnmgKnnService, ReplicaGroup)
+
+        rng = np.random.default_rng(2)
+        X = rng.standard_normal((256, 12)).astype(np.float32)
+        flat = ivf_flat.build(res, X, 8, seed=0, max_iter=4)
+        idx = build_mnmg(res, X, 8, 2, flat=flat)
+
+        def make_ex():
+            ex = Executor([IvfMnmgKnnService(idx, k=4, nprobe=3)],
+                          policy=BatchPolicy(max_batch=32,
+                                             max_wait_ms=1.0))
+            ex.warm([8])
+            return ex
+
+        op = f"ivf_mnmg_k4_np3_r{idx.n_ranks}_{idx.metric}"
+        return X, ReplicaGroup([make_ex() for _ in range(n)]), make_ex, op
+
+    def test_spawn_joins_at_vtime_floor(self, res):
+        X, group, make_ex, op = self._fleet(res)
+        with group:
+            for _ in range(10):
+                group.route(op, X[:8])[1].result(timeout=60.0)
+            floor = min(r.vtime for r in group.replicas)
+            assert floor > 0.0
+            rep = group.spawn("joiner", make_ex())
+            assert rep.vtime == 0.0 and rep.healthy
+            # the joiner is the fair-queue minimum, so it serves next —
+            # and its clock snaps to the fleet floor, never a flood
+            served, fut = group.route(op, X[:8])
+            fut.result(timeout=60.0)
+            assert served.name == "joiner"
+            assert served.vtime >= floor
+        assert len(group.replicas) == 3
+
+    def test_spawn_zero_post_warm_recompiles(self, res):
+        X, group, make_ex, op = self._fleet(res)
+        with group:
+            group.route(op, X[:8])[1].result(timeout=60.0)
+            ex = make_ex()                      # warmed BEFORE routable
+            group.spawn("joiner", ex, warm=False)  # already warm
+            traces0 = ex.stats.traces
+            misses0 = ex.stats.exec_misses
+            for _ in range(6):
+                group.route(op, X[:8])[1].result(timeout=60.0)
+            assert ex.stats.requests > 0        # the joiner did serve
+            assert ex.stats.traces == traces0
+            assert ex.stats.exec_misses == misses0
+
+    def test_spawn_validation(self, res):
+        X, group, make_ex, op = self._fleet(res)
+        with pytest.raises(ValueError, match="weight"):
+            group.spawn("w", make_ex(), weight=0.0)
+        with pytest.raises(ValueError, match="rejoin"):
+            group.spawn("replica0", make_ex())
+
+
+# ---------------------------------------------------------------------------
+# the two-process SIGKILL witness (slow tier — smoke.sh runs it too)
+# ---------------------------------------------------------------------------
+
+
+class TestDurabilityChaos:
+    @pytest.mark.slow
+    def test_sigkill_restart_catchup_bit_equal(self):
+        """Follower SIGKILL'd mid-stream, restarted from its mirrored
+        journal, catches up under query load: CRC equal to the leader
+        AND a clean never-killed twin; recall floor held throughout."""
+        worker = os.path.join(_REPO, "tests", "_durability_worker.py")
+        env2 = dict(os.environ)
+        env2["JAX_PLATFORMS"] = "cpu"
+        env2["PYTHONPATH"] = _REPO + os.pathsep + env2.get(
+            "PYTHONPATH", "")
+        p = subprocess.run([sys.executable, worker, "orchestrate"],
+                           cwd=_REPO, env=env2, capture_output=True,
+                           text=True, timeout=480)
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "DURABILITY_CHAOS_OK" in p.stdout, p.stdout
