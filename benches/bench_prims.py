@@ -1204,6 +1204,63 @@ def bench_ivf_recall():
     return out
 
 
+@bench("neighbors/ivf_pq_recall")
+def bench_ivf_pq_recall():
+    """IVF-PQ recall-vs-latency-vs-memory (era 19): the claim a
+    product-quantized row has to make is three-sided — queries/sec at
+    a stated recall@k at a stated compression. One blobs database, one
+    brute baseline row, a flat index built ONLY to measure the bytes
+    PQ saves, then a (nprobe, refine) sweep ending at the full-scan
+    delegation point. Every sweep row stamps recall_at_k AND
+    compression_ratio (flat index bytes / PQ index bytes, read off the
+    packed arrays actually resident — not estimated) next to
+    scanned_frac and speedup_vs_brute."""
+    import raft_tpu
+    from raft_tpu.neighbors import ivf_flat, ivf_pq, knn
+    from raft_tpu.random import RngState, make_blobs
+
+    full = SIZES["rows"] >= (1 << 20)
+    # full = the acceptance shape (1M×128, m=16); small = CPU-proxy
+    n, q, d, n_lists, k, m = ((1 << 20, 256, 128, 1024, 10, 16) if full
+                              else (1 << 14, 128, 32, 64, 10, 8))
+    res = raft_tpu.device_resources(seed=0)
+    X, _, _ = make_blobs(res, RngState(19), n, d, n_clusters=n_lists)
+    queries = X[:q]
+    brute = jax.jit(functools.partial(knn, None, k=k))
+    gd, gi = brute(X, queries)
+    ground = np.asarray(gi)
+    out = [run_case("neighbors/ivf_pq_brute_baseline", brute, X,
+                    queries, items=q, n=n, d=d, k=k)]
+    flat = ivf_flat.build(res, X, n_lists, seed=0,
+                          max_iter=10 if full else 25)
+    flat_bytes = int(flat.packed_db.nbytes + flat.packed_ids.nbytes
+                     + flat.centroids.nbytes + flat.starts.nbytes
+                     + flat.sizes.nbytes)
+    idx = ivf_pq.build(res, X, n_lists, m=m, nbits=8,
+                       centroids=flat.centroids,
+                       pq_max_iter=10 if full else 6, seed=0)
+    del flat
+    compr = round(flat_bytes / idx.device_bytes(), 2)
+    base_ms = out[0].median_ms
+    for nprobe, refine in ((1, 0), (4, 0), (16, 0), (16, 4 * k),
+                           (n_lists, 4 * k)):
+        f = functools.partial(ivf_pq.search, None, idx, queries, k,
+                              nprobe, refine=refine)
+        _, ai = f()
+        hits = np.asarray([len(set(a) & set(b)) for a, b in
+                           zip(ground, np.asarray(ai))])
+        r = run_case(
+            f"neighbors/ivf_pq_search_np{nprobe}_rf{refine}", f,
+            items=q, n=n, d=d, k=k, n_lists=n_lists, nprobe=nprobe,
+            refine=refine, m=m, nbits=idx.nbits,
+            recall_at_k=round(float(hits.mean()) / k, 4),
+            compression_ratio=compr,
+            scanned_frac=round(idx.scanned_fraction(nprobe), 4))
+        r.params["speedup_vs_brute"] = round(base_ms / r.median_ms, 2)
+        out.append(r)
+    return out
+
+
 @bench("neighbors/ivf_mnmg_scaling")
 def bench_ivf_mnmg_scaling():
     """Sharded IVF serving scaling (era 11): one database, one rank

@@ -67,7 +67,14 @@ import jax
 # rebuild under distribution drift; rows carry the durability
 # witnesses (crc_match, detect_repair_ok, recall floors) the CI gates
 # assert on.
-BENCH_ERA = 18
+# Era 19: product quantization (neighbors/ivf_pq.py) shrinks the
+# resident index to m uint8 codes/row + shared codebooks. The
+# neighbors/ivf_pq_recall family's rows sweep (nprobe, refine) and
+# stamp recall_at_k NEXT TO compression_ratio (flat index bytes / PQ
+# index bytes, measured from the packed arrays) — a PQ row's recall
+# is meaningless without the memory it was bought back with, and CI
+# gates assert both witnesses.
+BENCH_ERA = 19
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

@@ -42,8 +42,8 @@ def main(path: str) -> None:
         print("(no results)")
         return
     print("| bench | median ms | throughput | roofline | bar | recall@k "
-          "| qps @ ranks | dev/host ms per iter | params |")
-    print("|---|---|---|---|---|---|---|---|---|")
+          "| compr | qps @ ranks | dev/host ms per iter | params |")
+    print("|---|---|---|---|---|---|---|---|---|---|")
     # device_ms_per_iter / host_overhead_ms_per_iter: the era-8
     # compiled-inner-loop split on MULTICHIP solver rows. Rendered as
     # its own column so a collective-overhead claim has to show the
@@ -64,7 +64,7 @@ def main(path: str) -> None:
             "device_ms_per_iter", "host_overhead_ms_per_iter",
             "recall_at_k", "serve_qps", "mxu_frac", "hbm_frac",
             "roofline_frac", "bar_ms", "bar_gb_s", "bar_iters_per_s",
-            "bar_mxu_frac", "model_cut"}
+            "bar_mxu_frac", "model_cut", "compression_ratio"}
     for r in sorted(rows, key=lambda r: r["bench"]):
         thr = ""
         for k, unit in (("GFLOP_per_s", "GFLOP/s"), ("GB_per_s", "GB/s"),
@@ -100,6 +100,12 @@ def main(path: str) -> None:
         recall = ""
         if r.get("recall_at_k") is not None:
             recall = f"{r['recall_at_k']}"
+        # compression_ratio: the era-19 PQ column — an ANN row that
+        # quantizes the database has to show the recall next to the
+        # HBM bytes it saved (flat index bytes / PQ index bytes)
+        compr = ""
+        if r.get("compression_ratio") is not None:
+            compr = f"{float(r['compression_ratio']):.1f}x"
         qps_ranks = ""
         if r.get("serve_qps") is not None:
             qps_ranks = (f"{r['serve_qps']} @ "
@@ -109,7 +115,8 @@ def main(path: str) -> None:
                            and k not in ("GFLOP_per_s", "GB_per_s",
                                          "items_per_s"))
         print(f"| {r['bench']} | {r['median_ms']} | {thr} | {roof} "
-              f"| {bar} | {recall} | {qps_ranks} | {split} | {params} |")
+              f"| {bar} | {recall} | {compr} | {qps_ranks} | {split} "
+              f"| {params} |")
 
 
 if __name__ == "__main__":

@@ -751,6 +751,78 @@ print(f"ivf gate: nprobe=n_lists bit-identical to brute force; "
       f"zero post-warm recompiles, batched bits == eager bits")
 PYEOF
 
+# IVF-PQ gate (ISSUE 19 acceptance): the product-quantized index
+# clears the recall floor at nprobe=16 WITH the refine stage armed,
+# the full-probe+full-refine path is BIT-identical to brute_force.knn,
+# the packed index costs <= 1/8 of the flat layout's resident bytes at
+# the acceptance shape, and the serving IvfPqKnnService warms to zero
+# post-warm recompiles with batched answers bit-identical to eager.
+JAX_PLATFORMS=cpu python - <<'PYEOF'
+import numpy as np
+
+import raft_tpu
+from raft_tpu import serve
+from raft_tpu.neighbors import ivf_flat, ivf_pq, knn
+from raft_tpu.random import RngState, make_blobs
+
+res = raft_tpu.device_resources(seed=0)
+X, _, _ = make_blobs(res, RngState(5), 8192, 32, n_clusters=64)
+idx = ivf_pq.build(res, X, 64, m=8, nbits=8, seed=0)
+q = np.asarray(X[:128])
+
+# exactness boundary: full probe + full refine == brute force, bit
+# for bit (nprobe >= n_lists delegates to the exact scan over the
+# host-resident raw rows, so refine cannot perturb it either)
+bd, bi = knn(res, X, q, k=10)
+ad, ai = ivf_pq.search(res, idx, q, k=10, nprobe=idx.n_lists,
+                       refine=40)
+np.testing.assert_array_equal(np.asarray(bd), np.asarray(ad))
+np.testing.assert_array_equal(np.asarray(bi), np.asarray(ai))
+
+# recall floor at a partial probe with refine re-scoring the ADC
+# candidates against the raw vectors
+_, pi = ivf_pq.search(res, idx, q, k=10, nprobe=16, refine=40)
+gi, pi = np.asarray(bi), np.asarray(pi)
+recall = float(np.mean([len(set(a) & set(b)) / 10
+                        for a, b in zip(gi, pi)]))
+assert recall >= 0.9, f"refined recall@10 at nprobe=16 fell to {recall}"
+
+# memory contract at the acceptance shape (d=128, m=16, nbits=8):
+# PQ resident bytes <= 1/8 of the flat inverted-list layout, read off
+# the packed arrays actually built — not estimated
+rng = np.random.default_rng(29)
+M = rng.normal(size=(8192, 128)).astype(np.float32)
+flat = ivf_flat.build(res, M, 32, seed=0, max_iter=2)
+pq = ivf_pq.build(res, M, 32, m=16, nbits=8, seed=0, max_iter=2,
+                  pq_max_iter=2)
+flat_bytes = int(flat.packed_db.nbytes + flat.packed_ids.nbytes
+                 + flat.centroids.nbytes + flat.starts.nbytes
+                 + flat.sizes.nbytes)
+pq_bytes = int(pq.device_bytes())
+assert pq_bytes * 8 <= flat_bytes, (pq_bytes, flat_bytes)
+
+# serve path: warmed IvfPqKnnService, zero post-warm recompiles,
+# batched bits == eager bits
+svc = serve.IvfPqKnnService(idx, k=10, nprobe=16)
+assert svc.epilogue() == "ivf_pq"
+ex = serve.Executor([svc],
+                    policy=serve.BatchPolicy(max_batch=32,
+                                             max_wait_ms=1.0))
+ex.warm()
+t0 = ex.stats.traces
+with ex:
+    got = ex.submit(svc.name, q[:24]).result(timeout=120)
+assert ex.stats.traces == t0, \
+    f"steady-state serve must not recompile: {ex.stats.traces} != {t0}"
+want = ivf_pq.search(res, idx, q[:24], k=10, nprobe=16)
+for g, w in zip(got, want):
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+print(f"ivf_pq gate: full probe + refine bit-identical to brute "
+      f"force; refined recall@10={recall:.3f} at nprobe=16; index "
+      f"{flat_bytes / pq_bytes:.1f}x smaller than flat at d=128 m=16; "
+      f"IvfPqKnnService warmed with zero post-warm recompiles")
+PYEOF
+
 # Tracing gate (ISSUE 10 acceptance): a metrics+tracing-on loadgen run
 # must give EVERY completed request a full trace — a serve.request span
 # whose queue_wait/execute children share its trace_id, request_id, and
@@ -1785,5 +1857,64 @@ JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$DUR_ROWS" \
     --family-tol serve/durability_drift_rebuild=3.0 >/dev/null
 rm -f "$DUR_ROWS"
 echo "durability sentry: fresh current-era rows clear the shipped baseline"
+
+# IVF-PQ bench sentry (ISSUE 19): the neighbors/ivf_pq_recall family
+# must run on the CPU tier with every row stamped the current era, the
+# sweep rows carrying BOTH witnesses (recall_at_k next to the measured
+# compression_ratio), at least one swept (nprobe, refine) point
+# clearing recall@10 >= 0.9 at compression >= 8x, and the fresh rows
+# must clear the sentry against the shipped baseline (per-family
+# tolerance 3.0: CPU-proxy rows drift between container sessions).
+PQ_ROWS=$(mktemp /tmp/pq_rows.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python benches/run_benches.py \
+    --family neighbors/ivf_pq_recall > "$PQ_ROWS"
+python - "$PQ_ROWS" <<'PYEOF2'
+import json
+import sys
+
+from benches.harness import BENCH_ERA
+
+rows = {}
+with open(sys.argv[1]) as fh:
+    for line in fh:
+        line = line.strip()
+        if line:
+            row = json.loads(line)
+            if "bench" in row and row.get("median_ms") is not None:
+                rows[row["bench"]] = row
+
+expected = {"neighbors/ivf_pq_brute_baseline",
+            "neighbors/ivf_pq_search_np1_rf0",
+            "neighbors/ivf_pq_search_np4_rf0",
+            "neighbors/ivf_pq_search_np16_rf0",
+            "neighbors/ivf_pq_search_np16_rf40",
+            "neighbors/ivf_pq_search_np64_rf40"}
+missing = expected - set(rows)
+assert not missing, f"ivf_pq_recall family dropped rows: {missing}"
+best = 0.0
+compr = None
+for name, row in rows.items():
+    assert row["era"] == BENCH_ERA, (name, row.get("era"))
+    if name == "neighbors/ivf_pq_brute_baseline":
+        continue
+    assert row.get("recall_at_k") is not None, name
+    assert row.get("compression_ratio") is not None, name
+    compr = float(row["compression_ratio"])
+    assert compr >= 8.0, (name, compr)
+    if float(row["scanned_frac"]) < 1.0:
+        best = max(best, float(row["recall_at_k"]))
+assert best >= 0.9, f"no partial-probe sweep point reached 0.9: {best}"
+print(f"ivf_pq bench: {len(rows)} era-{BENCH_ERA} rows (best "
+      f"partial-probe recall@10 {best} at {compr}x compression)")
+PYEOF2
+JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$PQ_ROWS" \
+    --family-tol neighbors/ivf_pq_brute_baseline=3.0 \
+    --family-tol neighbors/ivf_pq_search_np1_rf0=3.0 \
+    --family-tol neighbors/ivf_pq_search_np4_rf0=3.0 \
+    --family-tol neighbors/ivf_pq_search_np16_rf0=3.0 \
+    --family-tol neighbors/ivf_pq_search_np16_rf40=3.0 \
+    --family-tol neighbors/ivf_pq_search_np64_rf40=3.0 >/dev/null
+rm -f "$PQ_ROWS"
+echo "ivf_pq sentry: fresh current-era rows clear the shipped baseline"
 
 echo "smoke: PASS"

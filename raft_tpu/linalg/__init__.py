@@ -74,10 +74,13 @@ from raft_tpu.linalg.pca import (  # noqa: F401
     Solver,
     PCAResult,
     TSVDResult,
+    IncrementalPCAState,
     pca_fit,
     pca_transform,
     pca_inverse_transform,
     pca_fit_transform,
+    pca_partial_fit,
+    pca_finalize,
     tsvd_fit,
     tsvd_transform,
     tsvd_inverse_transform,

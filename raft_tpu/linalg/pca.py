@@ -9,10 +9,14 @@ randomized path.  All heavy steps are MXU matmuls + XLA eigh/svd.
 from __future__ import annotations
 
 import enum
+import functools
 from typing import NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
+from raft_tpu.core import trace
 from raft_tpu.random.rng_state import RngState
 from raft_tpu.util.precision import with_matmul_precision
 
@@ -125,6 +129,206 @@ def pca_inverse_transform(res, T, result: PCAResult, whiten: bool = False):
 def pca_fit_transform(res, X, n_components: int, **kw):
     result = pca_fit(res, X, n_components, **kw)
     return pca_transform(res, X, result), result
+
+
+# -- incremental PCA (compiled-driver chunk runner) -------------------------
+
+
+class IncrementalPCAState(NamedTuple):
+    """Sufficient statistics for streaming PCA: running column mean,
+    centered scatter matrix ``S = Σ (x−μ)(x−μ)ᵀ`` and the row count.
+    Thread it through successive :func:`pca_partial_fit` calls, then
+    :func:`pca_finalize` turns it into a :class:`PCAResult`."""
+
+    mean: jnp.ndarray     # [n_cols] float32
+    scatter: jnp.ndarray  # [n_cols, n_cols] float32
+    count: jnp.ndarray    # scalar float32
+
+
+@with_matmul_precision
+@functools.partial(jax.jit, static_argnames=("chunk_rows",),
+                   donate_argnums=(2,))
+def _ipca_chunk(x, valid, carry, steps, *, chunk_rows: int):
+    """Up to ``steps`` mini-batch scatter merges as one device program.
+
+    Each step consumes one ``chunk_rows`` slice of the padded batch and
+    folds it into the running (mean, scatter, count) with Chan's
+    parallel update — exact in infinite precision, numerically stable
+    because each chunk is centered about its OWN mean before the rank-d
+    correction.  ``valid`` zero-weights pad rows: a fully-pad chunk has
+    ``nb == 0``, which zeroes both the mean step and the cross term, so
+    padding never perturbs the statistics."""
+    from raft_tpu.runtime.compiled_driver import chunk_while
+
+    n_chunks = x.shape[0] // chunk_rows
+
+    def step(carry):
+        mean, S, count, j = carry
+        # index pair must share j's dtype (see _minibatch_chunk)
+        rows = lax.dynamic_slice(
+            x, (j * chunk_rows, jnp.zeros((), j.dtype)),
+            (chunk_rows, x.shape[1]))
+        vw = lax.dynamic_slice(valid, (j * chunk_rows,), (chunk_rows,))
+        nb = jnp.sum(vw)
+        mean_b = (jnp.sum(rows * vw[:, None], axis=0)
+                  / jnp.maximum(nb, 1.0))
+        centered = (rows - mean_b[None, :]) * vw[:, None]
+        scatter_b = centered.T @ centered
+        new_count = count + nb
+        safe = jnp.maximum(new_count, 1.0)
+        delta = mean_b - mean
+        new_mean = mean + delta * (nb / safe)
+        new_S = (S + scatter_b
+                 + (count * nb / safe) * jnp.outer(delta, delta))
+        return (new_mean, new_S, new_count, j + 1), (j + 1) >= n_chunks
+
+    return chunk_while(step, carry, steps)
+
+
+@with_matmul_precision
+def pca_partial_fit(res, batch, *, state: Optional[
+        IncrementalPCAState] = None, chunk_rows: int = 256,
+        sync_every=None, checkpoint_dir: Optional[str] = None,
+        checkpoint_every: Optional[int] = None, checkpoint_keep: int = 2,
+        resume_from: Optional[str] = None) -> IncrementalPCAState:
+    """Absorb one mini-batch into streaming PCA sufficient statistics
+    (incremental PCA à la Ross et al. / sklearn's partial_fit, spelled
+    as Chan's parallel mean/scatter merge).  Returns the updated
+    :class:`IncrementalPCAState`; pass ``state=None`` to start cold and
+    thread the result through successive calls, then call
+    :func:`pca_finalize` for the eigendecomposition.
+
+    The batch is consumed in ``chunk_rows`` slices through the
+    compiled-driver chunk runner — the same boundary the mini-batch
+    k-means refit rides — so the stream inherits the driver's
+    checkpoint/deadline/trace hooks for free.  ``checkpoint_every`` (in
+    boundary units; requires ``checkpoint_dir``) saves
+    ``(mean, scatter, count, chunk)`` at chunk boundaries (prefix
+    ``pca_pf``), and ``resume_from`` restarts mid-batch from the saved
+    chunk cursor — the SAME ``batch`` must be passed again, since the
+    cursor indexes into it."""
+    from raft_tpu.runtime import compiled_driver, limits
+    from raft_tpu.util.input_validation import expect_2d
+
+    batch = jnp.asarray(batch)
+    expect_2d(batch, name="pca_partial_fit: batch")
+    if batch.shape[0] < 1:
+        raise ValueError("batch must have at least one row")
+    if chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+    d = int(batch.shape[1])
+    if state is None:
+        state = IncrementalPCAState(
+            jnp.zeros((d,), jnp.float32),
+            jnp.zeros((d, d), jnp.float32),
+            jnp.zeros((), jnp.float32))
+    else:
+        if state.mean.shape != (d,) or state.scatter.shape != (d, d):
+            raise ValueError(
+                f"state was fit on {state.mean.shape[0]} columns, "
+                f"batch has {d}")
+    n = int(batch.shape[0])
+    chunk_rows = min(int(chunk_rows), n)
+    n_chunks = -(-n // chunk_rows)
+    pad = n_chunks * chunk_rows - n
+    x = batch.astype(jnp.float32)
+    valid = jnp.ones((n,), jnp.float32)
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, d), jnp.float32)])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), jnp.float32)])
+    chunk_call = functools.partial(_ipca_chunk, x, valid,
+                                   chunk_rows=chunk_rows)
+    # per-chunk cost ≈ the centered scatter GEMM [chunk_rows,d]ᵀ@[..,d]
+    dims = dict(m=d, n=d, k=chunk_rows, itemsize=4)
+    est = limits.estimate_seconds("linalg.gemm", **dims)
+    sf, sb = limits.estimate_flops_bytes("linalg.gemm", **dims)
+    sync = compiled_driver.resolve_sync_every(sync_every)
+
+    import numpy as np
+
+    manager = None
+    if checkpoint_every is not None:
+        if checkpoint_dir is None:
+            raise ValueError("checkpoint_every requires checkpoint_dir")
+        from raft_tpu.core import checkpoint as core_ckpt
+
+        manager = core_ckpt.CheckpointManager(
+            checkpoint_dir, prefix="pca_pf", keep=checkpoint_keep)
+    start_chunk = 0
+    mean, S, count = state.mean, state.scatter, state.count
+    if resume_from is not None:
+        from raft_tpu.cluster.kmeans import _load_kmeans_checkpoint
+
+        entries = _load_kmeans_checkpoint(resume_from, prefix="pca_pf")
+        mean = jnp.asarray(np.asarray(entries["mean"]), jnp.float32)
+        S = jnp.asarray(np.asarray(entries["scatter"]), jnp.float32)
+        count = jnp.asarray(np.asarray(entries["count"]), jnp.float32)
+        start_chunk = int(entries["chunk"])
+        if start_chunk > n_chunks:
+            raise ValueError(
+                f"resume_from chunk {start_chunk} beyond this batch's "
+                f"{n_chunks} chunks — pass the SAME batch the "
+                "checkpoint was cut from")
+
+    boundary = None
+    if manager is not None:
+        stride = sync * max(1, int(checkpoint_every))
+        last_saved = [start_chunk if resume_from is not None else -1]
+
+        def boundary(cr, steps_done, done_flag):
+            if steps_done > 0 and (
+                    steps_done - max(last_saved[0], 0) >= stride
+                    or ((done_flag or steps_done >= n_chunks)
+                        and steps_done != last_saved[0])):
+                manager.save(steps_done, {
+                    "mean": np.asarray(cr[0]),
+                    "scatter": np.asarray(cr[1]),
+                    "count": np.asarray(cr[2]),
+                    "chunk": int(steps_done),
+                })
+                last_saved[0] = steps_done
+
+    carry = (mean, S, count, jnp.asarray(start_chunk, jnp.int32))
+    carry, n_steps, _ = compiled_driver.run_chunked(
+        chunk_call, carry, max_steps=n_chunks, sync_every=sync,
+        op="linalg.pca_partial_fit", steps_done=start_chunk,
+        est_step_seconds=est, step_flops=sf, step_bytes=sb,
+        boundary=boundary)
+    trace.record_event("pca.partial_fit", rows=n, n_cols=d,
+                       chunks=int(n_steps), chunk_rows=chunk_rows)
+    return IncrementalPCAState(carry[0], carry[1], carry[2])
+
+
+def pca_finalize(res, state: IncrementalPCAState, n_components: int,
+                 solver: Solver = Solver.COV_EIG_DQ) -> PCAResult:
+    """Eigendecompose accumulated sufficient statistics into the same
+    :class:`PCAResult` a monolithic :func:`pca_fit` returns — with
+    enough rows streamed, ``pca_finalize(pca_partial_fit(...))``
+    converges to ``pca_fit`` on the concatenated stream."""
+    from raft_tpu.util.input_validation import expect_positive
+
+    expect_positive(n_components, name="pca_finalize: n_components")
+    n_rows = int(state.count)
+    if n_rows < 2:
+        raise ValueError(
+            f"pca_finalize needs >= 2 absorbed rows, got {n_rows}")
+    d = int(state.mean.shape[0])
+    cov = state.scatter / (n_rows - 1)
+    w, v = cal_eig(res, cov, n_components, solver)
+    explained = w
+    s = jnp.sqrt(jnp.maximum(w * (n_rows - 1), 0.0))
+    comps = sign_flip_components(v.T)
+    total_var = jnp.trace(state.scatter) / (n_rows - 1)
+    ratio = explained / total_var
+    if n_components < min(n_rows, d):
+        noise = (total_var - jnp.sum(explained)) / (
+            min(n_rows, d) - n_components)
+    else:
+        noise = jnp.asarray(0.0, jnp.float32)
+    f32 = jnp.float32
+    return PCAResult(comps.astype(f32), explained.astype(f32),
+                     ratio.astype(f32), s.astype(f32), state.mean,
+                     noise.astype(f32))
 
 
 # -- truncated SVD (no centering) -------------------------------------------

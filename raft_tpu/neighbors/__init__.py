@@ -12,6 +12,7 @@ O(n) scan into probes over a few lists.
 
 from raft_tpu.neighbors import ivf_flat  # noqa: F401
 from raft_tpu.neighbors import ivf_mnmg  # noqa: F401
+from raft_tpu.neighbors import ivf_pq  # noqa: F401
 from raft_tpu.neighbors import scrub  # noqa: F401
 from raft_tpu.neighbors import streaming  # noqa: F401
 from raft_tpu.neighbors import wal_ship  # noqa: F401
@@ -20,6 +21,7 @@ from raft_tpu.neighbors.ivf_flat import IvfFlatIndex  # noqa: F401
 from raft_tpu.neighbors.ivf_mnmg import (IvfMnmgIndex,  # noqa: F401
                                          build_mnmg, rebalance_mnmg,
                                          search_mnmg, shrink_mnmg)
+from raft_tpu.neighbors.ivf_pq import IvfPqIndex  # noqa: F401
 from raft_tpu.neighbors.scrub import Scrubber, ScrubReport  # noqa: F401
 from raft_tpu.neighbors.streaming import (Compactor,  # noqa: F401
                                           DriftGauge, MutationLog,
@@ -34,6 +36,7 @@ from raft_tpu.neighbors.wal_ship import (CatchupReport,  # noqa: F401
                                          bootstrap_follower)
 
 __all__ = ["knn", "knn_mnmg", "ivf_flat", "IvfFlatIndex",
+           "ivf_pq", "IvfPqIndex",
            "ivf_mnmg", "IvfMnmgIndex", "build_mnmg", "search_mnmg",
            "shrink_mnmg", "rebalance_mnmg",
            "streaming", "StreamingIndex", "StreamingMnmg",

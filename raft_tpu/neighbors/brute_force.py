@@ -285,28 +285,30 @@ def knn(res, db, queries, k: int, metric: str = "l2",
 
 def knn_plan(n_queries: int, n_db: int, k: int, metric: str = "l2",
              tile: Optional[int] = None, vma_blocked: bool = False,
-             n_lists: Optional[int] = None, nprobe: Optional[int] = None
-             ) -> Tuple[str, int]:
-    """Pure dispatch predictor for :func:`knn`: ("ivf" | "fused" |
-    "radix" | "scan", chunk). knn() itself routes through this, so the
-    answer can never drift from the real dispatch — the serving
-    executor quotes it per warmed service and the dispatch tests assert
-    on it. "radix" is the digit-histogram epilogue
+             n_lists: Optional[int] = None, nprobe: Optional[int] = None,
+             pq: bool = False) -> Tuple[str, int]:
+    """Pure dispatch predictor for :func:`knn`: ("ivf" | "ivf_pq" |
+    "fused" | "radix" | "scan", chunk). knn() itself routes through
+    this, so the answer can never drift from the real dispatch — the
+    serving executor quotes it per warmed service and the dispatch
+    tests assert on it. "radix" is the digit-histogram epilogue
     (:func:`_knn_chunked`): above the fused kernel's k <= 256 it is the
     only non-materialize+full-select path, per-chunk distances bounded
     and selected at bandwidth class. ``vma_blocked``: the caller saw
     vma-carrying operands under the interpreter
     (pallas_utils.interpret_needs_ref) — both Pallas paths fall back to
-    the scan there. ``n_lists``/``nprobe``: an IVF-Flat caller
-    (:mod:`raft_tpu.neighbors.ivf_flat` / the serving IvfKnnService)
-    quoting its route — partial probes take the "ivf" probe scan;
-    nprobe >= n_lists is a full scan and falls through to the exact
-    brute-force plan it delegates to."""
+    the scan there. ``n_lists``/``nprobe``: an IVF caller
+    (:mod:`raft_tpu.neighbors.ivf_flat` / :mod:`raft_tpu.neighbors
+    .ivf_pq` / the serving Ivf[Pq]KnnService) quoting its route —
+    partial probes take the probe scan, "ivf_pq" when ``pq`` marks the
+    index as product-quantized (the ADC LUT formulation); nprobe >=
+    n_lists is a full scan and falls through to the exact brute-force
+    plan both delegate to."""
     from raft_tpu.neighbors import fused_topk
 
     kernel_metric = _resolve_metric(metric)
     if n_lists is not None and nprobe is not None and nprobe < n_lists:
-        return "ivf", 0
+        return ("ivf_pq" if pq else "ivf"), 0
     if (fused_topk.supports(k) and (tile is None or tile >= 128)
             and kernel_metric in ("l2", "cosine", "inner")
             and not vma_blocked):

@@ -1,0 +1,575 @@
+"""IVF-PQ: product-quantized sub-linear kNN composed from this tree's
+own primitives (lineage: cuvs::neighbors::ivf_pq — the IVFADC design of
+Jégou et al., "Product Quantization for Nearest Neighbor Search", TPAMI
+2011; cuVS recomposes it from the same layers this repo owns: kmeans
+quantizers, pairwise distance, gather, select_k).
+
+Index layout (the TPU formulation): the IVF-Flat skeleton, with the raw
+row payload replaced by PQ codes. The coarse quantizer partitions the
+database into ``n_lists`` inverted lists exactly as IVF-Flat does
+(:func:`raft_tpu.neighbors.ivf_flat._pack` — same SLOT_ALIGN padded
+spans, same CSR ``starts``/``sizes``, same ascending-id stable order so
+``extend`` == rebuild on fitting tail appends). Each row is stored as
+its RESIDUAL against its list centroid, product-quantized: the ``d``
+dims split into ``m`` subspaces of ``d/m`` dims, each encoded as the
+index of the nearest of ``2**nbits`` per-subspace codebook centroids
+(codebooks trained with the compiled-driver
+:func:`raft_tpu.cluster.kmeans.kmeans_fit`, so checkpoint / deadline /
+trace hooks ride along). The packed payload is ``[cap_total, m]``
+uint8 — a d=128 float32 row becomes m=16 bytes, the 32x row compression
+that lets ~10M×128 vectors sit where IVF-Flat held ~1M.
+
+Asymmetric-distance search (ADC): per query, the query→codebook lookup
+tables for every probed list are built as ONE batched contraction
+(``einsum`` of the per-list query residuals against the codebook table —
+the "one small matmul"), the probed spans' codes arrive through the same
+single padded :func:`raft_tpu.matrix.take_rows` gather IVF-Flat uses,
+and the LUT-sum either gathers per-code LUT entries or rides the
+:func:`raft_tpu.matrix.epilogue.slot_onehot` one-hot contraction (MXU
+formulation, preferred on the tpu backend; both spellings are
+bit-identical — the one-hot adds exact zeros). Selection finishes in the
+shared :func:`raft_tpu.matrix.epilogue.masked_topk` radix/top-k band.
+
+Exactness + refinement: PQ codes are lossy, so the raw rows are kept
+HOST-side (``db_host`` — deliberately never resident in device memory;
+the device footprint is the compressed index). ``refine=r`` re-scores
+the top ``max(k, r)`` ADC candidates against their raw rows (host
+gather of just those rows, one small exact-distance launch) — the
+recall-vs-latency lever the ``neighbors/ivf_pq_recall`` bench family
+sweeps. ``nprobe >= n_lists`` delegates to
+:func:`raft_tpu.neighbors.brute_force.knn` on the raw rows, so the
+full-probe(+refine) setting is bit-identical to brute force, ties and
+NaN rows included — the exactness boundary CI gates on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core import trace
+from raft_tpu.matrix.epilogue import masked_topk, slot_onehot
+from raft_tpu.matrix.gather import take_rows
+from raft_tpu.neighbors.ivf_flat import (_METRICS, SLOT_ALIGN,
+                                         _coarse_labels, _pack,
+                                         _resolve_metric, _use_radix)
+from raft_tpu.util import precision
+from raft_tpu.util.precision import with_matmul_precision
+
+__all__ = ["IvfPqIndex", "build", "search", "extend", "SLOT_ALIGN"]
+
+# rows encoded per device launch during build/extend (bounds the
+# transient residual block; the packed index itself is the small thing)
+_ENCODE_CHUNK = 1 << 16
+
+
+@dataclasses.dataclass
+class IvfPqIndex:
+    """Built IVF-PQ index: coarse centroids + per-subspace codebooks +
+    packed PQ codes in the IVF-Flat inverted-list layout.
+
+    ``packed_codes`` is the device-resident payload (uint8, one byte
+    per subspace per row); ``db_host`` keeps the ORIGINAL rows on the
+    host for the refine stage and the exact nprobe>=n_lists delegation
+    — it is never shipped wholesale to the device, which is the whole
+    memory point. ``packed_ids`` is -1 in pad slots; ``starts``/
+    ``sizes`` are the CSR span table; the host ``caps`` mirror is what
+    ``extend`` consults without a device sync."""
+
+    centroids: jnp.ndarray          # [n_lists, d] float32
+    codebooks: jnp.ndarray          # [m, 2**nbits, d/m] float32
+    packed_codes: jnp.ndarray       # [cap_total, m] uint8
+    packed_ids: jnp.ndarray         # [cap_total] int32, -1 = pad slot
+    starts: jnp.ndarray             # [n_lists] int32 (exclusive cumsum)
+    sizes: jnp.ndarray              # [n_lists] int32 live rows per list
+    caps: np.ndarray                # [n_lists] host int64 padded widths
+    cap_max: int                    # static gather width = caps.max()
+    n_db: int                       # live database rows
+    metric: str
+    db_host: np.ndarray = dataclasses.field(repr=False, compare=False,
+                                            default=None)
+    _raw_cache: Optional[jnp.ndarray] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    @property
+    def n_lists(self) -> int:
+        return int(self.centroids.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.centroids.shape[1])
+
+    @property
+    def m(self) -> int:
+        return int(self.codebooks.shape[0])
+
+    @property
+    def n_codes(self) -> int:
+        return int(self.codebooks.shape[1])
+
+    @property
+    def nbits(self) -> int:
+        return int(self.n_codes - 1).bit_length()
+
+    @property
+    def dsub(self) -> int:
+        return int(self.codebooks.shape[2])
+
+    def scanned_fraction(self, nprobe: int) -> float:
+        """Fraction of the index a search at ``nprobe`` plans to scan
+        (list-count fraction — the ``ivf_pq.search`` trace number)."""
+        return min(1.0, nprobe / max(self.n_lists, 1))
+
+    def device_bytes(self) -> int:
+        """Device-resident index footprint — the number the compression
+        claim is asserted FROM (packed arrays, not an estimate)."""
+        return int(self.packed_codes.nbytes + self.packed_ids.nbytes
+                   + self.centroids.nbytes + self.codebooks.nbytes
+                   + self.starts.nbytes + self.sizes.nbytes)
+
+    def raw(self) -> jnp.ndarray:
+        """The ORIGINAL database rows (host mirror shipped on demand) —
+        the refine oracle and the nprobe>=n_lists exact path. Cached;
+        ``extend`` invalidates."""
+        if self._raw_cache is None:
+            self._raw_cache = jnp.asarray(self.db_host)
+        return self._raw_cache
+
+    def decode(self) -> np.ndarray:
+        """Approximate reconstruction (list centroid + codebook
+        entries) in original row order — the quantized view the ADC
+        distances score against; the round-trip error bound tests
+        measure against it."""
+        ids = np.asarray(self.packed_ids)
+        live = ids >= 0
+        codes = np.asarray(self.packed_codes)[live].astype(np.int64)
+        labels = np.repeat(np.arange(self.n_lists), self.caps)[live]
+        cb = np.asarray(self.codebooks)
+        parts = [cb[s][codes[:, s]] for s in range(self.m)]
+        resid = np.concatenate(parts, axis=1)
+        rows = np.asarray(self.centroids)[labels] + resid
+        out = np.empty((self.n_db, self.dim), np.float32)
+        out[ids[live]] = rows
+        return out
+
+
+def _encode(db, centroids, labels, codebooks) -> np.ndarray:
+    """Residual PQ codes for ``db`` rows already routed to ``labels``:
+    per subspace, nearest codebook entry through the SAME fused assign
+    kernel the quantizer training uses — build and extend must encode a
+    row identically or extend == rebuild breaks. Chunked host loop so
+    the f32 residual transient never exceeds ``_ENCODE_CHUNK`` rows."""
+    from raft_tpu.cluster.kmeans import _assign
+
+    db = np.asarray(db)
+    labels = np.asarray(labels)
+    m, _, dsub = (int(s) for s in codebooks.shape)
+    cents = jnp.asarray(centroids, jnp.float32)
+    out = np.empty((db.shape[0], m), np.uint8)
+    with precision.scope():
+        for lo in range(0, db.shape[0], _ENCODE_CHUNK):
+            rows = jnp.asarray(db[lo:lo + _ENCODE_CHUNK], jnp.float32)
+            resid = rows - cents[jnp.asarray(labels[lo:lo + _ENCODE_CHUNK])]
+            for s in range(m):
+                sub = lax.slice_in_dim(resid, s * dsub, (s + 1) * dsub,
+                                       axis=1)
+                _, code = _assign(sub, codebooks[s])
+                out[lo:lo + _ENCODE_CHUNK, s] = np.asarray(code)
+    return out
+
+
+def _train_codebooks(res, resid, n_codes: int, m: int, dsub: int,
+                     max_iter: int, seed: int) -> jnp.ndarray:
+    """Per-subspace codebooks via the compiled-driver
+    :func:`~raft_tpu.cluster.kmeans.kmeans_fit` on the residual
+    subvectors — one fit per subspace, each inheriting the chunk
+    runner's checkpoint/deadline/trace hooks."""
+    from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+    books = []
+    for s in range(m):
+        sub = lax.slice_in_dim(resid, s * dsub, (s + 1) * dsub, axis=1)
+        params = KMeansParams(n_clusters=n_codes, max_iter=max_iter,
+                              seed=seed + 101 + s)
+        c, _, _, _ = kmeans_fit(res, params, sub)
+        books.append(c)
+    return jnp.stack(books).astype(jnp.float32)
+
+
+def build(res, db, n_lists: int, metric: str = "l2", *, m: int = 8,
+          nbits: int = 8, max_iter: int = 25, pq_max_iter: int = 10,
+          seed: int = 0, train_rows: int = 65536, centroids=None,
+          codebooks=None) -> IvfPqIndex:
+    """Train the coarse quantizer + per-subspace codebooks and pack the
+    residual PQ codes into the inverted-list layout.
+
+    Both quantizers ride :func:`raft_tpu.cluster.kmeans.kmeans_fit`
+    (the PR-8 compiled-driver path) unless supplied: a repack /
+    extend-rebuild passes the trained ``centroids`` AND ``codebooks``
+    through so routing and encoding are identical. Codebook training
+    subsamples to ``train_rows`` residuals (deterministic in ``seed``)
+    — quantizer quality saturates long before the full corpus, and the
+    fit cost must not scale with n_db. ``d`` must split evenly into
+    ``m`` subspaces; ``nbits <= 8`` keeps one byte per code."""
+    db = jnp.asarray(db)
+    if db.ndim != 2:
+        raise ValueError(f"db must be [n, d], got {db.shape}")
+    n, d = int(db.shape[0]), int(db.shape[1])
+    if not 0 < n_lists <= n:
+        raise ValueError(f"need 0 < n_lists <= n_db, got n_lists="
+                         f"{n_lists}, n_db={n}")
+    _resolve_metric(metric)
+    if m < 1 or d % m:
+        raise ValueError(f"m must divide d: d={d}, m={m}")
+    if not 1 <= nbits <= 8:
+        raise ValueError(f"nbits must be in [1, 8] (uint8 codes), got "
+                         f"{nbits}")
+    n_codes, dsub = 1 << nbits, d // m
+    if centroids is None:
+        from raft_tpu.cluster.kmeans import KMeansParams, kmeans_fit
+
+        params = KMeansParams(n_clusters=n_lists, max_iter=max_iter,
+                              seed=seed)
+        centroids, _, _, _ = kmeans_fit(res, params,
+                                        db.astype(jnp.float32))
+    centroids = jnp.asarray(centroids, jnp.float32)
+    if centroids.shape != (n_lists, d):
+        raise ValueError(f"centroids must be [{n_lists}, {d}], got "
+                         f"{centroids.shape}")
+    labels = _coarse_labels(db, centroids)
+    if codebooks is None:
+        if n < n_codes:
+            raise ValueError(f"need n_db >= 2**nbits = {n_codes} "
+                             f"residuals to train codebooks, got {n}")
+        sel = np.arange(n)
+        if n > train_rows:
+            sel = np.sort(np.random.default_rng(seed).choice(
+                n, train_rows, replace=False))
+        with precision.scope():
+            resid = (db[sel].astype(jnp.float32)
+                     - centroids[jnp.asarray(labels[sel])])
+        codebooks = _train_codebooks(res, resid, n_codes, m, dsub,
+                                     pq_max_iter, seed)
+    codebooks = jnp.asarray(codebooks, jnp.float32)
+    if codebooks.shape != (m, n_codes, dsub):
+        raise ValueError(f"codebooks must be [{m}, {n_codes}, {dsub}], "
+                         f"got {codebooks.shape}")
+    codes = _encode(db, centroids, labels, codebooks)
+    packed_codes, packed_ids, starts, counts, caps = _pack(
+        codes, np.arange(n, dtype=np.int32), labels, n_lists)
+    return IvfPqIndex(
+        centroids=centroids, codebooks=codebooks,
+        packed_codes=jnp.asarray(packed_codes),
+        packed_ids=jnp.asarray(packed_ids),
+        starts=jnp.asarray(starts, jnp.int32),
+        sizes=jnp.asarray(counts, jnp.int32),
+        caps=caps, cap_max=int(caps.max(initial=0)), n_db=n,
+        metric=metric, db_host=np.asarray(db))
+
+
+def extend(res, index: IvfPqIndex, new_rows) -> IvfPqIndex:
+    """Append rows (new ids continue from ``n_db``): encode against the
+    EXISTING quantizers and drop the codes into the padded tails when
+    they fit — a pure append; any overflowing tail triggers a full
+    repack via :func:`build` with the same centroids and codebooks.
+    Both branches are bit-identical to that rebuild (same routing, same
+    encoder, ascending-id stable pack — the IVF-Flat argument, verbatim,
+    applied to the code payload)."""
+    new_rows = np.asarray(new_rows, dtype=index.db_host.dtype)
+    if new_rows.ndim != 2 or new_rows.shape[1] != index.dim:
+        raise ValueError(f"new_rows must be [m, {index.dim}], got "
+                         f"{new_rows.shape}")
+    labels = _coarse_labels(new_rows, index.centroids)
+    sizes = np.asarray(index.sizes, np.int64)
+    add = np.bincount(labels, minlength=index.n_lists).astype(np.int64)
+    full_db = np.concatenate([index.db_host, new_rows], axis=0)
+    if np.any(sizes + add > index.caps):
+        return build(res, full_db, index.n_lists, index.metric,
+                     m=index.m, nbits=index.nbits,
+                     centroids=index.centroids,
+                     codebooks=index.codebooks)
+    codes = _encode(new_rows, index.centroids, labels, index.codebooks)
+    starts = np.asarray(index.starts, np.int64)
+    order = np.argsort(labels, kind="stable")
+    excl = np.zeros(index.n_lists, np.int64)
+    np.cumsum(add[:-1], out=excl[1:])
+    within = np.arange(len(labels)) - np.repeat(excl, add)
+    slots = (starts + sizes)[labels[order]] + within
+    packed_codes = np.asarray(index.packed_codes).copy()
+    packed_ids = np.asarray(index.packed_ids).copy()
+    new_ids = np.arange(index.n_db, index.n_db + len(labels),
+                        dtype=np.int32)
+    packed_codes[slots] = codes[order]
+    packed_ids[slots] = new_ids[order]
+    return IvfPqIndex(
+        centroids=index.centroids, codebooks=index.codebooks,
+        packed_codes=jnp.asarray(packed_codes),
+        packed_ids=jnp.asarray(packed_ids),
+        starts=index.starts,
+        sizes=jnp.asarray(sizes + add, jnp.int32),
+        caps=index.caps, cap_max=index.cap_max,
+        n_db=index.n_db + int(new_rows.shape[0]), metric=index.metric,
+        db_host=full_db)
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+def _use_onehot_lut() -> bool:
+    """MXU one-hot contraction vs per-code gather for the LUT-sum: the
+    contraction wins where gathers are slow (tpu); the gather wins on
+    the reference backends. Both spellings are bit-identical."""
+    return jax.default_backend() == "tpu"
+
+
+def _lut_sum(lut, codes, use_onehot: bool):
+    """The ADC inner sum ``out[q,p,c] = Σ_s lut[q,p,s,codes[q,p,c,s]]``.
+
+    ``use_onehot`` rides :func:`~raft_tpu.matrix.epilogue.slot_onehot`:
+    each subspace's code column becomes a ``(·, n_codes)`` one-hot
+    contracted against that subspace's LUT slice — an MXU batched
+    matvec instead of a gather. The contraction's non-selected terms
+    are exact zeros and BOTH spellings accumulate subspaces in the same
+    sequential order, so the two return the same bits (XLA does not
+    reassociate the chained f32 adds)."""
+    qn, p, m, n_codes = (int(s) for s in lut.shape)
+    idx = codes.astype(jnp.int32)
+    acc = jnp.zeros(idx.shape[:3], jnp.float32)
+    if use_onehot:
+        for s in range(m):
+            oh = slot_onehot(idx[..., s].reshape(-1, 1), n_codes)
+            oh = oh.reshape(idx.shape[:3] + (n_codes,))
+            acc = acc + jnp.einsum("qpcj,qpj->qpc", oh, lut[:, :, s])
+        return acc
+    qi = jnp.arange(qn, dtype=jnp.int32)[:, None, None]
+    pi = jnp.arange(p, dtype=jnp.int32)[None, :, None]
+    for s in range(m):
+        acc = acc + lut[qi, pi, s, idx[..., s]]
+    return acc
+
+
+def _adc_topk(queries, centroids, codebooks, packed_codes, packed_ids,
+              starts, sizes, *, k: int, nprobe: int, cap_max: int,
+              metric: str, use_radix: bool, use_onehot: bool):
+    """The ADC probe scan up to (but not including) the metric
+    finalize: coarse pairwise -> top-nprobe lists -> ONE batched
+    query-residual × codebook contraction (the per-list LUTs) -> one
+    padded span gather of the codes -> LUT-sum -> radix / top_k
+    epilogue. Returns RAW ascending selection keys plus ids, the same
+    mergeable form as :func:`raft_tpu.neighbors.ivf_flat._probe_topk`."""
+    kernel = _METRICS[metric]
+    m, n_codes, dsub = (int(s) for s in codebooks.shape)
+    with precision.scope():
+        q = queries.astype(jnp.float32)
+        c = centroids.astype(jnp.float32)
+        cb = codebooks.astype(jnp.float32)
+        qn = q.shape[0]
+        ip = q @ c.T
+        if kernel == "l2":
+            coarse = (jnp.sum(c * c, axis=1)[None, :] - 2.0 * ip
+                      + jnp.sum(q * q, axis=1)[:, None])
+        else:
+            coarse = -ip
+        _, probed = lax.top_k(-coarse, nprobe)          # [q, nprobe]
+        # per-(query, probed-list) LUTs as one batched contraction:
+        # l2:    lut[q,p,s,j] = ||cb[s,j]||^2 - 2 r_{q,p,s}·cb[s,j],
+        #        base[q,p]    = ||r_{q,p}||^2   (r = q - c_probed)
+        # inner: lut[q,s,j]   = -q_s·cb[s,j]  (list-independent),
+        #        base[q,p]    = -q·c_probed
+        if kernel == "l2":
+            resid = q[:, None, :] - c[probed]           # [q, p, d]
+            r = resid.reshape(qn, nprobe, m, dsub)
+            cross = jnp.einsum("qpmd,mjd->qpmj", r, cb)
+            cb_sq = jnp.sum(cb * cb, axis=-1)           # [m, j]
+            lut = cb_sq[None, None] - 2.0 * cross
+            base = jnp.sum(resid * resid, axis=-1)      # [q, p]
+        else:
+            cross = jnp.einsum("qmd,mjd->qmj",
+                               q.reshape(qn, m, dsub), cb)
+            lut = jnp.broadcast_to(-cross[:, None],
+                                   (qn, nprobe, m, n_codes))
+            base = -jnp.take_along_axis(ip, probed, axis=1)
+        codes, _ = take_rows(None, packed_codes, starts[probed],
+                             sizes[probed], cap_max)
+        ids, valid = take_rows(None, packed_ids, starts[probed],
+                               sizes[probed], cap_max, fill_value=-1)
+        adc = _lut_sum(lut, codes, use_onehot)          # [q, p, cap]
+        dist = base[:, :, None] + adc
+        L = nprobe * cap_max
+        dist = dist.reshape(qn, L)
+        ids = ids.reshape(qn, L)
+        valid = valid.reshape(qn, L)
+        vals, pos = masked_topk(dist, valid, k, use_radix=use_radix)
+        out_ids = jnp.take_along_axis(ids, pos, axis=1)
+        out_ids = jnp.where(jnp.isfinite(vals), out_ids, -1)
+        return vals, out_ids
+
+
+def _search_body(queries, centroids, codebooks, packed_codes,
+                 packed_ids, starts, sizes, *, k: int, nprobe: int,
+                 cap_max: int, metric: str, use_radix: bool,
+                 use_onehot: bool):
+    """The traced ADC scan (:func:`_adc_topk` + metric finalize).
+    Row-independent per query — the serving invariant."""
+    from raft_tpu.neighbors.brute_force import _finalize
+
+    vals, out_ids = _adc_topk(
+        queries, centroids, codebooks, packed_codes, packed_ids,
+        starts, sizes, k=k, nprobe=nprobe, cap_max=cap_max,
+        metric=metric, use_radix=use_radix, use_onehot=use_onehot)
+    return _finalize(vals, metric), out_ids
+
+
+_search_jit = functools.partial(
+    jax.jit, static_argnames=("k", "nprobe", "cap_max", "metric",
+                              "use_radix", "use_onehot"))(_search_body)
+
+
+def _refine_body(queries, cand, cand_ids, *, k: int, metric: str,
+                 use_radix: bool):
+    """Exact re-score of the gathered raw candidate rows: the same
+    expanded fine-distance form as the IVF-Flat probe scan, masked
+    top-k over ``cand_ids >= 0``, metric finalize. Row-independent."""
+    from raft_tpu.neighbors.brute_force import _finalize
+
+    kernel = _METRICS[metric]
+    with precision.scope():
+        q = queries.astype(jnp.float32)
+        c = cand.astype(jnp.float32)
+        ipf = jnp.einsum("qd,qrd->qr", q, c)
+        if kernel == "l2":
+            dist = (jnp.sum(c * c, axis=-1) - 2.0 * ipf
+                    + jnp.sum(q * q, axis=1)[:, None])
+        else:
+            dist = -ipf
+        vals, pos = masked_topk(dist, cand_ids >= 0, k,
+                                use_radix=use_radix)
+        out_ids = jnp.take_along_axis(cand_ids, pos, axis=1)
+        out_ids = jnp.where(jnp.isfinite(vals), out_ids, -1)
+        return _finalize(vals, metric), out_ids
+
+
+_refine_jit = functools.partial(
+    jax.jit, static_argnames=("k", "metric", "use_radix"))(_refine_body)
+
+
+@with_matmul_precision
+def search(res, index: IvfPqIndex, queries, k: int, nprobe: int,
+           refine: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k nearest database rows per query over ``nprobe`` probed lists,
+    scored by asymmetric PQ distance. Returns (distances [q, k],
+    indices [q, k]) nearest first, original row numbering; rows with
+    fewer than k reachable candidates pad with index -1 / distance +inf.
+
+    ``refine=r`` re-scores the top ``max(k, r)`` ADC candidates against
+    their RAW rows (host-side ``db_host`` gather + one exact-distance
+    launch) — distances become exact for the surviving candidates, and
+    recall recovers most of the quantization loss for r a few multiples
+    of k. ``refine=0`` returns pure ADC distances (approximate).
+
+    ``nprobe >= n_lists`` scans everything: delegates to
+    :func:`raft_tpu.neighbors.brute_force.knn` on the raw rows —
+    bit-identical to brute force (ties/NaN included), the exactness
+    boundary CI gates on, with any ``refine`` trivially satisfied.
+
+    Admission (the PR-5 contract): with a ``runtime.limits`` budget
+    active, a launch whose LUT block + gathered code tile would overrun
+    it degrades to query-row chunks (bit-identical — rows are
+    independent) or raises
+    :class:`~raft_tpu.runtime.limits.RejectedError`. Every search
+    records an ``ivf_pq.search`` trace event carrying nprobe, refine
+    and the scanned fraction.
+    """
+    from raft_tpu.runtime import limits
+
+    queries = jnp.asarray(queries)
+    if queries.ndim != 2 or queries.shape[1] != index.dim:
+        raise ValueError(f"queries must be [q, {index.dim}], got "
+                         f"{queries.shape}")
+    if not 0 < k <= index.n_db:
+        raise ValueError(f"need 0 < k <= n_db, got k={k}, "
+                         f"n_db={index.n_db}")
+    if nprobe <= 0:
+        raise ValueError(f"need nprobe > 0, got {nprobe}")
+    if refine < 0:
+        raise ValueError(f"need refine >= 0, got {refine}")
+    metric = index.metric
+    if nprobe >= index.n_lists:
+        from raft_tpu.neighbors.brute_force import knn
+
+        trace.record_event("ivf_pq.search", nprobe=index.n_lists,
+                           n_lists=index.n_lists, k=k, refine=refine,
+                           scanned_frac=1.0, path="exact")
+        return knn(res, index.raw(), queries, k, metric=metric)
+    rr = max(k, int(refine))
+    probe_rows = nprobe * index.cap_max
+    if probe_rows < rr:
+        raise ValueError(
+            f"nprobe={nprobe} reaches at most {probe_rows} candidates "
+            f"< max(k, refine)={rr}; raise nprobe (>= n_lists scans "
+            f"exactly)")
+    trace.record_event("ivf_pq.search", nprobe=nprobe,
+                       n_lists=index.n_lists, k=k, refine=refine,
+                       scanned_frac=round(
+                           index.scanned_fraction(nprobe), 4),
+                       path="ivf_pq")
+    use_radix = _use_radix(probe_rows, rr, index.packed_ids, queries)
+    use_onehot = _use_onehot_lut()
+    run_adc = functools.partial(
+        _search_jit, centroids=index.centroids,
+        codebooks=index.codebooks, packed_codes=index.packed_codes,
+        packed_ids=index.packed_ids, starts=index.starts,
+        sizes=index.sizes, k=rr, nprobe=nprobe, cap_max=index.cap_max,
+        metric=metric, use_radix=use_radix, use_onehot=use_onehot)
+
+    def run(qblock):
+        vals, ids = run_adc(queries=qblock)
+        if refine <= 0:
+            return vals, ids
+        ids_np = np.asarray(ids)
+        cand = index.db_host[np.maximum(ids_np, 0)]
+        return _refine_jit(qblock, jnp.asarray(cand), ids, k=k,
+                           metric=metric,
+                           use_radix=_use_radix(rr, k, ids, qblock))
+
+    budget = limits.active_budget()
+    if budget is not None:
+        op = "neighbors.ivf_pq_search"
+        qn = int(queries.shape[0])
+        itemsize = index.db_host.dtype.itemsize
+        dims = dict(nprobe=nprobe, probe_rows=probe_rows,
+                    n_dims=index.dim, k=rr, m=index.m,
+                    n_codes=index.n_codes, refine=int(refine),
+                    itemsize=itemsize)
+        est = limits.estimate_bytes(
+            op, n_queries=qn,
+            packed_rows=int(index.packed_codes.shape[0]), **dims)
+        if not limits.admit(op, est, budget=budget):
+            # degrade: row-chunk the queries — per-row results are
+            # independent of batch shape, so the bits are identical
+            fixed_bytes = (index.packed_codes.nbytes
+                           + index.packed_ids.nbytes
+                           + index.codebooks.nbytes
+                           + index.centroids.nbytes)
+            per_row = limits.estimate_bytes(op, n_queries=1, **dims)
+            chunk = (budget.limit_bytes - fixed_bytes) // max(per_row,
+                                                              1)
+            if chunk < 1:
+                limits.reject(op, est, budget=budget,
+                              detail="even a single query row's LUT + "
+                                     "gathered code tile overflows the "
+                                     "budget")
+            limits.record_degraded(op)
+            outs = [run(queries[i:i + int(chunk)])
+                    for i in range(0, qn, int(chunk))]
+            return (jnp.concatenate([o[0] for o in outs], axis=0),
+                    jnp.concatenate([o[1] for o in outs], axis=0))
+    return run(queries)

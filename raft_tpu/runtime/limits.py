@@ -425,6 +425,24 @@ def _est_ivf_mnmg_search(*, n_queries, probe_rows, n_dims, k, n_ranks,
             + n_queries * k * (dist_itemsize + 4))
 
 
+def _est_ivf_pq_search(*, n_queries, nprobe, probe_rows, n_dims, k, m,
+                       n_codes, itemsize=4, refine=0, packed_rows=0,
+                       dist_itemsize=4):
+    # resident packed codes (m bytes/row) + ids + queries, the
+    # per-(query, probed-list) LUT block the ADC stage materializes,
+    # the gathered code tile (codes, ADC score block, ids, valid mask),
+    # the refine stage's raw-row candidate tile when armed, and the
+    # top-k outputs
+    rr = max(k, refine)
+    est = (packed_rows * (m + 4) + n_queries * n_dims * itemsize
+           + n_queries * nprobe * m * n_codes * dist_itemsize
+           + n_queries * probe_rows * (m + dist_itemsize + 4 + 1)
+           + n_queries * rr * (dist_itemsize + 4))
+    if refine:
+        est += n_queries * rr * (n_dims * itemsize + dist_itemsize)
+    return est
+
+
 def _est_streaming_compact(*, packed_rows, n_dims, itemsize,
                            id_itemsize=4):
     # the double-buffered repack: old packed matrix + ids resident
@@ -450,6 +468,7 @@ _ESTIMATORS = {
     "neighbors.brute_force_knn": _est_knn,
     "neighbors.ivf_search": _est_ivf_search,
     "neighbors.ivf_mnmg_search": _est_ivf_mnmg_search,
+    "neighbors.ivf_pq_search": _est_ivf_pq_search,
     "neighbors.streaming_compact": _est_streaming_compact,
     "linalg.gemm": _est_gemm,
     "sparse.spmv": _est_spmv,
@@ -465,6 +484,8 @@ def estimate_bytes(op: str, **dims) -> int:
     packed_rows])``,
     ``neighbors.ivf_mnmg_search(n_queries, probe_rows, n_dims, k,
     n_ranks, itemsize[, packed_rows])``,
+    ``neighbors.ivf_pq_search(n_queries, nprobe, probe_rows, n_dims,
+    k, m, n_codes[, itemsize, refine, packed_rows])``,
     ``linalg.gemm(m, n, k, itemsize[, out_itemsize])``,
     ``sparse.spmv(n_rows, n_cols, nnz, itemsize[, index_itemsize])``."""
     try:
@@ -557,6 +578,27 @@ def _sec_ivf_mnmg_search(*, n_queries, probe_rows, n_dims, k, n_ranks,
         packed_rows=packed_rows, dist_itemsize=dist_itemsize)
 
 
+def _sec_ivf_pq_search(*, n_queries, nprobe, probe_rows, n_dims, k, m,
+                       n_codes, itemsize=4, refine=0, packed_rows=0,
+                       dist_itemsize=4):
+    # the LUT build is ONE batched residual×codebook contraction
+    # (2·q·nprobe·n_codes·d — every probed list's m subspace LUTs in a
+    # single einsum), the LUT-sum touches one code + one LUT entry per
+    # (candidate, subspace), the top-k drain mirrors the flat scan, and
+    # an armed refine adds one exact pass over the rr raw-row tile
+    rr = max(k, refine)
+    flops = (2.0 * n_queries * nprobe * n_codes * n_dims
+             + 2.0 * n_queries * probe_rows * m
+             + 4.0 * n_queries * probe_rows)
+    if refine:
+        flops += 2.0 * n_queries * rr * n_dims
+    return flops, _est_ivf_pq_search(
+        n_queries=n_queries, nprobe=nprobe, probe_rows=probe_rows,
+        n_dims=n_dims, k=k, m=m, n_codes=n_codes, itemsize=itemsize,
+        refine=refine, packed_rows=packed_rows,
+        dist_itemsize=dist_itemsize)
+
+
 def _sec_streaming_compact(*, packed_rows, n_dims, itemsize,
                            id_itemsize=4):
     # bandwidth-bound: the repack streams every packed byte through
@@ -587,6 +629,7 @@ _SECONDS_ESTIMATORS = {
     "neighbors.brute_force_knn": _sec_knn,
     "neighbors.ivf_search": _sec_ivf_search,
     "neighbors.ivf_mnmg_search": _sec_ivf_mnmg_search,
+    "neighbors.ivf_pq_search": _sec_ivf_pq_search,
     "neighbors.streaming_compact": _sec_streaming_compact,
     "linalg.gemm": _sec_gemm,
     "sparse.spmv": _sec_spmv,

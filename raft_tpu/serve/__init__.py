@@ -30,7 +30,8 @@ from raft_tpu.serve.brownout import (BrownoutController,
                                      knn_ladder)
 from raft_tpu.serve.executor import (Executor, ExecutorStats,
                                      IvfKnnService, IvfMnmgKnnService,
-                                     KnnService, KMeansPredictService,
+                                     IvfPqKnnService, KnnService,
+                                     KMeansPredictService,
                                      PairwiseService, Service)
 from raft_tpu.serve.ingest import IngestController, StreamingKnnService
 from raft_tpu.serve.loadgen import (CatchupLoadReport, ChaosReport,
@@ -51,9 +52,9 @@ __all__ = [
     "BUCKET_FLOOR", "bucket_rows", "bucket_ladder",
     "Request", "ResultFuture", "Batch", "BatchPolicy", "RequestQueue",
     "TenantPolicy", "QosPolicy",
-    "Service", "KnnService", "IvfKnnService", "IvfMnmgKnnService",
-    "PairwiseService", "KMeansPredictService", "Executor",
-    "ExecutorStats",
+    "Service", "KnnService", "IvfKnnService", "IvfPqKnnService",
+    "IvfMnmgKnnService", "PairwiseService", "KMeansPredictService",
+    "Executor", "ExecutorStats",
     "Replica", "ReplicaGroup", "ReplicaGroupStats", "RecoveryReport",
     "HedgePolicy",
     "BrownoutController", "BrownoutFloorError", "DegradationLadder",

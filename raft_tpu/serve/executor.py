@@ -54,9 +54,9 @@ from raft_tpu.serve.queue import (Batch, BatchPolicy, Request,
                                   bucket_rows)
 
 __all__ = [
-    "Service", "KnnService", "IvfKnnService", "IvfMnmgKnnService",
-    "PairwiseService", "KMeansPredictService", "Executor",
-    "ExecutorStats",
+    "Service", "KnnService", "IvfKnnService", "IvfPqKnnService",
+    "IvfMnmgKnnService", "PairwiseService", "KMeansPredictService",
+    "Executor", "ExecutorStats",
 ]
 
 
@@ -283,6 +283,95 @@ class IvfKnnService(Service):
                            metric=self.index.metric,
                            n_lists=self.index.n_lists,
                            nprobe=self.nprobe)
+        return path
+
+
+class IvfPqKnnService(Service):
+    """Batched IVF-PQ kNN against a fixed index
+    (:func:`raft_tpu.neighbors.ivf_pq.search`'s ADC path). One
+    instance per (k, nprobe) — the executor's (service, bucket)
+    executable cache then holds one warmed executable per
+    (bucket, nprobe), so sweeping nprobe at steady state never
+    compiles. Per-request result: ``(distances [rows, k], indices
+    [rows, k])`` in original database row numbering; distances are
+    asymmetric PQ distances (the served trade: the index in HBM is the
+    compressed one). Row independence holds exactly as for
+    :class:`IvfKnnService`, so the batched launch is bit-identical to
+    per-request eager searches.
+
+    The refine stage re-scores against HOST-side raw rows and is an
+    offline/eager lever (:func:`raft_tpu.neighbors.ivf_pq.search` with
+    ``refine > 0``) — the served hot path stays one device launch.
+    Full scans (nprobe >= n_lists) are exact brute force by definition
+    — serve those through :class:`KnnService` on ``index.raw()``; this
+    service rejects the degenerate setting."""
+
+    def __init__(self, index, k: int, nprobe: int):
+        super().__init__((index.centroids, index.codebooks,
+                          index.packed_codes, index.packed_ids,
+                          index.starts, index.sizes),
+                         dim=index.dim, dtype=jnp.float32)
+        if not 0 < nprobe < index.n_lists:
+            raise ValueError(
+                f"IvfPqKnnService needs 0 < nprobe < n_lists "
+                f"(got nprobe={nprobe}, n_lists={index.n_lists}); "
+                f"nprobe >= n_lists is a full scan — use KnnService on "
+                f"index.raw()")
+        self.index = index
+        self.k = int(k)
+        self.nprobe = int(nprobe)
+        self.name = (f"ivf_pq_knn_k{k}_np{nprobe}_m{index.m}"
+                     f"_{index.metric}")
+
+    def _build(self):
+        from raft_tpu.neighbors.ivf_pq import (_search_body,
+                                               _use_onehot_lut)
+        from raft_tpu.neighbors.ivf_flat import _use_radix
+
+        k, nprobe = self.k, self.nprobe
+        cap_max, metric = self.index.cap_max, self.index.metric
+        use_radix = _use_radix(nprobe * cap_max, k, self.fixed_args[3])
+        use_onehot = _use_onehot_lut()
+
+        def fn(centroids, codebooks, packed_codes, packed_ids, starts,
+               sizes, q):
+            return _search_body(q, centroids, codebooks, packed_codes,
+                                packed_ids, starts, sizes, k=k,
+                                nprobe=nprobe, cap_max=cap_max,
+                                metric=metric, use_radix=use_radix,
+                                use_onehot=use_onehot)
+        return fn
+
+    def unpack(self, out, start, rows):
+        d, i = out
+        return d[start:start + rows], i[start:start + rows]
+
+    def estimate_bytes(self, rows):
+        return limits.estimate_bytes(
+            "neighbors.ivf_pq_search", n_queries=rows,
+            nprobe=self.nprobe,
+            probe_rows=self.nprobe * self.index.cap_max,
+            n_dims=self.dim, k=self.k, m=self.index.m,
+            n_codes=self.index.n_codes, itemsize=self.dtype.itemsize,
+            packed_rows=int(self.index.packed_codes.shape[0]))
+
+    def eager(self, queries):
+        from raft_tpu.neighbors import ivf_pq
+
+        return ivf_pq.search(None, self.index, jnp.asarray(queries),
+                             self.k, self.nprobe)
+
+    def epilogue(self) -> str:
+        """"ivf_pq" — quoted from :func:`knn_plan` with this service's
+        (n_lists, nprobe, pq=True), the same predicate the other kNN
+        services quote, so the warm-path report and the compiled
+        dispatch share one source of truth."""
+        from raft_tpu.neighbors.brute_force import knn_plan
+
+        path, _ = knn_plan(1, self.index.n_db, self.k,
+                           metric=self.index.metric,
+                           n_lists=self.index.n_lists,
+                           nprobe=self.nprobe, pq=True)
         return path
 
 

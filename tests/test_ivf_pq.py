@@ -1,0 +1,331 @@
+"""IVF-PQ index: encode/decode round-trip error bound, the exactness
+boundary (full probe + full refine bit-identical to brute force,
+ties/NaN included), recall floor with refine at partial probes, the
+memory contract (PQ index bytes <= 1/8 of IVF-Flat at d=128 m=16
+nbits=8, asserted from the packed arrays), extend == rebuild,
+admission degrade/reject, the ivf_pq.search trace event, the knn_plan
+ivf_pq band, and the serving IvfPqKnnService (batched == eager bits,
+zero post-warm recompiles)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import trace
+from raft_tpu.neighbors import ivf_flat, ivf_pq, knn
+from raft_tpu.neighbors.brute_force import knn_plan
+from raft_tpu.random import RngState, make_blobs
+from raft_tpu.runtime import limits
+
+
+@pytest.fixture(scope="module")
+def blob_pq(res):
+    X, _, _ = make_blobs(res, RngState(3), 4096, 32, n_clusters=32)
+    return np.asarray(X), ivf_pq.build(res, X, 32, m=8, nbits=8,
+                                       seed=0, max_iter=6,
+                                       pq_max_iter=4)
+
+
+def _recall(gt_ids, ids, k):
+    gt_ids, ids = np.asarray(gt_ids), np.asarray(ids)
+    return np.mean([len(set(a) & set(b)) / k
+                    for a, b in zip(gt_ids, ids)])
+
+
+class TestBuildLayout:
+    def test_packed_is_a_permutation(self, res, blob_pq):
+        X, idx = blob_pq
+        ids = np.asarray(idx.packed_ids)
+        live = ids[ids >= 0]
+        assert sorted(live.tolist()) == list(range(len(X)))
+        assert idx.packed_codes.dtype == np.uint8
+        assert idx.packed_codes.shape[1] == idx.m
+        # raw rows ride host-side, bit-exact
+        np.testing.assert_array_equal(np.asarray(idx.raw()), X)
+
+    def test_spans_aligned_and_consistent(self, res, blob_pq):
+        _, idx = blob_pq
+        caps = idx.caps
+        assert (caps % ivf_pq.SLOT_ALIGN == 0).all()
+        sizes = np.asarray(idx.sizes)
+        assert (sizes <= caps).all()
+        starts = np.asarray(idx.starts)
+        np.testing.assert_array_equal(
+            starts, np.concatenate([[0], np.cumsum(caps)[:-1]]))
+        assert int(sizes.sum()) == idx.n_db
+
+    def test_decode_round_trip_bound(self, res, blob_pq):
+        # PQ reconstruction must beat the coarse-only quantizer by a
+        # wide margin: that is the whole point of spending m bytes/row
+        X, idx = blob_pq
+        dec = idx.decode()
+        assert dec.shape == X.shape
+        coarse = np.asarray(idx.centroids)[
+            np.argmin(((X[:, None] - np.asarray(idx.centroids)[None])
+                       ** 2).sum(-1), axis=1)]
+        pq_mse = float(np.mean((dec - X) ** 2))
+        coarse_mse = float(np.mean((coarse - X) ** 2))
+        assert pq_mse < 0.75 * coarse_mse, (pq_mse, coarse_mse)
+
+    def test_bad_args(self, res, blob_pq):
+        X, idx = blob_pq
+        with pytest.raises(ValueError, match="n_lists"):
+            ivf_pq.build(res, X[:4], 8)
+        with pytest.raises(ValueError, match="metric"):
+            ivf_pq.build(res, X[:64], 4, metric="canberra")
+        with pytest.raises(ValueError, match="divide"):
+            ivf_pq.build(res, X[:64], 4, m=5)
+        with pytest.raises(ValueError, match="nbits"):
+            ivf_pq.build(res, X[:64], 4, nbits=9)
+        with pytest.raises(ValueError, match="queries"):
+            ivf_pq.search(res, idx, X[:2, :5], k=4, nprobe=2)
+        with pytest.raises(ValueError, match="nprobe"):
+            ivf_pq.search(res, idx, X[:2], k=4, nprobe=0)
+        with pytest.raises(ValueError, match="n_db"):
+            ivf_pq.search(res, idx, X[:2], k=0, nprobe=2)
+        with pytest.raises(ValueError, match="refine"):
+            ivf_pq.search(res, idx, X[:2], k=4, nprobe=2, refine=-1)
+        with pytest.raises(ValueError, match="candidates"):
+            ivf_pq.search(res, idx, X[:2], k=4, nprobe=1,
+                          refine=idx.cap_max + 1)
+
+
+class TestMemoryContract:
+    def test_pq_bytes_at_most_eighth_of_flat(self, res):
+        # the ISSUE-19 acceptance shape: d=128, m=16, nbits=8 — one
+        # uint8 code byte per 8 float32 dims. Asserted from the packed
+        # arrays actually resident, not estimated.
+        rng = np.random.default_rng(29)
+        X = rng.normal(size=(8192, 128)).astype(np.float32)
+        flat = ivf_flat.build(res, X, 32, seed=0, max_iter=2)
+        pq = ivf_pq.build(res, X, 32, m=16, nbits=8, seed=0,
+                          max_iter=2, pq_max_iter=2)
+        flat_bytes = int(flat.packed_db.nbytes + flat.packed_ids.nbytes
+                         + flat.centroids.nbytes + flat.starts.nbytes
+                         + flat.sizes.nbytes)
+        pq_bytes = int(pq.device_bytes())
+        assert pq.packed_codes.nbytes == pq.packed_codes.shape[0] * 16
+        assert pq_bytes * 8 <= flat_bytes, (pq_bytes, flat_bytes)
+
+
+class TestExactnessBoundary:
+    def test_full_probe_bit_identical_to_brute(self, res, blob_pq):
+        X, idx = blob_pq
+        q = X[:96]
+        bd, bi = knn(res, X, q, k=12)
+        for refine in (0, 50):
+            ad, ai = ivf_pq.search(res, idx, q, k=12,
+                                   nprobe=idx.n_lists, refine=refine)
+            np.testing.assert_array_equal(np.asarray(bd),
+                                          np.asarray(ad))
+            np.testing.assert_array_equal(np.asarray(bi),
+                                          np.asarray(ai))
+
+    def test_full_probe_ties_and_nan_identical(self, res):
+        # adversarial db: exact duplicate rows (ties) and NaN rows —
+        # quantizer training validates finiteness, so build against
+        # supplied centroids AND codebooks; full probe + full refine
+        # must reproduce brute force's tie ordering and NaN bits
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(512, 8)).astype(np.float32)
+        X[100] = X[7]
+        X[200] = X[7]
+        X[300] = np.nan
+        cb = rng.normal(size=(2, 16, 4)).astype(np.float32)
+        idx = ivf_pq.build(res, X, 8, m=2, nbits=4, centroids=X[:8],
+                           codebooks=cb)
+        q = np.concatenate([X[7:8], X[300:301], X[40:44]])
+        bd, bi = knn(res, X, q, k=8)
+        ad, ai = ivf_pq.search(res, idx, q, k=8, nprobe=8, refine=100)
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(ad))
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(ai))
+
+    def test_overprobe_clamps_to_full_scan(self, res, blob_pq):
+        X, idx = blob_pq
+        d1 = ivf_pq.search(res, idx, X[:8], k=4, nprobe=idx.n_lists)
+        d2 = ivf_pq.search(res, idx, X[:8], k=4,
+                           nprobe=idx.n_lists + 7)
+        np.testing.assert_array_equal(np.asarray(d1[1]),
+                                      np.asarray(d2[1]))
+
+    def test_onehot_and_gather_lut_sum_bit_identical(self, res,
+                                                     blob_pq,
+                                                     monkeypatch):
+        # the TPU one-hot contraction and the CPU advanced-indexing
+        # gather are two spellings of the SAME sum — both accumulate
+        # subspaces sequentially, so the f32 rounding matches bit-wise
+        X, idx = blob_pq
+        q = X[:32]
+        monkeypatch.setattr(ivf_pq, "_use_onehot_lut", lambda: True)
+        d1, i1 = ivf_pq.search(res, idx, q, k=10, nprobe=8)
+        monkeypatch.setattr(ivf_pq, "_use_onehot_lut", lambda: False)
+        d0, i0 = ivf_pq.search(res, idx, q, k=10, nprobe=8)
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+
+
+class TestRecall:
+    @pytest.mark.slow  # also gated in ci/smoke.sh at the same shape
+    def test_recall_floor_nprobe16_with_refine(self, res):
+        X, _, _ = make_blobs(res, RngState(9), 8192, 32, n_clusters=64)
+        idx = ivf_pq.build(res, X, 64, m=8, nbits=8, seed=0)
+        q = np.asarray(X[:128])
+        _, gi = knn(res, X, q, k=10)
+        _, ai = ivf_pq.search(res, idx, q, k=10, nprobe=16, refine=40)
+        recall = _recall(gi, ai, 10)
+        assert recall >= 0.9, recall
+        # refine must not LOSE recall vs the raw ADC ranking
+        _, ri = ivf_pq.search(res, idx, q, k=10, nprobe=16)
+        assert recall >= _recall(gi, ri, 10) - 1e-9
+
+    @pytest.mark.slow
+    def test_inner_metric_full_probe_matches_brute(self, res):
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(1024, 16)).astype(np.float32)
+        idx = ivf_pq.build(res, X, 16, metric="inner", m=4, nbits=6,
+                           seed=0)
+        q = X[:32]
+        bd, bi = knn(res, X, q, k=5, metric="inner")
+        ad, ai = ivf_pq.search(res, idx, q, k=5, nprobe=16)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(ai))
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(ad))
+
+
+class TestExtend:
+    @pytest.mark.slow
+    def test_extend_fitting_tail_equals_rebuild(self, res):
+        rng = np.random.default_rng(17)
+        X = rng.normal(size=(1003, 12)).astype(np.float32)
+        idx = ivf_pq.build(res, X, 8, m=4, nbits=6, seed=0)
+        head = idx.caps - np.asarray(idx.sizes)
+        li = int(np.argmax(head))
+        assert head[li] >= 2, "all tails full; pick another seed"
+        c = np.asarray(idx.centroids)[li]
+        Y = (c + 0.01 * rng.normal(size=(2, 12))).astype(np.float32)
+        ext = ivf_pq.extend(res, idx, Y)
+        reb = ivf_pq.build(res, np.concatenate([X, Y]), 8, m=4,
+                           nbits=6, centroids=idx.centroids,
+                           codebooks=idx.codebooks)
+        assert np.array_equal(ext.caps, idx.caps)   # append, no repack
+        np.testing.assert_array_equal(np.asarray(ext.packed_ids),
+                                      np.asarray(reb.packed_ids))
+        np.testing.assert_array_equal(np.asarray(ext.packed_codes),
+                                      np.asarray(reb.packed_codes))
+        q = X[:40]
+        ed, ei = ivf_pq.search(res, ext, q, k=8, nprobe=3, refine=20)
+        rd, ri = ivf_pq.search(res, reb, q, k=8, nprobe=3, refine=20)
+        np.testing.assert_array_equal(np.asarray(ei), np.asarray(ri))
+        np.testing.assert_array_equal(np.asarray(ed), np.asarray(rd))
+
+    @pytest.mark.slow
+    def test_extend_overflow_repacks_and_equals_rebuild(self, res):
+        rng = np.random.default_rng(19)
+        X = rng.normal(size=(512, 12)).astype(np.float32)
+        Y = rng.normal(size=(300, 12)).astype(np.float32)  # overflows
+        idx = ivf_pq.build(res, X, 8, m=4, nbits=6, seed=0)
+        ext = ivf_pq.extend(res, idx, Y)
+        reb = ivf_pq.build(res, np.concatenate([X, Y]), 8, m=4,
+                           nbits=6, centroids=idx.centroids,
+                           codebooks=idx.codebooks)
+        np.testing.assert_array_equal(np.asarray(ext.packed_ids),
+                                      np.asarray(reb.packed_ids))
+        np.testing.assert_array_equal(np.asarray(ext.packed_codes),
+                                      np.asarray(reb.packed_codes))
+
+    def test_extend_full_probe_still_exact(self, res, blob_pq):
+        X, idx = blob_pq
+        rng = np.random.default_rng(23)
+        Y = rng.normal(size=(50, X.shape[1])).astype(np.float32)
+        ext = ivf_pq.extend(res, idx, Y)
+        assert ext.n_db == len(X) + 50
+        full = np.concatenate([X, Y])
+        np.testing.assert_array_equal(np.asarray(ext.raw()), full)
+        q = full[-8:]
+        bd, bi = knn(res, full, q, k=6)
+        ad, ai = ivf_pq.search(res, ext, q, k=6, nprobe=ext.n_lists)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(ai))
+
+
+class TestAdmissionAndObs:
+    def test_degraded_bit_identical(self, res, blob_pq):
+        X, idx = blob_pq
+        q = X[:64]
+        bd, bi = ivf_pq.search(res, idx, q, k=8, nprobe=4, refine=32)
+        est = limits.estimate_bytes(
+            "neighbors.ivf_pq_search", n_queries=64, nprobe=4,
+            probe_rows=4 * idx.cap_max, n_dims=idx.dim, k=32, m=idx.m,
+            n_codes=idx.n_codes, refine=32, itemsize=4,
+            packed_rows=int(idx.packed_codes.shape[0]))
+        with limits.budget_scope(est // 2 + int(idx.device_bytes())):
+            dd, di = ivf_pq.search(res, idx, q, k=8, nprobe=4,
+                                   refine=32)
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(dd))
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(di))
+
+    def test_unfittable_rejected(self, res, blob_pq):
+        X, idx = blob_pq
+        with limits.budget_scope(1024):
+            with pytest.raises(limits.RejectedError):
+                ivf_pq.search(res, idx, X[:4], k=8, nprobe=4)
+
+    def test_seconds_estimator_twin(self):
+        dims = dict(n_queries=64, nprobe=4, probe_rows=512, n_dims=32,
+                    k=10, m=8, n_codes=256)
+        assert limits.estimate_seconds("neighbors.ivf_pq_search",
+                                       **dims) > 0
+        assert limits.estimate_bytes("neighbors.ivf_pq_search",
+                                     **dims) > 0
+
+    def test_trace_event_carries_probe_plan(self, res, blob_pq):
+        X, idx = blob_pq
+        trace.clear_events()
+        ivf_pq.search(res, idx, X[:4], k=8, nprobe=4, refine=16)
+        ev = trace.events("ivf_pq.search")
+        assert len(ev) == 1
+        assert ev[0]["nprobe"] == 4 and ev[0]["path"] == "ivf_pq"
+        assert ev[0]["refine"] == 16
+        assert ev[0]["scanned_frac"] == pytest.approx(
+            idx.scanned_fraction(4), abs=1e-4)
+        trace.clear_events()
+        ivf_pq.search(res, idx, X[:4], k=8, nprobe=idx.n_lists)
+        ev = trace.events("ivf_pq.search")
+        assert ev[0]["path"] == "exact"
+        assert ev[0]["scanned_frac"] == 1.0
+
+    def test_knn_plan_ivf_pq_band(self):
+        assert knn_plan(64, 4096, 10, n_lists=64, nprobe=8,
+                        pq=True) == ("ivf_pq", 0)
+        assert knn_plan(64, 4096, 10, n_lists=64, nprobe=8) == \
+            ("ivf", 0)
+        # full scan is not a pq plan — it IS the brute-force plan
+        path, _ = knn_plan(64, 4096, 10, n_lists=64, nprobe=64,
+                           pq=True)
+        assert path != "ivf_pq"
+
+
+class TestIvfPqServe:
+    def test_batched_bits_and_zero_recompiles(self, res, blob_pq):
+        from raft_tpu import serve
+
+        X, idx = blob_pq
+        svc = serve.IvfPqKnnService(idx, k=10, nprobe=8)
+        assert svc.epilogue() == "ivf_pq"
+        ex = serve.Executor(
+            [svc], policy=serve.BatchPolicy(max_batch=64,
+                                            max_wait_ms=2.0))
+        ex.warm()
+        traces_after_warm = ex.stats.traces
+        q = X[:48]
+        with ex:
+            fut = ex.submit(svc.name, q)
+            d, i = fut.result(timeout=60.0)
+        assert ex.stats.traces == traces_after_warm
+        ed, ei = ivf_pq.search(res, idx, q, k=10, nprobe=8)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ei))
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(ed))
+
+    def test_full_scan_service_rejected(self, res, blob_pq):
+        from raft_tpu import serve
+
+        _, idx = blob_pq
+        with pytest.raises(ValueError, match="KnnService"):
+            serve.IvfPqKnnService(idx, k=4, nprobe=idx.n_lists)

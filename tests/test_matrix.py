@@ -150,6 +150,35 @@ class TestGatherScatter:
         np.testing.assert_array_equal(np.asarray(blocks1[0]),
                                       [4, 5, 6, -1])
 
+    def test_take_rows_preserves_integer_dtypes(self, rng):
+        # PQ code matrices ride take_rows as uint8/int8 — neither the
+        # gather nor the fill may promote (codes stay 1 byte/entry)
+        starts = np.array([2, 17], dtype=np.int32)
+        counts = np.array([3, 5], dtype=np.int32)
+        for dt, fill in ((np.uint8, 0), (np.int8, -1), (np.int32, -1)):
+            m = rng.integers(0, 100, size=(20, 3)).astype(dt)
+            blocks, valid = matrix.take_rows(None, m, starts, counts,
+                                             max_count=5,
+                                             fill_value=fill)
+            assert blocks.dtype == dt, (dt, blocks.dtype)
+            np.testing.assert_array_equal(np.asarray(blocks[0, :3]),
+                                          m[2:5])
+            np.testing.assert_array_equal(
+                np.asarray(blocks[0, 3:]),
+                np.full((2, 3), fill, dtype=dt))
+            # clipped tail block: data rows exact, pad filled
+            np.testing.assert_array_equal(np.asarray(blocks[1, :3]),
+                                          m[17:20])
+            np.testing.assert_array_equal(np.asarray(valid[1]),
+                                          [True, True, True,
+                                           False, False])
+        # 1-D code vectors too
+        v = np.arange(9, dtype=np.uint8)
+        b1, _ = matrix.take_rows(None, v, np.array([6]), np.array([3]),
+                                 max_count=4, fill_value=0)
+        assert b1.dtype == np.uint8
+        np.testing.assert_array_equal(np.asarray(b1[0]), [6, 7, 8, 0])
+
 
 class TestMiscOps:
     def test_diagonal(self, rng):

@@ -947,6 +947,10 @@ _SMOKE_DIMS = {
     "neighbors.ivf_mnmg_search": dict(n_queries=8, probe_rows=64,
                                       n_dims=16, k=4, n_ranks=2,
                                       itemsize=4, packed_rows=256),
+    "neighbors.ivf_pq_search": dict(n_queries=8, nprobe=4,
+                                    probe_rows=64, n_dims=16, k=4,
+                                    m=4, n_codes=16, itemsize=4,
+                                    refine=8, packed_rows=256),
     "neighbors.streaming_compact": dict(packed_rows=256, n_dims=16,
                                         itemsize=4),
     "linalg.gemm": dict(m=32, n=32, k=32, itemsize=4),
