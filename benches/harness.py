@@ -74,7 +74,14 @@ import jax
 # index bytes, measured from the packed arrays) — a PQ row's recall
 # is meaningless without the memory it was bought back with, and CI
 # gates assert both witnesses.
-BENCH_ERA = 19
+# Era 20: leader failover (neighbors/election.py) makes the durable
+# fleet self-coordinating — term-fenced election, quorum-acked writes,
+# attach-only promotion. The serve/failover family's rows measure
+# time-to-new-leader over a 3-node clique, the ingest gap a failover
+# opens, and the per-write p99 cost of majority quorum acks vs async
+# shipping; rows carry the failover witnesses (most-caught-up winner,
+# post-heal crc_match, resumed acked writes) the CI gates assert on.
+BENCH_ERA = 20
 
 
 def is_current_row(d: dict, newest_era: int) -> bool:

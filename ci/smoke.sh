@@ -1917,4 +1917,84 @@ JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$PQ_ROWS" \
 rm -f "$PQ_ROWS"
 echo "ivf_pq sentry: fresh current-era rows clear the shipped baseline"
 
+# Kill-the-leader chaos gate (ISSUE 20 acceptance): a three-node
+# real-TCP fleet — every node an ElectionNode over its own journal,
+# WAL records streaming leader→followers — with the LEADER SIGKILL'd
+# mid-stream. The survivors detect heartbeat silence, elect the
+# most-caught-up follower by (term, applied_seq), and the new leader
+# resumes term-stamped writes. The orchestrator asserts quorum-acked
+# writes survived the kill (zero acked-write loss), the new term
+# fences the old one, and the promoted journal lands content-CRC
+# bit-equal to a clean never-killed twin.
+FO_OUT=$(JAX_PLATFORMS=cpu python tests/_failover_worker.py orchestrate) \
+    || { echo "failover orchestrator exited rc=$?" >&2; exit 1; }
+echo "$FO_OUT" | grep -q "FAILOVER_CHAOS_OK" || {
+    echo "failover chaos gate failed:" >&2
+    echo "$FO_OUT" >&2
+    exit 1
+}
+echo "failover chaos: $(echo "$FO_OUT" | grep FAILOVER_CHAOS_OK)"
+
+# Failover bench sentry (ISSUE 20): the serve/failover family must run
+# on the CPU tier with every row stamped the current era + partial and
+# carrying its witnesses (most-caught-up winner, post-heal CRC match,
+# acked writes resumed on the successor), the quorum row must stamp
+# its overhead-vs-async ratios, and the fresh rows must clear the
+# sentry against the shipped baseline (per-family tolerance 3.0:
+# live-fleet rows drift between container sessions). The gate asserts
+# witness PRESENCE and the boolean witnesses, not latency magnitudes —
+# single-sample tails on a busy CPU container are noise-dominated.
+FO_ROWS=$(mktemp /tmp/fo_rows.XXXXXX.jsonl)
+JAX_PLATFORMS=cpu python benches/run_benches.py \
+    --family serve/failover > "$FO_ROWS"
+python - "$FO_ROWS" <<'PYEOF2'
+import json
+import sys
+
+from benches.harness import BENCH_ERA
+
+rows = {}
+with open(sys.argv[1]) as fh:
+    for line in fh:
+        line = line.strip()
+        if line:
+            row = json.loads(line)
+            if "bench" in row and row.get("median_ms") is not None:
+                rows[row["bench"]] = row
+
+expected = {"serve/failover_election_n3",
+            "serve/failover_ingest_gap",
+            "serve/failover_ack_async",
+            "serve/failover_ack_majority"}
+missing = expected - set(rows)
+assert not missing, f"failover family dropped rows: {missing}"
+for name, row in rows.items():
+    assert row["era"] == BENCH_ERA, (name, row.get("era"))
+    assert row.get("partial") is True, \
+        f"{name}: CPU proxy row must stamp partial"
+el = rows["serve/failover_election_n3"]
+assert el["winner_most_caught_up"] is True, el
+assert el["crc_match"] is True, el
+assert el["term"] >= 1, el
+gap = rows["serve/failover_ingest_gap"]
+assert gap["writes_resumed"] is True, gap
+for mode in ("async", "majority"):
+    assert rows[f"serve/failover_ack_{mode}"].get("p99_ms") is not None
+mj = rows["serve/failover_ack_majority"]
+assert mj.get("p99_overhead_vs_async") is not None, mj
+assert mj.get("p50_overhead_vs_async") is not None, mj
+assert mj.get("quorum_waits", 0) > 0, mj
+print(f"failover bench: {len(rows)} era-{BENCH_ERA} rows (election "
+      f"{el['median_ms']:.1f} ms, ingest gap {gap['median_ms']:.1f} ms, "
+      f"quorum p50 overhead {mj['p50_overhead_vs_async']}x, "
+      f"{mj['quorum_waits']} quorum waits)")
+PYEOF2
+JAX_PLATFORMS=cpu python ci/perf_sentry.py --fresh "$FO_ROWS" \
+    --family-tol serve/failover_election_n3=3.0 \
+    --family-tol serve/failover_ingest_gap=3.0 \
+    --family-tol serve/failover_ack_async=3.0 \
+    --family-tol serve/failover_ack_majority=3.0 >/dev/null
+rm -f "$FO_ROWS"
+echo "failover sentry: fresh current-era rows clear the shipped baseline"
+
 echo "smoke: PASS"

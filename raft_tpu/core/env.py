@@ -103,6 +103,23 @@ def _parse_pos_float(raw: str) -> float:
     return val
 
 
+def _parse_quorum(raw: str) -> "str | int":
+    """WAL ack mode: ``async`` | ``majority`` | ``all`` | a positive
+    integer follower count."""
+    val = raw.strip().lower()
+    if val in ("async", "majority", "all"):
+        return val
+    try:
+        count = int(val)
+    except ValueError:
+        raise ValueError(
+            f"want async|majority|all or a positive int, got {raw!r}"
+        ) from None
+    if count < 1:
+        raise ValueError(f"explicit ack count must be >= 1, got {raw!r}")
+    return count
+
+
 def _parse_ratio_ge1(raw: str) -> float:
     """A trigger ratio: a float >= 1.0 (1.0 = trigger immediately)."""
     try:
@@ -347,6 +364,21 @@ register("RAFT_TPU_SCRUB_INTERVAL", _parse_pos_float, 1.0,
          help="background scrubber pass interval in seconds (> 0); "
               "each pass re-verifies every epoch/WAL container CRC "
               "and the in-memory packed-list sidecar")
+
+# Failover knobs (ISSUE 20): fail-loud — a typo'd election timeout
+# must never silently become "never elect" (a dead leader would take
+# ingest down forever, the exact failure mode the election exists to
+# prevent), and a typo'd quorum mode must never silently weaken the
+# zero-loss acked-write guarantee down to async.
+register("RAFT_TPU_ELECTION_TIMEOUT", _parse_pos_float, 1.0,
+         help="heartbeat-silence threshold in seconds (> 0) after "
+              "which a follower triggers leader election; also the "
+              "per-peer ballot-exchange timeout")
+register("RAFT_TPU_WAL_QUORUM", _parse_quorum, "async",
+         help="WalShipper ack mode: 'async' (ack on local journal "
+              "apply), 'majority' (block until ceil((n+1)/2) "
+              "followers confirm), 'all', or an explicit positive "
+              "follower count")
 
 # Overload-resilience toggles (ISSUE 16): degrade to the conservative
 # setting (on) with a warning — resilience must not vanish on a typo.

@@ -10,6 +10,7 @@ next layer — the coarse-quantized inverted-file index that turns the
 O(n) scan into probes over a few lists.
 """
 
+from raft_tpu.neighbors import election  # noqa: F401
 from raft_tpu.neighbors import ivf_flat  # noqa: F401
 from raft_tpu.neighbors import ivf_mnmg  # noqa: F401
 from raft_tpu.neighbors import ivf_pq  # noqa: F401
@@ -17,6 +18,8 @@ from raft_tpu.neighbors import scrub  # noqa: F401
 from raft_tpu.neighbors import streaming  # noqa: F401
 from raft_tpu.neighbors import wal_ship  # noqa: F401
 from raft_tpu.neighbors.brute_force import knn, knn_mnmg  # noqa: F401
+from raft_tpu.neighbors.election import (ElectionError,  # noqa: F401
+                                         ElectionNode, ElectionRecord)
 from raft_tpu.neighbors.ivf_flat import IvfFlatIndex  # noqa: F401
 from raft_tpu.neighbors.ivf_mnmg import (IvfMnmgIndex,  # noqa: F401
                                          build_mnmg, rebalance_mnmg,
@@ -29,10 +32,12 @@ from raft_tpu.neighbors.streaming import (Compactor,  # noqa: F401
                                           ShardCorruptError,
                                           StreamingError,
                                           StreamingIndex,
-                                          StreamingMnmg, WalGapError,
+                                          StreamingMnmg,
+                                          TermFencedError, WalGapError,
                                           stream_build)
 from raft_tpu.neighbors.wal_ship import (CatchupReport,  # noqa: F401
-                                         WalFollower, WalShipper,
+                                         WalFollower, WalFrameError,
+                                         WalQuorumError, WalShipper,
                                          bootstrap_follower)
 
 __all__ = ["knn", "knn_mnmg", "ivf_flat", "IvfFlatIndex",
@@ -43,5 +48,8 @@ __all__ = ["knn", "knn_mnmg", "ivf_flat", "IvfFlatIndex",
            "stream_build", "Compactor", "DriftGauge", "MutationLog",
            "StreamingError", "RecoveryError",
            "wal_ship", "WalShipper", "WalFollower", "CatchupReport",
-           "bootstrap_follower", "WalGapError",
+           "bootstrap_follower", "WalGapError", "WalFrameError",
+           "WalQuorumError", "TermFencedError",
+           "election", "ElectionNode", "ElectionRecord",
+           "ElectionError",
            "scrub", "Scrubber", "ScrubReport", "ShardCorruptError"]

@@ -75,9 +75,9 @@ from raft_tpu.neighbors.ivf_flat import (SLOT_ALIGN, IvfFlatIndex,
 
 __all__ = [
     "StreamingError", "RecoveryError", "WalGapError",
-    "ShardCorruptError", "MutationLog", "DriftGauge",
+    "ShardCorruptError", "TermFencedError", "MutationLog", "DriftGauge",
     "StreamingIndex", "Compactor", "StreamingMnmg", "stream_build",
-    "KIND_INSERT", "KIND_DELETE", "KIND_CENTROIDS",
+    "KIND_INSERT", "KIND_DELETE", "KIND_CENTROIDS", "KIND_TERM",
 ]
 
 #: WAL record kinds (checkpoint entries carry scalars, not strings).
@@ -88,6 +88,12 @@ KIND_DELETE = 1
 #: it's content-neutral — but a refit changes centroids, which are part
 #: of the content_crc witness)
 KIND_CENTROIDS = 2
+#: a leadership change (ISSUE 20): the first record a freshly promoted
+#: leader journals under its new term. Content-neutral (no rows move),
+#: but it consumes a sequence number and ships like any record, so
+#: every follower's durable journal records exactly where the term
+#: boundary falls — the fencing line a deposed leader truncates to
+KIND_TERM = 3
 
 _WAL_RE = re.compile(r"^wal-(\d{8})\.ckpt$")
 _EPOCH_RE = re.compile(r"^epoch-(\d{8})\.ckpt$")
@@ -114,6 +120,28 @@ class WalGapError(StreamingError):
             f"required")
         self.expected = int(expected)
         self.got = int(got)
+
+
+class TermFencedError(StreamingError):
+    """A WAL record stamped with a STALE term reached a replica that
+    has already seen a higher one — the writer is a deposed leader
+    that missed an election (partitioned, paused, or restarted from an
+    old journal). The record is rejected, never applied; the carried
+    ``divergence`` sequence tells the deposed leader exactly where its
+    journal forked from the fleet's, i.e. the first sequence it must
+    truncate before demoting to follower and healing via catch-up
+    (ISSUE 20)."""
+
+    def __init__(self, *, stale_term: int, current_term: int,
+                 divergence: int):
+        super().__init__(
+            f"term fence: record stamped term {stale_term} rejected by "
+            f"a replica at term {current_term}; journals diverge at "
+            f"seq {divergence} — truncate the unreplicated suffix and "
+            f"rejoin as a follower")
+        self.stale_term = int(stale_term)
+        self.current_term = int(current_term)
+        self.divergence = int(divergence)
 
 
 class ShardCorruptError(StreamingError):
@@ -162,10 +190,13 @@ class MutationLog:
     Recovery loads the newest intact epoch and replays the WAL records
     past its ``wal_horizon`` (the highest sequence folded into it), in
     sequence order; committing a new epoch prunes the records it folds.
-    ``on_append`` (callable, one durable record dict) is the WAL-
-    shipping hook: it fires AFTER the record hits disk, so a shipped
-    record is always at least as durable at the source as at any
-    follower.
+    ``add_on_append`` registers an append subscriber (callable, one
+    durable record dict): subscribers fire in registration order AFTER
+    the record hits disk, so a shipped record is always at least as
+    durable at the source as at any follower. The WAL shipper, the
+    election heartbeater, and any scrub trigger coexist as independent
+    subscribers (ISSUE 20); the legacy single-slot ``on_append``
+    assignment still works through a property shim.
     """
 
     def __init__(self, directory: str, *, retain: Optional[int] = None):
@@ -180,7 +211,41 @@ class MutationLog:
         seqs = [int(m.group(1)) for f in os.listdir(self.directory)
                 if (m := _WAL_RE.match(f))]
         self._next_seq = max(seqs, default=-1) + 1
-        self.on_append: Optional[Callable[[Dict], None]] = None
+        self._on_append: List[Callable[[Dict], None]] = []
+
+    # -- append subscribers -------------------------------------------
+
+    @property
+    def on_append(self) -> Optional[Callable[[Dict], None]]:
+        """Legacy single-slot view of the subscriber list: ``None``
+        when empty, the callable when exactly one, the ordered tuple
+        when several (so ``log.on_append is not None`` keeps meaning
+        'someone is listening')."""
+        if not self._on_append:
+            return None
+        if len(self._on_append) == 1:
+            return self._on_append[0]
+        return tuple(self._on_append)
+
+    @on_append.setter
+    def on_append(self, fn: Optional[Callable[[Dict], None]]) -> None:
+        """Single-slot assignment shim: replaces the WHOLE subscriber
+        list (``None`` clears it) — the pre-ISSUE-20 contract."""
+        with self._lock:
+            self._on_append = [] if fn is None else [fn]
+
+    def add_on_append(self, fn: Callable[[Dict], None]) -> None:
+        """Register an append subscriber; idempotent — re-adding the
+        same callable keeps its original position."""
+        with self._lock:
+            if fn not in self._on_append:
+                self._on_append.append(fn)
+
+    def remove_on_append(self, fn: Callable[[Dict], None]) -> None:
+        """Unregister a subscriber; idempotent — removing a callable
+        that is not registered is a no-op."""
+        with self._lock:
+            self._on_append = [h for h in self._on_append if h is not fn]
 
     # -- WAL ----------------------------------------------------------
 
@@ -202,7 +267,8 @@ class MutationLog:
     def append(self, entries: Dict) -> int:
         """Atomically write one WAL record; returns its sequence number.
         ``entries`` must not contain ``seq`` (stamped here). Fires the
-        ``on_append`` shipping hook after the record is durable."""
+        ``on_append`` subscribers in order after the record is
+        durable."""
         with self._lock:
             seq = self._next_seq
             self._next_seq += 1
@@ -210,8 +276,7 @@ class MutationLog:
         rec["seq"] = seq
         save_checkpoint(
             os.path.join(self.directory, f"wal-{seq:08d}.ckpt"), rec)
-        hook = self.on_append
-        if hook is not None:
+        for hook in list(self._on_append):
             hook(rec)
         return seq
 
@@ -264,6 +329,29 @@ class MutationLog:
             if fold:
                 os.remove(path)
                 removed += 1
+        return removed
+
+    def truncate_from(self, from_seq: int) -> int:
+        """Delete every WAL record with ``seq >= from_seq`` — the
+        deposed-leader heal step (ISSUE 20): a stale leader that kept
+        appending past the fleet's divergence point holds a suffix the
+        quorum never saw, which fencing guarantees will NEVER be
+        accepted; it is cut here before the node demotes to follower
+        and resyncs. Rewinds the issue cursor so the mirrored records
+        that replace the suffix keep the fleet's numbering. Returns how
+        many records were removed."""
+        from_seq = int(from_seq)
+        removed = 0
+        with self._lock:
+            for name in sorted(f for f in os.listdir(self.directory)
+                               if _WAL_RE.match(f)):
+                if int(_WAL_RE.match(name).group(1)) >= from_seq:
+                    os.remove(os.path.join(self.directory, name))
+                    removed += 1
+            self._next_seq = min(self._next_seq, from_seq)
+        if removed:
+            trace.record_event("streaming.wal_truncate",
+                               from_seq=from_seq, removed=removed)
         return removed
 
     # -- epoch snapshots ----------------------------------------------
@@ -406,7 +494,8 @@ class StreamingIndex:
                  tomb_host: Optional[np.ndarray] = None,
                  n_live: Optional[int] = None,
                  reservoir_cap: int = 4096,
-                 repack_slack: int = SLOT_ALIGN):
+                 repack_slack: int = SLOT_ALIGN,
+                 term: int = 0):
         self._lock = threading.RLock()
         self.log = log
         # highest WAL sequence folded into the in-memory state — the
@@ -415,6 +504,26 @@ class StreamingIndex:
         # state, and a mid-replay repack must not claim — or prune —
         # records it hasn't folded yet)
         self._applied_seq = log.last_seq if log is not None else -1
+        # leadership term (ISSUE 20): stamped into every journaled
+        # record and every epoch snapshot; only ever advances. A record
+        # from a LOWER term is a deposed leader's write — fenced, never
+        # applied (WalFollower.apply_record raises TermFencedError)
+        self._term = int(term)
+        # the sequence number at which the current term began (the
+        # KIND_TERM record's seq): the divergence point a fence error
+        # carries — a deposed leader truncates its journal from here
+        self._term_start = 0
+        # optional post-commit barrier (ISSUE 20 quorum acks): called
+        # with the mutation's seq AFTER journal+apply, OUTSIDE the
+        # lock; a WalShipper in quorum mode installs its ack wait here
+        self._commit_barrier: Optional[Callable[[int], None]] = None
+        # bounded client-write dedup map (ISSUE 20): write_id → the ids
+        # the insert assigned, populated on apply/replay/mirror so an
+        # in-flight batch replayed at the NEW leader after a failover
+        # returns its original ids instead of double-inserting
+        self._write_ids: "collections.OrderedDict[int, np.ndarray]" = \
+            collections.OrderedDict()
+        self._write_ids_cap = 1024
         self.faults = faults
         self.res = res
         self.drift = drift if drift is not None else DriftGauge()
@@ -475,7 +584,9 @@ class StreamingIndex:
         idx = cls(flat, log=log, faults=faults, res=res, drift=drift,
                   epoch=epoch, next_id=int(ent["next_id"]),
                   tomb_host=np.asarray(ent["tomb_words"], np.uint32),
-                  n_live=int(ent["n_live"]))
+                  n_live=int(ent["n_live"]),
+                  term=int(ent.get("wal_term", 0)))
+        idx._term_start = int(ent.get("wal_term_start", 0))
         horizon = int(ent["wal_horizon"]) if "wal_horizon" in ent \
             else None
         if horizon is not None:
@@ -495,10 +606,14 @@ class StreamingIndex:
             # it a second time against state that already contains it
             if "seq" in rec:
                 idx._applied_seq = int(rec["seq"])
+            idx._term = max(idx._term, int(rec.get("term", 0)))
             if kind == KIND_INSERT:
-                idx._apply_insert(np.asarray(rec["data"]),
-                                  np.asarray(rec["labels"], np.int64),
-                                  journal=False)
+                ids = idx._apply_insert(
+                    np.asarray(rec["data"]),
+                    np.asarray(rec["labels"], np.int64),
+                    journal=False)
+                if "write_id" in rec:
+                    idx.note_write_id(int(rec["write_id"]), ids)
             elif kind == KIND_DELETE:
                 idx._apply_delete(np.asarray(rec["data"], np.int64),
                                   journal=False)
@@ -507,6 +622,10 @@ class StreamingIndex:
                     idx._repack_locked(
                         centroids=np.asarray(rec["data"], np.float32),
                         reason="refit_replay")
+            elif kind == KIND_TERM:
+                idx._term = max(idx._term,
+                                int(np.asarray(rec["data"]).ravel()[0]))
+                idx._term_start = int(rec["seq"])
             else:
                 raise RecoveryError(f"unknown WAL record kind {kind}")
             replayed += 1
@@ -538,6 +657,9 @@ class StreamingIndex:
             self._tomb_host = np.asarray(ent["tomb_words"],
                                          np.uint32).copy()
             self._applied_seq = int(ent.get("wal_horizon", -1))
+            self._term = max(self._term, int(ent.get("wal_term", 0)))
+            self._term_start = max(self._term_start,
+                                   int(ent.get("wal_term_start", 0)))
             if self.log is not None:
                 self.log.bump_seq(self._applied_seq + 1)
             self._write_epoch_locked(crash=False)
@@ -573,6 +695,64 @@ class StreamingIndex:
     def next_id(self) -> int:
         with self._lock:
             return self._next_id
+
+    @property
+    def term(self) -> int:
+        """Current leadership term (monotone; see :class:`TermFencedError`)."""
+        with self._lock:
+            return self._term
+
+    @property
+    def applied_seq(self) -> int:
+        """Highest WAL sequence folded into the in-memory state — the
+        election's catch-up yardstick: the survivor with the highest
+        ``(term, applied_seq)`` is the most complete mirror and wins
+        promotion."""
+        with self._lock:
+            return self._applied_seq
+
+    def begin_term(self, new_term: int) -> int:
+        """Adopt a HIGHER leadership term and journal the boundary as a
+        :data:`KIND_TERM` record — the freshly elected leader's first
+        write. The record consumes a sequence number and ships through
+        the normal on_append path, so every follower's journal durably
+        records where the old term ended. Returns the record's seq."""
+        with self._lock:
+            if int(new_term) <= self._term:
+                raise StreamingError(
+                    f"begin_term: new term {int(new_term)} must exceed "
+                    f"current term {self._term}")
+            self._term = int(new_term)
+            self._journal(KIND_TERM,
+                          np.asarray([self._term], np.int64))
+            seq = self._applied_seq
+            self._term_start = seq
+        trace.record_event("streaming.begin_term", term=int(new_term),
+                           seq=seq)
+        return seq
+
+    def adopt_term(self, new_term: int) -> None:
+        """Raise the local term WITHOUT journaling (the follower side
+        of an election: the journal boundary arrives as the new
+        leader's shipped :data:`KIND_TERM` record)."""
+        with self._lock:
+            self._term = max(self._term, int(new_term))
+
+    def note_write_id(self, write_id: int, ids: np.ndarray) -> None:
+        """Record a client write-id → assigned-ids mapping in the
+        bounded dedup map (see :meth:`insert`)."""
+        with self._lock:
+            self._write_ids[int(write_id)] = np.asarray(ids, np.int64)
+            self._write_ids.move_to_end(int(write_id))
+            while len(self._write_ids) > self._write_ids_cap:
+                self._write_ids.popitem(last=False)
+
+    def seen_write_id(self, write_id: int) -> Optional[np.ndarray]:
+        """The ids a previously applied ``write_id`` was assigned, or
+        None — the idempotent-replay check."""
+        with self._lock:
+            ids = self._write_ids.get(int(write_id))
+            return None if ids is None else ids.copy()
 
     def tombstone_fraction(self) -> float:
         """Dead rows still occupying packed slots / packed rows."""
@@ -630,12 +810,16 @@ class StreamingIndex:
             self.faults.crash_point(name)
 
     def _journal(self, kind: int, data: np.ndarray,
-                 labels: Optional[np.ndarray] = None) -> None:
+                 labels: Optional[np.ndarray] = None,
+                 write_id: Optional[int] = None) -> None:
         if self.log is None:
             return
-        rec: Dict = {"kind": kind, "epoch": self._epoch, "data": data}
+        rec: Dict = {"kind": kind, "epoch": self._epoch, "data": data,
+                     "term": self._term}
         if labels is not None:
             rec["labels"] = np.asarray(labels, np.int64)
+        if write_id is not None:
+            rec["write_id"] = int(write_id)
         # journal-first: the apply follows under the same lock, so the
         # applied horizon may advance with the durable write
         self._applied_seq = self.log.append(rec)
@@ -662,13 +846,20 @@ class StreamingIndex:
 
     # -- mutation ------------------------------------------------------
 
-    def insert(self, rows, labels: Optional[np.ndarray] = None
-               ) -> np.ndarray:
+    def insert(self, rows, labels: Optional[np.ndarray] = None, *,
+               write_id: Optional[int] = None) -> np.ndarray:
         """Append rows; returns their external ids (assigned in arrival
         order, stable forever). Journal-first: the WAL record (rows +
         routing labels, so replay is deterministic even under MNMG load
         routing) is durable before the in-memory apply — a kill between
         the two replays the insert on recovery.
+
+        ``write_id`` (optional client token, ISSUE 20) makes the insert
+        idempotent across a leader failover: a batch replayed at the
+        new leader with a write_id the journal already applied returns
+        its ORIGINAL ids without re-inserting (delete is naturally
+        idempotent — tombstones converge — so only insert needs the
+        token).
 
         Rows that fit the padded tails apply as a pure in-place append
         (same shapes — zero retrace); an overflow repacks live rows
@@ -681,6 +872,12 @@ class StreamingIndex:
         if rows.shape[0] == 0:
             return np.zeros((0,), np.int64)
         with self._lock:
+            if write_id is not None:
+                prior = self.seen_write_id(write_id)
+                if prior is not None:
+                    if obs.enabled():
+                        obs.inc("streaming_write_dedups_total")
+                    return prior
             if labels is None:
                 dist, labels = _coarse_assign(rows,
                                               self._flat.centroids)
@@ -693,9 +890,20 @@ class StreamingIndex:
                     f"labels must be [{rows.shape[0]}] list indices in "
                     f"[0, {self._flat.n_lists})")
             self._crash("ingest.pre_journal")
-            self._journal(KIND_INSERT, rows, labels)
+            self._journal(KIND_INSERT, rows, labels,
+                          write_id=write_id)
             self._crash("ingest.post_journal")
+            seq = self._applied_seq
             ids = self._apply_insert(rows, labels, journal=True)
+            if write_id is not None:
+                self.note_write_id(write_id, ids)
+        # quorum-ack barrier OUTSIDE the lock (ISSUE 20): the write is
+        # journaled+applied locally either way — the barrier only
+        # decides when the CLIENT may consider it replicated, and a
+        # timeout raises the typed indeterminate error
+        barrier = self._commit_barrier
+        if barrier is not None:
+            barrier(seq)
         if obs.enabled():
             obs.inc("streaming_inserts_total", int(rows.shape[0]))
         return ids
@@ -765,7 +973,11 @@ class StreamingIndex:
             self._crash("ingest.pre_journal")
             self._journal(KIND_DELETE, ids)
             self._crash("ingest.post_journal")
+            seq = self._applied_seq
             flipped = self._apply_delete(ids, journal=True)
+        barrier = self._commit_barrier
+        if barrier is not None:
+            barrier(seq)
         if obs.enabled():
             obs.inc("streaming_deletes_total", flipped)
             obs.set_gauge("streaming_tombstone_frac",
@@ -1082,6 +1294,11 @@ def _epoch_entries(idx: StreamingIndex) -> Dict:
         # highest WAL sequence folded into this snapshot: recovery
         # replays strictly past it, the commit prunes through it
         "wal_horizon": idx._applied_seq,
+        # leadership term at the snapshot (ISSUE 20): restored on
+        # recovery so a restarted replica rejoins fenced at the term
+        # it last saw, never accepting a deposed leader's writes
+        "wal_term": idx._term,
+        "wal_term_start": idx._term_start,
         "metric": np.frombuffer(flat.metric.encode(), np.uint8),
         "centroids": np.asarray(flat.centroids, np.float32),
         "packed_db": np.asarray(flat.packed_db),

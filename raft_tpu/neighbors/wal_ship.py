@@ -33,6 +33,7 @@ gap means records were genuinely pruned or lost, never reordered.
 
 from __future__ import annotations
 
+import collections
 import io
 import threading
 import time
@@ -43,18 +44,22 @@ import numpy as np
 
 from raft_tpu import obs
 from raft_tpu.comms.errors import PeerFailedError
-from raft_tpu.core import trace
-from raft_tpu.core.checkpoint import dump_checkpoint, load_checkpoint
+from raft_tpu.core import env, trace
+from raft_tpu.core.checkpoint import (CheckpointError, dump_checkpoint,
+                                      load_checkpoint)
 from raft_tpu.neighbors.streaming import (KIND_CENTROIDS, KIND_DELETE,
-                                          KIND_INSERT, MutationLog,
-                                          StreamingError, StreamingIndex,
-                                          WalGapError, _epoch_entries,
+                                          KIND_INSERT, KIND_TERM,
+                                          MutationLog, StreamingError,
+                                          StreamingIndex,
+                                          TermFencedError, WalGapError,
+                                          _epoch_entries,
                                           _flat_from_live)
 
 __all__ = [
-    "TAG_WAL", "TAG_CATCHUP_REQ", "TAG_CATCHUP",
+    "TAG_WAL", "TAG_CATCHUP_REQ", "TAG_CATCHUP", "TAG_WAL_ACK",
     "FRAME_WAL", "FRAME_SNAPSHOT", "FRAME_END",
-    "encode_frame", "decode_frame",
+    "encode_frame", "decode_frame", "frame_kind",
+    "WalFrameError", "WalQuorumError",
     "WalShipper", "WalFollower", "CatchupReport", "bootstrap_follower",
 ]
 
@@ -63,26 +68,79 @@ __all__ = [
 TAG_WAL = 7301          # leader → follower: one live WAL record
 TAG_CATCHUP_REQ = 7302  # follower → leader: {"from_seq": n}
 TAG_CATCHUP = 7303      # leader → follower: catch-up frame stream
+TAG_WAL_ACK = 7304      # follower → leader: {"applied", "rank", "term"}
 
 FRAME_WAL = 0       # one WAL record (keys of MutationLog.append + seq)
 FRAME_SNAPSHOT = 1  # full epoch entries (gap too wide — resync)
 FRAME_END = 2       # {"through_seq": n} — catch-up stream terminator
 
+_FRAME_KINDS = (FRAME_WAL, FRAME_SNAPSHOT, FRAME_END)
+
+
+class WalFrameError(StreamingError):
+    """A wire frame failed to encode, decode, or identify itself — a
+    damaged payload (bit-flip, truncation), a non-frame message on a
+    frame tag, or an unknown ``_frame`` kind. Typed so transport
+    corruption surfaces as one catchable error instead of the raw
+    ``KeyError``/pickle taxonomy of whatever broke first (ISSUE 20
+    satellite)."""
+
+
+class WalQuorumError(StreamingError):
+    """A quorum-ack mutation timed out before enough followers
+    confirmed the sequence durable in their mirror journals. The write
+    IS durable on the leader (journal-first) — the caller must treat it
+    as indeterminate and retry idempotently (``write_id`` dedup), never
+    as definitely-lost (ISSUE 20)."""
+
+    def __init__(self, *, seq: int, acked: int, needed: int):
+        super().__init__(
+            f"quorum ack timeout: seq {seq} confirmed by {acked} "
+            f"follower(s), needed {needed} — write is durable locally "
+            f"but NOT quorum-replicated; retry idempotently")
+        self.seq = int(seq)
+        self.acked = int(acked)
+        self.needed = int(needed)
+
 
 def encode_frame(entries: Dict) -> np.ndarray:
     """Serialize a frame dict into a uint8 array: the same CRC'd v1
     checkpoint container the WAL writes, so one integrity format guards
-    both rest and wire."""
+    both rest and wire. Raises :class:`WalFrameError` on an
+    unserializable frame."""
     bio = io.BytesIO()
-    dump_checkpoint(entries, bio)
+    try:
+        dump_checkpoint(entries, bio)
+    except (CheckpointError, KeyError, ValueError, TypeError) as exc:
+        raise WalFrameError(f"frame encode failed: {exc}") from exc
     return np.frombuffer(bio.getvalue(), np.uint8)
 
 
 def decode_frame(payload: np.ndarray) -> Dict:
-    """Inverse of :func:`encode_frame` (raises the typed
-    ``CheckpointError`` taxonomy on a damaged frame)."""
-    raw = np.asarray(payload, np.uint8).tobytes()
-    return load_checkpoint(io.BytesIO(raw))
+    """Inverse of :func:`encode_frame`. A damaged payload (bit-flip,
+    truncation, wrong format) raises :class:`WalFrameError` carrying
+    the underlying cause — never the raw ``KeyError``/pickle
+    taxonomy."""
+    try:
+        raw = np.asarray(payload, np.uint8).tobytes()
+        return load_checkpoint(io.BytesIO(raw))
+    except (CheckpointError, KeyError, ValueError, TypeError,
+            EOFError, OSError) as exc:
+        raise WalFrameError(f"frame decode failed: {exc}") from exc
+
+
+def frame_kind(frame: Dict) -> int:
+    """The validated ``_frame`` kind of a decoded frame; raises
+    :class:`WalFrameError` when the tag is missing or unknown (a
+    well-formed container that is not a protocol frame)."""
+    try:
+        kind = int(frame["_frame"])
+    except (KeyError, TypeError, ValueError) as exc:
+        raise WalFrameError(
+            f"frame has no usable _frame tag: {exc}") from exc
+    if kind not in _FRAME_KINDS:
+        raise WalFrameError(f"unknown _frame kind {kind}")
+    return kind
 
 
 @dataclass
@@ -114,7 +172,9 @@ class WalShipper:
 
     def __init__(self, index: StreamingIndex, mailbox, rank: int,
                  followers: Iterable[int], *,
-                 poll_interval: float = 0.05):
+                 poll_interval: float = 0.05,
+                 acks: "str | int | None" = None,
+                 ack_timeout: float = 10.0):
         if index.log is None:
             raise StreamingError(
                 "WAL shipping needs a journaled index (directory=...)")
@@ -125,29 +185,84 @@ class WalShipper:
         if self.rank in self.followers:
             raise ValueError(f"rank {self.rank} cannot follow itself")
         self.poll_interval = float(poll_interval)
+        if acks is None:
+            acks = env.read("RAFT_TPU_WAL_QUORUM")
+        if isinstance(acks, str) and acks not in ("async", "majority",
+                                                  "all"):
+            raise ValueError(
+                f"acks must be 'async', 'majority', 'all' or a "
+                f"positive follower count, got {acks!r}")
+        if not isinstance(acks, str) and int(acks) < 1:
+            raise ValueError(f"acks count must be >= 1, got {acks}")
+        self.acks = acks
+        self.ack_timeout = float(ack_timeout)
         self.shipped = 0
         self.ship_errors = 0
         self.catchups_served = 0
+        self.quorum_waits = 0
+        # per-follower highest acked sequence + bounded seq → send
+        # walltime map feeding the wal_replication_lag_seconds gauge
+        self._acked: Dict[int, int] = {}
+        self._sent_at: "collections.OrderedDict[int, float]" = \
+            collections.OrderedDict()
+        self._ack_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
 
+    def acks_needed(self) -> int:
+        """How many FOLLOWER confirmations a mutation must collect
+        before it returns (the leader's own journal is the +1 vote):
+        0 in async mode, ⌈(n+1)/2⌉−1 for majority over the n+1-node
+        fleet, every follower for ``all``."""
+        if self.acks == "async":
+            return 0
+        n = len(self.followers) + 1            # fleet size incl. leader
+        if self.acks == "majority":
+            return max((n + 1 + 1) // 2 - 1, 0)
+        if self.acks == "all":
+            return len(self.followers)
+        return min(int(self.acks), len(self.followers))
+
     # -- live shipping -------------------------------------------------
 
     def attach(self) -> "WalShipper":
-        if self.index.log.on_append is not None:
-            raise StreamingError("journal already has an on_append hook")
-        self.index.log.on_append = self._on_append
+        """Register on the journal's append-subscriber list. Exactly
+        ONE shipper may source a journal (two would double-ship every
+        record), but non-shipper subscribers — election heartbeater,
+        scrub trigger — coexist freely (ISSUE 20). Idempotent for the
+        same shipper instance. Quorum-ack modes also install the
+        index's commit barrier so ``insert()/delete()`` block on
+        follower confirmation."""
+        log = self.index.log
+        other = getattr(log, "_shipper", None)
+        if other is self:
+            return self
+        if other is not None:
+            raise StreamingError(
+                "journal already has an on_append WAL-shipping hook")
+        log._shipper = self
+        log.add_on_append(self._on_append)
+        if self.acks_needed() > 0:
+            self.index._commit_barrier = self._quorum_barrier
         return self
 
     def detach(self) -> None:
-        if self.index.log.on_append is self._on_append:
-            self.index.log.on_append = None
+        log = self.index.log
+        if getattr(log, "_shipper", None) is self:
+            log._shipper = None
+        log.remove_on_append(self._on_append)
+        if self.index._commit_barrier is self._quorum_barrier:
+            self.index._commit_barrier = None
 
     def _on_append(self, rec: Dict) -> None:
         fr = dict(rec)
         fr["_frame"] = FRAME_WAL
         payload = encode_frame(fr)
+        with self._ack_lock:
+            self._sent_at[int(rec["seq"])] = time.monotonic()
+            while len(self._sent_at) > 4096:
+                self._sent_at.popitem(last=False)
         ok = 0
         for f in self.followers:
             # replication is async: a dead follower must never fail the
@@ -165,6 +280,69 @@ class WalShipper:
         self.shipped += 1
         if obs.enabled() and ok:
             obs.inc("wal_records_shipped_total", ok)
+
+    # -- replication acks ---------------------------------------------
+
+    def drain_acks(self) -> int:
+        """Fold every queued follower ack into the per-follower acked
+        cursor and the ``wal_replication_lag_seconds`` gauge; returns
+        how many acks were processed. Runs on the poller thread AND
+        inside the quorum wait — both sides only ever advance the
+        cursor, so the race is benign."""
+        n = 0
+        for f in self.followers:
+            while True:
+                payload = self.mailbox.get_nowait(f, self.rank,
+                                                  TAG_WAL_ACK)
+                if payload is None:
+                    break
+                try:
+                    ack = decode_frame(payload)
+                    applied = int(ack["applied"])
+                except (WalFrameError, KeyError, ValueError) as exc:
+                    trace.record_event("wal_ship.bad_ack", follower=f,
+                                       error=repr(exc))
+                    continue
+                n += 1
+                with self._ack_lock:
+                    prev = self._acked.get(f, -1)
+                    self._acked[f] = max(prev, applied)
+                    sent = self._sent_at.get(applied)
+                if sent is not None and obs.enabled():
+                    obs.set_gauge("wal_replication_lag_seconds",
+                                  time.monotonic() - sent,
+                                  follower=str(f))
+        return n
+
+    def acked_seq(self, follower: int) -> int:
+        """Highest sequence this follower has confirmed durable."""
+        with self._ack_lock:
+            return self._acked.get(int(follower), -1)
+
+    def _quorum_barrier(self, seq: int) -> None:
+        """Block the committing mutation until ``acks_needed()``
+        followers confirmed ``seq`` durable in their mirror journals.
+        Installed as the index's commit barrier in quorum-ack modes —
+        it runs AFTER the local journal+apply, so a timeout leaves the
+        leader consistent and raises the typed
+        :class:`WalQuorumError` (indeterminate, retry idempotently)."""
+        need = self.acks_needed()
+        if need <= 0:
+            return
+        self.quorum_waits += 1
+        deadline = time.monotonic() + self.ack_timeout
+        while True:
+            self.drain_acks()
+            with self._ack_lock:
+                got = sum(1 for f in self.followers
+                          if self._acked.get(f, -1) >= seq)
+            if got >= need:
+                return
+            if time.monotonic() >= deadline:
+                if obs.enabled():
+                    obs.inc("wal_quorum_timeouts_total")
+                raise WalQuorumError(seq=seq, acked=got, needed=need)
+            time.sleep(0.001)
 
     # -- catch-up service ---------------------------------------------
 
@@ -228,6 +406,7 @@ class WalShipper:
         while not self._stop.wait(self.poll_interval):
             try:
                 self.serve_catchup_once()
+                self.drain_acks()
             except Exception as exc:  # noqa: BLE001 — surfaced at stop
                 self._error = exc
                 obs.record_failure(exc)
@@ -279,16 +458,18 @@ class WalFollower:
     """
 
     def __init__(self, index: StreamingIndex, mailbox, rank: int,
-                 leader: int):
+                 leader: int, *, send_acks: bool = True):
         self.index = index
         self.mailbox = mailbox
         self.rank = int(rank)
         self.leader = int(leader)
         if self.rank == self.leader:
             raise ValueError(f"rank {self.rank} cannot follow itself")
+        self.send_acks = bool(send_acks)
         self.applied = 0
         self.dups = 0
         self.resyncs = 0
+        self.fenced = 0
 
     @property
     def applied_seq(self) -> int:
@@ -296,14 +477,63 @@ class WalFollower:
         catch-up cursor — survives restart via the mirrored journal)."""
         return self.index._applied_seq
 
+    def repoint(self, new_leader: int) -> None:
+        """Re-point this follower at a NEW leader (the election-loser
+        step, ISSUE 20): live records and catch-up rounds now flow
+        from ``new_leader``; the cursor and mirrored journal carry
+        over untouched — sequence numbers are fleet-wide, not
+        per-leader."""
+        new_leader = int(new_leader)
+        if new_leader == self.rank:
+            raise ValueError(
+                f"rank {self.rank} cannot follow itself")
+        old, self.leader = self.leader, new_leader
+        trace.record_event("wal_ship.repoint", old_leader=old,
+                           new_leader=new_leader, rank=self.rank)
+
     # -- record application -------------------------------------------
+
+    def _ack(self) -> None:
+        """Confirm our durable cursor to the leader (the quorum-ack
+        vote AND the replication-lag sample — sent in async mode too,
+        so the gauge works without the blocking mode's cost). A dead
+        leader is tolerated: the ack is advisory, the election notices
+        the death."""
+        if not self.send_acks:
+            return
+        try:
+            self.mailbox.put(
+                self.rank, self.leader, TAG_WAL_ACK,
+                encode_frame({"applied": self.index._applied_seq,
+                              "rank": self.rank,
+                              "term": self.index._term}))
+        except (PeerFailedError, OSError):
+            pass
 
     def apply_record(self, rec: Dict) -> bool:
         """Mirror + apply ONE shipped record; returns True when it
         advanced the index (False = duplicate). Raises
-        :class:`WalGapError` when ``rec`` is not the next sequence."""
+        :class:`WalGapError` when ``rec`` is not the next sequence and
+        :class:`~raft_tpu.neighbors.streaming.TermFencedError` when it
+        is stamped with a term OLDER than this replica's — a deposed
+        leader's write, rejected before it can touch the journal."""
         seq = int(rec["seq"])
         with self.index._lock:
+            term = int(rec.get("term", 0))
+            cur = self.index._term
+            if term < cur and seq >= self.index._term_start:
+                # fence FIRST — a stale-term record at or past the
+                # current term's boundary is a deposed leader's
+                # divergent write (even as a duplicate seq); it must
+                # learn to demote. Records BELOW the boundary
+                # legitimately carry older terms (catch-up replays
+                # history) and fall through to the dup/gap checks.
+                self.fenced += 1
+                if obs.enabled():
+                    obs.inc("wal_fenced_records_total")
+                raise TermFencedError(
+                    stale_term=term, current_term=cur,
+                    divergence=self.index._term_start)
             applied = self.index._applied_seq
             if seq <= applied:
                 self.dups += 1
@@ -317,11 +547,15 @@ class WalFollower:
             # discipline): an apply that repacks folds this record into
             # the epoch it commits, so the horizon must cover it
             self.index._applied_seq = seq
+            if term > cur:
+                self.index._term = term
             kind = int(rec["kind"])
             if kind == KIND_INSERT:
-                self.index._apply_insert(
+                ids = self.index._apply_insert(
                     np.asarray(rec["data"]),
                     np.asarray(rec["labels"], np.int64), journal=False)
+                if "write_id" in rec:
+                    self.index.note_write_id(int(rec["write_id"]), ids)
             elif kind == KIND_DELETE:
                 self.index._apply_delete(
                     np.asarray(rec["data"], np.int64), journal=False)
@@ -329,6 +563,10 @@ class WalFollower:
                 self.index._repack_locked(
                     centroids=np.asarray(rec["data"], np.float32),
                     reason="refit_shipped")
+            elif kind == KIND_TERM:
+                new_t = int(np.asarray(rec["data"]).ravel()[0])
+                self.index._term = max(self.index._term, new_t)
+                self.index._term_start = seq
             else:
                 raise StreamingError(
                     f"unknown shipped WAL record kind {kind}")
@@ -338,27 +576,40 @@ class WalFollower:
     def drain(self, *, resync: bool = True) -> int:
         """Apply every queued live record; returns how many advanced
         the index. A detected gap triggers a :meth:`catch_up` when
-        ``resync`` (the steady-state loop), else propagates (tests)."""
+        ``resync`` (the steady-state loop), else propagates (tests).
+        Confirms the durable cursor back to the leader after every
+        batch that moved it (or re-confirms on duplicates — the
+        at-least-once resend path needs re-acks)."""
         n = 0
-        while True:
-            payload = self.mailbox.get_nowait(self.leader, self.rank,
-                                              TAG_WAL)
-            if payload is None:
-                return n
-            rec = decode_frame(payload)
-            try:
-                if self.apply_record(rec):
-                    n += 1
-            except WalGapError:
-                if not resync:
-                    raise
-                rpt = self.catch_up()
-                n += rpt.records
-                # the gapped record is ≤ the catch-up horizon now —
-                # re-offer it so a post-horizon record still applies
-                if int(rec["seq"]) > self.index._applied_seq:
+        saw = 0
+        try:
+            while True:
+                payload = self.mailbox.get_nowait(self.leader,
+                                                  self.rank, TAG_WAL)
+                if payload is None:
+                    return n
+                rec = decode_frame(payload)
+                if frame_kind(rec) != FRAME_WAL:
+                    raise WalFrameError(
+                        f"expected FRAME_WAL on TAG_WAL, got "
+                        f"{rec.get('_frame')!r}")
+                saw += 1
+                try:
                     if self.apply_record(rec):
                         n += 1
+                except WalGapError:
+                    if not resync:
+                        raise
+                    rpt = self.catch_up()
+                    n += rpt.records
+                    # the gapped record is ≤ the catch-up horizon now —
+                    # re-offer it so a post-horizon record still applies
+                    if int(rec["seq"]) > self.index._applied_seq:
+                        if self.apply_record(rec):
+                            n += 1
+        finally:
+            if saw:
+                self._ack()
 
     # -- catch-up ------------------------------------------------------
 
@@ -379,7 +630,7 @@ class WalFollower:
             frame = decode_frame(
                 self.mailbox.get(self.leader, self.rank, TAG_CATCHUP,
                                  timeout))
-            kind = int(frame["_frame"])
+            kind = frame_kind(frame)
             if kind == FRAME_END:
                 through = int(frame["through_seq"])
                 break
@@ -391,6 +642,7 @@ class WalFollower:
                 # a gap INSIDE the served stream is a protocol error —
                 # let WalGapError propagate; duplicates are fine
                 records += 1
+        self._ack()
         dt = time.monotonic() - t0
         if obs.enabled():
             obs.observe("replica_catchup_seconds", dt)

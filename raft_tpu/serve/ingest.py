@@ -54,7 +54,25 @@ from raft_tpu.runtime import limits
 from raft_tpu.serve.executor import Executor, Service
 from raft_tpu.serve.queue import bucket_ladder
 
-__all__ = ["StreamingKnnService", "IngestController"]
+__all__ = ["StreamingKnnService", "IngestController", "NotLeaderError"]
+
+
+class NotLeaderError(RuntimeError):
+    """A mutation reached a controller whose replica is not the fleet
+    leader. Carries the redirect: ``leader`` is the rank clients should
+    re-send the write to (with the SAME ``write_id`` — the seq-dedup
+    map makes the replay idempotent even when the original leader
+    applied it before dying)."""
+
+    def __init__(self, *, leader: int, rank: Optional[int] = None):
+        where = f"replica rank {rank}" if rank is not None else \
+            "a follower replica"
+        super().__init__(
+            f"not the leader: {where} cannot accept writes; redirect "
+            f"to leader rank {leader} (replay with the same write_id — "
+            f"the seq-dedup map makes the retry idempotent)")
+        self.leader = int(leader)
+        self.rank = rank
 
 
 class StreamingKnnService(Service):
@@ -220,7 +238,7 @@ class IngestController:
                  refit: bool = True,
                  warm_buckets: Optional[Sequence[int]] = None,
                  extra_services: Sequence[Service] = (),
-                 shipper=None):
+                 shipper=None, election=None):
         self.stream = stream
         self.streaming_services: List[StreamingKnnService] = \
             list(services)
@@ -246,6 +264,23 @@ class IngestController:
             raise ValueError(
                 "shipper replicates a different StreamingIndex than "
                 "this controller's")
+        # Leader failover (ISSUE 20): an election.ElectionNode makes
+        # the controller leader-aware — mutations on a follower raise
+        # the typed NotLeaderError redirect, and role changes roll the
+        # serving snapshot forward on the node's worker thread. The
+        # election node owns the shipper while leading, so the two
+        # wirings are mutually exclusive.
+        self.election = election
+        if election is not None:
+            if shipper is not None:
+                raise ValueError(
+                    "pass shipper= OR election= — the election node "
+                    "owns the WAL shipper across role changes")
+            if election.index is not stream:
+                raise ValueError(
+                    "election node coordinates a different "
+                    "StreamingIndex than this controller's")
+            self._wire_election(election)
         self._serve_lock = threading.Lock()
         self._warm_buckets = (list(warm_buckets)
                               if warm_buckets is not None else None)
@@ -265,6 +300,8 @@ class IngestController:
         if self.shipper is not None:
             self.shipper.attach()
             self.shipper.start()
+        if self.election is not None:
+            self.election.start()
         self.executor.start()
         self.compactor.start()
         return self
@@ -281,11 +318,15 @@ class IngestController:
             try:
                 self.executor.stop()
             finally:
-                if self.shipper is not None:
-                    try:
-                        self.shipper.stop()
-                    finally:
-                        self.shipper.detach()
+                try:
+                    if self.shipper is not None:
+                        try:
+                            self.shipper.stop()
+                        finally:
+                            self.shipper.detach()
+                finally:
+                    if self.election is not None:
+                        self.election.stop()
 
     def __enter__(self) -> "IngestController":
         return self.start()
@@ -293,20 +334,67 @@ class IngestController:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # -- leader awareness (ISSUE 20) ----------------------------------
+
+    def is_leader(self) -> bool:
+        """True when this controller accepts writes (no election wired
+        = the single-node regime, always the leader)."""
+        return self.election is None or self.election.is_leader()
+
+    @property
+    def leader(self) -> Optional[int]:
+        """The fleet's current leader rank (None without an election)."""
+        return None if self.election is None else self.election.leader
+
+    def _require_leader(self) -> None:
+        el = self.election
+        if el is not None and not el.is_leader():
+            if obs.enabled():
+                obs.inc("serve_not_leader_rejects_total")
+            raise NotLeaderError(leader=el.leader, rank=el.rank)
+
+    def _wire_election(self, election) -> None:
+        """Chain the controller into the node's role-change hooks: a
+        role switch rolls the serving snapshot forward on the worker
+        thread. Promotion is content-neutral (the KIND_TERM record
+        moves no rows), so the publish never changes operand shapes —
+        the warmed executables survive and the query path sees ZERO
+        recompiles; a demotion's snapshot resync MAY change shapes and
+        pays its rewarm here, off the query path, like any ingest."""
+        def chain(prev):
+            def hook(node):
+                self._on_index_change()
+                obs.emit_event("serve.ingest_role_change",
+                               role=node.role, term=node.index.term,
+                               leader=node.leader)
+                if prev is not None:
+                    prev(node)
+            return hook
+        election.on_promote = chain(election.on_promote)
+        election.on_repoint = chain(election.on_repoint)
+        election.on_demote = chain(election.on_demote)
+
     # -- ingest surface -----------------------------------------------
 
-    def insert(self, rows, labels: Optional[np.ndarray] = None
-               ) -> np.ndarray:
+    def insert(self, rows, labels: Optional[np.ndarray] = None, *,
+               write_id: Optional[int] = None) -> np.ndarray:
         """Journal + apply an insert, then roll the serving snapshot
-        forward. Returns the assigned external ids."""
-        ids = self.stream.insert(rows, labels)
+        forward. Returns the assigned external ids. On a follower
+        replica raises the typed :class:`NotLeaderError` redirect;
+        pass ``write_id`` so an in-flight batch replayed at the new
+        leader after failover lands exactly once (seq-dedup)."""
+        self._require_leader()
+        ids = self.stream.insert(rows, labels, write_id=write_id)
         self._on_index_change()
         return ids
 
     def delete(self, ids) -> int:
         """Tombstone ids, then roll the serving snapshot forward —
         always same-shape (the per-epoch fixed bitset), so the publish
-        is immediate and the warmed executables survive."""
+        is immediate and the warmed executables survive. Deletes are
+        naturally idempotent, so the failover replay needs no
+        write_id. Raises :class:`NotLeaderError` on a follower."""
+        self._require_leader()
         n = self.stream.delete(ids)
         self._on_index_change()
         return n

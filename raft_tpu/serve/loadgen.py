@@ -746,6 +746,60 @@ def chaos_kill_mid_spike(group, op: str, *, clients: int = 8,
     return rep
 
 
+def chaos_kill_leader(group, op: str, *, clients: int = 8,
+                      rows: int = 4, phase_s: float = 2.0,
+                      kill_after_s: Optional[float] = None,
+                      promote=None,
+                      tenants: Optional[Sequence[str]] = None,
+                      deadline_s: Optional[float] = None,
+                      seed: int = 0) -> ChaosReport:
+    """The fleet's WRITE leader dies at the peak of a closed-loop
+    spike (ISSUE 20, the serve half of a leader election): queries
+    keep routing across the survivors throughout, and a survivor is
+    promoted via :meth:`ReplicaGroup.promote` — the leader MARKER
+    moves, no data does, so the promotion itself is recompile-free.
+
+    ``promote`` picks the successor from the group (default: the
+    first healthy survivor — a real fleet passes the election
+    winner's replica here). Stamps both failover clocks the CI gate
+    reads: ``time_to_new_leader_s`` (kill to promote-returned) and
+    ``recovery_time_to_slo_s`` (kill to the first subsequent
+    completion meeting the tenant SLO)."""
+    rep = ChaosReport(scenario="kill_leader")
+    # make the write leader the replica the kill machinery targets
+    leader = group.promote(group.healthy()[-1].name)
+    state: Dict[str, float] = {}
+
+    def kill_leader(target) -> None:
+        t_kill = time.monotonic()
+        group.fail_replica(target, "leader killed")
+        pick = promote(group) if promote is not None \
+            else group.healthy()[0]
+        group.promote(getattr(pick, "name", pick))
+        state["time_to_new_leader_s"] = time.monotonic() - t_kill
+
+    fr = fleet_closed_loop(
+        group, op, clients=clients, rows=rows, duration_s=phase_s,
+        tenants=tenants, deadline_s=deadline_s, seed=seed,
+        kill_after_s=kill_after_s
+        if kill_after_s is not None else phase_s / 3,
+        kill=kill_leader)
+    rep.phases["spike"] = fr.as_dict()
+    rep.rejected_total = (fr.fleet.rejected if fr.fleet else 0) \
+        + fr.router_rejected
+    rep.hedges_issued = fr.hedges_issued
+    rep.hedges_won = fr.hedges_won
+    rep.hedge_rate = fr.hedge_rate
+    rep.notes["killed_leader"] = fr.killed
+    rep.notes["old_leader"] = leader.name
+    new = group.leader
+    rep.notes["new_leader"] = None if new is None else new.name
+    rep.notes["time_to_new_leader_s"] = state.get(
+        "time_to_new_leader_s")
+    rep.notes["recovery_time_to_slo_s"] = fr.recovery_time_to_slo_s
+    return rep
+
+
 @dataclass
 class StreamingReport:
     """One streaming-ingest load run (ISSUE 17): sustained inserts +
@@ -1104,6 +1158,7 @@ CHAOS_SCENARIOS = {
     "slow_replica": chaos_slow_replica,
     "hog_tenant": chaos_hog_tenant,
     "kill_mid_spike": chaos_kill_mid_spike,
+    "kill_leader": chaos_kill_leader,
 }
 
 

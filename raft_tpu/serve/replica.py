@@ -381,6 +381,7 @@ class ReplicaGroup:
                           for n, e, w in zip(names, executors, weights)]
         self.comms = comms
         self.on_shrink = on_shrink
+        self._leader: Optional[str] = None   # write-leader marker
         self.stats = ReplicaGroupStats()
         self._lock = threading.Lock()
         self._started = False
@@ -420,6 +421,8 @@ class ReplicaGroup:
                 return
             r.healthy = False
             r.failed_reason = reason
+            if self._leader == r.name:
+                self._leader = None     # until the election promotes
             self.stats.failures += 1
         obs.inc("serve_replica_failures_total", 1, replica=r.name)
         obs.emit_event("serve.replica_failed", replica=r.name,
@@ -469,6 +472,38 @@ class ReplicaGroup:
         obs.emit_event("serve.replica_spawn", replica=name,
                        weight=float(weight), warmed=bool(warm))
         return rep
+
+    def promote(self, which) -> Replica:
+        """Re-point write routing at a new leader replica (ISSUE 20:
+        the serving-tier half of a fleet election, called from the
+        election node's ``on_promote`` hook or by the orchestrator).
+
+        Deliberately does NOT touch any executor: the promoted
+        replica's index was already the most-caught-up mirror, its
+        serving snapshot is already published, and the role change
+        moves no rows — so the warmed executables survive verbatim and
+        the query path sees ZERO post-promotion recompiles (the chaos
+        witness asserts this via ``ExecutorStats.traces``). Queries
+        keep routing across every healthy replica; only the leader
+        marker — where :class:`~raft_tpu.serve.ingest.IngestController`
+        mutations must land — moves."""
+        r = self._resolve(which)
+        if not r.healthy:
+            raise ValueError(
+                f"cannot promote failed replica {r.name!r} "
+                f"({r.failed_reason}); rejoin it first")
+        with self._lock:
+            prev, self._leader = self._leader, r.name
+        obs.emit_event("serve.replica_promoted", replica=r.name,
+                       previous=prev)
+        obs.inc("serve_replica_promotions_total", 1, replica=r.name)
+        return r
+
+    @property
+    def leader(self) -> Optional[Replica]:
+        """The current write-leader replica (None until promoted)."""
+        name = self._leader
+        return None if name is None else self._resolve(name)
 
     def fail_replica(self, which, reason: str = "killed") -> Replica:
         """The in-process kill: gate the replica out, tear its drain

@@ -1,0 +1,503 @@
+"""Worker + orchestrator for the leader-failover chaos witness
+(ISSUE 20 acceptance: SIGKILL the leader of a real-TCP 3-node fleet
+mid-stream in quorum-ack mode; the survivors elect the most-caught-up
+follower, writes resume through the promoted controller, every
+client-acked seq is present on the new leader with ``content_crc``
+bit-equal to a never-killed twin's replay of the same durable prefix,
+and the stale old leader rejoins, truncates its unreplicated suffix
+behind a typed :class:`TermFencedError`, and converges bit-equal).
+
+Roles (``python tests/_failover_worker.py <role> ...``):
+
+``leader --dir D --addrs A0 A1 A2``
+    Rank 0: builds the journaled index, runs an
+    :class:`~raft_tpu.neighbors.election.ElectionNode` as leader with
+    ``acks="majority"``, waits for both followers' READY, then streams
+    the deterministic op sequence — each op blocks until
+    quorum-acked and prints ``ACKED seq=<s>`` — and SIGKILLs itself
+    after ``KILL_AT_ACKS`` acked ops (``LEADER_SUICIDE wall=<t>``).
+
+``follower --dir D --addrs A0 A1 A2 --rank R``
+    Ranks 1 and 2: bootstrap over the wire (snapshot resync), then run
+    a full serving stack — :class:`StreamingKnnService` +
+    :class:`IngestController` wired to an election node — so the
+    promotion's zero-recompile contract is witnessed on a live
+    executor. After the leader dies, exactly one follower prints
+    ``PROMOTED rank=<r> ... ballot_applied=<a> crc=<c>
+    traces_pre=<n> traces_post=<n>`` (crc is captured before any
+    resumed write, so it is the durable-prefix CRC the clean twin must
+    match); the other prints ``REDIRECT leader=<r>`` (the typed
+    NotLeaderError redirect) and ``LOSER_OK crc=<c>``. The winner then
+    resumes quorum-acked writes, shepherds the stale leader's rejoin
+    (HELLO/GO handshake), and prints ``WINNER_FINAL crc=<c>``.
+
+``rejoin --dir D --addrs A0 A1 A2``
+    Rank 0 restarted: recovers the killed leader's journal, appends a
+    deliberately unreplicated term-0 suffix (the partitioned-leader
+    writes), waits for the new leader's GO (a term-0 heartbeat
+    mid-election would read as the old leader returning), then starts
+    a stale election node that still believes it leads — and gets
+    fenced, truncates, demotes, and heals. Prints ``REJOIN_OK
+    fenced=TermFencedError divergence=<s> truncated=<n> crc=<c>``.
+
+``clean --dir D --records N``
+    The never-killed twin: replays the first N ops of the identical
+    deterministic sequence in-process and prints ``CLEAN_OK crc=<c>``.
+
+``orchestrate``
+    Runs the whole dance in subprocesses and asserts: leader rc is
+    −9; election lands inside 2x the transport heartbeat timeout; the
+    winner carried the max ballot (most-caught-up); every acked seq
+    is within the winner's ballot prefix (zero acked-write loss); the
+    promotion CRC equals the clean twin's replay (bit-equal durable
+    prefix); zero post-promotion retraces; the loser's redirect names
+    the winner; final CRCs converge three ways; and the rejoiner's
+    divergence equals ``ballot_applied + 1`` with a non-empty
+    truncation. Prints ``FAILOVER_CHAOS_OK ...`` — ci/smoke.sh gates
+    on it.
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+N_DB, DIM, N_LISTS = 160, 8, 8
+B_ROWS = 6
+KILL_AT_ACKS = 12       # acked ops before the leader SIGKILLs itself
+RESUME_OPS = 3          # post-promotion quorum-acked writes
+K, NPROBE = 5, 4
+HB_INTERVAL, HB_TIMEOUT = 0.3, 2.0      # transport failure detector
+ELECTION_TIMEOUT = 1.0                  # app-level silence threshold
+TAG_READY, TAG_DONE = 7400, 7401
+TAG_FINAL, TAG_HELLO, TAG_GO = 7402, 7403, 7404
+
+
+def _op_stream():
+    """The deterministic op sequence both twins run. Each op is
+    exactly ONE WAL record, so a replay of the first N ops reproduces
+    the content of any N-record durable prefix bit-for-bit."""
+    import itertools
+
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    next_id = N_DB
+    for n in itertools.count():
+        if n % 4 == 3:
+            prev = list(range(next_id - B_ROWS, next_id))
+            yield n, ("delete", np.asarray(prev[::3], np.int64))
+        else:
+            yield n, ("insert",
+                      rng.normal(size=(B_ROWS, DIM)).astype(np.float32))
+            next_id += B_ROWS
+
+
+def _apply_op(idx, n, op):
+    kind, payload = op
+    if kind == "insert":
+        idx.insert(payload, write_id=n)
+    else:
+        idx.delete(payload)
+
+
+def _build(directory):
+    import numpy as np
+
+    from raft_tpu.neighbors import streaming
+
+    rng = np.random.default_rng(7)
+    db = rng.normal(size=(N_DB, DIM)).astype(np.float32)
+    idx = streaming.stream_build(None, db, N_LISTS, seed=0, max_iter=4,
+                                 directory=directory, repack_slack=64)
+    # provision tail slack up front: the whole op stream then fits
+    # without a shape-changing repack, so the promotion's snapshot
+    # roll is content-only and the zero-recompile witness is strict
+    idx.compact(reason="provision")
+    return idx
+
+
+def _node_kw():
+    return dict(acks="majority", ack_timeout=30.0,
+                heartbeat_interval=0.25,
+                election_timeout=ELECTION_TIMEOUT, poll_interval=0.02)
+
+
+def run_clean(directory, records):
+    idx = _build(directory)
+    for n, op in _op_stream():
+        if n >= records:
+            break
+        _apply_op(idx, n, op)
+    print(f"CLEAN_OK crc={idx.content_crc()} applied={idx.applied_seq}",
+          flush=True)
+
+
+def run_leader(directory, addrs):
+    import numpy as np
+
+    from raft_tpu.comms.tcp_mailbox import TcpMailbox
+    from raft_tpu.neighbors.election import ElectionNode
+
+    box = TcpMailbox(0, addrs, heartbeat_interval=HB_INTERVAL,
+                     heartbeat_timeout=HB_TIMEOUT)
+    idx = _build(directory)
+    node = ElectionNode(idx, box, 0, [0, 1, 2], role="leader", leader=0,
+                        **_node_kw())
+    node.start()
+    for r in (1, 2):
+        np.asarray(box.get(r, 0, TAG_READY, timeout=240.0))
+    for n, op in _op_stream():
+        if n >= KILL_AT_ACKS:
+            break
+        _apply_op(idx, n, op)       # blocks until quorum-acked
+        print(f"ACKED seq={idx.applied_seq} op={n}", flush=True)
+        time.sleep(0.02)
+    print(f"LEADER_SUICIDE wall={time.time():.6f} seq={idx.applied_seq}",
+          flush=True)
+    sys.stdout.flush()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def run_follower(directory, addrs, rank):
+    import numpy as np
+
+    from raft_tpu import serve
+    from raft_tpu.comms.errors import CommsTimeoutError, PeerFailedError
+    from raft_tpu.comms.tcp_mailbox import TcpMailbox
+    from raft_tpu.neighbors.election import ElectionNode
+    from raft_tpu.neighbors.wal_ship import WalFollower, bootstrap_follower
+    from raft_tpu.serve.ingest import NotLeaderError
+
+    box = TcpMailbox(rank, addrs, heartbeat_interval=HB_INTERVAL,
+                     heartbeat_timeout=HB_TIMEOUT)
+    idx = bootstrap_follower(None, dim=DIM, n_lists=N_LISTS,
+                             directory=directory)
+    wf = WalFollower(idx, box, rank, 0)
+    wf.catch_up(timeout=120.0)      # snapshot resync: the base build
+    svc = serve.StreamingKnnService(idx, k=K, nprobe=NPROBE)
+    node = ElectionNode(idx, box, rank, [0, 1, 2], role="follower",
+                        leader=0, follower=wf, **_node_kw())
+    ctl = serve.IngestController(
+        idx, [svc], policy=serve.BatchPolicy(max_batch=8, max_wait_ms=2.0),
+        compact_interval=30.0, refit=False, warm_buckets=[4],
+        election=node)
+    ctl.start()
+    q = np.random.default_rng(40 + rank).normal(
+        size=(4, DIM)).astype(np.float32)
+    ctl.submit(svc.name, q).result(timeout=120.0)   # flush first-touch
+    box.put(rank, 0, TAG_READY, np.asarray([rank], np.int64))
+
+    deadline = time.monotonic() + 240.0
+    while True:
+        assert time.monotonic() < deadline, (node.role, node._error)
+        if node._error is not None:
+            raise node._error
+        if node.role == "leader" and node.last_election is not None:
+            won = True
+            break
+        # role flips before last_election lands on the winner: only a
+        # settled FOLLOWER pointing away from rank 0 is the loser
+        if node.role == "follower" and node.leader != 0:
+            won = False
+            break
+        time.sleep(0.02)
+
+    if won:
+        # last_election is stored after _promote returns, so the
+        # promotion hook (and any off-path rewarm it paid) is done:
+        # from here on the serving path must be compile-free
+        rec = node.last_election
+        t_pre = ctl.executor.stats.traces
+        ctl.submit(svc.name, q).result(timeout=120.0)
+        t_post = ctl.executor.stats.traces
+        ballot_applied = rec.votes[rec.winner][1]
+        votes_s = ";".join(f"{r}:{a}" for r, (_t, a)
+                           in sorted(rec.votes.items()))
+        print(f"PROMOTED rank={rank} wall={time.time():.6f} "
+              f"term={idx.term} ballot_applied={ballot_applied} "
+              f"applied={idx.applied_seq} crc={idx.content_crc()} "
+              f"votes={votes_s} seconds={rec.seconds:.3f} "
+              f"traces_pre={t_pre} traces_post={t_post}", flush=True)
+        rng2 = np.random.default_rng(100)
+        loser = 3 - rank
+        try:
+            for j in range(RESUME_OPS):     # quorum-acked by the loser
+                ctl.insert(rng2.normal(size=(4, DIM)).astype(np.float32),
+                           write_id=1000 + j)
+        except Exception:
+            sh = node.shipper
+            print(f"WINNER_STUCK followers={sh.followers} "
+                  f"shipped={sh.shipped} acked={sh.acked_seq(loser)} "
+                  f"applied={idx.applied_seq}", flush=True)
+            raise
+        final_applied = idx.applied_seq
+        box.put(rank, loser, TAG_FINAL,
+                np.asarray([final_applied], np.int64))
+        # shepherd the stale leader's rejoin: wait for its HELLO, then
+        # GO (carrying the convergence target) once our writes are in
+        while True:
+            assert time.monotonic() < deadline, "no HELLO from rank 0"
+            box.revive_peer(0)
+            if box.get_nowait(0, rank, TAG_HELLO) is not None:
+                break
+            time.sleep(0.1)
+        box.put(rank, 0, TAG_GO, np.asarray([final_applied], np.int64))
+        while True:
+            try:
+                np.asarray(box.get(0, rank, TAG_DONE, timeout=5.0))
+                break
+            except (PeerFailedError, CommsTimeoutError):
+                assert time.monotonic() < deadline, "no DONE from rank 0"
+                box.revive_peer(0)
+        box.put(rank, loser, TAG_DONE, np.asarray([1], np.int64))
+        print(f"WINNER_FINAL crc={idx.content_crc()} "
+              f"applied={idx.applied_seq}", flush=True)
+        time.sleep(0.2)             # let the shutdown frame flush
+    else:
+        # the typed redirect: a write on a follower names the leader
+        # and invites an idempotent same-write_id replay there
+        try:
+            ctl.insert(np.zeros((2, DIM), np.float32), write_id=9999)
+            print("REDIRECT_FAIL no NotLeaderError", flush=True)
+        except NotLeaderError as exc:
+            print(f"REDIRECT leader={exc.leader}", flush=True)
+        winner = node.leader
+        fin = None
+        last_report = time.monotonic()
+        while fin is None:
+            assert time.monotonic() < deadline, "no FINAL from winner"
+            fin = box.get_nowait(winner, rank, TAG_FINAL)
+            if time.monotonic() - last_report > 5.0:
+                last_report = time.monotonic()
+                print(f"LOSER_STATE applied={idx.applied_seq} "
+                      f"term={idx.term} leader={node.leader} "
+                      f"role={node.role} err={node._error!r}",
+                      flush=True)
+            time.sleep(0.02)
+        target = int(np.asarray(fin)[0])
+        while idx.applied_seq < target:
+            assert time.monotonic() < deadline, \
+                (idx.applied_seq, target, node._error)
+            time.sleep(0.02)
+        time.sleep(0.5)             # let the last apply's swap settle
+        print(f"LOSER_OK rank={rank} crc={idx.content_crc()} "
+              f"applied={idx.applied_seq}", flush=True)
+        # stay up through the stale leader's rejoin — an early exit
+        # would leave its HELLO puts blocking on a dead-peer reconnect
+        np.asarray(box.get(winner, rank, TAG_DONE, timeout=180.0))
+    ctl.stop()
+    box.close()
+
+
+def run_rejoin(directory, addrs):
+    import numpy as np
+
+    from raft_tpu.comms.tcp_mailbox import TcpMailbox
+    from raft_tpu.neighbors.election import ElectionNode
+    from raft_tpu.neighbors.streaming import StreamingIndex
+
+    box = TcpMailbox(0, addrs, heartbeat_interval=HB_INTERVAL,
+                     heartbeat_timeout=HB_TIMEOUT)
+    idx = StreamingIndex.recover(None, directory)
+    resumed = idx.applied_seq
+    # the partitioned-leader writes: a term-0 suffix the fleet never saw
+    rng = np.random.default_rng(55)
+    idx.insert(rng.normal(size=(5, DIM)).astype(np.float32))
+    stale_applied = idx.applied_seq
+    print(f"REJOIN_RECOVERED resumed={resumed} "
+          f"stale_applied={stale_applied} term={idx.term}", flush=True)
+    hello = np.asarray([0], np.int64)
+    winner = target = None
+    deadline = time.monotonic() + 240.0
+    while target is None:
+        assert time.monotonic() < deadline, "no GO from the new leader"
+        for p in (1, 2):
+            try:
+                box.put(0, p, TAG_HELLO, hello)
+            except Exception:       # noqa: BLE001 — peer may be gone
+                pass
+            got = box.get_nowait(p, 0, TAG_GO)
+            if got is not None:
+                winner, target = p, int(np.asarray(got)[0])
+                break
+        time.sleep(0.1)
+    # start a node that still believes it leads at term 0: the fleet
+    # fences it, it truncates the suffix, demotes, and heals
+    node = ElectionNode(idx, box, 0, [0, 1, 2], role="leader", leader=0,
+                        **_node_kw())
+    node.start()
+    while not (node.role == "follower" and node.last_fence is not None):
+        assert time.monotonic() < deadline, (node.role, node._error)
+        if node._error is not None:
+            raise node._error
+        time.sleep(0.02)
+    while idx.applied_seq < target:
+        assert time.monotonic() < deadline, \
+            (idx.applied_seq, target, node._error)
+        time.sleep(0.02)
+    time.sleep(0.5)
+    fence = node.last_fence
+    truncated = stale_applied - fence.divergence + 1
+    print(f"REJOIN_OK fenced={type(fence).__name__} "
+          f"divergence={fence.divergence} truncated={truncated} "
+          f"term={idx.term} crc={idx.content_crc()} "
+          f"applied={idx.applied_seq}", flush=True)
+    box.put(0, winner, TAG_DONE, np.asarray([1], np.int64))
+    time.sleep(0.2)                 # let the DONE frame flush
+    node.stop()
+    box.close()
+
+
+# -- orchestrator ------------------------------------------------------
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _field(out, marker, key):
+    import re
+
+    m = re.search(rf"{marker}\b.*\b{key}=([\w.:;+-]+)", out)
+    assert m, f"missing {marker} {key}= in:\n{out}"
+    return m.group(1)
+
+
+def orchestrate():
+    import re
+    import tempfile
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    me = os.path.abspath(__file__)
+
+    def launch(args):
+        return subprocess.Popen([sys.executable, me] + args, cwd=_REPO,
+                                env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        d_lead = os.path.join(tmp, "leader")
+        d_f1 = os.path.join(tmp, "f1")
+        d_f2 = os.path.join(tmp, "f2")
+        d_clean = os.path.join(tmp, "clean")
+        addrs = [f"127.0.0.1:{p}" for p in _free_ports(3)]
+        leader = launch(["leader", "--dir", d_lead, "--addrs"] + addrs)
+        f1 = launch(["follower", "--dir", d_f1, "--rank", "1",
+                     "--addrs"] + addrs)
+        f2 = launch(["follower", "--dir", d_f2, "--rank", "2",
+                     "--addrs"] + addrs)
+        out0 = leader.communicate(timeout=300)[0]
+        assert leader.returncode == -9, \
+            f"leader was not SIGKILLed (rc={leader.returncode}):\n{out0}"
+        assert "LEADER_SUICIDE" in out0, out0
+        # the restarted leader binds the dead process's port; it holds
+        # off its stale node until the winner's GO, so launching now
+        # (mid-election) is safe
+        rejoin = launch(["rejoin", "--dir", d_lead, "--addrs"] + addrs)
+        out_r = rejoin.communicate(timeout=300)[0]
+        assert rejoin.returncode == 0, f"rejoin failed:\n{out_r}"
+        out1 = f1.communicate(timeout=300)[0]
+        assert f1.returncode == 0, f"follower 1 failed:\n{out1}"
+        out2 = f2.communicate(timeout=300)[0]
+        assert f2.returncode == 0, f"follower 2 failed:\n{out2}"
+
+        w_out, l_out = (out1, out2) if "PROMOTED" in out1 else (out2, out1)
+        assert "PROMOTED" in w_out and "PROMOTED" not in l_out, \
+            f"expected exactly one promotion:\n{out1}\n{out2}"
+        ballot_applied = int(_field(w_out, "PROMOTED", "ballot_applied"))
+        clean = launch(["clean", "--dir", d_clean, "--records",
+                        str(ballot_applied + 1)])
+        out_c = clean.communicate(timeout=300)[0]
+        assert clean.returncode == 0, f"clean twin failed:\n{out_c}"
+
+    # zero acked-write loss: every seq the client saw acked is inside
+    # the winner's ballot prefix (quorum intersection: some survivor
+    # acked it, and the election picked the max-applied survivor)
+    acked = [int(s) for s in re.findall(r"ACKED seq=(\d+)", out0)]
+    assert len(acked) == KILL_AT_ACKS and max(acked) <= ballot_applied, \
+        f"acked={acked} ballot_applied={ballot_applied}\n{out0}\n{w_out}"
+    # the election landed inside 2x the transport heartbeat timeout
+    elected_in = (float(_field(w_out, "PROMOTED", "wall"))
+                  - float(_field(out0, "LEADER_SUICIDE", "wall")))
+    assert elected_in < 2 * HB_TIMEOUT, \
+        f"election took {elected_in:.2f}s >= {2 * HB_TIMEOUT}s\n{w_out}"
+    # most-caught-up follower won
+    winner = int(_field(w_out, "PROMOTED", "rank"))
+    votes = dict(pair.split(":") for pair
+                 in _field(w_out, "PROMOTED", "votes").split(";"))
+    assert int(votes[str(winner)]) == max(int(a) for a in votes.values())
+    assert int(_field(w_out, "PROMOTED", "term")) == 1, w_out
+    # durable prefix bit-equal to the never-killed twin's replay
+    crc_prom = _field(w_out, "PROMOTED", "crc")
+    crc_clean = _field(out_c, "CLEAN_OK", "crc")
+    assert crc_prom == crc_clean, \
+        f"promoted prefix diverged from clean twin: {crc_prom} != " \
+        f"{crc_clean}"
+    # zero post-promotion retraces on the serving path
+    t_pre = int(_field(w_out, "PROMOTED", "traces_pre"))
+    t_post = int(_field(w_out, "PROMOTED", "traces_post"))
+    assert t_post == t_pre, f"post-promotion retrace: {t_pre}->{t_post}"
+    # the loser's typed redirect names the winner
+    assert int(_field(l_out, "REDIRECT", "leader")) == winner, l_out
+    # final three-way convergence
+    crc_final = _field(w_out, "WINNER_FINAL", "crc")
+    assert _field(l_out, "LOSER_OK", "crc") == crc_final, \
+        f"loser diverged\n{l_out}\n{w_out}"
+    assert _field(out_r, "REJOIN_OK", "crc") == crc_final, \
+        f"rejoined leader diverged\n{out_r}\n{w_out}"
+    # the stale leader truncated a non-empty suffix at exactly the
+    # fence's divergence point (the winner's term boundary)
+    assert _field(out_r, "REJOIN_OK", "fenced") == "TermFencedError"
+    divergence = int(_field(out_r, "REJOIN_OK", "divergence"))
+    assert divergence == ballot_applied + 1, out_r
+    truncated = int(_field(out_r, "REJOIN_OK", "truncated"))
+    assert truncated >= 1, out_r
+    assert int(_field(out_r, "REJOIN_OK", "term")) == 1, out_r
+    print(f"FAILOVER_CHAOS_OK winner={winner} elected_in={elected_in:.2f} "
+          f"acked={len(acked)} ballot_applied={ballot_applied} "
+          f"truncated={truncated} crc={crc_final}", flush=True)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("role", choices=["leader", "follower", "rejoin",
+                                    "clean", "orchestrate"])
+    p.add_argument("--dir")
+    p.add_argument("--addrs", nargs="*", default=[])
+    p.add_argument("--rank", type=int, default=None)
+    p.add_argument("--records", type=int, default=None)
+    a = p.parse_args(argv)
+    if a.role == "orchestrate":
+        orchestrate()
+    elif a.role == "clean":
+        run_clean(a.dir, a.records)
+    elif a.role == "leader":
+        run_leader(a.dir, a.addrs)
+    elif a.role == "rejoin":
+        run_rejoin(a.dir, a.addrs)
+    else:
+        assert a.rank in (1, 2)
+        run_follower(a.dir, a.addrs, a.rank)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
